@@ -1,0 +1,23 @@
+"""kubeai_tpu — a TPU-native Kubernetes AI inference framework.
+
+A ground-up rebuild of the capabilities of substratusai/kubeai (reference:
+/root/reference), designed TPU-first:
+
+- **Serving engine** (`kubeai_tpu.engine`, `kubeai_tpu.models`,
+  `kubeai_tpu.ops`, `kubeai_tpu.parallel`): a JAX/XLA/Pallas inference
+  engine — continuous batching, slot-based KV cache, pjit/GSPMD tensor
+  parallelism over a TPU device mesh, Pallas attention kernels — replacing
+  the CUDA vLLM images the reference delegates to
+  (reference: charts/kubeai/values.yaml:45-48).
+- **Operator control plane** (`kubeai_tpu.operator`, `kubeai_tpu.crd`,
+  `kubeai_tpu.config`): Model resource + reconciler + pod planner with
+  surge rollouts, scale-from-zero, model-artifact caching and LoRA adapter
+  orchestration (reference: internal/modelcontroller).
+- **Routing tier** (`kubeai_tpu.routing`): OpenAI-compatible front door,
+  prefix-aware CHWBL load balancer, retrying proxy, pub/sub messenger
+  (reference: internal/{openaiserver,loadbalancer,modelproxy,messenger}).
+- **Autoscaler** (`kubeai_tpu.autoscaler`): metrics-driven, leader-elected,
+  state-persisted (reference: internal/modelautoscaler).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,81 @@
+"""Slot-based KV cache.
+
+A fixed buffer [num_layers, num_slots, max_len, kv_heads, head_dim] per of
+K and V. Slots are the continuous-batching unit: a request owns one slot
+from prefill-insert to completion. Static shapes keep the decode graph
+compiled once; slot bookkeeping (free list) is host-side Python, outside jit.
+
+Sharding: slots on `dp`, kv_heads on `tp` — within a slice the cache is
+sharded exactly like the attention heads so decode attention needs no
+cross-device traffic beyond the existing TP collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from kubeai_tpu.parallel import sharding as sh
+
+
+@dataclasses.dataclass
+class KVCache:
+    k: jax.Array  # [NL, slots, max_len, KVH, D]
+    v: jax.Array  # [NL, slots, max_len, KVH, D]
+
+    @property
+    def num_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+    @staticmethod
+    def logical_axes() -> tuple:
+        return (None, sh.KV_SLOTS, None, sh.KV_HEADS, None)
+
+    @staticmethod
+    def create(
+        num_layers: int,
+        num_slots: int,
+        max_len: int,
+        kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,
+        sharding=None,
+    ) -> "KVCache":
+        shape = (num_layers, num_slots, max_len, kv_heads, head_dim)
+        if sharding is not None:
+            zeros = jax.jit(
+                partial(jnp.zeros, shape, dtype), out_shardings=sharding
+            )
+            return KVCache(k=zeros(), v=zeros())
+        return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+jax.tree_util.register_dataclass(KVCache, ["k", "v"], [])
+
+
+def insert_sequence(
+    cache_k: jax.Array,  # [NL, slots, max_len, KVH, D]
+    cache_v: jax.Array,
+    k_new: jax.Array,  # [NL, S, KVH, D] (one sequence, padded to S)
+    v_new: jax.Array,
+    slot: jax.Array,  # scalar int32
+) -> tuple[jax.Array, jax.Array]:
+    """Write a prefilled sequence's KV into a slot (positions 0..S-1).
+
+    S is a padded bucket length ≤ max_len; padded tail positions hold
+    garbage but are masked by the per-slot length at attention time.
+    """
+    start = (jnp.zeros((), jnp.int32), slot, jnp.zeros((), jnp.int32),
+             jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+    k_new = k_new[:, None]  # [NL, 1, S, KVH, D]
+    v_new = v_new[:, None]
+    cache_k = jax.lax.dynamic_update_slice(cache_k, k_new.astype(cache_k.dtype), start)
+    cache_v = jax.lax.dynamic_update_slice(cache_v, v_new.astype(cache_v.dtype), start)
+    return cache_k, cache_v

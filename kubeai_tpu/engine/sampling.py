@@ -1,0 +1,79 @@
+"""Token sampling: greedy, temperature, top-k, top-p — jit-safe.
+
+All branches are computed with masking (no Python control flow on traced
+values). Semantics match the conventional engine behavior users calibrate
+against: top-k filters first, then top-p operates on the *renormalized*
+post-top-k distribution; the most-likely token always survives (so
+top_p=0.0 degrades to greedy, not to token 0).
+
+Per-request reproducibility: `sample` takes per-row uint32 seeds and the
+current position; the row key is fold_in(PRNGKey(seed), position), so a
+request with a fixed seed replays identically regardless of batch-mates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling config (host-side; arrays built per batch).
+
+    `stop` holds stop *strings*; they operate on detokenized text and are
+    enforced by the server layer (kubeai_tpu.engine.server), not here —
+    the engine core works purely in token space (EOS token ids).
+    """
+
+    temperature: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    top_p: float = 1.0
+    max_tokens: int = 16
+    stop: tuple[str, ...] = ()
+    seed: int | None = None
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+def sample(
+    logits: jnp.ndarray,  # [B, V] float32
+    seeds: jnp.ndarray,  # [B] uint32 per-request seeds
+    positions: jnp.ndarray,  # [B] int32 current position (per-step entropy)
+    temperature: jnp.ndarray,  # [B] (0 = greedy)
+    top_k: jnp.ndarray,  # [B] int32 (0 = off)
+    top_p: jnp.ndarray,  # [B] float32 (1 = off)
+) -> jnp.ndarray:
+    """Vectorized per-request sampling. Returns [B] int32 token ids."""
+    B, V = logits.shape
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # top-k: mask logits below the k-th largest (per row).
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V]
+    k_idx = jnp.clip(jnp.where(top_k > 0, top_k, V) - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)  # [B, 1]
+    scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+
+    # top-p (nucleus) over the RENORMALIZED post-top-k distribution.
+    sorted2 = jnp.sort(scaled, axis=-1)[:, ::-1]  # -inf tail for masked
+    probs_sorted = jax.nn.softmax(sorted2, axis=-1)
+    cumsum = jnp.cumsum(probs_sorted, axis=-1)
+    inside = cumsum - probs_sorted < top_p[:, None]
+    inside = inside.at[:, 0].set(True)  # top-1 always survives
+    cutoff = jnp.where(inside, sorted2, jnp.inf)
+    cutoff_val = jnp.min(cutoff, axis=-1, keepdims=True)
+    scaled = jnp.where(scaled >= cutoff_val, scaled, -jnp.inf)
+
+    def _row(seed, pos, row_logits):
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+        return jax.random.categorical(key, row_logits)
+
+    sampled = jax.vmap(_row)(seeds, positions, scaled).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy_tok, sampled)

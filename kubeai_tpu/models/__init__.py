@@ -1,0 +1,16 @@
+"""Model families served by the TPU engine.
+
+The reference's model catalog (reference: charts/models/values.yaml — ~60
+presets across vLLM/Ollama/Infinity/FasterWhisper engines) maps here to
+native JAX implementations grouped by CRD feature
+(reference: api/k8s/v1/model_types.go:145-153):
+
+  TextGeneration — llama (flagship), gemma, qwen, mixtral (MoE)
+  TextEmbedding  — embeddings (mean-pooled encoder or CLM last-token)
+  SpeechToText   — whisper
+
+All models are pure-functional: params are pytrees of arrays with logical
+sharding axes, forward passes are jittable with static shapes.
+"""
+
+from kubeai_tpu.models.registry import get_model_family, register_model_family

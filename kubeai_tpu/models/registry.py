@@ -1,0 +1,86 @@
+"""Model-family registry: maps HF `architectures` / engine model ids to
+native implementations.
+
+Parity note: the reference selects an engine image by `(engine, imageName)`
+from config (reference: internal/modelcontroller/model_controller.go:321-355);
+here model *code* is selected by architecture, since the engine is in-tree.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+_FAMILIES: dict[str, "ModelFamily"] = {}
+
+
+class ModelFamily:
+    """A family bundle: config parser, param init, prefill/decode fns."""
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        config_from_hf: Callable,
+        tiny_config: Callable,
+        init_params: Callable,
+        param_specs: Callable,
+        prefill: Callable,
+        decode_step: Callable,
+        hf_architectures: tuple[str, ...] = (),
+        feature: str = "TextGeneration",
+    ):
+        self.name = name
+        self.config_from_hf = config_from_hf
+        self.tiny_config = tiny_config
+        self.init_params = init_params
+        self.param_specs = param_specs
+        self.prefill = prefill
+        self.decode_step = decode_step
+        self.hf_architectures = hf_architectures
+        self.feature = feature
+
+
+def register_model_family(family: ModelFamily) -> ModelFamily:
+    _FAMILIES[family.name] = family
+    for arch in family.hf_architectures:
+        _FAMILIES[arch] = family
+    return family
+
+
+def get_model_family(name: str) -> ModelFamily:
+    _ensure_builtin()
+    if name not in _FAMILIES:
+        raise KeyError(
+            f"unknown model family {name!r}; known: {sorted(set(f.name for f in _FAMILIES.values()))}"
+        )
+    return _FAMILIES[name]
+
+
+_LOADED = False
+
+
+def _ensure_builtin() -> None:
+    global _LOADED
+    if _LOADED:
+        return
+    from kubeai_tpu.models import llama
+
+    register_model_family(
+        ModelFamily(
+            "llama",
+            config_from_hf=llama.LlamaConfig.from_hf_dict,
+            tiny_config=llama.LlamaConfig.tiny,
+            init_params=llama.init_params,
+            param_specs=llama.param_specs,
+            prefill=llama.prefill,
+            decode_step=llama.decode_step,
+            hf_architectures=("LlamaForCausalLM",),
+        )
+    )
+    # Further families (gemma, qwen, mixtral, …) self-register on import.
+    for mod in ("gemma", "qwen", "mixtral"):
+        try:
+            __import__(f"kubeai_tpu.models.{mod}")
+        except ImportError:
+            pass
+    _LOADED = True

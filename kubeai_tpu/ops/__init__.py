@@ -1,0 +1,16 @@
+"""Core TPU ops: norms, rotary embeddings, attention.
+
+These are the hot ops of the serving engine the reference outsources to
+vLLM's CUDA kernels (reference: charts/kubeai/values.yaml:45-48 pulls
+`vllm/vllm-openai` images). Implemented here as XLA-friendly JAX with
+optional Pallas TPU kernels (kubeai_tpu.ops.pallas_attention) for the
+attention inner loops.
+"""
+
+from kubeai_tpu.ops.norms import rms_norm
+from kubeai_tpu.ops.rope import apply_rope, rope_frequencies
+from kubeai_tpu.ops.attention import (
+    causal_prefill_attention,
+    decode_attention,
+    chunked_prefill_attention,
+)

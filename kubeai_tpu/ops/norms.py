@@ -1,0 +1,18 @@
+"""Normalization ops.
+
+RMSNorm computed in float32 for numerical stability, cast back to the input
+dtype — XLA fuses this into neighbouring elementwise work so it stays HBM-
+bandwidth-bound, not an extra kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    normed = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

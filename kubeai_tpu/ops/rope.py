@@ -1,0 +1,54 @@
+"""Rotary position embeddings (RoPE), including Llama-3 frequency scaling.
+
+Computed with static shapes and position indices passed as arrays so the
+same jitted graph serves any batch of positions (prefill ranges and decode
+single-steps) without retracing.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float = 500000.0,
+    scaling: dict | None = None,
+) -> np.ndarray:
+    """Per-pair inverse frequencies, with optional Llama-3.1-style scaling.
+
+    `scaling` mirrors HF config `rope_scaling` with rope_type="llama3":
+    {factor, low_freq_factor, high_freq_factor, original_max_position_embeddings}.
+    """
+    inv_freq = 1.0 / (
+        theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim)
+    )
+    if scaling and scaling.get("rope_type", scaling.get("type")) == "llama3":
+        factor = scaling["factor"]
+        low = scaling["low_freq_factor"]
+        high = scaling["high_freq_factor"]
+        orig = scaling["original_max_position_embeddings"]
+        wavelen = 2 * np.pi / inv_freq
+        smooth = (orig / wavelen - low) / (high - low)
+        mid = (1 - smooth) * inv_freq / factor + smooth * inv_freq
+        inv_freq = np.where(
+            wavelen > orig / low,  # low-frequency band: fully rescaled
+            inv_freq / factor,
+            np.where(wavelen < orig / high, inv_freq, mid),
+        )
+    return inv_freq.astype(np.float32)
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    inv_freq: jnp.ndarray,
+) -> jnp.ndarray:
+    """Rotate q or k. x: [..., seq, heads, head_dim]; positions: [..., seq]."""
+    angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # [..., S, D/2]
+    cos = jnp.cos(angles)[..., :, None, :]  # [..., S, 1, D/2]
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,23 @@
+"""Parallelism layer: device meshes, sharding rules, collectives.
+
+TPU-native equivalent of what the reference delegates to vLLM's Ray/NCCL
+executor (reference: charts/models/values.yaml:131-140 — `--tensor-parallel-size=4`
+passed as engine args). Here parallelism is first-class: a `jax.sharding.Mesh`
+built from the TPU slice topology, with GSPMD/pjit inserting XLA collectives
+over ICI.
+"""
+
+from kubeai_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+    mesh_from_topology,
+    AXIS_DATA,
+    AXIS_TENSOR,
+    AXIS_SEQ,
+    AXIS_EXPERT,
+)
+from kubeai_tpu.parallel.sharding import (
+    ShardingRules,
+    logical_to_physical,
+    shard_params,
+)

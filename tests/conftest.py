@@ -1,0 +1,32 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax init.
+
+Mirrors the reference's test strategy of running the full system with zero
+accelerators (reference: test/integration/main_test.go — envtest, no
+kubelet, fake backends). Multi-chip sharding is validated on a virtual CPU
+mesh; real-TPU checks live in bench.py and the manual tier.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment may pre-register an accelerator plugin via sitecustomize;
+# the config update (unlike the env var) reliably wins before backend init.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "float32")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,85 @@
+"""Llama model correctness: decode path must reproduce the prefill path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeai_tpu.models import llama
+
+
+def _setup():
+    cfg = llama.LlamaConfig.tiny()
+    cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+    params = llama.init_params(cfg, jax.random.PRNGKey(7))
+    return cfg, params
+
+
+def test_prefill_incremental_vs_full():
+    """Logits for token n via prefill(0..n) == prefill(0..n-1) + decode(n)."""
+    cfg, params = _setup()
+    rng = np.random.default_rng(0)
+    n = 7
+    toks = rng.integers(0, cfg.vocab_size, size=(1, n + 1)).astype(np.int32)
+
+    # Full prefill over n+1 tokens -> logits for the last token.
+    full_logits, _, _ = llama.prefill(
+        params, cfg, jnp.asarray(toks), jnp.asarray([n + 1], jnp.int32)
+    )
+
+    # Prefill n tokens, then decode token n against the cache.
+    _, k_all, v_all = llama.prefill(
+        params, cfg, jnp.asarray(toks[:, :n]), jnp.asarray([n], jnp.int32)
+    )
+    L = 16
+    k_cache = jnp.zeros((cfg.num_layers, 1, L, cfg.num_kv_heads, cfg.head_size))
+    v_cache = jnp.zeros_like(k_cache)
+    k_cache = k_cache.at[:, :, :n].set(k_all)
+    v_cache = v_cache.at[:, :, :n].set(v_all)
+    dec_logits, _, _ = llama.decode_step(
+        params,
+        cfg,
+        jnp.asarray(toks[:, n]),
+        jnp.asarray([n], jnp.int32),
+        k_cache,
+        v_cache,
+    )
+    np.testing.assert_allclose(
+        np.asarray(dec_logits), np.asarray(full_logits), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_prefill_padding_invariance():
+    """Right-padding must not change the last real token's logits."""
+    cfg, params = _setup()
+    rng = np.random.default_rng(1)
+    n = 5
+    toks = rng.integers(0, cfg.vocab_size, size=(1, n)).astype(np.int32)
+    logits_a, _, _ = llama.prefill(
+        params, cfg, jnp.asarray(toks), jnp.asarray([n], jnp.int32)
+    )
+    padded = np.zeros((1, 12), np.int32)
+    padded[0, :n] = toks
+    logits_b, _, _ = llama.prefill(
+        params, cfg, jnp.asarray(padded), jnp.asarray([n], jnp.int32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_a), np.asarray(logits_b), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_hf_config_roundtrip():
+    cfg = llama.LlamaConfig.from_hf_dict(
+        {
+            "vocab_size": 128256,
+            "hidden_size": 4096,
+            "intermediate_size": 14336,
+            "num_hidden_layers": 32,
+            "num_attention_heads": 32,
+            "num_key_value_heads": 8,
+            "rope_theta": 500000.0,
+            "rms_norm_eps": 1e-5,
+            "max_position_embeddings": 131072,
+        }
+    )
+    assert cfg.num_kv_heads == 8
+    assert cfg.head_size == 128

@@ -1,0 +1,137 @@
+"""Unit tests for core ops against naive numpy references."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeai_tpu.ops import (
+    rms_norm,
+    apply_rope,
+    rope_frequencies,
+    causal_prefill_attention,
+    decode_attention,
+    chunked_prefill_attention,
+)
+
+
+def test_rms_norm_matches_reference():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 5, 16)).astype(np.float32)
+    w = rng.standard_normal((16,)).astype(np.float32)
+    got = np.asarray(rms_norm(jnp.asarray(x), jnp.asarray(w), eps=1e-5))
+    want = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-5) * w
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_rope_preserves_norm_and_relative_phase():
+    D = 16
+    inv = jnp.asarray(rope_frequencies(D, 10000.0))
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 4, 2, D)).astype(np.float32)
+    pos = jnp.asarray(np.arange(4)[None, :])
+    out = np.asarray(apply_rope(jnp.asarray(x), pos, inv))
+    # Rotation preserves norms.
+    np.testing.assert_allclose(
+        np.linalg.norm(out, axis=-1), np.linalg.norm(x, axis=-1), rtol=1e-4
+    )
+    # Position 0 is identity.
+    np.testing.assert_allclose(out[:, 0], x[:, 0], rtol=1e-5, atol=1e-6)
+
+
+def test_rope_llama3_scaling_changes_low_freqs_only():
+    D = 32
+    base = rope_frequencies(D, 500000.0)
+    scaled = rope_frequencies(
+        D,
+        500000.0,
+        {
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 8192,
+        },
+    )
+    assert scaled.shape == base.shape
+    # Highest-frequency components are untouched; lowest are divided by ~8.
+    np.testing.assert_allclose(scaled[0], base[0], rtol=1e-6)
+    assert scaled[-1] < base[-1] / 4
+
+
+def _naive_causal(q, k, v, q_offset=0):
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    g = h // kvh
+    out = np.zeros_like(q, dtype=np.float32)
+    for bi in range(b):
+        for hi in range(h):
+            ki = hi // g
+            logits = (q[bi, :, hi] @ k[bi, :, ki].T) / np.sqrt(d)
+            qpos = np.arange(s) + q_offset
+            kpos = np.arange(k.shape[1])
+            logits = np.where(qpos[:, None] >= kpos[None, :], logits, -1e30)
+            p = np.exp(logits - logits.max(-1, keepdims=True))
+            p = p / p.sum(-1, keepdims=True)
+            out[bi, :, hi] = p @ v[bi, :, ki]
+    return out
+
+
+def test_causal_prefill_attention_matches_naive():
+    rng = np.random.default_rng(2)
+    B, S, H, KVH, D = 2, 6, 4, 2, 8
+    q = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, KVH, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, KVH, D)).astype(np.float32)
+    got = np.asarray(
+        causal_prefill_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    )
+    want = _naive_causal(q, k, v)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_decode_attention_matches_prefill_last_row():
+    """Decoding the last token against the cache == last row of full attn."""
+    rng = np.random.default_rng(3)
+    B, S, H, KVH, D = 2, 5, 4, 2, 8
+    q = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, KVH, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, KVH, D)).astype(np.float32)
+    full = _naive_causal(q, k, v)
+
+    L = 9  # cache longer than S; tail is garbage masked by lengths
+    k_cache = np.zeros((B, L, KVH, D), np.float32)
+    v_cache = np.zeros((B, L, KVH, D), np.float32)
+    k_cache[:, :S] = k
+    v_cache[:, :S] = v
+    k_cache[:, S:] = 99.0  # poison: must be masked out
+    v_cache[:, S:] = 99.0
+    got = np.asarray(
+        decode_attention(
+            jnp.asarray(q[:, -1]),
+            jnp.asarray(k_cache),
+            jnp.asarray(v_cache),
+            jnp.asarray([S, S], dtype=jnp.int32),
+        )
+    )
+    np.testing.assert_allclose(got, full[:, -1], rtol=1e-4, atol=1e-5)
+
+
+def test_chunked_prefill_matches_full():
+    """Prefill in two chunks == full prefill (second chunk's rows)."""
+    rng = np.random.default_rng(4)
+    B, S, H, KVH, D = 1, 8, 4, 2, 8
+    q = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, KVH, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, KVH, D)).astype(np.float32)
+    full = _naive_causal(q, k, v)
+    split = 5
+    got = np.asarray(
+        chunked_prefill_attention(
+            jnp.asarray(q[:, split:]),
+            jnp.asarray(k),
+            jnp.asarray(v),
+            jnp.asarray([split], jnp.int32),
+        )
+    )
+    np.testing.assert_allclose(got, full[:, split:], rtol=1e-4, atol=1e-5)
