@@ -1,0 +1,105 @@
+"""Benchmark: steady-state decode throughput of the TPU serving engine.
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "tok/s", "vs_baseline": N}
+
+Baseline: the build target from BASELINE.json — Llama-class decode at
+≥2,000 tok/s/chip on TPU v5e (the reference publishes no TPU numbers;
+its GPU headline tables are in BASELINE.md).
+
+Methodology: random-init Llama-3.2-1B-class weights (zero-egress image: no
+checkpoint downloads; throughput is weight-value-independent), all decode
+slots kept full (continuous batching steady state), timed after compile
+warm-up. `--smoke` runs a tiny config for quick sanity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def llama_1b_cfg():
+    from kubeai_tpu.models import llama
+
+    # Llama-3.2-1B architecture (hidden 2048, 16 layers, GQA 32/8 heads).
+    return llama.LlamaConfig(
+        vocab_size=128256,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_layers=16,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=64,
+        rope_theta=500000.0,
+        max_position_embeddings=4096,
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true", help="tiny model, quick run")
+    ap.add_argument("--slots", type=int, default=64)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--decode-steps", type=int, default=40)
+    ap.add_argument("--max-seq-len", type=int, default=512)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from kubeai_tpu.engine import Engine, EngineConfig
+    from kubeai_tpu.engine.sampling import SamplingParams
+    from kubeai_tpu.models import llama
+
+    if args.smoke:
+        cfg = llama.LlamaConfig.tiny()
+        args.slots, args.prompt_len, args.decode_steps = 4, 16, 20
+        args.max_seq_len = 64
+    else:
+        cfg = llama_1b_cfg()
+
+    params = llama.init_params(cfg)
+    eng = Engine(
+        "llama",
+        cfg,
+        params,
+        cfg=EngineConfig(num_slots=args.slots, max_seq_len=args.max_seq_len),
+    )
+
+    rng = np.random.default_rng(0)
+    gen_budget = args.max_seq_len - args.prompt_len
+    sp = SamplingParams(temperature=0.0, max_tokens=gen_budget)
+
+    # Fill every slot, warm up prefill+decode compiles.
+    for _ in range(args.slots):
+        eng.add_request(
+            rng.integers(0, cfg.vocab_size, args.prompt_len).tolist(), sp
+        )
+    eng.step()  # prefill-admit + first decode (compiles)
+    eng.step()
+
+    # Timed steady-state decode: all slots active, one token/slot/step.
+    t0 = time.perf_counter()
+    tokens = 0
+    for _ in range(args.decode_steps):
+        if not eng.has_work():
+            break
+        tokens += len(eng.step())
+    dt = time.perf_counter() - t0
+
+    toks_per_s = tokens / dt
+    baseline = 2000.0  # BASELINE.json north-star: tok/s/chip on v5e
+    result = {
+        "metric": "llama-1b-class decode throughput, continuous batching, "
+        f"bs={args.slots}, 1 chip" + (" (smoke)" if args.smoke else ""),
+        "value": round(toks_per_s, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(toks_per_s / baseline, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

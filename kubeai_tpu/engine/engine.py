@@ -47,6 +47,11 @@ class EngineConfig:
     max_seq_len: int = 1024
     prefill_buckets: tuple[int, ...] = ()  # default: powers of 2 up to max
     cache_dtype: Any = jnp.bfloat16
+    # Decode steps fused into one device call (lax.scan). Amortizes host
+    # dispatch — critical when the chip sits behind an RPC tunnel. Tokens a
+    # request emits past its stop point within a chunk are discarded
+    # host-side; slot rows are independent, so batch-mates are unaffected.
+    decode_chunk: int = 8
 
     def buckets(self) -> tuple[int, ...]:
         if self.prefill_buckets:
@@ -144,13 +149,18 @@ class Engine:
             sharding=cache_sharding,
         )
 
-        # Host mirrors of per-slot decode inputs.
-        self._slot_tokens = np.zeros((cfg.num_slots,), np.int32)
-        self._slot_positions = np.zeros((cfg.num_slots,), np.int32)
-        self._slot_temp = np.zeros((cfg.num_slots,), np.float32)
-        self._slot_topk = np.zeros((cfg.num_slots,), np.int32)
-        self._slot_topp = np.ones((cfg.num_slots,), np.float32)
-        self._slot_seed = np.zeros((cfg.num_slots,), np.uint32)
+        # Per-slot decode state lives ON DEVICE (replicated): steady-state
+        # decode then needs ZERO host->device transfers per chunk — critical
+        # when each transfer costs a network round trip to the chip.
+        B = cfg.num_slots
+        self._state = {
+            "tokens": jnp.zeros((B,), jnp.int32),
+            "positions": jnp.zeros((B,), jnp.int32),
+            "seeds": jnp.zeros((B,), jnp.uint32),
+            "temp": jnp.zeros((B,), jnp.float32),
+            "topk": jnp.zeros((B,), jnp.int32),
+            "topp": jnp.ones((B,), jnp.float32),
+        }
 
         self._build_jits(cache_sharding)
 
@@ -158,37 +168,78 @@ class Engine:
 
     def _build_jits(self, cache_sharding) -> None:
         fam, mcfg = self.family, self.model_cfg
+        max_len = self.cfg.max_seq_len
+        chunk = max(1, self.cfg.decode_chunk)
 
-        def _prefill(params, tokens, lengths):
-            return fam.prefill(params, mcfg, tokens, lengths)
+        def _prefill_admit(params, tokens, ints, floats, ck, cv, state):
+            """Fused prefill → cache insert → first-token sample → slot-state
+            update: ONE device call per admitted request. `ints` packs
+            [length, slot, seed, top_k]; `floats` packs [temp, top_p] —
+            two small transfers instead of six."""
+            length, slot, seed, topk = ints[0], ints[1], ints[2], ints[3]
+            temp, topp = floats[0], floats[1]
+            logits, k_all, v_all = fam.prefill(
+                params, mcfg, tokens, length[None]
+            )
+            ck, cv = insert_sequence(ck, cv, k_all[:, 0], v_all[:, 0], slot)
+            tok = sample(
+                logits,
+                seed.astype(jnp.uint32)[None],
+                length[None],
+                temp[None],
+                topk[None],
+                topp[None],
+            )[0]
+            state = dict(
+                tokens=state["tokens"].at[slot].set(tok),
+                positions=state["positions"].at[slot].set(length),
+                seeds=state["seeds"].at[slot].set(seed.astype(jnp.uint32)),
+                temp=state["temp"].at[slot].set(temp),
+                topk=state["topk"].at[slot].set(topk),
+                topp=state["topp"].at[slot].set(topp),
+            )
+            return tok, ck, cv, state
 
-        self._prefill_jit = jax.jit(_prefill)
-
-        def _insert(ck, cv, k_new, v_new, slot):
-            return insert_sequence(ck, cv, k_new, v_new, slot)
-
-        self._insert_jit = jax.jit(
-            _insert,
-            donate_argnums=(0, 1),
-            out_shardings=(cache_sharding, cache_sharding),
+        self._prefill_admit_jit = jax.jit(
+            _prefill_admit,
+            donate_argnums=(4, 5, 6),
+            out_shardings=(None, cache_sharding, cache_sharding, None),
         )
 
-        def _decode(params, tokens, positions, ck, cv, seeds, temp, topk, topp):
-            logits, ck, cv = fam.decode_step(
-                params, mcfg, tokens, positions, ck, cv
+        def _decode_chunk(params, ck, cv, state):
+            """`chunk` decode steps fused via lax.scan; emits [chunk, B]
+            tokens per device call. No host inputs besides the (donated,
+            device-resident) cache and slot state. Write positions are
+            clamped so rows that pass their stop point within a chunk stay
+            in-bounds (their surplus tokens are discarded host-side)."""
+            seeds, temp = state["seeds"], state["temp"]
+            topk, topp = state["topk"], state["topp"]
+
+            def body(carry, _):
+                tokens, positions, ck, cv = carry
+                logits, ck, cv = fam.decode_step(
+                    params, mcfg, tokens, positions, ck, cv
+                )
+                # Sampled token lands at position+1 — the fold-in value, so
+                # a seeded request replays identically across batches.
+                toks = sample(logits, seeds, positions + 1, temp, topk, topp)
+                next_pos = jnp.minimum(positions + 1, max_len - 1)
+                return (toks, next_pos, ck, cv), toks
+
+            (tokens, positions, ck, cv), toks_seq = jax.lax.scan(
+                body,
+                (state["tokens"], state["positions"], ck, cv),
+                None,
+                length=chunk,
             )
-            # Sampled token lands at position+1 — the fold-in value, so a
-            # seeded request replays identically regardless of batch-mates.
-            toks = sample(logits, seeds, positions + 1, temp, topk, topp)
-            return toks, ck, cv
+            state = dict(state, tokens=tokens, positions=positions)
+            return toks_seq, ck, cv, state
 
         self._decode_jit = jax.jit(
-            _decode,
-            donate_argnums=(3, 4),
-            out_shardings=(None, cache_sharding, cache_sharding),
+            _decode_chunk,
+            donate_argnums=(1, 2, 3),
+            out_shardings=(None, cache_sharding, cache_sharding, None),
         )
-
-        self._sample_jit = jax.jit(sample)
 
     # ---- public API ---------------------------------------------------------
 
@@ -249,23 +300,30 @@ class Engine:
             bucket = self._bucket(plen)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :plen] = req.prompt
-            logits, k_all, v_all = self._prefill_jit(
-                self.params, jnp.asarray(tokens), jnp.asarray([plen], jnp.int32)
+            tok_dev, self.cache.k, self.cache.v, self._state = (
+                self._prefill_admit_jit(
+                    self.params,
+                    jnp.asarray(tokens),
+                    jnp.asarray(
+                        [
+                            plen,
+                            slot,
+                            # uint32 seed bit-cast into the int32 pack; the
+                            # jit reinterprets it back via astype(uint32).
+                            int(np.uint32(req.seed).view(np.int32)),
+                            req.params.top_k,
+                        ],
+                        jnp.int32,
+                    ),
+                    jnp.asarray(
+                        [req.params.temperature, req.params.top_p], jnp.float32
+                    ),
+                    self.cache.k,
+                    self.cache.v,
+                    self._state,
+                )
             )
-            self.cache.k, self.cache.v = self._insert_jit(
-                self.cache.k, self.cache.v, k_all[:, 0], v_all[:, 0],
-                jnp.asarray(slot, jnp.int32),
-            )
-            tok = int(
-                self._sample_jit(
-                    logits,
-                    jnp.asarray([req.seed], jnp.uint32),
-                    jnp.asarray([plen], jnp.int32),  # token lands at plen
-                    jnp.asarray([req.params.temperature], jnp.float32),
-                    jnp.asarray([req.params.top_k], jnp.int32),
-                    jnp.asarray([req.params.top_p], jnp.float32),
-                )[0]
-            )
+            tok = int(tok_dev)
             req.out_tokens.append(tok)
             req.position = plen
             req.last_token = tok
@@ -275,12 +333,6 @@ class Engine:
                 self._release(req)
             else:
                 self._active[slot] = req
-                self._slot_tokens[slot] = tok
-                self._slot_positions[slot] = plen
-                self._slot_temp[slot] = req.params.temperature
-                self._slot_topk[slot] = req.params.top_k
-                self._slot_topp[slot] = req.params.top_p
-                self._slot_seed[slot] = req.seed
         return emitted
 
     def _check_stop(self, req: _Request) -> bool:
@@ -322,39 +374,37 @@ class Engine:
             return True
 
     def step(self) -> list[StepEvent]:
-        """Admit pending prefills, then run one batched decode step.
+        """Admit pending prefills, then run one fused decode chunk
+        (cfg.decode_chunk model steps in a single device call).
 
-        Returns a list of StepEvents.
+        Returns a list of StepEvents in emission order.
         """
         with self._lock:
             emitted = self._admit_pending()
             if not self._active:
                 return emitted
-            toks, self.cache.k, self.cache.v = self._decode_jit(
-                self.params,
-                jnp.asarray(self._slot_tokens),
-                jnp.asarray(self._slot_positions),
-                self.cache.k,
-                self.cache.v,
-                jnp.asarray(self._slot_seed),
-                jnp.asarray(self._slot_temp),
-                jnp.asarray(self._slot_topk),
-                jnp.asarray(self._slot_topp),
+            toks_seq, self.cache.k, self.cache.v, self._state = (
+                self._decode_jit(
+                    self.params, self.cache.k, self.cache.v, self._state
+                )
             )
-            toks = np.asarray(jax.device_get(toks))
+            toks_seq = np.asarray(jax.device_get(toks_seq))  # [chunk, B]
             self._steps += 1
-            for slot, req in list(self._active.items()):
-                tok = int(toks[slot])
-                req.out_tokens.append(tok)
-                req.position += 1
-                req.last_token = tok
-                finished = self._check_stop(req)
-                emitted.append(StepEvent(req.rid, tok, finished, req.finish_reason))
-                if finished:
-                    self._release(req)
-                else:
-                    self._slot_tokens[slot] = tok
-                    self._slot_positions[slot] = req.position
+            chunk_slots = list(self._active.items())
+            for k in range(toks_seq.shape[0]):
+                for slot, req in chunk_slots:
+                    if req.done:
+                        continue  # surplus chunk tokens discarded
+                    tok = int(toks_seq[k, slot])
+                    req.out_tokens.append(tok)
+                    req.position += 1
+                    req.last_token = tok
+                    finished = self._check_stop(req)
+                    emitted.append(
+                        StepEvent(req.rid, tok, finished, req.finish_reason)
+                    )
+                    if finished:
+                        self._release(req)
             return emitted
 
     def generate(

@@ -196,8 +196,11 @@ def prefill(
     # Logits only for each sequence's final real token.
     idx = jnp.clip(lengths - 1, 0, S - 1)
     last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]  # [B, E]
-    logits = jnp.einsum("be,ve->bv", last.astype(jnp.float32),
-                        params["lm_head"].astype(jnp.float32))
+    # bf16 matmul, fp32 accumulation: MXU-native, no fp32 weight copy.
+    logits = jnp.einsum(
+        "be,ve->bv", last, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
     return logits, k_all, v_all
 
 
@@ -245,6 +248,8 @@ def decode_step(
         layer, x, (params["layers"], k_cache, v_cache)
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    logits = jnp.einsum("be,ve->bv", x.astype(jnp.float32),
-                        params["lm_head"].astype(jnp.float32))
+    logits = jnp.einsum(
+        "be,ve->bv", x, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
     return logits, k_cache, v_cache
