@@ -1,0 +1,14 @@
+"""System configuration (reference: internal/config/system.go)."""
+
+from kubeai_tpu.config.system import (
+    System,
+    ResourceProfile,
+    CacheProfile,
+    ModelAutoscaling,
+    ModelRollouts,
+    ModelServerPods,
+    Messaging,
+    MessageStream,
+    LeaderElectionConfig,
+    load_config_file,
+)

@@ -1,0 +1,439 @@
+"""System-level configuration, loaded from a single YAML/JSON file.
+
+TPU-native rebuild of the reference's `config.System`
+(reference: internal/config/system.go:13-260): resource profiles carry TPU
+topology (`google.com/tpu` resources + `gke-tpu-accelerator`/`gke-tpu-topology`
+node selectors, as the reference's GKE values do —
+reference: charts/kubeai/values-gke.yaml:18-41), engine image matrices
+include the in-tree TPU engine, and defaulting/validation mirrors
+`DefaultAndValidate` (reference: internal/config/system.go:49-85).
+
+Parsing uses a small strict loader (no external YAML dep needed for tests:
+JSON is valid YAML; a minimal YAML subset parser handles the common config
+shapes when PyYAML is unavailable).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Any
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class ResourceProfile:
+    """Compute class multiplied by `resourceProfile: name:count`
+    (reference: internal/config/system.go:191-200)."""
+
+    image_name: str = ""
+    requests: dict[str, str] = dataclasses.field(default_factory=dict)
+    limits: dict[str, str] = dataclasses.field(default_factory=dict)
+    node_selector: dict[str, str] = dataclasses.field(default_factory=dict)
+    affinity: dict | None = None
+    tolerations: list[dict] = dataclasses.field(default_factory=list)
+    scheduler_name: str = ""
+    runtime_class_name: str = ""
+
+    @property
+    def tpu_topology(self) -> str | None:
+        return self.node_selector.get("gke-tpu-topology")
+
+    @property
+    def tpu_accelerator(self) -> str | None:
+        return self.node_selector.get("gke-tpu-accelerator")
+
+
+@dataclasses.dataclass
+class CacheProfile:
+    """Shared-filesystem model cache (reference: internal/config/system.go:202-212)."""
+
+    shared_filesystem: dict | None = None  # {storageClassName|persistentVolumeName}
+
+
+@dataclasses.dataclass
+class ModelAutoscaling:
+    """(reference: internal/config/system.go:119-146)"""
+
+    interval_seconds: float = 10.0
+    time_window_seconds: float = 600.0
+    state_configmap_name: str = "kubeai-autoscaler-state"
+
+    @property
+    def average_window_count(self) -> int:
+        # reference: internal/config/system.go AverageWindowCount()
+        return int(math.ceil(self.time_window_seconds / self.interval_seconds))
+
+    def required_consecutive_scale_downs(self, scale_down_delay_seconds: float) -> int:
+        # reference: internal/config/system.go:131-137
+        return int(math.ceil(scale_down_delay_seconds / self.interval_seconds))
+
+
+@dataclasses.dataclass
+class ModelRollouts:
+    """Surge pods during rollout (reference: internal/config/system.go:114-117)."""
+
+    surge: int = 1
+
+
+@dataclasses.dataclass
+class ModelServerPods:
+    """Cluster-wide pod settings (reference: internal/config/system.go:243-260)."""
+
+    service_account_name: str = ""
+    security_context: dict | None = None
+    container_security_context: dict | None = None
+    image_pull_secrets: list[str] = dataclasses.field(default_factory=list)
+    json_patches: list[dict] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class MessageStream:
+    """(reference: internal/config/system.go:214-220)"""
+
+    request_subscription: str = ""
+    response_topic: str = ""
+    max_handlers: int = 1000
+
+
+@dataclasses.dataclass
+class Messaging:
+    error_max_backoff_seconds: float = 30.0
+    streams: list[MessageStream] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class LeaderElectionConfig:
+    lease_duration_seconds: float = 15.0
+    renew_deadline_seconds: float = 10.0
+    retry_period_seconds: float = 2.0
+
+
+DEFAULT_MODEL_SERVERS: dict[str, dict[str, str]] = {
+    # engine -> imageName -> image (reference: charts/kubeai/values.yaml:40-60).
+    # The TPU engine serves from this repo's image; CPU variant for e2e tests.
+    "KubeAITPU": {
+        "default": "kubeai-tpu/engine:latest",
+        "google-tpu": "kubeai-tpu/engine:latest-tpu",
+        "cpu": "kubeai-tpu/engine:latest-cpu",
+    },
+    "VLLM": {"default": "vllm/vllm-openai:v0.8.3"},
+    "OLlama": {"default": "ollama/ollama:latest"},
+    "FasterWhisper": {
+        "default": "fedirz/faster-whisper-server:latest-cpu"
+    },
+    "Infinity": {
+        "default": "michaelf34/infinity:latest"
+    },
+}
+
+
+@dataclasses.dataclass
+class System:
+    """The full system config (reference: internal/config/system.go:13-47)."""
+
+    secret_names: dict[str, str] = dataclasses.field(
+        default_factory=lambda: {"huggingface": "kubeai-huggingface"}
+    )
+    model_servers: dict[str, dict[str, str]] = dataclasses.field(
+        default_factory=lambda: {
+            k: dict(v) for k, v in DEFAULT_MODEL_SERVERS.items()
+        }
+    )
+    model_loading_image: str = "kubeai-tpu/model-loader:latest"
+    resource_profiles: dict[str, ResourceProfile] = dataclasses.field(
+        default_factory=dict
+    )
+    cache_profiles: dict[str, CacheProfile] = dataclasses.field(
+        default_factory=dict
+    )
+    model_autoscaling: ModelAutoscaling = dataclasses.field(
+        default_factory=ModelAutoscaling
+    )
+    model_rollouts: ModelRollouts = dataclasses.field(
+        default_factory=ModelRollouts
+    )
+    model_server_pods: ModelServerPods = dataclasses.field(
+        default_factory=ModelServerPods
+    )
+    messaging: Messaging = dataclasses.field(default_factory=Messaging)
+    leader_election: LeaderElectionConfig = dataclasses.field(
+        default_factory=LeaderElectionConfig
+    )
+    metrics_addr: str = ":8080"
+    api_addr: str = ":8000"
+    allow_pod_address_override: bool = False  # test hook (reference: main_test.go:258)
+    fixed_self_metric_addrs: list[str] = dataclasses.field(default_factory=list)
+
+    def default_and_validate(self) -> "System":
+        """Apply defaults and validate (reference: internal/config/system.go:49-85)."""
+        if not self.resource_profiles:
+            self.resource_profiles = default_resource_profiles()
+        if "cpu" not in self.resource_profiles:
+            self.resource_profiles["cpu"] = default_resource_profiles()["cpu"]
+        if self.model_autoscaling.interval_seconds <= 0:
+            raise ConfigError("modelAutoscaling.interval must be > 0")
+        if self.model_autoscaling.time_window_seconds < self.model_autoscaling.interval_seconds:
+            raise ConfigError("modelAutoscaling.timeWindow must be >= interval")
+        if self.model_rollouts.surge < 0:
+            raise ConfigError("modelRollouts.surge must be >= 0")
+        for name, prof in self.resource_profiles.items():
+            if not isinstance(prof, ResourceProfile):
+                raise ConfigError(f"resourceProfiles[{name}] invalid")
+        for eng, images in self.model_servers.items():
+            if "default" not in images:
+                raise ConfigError(f"modelServers[{eng}] needs a 'default' image")
+        for stream in self.messaging.streams:
+            if not stream.request_subscription or not stream.response_topic:
+                raise ConfigError(
+                    "messaging.streams entries need requestSubscription and responseTopic"
+                )
+        return self
+
+
+def default_resource_profiles() -> dict[str, ResourceProfile]:
+    """TPU-first resource profiles (reference: charts/kubeai/values-gke.yaml:18-41
+    for the GKE TPU profiles; charts/kubeai/values.yaml for cpu/gpu)."""
+    profiles = {
+        "cpu": ResourceProfile(
+            requests={"cpu": "1", "memory": "2Gi"},
+            limits={},
+        ),
+        "nvidia-gpu-l4": ResourceProfile(
+            image_name="default",
+            requests={"nvidia.com/gpu": "1"},
+            limits={"nvidia.com/gpu": "1"},
+            node_selector={"cloud.google.com/gke-accelerator": "nvidia-l4"},
+        ),
+    }
+    for topo, chips in (("1x1", 1), ("2x2", 4), ("2x4", 8)):
+        profiles[f"google-tpu-v5e-{topo}"] = ResourceProfile(
+            image_name="google-tpu",
+            requests={"google.com/tpu": str(chips)},
+            limits={"google.com/tpu": str(chips)},
+            node_selector={
+                "gke-tpu-accelerator": "tpu-v5-lite-podslice",
+                "gke-tpu-topology": topo,
+            },
+        )
+    return profiles
+
+
+# ---- file loading -----------------------------------------------------------
+
+
+def load_config_file(path: str) -> System:
+    """Load the system config file (reference: internal/manager/configure.go:10-21).
+
+    Accepts JSON or a simple YAML subset (maps, lists, scalars)."""
+    with open(path) as f:
+        text = f.read()
+    data = _parse_config_text(text)
+    return system_from_dict(data).default_and_validate()
+
+
+def _parse_config_text(text: str) -> dict:
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        pass
+    try:
+        import yaml  # type: ignore
+
+        return yaml.safe_load(text) or {}
+    except ImportError:
+        return _mini_yaml(text)
+
+
+def _mini_yaml(text: str) -> dict:
+    """Minimal YAML subset: nested maps, `- ` lists, scalar values."""
+
+    lines = [
+        l for l in text.splitlines()
+        if l.strip() and not l.strip().startswith("#")
+    ]
+
+    def parse_block(idx: int, indent: int):
+        result: Any = None
+        while idx < len(lines):
+            line = lines[idx]
+            cur = len(line) - len(line.lstrip())
+            if cur < indent:
+                break
+            stripped = line.strip()
+            if stripped.startswith("- "):
+                if result is None:
+                    result = []
+                item_text = stripped[2:]
+                if ":" in item_text and not item_text.split(":", 1)[1].strip():
+                    sub, idx = parse_block(idx + 1, cur + 2)
+                    result.append({item_text.split(":")[0]: sub})
+                elif ":" in item_text:
+                    # inline map start on the list item line
+                    k, v = item_text.split(":", 1)
+                    item = {k.strip(): _scalar(v.strip())}
+                    nxt, idx = parse_block(idx + 1, cur + 2)
+                    if isinstance(nxt, dict):
+                        item.update(nxt)
+                    result.append(item)
+                else:
+                    result.append(_scalar(item_text))
+                    idx += 1
+            else:
+                if result is None:
+                    result = {}
+                key, _, val = stripped.partition(":")
+                val = val.strip()
+                if val:
+                    result[key.strip()] = _scalar(val)
+                    idx += 1
+                else:
+                    sub, idx = parse_block(idx + 1, cur + 1)
+                    result[key.strip()] = sub if sub is not None else {}
+        return result, idx
+
+    out, _ = parse_block(0, 0)
+    return out or {}
+
+
+def _scalar(s: str):
+    if s in ("true", "True"):
+        return True
+    if s in ("false", "False"):
+        return False
+    if s in ("null", "~", ""):
+        return None
+    if s.startswith('"') and s.endswith('"') or s.startswith("'") and s.endswith("'"):
+        return s[1:-1]
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    return s
+
+
+def _snake(d: dict) -> dict:
+    def conv(k: str) -> str:
+        out = []
+        for ch in k:
+            if ch.isupper():
+                out.append("_")
+                out.append(ch.lower())
+            else:
+                out.append(ch)
+        return "".join(out)
+
+    return {conv(k): v for k, v in d.items()}
+
+
+def system_from_dict(data: dict) -> System:
+    """Build a System from a camelCase config dict (file format parity with
+    the reference's YAML keys, e.g. `resourceProfiles`, `modelServers`)."""
+    data = data or {}
+    sys_obj = System()
+    if "secretNames" in data:
+        sys_obj.secret_names = dict(data["secretNames"])
+    if "modelServers" in data:
+        ms = {}
+        for eng, spec in data["modelServers"].items():
+            images = spec.get("images", spec) if isinstance(spec, dict) else {}
+            ms[eng] = dict(images)
+        sys_obj.model_servers = ms
+    if "modelLoading" in data:
+        sys_obj.model_loading_image = data["modelLoading"].get(
+            "image", sys_obj.model_loading_image
+        )
+    if "resourceProfiles" in data:
+        sys_obj.resource_profiles = {
+            name: ResourceProfile(
+                image_name=p.get("imageName", ""),
+                requests={k: str(v) for k, v in (p.get("requests") or {}).items()},
+                limits={k: str(v) for k, v in (p.get("limits") or {}).items()},
+                node_selector=dict(p.get("nodeSelector") or {}),
+                affinity=p.get("affinity"),
+                tolerations=list(p.get("tolerations") or []),
+                scheduler_name=p.get("schedulerName", ""),
+                runtime_class_name=p.get("runtimeClassName", ""),
+            )
+            for name, p in data["resourceProfiles"].items()
+        }
+    if "cacheProfiles" in data:
+        sys_obj.cache_profiles = {
+            name: CacheProfile(shared_filesystem=p.get("sharedFilesystem"))
+            for name, p in data["cacheProfiles"].items()
+        }
+    if "modelAutoscaling" in data:
+        a = data["modelAutoscaling"]
+        sys_obj.model_autoscaling = ModelAutoscaling(
+            interval_seconds=_seconds(a.get("interval", 10)),
+            time_window_seconds=_seconds(a.get("timeWindow", 600)),
+            state_configmap_name=a.get(
+                "stateConfigMapName", "kubeai-autoscaler-state"
+            ),
+        )
+    if "modelRollouts" in data:
+        sys_obj.model_rollouts = ModelRollouts(
+            surge=int(data["modelRollouts"].get("surge", 1))
+        )
+    if "modelServerPods" in data:
+        p = data["modelServerPods"]
+        sys_obj.model_server_pods = ModelServerPods(
+            service_account_name=p.get("serviceAccountName", ""),
+            security_context=p.get("podSecurityContext"),
+            container_security_context=p.get("securityContext"),
+            image_pull_secrets=[
+                s["name"] if isinstance(s, dict) else s
+                for s in (p.get("imagePullSecrets") or [])
+            ],
+            json_patches=list(p.get("jsonPatches") or []),
+        )
+    if "messaging" in data:
+        m = data["messaging"]
+        sys_obj.messaging = Messaging(
+            error_max_backoff_seconds=_seconds(m.get("errorMaxBackoff", 30)),
+            streams=[
+                MessageStream(
+                    request_subscription=s.get("requestSubscription", ""),
+                    response_topic=s.get("responseTopic", ""),
+                    max_handlers=int(s.get("maxHandlers", 1000)),
+                )
+                for s in (m.get("streams") or [])
+            ],
+        )
+    if "leaderElection" in data:
+        le = data["leaderElection"]
+        sys_obj.leader_election = LeaderElectionConfig(
+            lease_duration_seconds=_seconds(le.get("leaseDuration", 15)),
+            renew_deadline_seconds=_seconds(le.get("renewDeadline", 10)),
+            retry_period_seconds=_seconds(le.get("retryPeriod", 2)),
+        )
+    if "metricsAddr" in data:
+        sys_obj.metrics_addr = data["metricsAddr"]
+    if "apiAddr" in data:
+        sys_obj.api_addr = data["apiAddr"]
+    if "allowPodAddressOverride" in data:
+        sys_obj.allow_pod_address_override = bool(data["allowPodAddressOverride"])
+    if "fixedSelfMetricAddrs" in data:
+        sys_obj.fixed_self_metric_addrs = list(data["fixedSelfMetricAddrs"])
+    return sys_obj
+
+
+def _seconds(v) -> float:
+    """Parse Go-style durations ('10s', '3m') or bare numbers."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    units = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}
+    for suffix in ("ms", "s", "m", "h"):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * units[suffix]
+    return float(s)

@@ -1,0 +1,21 @@
+"""Model custom-resource types (reference: api/k8s/v1)."""
+
+from kubeai_tpu.crd.model import (
+    Model,
+    ModelSpec,
+    ModelStatus,
+    Adapter,
+    File,
+    LoadBalancing,
+    PrefixHash,
+    ValidationError,
+    FEATURE_TEXT_GENERATION,
+    FEATURE_TEXT_EMBEDDING,
+    FEATURE_SPEECH_TO_TEXT,
+    ENGINE_KUBEAI_TPU,
+    ENGINE_OLLAMA,
+    ENGINE_VLLM,
+    ENGINE_FASTER_WHISPER,
+    ENGINE_INFINITY,
+)
+from kubeai_tpu.crd import metadata

@@ -1,0 +1,39 @@
+"""Well-known labels/annotations/finalizers (reference: api/k8s/v1/metadata.go:3-31)."""
+
+GROUP = "kubeai.org"
+
+# Labels
+POD_MODEL_LABEL = "model"
+# Pod-hash of the rendered spec, drives rollouts
+# (reference: api/k8s/v1/metadata.go:8, k8sutils/pods.go:26-42).
+POD_HASH_LABEL = "pod-hash"
+
+MODEL_FEATURE_LABEL_DOMAIN = "features.kubeai.org"
+
+
+def feature_label(feature: str) -> str:
+    return f"{MODEL_FEATURE_LABEL_DOMAIN}/{feature}"
+
+
+# Annotations
+MODEL_POD_IP_ANNOTATION = "model-pod-ip"
+MODEL_POD_PORT_ANNOTATION = "model-pod-port"
+
+ADAPTER_LABEL_DOMAIN = "adapter.kubeai.org"
+
+
+def adapter_label(adapter_id: str) -> str:
+    return f"{ADAPTER_LABEL_DOMAIN}/{adapter_id}"
+
+
+# Finalizer used for cache eviction on Model deletion
+# (reference: api/k8s/v1/metadata.go:29-31).
+CACHE_EVICTION_FINALIZER = "kubeai.org/cache-eviction"
+
+# PVC annotation prefix tracking which model UID was loaded
+# (reference: internal/modelcontroller/cache.go:94-123).
+PVC_MODEL_ANNOTATION_PREFIX = "models.kubeai.org/"
+
+
+def pvc_model_annotation(model_name: str) -> str:
+    return PVC_MODEL_ANNOTATION_PREFIX + model_name

@@ -1,0 +1,389 @@
+"""The Model custom resource (reference: api/k8s/v1/model_types.go).
+
+Python dataclasses standing in for the CRD structs, with `validate()`
+enforcing the reference's CEL + kubebuilder rules
+(reference: api/k8s/v1/model_types.go:27-35,54-66,210-248) so invalid Models
+are rejected at admission just like the real CRD would.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any
+
+FEATURE_TEXT_GENERATION = "TextGeneration"
+FEATURE_TEXT_EMBEDDING = "TextEmbedding"
+FEATURE_SPEECH_TO_TEXT = "SpeechToText"
+ALL_FEATURES = (
+    FEATURE_TEXT_GENERATION,
+    FEATURE_TEXT_EMBEDDING,
+    FEATURE_SPEECH_TO_TEXT,
+)
+
+# Engines (reference: api/k8s/v1/model_types.go:64-66 enum OLlama;VLLM;
+# FasterWhisper;Infinity). KubeAITPU is the in-tree TPU-native engine that
+# replaces external vLLM images for the TPU path.
+ENGINE_KUBEAI_TPU = "KubeAITPU"
+ENGINE_OLLAMA = "OLlama"
+ENGINE_VLLM = "VLLM"
+ENGINE_FASTER_WHISPER = "FasterWhisper"
+ENGINE_INFINITY = "Infinity"
+ALL_ENGINES = (
+    ENGINE_KUBEAI_TPU,
+    ENGINE_OLLAMA,
+    ENGINE_VLLM,
+    ENGINE_FASTER_WHISPER,
+    ENGINE_INFINITY,
+)
+
+LB_STRATEGY_LEAST_LOAD = "LeastLoad"
+LB_STRATEGY_PREFIX_HASH = "PrefixHash"
+
+URL_SCHEMES = ("hf", "pvc", "ollama", "s3", "gs", "oss")
+
+MAX_NAME_LEN = 40  # reference: api/k8s/v1/model_types.go:248
+MAX_FILES = 10  # reference: api/k8s/v1/model_types.go:210-214
+MAX_FILE_PATH_LEN = 1024
+MAX_FILE_CONTENT_LEN = 100_000
+
+
+class ValidationError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class Adapter:
+    """(reference: api/k8s/v1/model_types.go:155-170)"""
+
+    name: str = ""
+    url: str = ""
+
+    def validate(self) -> None:
+        if not re.fullmatch(r"^[a-z0-9]+(?:[-a-z0-9]*[a-z0-9])?$", self.name or ""):
+            raise ValidationError(f"adapter name {self.name!r} must be lowercase DNS label")
+        if len(self.name) > 63:
+            raise ValidationError("adapter name too long")
+        if not self.url:
+            raise ValidationError("adapter url required")
+
+
+@dataclasses.dataclass
+class File:
+    """(reference: api/k8s/v1/model_types.go:210-224)"""
+
+    path: str = ""
+    content: str = ""
+
+    def validate(self) -> None:
+        if not self.path or len(self.path) > MAX_FILE_PATH_LEN:
+            raise ValidationError("file path required, <= 1024 chars")
+        if not self.path.startswith("/") or ".." in self.path:
+            raise ValidationError(f"file path {self.path!r} must be absolute without '..'")
+        if len(self.content) > MAX_FILE_CONTENT_LEN:
+            raise ValidationError("file content too large")
+
+
+@dataclasses.dataclass
+class PrefixHash:
+    """CHWBL tuning (reference: api/k8s/v1/model_types.go:190-208)."""
+
+    mean_load_percentage: int = 125
+    replication: int = 256
+    prefix_char_length: int = 100
+
+    def validate(self) -> None:
+        if self.mean_load_percentage < 100:
+            raise ValidationError("prefixHash.meanLoadPercentage must be >= 100")
+
+
+@dataclasses.dataclass
+class LoadBalancing:
+    """(reference: api/k8s/v1/model_types.go:172-188)"""
+
+    strategy: str = LB_STRATEGY_LEAST_LOAD
+    prefix_hash: PrefixHash = dataclasses.field(default_factory=PrefixHash)
+
+    def validate(self) -> None:
+        if self.strategy not in (LB_STRATEGY_LEAST_LOAD, LB_STRATEGY_PREFIX_HASH):
+            raise ValidationError(f"unknown loadBalancing.strategy {self.strategy!r}")
+        self.prefix_hash.validate()
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """(reference: api/k8s/v1/model_types.go:36-144)"""
+
+    url: str = ""
+    engine: str = ENGINE_KUBEAI_TPU
+    features: list[str] = dataclasses.field(default_factory=list)
+    adapters: list[Adapter] = dataclasses.field(default_factory=list)
+    resource_profile: str = ""  # "name:count"
+    cache_profile: str = ""  # immutable (reference: model_types.go:76)
+    image: str = ""
+    args: list[str] = dataclasses.field(default_factory=list)
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    env_from: list[dict] = dataclasses.field(default_factory=list)
+    replicas: int | None = None
+    min_replicas: int = 0
+    max_replicas: int | None = None
+    autoscaling_disabled: bool = False
+    target_requests: int = 100  # reference: model_types.go:115
+    scale_down_delay_seconds: int = 30  # reference: model_types.go:120
+    load_balancing: LoadBalancing = dataclasses.field(default_factory=LoadBalancing)
+    files: list[File] = dataclasses.field(default_factory=list)
+    priority_class_name: str = ""
+    owner: str = ""
+
+    def url_scheme(self) -> str:
+        return self.url.split("://", 1)[0] if "://" in self.url else ""
+
+    def validate(self) -> None:
+        # url scheme CEL rule (reference: model_types.go:54).
+        if not self.url:
+            raise ValidationError("spec.url required")
+        if self.url_scheme() not in URL_SCHEMES:
+            raise ValidationError(
+                f"spec.url scheme must be one of {URL_SCHEMES}, got {self.url!r}"
+            )
+        if self.engine not in ALL_ENGINES:
+            raise ValidationError(f"spec.engine must be one of {ALL_ENGINES}")
+        for f in self.features:
+            if f not in ALL_FEATURES:
+                raise ValidationError(f"unknown feature {f!r}")
+        # cross-field CEL rules (reference: model_types.go:27-35):
+        if self.engine == ENGINE_OLLAMA and self.url_scheme() not in ("ollama", "pvc"):
+            raise ValidationError("OLlama engine requires ollama:// or pvc:// url")
+        if self.url_scheme() == "ollama" and self.engine != ENGINE_OLLAMA:
+            raise ValidationError("ollama:// url requires engine OLlama")
+        if self.min_replicas < 0:
+            raise ValidationError("minReplicas must be >= 0")
+        if self.max_replicas is not None and self.max_replicas < max(self.min_replicas, 1):
+            raise ValidationError("maxReplicas must be >= minReplicas and >= 1")
+        if self.replicas is not None and self.replicas < 0:
+            raise ValidationError("replicas must be >= 0")
+        if (
+            not self.autoscaling_disabled
+            and self.max_replicas is None
+        ):
+            # reference CEL: maxReplicas required unless autoscalingDisabled
+            # (reference: model_types.go:30-32).
+            raise ValidationError(
+                "maxReplicas is required unless autoscalingDisabled is true"
+            )
+        if self.target_requests < 1:
+            raise ValidationError("targetRequests must be >= 1")
+        if self.scale_down_delay_seconds < 0:
+            raise ValidationError("scaleDownDelaySeconds must be >= 0")
+        if self.resource_profile:
+            parts = self.resource_profile.split(":")
+            if len(parts) != 2 or not parts[0]:
+                raise ValidationError(
+                    'resourceProfile must be "name:count"'
+                )
+            try:
+                count = int(parts[1])
+            except ValueError:
+                raise ValidationError("resourceProfile count must be an integer")
+            if count < 1:
+                raise ValidationError("resourceProfile count must be >= 1")
+        if len(self.files) > MAX_FILES:
+            raise ValidationError(f"at most {MAX_FILES} files allowed")
+        seen_paths = set()
+        for f in self.files:
+            f.validate()
+            if f.path in seen_paths:
+                raise ValidationError(f"duplicate file path {f.path}")
+            seen_paths.add(f.path)
+        seen_adapters = set()
+        for a in self.adapters:
+            a.validate()
+            if a.name in seen_adapters:
+                raise ValidationError(f"duplicate adapter {a.name}")
+            seen_adapters.add(a.name)
+        self.load_balancing.validate()
+
+
+@dataclasses.dataclass
+class ModelStatus:
+    """(reference: api/k8s/v1/model_types.go:226-239)"""
+
+    replicas_all: int = 0
+    replicas_ready: int = 0
+    cache_loaded: bool = False
+
+
+@dataclasses.dataclass
+class Model:
+    """A Model resource instance (metadata + spec + status)."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    resource_version: int = 0
+    generation: int = 1
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: dict[str, str] = dataclasses.field(default_factory=dict)
+    finalizers: list[str] = dataclasses.field(default_factory=list)
+    deletion_timestamp: float | None = None
+    spec: ModelSpec = dataclasses.field(default_factory=ModelSpec)
+    status: ModelStatus = dataclasses.field(default_factory=ModelStatus)
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValidationError("metadata.name required")
+        # name <= 40 chars so name+suffixes fit k8s limits
+        # (reference: api/k8s/v1/model_types.go:248).
+        if len(self.name) > MAX_NAME_LEN:
+            raise ValidationError(f"model name must be <= {MAX_NAME_LEN} chars")
+        if not re.fullmatch(r"^[a-z0-9]+(?:[-a-z0-9]*[a-z0-9])?$", self.name):
+            raise ValidationError("model name must be a lowercase DNS label")
+        self.spec.validate()
+
+    def validate_update(self, old: "Model") -> None:
+        self.validate()
+        # cacheProfile is immutable (reference: model_types.go:76-78).
+        if old.spec.cache_profile != self.spec.cache_profile:
+            raise ValidationError("spec.cacheProfile is immutable")
+        if old.spec.url != self.spec.url and old.spec.cache_profile:
+            raise ValidationError("spec.url is immutable when cacheProfile is set")
+
+    # -- dict round trip (k8s manifest shape) --------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "apiVersion": "kubeai.org/v1",
+            "kind": "Model",
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "uid": self.uid,
+                "resourceVersion": str(self.resource_version),
+                "generation": self.generation,
+                "labels": dict(self.labels),
+                "annotations": dict(self.annotations),
+                "finalizers": list(self.finalizers),
+                **(
+                    {"deletionTimestamp": self.deletion_timestamp}
+                    if self.deletion_timestamp
+                    else {}
+                ),
+            },
+            "spec": _spec_to_dict(self.spec),
+            "status": {
+                "replicas": {
+                    "all": self.status.replicas_all,
+                    "ready": self.status.replicas_ready,
+                },
+                "cache": {"loaded": self.status.cache_loaded},
+            },
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Model":
+        meta = d.get("metadata", {})
+        spec = d.get("spec", {})
+        status = d.get("status", {}) or {}
+        lb = spec.get("loadBalancing", {}) or {}
+        ph = lb.get("prefixHash", {}) or {}
+        return Model(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            uid=meta.get("uid", ""),
+            resource_version=int(meta.get("resourceVersion", 0) or 0),
+            generation=int(meta.get("generation", 1)),
+            labels=dict(meta.get("labels") or {}),
+            annotations=dict(meta.get("annotations") or {}),
+            finalizers=list(meta.get("finalizers") or []),
+            deletion_timestamp=meta.get("deletionTimestamp"),
+            spec=ModelSpec(
+                url=spec.get("url", ""),
+                engine=spec.get("engine", ENGINE_KUBEAI_TPU),
+                features=list(spec.get("features") or []),
+                adapters=[
+                    Adapter(name=a.get("name", ""), url=a.get("url", ""))
+                    for a in (spec.get("adapters") or [])
+                ],
+                resource_profile=spec.get("resourceProfile", ""),
+                cache_profile=spec.get("cacheProfile", ""),
+                image=spec.get("image", ""),
+                args=list(spec.get("args") or []),
+                env=dict(spec.get("env") or {}),
+                env_from=list(spec.get("envFrom") or []),
+                replicas=spec.get("replicas"),
+                min_replicas=int(spec.get("minReplicas", 0) or 0),
+                max_replicas=spec.get("maxReplicas"),
+                autoscaling_disabled=bool(spec.get("autoscalingDisabled", False)),
+                target_requests=int(spec.get("targetRequests", 100)),
+                scale_down_delay_seconds=int(spec.get("scaleDownDelaySeconds", 30)),
+                load_balancing=LoadBalancing(
+                    strategy=lb.get("strategy", LB_STRATEGY_LEAST_LOAD),
+                    prefix_hash=PrefixHash(
+                        mean_load_percentage=int(ph.get("meanLoadPercentage", 125)),
+                        replication=int(ph.get("replication", 256)),
+                        prefix_char_length=int(ph.get("prefixCharLength", 100)),
+                    ),
+                ),
+                files=[
+                    File(path=f.get("path", ""), content=f.get("content", ""))
+                    for f in (spec.get("files") or [])
+                ],
+                priority_class_name=spec.get("priorityClassName", ""),
+                owner=spec.get("owner", ""),
+            ),
+            status=ModelStatus(
+                replicas_all=int(
+                    ((status.get("replicas") or {}).get("all", 0))
+                ),
+                replicas_ready=int(
+                    ((status.get("replicas") or {}).get("ready", 0))
+                ),
+                cache_loaded=bool((status.get("cache") or {}).get("loaded", False)),
+            ),
+        )
+
+
+def _spec_to_dict(s: ModelSpec) -> dict:
+    d: dict[str, Any] = {
+        "url": s.url,
+        "engine": s.engine,
+        "features": list(s.features),
+    }
+    if s.adapters:
+        d["adapters"] = [{"name": a.name, "url": a.url} for a in s.adapters]
+    if s.resource_profile:
+        d["resourceProfile"] = s.resource_profile
+    if s.cache_profile:
+        d["cacheProfile"] = s.cache_profile
+    if s.image:
+        d["image"] = s.image
+    if s.args:
+        d["args"] = list(s.args)
+    if s.env:
+        d["env"] = dict(s.env)
+    if s.env_from:
+        d["envFrom"] = list(s.env_from)
+    if s.replicas is not None:
+        d["replicas"] = s.replicas
+    d["minReplicas"] = s.min_replicas
+    if s.max_replicas is not None:
+        d["maxReplicas"] = s.max_replicas
+    if s.autoscaling_disabled:
+        d["autoscalingDisabled"] = True
+    d["targetRequests"] = s.target_requests
+    d["scaleDownDelaySeconds"] = s.scale_down_delay_seconds
+    d["loadBalancing"] = {
+        "strategy": s.load_balancing.strategy,
+        "prefixHash": {
+            "meanLoadPercentage": s.load_balancing.prefix_hash.mean_load_percentage,
+            "replication": s.load_balancing.prefix_hash.replication,
+            "prefixCharLength": s.load_balancing.prefix_hash.prefix_char_length,
+        },
+    }
+    if s.files:
+        d["files"] = [{"path": f.path, "content": f.content} for f in s.files]
+    if s.priority_class_name:
+        d["priorityClassName"] = s.priority_class_name
+    if s.owner:
+        d["owner"] = s.owner
+    return d

@@ -1,0 +1,2 @@
+"""Operator control plane: reconcilers, pod planning, engines, cache,
+adapters (reference: internal/modelcontroller, internal/manager)."""

@@ -1,0 +1,135 @@
+"""LoRA adapter orchestration (reference: internal/modelcontroller/adapters.go:24-118).
+
+Per-Pod diff of `adapter.kubeai.org/<name>=hash(url)` labels against
+spec.adapters:
+  - missing/stale → download (exec into the loader sidecar for vLLM;
+    URL-direct for the in-tree TPU engine, which fetches adapters itself),
+    then the engine admin API, then set the Pod label
+  - labelled-but-unspecified → unload + remove the label
+
+The load balancer routes adapter-suffixed requests only to Pods carrying
+the adapter label (reference: internal/loadbalancer/load_balancer.go:90-127).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import Adapter, Model, ENGINE_KUBEAI_TPU, ENGINE_VLLM
+from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator.engine_client import EngineClient
+from kubeai_tpu.operator.k8s.store import KubeStore
+
+LOADER_CONTAINER = "loader"
+
+
+class ReturnEarly(Exception):
+    pass
+
+
+class PodExec(Protocol):
+    """Exec seam (reference: pod_utils.go:13-43 SPDY exec). Tests inject a
+    fake; production uses the k8s exec subresource."""
+
+    def exec(
+        self, namespace: str, pod: str, container: str, command: list[str]
+    ) -> None: ...
+
+
+def adapter_dir(adapter: Adapter) -> str:
+    return f"/adapters/{adapter.name}"
+
+
+def _pod_addr(pod: dict) -> str:
+    ip = k8sutils.get_annotation(pod, md.MODEL_POD_IP_ANNOTATION) or (
+        (pod.get("status") or {}).get("podIP", "")
+    )
+    port = k8sutils.get_annotation(pod, md.MODEL_POD_PORT_ANNOTATION) or "8000"
+    return f"http://{ip}:{port}"
+
+
+def _labelled_adapters(pod: dict) -> dict[str, str]:
+    labels = (pod.get("metadata") or {}).get("labels") or {}
+    prefix = md.ADAPTER_LABEL_DOMAIN + "/"
+    return {
+        k[len(prefix):]: v for k, v in labels.items() if k.startswith(prefix)
+    }
+
+
+def reconcile_adapters(
+    store: KubeStore,
+    model: Model,
+    pods: list[dict],
+    engine_client: EngineClient,
+    pod_exec: PodExec | None = None,
+) -> None:
+    adapters = model.spec.adapters
+    engine = model.spec.engine
+    if engine not in (ENGINE_VLLM, ENGINE_KUBEAI_TPU):
+        return
+
+    for pod in pods:
+        if not k8sutils.pod_is_ready(pod):
+            continue
+        addr = _pod_addr(pod)
+        candidates = _labelled_adapters(pod)
+        to_ensure: list[Adapter] = []
+        for adapter in adapters:
+            want_hash = k8sutils.string_hash(adapter.url)
+            if candidates.get(adapter.name) == want_hash:
+                candidates.pop(adapter.name, None)  # up to date
+            else:
+                to_ensure.append(adapter)
+        to_remove = list(candidates.keys())
+
+        for adapter in to_ensure:
+            if engine == ENGINE_VLLM:
+                # Download via the loader sidecar, then point vLLM at the
+                # shared emptyDir path.
+                if not k8sutils.container_is_ready(pod, LOADER_CONTAINER):
+                    raise ReturnEarly()
+                if pod_exec is not None:
+                    pod_exec.exec(
+                        pod["metadata"]["namespace"],
+                        pod["metadata"]["name"],
+                        LOADER_CONTAINER,
+                        ["load", adapter.url, adapter_dir(adapter)],
+                    )
+                engine_client.load_lora_adapter(
+                    addr,
+                    adapter.name,
+                    lora_path=adapter_dir(adapter),
+                    ignore_already_loaded=True,
+                )
+            else:
+                # TPU engine fetches the adapter itself from the URL.
+                engine_client.load_lora_adapter(
+                    addr,
+                    adapter.name,
+                    lora_url=adapter.url,
+                    ignore_already_loaded=True,
+                )
+            _update_pod_label(
+                store, pod, md.adapter_label(adapter.name),
+                k8sutils.string_hash(adapter.url),
+            )
+
+        for name in to_remove:
+            engine_client.unload_lora_adapter(addr, name, ignore_not_found=True)
+            _remove_pod_label(store, pod, md.adapter_label(name))
+
+
+def _update_pod_label(store: KubeStore, pod: dict, key: str, value: str) -> None:
+    fresh = store.get("Pod", pod["metadata"]["namespace"], pod["metadata"]["name"])
+    fresh["metadata"].setdefault("labels", {})[key] = value
+    store.update(fresh)
+    pod["metadata"].setdefault("labels", {})[key] = value
+
+
+def _remove_pod_label(store: KubeStore, pod: dict, key: str) -> None:
+    fresh = store.get("Pod", pod["metadata"]["namespace"], pod["metadata"]["name"])
+    labels = fresh["metadata"].get("labels") or {}
+    labels.pop(key, None)
+    store.update(fresh)
+    (pod["metadata"].get("labels") or {}).pop(key, None)

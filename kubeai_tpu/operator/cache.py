@@ -1,0 +1,217 @@
+"""Model-artifact cache subsystem (reference: internal/modelcontroller/cache.go).
+
+Shared-filesystem PVC per cacheProfile; a loader Job downloads the model to
+`/models/<name>-<uid>`; the PVC annotation `models.kubeai.org/<model>`
+records which Model UID is loaded; deletion runs an eviction Job guarded by
+the `kubeai.org/cache-eviction` finalizer.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from kubeai_tpu.config import System
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import Model
+from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator.engines.common import ModelConfig
+from kubeai_tpu.operator.k8s.store import KubeStore
+
+
+class ReturnEarly(Exception):
+    """Reconcile should stop and wait for the next event
+    (reference: modelcontroller errReturnEarly)."""
+
+
+def cache_pvc_name(model: Model, cfg: System) -> str:
+    profile = model.spec.cache_profile
+    cp = cfg.cache_profiles.get(profile)
+    if cp and cp.shared_filesystem is not None:
+        return f"shared-model-cache-{profile}"
+    return f"model-cache-{model.name}"
+
+
+def load_cache_job_name(model: Model) -> str:
+    return f"load-cache-{model.name}"
+
+
+def evict_cache_job_name(model: Model) -> str:
+    return f"evict-cache-{model.name}"
+
+
+def cache_dir(model: Model) -> str:
+    # /models/<name>-<uid> (reference: cache.go loadCacheJobForModel).
+    return f"/models/{model.name}-{model.uid}"
+
+
+def _parse_pvc_model_annotation(pvc: dict, model_name: str) -> dict:
+    raw = k8sutils.get_annotation(pvc, md.pvc_model_annotation(model_name))
+    if not raw:
+        return {"uid": "", "timestamp": 0}
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        return {"uid": "", "timestamp": 0}
+
+
+def _pvc_for_model(model: Model, cfg: System) -> dict:
+    cp = cfg.cache_profiles[model.spec.cache_profile]
+    shared = cp.shared_filesystem or {}
+    spec: dict = {
+        "accessModes": ["ReadWriteMany"],
+        "resources": {"requests": {"storage": shared.get("size", "100Gi")}},
+    }
+    if shared.get("storageClassName"):
+        spec["storageClassName"] = shared["storageClassName"]
+    if shared.get("persistentVolumeName"):
+        spec["volumeName"] = shared["persistentVolumeName"]
+    return {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": {
+            "name": cache_pvc_name(model, cfg),
+            "namespace": model.namespace,
+            "annotations": {},
+        },
+        "spec": spec,
+    }
+
+
+def _loader_job(model: Model, cfg: System, name: str, args: list[str]) -> dict:
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": name, "namespace": model.namespace},
+        "spec": {
+            "backoffLimit": 6,
+            "template": {
+                "spec": {
+                    "restartPolicy": "OnFailure",
+                    "containers": [
+                        {
+                            "name": "loader",
+                            "image": cfg.model_loading_image,
+                            "args": args,
+                            "volumeMounts": [
+                                {"name": "model-cache", "mountPath": "/models"}
+                            ],
+                        }
+                    ],
+                    "volumes": [
+                        {
+                            "name": "model-cache",
+                            "persistentVolumeClaim": {
+                                "claimName": cache_pvc_name(model, cfg)
+                            },
+                        }
+                    ],
+                }
+            },
+        },
+    }
+
+
+def reconcile_cache(
+    store: KubeStore, model: Model, model_obj: dict, cfg: System, mcfg: ModelConfig
+) -> bool:
+    """Ensure PVC + loader Job; returns cache_loaded. Raises ReturnEarly
+    while loading is in flight (reference: cache.go:30-134)."""
+    pvc = store.try_get("PersistentVolumeClaim", model.namespace, cache_pvc_name(model, cfg))
+    deleted = model.deletion_timestamp is not None
+    if pvc is None:
+        if not deleted:
+            pvc = store.create(_pvc_for_model(model, cfg))
+        else:
+            return False
+
+    cp = cfg.cache_profiles.get(model.spec.cache_profile)
+    if cp and cp.shared_filesystem is not None:
+        # Shared caches need per-model cleanup on delete → finalizer.
+        if md.CACHE_EVICTION_FINALIZER not in model_obj["metadata"].setdefault(
+            "finalizers", []
+        ):
+            model_obj["metadata"]["finalizers"].append(md.CACHE_EVICTION_FINALIZER)
+            store.update(model_obj)
+
+    job = store.try_get("Job", model.namespace, load_cache_job_name(model))
+    ann = _parse_pvc_model_annotation(pvc, model.name)
+
+    if ann["uid"] != model.uid:
+        if job is None:
+            job = _loader_job(
+                model,
+                cfg,
+                load_cache_job_name(model),
+                ["load", model.spec.url, cache_dir(model)],
+            )
+            k8sutils.set_owner_reference(model_obj, job)
+            store.create(job)
+            raise ReturnEarly()
+        if not k8sutils.job_is_complete(job):
+            raise ReturnEarly()
+        pvc = store.get(
+            "PersistentVolumeClaim", model.namespace, cache_pvc_name(model, cfg)
+        )
+        pvc["metadata"].setdefault("annotations", {})[
+            md.pvc_model_annotation(model.name)
+        ] = json.dumps({"uid": model.uid, "timestamp": time.time()})
+        store.update(pvc)
+        ann = {"uid": model.uid}
+
+    loaded = ann["uid"] == model.uid
+    if job is not None:
+        # Completed: delete to avoid accumulating Jobs (reference: cache.go:126-131).
+        store.delete("Job", model.namespace, load_cache_job_name(model))
+    return loaded
+
+
+def finalize_cache(
+    store: KubeStore, model: Model, model_obj: dict, cfg: System, mcfg: ModelConfig
+) -> None:
+    """Eviction flow on Model delete (reference: cache.go:136-217)."""
+    pvc = store.try_get(
+        "PersistentVolumeClaim", model.namespace, cache_pvc_name(model, cfg)
+    )
+    if pvc is None or (pvc["metadata"].get("deletionTimestamp") is not None):
+        _delete_cache_jobs(store, model)
+        _remove_finalizer(store, model_obj)
+        return
+
+    if md.CACHE_EVICTION_FINALIZER in (model_obj["metadata"].get("finalizers") or []):
+        evict = store.try_get("Job", model.namespace, evict_cache_job_name(model))
+        if evict is None:
+            job = _loader_job(
+                model,
+                cfg,
+                evict_cache_job_name(model),
+                ["evict", cache_dir(model)],
+            )
+            k8sutils.set_owner_reference(model_obj, job)
+            store.create(job)
+            raise ReturnEarly()
+        if not k8sutils.job_is_complete(evict):
+            raise ReturnEarly()
+        ann_key = md.pvc_model_annotation(model.name)
+        if ann_key in (pvc["metadata"].get("annotations") or {}):
+            del pvc["metadata"]["annotations"][ann_key]
+            store.update(pvc)
+        _remove_finalizer(store, model_obj)
+    _delete_cache_jobs(store, model)
+
+
+def _delete_cache_jobs(store: KubeStore, model: Model) -> None:
+    from kubeai_tpu.operator.k8s.store import NotFound
+
+    for name in (load_cache_job_name(model), evict_cache_job_name(model)):
+        try:
+            store.delete("Job", model.namespace, name)
+        except NotFound:
+            pass
+
+
+def _remove_finalizer(store: KubeStore, model_obj: dict) -> None:
+    fins = model_obj["metadata"].get("finalizers") or []
+    if md.CACHE_EVICTION_FINALIZER in fins:
+        fins.remove(md.CACHE_EVICTION_FINALIZER)
+        store.update(model_obj)

@@ -1,0 +1,273 @@
+"""The Model reconciler — the heart of the operator
+(reference: internal/modelcontroller/model_controller.go:70-198).
+
+Reconcile pass:
+  files ConfigMap → self feature-labels → autoscaling replica bounds →
+  model config resolution → [deletion: delete Pods + finalize cache] →
+  [cacheProfile: reconcile cache, early-return while loading] →
+  list Pods → status.replicas → pod plan (surge rollout) → adapters.
+
+Runs against the KubeStore interface; a watch-driven `ControllerLoop`
+(bottom) plays the controller-runtime role — Model events and events from
+owned Pods/Jobs/PVCs enqueue the owning Model
+(reference: model_controller.go:201-209 Owns(...)).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import traceback
+
+from kubeai_tpu.config import System
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import Model
+from kubeai_tpu.operator import adapters as adapters_mod
+from kubeai_tpu.operator import cache as cache_mod
+from kubeai_tpu.operator import files as files_mod
+from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator.engine_client import EngineClient
+from kubeai_tpu.operator.engines import render_pod, resolve_model_config
+from kubeai_tpu.operator.k8s.store import Conflict, KubeStore, NotFound
+from kubeai_tpu.operator.patch import apply_json_patches
+from kubeai_tpu.operator.pod_plan import calculate_pod_plan
+
+logger = logging.getLogger(__name__)
+
+
+class ModelReconciler:
+    def __init__(
+        self,
+        store: KubeStore,
+        cfg: System,
+        engine_client: EngineClient | None = None,
+        pod_exec: adapters_mod.PodExec | None = None,
+    ):
+        self.store = store
+        self.cfg = cfg
+        self.engine_client = engine_client or EngineClient()
+        self.pod_exec = pod_exec
+
+    # -- reconcile ------------------------------------------------------------
+
+    def reconcile(self, namespace: str, name: str) -> None:
+        try:
+            model_obj = self.store.get("Model", namespace, name)
+        except NotFound:
+            return
+        model = Model.from_dict(model_obj)
+
+        try:
+            self._reconcile(model, model_obj)
+        except (cache_mod.ReturnEarly, adapters_mod.ReturnEarly):
+            return
+        except Conflict:
+            # Stale snapshot — the next watch event re-enqueues us.
+            return
+
+    def _reconcile(self, model: Model, model_obj: dict) -> None:
+        files_mod.ensure_model_files_configmap(self.store, model, model_obj)
+
+        if self._apply_self_labels(model_obj) | self._apply_replica_bounds(
+            model_obj
+        ):
+            model_obj = self.store.update(model_obj)
+            model = Model.from_dict(model_obj)
+
+        mcfg = resolve_model_config(model, self.cfg)
+        if model.spec.cache_profile:
+            mcfg.cache_dir = cache_mod.cache_dir(model)
+
+        # Deletion path (reference: model_controller.go:112-133).
+        if model.deletion_timestamp is not None:
+            self.store.delete_all_of(
+                "Pod", model.namespace, {md.POD_MODEL_LABEL: model.name}
+            )
+            if model.spec.cache_profile:
+                cache_mod.finalize_cache(
+                    self.store, model, model_obj, self.cfg, mcfg
+                )
+            return
+
+        if model.spec.cache_profile:
+            loaded = cache_mod.reconcile_cache(
+                self.store, model, model_obj, self.cfg, mcfg
+            )
+            self._patch_status(model, cache_loaded=loaded)
+            if not loaded:
+                return
+
+        pods = self.store.list(
+            "Pod", model.namespace, {md.POD_MODEL_LABEL: model.name}
+        )
+        ready = sum(1 for p in pods if k8sutils.pod_is_ready(p))
+        self._patch_status(model, replicas_all=len(pods), replicas_ready=ready)
+
+        desired_pod = render_pod(model, self.cfg, mcfg, "x")
+        self._apply_model_annotations(model, desired_pod)
+        if self.cfg.model_server_pods.json_patches:
+            desired_pod = apply_json_patches(
+                self.cfg.model_server_pods.json_patches, desired_pod
+            )
+        plan = calculate_pod_plan(
+            pods, model, desired_pod, self.cfg.model_rollouts.surge
+        )
+        if plan.contains_actions():
+            plan.execute(self.store, model_obj)
+            pods = self.store.list(
+                "Pod", model.namespace, {md.POD_MODEL_LABEL: model.name}
+            )
+            ready = sum(1 for p in pods if k8sutils.pod_is_ready(p))
+            self._patch_status(
+                model, replicas_all=len(pods), replicas_ready=ready
+            )
+            return  # adapter pass runs on the next event, against fresh pods
+
+        adapters_mod.reconcile_adapters(
+            self.store, model, plan.to_remain, self.engine_client, self.pod_exec
+        )
+
+    # -- helpers --------------------------------------------------------------
+
+    def _apply_self_labels(self, model_obj: dict) -> bool:
+        """Feature labels on the Model itself
+        (reference: model_controller.go:374-407)."""
+        labels = model_obj["metadata"].setdefault("labels", {})
+        features = set((model_obj.get("spec") or {}).get("features") or [])
+        changed = False
+        prefix = md.MODEL_FEATURE_LABEL_DOMAIN + "/"
+        for key in list(labels):
+            if key.startswith(prefix) and key[len(prefix):] not in features:
+                del labels[key]
+                changed = True
+        for f in features:
+            if labels.get(prefix + f) != "true":
+                labels[prefix + f] = "true"
+                changed = True
+        return changed
+
+    def _apply_replica_bounds(self, model_obj: dict) -> bool:
+        """Clamp spec.replicas to [minReplicas, maxReplicas]
+        (reference: model_controller.go:357-372)."""
+        spec = model_obj.setdefault("spec", {})
+        mn = int(spec.get("minReplicas", 0) or 0)
+        mx = spec.get("maxReplicas")
+        replicas = spec.get("replicas")
+        if replicas is None or replicas < mn:
+            spec["replicas"] = mn
+            return True
+        if mx is not None and replicas > mx:
+            spec["replicas"] = mx
+            return True
+        return False
+
+    def _apply_model_annotations(self, model: Model, pod: dict) -> None:
+        """Copy address-override annotations when enabled — the integration-
+        test seam for fake backends (reference: model_controller.go:228-248,
+        test/integration/utils_test.go:150-159)."""
+        if not self.cfg.allow_pod_address_override:
+            return
+        for key in (md.MODEL_POD_IP_ANNOTATION, md.MODEL_POD_PORT_ANNOTATION):
+            if key in model.annotations:
+                pod["metadata"].setdefault("annotations", {})[key] = (
+                    model.annotations[key]
+                )
+
+    def _patch_status(self, model: Model, **kwargs) -> None:
+        patch: dict = {"status": {}}
+        if "replicas_all" in kwargs or "replicas_ready" in kwargs:
+            patch["status"]["replicas"] = {}
+            if "replicas_all" in kwargs:
+                patch["status"]["replicas"]["all"] = kwargs["replicas_all"]
+            if "replicas_ready" in kwargs:
+                patch["status"]["replicas"]["ready"] = kwargs["replicas_ready"]
+        if "cache_loaded" in kwargs:
+            patch["status"]["cache"] = {"loaded": kwargs["cache_loaded"]}
+        try:
+            self.store.patch_merge("Model", model.namespace, model.name, patch)
+        except NotFound:
+            pass
+
+
+class ControllerLoop:
+    """Watch-driven reconcile loop (controller-runtime equivalent)."""
+
+    WATCHED_KINDS = ("Model", "Pod", "Job", "PersistentVolumeClaim")
+
+    def __init__(self, reconciler: ModelReconciler):
+        self.reconciler = reconciler
+        self.store = reconciler.store
+        self._events = self.store.watch(self.WATCHED_KINDS)
+        self._queue: "queue.Queue[tuple[str, str] | None]" = queue.Queue()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> None:
+        self._threads = [
+            threading.Thread(target=self._watch_loop, daemon=True),
+            threading.Thread(target=self._work_loop, daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+        # Initial sync: reconcile everything already in the store.
+        for obj in self.store.list("Model"):
+            self._enqueue_obj(obj)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._events.put(None)
+        self._queue.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def _enqueue_obj(self, obj: dict) -> None:
+        kind = obj.get("kind")
+        meta = obj.get("metadata") or {}
+        ns = meta.get("namespace", "default")
+        if kind == "Model":
+            self._queue.put((ns, meta.get("name", "")))
+            return
+        # Owned objects map back to their Model via the `model` label or
+        # owner references.
+        model_name = ((meta.get("labels") or {}).get(md.POD_MODEL_LABEL))
+        if model_name:
+            self._queue.put((ns, model_name))
+            return
+        for ref in meta.get("ownerReferences") or []:
+            if ref.get("kind") == "Model":
+                self._queue.put((ns, ref.get("name", "")))
+
+    def _watch_loop(self) -> None:
+        while not self._stop.is_set():
+            item = self._events.get()
+            if item is None:
+                return
+            _event, obj = item
+            self._enqueue_obj(obj)
+
+    def _work_loop(self) -> None:
+        while not self._stop.is_set():
+            item = self._queue.get()
+            if item is None:
+                return
+            ns, name = item
+            # Coalesce duplicate keys waiting in the queue.
+            pending = []
+            try:
+                while True:
+                    nxt = self._queue.get_nowait()
+                    if nxt is None:
+                        return
+                    if nxt != (ns, name):
+                        pending.append(nxt)
+            except queue.Empty:
+                pass
+            for p in pending:
+                self._queue.put(p)
+            try:
+                self.reconciler.reconcile(ns, name)
+            except Exception:
+                logger.error(
+                    "reconcile %s/%s failed:\n%s", ns, name, traceback.format_exc()
+                )

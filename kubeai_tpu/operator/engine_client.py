@@ -1,0 +1,79 @@
+"""Engine admin client: LoRA adapter load/unload over the engine's HTTP
+admin API.
+
+Generalizes the reference's vLLM-only client
+(reference: internal/vllmclient/client.go:30-73) into the seam SURVEY.md §2
+calls out: the same `/v1/load_lora_adapter` + `/v1/unload_lora_adapter`
+contract is spoken by vLLM AND by the in-tree TPU engine
+(kubeai_tpu.engine.server), so one client covers both. Error handling is
+idempotency-tolerant: "already loaded" / "not found" are success when the
+caller says so.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+
+class EngineClientError(RuntimeError):
+    pass
+
+
+class EngineClient:
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+
+    def _post(self, url: str, body: dict) -> tuple[int, str]:
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, resp.read().decode(errors="replace")
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode(errors="replace")
+        except OSError as e:
+            raise EngineClientError(f"POST {url}: {e}") from e
+
+    def load_lora_adapter(
+        self,
+        addr: str,
+        lora_name: str,
+        lora_path: str = "",
+        lora_url: str = "",
+        ignore_already_loaded: bool = False,
+    ) -> None:
+        body: dict = {"lora_name": lora_name}
+        if lora_path:
+            body["lora_path"] = lora_path
+        if lora_url:
+            body["lora_url"] = lora_url
+        status, text = self._post(f"{addr}/v1/load_lora_adapter", body)
+        if status == 200:
+            return
+        if ignore_already_loaded and "already" in text.lower():
+            return
+        raise EngineClientError(
+            f"load adapter {lora_name} at {addr}: HTTP {status}: {text[:200]}"
+        )
+
+    def unload_lora_adapter(
+        self, addr: str, lora_name: str, ignore_not_found: bool = False
+    ) -> None:
+        status, text = self._post(
+            f"{addr}/v1/unload_lora_adapter", {"lora_name": lora_name}
+        )
+        if status == 200:
+            return
+        if ignore_not_found and status == 404:
+            return
+        if ignore_not_found and "not" in text.lower() and "found" in text.lower():
+            return
+        raise EngineClientError(
+            f"unload adapter {lora_name} at {addr}: HTTP {status}: {text[:200]}"
+        )

@@ -1,0 +1,27 @@
+"""Engine Pod renderers (reference: internal/modelcontroller/engine_*.go).
+
+One renderer per engine type; each returns a Pod manifest dict for a Model
+replica. The KubeAITPU renderer is the TPU-native path (in-tree JAX engine
+server, `google.com/tpu` resources, ICI topology from the resource profile);
+OLlama/VLLM/FasterWhisper/Infinity keep capability parity with the
+reference's external-engine orchestration.
+"""
+
+from kubeai_tpu.operator.engines.common import ModelConfig, resolve_model_config
+from kubeai_tpu.operator.engines.kubeai_tpu_engine import kubeai_tpu_pod
+from kubeai_tpu.operator.engines.ollama import ollama_pod
+from kubeai_tpu.operator.engines.vllm import vllm_pod
+from kubeai_tpu.operator.engines.fasterwhisper import fasterwhisper_pod
+from kubeai_tpu.operator.engines.infinity import infinity_pod
+
+RENDERERS = {
+    "KubeAITPU": kubeai_tpu_pod,
+    "OLlama": ollama_pod,
+    "VLLM": vllm_pod,
+    "FasterWhisper": fasterwhisper_pod,
+    "Infinity": infinity_pod,
+}
+
+
+def render_pod(model, cfg, mcfg, index_suffix: str) -> dict:
+    return RENDERERS[model.spec.engine](model, cfg, mcfg, index_suffix)

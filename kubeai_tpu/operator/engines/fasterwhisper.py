@@ -1,0 +1,66 @@
+"""FasterWhisper Pod renderer (reference: internal/modelcontroller/engine_fasterwhisper.go).
+
+Env-configured engine for the SpeechToText feature.
+"""
+
+from __future__ import annotations
+
+from kubeai_tpu.config import System
+from kubeai_tpu.crd.model import Model
+from kubeai_tpu.operator.engines.common import (
+    ModelConfig,
+    base_pod,
+    files_volume,
+    model_env,
+    source_env_and_volumes,
+)
+
+PORT = 8000
+
+
+def fasterwhisper_pod(model: Model, cfg: System, mcfg: ModelConfig, suffix: str) -> dict:
+    pod = base_pod(model, cfg, mcfg, suffix)
+    env, volumes, mounts = source_env_and_volumes(model, cfg, mcfg)
+    fvols, fmounts = files_volume(model, f"model-{model.name}-files")
+    volumes += fvols
+    mounts += fmounts
+
+    src = mcfg.source
+    model_id = "/model" if src.scheme == "pvc" else src.ref
+    env.append({"name": "WHISPER__MODEL", "value": model_id})
+    env.append({"name": "WHISPER__PORT", "value": str(PORT)})
+    env.append({"name": "ENABLE_UI", "value": "false"})
+    env += model_env(model)
+
+    container = {
+        "name": "server",
+        "image": mcfg.image,
+        "args": list(model.spec.args),
+        "env": env,
+        "ports": [{"containerPort": PORT, "name": "http"}],
+        "resources": {"requests": mcfg.requests, "limits": mcfg.limits},
+        "volumeMounts": mounts,
+        "startupProbe": {
+            "httpGet": {"path": "/health", "port": PORT},
+            "periodSeconds": 10,
+            "failureThreshold": 360,
+        },
+        "readinessProbe": {
+            "httpGet": {"path": "/health", "port": PORT},
+            "periodSeconds": 10,
+        },
+        "livenessProbe": {
+            "httpGet": {"path": "/health", "port": PORT},
+            "periodSeconds": 30,
+            "failureThreshold": 3,
+        },
+    }
+    if cfg.model_server_pods.container_security_context:
+        container["securityContext"] = cfg.model_server_pods.container_security_context
+    if model.spec.env_from:
+        container["envFrom"] = list(model.spec.env_from)
+
+    pod["spec"]["containers"] = [container]
+    pod["spec"]["volumes"] = volumes
+    pod["metadata"]["annotations"]["model-pod-port"] = str(PORT)
+    return pod

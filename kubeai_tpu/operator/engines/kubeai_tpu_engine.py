@@ -1,0 +1,86 @@
+"""Pod renderer for the in-tree TPU engine (the reference has no analog —
+its TPU path launches stock vLLM-TPU images, reference:
+charts/kubeai/values.yaml:48 + values-gke.yaml:18-41; here the engine is
+kubeai_tpu.engine.server running on the slice).
+
+TPU-specific rendering:
+  - `google.com/tpu` requests/limits from the resource profile
+  - ICI topology flows to the engine via TPU_TOPOLOGY env (mesh shape)
+  - generous startup probe budget for sharded weight loading (the
+    reference gives vLLM 3h — reference: engine_vllm.go:101-107)
+"""
+
+from __future__ import annotations
+
+from kubeai_tpu.config import System
+from kubeai_tpu.crd.model import Model
+from kubeai_tpu.operator.engines.common import (
+    ModelConfig,
+    base_pod,
+    files_volume,
+    model_env,
+    source_env_and_volumes,
+)
+
+PORT = 8000
+
+
+def kubeai_tpu_pod(model: Model, cfg: System, mcfg: ModelConfig, suffix: str) -> dict:
+    pod = base_pod(model, cfg, mcfg, suffix)
+    env, volumes, mounts = source_env_and_volumes(model, cfg, mcfg)
+    fvols, fmounts = files_volume(model, f"model-{model.name}-files")
+    volumes += fvols
+    mounts += fmounts
+
+    args = [
+        "--model-url", model.spec.url,
+        "--served-model-name", model.name,
+        "--port", str(PORT),
+    ]
+    if mcfg.tpu_topology:
+        args += ["--tpu-topology", mcfg.tpu_topology]
+    if mcfg.cache_dir:
+        args += ["--model-dir", mcfg.cache_dir]
+    # Adapters are NOT baked into the spec: they hot-swap through the
+    # /v1/load_lora_adapter admin API (see operator/adapters.py), so adapter
+    # changes never trigger a pod rollout.
+    args += list(model.spec.args)
+
+    env.append({"name": "TPU_TOPOLOGY", "value": mcfg.tpu_topology or "1x1"})
+    env.append({"name": "TPU_CHIPS", "value": str(mcfg.tpu_chips or 1)})
+    env += model_env(model)
+
+    container = {
+        "name": "server",
+        "image": mcfg.image,
+        "args": args,
+        "env": env,
+        "ports": [{"containerPort": PORT, "name": "http"}],
+        "resources": {"requests": mcfg.requests, "limits": mcfg.limits},
+        "volumeMounts": mounts,
+        # Sharded weight streaming into slice HBM can take a long time on
+        # first boot (no cache); same 3h ceiling the reference grants vLLM.
+        "startupProbe": {
+            "httpGet": {"path": "/health", "port": PORT},
+            "periodSeconds": 10,
+            "failureThreshold": 1080,
+        },
+        "readinessProbe": {
+            "httpGet": {"path": "/health", "port": PORT},
+            "periodSeconds": 10,
+        },
+        "livenessProbe": {
+            "httpGet": {"path": "/health", "port": PORT},
+            "periodSeconds": 30,
+            "failureThreshold": 3,
+        },
+    }
+    if cfg.model_server_pods.container_security_context:
+        container["securityContext"] = cfg.model_server_pods.container_security_context
+    if model.spec.env_from:
+        container["envFrom"] = list(model.spec.env_from)
+
+    pod["spec"]["containers"] = [container]
+    pod["spec"]["volumes"] = volumes
+    pod["metadata"]["annotations"]["model-pod-port"] = str(PORT)
+    return pod

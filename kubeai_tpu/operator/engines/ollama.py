@@ -1,0 +1,103 @@
+"""Ollama Pod renderer (reference: internal/modelcontroller/engine_ollama.go:13-213).
+
+The startup probe runs a shell script that pulls (or copies from PVC),
+renames via `ollama cp` so the served name matches the Model name, and
+warm-ups with `ollama run` — so Ready == actually serving, which the
+blocking load balancer relies on.
+"""
+
+from __future__ import annotations
+
+import shlex
+
+from kubeai_tpu.config import System
+from kubeai_tpu.crd.model import Model
+from kubeai_tpu.operator.engines.common import (
+    ModelConfig,
+    base_pod,
+    files_volume,
+    model_env,
+    source_env_and_volumes,
+)
+
+PORT = 8000
+
+
+def ollama_pod(model: Model, cfg: System, mcfg: ModelConfig, suffix: str) -> dict:
+    pod = base_pod(model, cfg, mcfg, suffix)
+    env, volumes, mounts = source_env_and_volumes(model, cfg, mcfg)
+    fvols, fmounts = files_volume(model, f"model-{model.name}-files")
+    volumes += fvols
+    mounts += fmounts
+
+    src = mcfg.source
+    is_pvc = src.scheme == "pvc"
+    ollama_ref = src.named_model or src.ref if not is_pvc else (
+        src.named_model or model.name
+    )
+
+    # Startup script (reference: engine_ollama.go:173-213): pull/copy, then
+    # rename to the Model name, then a warm-up generation.
+    steps = []
+    if is_pvc:
+        steps.append("true")  # models are preloaded under OLLAMA_MODELS
+    else:
+        pull = src.pull_policy or "missing"
+        if pull == "always":
+            steps.append(f"ollama pull {shlex.quote(ollama_ref)}")
+        elif pull == "never":
+            steps.append("true")
+        else:
+            steps.append(
+                f"ollama list | grep -q {shlex.quote(ollama_ref)} || "
+                f"ollama pull {shlex.quote(ollama_ref)}"
+            )
+    if ollama_ref != model.name:
+        steps.append(
+            f"ollama cp {shlex.quote(ollama_ref)} {shlex.quote(model.name)}"
+        )
+    steps.append(f"ollama run {shlex.quote(model.name)} hi")
+    script = " && ".join(steps)
+
+    env.append({"name": "OLLAMA_HOST", "value": f"0.0.0.0:{PORT}"})
+    # Never evict loaded models (reference: engine_ollama.go KEEP_ALIVE).
+    env.append({"name": "OLLAMA_KEEP_ALIVE", "value": "999999h"})
+    if is_pvc:
+        path = "/model" + ("/" + src.ref.split("/", 1)[1] if "/" in src.ref else "")
+        env.append({"name": "OLLAMA_MODELS", "value": path})
+    if src.insecure:
+        env.append({"name": "OLLAMA_INSECURE", "value": "true"})
+    env += model_env(model)
+
+    container = {
+        "name": "server",
+        "image": mcfg.image,
+        "env": env,
+        "ports": [{"containerPort": PORT, "name": "http"}],
+        "resources": {"requests": mcfg.requests, "limits": mcfg.limits},
+        "volumeMounts": mounts,
+        "startupProbe": {
+            "exec": {"command": ["bash", "-c", script]},
+            "periodSeconds": 10,
+            "failureThreshold": 180,
+            "timeoutSeconds": 600,
+        },
+        "readinessProbe": {
+            "httpGet": {"path": "/", "port": PORT},
+            "periodSeconds": 10,
+        },
+        "livenessProbe": {
+            "httpGet": {"path": "/", "port": PORT},
+            "periodSeconds": 30,
+            "failureThreshold": 3,
+        },
+    }
+    if cfg.model_server_pods.container_security_context:
+        container["securityContext"] = cfg.model_server_pods.container_security_context
+    if model.spec.env_from:
+        container["envFrom"] = list(model.spec.env_from)
+
+    pod["spec"]["containers"] = [container]
+    pod["spec"]["volumes"] = volumes
+    pod["metadata"]["annotations"]["model-pod-port"] = str(PORT)
+    return pod

@@ -1,0 +1,109 @@
+"""vLLM Pod renderer (reference: internal/modelcontroller/engine_vllm.go:12-167).
+
+Kept for capability parity — users migrating from the reference can keep
+GPU Models running unchanged while TPU Models use the in-tree engine.
+"""
+
+from __future__ import annotations
+
+from kubeai_tpu.config import System
+from kubeai_tpu.crd.model import Model
+from kubeai_tpu.operator.engines.common import (
+    ModelConfig,
+    base_pod,
+    files_volume,
+    model_env,
+    source_env_and_volumes,
+)
+
+PORT = 8000
+
+
+def vllm_pod(model: Model, cfg: System, mcfg: ModelConfig, suffix: str) -> dict:
+    pod = base_pod(model, cfg, mcfg, suffix)
+    env, volumes, mounts = source_env_and_volumes(model, cfg, mcfg)
+    fvols, fmounts = files_volume(model, f"model-{model.name}-files")
+    volumes += fvols
+    mounts += fmounts
+
+    src = mcfg.source
+    if src.scheme == "pvc":
+        model_arg = "/model" + (
+            "/" + src.ref.split("/", 1)[1] if "/" in src.ref else ""
+        )
+    elif src.scheme == "hf":
+        model_arg = src.ref
+    elif src.scheme in ("s3", "gs", "oss"):
+        # runai-streamer loads object storage directly
+        # (reference: engine_vllm.go s3 handling).
+        model_arg = f"{src.scheme}://{src.ref}"
+    else:
+        model_arg = src.ref
+    if mcfg.cache_dir:
+        model_arg = mcfg.cache_dir
+
+    args = ["--model=" + model_arg, f"--served-model-name={model.name}", f"--port={PORT}"]
+    if src.scheme in ("s3", "gs", "oss"):
+        args.append("--load-format=runai_streamer")
+    if model.spec.adapters:
+        args.append("--enable-lora")
+    args += list(model.spec.args)
+
+    env += model_env(model)
+    if model.spec.adapters:
+        env.append({"name": "VLLM_ALLOW_RUNTIME_LORA_UPDATING", "value": "True"})
+
+    # /dev/shm for torch inter-process comms (reference: engine_vllm.go).
+    volumes.append({"name": "dshm", "emptyDir": {"medium": "Memory"}})
+    mounts.append({"name": "dshm", "mountPath": "/dev/shm"})
+
+    container = {
+        "name": "server",
+        "image": mcfg.image,
+        "args": args,
+        "env": env,
+        "ports": [{"containerPort": PORT, "name": "http"}],
+        "resources": {"requests": mcfg.requests, "limits": mcfg.limits},
+        "volumeMounts": mounts,
+        # 3h startup budget for big-weight loads (reference: engine_vllm.go:101-107).
+        "startupProbe": {
+            "httpGet": {"path": "/health", "port": PORT},
+            "periodSeconds": 10,
+            "failureThreshold": 1080,
+        },
+        "readinessProbe": {
+            "httpGet": {"path": "/health", "port": PORT},
+            "periodSeconds": 10,
+        },
+        "livenessProbe": {
+            "httpGet": {"path": "/health", "port": PORT},
+            "periodSeconds": 30,
+            "failureThreshold": 3,
+        },
+    }
+    if cfg.model_server_pods.container_security_context:
+        container["securityContext"] = cfg.model_server_pods.container_security_context
+    if model.spec.env_from:
+        container["envFrom"] = list(model.spec.env_from)
+
+    # Adapter loader sidecar (exec target for adapter downloads,
+    # reference: adapters.go:203-217).
+    if model.spec.adapters:
+        pod["spec"]["initContainers"] = [
+            {
+                "name": "loader",
+                "image": cfg.model_loading_image,
+                "command": ["sleep", "infinity"],
+                "restartPolicy": "Always",  # sidecar
+                "volumeMounts": [
+                    {"name": "adapters", "mountPath": "/adapters"}
+                ],
+            }
+        ]
+        volumes.append({"name": "adapters", "emptyDir": {}})
+        mounts.append({"name": "adapters", "mountPath": "/adapters"})
+
+    pod["spec"]["containers"] = [container]
+    pod["spec"]["volumes"] = volumes
+    pod["metadata"]["annotations"]["model-pod-port"] = str(PORT)
+    return pod

@@ -1,0 +1,40 @@
+"""Model files ConfigMap projection (reference: internal/modelcontroller/files.go).
+
+spec.files entries are stored in a per-model ConfigMap and mounted into
+server Pods via items/subPath (see engines/common.files_volume).
+"""
+
+from __future__ import annotations
+
+from kubeai_tpu.crd.model import Model
+from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator.k8s.store import KubeStore, NotFound
+
+
+def files_configmap_name(model: Model) -> str:
+    return f"model-{model.name}-files"
+
+
+def ensure_model_files_configmap(
+    store: KubeStore, model: Model, model_obj: dict
+) -> None:
+    """Create/update/delete the files ConfigMap to match spec.files."""
+    name = files_configmap_name(model)
+    existing = store.try_get("ConfigMap", model.namespace, name)
+    if not model.spec.files:
+        if existing is not None:
+            store.delete("ConfigMap", model.namespace, name)
+        return
+    data = {f"file-{i}": f.content for i, f in enumerate(model.spec.files)}
+    if existing is None:
+        cm = {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": model.namespace},
+            "data": data,
+        }
+        k8sutils.set_owner_reference(model_obj, cm)
+        store.create(cm)
+    elif existing.get("data") != data:
+        existing["data"] = data
+        store.update(existing)

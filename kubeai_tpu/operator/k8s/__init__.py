@@ -1,0 +1,13 @@
+"""Kubernetes API access layer.
+
+Two implementations of one interface:
+  - `KubeStore`: in-memory API server (the test strategy's envtest
+    equivalent — reference: test/integration/main_test.go:83-89 runs a real
+    apiserver with no kubelet; here the store IS the apiserver).
+  - `RestKubeClient` (kubeai_tpu.operator.k8s.rest): stdlib-HTTP client for
+    a real cluster (in-cluster service account auth).
+
+Objects are plain dicts in manifest shape — same contract as the wire.
+"""
+
+from kubeai_tpu.operator.k8s.store import KubeStore, Conflict, NotFound

@@ -1,0 +1,233 @@
+"""In-memory Kubernetes API store with watches, finalizers and optimistic
+concurrency — the control plane's test substrate AND the single client
+interface the operator codes against.
+
+Semantics mirrored from the real API server (and exercised the way the
+reference exercises envtest — reference: test/integration/utils_test.go):
+  - resourceVersion optimistic concurrency on update (Conflict on mismatch)
+  - delete with finalizers sets deletionTimestamp; the object is removed
+    only when the last finalizer is cleared by an update
+  - label-selector list filtering
+  - watch events (ADDED/MODIFIED/DELETED) fan out to subscriber queues
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+import time
+import uuid
+from typing import Callable, Iterable
+
+
+class NotFound(KeyError):
+    pass
+
+
+class Conflict(RuntimeError):
+    pass
+
+
+class Invalid(ValueError):
+    pass
+
+
+def _key(kind: str, namespace: str, name: str) -> tuple:
+    return (kind, namespace, name)
+
+
+def meta(obj: dict) -> dict:
+    return obj.setdefault("metadata", {})
+
+
+def match_labels(obj: dict, selector: dict[str, str] | None) -> bool:
+    if not selector:
+        return True
+    labels = meta(obj).get("labels") or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class KubeStore:
+    """Thread-safe in-memory object store keyed by (kind, namespace, name)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: dict[tuple, dict] = {}
+        self._rv = 0
+        self._watchers: list[tuple[tuple[str, ...] | None, queue.Queue]] = []
+        # admission validators: kind -> callable(new_obj, old_obj|None)
+        self._validators: dict[str, Callable[[dict, dict | None], None]] = {}
+
+    # -- admission -----------------------------------------------------------
+
+    def register_validator(
+        self, kind: str, fn: Callable[[dict, dict | None], None]
+    ) -> None:
+        self._validators[kind] = fn
+
+    # -- watch ---------------------------------------------------------------
+
+    def watch(self, kinds: Iterable[str] | None = None) -> queue.Queue:
+        """Subscribe to events: queue yields (event_type, obj_copy)."""
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            self._watchers.append((tuple(kinds) if kinds else None, q))
+        return q
+
+    def _emit(self, event: str, obj: dict) -> None:
+        kind = obj.get("kind", "")
+        for kinds, q in list(self._watchers):
+            if kinds is None or kind in kinds:
+                q.put((event, copy.deepcopy(obj)))
+
+    # -- CRUD ----------------------------------------------------------------
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def create(self, obj: dict) -> dict:
+        with self._lock:
+            kind = obj.get("kind") or ""
+            m = meta(obj)
+            ns = m.setdefault("namespace", "default")
+            name = m.get("name")
+            if not name:
+                if m.get("generateName"):
+                    name = m["generateName"] + uuid.uuid4().hex[:6]
+                    m["name"] = name
+                else:
+                    raise Invalid("metadata.name required")
+            k = _key(kind, ns, name)
+            if k in self._objects:
+                raise Conflict(f"{kind} {ns}/{name} already exists")
+            if kind in self._validators:
+                self._validators[kind](obj, None)
+            m["uid"] = m.get("uid") or str(uuid.uuid4())
+            m["resourceVersion"] = self._next_rv()
+            m.setdefault("creationTimestamp", time.time())
+            m.setdefault("generation", 1)
+            stored = copy.deepcopy(obj)
+            self._objects[k] = stored
+            self._emit("ADDED", stored)
+            return copy.deepcopy(stored)
+
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        with self._lock:
+            k = _key(kind, namespace, name)
+            if k not in self._objects:
+                raise NotFound(f"{kind} {namespace}/{name}")
+            return copy.deepcopy(self._objects[k])
+
+    def try_get(self, kind: str, namespace: str, name: str) -> dict | None:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFound:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[dict]:
+        with self._lock:
+            out = []
+            for (k_kind, k_ns, _), obj in self._objects.items():
+                if k_kind != kind:
+                    continue
+                if namespace is not None and k_ns != namespace:
+                    continue
+                if not match_labels(obj, label_selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+            out.sort(key=lambda o: meta(o).get("name", ""))
+            return out
+
+    def update(self, obj: dict) -> dict:
+        """Full update with optimistic concurrency; spec change bumps
+        generation; clearing the last finalizer of a deleting object
+        removes it."""
+        with self._lock:
+            kind = obj.get("kind") or ""
+            m = meta(obj)
+            k = _key(kind, m.get("namespace", "default"), m.get("name"))
+            if k not in self._objects:
+                raise NotFound(f"{kind} {k[1]}/{k[2]}")
+            current = self._objects[k]
+            cur_m = meta(current)
+            if str(m.get("resourceVersion")) != str(cur_m.get("resourceVersion")):
+                raise Conflict(
+                    f"{kind} {k[1]}/{k[2]}: resourceVersion conflict"
+                )
+            if kind in self._validators:
+                self._validators[kind](obj, current)
+            if obj.get("spec") != current.get("spec"):
+                m["generation"] = int(cur_m.get("generation", 1)) + 1
+            # immutable server-set fields
+            m["uid"] = cur_m.get("uid")
+            m["creationTimestamp"] = cur_m.get("creationTimestamp")
+            if cur_m.get("deletionTimestamp"):
+                m["deletionTimestamp"] = cur_m["deletionTimestamp"]
+            m["resourceVersion"] = self._next_rv()
+            stored = copy.deepcopy(obj)
+            if m.get("deletionTimestamp") and not m.get("finalizers"):
+                del self._objects[k]
+                self._emit("DELETED", stored)
+            else:
+                self._objects[k] = stored
+                self._emit("MODIFIED", stored)
+            return copy.deepcopy(stored)
+
+    def patch_merge(
+        self, kind: str, namespace: str, name: str, patch: dict
+    ) -> dict:
+        """Strategic-merge-ish patch (dict deep merge; None deletes keys).
+        Retries are unnecessary: server-side under one lock."""
+        with self._lock:
+            obj = self.get(kind, namespace, name)
+            _deep_merge(obj, patch)
+            return self.update(obj)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        """Delete; honors finalizers like the real API server."""
+        with self._lock:
+            k = _key(kind, namespace, name)
+            if k not in self._objects:
+                raise NotFound(f"{kind} {namespace}/{name}")
+            obj = self._objects[k]
+            m = meta(obj)
+            if m.get("finalizers"):
+                if not m.get("deletionTimestamp"):
+                    m["deletionTimestamp"] = time.time()
+                    m["resourceVersion"] = self._next_rv()
+                    self._emit("MODIFIED", obj)
+                return
+            del self._objects[k]
+            self._emit("DELETED", obj)
+
+    def delete_all_of(
+        self,
+        kind: str,
+        namespace: str,
+        label_selector: dict[str, str] | None = None,
+    ) -> int:
+        with self._lock:
+            victims = self.list(kind, namespace, label_selector)
+            for v in victims:
+                try:
+                    self.delete(kind, namespace, meta(v)["name"])
+                except NotFound:
+                    pass
+            return len(victims)
+
+
+def _deep_merge(dst: dict, patch: dict) -> None:
+    for k, v in patch.items():
+        if v is None:
+            dst.pop(k, None)
+        elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = copy.deepcopy(v)

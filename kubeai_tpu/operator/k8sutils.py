@@ -1,0 +1,94 @@
+"""Small k8s helpers (reference: internal/k8sutils)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+
+def pod_hash(pod_spec: dict) -> str:
+    """Stable hash of a rendered Pod spec — drives rollout detection
+    (reference: internal/k8sutils/pods.go:26-42, FNV of dumped spec).
+
+    Uses a canonical JSON dump + FNV-1a 64; only the first 8 hex chars are
+    kept for label friendliness (same shape as the reference's %x of FNV32)."""
+    dumped = json.dumps(pod_spec, sort_keys=True, separators=(",", ":"))
+    return f"{_fnv1a64(dumped.encode()) & 0xFFFFFFFF:x}"
+
+
+def string_hash(s: str) -> str:
+    """(reference: internal/k8sutils/pods.go:45-49)"""
+    return f"{_fnv1a64(s.encode()) & 0xFFFFFFFF:x}"
+
+
+def _fnv1a64(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def pod_is_ready(pod: dict) -> bool:
+    """(reference: internal/k8sutils/pods.go PodIsReady)"""
+    for cond in (pod.get("status") or {}).get("conditions", []):
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return False
+
+
+def pod_is_scheduled(pod: dict) -> bool:
+    for cond in (pod.get("status") or {}).get("conditions", []):
+        if cond.get("type") == "PodScheduled":
+            return cond.get("status") == "True"
+    return bool((pod.get("spec") or {}).get("nodeName"))
+
+
+def container_is_ready(pod: dict, container_name: str) -> bool:
+    """(reference: internal/k8sutils/pods.go:60-66)"""
+    for cs in (pod.get("status") or {}).get("containerStatuses", []):
+        if cs.get("name") == container_name:
+            return bool(cs.get("ready"))
+    return False
+
+
+def job_is_complete(job: dict) -> bool:
+    """(reference: internal/k8sutils/jobs.go)"""
+    for cond in (job.get("status") or {}).get("conditions", []):
+        if cond.get("type") == "Complete" and cond.get("status") == "True":
+            return True
+    return False
+
+
+def set_label(obj: dict, key: str, value: str) -> None:
+    obj.setdefault("metadata", {}).setdefault("labels", {})[key] = value
+
+
+def get_label(obj: dict, key: str) -> str | None:
+    return ((obj.get("metadata") or {}).get("labels") or {}).get(key)
+
+
+def get_annotation(obj: dict, key: str) -> str | None:
+    return ((obj.get("metadata") or {}).get("annotations") or {}).get(key)
+
+
+def set_owner_reference(owner: dict, obj: dict, controller: bool = True) -> None:
+    """(controller-runtime SetControllerReference equivalent)"""
+    m = obj.setdefault("metadata", {})
+    refs = m.setdefault("ownerReferences", [])
+    refs.append(
+        {
+            "apiVersion": owner.get("apiVersion", "v1"),
+            "kind": owner.get("kind", ""),
+            "name": (owner.get("metadata") or {}).get("name", ""),
+            "uid": (owner.get("metadata") or {}).get("uid", ""),
+            "controller": controller,
+        }
+    )
+
+
+def is_owned_by(obj: dict, owner_uid: str) -> bool:
+    for ref in ((obj.get("metadata") or {}).get("ownerReferences") or []):
+        if ref.get("uid") == owner_uid:
+            return True
+    return False

