@@ -1,0 +1,19 @@
+"""Metrics registry (reference: internal/metrics/metrics.go).
+
+Prometheus-text-format instruments with no external deps. Metrics are not
+just observability here: the autoscaler scrapes
+`kubeai_inference_requests_active` from every operator replica — metrics
+are the autoscaling transport (reference: internal/modelautoscaler/metrics.go:15-71).
+"""
+
+from kubeai_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    REGISTRY,
+    INFERENCE_REQUESTS_ACTIVE,
+    INFERENCE_REQUESTS_TOTAL,
+    CHWBL_LOOKUPS,
+    CHWBL_DISPLACEMENTS,
+)

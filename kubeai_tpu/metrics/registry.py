@@ -1,0 +1,205 @@
+"""Minimal Prometheus-compatible metrics (text exposition format 0.0.4)."""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{str(v).replace(chr(92), chr(92)*2).replace(chr(34), chr(92)+chr(34))}"'
+        for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, registry: "Registry | None"):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        self._values: dict[tuple, float] = defaultdict(float)
+        self._label_keys: dict[tuple, dict] = {}
+        if registry is not None:
+            registry.register(self)
+
+    def _key(self, labels: dict[str, str]) -> tuple:
+        k = tuple(sorted(labels.items()))
+        self._label_keys[k] = labels
+        return k
+
+    def get(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def collect(self) -> list[str]:
+        with self._lock:
+            lines = [
+                f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} {self.TYPE}",
+            ]
+            if not self._values:
+                lines.append(f"{self.name} 0")
+            for k, v in sorted(self._values.items()):
+                lines.append(
+                    f"{self.name}{_fmt_labels(self._label_keys[k])} {v:g}"
+                )
+            return lines
+
+
+class Counter(_Metric):
+    TYPE = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] += amount
+
+
+class Gauge(_Metric):
+    TYPE = "gauge"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] += amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = value
+
+
+class Histogram(_Metric):
+    TYPE = "histogram"
+    BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
+
+    def __init__(self, name, help_, registry, buckets=None):
+        super().__init__(name, help_, registry)
+        self.buckets = tuple(buckets or self.BUCKETS)
+        self._bucket_counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = defaultdict(float)
+        self._counts: dict[tuple, int] = defaultdict(int)
+
+    def observe(self, value: float, **labels) -> None:
+        with self._lock:
+            k = self._key(labels)
+            if k not in self._bucket_counts:
+                self._bucket_counts[k] = [0] * len(self.buckets)
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._bucket_counts[k][i] += 1
+            self._sums[k] += value
+            self._counts[k] += 1
+
+    def collect(self) -> list[str]:
+        with self._lock:
+            lines = [
+                f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} histogram",
+            ]
+            for k in sorted(self._counts):
+                labels = self._label_keys[k]
+                cum = 0
+                for i, b in enumerate(self.buckets):
+                    cum += self._bucket_counts[k][i]
+                    lines.append(
+                        f"{self.name}_bucket{_fmt_labels({**labels, 'le': b})} {cum}"
+                    )
+                lines.append(
+                    f"{self.name}_bucket{_fmt_labels({**labels, 'le': '+Inf'})} {self._counts[k]}"
+                )
+                lines.append(f"{self.name}_sum{_fmt_labels(labels)} {self._sums[k]:g}")
+                lines.append(f"{self.name}_count{_fmt_labels(labels)} {self._counts[k]}")
+            return lines
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list[_Metric] = []
+        self._lock = threading.Lock()
+
+    def register(self, m: _Metric) -> None:
+        with self._lock:
+            self._metrics.append(m)
+
+    def expose(self) -> str:
+        out: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics)
+        for m in metrics:
+            out.extend(m.collect())
+        return "\n".join(out) + "\n"
+
+
+REGISTRY = Registry()
+
+# The autoscaling signal (reference: internal/metrics/metrics.go:16-20;
+# Prom name mapping metrics.go:81-87).
+INFERENCE_REQUESTS_ACTIVE = Gauge(
+    "kubeai_inference_requests_active",
+    "Number of in-flight inference requests per model.",
+    REGISTRY,
+)
+INFERENCE_REQUESTS_TOTAL = Counter(
+    "kubeai_inference_requests_total",
+    "Total inference requests per model.",
+    REGISTRY,
+)
+CHWBL_LOOKUPS = Counter(
+    "kubeai_chwbl_lookups_total",
+    "CHWBL address lookups.",
+    REGISTRY,
+)
+CHWBL_DISPLACEMENTS = Counter(
+    "kubeai_chwbl_displacements_total",
+    "CHWBL lookups displaced past the hashed endpoint by the bounded-load rule.",
+    REGISTRY,
+)
+
+
+def parse_prometheus_text(text: str) -> dict[tuple[str, tuple], float]:
+    """Parse exposition text into {(metric, ((label,val),...)): value} —
+    the autoscaler's scrape decoder (reference: modelautoscaler/metrics.go)."""
+    out: dict[tuple[str, tuple], float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name_part, value_s = line.rsplit(" ", 1)
+            value = float(value_s)
+        except ValueError:
+            continue
+        if "{" in name_part:
+            name, rest = name_part.split("{", 1)
+            rest = rest.rstrip("}")
+            labels = []
+            for pair in _split_label_pairs(rest):
+                if "=" not in pair:
+                    continue
+                k, v = pair.split("=", 1)
+                labels.append((k, v.strip('"')))
+            out[(name, tuple(sorted(labels)))] = value
+        else:
+            out[(name_part, ())] = value
+    return out
+
+
+def _split_label_pairs(s: str) -> list[str]:
+    pairs, cur, in_q = [], "", False
+    for ch in s:
+        if ch == '"':
+            in_q = not in_q
+            cur += ch
+        elif ch == "," and not in_q:
+            pairs.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur:
+        pairs.append(cur)
+    return pairs

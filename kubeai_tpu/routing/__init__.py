@@ -1,0 +1,3 @@
+"""Routing tier: request parsing, load balancing, proxying, serving mux
+(reference: internal/{apiutils,loadbalancer,modelproxy,openaiserver}).
+"""

@@ -1,0 +1,191 @@
+"""Protocol-independent request parsing (reference: internal/apiutils/request.go).
+
+Handles JSON bodies and multipart/form-data (Whisper uploads), splits
+`model_adapter` names, rewrites the body when an adapter is requested
+(engines expect the adapter name in the `model` field —
+reference: apiutils/request.go:190-199), and computes the CHWBL prefix at
+parse time from the first user-message text / prompt
+(reference: api/openai/v1/chat_completions.go:525-543, completions.go:134-137).
+
+Unknown-field preservation: bodies are parsed into plain dicts and
+re-serialized — every unknown engine-specific field round-trips by
+construction (the reference needs go-json-experiment Unknown fields for
+this; dicts give it for free).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+import uuid
+
+
+class APIError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+@dataclasses.dataclass
+class ParsedRequest:
+    id: str
+    body: bytes
+    model: str
+    adapter: str
+    prefix: str
+    selectors: dict[str, str]
+    lb_strategy: str | None = None
+    content_type: str = "application/json"
+
+    @property
+    def model_and_adapter(self) -> str:
+        return f"{self.model}_{self.adapter}" if self.adapter else self.model
+
+
+def split_model_adapter(s: str) -> tuple[str, str]:
+    """'model_adapter' → (model, adapter) (reference: apiutils/model.go:19-36)."""
+    model, _, adapter = s.partition("_")
+    return model, adapter
+
+
+def merge_model_adapter(model: str, adapter: str) -> str:
+    return f"{model}_{adapter}" if adapter else model
+
+
+def first_n_chars(s: str, n: int) -> str:
+    """Rune-safe prefix (reference: apiutils/request.go:227-230). Python
+    strings are code points already, so slicing is safe."""
+    return s[:n]
+
+
+def _message_text(content) -> str:
+    """Extract text from an OpenAI message content (string or parts list)."""
+    if isinstance(content, str):
+        return content
+    if isinstance(content, list):
+        return " ".join(
+            p.get("text", "") for p in content
+            if isinstance(p, dict) and p.get("type") == "text"
+        )
+    return ""
+
+
+def extract_prefix(path: str, body: dict, n: int) -> str:
+    """First user-message text (chat) / first prompt (completions), first
+    n chars — the CHWBL hash input."""
+    if n <= 0:
+        return ""
+    if "chat/completions" in path:
+        for msg in body.get("messages") or []:
+            if isinstance(msg, dict) and msg.get("role") == "user":
+                return first_n_chars(_message_text(msg.get("content")), n)
+        return ""
+    prompt = body.get("prompt", "")
+    if isinstance(prompt, list):
+        prompt = prompt[0] if prompt else ""
+    if isinstance(prompt, str):
+        return first_n_chars(prompt, n)
+    return ""
+
+
+def parse_label_selector(header_value: str | None) -> dict[str, str]:
+    """`X-Label-Selector: k1=v1,k2=v2` multitenancy filter
+    (reference: apiutils/request.go Selectors, openaiserver/models.go)."""
+    if not header_value:
+        return {}
+    out = {}
+    for part in header_value.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise APIError(400, f"invalid selector {part!r}")
+        k, v = part.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+_MULTIPART_BOUNDARY_RE = re.compile(r'boundary="?([^";]+)"?')
+
+
+def parse_request(
+    body: bytes,
+    path: str,
+    headers: dict[str, str],
+    prefix_char_length: int = 100,
+) -> ParsedRequest:
+    """(reference: internal/apiutils/request.go:64-165)"""
+    content_type = headers.get("content-type", "application/json")
+    selectors = parse_label_selector(headers.get("x-label-selector"))
+    rid = str(uuid.uuid4())
+
+    if content_type.startswith("multipart/form-data"):
+        return _parse_multipart(body, content_type, rid, selectors)
+
+    try:
+        parsed = json.loads(body or b"{}")
+    except json.JSONDecodeError as e:
+        raise APIError(400, f"invalid JSON body: {e}")
+    if not isinstance(parsed, dict):
+        raise APIError(400, "request body must be a JSON object")
+    model_full = parsed.get("model")
+    if not model_full or not isinstance(model_full, str):
+        raise APIError(400, "missing 'model' field in request body")
+
+    model, adapter = split_model_adapter(model_full)
+    if adapter:
+        # Engines expect the adapter name in `model`
+        # (reference: apiutils/request.go:190-199).
+        parsed["model"] = adapter
+        body = json.dumps(parsed).encode()
+
+    prefix = extract_prefix(path, parsed, prefix_char_length)
+    return ParsedRequest(
+        id=rid,
+        body=body,
+        model=model,
+        adapter=adapter,
+        prefix=prefix,
+        selectors=selectors,
+        content_type=content_type,
+    )
+
+
+def _parse_multipart(
+    body: bytes, content_type: str, rid: str, selectors: dict[str, str]
+) -> ParsedRequest:
+    """Extract (and strip) the `model` form field — the Whisper workaround
+    (reference: apiutils/request.go:109-165 strips `model` so engines that
+    reject unknown names still work; we keep parity by rewriting it to the
+    adapter-less name)."""
+    m = _MULTIPART_BOUNDARY_RE.search(content_type)
+    if not m:
+        raise APIError(400, "multipart body missing boundary")
+    boundary = b"--" + m.group(1).encode()
+    parts = body.split(boundary)
+    model_full = None
+    kept: list[bytes] = []
+    for part in parts:
+        if not part or part in (b"--", b"--\r\n", b"\r\n"):
+            continue
+        headers_block = part.split(b"\r\n\r\n", 1)[0]
+        if b'name="model"' in headers_block:
+            payload = part.split(b"\r\n\r\n", 1)[1]
+            model_full = payload.strip(b"\r\n-").decode()
+        else:
+            kept.append(part)
+    if not model_full:
+        raise APIError(400, "missing 'model' form field")
+    model, adapter = split_model_adapter(model_full)
+    new_body = boundary + boundary.join(kept) + boundary + b"--\r\n"
+    return ParsedRequest(
+        id=rid,
+        body=new_body,
+        model=model,
+        adapter=adapter,
+        prefix="",
+        selectors=selectors,
+        content_type=content_type,
+    )

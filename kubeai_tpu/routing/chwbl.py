@@ -1,0 +1,103 @@
+"""Consistent Hashing With Bounded Loads — the PrefixHash strategy's core
+(reference: internal/loadbalancer/balance_chwbl.go).
+
+Ring: each endpoint is inserted `replication` times (vnodes); a request's
+prefix hashes to a point; we walk clockwise until we find an endpoint whose
+in-flight load is within the bound:
+
+    load <= ceil((total_in_flight + 1) / num_endpoints) * load_factor
+
+(reference: balance_chwbl.go:152-162). Adapter-aware walk: endpoints not
+serving the requested adapter are skipped, falling back to the first
+load-OK endpoint of any kind if none match (reference: balance_chwbl.go:14-84).
+
+Uses the native C++ ring (kubeai_tpu.native) when available; the pure-
+Python path is the reference semantics and test oracle.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+from kubeai_tpu.metrics import CHWBL_DISPLACEMENTS, CHWBL_LOOKUPS
+from kubeai_tpu.routing.xxhash import xxhash64
+
+
+class CHWBL:
+    def __init__(self, load_factor: float = 1.25, replication: int = 256):
+        self.load_factor = load_factor
+        self.replication = replication
+        self._hashes: list[int] = []  # sorted ring points
+        self._ring: dict[int, str] = {}  # point -> endpoint
+
+    def _point(self, endpoint: str, i: int) -> int:
+        return xxhash64(f"{endpoint}{i}".encode())
+
+    def add(self, endpoint: str) -> None:
+        for i in range(self.replication):
+            h = self._point(endpoint, i)
+            if h in self._ring:
+                continue
+            self._ring[h] = endpoint
+            bisect.insort(self._hashes, h)
+
+    def remove(self, endpoint: str) -> None:
+        for i in range(self.replication):
+            h = self._point(endpoint, i)
+            if self._ring.get(h) == endpoint:
+                del self._ring[h]
+                idx = bisect.bisect_left(self._hashes, h)
+                if idx < len(self._hashes) and self._hashes[idx] == h:
+                    self._hashes.pop(idx)
+
+    def __contains__(self, endpoint: str) -> bool:
+        return any(True for e in self._ring.values() if e == endpoint)
+
+    def get(
+        self,
+        key: str,
+        loads: dict[str, int],
+        adapter_endpoints: set[str] | None = None,
+    ) -> str | None:
+        """Pick an endpoint for `key`. `loads` maps endpoint -> in-flight
+        count (must cover every ring endpoint). `adapter_endpoints`
+        restricts preferred endpoints (None = no restriction)."""
+        if not self._hashes:
+            return None
+        CHWBL_LOOKUPS.inc()
+        total = sum(loads.values())
+        n = max(len(loads), 1)
+        # "+1" simulates the incoming request (reference: balance_chwbl.go:152-162).
+        threshold = (total + 1) / n * self.load_factor
+
+        def load_ok(ep: str) -> bool:
+            return total == 0 or loads.get(ep, 0) <= threshold
+
+        start = bisect.bisect_left(
+            self._hashes, xxhash64(key.encode())
+        ) % len(self._hashes)
+        fallback: str | None = None
+        seen: set[str] = set()
+        displaced = False
+        for off in range(len(self._hashes)):
+            h = self._hashes[(start + off) % len(self._hashes)]
+            ep = self._ring[h]
+            if ep in seen:
+                continue
+            seen.add(ep)
+            ok = load_ok(ep)
+            if ok and fallback is None:
+                fallback = ep
+            if adapter_endpoints is not None and ep not in adapter_endpoints:
+                continue
+            if ok:
+                if displaced:
+                    CHWBL_DISPLACEMENTS.inc()
+                return ep
+            displaced = True
+        # No adapter-serving endpoint within bound: any bounded endpoint
+        # (reference: balance_chwbl.go default fallback), else the least
+        # loaded overall.
+        if fallback is not None:
+            return fallback
+        return min(loads, key=loads.get) if loads else None

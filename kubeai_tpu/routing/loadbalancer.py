@@ -1,0 +1,233 @@
+"""Endpoint-group load balancer (reference: internal/loadbalancer).
+
+A Pod-watching component maintaining per-model endpoint groups from Ready
+Pods (+ `model-pod-ip`/`model-pod-port` annotation overrides and adapter
+labels — reference: load_balancer.go:53-140). Strategies:
+
+  LeastLoad   — endpoint with fewest in-flight requests
+                (reference: balance_least_load.go:3-23)
+  PrefixHash  — CHWBL over the request prefix (see chwbl.py)
+
+`await_best_address` BLOCKS until an endpoint exists — the scale-from-zero
+hold (reference: group.go:53-94 broadcast channel; here a Condition).
+Returns a completion callback that decrements in-flight counters.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import (
+    LB_STRATEGY_PREFIX_HASH,
+)
+from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.chwbl import CHWBL
+
+
+class LoadBalancerTimeout(TimeoutError):
+    pass
+
+
+class _Endpoint:
+    __slots__ = ("address", "adapters", "in_flight")
+
+    def __init__(self, address: str, adapters: set[str]):
+        self.address = address
+        self.adapters = adapters
+        self.in_flight = 0
+
+
+class Group:
+    """Per-model endpoint set with in-flight accounting and a blocking wait
+    (reference: internal/loadbalancer/group.go)."""
+
+    def __init__(self, load_factor: float = 1.25, replication: int = 256):
+        self._cond = threading.Condition()
+        self._endpoints: dict[str, _Endpoint] = {}
+        self._chwbl = CHWBL(load_factor=load_factor, replication=replication)
+        self.total_in_flight = 0
+
+    def reconcile_endpoints(self, observed: dict[str, set[str]]) -> None:
+        """observed: address -> adapter names. Broadcasts on any addition
+        so blocked requests wake (reference: group.go:108-137)."""
+        with self._cond:
+            added = False
+            for addr, adapters in observed.items():
+                ep = self._endpoints.get(addr)
+                if ep is None:
+                    self._endpoints[addr] = _Endpoint(addr, set(adapters))
+                    self._chwbl.add(addr)
+                    added = True
+                else:
+                    ep.adapters = set(adapters)
+            for addr in list(self._endpoints):
+                if addr not in observed:
+                    del self._endpoints[addr]
+                    self._chwbl.remove(addr)
+            if added:
+                self._cond.notify_all()
+
+    def addresses(self) -> list[str]:
+        with self._cond:
+            return list(self._endpoints)
+
+    def get_best_addr(
+        self,
+        strategy: str,
+        adapter: str,
+        prefix: str,
+        timeout: float,
+    ) -> tuple[str, Callable[[], None]]:
+        """Block until a suitable endpoint exists; account the request."""
+        with self._cond:
+            deadline_ok = self._cond.wait_for(
+                lambda: bool(self._candidates(adapter)), timeout=timeout
+            )
+            if not deadline_ok:
+                raise LoadBalancerTimeout(
+                    f"no endpoint became ready within {timeout}s"
+                )
+            addr = self._pick(strategy, adapter, prefix)
+            ep = self._endpoints[addr]
+            ep.in_flight += 1
+            self.total_in_flight += 1
+
+        done_called = threading.Event()
+
+        def done() -> None:
+            if done_called.is_set():
+                return
+            done_called.set()
+            with self._cond:
+                e = self._endpoints.get(addr)
+                if e is not None:
+                    e.in_flight -= 1
+                self.total_in_flight -= 1
+
+        return addr, done
+
+    def _candidates(self, adapter: str) -> list[_Endpoint]:
+        eps = list(self._endpoints.values())
+        if adapter:
+            with_adapter = [e for e in eps if adapter in e.adapters]
+            return with_adapter
+        return eps
+
+    def _pick(self, strategy: str, adapter: str, prefix: str) -> str:
+        if strategy == LB_STRATEGY_PREFIX_HASH and prefix:
+            loads = {a: e.in_flight for a, e in self._endpoints.items()}
+            adapter_eps = (
+                {e.address for e in self._candidates(adapter)} if adapter else None
+            )
+            addr = self._chwbl.get(prefix, loads, adapter_eps)
+            if addr is not None:
+                return addr
+        # LeastLoad (and PrefixHash fallback when no prefix/ring).
+        candidates = self._candidates(adapter)
+        best = min(candidates, key=lambda e: e.in_flight)
+        return best.address
+
+
+class LoadBalancer:
+    """Watches Pods in the store and maintains groups + self IPs
+    (reference: internal/loadbalancer/load_balancer.go)."""
+
+    def __init__(self, store: KubeStore, default_timeout: float = 600.0):
+        self.store = store
+        self.default_timeout = default_timeout
+        self._lock = threading.Lock()
+        self._groups: dict[str, Group] = {}
+        self._self_ips: list[str] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._events = store.watch(("Pod",))
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        self.sync_all()
+        self._thread = threading.Thread(target=self._watch_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._events.put(None)
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _watch_loop(self) -> None:
+        while not self._stop.is_set():
+            item = self._events.get()
+            if item is None:
+                return
+            _event, pod = item
+            model = k8sutils.get_label(pod, md.POD_MODEL_LABEL)
+            if model:
+                self.sync_model(model, pod["metadata"].get("namespace", "default"))
+
+    # -- endpoint discovery (reference: load_balancer.go:90-140) --------------
+
+    def sync_all(self) -> None:
+        models: set[tuple[str, str]] = set()
+        for pod in self.store.list("Pod"):
+            model = k8sutils.get_label(pod, md.POD_MODEL_LABEL)
+            if model:
+                models.add((model, pod["metadata"].get("namespace", "default")))
+        for model, ns in models:
+            self.sync_model(model, ns)
+
+    def sync_model(self, model: str, namespace: str = "default") -> None:
+        observed: dict[str, set[str]] = {}
+        for pod in self.store.list(
+            "Pod", namespace, {md.POD_MODEL_LABEL: model}
+        ):
+            if not k8sutils.pod_is_ready(pod):
+                continue
+            ip = k8sutils.get_annotation(pod, md.MODEL_POD_IP_ANNOTATION) or (
+                (pod.get("status") or {}).get("podIP")
+            )
+            if not ip:
+                continue
+            port = (
+                k8sutils.get_annotation(pod, md.MODEL_POD_PORT_ANNOTATION)
+                or "8000"
+            )
+            adapters = set()
+            prefix = md.ADAPTER_LABEL_DOMAIN + "/"
+            for k in (pod["metadata"].get("labels") or {}):
+                if k.startswith(prefix):
+                    adapters.add(k[len(prefix):])
+            observed[f"{ip}:{port}"] = adapters
+        self.group(model).reconcile_endpoints(observed)
+
+    def group(self, model: str) -> Group:
+        with self._lock:
+            if model not in self._groups:
+                self._groups[model] = Group()
+            return self._groups[model]
+
+    # -- API (reference: load_balancer.go:182-204) -----------------------------
+
+    def get_self_ips(self) -> list[str]:
+        with self._lock:
+            return list(self._self_ips)
+
+    def set_self_ips(self, ips: list[str]) -> None:
+        with self._lock:
+            self._self_ips = list(ips)
+
+    def await_best_address(
+        self,
+        model: str,
+        adapter: str = "",
+        prefix: str = "",
+        strategy: str = "LeastLoad",
+        timeout: float | None = None,
+    ) -> tuple[str, Callable[[], None]]:
+        return self.group(model).get_best_addr(
+            strategy, adapter, prefix,
+            timeout=self.default_timeout if timeout is None else timeout,
+        )

@@ -1,0 +1,114 @@
+"""Model lookup and scale operations (reference: internal/modelclient).
+
+Scale-from-zero is the signature move: the proxy calls
+`scale_at_least_one_replica` before waiting on the load balancer
+(reference: internal/modelclient/scale.go:14-39, modelproxy/handler.go:84).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from kubeai_tpu.crd.model import Model
+from kubeai_tpu.operator.k8s.store import Conflict, KubeStore, NotFound
+
+
+class ModelNotFound(Exception):
+    pass
+
+
+class AdapterNotFound(Exception):
+    pass
+
+
+class ModelClient:
+    def __init__(self, store: KubeStore, namespace: str = "default"):
+        self.store = store
+        self.namespace = namespace
+        self._scale_lock = threading.Lock()
+        # model -> consecutive scale-down requests (hysteresis;
+        # reference: modelclient/scale.go:43-100).
+        self._consecutive_scale_downs: dict[str, int] = {}
+
+    def lookup_model(
+        self, name: str, adapter: str = "", selectors: dict[str, str] | None = None
+    ) -> Model:
+        """(reference: internal/modelclient/client.go:27-64)"""
+        try:
+            obj = self.store.get("Model", self.namespace, name)
+        except NotFound:
+            raise ModelNotFound(name)
+        model = Model.from_dict(obj)
+        for k, v in (selectors or {}).items():
+            if model.labels.get(k) != v:
+                raise ModelNotFound(name)  # selector mismatch = invisible
+        if adapter and not any(a.name == adapter for a in model.spec.adapters):
+            raise AdapterNotFound(f"{name}_{adapter}")
+        return model
+
+    def list_all_models(self, selectors: dict[str, str] | None = None) -> list[Model]:
+        return [
+            Model.from_dict(o)
+            for o in self.store.list("Model", self.namespace, selectors or None)
+        ]
+
+    def scale_at_least_one_replica(self, name: str) -> None:
+        """0 → 1 via the scale subresource (reference: scale.go:14-39)."""
+        with self._scale_lock:
+            for _ in range(3):
+                try:
+                    obj = self.store.get("Model", self.namespace, name)
+                except NotFound:
+                    raise ModelNotFound(name)
+                spec = obj.get("spec", {})
+                if spec.get("autoscalingDisabled"):
+                    return
+                if (spec.get("replicas") or 0) > 0:
+                    return
+                spec["replicas"] = 1
+                try:
+                    self.store.update(obj)
+                    return
+                except Conflict:
+                    continue
+
+    def scale(self, name: str, replicas: int) -> None:
+        """Bounded scale with consecutive-scale-down hysteresis
+        (reference: scale.go:43-100)."""
+        with self._scale_lock:
+            try:
+                obj = self.store.get("Model", self.namespace, name)
+            except NotFound:
+                raise ModelNotFound(name)
+            spec = obj.get("spec", {})
+            mn = int(spec.get("minReplicas", 0) or 0)
+            mx = spec.get("maxReplicas")
+            replicas = max(replicas, mn)
+            if mx is not None:
+                replicas = min(replicas, mx)
+            current = spec.get("replicas") or 0
+            if replicas == current:
+                self._consecutive_scale_downs[name] = 0
+                return
+            if replicas < current:
+                model = Model.from_dict(obj)
+                required = self._required_consecutive(model)
+                self._consecutive_scale_downs[name] = (
+                    self._consecutive_scale_downs.get(name, 0) + 1
+                )
+                if self._consecutive_scale_downs[name] < required:
+                    return
+            self._consecutive_scale_downs[name] = 0
+            spec["replicas"] = replicas
+            try:
+                self.store.update(obj)
+            except Conflict:
+                pass  # next tick retries
+
+    # injected by the autoscaler (interval-dependent); default 1 = immediate.
+    required_consecutive_scale_downs_fn = None
+
+    def _required_consecutive(self, model: Model) -> int:
+        if self.required_consecutive_scale_downs_fn is not None:
+            return self.required_consecutive_scale_downs_fn(model)
+        return 1

@@ -1,0 +1,185 @@
+"""OpenAI-compatible HTTP front door (reference: internal/openaiserver).
+
+Mux:
+  POST /openai/v1/chat/completions      → proxy
+  POST /openai/v1/completions           → proxy
+  POST /openai/v1/embeddings            → proxy
+  POST /openai/v1/audio/transcriptions  → proxy (multipart)
+  GET  /openai/v1/models                → list Models by feature labels,
+        expanding adapters into model ids (reference: openaiserver/models.go:13-109)
+
+Plus operator endpoints:
+  GET /metrics  → Prometheus exposition (the autoscaler's transport)
+  GET /healthz
+
+Built on ThreadingHTTPServer: each request thread may block in the load
+balancer's scale-from-zero wait without stalling others.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubeai_tpu.crd.model import Model
+from kubeai_tpu.metrics.registry import REGISTRY
+from kubeai_tpu.routing import apiutils
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.routing.proxy import ModelProxy
+
+PROXY_PATHS = (
+    "/openai/v1/chat/completions",
+    "/openai/v1/completions",
+    "/openai/v1/embeddings",
+    "/openai/v1/audio/transcriptions",
+)
+
+FEATURE_FOR_PATH = {
+    "/openai/v1/chat/completions": "TextGeneration",
+    "/openai/v1/completions": "TextGeneration",
+    "/openai/v1/embeddings": "TextEmbedding",
+    "/openai/v1/audio/transcriptions": "SpeechToText",
+}
+
+
+def _models_payload(models: list[Model]) -> dict:
+    data = []
+    for m in models:
+        entry = {
+            "id": m.name,
+            "object": "model",
+            "created": 0,
+            "owned_by": m.spec.owner or "kubeai",
+            "features": list(m.spec.features),
+        }
+        data.append(entry)
+        for a in m.spec.adapters:
+            data.append(
+                {
+                    "id": apiutils.merge_model_adapter(m.name, a.name),
+                    "object": "model",
+                    "created": 0,
+                    "owned_by": m.spec.owner or "kubeai",
+                    "features": list(m.spec.features),
+                }
+            )
+    return {"object": "list", "data": data}
+
+
+class OpenAIServer:
+    def __init__(
+        self,
+        proxy: ModelProxy,
+        model_client: ModelClient,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.proxy = proxy
+        self.model_client = model_client
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _headers_dict(self) -> dict[str, str]:
+                return {k.lower(): v for k, v in self.headers.items()}
+
+            def _respond_json(self, status: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path in ("/openai/v1/models", "/v1/models"):
+                    return self._handle_models()
+                if path == "/metrics":
+                    body = REGISTRY.expose().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/healthz":
+                    return self._respond_json(200, {"status": "ok"})
+                self._respond_json(404, {"error": {"message": "not found"}})
+
+            def _handle_models(self):
+                try:
+                    selectors = apiutils.parse_label_selector(
+                        self._headers_dict().get("x-label-selector")
+                    )
+                except apiutils.APIError as e:
+                    return self._respond_json(e.status, {"error": {"message": e.message}})
+                models = outer.model_client.list_all_models(selectors)
+                self._respond_json(200, _models_payload(models))
+
+            def do_POST(self):
+                path = self.path.split("?")[0]
+                # Accept both /openai/v1/* (reference mux) and bare /v1/*.
+                normalized = path
+                if normalized.startswith("/v1/"):
+                    normalized = "/openai" + normalized
+                if normalized not in PROXY_PATHS:
+                    return self._respond_json(
+                        404, {"error": {"message": f"unknown path {path}"}}
+                    )
+                length = int(self.headers.get("Content-Length", "0") or "0")
+                body = self.rfile.read(length) if length else b""
+                result = outer.proxy.handle(
+                    # strip the /openai prefix when forwarding to engines
+                    normalized[len("/openai"):],
+                    body,
+                    self._headers_dict(),
+                )
+                self.send_response(result.status)
+                has_length = any(
+                    k.lower() == "content-length" for k, _ in result.headers
+                )
+                for k, v in result.headers:
+                    self.send_header(k, v)
+                if not has_length:
+                    self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                if has_length:
+                    for chunk in result.chunks:
+                        self.wfile.write(chunk)
+                else:
+                    for chunk in result.chunks:
+                        if chunk:
+                            self.wfile.write(
+                                f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n"
+                            )
+                    self.wfile.write(b"0\r\n\r\n")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

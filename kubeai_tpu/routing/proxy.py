@@ -1,0 +1,185 @@
+"""Retrying reverse proxy — the synchronous data path
+(reference: internal/modelproxy/handler.go).
+
+Flow per request: parse → count active (autoscaling signal) →
+scale-from-zero → await endpoint (blocks) → proxy with ≤3 attempts on
+502/503/504/500 or transport error, replaying the saved body
+(reference: handler.go:50-155, request.go:73-79). 5xx bodies from engines
+are replaced with a generic message so internal details don't leak
+(reference: request.go:45-63). Streaming (SSE) passes through chunk by
+chunk — the body is piped, never buffered.
+"""
+
+from __future__ import annotations
+
+import http.client
+import logging
+from typing import BinaryIO
+
+from kubeai_tpu.crd.model import LB_STRATEGY_PREFIX_HASH
+from kubeai_tpu.metrics import (
+    INFERENCE_REQUESTS_ACTIVE,
+    INFERENCE_REQUESTS_TOTAL,
+)
+from kubeai_tpu.routing import apiutils
+from kubeai_tpu.routing.loadbalancer import LoadBalancer, LoadBalancerTimeout
+from kubeai_tpu.routing.modelclient import (
+    AdapterNotFound,
+    ModelClient,
+    ModelNotFound,
+)
+
+logger = logging.getLogger(__name__)
+
+MAX_RETRIES = 3
+RETRY_STATUSES = (500, 502, 503, 504)
+
+
+class ProxyResult:
+    """What the HTTP layer needs to respond: status, headers, body iterator."""
+
+    def __init__(self, status: int, headers: list[tuple[str, str]], chunks):
+        self.status = status
+        self.headers = headers
+        self.chunks = chunks  # iterator of bytes
+
+
+class ModelProxy:
+    def __init__(self, lb: LoadBalancer, model_client: ModelClient):
+        self.lb = lb
+        self.model_client = model_client
+
+    def handle(
+        self, path: str, body: bytes, headers: dict[str, str]
+    ) -> ProxyResult:
+        """Synchronous proxy entry (reference: modelproxy/handler.go:57-94)."""
+        try:
+            preq = apiutils.parse_request(body, path, headers)
+        except apiutils.APIError as e:
+            return _error(e.status, e.message)
+
+        try:
+            model = self.model_client.lookup_model(
+                preq.model, preq.adapter, preq.selectors
+            )
+        except ModelNotFound:
+            return _error(404, f"model not found: {preq.model}")
+        except AdapterNotFound:
+            return _error(404, f"adapter not found: {preq.model}_{preq.adapter}")
+
+        INFERENCE_REQUESTS_ACTIVE.inc(model=model.name)
+        INFERENCE_REQUESTS_TOTAL.inc(model=model.name)
+        decremented = [False]
+
+        def _done():
+            if not decremented[0]:
+                decremented[0] = True
+                INFERENCE_REQUESTS_ACTIVE.dec(model=model.name)
+
+        try:
+            self.model_client.scale_at_least_one_replica(model.name)
+            result = self._proxy_with_retries(path, preq, model, headers)
+        except LoadBalancerTimeout:
+            _done()
+            return _error(503, "no model endpoints became ready in time")
+        except Exception:
+            _done()
+            logger.exception("proxy failure for model %s", model.name)
+            return _error(502, "upstream failure")
+
+        # Wrap the body iterator so active-count drops when fully streamed.
+        orig = result.chunks
+
+        def wrapped():
+            try:
+                yield from orig
+            finally:
+                _done()
+
+        result.chunks = wrapped()
+        return result
+
+    def _proxy_with_retries(
+        self,
+        path: str,
+        preq: apiutils.ParsedRequest,
+        model,
+        headers: dict[str, str],
+    ) -> ProxyResult:
+        strategy = model.spec.load_balancing.strategy
+        prefix_len = model.spec.load_balancing.prefix_hash.prefix_char_length
+        prefix = preq.prefix[:prefix_len] if strategy == LB_STRATEGY_PREFIX_HASH else ""
+
+        last_err: Exception | None = None
+        for attempt in range(MAX_RETRIES):
+            addr, done = self.lb.await_best_address(
+                model.name,
+                adapter=preq.adapter,
+                prefix=prefix,
+                strategy=strategy,
+            )
+            try:
+                resp, conn = _send(addr, path, preq, headers)
+            except OSError as e:
+                done()
+                last_err = e
+                logger.warning(
+                    "attempt %d: connection to %s failed: %s", attempt, addr, e
+                )
+                continue
+            if resp.status in RETRY_STATUSES and attempt < MAX_RETRIES - 1:
+                resp.read()
+                conn.close()
+                done()
+                continue
+            if resp.status >= 500:
+                resp.read()
+                conn.close()
+                done()
+                # Strip engine error details (reference: request.go:45-63).
+                return _error(resp.status, "upstream model server error")
+
+            resp_headers = [
+                (k, v)
+                for k, v in resp.getheaders()
+                if k.lower() not in ("transfer-encoding", "connection")
+            ]
+
+            def chunks(resp=resp, conn=conn, done=done):
+                try:
+                    while True:
+                        chunk = resp.read(16384)
+                        if not chunk:
+                            break
+                        yield chunk
+                finally:
+                    conn.close()
+                    done()
+
+            return ProxyResult(resp.status, resp_headers, chunks())
+        raise last_err or RuntimeError("retries exhausted")
+
+
+def _send(addr: str, path: str, preq: apiutils.ParsedRequest, headers: dict):
+    host, _, port = addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port or 80), timeout=300)
+    fwd = {
+        "Content-Type": preq.content_type,
+        "Content-Length": str(len(preq.body)),
+    }
+    for k in ("authorization", "accept", "x-request-id"):
+        if k in headers:
+            fwd[k] = headers[k]
+    conn.request("POST", path, body=preq.body, headers=fwd)
+    return conn.getresponse(), conn
+
+
+def _error(status: int, message: str) -> ProxyResult:
+    import json
+
+    body = json.dumps({"error": {"message": message, "code": status}}).encode()
+    return ProxyResult(
+        status,
+        [("Content-Type", "application/json"), ("Content-Length", str(len(body)))],
+        iter([body]),
+    )

@@ -1,0 +1,475 @@
+"""Routing tier tests (reference suites: internal/loadbalancer/*_test.go,
+internal/modelproxy/handler_test.go, internal/apiutils/*_test.go)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubeai_tpu.crd.model import Model, ModelSpec, LoadBalancing
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing import apiutils
+from kubeai_tpu.routing.chwbl import CHWBL
+from kubeai_tpu.routing.loadbalancer import Group, LoadBalancer, LoadBalancerTimeout
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.routing.openai_server import OpenAIServer
+from kubeai_tpu.routing.proxy import ModelProxy
+from kubeai_tpu.routing.xxhash import xxhash64
+
+
+# ---- xxhash -----------------------------------------------------------------
+
+
+def test_xxhash64_vectors():
+    assert xxhash64(b"") == 0xEF46DB3751D8E999
+    assert xxhash64(b"a") == 0xD24EC4F1A98C6E5B
+    assert xxhash64(b"abc") == 0x44BC2CF5AD770999
+    # >=32 bytes path
+    assert xxhash64(b"x" * 100) == xxhash64(b"x" * 100)
+    assert xxhash64(b"x" * 100) != xxhash64(b"x" * 101)
+
+
+# ---- apiutils ---------------------------------------------------------------
+
+
+def test_parse_request_model_and_prefix():
+    body = json.dumps(
+        {
+            "model": "llama",
+            "messages": [
+                {"role": "system", "content": "be nice"},
+                {"role": "user", "content": "hello world, this is the prefix"},
+            ],
+            "some_vendor_field": {"x": 1},
+        }
+    ).encode()
+    p = apiutils.parse_request(body, "/v1/chat/completions", {})
+    assert p.model == "llama" and p.adapter == ""
+    assert p.prefix.startswith("hello world")
+    # Unknown fields preserved.
+    assert json.loads(p.body)["some_vendor_field"] == {"x": 1}
+
+
+def test_parse_request_adapter_rewrites_body():
+    body = json.dumps({"model": "llama_finetune", "prompt": "hi"}).encode()
+    p = apiutils.parse_request(body, "/v1/completions", {})
+    assert (p.model, p.adapter) == ("llama", "finetune")
+    assert json.loads(p.body)["model"] == "finetune"
+    assert p.model_and_adapter == "llama_finetune"
+
+
+def test_parse_request_errors():
+    with pytest.raises(apiutils.APIError):
+        apiutils.parse_request(b"not json", "/v1/completions", {})
+    with pytest.raises(apiutils.APIError):
+        apiutils.parse_request(b"{}", "/v1/completions", {})
+    with pytest.raises(apiutils.APIError):
+        apiutils.parse_label_selector("novalue")
+
+
+def test_parse_multipart_strips_model_field():
+    boundary = "XX"
+    body = (
+        b"--XX\r\n"
+        b'Content-Disposition: form-data; name="model"\r\n\r\n'
+        b"whisper_acc\r\n"
+        b"--XX\r\n"
+        b'Content-Disposition: form-data; name="file"; filename="a.wav"\r\n\r\n'
+        b"AUDIO\r\n"
+        b"--XX--\r\n"
+    )
+    p = apiutils.parse_request(
+        body,
+        "/v1/audio/transcriptions",
+        {"content-type": f'multipart/form-data; boundary="{boundary}"'},
+    )
+    assert (p.model, p.adapter) == ("whisper", "acc")
+    assert b'name="model"' not in p.body
+    assert b"AUDIO" in p.body
+
+
+# ---- CHWBL ------------------------------------------------------------------
+
+
+def test_chwbl_consistency_and_stickiness():
+    ring = CHWBL()
+    for ep in ("a:1", "b:1", "c:1"):
+        ring.add(ep)
+    loads = {"a:1": 0, "b:1": 0, "c:1": 0}
+    picks = {ring.get(f"prefix-{i}", loads) for i in range(50)}
+    assert picks == {"a:1", "b:1", "c:1"}  # spreads across endpoints
+    # Same key -> same endpoint while loads are balanced.
+    assert len({ring.get("stable-key", loads) for _ in range(10)}) == 1
+
+
+def test_chwbl_minimal_redistribution_on_removal():
+    ring = CHWBL()
+    for ep in ("a:1", "b:1", "c:1"):
+        ring.add(ep)
+    loads3 = {"a:1": 0, "b:1": 0, "c:1": 0}
+    before = {f"k{i}": ring.get(f"k{i}", loads3) for i in range(100)}
+    ring.remove("c:1")
+    loads2 = {"a:1": 0, "b:1": 0}
+    moved = 0
+    for k, ep in before.items():
+        now = ring.get(k, loads2)
+        if ep != "c:1" and now != ep:
+            moved += 1
+    # Keys not on the removed endpoint overwhelmingly stay put.
+    assert moved <= 5
+
+
+def test_chwbl_bounded_load_displaces():
+    ring = CHWBL(load_factor=1.0)
+    for ep in ("a:1", "b:1"):
+        ring.add(ep)
+    loads = {"a:1": 0, "b:1": 0}
+    home = ring.get("key", loads)
+    other = "b:1" if home == "a:1" else "a:1"
+    # Overload the home endpoint: bounded-load walks to the other.
+    loads[home] = 100
+    loads[other] = 0
+    assert ring.get("key", loads) == other
+
+
+def test_chwbl_adapter_walk_and_fallback():
+    ring = CHWBL()
+    for ep in ("a:1", "b:1", "c:1"):
+        ring.add(ep)
+    loads = {"a:1": 0, "b:1": 0, "c:1": 0}
+    # Only b has the adapter: every key lands on b.
+    for i in range(20):
+        assert ring.get(f"k{i}", loads, adapter_endpoints={"b:1"}) == "b:1"
+    # No adapter endpoints at all -> falls back to some bounded endpoint.
+    assert ring.get("k", loads, adapter_endpoints=set()) in loads
+
+
+# ---- endpoint group ---------------------------------------------------------
+
+
+def test_group_blocks_until_endpoint_arrives():
+    g = Group()
+    result = {}
+
+    def waiter():
+        addr, done = g.get_best_addr("LeastLoad", "", "", timeout=5)
+        result["addr"] = addr
+        done()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    assert "addr" not in result  # blocked (scale-from-zero hold)
+    g.reconcile_endpoints({"10.0.0.1:8000": set()})
+    t.join(timeout=5)
+    assert result["addr"] == "10.0.0.1:8000"
+
+
+def test_group_timeout():
+    g = Group()
+    with pytest.raises(LoadBalancerTimeout):
+        g.get_best_addr("LeastLoad", "", "", timeout=0.05)
+
+
+def test_group_least_load_and_accounting():
+    g = Group()
+    g.reconcile_endpoints({"a:1": set(), "b:1": set()})
+    addr1, done1 = g.get_best_addr("LeastLoad", "", "", timeout=1)
+    addr2, done2 = g.get_best_addr("LeastLoad", "", "", timeout=1)
+    assert {addr1, addr2} == {"a:1", "b:1"}  # spreads by in-flight
+    done1()
+    done1()  # double-done is a no-op
+    assert g.total_in_flight == 1
+    done2()
+    assert g.total_in_flight == 0
+
+
+def test_group_adapter_filter_blocks_until_adapter_pod():
+    g = Group()
+    g.reconcile_endpoints({"a:1": set()})
+    with pytest.raises(LoadBalancerTimeout):
+        g.get_best_addr("LeastLoad", "lora1", "", timeout=0.05)
+    g.reconcile_endpoints({"a:1": set(), "b:1": {"lora1"}})
+    addr, done = g.get_best_addr("LeastLoad", "lora1", "", timeout=1)
+    assert addr == "b:1"
+    done()
+
+
+# ---- full data path: openai server -> proxy -> fake engine -------------------
+
+
+class FakeEngine:
+    """httptest.Server equivalent: scripted engine backend."""
+
+    def __init__(self, behavior=None):
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req_body = self.rfile.read(n)
+                fake.requests.append((self.path, req_body))
+                status, payload = (fake.behavior or fake.default)(self.path, req_body)
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.requests: list = []
+        self.behavior = behavior
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def default(self, path, body):
+        model = json.loads(body).get("model", "?")
+        return 200, {"object": "chat.completion", "model": model, "backend": self.port}
+
+    @property
+    def port(self):
+        return self.httpd.server_address[1]
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture
+def stack():
+    """store + LB + proxy + openai server, with one Model backed by fakes."""
+    store = KubeStore()
+    lb = LoadBalancer(store, default_timeout=5)
+    mc = ModelClient(store)
+    server = OpenAIServer(ModelProxy(lb, mc), mc)
+    server.start()
+    engines: list[FakeEngine] = []
+
+    def add_model(name="m1", engines_n=1, strategy="LeastLoad", adapters=None):
+        m = Model(
+            name=name,
+            spec=ModelSpec(
+                url="hf://org/x",
+                engine="KubeAITPU",
+                features=["TextGeneration"],
+                autoscaling_disabled=True,
+                replicas=engines_n,
+                load_balancing=LoadBalancing(strategy=strategy),
+            ),
+        )
+        if adapters:
+            m.spec.adapters = adapters
+        store.create(m.to_dict())
+        for i in range(engines_n):
+            eng = FakeEngine()
+            engines.append(eng)
+            store.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {
+                        "name": f"model-{name}-{i}",
+                        "namespace": "default",
+                        "labels": {"model": name},
+                        "annotations": {
+                            "model-pod-ip": "127.0.0.1",
+                            "model-pod-port": str(eng.port),
+                        },
+                    },
+                    "status": {
+                        "conditions": [{"type": "Ready", "status": "True"}],
+                        "podIP": "127.0.0.1",
+                    },
+                }
+            )
+        lb.sync_model(name)
+        return engines
+
+    yield store, lb, server, add_model, engines
+    server.stop()
+    lb.stop()
+    for e in engines:
+        e.stop()
+
+
+def _post(server, path, payload):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    body = json.dumps(payload).encode()
+    conn.request(
+        "POST", path, body=body, headers={"Content-Type": "application/json"}
+    )
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def test_chat_completion_roundtrip(stack):
+    _, _, server, add_model, _ = stack
+    add_model()
+    status, data = _post(
+        server,
+        "/openai/v1/chat/completions",
+        {"model": "m1", "messages": [{"role": "user", "content": "hi"}]},
+    )
+    assert status == 200
+    assert json.loads(data)["object"] == "chat.completion"
+
+
+def test_unknown_model_404(stack):
+    _, _, server, add_model, _ = stack
+    add_model()
+    status, data = _post(
+        server, "/openai/v1/chat/completions", {"model": "nope", "messages": []}
+    )
+    assert status == 404
+
+
+def test_retry_on_5xx_until_success(stack):
+    """(reference: modelproxy/handler_test.go retry table)"""
+    _, _, server, add_model, engines = stack
+    add_model()
+    eng = engines[0]
+    calls = {"n": 0}
+
+    def flaky(path, body):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            return 503, {"error": "overloaded"}
+        return 200, {"ok": True}
+
+    eng.behavior = flaky
+    status, data = _post(
+        server,
+        "/openai/v1/completions",
+        {"model": "m1", "prompt": "x"},
+    )
+    assert status == 200 and calls["n"] == 3
+
+
+def test_5xx_details_stripped(stack):
+    _, _, server, add_model, engines = stack
+    add_model()
+    engines[0].behavior = lambda p, b: (500, {"error": "secret internal details"})
+    status, data = _post(
+        server, "/openai/v1/completions", {"model": "m1", "prompt": "x"}
+    )
+    assert status == 500
+    assert b"secret" not in data
+
+
+def test_least_load_spreads_across_backends(stack):
+    _, _, server, add_model, engines = stack
+    add_model(engines_n=2)
+    seen = set()
+    for _ in range(10):
+        status, data = _post(
+            server, "/openai/v1/completions", {"model": "m1", "prompt": "x"}
+        )
+        assert status == 200
+        seen.add(json.loads(data)["backend"])
+    assert len(seen) == 2
+
+
+def test_prefix_hash_stickiness_through_stack(stack):
+    _, _, server, add_model, engines = stack
+    add_model(engines_n=2, strategy="PrefixHash")
+    backends = set()
+    for _ in range(5):
+        status, data = _post(
+            server,
+            "/openai/v1/chat/completions",
+            {
+                "model": "m1",
+                "messages": [{"role": "user", "content": "the same long prefix"}],
+            },
+        )
+        assert status == 200
+        backends.add(json.loads(data)["backend"])
+    assert len(backends) == 1  # same prefix -> same backend
+
+
+def test_models_listing_expands_adapters(stack):
+    from kubeai_tpu.crd.model import Adapter
+    import http.client
+
+    _, _, server, add_model, _ = stack
+    add_model(name="m2", adapters=[Adapter(name="fin", url="hf://a/b")])
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    conn.request("GET", "/openai/v1/models")
+    resp = conn.getresponse()
+    ids = {m["id"] for m in json.loads(resp.read())["data"]}
+    conn.close()
+    assert {"m2", "m2_fin"} <= ids
+
+
+def test_scale_from_zero_via_proxy(stack):
+    """Proxy bumps replicas 0->1 and blocks until a pod is ready
+    (reference: test/integration/proxy_test.go:19-95)."""
+    store, lb, server, add_model, engines = stack
+    m = Model(
+        name="m0",
+        spec=ModelSpec(
+            url="hf://org/x",
+            engine="KubeAITPU",
+            features=["TextGeneration"],
+            min_replicas=0,
+            max_replicas=2,
+            replicas=0,
+        ),
+    )
+    store.create(m.to_dict())
+
+    result = {}
+
+    def call():
+        result["resp"] = _post(
+            server, "/openai/v1/completions", {"model": "m0", "prompt": "x"}
+        )
+
+    t = threading.Thread(target=call)
+    t.start()
+    # The request must trigger 0->1 scale.
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if (store.get("Model", "default", "m0")["spec"].get("replicas") or 0) == 1:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("proxy did not scale model from zero")
+    assert "resp" not in result  # still blocked: no ready pod yet
+
+    # Simulate the controller + kubelet: bring up a fake engine pod.
+    eng = FakeEngine()
+    engines.append(eng)
+    store.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "model-m0-0",
+                "namespace": "default",
+                "labels": {"model": "m0"},
+                "annotations": {
+                    "model-pod-ip": "127.0.0.1",
+                    "model-pod-port": str(eng.port),
+                },
+            },
+            "status": {
+                "conditions": [{"type": "Ready", "status": "True"}],
+                "podIP": "127.0.0.1",
+            },
+        }
+    )
+    lb.sync_model("m0")
+    t.join(timeout=5)
+    assert result["resp"][0] == 200
