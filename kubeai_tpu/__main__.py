@@ -1,0 +1,91 @@
+"""Operator binary entrypoint (reference: cmd/main.go:28-53).
+
+    python -m kubeai_tpu [--config PATH]
+
+Reads the system config from --config / $CONFIG_PATH (default
+./config.yaml, matching the reference), connects to the Kubernetes API
+(in-cluster service account when available, else an in-memory store for
+local development), and runs the Manager until SIGINT/SIGTERM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="kubeai-tpu")
+    ap.add_argument(
+        "--config",
+        default=os.environ.get("CONFIG_PATH", "./config.yaml"),
+        help="system config file (default $CONFIG_PATH or ./config.yaml)",
+    )
+    ap.add_argument("--api-host", default="0.0.0.0")
+    ap.add_argument("--api-port", type=int, default=8000)
+    ap.add_argument("--namespace", default=os.environ.get("POD_NAMESPACE", "default"))
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    log = logging.getLogger("kubeai-tpu")
+
+    from kubeai_tpu.config import System, load_config_file
+    from kubeai_tpu.operator.k8s.store import KubeStore
+    from kubeai_tpu.operator.manager import Manager
+
+    if os.path.exists(args.config):
+        cfg = load_config_file(args.config)
+        log.info("loaded config from %s", args.config)
+    else:
+        cfg = System()
+        log.warning("config file %s not found; using defaults", args.config)
+
+    # K8s API: in-cluster REST when a service account is mounted, else the
+    # in-memory store (local development / demo mode).
+    sa_token = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+    if os.path.exists(sa_token):
+        try:
+            from kubeai_tpu.operator.k8s.rest import RestKubeClient
+
+            store = RestKubeClient.in_cluster()
+            log.info("connected to in-cluster Kubernetes API")
+        except Exception as e:
+            log.error("in-cluster API connection failed: %s", e)
+            return 1
+    else:
+        store = KubeStore()
+        log.warning("no in-cluster credentials; running with in-memory store")
+
+    mgr = Manager(
+        store,
+        cfg,
+        api_host=args.api_host,
+        api_port=args.api_port,
+        namespace=args.namespace,
+    )
+    mgr.start()
+    log.info("kubeai-tpu operator serving on %s", mgr.api_address)
+
+    stop = threading.Event()
+
+    def _sig(_s, _f):
+        stop.set()
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    stop.wait()
+    log.info("shutting down")
+    mgr.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

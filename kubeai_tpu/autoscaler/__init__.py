@@ -1,0 +1,7 @@
+"""Metrics-driven autoscaler with leader election and persisted state
+(reference: internal/modelautoscaler, internal/leader, internal/movingaverage).
+"""
+
+from kubeai_tpu.autoscaler.movingaverage import SimpleMovingAverage
+from kubeai_tpu.autoscaler.leader import LeaderElection
+from kubeai_tpu.autoscaler.autoscaler import Autoscaler

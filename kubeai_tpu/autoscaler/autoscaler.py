@@ -1,0 +1,190 @@
+"""The autoscaling loop (reference: internal/modelautoscaler/autoscaler.go).
+
+Leader-only, every `interval`:
+  list Models → scrape `/metrics` of EVERY operator replica (self-IPs from
+  the LB, or `fixedSelfMetricAddrs` in tests) → sum
+  `kubeai_inference_requests_active` per model → moving average over
+  timeWindow/interval buckets → ceil(avg / targetRequests) → scale with
+  consecutive-scale-down hysteresis → persist averages to a ConfigMap so a
+  restarted operator resumes mid-window (reference: state.go:32-65).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.request
+
+from kubeai_tpu.autoscaler.leader import LeaderElection
+from kubeai_tpu.autoscaler.movingaverage import SimpleMovingAverage
+from kubeai_tpu.config import System
+from kubeai_tpu.crd.model import Model
+from kubeai_tpu.metrics.registry import parse_prometheus_text
+from kubeai_tpu.operator.k8s.store import KubeStore, NotFound
+from kubeai_tpu.routing.loadbalancer import LoadBalancer
+from kubeai_tpu.routing.modelclient import ModelClient
+
+logger = logging.getLogger(__name__)
+
+ACTIVE_METRIC = "kubeai_inference_requests_active"
+
+
+def scrape_active_requests(addrs: list[str], timeout: float = 5.0) -> dict[str, float]:
+    """Aggregate the active-request gauge across operator replicas
+    (reference: modelautoscaler/metrics.go:15-71)."""
+    totals: dict[str, float] = {}
+    for addr in addrs:
+        url = f"http://{addr}/metrics"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                text = resp.read().decode()
+        except OSError as e:
+            # A missing replica must not zero the signal: raise so the tick
+            # is skipped (reference treats scrape errors as tick failures).
+            raise RuntimeError(f"scraping {url}: {e}") from e
+        for (name, labels), value in parse_prometheus_text(text).items():
+            if name != ACTIVE_METRIC:
+                continue
+            model = dict(labels).get("model", "")
+            if model:
+                totals[model] = totals.get(model, 0.0) + value
+    return totals
+
+
+class Autoscaler:
+    def __init__(
+        self,
+        store: KubeStore,
+        cfg: System,
+        model_client: ModelClient,
+        lb: LoadBalancer,
+        leader: LeaderElection,
+        namespace: str = "default",
+    ):
+        self.store = store
+        self.cfg = cfg
+        self.model_client = model_client
+        self.lb = lb
+        self.leader = leader
+        self.namespace = namespace
+        self.interval = cfg.model_autoscaling.interval_seconds
+        self.window_count = cfg.model_autoscaling.average_window_count
+        self._averages: dict[str, SimpleMovingAverage] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        # Hysteresis wiring: scale-downs require N consecutive votes
+        # (reference: config/system.go:131-137 + modelclient/scale.go).
+        model_client.required_consecutive_scale_downs_fn = (
+            lambda m: max(
+                1,
+                cfg.model_autoscaling.required_consecutive_scale_downs(
+                    m.spec.scale_down_delay_seconds
+                ),
+            )
+        )
+
+        self._load_state()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            if not self.leader.is_leader:
+                continue
+            try:
+                self.tick()
+            except Exception as e:
+                logger.warning("autoscaler tick failed: %s", e)
+
+    # -- one tick (reference: autoscaler.go:94-166) ----------------------------
+
+    def tick(self) -> None:
+        models = self.model_client.list_all_models()
+        addrs = self._self_metric_addrs()
+        if not addrs:
+            return
+        totals = scrape_active_requests(addrs)
+
+        next_averages: dict[str, SimpleMovingAverage] = {}
+        for model in models:
+            if model.spec.autoscaling_disabled:
+                continue
+            active = totals.get(model.name, 0.0)
+            avg_tracker = self._avg_for(model.name)
+            avg = avg_tracker.next(active)
+            next_averages[model.name] = avg_tracker
+            desired = -(-avg // model.spec.target_requests)  # ceil
+            self.model_client.scale(model.name, int(desired))
+
+        # Keep state only for models that still exist — deleted models'
+        # averages must not accumulate in memory or the state ConfigMap
+        # (reference: autoscaler.go:115,159-163 rebuilds state per tick).
+        self._averages = next_averages
+        self._save_state()
+
+    def _self_metric_addrs(self) -> list[str]:
+        if self.cfg.fixed_self_metric_addrs:
+            return list(self.cfg.fixed_self_metric_addrs)
+        return self.lb.get_self_ips()
+
+    def _avg_for(self, model: str) -> SimpleMovingAverage:
+        if model not in self._averages:
+            self._averages[model] = SimpleMovingAverage(self.window_count)
+        return self._averages[model]
+
+    # -- state persistence (reference: state.go:32-65) --------------------------
+
+    @property
+    def _cm_name(self) -> str:
+        return self.cfg.model_autoscaling.state_configmap_name
+
+    def _save_state(self) -> None:
+        state = {
+            name: {"average": avg.average()}
+            for name, avg in self._averages.items()
+        }
+        data = {"state": json.dumps(state)}
+        try:
+            cm = self.store.get("ConfigMap", self.namespace, self._cm_name)
+            cm["data"] = data
+            self.store.update(cm)
+        except NotFound:
+            self.store.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {
+                        "name": self._cm_name,
+                        "namespace": self.namespace,
+                    },
+                    "data": data,
+                }
+            )
+
+    def _load_state(self) -> None:
+        """Preload averages so a restart doesn't forget recent load — the
+        scale-to-zero edge case (reference: autoscaler.go:43-66)."""
+        try:
+            cm = self.store.get("ConfigMap", self.namespace, self._cm_name)
+        except NotFound:
+            return
+        try:
+            state = json.loads((cm.get("data") or {}).get("state", "{}"))
+        except json.JSONDecodeError:
+            return
+        for name, entry in state.items():
+            avg = float(entry.get("average", 0.0))
+            self._averages[name] = SimpleMovingAverage(
+                self.window_count, seed=avg
+            )

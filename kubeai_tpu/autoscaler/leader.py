@@ -1,0 +1,107 @@
+"""Lease-based leader election (reference: internal/leader/election.go).
+
+A `Lease` object in the store records holder + renew time; candidates
+race to acquire/renew it. `is_leader` is the atomic flag the autoscaler
+checks each tick (reference: autoscaler.go:101)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from kubeai_tpu.operator.k8s.store import Conflict, KubeStore, NotFound
+
+LEASE_NAME = "kubeai.org.leader"
+
+
+class LeaderElection:
+    def __init__(
+        self,
+        store: KubeStore,
+        identity: str,
+        namespace: str = "default",
+        lease_duration: float = 15.0,
+        retry_period: float = 2.0,
+    ):
+        self.store = store
+        self.identity = identity
+        self.namespace = namespace
+        self.lease_duration = lease_duration
+        self.retry_period = retry_period
+        self._is_leader = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader.is_set()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if self.is_leader:
+            self._release()
+            self._is_leader.clear()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._try_acquire_or_renew()
+            except Exception:
+                self._is_leader.clear()
+            self._stop.wait(self.retry_period)
+
+    def _try_acquire_or_renew(self) -> None:
+        now = time.time()
+        try:
+            lease = self.store.get("Lease", self.namespace, LEASE_NAME)
+        except NotFound:
+            try:
+                self.store.create(
+                    {
+                        "apiVersion": "coordination.k8s.io/v1",
+                        "kind": "Lease",
+                        "metadata": {
+                            "name": LEASE_NAME,
+                            "namespace": self.namespace,
+                        },
+                        "spec": {
+                            "holderIdentity": self.identity,
+                            "renewTime": now,
+                        },
+                    }
+                )
+                self._is_leader.set()
+            except Conflict:
+                self._is_leader.clear()
+            return
+
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity")
+        renew = float(spec.get("renewTime") or 0)
+        expired = now - renew > self.lease_duration
+
+        if holder == self.identity or expired or not holder:
+            spec["holderIdentity"] = self.identity
+            spec["renewTime"] = now
+            try:
+                self.store.update(lease)
+                self._is_leader.set()
+            except Conflict:
+                self._is_leader.clear()
+        else:
+            self._is_leader.clear()
+
+    def _release(self) -> None:
+        try:
+            lease = self.store.get("Lease", self.namespace, LEASE_NAME)
+            if lease.get("spec", {}).get("holderIdentity") == self.identity:
+                lease["spec"]["holderIdentity"] = ""
+                self.store.update(lease)
+        except (NotFound, Conflict):
+            pass

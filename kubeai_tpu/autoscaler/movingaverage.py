@@ -1,0 +1,26 @@
+"""Fixed-window moving average (reference: internal/movingaverage/simple.go).
+
+A ring buffer of the last N samples. Unlike an EMA it reaches EXACTLY zero
+when all samples are zero — the property scale-to-zero depends on
+(reference: simple.go:10-18)."""
+
+from __future__ import annotations
+
+
+class SimpleMovingAverage:
+    def __init__(self, window: int, seed: float = 0.0):
+        if window < 1:
+            raise ValueError("window must be >= 1")
+        self._samples = [seed] * window
+        self._idx = 0
+
+    def next(self, value: float) -> float:
+        self._samples[self._idx] = value
+        self._idx = (self._idx + 1) % len(self._samples)
+        return self.average()
+
+    def average(self) -> float:
+        return sum(self._samples) / len(self._samples)
+
+    def history(self) -> list[float]:
+        return list(self._samples)

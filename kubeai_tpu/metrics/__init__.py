@@ -7,6 +7,8 @@ are the autoscaling transport (reference: internal/modelautoscaler/metrics.go:15
 """
 
 from kubeai_tpu.metrics.registry import (
+    Metrics,
+    DEFAULT_METRICS,
     Counter,
     Gauge,
     Histogram,

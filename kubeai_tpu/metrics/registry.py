@@ -135,30 +135,45 @@ class Registry:
         return "\n".join(out) + "\n"
 
 
-REGISTRY = Registry()
+class Metrics:
+    """One operator replica's instrument bundle. Each Manager owns its own
+    Metrics so multiple replicas embedded in one process (virtual HA,
+    integration tests) don't share counters — sharing would double-count the
+    autoscaling signal when the leader scrapes every replica."""
 
-# The autoscaling signal (reference: internal/metrics/metrics.go:16-20;
-# Prom name mapping metrics.go:81-87).
-INFERENCE_REQUESTS_ACTIVE = Gauge(
-    "kubeai_inference_requests_active",
-    "Number of in-flight inference requests per model.",
-    REGISTRY,
-)
-INFERENCE_REQUESTS_TOTAL = Counter(
-    "kubeai_inference_requests_total",
-    "Total inference requests per model.",
-    REGISTRY,
-)
-CHWBL_LOOKUPS = Counter(
-    "kubeai_chwbl_lookups_total",
-    "CHWBL address lookups.",
-    REGISTRY,
-)
-CHWBL_DISPLACEMENTS = Counter(
-    "kubeai_chwbl_displacements_total",
-    "CHWBL lookups displaced past the hashed endpoint by the bounded-load rule.",
-    REGISTRY,
-)
+    def __init__(self):
+        self.registry = Registry()
+        # The autoscaling signal (reference: internal/metrics/metrics.go:16-20;
+        # Prom name mapping metrics.go:81-87).
+        self.inference_requests_active = Gauge(
+            "kubeai_inference_requests_active",
+            "Number of in-flight inference requests per model.",
+            self.registry,
+        )
+        self.inference_requests_total = Counter(
+            "kubeai_inference_requests_total",
+            "Total inference requests per model.",
+            self.registry,
+        )
+        self.chwbl_lookups = Counter(
+            "kubeai_chwbl_lookups_total",
+            "CHWBL address lookups.",
+            self.registry,
+        )
+        self.chwbl_displacements = Counter(
+            "kubeai_chwbl_displacements_total",
+            "CHWBL lookups displaced past the hashed endpoint by the bounded-load rule.",
+            self.registry,
+        )
+
+
+# Process-default bundle (single-replica processes, ad-hoc use).
+DEFAULT_METRICS = Metrics()
+REGISTRY = DEFAULT_METRICS.registry
+INFERENCE_REQUESTS_ACTIVE = DEFAULT_METRICS.inference_requests_active
+INFERENCE_REQUESTS_TOTAL = DEFAULT_METRICS.inference_requests_total
+CHWBL_LOOKUPS = DEFAULT_METRICS.chwbl_lookups
+CHWBL_DISPLACEMENTS = DEFAULT_METRICS.chwbl_displacements
 
 
 def parse_prometheus_text(text: str) -> dict[tuple[str, tuple], float]:

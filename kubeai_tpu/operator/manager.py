@@ -1,0 +1,174 @@
+"""Composition root: builds and runs the whole operator
+(reference: internal/manager/run.go:76-399).
+
+Wires: load balancer (Pod watcher) → Model reconciler loop → model client →
+autoscaler (leader-gated) → OpenAI API server → messengers. The same
+assembly runs in production and inside integration tests (the reference
+starts the entire real manager in envtest — reference:
+test/integration/main_test.go:132-157; here tests call Manager.start()
+against a KubeStore).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import uuid
+
+from kubeai_tpu.autoscaler import Autoscaler, LeaderElection
+from kubeai_tpu.config import System
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.crd.model import Model, ValidationError
+from kubeai_tpu.operator.adapters import PodExec
+from kubeai_tpu.operator.controller import ControllerLoop, ModelReconciler
+from kubeai_tpu.operator.engine_client import EngineClient
+from kubeai_tpu.operator.k8s.store import Invalid, KubeStore
+from kubeai_tpu.routing.loadbalancer import LoadBalancer
+from kubeai_tpu.routing.messenger import Broker, MemBroker, Messenger
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.routing.openai_server import OpenAIServer
+from kubeai_tpu.routing.proxy import ModelProxy
+
+
+def _model_admission(new: dict, old: dict | None) -> None:
+    """CRD validation at the store boundary — admission-webhook parity."""
+    try:
+        model = Model.from_dict(new)
+        if old is not None:
+            model.validate_update(Model.from_dict(old))
+        else:
+            model.validate()
+    except ValidationError as e:
+        raise Invalid(str(e))
+
+
+@dataclasses.dataclass
+class Manager:
+    store: KubeStore
+    cfg: System
+    api_host: str = "127.0.0.1"
+    api_port: int = 0
+    namespace: str = "default"
+    identity: str = ""
+    broker: Broker | None = None
+    engine_client: EngineClient | None = None
+    pod_exec: PodExec | None = None
+
+    def __post_init__(self):
+        self.cfg.default_and_validate()
+        self.identity = self.identity or f"{socket.gethostname()}-{uuid.uuid4().hex[:6]}"
+        self.store.register_validator("Model", _model_admission)
+
+        # Per-replica instrument bundle: embedded multi-replica setups must
+        # not share counters (the leader scrapes every replica and sums).
+        self.metrics = Metrics()
+        self.lb = LoadBalancer(self.store, metrics=self.metrics)
+        self.model_client = ModelClient(self.store, self.namespace)
+        self.reconciler = ModelReconciler(
+            self.store,
+            self.cfg,
+            engine_client=self.engine_client,
+            pod_exec=self.pod_exec,
+        )
+        self.controller_loop = ControllerLoop(self.reconciler)
+        self.leader = LeaderElection(
+            self.store,
+            self.identity,
+            namespace=self.namespace,
+            lease_duration=self.cfg.leader_election.lease_duration_seconds,
+            retry_period=self.cfg.leader_election.retry_period_seconds,
+        )
+        self.autoscaler = Autoscaler(
+            self.store,
+            self.cfg,
+            self.model_client,
+            self.lb,
+            self.leader,
+            namespace=self.namespace,
+        )
+        self.proxy = ModelProxy(self.lb, self.model_client, metrics=self.metrics)
+        self.api_server = OpenAIServer(
+            self.proxy,
+            self.model_client,
+            host=self.api_host,
+            port=self.api_port,
+            metrics=self.metrics,
+        )
+        self.messengers: list[Messenger] = []
+        broker = self.broker or (MemBroker() if self.cfg.messaging.streams else None)
+        for stream in self.cfg.messaging.streams:
+            self.messengers.append(
+                Messenger(
+                    broker,
+                    stream.request_subscription,
+                    stream.response_topic,
+                    self.lb,
+                    self.model_client,
+                    max_handlers=stream.max_handlers,
+                    error_max_backoff=self.cfg.messaging.error_max_backoff_seconds,
+                    metrics=self.metrics,
+                )
+            )
+        self.broker = broker
+
+    @property
+    def api_address(self) -> str:
+        return self.api_server.address
+
+    def start(self) -> None:
+        self.lb.start()
+        self.controller_loop.start()
+        self.leader.start()
+        self.autoscaler.start()
+        self.api_server.start()
+        for m in self.messengers:
+            m.start()
+        # Register this replica's self pod so every LB instance discovers
+        # every replica's metrics address — the leader's autoscaler must sum
+        # load across ALL replicas, not just itself (reference:
+        # load_balancer.go:64-83 + autoscaler.go:118-130).
+        if not self.cfg.fixed_self_metric_addrs:
+            self._register_self_pod()
+
+    _self_pod_name: str = ""
+
+    def _register_self_pod(self) -> None:
+        from kubeai_tpu.routing.loadbalancer import (
+            SELF_METRICS_ADDR_ANNOTATION,
+            SELF_POD_LABEL,
+            SELF_POD_VALUE,
+        )
+
+        self._self_pod_name = f"kubeai-{self.identity}"
+        self.store.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": self._self_pod_name,
+                    "namespace": self.namespace,
+                    "labels": {SELF_POD_LABEL: SELF_POD_VALUE},
+                    "annotations": {
+                        SELF_METRICS_ADDR_ANNOTATION: self.api_server.address
+                    },
+                },
+                "status": {
+                    "conditions": [{"type": "Ready", "status": "True"}],
+                    "podIP": self.api_host,
+                },
+            }
+        )
+
+    def stop(self) -> None:
+        if self._self_pod_name:
+            try:
+                self.store.delete("Pod", self.namespace, self._self_pod_name)
+            except Exception:
+                pass
+        for m in self.messengers:
+            m.stop()
+        self.api_server.stop()
+        self.autoscaler.stop()
+        self.leader.stop()
+        self.controller_loop.stop()
+        self.lb.stop()

@@ -19,14 +19,20 @@ from __future__ import annotations
 
 import bisect
 
-from kubeai_tpu.metrics import CHWBL_DISPLACEMENTS, CHWBL_LOOKUPS
+from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
 from kubeai_tpu.routing.xxhash import xxhash64
 
 
 class CHWBL:
-    def __init__(self, load_factor: float = 1.25, replication: int = 256):
+    def __init__(
+        self,
+        load_factor: float = 1.25,
+        replication: int = 256,
+        metrics: Metrics = DEFAULT_METRICS,
+    ):
         self.load_factor = load_factor
         self.replication = replication
+        self.metrics = metrics
         self._hashes: list[int] = []  # sorted ring points
         self._ring: dict[int, str] = {}  # point -> endpoint
 
@@ -64,7 +70,7 @@ class CHWBL:
         restricts preferred endpoints (None = no restriction)."""
         if not self._hashes:
             return None
-        CHWBL_LOOKUPS.inc()
+        self.metrics.chwbl_lookups.inc()
         total = sum(loads.values())
         n = max(len(loads), 1)
         # "+1" simulates the incoming request (reference: balance_chwbl.go:152-162).
@@ -92,7 +98,7 @@ class CHWBL:
                 continue
             if ok:
                 if displaced:
-                    CHWBL_DISPLACEMENTS.inc()
+                    self.metrics.chwbl_displacements.inc()
                 return ep
             displaced = True
         # No adapter-serving endpoint within bound: any bounded endpoint

@@ -24,11 +24,20 @@ from kubeai_tpu.crd.model import (
 )
 from kubeai_tpu.operator import k8sutils
 from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
 from kubeai_tpu.routing.chwbl import CHWBL
 
 
 class LoadBalancerTimeout(TimeoutError):
     pass
+
+
+# Operator replicas self-identify with this label; the LB collects their
+# metrics addresses so the leader's autoscaler can scrape EVERY replica
+# (reference: load_balancer.go:64-83 tracks kubeai self pods the same way).
+SELF_POD_LABEL = "app.kubernetes.io/name"
+SELF_POD_VALUE = "kubeai"
+SELF_METRICS_ADDR_ANNOTATION = "kubeai.org/metrics-addr"
 
 
 class _Endpoint:
@@ -44,10 +53,17 @@ class Group:
     """Per-model endpoint set with in-flight accounting and a blocking wait
     (reference: internal/loadbalancer/group.go)."""
 
-    def __init__(self, load_factor: float = 1.25, replication: int = 256):
+    def __init__(
+        self,
+        load_factor: float = 1.25,
+        replication: int = 256,
+        metrics: Metrics = DEFAULT_METRICS,
+    ):
         self._cond = threading.Condition()
         self._endpoints: dict[str, _Endpoint] = {}
-        self._chwbl = CHWBL(load_factor=load_factor, replication=replication)
+        self._chwbl = CHWBL(
+            load_factor=load_factor, replication=replication, metrics=metrics
+        )
         self.total_in_flight = 0
 
     def reconcile_endpoints(self, observed: dict[str, set[str]]) -> None:
@@ -135,9 +151,15 @@ class LoadBalancer:
     """Watches Pods in the store and maintains groups + self IPs
     (reference: internal/loadbalancer/load_balancer.go)."""
 
-    def __init__(self, store: KubeStore, default_timeout: float = 600.0):
+    def __init__(
+        self,
+        store: KubeStore,
+        default_timeout: float = 600.0,
+        metrics: Metrics = DEFAULT_METRICS,
+    ):
         self.store = store
         self.default_timeout = default_timeout
+        self.metrics = metrics
         self._lock = threading.Lock()
         self._groups: dict[str, Group] = {}
         self._self_ips: list[str] = []
@@ -167,6 +189,8 @@ class LoadBalancer:
             model = k8sutils.get_label(pod, md.POD_MODEL_LABEL)
             if model:
                 self.sync_model(model, pod["metadata"].get("namespace", "default"))
+            elif k8sutils.get_label(pod, SELF_POD_LABEL) == SELF_POD_VALUE:
+                self._sync_self_ips()
 
     # -- endpoint discovery (reference: load_balancer.go:90-140) --------------
 
@@ -178,6 +202,26 @@ class LoadBalancer:
                 models.add((model, pod["metadata"].get("namespace", "default")))
         for model, ns in models:
             self.sync_model(model, ns)
+        self._sync_self_ips()
+
+    def _sync_self_ips(self) -> None:
+        """Collect metrics addresses of ALL operator replicas from their
+        self pods — the autoscaler scrapes every one of these each tick."""
+        addrs = []
+        for pod in self.store.list(
+            "Pod", label_selector={SELF_POD_LABEL: SELF_POD_VALUE}
+        ):
+            if not k8sutils.pod_is_ready(pod):
+                continue
+            addr = k8sutils.get_annotation(pod, SELF_METRICS_ADDR_ANNOTATION)
+            if not addr:
+                ip = (pod.get("status") or {}).get("podIP")
+                port = k8sutils.get_annotation(pod, md.MODEL_POD_PORT_ANNOTATION) or "8080"
+                addr = f"{ip}:{port}" if ip else None
+            if addr:
+                addrs.append(addr)
+        with self._lock:
+            self._self_ips = addrs
 
     def sync_model(self, model: str, namespace: str = "default") -> None:
         observed: dict[str, set[str]] = {}
@@ -206,7 +250,7 @@ class LoadBalancer:
     def group(self, model: str) -> Group:
         with self._lock:
             if model not in self._groups:
-                self._groups[model] = Group()
+                self._groups[model] = Group(metrics=self.metrics)
             return self._groups[model]
 
     # -- API (reference: load_balancer.go:182-204) -----------------------------

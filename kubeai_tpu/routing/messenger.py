@@ -1,0 +1,269 @@
+"""Async pub/sub ingestion path (reference: internal/messenger/messenger.go).
+
+Requests arrive as messages:
+    {"metadata": {...}, "path": "/v1/chat/completions", "body": {...}}
+and responses are published as:
+    {"metadata": {...}, "status_code": N, "body": {...}}
+(reference: messenger.go:180-348). A missing "path" defaults to
+/v1/completions and a missing leading "/" is prepended
+(reference: messenger.go:266-272).
+
+The broker seam mirrors gocloud.dev/pubsub's driver model
+(reference: internal/manager/run.go:47-52 registers SQS/PubSub/Kafka/...);
+`MemBroker` is the `mem://` driver used by tests
+(reference: test/integration/main_test.go:18,60-62). Production drivers
+plug in behind the same two methods.
+
+Failure behavior mirrored: per-message handler semaphore (`maxHandlers`),
+responses published BEFORE ack (publish failure → Nack → redelivery),
+bad-request replies count toward the consecutive-error throttle so a
+malformed-message flood backs off (reference: messenger.go:98-178).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+from typing import Protocol
+
+from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
+from kubeai_tpu.routing import apiutils
+from kubeai_tpu.routing.loadbalancer import LoadBalancer, LoadBalancerTimeout
+from kubeai_tpu.routing.modelclient import (
+    AdapterNotFound,
+    ModelClient,
+    ModelNotFound,
+)
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PATH = "/v1/completions"
+
+
+class Message:
+    def __init__(self, body: bytes):
+        self.body = body
+        self.acked: bool | None = None
+
+    def ack(self) -> None:
+        self.acked = True
+
+    def nack(self) -> None:
+        self.acked = False
+
+
+class Broker(Protocol):
+    def receive(self, subscription: str, timeout: float) -> Message | None: ...
+    def publish(self, topic: str, body: bytes) -> None: ...
+
+
+class MemBroker:
+    """In-memory pub/sub (the `mem://` driver equivalent)."""
+
+    def __init__(self):
+        self._topics: dict[str, queue.Queue] = {}
+        self._lock = threading.Lock()
+
+    def _q(self, name: str) -> queue.Queue:
+        with self._lock:
+            if name not in self._topics:
+                self._topics[name] = queue.Queue()
+            return self._topics[name]
+
+    def publish(self, topic: str, body: bytes) -> None:
+        self._q(topic).put(Message(body))
+
+    def receive(self, subscription: str, timeout: float) -> Message | None:
+        try:
+            return self._q(subscription).get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class Messenger:
+    def __init__(
+        self,
+        broker: Broker,
+        request_subscription: str,
+        response_topic: str,
+        lb: LoadBalancer,
+        model_client: ModelClient,
+        max_handlers: int = 100,
+        error_max_backoff: float = 30.0,
+        http_send=None,  # injectable for tests
+        metrics: Metrics = DEFAULT_METRICS,
+    ):
+        self.metrics = metrics
+        self.broker = broker
+        self.request_subscription = request_subscription
+        self.response_topic = response_topic
+        self.lb = lb
+        self.model_client = model_client
+        self._semaphore = threading.Semaphore(max_handlers)
+        self.error_max_backoff = error_max_backoff
+        self._consecutive_errors = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._http_send = http_send or self._default_http_send
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._receive_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # -- receive loop (reference: messenger.go:82-178) --------------------------
+
+    def _receive_loop(self) -> None:
+        while not self._stop.is_set():
+            # Consecutive-error throttle (reference: messenger.go:156-178).
+            if self._consecutive_errors:
+                backoff = min(
+                    2 ** min(self._consecutive_errors, 10) * 0.1,
+                    self.error_max_backoff,
+                )
+                if self._stop.wait(backoff):
+                    return
+            # Reserve a handler slot BEFORE pulling a message, so a message
+            # is never stranded un-acked while we wait; keep the wait
+            # interruptible by stop().
+            if not self._semaphore.acquire(timeout=0.2):
+                continue
+            if self._stop.is_set():
+                self._semaphore.release()
+                return
+            msg = self.broker.receive(self.request_subscription, timeout=0.2)
+            if msg is None:
+                self._semaphore.release()
+                continue
+            threading.Thread(
+                target=self._handle_wrapper, args=(msg,), daemon=True
+            ).start()
+
+    def _handle_wrapper(self, msg: Message) -> None:
+        try:
+            err = self.handle_request(msg)
+            self._consecutive_errors = (
+                0 if not err else self._consecutive_errors + 1
+            )
+        except Exception:
+            logger.exception("messenger handler crashed")
+            msg.nack()
+            self._consecutive_errors += 1
+        finally:
+            self._semaphore.release()
+
+    # -- one request (reference: messenger.go:180-348) --------------------------
+
+    def handle_request(self, msg: Message) -> bool:
+        """Process one message. Returns True when the error throttle should
+        count this message (bad requests included — a malformed flood must
+        back off; reference: messenger.go:148-155)."""
+        metadata: dict = {}
+        try:
+            envelope = json.loads(msg.body)
+            metadata = envelope.get("metadata") or {}
+            path = envelope.get("path") or DEFAULT_PATH
+            if not path.startswith("/"):
+                path = "/" + path
+            body = json.dumps(envelope["body"]).encode()
+        except (json.JSONDecodeError, KeyError, TypeError, AttributeError) as e:
+            return self._reply_error(
+                msg, metadata, 400, f"invalid message envelope: {e}"
+            )
+
+        try:
+            preq = apiutils.parse_request(body, path, {})
+        except apiutils.APIError as e:
+            return self._reply_error(msg, metadata, e.status, e.message)
+
+        try:
+            model = self.model_client.lookup_model(
+                preq.model, preq.adapter, preq.selectors
+            )
+        except (ModelNotFound, AdapterNotFound) as e:
+            return self._reply_error(
+                msg, metadata, 404, f"model not found: {e}"
+            )
+
+        self.metrics.inference_requests_active.inc(model=model.name)
+        self.metrics.inference_requests_total.inc(model=model.name)
+        try:
+            self.model_client.scale_at_least_one_replica(model.name)
+            addr, done = self.lb.await_best_address(
+                model.name,
+                adapter=preq.adapter,
+                prefix=preq.prefix,
+                strategy=model.spec.load_balancing.strategy,
+            )
+            try:
+                status, resp_body = self._http_send(addr, path, preq.body)
+            finally:
+                done()
+        except LoadBalancerTimeout:
+            self._respond(metadata, 503, {"error": {"message": "no endpoints ready"}})
+            msg.nack()
+            return True
+        except Exception as e:
+            msg.nack()
+            logger.warning("backend send failed: %s", e)
+            return True
+        finally:
+            self.metrics.inference_requests_active.dec(model=model.name)
+
+        try:
+            parsed = json.loads(resp_body)
+        except json.JSONDecodeError:
+            parsed = {"raw": resp_body.decode(errors="replace")}
+        if self._respond(metadata, status, parsed):
+            msg.ack()
+            return False
+        msg.nack()  # publish failure → redelivery (reference: messenger.go:308-348)
+        return True
+
+    def _reply_error(
+        self, msg: Message, metadata: dict, status: int, message: str
+    ) -> bool:
+        """Bad-request reply: publish first, ack only if published; always
+        counts toward the throttle."""
+        ok = self._respond(metadata, status, {"error": {"message": message}})
+        if ok:
+            msg.ack()
+        else:
+            msg.nack()
+        return True
+
+    def _respond(self, metadata: dict, status: int, body: dict) -> bool:
+        payload = json.dumps(
+            {"metadata": metadata, "status_code": status, "body": body}
+        ).encode()
+        try:
+            self.broker.publish(self.response_topic, payload)
+            return True
+        except Exception:
+            logger.exception("publishing response failed")
+            return False
+
+    @staticmethod
+    def _default_http_send(addr: str, path: str, body: bytes) -> tuple[int, bytes]:
+        """Plain non-streaming POST (reference: messenger.go:285-306)."""
+        import http.client
+
+        host, _, port = addr.partition(":")
+        conn = http.client.HTTPConnection(host, int(port or 80), timeout=300)
+        try:
+            conn.request(
+                "POST",
+                path,
+                body=body,
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()

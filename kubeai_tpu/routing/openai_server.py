@@ -23,7 +23,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from kubeai_tpu.crd.model import Model
-from kubeai_tpu.metrics.registry import REGISTRY
+from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
 from kubeai_tpu.routing import apiutils
 from kubeai_tpu.routing.modelclient import ModelClient
 from kubeai_tpu.routing.proxy import ModelProxy
@@ -74,9 +74,11 @@ class OpenAIServer:
         model_client: ModelClient,
         host: str = "127.0.0.1",
         port: int = 0,
+        metrics: Metrics = DEFAULT_METRICS,
     ):
         self.proxy = proxy
         self.model_client = model_client
+        self.metrics = metrics
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -101,7 +103,7 @@ class OpenAIServer:
                 if path in ("/openai/v1/models", "/v1/models"):
                     return self._handle_models()
                 if path == "/metrics":
-                    body = REGISTRY.expose().encode()
+                    body = outer.metrics.registry.expose().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain; version=0.0.4")
                     self.send_header("Content-Length", str(len(body)))

@@ -17,10 +17,7 @@ import logging
 from typing import BinaryIO
 
 from kubeai_tpu.crd.model import LB_STRATEGY_PREFIX_HASH
-from kubeai_tpu.metrics import (
-    INFERENCE_REQUESTS_ACTIVE,
-    INFERENCE_REQUESTS_TOTAL,
-)
+from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
 from kubeai_tpu.routing import apiutils
 from kubeai_tpu.routing.loadbalancer import LoadBalancer, LoadBalancerTimeout
 from kubeai_tpu.routing.modelclient import (
@@ -45,9 +42,15 @@ class ProxyResult:
 
 
 class ModelProxy:
-    def __init__(self, lb: LoadBalancer, model_client: ModelClient):
+    def __init__(
+        self,
+        lb: LoadBalancer,
+        model_client: ModelClient,
+        metrics: Metrics = DEFAULT_METRICS,
+    ):
         self.lb = lb
         self.model_client = model_client
+        self.metrics = metrics
 
     def handle(
         self, path: str, body: bytes, headers: dict[str, str]
@@ -67,14 +70,14 @@ class ModelProxy:
         except AdapterNotFound:
             return _error(404, f"adapter not found: {preq.model}_{preq.adapter}")
 
-        INFERENCE_REQUESTS_ACTIVE.inc(model=model.name)
-        INFERENCE_REQUESTS_TOTAL.inc(model=model.name)
+        self.metrics.inference_requests_active.inc(model=model.name)
+        self.metrics.inference_requests_total.inc(model=model.name)
         decremented = [False]
 
         def _done():
             if not decremented[0]:
                 decremented[0] = True
-                INFERENCE_REQUESTS_ACTIVE.dec(model=model.name)
+                self.metrics.inference_requests_active.dec(model=model.name)
 
         try:
             self.model_client.scale_at_least_one_replica(model.name)

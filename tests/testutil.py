@@ -1,0 +1,202 @@
+"""Shared test fakes: scripted engine backends, metrics servers, a fake
+kubelet — the httptest.Server / markAllModelPodsReady equivalents
+(reference: test/integration/utils_test.go)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeEngine:
+    """Scripted engine backend. `behavior(path, body) -> (status, payload)`
+    overrides the default echo response."""
+
+    def __init__(self, behavior=None):
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req_body = self.rfile.read(n)
+                fake.requests.append((self.path, req_body))
+                status, payload = (fake.behavior or fake.default)(
+                    self.path, req_body
+                )
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.requests: list = []
+        self.behavior = behavior
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def default(self, path, body):
+        try:
+            model = json.loads(body).get("model", "?")
+        except json.JSONDecodeError:
+            model = "?"
+        return 200, {
+            "object": "chat.completion",
+            "model": model,
+            "echo": model,
+            "backend": self.port,
+        }
+
+    @property
+    def port(self):
+        return self.httpd.server_address[1]
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class FakeMetricsServer:
+    """Static Prom-text server (reference: hack/vllm-mock-metrics/main.go)."""
+
+    def __init__(self, text: str):
+        srv = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = srv.text.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.text = text
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def addr(self):
+        h, p = self.httpd.server_address[:2]
+        return f"{h}:{p}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def http_post(address: str, path: str, payload: dict, timeout=30):
+    """POST JSON to host:port; returns (status, body_bytes)."""
+    import http.client
+
+    host, _, port = address.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    body = json.dumps(payload).encode()
+    conn.request(
+        "POST", path, body=body, headers={"Content-Type": "application/json"}
+    )
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def http_get(address: str, path: str, timeout=10):
+    import http.client
+
+    host, _, port = address.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def ready_pod_manifest(model: str, index: int, port: int, ip="127.0.0.1") -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"model-{model}-{index}",
+            "namespace": "default",
+            "labels": {"model": model},
+            "annotations": {
+                "model-pod-ip": ip,
+                "model-pod-port": str(port),
+            },
+        },
+        "status": {
+            "conditions": [{"type": "Ready", "status": "True"}],
+            "podIP": ip,
+        },
+    }
+
+
+def mark_model_pods_ready(store, name: str | None = None):
+    """Write Pod status by hand — no kubelet runs in these tests
+    (reference: utils_test.go:118-132)."""
+    selector = {"model": name} if name else None
+    for pod in store.list("Pod", "default", selector):
+        if "model" not in (pod["metadata"].get("labels") or {}):
+            continue
+        status = pod.setdefault("status", {})
+        if any(
+            c.get("type") == "Ready" and c.get("status") == "True"
+            for c in status.get("conditions", [])
+        ):
+            continue
+        status["conditions"] = [
+            {"type": "Ready", "status": "True"},
+            {"type": "PodScheduled", "status": "True"},
+        ]
+        status["podIP"] = "10.0.0.9"
+        try:
+            store.update(pod)
+        except Exception:
+            pass
+
+
+@contextmanager
+def fake_kubelet(store, name: str | None = None, interval: float = 0.05):
+    """Background thread continuously marking model pods ready."""
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            mark_model_pods_ready(store, name)
+            time.sleep(interval)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    try:
+        yield
+    finally:
+        stop.set()
+        t.join(timeout=2)
+
+
+def eventually(fn, timeout=10, interval=0.05, msg="condition"):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            result = fn()
+            if result:
+                return result
+        except Exception as e:
+            last = e
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg} (last error: {last})")

@@ -1,0 +1,300 @@
+"""Autoscaler + leader + state + messenger tests
+(reference suites: test/integration/{autoscaler_state,autoscaling_ha,
+messenger}_test.go)."""
+
+import json
+import time
+
+import pytest
+
+from testutil import FakeMetricsServer
+
+from kubeai_tpu.autoscaler import Autoscaler, LeaderElection, SimpleMovingAverage
+from kubeai_tpu.config import System
+from kubeai_tpu.crd.model import Model, ModelSpec
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.loadbalancer import LoadBalancer
+from kubeai_tpu.routing.messenger import MemBroker, Messenger, Message
+from kubeai_tpu.routing.modelclient import ModelClient
+
+
+def test_moving_average_reaches_exact_zero():
+    avg = SimpleMovingAverage(3)
+    avg.next(9)
+    assert avg.average() == 3
+    avg.next(0), avg.next(0), avg.next(0)
+    assert avg.average() == 0.0  # exact zero -> scale-to-zero works
+
+
+def test_leader_election_single_winner_and_failover():
+    store = KubeStore()
+    a = LeaderElection(store, "pod-a", lease_duration=0.5, retry_period=0.05)
+    b = LeaderElection(store, "pod-b", lease_duration=0.5, retry_period=0.05)
+    a.start(), b.start()
+    time.sleep(0.3)
+    assert a.is_leader != b.is_leader  # exactly one leader
+    leader, follower = (a, b) if a.is_leader else (b, a)
+    leader.stop()  # releases the lease
+    deadline = time.time() + 3
+    while time.time() < deadline and not follower.is_leader:
+        time.sleep(0.05)
+    assert follower.is_leader
+    follower.stop()
+
+
+class AlwaysLeader:
+    is_leader = True
+
+
+def make_world(metric_servers, interval=10, window=600, **model_kw):
+    store = KubeStore()
+    cfg = System()
+    cfg.model_autoscaling.interval_seconds = interval
+    cfg.model_autoscaling.time_window_seconds = window
+    cfg.fixed_self_metric_addrs = [s.addr for s in metric_servers]
+    cfg.default_and_validate()
+    mc = ModelClient(store)
+    lb = LoadBalancer(store)
+    spec = ModelSpec(
+        url="hf://org/x",
+        engine="KubeAITPU",
+        min_replicas=0,
+        max_replicas=10,
+        replicas=0,
+        target_requests=10,
+        scale_down_delay_seconds=0,
+    )
+    for k, v in model_kw.items():
+        setattr(spec, k, v)
+    store.create(Model(name="m1", spec=spec).to_dict())
+    scaler = Autoscaler(store, cfg, mc, lb, AlwaysLeader())
+    return store, cfg, scaler
+
+
+def metrics_text(model: str, active: float) -> str:
+    return (
+        "# TYPE kubeai_inference_requests_active gauge\n"
+        f'kubeai_inference_requests_active{{model="{model}"}} {active}\n'
+    )
+
+
+def test_autoscaler_ha_sums_across_replicas():
+    """3 operator replicas each reporting 25 active -> 75 total -> 8 pods."""
+    servers = [FakeMetricsServer(metrics_text("m1", 25)) for _ in range(3)]
+    try:
+        store, cfg, scaler = make_world(servers, interval=10, window=10)
+        scaler.tick()
+        m = store.get("Model", "default", "m1")
+        assert m["spec"]["replicas"] == 8  # ceil(75/10)
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_autoscaler_moving_window_and_scale_down_hysteresis():
+    srv = FakeMetricsServer(metrics_text("m1", 100))
+    try:
+        store, cfg, scaler = make_world(
+            srv and [srv], interval=10, window=20, scale_down_delay_seconds=20
+        )
+        scaler.tick()  # avg over 2 buckets: (100+0)/2=50 -> 5
+        m = store.get("Model", "default", "m1")
+        assert m["spec"]["replicas"] == 5
+        scaler.tick()  # avg (100+100)/2 = 100 -> 10
+        assert store.get("Model", "default", "m1")["spec"]["replicas"] == 10
+        # Load vanishes: scale-down needs 2 consecutive votes (20s delay / 10s).
+        srv.text = metrics_text("m1", 0)
+        scaler.tick()  # avg 50 -> 5, first vote: suppressed
+        assert store.get("Model", "default", "m1")["spec"]["replicas"] == 10
+        scaler.tick()  # avg 0 -> 0, second vote: applied
+        assert store.get("Model", "default", "m1")["spec"]["replicas"] == 0
+    finally:
+        srv.stop()
+
+
+def test_autoscaler_state_persists_across_restart():
+    """(reference: test/integration/autoscaler_state_test.go)"""
+    srv = FakeMetricsServer(metrics_text("m1", 40))
+    try:
+        store, cfg, scaler = make_world([srv], interval=10, window=40)
+        scaler.tick()
+        cm = store.get("ConfigMap", "default", "kubeai-autoscaler-state")
+        state = json.loads(cm["data"]["state"])
+        assert state["m1"]["average"] == pytest.approx(10.0)
+
+        # "Restart": a new autoscaler against the same store preloads state.
+        mc2 = ModelClient(store)
+        lb2 = LoadBalancer(store)
+        scaler2 = Autoscaler(store, cfg, mc2, lb2, AlwaysLeader())
+        assert scaler2._avg_for("m1").average() == pytest.approx(10.0)
+    finally:
+        srv.stop()
+
+
+def test_autoscaler_skips_disabled_and_respects_max():
+    srv = FakeMetricsServer(metrics_text("m1", 1000))
+    try:
+        store, cfg, scaler = make_world([srv], interval=10, window=10)
+        scaler.tick()
+        assert store.get("Model", "default", "m1")["spec"]["replicas"] == 10  # max
+    finally:
+        srv.stop()
+
+
+def test_scrape_failure_skips_tick():
+    servers = [FakeMetricsServer(metrics_text("m1", 50))]
+    store, cfg, scaler = make_world(servers, interval=10, window=10)
+    servers[0].stop()
+    cfg.fixed_self_metric_addrs = ["127.0.0.1:1"]  # dead addr
+    with pytest.raises(Exception):
+        scaler.tick()
+    # replicas untouched
+    assert store.get("Model", "default", "m1")["spec"]["replicas"] == 0
+
+
+# ---- messenger ----------------------------------------------------------------
+
+
+@pytest.fixture
+def msg_world():
+    store = KubeStore()
+    broker = MemBroker()
+    mc = ModelClient(store)
+    lb = LoadBalancer(store)
+    sent = []
+
+    def fake_send(addr, path, body):
+        sent.append((addr, path, json.loads(body)))
+        return 200, json.dumps({"ok": True, "addr": addr}).encode()
+
+    m = Model(
+        name="m1",
+        spec=ModelSpec(
+            url="hf://org/x", engine="KubeAITPU",
+            min_replicas=0, max_replicas=2, replicas=0,
+        ),
+    )
+    store.create(m.to_dict())
+    msgr = Messenger(
+        broker, "requests", "responses", lb, mc, http_send=fake_send
+    )
+    return store, broker, lb, msgr, sent
+
+
+def _ready_pod(store, lb, name="m1", port=9000):
+    store.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"model-{name}-0",
+                "namespace": "default",
+                "labels": {"model": name},
+                "annotations": {
+                    "model-pod-ip": "127.0.0.1",
+                    "model-pod-port": str(port),
+                },
+            },
+            "status": {
+                "conditions": [{"type": "Ready", "status": "True"}],
+                "podIP": "127.0.0.1",
+            },
+        }
+    )
+    lb.sync_model(name)
+
+
+def test_messenger_roundtrip(msg_world):
+    store, broker, lb, msgr, sent = msg_world
+    _ready_pod(store, lb)
+    msg = Message(
+        json.dumps(
+            {
+                "metadata": {"trace": "t-1"},
+                "path": "/v1/chat/completions",
+                "body": {
+                    "model": "m1",
+                    "messages": [{"role": "user", "content": "hi"}],
+                },
+            }
+        ).encode()
+    )
+    err = msgr.handle_request(msg)
+    assert err is False and msg.acked is True
+    resp = broker.receive("responses", timeout=1)
+    payload = json.loads(resp.body)
+    assert payload["status_code"] == 200
+    assert payload["metadata"]["trace"] == "t-1"
+    assert payload["body"]["ok"] is True
+    assert sent[0][1] == "/v1/chat/completions"
+    # Scale-from-zero happened.
+    assert store.get("Model", "default", "m1")["spec"]["replicas"] == 1
+
+
+def test_messenger_bad_envelope_acked_with_400_and_throttled(msg_world):
+    _, broker, _, msgr, _ = msg_world
+    msg = Message(b"not json")
+    err = msgr.handle_request(msg)
+    # Replied + acked, but COUNTS toward the error throttle so a malformed
+    # flood backs off (reference: messenger.go:148-155).
+    assert err is True and msg.acked is True
+    resp = broker.receive("responses", timeout=1)
+    assert json.loads(resp.body)["status_code"] == 400
+
+
+def test_messenger_missing_path_defaults_and_echoes_metadata(msg_world):
+    store, broker, lb, msgr, sent = msg_world
+    _ready_pod(store, lb)
+    msg = Message(
+        json.dumps(
+            {"metadata": {"id": 7}, "body": {"model": "m1", "prompt": "x"}}
+        ).encode()
+    )
+    msgr.handle_request(msg)
+    assert sent[-1][1] == "/v1/completions"  # defaulted path
+    # Envelope missing "body" still echoes metadata on the 400.
+    bad = Message(json.dumps({"metadata": {"id": 9}, "path": "/v1/x"}).encode())
+    msgr.handle_request(bad)
+    responses = []
+    while True:
+        r = broker.receive("responses", timeout=0.2)
+        if r is None:
+            break
+        responses.append(json.loads(r.body))
+    assert any(
+        p["status_code"] == 400 and p["metadata"] == {"id": 9} for p in responses
+    )
+
+
+def test_messenger_unknown_model_404(msg_world):
+    _, broker, _, msgr, _ = msg_world
+    msg = Message(
+        json.dumps(
+            {"path": "/v1/completions", "body": {"model": "ghost", "prompt": "x"}}
+        ).encode()
+    )
+    err = msgr.handle_request(msg)
+    assert err is True and msg.acked is True  # replied, acked, throttled
+    assert json.loads(broker.receive("responses", timeout=1).body)["status_code"] == 404
+
+
+def test_messenger_receive_loop_end_to_end(msg_world):
+    store, broker, lb, msgr, sent = msg_world
+    _ready_pod(store, lb)
+    msgr.start()
+    try:
+        broker.publish(
+            "requests",
+            json.dumps(
+                {
+                    "metadata": {"id": 42},
+                    "path": "/v1/completions",
+                    "body": {"model": "m1", "prompt": "hello"},
+                }
+            ).encode(),
+        )
+        resp = broker.receive("responses", timeout=5)
+        assert resp is not None
+        assert json.loads(resp.body)["metadata"]["id"] == 42
+    finally:
+        msgr.stop()

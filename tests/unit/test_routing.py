@@ -4,9 +4,10 @@ internal/modelproxy/handler_test.go, internal/apiutils/*_test.go)."""
 import json
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
+
+from testutil import FakeEngine, http_post
 
 from kubeai_tpu.crd.model import Model, ModelSpec, LoadBalancing
 from kubeai_tpu.operator.k8s.store import KubeStore
@@ -200,48 +201,6 @@ def test_group_adapter_filter_blocks_until_adapter_pod():
 # ---- full data path: openai server -> proxy -> fake engine -------------------
 
 
-class FakeEngine:
-    """httptest.Server equivalent: scripted engine backend."""
-
-    def __init__(self, behavior=None):
-        fake = self
-
-        class H(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def log_message(self, *a):
-                pass
-
-            def do_POST(self):
-                n = int(self.headers.get("Content-Length", 0))
-                req_body = self.rfile.read(n)
-                fake.requests.append((self.path, req_body))
-                status, payload = (fake.behavior or fake.default)(self.path, req_body)
-                body = json.dumps(payload).encode()
-                self.send_response(status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-        self.requests: list = []
-        self.behavior = behavior
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
-        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
-
-    def default(self, path, body):
-        model = json.loads(body).get("model", "?")
-        return 200, {"object": "chat.completion", "model": model, "backend": self.port}
-
-    @property
-    def port(self):
-        return self.httpd.server_address[1]
-
-    def stop(self):
-        self.httpd.shutdown()
-        self.httpd.server_close()
-
-
 @pytest.fixture
 def stack():
     """store + LB + proxy + openai server, with one Model backed by fakes."""
@@ -300,17 +259,7 @@ def stack():
 
 
 def _post(server, path, payload):
-    import http.client
-
-    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
-    body = json.dumps(payload).encode()
-    conn.request(
-        "POST", path, body=body, headers={"Content-Type": "application/json"}
-    )
-    resp = conn.getresponse()
-    data = resp.read()
-    conn.close()
-    return resp.status, data
+    return http_post(server.address, path, payload, timeout=10)
 
 
 def test_chat_completion_roundtrip(stack):
@@ -368,15 +317,34 @@ def test_5xx_details_stripped(stack):
 
 
 def test_least_load_spreads_across_backends(stack):
+    """Concurrent in-flight requests must spread by least-load (sequential
+    requests legitimately may all pick one backend: loads are equal)."""
     _, _, server, add_model, engines = stack
     add_model(engines_n=2)
+    for e in engines:
+        orig = e.default
+
+        def slow(path, body, orig=orig):
+            time.sleep(0.3)
+            return orig(path, body)
+
+        e.behavior = slow
     seen = set()
-    for _ in range(10):
+    lock = threading.Lock()
+
+    def call():
         status, data = _post(
             server, "/openai/v1/completions", {"model": "m1", "prompt": "x"}
         )
         assert status == 200
-        seen.add(json.loads(data)["backend"])
+        with lock:
+            seen.add(json.loads(data)["backend"])
+
+    threads = [threading.Thread(target=call) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
     assert len(seen) == 2
 
 
