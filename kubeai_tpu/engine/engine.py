@@ -52,6 +52,10 @@ class EngineConfig:
     # request emits past its stop point within a chunk are discarded
     # host-side; slot rows are independent, so batch-mates are unaffected.
     decode_chunk: int = 8
+    # LoRA hot-swap: number of simultaneously loaded adapters (0 disables
+    # the LoRA path entirely — no extra compute in the compiled graphs).
+    max_adapters: int = 0
+    max_lora_rank: int = 16
 
     def buckets(self) -> tuple[int, ...]:
         if self.prefill_buckets:
@@ -80,6 +84,7 @@ class _Request:
     prompt: list[int]
     params: SamplingParams
     seed: int
+    adapter_idx: int = 0  # 0 = no adapter
     out_tokens: list[int] = dataclasses.field(default_factory=list)
     slot: int = -1
     position: int = 0  # absolute position of the next token to decode
@@ -160,7 +165,24 @@ class Engine:
             "temp": jnp.zeros((B,), jnp.float32),
             "topk": jnp.zeros((B,), jnp.int32),
             "topp": jnp.ones((B,), jnp.float32),
+            "lora_idx": jnp.zeros((B,), jnp.int32),
         }
+
+        # LoRA adapter buffers: fixed shapes, slot 0 = zeros ("no adapter").
+        # Loading an adapter updates a buffer slice — never a recompile.
+        self._lora = None
+        self._adapter_slots: dict[str, int] = {}
+        if cfg.max_adapters > 0:
+            if not hasattr(self.family, "init_lora_buffers"):
+                from kubeai_tpu.models import llama as _llama
+
+                init_fn = _llama.init_lora_buffers
+            else:
+                init_fn = self.family.init_lora_buffers
+            self._lora = init_fn(
+                model_cfg, cfg.max_adapters + 1, cfg.max_lora_rank
+            )
+            self._adapter_free = list(range(1, cfg.max_adapters + 1))
 
         self._build_jits(cache_sharding)
 
@@ -171,16 +193,23 @@ class Engine:
         max_len = self.cfg.max_seq_len
         chunk = max(1, self.cfg.decode_chunk)
 
-        def _prefill_admit(params, tokens, ints, floats, ck, cv, state):
+        def _prefill_admit(params, tokens, ints, floats, ck, cv, state, lora):
             """Fused prefill → cache insert → first-token sample → slot-state
             update: ONE device call per admitted request. `ints` packs
-            [length, slot, seed, top_k]; `floats` packs [temp, top_p] —
-            two small transfers instead of six."""
+            [length, slot, seed, top_k, adapter]; `floats` packs
+            [temp, top_p] — two small transfers instead of seven."""
             length, slot, seed, topk = ints[0], ints[1], ints[2], ints[3]
+            adapter = ints[4]
             temp, topp = floats[0], floats[1]
-            logits, k_all, v_all = fam.prefill(
-                params, mcfg, tokens, length[None]
-            )
+            if lora is None:
+                logits, k_all, v_all = fam.prefill(
+                    params, mcfg, tokens, length[None]
+                )
+            else:
+                logits, k_all, v_all = fam.prefill(
+                    params, mcfg, tokens, length[None],
+                    lora=lora, lora_idx=adapter[None],
+                )
             ck, cv = insert_sequence(ck, cv, k_all[:, 0], v_all[:, 0], slot)
             tok = sample(
                 logits,
@@ -197,6 +226,7 @@ class Engine:
                 temp=state["temp"].at[slot].set(temp),
                 topk=state["topk"].at[slot].set(topk),
                 topp=state["topp"].at[slot].set(topp),
+                lora_idx=state["lora_idx"].at[slot].set(adapter),
             )
             return tok, ck, cv, state
 
@@ -204,9 +234,10 @@ class Engine:
             _prefill_admit,
             donate_argnums=(4, 5, 6),
             out_shardings=(None, cache_sharding, cache_sharding, None),
+            static_argnames=(),
         )
 
-        def _decode_chunk(params, ck, cv, state):
+        def _decode_chunk(params, ck, cv, state, lora):
             """`chunk` decode steps fused via lax.scan; emits [chunk, B]
             tokens per device call. No host inputs besides the (donated,
             device-resident) cache and slot state. Write positions are
@@ -217,9 +248,15 @@ class Engine:
 
             def body(carry, _):
                 tokens, positions, ck, cv = carry
-                logits, ck, cv = fam.decode_step(
-                    params, mcfg, tokens, positions, ck, cv
-                )
+                if lora is None:
+                    logits, ck, cv = fam.decode_step(
+                        params, mcfg, tokens, positions, ck, cv
+                    )
+                else:
+                    logits, ck, cv = fam.decode_step(
+                        params, mcfg, tokens, positions, ck, cv,
+                        lora=lora, lora_idx=state["lora_idx"],
+                    )
                 # Sampled token lands at position+1 — the fold-in value, so
                 # a seeded request replays identically across batches.
                 toks = sample(logits, seeds, positions + 1, temp, topk, topp)
@@ -244,9 +281,19 @@ class Engine:
     # ---- public API ---------------------------------------------------------
 
     def add_request(
-        self, prompt_tokens: list[int], params: SamplingParams | None = None
+        self,
+        prompt_tokens: list[int],
+        params: SamplingParams | None = None,
+        adapter: str | None = None,
     ) -> int:
         params = params or SamplingParams()
+        adapter_idx = 0
+        if adapter:
+            if self._lora is None:
+                raise ValueError("LoRA is disabled (max_adapters=0)")
+            if adapter not in self._adapter_slots:
+                raise KeyError(f"adapter {adapter!r} not loaded")
+            adapter_idx = self._adapter_slots[adapter]
         if len(prompt_tokens) == 0:
             raise ValueError("empty prompt")
         if len(prompt_tokens) >= self.cfg.max_seq_len:
@@ -266,6 +313,7 @@ class Engine:
                 prompt=list(prompt_tokens),
                 params=params,
                 seed=seed,
+                adapter_idx=adapter_idx,
                 stop_token_ids=self.eos_token_ids,
             )
             self._requests[rid] = req
@@ -312,6 +360,7 @@ class Engine:
                             # jit reinterprets it back via astype(uint32).
                             int(np.uint32(req.seed).view(np.int32)),
                             req.params.top_k,
+                            req.adapter_idx,
                         ],
                         jnp.int32,
                     ),
@@ -321,6 +370,7 @@ class Engine:
                     self.cache.k,
                     self.cache.v,
                     self._state,
+                    self._lora,
                 )
             )
             tok = int(tok_dev)
@@ -385,7 +435,8 @@ class Engine:
                 return emitted
             toks_seq, self.cache.k, self.cache.v, self._state = (
                 self._decode_jit(
-                    self.params, self.cache.k, self.cache.v, self._state
+                    self.params, self.cache.k, self.cache.v, self._state,
+                    self._lora,
                 )
             )
             toks_seq = np.asarray(jax.device_get(toks_seq))  # [chunk, B]
@@ -407,13 +458,74 @@ class Engine:
                         self._release(req)
             return emitted
 
+    # ---- LoRA adapter admin (reference: internal/vllmclient/client.go) ------
+
+    def loaded_adapters(self) -> list[str]:
+        return sorted(self._adapter_slots)
+
+    def load_adapter(self, name: str, adapter_weights: dict) -> None:
+        """Install adapter weights into a free buffer slot. Weights:
+        {target: (A [NL, in, r], B [NL, r, out])} with r <= max_lora_rank.
+        Scaling (alpha/r) must already be folded into B."""
+        if self._lora is None:
+            raise ValueError("LoRA is disabled (max_adapters=0)")
+        with self._lock:
+            if name in self._adapter_slots:
+                slot = self._adapter_slots[name]
+            else:
+                if not self._adapter_free:
+                    raise RuntimeError(
+                        f"adapter capacity ({self.cfg.max_adapters}) exhausted"
+                    )
+                slot = self._adapter_free.pop(0)
+            r_max = self.cfg.max_lora_rank
+            for target, (A, B) in adapter_weights.items():
+                if target not in self._lora:
+                    raise KeyError(f"unknown LoRA target {target!r}")
+                A = jnp.asarray(A)
+                B = jnp.asarray(B)
+                r = A.shape[-1]
+                if r > r_max:
+                    raise ValueError(
+                        f"adapter rank {r} > max_lora_rank {r_max}"
+                    )
+                bufA = self._lora[target]["A"]
+                bufB = self._lora[target]["B"]
+                padA = jnp.zeros(bufA.shape[1:], bufA.dtype).at[
+                    ..., :r
+                ].set(A.astype(bufA.dtype))
+                padB = jnp.zeros(bufB.shape[1:], bufB.dtype).at[
+                    :, :r, :
+                ].set(B.astype(bufB.dtype))
+                self._lora[target]["A"] = bufA.at[slot].set(padA)
+                self._lora[target]["B"] = bufB.at[slot].set(padB)
+            self._adapter_slots[name] = slot
+
+    def unload_adapter(self, name: str) -> bool:
+        if self._lora is None or name not in self._adapter_slots:
+            return False
+        with self._lock:
+            slot = self._adapter_slots.pop(name)
+            for target in self._lora:
+                bufA = self._lora[target]["A"]
+                bufB = self._lora[target]["B"]
+                self._lora[target]["A"] = bufA.at[slot].set(
+                    jnp.zeros(bufA.shape[1:], bufA.dtype)
+                )
+                self._lora[target]["B"] = bufB.at[slot].set(
+                    jnp.zeros(bufB.shape[1:], bufB.dtype)
+                )
+            self._adapter_free.append(slot)
+            return True
+
     def generate(
         self,
         prompts: list[list[int]],
         params: SamplingParams | None = None,
+        adapter: str | None = None,
     ) -> list[list[int]]:
         """Blocking batch generation (tests/benchmarks)."""
-        rids = [self.add_request(p, params) for p in prompts]
+        rids = [self.add_request(p, params, adapter=adapter) for p in prompts]
         collected: dict[int, list[int]] = {r: [] for r in rids}
         while self.has_work():
             for ev in self.step():

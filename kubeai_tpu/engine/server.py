@@ -1,0 +1,519 @@
+"""The engine's HTTP serving front — what runs inside a KubeAITPU engine
+Pod (rendered by kubeai_tpu.operator.engines.kubeai_tpu_engine).
+
+Endpoints (OpenAI-compatible surface + the admin seam the operator uses):
+  POST /v1/chat/completions   (stream=true → SSE chunks)
+  POST /v1/completions
+  GET  /v1/models
+  GET  /health                ← readiness/liveness probes
+  GET  /metrics               ← Prometheus text (engine counters)
+  POST /v1/load_lora_adapter  ← operator adapter orchestration
+  POST /v1/unload_lora_adapter   (reference: internal/vllmclient/client.go)
+
+Serving loop: a dedicated thread drives Engine.step() continuously while
+work exists; HTTP handler threads enqueue requests and consume per-request
+token queues (streaming starts on the first decoded chunk).
+
+Run: python -m kubeai_tpu.engine.server --model-url ... [--tpu-topology 2x2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import queue
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubeai_tpu.engine.engine import Engine, EngineConfig
+from kubeai_tpu.engine.sampling import SamplingParams
+from kubeai_tpu.engine.tokenizer import Tokenizer, load_tokenizer
+from kubeai_tpu.metrics.registry import Counter, Gauge, Registry
+
+logger = logging.getLogger(__name__)
+
+
+class EngineMetrics:
+    def __init__(self):
+        self.registry = Registry()
+        self.generated_tokens = Counter(
+            "kubeai_engine_generated_tokens_total",
+            "Tokens generated.",
+            self.registry,
+        )
+        self.prompt_tokens = Counter(
+            "kubeai_engine_prompt_tokens_total",
+            "Prompt tokens processed.",
+            self.registry,
+        )
+        self.active_requests = Gauge(
+            "kubeai_engine_active_requests",
+            "Requests currently queued or decoding.",
+            self.registry,
+        )
+        self.requests_total = Counter(
+            "kubeai_engine_requests_total", "Requests served.", self.registry
+        )
+
+
+class EngineServer:
+    def __init__(
+        self,
+        engine: Engine,
+        tokenizer: Tokenizer,
+        served_model_name: str,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        adapter_fetcher=None,  # (name, url) -> adapter weight tree
+    ):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.served_model_name = served_model_name
+        self.metrics = EngineMetrics()
+        self.adapter_fetcher = adapter_fetcher
+        self._subscribers: dict[int, queue.Queue] = {}
+        self._sub_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._work = threading.Event()
+        self._loop_thread = threading.Thread(target=self._serve_loop, daemon=True)
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, status: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path == "/health":
+                    return self._json(200, {"status": "ok"})
+                if path == "/metrics":
+                    body = outer.metrics.registry.expose().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if path == "/v1/models":
+                    data = [
+                        {
+                            "id": outer.served_model_name,
+                            "object": "model",
+                            "owned_by": "kubeai-tpu",
+                        }
+                    ] + [
+                        {"id": a, "object": "model", "owned_by": "kubeai-tpu"}
+                        for a in outer.engine.loaded_adapters()
+                    ]
+                    return self._json(200, {"object": "list", "data": data})
+                return self._json(404, {"error": {"message": "not found"}})
+
+            def do_POST(self):
+                path = self.path.split("?")[0]
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                raw = self.rfile.read(n) if n else b""
+                try:
+                    body = json.loads(raw or b"{}")
+                except json.JSONDecodeError as e:
+                    return self._json(
+                        400, {"error": {"message": f"bad JSON: {e}"}}
+                    )
+                try:
+                    if path == "/v1/chat/completions":
+                        return outer._handle_generate(self, body, chat=True)
+                    if path == "/v1/completions":
+                        return outer._handle_generate(self, body, chat=False)
+                    if path == "/v1/load_lora_adapter":
+                        return outer._handle_load_adapter(self, body)
+                    if path == "/v1/unload_lora_adapter":
+                        return outer._handle_unload_adapter(self, body)
+                except BrokenPipeError:
+                    raise
+                except Exception as e:
+                    logger.exception("handler error")
+                    return self._json(500, {"error": {"message": str(e)}})
+                return self._json(404, {"error": {"message": "not found"}})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self._http_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> None:
+        self._loop_thread.start()
+        self._http_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._work.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- engine loop -----------------------------------------------------------
+
+    def _serve_loop(self) -> None:
+        while not self._stop.is_set():
+            if not self.engine.has_work():
+                self._work.wait(timeout=0.01)
+                self._work.clear()
+                continue
+            for ev in self.engine.step():
+                with self._sub_lock:
+                    q = self._subscribers.get(ev.rid)
+                if q is not None:
+                    q.put(ev)
+
+    # -- request handling -------------------------------------------------------
+
+    def _resolve_model(self, requested: str) -> tuple[str, str | None]:
+        """Returns (display_name, adapter_or_None). Engines receive the
+        adapter name in the `model` field (the operator's apiutils rewrites
+        it — reference: internal/apiutils/request.go:190-199)."""
+        if requested in self.engine.loaded_adapters():
+            return requested, requested
+        return requested or self.served_model_name, None
+
+    def _handle_generate(self, http, body: dict, chat: bool):
+        model_field = str(body.get("model") or self.served_model_name)
+        display, adapter = self._resolve_model(model_field)
+
+        if chat:
+            messages = body.get("messages") or []
+            prompt_ids = self.tokenizer.apply_chat_template(messages)
+        else:
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, list):
+                prompt = prompt[0] if prompt else ""
+            prompt_ids = self.tokenizer.encode(str(prompt))
+        if not prompt_ids:
+            prompt_ids = [0]
+
+        max_tokens = int(
+            body.get("max_tokens")
+            or body.get("max_completion_tokens")
+            or 128
+        )
+        room = self.engine.cfg.max_seq_len - len(prompt_ids) - 1
+        if room <= 0:
+            return http._json(
+                400,
+                {
+                    "error": {
+                        "message": (
+                            f"prompt too long: {len(prompt_ids)} tokens "
+                            f">= context {self.engine.cfg.max_seq_len}"
+                        )
+                    }
+                },
+            )
+        sp = SamplingParams(
+            temperature=float(body.get("temperature", 1.0)),
+            top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 1.0)),
+            max_tokens=min(max_tokens, room),
+            seed=body.get("seed"),
+            stop=tuple(
+                [body["stop"]] if isinstance(body.get("stop"), str)
+                else body.get("stop") or []
+            ),
+        )
+        stream = bool(body.get("stream", False))
+
+        self.metrics.requests_total.inc(model=display)
+        self.metrics.active_requests.inc()
+        self.metrics.prompt_tokens.inc(len(prompt_ids))
+        sub: queue.Queue = queue.Queue()
+        rid = self.engine.add_request(prompt_ids, sp, adapter=adapter)
+        with self._sub_lock:
+            self._subscribers[rid] = sub
+        self._work.set()
+        try:
+            if stream:
+                self._stream_response(http, rid, sub, sp, display, chat)
+            else:
+                self._unary_response(http, rid, sub, sp, display, chat, len(prompt_ids))
+        finally:
+            with self._sub_lock:
+                self._subscribers.pop(rid, None)
+            self.metrics.active_requests.dec()
+
+    def _collect(self, rid, sub, sp, on_delta=None):
+        """Drain tokens; detokenize incrementally; apply stop strings.
+        Returns (text, finish_reason)."""
+        tokens: list[int] = []
+        emitted_len = 0
+        finish = "length"
+        while True:
+            try:
+                ev = sub.get(timeout=600)
+            except queue.Empty:
+                finish = "timeout"
+                break
+            tokens.append(ev.token)
+            self.metrics.generated_tokens.inc()
+            text = self.tokenizer.decode(tokens)
+            # Stop strings act on detokenized text (engine core is
+            # token-space only; see sampling.SamplingParams docstring).
+            stop_hit = None
+            for s in sp.stop:
+                idx = text.find(s, max(0, emitted_len - len(s)))
+                if idx != -1:
+                    stop_hit = idx
+                    break
+            if stop_hit is not None:
+                if on_delta and stop_hit > emitted_len:
+                    on_delta(text[emitted_len:stop_hit])
+                self.engine.cancel(rid)
+                return text[:stop_hit], "stop"
+            if on_delta and len(text) > emitted_len:
+                # Hold back a partial UTF-8 replacement char at the tail.
+                safe = text[:-1] if text.endswith("�") else text
+                if len(safe) > emitted_len:
+                    on_delta(safe[emitted_len:])
+                    emitted_len = len(safe)
+            if ev.finished:
+                finish = ev.finish_reason or "stop"
+                break
+        text = self.tokenizer.decode(tokens)
+        if on_delta and len(text) > emitted_len:
+            on_delta(text[emitted_len:])
+        return text, finish
+
+    def _unary_response(self, http, rid, sub, sp, display, chat, n_prompt):
+        text, finish = self._collect(rid, sub, sp)
+        created = int(time.time())
+        completion_tokens = len(self.tokenizer.encode(text)) if text else 0
+        usage = {
+            "prompt_tokens": n_prompt,
+            "completion_tokens": completion_tokens,
+            "total_tokens": n_prompt + completion_tokens,
+        }
+        rid_s = f"cmpl-{uuid.uuid4().hex[:24]}"
+        if chat:
+            payload = {
+                "id": rid_s,
+                "object": "chat.completion",
+                "created": created,
+                "model": display,
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {"role": "assistant", "content": text},
+                        "finish_reason": finish,
+                    }
+                ],
+                "usage": usage,
+            }
+        else:
+            payload = {
+                "id": rid_s,
+                "object": "text_completion",
+                "created": created,
+                "model": display,
+                "choices": [
+                    {"index": 0, "text": text, "finish_reason": finish}
+                ],
+                "usage": usage,
+            }
+        http._json(200, payload)
+
+    def _stream_response(self, http, rid, sub, sp, display, chat):
+        http.send_response(200)
+        http.send_header("Content-Type", "text/event-stream")
+        http.send_header("Cache-Control", "no-cache")
+        http.send_header("Transfer-Encoding", "chunked")
+        http.end_headers()
+        rid_s = f"cmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+
+        def send_chunk(obj: dict):
+            data = f"data: {json.dumps(obj)}\n\n".encode()
+            http.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            http.wfile.flush()
+
+        def on_delta(delta_text: str):
+            if chat:
+                choice = {
+                    "index": 0,
+                    "delta": {"content": delta_text},
+                    "finish_reason": None,
+                }
+                obj = {
+                    "id": rid_s,
+                    "object": "chat.completion.chunk",
+                    "created": created,
+                    "model": display,
+                    "choices": [choice],
+                }
+            else:
+                obj = {
+                    "id": rid_s,
+                    "object": "text_completion",
+                    "created": created,
+                    "model": display,
+                    "choices": [
+                        {"index": 0, "text": delta_text, "finish_reason": None}
+                    ],
+                }
+            send_chunk(obj)
+
+        _text, finish = self._collect(rid, sub, sp, on_delta=on_delta)
+        final_choice = (
+            {"index": 0, "delta": {}, "finish_reason": finish}
+            if chat
+            else {"index": 0, "text": "", "finish_reason": finish}
+        )
+        send_chunk(
+            {
+                "id": rid_s,
+                "object": "chat.completion.chunk" if chat else "text_completion",
+                "created": created,
+                "model": display,
+                "choices": [final_choice],
+            }
+        )
+        done = b"data: [DONE]\n\n"
+        http.wfile.write(f"{len(done):x}\r\n".encode() + done + b"\r\n")
+        http.wfile.write(b"0\r\n\r\n")
+        http.wfile.flush()
+
+    # -- adapter admin ----------------------------------------------------------
+
+    def _handle_load_adapter(self, http, body: dict):
+        name = body.get("lora_name")
+        if not name:
+            return http._json(400, {"error": {"message": "lora_name required"}})
+        if name in self.engine.loaded_adapters():
+            return http._json(
+                200, {"status": "already loaded", "lora_name": name}
+            )
+        path_or_url = body.get("lora_path") or body.get("lora_url") or ""
+        try:
+            if self.adapter_fetcher is not None:
+                weights = self.adapter_fetcher(name, path_or_url)
+            else:
+                from kubeai_tpu.engine.lora_weights import load_peft_adapter
+
+                weights = load_peft_adapter(
+                    path_or_url, self.engine.model_cfg,
+                    max_rank=self.engine.cfg.max_lora_rank,
+                )
+            self.engine.load_adapter(name, weights)
+        except Exception as e:
+            logger.exception("adapter load failed")
+            return http._json(400, {"error": {"message": str(e)}})
+        return http._json(200, {"status": "loaded", "lora_name": name})
+
+    def _handle_unload_adapter(self, http, body: dict):
+        name = body.get("lora_name")
+        if not name:
+            return http._json(400, {"error": {"message": "lora_name required"}})
+        if self.engine.unload_adapter(name):
+            return http._json(200, {"status": "unloaded", "lora_name": name})
+        return http._json(404, {"error": {"message": f"adapter {name} not found"}})
+
+
+# ---- process entrypoint ------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="kubeai-tpu-engine")
+    ap.add_argument("--model-url", required=True)
+    ap.add_argument("--served-model-name", default="model")
+    ap.add_argument("--model-dir", default="", help="pre-downloaded cache dir")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--tpu-topology", default="")
+    ap.add_argument("--num-slots", type=int, default=32)
+    ap.add_argument("--max-seq-len", type=int, default=4096)
+    ap.add_argument("--max-adapters", type=int, default=4)
+    ap.add_argument("--decode-chunk", type=int, default=8)
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    log = logging.getLogger("kubeai-tpu-engine")
+
+    from kubeai_tpu.engine.weights import (
+        load_hf_config,
+        load_llama_params,
+        resolve_model_dir,
+    )
+    from kubeai_tpu.models.registry import get_model_family
+    from kubeai_tpu.parallel.mesh import mesh_from_topology, single_device_mesh
+
+    model_dir = resolve_model_dir(args.model_url, args.model_dir)
+    hf_cfg = load_hf_config(model_dir)
+    arch = (hf_cfg.get("architectures") or ["LlamaForCausalLM"])[0]
+    family = get_model_family(arch)
+    model_cfg = family.config_from_hf(hf_cfg)
+    log.info("loading %s (%s) from %s", args.served_model_name, arch, model_dir)
+    params = load_llama_params(model_dir, model_cfg)
+
+    mesh = (
+        mesh_from_topology(args.tpu_topology)
+        if args.tpu_topology
+        else single_device_mesh()
+    )
+    tokenizer = load_tokenizer(model_dir)
+    engine = Engine(
+        family,
+        model_cfg,
+        params,
+        mesh=mesh,
+        cfg=EngineConfig(
+            num_slots=args.num_slots,
+            max_seq_len=args.max_seq_len,
+            max_adapters=args.max_adapters,
+            decode_chunk=args.decode_chunk,
+        ),
+        eos_token_ids=tuple(getattr(tokenizer, "eos_token_ids", ())),
+    )
+    # Warm-up before Ready: compile prefill+decode so the first request
+    # doesn't eat compile time (the reference warms Ollama the same way —
+    # reference: engine_ollama.go:173-213 probe warm-up).
+    engine.generate([[1, 2, 3]], SamplingParams(temperature=0.0, max_tokens=2))
+    log.info("warm-up complete")
+
+    server = EngineServer(
+        engine,
+        tokenizer,
+        args.served_model_name,
+        host=args.host,
+        port=args.port,
+    )
+    server.start()
+    log.info("engine serving on %s:%d", args.host, server.port)
+    try:
+        while True:
+            time.sleep(5)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

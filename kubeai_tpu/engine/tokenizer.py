@@ -1,0 +1,95 @@
+"""Tokenizer seam: HuggingFace tokenizers in production, a byte-level
+fallback for offline tests.
+
+Chat templating follows the tokenizer's own template when present
+(`apply_chat_template`), else a minimal generic template — the engine
+serves /v1/chat/completions either way.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    eos_token_ids: tuple[int, ...]
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: Sequence[int]) -> str: ...
+    def apply_chat_template(self, messages: list[dict]) -> list[int]: ...
+
+
+class ByteTokenizer:
+    """Offline fallback: UTF-8 bytes + 0 as BOS/EOS. Vocab 257."""
+
+    vocab_size = 257
+    eos_token_ids = (256,)
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode(
+            "utf-8", errors="replace"
+        )
+
+    def apply_chat_template(self, messages: list[dict]) -> list[int]:
+        text = _generic_chat_text(messages)
+        return self.encode(text)
+
+
+class HFTokenizer:
+    def __init__(self, model_dir: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(model_dir)
+        eos = self._tok.eos_token_id
+        ids = []
+        if eos is not None:
+            ids.append(int(eos))
+        # Llama-3 end-of-turn token also terminates generation.
+        for special in ("<|eot_id|>", "<|im_end|>", "<|end|>"):
+            try:
+                tid = self._tok.convert_tokens_to_ids(special)
+                if tid is not None and tid >= 0 and tid not in ids:
+                    ids.append(int(tid))
+            except Exception:
+                pass
+        self.eos_token_ids = tuple(ids)
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: list[dict]) -> list[int]:
+        if getattr(self._tok, "chat_template", None):
+            return self._tok.apply_chat_template(
+                messages, add_generation_prompt=True
+            )
+        return self.encode(_generic_chat_text(messages))
+
+
+def _generic_chat_text(messages: list[dict]) -> str:
+    parts = []
+    for m in messages:
+        content = m.get("content", "")
+        if isinstance(content, list):
+            content = " ".join(
+                p.get("text", "") for p in content
+                if isinstance(p, dict) and p.get("type") == "text"
+            )
+        parts.append(f"{m.get('role', 'user')}: {content}")
+    parts.append("assistant:")
+    return "\n".join(parts)
+
+
+def load_tokenizer(model_dir: str | None) -> Tokenizer:
+    if model_dir and os.path.isdir(model_dir):
+        try:
+            return HFTokenizer(model_dir)
+        except Exception:
+            pass
+    return ByteTokenizer()

@@ -158,11 +158,81 @@ def _mlp(x, gate, up, down):
     )
 
 
+# ---- LoRA (hot-swappable, batched) ------------------------------------------
+#
+# Adapter weights live in fixed-shape stacked buffers so loading/unloading an
+# adapter is a buffer update, never a recompile (the hot-swap requirement the
+# reference meets through vLLM's dynamic LoRA API —
+# reference: internal/vllmclient/client.go:30-73, adapters.go:24-118):
+#
+#   A[target]: [n_adapters, NL, E, r_max]    B[target]: [n_adapters, NL, r_max, out]
+#
+# Adapter index 0 is reserved as all-zeros ("no adapter"); per-request
+# adapter selection is a gather over the adapter axis, so one batched decode
+# serves a mix of adapters (punica-style batching, MXU-friendly).
+
+LORA_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def init_lora_buffers(
+    cfg: LlamaConfig, n_adapters: int, max_rank: int, dtype=None
+) -> dict:
+    dtype = dtype or cfg.dtype
+    E, H, KVH, D = (
+        cfg.hidden_size,
+        cfg.num_heads,
+        cfg.num_kv_heads,
+        cfg.head_size,
+    )
+    NL = cfg.num_layers
+    out_dims = {"wq": H * D, "wk": KVH * D, "wv": KVH * D, "wo": E}
+    in_dims = {"wq": E, "wk": E, "wv": E, "wo": H * D}
+    bufs = {}
+    for t in LORA_TARGETS:
+        bufs[t] = {
+            "A": jnp.zeros((n_adapters, NL, in_dims[t], max_rank), dtype),
+            "B": jnp.zeros((n_adapters, NL, max_rank, out_dims[t]), dtype),
+        }
+    return bufs
+
+
+def _lora_delta(x, A, B, idx):
+    """x: [B, S, in] (or [B, in]); A: [n, in, r], B: [n, r, out] for ONE
+    layer; idx: [B] adapter index per row. Returns the low-rank delta."""
+    Ag = A[idx]  # [B, in, r]
+    Bg = B[idx]  # [B, r, out]
+    if x.ndim == 2:
+        xa = jnp.einsum("be,ber->br", x, Ag)
+        return jnp.einsum("br,bro->bo", xa, Bg)
+    xa = jnp.einsum("bse,ber->bsr", x, Ag)
+    return jnp.einsum("bsr,bro->bso", xa, Bg)
+
+
+def _scan_xs(params: dict, lora: dict | None):
+    """Build scan inputs: per-layer params plus (optionally) per-layer LoRA
+    slices. Adapter axis moves behind the layer axis so lax.scan slices
+    layers: [n, NL, ...] -> [NL, n, ...]."""
+    if lora is None:
+        return {"p": params["layers"]}
+    return {
+        "p": params["layers"],
+        "l": {
+            t: {
+                "A": jnp.moveaxis(lora[t]["A"], 1, 0),
+                "B": jnp.moveaxis(lora[t]["B"], 1, 0),
+            }
+            for t in LORA_TARGETS
+        },
+    }
+
+
 def prefill(
     params: dict,
     cfg: LlamaConfig,
     tokens: jnp.ndarray,  # [B, S] int32, right-padded
     lengths: jnp.ndarray,  # [B] true prompt lengths
+    lora: dict | None = None,  # stacked adapter buffers (init_lora_buffers)
+    lora_idx: jnp.ndarray | None = None,  # [B] adapter index (0 = none)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Full-prompt forward. Returns (last_token_logits [B, V],
     k_all [NL, B, S, KVH, D], v_all [NL, B, S, KVH, D]).
@@ -178,20 +248,31 @@ def prefill(
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)
     x = params["embed"][tokens]  # gather: [B, S, E]
 
-    def layer(x, lp):
+    def layer(x, scanned):
+        lp = scanned["p"]
+        lor = scanned.get("l")
+
+        def proj(h, w, target):
+            out = jnp.einsum("bse,eh->bsh", h, w)
+            if lor is not None:
+                out = out + _lora_delta(
+                    h, lor[target]["A"], lor[target]["B"], lora_idx
+                )
+            return out
+
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-        q = jnp.einsum("bse,eh->bsh", h, lp["wq"]).reshape(B, S, H, D)
-        k = jnp.einsum("bse,eh->bsh", h, lp["wk"]).reshape(B, S, KVH, D)
-        v = jnp.einsum("bse,eh->bsh", h, lp["wv"]).reshape(B, S, KVH, D)
+        q = proj(h, lp["wq"], "wq").reshape(B, S, H, D)
+        k = proj(h, lp["wk"], "wk").reshape(B, S, KVH, D)
+        v = proj(h, lp["wv"], "wv").reshape(B, S, KVH, D)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         attn = causal_prefill_attention(q, k, v)
-        x = x + jnp.einsum("bsh,he->bse", attn.reshape(B, S, H * D), lp["wo"])
+        x = x + proj(attn.reshape(B, S, H * D), lp["wo"], "wo")
         h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
         x = x + _mlp(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
         return x, (k, v)
 
-    x, (k_all, v_all) = jax.lax.scan(layer, x, params["layers"])
+    x, (k_all, v_all) = jax.lax.scan(layer, x, _scan_xs(params, lora))
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     # Logits only for each sequence's final real token.
     idx = jnp.clip(lengths - 1, 0, S - 1)
@@ -211,6 +292,8 @@ def decode_step(
     positions: jnp.ndarray,  # [B] absolute position of each token
     k_cache: jnp.ndarray,  # [NL, B, L, KVH, D]
     v_cache: jnp.ndarray,  # [NL, B, L, KVH, D]
+    lora: dict | None = None,  # stacked adapter buffers
+    lora_idx: jnp.ndarray | None = None,  # [B] adapter index per slot
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decode step for every active slot. Writes the new token's KV into
     the cache (functional update) and returns (logits [B, V], k_cache, v_cache).
@@ -227,11 +310,22 @@ def decode_step(
 
     def layer(carry, scanned):
         x = carry
-        lp, kc, vc = scanned
+        lp = scanned["p"]
+        lor = scanned.get("l")
+        kc, vc = scanned["kc"], scanned["vc"]
+
+        def proj(h, w, target):
+            out = jnp.einsum("be,eh->bh", h, w)
+            if lor is not None:
+                out = out + _lora_delta(
+                    h, lor[target]["A"], lor[target]["B"], lora_idx
+                )
+            return out
+
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-        q = jnp.einsum("be,eh->bh", h, lp["wq"]).reshape(B, 1, H, D)
-        k = jnp.einsum("be,eh->bh", h, lp["wk"]).reshape(B, 1, KVH, D)
-        v = jnp.einsum("be,eh->bh", h, lp["wv"]).reshape(B, 1, KVH, D)
+        q = proj(h, lp["wq"], "wq").reshape(B, 1, H, D)
+        k = proj(h, lp["wk"], "wk").reshape(B, 1, KVH, D)
+        v = proj(h, lp["wv"], "wv").reshape(B, 1, KVH, D)
         q = apply_rope(q, pos1, inv_freq)[:, 0]  # [B, H, D]
         k = apply_rope(k, pos1, inv_freq)[:, 0]  # [B, KVH, D]
         v = v[:, 0]
@@ -239,14 +333,15 @@ def decode_step(
         kc = kc.at[slot_idx, positions].set(k.astype(kc.dtype))
         vc = vc.at[slot_idx, positions].set(v.astype(vc.dtype))
         attn = decode_attention(q, kc, vc, lengths)  # [B, H, D]
-        x = x + jnp.einsum("bh,he->be", attn.reshape(B, H * D), lp["wo"])
+        x = x + proj(attn.reshape(B, H * D), lp["wo"], "wo")
         h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
         x = x + _mlp(h2[:, None], lp["w_gate"], lp["w_up"], lp["w_down"])[:, 0]
         return x, (kc, vc)
 
-    x, (k_cache, v_cache) = jax.lax.scan(
-        layer, x, (params["layers"], k_cache, v_cache)
-    )
+    xs = _scan_xs(params, lora)
+    xs["kc"] = k_cache
+    xs["vc"] = v_cache
+    x, (k_cache, v_cache) = jax.lax.scan(layer, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = jnp.einsum(
         "be,ve->bv", x, params["lm_head"],

@@ -1,0 +1,248 @@
+"""Engine HTTP server tests: OpenAI surface, SSE streaming, stop strings,
+adapter admin — driven over a real socket with the offline byte tokenizer."""
+
+import json
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from testutil import http_get, http_post
+
+from kubeai_tpu.engine import Engine, EngineConfig
+from kubeai_tpu.engine.server import EngineServer
+from kubeai_tpu.engine.tokenizer import ByteTokenizer
+from kubeai_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def server():
+    tok = ByteTokenizer()
+    cfg = llama.LlamaConfig.tiny(vocab_size=tok.vocab_size)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(1)
+    r, E, H, D, NL = 4, cfg.hidden_size, cfg.num_heads, cfg.head_size, cfg.num_layers
+    adapter_weights = {
+        "wq": (
+            (rng.standard_normal((NL, E, r)) * 0.5).astype(np.float32),
+            (rng.standard_normal((NL, r, H * D)) * 0.5).astype(np.float32),
+        )
+    }
+    engine = Engine(
+        "llama",
+        cfg,
+        params,
+        cfg=EngineConfig(
+            num_slots=4, max_seq_len=128, max_adapters=2, max_lora_rank=8,
+            decode_chunk=4,
+        ),
+        eos_token_ids=tok.eos_token_ids,
+    )
+    srv = EngineServer(
+        engine,
+        tok,
+        "tiny-llama",
+        host="127.0.0.1",
+        port=0,
+        adapter_fetcher=lambda name, url: adapter_weights,
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def addr(server):
+    return f"127.0.0.1:{server.port}"
+
+
+def test_health_metrics_models(server):
+    assert http_get(addr(server), "/health")[0] == 200
+    status, body = http_get(addr(server), "/metrics")
+    assert status == 200 and b"kubeai_engine" in body
+    status, body = http_get(addr(server), "/v1/models")
+    ids = [m["id"] for m in json.loads(body)["data"]]
+    assert "tiny-llama" in ids
+
+
+def test_completion_roundtrip(server):
+    status, body = http_post(
+        addr(server),
+        "/v1/completions",
+        {"model": "tiny-llama", "prompt": "hello", "max_tokens": 8,
+         "temperature": 0},
+    )
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["object"] == "text_completion"
+    assert payload["choices"][0]["finish_reason"] in ("length", "stop")
+    assert payload["usage"]["prompt_tokens"] == 5
+
+
+def test_chat_completion_roundtrip(server):
+    status, body = http_post(
+        addr(server),
+        "/v1/chat/completions",
+        {
+            "model": "tiny-llama",
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 6,
+            "temperature": 0,
+        },
+    )
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["object"] == "chat.completion"
+    assert payload["choices"][0]["message"]["role"] == "assistant"
+
+
+def test_deterministic_greedy_same_output(server):
+    req = {"model": "tiny-llama", "prompt": "abc", "max_tokens": 8,
+           "temperature": 0}
+    a = json.loads(http_post(addr(server), "/v1/completions", req)[1])
+    b = json.loads(http_post(addr(server), "/v1/completions", req)[1])
+    assert a["choices"][0]["text"] == b["choices"][0]["text"]
+
+
+def test_streaming_sse_matches_unary(server):
+    import http.client
+
+    req = {"model": "tiny-llama", "prompt": "xyz", "max_tokens": 8,
+           "temperature": 0}
+    unary = json.loads(http_post(addr(server), "/v1/completions", req)[1])
+
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+    conn.request(
+        "POST",
+        "/v1/completions",
+        body=json.dumps({**req, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    assert resp.getheader("Content-Type") == "text/event-stream"
+    raw = resp.read().decode()
+    conn.close()
+    events = [
+        json.loads(line[len("data: "):])
+        for line in raw.splitlines()
+        if line.startswith("data: ") and line != "data: [DONE]"
+    ]
+    text = "".join(e["choices"][0]["text"] for e in events)
+    assert text == unary["choices"][0]["text"]
+    assert events[-1]["choices"][0]["finish_reason"] in ("length", "stop")
+    assert "data: [DONE]" in raw
+
+
+def test_stop_string_truncates(server):
+    # Find greedy output first, pick a substring as the stop sequence.
+    base = json.loads(
+        http_post(
+            addr(server),
+            "/v1/completions",
+            {"model": "tiny-llama", "prompt": "qq", "max_tokens": 10,
+             "temperature": 0},
+        )[1]
+    )["choices"][0]["text"]
+    if len(base) < 3:
+        pytest.skip("output too short to carve a stop string")
+    stop = base[1:3]
+    out = json.loads(
+        http_post(
+            addr(server),
+            "/v1/completions",
+            {"model": "tiny-llama", "prompt": "qq", "max_tokens": 10,
+             "temperature": 0, "stop": stop},
+        )[1]
+    )
+    assert out["choices"][0]["finish_reason"] == "stop"
+    assert stop not in out["choices"][0]["text"]
+    assert base.startswith(out["choices"][0]["text"])
+
+
+def test_prompt_too_long_400(server):
+    status, body = http_post(
+        addr(server),
+        "/v1/completions",
+        {"model": "tiny-llama", "prompt": "x" * 300, "max_tokens": 4},
+    )
+    assert status == 400
+    assert b"too long" in body
+
+
+def test_adapter_admin_flow(server):
+    # Load via the admin API (operator seam).
+    status, body = http_post(
+        addr(server),
+        "/v1/load_lora_adapter",
+        {"lora_name": "fin", "lora_url": "hf://org/fin-lora"},
+    )
+    assert status == 200, body
+    # Idempotent re-load.
+    status, body = http_post(
+        addr(server),
+        "/v1/load_lora_adapter",
+        {"lora_name": "fin", "lora_url": "hf://org/fin-lora"},
+    )
+    assert status == 200 and b"already" in body
+
+    # The adapter shows up in /v1/models and serves requests (apiutils puts
+    # the adapter name in the model field).
+    ids = [
+        m["id"]
+        for m in json.loads(http_get(addr(server), "/v1/models")[1])["data"]
+    ]
+    assert "fin" in ids
+    req = {"prompt": "hello", "max_tokens": 6, "temperature": 0}
+    base = json.loads(
+        http_post(addr(server), "/v1/completions",
+                  {**req, "model": "tiny-llama"})[1]
+    )["choices"][0]["text"]
+    fin = json.loads(
+        http_post(addr(server), "/v1/completions", {**req, "model": "fin"})[1]
+    )["choices"][0]["text"]
+    assert fin != base  # adapter changes generation
+
+    # Unload.
+    status, _ = http_post(
+        addr(server), "/v1/unload_lora_adapter", {"lora_name": "fin"}
+    )
+    assert status == 200
+    status, _ = http_post(
+        addr(server), "/v1/unload_lora_adapter", {"lora_name": "fin"}
+    )
+    assert status == 404
+
+
+def test_concurrent_mixed_requests(server):
+    results = {}
+
+    def call(key, prompt):
+        results[key] = json.loads(
+            http_post(
+                addr(server),
+                "/v1/completions",
+                {"model": "tiny-llama", "prompt": prompt, "max_tokens": 6,
+                 "temperature": 0},
+            )[1]
+        )["choices"][0]["text"]
+
+    threads = [
+        threading.Thread(target=call, args=(i, f"prompt-{i}"))
+        for i in range(6)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert len(results) == 6
+    # Each equals its solo greedy run.
+    for i in range(6):
+        solo = json.loads(
+            http_post(
+                addr(server),
+                "/v1/completions",
+                {"model": "tiny-llama", "prompt": f"prompt-{i}",
+                 "max_tokens": 6, "temperature": 0},
+            )[1]
+        )["choices"][0]["text"]
+        assert results[i] == solo
