@@ -34,6 +34,17 @@ from kubeai_tpu.ops.attention import (
 from kubeai_tpu.parallel import sharding as sh
 
 
+def _prefill_attention(q, k, v):
+    """Pick the Pallas flash kernel on TPU for aligned long sequences; the
+    jnp reference path otherwise (CPU tests, short/unaligned shapes)."""
+    S = q.shape[1]
+    if jax.default_backend() == "tpu" and S >= 256 and S % 128 == 0:
+        from kubeai_tpu.ops.pallas_attention import flash_causal_prefill
+
+        return flash_causal_prefill(q, k, v)
+    return causal_prefill_attention(q, k, v)
+
+
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 128256
@@ -266,7 +277,7 @@ def prefill(
         v = proj(h, lp["wv"], "wv").reshape(B, S, KVH, D)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        attn = causal_prefill_attention(q, k, v)
+        attn = _prefill_attention(q, k, v)
         x = x + proj(attn.reshape(B, S, H * D), lp["wo"], "wo")
         h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
         x = x + _mlp(h2, lp["w_gate"], lp["w_up"], lp["w_down"])

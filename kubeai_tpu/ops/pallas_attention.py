@@ -1,0 +1,162 @@
+"""Pallas TPU flash attention for prefill.
+
+Online-softmax attention computed block-by-block so the [S, S] logits
+matrix never materializes in HBM — the prefill hot op for long context.
+Grid: (batch, q-head, q-block); the kernel loops over k-blocks up to the
+causal frontier (skipping fully-masked blocks entirely).
+
+GQA: the q-head grid axis maps each q head onto its kv head (h // group).
+
+Numerics: fp32 accumulation in VMEM scratch; bf16 in/out. Falls back to
+kubeai_tpu.ops.attention.causal_prefill_attention when shapes don't meet
+TPU tiling constraints (head_dim padded to 128 lanes; q/k blocks of 128).
+
+Usage: flash_causal_prefill(q, k, v) — same contract as the jnp reference;
+`interpret=True` runs on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU for interpret mode
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+from kubeai_tpu.ops.attention import causal_prefill_attention
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref,  # [1, 1, BQ, D]
+    k_ref,  # [1, 1, S, D]
+    v_ref,  # [1, 1, S, D]
+    o_ref,  # [1, 1, BQ, D]
+    *,
+    block_q: int,
+    block_k: int,
+    seq_len: int,
+    scale: float,
+):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [BQ, D]
+
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros_like(q)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    # Causal frontier: k blocks strictly after this q block are all masked.
+    num_k = (qi + 1) * block_q // block_k
+    m, l, acc = jax.lax.fori_loop(0, num_k, body, (m, l, acc))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "block_k", "interpret", "scale")
+)
+def _flash_bhsd(
+    q: jnp.ndarray,  # [B, H, S, D]
+    k: jnp.ndarray,  # [B, H, S, D] (kv heads already expanded to H)
+    v: jnp.ndarray,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+    scale: float = 1.0,
+) -> jnp.ndarray:
+    B, H, S, D = q.shape
+    grid = (B, H, S // block_q)
+    kernel = functools.partial(
+        _flash_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        seq_len=S,
+        scale=scale,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)
+            ),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_supported(seq_len: int, head_dim: int, block: int = 128) -> bool:
+    return seq_len % block == 0 and seq_len >= block
+
+
+def flash_causal_prefill(
+    q: jnp.ndarray,  # [B, S, H, D]
+    k: jnp.ndarray,  # [B, S, KVH, D]
+    v: jnp.ndarray,
+    *,
+    block: int = 128,
+    interpret: bool = False,
+    force: bool = False,
+) -> jnp.ndarray:
+    """Flash attention with the causal_prefill_attention contract."""
+    B, S, H, D = q.shape
+    KVH = k.shape[2]
+    if not force and not flash_supported(S, D, block):
+        return causal_prefill_attention(q, k, v)
+
+    group = H // KVH
+    # [B, S, H, D] -> [B, H, S, D]; expand kv heads to H (cheap view-ish;
+    # XLA keeps this fused into the kernel's DMA pattern).
+    qt = jnp.moveaxis(q, 1, 2)
+    kt = jnp.repeat(jnp.moveaxis(k, 1, 2), group, axis=1)
+    vt = jnp.repeat(jnp.moveaxis(v, 1, 2), group, axis=1)
+
+    # Pad head_dim to the 128-lane tile.
+    Dp = max(128, ((D + 127) // 128) * 128)
+    if Dp != D:
+        pad = [(0, 0), (0, 0), (0, 0), (0, Dp - D)]
+        qt, kt, vt = (jnp.pad(x, pad) for x in (qt, kt, vt))
+
+    out = _flash_bhsd(
+        qt, kt, vt, block_q=block, block_k=block, interpret=interpret,
+        scale=D ** -0.5,
+    )
+    if Dp != D:
+        out = out[..., :D]
+    return jnp.moveaxis(out, 1, 2)  # [B, S, H, D]

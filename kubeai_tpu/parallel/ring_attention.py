@@ -1,0 +1,125 @@
+"""Ring attention: causal self-attention with the sequence sharded over the
+`sp` mesh axis — the long-context mechanism the reference lacks entirely
+(SURVEY.md §5.7: "ring attention / context parallel ... absent"; sequence
+length there is just an engine arg, charts/models/values.yaml:117).
+
+Design (blockwise attention + ring K/V rotation — the standard TPU recipe):
+  - each device holds a contiguous sequence shard of q, k, v;
+  - sp_size steps: compute blockwise attention of the LOCAL q shard against
+    the currently-held K/V shard with online-softmax accumulation, then
+    rotate K/V one hop around the ring with `jax.lax.ppermute` (XLA lowers
+    this onto ICI; compute of step i overlaps the DMA of step i+1);
+  - causal masking is by GLOBAL position: a K/V shard entirely in the
+    future contributes nothing (fully masked block), so the mask math
+    handles it without control flow.
+
+Exposed as `ring_causal_attention` (shard_map-ready: operates on the local
+shards inside a mesh context) and `ring_attention_sharded` (wraps
+shard_map over a Mesh for whole-array inputs).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeai_tpu.parallel.mesh import AXIS_SEQ
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_pos, k_pos, scale):
+    """One blockwise attention step with running-softmax stats.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, KVH, D]; positions are global indices.
+    Returns (scores_max [B,H,Sq,1], exp_sums, weighted_values) for online
+    combination.
+    """
+    B, Sq, H, D = q.shape
+    KVH = k.shape[2]
+    qg = (q * scale).reshape(B, Sq, KVH, H // KVH, D)
+    logits = jnp.einsum(
+        "bqkgd,bskd->bkgqs",
+        qg.astype(jnp.float32),
+        k.astype(jnp.float32),
+    )  # [B, KVH, G, Sq, Sk]
+    mask = q_pos[:, None] >= k_pos[None, :]  # [Sq, Sk]
+    logits = jnp.where(mask[None, None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)  # [B, KVH, G, Sq, 1]
+    p = jnp.exp(logits - m)
+    # Fully-masked rows: m = NEG_INF -> p = exp(0) = 1 would pollute; zero
+    # them via the mask instead.
+    p = jnp.where(mask[None, None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bkgqs,bskd->bkgqd", p, v.astype(jnp.float32))
+    return m, l, o
+
+
+def ring_causal_attention(
+    q: jnp.ndarray,  # [B, S_local, H, D] — this device's sequence shard
+    k: jnp.ndarray,  # [B, S_local, KVH, D]
+    v: jnp.ndarray,
+    *,
+    axis_name: str = AXIS_SEQ,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Runs INSIDE shard_map over the sp axis. Returns the local q shard's
+    attention output [B, S_local, H, D]."""
+    B, S, H, D = q.shape
+    KVH = k.shape[2]
+    G = H // KVH
+    scale = scale if scale is not None else D ** -0.5
+    sp = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+
+    q_pos = my * S + jnp.arange(S)
+
+    m_acc = jnp.full((B, KVH, G, S, 1), NEG_INF, jnp.float32)
+    l_acc = jnp.zeros((B, KVH, G, S, 1), jnp.float32)
+    o_acc = jnp.zeros((B, KVH, G, S, D), jnp.float32)
+
+    def step(i, carry):
+        m_acc, l_acc, o_acc, k_cur, v_cur = carry
+        # The shard we hold at step i originated on device (my - i) mod sp.
+        src = (my - i) % sp
+        k_pos = src * S + jnp.arange(S)
+        m, l, o = _block_attend(q, k_cur, v_cur, q_pos, k_pos, scale)
+        m_new = jnp.maximum(m_acc, m)
+        a_old = jnp.exp(m_acc - m_new)
+        a_blk = jnp.exp(m - m_new)
+        l_new = l_acc * a_old + l * a_blk
+        o_new = o_acc * a_old + o * a_blk
+        # Rotate K/V one hop: device d sends to d+1 (ring over ICI).
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return m_new, l_new, o_new, k_nxt, v_nxt
+
+    m_acc, l_acc, o_acc, _, _ = jax.lax.fori_loop(
+        0, sp, step, (m_acc, l_acc, o_acc, k, v)
+    )
+    out = o_acc / jnp.maximum(l_acc, 1e-30)
+    return out.reshape(B, KVH * G, S, D).transpose(0, 2, 1, 3).astype(q.dtype)
+
+def ring_attention_sharded(
+    q: jnp.ndarray,  # [B, S, H, D] global arrays
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    axis_name: str = AXIS_SEQ,
+) -> jnp.ndarray:
+    """Whole-array convenience wrapper: shards the sequence over `axis_name`
+    via shard_map and runs the ring. S must divide by the axis size."""
+    fn = functools.partial(ring_causal_attention, axis_name=axis_name)
+    spec = P(None, axis_name, None, None)
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
