@@ -139,6 +139,8 @@ class EngineServer:
                         return outer._handle_generate(self, body, chat=True)
                     if path == "/v1/completions":
                         return outer._handle_generate(self, body, chat=False)
+                    if path == "/v1/embeddings":
+                        return outer._handle_embeddings(self, body)
                     if path == "/v1/load_lora_adapter":
                         return outer._handle_load_adapter(self, body)
                     if path == "/v1/unload_lora_adapter":
@@ -399,6 +401,72 @@ class EngineServer:
         http.wfile.write(b"0\r\n\r\n")
         http.wfile.flush()
 
+    # -- embeddings (TextEmbedding feature) -------------------------------------
+
+    def _handle_embeddings(self, http, body: dict):
+        fam = self.engine.family
+        if getattr(fam, "hidden_states", None) is None:
+            return http._json(
+                400,
+                {"error": {"message": f"model family {fam.name} has no embedding support"}},
+            )
+        inputs = body.get("input", "")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if not inputs or not all(isinstance(i, str) for i in inputs):
+            return http._json(
+                400, {"error": {"message": "input must be a string or list of strings"}}
+            )
+        import jax.numpy as jnp
+        import numpy as np
+
+        ids = [self.tokenizer.encode(t) or [0] for t in inputs]
+        max_len = self.engine.cfg.max_seq_len
+        if any(len(i) > max_len for i in ids):
+            return http._json(400, {"error": {"message": "input too long"}})
+        bucket = self.engine._bucket(max(len(i) for i in ids))
+        batch = np.zeros((len(ids), bucket), np.int32)
+        for row, i in enumerate(ids):
+            batch[row, : len(i)] = i
+        lengths = jnp.asarray([len(i) for i in ids], jnp.int32)
+        vecs = np.asarray(
+            self._embed_jit(self.engine.params, jnp.asarray(batch), lengths)
+        )
+        total_tokens = int(sum(len(i) for i in ids))
+        self.metrics.prompt_tokens.inc(total_tokens)
+        return http._json(
+            200,
+            {
+                "object": "list",
+                "model": self.served_model_name,
+                "data": [
+                    {
+                        "object": "embedding",
+                        "index": i,
+                        "embedding": [float(x) for x in vecs[i]],
+                    }
+                    for i in range(len(ids))
+                ],
+                "usage": {
+                    "prompt_tokens": total_tokens,
+                    "total_tokens": total_tokens,
+                },
+            },
+        )
+
+    @property
+    def _embed_jit(self):
+        if not hasattr(self, "_embed_jit_cached"):
+            import jax
+
+            fam, mcfg = self.engine.family, self.engine.model_cfg
+            self._embed_jit_cached = jax.jit(
+                lambda params, tokens, lengths: fam.hidden_states(
+                    params, mcfg, tokens, lengths
+                )
+            )
+        return self._embed_jit_cached
+
     # -- adapter admin ----------------------------------------------------------
 
     def _handle_load_adapter(self, http, body: dict):
@@ -469,7 +537,34 @@ def main(argv=None) -> int:
     family = get_model_family(arch)
     model_cfg = family.config_from_hf(hf_cfg)
     log.info("loading %s (%s) from %s", args.served_model_name, arch, model_dir)
-    params = load_llama_params(model_dir, model_cfg)
+
+    if family.feature == "SpeechToText":
+        from kubeai_tpu.engine.weights import load_params
+        from kubeai_tpu.engine.whisper_server import TranscriptionServer
+
+        params = load_params(family.name, model_dir, model_cfg)
+        try:
+            from transformers import AutoTokenizer
+
+            wtok = AutoTokenizer.from_pretrained(model_dir)
+        except Exception:
+            wtok = None
+        tserver = TranscriptionServer(
+            params, model_cfg, args.served_model_name,
+            tokenizer=wtok, host=args.host, port=args.port,
+        )
+        tserver.start()
+        log.info("transcription engine serving on %s:%d", args.host, tserver.port)
+        try:
+            while True:
+                time.sleep(5)
+        except KeyboardInterrupt:
+            tserver.stop()
+        return 0
+
+    from kubeai_tpu.engine.weights import load_params as _load_params
+
+    params = _load_params(family.name, model_dir, model_cfg)
 
     mesh = (
         mesh_from_topology(args.tpu_topology)

@@ -133,6 +133,13 @@ def load_llama_params(model_dir: str, cfg, dtype=jnp.bfloat16) -> dict:
             arrs.append(a.T if transpose else a)
         return jnp.asarray(np.stack(arrs), dtype)
 
+    extra_layers = {}
+    if getattr(cfg, "attention_bias", False):
+        extra_layers = {
+            "bq": stack("model.layers.{i}.self_attn.q_proj.bias", transpose=False),
+            "bk": stack("model.layers.{i}.self_attn.k_proj.bias", transpose=False),
+            "bv": stack("model.layers.{i}.self_attn.v_proj.bias", transpose=False),
+        }
     embed = get("model.embed_tokens.weight")
     params = {
         "embed": jnp.asarray(embed, dtype),
@@ -151,6 +158,7 @@ def load_llama_params(model_dir: str, cfg, dtype=jnp.bfloat16) -> dict:
             "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight"),
             "w_up": stack("model.layers.{i}.mlp.up_proj.weight"),
             "w_down": stack("model.layers.{i}.mlp.down_proj.weight"),
+            **extra_layers,
         },
         "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
     }
@@ -187,3 +195,194 @@ def resolve_model_dir(model_url: str, model_dir: str = "") -> str:
     if os.path.isdir(model_url):
         return model_url
     raise WeightLoadError(f"unsupported model url {model_url!r}")
+
+
+def load_gemma_params(model_dir: str, cfg, dtype=jnp.bfloat16) -> dict:
+    """HF Gemma/Gemma2 checkpoint → kubeai_tpu.models.gemma layout."""
+    t = _open_checkpoint_tensors(model_dir)
+    NL = cfg.num_layers
+
+    def get(name):
+        if name not in t:
+            raise WeightLoadError(f"missing tensor {name}")
+        return np.asarray(t[name], np.float32)
+
+    def stack(fmt, transpose=True):
+        return jnp.asarray(
+            np.stack([
+                get(fmt.format(i=i)).T if transpose else get(fmt.format(i=i))
+                for i in range(NL)
+            ]),
+            dtype,
+        )
+
+    layers = {
+        "input_norm": stack("model.layers.{i}.input_layernorm.weight", False),
+        "wq": stack("model.layers.{i}.self_attn.q_proj.weight"),
+        "wk": stack("model.layers.{i}.self_attn.k_proj.weight"),
+        "wv": stack("model.layers.{i}.self_attn.v_proj.weight"),
+        "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
+        "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight"),
+        "w_up": stack("model.layers.{i}.mlp.up_proj.weight"),
+        "w_down": stack("model.layers.{i}.mlp.down_proj.weight"),
+    }
+    if cfg.sandwich_norms:  # gemma2 naming
+        layers["post_attn_norm"] = stack(
+            "model.layers.{i}.post_attention_layernorm.weight", False
+        )
+        layers["pre_mlp_norm"] = stack(
+            "model.layers.{i}.pre_feedforward_layernorm.weight", False
+        )
+        layers["post_mlp_norm"] = stack(
+            "model.layers.{i}.post_feedforward_layernorm.weight", False
+        )
+    else:  # gemma1: post_attention_layernorm IS the pre-MLP norm
+        layers["pre_mlp_norm"] = stack(
+            "model.layers.{i}.post_attention_layernorm.weight", False
+        )
+    return {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype),
+        "layers": layers,
+        "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
+    }
+
+
+def load_mixtral_params(model_dir: str, cfg, dtype=jnp.bfloat16) -> dict:
+    """HF Mixtral checkpoint → kubeai_tpu.models.mixtral layout
+    (experts stacked: w1=gate, w3=up, w2=down)."""
+    t = _open_checkpoint_tensors(model_dir)
+    NL, X = cfg.num_layers, cfg.num_experts
+
+    def get(name):
+        if name not in t:
+            raise WeightLoadError(f"missing tensor {name}")
+        return np.asarray(t[name], np.float32)
+
+    def stack(fmt, transpose=True):
+        return jnp.asarray(
+            np.stack([
+                get(fmt.format(i=i)).T if transpose else get(fmt.format(i=i))
+                for i in range(NL)
+            ]),
+            dtype,
+        )
+
+    def stack_experts(w_name):
+        out = []
+        for i in range(NL):
+            per_layer = [
+                get(
+                    f"model.layers.{i}.block_sparse_moe.experts.{e}.{w_name}.weight"
+                ).T
+                for e in range(X)
+            ]
+            out.append(np.stack(per_layer))
+        return jnp.asarray(np.stack(out), dtype)  # [NL, X, in, out]
+
+    return {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype),
+        "layers": {
+            "input_norm": stack("model.layers.{i}.input_layernorm.weight", False),
+            "wq": stack("model.layers.{i}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{i}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{i}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{i}.self_attn.o_proj.weight"),
+            "post_attn_norm": stack(
+                "model.layers.{i}.post_attention_layernorm.weight", False
+            ),
+            "router": stack("model.layers.{i}.block_sparse_moe.gate.weight"),
+            "w_gate": stack_experts("w1"),
+            "w_up": stack_experts("w3"),
+            "w_down": stack_experts("w2"),
+        },
+        "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
+        "lm_head": jnp.asarray(get("lm_head.weight"), dtype),
+    }
+
+
+_LOADERS = {
+    "llama": load_llama_params,
+    "qwen": load_llama_params,  # same layout + biases (attention_bias)
+    "gemma": load_gemma_params,
+    "mixtral": load_mixtral_params,
+}
+
+
+def load_params(family_name: str, model_dir: str, cfg, dtype=jnp.bfloat16):
+    """Family-dispatching checkpoint loader."""
+    if family_name not in _LOADERS:
+        raise WeightLoadError(f"no weight loader for family {family_name!r}")
+    return _LOADERS[family_name](model_dir, cfg, dtype)
+
+
+def load_whisper_params(model_dir: str, cfg, dtype=jnp.float32) -> dict:
+    """HF WhisperForConditionalGeneration → kubeai_tpu.models.whisper layout."""
+    t = _open_checkpoint_tensors(model_dir)
+
+    def get(name):
+        if name not in t:
+            raise WeightLoadError(f"missing tensor {name}")
+        return np.asarray(t[name], np.float32)
+
+    def j(a):
+        return jnp.asarray(a, dtype)
+
+    def attn(prefix):
+        return {
+            "wq": j(get(f"{prefix}.q_proj.weight").T),
+            "bq": j(get(f"{prefix}.q_proj.bias")),
+            "wk": j(get(f"{prefix}.k_proj.weight").T),
+            "wv": j(get(f"{prefix}.v_proj.weight").T),
+            "bv": j(get(f"{prefix}.v_proj.bias")),
+            "wo": j(get(f"{prefix}.out_proj.weight").T),
+            "bo": j(get(f"{prefix}.out_proj.bias")),
+        }
+
+    def ln(name):
+        return {"w": j(get(f"{name}.weight")), "b": j(get(f"{name}.bias"))}
+
+    def ffn(prefix):
+        return {
+            "w1": j(get(f"{prefix}.fc1.weight").T),
+            "b1": j(get(f"{prefix}.fc1.bias")),
+            "w2": j(get(f"{prefix}.fc2.weight").T),
+            "b2": j(get(f"{prefix}.fc2.bias")),
+        }
+
+    enc_layers = [
+        {
+            "ln1": ln(f"model.encoder.layers.{i}.self_attn_layer_norm"),
+            "attn": attn(f"model.encoder.layers.{i}.self_attn"),
+            "ln2": ln(f"model.encoder.layers.{i}.final_layer_norm"),
+            "ffn": ffn(f"model.encoder.layers.{i}"),
+        }
+        for i in range(cfg.encoder_layers)
+    ]
+    dec_layers = [
+        {
+            "ln1": ln(f"model.decoder.layers.{i}.self_attn_layer_norm"),
+            "self_attn": attn(f"model.decoder.layers.{i}.self_attn"),
+            "ln2": ln(f"model.decoder.layers.{i}.encoder_attn_layer_norm"),
+            "cross_attn": attn(f"model.decoder.layers.{i}.encoder_attn"),
+            "ln3": ln(f"model.decoder.layers.{i}.final_layer_norm"),
+            "ffn": ffn(f"model.decoder.layers.{i}"),
+        }
+        for i in range(cfg.decoder_layers)
+    ]
+    return {
+        # torch conv1d weight [out, in, k] -> [k, in, out]
+        "conv1_w": j(get("model.encoder.conv1.weight").transpose(2, 1, 0)),
+        "conv1_b": j(get("model.encoder.conv1.bias")),
+        "conv2_w": j(get("model.encoder.conv2.weight").transpose(2, 1, 0)),
+        "conv2_b": j(get("model.encoder.conv2.bias")),
+        "enc_pos": j(get("model.encoder.embed_positions.weight")),
+        "enc_layers": enc_layers,
+        "enc_ln": ln("model.encoder.layer_norm"),
+        "dec_embed": j(get("model.decoder.embed_tokens.weight")),
+        "dec_pos": j(get("model.decoder.embed_positions.weight")),
+        "dec_layers": dec_layers,
+        "dec_ln": ln("model.decoder.layer_norm"),
+    }
+
+
+_LOADERS["whisper"] = load_whisper_params

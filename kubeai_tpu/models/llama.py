@@ -59,6 +59,7 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     max_position_embeddings: int = 131072
     tie_word_embeddings: bool = False
+    attention_bias: bool = False  # Qwen2-style q/k/v biases
     dtype: Any = jnp.bfloat16
 
     @property
@@ -81,6 +82,12 @@ class LlamaConfig:
             rms_norm_eps=d.get("rms_norm_eps", 1e-5),
             max_position_embeddings=d.get("max_position_embeddings", 8192),
             tie_word_embeddings=d.get("tie_word_embeddings", False),
+            # Qwen2 always uses qkv biases; HF exposes attention_bias on
+            # both configs (Qwen2 defaults true, Llama false).
+            attention_bias=d.get(
+                "attention_bias",
+                d.get("model_type") == "qwen2",
+            ),
         )
 
     @staticmethod
@@ -101,19 +108,24 @@ class LlamaConfig:
 def param_specs(cfg: LlamaConfig) -> dict:
     """Logical sharding axes per parameter (leading None = stacked layers)."""
     L = None  # layer axis: replicated across the mesh
+    layers = {
+        "input_norm": (L, sh.EMBED),
+        "wq": (L, sh.EMBED, sh.HEADS),
+        "wk": (L, sh.EMBED, sh.KV_HEADS),
+        "wv": (L, sh.EMBED, sh.KV_HEADS),
+        "wo": (L, sh.HEADS, sh.EMBED),
+        "post_attn_norm": (L, sh.EMBED),
+        "w_gate": (L, sh.EMBED, sh.MLP),
+        "w_up": (L, sh.EMBED, sh.MLP),
+        "w_down": (L, sh.MLP, sh.EMBED),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = (L, sh.HEADS)
+        layers["bk"] = (L, sh.KV_HEADS)
+        layers["bv"] = (L, sh.KV_HEADS)
     return {
         "embed": (sh.VOCAB, sh.EMBED),
-        "layers": {
-            "input_norm": (L, sh.EMBED),
-            "wq": (L, sh.EMBED, sh.HEADS),
-            "wk": (L, sh.EMBED, sh.KV_HEADS),
-            "wv": (L, sh.EMBED, sh.KV_HEADS),
-            "wo": (L, sh.HEADS, sh.EMBED),
-            "post_attn_norm": (L, sh.EMBED),
-            "w_gate": (L, sh.EMBED, sh.MLP),
-            "w_up": (L, sh.EMBED, sh.MLP),
-            "w_down": (L, sh.MLP, sh.EMBED),
-        },
+        "layers": layers,
         "final_norm": (sh.EMBED,),
         "lm_head": (sh.VOCAB, sh.EMBED),
     }
@@ -140,19 +152,24 @@ def init_params(cfg: LlamaConfig, key: jax.Array | None = None) -> dict:
     def rnd(k, shape):
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
 
+    layers = {
+        "input_norm": jnp.ones((NL, E), dt),
+        "wq": rnd(ks[1], (NL, E, H * D)),
+        "wk": rnd(ks[2], (NL, E, KVH * D)),
+        "wv": rnd(ks[3], (NL, E, KVH * D)),
+        "wo": rnd(ks[4], (NL, H * D, E)),
+        "post_attn_norm": jnp.ones((NL, E), dt),
+        "w_gate": rnd(ks[5], (NL, E, M)),
+        "w_up": rnd(ks[6], (NL, E, M)),
+        "w_down": rnd(ks[7], (NL, M, E)),
+    }
+    if cfg.attention_bias:
+        layers["bq"] = rnd(ks[9], (NL, H * D))
+        layers["bk"] = jnp.zeros((NL, KVH * D), dt)
+        layers["bv"] = jnp.zeros((NL, KVH * D), dt)
     params = {
         "embed": rnd(ks[0], (V, E)),
-        "layers": {
-            "input_norm": jnp.ones((NL, E), dt),
-            "wq": rnd(ks[1], (NL, E, H * D)),
-            "wk": rnd(ks[2], (NL, E, KVH * D)),
-            "wv": rnd(ks[3], (NL, E, KVH * D)),
-            "wo": rnd(ks[4], (NL, H * D, E)),
-            "post_attn_norm": jnp.ones((NL, E), dt),
-            "w_gate": rnd(ks[5], (NL, E, M)),
-            "w_up": rnd(ks[6], (NL, E, M)),
-            "w_down": rnd(ks[7], (NL, M, E)),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((E,), dt),
         "lm_head": rnd(ks[8], (V, E)),
     }
@@ -263,8 +280,10 @@ def prefill(
         lp = scanned["p"]
         lor = scanned.get("l")
 
-        def proj(h, w, target):
+        def proj(h, w, target, bias=None):
             out = jnp.einsum("bse,eh->bsh", h, w)
+            if bias is not None:
+                out = out + bias
             if lor is not None:
                 out = out + _lora_delta(
                     h, lor[target]["A"], lor[target]["B"], lora_idx
@@ -272,9 +291,9 @@ def prefill(
             return out
 
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-        q = proj(h, lp["wq"], "wq").reshape(B, S, H, D)
-        k = proj(h, lp["wk"], "wk").reshape(B, S, KVH, D)
-        v = proj(h, lp["wv"], "wv").reshape(B, S, KVH, D)
+        q = proj(h, lp["wq"], "wq", lp.get("bq")).reshape(B, S, H, D)
+        k = proj(h, lp["wk"], "wk", lp.get("bk")).reshape(B, S, KVH, D)
+        v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, S, KVH, D)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         attn = _prefill_attention(q, k, v)
@@ -325,8 +344,10 @@ def decode_step(
         lor = scanned.get("l")
         kc, vc = scanned["kc"], scanned["vc"]
 
-        def proj(h, w, target):
+        def proj(h, w, target, bias=None):
             out = jnp.einsum("be,eh->bh", h, w)
+            if bias is not None:
+                out = out + bias
             if lor is not None:
                 out = out + _lora_delta(
                     h, lor[target]["A"], lor[target]["B"], lora_idx
@@ -334,9 +355,9 @@ def decode_step(
             return out
 
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-        q = proj(h, lp["wq"], "wq").reshape(B, 1, H, D)
-        k = proj(h, lp["wk"], "wk").reshape(B, 1, KVH, D)
-        v = proj(h, lp["wv"], "wv").reshape(B, 1, KVH, D)
+        q = proj(h, lp["wq"], "wq", lp.get("bq")).reshape(B, 1, H, D)
+        k = proj(h, lp["wk"], "wk", lp.get("bk")).reshape(B, 1, KVH, D)
+        v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, 1, KVH, D)
         q = apply_rope(q, pos1, inv_freq)[:, 0]  # [B, H, D]
         k = apply_rope(k, pos1, inv_freq)[:, 0]  # [B, KVH, D]
         v = v[:, 0]
@@ -359,3 +380,54 @@ def decode_step(
         preferred_element_type=jnp.float32,
     )
     return logits, k_cache, v_cache
+
+
+def _trunk(params: dict, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Transformer trunk: [B, S] tokens -> [B, S, E] final hidden states."""
+    B, S = tokens.shape
+    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    inv_freq = jnp.asarray(rope_frequencies(D, cfg.rope_theta, cfg.rope_scaling))
+    positions = jnp.arange(S)[None, :].repeat(B, axis=0)
+    x = params["embed"][tokens]
+
+    def layer(x, lp):
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q = jnp.einsum("bse,eh->bsh", h, lp["wq"])
+        if "bq" in lp:
+            q = q + lp["bq"]
+        k = jnp.einsum("bse,eh->bsh", h, lp["wk"])
+        if "bk" in lp:
+            k = k + lp["bk"]
+        v = jnp.einsum("bse,eh->bsh", h, lp["wv"])
+        if "bv" in lp:
+            v = v + lp["bv"]
+        q = apply_rope(q.reshape(B, S, H, D), positions, inv_freq)
+        k = apply_rope(k.reshape(B, S, KVH, D), positions, inv_freq)
+        attn = _prefill_attention(q, k, v.reshape(B, S, KVH, D))
+        x = x + jnp.einsum("bsh,he->bse", attn.reshape(B, S, H * D), lp["wo"])
+        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+
+
+def hidden_states(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [B, S] right-padded
+    lengths: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    """Mean-pooled, L2-normalized embeddings [B, E] — the TextEmbedding
+    feature (the reference delegates embeddings to Infinity Pods,
+    reference: internal/modelcontroller/engine_infinity.go; here any causal
+    model doubles as an embedder)."""
+    x = _trunk(params, cfg, tokens)  # [B, S, E]
+    S = tokens.shape[1]
+    mask = (jnp.arange(S)[None, :] < lengths[:, None]).astype(jnp.float32)
+    summed = jnp.einsum("bse,bs->be", x.astype(jnp.float32), mask)
+    pooled = summed / jnp.maximum(lengths[:, None].astype(jnp.float32), 1.0)
+    return pooled / jnp.maximum(
+        jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+    )

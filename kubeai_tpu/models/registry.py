@@ -8,6 +8,7 @@ here model *code* is selected by architecture, since the engine is in-tree.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable
 
 _FAMILIES: dict[str, "ModelFamily"] = {}
@@ -28,7 +29,9 @@ class ModelFamily:
         decode_step: Callable,
         hf_architectures: tuple[str, ...] = (),
         feature: str = "TextGeneration",
+        hidden_states=None,
     ):
+        self.hidden_states = hidden_states
         self.name = name
         self.config_from_hf = config_from_hf
         self.tiny_config = tiny_config
@@ -74,11 +77,44 @@ def _ensure_builtin() -> None:
             param_specs=llama.param_specs,
             prefill=llama.prefill,
             decode_step=llama.decode_step,
-            hf_architectures=("LlamaForCausalLM",),
+            hf_architectures=("LlamaForCausalLM", "MistralForCausalLM"),
+            hidden_states=llama.hidden_states,
         )
     )
-    # Further families (gemma, qwen, mixtral, …) self-register on import.
-    for mod in ("gemma", "qwen", "mixtral"):
+    # Qwen2 is the Llama computation plus q/k/v biases — one implementation,
+    # config-driven (attention_bias=True via from_hf_dict model_type).
+    register_model_family(
+        ModelFamily(
+            "qwen",
+            config_from_hf=llama.LlamaConfig.from_hf_dict,
+            tiny_config=lambda: dataclasses.replace(
+                llama.LlamaConfig.tiny(), attention_bias=True
+            ),
+            init_params=llama.init_params,
+            param_specs=llama.param_specs,
+            prefill=llama.prefill,
+            decode_step=llama.decode_step,
+            hf_architectures=("Qwen2ForCausalLM",),
+            hidden_states=llama.hidden_states,
+        )
+    )
+    from kubeai_tpu.models import whisper
+
+    register_model_family(
+        ModelFamily(
+            "whisper",
+            config_from_hf=whisper.WhisperConfig.from_hf_dict,
+            tiny_config=whisper.WhisperConfig.tiny,
+            init_params=whisper.init_params,
+            param_specs=lambda cfg: None,  # replicated (encoder-decoder)
+            prefill=None,  # served via TranscriptionServer, not the slot engine
+            decode_step=None,
+            hf_architectures=("WhisperForConditionalGeneration",),
+            feature="SpeechToText",
+        )
+    )
+    # Further families (gemma, mixtral, …) self-register on import.
+    for mod in ("gemma", "mixtral"):
         try:
             __import__(f"kubeai_tpu.models.{mod}")
         except ImportError:

@@ -1,0 +1,314 @@
+"""Whisper encoder-decoder — the SpeechToText feature.
+
+The reference serves speech via FasterWhisper Pods (reference:
+internal/modelcontroller/engine_fasterwhisper.go); here transcription is
+native: log-mel frontend (numpy), conv-downsampled transformer encoder,
+causal decoder with cross-attention, greedy loop under jit.
+
+Whisper's decoder is encoder-conditioned and transcription traffic is not
+token-streamed at high QPS, so it uses its own compact generate loop
+(jitted per step with static shapes) rather than the slot engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class WhisperConfig:
+    vocab_size: int = 51865
+    num_mel_bins: int = 80
+    d_model: int = 384
+    encoder_layers: int = 4
+    encoder_heads: int = 6
+    decoder_layers: int = 4
+    decoder_heads: int = 6
+    ffn_dim: int = 1536
+    max_source_positions: int = 1500
+    max_target_positions: int = 448
+    decoder_start_token_id: int = 50258
+    eos_token_id: int = 50257
+    dtype: Any = jnp.float32
+
+    @staticmethod
+    def from_hf_dict(d: dict) -> "WhisperConfig":
+        return WhisperConfig(
+            vocab_size=d["vocab_size"],
+            num_mel_bins=d.get("num_mel_bins", 80),
+            d_model=d["d_model"],
+            encoder_layers=d["encoder_layers"],
+            encoder_heads=d["encoder_attention_heads"],
+            decoder_layers=d["decoder_layers"],
+            decoder_heads=d["decoder_attention_heads"],
+            ffn_dim=d.get("encoder_ffn_dim", 4 * d["d_model"]),
+            max_source_positions=d.get("max_source_positions", 1500),
+            max_target_positions=d.get("max_target_positions", 448),
+            decoder_start_token_id=d.get("decoder_start_token_id", 50258),
+            eos_token_id=d.get("eos_token_id", 50257),
+        )
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "WhisperConfig":
+        return WhisperConfig(
+            vocab_size=vocab_size,
+            num_mel_bins=16,
+            d_model=32,
+            encoder_layers=2,
+            encoder_heads=2,
+            decoder_layers=2,
+            decoder_heads=2,
+            ffn_dim=64,
+            max_source_positions=50,
+            max_target_positions=32,
+            decoder_start_token_id=1,
+            eos_token_id=2,
+        )
+
+
+# ---- audio frontend ---------------------------------------------------------
+
+SAMPLE_RATE = 16000
+N_FFT = 400
+HOP = 160
+
+
+def _mel_filterbank(n_mels: int, n_fft: int = N_FFT, sr: int = SAMPLE_RATE):
+    """Slaney-style mel filterbank (numpy, no deps)."""
+    fmin, fmax = 0.0, sr / 2
+    def hz_to_mel(f):
+        return 2595.0 * np.log10(1.0 + f / 700.0)
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    mels = np.linspace(hz_to_mel(fmin), hz_to_mel(fmax), n_mels + 2)
+    freqs = mel_to_hz(mels)
+    bins = np.floor((n_fft + 1) * freqs / sr).astype(int)
+    fb = np.zeros((n_mels, n_fft // 2 + 1))
+    for i in range(n_mels):
+        lo, c, hi = bins[i], bins[i + 1], bins[i + 2]
+        if c > lo:
+            fb[i, lo:c] = (np.arange(lo, c) - lo) / (c - lo)
+        if hi > c:
+            fb[i, c:hi] = (hi - np.arange(c, hi)) / (hi - c)
+    return fb
+
+
+def log_mel_spectrogram(
+    audio: np.ndarray, n_mels: int = 80, max_frames: int | None = None
+) -> np.ndarray:
+    """float32 PCM [-1, 1] @ 16 kHz -> [n_mels, T] log-mel features."""
+    window = np.hanning(N_FFT + 1)[:-1]
+    n = len(audio)
+    frames = max(1, 1 + (n - N_FFT) // HOP) if n >= N_FFT else 1
+    padded = np.pad(audio, (0, max(0, N_FFT + frames * HOP - n)))
+    stft = np.stack(
+        [
+            np.fft.rfft(padded[i * HOP : i * HOP + N_FFT] * window)
+            for i in range(frames)
+        ],
+        axis=1,
+    )
+    power = np.abs(stft) ** 2
+    mel = _mel_filterbank(n_mels) @ power
+    log_spec = np.log10(np.maximum(mel, 1e-10))
+    log_spec = np.maximum(log_spec, log_spec.max() - 8.0)
+    log_spec = (log_spec + 4.0) / 4.0
+    if max_frames is not None:
+        if log_spec.shape[1] < max_frames:
+            log_spec = np.pad(
+                log_spec, ((0, 0), (0, max_frames - log_spec.shape[1]))
+            )
+        else:
+            log_spec = log_spec[:, :max_frames]
+    return log_spec.astype(np.float32)
+
+
+def decode_wav(data: bytes) -> np.ndarray:
+    """WAV bytes -> mono float32 PCM (resampled to 16 kHz by decimation/
+    linear interp — stdlib only)."""
+    import io
+    import wave
+
+    with wave.open(io.BytesIO(data)) as w:
+        sr = w.getframerate()
+        n = w.getnframes()
+        width = w.getsampwidth()
+        ch = w.getnchannels()
+        raw = w.readframes(n)
+    dtype = {1: np.int8, 2: np.int16, 4: np.int32}.get(width)
+    if dtype is None:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    pcm = np.frombuffer(raw, dtype).astype(np.float32)
+    pcm /= float(np.iinfo(dtype).max)
+    if ch > 1:
+        pcm = pcm.reshape(-1, ch).mean(axis=1)
+    if sr != SAMPLE_RATE:
+        t_new = np.linspace(0, len(pcm) - 1, int(len(pcm) * SAMPLE_RATE / sr))
+        pcm = np.interp(t_new, np.arange(len(pcm)), pcm).astype(np.float32)
+    return pcm
+
+
+# ---- parameters -------------------------------------------------------------
+
+
+def init_params(cfg: WhisperConfig, key=None) -> dict:
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    ks = iter(jax.random.split(key, 64))
+    dt = cfg.dtype
+    E, F = cfg.d_model, cfg.ffn_dim
+
+    def rnd(shape, scale=0.05):
+        return (jax.random.normal(next(ks), shape, jnp.float32) * scale).astype(dt)
+
+    def attn_block(heads):
+        return {
+            "wq": rnd((E, E)), "bq": jnp.zeros((E,), dt),
+            "wk": rnd((E, E)),
+            "wv": rnd((E, E)), "bv": jnp.zeros((E,), dt),
+            "wo": rnd((E, E)), "bo": jnp.zeros((E,), dt),
+        }
+
+    def ln():
+        return {"w": jnp.ones((E,), dt), "b": jnp.zeros((E,), dt)}
+
+    def ffn():
+        return {
+            "w1": rnd((E, F)), "b1": jnp.zeros((F,), dt),
+            "w2": rnd((F, E)), "b2": jnp.zeros((E,), dt),
+        }
+
+    enc_layers = [
+        {
+            "ln1": ln(), "attn": attn_block(cfg.encoder_heads),
+            "ln2": ln(), "ffn": ffn(),
+        }
+        for _ in range(cfg.encoder_layers)
+    ]
+    dec_layers = [
+        {
+            "ln1": ln(), "self_attn": attn_block(cfg.decoder_heads),
+            "ln2": ln(), "cross_attn": attn_block(cfg.decoder_heads),
+            "ln3": ln(), "ffn": ffn(),
+        }
+        for _ in range(cfg.decoder_layers)
+    ]
+    return {
+        "conv1_w": rnd((3, cfg.num_mel_bins, E)),
+        "conv1_b": jnp.zeros((E,), dt),
+        "conv2_w": rnd((3, E, E)),
+        "conv2_b": jnp.zeros((E,), dt),
+        "enc_pos": rnd((cfg.max_source_positions, E), 0.02),
+        "enc_layers": enc_layers,
+        "enc_ln": ln(),
+        "dec_embed": rnd((cfg.vocab_size, E), 0.02),
+        "dec_pos": rnd((cfg.max_target_positions, E), 0.02),
+        "dec_layers": dec_layers,
+        "dec_ln": ln(),
+    }
+
+
+def _layer_norm(x, p):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    return ((x32 - mu) / jnp.sqrt(var + 1e-5) * p["w"] + p["b"]).astype(x.dtype)
+
+
+def _mha(q_x, kv_x, p, heads, causal=False):
+    E = q_x.shape[-1]
+    D = E // heads
+    q = (q_x @ p["wq"] + p["bq"]).reshape(*q_x.shape[:-1], heads, D)
+    k = (kv_x @ p["wk"]).reshape(*kv_x.shape[:-1], heads, D)
+    v = (kv_x @ p["wv"] + p["bv"]).reshape(*kv_x.shape[:-1], heads, D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D ** -0.5)
+    if causal:
+        Sq, Sk = q_x.shape[1], kv_x.shape[1]
+        mask = jnp.arange(Sq)[:, None] + (Sk - Sq) >= jnp.arange(Sk)[None, :]
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q_x.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return out.reshape(*q_x.shape[:-1], E) @ p["wo"] + p["bo"]
+
+
+def _ffn(x, p):
+    return jax.nn.gelu(x @ p["w1"] + p["b1"], approximate=False) @ p["w2"] + p["b2"]
+
+
+def encode(params: dict, cfg: WhisperConfig, mel: jnp.ndarray) -> jnp.ndarray:
+    """mel: [B, n_mels, T] -> encoder states [B, T//2, E]."""
+    x = jnp.moveaxis(mel, 1, 2)  # [B, T, mel]
+    # conv1: kernel 3 stride 1 (same), gelu
+    x = jax.lax.conv_general_dilated(
+        x, params["conv1_w"], window_strides=(1,), padding="SAME",
+        dimension_numbers=("NWC", "WIO", "NWC"),
+    ) + params["conv1_b"]
+    x = jax.nn.gelu(x, approximate=False)
+    # conv2: kernel 3 stride 2, gelu
+    x = jax.lax.conv_general_dilated(
+        x, params["conv2_w"], window_strides=(2,), padding="SAME",
+        dimension_numbers=("NWC", "WIO", "NWC"),
+    ) + params["conv2_b"]
+    x = jax.nn.gelu(x, approximate=False)
+    x = x + params["enc_pos"][: x.shape[1]]
+    for lp in params["enc_layers"]:
+        h = _layer_norm(x, lp["ln1"])
+        x = x + _mha(h, h, lp["attn"], cfg.encoder_heads)
+        h = _layer_norm(x, lp["ln2"])
+        x = x + _ffn(h, lp["ffn"])
+    return _layer_norm(x, params["enc_ln"])
+
+
+def decoder_logits(
+    params: dict, cfg: WhisperConfig, tokens: jnp.ndarray, enc: jnp.ndarray
+) -> jnp.ndarray:
+    """tokens [B, S] + encoder states -> logits [B, S, V] (full forward;
+    the greedy loop below re-runs with growing S under distinct jits per
+    power-of-two bucket)."""
+    x = params["dec_embed"][tokens] + params["dec_pos"][: tokens.shape[1]]
+    for lp in params["dec_layers"]:
+        h = _layer_norm(x, lp["ln1"])
+        x = x + _mha(h, h, lp["self_attn"], cfg.decoder_heads, causal=True)
+        h = _layer_norm(x, lp["ln2"])
+        x = x + _mha(h, enc, lp["cross_attn"], cfg.decoder_heads)
+        h = _layer_norm(x, lp["ln3"])
+        x = x + _ffn(h, lp["ffn"])
+    x = _layer_norm(x, params["dec_ln"])
+    return jnp.einsum(
+        "bse,ve->bsv", x, params["dec_embed"],
+        preferred_element_type=jnp.float32,
+    )
+
+
+def transcribe_tokens(
+    params: dict,
+    cfg: WhisperConfig,
+    mel: np.ndarray,  # [n_mels, T]
+    max_tokens: int = 0,
+    forced_tokens: tuple[int, ...] = (),
+) -> list[int]:
+    """Greedy decode; returns generated token ids (without the start token)."""
+    max_tokens = max_tokens or (cfg.max_target_positions - 1)
+    enc = jax.jit(lambda p, m: encode(p, cfg, m))(
+        params, jnp.asarray(mel)[None]
+    )
+    tokens = [cfg.decoder_start_token_id, *forced_tokens]
+    logits_fn = jax.jit(
+        lambda p, t, e: decoder_logits(p, cfg, t, e)[:, -1]
+    )
+    out: list[int] = []
+    for _ in range(max_tokens):
+        if len(tokens) >= cfg.max_target_positions:
+            break
+        logits = logits_fn(params, jnp.asarray([tokens]), enc)
+        tok = int(jnp.argmax(logits[0]))
+        if tok == cfg.eos_token_id:
+            break
+        tokens.append(tok)
+        out.append(tok)
+    return out

@@ -246,3 +246,31 @@ def test_concurrent_mixed_requests(server):
             )[1]
         )["choices"][0]["text"]
         assert results[i] == solo
+
+
+def test_embeddings_endpoint(server):
+    status, body = http_post(
+        addr(server),
+        "/v1/embeddings",
+        {"model": "tiny-llama", "input": ["hello world", "hi"]},
+    )
+    assert status == 200, body
+    payload = json.loads(body)
+    assert payload["object"] == "list"
+    assert len(payload["data"]) == 2
+    v0 = payload["data"][0]["embedding"]
+    import math
+    assert abs(math.fsum(x * x for x in v0) - 1.0) < 1e-3  # L2-normalized
+    # Deterministic + input-sensitive.
+    again = json.loads(
+        http_post(addr(server), "/v1/embeddings",
+                  {"input": "hello world"})[1]
+    )["data"][0]["embedding"]
+    assert np.allclose(v0, again, atol=1e-5)
+    other = json.loads(
+        http_post(addr(server), "/v1/embeddings", {"input": "different"})[1]
+    )["data"][0]["embedding"]
+    assert not np.allclose(v0, other, atol=1e-3)
+
+    # probe: bad input types
+    assert http_post(addr(server), "/v1/embeddings", {"input": [1, 2]})[0] == 400
