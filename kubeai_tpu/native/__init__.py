@@ -1,0 +1,148 @@
+"""ctypes bindings for the native C++ data-plane library.
+
+Builds on demand (g++ is a one-second compile) and caches the .so next to
+the sources; everything degrades to the pure-Python implementations when
+no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB = None
+_LOCK = threading.Lock()
+_TRIED = False
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_SO_PATH = os.path.join(_NATIVE_DIR, "libkubeai_native.so")
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "kubeai_native.cpp")
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return os.path.exists(_SO_PATH)
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def load_native():
+    """Returns the loaded library or None."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if not os.path.exists(_SO_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        lib.kubeai_xxhash64.restype = ctypes.c_uint64
+        lib.kubeai_xxhash64.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64,
+        ]
+        lib.kubeai_ring_new.restype = ctypes.c_void_p
+        lib.kubeai_ring_new.argtypes = [ctypes.c_double, ctypes.c_int]
+        lib.kubeai_ring_free.argtypes = [ctypes.c_void_p]
+        lib.kubeai_ring_add.restype = ctypes.c_int
+        lib.kubeai_ring_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.kubeai_ring_remove.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.kubeai_ring_lookup.restype = ctypes.c_int
+        lib.kubeai_ring_lookup.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int,
+            ctypes.c_char_p,
+        ]
+        _LIB = lib
+        return _LIB
+
+
+def xxhash64_native(data: bytes, seed: int = 0) -> int | None:
+    lib = load_native()
+    if lib is None:
+        return None
+    return lib.kubeai_xxhash64(data, len(data), seed)
+
+
+class NativeCHWBL:
+    """Native consistent-hash ring with bounded loads (see chwbl.py for
+    the contract; the Python CHWBL is the oracle)."""
+
+    def __init__(self, load_factor: float = 1.25, replication: int = 256):
+        lib = load_native()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.kubeai_ring_new(load_factor, replication)
+        self._ids: dict[str, int] = {}
+        self._names: list[str] = []
+        self._lock = threading.Lock()
+
+    def __del__(self):
+        if getattr(self, "_h", None) and getattr(self, "_lib", None):
+            self._lib.kubeai_ring_free(self._h)
+            self._h = None
+
+    def add(self, endpoint: str) -> None:
+        with self._lock:
+            eid = self._lib.kubeai_ring_add(self._h, endpoint.encode())
+            self._ids[endpoint] = eid
+            while len(self._names) <= eid:
+                self._names.append("")
+            self._names[eid] = endpoint
+
+    def remove(self, endpoint: str) -> None:
+        with self._lock:
+            self._lib.kubeai_ring_remove(self._h, endpoint.encode())
+            eid = self._ids.pop(endpoint, None)
+            if eid is not None and eid < len(self._names):
+                self._names[eid] = ""
+
+    def get(
+        self,
+        key: str,
+        loads: dict[str, int],
+        adapter_endpoints: set[str] | None = None,
+    ) -> str | None:
+        with self._lock:
+            n = len(self._names)
+            if n == 0:
+                return None
+            arr = (ctypes.c_int64 * n)()
+            for name, load in loads.items():
+                eid = self._ids.get(name)
+                if eid is not None:
+                    arr[eid] = load
+            mask = None
+            if adapter_endpoints is not None:
+                mask_bytes = bytearray(n)
+                for name in adapter_endpoints:
+                    eid = self._ids.get(name)
+                    if eid is not None:
+                        mask_bytes[eid] = 1
+                mask = bytes(mask_bytes)
+            kb = key.encode()
+            eid = self._lib.kubeai_ring_lookup(
+                self._h, kb, len(kb), arr, n, mask
+            )
+            if eid < 0 or eid >= len(self._names) or not self._names[eid]:
+                return None
+            return self._names[eid]

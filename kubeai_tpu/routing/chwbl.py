@@ -107,3 +107,44 @@ class CHWBL:
         if fallback is not None:
             return fallback
         return min(loads, key=loads.get) if loads else None
+
+
+class _NativeRing:
+    """Thin adapter over the C++ ring: same interface as CHWBL, Python-side
+    metrics accounting."""
+
+    def __init__(self, native, metrics: Metrics):
+        self._native = native
+        self.metrics = metrics
+
+    def add(self, endpoint: str) -> None:
+        self._native.add(endpoint)
+
+    def remove(self, endpoint: str) -> None:
+        self._native.remove(endpoint)
+
+    def get(self, key, loads, adapter_endpoints=None):
+        self.metrics.chwbl_lookups.inc()
+        return self._native.get(key, loads, adapter_endpoints)
+
+
+def make_ring(
+    load_factor: float = 1.25,
+    replication: int = 256,
+    metrics: Metrics = DEFAULT_METRICS,
+    prefer_native: bool = True,
+):
+    """Build the CHWBL ring: native C++ when the library is available
+    (tests assert pick-for-pick parity with the Python oracle), else the
+    pure-Python implementation."""
+    if prefer_native:
+        try:
+            from kubeai_tpu.native import NativeCHWBL, load_native
+
+            if load_native() is not None:
+                return _NativeRing(
+                    NativeCHWBL(load_factor, replication), metrics
+                )
+        except Exception:
+            pass
+    return CHWBL(load_factor, replication, metrics)

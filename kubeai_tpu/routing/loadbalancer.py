@@ -25,7 +25,7 @@ from kubeai_tpu.crd.model import (
 from kubeai_tpu.operator import k8sutils
 from kubeai_tpu.operator.k8s.store import KubeStore
 from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
-from kubeai_tpu.routing.chwbl import CHWBL
+from kubeai_tpu.routing.chwbl import make_ring
 
 
 class LoadBalancerTimeout(TimeoutError):
@@ -61,7 +61,7 @@ class Group:
     ):
         self._cond = threading.Condition()
         self._endpoints: dict[str, _Endpoint] = {}
-        self._chwbl = CHWBL(
+        self._chwbl = make_ring(
             load_factor=load_factor, replication=replication, metrics=metrics
         )
         self.total_in_flight = 0

@@ -1,0 +1,223 @@
+// Native data-plane components for kubeai_tpu.
+//
+// The reference's hot routing loops are Go (xxhash ring walk,
+// internal/loadbalancer/balance_chwbl.go); here the Python control plane
+// delegates them to this C++ library via ctypes:
+//
+//   - xxHash64 (reference algorithm, matches cespare/xxhash)
+//   - CHWBL ring: consistent hashing with bounded loads, vnode ring with
+//     binary search, adapter-aware walk — one lookup is O(log R + walk)
+//     with no Python object traffic.
+//
+// Build: make -C native   (produces libkubeai_native.so; the Python wrapper
+// kubeai_tpu/native/__init__.py falls back to pure Python when absent).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+// ---------------- xxHash64 ----------------
+
+static const uint64_t P1 = 0x9E3779B185EBCA87ULL;
+static const uint64_t P2 = 0xC2B2AE3D27D4EB4FULL;
+static const uint64_t P3 = 0x165667B19E3779F9ULL;
+static const uint64_t P4 = 0x85EBCA77C2B2AE63ULL;
+static const uint64_t P5 = 0x27D4EB2F165667C5ULL;
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t round64(uint64_t acc, uint64_t input) {
+  acc += input * P2;
+  acc = rotl64(acc, 31);
+  acc *= P1;
+  return acc;
+}
+
+static inline uint64_t merge_round(uint64_t acc, uint64_t val) {
+  acc ^= round64(0, val);
+  acc = acc * P1 + P4;
+  return acc;
+}
+
+static inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+static inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+extern "C" uint64_t kubeai_xxhash64(const uint8_t* data, size_t len,
+                                    uint64_t seed) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2;
+    uint64_t v2 = seed + P2;
+    uint64_t v3 = seed;
+    uint64_t v4 = seed - P1;
+    const uint8_t* limit = end - 32;
+    do {
+      v1 = round64(v1, read64(p)); p += 8;
+      v2 = round64(v2, read64(p)); p += 8;
+      v3 = round64(v3, read64(p)); p += 8;
+      v4 = round64(v4, read64(p)); p += 8;
+    } while (p <= limit);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    h = merge_round(h, v1);
+    h = merge_round(h, v2);
+    h = merge_round(h, v3);
+    h = merge_round(h, v4);
+  } else {
+    h = seed + P5;
+  }
+  h += (uint64_t)len;
+  while (p + 8 <= end) {
+    h ^= round64(0, read64(p));
+    h = rotl64(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= (uint64_t)read32(p) * P1;
+    h = rotl64(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (uint64_t)(*p) * P5;
+    h = rotl64(h, 11) * P1;
+    p++;
+  }
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+// ---------------- CHWBL ring ----------------
+
+struct Ring {
+  double load_factor;
+  int replication;
+  // sorted ring points -> endpoint id
+  std::vector<std::pair<uint64_t, int>> points;
+  std::vector<std::string> endpoints;  // id -> name ("" = removed)
+};
+
+extern "C" void* kubeai_ring_new(double load_factor, int replication) {
+  Ring* r = new Ring();
+  r->load_factor = load_factor;
+  r->replication = replication;
+  return r;
+}
+
+extern "C" void kubeai_ring_free(void* h) { delete (Ring*)h; }
+
+static uint64_t point_hash(const std::string& name, int i) {
+  std::string s = name + std::to_string(i);
+  return kubeai_xxhash64((const uint8_t*)s.data(), s.size(), 0);
+}
+
+extern "C" int kubeai_ring_add(void* h, const char* endpoint) {
+  Ring* r = (Ring*)h;
+  std::string name(endpoint);
+  for (size_t i = 0; i < r->endpoints.size(); i++) {
+    if (r->endpoints[i] == name) return (int)i;  // already present
+  }
+  int id = -1;
+  for (size_t i = 0; i < r->endpoints.size(); i++) {
+    if (r->endpoints[i].empty()) { id = (int)i; break; }
+  }
+  if (id < 0) {
+    id = (int)r->endpoints.size();
+    r->endpoints.push_back(name);
+  } else {
+    r->endpoints[id] = name;
+  }
+  for (int i = 0; i < r->replication; i++) {
+    uint64_t pt = point_hash(name, i);
+    auto it = std::lower_bound(
+        r->points.begin(), r->points.end(), std::make_pair(pt, -1));
+    if (it != r->points.end() && it->first == pt) continue;  // collision
+    r->points.insert(it, {pt, id});
+  }
+  return id;
+}
+
+extern "C" void kubeai_ring_remove(void* h, const char* endpoint) {
+  Ring* r = (Ring*)h;
+  std::string name(endpoint);
+  int id = -1;
+  for (size_t i = 0; i < r->endpoints.size(); i++) {
+    if (r->endpoints[i] == name) { id = (int)i; break; }
+  }
+  if (id < 0) return;
+  r->endpoints[id].clear();
+  r->points.erase(
+      std::remove_if(r->points.begin(), r->points.end(),
+                     [id](const std::pair<uint64_t, int>& p) {
+                       return p.second == id;
+                     }),
+      r->points.end());
+}
+
+// Lookup. loads: per-endpoint-id in-flight counts (indexed by the id
+// returned from ring_add; -1 entries = endpoint unknown to caller).
+// adapter_mask: per-id 0/1 restriction (NULL = unrestricted).
+// Returns endpoint id, or -1 when the ring is empty.
+extern "C" int kubeai_ring_lookup(void* h, const uint8_t* key, size_t key_len,
+                                  const int64_t* loads, int n_ids,
+                                  const uint8_t* adapter_mask) {
+  Ring* r = (Ring*)h;
+  if (r->points.empty()) return -1;
+  int64_t total = 0;
+  int n_live = 0;
+  for (int i = 0; i < n_ids; i++) {
+    if (i < (int)r->endpoints.size() && !r->endpoints[i].empty()) {
+      total += loads[i] > 0 ? loads[i] : 0;
+      n_live++;
+    }
+  }
+  if (n_live == 0) return -1;
+  double threshold = (double)(total + 1) / (double)n_live * r->load_factor;
+
+  uint64_t kh = kubeai_xxhash64(key, key_len, 0);
+  size_t start = std::lower_bound(r->points.begin(), r->points.end(),
+                                  std::make_pair(kh, -1)) -
+                 r->points.begin();
+  if (start == r->points.size()) start = 0;
+
+  int fallback = -1;
+  std::vector<uint8_t> seen(r->endpoints.size(), 0);
+  size_t n_pts = r->points.size();
+  for (size_t off = 0; off < n_pts; off++) {
+    int id = r->points[(start + off) % n_pts].second;
+    if (id < 0 || id >= (int)seen.size() || seen[id]) continue;
+    seen[id] = 1;
+    if (id >= n_ids) continue;
+    bool load_ok = (total == 0) || ((double)loads[id] <= threshold);
+    if (load_ok && fallback < 0) fallback = id;
+    if (adapter_mask != nullptr && !adapter_mask[id]) continue;
+    if (load_ok) return id;
+  }
+  if (fallback >= 0) return fallback;
+  // All overloaded: least-loaded live endpoint.
+  int best = -1;
+  int64_t best_load = INT64_MAX;
+  for (int i = 0; i < n_ids && i < (int)r->endpoints.size(); i++) {
+    if (r->endpoints[i].empty()) continue;
+    if (loads[i] < best_load) { best_load = loads[i]; best = i; }
+  }
+  return best;
+}
