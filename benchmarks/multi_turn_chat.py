@@ -1,0 +1,145 @@
+"""Multi-turn chat load generator — the reference's headline benchmark
+client (reference: benchmarks/chat-py/benchmark_serving.py + benchmarks/
+multi-turn-chat-go): N concurrent conversation threads, each holding a
+growing message history (shared prefix per thread — what PrefixHash
+exploits), streaming requests, reporting TTFT / ITL / token throughput.
+
+Usage:
+  python benchmarks/multi_turn_chat.py --base-url http://HOST:PORT/openai \
+      --model MODEL --threads 32 --turns 4 --max-tokens 64
+
+Prints a JSON report (mean/p50/p90 TTFT ms, mean ITL ms, output tok/s).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import statistics
+import string
+import threading
+import time
+import urllib.request
+
+
+def _rand_text(rng: random.Random, words: int) -> str:
+    return " ".join(
+        "".join(rng.choices(string.ascii_lowercase, k=rng.randint(3, 9)))
+        for _ in range(words)
+    )
+
+
+def run_conversation(base_url, model, turns, max_tokens, seed, results, lock):
+    rng = random.Random(seed)
+    messages = [
+        {"role": "system", "content": f"conversation-{seed}: " + _rand_text(rng, 30)}
+    ]
+    for _turn in range(turns):
+        messages.append({"role": "user", "content": _rand_text(rng, 20)})
+        body = json.dumps(
+            {
+                "model": model,
+                "messages": messages,
+                "max_tokens": max_tokens,
+                "temperature": 0.7,
+                "stream": True,
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"{base_url}/v1/chat/completions",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        t0 = time.perf_counter()
+        ttft = None
+        chunk_times = []
+        text_parts = []
+        try:
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                for line in resp:
+                    line = line.strip()
+                    if not line.startswith(b"data: ") or line == b"data: [DONE]":
+                        continue
+                    now = time.perf_counter()
+                    if ttft is None:
+                        ttft = now - t0
+                    chunk_times.append(now)
+                    try:
+                        ev = json.loads(line[len(b"data: "):])
+                        delta = ev["choices"][0].get("delta", {}).get(
+                            "content"
+                        ) or ev["choices"][0].get("text", "")
+                        if delta:
+                            text_parts.append(delta)
+                    except (json.JSONDecodeError, KeyError, IndexError):
+                        pass
+        except OSError as e:
+            with lock:
+                results["errors"] += 1
+            return
+        text = "".join(text_parts)
+        messages.append({"role": "assistant", "content": text})
+        itls = [
+            b - a for a, b in zip(chunk_times, chunk_times[1:])
+        ]
+        with lock:
+            if ttft is not None:
+                results["ttft"].append(ttft)
+            results["itl"].extend(itls)
+            results["out_chars"] += len(text)
+            results["requests"] += 1
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base-url", default="http://127.0.0.1:8000/openai")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--threads", type=int, default=16)
+    ap.add_argument("--turns", type=int, default=4)
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    results = {"ttft": [], "itl": [], "out_chars": 0, "requests": 0, "errors": 0}
+    lock = threading.Lock()
+    t0 = time.perf_counter()
+    threads = [
+        threading.Thread(
+            target=run_conversation,
+            args=(args.base_url, args.model, args.turns, args.max_tokens,
+                  args.seed * 1000 + i, results, lock),
+        )
+        for i in range(args.threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    def pct(xs, p):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+    report = {
+        "requests": results["requests"],
+        "errors": results["errors"],
+        "wall_s": round(wall, 2),
+        "mean_ttft_ms": round(statistics.mean(results["ttft"]) * 1e3, 2)
+        if results["ttft"] else None,
+        "p50_ttft_ms": round(pct(results["ttft"], 0.5) * 1e3, 2)
+        if results["ttft"] else None,
+        "p90_ttft_ms": round(pct(results["ttft"], 0.9) * 1e3, 2)
+        if results["ttft"] else None,
+        "mean_itl_ms": round(statistics.mean(results["itl"]) * 1e3, 2)
+        if results["itl"] else None,
+        "output_chars_per_s": round(results["out_chars"] / wall, 1),
+    }
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,203 @@
+"""Real-cluster Kubernetes client speaking the same interface as KubeStore.
+
+stdlib-only (urllib over the in-cluster API endpoint with the mounted
+service-account token). Maps the store interface onto REST verbs:
+
+  get/list/create/update/patch_merge/delete/delete_all_of + watch
+
+Watches use the streaming watch API (chunked JSON lines). Objects are the
+same manifest dicts KubeStore holds, so every controller-path component
+(reconciler, LB, autoscaler) runs unmodified against a live cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import ssl
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Callable, Iterable
+
+from kubeai_tpu.operator.k8s.store import Conflict, Invalid, NotFound
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# kind -> (api_prefix, plural, namespaced)
+KIND_ROUTES = {
+    "Pod": ("/api/v1", "pods", True),
+    "ConfigMap": ("/api/v1", "configmaps", True),
+    "PersistentVolumeClaim": ("/api/v1", "persistentvolumeclaims", True),
+    "Job": ("/apis/batch/v1", "jobs", True),
+    "Lease": ("/apis/coordination.k8s.io/v1", "leases", True),
+    "Model": ("/apis/kubeai.org/v1", "models", True),
+}
+
+
+class RestKubeClient:
+    def __init__(self, base_url: str, token: str, ca_file: str | None = None):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        if ca_file:
+            self._ctx = ssl.create_default_context(cafile=ca_file)
+        else:
+            self._ctx = ssl.create_default_context()
+        self._watchers: list[tuple[tuple[str, ...] | None, queue.Queue]] = []
+        self._watch_threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    @staticmethod
+    def in_cluster() -> "RestKubeClient":
+        with open(f"{SA_DIR}/token") as f:
+            token = f.read().strip()
+        import os
+
+        host = os.environ["KUBERNETES_SERVICE_HOST"]
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        return RestKubeClient(
+            f"https://{host}:{port}", token, ca_file=f"{SA_DIR}/ca.crt"
+        )
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _route(self, kind: str, namespace: str | None) -> str:
+        if kind not in KIND_ROUTES:
+            raise Invalid(f"unsupported kind {kind!r}")
+        prefix, plural, namespaced = KIND_ROUTES[kind]
+        if namespaced and namespace:
+            return f"{prefix}/namespaces/{namespace}/{plural}"
+        return f"{prefix}/{plural}"
+
+    def _req(
+        self, method: str, path: str, body: dict | None = None,
+        content_type: str = "application/json",
+    ) -> dict:
+        url = self.base_url + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Authorization", f"Bearer {self.token}")
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        try:
+            with urllib.request.urlopen(req, context=self._ctx, timeout=30) as r:
+                return json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:500]
+            if e.code == 404:
+                raise NotFound(detail)
+            if e.code == 409:
+                raise Conflict(detail)
+            if e.code in (400, 422):
+                raise Invalid(detail)
+            raise
+
+    # -- store interface ------------------------------------------------------
+
+    def register_validator(self, kind: str, fn) -> None:
+        pass  # validation is the real API server's / webhook's job
+
+    def create(self, obj: dict) -> dict:
+        meta = obj.get("metadata", {})
+        ns = meta.get("namespace", "default")
+        return self._req("POST", self._route(obj["kind"], ns), obj)
+
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        return self._req("GET", f"{self._route(kind, namespace)}/{name}")
+
+    def try_get(self, kind: str, namespace: str, name: str) -> dict | None:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFound:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict[str, str] | None = None,
+    ) -> list[dict]:
+        path = self._route(kind, namespace)
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
+            path += "?" + urllib.parse.urlencode({"labelSelector": sel})
+        out = self._req("GET", path)
+        items = out.get("items", [])
+        for it in items:
+            it.setdefault("kind", kind)
+        return items
+
+    def update(self, obj: dict) -> dict:
+        meta = obj.get("metadata", {})
+        ns = meta.get("namespace", "default")
+        return self._req(
+            "PUT", f"{self._route(obj['kind'], ns)}/{meta['name']}", obj
+        )
+
+    def patch_merge(self, kind: str, namespace: str, name: str, patch: dict) -> dict:
+        return self._req(
+            "PATCH",
+            f"{self._route(kind, namespace)}/{name}",
+            patch,
+            content_type="application/merge-patch+json",
+        )
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._req("DELETE", f"{self._route(kind, namespace)}/{name}")
+
+    def delete_all_of(
+        self, kind: str, namespace: str,
+        label_selector: dict[str, str] | None = None,
+    ) -> int:
+        victims = self.list(kind, namespace, label_selector)
+        for v in victims:
+            try:
+                self.delete(kind, namespace, v["metadata"]["name"])
+            except NotFound:
+                pass
+        return len(victims)
+
+    # -- watch ----------------------------------------------------------------
+
+    def watch(self, kinds: Iterable[str] | None = None) -> queue.Queue:
+        q: queue.Queue = queue.Queue()
+        kinds_t = tuple(kinds) if kinds else tuple(KIND_ROUTES)
+        self._watchers.append((kinds_t, q))
+        for kind in kinds_t:
+            t = threading.Thread(
+                target=self._watch_loop, args=(kind, q), daemon=True
+            )
+            t.start()
+            self._watch_threads.append(t)
+        return q
+
+    def _watch_loop(self, kind: str, q: queue.Queue) -> None:
+        rv = ""
+        while not self._stop.is_set():
+            path = self._route(kind, None) + "?watch=true"
+            if rv:
+                path += f"&resourceVersion={rv}"
+            url = self.base_url + path
+            req = urllib.request.Request(url)
+            req.add_header("Authorization", f"Bearer {self.token}")
+            try:
+                with urllib.request.urlopen(
+                    req, context=self._ctx, timeout=300
+                ) as r:
+                    for line in r:
+                        if self._stop.is_set():
+                            return
+                        try:
+                            ev = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        obj = ev.get("object") or {}
+                        obj.setdefault("kind", kind)
+                        rv = (obj.get("metadata") or {}).get(
+                            "resourceVersion", rv
+                        )
+                        q.put((ev.get("type", "MODIFIED"), obj))
+            except OSError:
+                self._stop.wait(2.0)  # reconnect with backoff

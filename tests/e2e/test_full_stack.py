@@ -1,0 +1,170 @@
+"""End-to-end: the full operator stack routing to a REAL engine server
+(tiny Llama, byte tokenizer) — the reference's `quickstart` e2e equivalent
+(reference: test/e2e/quickstart/test.sh runs a real completion through a
+real Ollama backend; here the backend is the in-tree TPU engine on CPU).
+
+Covers: Model create → controller renders pod (engine 'started' by the
+test) → LB discovery → chat completion through the operator proxy →
+LoRA adapter orchestration end-to-end (controller → engine admin API with
+a real PEFT checkpoint from disk → adapter-routed request)."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from testutil import eventually, http_get, http_post
+
+from kubeai_tpu.config import System
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import Adapter, Model, ModelSpec
+from kubeai_tpu.engine import Engine, EngineConfig
+from kubeai_tpu.engine.server import EngineServer
+from kubeai_tpu.engine.tokenizer import ByteTokenizer
+from kubeai_tpu.models import llama
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.operator.manager import Manager
+
+
+def _save_peft_adapter(tmp_path, cfg, rank=4, seed=7):
+    """Write a real PEFT-format LoRA checkpoint (safetensors) to disk."""
+    import torch
+    from safetensors.torch import save_file
+
+    rng = np.random.default_rng(seed)
+    E, H, D, NL = cfg.hidden_size, cfg.num_heads, cfg.head_size, cfg.num_layers
+    tensors = {}
+    for i in range(NL):
+        prefix = f"base_model.model.model.layers.{i}.self_attn.q_proj"
+        tensors[f"{prefix}.lora_A.weight"] = torch.tensor(
+            (rng.standard_normal((rank, E)) * 12.0).astype(np.float32)
+        )
+        tensors[f"{prefix}.lora_B.weight"] = torch.tensor(
+            (rng.standard_normal((H * D, rank)) * 12.0).astype(np.float32)
+        )
+    adapter_dir = tmp_path / "fin-lora"
+    adapter_dir.mkdir()
+    save_file(tensors, str(adapter_dir / "adapter_model.safetensors"))
+    (adapter_dir / "adapter_config.json").write_text(
+        json.dumps({"r": rank, "lora_alpha": rank, "target_modules": ["q_proj"]})
+    )
+    return str(adapter_dir)
+
+
+@pytest.fixture(scope="module")
+def real_engine():
+    tok = ByteTokenizer()
+    cfg = llama.LlamaConfig.tiny(vocab_size=tok.vocab_size)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    engine = Engine(
+        "llama", cfg, params,
+        cfg=EngineConfig(num_slots=4, max_seq_len=128, max_adapters=2,
+                         max_lora_rank=8, decode_chunk=4),
+        eos_token_ids=tok.eos_token_ids,
+    )
+    srv = EngineServer(engine, tok, "e2e-model", host="127.0.0.1", port=0)
+    srv.start()
+    yield srv, cfg
+    srv.stop()
+
+
+def test_quickstart_through_operator(real_engine, tmp_path):
+    engine_srv, model_cfg = real_engine
+    store = KubeStore()
+    cfg = System()
+    cfg.allow_pod_address_override = True
+    mgr = Manager(store, cfg)
+    mgr.start()
+    try:
+        adapter_dir = _save_peft_adapter(tmp_path, model_cfg)
+        m = Model(
+            name="e2e-model",
+            spec=ModelSpec(
+                url="hf://org/e2e-model",
+                engine="KubeAITPU",
+                features=["TextGeneration"],
+                min_replicas=1,
+                max_replicas=1,
+                adapters=[Adapter(name="fin", url=adapter_dir)],
+            ),
+            annotations={
+                md.MODEL_POD_IP_ANNOTATION: "127.0.0.1",
+                md.MODEL_POD_PORT_ANNOTATION: str(engine_srv.port),
+            },
+        )
+        store.create(m.to_dict())
+
+        # Controller creates the pod; mark it ready ("kubelet") — the REAL
+        # engine is listening at the annotated address.
+        def ready():
+            pods = store.list("Pod", "default", {md.POD_MODEL_LABEL: "e2e-model"})
+            for pod in pods:
+                pod.setdefault("status", {})["conditions"] = [
+                    {"type": "Ready", "status": "True"},
+                    {"type": "PodScheduled", "status": "True"},
+                ]
+                pod["status"]["podIP"] = "127.0.0.1"
+                try:
+                    store.update(pod)
+                except Exception:
+                    pass
+            return pods
+
+        eventually(ready, msg="engine pod created")
+
+        # 1. Base chat completion through the operator front door.
+        def chat_ok():
+            status, data = http_post(
+                mgr.api_address,
+                "/openai/v1/chat/completions",
+                {
+                    "model": "e2e-model",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "max_tokens": 8,
+                    "temperature": 0,
+                },
+            )
+            return json.loads(data) if status == 200 else None
+
+        payload = eventually(chat_ok, timeout=30, msg="chat completion 200")
+        assert payload["object"] == "chat.completion"
+        base_text = payload["choices"][0]["message"]["content"]
+
+        # 2. Adapter orchestration: the controller exec-free path loads the
+        # PEFT checkpoint into the engine and labels the pod.
+        def adapter_labelled():
+            pods = store.list("Pod", "default", {md.POD_MODEL_LABEL: "e2e-model"})
+            return pods and md.adapter_label("fin") in (
+                pods[0]["metadata"].get("labels") or {}
+            )
+
+        eventually(adapter_labelled, timeout=30, msg="adapter label on pod")
+        status, body = http_get(
+            f"127.0.0.1:{engine_srv.port}", "/v1/models"
+        )
+        assert "fin" in [m["id"] for m in json.loads(body)["data"]]
+
+        # 3. Adapter-suffixed request routes through and generates
+        # differently (LoRA weights actually applied).
+        status, data = http_post(
+            mgr.api_address,
+            "/openai/v1/chat/completions",
+            {
+                "model": "e2e-model_fin",
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 8,
+                "temperature": 0,
+            },
+        )
+        assert status == 200, data
+        fin_text = json.loads(data)["choices"][0]["message"]["content"]
+        assert fin_text != base_text
+
+        # 4. /v1/models through the operator lists model + adapter ids.
+        status, body = http_get(mgr.api_address, "/openai/v1/models")
+        ids = {m["id"] for m in json.loads(body)["data"]}
+        assert {"e2e-model", "e2e-model_fin"} <= ids
+    finally:
+        mgr.stop()
