@@ -56,6 +56,13 @@ class EngineConfig:
     # the LoRA path entirely — no extra compute in the compiled graphs).
     max_adapters: int = 0
     max_lora_rank: int = 16
+    # Pipelined stepping: dispatch decode chunk N+1 before fetching chunk
+    # N's tokens, so the device computes through the host's fetch+process
+    # time. Costs one chunk of extra stop-check latency. Default OFF: some
+    # remote-dispatch transports (e.g. relayed single-chip tunnels) stall
+    # with a second donated-buffer program in flight behind a pending
+    # fetch; direct PJRT targets can enable it safely.
+    pipeline: bool = False
 
     def buckets(self) -> tuple[int, ...]:
         if self.prefill_buckets:
@@ -121,6 +128,9 @@ class Engine:
         self._active: dict[int, _Request] = {}  # slot -> request
         self._requests: dict[int, _Request] = {}
         self._free_slots = list(range(cfg.num_slots))
+        # In-flight decode chunk (pipelined stepping): (token futures,
+        # snapshot of the slot->request map the chunk was dispatched with).
+        self._inflight: tuple | None = None
         # Base entropy for unseeded requests (per-request seed = base ^ rid).
         self._seed_base = int.from_bytes(np.random.bytes(4), "little")
         self._steps = 0
@@ -321,7 +331,7 @@ class Engine:
             return rid
 
     def has_work(self) -> bool:
-        return bool(self._pending or self._active)
+        return bool(self._pending or self._active or self._inflight)
 
     @property
     def num_active(self) -> int:
@@ -427,36 +437,56 @@ class Engine:
         """Admit pending prefills, then run one fused decode chunk
         (cfg.decode_chunk model steps in a single device call).
 
+        With cfg.pipeline, the chunk dispatched this call is fetched on the
+        NEXT call: the device computes chunk N+1 while the host fetches and
+        processes chunk N's tokens.
+
         Returns a list of StepEvents in emission order.
         """
         with self._lock:
             emitted = self._admit_pending()
-            if not self._active:
-                return emitted
-            toks_seq, self.cache.k, self.cache.v, self._state = (
-                self._decode_jit(
-                    self.params, self.cache.k, self.cache.v, self._state,
-                    self._lora,
-                )
-            )
-            toks_seq = np.asarray(jax.device_get(toks_seq))  # [chunk, B]
-            self._steps += 1
-            chunk_slots = list(self._active.items())
-            for k in range(toks_seq.shape[0]):
-                for slot, req in chunk_slots:
-                    if req.done:
-                        continue  # surplus chunk tokens discarded
-                    tok = int(toks_seq[k, slot])
-                    req.out_tokens.append(tok)
-                    req.position += 1
-                    req.last_token = tok
-                    finished = self._check_stop(req)
-                    emitted.append(
-                        StepEvent(req.rid, tok, finished, req.finish_reason)
+            prev = self._inflight
+            self._inflight = None
+            current = None
+            if self._active:
+                toks_seq, self.cache.k, self.cache.v, self._state = (
+                    self._decode_jit(
+                        self.params, self.cache.k, self.cache.v, self._state,
+                        self._lora,
                     )
-                    if finished:
-                        self._release(req)
+                )
+                self._steps += 1
+                current = (toks_seq, list(self._active.items()))
+                if self.cfg.pipeline:
+                    # Fetch current NEXT call: device computes through the
+                    # host's fetch+process of prev.
+                    self._inflight = current
+                    current = None
+            if prev is not None:
+                emitted.extend(self._process_chunk(prev))
+            if current is not None:
+                emitted.extend(self._process_chunk(current))
             return emitted
+
+    def _process_chunk(self, inflight: tuple) -> list[StepEvent]:
+        toks_seq, chunk_slots = inflight
+        toks_seq = np.asarray(jax.device_get(toks_seq))  # [chunk, B]
+        emitted: list[StepEvent] = []
+        for k in range(toks_seq.shape[0]):
+            for slot, req in chunk_slots:
+                if req.done:
+                    continue  # surplus chunk tokens discarded
+                tok = int(toks_seq[k, slot])
+                req.out_tokens.append(tok)
+                req.position += 1
+                req.last_token = tok
+                finished = self._check_stop(req)
+                emitted.append(
+                    StepEvent(req.rid, tok, finished, req.finish_reason)
+                )
+                if finished:
+                    self._release(req)
+        return emitted
 
     # ---- LoRA adapter admin (reference: internal/vllmclient/client.go) ------
 

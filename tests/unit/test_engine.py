@@ -124,3 +124,32 @@ def test_sharded_engine_tp_matches_single(devices8):
     out1 = eng1.generate(prompts, GREEDY)
     out4 = eng4.generate(prompts, GREEDY)
     assert out1 == out4
+
+
+def test_pipelined_stepping_equivalent():
+    """pipeline=True must emit the identical token stream, one chunk late."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    base = Engine(
+        "llama", cfg, params,
+        cfg=EngineConfig(num_slots=3, max_seq_len=64, decode_chunk=4,
+                         pipeline=False),
+    )
+    piped = Engine(
+        "llama", cfg, params,
+        cfg=EngineConfig(num_slots=3, max_seq_len=64, decode_chunk=4,
+                         pipeline=True),
+    )
+    prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [2, 2]]  # > slots: queueing
+    want = base.generate(prompts, GREEDY)
+    got = piped.generate(prompts, GREEDY)
+    assert got == want
+    assert not piped.has_work()  # drain complete, no stuck inflight
+
+    # Streaming events still carry correct finish reasons.
+    rid = piped.add_request([3, 1, 4], GREEDY)
+    evs = []
+    while piped.has_work():
+        evs.extend(e for e in piped.step() if e.rid == rid)
+    assert [e.token for e in evs] == piped.generate([[3, 1, 4]], GREEDY)[0]
+    assert evs[-1].finished and evs[-1].finish_reason == "length"
