@@ -52,6 +52,9 @@ class EngineConfig:
     # request emits past its stop point within a chunk are discarded
     # host-side; slot rows are independent, so batch-mates are unaffected.
     decode_chunk: int = 8
+    # Weight-only quantization: "" (bf16) or "int8" (per-channel symmetric;
+    # halves HBM weight traffic on the memory-bound decode path).
+    quantization: str = ""
     # LoRA hot-swap: number of simultaneously loaded adapters (0 disables
     # the LoRA path entirely — no extra compute in the compiled graphs).
     max_adapters: int = 0
@@ -135,8 +138,18 @@ class Engine:
         self._seed_base = int.from_bytes(np.random.bytes(4), "little")
         self._steps = 0
 
-        # Shard params onto the mesh.
+        # Quantize (optional), then shard params onto the mesh.
         specs = self.family.param_specs(model_cfg)
+        if cfg.quantization == "int8":
+            from kubeai_tpu.engine.quantization import (
+                quantize_params,
+                quantized_specs,
+            )
+
+            params = quantize_params(params)
+            specs = quantized_specs(specs, params["layers"])
+        elif cfg.quantization:
+            raise ValueError(f"unknown quantization {cfg.quantization!r}")
         self.params = psh.shard_params(params, specs, self.mesh, rules)
 
         # GQA: when tp exceeds the KV-head count the cache can't shard on

@@ -518,6 +518,7 @@ def main(argv=None) -> int:
     ap.add_argument("--max-seq-len", type=int, default=4096)
     ap.add_argument("--max-adapters", type=int, default=4)
     ap.add_argument("--decode-chunk", type=int, default=8)
+    ap.add_argument("--quantization", default="", choices=["", "int8"])
     ap.add_argument(
         "--pipeline", action="store_true",
         help="overlap decode chunks with host processing (direct PJRT targets)",
@@ -587,6 +588,7 @@ def main(argv=None) -> int:
             max_adapters=args.max_adapters,
             decode_chunk=args.decode_chunk,
             pipeline=args.pipeline,
+            quantization=args.quantization,
         ),
         eos_token_ids=tuple(getattr(tokenizer, "eos_token_ids", ())),
     )

@@ -31,6 +31,7 @@ from kubeai_tpu.ops.attention import (
     causal_prefill_attention,
     decode_attention,
 )
+from kubeai_tpu.engine.quantization import dequantize as _w
 from kubeai_tpu.parallel import sharding as sh
 
 
@@ -180,9 +181,9 @@ def init_params(cfg: LlamaConfig, key: jax.Array | None = None) -> dict:
 
 def _mlp(x, gate, up, down):
     return jnp.einsum(
-        "bsm,me->bse", jax.nn.silu(jnp.einsum("bse,em->bsm", x, gate))
-        * jnp.einsum("bse,em->bsm", x, up),
-        down,
+        "bsm,me->bse", jax.nn.silu(jnp.einsum("bse,em->bsm", x, _w(gate)))
+        * jnp.einsum("bse,em->bsm", x, _w(up)),
+        _w(down),
     )
 
 
@@ -281,7 +282,7 @@ def prefill(
         lor = scanned.get("l")
 
         def proj(h, w, target, bias=None):
-            out = jnp.einsum("bse,eh->bsh", h, w)
+            out = jnp.einsum("bse,eh->bsh", h, _w(w))
             if bias is not None:
                 out = out + bias
             if lor is not None:
@@ -345,7 +346,7 @@ def decode_step(
         kc, vc = scanned["kc"], scanned["vc"]
 
         def proj(h, w, target, bias=None):
-            out = jnp.einsum("be,eh->bh", h, w)
+            out = jnp.einsum("be,eh->bh", h, _w(w))
             if bias is not None:
                 out = out + bias
             if lor is not None:
@@ -392,19 +393,19 @@ def _trunk(params: dict, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray:
 
     def layer(x, lp):
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-        q = jnp.einsum("bse,eh->bsh", h, lp["wq"])
+        q = jnp.einsum("bse,eh->bsh", h, _w(lp["wq"]))
         if "bq" in lp:
             q = q + lp["bq"]
-        k = jnp.einsum("bse,eh->bsh", h, lp["wk"])
+        k = jnp.einsum("bse,eh->bsh", h, _w(lp["wk"]))
         if "bk" in lp:
             k = k + lp["bk"]
-        v = jnp.einsum("bse,eh->bsh", h, lp["wv"])
+        v = jnp.einsum("bse,eh->bsh", h, _w(lp["wv"]))
         if "bv" in lp:
             v = v + lp["bv"]
         q = apply_rope(q.reshape(B, S, H, D), positions, inv_freq)
         k = apply_rope(k.reshape(B, S, KVH, D), positions, inv_freq)
         attn = _prefill_attention(q, k, v.reshape(B, S, KVH, D))
-        x = x + jnp.einsum("bsh,he->bse", attn.reshape(B, S, H * D), lp["wo"])
+        x = x + jnp.einsum("bsh,he->bse", attn.reshape(B, S, H * D), _w(lp["wo"]))
         h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
         x = x + _mlp(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
         return x, None
