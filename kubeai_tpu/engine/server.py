@@ -99,7 +99,9 @@ class EngineServer:
             def do_GET(self):
                 path = self.path.split("?")[0]
                 if path == "/health":
-                    return self._json(200, {"status": "ok"})
+                    if outer.healthy():
+                        return self._json(200, {"status": "ok"})
+                    return self._json(503, {"status": "unhealthy"})
                 if path == "/metrics":
                     body = outer.metrics.registry.expose().encode()
                     self.send_response(200)
@@ -177,15 +179,31 @@ class EngineServer:
 
     def _serve_loop(self) -> None:
         while not self._stop.is_set():
-            if not self.engine.has_work():
-                self._work.wait(timeout=0.01)
-                self._work.clear()
-                continue
-            for ev in self.engine.step():
-                with self._sub_lock:
-                    q = self._subscribers.get(ev.rid)
-                if q is not None:
-                    q.put(ev)
+            try:
+                if not self.engine.has_work():
+                    self._work.wait(timeout=0.01)
+                    self._work.clear()
+                    continue
+                for ev in self.engine.step():
+                    with self._sub_lock:
+                        q = self._subscribers.get(ev.rid)
+                    if q is not None:
+                        q.put(ev)
+                self._last_progress = time.time()
+            except Exception:
+                # A dead serving loop must flip /health so the liveness
+                # probe restarts the Pod (the blocking LB then stops
+                # routing here) — failure detection parity with the
+                # reference's probe design (engine_vllm.go liveness).
+                logger.exception("serving loop crashed")
+                self._loop_dead = True
+                return
+
+    _loop_dead = False
+    _last_progress = 0.0
+
+    def healthy(self) -> bool:
+        return not self._loop_dead and not self._stop.is_set()
 
     # -- request handling -------------------------------------------------------
 

@@ -1,5 +1,12 @@
 """OpenAI-compatible HTTP front door (reference: internal/openaiserver).
 
+Request tracing: every request gets/propagates an `X-Request-Id` (set on
+the response and forwarded upstream), and completions emit a structured
+access log line with route/model/status/duration — the lightweight stand-
+in for the reference's otelhttp route tagging (reference:
+internal/openaiserver/handler.go:28-31; its OTel *tracing* is commented
+out upstream too, SURVEY.md §5.1).
+
 Mux:
   POST /openai/v1/chat/completions      → proxy
   POST /openai/v1/completions           → proxy
@@ -19,8 +26,13 @@ balancer's scale-from-zero wait without stalling others.
 from __future__ import annotations
 
 import json
+import logging
 import threading
+import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+access_log = logging.getLogger("kubeai.access")
 
 from kubeai_tpu.crd.model import Model
 from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
@@ -126,6 +138,10 @@ class OpenAIServer:
 
             def do_POST(self):
                 path = self.path.split("?")[0]
+                t0 = time.monotonic()
+                headers = self._headers_dict()
+                request_id = headers.get("x-request-id") or f"req-{uuid.uuid4().hex[:16]}"
+                headers["x-request-id"] = request_id
                 # Accept both /openai/v1/* (reference mux) and bare /v1/*.
                 normalized = path
                 if normalized.startswith("/v1/"):
@@ -140,9 +156,15 @@ class OpenAIServer:
                     # strip the /openai prefix when forwarding to engines
                     normalized[len("/openai"):],
                     body,
-                    self._headers_dict(),
+                    headers,
+                )
+                access_log.info(
+                    "route=%s request_id=%s status=%d duration_ms=%.1f",
+                    normalized, request_id, result.status,
+                    (time.monotonic() - t0) * 1e3,
                 )
                 self.send_response(result.status)
+                self.send_header("X-Request-Id", request_id)
                 has_length = any(
                     k.lower() == "content-length" for k, _ in result.headers
                 )

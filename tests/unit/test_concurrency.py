@@ -1,0 +1,126 @@
+"""Concurrency stress tests for the load-balancer group — the reference
+keeps these as its own tier (reference: internal/loadbalancer/group_test.go
++ group_bench_test.go concurrency benchmark)."""
+
+import threading
+
+from kubeai_tpu.routing.loadbalancer import Group
+
+
+def test_group_accounting_under_contention():
+    """Many threads acquiring/releasing against endpoint churn: in-flight
+    accounting must balance to zero and never go negative."""
+    g = Group()
+    eps = {f"10.0.0.{i}:8000": set() for i in range(4)}
+    g.reconcile_endpoints(eps)
+    errors = []
+    N_THREADS, N_ITERS = 16, 200
+
+    def worker(tid):
+        try:
+            for i in range(N_ITERS):
+                addr, done = g.get_best_addr(
+                    "PrefixHash" if i % 2 else "LeastLoad",
+                    "",
+                    f"prefix-{tid}-{i % 7}",
+                    timeout=5,
+                )
+                assert addr in eps
+                done()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def churner():
+        for i in range(50):
+            smaller = dict(list(eps.items())[: 2 + (i % 3)])
+            g.reconcile_endpoints(smaller)
+        g.reconcile_endpoints(eps)
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(N_THREADS)
+    ] + [threading.Thread(target=churner)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    assert g.total_in_flight == 0
+    for ep in g._endpoints.values():
+        assert ep.in_flight == 0
+
+
+def test_request_id_propagation():
+    """X-Request-Id is generated/propagated and echoed on responses."""
+    import json
+    import sys
+
+    sys.path.insert(0, "tests")
+    from testutil import FakeEngine
+
+    from kubeai_tpu.crd.model import Model, ModelSpec
+    from kubeai_tpu.operator.k8s.store import KubeStore
+    from kubeai_tpu.routing.loadbalancer import LoadBalancer
+    from kubeai_tpu.routing.modelclient import ModelClient
+    from kubeai_tpu.routing.openai_server import OpenAIServer
+    from kubeai_tpu.routing.proxy import ModelProxy
+
+    store = KubeStore()
+    lb = LoadBalancer(store, default_timeout=5)
+    mc = ModelClient(store)
+    server = OpenAIServer(ModelProxy(lb, mc), mc)
+    server.start()
+    engine = FakeEngine()
+    try:
+        store.create(
+            Model(
+                name="m1",
+                spec=ModelSpec(url="hf://o/m", engine="KubeAITPU",
+                               autoscaling_disabled=True, replicas=1),
+            ).to_dict()
+        )
+        store.create(
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": "model-m1-0", "namespace": "default",
+                    "labels": {"model": "m1"},
+                    "annotations": {"model-pod-ip": "127.0.0.1",
+                                    "model-pod-port": str(engine.port)},
+                },
+                "status": {"conditions": [{"type": "Ready", "status": "True"}],
+                           "podIP": "127.0.0.1"},
+            }
+        )
+        lb.sync_model("m1")
+
+        import http.client
+
+        host, _, port = server.address.partition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        conn.request(
+            "POST", "/openai/v1/completions",
+            body=json.dumps({"model": "m1", "prompt": "x"}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "trace-me-123"},
+        )
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.getheader("X-Request-Id") == "trace-me-123"
+        conn.close()
+
+        # Without a client-supplied id, one is generated.
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        conn.request(
+            "POST", "/openai/v1/completions",
+            body=json.dumps({"model": "m1", "prompt": "x"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        resp.read()
+        assert (resp.getheader("X-Request-Id") or "").startswith("req-")
+        conn.close()
+    finally:
+        server.stop()
+        lb.stop()
+        engine.stop()

@@ -250,3 +250,35 @@ def test_address_override_annotations_flow_to_pod(world):
     pod = model_pods(store, "m5")[0]
     assert pod["metadata"]["annotations"]["model-pod-ip"] == "127.0.0.1"
     assert pod["metadata"]["annotations"]["model-pod-port"] == "9999"
+
+
+def test_priority_class_rendered(world):
+    """(reference suite: test/integration/model_priority_test.go)"""
+    store, _, rec, _ = world
+    mk_model(store, name="mp", replicas=1, priority_class_name="high-priority")
+    rec.reconcile("default", "mp")
+    pod = model_pods(store, "mp")[0]
+    assert pod["spec"]["priorityClassName"] == "high-priority"
+
+
+def test_label_selector_multitenancy(world):
+    """(reference suite: test/integration/selector_test.go)"""
+    from kubeai_tpu.routing.modelclient import ModelClient, ModelNotFound
+
+    store, _, rec, _ = world
+    obj = mk_model(store, name="tenant-a-model", replicas=1)
+    obj["metadata"].setdefault("labels", {})["tenant"] = "a"
+    store.update(obj)
+
+    mc = ModelClient(store)
+    # Matching selector sees it; mismatching selector gets NotFound.
+    assert mc.lookup_model("tenant-a-model", selectors={"tenant": "a"})
+    import pytest as _pytest
+
+    with _pytest.raises(ModelNotFound):
+        mc.lookup_model("tenant-a-model", selectors={"tenant": "b"})
+    # Listing filters the same way.
+    assert [m.name for m in mc.list_all_models({"tenant": "a"})] == [
+        "tenant-a-model"
+    ]
+    assert mc.list_all_models({"tenant": "b"}) == []

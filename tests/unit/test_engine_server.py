@@ -274,3 +274,34 @@ def test_embeddings_endpoint(server):
 
     # probe: bad input types
     assert http_post(addr(server), "/v1/embeddings", {"input": [1, 2]})[0] == 400
+
+
+def test_health_degrades_when_loop_dies():
+    """Liveness honesty: a crashed serving loop must flip /health to 503."""
+    tok = ByteTokenizer()
+    cfg = llama.LlamaConfig.tiny(vocab_size=tok.vocab_size)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = Engine("llama", cfg, params,
+                 cfg=EngineConfig(num_slots=2, max_seq_len=64))
+    srv = EngineServer(eng, tok, "m", host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        assert http_get(f"127.0.0.1:{srv.port}", "/health")[0] == 200
+
+        # Sabotage the engine so the next step raises in the loop.
+        def boom():
+            raise RuntimeError("injected engine failure")
+
+        eng.step = boom
+        eng.has_work = lambda: True
+        import time as _t
+
+        deadline = _t.time() + 5
+        while _t.time() < deadline:
+            status, _ = http_get(f"127.0.0.1:{srv.port}", "/health")
+            if status == 503:
+                break
+            _t.sleep(0.05)
+        assert status == 503
+    finally:
+        srv.stop()
