@@ -46,6 +46,11 @@ class EngineConfig:
     num_slots: int = 8
     max_seq_len: int = 1024
     prefill_buckets: tuple[int, ...] = ()  # default: powers of 2 up to max
+    # Chunked prefill: prompts longer than this are prefilled in fixed
+    # [1, prefill_chunk] steps against the slot cache — ONE compiled graph
+    # for every prompt length and O(chunk * max_seq_len) activation memory
+    # (0 = whole-prompt bucketed prefill only). Requires family support.
+    prefill_chunk: int = 0
     cache_dtype: Any = jnp.bfloat16
     # Decode steps fused into one device call (lax.scan). Amortizes host
     # dispatch — critical when the chip sits behind an RPC tunnel. Tokens a
@@ -301,6 +306,83 @@ class Engine:
             out_shardings=(None, cache_sharding, cache_sharding, None),
         )
 
+        if self.cfg.prefill_chunk > 0:
+            if not hasattr(fam, "prefill_chunk") and fam.name != "llama":
+                raise ValueError(
+                    f"family {fam.name} does not support chunked prefill"
+                )
+            from kubeai_tpu.models import llama as _llama
+
+            chunk_fn = getattr(fam, "prefill_chunk", None) or _llama.prefill_chunk
+
+            def _slot_slice(c, slot):
+                nl, _, L, kvh, d = c.shape
+                sl = jax.lax.dynamic_slice(
+                    c, (0, slot, 0, 0, 0), (nl, 1, L, kvh, d)
+                )
+                return sl[:, 0]
+
+            def _slot_write(c, slot, sl):
+                return jax.lax.dynamic_update_slice(
+                    c, sl[:, None].astype(c.dtype), (0, slot, 0, 0, 0)
+                )
+
+            def _chunk_mid(params, tokens, ints, ck, cv, lora):
+                start, slot, length, adapter = ints[0], ints[1], ints[2], ints[3]
+                ks, vs = _slot_slice(ck, slot), _slot_slice(cv, slot)
+                _, ks, vs = chunk_fn(
+                    params, mcfg, tokens, start, length, ks, vs,
+                    want_logits=False,
+                    lora=lora,
+                    lora_idx=None if lora is None else adapter[None],
+                )
+                return _slot_write(ck, slot, ks), _slot_write(cv, slot, vs)
+
+            self._prefill_chunk_mid_jit = jax.jit(
+                _chunk_mid,
+                donate_argnums=(3, 4),
+                static_argnums=(),
+                out_shardings=(cache_sharding, cache_sharding),
+            )
+
+            def _chunk_last(params, tokens, ints, floats, ck, cv, state, lora):
+                start, slot, length = ints[0], ints[1], ints[2]
+                adapter, seed, topk = ints[3], ints[4], ints[5]
+                temp, topp = floats[0], floats[1]
+                ks, vs = _slot_slice(ck, slot), _slot_slice(cv, slot)
+                logits, ks, vs = chunk_fn(
+                    params, mcfg, tokens, start, length, ks, vs,
+                    want_logits=True,
+                    lora=lora,
+                    lora_idx=None if lora is None else adapter[None],
+                )
+                ck = _slot_write(ck, slot, ks)
+                cv = _slot_write(cv, slot, vs)
+                tok = sample(
+                    logits,
+                    seed.astype(jnp.uint32)[None],
+                    length[None],
+                    temp[None],
+                    topk[None],
+                    topp[None],
+                )[0]
+                state = dict(
+                    tokens=state["tokens"].at[slot].set(tok),
+                    positions=state["positions"].at[slot].set(length),
+                    seeds=state["seeds"].at[slot].set(seed.astype(jnp.uint32)),
+                    temp=state["temp"].at[slot].set(temp),
+                    topk=state["topk"].at[slot].set(topk),
+                    topp=state["topp"].at[slot].set(topp),
+                    lora_idx=state["lora_idx"].at[slot].set(adapter),
+                )
+                return tok, ck, cv, state
+
+            self._prefill_chunk_last_jit = jax.jit(
+                _chunk_last,
+                donate_argnums=(4, 5, 6),
+                out_shardings=(None, cache_sharding, cache_sharding, None),
+            )
+
     # ---- public API ---------------------------------------------------------
 
     def add_request(
@@ -368,6 +450,11 @@ class Engine:
             slot = self._free_slots.pop()
             req.slot = slot
             plen = len(req.prompt)
+            C = self.cfg.prefill_chunk
+            if C > 0 and plen > C:
+                tok = self._admit_chunked(req, slot, plen, C)
+                emitted.append(self._finish_admission(req, slot, plen, tok))
+                continue
             bucket = self._bucket(plen)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :plen] = req.prompt
@@ -396,17 +483,67 @@ class Engine:
                     self._lora,
                 )
             )
-            tok = int(tok_dev)
-            req.out_tokens.append(tok)
-            req.position = plen
-            req.last_token = tok
-            finished = self._check_stop(req)
-            emitted.append(StepEvent(req.rid, tok, finished, req.finish_reason))
-            if finished:
-                self._release(req)
-            else:
-                self._active[slot] = req
+            emitted.append(
+                self._finish_admission(req, slot, plen, int(tok_dev))
+            )
         return emitted
+
+    def _finish_admission(
+        self, req: _Request, slot: int, plen: int, tok: int
+    ) -> StepEvent:
+        req.out_tokens.append(tok)
+        req.position = plen
+        req.last_token = tok
+        finished = self._check_stop(req)
+        if finished:
+            self._release(req)
+        else:
+            self._active[slot] = req
+        return StepEvent(req.rid, tok, finished, req.finish_reason)
+
+    def _admit_chunked(self, req: _Request, slot: int, plen: int, C: int) -> int:
+        """Prefill a long prompt chunk-by-chunk into the slot cache; the
+        final chunk also samples the first token and updates slot state."""
+        n_chunks = -(-plen // C)
+        padded = np.zeros((1, n_chunks * C), np.int32)
+        padded[0, :plen] = req.prompt
+        for i in range(n_chunks - 1):
+            self.cache.k, self.cache.v = self._prefill_chunk_mid_jit(
+                self.params,
+                jnp.asarray(padded[:, i * C : (i + 1) * C]),
+                jnp.asarray(
+                    [i * C, slot, plen, req.adapter_idx], jnp.int32
+                ),
+                self.cache.k,
+                self.cache.v,
+                self._lora,
+            )
+        last = n_chunks - 1
+        tok_dev, self.cache.k, self.cache.v, self._state = (
+            self._prefill_chunk_last_jit(
+                self.params,
+                jnp.asarray(padded[:, last * C :]),
+                jnp.asarray(
+                    [
+                        last * C,
+                        slot,
+                        plen,
+                        req.adapter_idx,
+                        int(np.uint32(req.seed).view(np.int32)),
+                        req.params.top_k,
+                    ],
+                    jnp.int32,
+                ),
+                jnp.asarray(
+                    [req.params.temperature, req.params.top_p], jnp.float32
+                ),
+                self.cache.k,
+                self.cache.v,
+                self._state,
+                self._lora,
+            )
+        )
+        return int(tok_dev)
 
     def _check_stop(self, req: _Request) -> bool:
         if req.last_token in req.stop_token_ids:

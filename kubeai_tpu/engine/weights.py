@@ -386,3 +386,28 @@ def load_whisper_params(model_dir: str, cfg, dtype=jnp.float32) -> dict:
 
 
 _LOADERS["whisper"] = load_whisper_params
+
+
+# ---- native checkpoint format (orbax) ---------------------------------------
+#
+# Engine-side save/resume (SURVEY.md §5.4: the reference has no engine-side
+# checkpointing — weight loading is delegated to vLLM images; here the
+# engine can snapshot its post-conversion param tree so replica restarts
+# skip the HF->native mapping and load sharded directly from disk/GCS-fuse).
+
+
+def save_native_checkpoint(path: str, params) -> None:
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), params, force=True)
+        ckptr.wait_until_finished()
+
+
+def load_native_checkpoint(path: str, like=None):
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        if like is not None:
+            return ckptr.restore(os.path.abspath(path), like)
+        return ckptr.restore(os.path.abspath(path))

@@ -432,3 +432,83 @@ def hidden_states(
     return pooled / jnp.maximum(
         jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
     )
+
+
+def prefill_chunk(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [1, C] one chunk (right-padded on the last chunk)
+    start: jnp.ndarray,  # scalar int32: absolute position of tokens[:, 0]
+    length: jnp.ndarray,  # scalar int32: true total prompt length
+    k_slot: jnp.ndarray,  # [NL, L, KVH, D] this slot's cache
+    v_slot: jnp.ndarray,
+    want_logits: bool = False,
+    lora: dict | None = None,
+    lora_idx: jnp.ndarray | None = None,
+):
+    """One chunk of incremental prefill against the slot cache.
+
+    The same compiled graph serves every chunk of every prompt length
+    (static [1, C] shape) — unlike whole-prompt prefill, which compiles per
+    power-of-two bucket — and activation memory stays O(C * L) instead of
+    O(S^2). Stale cache contents beyond the causal frontier are masked by
+    position. Returns (logits_or_None, k_slot, v_slot).
+    """
+    B, C = tokens.shape
+    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    inv_freq = jnp.asarray(rope_frequencies(D, cfg.rope_theta, cfg.rope_scaling))
+    positions = start + jnp.arange(C)[None, :]
+    x = params["embed"][tokens]
+
+    def layer(x, scanned):
+        lp = scanned["p"]
+        lor = scanned.get("l")
+        kc, vc = scanned["kc"], scanned["vc"]  # [L, KVH, D]
+
+        def proj(h, w, target, bias=None):
+            out = jnp.einsum("bse,eh->bsh", h, _w(w))
+            if bias is not None:
+                out = out + bias
+            if lor is not None:
+                out = out + _lora_delta(
+                    h, lor[target]["A"], lor[target]["B"], lora_idx
+                )
+            return out
+
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q = proj(h, lp["wq"], "wq", lp.get("bq")).reshape(B, C, H, D)
+        k = proj(h, lp["wk"], "wk", lp.get("bk")).reshape(B, C, KVH, D)
+        v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, C, KVH, D)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        kc = jax.lax.dynamic_update_slice(
+            kc, k[0].astype(kc.dtype), (start, 0, 0)
+        )
+        vc = jax.lax.dynamic_update_slice(
+            vc, v[0].astype(vc.dtype), (start, 0, 0)
+        )
+        from kubeai_tpu.ops.attention import chunked_prefill_attention
+
+        attn = chunked_prefill_attention(
+            q, kc[None], vc[None], start[None]
+        )
+        x = x + proj(attn.reshape(B, C, H * D), lp["wo"], "wo")
+        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, {"kc": kc, "vc": vc}
+
+    xs = _scan_xs(params, lora)
+    xs["kc"] = k_slot
+    xs["vc"] = v_slot
+    x, caches = jax.lax.scan(layer, x, xs)
+    k_slot, v_slot = caches["kc"], caches["vc"]
+    if not want_logits:
+        return None, k_slot, v_slot
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    idx = jnp.clip(length - 1 - start, 0, C - 1)
+    last = jax.lax.dynamic_slice(x, (0, idx, 0), (1, 1, x.shape[-1]))[:, 0]
+    logits = jnp.einsum(
+        "be,ve->bv", last, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
+    return logits, k_slot, v_slot

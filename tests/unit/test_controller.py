@@ -282,3 +282,17 @@ def test_label_selector_multitenancy(world):
         "tenant-a-model"
     ]
     assert mc.list_all_models({"tenant": "b"}) == []
+
+
+def test_system_json_patches_applied_to_rendered_pods(world):
+    """(reference: internal/modelcontroller/patch_test.go + pod_plan.go:42)"""
+    store, cfg, rec, _ = world
+    cfg.model_server_pods.json_patches = [
+        {"op": "add", "path": "/metadata/labels/team", "value": "ml"},
+        {"op": "add", "path": "/spec/hostNetwork", "value": True},
+    ]
+    mk_model(store, name="mj", replicas=1)
+    rec.reconcile("default", "mj")
+    pod = model_pods(store, "mj")[0]
+    assert pod["metadata"]["labels"]["team"] == "ml"
+    assert pod["spec"]["hostNetwork"] is True

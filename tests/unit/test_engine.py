@@ -183,3 +183,43 @@ def test_int8_quantized_engine_close_to_bf16():
             cfg=EngineConfig(num_slots=2, max_seq_len=64, quantization="int8"),
         )
         assert q8tp.generate(prompts, GREEDY) == got
+
+
+def test_chunked_prefill_matches_bucketed():
+    """prefill_chunk engine path == whole-prompt path, greedy-token exact."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    base = Engine("llama", cfg, params,
+                  cfg=EngineConfig(num_slots=2, max_seq_len=64))
+    chunked = Engine(
+        "llama", cfg, params,
+        cfg=EngineConfig(num_slots=2, max_seq_len=64, prefill_chunk=8),
+    )
+    prompts = [list(range(1, 21)), [5, 6, 7]]  # 20 toks (3 chunks) + short
+    want = base.generate(prompts, GREEDY)
+    got = chunked.generate(prompts, GREEDY)
+    assert got == want
+
+
+def test_chunked_prefill_with_lora_and_seeds():
+    import numpy as np
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    r, E, H, D, NL = 4, cfg.hidden_size, cfg.num_heads, cfg.head_size, cfg.num_layers
+    A = (rng.standard_normal((NL, E, r)) * 0.8).astype(np.float32)
+    B = (rng.standard_normal((NL, r, H * D)) * 0.8).astype(np.float32)
+    mk = lambda pc: Engine(
+        "llama", cfg, params,
+        cfg=EngineConfig(num_slots=2, max_seq_len=64, prefill_chunk=pc,
+                         max_adapters=1, max_lora_rank=8),
+    )
+    base, chunked = mk(0), mk(8)
+    for e in (base, chunked):
+        e.load_adapter("fin", {"wq": (A, B)})
+    prompt = list(range(1, 19))
+    sp = SamplingParams(temperature=0.8, top_k=30, max_tokens=6, seed=42)
+    assert base.generate([prompt], sp, adapter="fin") == chunked.generate(
+        [prompt], sp, adapter="fin"
+    )

@@ -114,3 +114,21 @@ def test_resolve_model_dir_pvc_and_local(tmp_path):
     d.mkdir()
     assert resolve_model_dir(str(d)) == str(d)
     assert resolve_model_dir("hf://x", model_dir="/cache/dir") == "/cache/dir"
+
+
+def test_native_checkpoint_roundtrip(tmp_path):
+    """Orbax save/restore of the engine's native param tree."""
+    import jax
+
+    from kubeai_tpu.engine.weights import (
+        load_native_checkpoint,
+        save_native_checkpoint,
+    )
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(5))
+    path = str(tmp_path / "ckpt")
+    save_native_checkpoint(path, params)
+    restored = load_native_checkpoint(path, like=params)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
