@@ -26,6 +26,11 @@ class ConfigError(ValueError):
     pass
 
 
+# GKE TPU node labels (reference: charts/kubeai/values-gke.yaml:18-41).
+TPU_ACCELERATOR_SELECTOR = "cloud.google.com/gke-tpu-accelerator"
+TPU_TOPOLOGY_SELECTOR = "cloud.google.com/gke-tpu-topology"
+
+
 @dataclasses.dataclass
 class ResourceProfile:
     """Compute class multiplied by `resourceProfile: name:count`
@@ -42,11 +47,11 @@ class ResourceProfile:
 
     @property
     def tpu_topology(self) -> str | None:
-        return self.node_selector.get("gke-tpu-topology")
+        return self.node_selector.get(TPU_TOPOLOGY_SELECTOR)
 
     @property
     def tpu_accelerator(self) -> str | None:
-        return self.node_selector.get("gke-tpu-accelerator")
+        return self.node_selector.get(TPU_ACCELERATOR_SELECTOR)
 
 
 @dataclasses.dataclass
@@ -211,14 +216,17 @@ def default_resource_profiles() -> dict[str, ResourceProfile]:
             node_selector={"cloud.google.com/gke-accelerator": "nvidia-l4"},
         ),
     }
-    for topo, chips in (("1x1", 1), ("2x2", 4), ("2x4", 8)):
+    # One chip per profile unit: `resourceProfile: google-tpu-v5e-2x2:4`
+    # multiplies to the slice's 4 chips (reference semantics,
+    # charts/kubeai/values-gke.yaml:18-41 + charts/models/values.yaml:128).
+    for topo in ("1x1", "2x2", "2x4"):
         profiles[f"google-tpu-v5e-{topo}"] = ResourceProfile(
             image_name="google-tpu",
-            requests={"google.com/tpu": str(chips)},
-            limits={"google.com/tpu": str(chips)},
+            requests={"google.com/tpu": "1"},
+            limits={"google.com/tpu": "1"},
             node_selector={
-                "gke-tpu-accelerator": "tpu-v5-lite-podslice",
-                "gke-tpu-topology": topo,
+                TPU_ACCELERATOR_SELECTOR: "tpu-v5-lite-podslice",
+                TPU_TOPOLOGY_SELECTOR: topo,
             },
         )
     return profiles
@@ -427,13 +435,4 @@ def system_from_dict(data: dict) -> System:
     return sys_obj
 
 
-def _seconds(v) -> float:
-    """Parse Go-style durations ('10s', '3m') or bare numbers."""
-    if isinstance(v, (int, float)):
-        return float(v)
-    s = str(v).strip()
-    units = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}
-    for suffix in ("ms", "s", "m", "h"):
-        if s.endswith(suffix):
-            return float(s[: -len(suffix)]) * units[suffix]
-    return float(s)
+from kubeai_tpu.utils.units import parse_duration_seconds as _seconds  # noqa: E402

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from kubeai_tpu.models.registry import ModelFamily, register_model_family
 from kubeai_tpu.ops.attention import decode_attention
 from kubeai_tpu.models.llama import _prefill_attention
+from kubeai_tpu.ops.attention import causal_prefill_attention
 from kubeai_tpu.ops.norms import rms_norm
 from kubeai_tpu.ops.rope import apply_rope, rope_frequencies
 from kubeai_tpu.parallel import sharding as sh
@@ -195,7 +196,14 @@ def prefill(params, cfg, tokens, lengths, lora=None, lora_idx=None):
         v = jnp.einsum("bse,eh->bsh", h, lp["wv"]).reshape(B, S, KVH, D)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        attn = _prefill_attention(q * (_q_scale(cfg) * D ** 0.5), k, v)
+        qs = q * (_q_scale(cfg) * D ** 0.5)
+        if cfg.attn_logit_softcapping is not None:
+            # Softcapping needs the raw-logit path (not the flash kernel).
+            attn = causal_prefill_attention(
+                qs, k, v, logit_softcap=cfg.attn_logit_softcapping
+            )
+        else:
+            attn = _prefill_attention(qs, k, v)
         a_out = jnp.einsum(
             "bsh,he->bse", attn.reshape(B, S, H * D), lp["wo"]
         )
@@ -245,7 +253,8 @@ def decode_step(params, cfg, tokens, positions, k_cache, v_cache,
         kc = kc.at[slot_idx, positions].set(k.astype(kc.dtype))
         vc = vc.at[slot_idx, positions].set(v.astype(vc.dtype))
         attn = decode_attention(
-            q * (_q_scale(cfg) * D ** 0.5), kc, vc, lengths
+            q * (_q_scale(cfg) * D ** 0.5), kc, vc, lengths,
+            logit_softcap=cfg.attn_logit_softcapping,
         )
         a_out = jnp.einsum("bh,he->be", attn.reshape(B, H * D), lp["wo"])
         if cfg.sandwich_norms:

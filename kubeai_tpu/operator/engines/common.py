@@ -10,11 +10,11 @@ Mirrors:
 from __future__ import annotations
 
 import dataclasses
-import re
 from urllib.parse import parse_qs, urlparse
 
 from kubeai_tpu.config import System, ResourceProfile
 from kubeai_tpu.crd.model import Model
+from kubeai_tpu.utils.units import multiply_quantity
 
 
 class ResolutionError(ValueError):
@@ -71,28 +71,14 @@ class ModelConfig:
 
     @property
     def tpu_topology(self) -> str | None:
-        return self.node_selector.get("gke-tpu-topology")
+        from kubeai_tpu.config.system import TPU_TOPOLOGY_SELECTOR
+
+        return self.node_selector.get(TPU_TOPOLOGY_SELECTOR)
 
     @property
     def tpu_chips(self) -> int:
         v = self.limits.get("google.com/tpu") or self.requests.get("google.com/tpu")
         return int(v) if v else 0
-
-
-_QTY_RE = re.compile(r"^([0-9.]+)([a-zA-Z]*)$")
-
-
-def multiply_quantity(q: str, n: int) -> str:
-    """Multiply a k8s quantity string ('4', '2Gi', '500m') by n
-    (reference: model_controller.go:274-306 multiplies profile resources)."""
-    m = _QTY_RE.match(str(q))
-    if not m:
-        raise ResolutionError(f"bad quantity {q!r}")
-    num, unit = m.groups()
-    val = float(num) * n
-    if val.is_integer():
-        return f"{int(val)}{unit}"
-    return f"{val}{unit}"
 
 
 def resolve_model_config(model: Model, cfg: System) -> ModelConfig:

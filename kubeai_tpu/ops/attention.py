@@ -38,6 +38,7 @@ def causal_prefill_attention(
     *,
     q_offset: jnp.ndarray | int = 0,  # positions of q within the sequence
     scale: float | None = None,
+    logit_softcap: float | None = None,  # Gemma-2 tanh capping
 ) -> jnp.ndarray:
     """Causal self-attention over a freshly computed prompt segment.
 
@@ -52,6 +53,8 @@ def causal_prefill_attention(
     logits = jnp.einsum(
         "bqkgd,bskd->bkgqs", qg.astype(jnp.float32), k.astype(jnp.float32)
     )
+    if logit_softcap is not None:
+        logits = jnp.tanh(logits / logit_softcap) * logit_softcap
     q_pos = jnp.arange(s) + q_offset
     k_pos = jnp.arange(k.shape[1])
     mask = q_pos[:, None] >= k_pos[None, :]  # [Sq, Sk]
@@ -93,6 +96,7 @@ def decode_attention(
     lengths: jnp.ndarray,  # [B] valid cache length per slot (incl. new token)
     *,
     scale: float | None = None,
+    logit_softcap: float | None = None,  # Gemma-2 tanh capping
 ) -> jnp.ndarray:
     """Single-token decode attention against the slot cache with length mask."""
     b, h, d = q.shape
@@ -102,6 +106,8 @@ def decode_attention(
     logits = jnp.einsum(
         "bkgd,blkd->bkgl", qg.astype(jnp.float32), k_cache.astype(jnp.float32)
     )
+    if logit_softcap is not None:
+        logits = jnp.tanh(logits / logit_softcap) * logit_softcap
     l_pos = jnp.arange(k_cache.shape[1])
     mask = l_pos[None, :] < lengths[:, None]  # [B, L]
     logits = jnp.where(mask[:, None, None], logits, NEG_INF)

@@ -1,0 +1,8 @@
+"""Shared utilities: Kubernetes quantity/duration parsing, misc helpers."""
+
+from kubeai_tpu.utils.units import (
+    parse_duration_seconds,
+    parse_quantity,
+    multiply_quantity,
+    format_quantity,
+)

@@ -1,0 +1,61 @@
+"""Kubernetes-style quantities and Go-style durations.
+
+Shared by the config loader (interval/timeWindow durations —
+reference: internal/config/system.go duration fields) and the engine
+renderers (resource profile multiplication —
+reference: internal/modelcontroller/model_controller.go:274-306).
+"""
+
+from __future__ import annotations
+
+import re
+
+_DURATION_UNITS = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def parse_duration_seconds(v) -> float:
+    """'10s' / '3m' / '250ms' / bare numbers -> seconds."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    for suffix in ("ms", "s", "m", "h"):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * _DURATION_UNITS[suffix]
+    return float(s)
+
+
+_QTY_RE = re.compile(r"^([0-9.]+)([a-zA-Z]*)$")
+
+# Binary and decimal suffix multipliers (memory quantities).
+_QTY_SUFFIX = {
+    "": 1,
+    "m": 1e-3,  # milli (cpu)
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+}
+
+
+def parse_quantity(q) -> float:
+    """'4' / '500m' / '2Gi' -> float in base units."""
+    m = _QTY_RE.match(str(q).strip())
+    if not m:
+        raise ValueError(f"bad quantity {q!r}")
+    num, unit = m.groups()
+    if unit not in _QTY_SUFFIX:
+        raise ValueError(f"unknown quantity suffix {unit!r} in {q!r}")
+    return float(num) * _QTY_SUFFIX[unit]
+
+
+def format_quantity(value: float, unit: str) -> str:
+    if value == int(value):
+        return f"{int(value)}{unit}"
+    return f"{value}{unit}"
+
+
+def multiply_quantity(q, n: int) -> str:
+    """Multiply a quantity string by n, preserving its suffix."""
+    m = _QTY_RE.match(str(q).strip())
+    if not m:
+        raise ValueError(f"bad quantity {q!r}")
+    num, unit = m.groups()
+    return format_quantity(float(num) * n, unit)

@@ -76,7 +76,7 @@ def test_create_model_creates_pods(world):
     # TPU rendering: google.com/tpu resources + topology nodeSelector.
     c = pod["spec"]["containers"][0]
     assert c["resources"]["limits"]["google.com/tpu"] == "1"
-    assert pod["spec"]["nodeSelector"]["gke-tpu-accelerator"]
+    assert pod["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"]
     assert k8sutils.get_label(pod, md.POD_HASH_LABEL)
     # Owner reference points at the Model.
     assert pod["metadata"]["ownerReferences"][0]["kind"] == "Model"

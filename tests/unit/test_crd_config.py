@@ -108,7 +108,7 @@ def test_system_from_dict_camel_case():
                 "google-tpu-v5e-2x2": {
                     "imageName": "google-tpu",
                     "requests": {"google.com/tpu": 4},
-                    "nodeSelector": {"gke-tpu-topology": "2x2"},
+                    "nodeSelector": {"cloud.google.com/gke-tpu-topology": "2x2"},
                 }
             },
             "modelAutoscaling": {"interval": "5s", "timeWindow": "10m"},

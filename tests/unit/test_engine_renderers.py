@@ -1,0 +1,145 @@
+"""Engine Pod renderer goldens (reference suites: engine_ollama_test.go,
+model_source_test.go, pod-spec goldens in pod_plan_test.go)."""
+
+import pytest
+
+from kubeai_tpu.config import System
+from kubeai_tpu.crd.model import Model, ModelSpec
+from kubeai_tpu.operator.engines import render_pod, resolve_model_config
+from kubeai_tpu.operator.engines.common import parse_model_source
+
+
+@pytest.fixture
+def cfg():
+    return System().default_and_validate()
+
+
+def mk(engine, url, **kw):
+    spec = ModelSpec(url=url, engine=engine, autoscaling_disabled=True,
+                     replicas=1)
+    for k, v in kw.items():
+        setattr(spec, k, v)
+    m = Model(name="m", spec=spec)
+    m.validate()
+    return m
+
+
+def render(cfg, model):
+    return render_pod(model, cfg, resolve_model_config(model, cfg), "x")
+
+
+def container(pod):
+    return pod["spec"]["containers"][0]
+
+
+def env_dict(c):
+    return {e["name"]: e.get("value") for e in c["env"]}
+
+
+def test_model_source_parsing():
+    s = parse_model_source("ollama://gemma2:2b?pull=always&insecure=true")
+    assert s.scheme == "ollama" and s.ref == "gemma2:2b"
+    assert s.pull_policy == "always" and s.insecure
+    s = parse_model_source("hf://org/repo?model=alias")
+    assert s.named_model == "alias"
+    s = parse_model_source("pvc://my-claim/sub/path")
+    assert s.ref == "my-claim/sub/path"
+
+
+def test_ollama_renderer_probe_script(cfg):
+    m = mk("OLlama", "ollama://gemma2:2b")
+    pod = render(cfg, m)
+    c = container(pod)
+    script = " ".join(c["startupProbe"]["exec"]["command"])
+    # pull if missing, rename to the Model name, warm up.
+    assert "ollama pull gemma2:2b" in script
+    assert "ollama cp gemma2:2b m" in script
+    assert "ollama run m" in script
+    env = env_dict(c)
+    assert env["OLLAMA_KEEP_ALIVE"] == "999999h"
+
+    # pull=never skips the pull entirely.
+    m2 = mk("OLlama", "ollama://gemma2:2b?pull=never")
+    script2 = " ".join(
+        container(render(cfg, m2))["startupProbe"]["exec"]["command"]
+    )
+    assert "pull" not in script2
+
+
+def test_vllm_renderer(cfg):
+    from kubeai_tpu.crd.model import Adapter
+
+    m = mk("VLLM", "hf://meta-llama/Llama-3.1-8B",
+           adapters=[Adapter(name="a1", url="hf://o/a")])
+    pod = render(cfg, m)
+    c = container(pod)
+    assert "--model=meta-llama/Llama-3.1-8B" in c["args"]
+    assert "--served-model-name=m" in c["args"]
+    assert "--enable-lora" in c["args"]
+    assert env_dict(c)["VLLM_ALLOW_RUNTIME_LORA_UPDATING"] == "True"
+    # /dev/shm for torch IPC; adapter loader sidecar present.
+    vols = {v["name"] for v in pod["spec"]["volumes"]}
+    assert "dshm" in vols
+    sidecars = [ic["name"] for ic in pod["spec"].get("initContainers", [])]
+    assert "loader" in sidecars
+    # 3h startup budget.
+    sp = c["startupProbe"]
+    assert sp["periodSeconds"] * sp["failureThreshold"] >= 3 * 3600
+
+
+def test_vllm_s3_uses_streamer(cfg):
+    m = mk("VLLM", "s3://bucket/path")
+    c = container(render(cfg, m))
+    assert "--load-format=runai_streamer" in c["args"]
+    assert any(e["name"] == "AWS_ACCESS_KEY_ID" for e in c["env"])
+
+
+def test_fasterwhisper_and_infinity_env(cfg):
+    m = mk("FasterWhisper", "hf://Systran/faster-whisper-medium-en",
+           features=["SpeechToText"])
+    env = env_dict(container(render(cfg, m)))
+    assert env["WHISPER__MODEL"] == "Systran/faster-whisper-medium-en"
+
+    m = mk("Infinity", "hf://BAAI/bge-small-en-v1.5",
+           features=["TextEmbedding"])
+    env = env_dict(container(render(cfg, m)))
+    assert env["INFINITY_MODEL_ID"] == "BAAI/bge-small-en-v1.5"
+    assert env["INFINITY_SERVED_MODEL_NAME"] == "m"
+
+
+def test_kubeai_tpu_renderer_topology(cfg):
+    m = mk("KubeAITPU", "hf://org/model",
+           resource_profile="google-tpu-v5e-2x4:8")
+    pod = render(cfg, m)
+    c = container(pod)
+    # Profile is 1 chip/unit; :8 multiplies to the full 2x4 slice.
+    assert c["resources"]["limits"]["google.com/tpu"] == "8"
+    assert (
+        pod["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"]
+        == "2x4"
+    )
+    env = env_dict(c)
+    assert env["TPU_TOPOLOGY"] == "2x4" and env["TPU_CHIPS"] == "8"
+    assert "--tpu-topology" in c["args"]
+
+
+def test_files_projected_via_configmap(cfg):
+    from kubeai_tpu.crd.model import File
+
+    m = mk("KubeAITPU", "hf://org/model",
+           files=[File(path="/etc/cfg/a.json", content="{}")])
+    pod = render(cfg, m)
+    mounts = {v["mountPath"] for v in container(pod)["volumeMounts"]}
+    assert "/etc/cfg/a.json" in mounts
+    vols = [v for v in pod["spec"]["volumes"] if v["name"] == "model-files"]
+    assert vols and vols[0]["configMap"]["name"] == "model-m-files"
+
+
+def test_pvc_source_mounts_readonly(cfg):
+    m = mk("KubeAITPU", "pvc://weights-claim/llama")
+    pod = render(cfg, m)
+    vols = [v for v in pod["spec"]["volumes"] if v["name"] == "model-pvc"]
+    assert vols[0]["persistentVolumeClaim"]["claimName"] == "weights-claim"
+    mounts = [m_ for m_ in container(pod)["volumeMounts"]
+              if m_["name"] == "model-pvc"]
+    assert mounts[0]["readOnly"] is True

@@ -113,3 +113,22 @@ def test_mixtral_expert_parallel_matches_single(devices8):
     eng4 = Engine("mixtral", cfg, params, mesh=mesh, cfg=ecfg)
     prompts = [[1, 2, 3, 4], [9, 8, 7]]
     assert eng1.generate(prompts, GREEDY) == eng4.generate(prompts, GREEDY)
+
+
+def test_gemma2_parity(tmp_path):
+    """Gemma-2: sandwich norms + attention/final logit softcapping."""
+    torch = pytest.importorskip("torch")
+    from transformers import Gemma2Config as HFG2, Gemma2ForCausalLM
+
+    hf_cfg = HFG2(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rope_theta=10000.0, max_position_embeddings=512,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        query_pre_attn_scalar=16, sliding_window=512,  # > seq len: behaves as full attention
+    )
+    torch.manual_seed(4)
+    model = Gemma2ForCausalLM(hf_cfg)
+    model.eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    _roundtrip("gemma", model, tmp_path)
