@@ -22,6 +22,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import queue
 import threading
 import time
@@ -532,6 +533,18 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--tpu-topology", default="")
+    # Multi-host slices (v5e-4x4 and larger span hosts): every host runs
+    # this server process; JAX's distributed runtime wires them into one
+    # mesh over DCN for init + ICI for collectives. On GKE these come from
+    # the TPU podslice environment (reference parity: the operator treats a
+    # replica as one Pod; a multi-host replica is one Pod per host behind
+    # the same headless service).
+    ap.add_argument("--dcn-coordinator", default=os.environ.get("TPU_COORDINATOR", ""),
+                    help="host:port of process 0 (enables jax.distributed)")
+    ap.add_argument("--process-id", type=int,
+                    default=int(os.environ.get("TPU_PROCESS_ID", "0")))
+    ap.add_argument("--num-processes", type=int,
+                    default=int(os.environ.get("TPU_PROCESS_COUNT", "1")))
     ap.add_argument("--num-slots", type=int, default=32)
     ap.add_argument("--max-seq-len", type=int, default=4096)
     ap.add_argument("--max-adapters", type=int, default=4)
@@ -545,6 +558,19 @@ def main(argv=None) -> int:
 
     logging.basicConfig(level=logging.INFO)
     log = logging.getLogger("kubeai-tpu-engine")
+
+    if args.dcn_coordinator and args.num_processes > 1:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=args.dcn_coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+        log.info(
+            "joined distributed runtime: process %d/%d via %s",
+            args.process_id, args.num_processes, args.dcn_coordinator,
+        )
 
     from kubeai_tpu.engine.weights import (
         load_hf_config,
