@@ -1,0 +1,188 @@
+"""Paged KV cache: block-table paging over a shared page pool.
+
+The slot cache (kvcache.py) preallocates [slots, max_seq_len] per slot —
+simple and fast, but HBM scales with the worst case. Paging allocates
+fixed-size pages on demand from a shared pool, so memory scales with the
+TOKENS ACTUALLY RESIDENT, buying more concurrent slots per chip under
+mixed-length traffic (the vLLM insight, rebuilt TPU-style: static
+shapes — the pool and block tables are fixed-size buffers; only their
+CONTENTS change).
+
+Layout:
+  k_pages / v_pages: [NL, n_pages, page_size, KVH, D]
+  block_tables:      [slots, max_pages_per_slot] int32 (page ids; -1 free)
+  host allocator:    free-list of page ids (bookkeeping outside jit)
+
+Ops (jit-safe, tested against contiguous semantics):
+  gather_slot_kv     — virtual [slots, L] view for decode attention
+  scatter_token      — write one token's K/V per slot through the tables
+  insert_sequence    — write a prefilled sequence through the tables
+
+Engine integration (cache_mode="paged" + a Pallas ragged-paged-attention
+decode kernel that reads pages in place instead of gathering) is the
+round-2 item tracked in ROADMAP.md; this module is the validated
+bookkeeping + functional reference it drops into.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+class OutOfPages(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class PagedKVCache:
+    k_pages: jax.Array  # [NL, n_pages, page, KVH, D]
+    v_pages: jax.Array
+    block_tables: jax.Array  # [slots, max_pages] int32, -1 = unallocated
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[2]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k_pages.shape[1]
+
+    @property
+    def max_pages_per_slot(self) -> int:
+        return self.block_tables.shape[1]
+
+    @staticmethod
+    def create(
+        num_layers: int,
+        num_pages: int,
+        page_size: int,
+        num_slots: int,
+        max_seq_len: int,
+        kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,
+    ) -> "PagedKVCache":
+        max_pages = -(-max_seq_len // page_size)
+        shape = (num_layers, num_pages, page_size, kv_heads, head_dim)
+        return PagedKVCache(
+            k_pages=jnp.zeros(shape, dtype),
+            v_pages=jnp.zeros(shape, dtype),
+            block_tables=jnp.full((num_slots, max_pages), -1, jnp.int32),
+        )
+
+
+jax.tree_util.register_dataclass(
+    PagedKVCache, ["k_pages", "v_pages", "block_tables"], []
+)
+
+
+class PageAllocator:
+    """Host-side free-list. The device never sees allocation — only the
+    resulting block tables."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        self.page_size = page_size
+        self._free = list(range(num_pages))
+        # slot -> allocated page ids, in order.
+        self._owned: dict[int, list[int]] = {}
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_for(self, slot: int) -> list[int]:
+        return list(self._owned.get(slot, []))
+
+    def ensure(self, slot: int, length: int) -> list[int]:
+        """Grow slot's allocation to cover `length` tokens. Returns the page
+        list. Raises OutOfPages when the pool is exhausted (caller should
+        defer admission — backpressure, not corruption)."""
+        need = -(-length // self.page_size)
+        owned = self._owned.setdefault(slot, [])
+        while len(owned) < need:
+            if not self._free:
+                raise OutOfPages(
+                    f"page pool exhausted ({need} needed for slot {slot})"
+                )
+            owned.append(self._free.pop())
+        return list(owned)
+
+    def release(self, slot: int) -> None:
+        self._free.extend(self._owned.pop(slot, []))
+
+
+def set_block_table(
+    block_tables: jax.Array, slot: int, pages: list[int]
+) -> jax.Array:
+    row = jnp.full((block_tables.shape[1],), -1, jnp.int32)
+    if pages:
+        row = row.at[: len(pages)].set(jnp.asarray(pages, jnp.int32))
+    return block_tables.at[slot].set(row)
+
+
+def gather_slot_kv(cache: PagedKVCache) -> tuple[jax.Array, jax.Array]:
+    """Materialize the virtual contiguous view [NL, slots, L_max, KVH, D].
+
+    L_max = max_pages_per_slot * page_size. Unallocated pages (-1) index
+    page 0 — garbage that decode attention masks via per-slot lengths.
+    This is the functional reference; the paged-attention kernel reads
+    pages in place and never materializes this view.
+    """
+    bt = jnp.maximum(cache.block_tables, 0)  # [slots, max_pages]
+    k = cache.k_pages[:, bt]  # [NL, slots, max_pages, page, KVH, D]
+    v = cache.v_pages[:, bt]
+    nl, slots, mp, page, kvh, d = k.shape
+    return (
+        k.reshape(nl, slots, mp * page, kvh, d),
+        v.reshape(nl, slots, mp * page, kvh, d),
+    )
+
+
+def scatter_token(
+    cache: PagedKVCache,
+    k_new: jax.Array,  # [NL, slots, KVH, D] one token per slot
+    v_new: jax.Array,
+    positions: jax.Array,  # [slots] absolute position of the token
+) -> PagedKVCache:
+    """Write one token per slot through the block tables (decode step)."""
+    page = cache.page_size
+    slot_idx = jnp.arange(cache.block_tables.shape[0])
+    page_ids = cache.block_tables[slot_idx, positions // page]  # [slots]
+    page_ids = jnp.maximum(page_ids, 0)  # unallocated slots write page 0 junk
+    offsets = positions % page
+    k_pages = cache.k_pages.at[:, page_ids, offsets].set(
+        k_new.astype(cache.k_pages.dtype)
+    )
+    v_pages = cache.v_pages.at[:, page_ids, offsets].set(
+        v_new.astype(cache.v_pages.dtype)
+    )
+    return PagedKVCache(k_pages, v_pages, cache.block_tables)
+
+
+def insert_sequence(
+    cache: PagedKVCache,
+    k_seq: jax.Array,  # [NL, S, KVH, D] prefilled sequence (padded)
+    v_seq: jax.Array,
+    slot: int,
+    length: int,
+) -> PagedKVCache:
+    """Write a prefilled sequence through slot's block table (admission)."""
+    page = cache.page_size
+    bt = cache.block_tables
+    k_pages, v_pages = cache.k_pages, cache.v_pages
+    n_pages = -(-length // page)
+    for p in range(n_pages):
+        pid = bt[slot, p]
+        pid = jnp.maximum(pid, 0)
+        start = p * page
+        count = min(page, length - start)
+        k_pages = k_pages.at[:, pid, :count].set(
+            k_seq[:, start : start + count].astype(k_pages.dtype)
+        )
+        v_pages = v_pages.at[:, pid, :count].set(
+            v_seq[:, start : start + count].astype(v_pages.dtype)
+        )
+    return PagedKVCache(k_pages, v_pages, bt)

@@ -31,11 +31,13 @@ def test_leader_election_single_winner_and_failover():
     a = LeaderElection(store, "pod-a", lease_duration=0.5, retry_period=0.05)
     b = LeaderElection(store, "pod-b", lease_duration=0.5, retry_period=0.05)
     a.start(), b.start()
-    time.sleep(0.3)
+    deadline = time.time() + 5
+    while time.time() < deadline and not (a.is_leader or b.is_leader):
+        time.sleep(0.02)
     assert a.is_leader != b.is_leader  # exactly one leader
     leader, follower = (a, b) if a.is_leader else (b, a)
     leader.stop()  # releases the lease
-    deadline = time.time() + 3
+    deadline = time.time() + 5
     while time.time() < deadline and not follower.is_leader:
         time.sleep(0.05)
     assert follower.is_leader
