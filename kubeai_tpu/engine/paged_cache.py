@@ -79,13 +79,25 @@ jax.tree_util.register_dataclass(
 )
 
 
+class SequenceTooLong(RuntimeError):
+    pass
+
+
 class PageAllocator:
     """Host-side free-list. The device never sees allocation — only the
-    resulting block tables."""
+    resulting block tables.
 
-    def __init__(self, num_pages: int, page_size: int):
+    Page 0 is RESERVED as a scratch page and never handed out: jit-safe
+    ops clamp unallocated block-table entries (-1) to 0, so reads hit
+    masked junk and writes land in scratch — never in a live sequence."""
+
+    def __init__(
+        self, num_pages: int, page_size: int,
+        max_pages_per_slot: int | None = None,
+    ):
         self.page_size = page_size
-        self._free = list(range(num_pages))
+        self.max_pages_per_slot = max_pages_per_slot
+        self._free = list(range(1, num_pages))  # page 0 reserved
         # slot -> allocated page ids, in order.
         self._owned: dict[int, list[int]] = {}
 
@@ -98,16 +110,25 @@ class PageAllocator:
 
     def ensure(self, slot: int, length: int) -> list[int]:
         """Grow slot's allocation to cover `length` tokens. Returns the page
-        list. Raises OutOfPages when the pool is exhausted (caller should
-        defer admission — backpressure, not corruption)."""
+        list. Raises OutOfPages when the pool is exhausted (pages taken in
+        the failed call are rolled back, so a deferred admission holds
+        nothing) and SequenceTooLong past the per-slot block-table cap."""
         need = -(-length // self.page_size)
+        if self.max_pages_per_slot is not None and need > self.max_pages_per_slot:
+            raise SequenceTooLong(
+                f"{length} tokens need {need} pages > per-slot cap "
+                f"{self.max_pages_per_slot}"
+            )
         owned = self._owned.setdefault(slot, [])
-        while len(owned) < need:
+        taken: list[int] = []
+        while len(owned) + len(taken) < need:
             if not self._free:
+                self._free.extend(taken)  # roll back: hold nothing on failure
                 raise OutOfPages(
                     f"page pool exhausted ({need} needed for slot {slot})"
                 )
-            owned.append(self._free.pop())
+            taken.append(self._free.pop())
+        owned.extend(taken)
         return list(owned)
 
     def release(self, slot: int) -> None:
@@ -131,7 +152,7 @@ def gather_slot_kv(cache: PagedKVCache) -> tuple[jax.Array, jax.Array]:
     This is the functional reference; the paged-attention kernel reads
     pages in place and never materializes this view.
     """
-    bt = jnp.maximum(cache.block_tables, 0)  # [slots, max_pages]
+    bt = jnp.maximum(cache.block_tables, 0)  # -1 -> reserved scratch page 0
     k = cache.k_pages[:, bt]  # [NL, slots, max_pages, page, KVH, D]
     v = cache.v_pages[:, bt]
     nl, slots, mp, page, kvh, d = k.shape
@@ -151,7 +172,9 @@ def scatter_token(
     page = cache.page_size
     slot_idx = jnp.arange(cache.block_tables.shape[0])
     page_ids = cache.block_tables[slot_idx, positions // page]  # [slots]
-    page_ids = jnp.maximum(page_ids, 0)  # unallocated slots write page 0 junk
+    # Unallocated slots (-1) write into the RESERVED scratch page 0 — safe
+    # because the allocator never hands page 0 to a live sequence.
+    page_ids = jnp.maximum(page_ids, 0)
     offsets = positions % page
     k_pages = cache.k_pages.at[:, page_ids, offsets].set(
         k_new.astype(cache.k_pages.dtype)

@@ -9,6 +9,7 @@ import pytest
 from kubeai_tpu.engine.paged_cache import (
     OutOfPages,
     PageAllocator,
+    SequenceTooLong,
     PagedKVCache,
     gather_slot_kv,
     insert_sequence,
@@ -28,9 +29,12 @@ def mk_cache():
 
 
 def test_allocator_grow_release_exhaust():
-    alloc = PageAllocator(num_pages=4, page_size=8)
+    # 5 pages, page 0 reserved as scratch -> 4 usable.
+    alloc = PageAllocator(num_pages=5, page_size=8)
+    assert alloc.free_pages == 4
     p = alloc.ensure(0, 9)  # 2 pages
     assert len(p) == 2 and alloc.free_pages == 2
+    assert 0 not in p  # scratch page never handed out
     assert alloc.ensure(0, 10) == p  # no growth needed
     alloc.ensure(1, 16)  # 2 more
     assert alloc.free_pages == 0
@@ -39,7 +43,22 @@ def test_allocator_grow_release_exhaust():
     alloc.release(0)
     assert alloc.free_pages == 2
     # Released pages are reusable.
-    assert len(alloc.ensure(2, 17 - 1)) == 2
+    assert len(alloc.ensure(2, 16)) == 2
+
+
+def test_allocator_rollback_and_caps():
+    alloc = PageAllocator(num_pages=4, page_size=8, max_pages_per_slot=2)
+    # Needing 3 pages with only 3 free but cap 2 -> typed rejection.
+    with pytest.raises(SequenceTooLong):
+        alloc.ensure(0, 17)
+    # Partial-allocation rollback: 3 free, request needs 3+... slot A takes
+    # 2 (cap), then exhaust: B wants 2 with 1 free -> OutOfPages AND holds 0.
+    alloc.ensure(0, 16)
+    assert alloc.free_pages == 1
+    with pytest.raises(OutOfPages):
+        alloc.ensure(1, 16)
+    assert alloc.free_pages == 1  # rolled back, nothing held
+    assert alloc.pages_for(1) == []
 
 
 def test_paged_lifecycle_matches_contiguous():
