@@ -113,14 +113,15 @@ class Group:
 
         done_called = threading.Event()
 
-        def done() -> None:
+        def done(ep=ep) -> None:
             if done_called.is_set():
                 return
             done_called.set()
             with self._cond:
-                e = self._endpoints.get(addr)
-                if e is not None:
-                    e.in_flight -= 1
+                # Decrement the endpoint OBJECT acquired above, not a lookup:
+                # if the endpoint was removed and re-added mid-request, a
+                # lookup would push the fresh endpoint's counter negative.
+                ep.in_flight -= 1
                 self.total_in_flight -= 1
 
         return addr, done
