@@ -390,7 +390,13 @@ class Engine:
         prompt_tokens: list[int],
         params: SamplingParams | None = None,
         adapter: str | None = None,
+        on_admit=None,
     ) -> int:
+        """Queue a request. `on_admit(rid)` runs under the engine lock
+        before the request becomes visible to `step()` — callers use it to
+        register event subscribers without racing a concurrent serve loop
+        (a request admitted and finished before registration would
+        otherwise drop its events)."""
         params = params or SamplingParams()
         adapter_idx = 0
         if adapter:
@@ -422,6 +428,12 @@ class Engine:
                 stop_token_ids=self.eos_token_ids,
             )
             self._requests[rid] = req
+            if on_admit is not None:
+                try:
+                    on_admit(rid)
+                except BaseException:
+                    del self._requests[rid]
+                    raise
             self._pending.append(req)
             return rid
 

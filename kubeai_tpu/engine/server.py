@@ -69,12 +69,16 @@ class EngineServer:
         host: str = "0.0.0.0",
         port: int = 8000,
         adapter_fetcher=None,  # (name, url) -> adapter weight tree
+        max_queue: int = 256,
+        request_timeout: float = 600.0,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.served_model_name = served_model_name
         self.metrics = EngineMetrics()
         self.adapter_fetcher = adapter_fetcher
+        self.max_queue = max_queue
+        self.request_timeout = request_timeout
         self._subscribers: dict[int, queue.Queue] = {}
         self._sub_lock = threading.Lock()
         self._stop = threading.Event()
@@ -89,11 +93,13 @@ class EngineServer:
             def log_message(self, *a):
                 pass
 
-            def _json(self, status: int, payload: dict):
+            def _json(self, status: int, payload: dict, headers: dict | None = None):
                 body = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -208,17 +214,43 @@ class EngineServer:
 
     # -- request handling -------------------------------------------------------
 
-    def _resolve_model(self, requested: str) -> tuple[str, str | None]:
-        """Returns (display_name, adapter_or_None). Engines receive the
-        adapter name in the `model` field (the operator's apiutils rewrites
-        it — reference: internal/apiutils/request.go:190-199)."""
+    def _resolve_model(self, requested: str) -> tuple[str, str | None] | None:
+        """Returns (display_name, adapter_or_None), or None when the name
+        matches neither the served model nor a loaded adapter. Engines
+        receive the adapter name in the `model` field (the operator's
+        apiutils rewrites it — reference: internal/apiutils/request.go:
+        190-199); an adapter this replica hasn't loaded must 404 like
+        vLLM's admin API does, not silently serve the base model."""
         if requested in self.engine.loaded_adapters():
             return requested, requested
-        return requested or self.served_model_name, None
+        if not requested or requested == self.served_model_name:
+            return self.served_model_name, None
+        return None
 
     def _handle_generate(self, http, body: dict, chat: bool):
         model_field = str(body.get("model") or self.served_model_name)
-        display, adapter = self._resolve_model(model_field)
+        resolved = self._resolve_model(model_field)
+        if resolved is None:
+            return http._json(
+                404,
+                {
+                    "error": {
+                        "message": f"model {model_field!r} not found "
+                        "(not the served model and no such loaded adapter)"
+                    }
+                },
+            )
+        display, adapter = resolved
+        # Bounded admission: past this depth requests would only pile onto
+        # the pending deque and blow the 600s budget anyway — shed early
+        # so the LB retries another replica (reference front-door survives
+        # 8000 conc because vLLM sheds; we do our own shedding).
+        if self.engine.num_pending >= self.max_queue:
+            return http._json(
+                429,
+                {"error": {"message": "engine queue full, retry later"}},
+                headers={"Retry-After": "1"},
+            )
 
         if chat:
             messages = body.get("messages") or []
@@ -262,13 +294,26 @@ class EngineServer:
         )
         stream = bool(body.get("stream", False))
 
+        sub: queue.Queue = queue.Queue()
+
+        def register(rid: int) -> None:
+            # Runs under the engine lock, before the request is visible to
+            # step(): no StepEvent can be emitted unsubscribed.
+            with self._sub_lock:
+                self._subscribers[rid] = sub
+
+        try:
+            rid = self.engine.add_request(
+                prompt_ids, sp, adapter=adapter, on_admit=register
+            )
+        except KeyError as e:
+            # Adapter unloaded between _resolve_model and admission.
+            return http._json(404, {"error": {"message": str(e)}})
+        # Metrics only after successful admission, so a failed add_request
+        # can't drift the gauge or inflate the counters.
         self.metrics.requests_total.inc(model=display)
         self.metrics.active_requests.inc()
         self.metrics.prompt_tokens.inc(len(prompt_ids))
-        sub: queue.Queue = queue.Queue()
-        rid = self.engine.add_request(prompt_ids, sp, adapter=adapter)
-        with self._sub_lock:
-            self._subscribers[rid] = sub
         self._work.set()
         try:
             if stream:
@@ -276,20 +321,33 @@ class EngineServer:
             else:
                 self._unary_response(http, rid, sub, sp, display, chat, len(prompt_ids))
         finally:
+            # Client gone / handler done: release the batch slot if the
+            # request is still decoding (no-op after normal completion).
+            self.engine.cancel(rid)
             with self._sub_lock:
                 self._subscribers.pop(rid, None)
             self.metrics.active_requests.dec()
 
     def _collect(self, rid, sub, sp, on_delta=None):
         """Drain tokens; detokenize incrementally; apply stop strings.
-        Returns (text, finish_reason)."""
+        Returns (text, finish_reason, n_generated_tokens).
+
+        request_timeout is a TOTAL budget for the request, not a per-token
+        gap — a slow drip must not hold a batch slot indefinitely."""
         tokens: list[int] = []
         emitted_len = 0
         finish = "length"
+        deadline = time.monotonic() + self.request_timeout
         while True:
             try:
-                ev = sub.get(timeout=600)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise queue.Empty
+                ev = sub.get(timeout=remaining)
             except queue.Empty:
+                # Stalled engine or abandoned stream: stop decoding now —
+                # otherwise the request keeps a batch slot to max_tokens.
+                self.engine.cancel(rid)
                 finish = "timeout"
                 break
             tokens.append(ev.token)
@@ -307,7 +365,7 @@ class EngineServer:
                 if on_delta and stop_hit > emitted_len:
                     on_delta(text[emitted_len:stop_hit])
                 self.engine.cancel(rid)
-                return text[:stop_hit], "stop"
+                return text[:stop_hit], "stop", len(tokens)
             if on_delta and len(text) > emitted_len:
                 # Hold back a partial UTF-8 replacement char at the tail.
                 safe = text[:-1] if text.endswith("�") else text
@@ -320,12 +378,26 @@ class EngineServer:
         text = self.tokenizer.decode(tokens)
         if on_delta and len(text) > emitted_len:
             on_delta(text[emitted_len:])
-        return text, finish
+        return text, finish, len(tokens)
 
     def _unary_response(self, http, rid, sub, sp, display, chat, n_prompt):
-        text, finish = self._collect(rid, sub, sp)
+        # Usage counts the tokens actually generated (re-encoding the text
+        # diverges around merges/special tokens and from the
+        # generated_tokens metric).
+        text, finish, completion_tokens = self._collect(rid, sub, sp)
+        if finish == "timeout":
+            if completion_tokens == 0:
+                # No first token within the budget — stalled OR merely
+                # backlogged; either way this replica can't serve it now.
+                # 503 (not 500) so the proxy retries a different replica.
+                return http._json(
+                    503,
+                    {"error": {"message": "engine produced no tokens within "
+                               f"{self.request_timeout}s"}},
+                    headers={"Retry-After": "1"},
+                )
+            finish = "length"  # partial result; valid OpenAI finish value
         created = int(time.time())
-        completion_tokens = len(self.tokenizer.encode(text)) if text else 0
         usage = {
             "prompt_tokens": n_prompt,
             "completion_tokens": completion_tokens,
@@ -400,7 +472,11 @@ class EngineServer:
                 }
             send_chunk(obj)
 
-        _text, finish = self._collect(rid, sub, sp, on_delta=on_delta)
+        _text, finish, _n = self._collect(rid, sub, sp, on_delta=on_delta)
+        if finish == "timeout":
+            # Headers are already on the wire; the best we can do is a
+            # valid finish value on the final chunk.
+            finish = "length"
         final_choice = (
             {"index": 0, "delta": {}, "finish_reason": finish}
             if chat

@@ -8,8 +8,10 @@ in-flight load is within the bound:
     load <= ceil((total_in_flight + 1) / num_endpoints) * load_factor
 
 (reference: balance_chwbl.go:152-162). Adapter-aware walk: endpoints not
-serving the requested adapter are skipped, falling back to the first
-load-OK endpoint of any kind if none match (reference: balance_chwbl.go:14-84).
+serving the requested adapter are skipped; if no adapter-serving endpoint
+meets the bound, the first adapter-serving endpoint in ring order is
+returned, and an endpoint without the adapter is never returned
+(reference: balance_chwbl.go:14-84 defaultEndpoint).
 
 Uses the native C++ ring (kubeai_tpu.native) when available; the pure-
 Python path is the reference semantics and test oracle.
@@ -82,7 +84,12 @@ class CHWBL:
         start = bisect.bisect_left(
             self._hashes, xxhash64(key.encode())
         ) % len(self._hashes)
-        fallback: str | None = None
+        # The default is the FIRST endpoint in ring order that can serve the
+        # request (has the adapter); it is returned when no serving-capable
+        # endpoint meets the load bound. An endpoint without the adapter is
+        # never returned — the engine would silently serve the base model
+        # (reference: balance_chwbl.go defaultEndpoint, :29-31,74-84).
+        default: str | None = None
         seen: set[str] = set()
         displaced = False
         for off in range(len(self._hashes)):
@@ -91,22 +98,18 @@ class CHWBL:
             if ep in seen:
                 continue
             seen.add(ep)
-            ok = load_ok(ep)
-            if ok and fallback is None:
-                fallback = ep
             if adapter_endpoints is not None and ep not in adapter_endpoints:
                 continue
-            if ok:
+            if default is None:
+                default = ep
+            if load_ok(ep):
                 if displaced:
                     self.metrics.chwbl_displacements.inc()
                 return ep
             displaced = True
-        # No adapter-serving endpoint within bound: any bounded endpoint
-        # (reference: balance_chwbl.go default fallback), else the least
-        # loaded overall.
-        if fallback is not None:
-            return fallback
-        return min(loads, key=loads.get) if loads else None
+        # None ⇔ no endpoint serves the adapter; caller falls back to
+        # least-load over adapter-serving candidates.
+        return default
 
 
 class _NativeRing:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import http.client
 import logging
+import time
 from typing import BinaryIO
 
 from kubeai_tpu.crd.model import LB_STRATEGY_PREFIX_HASH
@@ -29,7 +30,11 @@ from kubeai_tpu.routing.modelclient import (
 logger = logging.getLogger(__name__)
 
 MAX_RETRIES = 3
-RETRY_STATUSES = (500, 502, 503, 504)
+# 500/502/503/504 per the reference (internal/modelproxy/handler.go:50-55);
+# 429 added because our engine sheds with it when its admission queue is
+# full — the retry re-runs AwaitBestAddress, which lands on a less-loaded
+# replica (body replay already buffered).
+RETRY_STATUSES = (429, 500, 502, 503, 504)
 
 
 class ProxyResult:
@@ -131,9 +136,18 @@ class ModelProxy:
                 )
                 continue
             if resp.status in RETRY_STATUSES and attempt < MAX_RETRIES - 1:
+                retry_after = resp.getheader("Retry-After")
                 resp.read()
                 conn.close()
                 done()
+                # A shedding replica (429/503 + Retry-After) asked for
+                # backoff; under prefix-hash an immediate re-pick can land
+                # on the same replica, so honor a short pause (capped).
+                if retry_after and resp.status in (429, 503):
+                    try:
+                        time.sleep(min(float(retry_after), 2.0))
+                    except ValueError:
+                        pass
                 continue
             if resp.status >= 500:
                 resp.read()

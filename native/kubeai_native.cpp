@@ -198,7 +198,10 @@ extern "C" int kubeai_ring_lookup(void* h, const uint8_t* key, size_t key_len,
                  r->points.begin();
   if (start == r->points.size()) start = 0;
 
-  int fallback = -1;
+  // First adapter-capable endpoint in ring order; returned when none is
+  // within the load bound. An endpoint that cannot serve the adapter is
+  // never returned (reference: balance_chwbl.go defaultEndpoint).
+  int default_id = -1;
   std::vector<uint8_t> seen(r->endpoints.size(), 0);
   size_t n_pts = r->points.size();
   for (size_t off = 0; off < n_pts; off++) {
@@ -206,18 +209,12 @@ extern "C" int kubeai_ring_lookup(void* h, const uint8_t* key, size_t key_len,
     if (id < 0 || id >= (int)seen.size() || seen[id]) continue;
     seen[id] = 1;
     if (id >= n_ids) continue;
-    bool load_ok = (total == 0) || ((double)loads[id] <= threshold);
-    if (load_ok && fallback < 0) fallback = id;
     if (adapter_mask != nullptr && !adapter_mask[id]) continue;
+    if (default_id < 0) default_id = id;
+    bool load_ok = (total == 0) || ((double)loads[id] <= threshold);
     if (load_ok) return id;
   }
-  if (fallback >= 0) return fallback;
-  // All overloaded: least-loaded live endpoint.
-  int best = -1;
-  int64_t best_load = INT64_MAX;
-  for (int i = 0; i < n_ids && i < (int)r->endpoints.size(); i++) {
-    if (r->endpoints[i].empty()) continue;
-    if (loads[i] < best_load) { best_load = loads[i]; best = i; }
-  }
-  return best;
+  // -1 ⇔ no endpoint serves the adapter; caller falls back to least-load
+  // over adapter-serving candidates.
+  return default_id;
 }

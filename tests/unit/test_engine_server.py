@@ -305,3 +305,40 @@ def test_health_degrades_when_loop_dies():
         assert status == 503
     finally:
         srv.stop()
+
+
+def test_unknown_model_404(server):
+    # A model name that is neither the served model nor a loaded adapter
+    # must 404 (vLLM parity) — never silently serve the base model under
+    # the wrong display name.
+    status, body = http_post(
+        addr(server),
+        "/v1/completions",
+        {"model": "no-such-adapter", "prompt": "hi", "max_tokens": 2},
+    )
+    assert status == 404
+    assert "not found" in json.loads(body)["error"]["message"]
+
+
+def test_queue_full_429(server):
+    # max_queue=0 makes the admission check trip on every generate request:
+    # deterministic coverage of the shed path (429 + Retry-After).
+    old_max, server.max_queue = server.max_queue, 0
+    try:
+        status, body = http_post(
+            addr(server),
+            "/v1/completions",
+            {"model": "tiny-llama", "prompt": "hi", "max_tokens": 2},
+        )
+        assert status == 429
+        assert "queue full" in json.loads(body)["error"]["message"]
+    finally:
+        server.max_queue = old_max
+    # Back to normal service afterwards.
+    status, _ = http_post(
+        addr(server),
+        "/v1/completions",
+        {"model": "tiny-llama", "prompt": "hi", "max_tokens": 2,
+         "temperature": 0},
+    )
+    assert status == 200

@@ -143,8 +143,15 @@ def test_chwbl_adapter_walk_and_fallback():
     # Only b has the adapter: every key lands on b.
     for i in range(20):
         assert ring.get(f"k{i}", loads, adapter_endpoints={"b:1"}) == "b:1"
-    # No adapter endpoints at all -> falls back to some bounded endpoint.
-    assert ring.get("k", loads, adapter_endpoints=set()) in loads
+    # All adapter-serving endpoints over the bound: still returns an
+    # adapter endpoint (the ring-order default), NEVER one without the
+    # adapter — the engine would silently serve the base model
+    # (reference: balance_chwbl.go defaultEndpoint).
+    hot = {"a:1": 0, "b:1": 1000, "c:1": 0}
+    for i in range(20):
+        assert ring.get(f"k{i}", hot, adapter_endpoints={"b:1"}) == "b:1"
+    # No adapter endpoints at all -> not found; caller handles fallback.
+    assert ring.get("k", loads, adapter_endpoints=set()) is None
 
 
 # ---- endpoint group ---------------------------------------------------------
@@ -303,6 +310,29 @@ def test_retry_on_5xx_until_success(stack):
         {"model": "m1", "prompt": "x"},
     )
     assert status == 200 and calls["n"] == 3
+
+
+def test_retry_on_429_shed(stack):
+    """An engine shedding with 429 + Retry-After is retried (the in-tree
+    engine sheds when its admission queue is full), and the pause is
+    honored before re-picking."""
+    _, _, server, add_model, engines = stack
+    add_model()
+    eng = engines[0]
+    calls = {"n": 0}
+
+    def shedding(path, body):
+        calls["n"] += 1
+        if calls["n"] < 2:
+            return 429, {"error": "engine queue full"}
+        return 200, {"ok": True}
+
+    eng.behavior = shedding
+    t0 = time.monotonic()
+    status, data = _post(
+        server, "/openai/v1/completions", {"model": "m1", "prompt": "x"}
+    )
+    assert status == 200 and calls["n"] == 2
 
 
 def test_5xx_details_stripped(stack):
