@@ -119,6 +119,17 @@ def main() -> None:
         help="force the host CPU backend (also auto-selected when the TPU "
         "relay is unreachable, with the fallback named in the metric)",
     )
+    ap.add_argument(
+        "--cache-mode", default="paged", choices=["paged", "slot"],
+        help="KV cache layout (paged = block tables, reads resident pages "
+        "only; slot = dense [slots, max_seq_len] reservation)",
+    )
+    ap.add_argument(
+        "--uniform-prompts", action="store_true",
+        help="all prompts exactly --prompt-len (default: mixed lengths in "
+        "[prompt-len/4, prompt-len], the serving-realistic case where "
+        "paging wins)",
+    )
     try:
         default_watchdog = float(os.environ.get("BENCH_WATCHDOG_S", "900"))
     except ValueError:
@@ -163,17 +174,29 @@ def main() -> None:
         "llama",
         cfg,
         params,
-        cfg=EngineConfig(num_slots=args.slots, max_seq_len=args.max_seq_len),
+        cfg=EngineConfig(
+            num_slots=args.slots,
+            max_seq_len=args.max_seq_len,
+            cache_mode=args.cache_mode,
+        ),
     )
 
     rng = np.random.default_rng(0)
     gen_budget = args.max_seq_len - args.prompt_len
     sp = SamplingParams(temperature=0.0, max_tokens=gen_budget)
 
-    # Fill every slot, warm up prefill+decode compiles.
-    for _ in range(args.slots):
+    # Fill every slot, warm up prefill+decode compiles. Mixed lengths by
+    # default: decode cost under paging tracks RESIDENT tokens, which is
+    # what serving traffic looks like (uniform max-length is the slot
+    # cache's best case, not the common case).
+    for i in range(args.slots):
+        if args.uniform_prompts:
+            plen = args.prompt_len
+        else:
+            lo = min(max(4, args.prompt_len // 4), args.prompt_len)
+            plen = int(rng.integers(lo, args.prompt_len + 1))
         eng.add_request(
-            rng.integers(0, cfg.vocab_size, args.prompt_len).tolist(), sp
+            rng.integers(0, cfg.vocab_size, plen).tolist(), sp
         )
     eng.step()  # prefill-admit + first decode (compiles)
     eng.step()
@@ -191,7 +214,9 @@ def main() -> None:
     baseline = 2000.0  # BASELINE.json north-star: tok/s/chip on v5e
     result = {
         "metric": "llama-1b-class decode throughput, continuous batching, "
-        f"bs={args.slots}, 1 chip" + (" (smoke)" if args.smoke else "")
+        f"bs={args.slots}, {args.cache_mode} kv cache, "
+        + ("uniform" if args.uniform_prompts else "mixed")
+        + " prompts, 1 chip" + (" (smoke)" if args.smoke else "")
         + backend_note,
         "value": round(toks_per_s, 2),
         "unit": "tok/s",

@@ -45,6 +45,18 @@ from kubeai_tpu.parallel.mesh import single_device_mesh
 class EngineConfig:
     num_slots: int = 8
     max_seq_len: int = 1024
+    # KV cache layout: "paged" (block tables over a shared page pool; decode
+    # reads only resident pages — the default) or "slot" (fixed
+    # [slots, max_seq_len] reservation per slot). Families without a paged
+    # decode path (and chunked prefill, for now) fall back to "slot".
+    cache_mode: str = "paged"
+    page_size: int = 64
+    # Page-pool size. 0 = full reservation (num_slots * max_seq_len worth
+    # of pages + the reserved scratch page): identical capacity to the slot
+    # cache, no preemption possible. Set smaller to oversubscribe slots —
+    # admission defers on pool exhaustion and decode preempts (recompute)
+    # the youngest request when it can't grow.
+    num_pages: int = 0
     prefill_buckets: tuple[int, ...] = ()  # default: powers of 2 up to max
     # Chunked prefill: prompts longer than this are prefilled in fixed
     # [1, prefill_chunk] steps against the slot cache — ONE compiled graph
@@ -81,6 +93,12 @@ class EngineConfig:
             b *= 2
         out.append(self.max_seq_len)
         return tuple(out)
+
+    def effective_num_pages(self) -> int:
+        if self.num_pages > 0:
+            return self.num_pages
+        per_slot = -(-self.max_seq_len // self.page_size)
+        return 1 + self.num_slots * per_slot  # +1: reserved scratch page 0
 
 
 class StepEvent(NamedTuple):
@@ -169,18 +187,73 @@ class Engine:
                     for name, phys in rules.rules
                 )
             )
-        cache_sharding = psh.named_sharding(
-            self.mesh, KVCache.logical_axes(), cache_rules
-        )
-        self.cache = KVCache.create(
-            model_cfg.num_layers,
-            cfg.num_slots,
-            cfg.max_seq_len,
-            model_cfg.num_kv_heads,
-            model_cfg.head_size,
-            dtype=cfg.cache_dtype,
-            sharding=cache_sharding,
-        )
+        # Resolve the cache mode: paged needs family support and (for now)
+        # whole-prompt prefill; otherwise fall back to the slot cache.
+        self.cache_mode = cfg.cache_mode
+        if cfg.cache_mode == "paged" and (
+            getattr(self.family, "decode_step_paged", None) is None
+            or cfg.prefill_chunk > 0
+        ):
+            self.cache_mode = "slot"
+        elif cfg.cache_mode not in ("paged", "slot"):
+            raise ValueError(f"unknown cache_mode {cfg.cache_mode!r}")
+
+        if self.cache_mode == "paged":
+            from kubeai_tpu.engine.paged_cache import PageAllocator, PagedKVCache
+
+            n_pages = cfg.effective_num_pages()
+            max_pages = -(-cfg.max_seq_len // cfg.page_size)
+            # Pages replicated across dp (page ids are global); KV heads on
+            # tp exactly like the slot cache.
+            pool_sharding = psh.named_sharding(
+                self.mesh, (None, None, None, psh.KV_HEADS, None), cache_rules
+            )
+            if n_pages - 1 < max_pages:
+                raise ValueError(
+                    f"num_pages={n_pages} cannot hold one max_seq_len "
+                    f"sequence ({max_pages} pages + scratch); preemption "
+                    "could not guarantee progress"
+                )
+            self.cache = PagedKVCache.create(
+                model_cfg.num_layers,
+                n_pages,
+                cfg.page_size,
+                cfg.num_slots,
+                cfg.max_seq_len,
+                model_cfg.num_kv_heads,
+                model_cfg.head_size,
+                dtype=cfg.cache_dtype,
+            )
+            self.cache.k_pages = jax.device_put(self.cache.k_pages, pool_sharding)
+            self.cache.v_pages = jax.device_put(self.cache.v_pages, pool_sharding)
+            self._bt_sharding = psh.named_sharding(
+                self.mesh, (None, None), cache_rules
+            )
+            self.cache.block_tables = jax.device_put(
+                self.cache.block_tables, self._bt_sharding
+            )
+            self._alloc = PageAllocator(
+                n_pages, cfg.page_size, max_pages_per_slot=max_pages
+            )
+            # Host mirror of the block tables: page growth/release edits
+            # this; one small [slots, MP] transfer syncs the device copy
+            # before the next decode dispatch (_bt_dirty).
+            self._bt_host = np.full((cfg.num_slots, max_pages), -1, np.int32)
+            self._bt_dirty = False
+            cache_sharding = pool_sharding
+        else:
+            cache_sharding = psh.named_sharding(
+                self.mesh, KVCache.logical_axes(), cache_rules
+            )
+            self.cache = KVCache.create(
+                model_cfg.num_layers,
+                cfg.num_slots,
+                cfg.max_seq_len,
+                model_cfg.num_kv_heads,
+                model_cfg.head_size,
+                dtype=cfg.cache_dtype,
+                sharding=cache_sharding,
+            )
 
         # Per-slot decode state lives ON DEVICE (replicated): steady-state
         # decode then needs ZERO host->device transfers per chunk — critical
@@ -217,6 +290,9 @@ class Engine:
     # ---- compiled functions -------------------------------------------------
 
     def _build_jits(self, cache_sharding) -> None:
+        if self.cache_mode == "paged":
+            self._build_jits_paged(cache_sharding)
+            return
         fam, mcfg = self.family, self.model_cfg
         max_len = self.cfg.max_seq_len
         chunk = max(1, self.cfg.decode_chunk)
@@ -383,6 +459,114 @@ class Engine:
                 out_shardings=(None, cache_sharding, cache_sharding, None),
             )
 
+    def _build_jits_paged(self, pool_sharding) -> None:
+        """Paged-cache compiled paths: admission scatters the prefilled
+        sequence through the slot's block-table row; decode scatters one
+        token per slot and attends over resident pages only."""
+        from kubeai_tpu.ops.paged_attention import sequence_page_coords
+
+        fam, mcfg = self.family, self.model_cfg
+        max_len = self.cfg.max_seq_len
+        chunk = max(1, self.cfg.decode_chunk)
+        page = self.cfg.page_size
+        decode_paged = fam.decode_step_paged
+
+        def _prefill_admit(
+            params, tokens, ints, floats, bt_row, kp, vp, bt, state, lora
+        ):
+            """Prefill → page scatter → first-token sample → state update.
+            `bt_row` is the slot's freshly allocated block-table row; it is
+            committed into the device tables here so admission stays one
+            device call. ints[5] >= 0 FORCES the sampled token — used when
+            re-admitting a preempted request, whose "first token" was
+            already emitted before preemption (re-sampling could diverge:
+            prefill and paged-decode logits come from different kernels)."""
+            length, slot, seed, topk = ints[0], ints[1], ints[2], ints[3]
+            adapter, forced = ints[4], ints[5]
+            temp, topp = floats[0], floats[1]
+            if lora is None:
+                logits, k_all, v_all = fam.prefill(
+                    params, mcfg, tokens, length[None]
+                )
+            else:
+                logits, k_all, v_all = fam.prefill(
+                    params, mcfg, tokens, length[None],
+                    lora=lora, lora_idx=adapter[None],
+                )
+            from kubeai_tpu.ops.paged_attention import scatter_sequence
+
+            S = tokens.shape[1]
+            page_ids, offsets = sequence_page_coords(bt_row, length, S, page)
+            kp, vp = scatter_sequence(
+                kp, vp, k_all[:, 0], v_all[:, 0], page_ids, offsets
+            )
+            bt = bt.at[slot].set(bt_row)
+            tok = sample(
+                logits,
+                seed.astype(jnp.uint32)[None],
+                length[None],
+                temp[None],
+                topk[None],
+                topp[None],
+            )[0]
+            tok = jnp.where(forced >= 0, forced, tok)
+            state = dict(
+                tokens=state["tokens"].at[slot].set(tok),
+                positions=state["positions"].at[slot].set(length),
+                seeds=state["seeds"].at[slot].set(seed.astype(jnp.uint32)),
+                temp=state["temp"].at[slot].set(temp),
+                topk=state["topk"].at[slot].set(topk),
+                topp=state["topp"].at[slot].set(topp),
+                lora_idx=state["lora_idx"].at[slot].set(adapter),
+            )
+            return tok, kp, vp, bt, state
+
+        self._prefill_admit_jit = jax.jit(
+            _prefill_admit,
+            donate_argnums=(5, 6),
+            out_shardings=(
+                None, pool_sharding, pool_sharding, self._bt_sharding, None,
+            ),
+        )
+
+        def _decode_chunk(params, kp, vp, bt, state, lora):
+            """`chunk` paged decode steps fused via lax.scan. The block
+            tables are read-only here — page growth happens host-side
+            between chunks (the host ensures pages cover position+chunk
+            before dispatching)."""
+            seeds, temp = state["seeds"], state["temp"]
+            topk, topp = state["topk"], state["topp"]
+
+            def body(carry, _):
+                tokens, positions, kp, vp = carry
+                if lora is None:
+                    logits, kp, vp = decode_paged(
+                        params, mcfg, tokens, positions, kp, vp, bt
+                    )
+                else:
+                    logits, kp, vp = decode_paged(
+                        params, mcfg, tokens, positions, kp, vp, bt,
+                        lora=lora, lora_idx=state["lora_idx"],
+                    )
+                toks = sample(logits, seeds, positions + 1, temp, topk, topp)
+                next_pos = jnp.minimum(positions + 1, max_len - 1)
+                return (toks, next_pos, kp, vp), toks
+
+            (tokens, positions, kp, vp), toks_seq = jax.lax.scan(
+                body,
+                (state["tokens"], state["positions"], kp, vp),
+                None,
+                length=chunk,
+            )
+            state = dict(state, tokens=tokens, positions=positions)
+            return toks_seq, kp, vp, state
+
+        self._decode_jit = jax.jit(
+            _decode_chunk,
+            donate_argnums=(1, 2),
+            out_shardings=(None, pool_sharding, pool_sharding, None),
+        )
+
     # ---- public API ---------------------------------------------------------
 
     def add_request(
@@ -458,18 +642,46 @@ class Engine:
         """Prefill pending requests into free slots. Returns emitted tokens."""
         emitted = []
         while self._pending and self._free_slots:
-            req = self._pending.popleft()
-            slot = self._free_slots.pop()
+            req = self._pending[0]
+            slot = self._free_slots[-1]
+            # A preempted (paged-mode) request resumes by RECOMPUTE:
+            # re-prefill prompt + already-emitted tokens (minus the last —
+            # its KV is written by the next decode step). The admission
+            # sample deterministically reproduces the last emitted token
+            # (same seed, same position fold), so it is not re-emitted.
+            resumed = bool(req.out_tokens)
+            seq = (
+                req.prompt + req.out_tokens[:-1] if resumed else req.prompt
+            )
+            plen = len(seq)
+            if self.cache_mode == "paged":
+                from kubeai_tpu.engine.paged_cache import OutOfPages
+
+                try:
+                    pages = self._alloc.ensure(slot, plen)
+                except OutOfPages:
+                    break  # defer admission; ensure() rolled back
+                self._pending.popleft()
+                self._free_slots.pop()
+                req.slot = slot
+                tok = self._admit_paged(req, slot, seq, plen, pages)
+                ev = self._finish_admission(req, slot, plen, tok, resumed)
+                if ev is not None:
+                    emitted.append(ev)
+                continue
+            self._pending.popleft()
+            self._free_slots.pop()
             req.slot = slot
-            plen = len(req.prompt)
             C = self.cfg.prefill_chunk
             if C > 0 and plen > C:
                 tok = self._admit_chunked(req, slot, plen, C)
-                emitted.append(self._finish_admission(req, slot, plen, tok))
+                ev = self._finish_admission(req, slot, plen, tok, resumed)
+                if ev is not None:
+                    emitted.append(ev)
                 continue
             bucket = self._bucket(plen)
             tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :plen] = req.prompt
+            tokens[0, :plen] = seq
             tok_dev, self.cache.k, self.cache.v, self._state = (
                 self._prefill_admit_jit(
                     self.params,
@@ -495,14 +707,66 @@ class Engine:
                     self._lora,
                 )
             )
-            emitted.append(
-                self._finish_admission(req, slot, plen, int(tok_dev))
-            )
+            ev = self._finish_admission(req, slot, plen, int(tok_dev), resumed)
+            if ev is not None:
+                emitted.append(ev)
         return emitted
 
+    def _admit_paged(
+        self, req: _Request, slot: int, seq: list[int], plen: int,
+        pages: list[int],
+    ) -> int:
+        bucket = self._bucket(plen)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :plen] = seq
+        self._set_bt_row(slot, pages)
+        (
+            tok_dev,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            self.cache.block_tables,
+            self._state,
+        ) = self._prefill_admit_jit(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(
+                [
+                    plen,
+                    slot,
+                    int(np.uint32(req.seed).view(np.int32)),
+                    req.params.top_k,
+                    req.adapter_idx,
+                    # Resume: force the already-emitted last token instead
+                    # of trusting cross-kernel re-sampling determinism.
+                    req.out_tokens[-1] if req.out_tokens else -1,
+                ],
+                jnp.int32,
+            ),
+            jnp.asarray(
+                [req.params.temperature, req.params.top_p], jnp.float32
+            ),
+            jnp.asarray(self._bt_host[slot]),
+            self.cache.k_pages,
+            self.cache.v_pages,
+            self.cache.block_tables,
+            self._state,
+            self._lora,
+        )
+        return int(tok_dev)
+
     def _finish_admission(
-        self, req: _Request, slot: int, plen: int, tok: int
-    ) -> StepEvent:
+        self, req: _Request, slot: int, plen: int, tok: int,
+        resumed: bool = False,
+    ) -> StepEvent | None:
+        if resumed:
+            if req.done:  # finished/cancelled while pending: don't revive
+                self._release(req)
+                return None
+            # tok is the FORCED already-emitted last token; no new event.
+            req.position = plen
+            req.last_token = tok
+            self._active[slot] = req
+            return None
         req.out_tokens.append(tok)
         req.position = plen
         req.last_token = tok
@@ -571,10 +835,73 @@ class Engine:
             req.finish_reason = "length"
         return req.done
 
+    def _ensure_decode_pages(self) -> None:
+        """Grow every active slot's pages to cover the next decode chunk.
+        Pool exhaustion preempts the YOUNGEST other request (recompute on
+        re-admission). Init guarantees the pool holds one full sequence,
+        so the loop always terminates with the oldest request served."""
+        from kubeai_tpu.engine.paged_cache import OutOfPages
+
+        chunk = max(1, self.cfg.decode_chunk)
+        for slot, req in sorted(
+            self._active.items(), key=lambda kv: kv[1].rid
+        ):
+            if self._active.get(slot) is not req:
+                continue  # preempted by an earlier iteration of this loop
+            need = min(req.position + chunk + 1, self.cfg.max_seq_len)
+            while True:
+                before = len(self._alloc.pages_for(slot))
+                try:
+                    pages = self._alloc.ensure(slot, need)
+                except OutOfPages:
+                    victims = [
+                        r for r in self._active.values() if r is not req
+                    ]
+                    if not victims:  # cannot happen (init invariant)
+                        raise
+                    self._preempt(max(victims, key=lambda r: r.rid))
+                    continue
+                break
+            if len(pages) != before:
+                self._set_bt_row(slot, pages)
+
+    def _set_bt_row(self, slot: int, pages: list[int]) -> None:
+        """Update the host block-table mirror for one slot and mark the
+        device copy stale (pushed before the next decode dispatch)."""
+        row = np.full((self._bt_host.shape[1],), -1, np.int32)
+        row[: len(pages)] = pages
+        self._bt_host[slot] = row
+        self._bt_dirty = True
+
+    def _preempt(self, victim: _Request) -> None:
+        """Evict an active request: free its slot + pages, requeue it at
+        the FRONT of pending for recompute re-admission (vLLM-style
+        preemption, TPU-shaped: static graphs, host-side bookkeeping)."""
+        slot = victim.slot
+        self._active.pop(slot, None)
+        self._free_slots.append(slot)
+        victim.slot = -1
+        self._alloc.release(slot)
+        self._bt_host[slot] = -1
+        self._bt_dirty = True
+        self._pending.appendleft(victim)
+
     def _release(self, req: _Request) -> None:
+        # A preempted request can finish (stop/cancel) while waiting in
+        # the pending queue — drop it there too, or re-admission would
+        # resurrect a done request that leaks its slot and pages forever.
+        if req in self._pending:
+            self._pending.remove(req)
         if req.slot >= 0:
             self._active.pop(req.slot, None)
             self._free_slots.append(req.slot)
+            if self.cache_mode == "paged":
+                # Free the pages and clear the row BEFORE the next decode:
+                # a stale row would scatter the (junk) token of a freed
+                # slot into pages that may now belong to a live sequence.
+                self._alloc.release(req.slot)
+                self._bt_host[req.slot] = -1
+                self._bt_dirty = True
             req.slot = -1
         # Finished/cancelled requests leave the table immediately: callers
         # consume tokens from step() events, so retaining them would leak
@@ -611,12 +938,33 @@ class Engine:
             self._inflight = None
             current = None
             if self._active:
-                toks_seq, self.cache.k, self.cache.v, self._state = (
-                    self._decode_jit(
-                        self.params, self.cache.k, self.cache.v, self._state,
+                if self.cache_mode == "paged":
+                    self._ensure_decode_pages()
+                    if self._bt_dirty:
+                        self.cache.block_tables = jax.device_put(
+                            jnp.asarray(self._bt_host), self._bt_sharding
+                        )
+                        self._bt_dirty = False
+                    (
+                        toks_seq,
+                        self.cache.k_pages,
+                        self.cache.v_pages,
+                        self._state,
+                    ) = self._decode_jit(
+                        self.params,
+                        self.cache.k_pages,
+                        self.cache.v_pages,
+                        self.cache.block_tables,
+                        self._state,
                         self._lora,
                     )
-                )
+                else:
+                    toks_seq, self.cache.k, self.cache.v, self._state = (
+                        self._decode_jit(
+                            self.params, self.cache.k, self.cache.v,
+                            self._state, self._lora,
+                        )
+                    )
                 self._steps += 1
                 current = (toks_seq, list(self._active.items()))
                 if self.cfg.pipeline:

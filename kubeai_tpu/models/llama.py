@@ -383,6 +383,81 @@ def decode_step(
     return logits, k_cache, v_cache
 
 
+def decode_step_paged(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [B] one token per slot
+    positions: jnp.ndarray,  # [B] absolute position of each token
+    k_pages: jnp.ndarray,  # [NL, P, page, KVH, D] page pools
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, MP] page ids per slot (-1 = free)
+    lora: dict | None = None,
+    lora_idx: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Decode step against the PAGED cache: the new token's K/V scatter
+    through the block tables and attention reads only each slot's resident
+    pages (Pallas kernel on TPU; gather reference elsewhere). HBM traffic
+    per step is O(sum of true lengths), not O(B * max_seq_len) — the
+    reason paging beats the slot cache under mixed-length batches."""
+    from kubeai_tpu.ops.paged_attention import (
+        paged_decode_attention,
+        scatter_decode_token,
+        token_page_coords,
+    )
+
+    B = tokens.shape[0]
+    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    page_size = k_pages.shape[2]
+    inv_freq = jnp.asarray(
+        rope_frequencies(D, cfg.rope_theta, cfg.rope_scaling)
+    )
+    x = params["embed"][tokens]  # [B, E]
+    pos1 = positions[:, None]
+    lengths = positions + 1
+    page_ids, offsets = token_page_coords(block_tables, positions, page_size)
+
+    def layer(carry, scanned):
+        x = carry
+        lp = scanned["p"]
+        lor = scanned.get("l")
+        kp, vp = scanned["kp"], scanned["vp"]
+
+        def proj(h, w, target, bias=None):
+            out = jnp.einsum("be,eh->bh", h, _w(w))
+            if bias is not None:
+                out = out + bias
+            if lor is not None:
+                out = out + _lora_delta(
+                    h, lor[target]["A"], lor[target]["B"], lora_idx
+                )
+            return out
+
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q = proj(h, lp["wq"], "wq", lp.get("bq")).reshape(B, 1, H, D)
+        k = proj(h, lp["wk"], "wk", lp.get("bk")).reshape(B, 1, KVH, D)
+        v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, 1, KVH, D)
+        q = apply_rope(q, pos1, inv_freq)[:, 0]  # [B, H, D]
+        k = apply_rope(k, pos1, inv_freq)[:, 0]  # [B, KVH, D]
+        v = v[:, 0]
+        kp, vp = scatter_decode_token(kp, vp, k, v, page_ids, offsets)
+        attn = paged_decode_attention(q, kp, vp, block_tables, lengths)
+        x = x + proj(attn.reshape(B, H * D), lp["wo"], "wo")
+        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(h2[:, None], lp["w_gate"], lp["w_up"], lp["w_down"])[:, 0]
+        return x, (kp, vp)
+
+    xs = _scan_xs(params, lora)
+    xs["kp"] = k_pages
+    xs["vp"] = v_pages
+    x, (k_pages, v_pages) = jax.lax.scan(layer, x, xs)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = jnp.einsum(
+        "be,ve->bv", x, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
+    return logits, k_pages, v_pages
+
+
 def _trunk(params: dict, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray:
     """Transformer trunk: [B, S] tokens -> [B, S, E] final hidden states."""
     B, S = tokens.shape

@@ -27,6 +27,7 @@ class ModelFamily:
         param_specs: Callable,
         prefill: Callable,
         decode_step: Callable,
+        decode_step_paged: Callable | None = None,
         hf_architectures: tuple[str, ...] = (),
         feature: str = "TextGeneration",
         hidden_states=None,
@@ -39,6 +40,9 @@ class ModelFamily:
         self.param_specs = param_specs
         self.prefill = prefill
         self.decode_step = decode_step
+        # Paged-KV decode (block tables + page pools). None = family only
+        # supports the slot cache; the engine falls back automatically.
+        self.decode_step_paged = decode_step_paged
         self.hf_architectures = hf_architectures
         self.feature = feature
 
@@ -77,6 +81,7 @@ def _ensure_builtin() -> None:
             param_specs=llama.param_specs,
             prefill=llama.prefill,
             decode_step=llama.decode_step,
+            decode_step_paged=llama.decode_step_paged,
             hf_architectures=("LlamaForCausalLM", "MistralForCausalLM"),
             hidden_states=llama.hidden_states,
         )
@@ -94,6 +99,7 @@ def _ensure_builtin() -> None:
             param_specs=llama.param_specs,
             prefill=llama.prefill,
             decode_step=llama.decode_step,
+            decode_step_paged=llama.decode_step_paged,
             hf_architectures=("Qwen2ForCausalLM",),
             hidden_states=llama.hidden_states,
         )

@@ -83,16 +83,18 @@ def _flash_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_q", "block_k", "interpret", "scale")
+    jax.jit,
+    static_argnames=("block_q", "block_k", "interpret", "scale", "group"),
 )
 def _flash_bhsd(
     q: jnp.ndarray,  # [B, H, S, D]
-    k: jnp.ndarray,  # [B, H, S, D] (kv heads already expanded to H)
-    v: jnp.ndarray,
-    block_q: int = 128,
+    k: jnp.ndarray,  # [B, KVH, S, D] — NOT expanded; the q-head grid axis
+    v: jnp.ndarray,  #                 maps h -> kv head h // group in the
+    block_q: int = 128,  #              BlockSpec, so GQA costs no extra HBM
     block_k: int = 128,
     interpret: bool = False,
     scale: float = 1.0,
+    group: int = 1,
 ) -> jnp.ndarray:
     B, H, S, D = q.shape
     grid = (B, H, S // block_q)
@@ -110,8 +112,8 @@ def _flash_bhsd(
             pl.BlockSpec(
                 (1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)
             ),
-            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // group, 0, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h // group, 0, 0)),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)
@@ -141,11 +143,12 @@ def flash_causal_prefill(
         return causal_prefill_attention(q, k, v)
 
     group = H // KVH
-    # [B, S, H, D] -> [B, H, S, D]; expand kv heads to H (cheap view-ish;
-    # XLA keeps this fused into the kernel's DMA pattern).
+    # [B, S, H, D] -> [B, H, S, D]. K/V keep their KVH heads — the kernel's
+    # q-head grid axis maps onto kv head h // group in the BlockSpec, so
+    # GQA never materializes ×group KV in HBM.
     qt = jnp.moveaxis(q, 1, 2)
-    kt = jnp.repeat(jnp.moveaxis(k, 1, 2), group, axis=1)
-    vt = jnp.repeat(jnp.moveaxis(v, 1, 2), group, axis=1)
+    kt = jnp.moveaxis(k, 1, 2)
+    vt = jnp.moveaxis(v, 1, 2)
 
     # Pad head_dim to the 128-lane tile.
     Dp = max(128, ((D + 127) // 128) * 128)
@@ -155,7 +158,7 @@ def flash_causal_prefill(
 
     out = _flash_bhsd(
         qt, kt, vt, block_q=block, block_k=block, interpret=interpret,
-        scale=D ** -0.5,
+        scale=D ** -0.5, group=group,
     )
     if Dp != D:
         out = out[..., :D]

@@ -1,0 +1,175 @@
+"""Paged decode attention: jnp reference vs dense oracle vs Pallas kernel
+(interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeai_tpu.engine.paged_cache import PageAllocator, set_block_table
+from kubeai_tpu.ops.attention import decode_attention
+from kubeai_tpu.ops.paged_attention import (
+    paged_decode_attention,
+    ref_paged_decode_attention,
+    scatter_decode_token,
+    scatter_sequence,
+    sequence_page_coords,
+    token_page_coords,
+)
+
+B, KVH, G, D, PAGE, MP = 3, 2, 4, 32, 8, 4
+H = KVH * G
+P = 1 + B * MP  # pool: scratch page 0 + full reservation
+L_MAX = MP * PAGE
+
+
+def _setup(lengths, seed=0):
+    """Build equivalent dense [B, L, KVH, D] caches and paged pools."""
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    k_dense = np.zeros((B, L_MAX, KVH, D), np.float32)
+    v_dense = np.zeros((B, L_MAX, KVH, D), np.float32)
+    k_pages = np.zeros((P, PAGE, KVH, D), np.float32)
+    v_pages = np.zeros((P, PAGE, KVH, D), np.float32)
+    alloc = PageAllocator(P, PAGE, max_pages_per_slot=MP)
+    bt = jnp.full((B, MP), -1, jnp.int32)
+    for s, ln in enumerate(lengths):
+        pages = alloc.ensure(s, ln)
+        bt = set_block_table(bt, s, pages)
+        kv = rng.standard_normal((2, ln, KVH, D)).astype(np.float32)
+        k_dense[s, :ln] = kv[0]
+        v_dense[s, :ln] = kv[1]
+        for t in range(ln):
+            k_pages[pages[t // PAGE], t % PAGE] = kv[0, t]
+            v_pages[pages[t // PAGE], t % PAGE] = kv[1, t]
+    return (
+        q,
+        jnp.asarray(k_dense),
+        jnp.asarray(v_dense),
+        jnp.asarray(k_pages),
+        jnp.asarray(v_pages),
+        bt,
+        jnp.asarray(lengths, jnp.int32),
+    )
+
+
+def test_reference_matches_dense_oracle():
+    q, kd, vd, kp, vp, bt, lengths = _setup([5, 17, 32])
+    ref = ref_paged_decode_attention(q, kp, vp, bt, lengths)
+    dense = decode_attention(q, kd, vd, lengths)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(dense), atol=1e-5)
+
+
+def test_kernel_matches_reference():
+    q, _, _, kp, vp, bt, lengths = _setup([5, 17, 32])
+    got = paged_decode_attention(
+        q, kp, vp, bt, lengths, use_pallas=True, interpret=True
+    )
+    want = ref_paged_decode_attention(q, kp, vp, bt, lengths)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+    )
+
+
+def test_kernel_softcap_and_window():
+    q, kd, vd, kp, vp, bt, lengths = _setup([9, 26, 31], seed=3)
+    for cap, win in ((30.0, None), (None, 12), (50.0, 7)):
+        got = paged_decode_attention(
+            q, kp, vp, bt, lengths,
+            logit_softcap=cap, window=win, use_pallas=True, interpret=True,
+        )
+        want = ref_paged_decode_attention(
+            q, kp, vp, bt, lengths, logit_softcap=cap, window=win
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+        )
+        # Window actually changes the result (keys fall out of range).
+        if win is not None:
+            full = ref_paged_decode_attention(
+                q, kp, vp, bt, lengths, logit_softcap=cap
+            )
+            assert float(jnp.max(jnp.abs(got - full))) > 1e-4
+
+
+def test_window_matches_dense_masked_oracle():
+    q, kd, vd, kp, vp, bt, lengths = _setup([20, 32, 11], seed=5)
+    win = 6
+    got = ref_paged_decode_attention(q, kp, vp, bt, lengths, window=win)
+    # Dense oracle: zero out everything outside [len-win, len) by masking
+    # via lengths on a shifted cache is awkward; recompute with explicit
+    # softmax instead.
+    b, h, d = q.shape
+    qg = (q * (d ** -0.5)).reshape(b, KVH, G, d).astype(jnp.float32)
+    logits = jnp.einsum("bkgd,blkd->bkgl", qg, kd.astype(jnp.float32))
+    pos = jnp.arange(L_MAX)
+    mask = (pos[None, :] < lengths[:, None]) & (
+        pos[None, :] >= lengths[:, None] - win
+    )
+    logits = jnp.where(mask[:, None, None], logits, -1e30)
+    want = jnp.einsum(
+        "bkgl,blkd->bkgd", jax.nn.softmax(logits, -1),
+        vd.astype(jnp.float32),
+    ).reshape(b, h, d)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_scatter_token_roundtrip():
+    q, _, _, kp, vp, bt, lengths = _setup([5, 17, 32])
+    kp_all = jnp.stack([kp])  # [NL=1, ...] not needed; per-layer API
+    rng = np.random.default_rng(7)
+    k_new = jnp.asarray(rng.standard_normal((B, KVH, D)), jnp.float32)
+    v_new = jnp.asarray(rng.standard_normal((B, KVH, D)), jnp.float32)
+    positions = lengths  # write at the next position
+    # All slots have room in their allocated pages? Ensure via allocator
+    # semantics in _setup: lengths 5,17,32 -> pages cover ceil(len/8)*8 =
+    # 8,24,32; position 32 for slot 2 needs page 5th -> NOT allocated.
+    # Use positions within allocation instead.
+    positions = jnp.asarray([5, 17, 24], jnp.int32)
+    page_ids, offsets = token_page_coords(bt, positions, PAGE)
+    kp2, vp2 = scatter_decode_token(kp, vp, k_new, v_new, page_ids, offsets)
+    for s in range(B):
+        pid, off = int(page_ids[s]), int(offsets[s])
+        np.testing.assert_allclose(
+            np.asarray(kp2[pid, off]), np.asarray(k_new[s]), atol=0
+        )
+        np.testing.assert_allclose(
+            np.asarray(vp2[pid, off]), np.asarray(v_new[s]), atol=0
+        )
+
+
+def test_scatter_sequence_matches_paged_layout():
+    rng = np.random.default_rng(11)
+    NL, S, ln = 2, 16, 13
+    alloc = PageAllocator(P, PAGE, max_pages_per_slot=MP)
+    pages = alloc.ensure(0, ln)
+    bt = set_block_table(jnp.full((B, MP), -1, jnp.int32), 0, pages)
+    kp = jnp.zeros((NL, P, PAGE, KVH, D), jnp.float32)
+    vp = jnp.zeros((NL, P, PAGE, KVH, D), jnp.float32)
+    k_seq = jnp.asarray(rng.standard_normal((NL, S, KVH, D)), jnp.float32)
+    v_seq = jnp.asarray(rng.standard_normal((NL, S, KVH, D)), jnp.float32)
+    page_ids, offsets = sequence_page_coords(
+        bt[0], jnp.asarray(ln), S, PAGE
+    )
+    kp2, vp2 = scatter_sequence(kp, vp, k_seq, v_seq, page_ids, offsets)
+    for t in range(ln):
+        pid = pages[t // PAGE]
+        np.testing.assert_allclose(
+            np.asarray(kp2[:, pid, t % PAGE]),
+            np.asarray(k_seq[:, t]),
+            atol=0,
+        )
+    # Padded tail landed in scratch page 0, not in any allocated page.
+    for t in range(ln, S):
+        assert int(page_ids[t]) == 0
+
+
+def test_allocator_oversubscription_and_rollback():
+    alloc = PageAllocator(num_pages=5, page_size=8)  # 4 usable pages
+    assert alloc.free_pages == 4
+    alloc.ensure(0, 16)  # 2 pages
+    with pytest.raises(Exception):
+        alloc.ensure(1, 9 * 8)  # too many -> rollback
+    assert alloc.free_pages == 2  # slot 1 holds nothing
+    alloc.release(0)
+    assert alloc.free_pages == 4
