@@ -50,7 +50,27 @@ class GemmaConfig:
     final_logit_softcapping: float | None = None
     attn_logit_softcapping: float | None = None
     query_pre_attn_scalar: float | None = None
+    # Gemma-2 alternates sliding-window (even layers) and global (odd
+    # layers) attention; None = all-global (Gemma 1). `layer_types`
+    # (serialized by HF as "sliding_attention"/"full_attention" per layer)
+    # overrides the default alternating pattern when a checkpoint carries
+    # a custom mapping.
+    sliding_window: int | None = None
+    layer_types: tuple[str, ...] | None = None
     dtype: Any = jnp.bfloat16
+
+    def layer_windows(self) -> jnp.ndarray:
+        """Per-layer effective window, 0 = global (scanned through the
+        layer loop so one compiled graph serves both layer kinds)."""
+        if self.sliding_window is None:
+            return jnp.zeros((self.num_layers,), jnp.int32)
+        if self.layer_types is not None:
+            sliding = [t == "sliding_attention" for t in self.layer_types]
+        else:
+            sliding = [i % 2 == 0 for i in range(self.num_layers)]
+        return jnp.asarray(
+            [self.sliding_window if s else 0 for s in sliding], jnp.int32
+        )
 
     @property
     def head_size(self) -> int:
@@ -80,6 +100,8 @@ class GemmaConfig:
             final_logit_softcapping=d.get("final_logit_softcapping"),
             attn_logit_softcapping=d.get("attn_logit_softcapping"),
             query_pre_attn_scalar=d.get("query_pre_attn_scalar"),
+            sliding_window=d.get("sliding_window") if is_g2 else None,
+            layer_types=tuple(d["layer_types"]) if d.get("layer_types") else None,
         )
 
     @staticmethod
@@ -189,7 +211,8 @@ def prefill(params, cfg, tokens, lengths, lora=None, lora_idx=None):
     x = params["embed"][tokens].astype(jnp.float32)
     x = (x * (cfg.hidden_size ** 0.5)).astype(params["embed"].dtype)
 
-    def layer(x, lp):
+    def layer(x, scanned):
+        lp, win = scanned["p"], scanned["win"]
         h = _norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q = jnp.einsum("bse,eh->bsh", h, lp["wq"]).reshape(B, S, H, D)
         k = jnp.einsum("bse,eh->bsh", h, lp["wk"]).reshape(B, S, KVH, D)
@@ -197,10 +220,13 @@ def prefill(params, cfg, tokens, lengths, lora=None, lora_idx=None):
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         qs = q * (_q_scale(cfg) * D ** 0.5)
-        if cfg.attn_logit_softcapping is not None:
-            # Softcapping needs the raw-logit path (not the flash kernel).
+        if cfg.attn_logit_softcapping is not None or cfg.sliding_window:
+            # Softcap / sliding window need the raw-logit path (the flash
+            # kernel carries neither mask).
             attn = causal_prefill_attention(
-                qs, k, v, logit_softcap=cfg.attn_logit_softcapping
+                qs, k, v,
+                logit_softcap=cfg.attn_logit_softcapping,
+                window=win if cfg.sliding_window else None,
             )
         else:
             attn = _prefill_attention(qs, k, v)
@@ -217,7 +243,9 @@ def prefill(params, cfg, tokens, lengths, lora=None, lora_idx=None):
         x = x + m_out
         return x, (k, v)
 
-    x, (k_all, v_all) = jax.lax.scan(layer, x, params["layers"])
+    x, (k_all, v_all) = jax.lax.scan(
+        layer, x, {"p": params["layers"], "win": cfg.layer_windows()}
+    )
     x = _norm(x, params["final_norm"], cfg.rms_norm_eps)
     idx = jnp.clip(lengths - 1, 0, S - 1)
     last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
@@ -255,6 +283,7 @@ def decode_step(params, cfg, tokens, positions, k_cache, v_cache,
         attn = decode_attention(
             q * (_q_scale(cfg) * D ** 0.5), kc, vc, lengths,
             logit_softcap=cfg.attn_logit_softcapping,
+            window=scanned["win"] if cfg.sliding_window else None,
         )
         a_out = jnp.einsum("bh,he->be", attn.reshape(B, H * D), lp["wo"])
         if cfg.sandwich_norms:
@@ -268,7 +297,11 @@ def decode_step(params, cfg, tokens, positions, k_cache, v_cache,
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = jax.lax.scan(
-        layer, x, {"p": params["layers"], "kc": k_cache, "vc": v_cache}
+        layer, x,
+        {
+            "p": params["layers"], "kc": k_cache, "vc": v_cache,
+            "win": cfg.layer_windows(),
+        },
     )
     x = _norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = jnp.einsum(
@@ -276,6 +309,69 @@ def decode_step(params, cfg, tokens, positions, k_cache, v_cache,
     )
     logits = _softcap(logits, cfg.final_logit_softcapping)
     return logits, k_cache, v_cache
+
+
+def decode_step_paged(params, cfg, tokens, positions, k_pages, v_pages,
+                      block_tables, lora=None, lora_idx=None):
+    """Paged decode (block tables; see llama.decode_step_paged). The
+    per-layer sliding window rides the scan, so Gemma-2's alternating
+    local/global layers share one compiled graph."""
+    from kubeai_tpu.ops.paged_attention import (
+        paged_decode_attention,
+        scatter_decode_token,
+        token_page_coords,
+    )
+
+    B = tokens.shape[0]
+    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    page_size = k_pages.shape[2]
+    inv_freq = jnp.asarray(rope_frequencies(D, cfg.rope_theta))
+    x = params["embed"][tokens].astype(jnp.float32)
+    x = (x * (cfg.hidden_size ** 0.5)).astype(params["embed"].dtype)
+    pos1 = positions[:, None]
+    lengths = positions + 1
+    page_ids, offsets = token_page_coords(block_tables, positions, page_size)
+
+    def layer(carry, scanned):
+        x = carry
+        lp, kp, vp = scanned["p"], scanned["kp"], scanned["vp"]
+        h = _norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q = jnp.einsum("be,eh->bh", h, lp["wq"]).reshape(B, 1, H, D)
+        k = jnp.einsum("be,eh->bh", h, lp["wk"]).reshape(B, 1, KVH, D)
+        v = jnp.einsum("be,eh->bh", h, lp["wv"]).reshape(B, 1, KVH, D)
+        q = apply_rope(q, pos1, inv_freq)[:, 0]
+        k = apply_rope(k, pos1, inv_freq)[:, 0]
+        v = v[:, 0]
+        kp, vp = scatter_decode_token(kp, vp, k, v, page_ids, offsets)
+        attn = paged_decode_attention(
+            q * (_q_scale(cfg) * D ** 0.5), kp, vp, block_tables, lengths,
+            logit_softcap=cfg.attn_logit_softcapping,
+            window=scanned["win"] if cfg.sliding_window else None,
+        )
+        a_out = jnp.einsum("bh,he->be", attn.reshape(B, H * D), lp["wo"])
+        if cfg.sandwich_norms:
+            a_out = _norm(a_out, lp["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + a_out
+        h2 = _norm(x, lp["pre_mlp_norm"], cfg.rms_norm_eps)
+        m_out = _mlp(h2[:, None], lp["w_gate"], lp["w_up"], lp["w_down"])[:, 0]
+        if cfg.sandwich_norms:
+            m_out = _norm(m_out, lp["post_mlp_norm"], cfg.rms_norm_eps)
+        x = x + m_out
+        return x, (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        layer, x,
+        {
+            "p": params["layers"], "kp": k_pages, "vp": v_pages,
+            "win": cfg.layer_windows(),
+        },
+    )
+    x = _norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = jnp.einsum(
+        "be,ve->bv", x, params["embed"], preferred_element_type=jnp.float32
+    )
+    logits = _softcap(logits, cfg.final_logit_softcapping)
+    return logits, k_pages, v_pages
 
 
 register_model_family(
@@ -287,6 +383,7 @@ register_model_family(
         param_specs=param_specs,
         prefill=prefill,
         decode_step=decode_step,
+        decode_step_paged=decode_step_paged,
         hf_architectures=("GemmaForCausalLM", "Gemma2ForCausalLM"),
     )
 )

@@ -238,6 +238,53 @@ def decode_step(params, cfg, tokens, positions, k_cache, v_cache,
     return logits, k_cache, v_cache
 
 
+def decode_step_paged(params, cfg, tokens, positions, k_pages, v_pages,
+                      block_tables, lora=None, lora_idx=None):
+    """Paged decode (block tables; see llama.decode_step_paged): scatter
+    the new token's K/V through the tables, attend over resident pages,
+    MoE FFN unchanged."""
+    from kubeai_tpu.ops.paged_attention import (
+        paged_decode_attention,
+        scatter_decode_token,
+        token_page_coords,
+    )
+
+    B = tokens.shape[0]
+    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    page_size = k_pages.shape[2]
+    inv_freq = jnp.asarray(rope_frequencies(D, cfg.rope_theta))
+    x = params["embed"][tokens]
+    pos1 = positions[:, None]
+    lengths = positions + 1
+    page_ids, offsets = token_page_coords(block_tables, positions, page_size)
+
+    def layer(carry, scanned):
+        x = carry
+        lp, kp, vp = scanned["p"], scanned["kp"], scanned["vp"]
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q = jnp.einsum("be,eh->bh", h, lp["wq"]).reshape(B, 1, H, D)
+        k = jnp.einsum("be,eh->bh", h, lp["wk"]).reshape(B, 1, KVH, D)
+        v = jnp.einsum("be,eh->bh", h, lp["wv"]).reshape(B, 1, KVH, D)
+        q = apply_rope(q, pos1, inv_freq)[:, 0]
+        k = apply_rope(k, pos1, inv_freq)[:, 0]
+        v = v[:, 0]
+        kp, vp = scatter_decode_token(kp, vp, k, v, page_ids, offsets)
+        attn = paged_decode_attention(q, kp, vp, block_tables, lengths)
+        x = x + jnp.einsum("bh,he->be", attn.reshape(B, H * D), lp["wo"])
+        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + _moe_ffn(h2[:, None], lp, cfg)[:, 0]
+        return x, (kp, vp)
+
+    x, (k_pages, v_pages) = jax.lax.scan(
+        layer, x, {"p": params["layers"], "kp": k_pages, "vp": v_pages}
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = jnp.einsum(
+        "be,ve->bv", x, params["lm_head"], preferred_element_type=jnp.float32
+    )
+    return logits, k_pages, v_pages
+
+
 register_model_family(
     ModelFamily(
         "mixtral",
@@ -247,6 +294,7 @@ register_model_family(
         param_specs=param_specs,
         prefill=prefill,
         decode_step=decode_step,
+        decode_step_paged=decode_step_paged,
         hf_architectures=("MixtralForCausalLM",),
     )
 )

@@ -39,6 +39,8 @@ def causal_prefill_attention(
     q_offset: jnp.ndarray | int = 0,  # positions of q within the sequence
     scale: float | None = None,
     logit_softcap: float | None = None,  # Gemma-2 tanh capping
+    window: jnp.ndarray | int | None = None,  # sliding window; traced OK,
+    #   <= 0 disables (lets a layer scan alternate local/global layers)
 ) -> jnp.ndarray:
     """Causal self-attention over a freshly computed prompt segment.
 
@@ -58,6 +60,11 @@ def causal_prefill_attention(
     q_pos = jnp.arange(s) + q_offset
     k_pos = jnp.arange(k.shape[1])
     mask = q_pos[:, None] >= k_pos[None, :]  # [Sq, Sk]
+    if window is not None:
+        win = jnp.asarray(window, jnp.int32)
+        mask = mask & (
+            (win <= 0) | (q_pos[:, None] - k_pos[None, :] < win)
+        )
     logits = jnp.where(mask[None, None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v.astype(jnp.float32))
@@ -97,6 +104,7 @@ def decode_attention(
     *,
     scale: float | None = None,
     logit_softcap: float | None = None,  # Gemma-2 tanh capping
+    window: jnp.ndarray | int | None = None,  # sliding window; <= 0 = off
 ) -> jnp.ndarray:
     """Single-token decode attention against the slot cache with length mask."""
     b, h, d = q.shape
@@ -110,6 +118,9 @@ def decode_attention(
         logits = jnp.tanh(logits / logit_softcap) * logit_softcap
     l_pos = jnp.arange(k_cache.shape[1])
     mask = l_pos[None, :] < lengths[:, None]  # [B, L]
+    if window is not None:
+        win = jnp.asarray(window, jnp.int32)
+        mask = mask & ((win <= 0) | (l_pos[None, :] >= lengths[:, None] - win))
     logits = jnp.where(mask[:, None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgl,blkd->bkgd", probs, v_cache.astype(jnp.float32))

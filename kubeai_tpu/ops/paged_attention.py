@@ -55,7 +55,9 @@ def ref_paged_decode_attention(
     *,
     scale: float | None = None,
     logit_softcap: float | None = None,
-    window: int | None = None,  # sliding window (Gemma-2); None = full
+    window: jnp.ndarray | int | None = None,  # sliding window (Gemma-2);
+    #   traced scalars OK, <= 0 disables — layer scans alternate
+    #   local/global layers with one compiled graph
 ) -> jnp.ndarray:
     """Gather pages into a virtual contiguous view, then masked attention.
     Semantics oracle for the kernel; CPU/test fallback path."""
@@ -77,7 +79,10 @@ def ref_paged_decode_attention(
     pos = jnp.arange(mp * page)
     mask = pos[None, :] < lengths[:, None]  # [B, L]
     if window is not None:
-        mask = mask & (pos[None, :] >= lengths[:, None] - window)
+        win = jnp.asarray(window, jnp.int32)
+        mask = mask & (
+            (win <= 0) | (pos[None, :] >= lengths[:, None] - win)
+        )
     logits = jnp.where(mask[:, None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgl,blkd->bkgd", probs, v.astype(jnp.float32))
@@ -91,6 +96,7 @@ def _paged_kernel(
     # scalar-prefetch
     bt_ref,  # [B, MP] int32 block tables
     len_ref,  # [B] int32 lengths
+    win_ref,  # [1] int32 sliding window (<= 0 = disabled)
     # blocks
     q_ref,  # [1, 1, G, D]
     k_ref,  # [1, page, 1, D] — the page selected by the index_map
@@ -104,14 +110,20 @@ def _paged_kernel(
     page_size: int,
     scale: float,
     logit_softcap: float | None,
-    window: int | None,
 ):
     b = pl.program_id(0)
     i = pl.program_id(2)
     mp = pl.num_programs(2)
 
     length = len_ref[b]
+    win = win_ref[0]
     n_pages = pl.cdiv(length, page_size)
+    # First page holding in-window keys (0 when the window is off):
+    # pages below it contribute nothing and their compute is skipped
+    # (their DMA is elided by the index_map clamp).
+    first = jnp.where(
+        win > 0, jnp.maximum(length - win, 0) // page_size, 0
+    )
 
     @pl.when(i == 0)
     def _init():
@@ -119,7 +131,7 @@ def _paged_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    @pl.when(i < n_pages)
+    @pl.when((i >= first) & (i < n_pages))
     def _attend():
         q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, D]
         k = k_ref[0, :, 0].astype(jnp.float32)  # [page, D]
@@ -131,8 +143,7 @@ def _paged_kernel(
             jnp.int32, s.shape, 1
         )
         valid = pos < length
-        if window is not None:
-            valid = valid & (pos >= length - window)
+        valid = valid & ((win <= 0) | (pos >= length - win))
         s = jnp.where(valid, s, NEG_INF)
         m_prev, l_prev, acc_prev = m_ref[:], l_ref[:], acc_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -151,20 +162,25 @@ def _paged_kernel(
         )
 
 
-def _page_index(b, h, i, bt_ref, len_ref, *, page_size):
-    """Index map for k/v pages: slot b's i-th page. Past the slot's last
-    page, KEEP RETURNING the last valid page — an unchanged block index
-    between consecutive grid steps elides the DMA entirely."""
+def _page_index(b, h, i, bt_ref, len_ref, win_ref, *, page_size):
+    """Index map for k/v pages: slot b's i-th page. Outside the live range
+    (past the last page, or below the sliding window's first page), KEEP
+    RETURNING the nearest live page — an unchanged block index between
+    consecutive grid steps elides the DMA entirely."""
     length = len_ref[b]
+    win = win_ref[0]
     last = jnp.maximum(pl.cdiv(length, page_size) - 1, 0)
-    clamped = jnp.minimum(i, last)
+    first = jnp.where(
+        win > 0, jnp.maximum(length - win, 0) // page_size, 0
+    )
+    clamped = jnp.clip(i, first, last)
     page_id = jnp.maximum(bt_ref[b, clamped], 0)
     return page_id, 0, h, 0
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "logit_softcap", "window", "interpret"),
+    static_argnames=("scale", "logit_softcap", "interpret"),
 )
 def _paged_pallas(
     q,  # [B, KVH, G, D]
@@ -172,10 +188,10 @@ def _paged_pallas(
     v_pages,
     block_tables,  # [B, MP]
     lengths,  # [B]
+    window,  # [1] int32, <= 0 disables
     *,
     scale: float,
     logit_softcap: float | None,
-    window: int | None,
     interpret: bool,
 ):
     b, kvh, g, d = q.shape
@@ -187,14 +203,14 @@ def _paged_pallas(
         page_size=page,
         scale=scale,
         logit_softcap=logit_softcap,
-        window=window,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(b, kvh, mp),
         in_specs=[
             pl.BlockSpec(
-                (1, 1, g, d), lambda b_, h_, i_, bt, ln: (b_, h_, 0, 0)
+                (1, 1, g, d),
+                lambda b_, h_, i_, bt, ln, wn: (b_, h_, 0, 0),
             ),
             pl.BlockSpec(
                 (1, page, 1, d),
@@ -206,7 +222,8 @@ def _paged_pallas(
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, g, d), lambda b_, h_, i_, bt, ln: (b_, h_, 0, 0)
+            (1, 1, g, d),
+            lambda b_, h_, i_, bt, ln, wn: (b_, h_, 0, 0),
         ),
         scratch_shapes=[
             pltpu.VMEM((g, 1), jnp.float32),
@@ -219,7 +236,7 @@ def _paged_pallas(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
         interpret=interpret,
-    )(block_tables, lengths, q, k_pages, v_pages)
+    )(block_tables, lengths, window, q, k_pages, v_pages)
     return out.reshape(b, kvh * g, d)
 
 
@@ -239,7 +256,7 @@ def paged_decode_attention(
     *,
     scale: float | None = None,
     logit_softcap: float | None = None,
-    window: int | None = None,
+    window: jnp.ndarray | int | None = None,
     use_pallas: bool | None = None,  # None = auto (TPU backend only)
     interpret: bool = False,
 ) -> jnp.ndarray:
@@ -259,10 +276,13 @@ def paged_decode_attention(
             q, k_pages, v_pages, block_tables, lengths,
             scale=scale, logit_softcap=logit_softcap, window=window,
         )
+    win_arr = jnp.asarray(
+        [0 if window is None else window], jnp.int32
+    ).reshape(1)
     qg = q.reshape(b, kvh, h // kvh, d)
     out = _paged_pallas(
-        qg, k_pages, v_pages, block_tables, lengths,
-        scale=scale, logit_softcap=logit_softcap, window=window,
+        qg, k_pages, v_pages, block_tables, lengths, win_arr,
+        scale=scale, logit_softcap=logit_softcap,
         interpret=interpret,
     )
     return out.reshape(b, h, d)
