@@ -198,6 +198,19 @@ class System:
                 raise ConfigError(
                     "messaging.streams entries need requestSubscription and responseTopic"
                 )
+            from kubeai_tpu.routing.brokers import SUPPORTED_SCHEMES, scheme_of
+
+            req_s = scheme_of(stream.request_subscription)
+            resp_s = scheme_of(stream.response_topic)
+            if req_s != resp_s:
+                raise ConfigError(
+                    f"messaging stream mixes schemes: {req_s} vs {resp_s}"
+                )
+            if req_s not in SUPPORTED_SCHEMES:
+                raise ConfigError(
+                    f"unsupported messaging scheme {req_s!r} "
+                    f"(supported: {', '.join(SUPPORTED_SCHEMES)})"
+                )
         return self
 
 

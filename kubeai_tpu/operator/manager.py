@@ -95,8 +95,28 @@ class Manager:
             metrics=self.metrics,
         )
         self.messengers: list[Messenger] = []
-        broker = self.broker or (MemBroker() if self.cfg.messaging.streams else None)
+        # One broker per stream, chosen by URL scheme (gcppubsub://,
+        # nats://, plain names = in-memory) — the reference registers the
+        # same per-scheme driver model (reference: internal/manager/
+        # run.go:47-52). An injected self.broker overrides all streams
+        # (test seam, like the reference's mem:// integration wiring).
+        from kubeai_tpu.routing.brokers import make_broker, scheme_of
+
+        default_broker = self.broker  # injected test seam overrides all
+        self._owned_brokers: list = []
         for stream in self.cfg.messaging.streams:
+            scheme = scheme_of(stream.request_subscription)
+            if self.broker is not None:
+                broker = self.broker
+            elif scheme == "mem":
+                # One shared MemBroker across mem streams, built only when
+                # a stream actually uses it.
+                if default_broker is None:
+                    default_broker = MemBroker()
+                broker = default_broker
+            else:
+                broker = make_broker(stream.request_subscription)
+                self._owned_brokers.append(broker)
             self.messengers.append(
                 Messenger(
                     broker,
@@ -109,7 +129,7 @@ class Manager:
                     metrics=self.metrics,
                 )
             )
-        self.broker = broker
+        self.broker = default_broker
 
     @property
     def api_address(self) -> str:
@@ -167,6 +187,12 @@ class Manager:
                 pass
         for m in self.messengers:
             m.stop()
+        for b in getattr(self, "_owned_brokers", []):
+            try:
+                b.close()  # stop pull threads / close sockets so un-acked
+                # messages redeliver to surviving replicas promptly
+            except Exception:
+                pass
         self.api_server.stop()
         self.autoscaler.stop()
         self.leader.stop()

@@ -43,15 +43,30 @@ DEFAULT_PATH = "/v1/completions"
 
 
 class Message:
-    def __init__(self, body: bytes):
+    """One delivered message. `on_ack`/`on_nack` carry the driver's side
+    effects (Pub/Sub acknowledge / modifyAckDeadline(0); no-ops for
+    MemBroker and core NATS). ack/nack are idempotent — the first call
+    wins, mirroring broker semantics."""
+
+    def __init__(self, body: bytes, on_ack=None, on_nack=None):
         self.body = body
         self.acked: bool | None = None
+        self._on_ack = on_ack
+        self._on_nack = on_nack
 
     def ack(self) -> None:
+        if self.acked is not None:
+            return
         self.acked = True
+        if self._on_ack:
+            self._on_ack()
 
     def nack(self) -> None:
+        if self.acked is not None:
+            return
         self.acked = False
+        if self._on_nack:
+            self._on_nack()
 
 
 class Broker(Protocol):
@@ -137,7 +152,16 @@ class Messenger:
             if self._stop.is_set():
                 self._semaphore.release()
                 return
-            msg = self.broker.receive(self.request_subscription, timeout=0.2)
+            try:
+                msg = self.broker.receive(self.request_subscription, timeout=0.2)
+            except Exception as e:
+                # A driver may raise on transport failure (e.g. NATS
+                # connect refused); the loop must survive and retry —
+                # a dead receive loop deafens the stream permanently.
+                logger.warning("broker receive failed: %s", e)
+                self._semaphore.release()
+                self._consecutive_errors += 1
+                continue
             if msg is None:
                 self._semaphore.release()
                 continue

@@ -242,3 +242,60 @@ def test_ha_two_replicas_leader_scrapes_follower_load(world):
     finally:
         mgr_b.stop()
         engine.behavior = None
+
+
+def test_messenger_gcppubsub_stream_through_manager(monkeypatch):
+    """A stream configured with gcppubsub:// URLs runs through the real
+    per-scheme broker wiring (PUBSUB_EMULATOR_HOST, like the official
+    emulator) — request envelope in, response envelope out."""
+    import sys as _sys
+
+    _sys.path.insert(0, "tests/unit")
+    from test_brokers import FakePubSub
+
+    from kubeai_tpu.routing.brokers import GCPPubSubBroker
+
+    fake = FakePubSub()
+    monkeypatch.setenv(
+        "PUBSUB_EMULATOR_HOST", fake.endpoint.replace("http://", "")
+    )
+    store = KubeStore()
+    cfg = System()
+    cfg.allow_pod_address_override = True
+    cfg.messaging.streams = [
+        MessageStream(
+            request_subscription="gcppubsub://projects/p/subscriptions/req",
+            response_topic="gcppubsub://projects/p/topics/resp",
+        )
+    ]
+    engine = FakeEngine()
+    mgr = Manager(store, cfg)
+    assert isinstance(mgr.messengers[0].broker, GCPPubSubBroker)
+    mgr.start()
+    try:
+        create_model(store, engine, name="mps", min_replicas=0)
+        with fake_kubelet(store, "mps"):
+            client = GCPPubSubBroker(endpoint=fake.endpoint)
+            client.publish(
+                "gcppubsub://projects/p/topics/req",
+                json.dumps(
+                    {
+                        "metadata": {"trace": "t1"},
+                        "path": "/v1/completions",
+                        "body": {"model": "mps", "prompt": "hi"},
+                    }
+                ).encode(),
+            )
+            payload = eventually(
+                lambda: (fake.published.get("resp") or [None])[-1],
+                timeout=20,
+                msg="pubsub response published",
+            )
+            parsed = json.loads(payload)
+            assert parsed["status_code"] == 200
+            assert parsed["metadata"] == {"trace": "t1"}
+            client.close()
+    finally:
+        mgr.stop()
+        engine.stop()
+        fake.close()

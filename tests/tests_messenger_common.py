@@ -1,0 +1,63 @@
+"""Shared messenger-world builder: a Messenger wired to an arbitrary
+broker driver, with a fake engine send and a ready endpoint — so the
+same behavioral suite runs over MemBroker, Pub/Sub, and NATS."""
+
+import json
+
+from kubeai_tpu.crd.model import Model, ModelSpec
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.loadbalancer import LoadBalancer
+from kubeai_tpu.routing.messenger import Messenger
+from kubeai_tpu.routing.modelclient import ModelClient
+
+
+def build_messenger_world(broker, request_subscription, response_topic):
+    store = KubeStore()
+    mc = ModelClient(store)
+    lb = LoadBalancer(store)
+    sent = []
+
+    def fake_send(addr, path, body):
+        sent.append((addr, path, json.loads(body)))
+        return 200, json.dumps({"ok": True}).encode()
+
+    store.create(
+        Model(
+            name="m1",
+            spec=ModelSpec(
+                url="hf://org/x", engine="KubeAITPU",
+                min_replicas=0, max_replicas=2, replicas=1,
+            ),
+        ).to_dict()
+    )
+    store.create(
+        {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": "model-m1-0",
+                "namespace": "default",
+                "labels": {"model": "m1"},
+                "annotations": {
+                    "model-pod-ip": "127.0.0.1",
+                    "model-pod-port": "9000",
+                },
+            },
+            "status": {
+                "conditions": [{"type": "Ready", "status": "True"}],
+                "podIP": "127.0.0.1",
+            },
+        }
+    )
+    lb.sync_model("m1")
+    messenger = Messenger(
+        broker, request_subscription, response_topic, lb, mc,
+        http_send=fake_send,
+    )
+    messenger.start()
+    return {
+        "store": store,
+        "lb": lb,
+        "messenger": messenger,
+        "sent": sent,
+    }
