@@ -18,8 +18,8 @@ import os
 import numpy as np
 
 from kubeai_tpu.engine.weights import (
+    LazyTensors,
     WeightLoadError,
-    _open_checkpoint_tensors,
     resolve_model_dir,
 )
 
@@ -44,7 +44,7 @@ def load_peft_adapter(path_or_url: str, model_cfg, max_rank: int = 16) -> dict:
     if r > max_rank:
         raise WeightLoadError(f"adapter rank {r} exceeds engine max {max_rank}")
 
-    tensors = _open_checkpoint_tensors(adapter_dir)
+    tensors = LazyTensors(adapter_dir)
     NL = model_cfg.num_layers
 
     out: dict = {}

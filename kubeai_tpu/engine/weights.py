@@ -2,15 +2,25 @@
 
 The reference delegates weight loading to engine images + a loader
 container (reference: components/model-loader/load.sh, engine_vllm.go
-runai-streamer args). Here loading is native: safetensors/PyTorch-bin
-checkpoints are mapped tensor-by-tensor onto the stacked-layer layout and
-device_put with the target sharding — each shard's slice streams straight
-from host to its device (no full-model host copy per device).
+runai-streamer args). Here loading is native AND streamed:
+
+  - Tensors are read LAZILY: safetensors headers are parsed once, each
+    tensor is seek-read from its shard file only when its target slot is
+    being filled, and stacked-layer leaves are assembled directly into
+    preallocated TARGET-dtype (bf16) buffers. Peak host memory is the
+    bf16 param tree plus ONE tensor — never an fp32 full-model staging
+    copy (SURVEY.md §7 "sharded load fast enough for elastic scaling";
+    70B in fp32 staging would need ~280 GB host RAM).
+  - Remote artifacts (s3:// gs:// oss://) stream shard-at-a-time to
+    local disk through kubeai_tpu.objstore (chunked object→file copies,
+    one object in flight), then lazy-load from there.
 
 Supported sources:
   - local directory (pvc:// mounts, cache dirs): config.json + *.safetensors
   - hf://repo: resolved through HF_HOME cache / huggingface_hub when
     network is available (zero-egress test environments use local dirs)
+  - s3://, gs://, oss:// bucket prefixes (engine-direct; cache Jobs use
+    kubeai_tpu.loader for the shared-PVC flow)
 """
 
 from __future__ import annotations
@@ -35,44 +45,6 @@ def load_hf_config(model_dir: str) -> dict:
         return json.load(f)
 
 
-def _open_checkpoint_tensors(model_dir: str) -> dict[str, np.ndarray]:
-    """Load all tensors from safetensors (preferred) or torch .bin files."""
-    tensors: dict[str, np.ndarray] = {}
-    st_files = sorted(
-        f for f in os.listdir(model_dir) if f.endswith(".safetensors")
-    )
-    if st_files:
-        try:
-            from safetensors import safe_open
-        except ImportError:
-            safe_open = None
-        for fname in st_files:
-            fpath = os.path.join(model_dir, fname)
-            if safe_open is not None:
-                with safe_open(fpath, framework="np") as f:
-                    for k in f.keys():
-                        tensors[k] = f.get_tensor(k)
-            else:
-                tensors.update(_read_safetensors_raw(fpath))
-        return tensors
-    bin_files = sorted(
-        f for f in os.listdir(model_dir)
-        if f.endswith(".bin") and f.startswith("pytorch_model")
-    )
-    if bin_files:
-        import torch
-
-        for fname in bin_files:
-            sd = torch.load(
-                os.path.join(model_dir, fname), map_location="cpu",
-                weights_only=True,
-            )
-            for k, v in sd.items():
-                tensors[k] = v.to(torch.float32).numpy()
-        return tensors
-    raise WeightLoadError(f"no safetensors or pytorch_model*.bin in {model_dir}")
-
-
 _ST_DTYPES = {
     "F32": np.float32,
     "F16": np.float16,
@@ -83,31 +55,119 @@ _ST_DTYPES = {
 }
 
 
-def _read_safetensors_raw(path: str) -> dict[str, np.ndarray]:
-    """Minimal safetensors reader (header + raw slices) — no dependency."""
-    out: dict[str, np.ndarray] = {}
-    with open(path, "rb") as f:
-        header_len = int.from_bytes(f.read(8), "little")
-        header = json.loads(f.read(header_len))
-        base = 8 + header_len
-        for name, meta in header.items():
-            if name == "__metadata__":
-                continue
-            dtype_s = meta["dtype"]
-            start, end = meta["data_offsets"]
-            f.seek(base + start)
-            raw = f.read(end - start)
-            shape = meta["shape"]
-            if dtype_s == "BF16":
-                u16 = np.frombuffer(raw, np.uint16).reshape(shape)
-                u32 = u16.astype(np.uint32) << 16
-                out[name] = u32.view(np.float32).reshape(shape)
-            else:
-                np_dtype = _ST_DTYPES.get(dtype_s)
-                if np_dtype is None:
-                    raise WeightLoadError(f"unsupported dtype {dtype_s} for {name}")
-                out[name] = np.frombuffer(raw, np_dtype).reshape(shape)
-    return out
+def _decode_raw(raw: bytes, dtype_s: str, shape, name: str) -> np.ndarray:
+    if dtype_s == "BF16":
+        u16 = np.frombuffer(raw, np.uint16)
+        u32 = u16.astype(np.uint32) << 16
+        return u32.view(np.float32).reshape(shape)
+    np_dtype = _ST_DTYPES.get(dtype_s)
+    if np_dtype is None:
+        raise WeightLoadError(f"unsupported dtype {dtype_s} for {name}")
+    return np.frombuffer(raw, np_dtype).reshape(shape)
+
+
+class LazyTensors:
+    """Lazy tensor mapping over a checkpoint directory.
+
+    safetensors: headers parsed up front (cheap), tensor data seek-read
+    on demand — nothing resident until requested, nothing cached after.
+    pytorch_model*.bin: eager fallback (torch pickles don't support
+    random access without loading)."""
+
+    def __init__(self, model_dir: str):
+        self._index: dict[str, tuple[str, str, list, int, int]] = {}
+        self._eager: dict[str, np.ndarray] | None = None
+        st_files = sorted(
+            f for f in os.listdir(model_dir) if f.endswith(".safetensors")
+        )
+        if st_files:
+            for fname in st_files:
+                fpath = os.path.join(model_dir, fname)
+                with open(fpath, "rb") as f:
+                    header_len = int.from_bytes(f.read(8), "little")
+                    header = json.loads(f.read(header_len))
+                    base = 8 + header_len
+                for name, meta in header.items():
+                    if name == "__metadata__":
+                        continue
+                    start, end = meta["data_offsets"]
+                    self._index[name] = (
+                        fpath, meta["dtype"], meta["shape"],
+                        base + start, end - start,
+                    )
+            return
+        bin_files = sorted(
+            f for f in os.listdir(model_dir)
+            if f.endswith(".bin") and f.startswith("pytorch_model")
+        )
+        if not bin_files:
+            raise WeightLoadError(
+                f"no safetensors or pytorch_model*.bin in {model_dir}"
+            )
+        import torch
+
+        self._eager = {}
+        for fname in bin_files:
+            sd = torch.load(
+                os.path.join(model_dir, fname), map_location="cpu",
+                weights_only=True,
+            )
+            for k, v in sd.items():
+                self._eager[k] = v.to(torch.float32).numpy()
+
+    def __contains__(self, name: str) -> bool:
+        if self._eager is not None:
+            return name in self._eager
+        return name in self._index
+
+    def keys(self):
+        return (self._eager or self._index).keys()
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        """fp32 view of one tensor, freshly read (caller must not expect
+        the buffer to persist cheaply — copy into the target and drop)."""
+        if self._eager is not None:
+            return self._eager[name]
+        if name not in self._index:
+            raise KeyError(name)
+        fpath, dtype_s, shape, offset, nbytes = self._index[name]
+        with open(fpath, "rb") as f:
+            f.seek(offset)
+            raw = f.read(nbytes)
+        a = _decode_raw(raw, dtype_s, shape, name)
+        return np.asarray(a, np.float32)
+
+
+def _stream_helpers(model_dir: str, NL: int, dtype):
+    """(tensors, get, stack, leaf): the shared streamed-assembly kit.
+
+    `stack` fills a preallocated [NL, ...] TARGET-dtype buffer one layer
+    tensor at a time (numpy casts on assignment), so the fp32 view of
+    each tensor lives only for its own copy — peak host memory is the
+    target tree + one tensor, not an fp32 full model."""
+    t = LazyTensors(model_dir)
+    target = np.dtype(dtype)
+
+    def get(name: str) -> np.ndarray:
+        if name not in t:
+            raise WeightLoadError(f"missing tensor {name}")
+        return t[name]
+
+    def leaf(name: str) -> jnp.ndarray:
+        return jnp.asarray(get(name).astype(target))
+
+    def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
+        buf = None
+        for i in range(NL):
+            a = get(fmt.format(i=i))
+            if transpose:
+                a = a.T
+            if buf is None:
+                buf = np.empty((NL, *a.shape), target)
+            buf[i] = a  # casts fp32 -> target in place
+        return jnp.asarray(buf)
+
+    return t, get, stack, leaf
 
 
 def load_llama_params(model_dir: str, cfg, dtype=jnp.bfloat16) -> dict:
@@ -118,20 +178,7 @@ def load_llama_params(model_dir: str, cfg, dtype=jnp.bfloat16) -> dict:
     shape [out, in]; our layout stacks layers and keeps [in, out] so the
     forward einsums contract without transposes on the MXU.
     """
-    t = _open_checkpoint_tensors(model_dir)
-    NL = cfg.num_layers
-
-    def get(name: str) -> np.ndarray:
-        if name not in t:
-            raise WeightLoadError(f"missing tensor {name}")
-        return np.asarray(t[name], np.float32)
-
-    def stack(fmt: str, transpose: bool = True) -> jnp.ndarray:
-        arrs = []
-        for i in range(NL):
-            a = get(fmt.format(i=i))
-            arrs.append(a.T if transpose else a)
-        return jnp.asarray(np.stack(arrs), dtype)
+    t, get, stack, leaf = _stream_helpers(model_dir, cfg.num_layers, dtype)
 
     extra_layers = {}
     if getattr(cfg, "attention_bias", False):
@@ -140,9 +187,8 @@ def load_llama_params(model_dir: str, cfg, dtype=jnp.bfloat16) -> dict:
             "bk": stack("model.layers.{i}.self_attn.k_proj.bias", transpose=False),
             "bv": stack("model.layers.{i}.self_attn.v_proj.bias", transpose=False),
         }
-    embed = get("model.embed_tokens.weight")
     params = {
-        "embed": jnp.asarray(embed, dtype),
+        "embed": leaf("model.embed_tokens.weight"),
         "layers": {
             "input_norm": stack(
                 "model.layers.{i}.input_layernorm.weight", transpose=False
@@ -160,10 +206,10 @@ def load_llama_params(model_dir: str, cfg, dtype=jnp.bfloat16) -> dict:
             "w_down": stack("model.layers.{i}.mlp.down_proj.weight"),
             **extra_layers,
         },
-        "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
+        "final_norm": leaf("model.norm.weight"),
     }
     if "lm_head.weight" in t:
-        params["lm_head"] = jnp.asarray(get("lm_head.weight"), dtype)
+        params["lm_head"] = leaf("lm_head.weight")
     else:  # tied embeddings
         params["lm_head"] = params["embed"]
     return params
@@ -192,6 +238,44 @@ def resolve_model_dir(model_url: str, model_dir: str = "") -> str:
             raise WeightLoadError(
                 f"cannot download {repo} (offline?): {e}"
             ) from e
+    if model_url.split("://")[0] in ("s3", "gs", "oss"):
+        # Engine-direct object-store load: stream shard files one at a
+        # time to a local cache dir (disk, chunked — never whole-model in
+        # RAM), then lazy-read from there. Cache Jobs pre-populate a PVC
+        # via kubeai_tpu.loader for the shared-filesystem flow.
+        import hashlib as _hashlib
+
+        from kubeai_tpu import objstore
+
+        cache_root = os.environ.get(
+            "KUBEAI_WEIGHTS_CACHE", "/tmp/kubeai-weights"
+        )
+        digest = _hashlib.sha256(model_url.encode()).hexdigest()[:16]
+        dest = os.path.join(cache_root, digest)
+        done_marker = os.path.join(dest, ".kubeai-complete")
+        if not os.path.exists(done_marker):
+            # Download into a process-private staging dir, then atomically
+            # rename: concurrent replicas sharing the cache never read a
+            # half-written shard, and the loser of the rename race just
+            # uses the winner's copy.
+            import shutil as _shutil
+            import tempfile as _tempfile
+
+            os.makedirs(cache_root, exist_ok=True)
+            staging = _tempfile.mkdtemp(dir=cache_root, prefix=f".{digest}-")
+            try:
+                objstore.download_prefix(model_url.split("?")[0], staging)
+                with open(os.path.join(staging, ".kubeai-complete"), "w") as f:
+                    f.write(model_url)
+                try:
+                    os.rename(staging, dest)
+                except OSError:
+                    if not os.path.exists(done_marker):
+                        raise
+            finally:
+                if os.path.exists(staging):
+                    _shutil.rmtree(staging, ignore_errors=True)
+        return dest
     if os.path.isdir(model_url):
         return model_url
     raise WeightLoadError(f"unsupported model url {model_url!r}")
@@ -199,22 +283,7 @@ def resolve_model_dir(model_url: str, model_dir: str = "") -> str:
 
 def load_gemma_params(model_dir: str, cfg, dtype=jnp.bfloat16) -> dict:
     """HF Gemma/Gemma2 checkpoint → kubeai_tpu.models.gemma layout."""
-    t = _open_checkpoint_tensors(model_dir)
-    NL = cfg.num_layers
-
-    def get(name):
-        if name not in t:
-            raise WeightLoadError(f"missing tensor {name}")
-        return np.asarray(t[name], np.float32)
-
-    def stack(fmt, transpose=True):
-        return jnp.asarray(
-            np.stack([
-                get(fmt.format(i=i)).T if transpose else get(fmt.format(i=i))
-                for i in range(NL)
-            ]),
-            dtype,
-        )
+    t, get, stack, leaf = _stream_helpers(model_dir, cfg.num_layers, dtype)
 
     layers = {
         "input_norm": stack("model.layers.{i}.input_layernorm.weight", False),
@@ -241,46 +310,33 @@ def load_gemma_params(model_dir: str, cfg, dtype=jnp.bfloat16) -> dict:
             "model.layers.{i}.post_attention_layernorm.weight", False
         )
     return {
-        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype),
+        "embed": leaf("model.embed_tokens.weight"),
         "layers": layers,
-        "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
+        "final_norm": leaf("model.norm.weight"),
     }
 
 
 def load_mixtral_params(model_dir: str, cfg, dtype=jnp.bfloat16) -> dict:
     """HF Mixtral checkpoint → kubeai_tpu.models.mixtral layout
     (experts stacked: w1=gate, w3=up, w2=down)."""
-    t = _open_checkpoint_tensors(model_dir)
     NL, X = cfg.num_layers, cfg.num_experts
-
-    def get(name):
-        if name not in t:
-            raise WeightLoadError(f"missing tensor {name}")
-        return np.asarray(t[name], np.float32)
-
-    def stack(fmt, transpose=True):
-        return jnp.asarray(
-            np.stack([
-                get(fmt.format(i=i)).T if transpose else get(fmt.format(i=i))
-                for i in range(NL)
-            ]),
-            dtype,
-        )
+    t, get, stack, leaf = _stream_helpers(model_dir, NL, dtype)
+    target = np.dtype(dtype)
 
     def stack_experts(w_name):
-        out = []
+        buf = None
         for i in range(NL):
-            per_layer = [
-                get(
+            for e in range(X):
+                a = get(
                     f"model.layers.{i}.block_sparse_moe.experts.{e}.{w_name}.weight"
                 ).T
-                for e in range(X)
-            ]
-            out.append(np.stack(per_layer))
-        return jnp.asarray(np.stack(out), dtype)  # [NL, X, in, out]
+                if buf is None:
+                    buf = np.empty((NL, X, *a.shape), target)
+                buf[i, e] = a
+        return jnp.asarray(buf)  # [NL, X, in, out]
 
     return {
-        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype),
+        "embed": leaf("model.embed_tokens.weight"),
         "layers": {
             "input_norm": stack("model.layers.{i}.input_layernorm.weight", False),
             "wq": stack("model.layers.{i}.self_attn.q_proj.weight"),
@@ -295,8 +351,8 @@ def load_mixtral_params(model_dir: str, cfg, dtype=jnp.bfloat16) -> dict:
             "w_up": stack_experts("w3"),
             "w_down": stack_experts("w2"),
         },
-        "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
-        "lm_head": jnp.asarray(get("lm_head.weight"), dtype),
+        "final_norm": leaf("model.norm.weight"),
+        "lm_head": leaf("lm_head.weight"),
     }
 
 
@@ -317,12 +373,12 @@ def load_params(family_name: str, model_dir: str, cfg, dtype=jnp.bfloat16):
 
 def load_whisper_params(model_dir: str, cfg, dtype=jnp.float32) -> dict:
     """HF WhisperForConditionalGeneration → kubeai_tpu.models.whisper layout."""
-    t = _open_checkpoint_tensors(model_dir)
+    t = LazyTensors(model_dir)
 
     def get(name):
         if name not in t:
             raise WeightLoadError(f"missing tensor {name}")
-        return np.asarray(t[name], np.float32)
+        return t[name]
 
     def j(a):
         return jnp.asarray(a, dtype)

@@ -1,0 +1,78 @@
+"""Model-artifact loader: `python -m kubeai_tpu.loader load <src> <dst>`.
+
+The in-tree equivalent of the reference's loader container
+(reference: components/model-loader/load.sh:1-67, used by cache Jobs at
+internal/modelcontroller/cache.go:310-372 and the adapter sidecar). Same
+contract: download <src> (hf/s3/gs/oss) into <dst>; when <dst> is itself
+a URL, download to a temp dir then upload. The operator's cache Job
+renders exactly `["load", <model url>, <cache dir>]`
+(kubeai_tpu/operator/cache.py), with this module as the image
+entrypoint. No cloud CLIs — kubeai_tpu.objstore speaks the wire
+protocols directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import shutil
+import sys
+import tempfile
+
+from kubeai_tpu import objstore
+
+logger = logging.getLogger("kubeai-tpu-loader")
+
+
+def _download_hf(repo_ref: str, dest: str) -> None:
+    repo = repo_ref.split("?")[0]
+    from huggingface_hub import snapshot_download
+
+    snapshot_download(repo, local_dir=dest)
+    # Parity with load.sh: drop the hub cache metadata from the artifact.
+    cache = os.path.join(dest, ".cache")
+    if os.path.isdir(cache):
+        shutil.rmtree(cache, ignore_errors=True)
+
+
+def download(src: str, dest_dir: str) -> None:
+    os.makedirs(dest_dir, exist_ok=True)
+    if src.startswith("hf://"):
+        _download_hf(src[len("hf://"):], dest_dir)
+    elif src.split("://")[0] in ("s3", "gs", "oss"):
+        objstore.download_prefix(src, dest_dir)
+    elif os.path.isdir(src):  # local-to-local (tests, pvc copies)
+        shutil.copytree(src, dest_dir, dirs_exist_ok=True)
+    else:
+        raise SystemExit(f"Unsupported source url: {src}")
+
+
+def upload(src_dir: str, dest: str) -> None:
+    if dest.split("://")[0] in ("s3", "gs", "oss"):
+        objstore.upload_dir(src_dir, dest)
+    else:
+        raise SystemExit(f"Unsupported destination url: {dest}")
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    ap = argparse.ArgumentParser(prog="kubeai-tpu-loader")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("load", help="load <src> <dst>")
+    p.add_argument("src")
+    p.add_argument("dst")
+    args = ap.parse_args(argv)
+
+    if "://" in args.dst:
+        with tempfile.TemporaryDirectory() as tmp:
+            download(args.src, tmp)
+            upload(tmp, args.dst)
+    else:
+        download(args.src, args.dst)
+    logger.info("load complete: %s -> %s", args.src, args.dst)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

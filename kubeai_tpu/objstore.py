@@ -1,0 +1,407 @@
+"""Zero-dependency object-store clients: GCS (JSON API) and S3 (SigV4).
+
+The reference ships a loader container with gcloud/awscli/ossutil
+(reference: components/model-loader/load.sh:20-67, Dockerfile). This
+environment installs nothing, so the stores are spoken natively:
+
+  gs://bucket/prefix   — GCS JSON API over HTTPS. Auth from the GKE
+      metadata server when available, anonymous otherwise.
+      STORAGE_EMULATOR_HOST / endpoint override points at the
+      fake-gcs-server surface used in tests.
+  s3://bucket/prefix   — S3 REST with AWS Signature V4 (hand-rolled:
+      hmac+sha256 only). Credentials from AWS_ACCESS_KEY_ID/
+      AWS_SECRET_ACCESS_KEY; unsigned requests when absent (test fakes,
+      public buckets). AWS_ENDPOINT_URL overrides for MinIO-style fakes.
+  oss://bucket/prefix  — Alibaba OSS through its S3-compatible surface:
+      the S3 client with OSS_ENDPOINT (+ OSS_ACCESS_KEY_ID/SECRET).
+
+Streaming discipline: downloads go object→file in fixed-size chunks
+(never whole-object in memory), one object at a time — the weight
+loader's shard-at-a-time path builds on this.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import http.client
+import json
+import logging
+import os
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+logger = logging.getLogger(__name__)
+
+CHUNK = 1 << 20  # 1 MiB copy chunks
+
+
+class ObjStoreError(RuntimeError):
+    pass
+
+
+def parse_url(url: str) -> tuple[str, str, str]:
+    """'gs://bucket/a/b' -> ('gs', 'bucket', 'a/b')."""
+    parsed = urllib.parse.urlparse(url)
+    return parsed.scheme, parsed.netloc, parsed.path.lstrip("/")
+
+
+def client_for(url: str):
+    scheme, _, _ = parse_url(url)
+    if scheme == "gs":
+        return GCSClient()
+    if scheme == "s3":
+        return S3Client()
+    if scheme == "oss":
+        return S3Client(
+            endpoint=os.environ.get("OSS_ENDPOINT"),
+            access_key=os.environ.get("OSS_ACCESS_KEY_ID"),
+            secret_key=os.environ.get("OSS_ACCESS_KEY_SECRET"),
+        )
+    raise ObjStoreError(f"unsupported object-store scheme {scheme!r}")
+
+
+def _http(endpoint: str, default_host: str, timeout: float = 120.0):
+    """HTTPConnection for an endpoint override (scheme optional) or the
+    default HTTPS host. Shared by the GCS client and the Pub/Sub broker."""
+    if endpoint:
+        if "://" not in endpoint:
+            endpoint = "http://" + endpoint
+        p = urllib.parse.urlparse(endpoint)
+        if p.scheme == "https":
+            return http.client.HTTPSConnection(p.hostname, p.port or 443, timeout=timeout)
+        return http.client.HTTPConnection(p.hostname, p.port or 80, timeout=timeout)
+    return http.client.HTTPSConnection(default_host, 443, timeout=timeout)
+
+
+_META_LOCK = __import__("threading").Lock()
+_META_TOKEN: tuple[str, float] | None = None
+
+
+def gcp_metadata_token(required: bool = False) -> str | None:
+    """OAuth token from the GKE metadata server (workload identity /
+    node SA), cached with 60s expiry skew. None when unreachable and not
+    required. Shared by every Google-API client in the tree."""
+    global _META_TOKEN
+    import time
+
+    now = time.time()
+    with _META_LOCK:
+        if _META_TOKEN and _META_TOKEN[1] > now + 60:
+            return _META_TOKEN[0]
+        try:
+            conn = http.client.HTTPConnection(
+                "metadata.google.internal", 80, timeout=5
+            )
+            conn.request(
+                "GET",
+                "/computeMetadata/v1/instance/service-accounts/default/token",
+                headers={"Metadata-Flavor": "Google"},
+            )
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            if resp.status != 200:
+                raise ObjStoreError(
+                    f"metadata token: {resp.status} {body[:120]!r}"
+                )
+            data = json.loads(body)
+            _META_TOKEN = (
+                data["access_token"],
+                now + float(data.get("expires_in", 300)),
+            )
+            return _META_TOKEN[0]
+        except OSError as e:
+            if required:
+                raise ObjStoreError(f"metadata server unreachable: {e}")
+            return None
+
+
+class GCSClient:
+    """GCS JSON API: list / download (alt=media, chunked) / upload."""
+
+    def __init__(self, endpoint: str | None = None):
+        self.endpoint = endpoint or os.environ.get("STORAGE_EMULATOR_HOST")
+
+    def _auth(self) -> dict:
+        if self.endpoint:
+            return {}
+        token = gcp_metadata_token()
+        return {"Authorization": f"Bearer {token}"} if token else {}
+
+    def _conn(self):
+        return _http(self.endpoint, "storage.googleapis.com")
+
+    def list(self, bucket: str, prefix: str) -> list[dict]:
+        """[{name, size}] under prefix (paginated)."""
+        items, page = [], None
+        while True:
+            q = {"prefix": prefix, "maxResults": "1000"}
+            if page:
+                q["pageToken"] = page
+            conn = self._conn()
+            try:
+                conn.request(
+                    "GET",
+                    f"/storage/v1/b/{urllib.parse.quote(bucket, safe='')}/o?"
+                    + urllib.parse.urlencode(q),
+                    headers=self._auth(),
+                )
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status >= 400:
+                    raise ObjStoreError(
+                        f"gcs list {bucket}/{prefix}: {resp.status} {body[:200]!r}"
+                    )
+            finally:
+                conn.close()
+            out = json.loads(body)
+            items += [
+                {"name": o["name"], "size": int(o.get("size", 0))}
+                for o in out.get("items", [])
+            ]
+            page = out.get("nextPageToken")
+            if not page:
+                return items
+
+    def get_to_file(self, bucket: str, name: str, dest_path: str) -> None:
+        conn = self._conn()
+        try:
+            conn.request(
+                "GET",
+                f"/download/storage/v1/b/{urllib.parse.quote(bucket, safe='')}"
+                f"/o/{urllib.parse.quote(name, safe='')}?alt=media",
+                headers=self._auth(),
+            )
+            resp = conn.getresponse()
+            if resp.status >= 400:
+                raise ObjStoreError(
+                    f"gcs get {bucket}/{name}: {resp.status}"
+                )
+            os.makedirs(os.path.dirname(dest_path) or ".", exist_ok=True)
+            with open(dest_path, "wb") as f:
+                while True:
+                    chunk = resp.read(CHUNK)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+        finally:
+            conn.close()
+
+    def put_from_file(self, bucket: str, name: str, src_path: str) -> None:
+        size = os.path.getsize(src_path)
+        conn = self._conn()
+        try:
+            with open(src_path, "rb") as f:
+                headers = {
+                    "Content-Length": str(size),
+                    "Content-Type": "application/octet-stream",
+                }
+                headers.update(self._auth())
+                conn.request(
+                    "POST",
+                    f"/upload/storage/v1/b/{urllib.parse.quote(bucket, safe='')}"
+                    f"/o?uploadType=media&name={urllib.parse.quote(name, safe='')}",
+                    body=f,
+                    headers=headers,
+                )
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status >= 400:
+                    raise ObjStoreError(
+                        f"gcs put {bucket}/{name}: {resp.status}"
+                    )
+        finally:
+            conn.close()
+
+
+class S3Client:
+    """S3 REST (path-style) with optional SigV4 signing."""
+
+    def __init__(
+        self,
+        endpoint: str | None = None,
+        access_key: str | None = None,
+        secret_key: str | None = None,
+        region: str | None = None,
+    ):
+        self.endpoint = endpoint or os.environ.get("AWS_ENDPOINT_URL")
+        self.access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID")
+        self.secret_key = secret_key or os.environ.get("AWS_SECRET_ACCESS_KEY")
+        self.region = region or os.environ.get("AWS_REGION", "us-east-1")
+
+    def _host(self) -> str:
+        if self.endpoint:
+            return urllib.parse.urlparse(
+                self.endpoint if "://" in self.endpoint
+                else "http://" + self.endpoint
+            ).netloc
+        return f"s3.{self.region}.amazonaws.com"
+
+    def _conn(self):
+        return _http(self.endpoint, f"s3.{self.region}.amazonaws.com")
+
+    def _sign(
+        self, method: str, path: str, query: str, payload_hash: str
+    ) -> dict:
+        """AWS Signature Version 4 (headers-only, single-chunk)."""
+        if not self.access_key or not self.secret_key:
+            return {}  # unsigned: fakes/public buckets
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+        host = self._host()
+        canonical_headers = (
+            f"host:{host}\nx-amz-content-sha256:{payload_hash}\n"
+            f"x-amz-date:{amz_date}\n"
+        )
+        signed = "host;x-amz-content-sha256;x-amz-date"
+        canonical = "\n".join(
+            [method, path, query, canonical_headers, signed, payload_hash]
+        )
+        scope = f"{datestamp}/{self.region}/s3/aws4_request"
+        to_sign = "\n".join(
+            [
+                "AWS4-HMAC-SHA256",
+                amz_date,
+                scope,
+                hashlib.sha256(canonical.encode()).hexdigest(),
+            ]
+        )
+
+        def hm(key, msg):
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k = hm(("AWS4" + self.secret_key).encode(), datestamp)
+        k = hm(k, self.region)
+        k = hm(k, "s3")
+        k = hm(k, "aws4_request")
+        sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+        return {
+            "x-amz-date": amz_date,
+            "x-amz-content-sha256": payload_hash,
+            "Authorization": (
+                f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+                f"SignedHeaders={signed}, Signature={sig}"
+            ),
+        }
+
+    EMPTY_SHA = hashlib.sha256(b"").hexdigest()
+
+    def list(self, bucket: str, prefix: str) -> list[dict]:
+        items, token = [], None
+        while True:
+            q = {"list-type": "2", "prefix": prefix, "max-keys": "1000"}
+            if token:
+                q["continuation-token"] = token
+            query = urllib.parse.urlencode(sorted(q.items()))
+            path = f"/{bucket}"
+            conn = self._conn()
+            try:
+                headers = self._sign("GET", path, query, self.EMPTY_SHA)
+                conn.request("GET", f"{path}?{query}", headers=headers)
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status >= 400:
+                    raise ObjStoreError(
+                        f"s3 list {bucket}/{prefix}: {resp.status} {body[:200]!r}"
+                    )
+            finally:
+                conn.close()
+            ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+            root = ET.fromstring(body)
+            # Tolerate namespaced and namespace-less XML (fakes).
+            def findall(tag):
+                return root.findall(f"s3:{tag}", ns) or root.findall(tag)
+
+            for c in findall("Contents"):
+                key = c.find("s3:Key", ns)
+                key = key if key is not None else c.find("Key")
+                size = c.find("s3:Size", ns)
+                size = size if size is not None else c.find("Size")
+                items.append(
+                    {"name": key.text, "size": int(size.text if size is not None else 0)}
+                )
+            trunc = findall("IsTruncated")
+            token_el = findall("NextContinuationToken")
+            if trunc and trunc[0].text == "true" and token_el:
+                token = token_el[0].text
+            else:
+                return items
+
+    def get_to_file(self, bucket: str, name: str, dest_path: str) -> None:
+        path = f"/{bucket}/{urllib.parse.quote(name)}"
+        conn = self._conn()
+        try:
+            headers = self._sign("GET", path, "", self.EMPTY_SHA)
+            conn.request("GET", path, headers=headers)
+            resp = conn.getresponse()
+            if resp.status >= 400:
+                raise ObjStoreError(f"s3 get {bucket}/{name}: {resp.status}")
+            os.makedirs(os.path.dirname(dest_path) or ".", exist_ok=True)
+            with open(dest_path, "wb") as f:
+                while True:
+                    chunk = resp.read(CHUNK)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+        finally:
+            conn.close()
+
+    def put_from_file(self, bucket: str, name: str, src_path: str) -> None:
+        path = f"/{bucket}/{urllib.parse.quote(name)}"
+        # Sign with UNSIGNED-PAYLOAD so the file streams without a
+        # whole-file hash pass into memory.
+        conn = self._conn()
+        try:
+            with open(src_path, "rb") as f:
+                headers = {
+                    "Content-Length": str(os.path.getsize(src_path)),
+                }
+                headers.update(self._sign("PUT", path, "", "UNSIGNED-PAYLOAD"))
+                conn.request("PUT", path, body=f, headers=headers)
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status >= 400:
+                    raise ObjStoreError(
+                        f"s3 put {bucket}/{name}: {resp.status}"
+                    )
+        finally:
+            conn.close()
+
+
+def download_prefix(url: str, dest_dir: str, client=None) -> list[str]:
+    """Download every object under `url` into dest_dir (relative names),
+    one object at a time, chunked to disk. Returns the local paths."""
+    scheme, bucket, prefix = parse_url(url)
+    client = client or client_for(url)
+    objects = client.list(bucket, prefix)
+    if not objects:
+        raise ObjStoreError(f"no objects under {url}")
+    out = []
+    for obj in objects:
+        rel = obj["name"][len(prefix):].lstrip("/") if prefix else obj["name"]
+        if not rel:  # the prefix itself as an object
+            rel = os.path.basename(obj["name"])
+        dest = os.path.join(dest_dir, rel)
+        logger.info("downloading %s/%s (%d bytes)", bucket, obj["name"], obj["size"])
+        client.get_to_file(bucket, obj["name"], dest)
+        out.append(dest)
+    return out
+
+
+def upload_dir(src_dir: str, url: str, client=None) -> list[str]:
+    """Upload a directory tree under the destination prefix."""
+    scheme, bucket, prefix = parse_url(url)
+    client = client or client_for(url)
+    uploaded = []
+    for root, _, files in os.walk(src_dir):
+        for fname in files:
+            full = os.path.join(root, fname)
+            rel = os.path.relpath(full, src_dir)
+            key = f"{prefix.rstrip('/')}/{rel}" if prefix else rel
+            logger.info("uploading %s -> %s/%s", rel, bucket, key)
+            client.put_from_file(bucket, key, full)
+            uploaded.append(key)
+    return uploaded

@@ -97,50 +97,29 @@ class GCPPubSubBroker:
             endpoint = "http://" + endpoint
         self.endpoint = endpoint  # None = production API
         self.pull_batch = pull_batch
+        # Bounded local queues: the puller blocks when the Messenger falls
+        # behind, so a deep subscription backlog stays server-side (where
+        # ack deadlines and redelivery are managed) instead of parking
+        # unacked in process memory.
         self._queues: dict[str, queue.Queue] = {}
         self._pullers: dict[str, threading.Thread] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        self._token: tuple[str, float] | None = None  # (token, expiry)
 
     # -- transport ------------------------------------------------------------
 
     def _conn(self) -> http.client.HTTPConnection:
-        if self.endpoint:
-            p = urllib.parse.urlparse(self.endpoint)
-            if p.scheme == "https":
-                return http.client.HTTPSConnection(
-                    p.hostname, p.port or 443, timeout=35
-                )
-            return http.client.HTTPConnection(
-                p.hostname, p.port or 80, timeout=35
-            )
-        return http.client.HTTPSConnection(
-            "pubsub.googleapis.com", 443, timeout=35
-        )
+        from kubeai_tpu.objstore import _http
+
+        return _http(self.endpoint, "pubsub.googleapis.com", timeout=35)
 
     def _auth_header(self) -> dict:
         if self.endpoint:  # emulator/fake: no auth
             return {}
-        now = time.time()
-        if self._token and self._token[1] > now + 60:
-            return {"Authorization": f"Bearer {self._token[0]}"}
-        # GKE metadata server (workload identity / node SA).
-        conn = http.client.HTTPConnection("metadata.google.internal", 80, timeout=5)
-        try:
-            conn.request(
-                "GET",
-                "/computeMetadata/v1/instance/service-accounts/default/token",
-                headers={"Metadata-Flavor": "Google"},
-            )
-            resp = conn.getresponse()
-            data = json.loads(resp.read())
-            self._token = (
-                data["access_token"], now + float(data.get("expires_in", 300))
-            )
-        finally:
-            conn.close()
-        return {"Authorization": f"Bearer {self._token[0]}"}
+        from kubeai_tpu.objstore import gcp_metadata_token
+
+        token = gcp_metadata_token(required=True)
+        return {"Authorization": f"Bearer {token}"}
 
     def _call(self, method: str, path: str, payload: dict) -> dict:
         conn = self._conn()
@@ -180,7 +159,7 @@ class GCPPubSubBroker:
         sub = self._resource(subscription)
         with self._lock:
             if sub not in self._queues:
-                self._queues[sub] = queue.Queue()
+                self._queues[sub] = queue.Queue(maxsize=2 * self.pull_batch)
                 t = threading.Thread(
                     target=self._pull_loop, args=(sub,), daemon=True
                 )
@@ -224,13 +203,19 @@ class GCPPubSubBroker:
                 data = base64.b64decode(
                     (rm.get("message") or {}).get("data", "")
                 )
-                self._queues[sub].put(
-                    Message(
-                        data,
-                        on_ack=lambda a=ack_id: self._ack(sub, a),
-                        on_nack=lambda a=ack_id: self._nack(sub, a),
-                    )
+                msg = Message(
+                    data,
+                    on_ack=lambda a=ack_id: self._ack(sub, a),
+                    on_nack=lambda a=ack_id: self._nack(sub, a),
                 )
+                # Bounded put: blocks (flow control) until the Messenger
+                # drains; poll so stop() still wins.
+                while not self._stop.is_set():
+                    try:
+                        self._queues[sub].put(msg, timeout=1.0)
+                        break
+                    except queue.Full:
+                        continue
 
     def _ack(self, sub: str, ack_id: str) -> None:
         try:

@@ -118,7 +118,13 @@ class Messenger:
         self.model_client = model_client
         self._semaphore = threading.Semaphore(max_handlers)
         self.error_max_backoff = error_max_backoff
+        # Two throttles: handler errors (bad-request floods, backend
+        # failures — reset on a clean request) and transport errors
+        # (broker receive failures — reset on any successful receive, so
+        # an idle stream doesn't stay pinned at max backoff after an
+        # outage).
         self._consecutive_errors = 0
+        self._transport_errors = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._http_send = http_send or self._default_http_send
@@ -137,9 +143,10 @@ class Messenger:
     def _receive_loop(self) -> None:
         while not self._stop.is_set():
             # Consecutive-error throttle (reference: messenger.go:156-178).
-            if self._consecutive_errors:
+            errors = max(self._consecutive_errors, self._transport_errors)
+            if errors:
                 backoff = min(
-                    2 ** min(self._consecutive_errors, 10) * 0.1,
+                    2 ** min(errors, 10) * 0.1,
                     self.error_max_backoff,
                 )
                 if self._stop.wait(backoff):
@@ -160,8 +167,11 @@ class Messenger:
                 # a dead receive loop deafens the stream permanently.
                 logger.warning("broker receive failed: %s", e)
                 self._semaphore.release()
-                self._consecutive_errors += 1
+                self._transport_errors += 1
                 continue
+            # A successful receive — even an empty one — proves transport
+            # health; the handler-error throttle is tracked separately.
+            self._transport_errors = 0
             if msg is None:
                 self._semaphore.release()
                 continue

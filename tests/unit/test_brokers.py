@@ -36,6 +36,7 @@ class FakePubSub:
         self.pending: dict[str, tuple[str, bytes]] = {}  # ackId -> (tail, data)
         self.acked: list[str] = []
         self.published: dict[str, list[bytes]] = {}
+        self.fail_next_pulls = 0  # fault injection: 500s for N pulls
         self._next_ack = [0]
         self._lock = threading.RLock()  # _backlog() nests under publish
         outer = self
@@ -60,6 +61,17 @@ class FakePubSub:
                             outer._backlog(tail).put(data)
                     out = {"messageIds": ["1"]}
                 elif verb == "pull":
+                    with outer._lock:
+                        if outer.fail_next_pulls > 0:
+                            outer.fail_next_pulls -= 1
+                            body = b'{"error": "injected"}'
+                            self.send_response(500)
+                            self.send_header(
+                                "Content-Length", str(len(body))
+                            )
+                            self.end_headers()
+                            self.wfile.write(body)
+                            return
                     msgs = []
                     try:
                         data = outer._backlog(tail).get(timeout=0.2)
@@ -259,14 +271,21 @@ def test_pubsub_nack_redelivers(pubsub):
 
 
 def test_pubsub_pull_survives_server_errors(pubsub):
+    """Transient pull 500s back off and the puller resumes delivering."""
     fake, broker = pubsub
-    # Kill the fake, force pull failures, then restore reachability by
-    # restarting on the same port is complex — instead verify the puller
-    # keeps working after transient 500s is covered by backoff logic in
-    # pull loop; here we just verify publish errors surface to callers.
-    broker2 = GCPPubSubBroker(endpoint="http://127.0.0.1:1")  # nothing there
+    fake.fail_next_pulls = 3
+    broker.publish(TOPIC_REQ, b"after-outage")
+    # First receive starts the puller, which eats the injected 500s with
+    # backoff (0.2+0.4+0.8s) before the pull succeeds.
+    msg = broker.receive(SUB, timeout=15)
+    assert msg is not None and msg.body == b"after-outage"
+    assert fake.fail_next_pulls == 0
+
+
+def test_pubsub_publish_error_surfaces():
+    broker = GCPPubSubBroker(endpoint="http://127.0.0.1:1")  # nothing there
     with pytest.raises(Exception):
-        broker2.publish(TOPIC_REQ, b"x")
+        broker.publish(TOPIC_REQ, b"x")
 
 
 # ---- NATS driver -------------------------------------------------------------
