@@ -44,6 +44,10 @@ class ResourceProfile:
     tolerations: list[dict] = dataclasses.field(default_factory=list)
     scheduler_name: str = ""
     runtime_class_name: str = ""
+    # Hosts per replica: >1 for TPU slices spanning hosts (e.g. v5e-4x4 =
+    # 16 chips = 2 hosts); requests/limits describe ONE host's share. The
+    # operator renders one Pod per host behind a headless Service.
+    num_hosts: int = 1
 
     @property
     def tpu_topology(self) -> str | None:
@@ -242,6 +246,20 @@ def default_resource_profiles() -> dict[str, ResourceProfile]:
                 TPU_TOPOLOGY_SELECTOR: topo,
             },
         )
+    # Multi-host slices: >8 v5e chips span hosts (8 chips/host). The
+    # profile is PER HOST — `google-tpu-v5e-4x4:8` gives each of the two
+    # host Pods 8 chips; the operator renders num_hosts Pods per replica.
+    for topo, hosts in (("4x4", 2), ("4x8", 4)):
+        profiles[f"google-tpu-v5e-{topo}"] = ResourceProfile(
+            image_name="google-tpu",
+            requests={"google.com/tpu": "1"},
+            limits={"google.com/tpu": "1"},
+            node_selector={
+                TPU_ACCELERATOR_SELECTOR: "tpu-v5-lite-podslice",
+                TPU_TOPOLOGY_SELECTOR: topo,
+            },
+            num_hosts=hosts,
+        )
     return profiles
 
 
@@ -384,6 +402,7 @@ def system_from_dict(data: dict) -> System:
                 tolerations=list(p.get("tolerations") or []),
                 scheduler_name=p.get("schedulerName", ""),
                 runtime_class_name=p.get("runtimeClassName", ""),
+                num_hosts=int(p.get("numHosts", 1)),
             )
             for name, p in data["resourceProfiles"].items()
         }

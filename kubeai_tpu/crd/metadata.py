@@ -18,6 +18,13 @@ def feature_label(feature: str) -> str:
 # Annotations
 MODEL_POD_IP_ANNOTATION = "model-pod-ip"
 MODEL_POD_PORT_ANNOTATION = "model-pod-port"
+# Multi-host replicas (no reference analog — the reference is strictly
+# one-Pod-per-replica, pod_plan.go:28-156; TPU slices >8 chips span
+# hosts). Worker hosts carry serving="false" so the LB never routes to
+# them; group/host labels identify a replica's Pod group.
+MODEL_POD_SERVING_ANNOTATION = "model-pod-serving"
+POD_GROUP_LABEL = "model-group-index"
+POD_HOST_LABEL = "model-host-index"
 
 ADAPTER_LABEL_DOMAIN = "adapter.kubeai.org"
 

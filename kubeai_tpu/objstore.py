@@ -103,9 +103,13 @@ def gcp_metadata_token(required: bool = False) -> str | None:
             body = resp.read()
             conn.close()
             if resp.status != 200:
-                raise ObjStoreError(
-                    f"metadata token: {resp.status} {body[:120]!r}"
-                )
+                # Reachable but no default SA (e.g. 404): anonymous
+                # fallback unless the caller needs auth.
+                if required:
+                    raise ObjStoreError(
+                        f"metadata token: {resp.status} {body[:120]!r}"
+                    )
+                return None
             data = json.loads(body)
             _META_TOKEN = (
                 data["access_token"],
@@ -295,7 +299,10 @@ class S3Client:
             q = {"list-type": "2", "prefix": prefix, "max-keys": "1000"}
             if token:
                 q["continuation-token"] = token
-            query = urllib.parse.urlencode(sorted(q.items()))
+            # SigV4 canonicalizes with %20, not '+': quote, not quote_plus.
+            query = urllib.parse.urlencode(
+                sorted(q.items()), quote_via=urllib.parse.quote
+            )
             path = f"/{bucket}"
             conn = self._conn()
             try:
@@ -377,6 +384,13 @@ def download_prefix(url: str, dest_dir: str, client=None) -> list[str]:
     scheme, bucket, prefix = parse_url(url)
     client = client or client_for(url)
     objects = client.list(bucket, prefix)
+    # Store listing is plain string-prefix matching: 'models/llama' also
+    # matches 'models/llama-70b/...'. Keep only the directory itself.
+    if prefix and not prefix.endswith("/"):
+        objects = [
+            o for o in objects
+            if o["name"] == prefix or o["name"].startswith(prefix + "/")
+        ]
     if not objects:
         raise ObjStoreError(f"no objects under {url}")
     out = []

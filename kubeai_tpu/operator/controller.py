@@ -84,6 +84,17 @@ class ModelReconciler:
             self.store.delete_all_of(
                 "Pod", model.namespace, {md.POD_MODEL_LABEL: model.name}
             )
+            if mcfg.num_hosts > 1:
+                from kubeai_tpu.operator.engines.kubeai_tpu_engine import (
+                    hosts_service_name,
+                )
+
+                try:
+                    self.store.delete(
+                        "Service", model.namespace, hosts_service_name(model)
+                    )
+                except NotFound:
+                    pass
             if model.spec.cache_profile:
                 cache_mod.finalize_cache(
                     self.store, model, model_obj, self.cfg, mcfg
@@ -101,26 +112,29 @@ class ModelReconciler:
         pods = self.store.list(
             "Pod", model.namespace, {md.POD_MODEL_LABEL: model.name}
         )
-        ready = sum(1 for p in pods if k8sutils.pod_is_ready(p))
-        self._patch_status(model, replicas_all=len(pods), replicas_ready=ready)
+        n_all, ready = self._replica_counts(pods, mcfg)
+        self._patch_status(model, replicas_all=n_all, replicas_ready=ready)
 
-        desired_pod = render_pod(model, self.cfg, mcfg, "x")
-        self._apply_model_annotations(model, desired_pod)
-        if self.cfg.model_server_pods.json_patches:
-            desired_pod = apply_json_patches(
-                self.cfg.model_server_pods.json_patches, desired_pod
+        if mcfg.num_hosts > 1:
+            plan = self._plan_multihost(model, model_obj, mcfg, pods)
+        else:
+            desired_pod = render_pod(model, self.cfg, mcfg, "x")
+            self._apply_model_annotations(model, desired_pod)
+            if self.cfg.model_server_pods.json_patches:
+                desired_pod = apply_json_patches(
+                    self.cfg.model_server_pods.json_patches, desired_pod
+                )
+            plan = calculate_pod_plan(
+                pods, model, desired_pod, self.cfg.model_rollouts.surge
             )
-        plan = calculate_pod_plan(
-            pods, model, desired_pod, self.cfg.model_rollouts.surge
-        )
         if plan.contains_actions():
             plan.execute(self.store, model_obj)
             pods = self.store.list(
                 "Pod", model.namespace, {md.POD_MODEL_LABEL: model.name}
             )
-            ready = sum(1 for p in pods if k8sutils.pod_is_ready(p))
+            n_all, ready = self._replica_counts(pods, mcfg)
             self._patch_status(
-                model, replicas_all=len(pods), replicas_ready=ready
+                model, replicas_all=n_all, replicas_ready=ready
             )
             return  # adapter pass runs on the next event, against fresh pods
 
@@ -129,6 +143,62 @@ class ModelReconciler:
         )
 
     # -- helpers --------------------------------------------------------------
+
+    @staticmethod
+    def _replica_counts(pods: list[dict], mcfg) -> tuple[int, int]:
+        """status.replicas in REPLICA units. Multi-host: a replica exists
+        when its pod group is complete and is ready only when EVERY host
+        is ready (the mesh needs all of them)."""
+        if mcfg.num_hosts <= 1:
+            ready = sum(1 for p in pods if k8sutils.pod_is_ready(p))
+            return len(pods), ready
+        groups: dict[str, list[dict]] = {}
+        for p in pods:
+            g = k8sutils.get_label(p, md.POD_GROUP_LABEL)
+            groups.setdefault(g or "?", []).append(p)
+        complete = [
+            ps for ps in groups.values() if len(ps) >= mcfg.num_hosts
+        ]
+        ready = sum(
+            1
+            for ps in complete
+            if all(k8sutils.pod_is_ready(p) for p in ps)
+        )
+        return len(complete), ready
+
+    def _plan_multihost(self, model, model_obj, mcfg, pods):
+        """Multi-host replicas: ensure the headless Service, render pod
+        GROUPS (one Pod per host), and diff by fixed name (no reference
+        analog — one-Pod-per-replica there; see engines/kubeai_tpu_engine
+        multi-host section)."""
+        from kubeai_tpu.operator.engines.kubeai_tpu_engine import (
+            kubeai_tpu_host_pods,
+            multihost_service,
+        )
+        from kubeai_tpu.operator.pod_plan import calculate_group_pod_plan
+
+        svc = multihost_service(model)
+        try:
+            self.store.get("Service", model.namespace, svc["metadata"]["name"])
+        except NotFound:
+            k8sutils.set_owner_reference(model_obj, svc)
+            try:
+                self.store.create(svc)
+            except Conflict:
+                pass
+
+        def render_group(g: int) -> list[dict]:
+            rendered = []
+            for pod in kubeai_tpu_host_pods(model, self.cfg, mcfg, g):
+                self._apply_model_annotations(model, pod)
+                if self.cfg.model_server_pods.json_patches:
+                    pod = apply_json_patches(
+                        self.cfg.model_server_pods.json_patches, pod
+                    )
+                rendered.append(pod)
+            return rendered
+
+        return calculate_group_pod_plan(pods, model, render_group, mcfg.num_hosts)
 
     def _apply_self_labels(self, model_obj: dict) -> bool:
         """Feature labels on the Model itself

@@ -68,6 +68,7 @@ class ModelConfig:
     source: ModelSource
     # Scale: replica bounds after autoscaling clamping
     cache_dir: str = ""  # set when cacheProfile in play
+    num_hosts: int = 1  # Pods per replica (multi-host TPU slices)
 
     @property
     def tpu_topology(self) -> str | None:
@@ -119,6 +120,7 @@ def resolve_model_config(model: Model, cfg: System) -> ModelConfig:
         profile_name=profile_name,
         profile_count=count,
         source=parse_model_source(model.spec.url),
+        num_hosts=profile.num_hosts,
     )
 
 

@@ -84,3 +84,94 @@ def kubeai_tpu_pod(model: Model, cfg: System, mcfg: ModelConfig, suffix: str) ->
     pod["spec"]["volumes"] = volumes
     pod["metadata"]["annotations"]["model-pod-port"] = str(PORT)
     return pod
+
+
+# ---- multi-host replicas -----------------------------------------------------
+#
+# A v5e slice larger than 8 chips spans hosts; every host runs the same
+# engine process and jax.distributed joins them into one mesh over DCN
+# (engine flags --dcn-coordinator/--process-id/--num-processes,
+# kubeai_tpu/engine/server.py). The operator's unit becomes a POD GROUP:
+# one Pod per host with a stable hostname under a headless Service, host
+# 0 as coordinator and the only HTTP-serving endpoint. No reference
+# analog (strict one-Pod-per-replica, pod_plan.go:28-156).
+
+DCN_PORT = 8476
+
+
+def hosts_service_name(model: Model) -> str:
+    return f"model-{model.name}-hosts"
+
+
+def multihost_service(model: Model) -> dict:
+    """Headless Service giving host Pods stable DNS for the coordinator."""
+    from kubeai_tpu.crd import metadata as md
+
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": hosts_service_name(model),
+            "namespace": model.namespace,
+            "labels": {md.POD_MODEL_LABEL: model.name},
+        },
+        "spec": {
+            "clusterIP": "None",
+            # Pods can only become ready AFTER jax.distributed joins all
+            # hosts, and hosts join by resolving each other's per-pod DNS
+            # — which must therefore be published for NOT-ready Pods, or
+            # the group deadlocks at startup (the StatefulSet peer-
+            # discovery pattern).
+            "publishNotReadyAddresses": True,
+            "selector": {md.POD_MODEL_LABEL: model.name},
+            "ports": [{"name": "dcn", "port": DCN_PORT}],
+        },
+    }
+
+
+def kubeai_tpu_host_pods(
+    model: Model, cfg: System, mcfg: ModelConfig, group: int
+) -> list[dict]:
+    """Render one replica group: num_hosts Pods with fixed names (stable
+    hostnames are part of the coordinator address, so generateName-style
+    random suffixes can't be used)."""
+    from kubeai_tpu.crd import metadata as md
+
+    svc = hosts_service_name(model)
+    coord_host = f"model-{model.name}-g{group}-h0"
+    coordinator = f"{coord_host}.{svc}.{model.namespace}.svc:{DCN_PORT}"
+    pods = []
+    for h in range(mcfg.num_hosts):
+        pod = kubeai_tpu_pod(model, cfg, mcfg, f"g{group}-h{h}")
+        spec = pod["spec"]
+        spec["hostname"] = f"model-{model.name}-g{group}-h{h}"
+        spec["subdomain"] = svc
+        c = spec["containers"][0]
+        c["args"] += [
+            "--dcn-coordinator", coordinator,
+            "--process-id", str(h),
+            "--num-processes", str(mcfg.num_hosts),
+        ]
+        c["env"] += [
+            {"name": "TPU_COORDINATOR", "value": coordinator},
+            {"name": "TPU_PROCESS_ID", "value": str(h)},
+            {"name": "TPU_PROCESS_COUNT", "value": str(mcfg.num_hosts)},
+            {
+                "name": "TPU_WORKER_HOSTNAMES",
+                "value": ",".join(
+                    f"model-{model.name}-g{group}-h{i}.{svc}"
+                    for i in range(mcfg.num_hosts)
+                ),
+            },
+        ]
+        labels = pod["metadata"]["labels"]
+        labels[md.POD_GROUP_LABEL] = str(group)
+        labels[md.POD_HOST_LABEL] = str(h)
+        if h > 0:
+            # Workers join the mesh but never serve HTTP: the LB must not
+            # route to them.
+            pod["metadata"]["annotations"][
+                md.MODEL_POD_SERVING_ANNOTATION
+            ] = "false"
+        pods.append(pod)
+    return pods

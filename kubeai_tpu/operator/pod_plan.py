@@ -150,3 +150,81 @@ def calculate_pod_plan(
         to_remain=list(remainder.values()),
         details=details,
     )
+
+
+def calculate_group_pod_plan(
+    all_pods: list[dict],
+    model: Model,
+    render_group,  # (group_idx) -> list[pod dict] with FIXED names
+    num_hosts: int,
+) -> PodPlan:
+    """Pod-group planner for multi-host replicas: replica g is the set of
+    Pods model-{name}-g{g}-h{0..num_hosts-1}. Fixed names (stable
+    hostnames feed the DCN coordinator address), so the diff is by name:
+    missing members are created, hash-stale or surplus members deleted
+    (delete-before-create; the recreate lands next reconcile). A group is
+    replaced as a unit — jax.distributed cannot survive a partial host
+    swap — and there is no surge (a surge group would double TPU-slice
+    capacity transiently; recreate-in-place instead)."""
+    desired: dict[str, dict] = {}
+    for g in range(model.spec.replicas or 0):
+        for pod in render_group(g):
+            expected = k8sutils.pod_hash(pod["spec"])
+            k8sutils.set_label(pod, md.POD_HASH_LABEL, expected)
+            desired[pod["metadata"]["name"]] = pod
+
+    existing = {p["metadata"]["name"]: p for p in all_pods}
+    details: list[str] = []
+    to_create: list[dict] = []
+    to_delete: list[dict] = []
+
+    def group_of(pod: dict) -> str:
+        return k8sutils.get_label(pod, md.POD_GROUP_LABEL) or "?"
+
+    # A group is STALE when it has surviving members AND any member is
+    # missing or hash-mismatched: tear it down whole this pass and
+    # recreate fresh next pass (a fresh Pod must not join a coordinator
+    # that's being replaced). A group with NO existing members is simply
+    # new: create all its Pods now.
+    members_existing: dict[str, list[dict]] = {}
+    members_bad: set[str] = set()
+    for name, pod in desired.items():
+        g = group_of(pod)
+        cur = existing.get(name)
+        if cur is not None:
+            members_existing.setdefault(g, []).append(cur)
+            if k8sutils.get_label(cur, md.POD_HASH_LABEL) != k8sutils.get_label(
+                pod, md.POD_HASH_LABEL
+            ):
+                members_bad.add(g)
+        else:
+            members_bad.add(g)
+    stale_groups = {g for g in members_bad if g in members_existing}
+
+    for name, pod in desired.items():
+        g = group_of(pod)
+        cur = existing.get(name)
+        if g in stale_groups:
+            if cur is not None:
+                details.append(f"group {g} stale, deleting {name}")
+                to_delete.append(cur)
+        elif cur is None:
+            details.append(f"creating {name}")
+            to_create.append(pod)
+
+    for name, cur in existing.items():
+        if name not in desired:
+            details.append(f"deleting surplus {name}")
+            to_delete.append(cur)
+
+    deleted = {p["metadata"]["name"] for p in to_delete}
+    remain = [
+        p for n, p in existing.items() if n not in deleted and n in desired
+    ]
+    return PodPlan(
+        model=model,
+        to_create=to_create,
+        to_delete=to_delete,
+        to_remain=remain,
+        details=details,
+    )

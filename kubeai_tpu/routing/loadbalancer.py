@@ -231,6 +231,13 @@ class LoadBalancer:
         ):
             if not k8sutils.pod_is_ready(pod):
                 continue
+            # Multi-host worker Pods participate in the mesh but do not
+            # serve HTTP; only host-0 is an endpoint.
+            if (
+                k8sutils.get_annotation(pod, md.MODEL_POD_SERVING_ANNOTATION)
+                == "false"
+            ):
+                continue
             ip = k8sutils.get_annotation(pod, md.MODEL_POD_IP_ANNOTATION) or (
                 (pod.get("status") or {}).get("podIP")
             )

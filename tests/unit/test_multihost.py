@@ -1,0 +1,140 @@
+"""Multi-host replica orchestration: renderer, group planner, LB worker
+exclusion. (No reference analog — one-Pod-per-replica there,
+pod_plan.go:28-156; multi-host TPU slices are this repo's SURVEY §2
+obligation.)"""
+
+import copy
+
+from kubeai_tpu.config import System
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import Model, ModelSpec
+from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator.engines import resolve_model_config
+from kubeai_tpu.operator.engines.kubeai_tpu_engine import (
+    kubeai_tpu_host_pods,
+    multihost_service,
+)
+from kubeai_tpu.operator.pod_plan import calculate_group_pod_plan
+
+
+def _model(replicas=1):
+    return Model(
+        name="big",
+        spec=ModelSpec(
+            url="hf://org/llama-70b",
+            engine="KubeAITPU",
+            resource_profile="google-tpu-v5e-4x4:8",
+            replicas=replicas,
+            min_replicas=0,
+            max_replicas=3,
+        ),
+    )
+
+
+def test_profile_resolution_carries_hosts():
+    cfg = System().default_and_validate()
+    mcfg = resolve_model_config(_model(), cfg)
+    assert mcfg.num_hosts == 2
+    assert mcfg.requests["google.com/tpu"] == "8"  # per HOST, x8 count
+    assert mcfg.tpu_topology == "4x4"
+
+
+def test_host_pods_rendering():
+    cfg = System().default_and_validate()
+    model = _model()
+    mcfg = resolve_model_config(model, cfg)
+    pods = kubeai_tpu_host_pods(model, cfg, mcfg, group=0)
+    assert [p["metadata"]["name"] for p in pods] == [
+        "model-big-g0-h0", "model-big-g0-h1",
+    ]
+    for h, pod in enumerate(pods):
+        args = pod["spec"]["containers"][0]["args"]
+        assert args[args.index("--process-id") + 1] == str(h)
+        assert args[args.index("--num-processes") + 1] == "2"
+        coord = args[args.index("--dcn-coordinator") + 1]
+        assert coord == "model-big-g0-h0.model-big-hosts.default.svc:8476"
+        assert pod["spec"]["hostname"] == f"model-big-g0-h{h}"
+        assert pod["spec"]["subdomain"] == "model-big-hosts"
+        env = {
+            e["name"]: e.get("value")
+            for e in pod["spec"]["containers"][0]["env"]
+        }
+        assert env["TPU_PROCESS_ID"] == str(h)
+        assert "model-big-g0-h0.model-big-hosts" in env["TPU_WORKER_HOSTNAMES"]
+    # Only host 0 serves HTTP.
+    assert (
+        pods[0]["metadata"]["annotations"].get(md.MODEL_POD_SERVING_ANNOTATION)
+        is None
+    )
+    assert (
+        pods[1]["metadata"]["annotations"][md.MODEL_POD_SERVING_ANNOTATION]
+        == "false"
+    )
+    svc = multihost_service(model)
+    assert svc["spec"]["clusterIP"] == "None"
+    assert svc["metadata"]["name"] == "model-big-hosts"
+
+
+def _rendered(model, cfg, mcfg):
+    def render_group(g):
+        return kubeai_tpu_host_pods(model, cfg, mcfg, g)
+
+    return render_group
+
+
+def _materialize(plan):
+    """Pretend-create: what the store would hold after plan.execute."""
+    return [copy.deepcopy(p) for p in plan.to_create]
+
+
+def test_group_plan_create_and_scale_down():
+    cfg = System().default_and_validate()
+    model = _model(replicas=2)
+    mcfg = resolve_model_config(model, cfg)
+    rg = _rendered(model, cfg, mcfg)
+    plan = calculate_group_pod_plan([], model, rg, 2)
+    names = sorted(p["metadata"]["name"] for p in plan.to_create)
+    assert names == [
+        "model-big-g0-h0", "model-big-g0-h1",
+        "model-big-g1-h0", "model-big-g1-h1",
+    ]
+    assert not plan.to_delete
+
+    # Scale to 1 replica: group 1 is surplus, deleted whole.
+    existing = _materialize(plan)
+    model2 = _model(replicas=1)
+    plan2 = calculate_group_pod_plan(existing, model2, _rendered(model2, cfg, mcfg), 2)
+    deleted = sorted(p["metadata"]["name"] for p in plan2.to_delete)
+    assert deleted == ["model-big-g1-h0", "model-big-g1-h1"]
+    assert not plan2.to_create
+
+
+def test_group_plan_member_loss_recreates_whole_group():
+    cfg = System().default_and_validate()
+    model = _model(replicas=1)
+    mcfg = resolve_model_config(model, cfg)
+    rg = _rendered(model, cfg, mcfg)
+    existing = _materialize(calculate_group_pod_plan([], model, rg, 2))
+    # Host 1 dies: surviving member is torn down this pass...
+    survivors = [p for p in existing if p["metadata"]["name"].endswith("h0")]
+    plan = calculate_group_pod_plan(survivors, model, rg, 2)
+    assert [p["metadata"]["name"] for p in plan.to_delete] == ["model-big-g0-h0"]
+    assert not plan.to_create
+    # ...and the next pass recreates the full group.
+    plan2 = calculate_group_pod_plan([], model, rg, 2)
+    assert len(plan2.to_create) == 2
+
+
+def test_group_plan_spec_change_recreates_group():
+    cfg = System().default_and_validate()
+    model = _model(replicas=1)
+    mcfg = resolve_model_config(model, cfg)
+    rg = _rendered(model, cfg, mcfg)
+    existing = _materialize(calculate_group_pod_plan([], model, rg, 2))
+    model.spec.env = {"NEW": "1"}
+    plan = calculate_group_pod_plan(existing, model, _rendered(model, cfg, mcfg), 2)
+    assert len(plan.to_delete) == 2 and not plan.to_create
+    plan2 = calculate_group_pod_plan([], model, _rendered(model, cfg, mcfg), 2)
+    assert len(plan2.to_create) == 2
+    for p in plan2.to_create:
+        assert k8sutils.get_label(p, md.POD_HASH_LABEL)
