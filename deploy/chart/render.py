@@ -1,0 +1,280 @@
+#!/usr/bin/env python3
+"""Render the deployment manifests from values — the Helm-chart
+equivalent (reference: charts/kubeai/templates/*). Zero dependencies:
+values parse with the repo's mini-YAML reader and manifests emit as
+JSON documents (valid YAML input for kubectl).
+
+Usage:
+  python deploy/chart/render.py                         # default values
+  python deploy/chart/render.py --values my-values.yaml
+  python deploy/chart/render.py --set operator.image=me/op:v2 \
+      --set ingress.enabled=true
+  python deploy/chart/render.py --models                # catalog Models
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+from kubeai_tpu.config.system import _parse_config_text  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def deep_merge(dst: dict, src: dict) -> dict:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            deep_merge(dst[k], v)
+        else:
+            dst[k] = v
+    return dst
+
+
+def apply_set(values: dict, expr: str) -> None:
+    path, _, raw = expr.partition("=")
+    keys = path.split(".")
+    cur = values
+    for k in keys[:-1]:
+        cur = cur.setdefault(k, {})
+    val: object = raw
+    if raw in ("true", "false"):
+        val = raw == "true"
+    elif raw.isdigit():
+        val = int(raw)
+    cur[keys[-1]] = val
+
+
+def load_values(path: str | None, sets: list[str]) -> dict:
+    with open(os.path.join(HERE, "values.yaml")) as f:
+        values = _parse_config_text(f.read())
+    if path:
+        with open(path) as f:
+            deep_merge(values, _parse_config_text(f.read()))
+    for expr in sets:
+        apply_set(values, expr)
+    return values
+
+
+def _meta(name: str, ns: str, labels: dict | None = None) -> dict:
+    return {
+        "name": name,
+        "namespace": ns,
+        "labels": {"app.kubernetes.io/name": "kubeai-tpu", **(labels or {})},
+    }
+
+
+def render(values: dict, include_models: bool = False) -> list[dict]:
+    ns = values.get("namespace", "kubeai")
+    op = values.get("operator", {})
+    docs: list[dict] = []
+
+    docs.append({"apiVersion": "v1", "kind": "Namespace",
+                 "metadata": {"name": ns}})
+
+    # CRD travels verbatim (deploy/crd-model.yaml is the source of truth
+    # incl. CEL rules); emitted as a passthrough document marker so
+    # `kubectl apply -f deploy/crd-model.yaml -f <(render.py)` composes.
+    docs.append({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": _meta("kubeai-tpu-crd-pointer", ns),
+        "data": {"apply-first": "deploy/crd-model.yaml"},
+    })
+
+    docs.append({"apiVersion": "v1", "kind": "ServiceAccount",
+                 "metadata": _meta("kubeai-tpu", ns)})
+    docs.append({
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "Role",
+        "metadata": _meta("kubeai-tpu", ns),
+        "rules": [
+            {"apiGroups": ["kubeai.org"],
+             "resources": ["models", "models/status", "models/scale"],
+             "verbs": ["get", "list", "watch", "create", "update",
+                       "patch", "delete"]},
+            {"apiGroups": [""],
+             "resources": ["pods", "configmaps", "persistentvolumeclaims",
+                           "services"],
+             "verbs": ["get", "list", "watch", "create", "update",
+                       "patch", "delete"]},
+            {"apiGroups": [""], "resources": ["pods/exec"],
+             "verbs": ["create"]},
+            {"apiGroups": ["batch"], "resources": ["jobs"],
+             "verbs": ["get", "list", "watch", "create", "update",
+                       "patch", "delete"]},
+            {"apiGroups": ["coordination.k8s.io"], "resources": ["leases"],
+             "verbs": ["get", "list", "watch", "create", "update"]},
+        ],
+    })
+    docs.append({
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "RoleBinding",
+        "metadata": _meta("kubeai-tpu", ns),
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "Role", "name": "kubeai-tpu"},
+        "subjects": [{"kind": "ServiceAccount", "name": "kubeai-tpu",
+                      "namespace": ns}],
+    })
+
+    # System config (reference: charts/kubeai/templates/configmap.yaml).
+    sys_cfg: dict = {
+        "modelServers": values.get("modelServers", {}),
+        "modelLoading": {"image": values.get("modelLoading", {}).get(
+            "image", "kubeai-tpu/model-loader:latest")},
+        "modelAutoscaling": {
+            "interval": values.get("modelAutoscaling", {}).get("interval", 10),
+            "timeWindow": values.get("modelAutoscaling", {}).get(
+                "timeWindow", 600),
+        },
+    }
+    for key in ("resourceProfiles", "cacheProfiles", "messaging"):
+        if values.get(key):
+            sys_cfg[key] = values[key]
+    docs.append({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": _meta("kubeai-tpu-config", ns),
+        "data": {"config.yaml": json.dumps(sys_cfg, indent=2)},
+    })
+
+    if values.get("secrets", {}).get("huggingface", {}).get("create"):
+        docs.append({
+            "apiVersion": "v1", "kind": "Secret",
+            "metadata": _meta("kubeai-huggingface", ns),
+            "stringData": {
+                "token": values["secrets"]["huggingface"].get("token", ""),
+            },
+        })
+
+    api_port = int(op.get("apiPort", 8000))
+    metrics_port = int(op.get("metricsPort", 8080))
+    docs.append({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": _meta("kubeai-tpu", ns),
+        "spec": {
+            "replicas": int(op.get("replicas", 2)),
+            "selector": {"matchLabels": {
+                "app.kubernetes.io/name": "kubeai-tpu"}},
+            "template": {
+                "metadata": {"labels": {
+                    "app.kubernetes.io/name": "kubeai-tpu"}},
+                "spec": {
+                    "serviceAccountName": "kubeai-tpu",
+                    "containers": [{
+                        "name": "operator",
+                        "image": op.get("image", "kubeai-tpu/operator:latest"),
+                        "env": [{"name": "CONFIG_PATH",
+                                 "value": "/config/config.yaml"}],
+                        "ports": [
+                            {"containerPort": api_port, "name": "api"},
+                            {"containerPort": metrics_port, "name": "metrics"},
+                        ],
+                        "resources": op.get("resources", {}),
+                        "volumeMounts": [{"name": "config",
+                                          "mountPath": "/config"}],
+                        "readinessProbe": {
+                            "httpGet": {"path": "/healthz", "port": api_port},
+                        },
+                    }],
+                    "volumes": [{"name": "config", "configMap": {
+                        "name": "kubeai-tpu-config"}}],
+                },
+            },
+        },
+    })
+    docs.append({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": _meta("kubeai-tpu", ns),
+        "spec": {
+            "selector": {"app.kubernetes.io/name": "kubeai-tpu"},
+            "ports": [
+                {"name": "api", "port": 80, "targetPort": api_port},
+                {"name": "metrics", "port": metrics_port,
+                 "targetPort": metrics_port},
+            ],
+        },
+    })
+
+    ing = values.get("ingress", {})
+    if ing.get("enabled"):
+        spec: dict = {
+            "rules": [{
+                "host": ing.get("host", ""),
+                "http": {"paths": [{
+                    "path": "/",
+                    "pathType": "Prefix",
+                    "backend": {"service": {
+                        "name": "kubeai-tpu",
+                        "port": {"name": "api"},
+                    }},
+                }]},
+            }],
+        }
+        if ing.get("className"):
+            spec["ingressClassName"] = ing["className"]
+        docs.append({
+            "apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+            "metadata": _meta("kubeai-tpu", ns),
+            "spec": spec,
+        })
+
+    pm = values.get("metrics", {}).get("podMonitor", {})
+    if pm.get("enabled"):
+        # reference: charts/kubeai/templates/vllm-pod-monitor.yaml — here
+        # the monitor scrapes the in-tree engine Pods' /metrics.
+        docs.append({
+            "apiVersion": "monitoring.coreos.com/v1", "kind": "PodMonitor",
+            "metadata": _meta("kubeai-tpu-engines", ns,
+                              labels=pm.get("labels") or {}),
+            "spec": {
+                "selector": {"matchExpressions": [{
+                    "key": "model", "operator": "Exists"}]},
+                "podMetricsEndpoints": [{"port": "http",
+                                         "path": "/metrics"}],
+            },
+        })
+
+    if include_models:
+        docs += render_models(ns)
+    return docs
+
+
+def render_models(ns: str) -> list[dict]:
+    """Catalog entries with enabled: true become Model manifests
+    (reference: charts/models/values.yaml + templates)."""
+    with open(os.path.join(REPO, "catalog", "models.yaml")) as f:
+        catalog = _parse_config_text(f.read()).get("catalog", {})
+    docs = []
+    for name, entry in sorted(catalog.items()):
+        if not entry.get("enabled", False):
+            continue
+        spec = {k: v for k, v in entry.items() if k != "enabled"}
+        docs.append({
+            "apiVersion": "kubeai.org/v1", "kind": "Model",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": spec,
+        })
+    return docs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--values", default=None)
+    ap.add_argument("--set", action="append", default=[], dest="sets")
+    ap.add_argument("--models", action="store_true",
+                    help="also render enabled catalog Models")
+    args = ap.parse_args(argv)
+    values = load_values(args.values, args.sets)
+    docs = render(values, include_models=args.models)
+    out = "\n---\n".join(json.dumps(d, indent=2) for d in docs)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -162,14 +162,22 @@ class ModelSpec:
             raise ValidationError("maxReplicas must be >= minReplicas and >= 1")
         if self.replicas is not None and self.replicas < 0:
             raise ValidationError("replicas must be >= 0")
-        if (
-            not self.autoscaling_disabled
-            and self.max_replicas is None
+        # A nil maxReplicas is VALID (unbounded autoscaling) — reference
+        # CEL only relates the bounds when both are set
+        # (reference: model_types.go:30, test replicas-1-2-nil-valid).
+        if self.cache_profile and self.url_scheme() not in (
+            "hf", "s3", "gs", "oss"
         ):
-            # reference CEL: maxReplicas required unless autoscalingDisabled
-            # (reference: model_types.go:30-32).
+            # reference CEL rule (model_types.go:27).
             raise ValidationError(
-                "maxReplicas is required unless autoscalingDisabled is true"
+                'cacheProfile is only supported with urls of format "hf://", '
+                '"s3://", "gs://", or "oss://"'
+            )
+        if self.adapters and self.engine not in (ENGINE_VLLM, ENGINE_KUBEAI_TPU):
+            # reference CEL restricts adapters to VLLM (model_types.go:31);
+            # the in-tree TPU engine hot-swaps adapters natively too.
+            raise ValidationError(
+                "adapters only supported with VLLM or KubeAITPU engines"
             )
         if self.target_requests < 1:
             raise ValidationError("targetRequests must be >= 1")
@@ -236,8 +244,15 @@ class Model:
         # (reference: api/k8s/v1/model_types.go:248).
         if len(self.name) > MAX_NAME_LEN:
             raise ValidationError(f"model name must be <= {MAX_NAME_LEN} chars")
-        if not re.fullmatch(r"^[a-z0-9]+(?:[-a-z0-9]*[a-z0-9])?$", self.name):
-            raise ValidationError("model name must be a lowercase DNS label")
+        # DNS-1123 subdomain: dots allowed — the reference catalog ships
+        # names like "llama-3.1-8b-instruct-tpu"
+        # (reference: charts/models/values.yaml).
+        if not re.fullmatch(
+            r"^[a-z0-9]+(?:[-.a-z0-9]*[a-z0-9])?$", self.name
+        ):
+            raise ValidationError(
+                "model name must be a lowercase DNS subdomain"
+            )
         self.spec.validate()
 
     def validate_update(self, old: "Model") -> None:

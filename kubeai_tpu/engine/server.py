@@ -179,7 +179,10 @@ class EngineServer:
     def stop(self) -> None:
         self._stop.set()
         self._work.set()
-        self.httpd.shutdown()
+        # shutdown() handshakes with serve_forever; on a never-started
+        # server it would wait forever.
+        if self._http_thread.is_alive():
+            self.httpd.shutdown()
         self.httpd.server_close()
 
     # -- engine loop -----------------------------------------------------------

@@ -77,12 +77,17 @@ def _http(endpoint: str, default_host: str, timeout: float = 120.0):
 
 _META_LOCK = __import__("threading").Lock()
 _META_TOKEN: tuple[str, float] | None = None
+_META_NEGATIVE_UNTIL = [0.0]  # cache "no SA / unreachable" for 60s
 
 
 def gcp_metadata_token(required: bool = False) -> str | None:
     """OAuth token from the GKE metadata server (workload identity /
-    node SA), cached with 60s expiry skew. None when unreachable and not
-    required. Shared by every Google-API client in the tree."""
+    node SA), cached with 60s expiry skew. None (anonymous fallback)
+    ONLY for the definitive no-credentials signals — unreachable server
+    or 404 no-default-SA — and that negative result is cached for 60s so
+    hot paths don't re-poll a 5s-timeout endpoint. Transient errors
+    (429/5xx) raise: silently downgrading to anonymous would turn them
+    into misleading permission errors downstream."""
     global _META_TOKEN
     import time
 
@@ -90,6 +95,8 @@ def gcp_metadata_token(required: bool = False) -> str | None:
     with _META_LOCK:
         if _META_TOKEN and _META_TOKEN[1] > now + 60:
             return _META_TOKEN[0]
+        if _META_NEGATIVE_UNTIL[0] > now and not required:
+            return None
         try:
             conn = http.client.HTTPConnection(
                 "metadata.google.internal", 80, timeout=5
@@ -102,24 +109,26 @@ def gcp_metadata_token(required: bool = False) -> str | None:
             resp = conn.getresponse()
             body = resp.read()
             conn.close()
-            if resp.status != 200:
-                # Reachable but no default SA (e.g. 404): anonymous
-                # fallback unless the caller needs auth.
-                if required:
-                    raise ObjStoreError(
-                        f"metadata token: {resp.status} {body[:120]!r}"
-                    )
-                return None
-            data = json.loads(body)
-            _META_TOKEN = (
-                data["access_token"],
-                now + float(data.get("expires_in", 300)),
-            )
-            return _META_TOKEN[0]
         except OSError as e:
+            _META_NEGATIVE_UNTIL[0] = now + 60
             if required:
                 raise ObjStoreError(f"metadata server unreachable: {e}")
             return None
+        if resp.status == 404:  # reachable, no default service account
+            _META_NEGATIVE_UNTIL[0] = now + 60
+            if required:
+                raise ObjStoreError("metadata server: no default service account")
+            return None
+        if resp.status != 200:  # transient (429/5xx): surface, don't downgrade
+            raise ObjStoreError(
+                f"metadata token: {resp.status} {body[:120]!r}"
+            )
+        data = json.loads(body)
+        _META_TOKEN = (
+            data["access_token"],
+            now + float(data.get("expires_in", 300)),
+        )
+        return _META_TOKEN[0]
 
 
 class GCSClient:

@@ -99,8 +99,15 @@ def kubeai_tpu_pod(model: Model, cfg: System, mcfg: ModelConfig, suffix: str) ->
 DCN_PORT = 8476
 
 
+def _dns_label(s: str) -> str:
+    """Model names are DNS SUBDOMAINS (dots allowed, e.g.
+    llama-3.1-8b...), but Service names and Pod hostnames are DNS
+    LABELS — sanitize dots to dashes for those surfaces only."""
+    return s.replace(".", "-")
+
+
 def hosts_service_name(model: Model) -> str:
-    return f"model-{model.name}-hosts"
+    return f"model-{_dns_label(model.name)}-hosts"
 
 
 def multihost_service(model: Model) -> dict:
@@ -138,13 +145,14 @@ def kubeai_tpu_host_pods(
     from kubeai_tpu.crd import metadata as md
 
     svc = hosts_service_name(model)
-    coord_host = f"model-{model.name}-g{group}-h0"
+    label_name = _dns_label(model.name)
+    coord_host = f"model-{label_name}-g{group}-h0"
     coordinator = f"{coord_host}.{svc}.{model.namespace}.svc:{DCN_PORT}"
     pods = []
     for h in range(mcfg.num_hosts):
         pod = kubeai_tpu_pod(model, cfg, mcfg, f"g{group}-h{h}")
         spec = pod["spec"]
-        spec["hostname"] = f"model-{model.name}-g{group}-h{h}"
+        spec["hostname"] = f"model-{label_name}-g{group}-h{h}"
         spec["subdomain"] = svc
         c = spec["containers"][0]
         c["args"] += [
@@ -159,7 +167,7 @@ def kubeai_tpu_host_pods(
             {
                 "name": "TPU_WORKER_HOSTNAMES",
                 "value": ",".join(
-                    f"model-{model.name}-g{group}-h{i}.{svc}"
+                    f"model-{label_name}-g{group}-h{i}.{svc}"
                     for i in range(mcfg.num_hosts)
                 ),
             },

@@ -203,7 +203,10 @@ class OpenAIServer:
         self._thread.start()
 
     def stop(self) -> None:
-        self.httpd.shutdown()
-        self.httpd.server_close()
-        if self._thread:
+        # shutdown() handshakes with serve_forever; calling it on a
+        # never-started (or already-stopped) server waits forever.
+        if self._thread is not None:
+            self.httpd.shutdown()
             self._thread.join(timeout=5)
+            self._thread = None
+        self.httpd.server_close()

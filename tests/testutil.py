@@ -97,28 +97,28 @@ class FakeMetricsServer:
         self.httpd.server_close()
 
 
-def http_post(address: str, path: str, payload: dict, timeout=30):
+def http_post(address: str, path: str, payload: dict, timeout=30, headers=None):
     """POST JSON to host:port; returns (status, body_bytes)."""
     import http.client
 
     host, _, port = address.partition(":")
     conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
     body = json.dumps(payload).encode()
-    conn.request(
-        "POST", path, body=body, headers={"Content-Type": "application/json"}
-    )
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    conn.request("POST", path, body=body, headers=hdrs)
     resp = conn.getresponse()
     data = resp.read()
     conn.close()
     return resp.status, data
 
 
-def http_get(address: str, path: str, timeout=10):
+def http_get(address: str, path: str, timeout=10, headers=None):
     import http.client
 
     host, _, port = address.partition(":")
     conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
-    conn.request("GET", path)
+    conn.request("GET", path, headers=headers or {})
     resp = conn.getresponse()
     data = resp.read()
     conn.close()

@@ -41,7 +41,12 @@ def test_valid_model_passes():
         {"engine": "NotAnEngine"},
         {"features": ["Bogus"]},
         {"min_replicas": -1},
-        {"max_replicas": None, "autoscaling_disabled": False},
+        # nil maxReplicas is VALID (unbounded) — reference parity;
+        # minReplicas > maxReplicas is not.
+        {"min_replicas": 3, "max_replicas": 2},
+        {"cache_profile": "c", "url": "ollama://x", "engine": "OLlama"},
+        {"adapters": [Adapter(name="a", url="hf://x")], "engine": "OLlama",
+         "url": "ollama://x"},
         {"resource_profile": "nocolon"},
         {"resource_profile": "cpu:0"},
         {"target_requests": 0},
