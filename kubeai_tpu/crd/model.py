@@ -244,12 +244,12 @@ class Model:
         # (reference: api/k8s/v1/model_types.go:248).
         if len(self.name) > MAX_NAME_LEN:
             raise ValidationError(f"model name must be <= {MAX_NAME_LEN} chars")
-        # DNS-1123 subdomain: dots allowed — the reference catalog ships
-        # names like "llama-3.1-8b-instruct-tpu"
-        # (reference: charts/models/values.yaml).
-        if not re.fullmatch(
-            r"^[a-z0-9]+(?:[-.a-z0-9]*[a-z0-9])?$", self.name
-        ):
+        # DNS-1123 subdomain: dot-separated DNS labels — the reference
+        # catalog ships names like "llama-3.1-8b-instruct-tpu"
+        # (reference: charts/models/values.yaml). Each label must stand
+        # alone ("a..b" / "a.-b" are invalid).
+        label = r"[a-z0-9](?:[-a-z0-9]*[a-z0-9])?"
+        if not re.fullmatch(rf"{label}(?:\.{label})*", self.name):
             raise ValidationError(
                 "model name must be a lowercase DNS subdomain"
             )

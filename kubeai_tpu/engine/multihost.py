@@ -1,0 +1,312 @@
+"""Multi-host lockstep serving: one engine program, N processes.
+
+JAX multi-controller SPMD requires EVERY process to enter the same jitted
+computation in the same order. Requests, however, arrive only at host 0
+(the operator exposes only host 0 to the LB). The bridge is op
+BROADCAST: host 0 buffers control ops (admissions, cancels), and each
+step() broadcasts a fixed-shape descriptor to all processes via
+`multihost_utils.broadcast_one_to_all` (itself a collective every
+process enters — workers block there until host 0 acts). All processes
+then apply the SAME ops to their local Engine replica and run the SAME
+engine.step(): the jitted collectives line up across the slice.
+
+Determinism requirements this module enforces:
+  - request ids: all processes call inner.add_request in broadcast
+    order, so rid sequences match;
+  - sampling seeds: resolved ON HOST 0 (explicit seed or drawn once) and
+    shipped in the descriptor — never derived from per-process entropy;
+  - page allocation (paged cache): the allocator is a deterministic
+    free-list, so identical op streams yield identical block tables on
+    every host.
+
+LoRA hot-swap is not yet lockstep (adapters would need weight
+broadcast); multi-host engines must run with max_adapters=0.
+
+The serving analog is JetStream/MaxText-style multihost orchestration;
+the reference has no counterpart (one-Pod-per-replica,
+pod_plan.go:28-156 — engine-internal distribution lives in vLLM images).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+
+import numpy as np
+
+from kubeai_tpu.engine.engine import Engine, StepEvent
+from kubeai_tpu.engine.sampling import SamplingParams
+
+logger = logging.getLogger(__name__)
+
+MAX_ADMITS = 8  # ops per step (excess stays buffered for the next step)
+MAX_CANCELS = 32
+# meta columns: plen, seed(int32 bit-cast), top_k, adapter_idx, max_tokens
+_META_COLS = 5
+
+
+@dataclasses.dataclass
+class _PendingAdd:
+    vrid: int  # the virtual rid handed to the caller
+    tokens: list[int]
+    params: SamplingParams
+    cancelled: bool = False
+
+
+def _control_zeros() -> dict:
+    """The per-step control descriptor — small (a few hundred bytes), so
+    the common no-admission decode step stays cheap on DCN. The padded
+    token matrix broadcasts in a SECOND collective only when
+    n_admits > 0 (both sides branch on the same header, so the
+    collective sequence stays identical across processes)."""
+    return {
+        "header": np.zeros((4,), np.int32),  # n_admits, n_cancels, step, stop
+        "meta": np.zeros((MAX_ADMITS, _META_COLS), np.int32),
+        "floats": np.zeros((MAX_ADMITS, 2), np.float32),  # temp, top_p
+        "cancels": np.zeros((MAX_CANCELS,), np.int32),
+    }
+
+
+def _broadcast(desc, is_source: bool):
+    from jax.experimental import multihost_utils
+
+    out = multihost_utils.broadcast_one_to_all(desc, is_source=is_source)
+    if isinstance(out, dict):
+        return {k: np.asarray(v) for k, v in out.items()}
+    return np.asarray(out)
+
+
+class LockstepEngine:
+    """Engine facade for HOST 0: buffers ops, broadcasts them inside
+    step(), and drives the inner engine exactly like every worker drives
+    theirs. Exposes the Engine surface EngineServer consumes."""
+
+    is_lockstep = True  # server gates non-lockstep paths (embeddings)
+
+    def __init__(self, inner: Engine):
+        if inner.cfg.max_adapters:
+            raise ValueError(
+                "multi-host engines must run with max_adapters=0 "
+                "(LoRA hot-swap is not lockstep yet)"
+            )
+        self.inner = inner
+        self._lock = threading.Lock()
+        self._adds: list[_PendingAdd] = []
+        self._cancels: list[int] = []
+        self._next_virtual_rid = 0
+        # virtual rid (handed to callers before broadcast) -> inner rid
+        self._rid_map: dict[int, int] = {}
+        self._entropy = np.random.default_rng()
+
+    # -- Engine surface used by EngineServer ----------------------------------
+
+    @property
+    def cfg(self):
+        return self.inner.cfg
+
+    @property
+    def family(self):
+        return self.inner.family
+
+    @property
+    def model_cfg(self):
+        return self.inner.model_cfg
+
+    @property
+    def params(self):
+        return self.inner.params
+
+    @property
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._adds) + self.inner.num_pending
+
+    @property
+    def num_active(self) -> int:
+        return self.inner.num_active
+
+    def _bucket(self, n: int) -> int:
+        return self.inner._bucket(n)
+
+    def loaded_adapters(self) -> list[str]:
+        return []
+
+    def load_adapter(self, *a, **kw):
+        raise ValueError("LoRA not supported on multi-host engines yet")
+
+    def unload_adapter(self, *a, **kw) -> bool:
+        return False
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._adds or self._cancels) or self.inner.has_work()
+
+    def add_request(
+        self,
+        prompt_tokens: list[int],
+        params: SamplingParams | None = None,
+        adapter: str | None = None,
+        on_admit=None,
+    ) -> int:
+        params = params or SamplingParams()
+        if adapter:
+            raise KeyError(f"adapter {adapter!r} not loaded")
+        if len(prompt_tokens) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt_tokens) >= self.inner.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(prompt_tokens)} >= max_seq_len "
+                f"{self.inner.cfg.max_seq_len}"
+            )
+        # Seeds ship in the descriptor: resolve host-0-side once, masked
+        # to 32 bits (clients may send negative / >32-bit seeds; the
+        # inner engine masks too, so the fold-in value stays identical).
+        seed = (
+            params.seed
+            if params.seed is not None
+            else int(self._entropy.integers(0, 2**31 - 1))
+        )
+        params = dataclasses.replace(params, seed=seed & 0xFFFFFFFF)
+        with self._lock:
+            rid = self._next_virtual_rid
+            self._next_virtual_rid += 1
+            if on_admit is not None:
+                # Same contract as Engine.add_request: registration is
+                # visible before any step can emit events for this rid.
+                on_admit(rid)
+            self._adds.append(_PendingAdd(rid, list(prompt_tokens), params))
+            return rid
+
+    def cancel(self, rid: int) -> bool:
+        with self._lock:
+            inner_rid = self._rid_map.pop(rid, None)
+            if inner_rid is None:
+                # Not yet broadcast: tombstone the buffered entry.
+                for add in self._adds:
+                    if add.vrid == rid and not add.cancelled:
+                        add.cancelled = True
+                        return True
+                return False
+            # Mapping pruned here: a cancelled request emits no further
+            # events (the inner engine releases it on cancel), so keeping
+            # the entry would only leak.
+            self._cancels.append(inner_rid)
+            return True
+
+    def step(self) -> list[StepEvent]:
+        """One lockstep iteration: broadcast buffered ops, apply, step."""
+        with self._lock:
+            batch = self._adds[:MAX_ADMITS]
+            self._adds = self._adds[MAX_ADMITS:]
+            cancels = self._cancels[:MAX_CANCELS]
+            self._cancels = self._cancels[MAX_CANCELS:]
+        live = [a for a in batch if not a.cancelled]
+        desc = _control_zeros()
+        desc["header"][0] = len(live)
+        desc["header"][1] = len(cancels)
+        desc["header"][2] = 1  # run a decode step
+        for i, add in enumerate(live):
+            desc["meta"][i] = [
+                len(add.tokens),
+                np.uint32(add.params.seed).view(np.int32),
+                add.params.top_k,
+                0,
+                add.params.max_tokens,
+            ]
+            desc["floats"][i] = [add.params.temperature, add.params.top_p]
+        desc["cancels"][: len(cancels)] = cancels
+
+        out = _broadcast(desc, is_source=True)
+        tokens = None
+        if live:  # second, payload-sized collective only on admissions
+            tokens = np.zeros(
+                (MAX_ADMITS, self.inner.cfg.max_seq_len), np.int32
+            )
+            for i, add in enumerate(live):
+                tokens[i, : len(add.tokens)] = add.tokens
+            tokens = _broadcast(tokens, is_source=True)
+        inner_rids = _apply_descriptor(self.inner, out, tokens, do_step=False)
+        with self._lock:
+            for add, inner_rid in zip(live, inner_rids):
+                self._rid_map[add.vrid] = inner_rid
+        events = self.inner.step()
+        # Map inner rids back to the virtual rids callers hold; prune
+        # finished mappings so the table doesn't grow unboundedly.
+        with self._lock:
+            inv = {v: k for k, v in self._rid_map.items()}
+            mapped = [
+                StepEvent(inv.get(ev.rid, ev.rid), ev.token, ev.finished,
+                          ev.finish_reason)
+                for ev in events
+            ]
+            for ev in events:
+                if ev.finished and ev.rid in inv:
+                    self._rid_map.pop(inv[ev.rid], None)
+        return mapped
+
+    def generate(self, prompts, params=None):
+        """Convenience parity with Engine.generate (tests)."""
+        outs: dict[int, list[int]] = {}
+        rids = [self.add_request(p, params) for p in prompts]
+        for r in rids:
+            outs[r] = []
+        while self.has_work():
+            for ev in self.step():
+                if ev.rid in outs and ev.token is not None:
+                    outs[ev.rid].append(ev.token)
+        return [outs[r] for r in rids]
+
+    def shutdown(self) -> None:
+        """Release the workers (they exit their loop)."""
+        desc = _control_zeros()
+        desc["header"][3] = 1
+        _broadcast(desc, is_source=True)
+
+
+def _apply_descriptor(
+    engine: Engine, desc: dict, tokens, do_step: bool
+) -> list[int]:
+    """Apply a broadcast descriptor to the local engine replica. Returns
+    the inner rids assigned to this step's admissions (same on every
+    process, by construction)."""
+    n_admits = int(desc["header"][0])
+    n_cancels = int(desc["header"][1])
+    rids = []
+    for i in range(n_admits):
+        plen, seed_bits, top_k, _adapter, max_tokens = (
+            int(x) for x in desc["meta"][i]
+        )
+        temp, top_p = (float(x) for x in desc["floats"][i])
+        params = SamplingParams(
+            temperature=temp,
+            top_k=top_k,
+            top_p=top_p,
+            max_tokens=max_tokens,
+            seed=int(np.int32(seed_bits).view(np.uint32)),
+        )
+        rids.append(engine.add_request(list(tokens[i, :plen]), params))
+    for i in range(n_cancels):
+        engine.cancel(int(desc["cancels"][i]))
+    if do_step and int(desc["header"][2]):
+        engine.step()
+    return rids
+
+
+def worker_loop(engine: Engine) -> None:
+    """WORKER processes (process_id > 0): receive descriptors forever,
+    mirror host 0's ops and steps. Blocks inside the broadcast collective
+    while host 0 is idle."""
+    logger.info("multihost worker loop running")
+    while True:
+        desc = _broadcast(_control_zeros(), is_source=False)
+        if int(desc["header"][3]):
+            logger.info("multihost worker loop: shutdown")
+            return
+        tokens = None
+        if int(desc["header"][0]):
+            tokens = _broadcast(
+                np.zeros((MAX_ADMITS, engine.cfg.max_seq_len), np.int32),
+                is_source=False,
+            )
+        _apply_descriptor(engine, desc, tokens, do_step=True)

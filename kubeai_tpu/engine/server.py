@@ -179,6 +179,11 @@ class EngineServer:
     def stop(self) -> None:
         self._stop.set()
         self._work.set()
+        # Join the serve loop BEFORE anything else broadcasts (multihost
+        # shutdown): a step() collective in flight from this thread must
+        # finish first or two host-0 collectives interleave undefined.
+        if self._loop_thread.is_alive():
+            self._loop_thread.join(timeout=30)
         # shutdown() handshakes with serve_forever; on a never-started
         # server it would wait forever.
         if self._http_thread.is_alive():
@@ -502,6 +507,14 @@ class EngineServer:
     # -- embeddings (TextEmbedding feature) -------------------------------------
 
     def _handle_embeddings(self, http, body: dict):
+        if getattr(self.engine, "is_lockstep", False):
+            # The embed jit is a separate computation host 0 would enter
+            # alone — on a multi-host slice that deadlocks the mesh.
+            return http._json(
+                400,
+                {"error": {"message":
+                           "embeddings not supported on multi-host replicas"}},
+            )
         fam = self.engine.family
         if getattr(fam, "hidden_states", None) is None:
             return http._json(
@@ -604,6 +617,41 @@ class EngineServer:
 # ---- process entrypoint ------------------------------------------------------
 
 
+class _WorkerHealthServer:
+    """Minimal /health endpoint for multi-host WORKER processes."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 8000):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = b'{"status": "ok", "role": "worker"}'
+                status = 200 if self.path == "/health" else 404
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread.is_alive():
+            self.httpd.shutdown()
+        self.httpd.server_close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="kubeai-tpu-engine")
     ap.add_argument("--model-url", required=True)
@@ -700,6 +748,7 @@ def main(argv=None) -> int:
         else single_device_mesh()
     )
     tokenizer = load_tokenizer(model_dir)
+    multihost = args.num_processes > 1
     engine = Engine(
         family,
         model_cfg,
@@ -708,16 +757,40 @@ def main(argv=None) -> int:
         cfg=EngineConfig(
             num_slots=args.num_slots,
             max_seq_len=args.max_seq_len,
-            max_adapters=args.max_adapters,
+            # LoRA hot-swap is not lockstep yet (engine/multihost.py).
+            max_adapters=0 if multihost else args.max_adapters,
             decode_chunk=args.decode_chunk,
             pipeline=args.pipeline,
             quantization=args.quantization,
         ),
         eos_token_ids=tuple(getattr(tokenizer, "eos_token_ids", ())),
     )
+
+    if multihost and args.process_id != 0:
+        # WORKER host: mirror host 0's ops/steps in lockstep; expose only
+        # /health so kubelet probes see the process (never the OpenAI
+        # surface — the LB routes to host 0 alone).
+        from kubeai_tpu.engine.multihost import worker_loop
+
+        health = _WorkerHealthServer(host=args.host, port=args.port)
+        health.start()
+        log.info(
+            "worker %d/%d: health on %s:%d, entering lockstep loop",
+            args.process_id, args.num_processes, args.host, health.port,
+        )
+        worker_loop(engine)
+        health.stop()
+        return 0
+
+    if multihost:
+        from kubeai_tpu.engine.multihost import LockstepEngine
+
+        engine = LockstepEngine(engine)
+
     # Warm-up before Ready: compile prefill+decode so the first request
     # doesn't eat compile time (the reference warms Ollama the same way —
-    # reference: engine_ollama.go:173-213 probe warm-up).
+    # reference: engine_ollama.go:173-213 probe warm-up). In multihost
+    # mode this is the first lockstep broadcast: workers join here.
     engine.generate([[1, 2, 3]], SamplingParams(temperature=0.0, max_tokens=2))
     log.info("warm-up complete")
 
@@ -735,6 +808,8 @@ def main(argv=None) -> int:
             time.sleep(5)
     except KeyboardInterrupt:
         server.stop()
+        if multihost:
+            engine.shutdown()  # release the workers
     return 0
 
 

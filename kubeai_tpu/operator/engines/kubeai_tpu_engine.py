@@ -102,8 +102,15 @@ DCN_PORT = 8476
 def _dns_label(s: str) -> str:
     """Model names are DNS SUBDOMAINS (dots allowed, e.g.
     llama-3.1-8b...), but Service names and Pod hostnames are DNS
-    LABELS — sanitize dots to dashes for those surfaces only."""
-    return s.replace(".", "-")
+    LABELS. Sanitize dots to dashes WITH a short hash of the original —
+    plain replacement would collide "llama-3.1" with "llama-3-1" in the
+    same namespace."""
+    if "." not in s:
+        return s
+    import hashlib
+
+    digest = hashlib.sha256(s.encode()).hexdigest()[:6]
+    return f"{s.replace('.', '-')}-{digest}"
 
 
 def hosts_service_name(model: Model) -> str:
