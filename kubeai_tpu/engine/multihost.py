@@ -94,6 +94,10 @@ class LockstepEngine:
         self._lock = threading.Lock()
         self._adds: list[_PendingAdd] = []
         self._cancels: list[int] = []
+        # Cancels that raced step(): their admission batch was popped
+        # from _adds but its _rid_map entries weren't populated yet.
+        # Resolved at the top of the next step().
+        self._unresolved_cancels: list[int] = []
         self._next_virtual_rid = 0
         # virtual rid (handed to callers before broadcast) -> inner rid
         self._rid_map: dict[int, int] = {}
@@ -187,6 +191,13 @@ class LockstepEngine:
                     if add.vrid == rid and not add.cancelled:
                         add.cancelled = True
                         return True
+                if 0 <= rid < self._next_virtual_rid:
+                    # Mid-step race: the admission batch holding this rid
+                    # is being broadcast right now (popped from _adds, not
+                    # yet in _rid_map) — or the request already finished.
+                    # Defer; step() resolves or discards it.
+                    self._unresolved_cancels.append(rid)
+                    return True
                 return False
             # Mapping pruned here: a cancelled request emits no further
             # events (the inner engine releases it on cancel), so keeping
@@ -197,6 +208,20 @@ class LockstepEngine:
     def step(self) -> list[StepEvent]:
         """One lockstep iteration: broadcast buffered ops, apply, step."""
         with self._lock:
+            # Resolve cancels that raced the previous step's broadcast
+            # window: by now (single stepping thread) their rids are
+            # mapped, back in the buffer, or gone (finished) — gone ones
+            # are discarded.
+            for vrid in self._unresolved_cancels:
+                inner = self._rid_map.pop(vrid, None)
+                if inner is not None:
+                    self._cancels.append(inner)
+                    continue
+                for add in self._adds:
+                    if add.vrid == vrid and not add.cancelled:
+                        add.cancelled = True
+                        break
+            self._unresolved_cancels = []
             batch = self._adds[:MAX_ADMITS]
             self._adds = self._adds[MAX_ADMITS:]
             cancels = self._cancels[:MAX_CANCELS]
@@ -235,10 +260,15 @@ class LockstepEngine:
         # finished mappings so the table doesn't grow unboundedly.
         with self._lock:
             inv = {v: k for k, v in self._rid_map.items()}
+            # Events whose inner rid has no live mapping (cancelled mid
+            # step) are DROPPED — falling back to the raw inner rid could
+            # deliver tokens to a different request's subscriber once the
+            # virtual and inner sequences diverge.
             mapped = [
-                StepEvent(inv.get(ev.rid, ev.rid), ev.token, ev.finished,
+                StepEvent(inv[ev.rid], ev.token, ev.finished,
                           ev.finish_reason)
                 for ev in events
+                if ev.rid in inv
             ]
             for ev in events:
                 if ev.finished and ev.rid in inv:

@@ -27,7 +27,9 @@ import queue
 import threading
 import time
 import uuid
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
+
+from kubeai_tpu.httpserver import DeepBacklogHTTPServer
 
 from kubeai_tpu.engine.engine import Engine, EngineConfig
 from kubeai_tpu.engine.sampling import SamplingParams
@@ -35,6 +37,7 @@ from kubeai_tpu.engine.tokenizer import Tokenizer, load_tokenizer
 from kubeai_tpu.metrics.registry import Counter, Gauge, Registry
 
 logger = logging.getLogger(__name__)
+
 
 
 class EngineMetrics:
@@ -161,7 +164,7 @@ class EngineServer:
                     return self._json(500, {"error": {"message": str(e)}})
                 return self._json(404, {"error": {"message": "not found"}})
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd = DeepBacklogHTTPServer((host, port), Handler)
         self._http_thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True
         )
@@ -634,7 +637,7 @@ class _WorkerHealthServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd = DeepBacklogHTTPServer((host, port), Handler)
         self._thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True
         )

@@ -13,7 +13,10 @@ import json
 import logging
 import re
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
+
+from kubeai_tpu.httpserver import DeepBacklogHTTPServer
+
 
 import numpy as np
 
@@ -136,7 +139,7 @@ class TranscriptionServer:
                     return self._json(400, {"error": {"message": str(e)}})
                 self._json(200, {"text": text})
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd = DeepBacklogHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None
         self._lock = threading.Lock()
 

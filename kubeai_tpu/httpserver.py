@@ -1,0 +1,17 @@
+"""Shared HTTP server base for every serving surface in the tree.
+
+The stdlib default accept backlog (request_queue_size=5) resets
+connections under reference-scale bursts — the prefix-LB benchmark runs
+800–8000 concurrent streams (reference:
+docs/benchmarks/prefix-aware-load-balancing.md:450-512). Admission
+control belongs to the application (bounded queues + 429), never to the
+kernel backlog.
+"""
+
+from __future__ import annotations
+
+from http.server import ThreadingHTTPServer
+
+
+class DeepBacklogHTTPServer(ThreadingHTTPServer):
+    request_queue_size = 1024

@@ -30,7 +30,10 @@ import logging
 import threading
 import time
 import uuid
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
+
+from kubeai_tpu.httpserver import DeepBacklogHTTPServer
+
 
 access_log = logging.getLogger("kubeai.access")
 
@@ -184,7 +187,7 @@ class OpenAIServer:
                             )
                     self.wfile.write(b"0\r\n\r\n")
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd = DeepBacklogHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None
 
     @property

@@ -1,0 +1,164 @@
+"""Engine front-door saturation: >= 800 concurrent streams against the
+CPU engine. The bounded admission queue must shed (429) instead of
+piling unbounded work, every request must get a definite answer, the
+server must stay healthy, and p99 of served requests must stay sane
+(reference front door survives 8000 conc in its benchmark,
+docs/benchmarks/prefix-aware-load-balancing.md:450-512 — there vLLM
+sheds; here the engine sheds for itself)."""
+
+import http.client
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from testutil import http_get
+
+from kubeai_tpu.engine import Engine, EngineConfig
+from kubeai_tpu.engine.sampling import SamplingParams
+from kubeai_tpu.engine.server import EngineServer
+from kubeai_tpu.engine.tokenizer import ByteTokenizer
+
+CONCURRENCY = 800
+
+
+@pytest.fixture(scope="module")
+def server():
+    tok = ByteTokenizer()
+    from kubeai_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=tok.vocab_size)
+    engine = Engine(
+        "llama",
+        cfg,
+        llama.init_params(cfg, jax.random.PRNGKey(0)),
+        cfg=EngineConfig(num_slots=16, max_seq_len=128, decode_chunk=4),
+    )
+    # Warm the compile caches so the load phase measures serving, not XLA.
+    engine.generate([[1, 2, 3]], SamplingParams(temperature=0.0, max_tokens=2))
+
+    # Emulate realistic accelerator step latency: the tiny CPU model's
+    # sub-ms steps would otherwise outrun any client burst, so the
+    # admission queue never fills and the shed path never exercises.
+    orig_step = engine.step
+
+    def paced_step():
+        time.sleep(0.03)
+        return orig_step()
+
+    engine.step = paced_step
+    srv = EngineServer(
+        engine, tok, "tiny", host="127.0.0.1", port=0,
+        max_queue=16, request_timeout=120,
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_800_concurrent_streams_shed_and_serve(server):
+    addr = f"127.0.0.1:{server.port}"
+    results = []
+    lock = threading.Lock()
+    start_barrier = threading.Barrier(CONCURRENCY + 1)
+
+    def client(i: int):
+        stream = i % 2 == 0
+        body = json.dumps(
+            {
+                "model": "tiny",
+                "prompt": f"load {i}",
+                "max_tokens": 8,
+                "temperature": 0,
+                "stream": stream,
+            }
+        ).encode()
+        try:
+            start_barrier.wait(timeout=60)
+            t0 = time.monotonic()
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=180
+            )
+            conn.request(
+                "POST", "/v1/completions", body=body,
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            data = resp.read()  # drain (SSE streams fully)
+            conn.close()
+            dt = time.monotonic() - t0
+            ok_payload = (
+                b"[DONE]" in data if (stream and resp.status == 200)
+                else bool(data)
+            )
+            with lock:
+                results.append((resp.status, dt, ok_payload))
+        except Exception as e:  # noqa: BLE001 — recorded, asserted below
+            with lock:
+                results.append((0, 0.0, repr(e)))
+
+    threads = [
+        threading.Thread(target=client, args=(i,), daemon=True)
+        for i in range(CONCURRENCY)
+    ]
+    baseline_threads = threading.active_count()
+    for t in threads:
+        t.start()
+    start_barrier.wait(timeout=60)
+    for t in threads:
+        t.join(timeout=300)
+    assert all(not t.is_alive() for t in threads), "clients hung"
+
+    statuses = [s for s, _, _ in results]
+    assert len(results) == CONCURRENCY
+    # Every request got a definite engine answer: served or shed.
+    bad = [r for r in results if r[0] not in (200, 429)]
+    assert not bad, f"unexpected outcomes (first 5): {bad[:5]}"
+    served = [r for r in results if r[0] == 200]
+    # Whether the burst sheds depends on host speed (arrival vs service
+    # rate); the invariant is that EVERY outcome is definite (200/429)
+    # and plenty get served. The shed path itself is asserted
+    # deterministically below and in test_engine_server's 429 test.
+    assert len(served) >= 32, f"only {len(served)} served"
+    assert all(ok for _, _, ok in served), "malformed success payloads"
+
+    # p99 of served requests stays bounded under saturation (CPU tiny
+    # model: generous ceiling, but NOT unbounded-queue-minutes).
+    lat = sorted(dt for _, dt, _ in served)
+    p99 = lat[int(len(lat) * 0.99) - 1]
+    assert p99 < 120, f"p99 {p99:.1f}s under saturation"
+
+    # No thread explosion left behind: handler threads wind down.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if threading.active_count() <= baseline_threads + 20:
+            break
+        time.sleep(0.5)
+    assert threading.active_count() <= baseline_threads + 20
+
+    # The engine is still healthy and serving afterwards.
+    assert http_get(addr, "/health")[0] == 200
+    status, body = http_get(addr, "/metrics")
+    assert status == 200 and b"kubeai_engine_requests_total" in body
+
+    # Deterministic shed check: with a zero admission budget the server
+    # answers 429 + Retry-After instead of queueing.
+    old_q, server.max_queue = server.max_queue, 0
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+        conn.request(
+            "POST", "/v1/completions",
+            body=json.dumps({"model": "tiny", "prompt": "x",
+                             "max_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 429
+        assert resp.getheader("Retry-After")
+        resp.read()
+        conn.close()
+    finally:
+        server.max_queue = old_q
