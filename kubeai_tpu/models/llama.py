@@ -26,7 +26,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from kubeai_tpu.ops.norms import rms_norm
-from kubeai_tpu.ops.rope import apply_rope, rope_frequencies
+from kubeai_tpu.ops.rope import (
+    apply_rope,
+    rope_attention_scaling,
+    rope_frequencies,
+)
 from kubeai_tpu.ops.attention import (
     causal_prefill_attention,
     decode_attention,
@@ -272,8 +276,12 @@ def prefill(
     B, S = tokens.shape
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
     inv_freq = jnp.asarray(
-        rope_frequencies(D, cfg.rope_theta, cfg.rope_scaling)
+        rope_frequencies(
+            D, cfg.rope_theta, cfg.rope_scaling,
+            cfg.max_position_embeddings,
+        )
     )
+    msc = rope_attention_scaling(cfg.rope_scaling)
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)
     x = params["embed"][tokens]  # gather: [B, S, E]
 
@@ -295,8 +303,8 @@ def prefill(
         q = proj(h, lp["wq"], "wq", lp.get("bq")).reshape(B, S, H, D)
         k = proj(h, lp["wk"], "wk", lp.get("bk")).reshape(B, S, KVH, D)
         v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, S, KVH, D)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
+        q = apply_rope(q, positions, inv_freq, msc)
+        k = apply_rope(k, positions, inv_freq, msc)
         attn = _prefill_attention(q, k, v)
         x = x + proj(attn.reshape(B, S, H * D), lp["wo"], "wo")
         h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
@@ -332,8 +340,12 @@ def decode_step(
     B = tokens.shape[0]
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
     inv_freq = jnp.asarray(
-        rope_frequencies(D, cfg.rope_theta, cfg.rope_scaling)
+        rope_frequencies(
+            D, cfg.rope_theta, cfg.rope_scaling,
+            cfg.max_position_embeddings,
+        )
     )
+    msc = rope_attention_scaling(cfg.rope_scaling)
     x = params["embed"][tokens]  # [B, E]
     pos1 = positions[:, None]  # [B, 1]
     lengths = positions + 1  # cache valid length incl. this token
@@ -359,8 +371,8 @@ def decode_step(
         q = proj(h, lp["wq"], "wq", lp.get("bq")).reshape(B, 1, H, D)
         k = proj(h, lp["wk"], "wk", lp.get("bk")).reshape(B, 1, KVH, D)
         v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, 1, KVH, D)
-        q = apply_rope(q, pos1, inv_freq)[:, 0]  # [B, H, D]
-        k = apply_rope(k, pos1, inv_freq)[:, 0]  # [B, KVH, D]
+        q = apply_rope(q, pos1, inv_freq, msc)[:, 0]  # [B, H, D]
+        k = apply_rope(k, pos1, inv_freq, msc)[:, 0]  # [B, KVH, D]
         v = v[:, 0]
         # Scatter the new token's K/V into each slot at its position.
         kc = kc.at[slot_idx, positions].set(k.astype(kc.dtype))
@@ -409,8 +421,12 @@ def decode_step_paged(
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
     page_size = k_pages.shape[2]
     inv_freq = jnp.asarray(
-        rope_frequencies(D, cfg.rope_theta, cfg.rope_scaling)
+        rope_frequencies(
+            D, cfg.rope_theta, cfg.rope_scaling,
+            cfg.max_position_embeddings,
+        )
     )
+    msc = rope_attention_scaling(cfg.rope_scaling)
     x = params["embed"][tokens]  # [B, E]
     pos1 = positions[:, None]
     lengths = positions + 1
@@ -436,8 +452,8 @@ def decode_step_paged(
         q = proj(h, lp["wq"], "wq", lp.get("bq")).reshape(B, 1, H, D)
         k = proj(h, lp["wk"], "wk", lp.get("bk")).reshape(B, 1, KVH, D)
         v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, 1, KVH, D)
-        q = apply_rope(q, pos1, inv_freq)[:, 0]  # [B, H, D]
-        k = apply_rope(k, pos1, inv_freq)[:, 0]  # [B, KVH, D]
+        q = apply_rope(q, pos1, inv_freq, msc)[:, 0]  # [B, H, D]
+        k = apply_rope(k, pos1, inv_freq, msc)[:, 0]  # [B, KVH, D]
         v = v[:, 0]
         kp, vp = scatter_decode_token(kp, vp, k, v, page_ids, offsets)
         attn = paged_decode_attention(q, kp, vp, block_tables, lengths)
@@ -462,7 +478,11 @@ def _trunk(params: dict, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray:
     """Transformer trunk: [B, S] tokens -> [B, S, E] final hidden states."""
     B, S = tokens.shape
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
-    inv_freq = jnp.asarray(rope_frequencies(D, cfg.rope_theta, cfg.rope_scaling))
+    inv_freq = jnp.asarray(rope_frequencies(
+            D, cfg.rope_theta, cfg.rope_scaling,
+            cfg.max_position_embeddings,
+        ))
+    msc = rope_attention_scaling(cfg.rope_scaling)
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)
     x = params["embed"][tokens]
 
@@ -477,8 +497,8 @@ def _trunk(params: dict, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray:
         v = jnp.einsum("bse,eh->bsh", h, _w(lp["wv"]))
         if "bv" in lp:
             v = v + lp["bv"]
-        q = apply_rope(q.reshape(B, S, H, D), positions, inv_freq)
-        k = apply_rope(k.reshape(B, S, KVH, D), positions, inv_freq)
+        q = apply_rope(q.reshape(B, S, H, D), positions, inv_freq, msc)
+        k = apply_rope(k.reshape(B, S, KVH, D), positions, inv_freq, msc)
         attn = _prefill_attention(q, k, v.reshape(B, S, KVH, D))
         x = x + jnp.einsum("bsh,he->bse", attn.reshape(B, S, H * D), _w(lp["wo"]))
         h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
@@ -531,7 +551,11 @@ def prefill_chunk(
     """
     B, C = tokens.shape
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
-    inv_freq = jnp.asarray(rope_frequencies(D, cfg.rope_theta, cfg.rope_scaling))
+    inv_freq = jnp.asarray(rope_frequencies(
+            D, cfg.rope_theta, cfg.rope_scaling,
+            cfg.max_position_embeddings,
+        ))
+    msc = rope_attention_scaling(cfg.rope_scaling)
     positions = start + jnp.arange(C)[None, :]
     x = params["embed"][tokens]
 
@@ -554,8 +578,8 @@ def prefill_chunk(
         q = proj(h, lp["wq"], "wq", lp.get("bq")).reshape(B, C, H, D)
         k = proj(h, lp["wk"], "wk", lp.get("bk")).reshape(B, C, KVH, D)
         v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, C, KVH, D)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
+        q = apply_rope(q, positions, inv_freq, msc)
+        k = apply_rope(k, positions, inv_freq, msc)
         kc = jax.lax.dynamic_update_slice(
             kc, k[0].astype(kc.dtype), (start, 0, 0)
         )

@@ -223,3 +223,69 @@ def test_gemma_mixtral_paged_equivalence():
             assert eng.cache_mode == mode
             outs[mode] = eng.generate(prompts, sp)
         assert outs["slot"] == outs["paged"], fam
+
+
+@pytest.mark.parametrize(
+    "rope_scaling",
+    [
+        {"rope_type": "linear", "factor": 2.0},
+        {"rope_type": "yarn", "factor": 4.0,
+         "original_max_position_embeddings": 32},
+        {"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+         "high_freq_factor": 4.0, "original_max_position_embeddings": 32},
+    ],
+    ids=["linear", "yarn", "llama3"],
+)
+def test_rope_scaling_variant_parity(tmp_path, rope_scaling):
+    """Context-extension rope variants match HF exactly (logits + greedy),
+    with prompts LONGER than original_max_position_embeddings (32) so
+    the scaled bands actually engage (engine context caps at 64)."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HFLlama, LlamaForCausalLM
+
+    hf_cfg = HFLlama(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=512, rope_theta=10000.0,
+        rope_scaling=dict(rope_scaling),
+        attn_implementation="eager",
+    )
+    torch.manual_seed(7)
+    model = LlamaForCausalLM(hf_cfg)
+    model.eval()
+    out_dir = tmp_path / rope_scaling["rope_type"]
+    model.save_pretrained(out_dir, safe_serialization=True)
+    prompt = tuple(int(x) for x in
+                   np.random.default_rng(9).integers(1, 256, 56))
+    _roundtrip("llama", model, out_dir, prompt=prompt)
+
+
+def test_dynamic_ntk_frequencies_rescale():
+    """Dynamic NTK: frequencies rescale at the serving context and reduce
+    to the base frequencies when no extension is configured."""
+    from kubeai_tpu.ops.rope import rope_frequencies
+
+    base = rope_frequencies(32, 10000.0, None)
+    dyn = rope_frequencies(
+        32, 10000.0,
+        {"rope_type": "dynamic", "factor": 4.0,
+         "original_max_position_embeddings": 2048,
+         "max_position_embeddings": 8192},
+    )
+    # Extended context lowers every non-constant frequency.
+    assert (dyn[1:] < base[1:]).all()
+    # Without original_max_position_embeddings, HF reads the model's
+    # context length — the top-level fallback must engage, not no-op.
+    fallback = rope_frequencies(
+        32, 10000.0, {"rope_type": "dynamic", "factor": 4.0},
+        max_position_embeddings=2048,
+    )
+    assert (fallback[1:] < base[1:]).all()
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        rope_frequencies(32, 10000.0, {"rope_type": "dynamic", "factor": 4.0})
+    # "default" is HF's explicit no-scaling marker.
+    np.testing.assert_allclose(
+        rope_frequencies(32, 10000.0, {"rope_type": "default"}), base
+    )
