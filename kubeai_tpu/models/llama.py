@@ -474,38 +474,41 @@ def decode_step_paged(
     return logits, k_pages, v_pages
 
 
-def _trunk(params: dict, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray:
-    """Transformer trunk: [B, S] tokens -> [B, S, E] final hidden states."""
-    B, S = tokens.shape
+def trunk_layer(x: jnp.ndarray, lp: dict, cfg: LlamaConfig) -> jnp.ndarray:
+    """One trunk layer [B, S, E] -> [B, S, E] (per-layer params `lp`).
+    Module-level (not a closure) so pipeline parallelism can stage it
+    (parallel/pipeline.py shards the stacked layer axis over pp)."""
+    B, S, _ = x.shape
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
     inv_freq = jnp.asarray(rope_frequencies(
-            D, cfg.rope_theta, cfg.rope_scaling,
-            cfg.max_position_embeddings,
-        ))
+        D, cfg.rope_theta, cfg.rope_scaling, cfg.max_position_embeddings,
+    ))
     msc = rope_attention_scaling(cfg.rope_scaling)
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)
+    h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+    q = jnp.einsum("bse,eh->bsh", h, _w(lp["wq"]))
+    if "bq" in lp:
+        q = q + lp["bq"]
+    k = jnp.einsum("bse,eh->bsh", h, _w(lp["wk"]))
+    if "bk" in lp:
+        k = k + lp["bk"]
+    v = jnp.einsum("bse,eh->bsh", h, _w(lp["wv"]))
+    if "bv" in lp:
+        v = v + lp["bv"]
+    q = apply_rope(q.reshape(B, S, H, D), positions, inv_freq, msc)
+    k = apply_rope(k.reshape(B, S, KVH, D), positions, inv_freq, msc)
+    attn = _prefill_attention(q, k, v.reshape(B, S, KVH, D))
+    x = x + jnp.einsum("bsh,he->bse", attn.reshape(B, S, H * D), _w(lp["wo"]))
+    h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+    return x + _mlp(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+
+
+def _trunk(params: dict, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Transformer trunk: [B, S] tokens -> [B, S, E] final hidden states."""
     x = params["embed"][tokens]
-
-    def layer(x, lp):
-        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-        q = jnp.einsum("bse,eh->bsh", h, _w(lp["wq"]))
-        if "bq" in lp:
-            q = q + lp["bq"]
-        k = jnp.einsum("bse,eh->bsh", h, _w(lp["wk"]))
-        if "bk" in lp:
-            k = k + lp["bk"]
-        v = jnp.einsum("bse,eh->bsh", h, _w(lp["wv"]))
-        if "bv" in lp:
-            v = v + lp["bv"]
-        q = apply_rope(q.reshape(B, S, H, D), positions, inv_freq, msc)
-        k = apply_rope(k.reshape(B, S, KVH, D), positions, inv_freq, msc)
-        attn = _prefill_attention(q, k, v.reshape(B, S, KVH, D))
-        x = x + jnp.einsum("bsh,he->bse", attn.reshape(B, S, H * D), _w(lp["wo"]))
-        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
-        return x, None
-
-    x, _ = jax.lax.scan(layer, x, params["layers"])
+    x, _ = jax.lax.scan(
+        lambda h, lp: (trunk_layer(h, lp, cfg), None), x, params["layers"]
+    )
     return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
 
 

@@ -8,6 +8,7 @@ axes map onto ICI; across slices/hosts, the data axis rides DCN.
 
 Axes (logical):
   dp  — data parallel (whole-request replication; across slices → DCN)
+  pp  — pipeline parallel (layer stages; see parallel/pipeline.py)
   tp  — tensor parallel (weight sharding; within slice → ICI)
   sp  — sequence parallel (ring attention for long context; ICI)
   ep  — expert parallel (MoE; ICI)
@@ -29,14 +30,16 @@ import numpy as np
 from jax.sharding import Mesh
 
 AXIS_DATA = "dp"
+AXIS_PIPELINE = "pp"
 AXIS_TENSOR = "tp"
 AXIS_SEQ = "sp"
 AXIS_EXPERT = "ep"
 
 # Standard mesh axis order. tp innermost: adjacent devices share the fastest
 # ICI links, and tensor-parallel collectives (psum of partial matmul results)
-# are the most latency-sensitive.
-MESH_AXES = (AXIS_DATA, AXIS_SEQ, AXIS_TENSOR)
+# are the most latency-sensitive. pp outermost after dp: stage hops are
+# point-to-point and the least latency-sensitive.
+MESH_AXES = (AXIS_DATA, AXIS_PIPELINE, AXIS_SEQ, AXIS_TENSOR)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,13 +49,14 @@ class MeshConfig:
     dp: int = 1
     sp: int = 1
     tp: int = 1
+    pp: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.sp * self.tp
+        return self.dp * self.pp * self.sp * self.tp
 
-    def axis_sizes(self) -> tuple[int, int, int]:
-        return (self.dp, self.sp, self.tp)
+    def axis_sizes(self) -> tuple[int, int, int, int]:
+        return (self.dp, self.pp, self.sp, self.tp)
 
 
 def parse_topology(topology: str) -> tuple[int, ...]:
@@ -93,7 +97,7 @@ def mesh_from_topology(
 def build_mesh(
     cfg: MeshConfig, *, devices: Sequence[jax.Device] | None = None
 ) -> Mesh:
-    """Build a Mesh with axes (dp, sp, tp) over the given devices."""
+    """Build a Mesh with axes (dp, pp, sp, tp) over the given devices."""
     if devices is None:
         devices = jax.devices()
     devices = list(devices)

@@ -1,0 +1,115 @@
+"""Pipeline parallelism (PP): GPipe-style microbatched layer stages.
+
+SURVEY §2's TPU-equivalents list calls for TP/DP(/PP for >8B). TP shards
+every matmul; PP shards the LAYER STACK: stage s owns layers
+[s*L/P, (s+1)*L/P) and activations hop stage-to-stage over ICI/DCN with
+`lax.ppermute` inside a `shard_map` over the `pp` mesh axis — no
+hand-written NCCL analog, just XLA collectives (reference has no PP at
+all; its engines are single-Pod, internal/modelcontroller/pod_plan.go).
+
+Schedule: classic GPipe fill/drain. With M microbatches and P stages the
+loop runs M + P - 1 ticks; at tick t stage s works on microbatch t - s.
+Stages run identical programs (SPMD): off-schedule ticks compute on
+padding and their results are discarded. Steady-state utilization is
+M / (M + P - 1) — pick M >= P.
+
+The stacked-layer model layout ([num_layers, ...] leading axis on every
+layer param — see models/llama.py) makes PP a pure RESHARDING choice:
+the same param tree pipelines by sharding its leading axis over `pp`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeai_tpu.parallel.mesh import AXIS_PIPELINE
+
+
+def pipeline_forward(
+    layer_fn: Callable,  # (x [mb, ...], layer_params) -> x
+    stacked_params,  # pytree, every leaf [num_layers, ...]
+    x: jnp.ndarray,  # [batch, ...] activations
+    mesh: Mesh,
+    microbatches: int,
+) -> jnp.ndarray:
+    """Run x through all layers, layer stack sharded over the pp axis.
+
+    Semantically identical to `lax.scan(layer_fn, x, stacked_params)`
+    (tested against it); the difference is WHERE layers live: each pp
+    stage holds only its slice of every layer param.
+    """
+    n_stages = mesh.shape[AXIS_PIPELINE]
+    batch = x.shape[0]
+    if batch % microbatches:
+        raise ValueError(f"batch {batch} not divisible by M={microbatches}")
+    num_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if num_layers % n_stages:
+        raise ValueError(
+            f"{num_layers} layers not divisible by {n_stages} pp stages"
+        )
+    if n_stages == 1:
+        return jax.lax.scan(
+            lambda h, p: (layer_fn(h, p), None), x, stacked_params
+        )[0]
+
+    mb = batch // microbatches
+    x_mb = x.reshape(microbatches, mb, *x.shape[1:])
+    ticks = microbatches + n_stages - 1
+
+    # Params: leading layer axis sharded over pp; everything else of the
+    # computation is replicated across pp (tp/sp sharding inside
+    # layer_fn would need shard_map nesting — one axis at a time here).
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(AXIS_PIPELINE), stacked_params
+    )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def run(local_params, x_mb):
+        stage = jax.lax.axis_index(AXIS_PIPELINE)
+        last = n_stages - 1
+        fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def local_layers(h):
+            return jax.lax.scan(
+                lambda c, p: (layer_fn(c, p), None), h, local_params
+            )[0]
+
+        def tick(carry, t):
+            buf, out = carry
+            # Stage 0 injects microbatch t (clamped; off-schedule ticks
+            # recompute a stale microbatch and the result is ignored).
+            inject = x_mb[jnp.clip(t, 0, microbatches - 1)]
+            h = jnp.where(stage == 0, inject, buf)
+            y = local_layers(h)
+            mb_idx = t - last
+            store = (stage == last) & (mb_idx >= 0)
+            out = jnp.where(
+                store,
+                out.at[jnp.clip(mb_idx, 0, microbatches - 1)].set(y),
+                out,
+            )
+            buf_next = jax.lax.ppermute(y, AXIS_PIPELINE, fwd)
+            return (buf_next, out), None
+
+        zero = jnp.zeros_like(x_mb[0])
+        out0 = jnp.zeros_like(x_mb)
+        (_, out), _ = jax.lax.scan(
+            tick, (zero, out0), jnp.arange(ticks)
+        )
+        # Only the last stage holds real outputs; replicate them.
+        out = jnp.where(stage == last, out, jnp.zeros_like(out))
+        return jax.lax.psum(out, AXIS_PIPELINE)
+
+    out = run(stacked_params, x_mb)
+    return out.reshape(batch, *x.shape[1:])
