@@ -57,6 +57,12 @@ class EngineConfig:
     # admission defers on pool exhaustion and decode preempts (recompute)
     # the youngest request when it can't grow.
     num_pages: int = 0
+    # Batched admission (paged mode): up to this many same-bucket pending
+    # prompts prefill in ONE device call — each dispatch costs a full
+    # round trip to the chip, so admission under a request burst is
+    # dispatch-bound without batching. Rows pad to the next power of two
+    # (bounded compile count).
+    max_admit_batch: int = 8
     prefill_buckets: tuple[int, ...] = ()  # default: powers of 2 up to max
     # Chunked prefill: prompts longer than this are prefilled in fixed
     # [1, prefill_chunk] steps against the slot cache — ONE compiled graph
@@ -463,8 +469,6 @@ class Engine:
         """Paged-cache compiled paths: admission scatters the prefilled
         sequence through the slot's block-table row; decode scatters one
         token per slot and attends over resident pages only."""
-        from kubeai_tpu.ops.paged_attention import sequence_page_coords
-
         fam, mcfg = self.family, self.model_cfg
         max_len = self.cfg.max_seq_len
         chunk = max(1, self.cfg.decode_chunk)
@@ -472,54 +476,61 @@ class Engine:
         decode_paged = fam.decode_step_paged
 
         def _prefill_admit(
-            params, tokens, ints, floats, bt_row, kp, vp, bt, state, lora
+            params, tokens, ints, floats, bt_rows, kp, vp, bt, state, lora
         ):
-            """Prefill → page scatter → first-token sample → state update.
-            `bt_row` is the slot's freshly allocated block-table row; it is
-            committed into the device tables here so admission stays one
-            device call. ints[5] >= 0 FORCES the sampled token — used when
-            re-admitting a preempted request, whose "first token" was
-            already emitted before preemption (re-sampling could diverge:
-            prefill and paged-decode logits come from different kernels)."""
-            length, slot, seed, topk = ints[0], ints[1], ints[2], ints[3]
-            adapter, forced = ints[4], ints[5]
-            temp, topp = floats[0], floats[1]
+            """BATCHED admission: prefill [A, S] prompts → page scatter →
+            first-token sample → state update, ONE device call for up to
+            max_admit_batch same-bucket prompts (each dispatch is a chip
+            round trip — admission under bursts is dispatch-bound).
+
+            ints [A, 6] packs per row [length, slot, seed, top_k,
+            adapter, forced]; floats [A, 2] packs [temp, top_p];
+            bt_rows [A, MP] are the freshly allocated block-table rows.
+            forced >= 0 overrides the sampled token (preemption resume —
+            re-sampling could diverge across kernels). PADDING rows use
+            slot = num_slots: their scatter indices are out of bounds and
+            jit scatters DROP OOB writes, so they touch nothing (their
+            page writes go to scratch page 0 via bt_row = -1)."""
+            lengths = ints[:, 0]
+            slots = ints[:, 1]
+            seeds = ints[:, 2].astype(jnp.uint32)
+            topk = ints[:, 3]
+            adapters = ints[:, 4]
+            forced = ints[:, 5]
+            temp, topp = floats[:, 0], floats[:, 1]
             if lora is None:
-                logits, k_all, v_all = fam.prefill(
-                    params, mcfg, tokens, length[None]
-                )
+                logits, k_all, v_all = fam.prefill(params, mcfg, tokens, lengths)
             else:
                 logits, k_all, v_all = fam.prefill(
-                    params, mcfg, tokens, length[None],
-                    lora=lora, lora_idx=adapter[None],
+                    params, mcfg, tokens, lengths,
+                    lora=lora, lora_idx=adapters,
                 )
-            from kubeai_tpu.ops.paged_attention import scatter_sequence
+            # Per-row page coordinates: [A, S] ids/offsets; padded tails
+            # (and padding rows) land in reserved scratch page 0.
+            from kubeai_tpu.ops.paged_attention import (
+                batched_scatter_sequence,
+                batched_sequence_page_coords,
+            )
 
-            S = tokens.shape[1]
-            page_ids, offsets = sequence_page_coords(bt_row, length, S, page)
-            kp, vp = scatter_sequence(
-                kp, vp, k_all[:, 0], v_all[:, 0], page_ids, offsets
+            page_ids, offsets = batched_sequence_page_coords(
+                bt_rows, lengths, tokens.shape[1], page
             )
-            bt = bt.at[slot].set(bt_row)
-            tok = sample(
-                logits,
-                seed.astype(jnp.uint32)[None],
-                length[None],
-                temp[None],
-                topk[None],
-                topp[None],
-            )[0]
-            tok = jnp.where(forced >= 0, forced, tok)
+            kp, vp = batched_scatter_sequence(
+                kp, vp, k_all, v_all, page_ids, offsets
+            )
+            bt = bt.at[slots].set(bt_rows)
+            toks = sample(logits, seeds, lengths, temp, topk, topp)  # [A]
+            toks = jnp.where(forced >= 0, forced, toks)
             state = dict(
-                tokens=state["tokens"].at[slot].set(tok),
-                positions=state["positions"].at[slot].set(length),
-                seeds=state["seeds"].at[slot].set(seed.astype(jnp.uint32)),
-                temp=state["temp"].at[slot].set(temp),
-                topk=state["topk"].at[slot].set(topk),
-                topp=state["topp"].at[slot].set(topp),
-                lora_idx=state["lora_idx"].at[slot].set(adapter),
+                tokens=state["tokens"].at[slots].set(toks),
+                positions=state["positions"].at[slots].set(lengths),
+                seeds=state["seeds"].at[slots].set(seeds),
+                temp=state["temp"].at[slots].set(temp),
+                topk=state["topk"].at[slots].set(topk),
+                topp=state["topp"].at[slots].set(topp),
+                lora_idx=state["lora_idx"].at[slots].set(adapters),
             )
-            return tok, kp, vp, bt, state
+            return toks, kp, vp, bt, state
 
         self._prefill_admit_jit = jax.jit(
             _prefill_admit,
@@ -640,35 +651,17 @@ class Engine:
 
     def _admit_pending(self) -> list[StepEvent]:
         """Prefill pending requests into free slots. Returns emitted tokens."""
+        if self.cache_mode == "paged":
+            return self._admit_pending_paged()
         emitted = []
         while self._pending and self._free_slots:
             req = self._pending[0]
             slot = self._free_slots[-1]
-            # A preempted (paged-mode) request resumes by RECOMPUTE:
-            # re-prefill prompt + already-emitted tokens (minus the last —
-            # its KV is written by the next decode step). The admission
-            # sample deterministically reproduces the last emitted token
-            # (same seed, same position fold), so it is not re-emitted.
-            resumed = bool(req.out_tokens)
-            seq = (
-                req.prompt + req.out_tokens[:-1] if resumed else req.prompt
-            )
+            # Preemption/resume only exists in paged mode; slot-mode
+            # pending requests always start fresh.
+            resumed = False
+            seq = req.prompt
             plen = len(seq)
-            if self.cache_mode == "paged":
-                from kubeai_tpu.engine.paged_cache import OutOfPages
-
-                try:
-                    pages = self._alloc.ensure(slot, plen)
-                except OutOfPages:
-                    break  # defer admission; ensure() rolled back
-                self._pending.popleft()
-                self._free_slots.pop()
-                req.slot = slot
-                tok = self._admit_paged(req, slot, seq, plen, pages)
-                ev = self._finish_admission(req, slot, plen, tok, resumed)
-                if ev is not None:
-                    emitted.append(ev)
-                continue
             self._pending.popleft()
             self._free_slots.pop()
             req.slot = slot
@@ -712,16 +705,86 @@ class Engine:
                 emitted.append(ev)
         return emitted
 
-    def _admit_paged(
-        self, req: _Request, slot: int, seq: list[int], plen: int,
-        pages: list[int],
-    ) -> int:
-        bucket = self._bucket(plen)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :plen] = seq
-        self._set_bt_row(slot, pages)
+    def _admit_pending_paged(self) -> list[StepEvent]:
+        """Paged admission, BATCHED: same-bucket pending prompts prefill
+        in one fused device call (up to cfg.max_admit_batch per call).
+        A preempted request resumes by RECOMPUTE — re-prefill prompt +
+        already-emitted tokens (minus the last, whose KV the next decode
+        step writes) with its first token FORCED to the one already
+        emitted."""
+        from kubeai_tpu.engine.paged_cache import OutOfPages
+
+        emitted: list[StepEvent] = []
+        while self._pending and self._free_slots:
+            batch: list[tuple[_Request, int, list[int], int, bool]] = []
+            bucket = None
+            while (
+                self._pending
+                and self._free_slots
+                and len(batch) < max(1, self.cfg.max_admit_batch)
+            ):
+                req = self._pending[0]
+                resumed = bool(req.out_tokens)
+                seq = (
+                    req.prompt + req.out_tokens[:-1] if resumed
+                    else req.prompt
+                )
+                plen = len(seq)
+                b = self._bucket(plen)
+                if bucket is None:
+                    bucket = b
+                elif b != bucket:
+                    break  # same-bucket batching only (no pad blow-up)
+                slot = self._free_slots[-1]
+                try:
+                    pages = self._alloc.ensure(slot, plen)
+                except OutOfPages:
+                    break  # defer; ensure() rolled back
+                self._pending.popleft()
+                self._free_slots.pop()
+                req.slot = slot
+                self._set_bt_row(slot, pages)
+                batch.append((req, slot, seq, plen, resumed))
+            if not batch:
+                break
+            toks = self._admit_paged_batch(batch, bucket)
+            for (req, slot, _seq, plen, resumed), tok in zip(batch, toks):
+                ev = self._finish_admission(req, slot, plen, int(tok), resumed)
+                if ev is not None:
+                    emitted.append(ev)
+        return emitted
+
+    def _admit_paged_batch(self, batch, bucket: int) -> np.ndarray:
+        A = len(batch)
+        a_pad = 1
+        while a_pad < A:
+            a_pad *= 2
+        mp = self._bt_host.shape[1]
+        tokens = np.zeros((a_pad, bucket), np.int32)
+        ints = np.zeros((a_pad, 6), np.int32)
+        floats = np.zeros((a_pad, 2), np.float32)
+        bt_rows = np.full((a_pad, mp), -1, np.int32)
+        # Padding rows: length 1, slot out of range (scatter drops it),
+        # bt_row -1 (page writes hit scratch), greedy sampling params.
+        ints[:, 0] = 1
+        ints[:, 1] = self.cfg.num_slots
+        floats[:, 1] = 1.0
+        for i, (req, slot, seq, plen, _resumed) in enumerate(batch):
+            tokens[i, :plen] = seq
+            ints[i] = [
+                plen,
+                slot,
+                int(np.uint32(req.seed).view(np.int32)),
+                req.params.top_k,
+                req.adapter_idx,
+                # Resume: force the already-emitted last token instead
+                # of trusting cross-kernel re-sampling determinism.
+                req.out_tokens[-1] if req.out_tokens else -1,
+            ]
+            floats[i] = [req.params.temperature, req.params.top_p]
+            bt_rows[i] = self._bt_host[slot]
         (
-            tok_dev,
+            toks_dev,
             self.cache.k_pages,
             self.cache.v_pages,
             self.cache.block_tables,
@@ -729,30 +792,16 @@ class Engine:
         ) = self._prefill_admit_jit(
             self.params,
             jnp.asarray(tokens),
-            jnp.asarray(
-                [
-                    plen,
-                    slot,
-                    int(np.uint32(req.seed).view(np.int32)),
-                    req.params.top_k,
-                    req.adapter_idx,
-                    # Resume: force the already-emitted last token instead
-                    # of trusting cross-kernel re-sampling determinism.
-                    req.out_tokens[-1] if req.out_tokens else -1,
-                ],
-                jnp.int32,
-            ),
-            jnp.asarray(
-                [req.params.temperature, req.params.top_p], jnp.float32
-            ),
-            jnp.asarray(self._bt_host[slot]),
+            jnp.asarray(ints),
+            jnp.asarray(floats),
+            jnp.asarray(bt_rows),
             self.cache.k_pages,
             self.cache.v_pages,
             self.cache.block_tables,
             self._state,
             self._lora,
         )
-        return int(tok_dev)
+        return np.asarray(toks_dev)[:A]
 
     def _finish_admission(
         self, req: _Request, slot: int, plen: int, tok: int,

@@ -317,18 +317,51 @@ def scatter_decode_token(
     return k_pages, v_pages
 
 
+def batched_sequence_page_coords(
+    bt_rows: jnp.ndarray,  # [A, MP] block-table rows (one per admission)
+    lengths: jnp.ndarray,  # [A] true lengths
+    seq_len: int,  # padded (bucket) length
+    page_size: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(page_ids [A, S], offsets [A, S]) for prefilled sequences. Padded
+    tail positions (>= length) and unallocated entries (-1) write into
+    the reserved scratch page 0."""
+    pos = jnp.arange(seq_len)
+    page_ids = jnp.maximum(bt_rows[:, pos // page_size], 0)
+    page_ids = jnp.where(pos[None, :] < lengths[:, None], page_ids, 0)
+    return page_ids, jnp.broadcast_to(pos % page_size, page_ids.shape)
+
+
+def batched_scatter_sequence(
+    k_pages: jnp.ndarray,  # [NL, P, page, KVH, D]
+    v_pages: jnp.ndarray,
+    k_seq: jnp.ndarray,  # [NL, A, S, KVH, D]
+    v_seq: jnp.ndarray,
+    page_ids: jnp.ndarray,  # [A, S]
+    offsets: jnp.ndarray,  # [A, S]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Write A prefilled sequences through their block tables in one
+    static-shape scatter (batched admission)."""
+    k_pages = k_pages.at[:, page_ids, offsets].set(
+        k_seq.astype(k_pages.dtype)
+    )
+    v_pages = v_pages.at[:, page_ids, offsets].set(
+        v_seq.astype(v_pages.dtype)
+    )
+    return k_pages, v_pages
+
+
 def sequence_page_coords(
     bt_row: jnp.ndarray,  # [MP] the slot's block-table row
     length: jnp.ndarray,  # scalar true length
     seq_len: int,  # padded (bucket) length
     page_size: int,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """(page_ids [S], offsets [S]) for a prefilled sequence. Padded tail
-    positions (>= length) write into scratch page 0."""
-    pos = jnp.arange(seq_len)
-    page_ids = jnp.maximum(bt_row[pos // page_size], 0)
-    page_ids = jnp.where(pos < length, page_ids, 0)
-    return page_ids, pos % page_size
+    """Single-sequence view of batched_sequence_page_coords."""
+    ids, offs = batched_sequence_page_coords(
+        bt_row[None], jnp.asarray(length)[None], seq_len, page_size
+    )
+    return ids[0], offs[0]
 
 
 def scatter_sequence(
@@ -339,12 +372,8 @@ def scatter_sequence(
     page_ids: jnp.ndarray,  # [S]
     offsets: jnp.ndarray,  # [S]
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Write a prefilled sequence through its block table (admission).
-    One static-shape scatter per admission — jit-safe for any length."""
-    k_pages = k_pages.at[:, page_ids, offsets].set(
-        k_seq.astype(k_pages.dtype)
+    """Single-sequence view of batched_scatter_sequence."""
+    return batched_scatter_sequence(
+        k_pages, v_pages, k_seq[:, None], v_seq[:, None],
+        page_ids[None], offsets[None],
     )
-    v_pages = v_pages.at[:, page_ids, offsets].set(
-        v_seq.astype(v_pages.dtype)
-    )
-    return k_pages, v_pages

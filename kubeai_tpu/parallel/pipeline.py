@@ -45,6 +45,8 @@ def pipeline_forward(
     """
     n_stages = mesh.shape[AXIS_PIPELINE]
     batch = x.shape[0]
+    if microbatches < 1:
+        raise ValueError(f"microbatches must be >= 1, got {microbatches}")
     if batch % microbatches:
         raise ValueError(f"batch {batch} not divisible by M={microbatches}")
     num_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
