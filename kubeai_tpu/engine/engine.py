@@ -295,11 +295,27 @@ class Engine:
 
     # ---- compiled functions -------------------------------------------------
 
+    def _resolve_prefill(self):
+        """Family prefill, with the engine mesh bound when an sp axis is
+        live and the family supports ring-attention prefill (llama/qwen):
+        makes sequence parallelism a serving path, not a demo."""
+        import inspect
+        from functools import partial as _partial
+
+        fam = self.family
+        if (
+            self.mesh.shape.get("sp", 1) > 1
+            and "mesh" in inspect.signature(fam.prefill).parameters
+        ):
+            return _partial(fam.prefill, mesh=self.mesh)
+        return fam.prefill
+
     def _build_jits(self, cache_sharding) -> None:
         if self.cache_mode == "paged":
             self._build_jits_paged(cache_sharding)
             return
         fam, mcfg = self.family, self.model_cfg
+        prefill_fn = self._resolve_prefill()
         max_len = self.cfg.max_seq_len
         chunk = max(1, self.cfg.decode_chunk)
 
@@ -312,11 +328,11 @@ class Engine:
             adapter = ints[4]
             temp, topp = floats[0], floats[1]
             if lora is None:
-                logits, k_all, v_all = fam.prefill(
+                logits, k_all, v_all = prefill_fn(
                     params, mcfg, tokens, length[None]
                 )
             else:
-                logits, k_all, v_all = fam.prefill(
+                logits, k_all, v_all = prefill_fn(
                     params, mcfg, tokens, length[None],
                     lora=lora, lora_idx=adapter[None],
                 )
@@ -470,6 +486,7 @@ class Engine:
         sequence through the slot's block-table row; decode scatters one
         token per slot and attends over resident pages only."""
         fam, mcfg = self.family, self.model_cfg
+        prefill_fn = self._resolve_prefill()
         max_len = self.cfg.max_seq_len
         chunk = max(1, self.cfg.decode_chunk)
         page = self.cfg.page_size
@@ -499,9 +516,9 @@ class Engine:
             forced = ints[:, 5]
             temp, topp = floats[:, 0], floats[:, 1]
             if lora is None:
-                logits, k_all, v_all = fam.prefill(params, mcfg, tokens, lengths)
+                logits, k_all, v_all = prefill_fn(params, mcfg, tokens, lengths)
             else:
-                logits, k_all, v_all = fam.prefill(
+                logits, k_all, v_all = prefill_fn(
                     params, mcfg, tokens, lengths,
                     lora=lora, lora_idx=adapters,
                 )

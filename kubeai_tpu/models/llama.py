@@ -266,15 +266,32 @@ def prefill(
     lengths: jnp.ndarray,  # [B] true prompt lengths
     lora: dict | None = None,  # stacked adapter buffers (init_lora_buffers)
     lora_idx: jnp.ndarray | None = None,  # [B] adapter index (0 = none)
+    mesh=None,  # Mesh with an sp axis > 1 → ring-attention prefill
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Full-prompt forward. Returns (last_token_logits [B, V],
     k_all [NL, B, S, KVH, D], v_all [NL, B, S, KVH, D]).
 
     The caller inserts the returned KV into the slot cache
     (kubeai_tpu.engine.kvcache.insert_sequence).
+
+    Long-context serving: when `mesh` carries an sp axis of size > 1 (and
+    the padded length divides by it), prefill attention runs as RING
+    ATTENTION with the sequence sharded over sp — each device holds S/sp
+    of the prompt and K/V rotate over ICI (parallel/ring_attention.py).
+    The engine passes its mesh automatically, making sp a serving-path
+    knob rather than a demo.
     """
     B, S = tokens.shape
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    use_ring = sp > 1 and S % sp == 0 and (S // sp) >= 1
+    if use_ring:
+        from kubeai_tpu.parallel.ring_attention import ring_attention_sharded
+
+        def attend(q, k, v):
+            return ring_attention_sharded(q, k, v, mesh)
+    else:
+        attend = _prefill_attention
     inv_freq = jnp.asarray(
         rope_frequencies(
             D, cfg.rope_theta, cfg.rope_scaling,
@@ -305,7 +322,7 @@ def prefill(
         v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, S, KVH, D)
         q = apply_rope(q, positions, inv_freq, msc)
         k = apply_rope(k, positions, inv_freq, msc)
-        attn = _prefill_attention(q, k, v)
+        attn = attend(q, k, v)
         x = x + proj(attn.reshape(B, S, H * D), lp["wo"], "wo")
         h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
         x = x + _mlp(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
