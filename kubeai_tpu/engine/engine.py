@@ -63,6 +63,23 @@ class EngineConfig:
     # dispatch-bound without batching. Rows pad to the next power of two
     # (bounded compile count).
     max_admit_batch: int = 8
+    # Speculative decoding (paged mode, families with a verify forward):
+    # propose this many tokens per step via prompt-lookup (n-gram match
+    # against the request's own context — no draft model) and verify all
+    # of them in ONE forward. Accepted tokens cost one model pass total,
+    # so repetitive/structured text decodes several tokens per step.
+    # Acceptance compares against the same seeded sampler the vanilla
+    # path uses, so the stream matches vanilla decoding exactly on the
+    # reference attention backend (CPU tests assert it); on TPU the
+    # verify pass currently uses the gather-reference attention while
+    # vanilla decode uses the Pallas kernel, so near-tie logits can
+    # diverge between speculate on/off (a multi-query Pallas verify
+    # kernel is the upgrade path). Trade-off: speculation replaces the
+    # decode_chunk fused scan with one device call per window — on
+    # low-acceptance text that is ~1 token per dispatch instead of
+    # decode_chunk, which matters on remote-dispatch transports. 0 = off.
+    # Mutually exclusive with pipeline=True.
+    speculate: int = 0
     prefill_buckets: tuple[int, ...] = ()  # default: powers of 2 up to max
     # Chunked prefill: prompts longer than this are prefilled in fixed
     # [1, prefill_chunk] steps against the slot cache — ONE compiled graph
@@ -131,6 +148,11 @@ class _Request:
     done: bool = False
     finish_reason: str = ""  # "stop" | "length" (OpenAI semantics)
     stop_token_ids: tuple[int, ...] = ()
+    # Incremental context buffer for speculative prompt-lookup (built on
+    # first use; appended per emitted token — avoids O(L) rebuilds on the
+    # dispatch path).
+    ctx: Any = None
+    ctx_len: int = 0
 
 
 class Engine:
@@ -196,6 +218,7 @@ class Engine:
         # Resolve the cache mode: paged needs family support and (for now)
         # whole-prompt prefill; otherwise fall back to the slot cache.
         self.cache_mode = cfg.cache_mode
+        self._spec = 0  # resolved speculation window (see below)
         if cfg.cache_mode == "paged" and (
             getattr(self.family, "decode_step_paged", None) is None
             or cfg.prefill_chunk > 0
@@ -290,6 +313,16 @@ class Engine:
                 model_cfg, cfg.max_adapters + 1, cfg.max_lora_rank
             )
             self._adapter_free = list(range(1, cfg.max_adapters + 1))
+
+        if cfg.speculate > 0:
+            if cfg.pipeline:
+                raise ValueError("speculate and pipeline are mutually exclusive")
+            if (
+                self.cache_mode == "paged"
+                and getattr(self.family, "decode_verify_paged", None)
+                is not None
+            ):
+                self._spec = cfg.speculate
 
         self._build_jits(cache_sharding)
 
@@ -594,6 +627,64 @@ class Engine:
             donate_argnums=(1, 2),
             out_shardings=(None, pool_sharding, pool_sharding, None),
         )
+
+        if self._spec:
+            gamma = self._spec
+            verify = fam.decode_verify_paged
+
+            def _spec_step(params, kp, vp, bt, state, proposals, lora):
+                """One speculative step: verify [last_token, γ proposals]
+                in a single forward; accept the longest prefix where the
+                seeded sampler's choice equals the proposal; emit
+                accepted+1 tokens. The emitted stream is bit-identical to
+                vanilla decoding: choice k is sampled from the same
+                logits with the same position fold it would see
+                sequentially, and a mismatch truncates the window before
+                any diverging context is used."""
+                positions = state["positions"]
+                seeds, temp = state["seeds"], state["temp"]
+                topk, topp = state["topk"], state["topp"]
+                tokens_in = jnp.concatenate(
+                    [state["tokens"][:, None], proposals], axis=1
+                )  # [B, γ+1]
+                if lora is None:
+                    logits, kp, vp = verify(
+                        params, mcfg, tokens_in, positions, kp, vp, bt
+                    )
+                else:
+                    logits, kp, vp = verify(
+                        params, mcfg, tokens_in, positions, kp, vp, bt,
+                        lora=lora, lora_idx=state["lora_idx"],
+                    )
+                choices = jnp.stack(
+                    [
+                        sample(
+                            logits[:, k], seeds, positions + k + 1,
+                            temp, topk, topp,
+                        )
+                        for k in range(gamma + 1)
+                    ],
+                    axis=1,
+                )  # [B, γ+1]
+                match = (choices[:, :gamma] == proposals).astype(jnp.int32)
+                accepted = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                n_emit = accepted + 1  # [B] in 1..γ+1
+                new_pos = jnp.minimum(positions + n_emit, max_len - 1)
+                last_tok = jnp.take_along_axis(
+                    choices, accepted[:, None], axis=1
+                )[:, 0]
+                state = dict(
+                    state, tokens=last_tok, positions=new_pos,
+                )
+                return choices, n_emit, kp, vp, state
+
+            self._spec_jit = jax.jit(
+                _spec_step,
+                donate_argnums=(1, 2),
+                out_shardings=(
+                    None, None, pool_sharding, pool_sharding, None,
+                ),
+            )
 
     # ---- public API ---------------------------------------------------------
 
@@ -908,7 +999,8 @@ class Engine:
         so the loop always terminates with the oldest request served."""
         from kubeai_tpu.engine.paged_cache import OutOfPages
 
-        chunk = max(1, self.cfg.decode_chunk)
+        # Lookahead: how far positions can advance in one device call.
+        chunk = (self._spec + 1) if self._spec else max(1, self.cfg.decode_chunk)
         for slot, req in sorted(
             self._active.items(), key=lambda kv: kv[1].rid
         ):
@@ -1011,19 +1103,37 @@ class Engine:
                             jnp.asarray(self._bt_host), self._bt_sharding
                         )
                         self._bt_dirty = False
-                    (
-                        toks_seq,
-                        self.cache.k_pages,
-                        self.cache.v_pages,
-                        self._state,
-                    ) = self._decode_jit(
-                        self.params,
-                        self.cache.k_pages,
-                        self.cache.v_pages,
-                        self.cache.block_tables,
-                        self._state,
-                        self._lora,
-                    )
+                    if self._spec:
+                        (
+                            choices,
+                            n_emit,
+                            self.cache.k_pages,
+                            self.cache.v_pages,
+                            self._state,
+                        ) = self._spec_jit(
+                            self.params,
+                            self.cache.k_pages,
+                            self.cache.v_pages,
+                            self.cache.block_tables,
+                            self._state,
+                            jnp.asarray(self._build_proposals()),
+                            self._lora,
+                        )
+                        toks_seq = ("spec", choices, n_emit)
+                    else:
+                        (
+                            toks_seq,
+                            self.cache.k_pages,
+                            self.cache.v_pages,
+                            self._state,
+                        ) = self._decode_jit(
+                            self.params,
+                            self.cache.k_pages,
+                            self.cache.v_pages,
+                            self.cache.block_tables,
+                            self._state,
+                            self._lora,
+                        )
                 else:
                     toks_seq, self.cache.k, self.cache.v, self._state = (
                         self._decode_jit(
@@ -1046,6 +1156,8 @@ class Engine:
 
     def _process_chunk(self, inflight: tuple) -> list[StepEvent]:
         toks_seq, chunk_slots = inflight
+        if isinstance(toks_seq, tuple) and toks_seq[0] == "spec":
+            return self._process_spec(toks_seq[1], toks_seq[2], chunk_slots)
         toks_seq = np.asarray(jax.device_get(toks_seq))  # [chunk, B]
         emitted: list[StepEvent] = []
         for k in range(toks_seq.shape[0]):
@@ -1063,6 +1175,74 @@ class Engine:
                 if finished:
                     self._release(req)
         return emitted
+
+    def _process_spec(
+        self, choices, n_emit, chunk_slots
+    ) -> list[StepEvent]:
+        """Emit each slot's accepted+corrected tokens (1..γ+1 per step).
+        A stop mid-window discards the remainder, like chunk surplus."""
+        choices = np.asarray(jax.device_get(choices))  # [B, γ+1]
+        n_emit = np.asarray(jax.device_get(n_emit))  # [B]
+        emitted: list[StepEvent] = []
+        for slot, req in chunk_slots:
+            if req.done:
+                continue
+            for j in range(int(n_emit[slot])):
+                tok = int(choices[slot, j])
+                req.out_tokens.append(tok)
+                req.position += 1
+                req.last_token = tok
+                finished = self._check_stop(req)
+                emitted.append(
+                    StepEvent(req.rid, tok, finished, req.finish_reason)
+                )
+                if finished:
+                    self._release(req)
+                    break
+        return emitted
+
+    def _build_proposals(self) -> np.ndarray:
+        """Prompt-lookup proposals [num_slots, γ]: the longest suffix
+        n-gram (n = 3, 2, 1) of each active request's context that
+        occurred earlier proposes its historical continuation (inactive
+        slots get zeros; their results are discarded anyway). Contexts
+        are kept in per-request incremental buffers — only newly emitted
+        tokens append each step."""
+        gamma = self._spec
+        out = np.zeros((self.cfg.num_slots, gamma), np.int32)
+        for slot, req in self._active.items():
+            need = len(req.prompt) + len(req.out_tokens)
+            if req.ctx is None or need < req.ctx_len:
+                req.ctx = np.empty(
+                    self.cfg.max_seq_len + gamma + 2, np.int32
+                )
+                base = req.prompt + req.out_tokens
+                req.ctx[: len(base)] = base
+                req.ctx_len = len(base)
+            elif req.ctx_len < need:
+                fresh = req.out_tokens[req.ctx_len - len(req.prompt):]
+                req.ctx[req.ctx_len:need] = fresh
+                req.ctx_len = need
+            out[slot] = self._ngram_propose(req.ctx[: req.ctx_len], gamma)
+        return out
+
+    @staticmethod
+    def _ngram_propose(ctx: np.ndarray, gamma: int) -> np.ndarray:
+        L = len(ctx)
+        for n in (3, 2, 1):
+            if L <= n:
+                continue
+            suffix = ctx[-n:]
+            windows = np.lib.stride_tricks.sliding_window_view(ctx, n)
+            hits = np.flatnonzero((windows == suffix).all(axis=1))
+            hits = hits[hits < L - n]  # exclude the suffix itself
+            if len(hits):
+                start = int(hits[-1]) + n
+                prop = ctx[start : start + gamma]
+                if len(prop):
+                    pad = np.full(gamma - len(prop), prop[-1], np.int32)
+                    return np.concatenate([prop, pad])
+        return np.full(gamma, int(ctx[-1]), np.int32)  # repeat-last fallback
 
     # ---- LoRA adapter admin (reference: internal/vllmclient/client.go) ------
 

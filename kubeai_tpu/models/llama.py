@@ -520,6 +520,95 @@ def trunk_layer(x: jnp.ndarray, lp: dict, cfg: LlamaConfig) -> jnp.ndarray:
     return x + _mlp(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
 
 
+def decode_verify_paged(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [B, K] — last emitted token + K-1 proposals
+    positions: jnp.ndarray,  # [B] absolute position of tokens[:, 0]
+    k_pages: jnp.ndarray,  # [NL, P, page, KVH, D]
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, MP]
+    lora: dict | None = None,
+    lora_idx: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """SPECULATIVE VERIFY: one forward over a K-token window per slot
+    against the paged cache. Writes the window's KV through the block
+    tables (rejected tail positions hold garbage that the per-slot
+    position pointer masks and later steps overwrite) and returns logits
+    for EVERY window position [B, K, V] so the engine can accept the
+    longest matching proposal prefix (engine.py speculative mode)."""
+    from kubeai_tpu.ops.paged_attention import (
+        ref_paged_verify_attention,
+        token_page_coords,
+    )
+
+    B, K = tokens.shape
+    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    page_size = k_pages.shape[2]
+    inv_freq = jnp.asarray(
+        rope_frequencies(
+            D, cfg.rope_theta, cfg.rope_scaling,
+            cfg.max_position_embeddings,
+        )
+    )
+    msc = rope_attention_scaling(cfg.rope_scaling)
+    pos_k = positions[:, None] + jnp.arange(K)[None, :]  # [B, K]
+    x = params["embed"][tokens]  # [B, K, E]
+    # Page coords for all K window positions per slot.
+    ids_list, offs_list = [], []
+    for k_i in range(K):
+        ids, offs = token_page_coords(
+            block_tables, positions + k_i, page_size
+        )
+        ids_list.append(ids)
+        offs_list.append(offs)
+    page_ids = jnp.stack(ids_list, axis=1)  # [B, K]
+    offsets = jnp.stack(offs_list, axis=1)
+
+    def layer(carry, scanned):
+        x = carry
+        lp = scanned["p"]
+        lor = scanned.get("l")
+        kp, vp = scanned["kp"], scanned["vp"]
+
+        def proj(h, w, target, bias=None):
+            out = jnp.einsum("bke,eh->bkh", h, _w(w))
+            if bias is not None:
+                out = out + bias
+            if lor is not None:
+                out = out + _lora_delta(
+                    h, lor[target]["A"], lor[target]["B"], lora_idx
+                )
+            return out
+
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q = proj(h, lp["wq"], "wq", lp.get("bq")).reshape(B, K, H, D)
+        k = proj(h, lp["wk"], "wk", lp.get("bk")).reshape(B, K, KVH, D)
+        v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, K, KVH, D)
+        q = apply_rope(q, pos_k, inv_freq, msc)
+        k = apply_rope(k, pos_k, inv_freq, msc)
+        kp = kp.at[page_ids, offsets].set(k.astype(kp.dtype))
+        vp = vp.at[page_ids, offsets].set(v.astype(vp.dtype))
+        attn = ref_paged_verify_attention(
+            q, kp, vp, block_tables, positions
+        )
+        x = x + proj(attn.reshape(B, K, H * D), lp["wo"], "wo")
+        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return x, (kp, vp)
+
+    xs = _scan_xs(params, lora)
+    xs["kp"] = k_pages
+    xs["vp"] = v_pages
+    x, (k_pages, v_pages) = jax.lax.scan(layer, x, xs)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = jnp.einsum(
+        "bke,ve->bkv", x, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
+    return logits, k_pages, v_pages
+
+
 def _trunk(params: dict, cfg: LlamaConfig, tokens: jnp.ndarray) -> jnp.ndarray:
     """Transformer trunk: [B, S] tokens -> [B, S, E] final hidden states."""
     x = params["embed"][tokens]

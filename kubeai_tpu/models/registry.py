@@ -28,6 +28,7 @@ class ModelFamily:
         prefill: Callable,
         decode_step: Callable,
         decode_step_paged: Callable | None = None,
+        decode_verify_paged: Callable | None = None,
         hf_architectures: tuple[str, ...] = (),
         feature: str = "TextGeneration",
         hidden_states=None,
@@ -43,6 +44,9 @@ class ModelFamily:
         # Paged-KV decode (block tables + page pools). None = family only
         # supports the slot cache; the engine falls back automatically.
         self.decode_step_paged = decode_step_paged
+        # Multi-position verify forward for speculative decoding (None =
+        # speculation unsupported for this family).
+        self.decode_verify_paged = decode_verify_paged
         self.hf_architectures = hf_architectures
         self.feature = feature
 
@@ -82,6 +86,7 @@ def _ensure_builtin() -> None:
             prefill=llama.prefill,
             decode_step=llama.decode_step,
             decode_step_paged=llama.decode_step_paged,
+            decode_verify_paged=llama.decode_verify_paged,
             hf_architectures=("LlamaForCausalLM", "MistralForCausalLM"),
             hidden_states=llama.hidden_states,
         )
@@ -100,6 +105,7 @@ def _ensure_builtin() -> None:
             prefill=llama.prefill,
             decode_step=llama.decode_step,
             decode_step_paged=llama.decode_step_paged,
+            decode_verify_paged=llama.decode_verify_paged,
             hf_architectures=("Qwen2ForCausalLM",),
             hidden_states=llama.hidden_states,
         )

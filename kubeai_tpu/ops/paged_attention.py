@@ -288,6 +288,53 @@ def paged_decode_attention(
     return out.reshape(b, h, d)
 
 
+def ref_paged_verify_attention(
+    q: jnp.ndarray,  # [B, K, H, D] — K speculative positions per slot
+    k_pages: jnp.ndarray,  # [P, page, KVH, D]
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, MP]
+    positions: jnp.ndarray,  # [B] absolute position of query 0
+    *,
+    scale: float | None = None,
+    logit_softcap: float | None = None,
+    window: jnp.ndarray | int | None = None,
+) -> jnp.ndarray:
+    """Multi-query paged attention for SPECULATIVE VERIFY: query k sits at
+    absolute position positions+k and attends keys at cols <= positions+k
+    (the K window's KV is already scattered into the pages). Gather-based
+    reference — speculative windows are small (K <= 8), so the extra HBM
+    read vs a dedicated kernel is bounded; a multi-query Pallas kernel is
+    the upgrade path."""
+    b, kq, h, d = q.shape
+    kvh = k_pages.shape[2]
+    bt = jnp.maximum(block_tables, 0)
+    k = k_pages[bt]
+    v = v_pages[bt]
+    mp, page = k.shape[1], k.shape[2]
+    L = mp * page
+    k = k.reshape(b, L, kvh, d)
+    v = v.reshape(b, L, kvh, d)
+    scale = scale if scale is not None else d ** -0.5
+    qg = (q * scale).reshape(b, kq, kvh, h // kvh, d)
+    logits = jnp.einsum(
+        "bqkgd,blkd->bkgql", qg.astype(jnp.float32), k.astype(jnp.float32)
+    )  # [B, KVH, G, K, L]
+    if logit_softcap is not None:
+        logits = jnp.tanh(logits / logit_softcap) * logit_softcap
+    col = jnp.arange(L)
+    q_abs = positions[:, None] + jnp.arange(kq)[None, :]  # [B, K]
+    mask = col[None, None, :] <= q_abs[:, :, None]  # [B, K, L]
+    if window is not None:
+        win = jnp.asarray(window, jnp.int32)
+        mask = mask & (
+            (win <= 0) | (col[None, None, :] > q_abs[:, :, None] - win)
+        )
+    logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgql,blkd->bqkgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, kq, h, d).astype(q.dtype)
+
+
 # ---- paged cache writes (decode + admission) ---------------------------------
 
 
@@ -297,9 +344,15 @@ def token_page_coords(
     page_size: int,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(page_ids [B], offsets [B]) for one new token per slot. Unallocated
-    entries (-1) map to the reserved scratch page 0."""
+    entries (-1) AND positions past the block table (a speculative window
+    can poke beyond max_seq_len near the context end — jnp gather CLAMPS
+    out-of-bounds indices, which would silently hit a live page) map to
+    the reserved scratch page 0."""
+    mp = block_tables.shape[1]
     slot_idx = jnp.arange(block_tables.shape[0])
-    page_ids = block_tables[slot_idx, positions // page_size]
+    pidx = positions // page_size
+    page_ids = block_tables[slot_idx, jnp.minimum(pidx, mp - 1)]
+    page_ids = jnp.where(pidx < mp, page_ids, -1)
     return jnp.maximum(page_ids, 0), positions % page_size
 
 

@@ -142,3 +142,65 @@ def test_ring_prefill_serving_path(monkeypatch):
 
     want = _make("paged", num_slots=2).generate(prompts, sp_param)
     assert got == want
+
+
+def test_speculative_greedy_matches_vanilla():
+    """Prompt-lookup speculation emits EXACTLY the vanilla stream —
+    greedy, including repetitive prompts where acceptance is high and a
+    max_seq_len-boundary case."""
+    rng = np.random.default_rng(21)
+    repetitive = ([7, 8, 9, 10] * 12)[:40]  # n-grams repeat → accepts
+    prompts = [
+        repetitive,
+        rng.integers(1, CFG.vocab_size, 23).tolist(),
+        rng.integers(1, CFG.vocab_size, 9).tolist(),
+    ]
+    sp = SamplingParams(temperature=0.0, max_tokens=30)
+    want = _make("paged").generate(prompts, sp)
+    eng = _make("paged", speculate=4)
+    assert eng._spec == 4
+    got = eng.generate(prompts, sp)
+    assert got == want
+
+    # Boundary: generation runs into max_seq_len mid-window.
+    long_prompt = ([3, 4, 5] * 40)[:110]
+    sp2 = SamplingParams(temperature=0.0, max_tokens=64)
+    want2 = _make("paged").generate([long_prompt], sp2)
+    got2 = _make("paged", speculate=4).generate([long_prompt], sp2)
+    assert got2 == want2
+
+
+def test_speculative_seeded_matches_vanilla():
+    rng = np.random.default_rng(22)
+    prompts = [
+        ([5, 6] * 20)[:30],
+        rng.integers(1, CFG.vocab_size, 17).tolist(),
+    ]
+    sp = SamplingParams(temperature=0.9, top_k=12, max_tokens=20, seed=77)
+    want = _make("paged").generate(prompts, sp)
+    got = _make("paged", speculate=3).generate(prompts, sp)
+    assert got == want
+
+
+def test_speculative_accepts_on_repetitive_text():
+    """On repetitive context the lookup proposals are right, so steps
+    emit >1 token — fewer device steps than tokens."""
+    eng = _make("paged", speculate=4)
+    prompt = ([11, 12, 13, 14, 15] * 10)[:45]
+    sp = SamplingParams(temperature=0.0, max_tokens=24)
+    out = eng.generate([prompt], sp)[0]
+    assert len(out) == 24
+    # steps counter: admission + N spec steps; acceptance must have
+    # compressed 24 tokens into fewer than 24 decode steps.
+    assert eng._steps < 24, f"no acceptance: {eng._steps} steps"
+
+
+def test_ngram_proposer():
+    propose = Engine._ngram_propose
+    ctx = np.asarray([1, 2, 3, 9, 1, 2, 3], np.int32)
+    # suffix [1,2,3] matched at start → proposes the continuation [9, ...]
+    got = propose(ctx, 3)
+    assert got[0] == 9
+    # No match anywhere: repeat-last fallback.
+    got = propose(np.asarray([4, 5, 6], np.int32), 2)
+    assert list(got) == [6, 6]
