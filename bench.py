@@ -130,6 +130,10 @@ def main() -> None:
         "[prompt-len/4, prompt-len], the serving-realistic case where "
         "paging wins)",
     )
+    ap.add_argument(
+        "--speculate", type=int, default=0,
+        help="prompt-lookup speculative decoding window (0 = off)",
+    )
     try:
         default_watchdog = float(os.environ.get("BENCH_WATCHDOG_S", "900"))
     except ValueError:
@@ -178,6 +182,7 @@ def main() -> None:
             num_slots=args.slots,
             max_seq_len=args.max_seq_len,
             cache_mode=args.cache_mode,
+            speculate=args.speculate,
         ),
     )
 
@@ -216,7 +221,11 @@ def main() -> None:
         "metric": "llama-1b-class decode throughput, continuous batching, "
         f"bs={args.slots}, {args.cache_mode} kv cache, "
         + ("uniform" if args.uniform_prompts else "mixed")
-        + " prompts, 1 chip" + (" (smoke)" if args.smoke else "")
+        + " prompts"
+        # Label with what actually RAN (the engine downgrades silently
+        # when speculation preconditions fail).
+        + (f", speculate={eng._spec}" if eng._spec else "")
+        + ", 1 chip" + (" (smoke)" if args.smoke else "")
         + backend_note,
         "value": round(toks_per_s, 2),
         "unit": "tok/s",

@@ -70,11 +70,11 @@ class EngineConfig:
     # so repetitive/structured text decodes several tokens per step.
     # Acceptance compares against the same seeded sampler the vanilla
     # path uses, so the stream matches vanilla decoding exactly on the
-    # reference attention backend (CPU tests assert it); on TPU the
-    # verify pass currently uses the gather-reference attention while
-    # vanilla decode uses the Pallas kernel, so near-tie logits can
-    # diverge between speculate on/off (a multi-query Pallas verify
-    # kernel is the upgrade path). Trade-off: speculation replaces the
+    # reference backend (CPU tests assert it). On TPU, verify runs its
+    # own multi-query Pallas kernel mirroring the decode kernel's
+    # per-page online-softmax accumulation — near-tie logits may still
+    # differ between the two kernels' schedules, but a speculative
+    # engine is internally deterministic. Trade-off: speculation replaces the
     # decode_chunk fused scan with one device call per window — on
     # low-acceptance text that is ~1 token per dispatch instead of
     # decode_chunk, which matters on remote-dispatch transports. 0 = off.
@@ -148,11 +148,13 @@ class _Request:
     done: bool = False
     finish_reason: str = ""  # "stop" | "length" (OpenAI semantics)
     stop_token_ids: tuple[int, ...] = ()
-    # Incremental context buffer for speculative prompt-lookup (built on
-    # first use; appended per emitted token — avoids O(L) rebuilds on the
-    # dispatch path).
+    # Incremental context buffer + n-gram last-occurrence index for
+    # speculative prompt-lookup (built on first use; appended per emitted
+    # token — proposal lookup is O(γ) per step, never an O(L) rescan).
     ctx: Any = None
     ctx_len: int = 0
+    ngram_idx: Any = None  # {n: {ngram tuple -> last start index}}
+    ngram_upto: Any = None  # {n: window starts indexed so far}
 
 
 class Engine:
@@ -323,6 +325,16 @@ class Engine:
                 is not None
             ):
                 self._spec = cfg.speculate
+            else:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "speculate=%d requested but unavailable (cache_mode=%s, "
+                    "family verify=%s) — running vanilla decode",
+                    cfg.speculate, self.cache_mode,
+                    getattr(self.family, "decode_verify_paged", None)
+                    is not None,
+                )
 
         self._build_jits(cache_sharding)
 
@@ -1219,12 +1231,38 @@ class Engine:
                 base = req.prompt + req.out_tokens
                 req.ctx[: len(base)] = base
                 req.ctx_len = len(base)
+                req.ngram_idx = {n: {} for n in (3, 2, 1)}
+                req.ngram_upto = {n: 0 for n in (3, 2, 1)}
             elif req.ctx_len < need:
                 fresh = req.out_tokens[req.ctx_len - len(req.prompt):]
                 req.ctx[req.ctx_len:need] = fresh
                 req.ctx_len = need
-            out[slot] = self._ngram_propose(req.ctx[: req.ctx_len], gamma)
+            out[slot] = self._ngram_propose_indexed(req, gamma)
         return out
+
+    @staticmethod
+    def _ngram_propose_indexed(req: _Request, gamma: int) -> np.ndarray:
+        """O(γ)-per-step lookup: the last-occurrence index is extended
+        only over the window starts added since the previous step."""
+        ctx, L = req.ctx, req.ctx_len
+        for n in (3, 2, 1):
+            if L <= n:
+                continue
+            s = L - n  # the suffix's own start — never indexed
+            idx = req.ngram_idx[n]
+            for i in range(req.ngram_upto[n], s):
+                idx[tuple(ctx[i : i + n].tolist())] = i
+            req.ngram_upto[n] = s
+            hit = idx.get(tuple(ctx[s:L].tolist()))
+            if hit is not None:
+                start = hit + n
+                prop = ctx[start : min(start + gamma, L)]
+                if len(prop):
+                    pad = np.full(
+                        gamma - len(prop), prop[-1], np.int32
+                    )
+                    return np.concatenate([prop, pad])
+        return np.full(gamma, int(ctx[L - 1]), np.int32)
 
     @staticmethod
     def _ngram_propose(ctx: np.ndarray, gamma: int) -> np.ndarray:

@@ -536,9 +536,11 @@ def decode_verify_paged(
     tables (rejected tail positions hold garbage that the per-slot
     position pointer masks and later steps overwrite) and returns logits
     for EVERY window position [B, K, V] so the engine can accept the
-    longest matching proposal prefix (engine.py speculative mode)."""
+    longest matching proposal prefix (engine.py speculative mode).
+    Attention dispatches to the multi-query paged Pallas kernel on TPU,
+    gather reference elsewhere (ops/paged_attention.py)."""
     from kubeai_tpu.ops.paged_attention import (
-        ref_paged_verify_attention,
+        paged_verify_attention,
         token_page_coords,
     )
 
@@ -589,7 +591,7 @@ def decode_verify_paged(
         k = apply_rope(k, pos_k, inv_freq, msc)
         kp = kp.at[page_ids, offsets].set(k.astype(kp.dtype))
         vp = vp.at[page_ids, offsets].set(v.astype(vp.dtype))
-        attn = ref_paged_verify_attention(
+        attn = paged_verify_attention(
             q, kp, vp, block_tables, positions
         )
         x = x + proj(attn.reshape(B, K, H * D), lp["wo"], "wo")

@@ -335,6 +335,211 @@ def ref_paged_verify_attention(
     return out.reshape(b, kq, h, d).astype(q.dtype)
 
 
+def _paged_verify_kernel(
+    # scalar-prefetch
+    bt_ref,  # [B, MP]
+    pos_ref,  # [B] absolute position of query 0
+    win_ref,  # [1] sliding window (<= 0 off)
+    # blocks
+    q_ref,  # [1, 1, K*G, D]
+    k_ref,  # [1, page, 1, D]
+    v_ref,  # [1, page, 1, D]
+    o_ref,  # [1, 1, K*G, D]
+    # scratch
+    m_ref,  # [K*G, 1] f32
+    l_ref,  # [K*G, 1] f32
+    acc_ref,  # [K*G, D] f32
+    *,
+    page_size: int,
+    scale: float,
+    spec_k: int,
+    group: int,
+    logit_softcap: float | None,
+):
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    mp = pl.num_programs(2)
+    pos = pos_ref[b]
+    win = win_ref[0]
+    # Keys exist up to absolute position pos + spec_k - 1.
+    n_pages = pl.cdiv(pos + spec_k, page_size)
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(i < n_pages)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # [KQ, D]
+        k = k_ref[0, :, 0].astype(jnp.float32)  # [page, D]
+        v = v_ref[0, :, 0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [KQ, page]
+        if logit_softcap is not None:
+            s = jnp.tanh(s / logit_softcap) * logit_softcap
+        col = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        row_pos = pos + (
+            jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
+        )
+        valid = col <= row_pos
+        valid = valid & ((win <= 0) | (col > row_pos - win))
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev, l_prev, acc_prev = m_ref[:], l_ref[:], acc_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        # Fully-masked rows keep m = NEG_INF; zero their contributions.
+        p = jnp.where(valid, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_prev * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(i == mp - 1)
+    def _finalize():
+        o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+def _verify_page_index(b, h, i, bt_ref, pos_ref, win_ref, *, page_size, spec_k):
+    """Clamp to the slot's live page range so out-of-range grid steps
+    revisit a live page (DMA elided)."""
+    pos = pos_ref[b]
+    win = win_ref[0]
+    last = jnp.maximum(pl.cdiv(pos + spec_k, page_size) - 1, 0)
+    first = jnp.where(
+        win > 0, jnp.maximum(pos - win + 1, 0) // page_size, 0
+    )
+    clamped = jnp.clip(i, first, last)
+    return jnp.maximum(bt_ref[b, clamped], 0), 0, h, 0
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "spec_k", "group", "scale", "logit_softcap", "interpret",
+    ),
+)
+def _paged_verify_pallas(
+    q,  # [B, KVH, K*G, D]
+    k_pages,
+    v_pages,
+    block_tables,
+    positions,  # [B]
+    window,  # [1] int32
+    spec_k: int,
+    group: int,
+    *,
+    scale: float,
+    logit_softcap: float | None,
+    interpret: bool,
+):
+    b, kvh, kq, d = q.shape
+    page = k_pages.shape[1]
+    mp = block_tables.shape[1]
+    kernel = functools.partial(
+        _paged_verify_kernel,
+        page_size=page,
+        scale=scale,
+        spec_k=int(spec_k),
+        group=int(group),
+        logit_softcap=logit_softcap,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, kvh, mp),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, kq, d),
+                lambda b_, h_, i_, bt, ps, wn: (b_, h_, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, page, 1, d),
+                functools.partial(
+                    _verify_page_index, page_size=page, spec_k=int(spec_k)
+                ),
+            ),
+            pl.BlockSpec(
+                (1, page, 1, d),
+                functools.partial(
+                    _verify_page_index, page_size=page, spec_k=int(spec_k)
+                ),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, kq, d),
+            lambda b_, h_, i_, bt, ps, wn: (b_, h_, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((kq, 1), jnp.float32),
+            pltpu.VMEM((kq, 1), jnp.float32),
+            pltpu.VMEM((kq, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, kq, d), q.dtype),
+        interpret=interpret,
+    )(block_tables, positions, window, q, k_pages, v_pages)
+
+
+def paged_verify_attention(
+    q: jnp.ndarray,  # [B, K, H, D]
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    positions: jnp.ndarray,  # [B]
+    *,
+    scale: float | None = None,
+    logit_softcap: float | None = None,
+    window: jnp.ndarray | int | None = None,
+    use_pallas: bool | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Multi-query paged verify attention with kernel/reference dispatch
+    (speculative decoding's verify pass; see ref_paged_verify_attention
+    for semantics)."""
+    b, spec_k, h, d = q.shape
+    kvh = k_pages.shape[2]
+    group = h // kvh
+    scale = scale if scale is not None else d ** -0.5
+    if use_pallas is None:
+        use_pallas = (
+            _HAS_PLTPU
+            and not interpret
+            and jax.default_backend() not in ("cpu",)
+            and paged_supported(d, k_pages.shape[1])
+        )
+    if not use_pallas and not interpret:
+        return ref_paged_verify_attention(
+            q, k_pages, v_pages, block_tables, positions,
+            scale=scale, logit_softcap=logit_softcap, window=window,
+        )
+    win_arr = jnp.asarray(
+        [0 if window is None else window], jnp.int32
+    ).reshape(1)
+    # [B, K, H, D] -> [B, KVH, K*G, D]: row r = query r//G, q-head-in-group
+    # r%G, so the kernel's row//group recovers the query index.
+    qk = jnp.moveaxis(
+        q.reshape(b, spec_k, kvh, group, d), 1, 2
+    ).reshape(b, kvh, spec_k * group, d)
+    out = _paged_verify_pallas(
+        qk, k_pages, v_pages, block_tables, positions, win_arr,
+        spec_k, group,
+        scale=scale, logit_softcap=logit_softcap, interpret=interpret,
+    )
+    out = jnp.moveaxis(
+        out.reshape(b, kvh, spec_k, group, d), 2, 1
+    )  # [B, K, KVH, G, D]
+    return out.reshape(b, spec_k, h, d)
+
+
 # ---- paged cache writes (decode + admission) ---------------------------------
 
 

@@ -204,3 +204,24 @@ def test_ngram_proposer():
     # No match anywhere: repeat-last fallback.
     got = propose(np.asarray([4, 5, 6], np.int32), 2)
     assert list(got) == [6, 6]
+
+
+def test_ngram_indexed_matches_scan_proposer():
+    """The O(γ) incremental index must propose exactly what the full
+    rescan proposes, across growing contexts."""
+    from kubeai_tpu.engine.engine import _Request
+
+    rng = np.random.default_rng(31)
+    tokens = rng.integers(1, 6, 200).tolist()  # small vocab → many repeats
+    req = _Request(rid=0, prompt=tokens[:20], params=SamplingParams(), seed=0)
+    req.ctx = np.empty(512, np.int32)
+    req.ctx[:20] = tokens[:20]
+    req.ctx_len = 20
+    req.ngram_idx = {n: {} for n in (3, 2, 1)}
+    req.ngram_upto = {n: 0 for n in (3, 2, 1)}
+    for t in tokens[20:]:
+        req.ctx[req.ctx_len] = t
+        req.ctx_len += 1
+        want = Engine._ngram_propose(req.ctx[: req.ctx_len], 4)
+        got = Engine._ngram_propose_indexed(req, 4)
+        assert list(got) == list(want), req.ctx_len

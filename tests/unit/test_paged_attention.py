@@ -173,3 +173,52 @@ def test_allocator_oversubscription_and_rollback():
     assert alloc.free_pages == 2  # slot 1 holds nothing
     alloc.release(0)
     assert alloc.free_pages == 4
+
+
+def test_verify_kernel_matches_reference():
+    """Multi-query verify kernel (interpret mode) vs the gather
+    reference, incl. softcap/window and ragged base positions."""
+    from kubeai_tpu.ops.paged_attention import (
+        paged_verify_attention,
+        ref_paged_verify_attention,
+    )
+
+    K = 3
+    lengths = [5, 17, 28]  # position of query 0 per slot = length
+    q_, kd, vd, kp, vp, bt, _len = _setup(
+        [l + K for l in lengths], seed=13
+    )  # allocate pages covering the K window
+    rng = np.random.default_rng(14)
+    q = jnp.asarray(rng.standard_normal((B, K, H, D)), jnp.float32)
+    positions = jnp.asarray(lengths, jnp.int32)
+    for cap, win in ((None, None), (40.0, None), (None, 9), (25.0, 6)):
+        got = paged_verify_attention(
+            q, kp, vp, bt, positions,
+            logit_softcap=cap, window=win,
+            use_pallas=True, interpret=True,
+        )
+        want = ref_paged_verify_attention(
+            q, kp, vp, bt, positions, logit_softcap=cap, window=win,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+        )
+
+
+def test_verify_reference_row0_matches_decode():
+    """Verify row 0 must equal single-token decode attention on the same
+    cache state (the speculative stream's first token is the vanilla
+    decode token)."""
+    from kubeai_tpu.ops.paged_attention import ref_paged_verify_attention
+
+    q, kd, vd, kp, vp, bt, lengths = _setup([6, 14, 27], seed=15)
+    rng = np.random.default_rng(16)
+    qk = jnp.asarray(rng.standard_normal((B, 2, H, D)), jnp.float32)
+    # decode semantics: new token at position `length-?`... use positions
+    # = lengths - 1 so query 0 attends exactly `lengths` keys.
+    positions = lengths - 1
+    ver = ref_paged_verify_attention(qk, kp, vp, bt, positions)
+    dec = ref_paged_decode_attention(qk[:, 0], kp, vp, bt, lengths)
+    np.testing.assert_allclose(
+        np.asarray(ver[:, 0]), np.asarray(dec), atol=1e-5
+    )
