@@ -134,6 +134,10 @@ def main() -> None:
         "--speculate", type=int, default=0,
         help="prompt-lookup speculative decoding window (0 = off)",
     )
+    ap.add_argument(
+        "--quantization", default="", choices=["", "int8"],
+        help="weight-only quantization",
+    )
     try:
         default_watchdog = float(os.environ.get("BENCH_WATCHDOG_S", "900"))
     except ValueError:
@@ -183,6 +187,7 @@ def main() -> None:
             max_seq_len=args.max_seq_len,
             cache_mode=args.cache_mode,
             speculate=args.speculate,
+            quantization=args.quantization,
         ),
     )
 
@@ -225,6 +230,7 @@ def main() -> None:
         # Label with what actually RAN (the engine downgrades silently
         # when speculation preconditions fail).
         + (f", speculate={eng._spec}" if eng._spec else "")
+        + (f", {args.quantization}" if args.quantization else "")
         + ", 1 chip" + (" (smoke)" if args.smoke else "")
         + backend_note,
         "value": round(toks_per_s, 2),
