@@ -6,14 +6,16 @@ actually occupies. Two implementations with one contract:
 
   ref_paged_decode_attention — jnp gather-through-block-tables reference
       (CPU/tests; also the fallback when kernel constraints aren't met).
-  paged_decode_attention     — Pallas TPU kernel. Grid (slots, kv_heads,
-      max_pages); block tables + lengths are SCALAR-PREFETCHED so the
-      BlockSpec index_map selects each slot's next real page for DMA.
-      Pages past a slot's length re-map to the slot's LAST valid page —
-      consecutive grid steps with an unchanged block index elide the
-      copy, so HBM traffic ≈ sum(ceil(len/page)) pages, not B*max_pages
-      (the revisiting trick; compute for those steps is skipped with
-      pl.when).
+  paged_decode_attention     — Pallas TPU kernel. Grid (slots, max_pages);
+      each DMA carries a full page across ALL kv heads (the block's last
+      two dims are the full (KVH, D) — a Mosaic tiling requirement) and a
+      static in-kernel unroll attends each head. Block tables + lengths
+      are SCALAR-PREFETCHED so the BlockSpec index_map selects each
+      slot's next real page for DMA. Pages past a slot's length re-map
+      to the slot's LAST valid page — consecutive grid steps with an
+      unchanged block index elide the copy, so HBM traffic ≈
+      sum(ceil(len/page)) pages, not B*max_pages (the revisiting trick;
+      compute for those steps is skipped with pl.when).
 
 Sliding-window (Gemma-2) and logit softcap are supported in both paths:
 window masks keys at positions < length - window.
@@ -41,6 +43,38 @@ except ImportError:  # pragma: no cover
     _HAS_PLTPU = False
 
 NEG_INF = -1e30
+
+
+def _accum_head(
+    q_ref, k_ref, v_ref, valid, m_ref, l_ref, acc_ref, kh,
+    *, scale, logit_softcap, zero_masked_p,
+):
+    """One kv head's online-softmax update over the current page block.
+    Shared by the decode and verify kernels; `zero_masked_p` guards rows
+    that can be FULLY masked (verify: speculative rows past a window).
+    Scratch refs are [KVH, rows, ...] — indexing the LEADING dim keeps
+    every VMEM access tile-aligned regardless of the per-head row count."""
+    q = q_ref[0, kh].astype(jnp.float32) * scale  # [rows, D]
+    k = k_ref[0, :, kh].astype(jnp.float32)  # [page, D]
+    v = v_ref[0, :, kh].astype(jnp.float32)
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [rows, page]
+    if logit_softcap is not None:
+        s = jnp.tanh(s / logit_softcap) * logit_softcap
+    s = jnp.where(valid, s, NEG_INF)
+    m_prev = m_ref[kh]
+    l_prev = l_ref[kh]
+    acc_prev = acc_ref[kh]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    if zero_masked_p:
+        # Fully-masked rows keep m = NEG_INF; zero their contributions.
+        p = jnp.where(valid, p, 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    m_ref[kh] = m_new
+    l_ref[kh] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[kh] = acc_prev * alpha + jnp.dot(
+        p, v, preferred_element_type=jnp.float32
+    )
 
 
 # ---- functional reference ----------------------------------------------------
@@ -98,22 +132,27 @@ def _paged_kernel(
     len_ref,  # [B] int32 lengths
     win_ref,  # [1] int32 sliding window (<= 0 = disabled)
     # blocks
-    q_ref,  # [1, 1, G, D]
-    k_ref,  # [1, page, 1, D] — the page selected by the index_map
-    v_ref,  # [1, page, 1, D]
-    o_ref,  # [1, 1, G, D]
+    q_ref,  # [1, KVH, G, D]
+    k_ref,  # [1, page, KVH, D] — the page selected by the index_map
+    v_ref,  # [1, page, KVH, D]
+    o_ref,  # [1, KVH, G, D]
     # scratch (carried across the page grid dimension)
-    m_ref,  # [G, 1] f32
-    l_ref,  # [G, 1] f32
-    acc_ref,  # [G, D] f32
+    m_ref,  # [KVH, G, 1] f32
+    l_ref,  # [KVH, G, 1] f32
+    acc_ref,  # [KVH, G, D] f32
     *,
     page_size: int,
+    kvh: int,
+    group: int,
     scale: float,
     logit_softcap: float | None,
 ):
+    # Grid is (slots, pages): one DMA per (slot, page) carries ALL kv
+    # heads of that page — Mosaic requires the block's last two dims to
+    # be full (KVH, D) here, and the single fetch serves every head.
     b = pl.program_id(0)
-    i = pl.program_id(2)
-    mp = pl.num_programs(2)
+    i = pl.program_id(1)
+    mp = pl.num_programs(1)
 
     length = len_ref[b]
     win = win_ref[0]
@@ -133,36 +172,25 @@ def _paged_kernel(
 
     @pl.when((i >= first) & (i < n_pages))
     def _attend():
-        q = q_ref[0, 0].astype(jnp.float32) * scale  # [G, D]
-        k = k_ref[0, :, 0].astype(jnp.float32)  # [page, D]
-        v = v_ref[0, :, 0].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [G, page]
-        if logit_softcap is not None:
-            s = jnp.tanh(s / logit_softcap) * logit_softcap
         pos = i * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1
+            jnp.int32, (group, page_size), 1
         )
         valid = pos < length
         valid = valid & ((win <= 0) | (pos >= length - win))
-        s = jnp.where(valid, s, NEG_INF)
-        m_prev, l_prev, acc_prev = m_ref[:], l_ref[:], acc_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        m_ref[:] = m_new
-        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_prev * alpha + jnp.dot(
-            p, v, preferred_element_type=jnp.float32
-        )
+        for kh in range(kvh):  # static unroll: one [G,page] dot per head
+            _accum_head(
+                q_ref, k_ref, v_ref, valid, m_ref, l_ref, acc_ref, kh,
+                scale=scale, logit_softcap=logit_softcap,
+                zero_masked_p=False,
+            )
 
     @pl.when(i == mp - 1)
     def _finalize():
-        o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(
-            o_ref.dtype
-        )
+        out = acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)  # [KVH, G, D]
+        o_ref[0] = out.astype(o_ref.dtype)
 
 
-def _page_index(b, h, i, bt_ref, len_ref, win_ref, *, page_size):
+def _page_index(b, i, bt_ref, len_ref, win_ref, *, page_size):
     """Index map for k/v pages: slot b's i-th page. Outside the live range
     (past the last page, or below the sliding window's first page), KEEP
     RETURNING the nearest live page — an unchanged block index between
@@ -175,7 +203,7 @@ def _page_index(b, h, i, bt_ref, len_ref, win_ref, *, page_size):
     )
     clamped = jnp.clip(i, first, last)
     page_id = jnp.maximum(bt_ref[b, clamped], 0)
-    return page_id, 0, h, 0
+    return page_id, 0, 0, 0
 
 
 @functools.partial(
@@ -201,34 +229,36 @@ def _paged_pallas(
     kernel = functools.partial(
         _paged_kernel,
         page_size=page,
+        kvh=kvh,
+        group=g,
         scale=scale,
         logit_softcap=logit_softcap,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(b, kvh, mp),
+        grid=(b, mp),
         in_specs=[
             pl.BlockSpec(
-                (1, 1, g, d),
-                lambda b_, h_, i_, bt, ln, wn: (b_, h_, 0, 0),
+                (1, kvh, g, d),
+                lambda b_, i_, bt, ln, wn: (b_, 0, 0, 0),
             ),
             pl.BlockSpec(
-                (1, page, 1, d),
+                (1, page, kvh, d),
                 functools.partial(_page_index, page_size=page),
             ),
             pl.BlockSpec(
-                (1, page, 1, d),
+                (1, page, kvh, d),
                 functools.partial(_page_index, page_size=page),
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, g, d),
-            lambda b_, h_, i_, bt, ln, wn: (b_, h_, 0, 0),
+            (1, kvh, g, d),
+            lambda b_, i_, bt, ln, wn: (b_, 0, 0, 0),
         ),
         scratch_shapes=[
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, 1), jnp.float32),
-            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((kvh, g, 1), jnp.float32),
+            pltpu.VMEM((kvh, g, 1), jnp.float32),
+            pltpu.VMEM((kvh, g, d), jnp.float32),
         ],
     )
     out = pl.pallas_call(
@@ -241,9 +271,12 @@ def _paged_pallas(
 
 
 def paged_supported(head_dim: int, page_size: int) -> bool:
-    """Kernel constraints: lane dim = head_dim multiple of 128 would be
-    ideal; we accept any D and let Mosaic pad lanes, but require the page
-    (sublane) dimension to satisfy the bf16 tile."""
+    """Kernel constraints. The k/v block is (1, page, KVH, D) — its last
+    two dims are the FULL array dims, so the BLOCK shape itself imposes
+    no divisibility rule; but in-kernel values still use `page` as a
+    sublane/lane dim ([page, D] loads, [rows, page] logits), so keep the
+    f32 sublane tile divisibility until odd sizes are validated on real
+    hardware (non-conforming pools use the jnp reference path)."""
     return page_size % 8 == 0
 
 
@@ -341,28 +374,38 @@ def _paged_verify_kernel(
     pos_ref,  # [B] absolute position of query 0
     win_ref,  # [1] sliding window (<= 0 off)
     # blocks
-    q_ref,  # [1, 1, K*G, D]
-    k_ref,  # [1, page, 1, D]
-    v_ref,  # [1, page, 1, D]
-    o_ref,  # [1, 1, K*G, D]
+    q_ref,  # [1, KVH, K*G, D]
+    k_ref,  # [1, page, KVH, D]
+    v_ref,  # [1, page, KVH, D]
+    o_ref,  # [1, KVH, K*G, D]
     # scratch
-    m_ref,  # [K*G, 1] f32
-    l_ref,  # [K*G, 1] f32
-    acc_ref,  # [K*G, D] f32
+    m_ref,  # [KVH, K*G, 1] f32
+    l_ref,  # [KVH, K*G, 1] f32
+    acc_ref,  # [KVH, K*G, D] f32
     *,
     page_size: int,
+    kvh: int,
     scale: float,
     spec_k: int,
     group: int,
     logit_softcap: float | None,
 ):
+    # Grid (slots, pages); every kv head of a page rides one DMA (the
+    # block's last two dims must be the full (KVH, D) on TPU).
     b = pl.program_id(0)
-    i = pl.program_id(2)
-    mp = pl.num_programs(2)
+    i = pl.program_id(1)
+    mp = pl.num_programs(1)
     pos = pos_ref[b]
     win = win_ref[0]
+    kq = spec_k * group
     # Keys exist up to absolute position pos + spec_k - 1.
     n_pages = pl.cdiv(pos + spec_k, page_size)
+    # First page with any in-window key (query 0 is the lowest row);
+    # pages below it are provably all-masked — skip their compute (the
+    # index_map clamp already elides their DMA).
+    first = jnp.where(
+        win > 0, jnp.maximum(pos - win + 1, 0) // page_size, 0
+    )
 
     @pl.when(i == 0)
     def _init():
@@ -370,43 +413,30 @@ def _paged_verify_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    @pl.when(i < n_pages)
+    @pl.when((i >= first) & (i < n_pages))
     def _attend():
-        q = q_ref[0, 0].astype(jnp.float32) * scale  # [KQ, D]
-        k = k_ref[0, :, 0].astype(jnp.float32)  # [page, D]
-        v = v_ref[0, :, 0].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [KQ, page]
-        if logit_softcap is not None:
-            s = jnp.tanh(s / logit_softcap) * logit_softcap
         col = i * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1
+            jnp.int32, (kq, page_size), 1
         )
         row_pos = pos + (
-            jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // group
+            jax.lax.broadcasted_iota(jnp.int32, (kq, page_size), 0) // group
         )
         valid = col <= row_pos
         valid = valid & ((win <= 0) | (col > row_pos - win))
-        s = jnp.where(valid, s, NEG_INF)
-        m_prev, l_prev, acc_prev = m_ref[:], l_ref[:], acc_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        # Fully-masked rows keep m = NEG_INF; zero their contributions.
-        p = jnp.where(valid, p, 0.0)
-        alpha = jnp.exp(m_prev - m_new)
-        m_ref[:] = m_new
-        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_prev * alpha + jnp.dot(
-            p, v, preferred_element_type=jnp.float32
-        )
+        for kh in range(kvh):  # static unroll: one [KQ,page] dot per head
+            _accum_head(
+                q_ref, k_ref, v_ref, valid, m_ref, l_ref, acc_ref, kh,
+                scale=scale, logit_softcap=logit_softcap,
+                zero_masked_p=True,
+            )
 
     @pl.when(i == mp - 1)
     def _finalize():
-        o_ref[0, 0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(
-            o_ref.dtype
-        )
+        out = acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)  # [KVH, KQ, D]
+        o_ref[0] = out.astype(o_ref.dtype)
 
 
-def _verify_page_index(b, h, i, bt_ref, pos_ref, win_ref, *, page_size, spec_k):
+def _verify_page_index(b, i, bt_ref, pos_ref, win_ref, *, page_size, spec_k):
     """Clamp to the slot's live page range so out-of-range grid steps
     revisit a live page (DMA elided)."""
     pos = pos_ref[b]
@@ -416,7 +446,7 @@ def _verify_page_index(b, h, i, bt_ref, pos_ref, win_ref, *, page_size, spec_k):
         win > 0, jnp.maximum(pos - win + 1, 0) // page_size, 0
     )
     clamped = jnp.clip(i, first, last)
-    return jnp.maximum(bt_ref[b, clamped], 0), 0, h, 0
+    return jnp.maximum(bt_ref[b, clamped], 0), 0, 0, 0
 
 
 @functools.partial(
@@ -445,6 +475,7 @@ def _paged_verify_pallas(
     kernel = functools.partial(
         _paged_verify_kernel,
         page_size=page,
+        kvh=kvh,
         scale=scale,
         spec_k=int(spec_k),
         group=int(group),
@@ -452,33 +483,33 @@ def _paged_verify_pallas(
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(b, kvh, mp),
+        grid=(b, mp),
         in_specs=[
             pl.BlockSpec(
-                (1, 1, kq, d),
-                lambda b_, h_, i_, bt, ps, wn: (b_, h_, 0, 0),
+                (1, kvh, kq, d),
+                lambda b_, i_, bt, ps, wn: (b_, 0, 0, 0),
             ),
             pl.BlockSpec(
-                (1, page, 1, d),
+                (1, page, kvh, d),
                 functools.partial(
                     _verify_page_index, page_size=page, spec_k=int(spec_k)
                 ),
             ),
             pl.BlockSpec(
-                (1, page, 1, d),
+                (1, page, kvh, d),
                 functools.partial(
                     _verify_page_index, page_size=page, spec_k=int(spec_k)
                 ),
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, kq, d),
-            lambda b_, h_, i_, bt, ps, wn: (b_, h_, 0, 0),
+            (1, kvh, kq, d),
+            lambda b_, i_, bt, ps, wn: (b_, 0, 0, 0),
         ),
         scratch_shapes=[
-            pltpu.VMEM((kq, 1), jnp.float32),
-            pltpu.VMEM((kq, 1), jnp.float32),
-            pltpu.VMEM((kq, d), jnp.float32),
+            pltpu.VMEM((kvh, kq, 1), jnp.float32),
+            pltpu.VMEM((kvh, kq, 1), jnp.float32),
+            pltpu.VMEM((kvh, kq, d), jnp.float32),
         ],
     )
     return pl.pallas_call(
