@@ -48,7 +48,7 @@ class EngineConfig:
     # KV cache layout: "paged" (block tables over a shared page pool; decode
     # reads only resident pages — the default) or "slot" (fixed
     # [slots, max_seq_len] reservation per slot). Families without a paged
-    # decode path (and chunked prefill, for now) fall back to "slot".
+    # decode path fall back to "slot".
     cache_mode: str = "paged"
     page_size: int = 64
     # Page-pool size. 0 = full reservation (num_slots * max_seq_len worth
@@ -82,9 +82,12 @@ class EngineConfig:
     speculate: int = 0
     prefill_buckets: tuple[int, ...] = ()  # default: powers of 2 up to max
     # Chunked prefill: prompts longer than this are prefilled in fixed
-    # [1, prefill_chunk] steps against the slot cache — ONE compiled graph
-    # for every prompt length and O(chunk * max_seq_len) activation memory
-    # (0 = whole-prompt bucketed prefill only). Requires family support.
+    # [1, prefill_chunk] steps — ONE compiled graph for every prompt
+    # length and O(chunk * max_seq_len) activation memory (0 = whole-
+    # prompt bucketed prefill only). Works in both cache modes: slot mode
+    # chunks straight into the slot's cache row; paged mode stages chunks
+    # in a one-slot buffer and scatters pages on the final chunk.
+    # Requires family support.
     prefill_chunk: int = 0
     cache_dtype: Any = jnp.bfloat16
     # Decode steps fused into one device call (lax.scan). Amortizes host
@@ -217,13 +220,13 @@ class Engine:
                     for name, phys in rules.rules
                 )
             )
-        # Resolve the cache mode: paged needs family support and (for now)
-        # whole-prompt prefill; otherwise fall back to the slot cache.
+        # Resolve the cache mode: paged needs family support; otherwise
+        # fall back to the slot cache. Chunked prefill works in both modes
+        # (paged stages chunks in a one-slot buffer, then scatters).
         self.cache_mode = cfg.cache_mode
         self._spec = 0  # resolved speculation window (see below)
         if cfg.cache_mode == "paged" and (
             getattr(self.family, "decode_step_paged", None) is None
-            or cfg.prefill_chunk > 0
         ):
             self.cache_mode = "slot"
         elif cfg.cache_mode not in ("paged", "slot"):
@@ -272,6 +275,30 @@ class Engine:
             self._bt_host = np.full((cfg.num_slots, max_pages), -1, np.int32)
             self._bt_dirty = False
             cache_sharding = pool_sharding
+            # Chunked prefill staging: chunks write a ONE-slot [NL, L,
+            # KVH, D] buffer (the exact layout the chunk graph already
+            # speaks); the last chunk scatters the staged sequence through
+            # the block tables in the same device call. Costs one slot's
+            # KV of extra HBM, keeps the single compiled chunk graph.
+            self._stage_k = self._stage_v = None
+            if cfg.prefill_chunk > 0:
+                self._stage_sharding = psh.named_sharding(
+                    self.mesh, (None, None, psh.KV_HEADS, None), cache_rules
+                )
+                stage_shape = (
+                    model_cfg.num_layers,
+                    cfg.max_seq_len,
+                    model_cfg.num_kv_heads,
+                    model_cfg.head_size,
+                )
+                self._stage_k = jax.device_put(
+                    jnp.zeros(stage_shape, cfg.cache_dtype),
+                    self._stage_sharding,
+                )
+                self._stage_v = jax.device_put(
+                    jnp.zeros(stage_shape, cfg.cache_dtype),
+                    self._stage_sharding,
+                )
         else:
             cache_sharding = psh.named_sharding(
                 self.mesh, KVCache.logical_axes(), cache_rules
@@ -315,6 +342,24 @@ class Engine:
                 model_cfg, cfg.max_adapters + 1, cfg.max_lora_rank
             )
             self._adapter_free = list(range(1, cfg.max_adapters + 1))
+
+        # Chunked-prefill support is resolved ONCE here; both cache-mode
+        # builders reuse it.
+        self._chunk_fn = None
+        if cfg.prefill_chunk > 0:
+            if (
+                not hasattr(self.family, "prefill_chunk")
+                and self.family.name != "llama"
+            ):
+                raise ValueError(
+                    f"family {self.family.name} does not support chunked prefill"
+                )
+            from kubeai_tpu.models import llama as _llama
+
+            self._chunk_fn = (
+                getattr(self.family, "prefill_chunk", None)
+                or _llama.prefill_chunk
+            )
 
         if cfg.speculate > 0:
             if cfg.pipeline:
@@ -450,13 +495,7 @@ class Engine:
         )
 
         if self.cfg.prefill_chunk > 0:
-            if not hasattr(fam, "prefill_chunk") and fam.name != "llama":
-                raise ValueError(
-                    f"family {fam.name} does not support chunked prefill"
-                )
-            from kubeai_tpu.models import llama as _llama
-
-            chunk_fn = getattr(fam, "prefill_chunk", None) or _llama.prefill_chunk
+            chunk_fn = self._chunk_fn
 
             def _slot_slice(c, slot):
                 nl, _, L, kvh, d = c.shape
@@ -698,6 +737,87 @@ class Engine:
                 ),
             )
 
+        if self.cfg.prefill_chunk > 0:
+            from kubeai_tpu.ops.paged_attention import (
+                scatter_sequence,
+                sequence_page_coords,
+            )
+
+            chunk_fn = self._chunk_fn
+            stage_sharding = self._stage_sharding
+
+            def _stage_mid(params, tokens, ints, ks, vs, lora):
+                """One non-final chunk into the staging buffer. `ints`
+                packs [start, length, adapter]."""
+                start, length, adapter = ints[0], ints[1], ints[2]
+                _, ks, vs = chunk_fn(
+                    params, mcfg, tokens, start, length, ks, vs,
+                    want_logits=False,
+                    lora=lora,
+                    lora_idx=None if lora is None else adapter[None],
+                )
+                return ks, vs
+
+            self._stage_chunk_mid_jit = jax.jit(
+                _stage_mid,
+                donate_argnums=(3, 4),
+                out_shardings=(stage_sharding, stage_sharding),
+            )
+
+            def _stage_last(
+                params, tokens, ints, floats, ks, vs, bt_row, kp, vp, bt,
+                state, lora,
+            ):
+                """Final chunk: logits + staged-KV page scatter + first
+                token + slot-state update in one device call. `ints`
+                packs [start, length, slot, adapter, seed, top_k,
+                forced]; forced >= 0 overrides the sample (preemption
+                resume). Staged positions >= length scatter into the
+                reserved scratch page 0."""
+                start, length, slot = ints[0], ints[1], ints[2]
+                adapter, seed = ints[3], ints[4]
+                topk, forced = ints[5], ints[6]
+                temp, topp = floats[0], floats[1]
+                logits, ks, vs = chunk_fn(
+                    params, mcfg, tokens, start, length, ks, vs,
+                    want_logits=True,
+                    lora=lora,
+                    lora_idx=None if lora is None else adapter[None],
+                )
+                page_ids, offsets = sequence_page_coords(
+                    bt_row, length, max_len, page
+                )
+                kp, vp = scatter_sequence(kp, vp, ks, vs, page_ids, offsets)
+                bt = bt.at[slot].set(bt_row)
+                tok = sample(
+                    logits,
+                    seed.astype(jnp.uint32)[None],
+                    length[None],
+                    temp[None],
+                    topk[None],
+                    topp[None],
+                )[0]
+                tok = jnp.where(forced >= 0, forced, tok)
+                state = dict(
+                    tokens=state["tokens"].at[slot].set(tok),
+                    positions=state["positions"].at[slot].set(length),
+                    seeds=state["seeds"].at[slot].set(seed.astype(jnp.uint32)),
+                    temp=state["temp"].at[slot].set(temp),
+                    topk=state["topk"].at[slot].set(topk),
+                    topp=state["topp"].at[slot].set(topp),
+                    lora_idx=state["lora_idx"].at[slot].set(adapter),
+                )
+                return tok, ks, vs, kp, vp, bt, state
+
+            self._stage_chunk_last_jit = jax.jit(
+                _stage_last,
+                donate_argnums=(4, 5, 7, 8, 9),
+                out_shardings=(
+                    None, stage_sharding, stage_sharding,
+                    pool_sharding, pool_sharding, self._bt_sharding, None,
+                ),
+            )
+
     # ---- public API ---------------------------------------------------------
 
     def add_request(
@@ -835,9 +955,11 @@ class Engine:
         from kubeai_tpu.engine.paged_cache import OutOfPages
 
         emitted: list[StepEvent] = []
+        C = self.cfg.prefill_chunk
         while self._pending and self._free_slots:
             batch: list[tuple[_Request, int, list[int], int, bool]] = []
             bucket = None
+            chunked = None  # long prompt diverted to the staged-chunk path
             while (
                 self._pending
                 and self._free_slots
@@ -850,6 +972,12 @@ class Engine:
                     else req.prompt
                 )
                 plen = len(seq)
+                if C > 0 and plen > C:
+                    # Chunked admission is one-at-a-time (the staging
+                    # buffer holds one sequence); flush any batch first.
+                    if not batch:
+                        chunked = (req, seq, plen, resumed)
+                    break
                 b = self._bucket(plen)
                 if bucket is None:
                     bucket = b
@@ -865,6 +993,22 @@ class Engine:
                 req.slot = slot
                 self._set_bt_row(slot, pages)
                 batch.append((req, slot, seq, plen, resumed))
+            if chunked is not None:
+                req, seq, plen, resumed = chunked
+                slot = self._free_slots[-1]
+                try:
+                    pages = self._alloc.ensure(slot, plen)
+                except OutOfPages:
+                    break  # defer; ensure() rolled back
+                self._pending.popleft()
+                self._free_slots.pop()
+                req.slot = slot
+                self._set_bt_row(slot, pages)
+                tok = self._admit_chunked_paged(req, slot, seq, plen, C)
+                ev = self._finish_admission(req, slot, plen, tok, resumed)
+                if ev is not None:
+                    emitted.append(ev)
+                continue
             if not batch:
                 break
             toks = self._admit_paged_batch(batch, bucket)
@@ -873,6 +1017,60 @@ class Engine:
                 if ev is not None:
                     emitted.append(ev)
         return emitted
+
+    def _admit_chunked_paged(
+        self, req: _Request, slot: int, seq: list[int], plen: int, C: int
+    ) -> int:
+        """Chunked prefill in paged mode: chunks accumulate in the one-slot
+        staging buffer; the final chunk scatters the whole staged sequence
+        through the slot's freshly-allocated block-table row."""
+        mids, (last_start, last_tokens) = self._chunk_plan(seq, plen, C)
+        for start, tokens in mids:
+            self._stage_k, self._stage_v = self._stage_chunk_mid_jit(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.asarray([start, plen, req.adapter_idx], jnp.int32),
+                self._stage_k,
+                self._stage_v,
+                self._lora,
+            )
+        forced = req.out_tokens[-1] if req.out_tokens else -1
+        (
+            tok_dev,
+            self._stage_k,
+            self._stage_v,
+            self.cache.k_pages,
+            self.cache.v_pages,
+            self.cache.block_tables,
+            self._state,
+        ) = self._stage_chunk_last_jit(
+            self.params,
+            jnp.asarray(last_tokens),
+            jnp.asarray(
+                [
+                    last_start,
+                    plen,
+                    slot,
+                    req.adapter_idx,
+                    int(np.uint32(req.seed).view(np.int32)),
+                    req.params.top_k,
+                    forced,
+                ],
+                jnp.int32,
+            ),
+            jnp.asarray(
+                [req.params.temperature, req.params.top_p], jnp.float32
+            ),
+            self._stage_k,
+            self._stage_v,
+            jnp.asarray(self._bt_host[slot]),
+            self.cache.k_pages,
+            self.cache.v_pages,
+            self.cache.block_tables,
+            self._state,
+            self._lora,
+        )
+        return int(tok_dev)
 
     def _admit_paged_batch(self, batch, bucket: int) -> np.ndarray:
         A = len(batch)
@@ -946,31 +1144,47 @@ class Engine:
             self._active[slot] = req
         return StepEvent(req.rid, tok, finished, req.finish_reason)
 
+    @staticmethod
+    def _chunk_plan(seq: list[int], plen: int, C: int):
+        """Chunk schedule: full-C mid chunks at 0, C, …; the FINAL chunk
+        is aligned BACKWARD to end exactly at plen (start = plen - C), so
+        its cache writes never reach past position plen —
+        dynamic_update_slice would otherwise CLAMP the start index when
+        ceil(plen/C)*C exceeds the buffer length and silently corrupt
+        staged KV. Overlapping positions recompute byte-identical KV.
+        Returns ([(start, tokens[1, C])...], (last_start, last_tokens))."""
+        arr = np.asarray(seq, np.int32)
+        n_chunks = -(-plen // C)
+        mids = [
+            (i * C, arr[None, i * C : (i + 1) * C])
+            for i in range(n_chunks - 1)
+        ]
+        return mids, (plen - C, arr[None, plen - C : plen])
+
     def _admit_chunked(self, req: _Request, slot: int, plen: int, C: int) -> int:
         """Prefill a long prompt chunk-by-chunk into the slot cache; the
         final chunk also samples the first token and updates slot state."""
-        n_chunks = -(-plen // C)
-        padded = np.zeros((1, n_chunks * C), np.int32)
-        padded[0, :plen] = req.prompt
-        for i in range(n_chunks - 1):
+        mids, (last_start, last_tokens) = self._chunk_plan(
+            req.prompt, plen, C
+        )
+        for start, tokens in mids:
             self.cache.k, self.cache.v = self._prefill_chunk_mid_jit(
                 self.params,
-                jnp.asarray(padded[:, i * C : (i + 1) * C]),
+                jnp.asarray(tokens),
                 jnp.asarray(
-                    [i * C, slot, plen, req.adapter_idx], jnp.int32
+                    [start, slot, plen, req.adapter_idx], jnp.int32
                 ),
                 self.cache.k,
                 self.cache.v,
                 self._lora,
             )
-        last = n_chunks - 1
         tok_dev, self.cache.k, self.cache.v, self._state = (
             self._prefill_chunk_last_jit(
                 self.params,
-                jnp.asarray(padded[:, last * C :]),
+                jnp.asarray(last_tokens),
                 jnp.asarray(
                     [
-                        last * C,
+                        last_start,
                         slot,
                         plen,
                         req.adapter_idx,

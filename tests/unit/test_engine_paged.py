@@ -225,3 +225,48 @@ def test_ngram_indexed_matches_scan_proposer():
         want = Engine._ngram_propose(req.ctx[: req.ctx_len], 4)
         got = Engine._ngram_propose_indexed(req, 4)
         assert list(got) == list(want), req.ctx_len
+
+
+def test_chunked_prefill_paged_matches_whole_prompt():
+    """prefill_chunk in PAGED mode (staged chunks -> page scatter) emits
+    exactly the whole-prompt paged stream, greedy and seeded; short
+    prompts (<= chunk) keep using the batched admission path."""
+    rng = np.random.default_rng(11)
+    prompts = [
+        rng.integers(1, CFG.vocab_size, n).tolist() for n in (5, 23, 40, 61)
+    ]
+    for sp in (
+        SamplingParams(temperature=0.0, max_tokens=10),
+        SamplingParams(temperature=0.9, top_k=12, max_tokens=8, seed=77),
+    ):
+        want = _make("paged").generate(prompts, sp)
+        chunked = _make("paged", prefill_chunk=16)
+        assert chunked.cache_mode == "paged"  # no slot fallback anymore
+        assert chunked.generate(prompts, sp) == want
+
+
+def test_chunked_prefill_paged_preemption_resume():
+    """A preempted long-prompt request re-admits through the chunked
+    path with its forced token; the stream must match unconstrained."""
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(1, CFG.vocab_size, 30).tolist() for _ in range(3)]
+    sp = SamplingParams(temperature=0.0, max_tokens=40)
+    want = _make("paged", prefill_chunk=16).generate(prompts, sp)
+    tight = _make("paged", prefill_chunk=16, num_pages=1 + 9)
+    assert tight.generate(prompts, sp) == want
+
+
+def test_chunked_prefill_nondivisible_tail():
+    """ceil(plen/C)*C > max_seq_len used to make the final chunk's
+    dynamic_update_slice CLAMP its start and silently corrupt staged KV;
+    the backward-aligned final chunk must match whole-prompt output in
+    both cache modes."""
+    rng = np.random.default_rng(17)
+    prompt = rng.integers(1, CFG.vocab_size, 97).tolist()  # 7*16 = 112 > 100
+    sp = SamplingParams(temperature=0.0, max_tokens=3)
+    for mode in ("slot", "paged"):
+        want = _make(mode, max_seq_len=100).generate([prompt], sp)
+        got = _make(mode, max_seq_len=100, prefill_chunk=16).generate(
+            [prompt], sp
+        )
+        assert got == want, mode
