@@ -19,8 +19,16 @@ Determinism requirements this module enforces:
     free-list, so identical op streams yield identical block tables on
     every host.
 
-LoRA hot-swap is not yet lockstep (adapters would need weight
-broadcast); multi-host engines must run with max_adapters=0.
+LoRA hot-swap IS lockstep: host 0's admin call broadcasts a control
+descriptor carrying the op + adapter name, then (for loads) one
+fixed-shape weight payload — adapter A/B matrices zero-padded to
+max_lora_rank, so every adapter broadcasts with identical shapes and
+the zero padding contributes nothing to the delta. Every process then
+installs the same weights into the same buffer slot (slot assignment is
+deterministic under identical op order). The broadcast happens INSIDE
+load_adapter under the same I/O lock step() holds across its
+descriptor→tokens→engine.step() sequence, so the global collective
+order stays identical on every process.
 
 The serving analog is JetStream/MaxText-style multihost orchestration;
 the reference has no counterpart (one-Pod-per-replica,
@@ -51,7 +59,17 @@ class _PendingAdd:
     vrid: int  # the virtual rid handed to the caller
     tokens: list[int]
     params: SamplingParams
+    adapter_idx: int = 0
+    # Name kept alongside the resolved index so unload_adapter can refuse
+    # while this admission is still buffered (the index must stay valid
+    # until it broadcasts).
+    adapter_name: str | None = None
     cancelled: bool = False
+
+
+# header[4] adapter op codes
+_ADAPTER_NONE, _ADAPTER_LOAD, _ADAPTER_UNLOAD = 0, 1, 2
+_ADAPTER_NAME_BYTES = 64
 
 
 def _control_zeros() -> dict:
@@ -59,12 +77,49 @@ def _control_zeros() -> dict:
     the common no-admission decode step stays cheap on DCN. The padded
     token matrix broadcasts in a SECOND collective only when
     n_admits > 0 (both sides branch on the same header, so the
-    collective sequence stays identical across processes)."""
+    collective sequence stays identical across processes); adapter LOAD
+    ops likewise trigger a second, fixed-shape weight broadcast."""
     return {
-        "header": np.zeros((4,), np.int32),  # n_admits, n_cancels, step, stop
+        # n_admits, n_cancels, step, stop, adapter_op
+        "header": np.zeros((5,), np.int32),
         "meta": np.zeros((MAX_ADMITS, _META_COLS), np.int32),
         "floats": np.zeros((MAX_ADMITS, 2), np.float32),  # temp, top_p
         "cancels": np.zeros((MAX_CANCELS,), np.int32),
+        "adapter_name": np.zeros((_ADAPTER_NAME_BYTES,), np.uint8),
+    }
+
+
+def _encode_name(name: str) -> np.ndarray:
+    raw = name.encode("utf-8")
+    if len(raw) > _ADAPTER_NAME_BYTES:
+        raise ValueError(
+            f"adapter name longer than {_ADAPTER_NAME_BYTES} utf-8 bytes"
+        )
+    buf = np.zeros((_ADAPTER_NAME_BYTES,), np.uint8)
+    buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+    return buf
+
+
+def _decode_name(buf: np.ndarray) -> str:
+    return bytes(buf).rstrip(b"\x00").decode("utf-8")
+
+
+def _lora_payload_zeros(engine: Engine) -> dict:
+    """Fixed-shape weight payload: one {target.A/.B} float32 array pair
+    per LoRA target, shaped like one buffer slot (rank = max_lora_rank).
+    Identical construction on every process ⇒ identical broadcast
+    shapes."""
+    out = {}
+    for target, bufs in engine._lora.items():
+        out[target + ".A"] = np.zeros(bufs["A"].shape[1:], np.float32)
+        out[target + ".B"] = np.zeros(bufs["B"].shape[1:], np.float32)
+    return out
+
+
+def _payload_to_weights(engine: Engine, payload: dict) -> dict:
+    return {
+        target: (payload[target + ".A"], payload[target + ".B"])
+        for target in engine._lora
     }
 
 
@@ -85,13 +140,13 @@ class LockstepEngine:
     is_lockstep = True  # server gates non-lockstep paths (embeddings)
 
     def __init__(self, inner: Engine):
-        if inner.cfg.max_adapters:
-            raise ValueError(
-                "multi-host engines must run with max_adapters=0 "
-                "(LoRA hot-swap is not lockstep yet)"
-            )
         self.inner = inner
         self._lock = threading.Lock()
+        # Serializes every broadcast SEQUENCE (a step's descriptor→
+        # tokens→engine.step(), an adapter op's descriptor→payload, a
+        # shutdown) so the global collective order is identical on every
+        # process.
+        self._io_lock = threading.RLock()
         self._adds: list[_PendingAdd] = []
         self._cancels: list[int] = []
         # Cancels that raced step(): their admission batch was popped
@@ -134,13 +189,72 @@ class LockstepEngine:
         return self.inner._bucket(n)
 
     def loaded_adapters(self) -> list[str]:
-        return []
+        return self.inner.loaded_adapters()
 
-    def load_adapter(self, *a, **kw):
-        raise ValueError("LoRA not supported on multi-host engines yet")
+    def load_adapter(self, name: str, adapter_weights: dict) -> None:
+        """Lockstep adapter install: broadcast the op + padded weights to
+        every process, then install locally. Synchronous — returns once
+        this process has installed (workers install on their own receive,
+        strictly before their next engine collective)."""
+        if self.inner._lora is None:
+            raise ValueError("LoRA is disabled (max_adapters=0)")
+        name_buf = _encode_name(name)
+        payload = _lora_payload_zeros(self.inner)
+        r_max = self.cfg.max_lora_rank
+        for target, (A, B) in adapter_weights.items():
+            if target + ".A" not in payload:
+                raise KeyError(f"unknown LoRA target {target!r}")
+            A = np.asarray(A, np.float32)
+            B = np.asarray(B, np.float32)
+            r = A.shape[-1]
+            if r > r_max:
+                raise ValueError(f"adapter rank {r} > max_lora_rank {r_max}")
+            # Zero-pad rank to r_max: fixed broadcast shapes, and the
+            # padding contributes nothing to x@A@B.
+            payload[target + ".A"][..., :r] = A
+            payload[target + ".B"][:, :r, :] = B
+        desc = _control_zeros()
+        desc["header"][4] = _ADAPTER_LOAD
+        desc["adapter_name"] = name_buf
+        with self._io_lock:
+            # Capacity must be validated BEFORE any broadcast: a
+            # post-broadcast raise would leave workers' loops dead (or
+            # diverged) and the next step() collective hanging.
+            if (
+                name not in self.inner._adapter_slots
+                and not self.inner._adapter_free
+            ):
+                raise RuntimeError(
+                    f"adapter capacity ({self.cfg.max_adapters}) exhausted"
+                )
+            _broadcast(desc, is_source=True)
+            payload = _broadcast(payload, is_source=True)
+            self.inner.load_adapter(
+                name, _payload_to_weights(self.inner, payload)
+            )
 
-    def unload_adapter(self, *a, **kw) -> bool:
-        return False
+    def unload_adapter(self, name: str) -> bool:
+        if self.inner._lora is None or name not in self.inner._adapter_slots:
+            return False
+        desc = _control_zeros()
+        desc["header"][4] = _ADAPTER_UNLOAD
+        desc["adapter_name"] = _encode_name(name)
+        with self._io_lock:
+            # Buffered admissions hold a resolved slot index; unloading
+            # now could let a subsequent load reassign that slot to a
+            # DIFFERENT adapter before the admission broadcasts —
+            # silently decoding with the wrong weights. Refuse instead.
+            with self._lock:
+                if any(
+                    a.adapter_name == name and not a.cancelled
+                    for a in self._adds
+                ):
+                    raise RuntimeError(
+                        f"adapter {name!r} has queued requests; retry after "
+                        "they admit"
+                    )
+            _broadcast(desc, is_source=True)
+            return self.inner.unload_adapter(name)
 
     def has_work(self) -> bool:
         with self._lock:
@@ -154,8 +268,17 @@ class LockstepEngine:
         on_admit=None,
     ) -> int:
         params = params or SamplingParams()
+        adapter_idx = 0
         if adapter:
-            raise KeyError(f"adapter {adapter!r} not loaded")
+            if self.inner._lora is None:
+                raise ValueError("LoRA is disabled (max_adapters=0)")
+            # Resolve to the inner slot index NOW (deterministic across
+            # processes — identical adapter-op order assigns identical
+            # slots); the descriptor ships the index.
+            slot = self.inner._adapter_slots.get(adapter)
+            if slot is None:
+                raise KeyError(f"adapter {adapter!r} not loaded")
+            adapter_idx = slot
         if len(prompt_tokens) == 0:
             raise ValueError("empty prompt")
         if len(prompt_tokens) >= self.inner.cfg.max_seq_len:
@@ -179,7 +302,12 @@ class LockstepEngine:
                 # Same contract as Engine.add_request: registration is
                 # visible before any step can emit events for this rid.
                 on_admit(rid)
-            self._adds.append(_PendingAdd(rid, list(prompt_tokens), params))
+            self._adds.append(
+                _PendingAdd(
+                    rid, list(prompt_tokens), params, adapter_idx,
+                    adapter or None,
+                )
+            )
             return rid
 
     def cancel(self, rid: int) -> bool:
@@ -236,26 +364,29 @@ class LockstepEngine:
                 len(add.tokens),
                 np.uint32(add.params.seed).view(np.int32),
                 add.params.top_k,
-                0,
+                add.adapter_idx,
                 add.params.max_tokens,
             ]
             desc["floats"][i] = [add.params.temperature, add.params.top_p]
         desc["cancels"][: len(cancels)] = cancels
 
-        out = _broadcast(desc, is_source=True)
-        tokens = None
-        if live:  # second, payload-sized collective only on admissions
-            tokens = np.zeros(
-                (MAX_ADMITS, self.inner.cfg.max_seq_len), np.int32
+        with self._io_lock:
+            out = _broadcast(desc, is_source=True)
+            tokens = None
+            if live:  # second, payload-sized collective only on admissions
+                tokens = np.zeros(
+                    (MAX_ADMITS, self.inner.cfg.max_seq_len), np.int32
+                )
+                for i, add in enumerate(live):
+                    tokens[i, : len(add.tokens)] = add.tokens
+                tokens = _broadcast(tokens, is_source=True)
+            inner_rids = _apply_descriptor(
+                self.inner, out, tokens, do_step=False
             )
-            for i, add in enumerate(live):
-                tokens[i, : len(add.tokens)] = add.tokens
-            tokens = _broadcast(tokens, is_source=True)
-        inner_rids = _apply_descriptor(self.inner, out, tokens, do_step=False)
-        with self._lock:
-            for add, inner_rid in zip(live, inner_rids):
-                self._rid_map[add.vrid] = inner_rid
-        events = self.inner.step()
+            with self._lock:
+                for add, inner_rid in zip(live, inner_rids):
+                    self._rid_map[add.vrid] = inner_rid
+            events = self.inner.step()
         # Map inner rids back to the virtual rids callers hold; prune
         # finished mappings so the table doesn't grow unboundedly.
         with self._lock:
@@ -291,7 +422,8 @@ class LockstepEngine:
         """Release the workers (they exit their loop)."""
         desc = _control_zeros()
         desc["header"][3] = 1
-        _broadcast(desc, is_source=True)
+        with self._io_lock:
+            _broadcast(desc, is_source=True)
 
 
 def _apply_descriptor(
@@ -302,9 +434,16 @@ def _apply_descriptor(
     process, by construction)."""
     n_admits = int(desc["header"][0])
     n_cancels = int(desc["header"][1])
+    # adapter_idx → name (slot assignment is deterministic, so the map
+    # is identical on every process).
+    slot_names = (
+        {v: k for k, v in engine._adapter_slots.items()}
+        if engine._lora is not None
+        else {}
+    )
     rids = []
     for i in range(n_admits):
-        plen, seed_bits, top_k, _adapter, max_tokens = (
+        plen, seed_bits, top_k, adapter_idx, max_tokens = (
             int(x) for x in desc["meta"][i]
         )
         temp, top_p = (float(x) for x in desc["floats"][i])
@@ -315,7 +454,12 @@ def _apply_descriptor(
             max_tokens=max_tokens,
             seed=int(np.int32(seed_bits).view(np.uint32)),
         )
-        rids.append(engine.add_request(list(tokens[i, :plen]), params))
+        rids.append(
+            engine.add_request(
+                list(tokens[i, :plen]), params,
+                adapter=slot_names.get(adapter_idx),
+            )
+        )
     for i in range(n_cancels):
         engine.cancel(int(desc["cancels"][i]))
     if do_step and int(desc["header"][2]):
@@ -333,6 +477,27 @@ def worker_loop(engine: Engine) -> None:
         if int(desc["header"][3]):
             logger.info("multihost worker loop: shutdown")
             return
+        adapter_op = int(desc["header"][4])
+        if adapter_op == _ADAPTER_LOAD:
+            payload = _broadcast(
+                _lora_payload_zeros(engine), is_source=False
+            )
+            # Host 0 validated capacity before broadcasting; a local
+            # failure here means state divergence — log loudly but keep
+            # the loop alive (a dead worker hangs the whole slice's next
+            # collective).
+            try:
+                engine.load_adapter(
+                    _decode_name(desc["adapter_name"]),
+                    _payload_to_weights(engine, payload),
+                )
+            except Exception:
+                logger.exception("lockstep adapter load failed on worker")
+        elif adapter_op == _ADAPTER_UNLOAD:
+            try:
+                engine.unload_adapter(_decode_name(desc["adapter_name"]))
+            except Exception:
+                logger.exception("lockstep adapter unload failed on worker")
         tokens = None
         if int(desc["header"][0]):
             tokens = _broadcast(

@@ -760,8 +760,9 @@ def main(argv=None) -> int:
         cfg=EngineConfig(
             num_slots=args.num_slots,
             max_seq_len=args.max_seq_len,
-            # LoRA hot-swap is not lockstep yet (engine/multihost.py).
-            max_adapters=0 if multihost else args.max_adapters,
+            # LoRA is lockstep on multihost: host 0 broadcasts adapter
+            # weights to every process (engine/multihost.py).
+            max_adapters=args.max_adapters,
             decode_chunk=args.decode_chunk,
             pipeline=args.pipeline,
             quantization=args.quantization,

@@ -33,11 +33,22 @@ _WORKER = textwrap.dedent(
         MeshConfig(dp=2, sp=1, tp=4), devices=jax.devices()
     )  # GLOBAL 8-device mesh spanning both processes
     ecfg = EngineConfig(num_slots=4, max_seq_len=64, page_size=16,
-                        decode_chunk=4)
+                        decode_chunk=4, max_adapters=1)
     eng = Engine("llama", cfg, params, mesh=mesh, cfg=ecfg)
 
     prompts = [[1, 2, 3, 4], [9, 8, 7], [5, 6]]
     sp = SamplingParams(temperature=0.8, top_k=16, max_tokens=8, seed=42)
+
+    # Deterministic synthetic adapter (same on every process — the
+    # oracle installs it directly; lockstep ships it over broadcast).
+    arng = np.random.default_rng(5)
+    A = 0.5 * arng.standard_normal(
+        (cfg.num_layers, cfg.hidden_size, 4)).astype("float32")
+    Bm = 0.5 * arng.standard_normal(
+        (cfg.num_layers, 4, cfg.num_heads * cfg.head_size)).astype("float32")
+    adapter_weights = {"wq": (A, Bm)}
+    lora_prompt = [2, 4, 6, 8]
+    lsp = SamplingParams(temperature=0.0, max_tokens=8)
 
     if pid == 0:
         from kubeai_tpu.engine.multihost import LockstepEngine
@@ -53,9 +64,23 @@ _WORKER = textwrap.dedent(
         ls.cancel(rid)
         while ls.has_work():
             ls.step()
+        # LoRA lockstep: install over broadcast, decode with it, then a
+        # base-model request to prove slot 0 stays clean.
+        ls.load_adapter("fin", adapter_weights)
+        lrid = ls.add_request(lora_prompt, lsp, adapter="fin")
+        lora_toks = []
+        while ls.has_work():
+            lora_toks += [e.token for e in ls.step() if e.rid == lrid]
+        base_toks = []
+        brid = ls.add_request(lora_prompt, lsp)
+        while ls.has_work():
+            base_toks += [e.token for e in ls.step() if e.rid == brid]
+        assert ls.unload_adapter("fin")
         ls.shutdown()
         print("LOCKSTEP-OUTS", outs)
         print("LOCKSTEP-CANCEL-TOKENS", len(got))
+        print("LOCKSTEP-LORA", lora_toks)
+        print("LOCKSTEP-BASE", base_toks)
     else:
         from kubeai_tpu.engine.multihost import worker_loop
 
@@ -68,8 +93,13 @@ _WORKER = textwrap.dedent(
     # match it exactly: same mesh numerics, same seeds, same rid order.
     ref = Engine("llama", cfg, params, mesh=mesh, cfg=ecfg)
     ref_outs = ref.generate(prompts, sp)
+    ref.load_adapter("fin", adapter_weights)
+    ref_lora = ref.generate([lora_prompt], lsp, adapter="fin")[0]
+    ref_base = ref.generate([lora_prompt], lsp)[0]
     if pid == 0:
         print("REF-OUTS", ref_outs)
+        print("REF-LORA", ref_lora)
+        print("REF-BASE", ref_base)
     print(f"PROC-{pid}-OK")
     """
 )
@@ -122,3 +152,8 @@ def test_lockstep_serving_two_processes(tmp_path):
         if ln.startswith("LOCKSTEP-CANCEL-TOKENS")
     )
     assert int(cancel_line.rsplit(" ", 1)[1]) == 9
+    # LoRA over lockstep broadcast == direct install on every process,
+    # and the adapter genuinely changes the stream vs the base model.
+    assert grab("LOCKSTEP-LORA") == grab("REF-LORA")
+    assert grab("LOCKSTEP-BASE") == grab("REF-BASE")
+    assert grab("LOCKSTEP-LORA") != grab("LOCKSTEP-BASE")
