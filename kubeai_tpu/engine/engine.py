@@ -1510,6 +1510,14 @@ class Engine:
         with self._lock:
             if name in self._adapter_slots:
                 slot = self._adapter_slots[name]
+                if self._adapter_in_use_locked(slot):
+                    # Overwriting the slot would flip in-flight streams to
+                    # the new weight version mid-generation — same hazard
+                    # unload_adapter refuses.
+                    raise RuntimeError(
+                        f"adapter {name!r} has in-flight requests; retry "
+                        "after they finish"
+                    )
             else:
                 if not self._adapter_free:
                     raise RuntimeError(
@@ -1529,21 +1537,49 @@ class Engine:
                     )
                 bufA = self._lora[target]["A"]
                 bufB = self._lora[target]["B"]
-                padA = jnp.zeros(bufA.shape[1:], bufA.dtype).at[
-                    ..., :r
-                ].set(A.astype(bufA.dtype))
-                padB = jnp.zeros(bufB.shape[1:], bufB.dtype).at[
-                    :, :r, :
-                ].set(B.astype(bufB.dtype))
+                if r == r_max:
+                    # Already slot-shaped (e.g. the lockstep broadcast
+                    # payload pads to r_max before shipping).
+                    padA = A.astype(bufA.dtype)
+                    padB = B.astype(bufB.dtype)
+                else:
+                    padA = jnp.zeros(bufA.shape[1:], bufA.dtype).at[
+                        ..., :r
+                    ].set(A.astype(bufA.dtype))
+                    padB = jnp.zeros(bufB.shape[1:], bufB.dtype).at[
+                        :, :r, :
+                    ].set(B.astype(bufB.dtype))
                 self._lora[target]["A"] = bufA.at[slot].set(padA)
                 self._lora[target]["B"] = bufB.at[slot].set(padB)
             self._adapter_slots[name] = slot
+
+    def _adapter_in_use_locked(self, slot: int) -> bool:
+        """True when any pending/active request references the adapter
+        slot. Caller holds self._lock (step() holds it for its whole
+        body, so mid-admission requests can't be missed). Shared by the
+        load/unload guards here and LockstepEngine's pre-broadcast
+        mirror."""
+        return any(
+            r.adapter_idx == slot for r in self._pending
+        ) or any(r.adapter_idx == slot for r in self._active.values())
 
     def unload_adapter(self, name: str) -> bool:
         if self._lora is None or name not in self._adapter_slots:
             return False
         with self._lock:
-            slot = self._adapter_slots.pop(name)
+            slot = self._adapter_slots.get(name)
+            if slot is None:
+                return False
+            # Refuse while any request still decodes (or waits to decode)
+            # with this adapter: zeroing the slot would silently flip the
+            # stream to base-model output, and a subsequent load could
+            # reassign the slot to a DIFFERENT adapter mid-stream.
+            if self._adapter_in_use_locked(slot):
+                raise RuntimeError(
+                    f"adapter {name!r} has in-flight requests; retry after "
+                    "they finish"
+                )
+            del self._adapter_slots[name]
             for target in self._lora:
                 bufA = self._lora[target]["A"]
                 bufB = self._lora[target]["B"]

@@ -227,6 +227,15 @@ class LockstepEngine:
                 raise RuntimeError(
                     f"adapter capacity ({self.cfg.max_adapters}) exhausted"
                 )
+            slot = self.inner._adapter_slots.get(name)
+            if slot is not None and self.inner._adapter_in_use_locked(slot):
+                # Same-name reload would overwrite weights under in-flight
+                # streams; refuse BEFORE the broadcast (pre-broadcast
+                # mirror of Engine.load_adapter's guard).
+                raise RuntimeError(
+                    f"adapter {name!r} has in-flight requests; retry "
+                    "after they finish"
+                )
             _broadcast(desc, is_source=True)
             payload = _broadcast(payload, is_source=True)
             self.inner.load_adapter(
@@ -244,6 +253,10 @@ class LockstepEngine:
             # now could let a subsequent load reassign that slot to a
             # DIFFERENT adapter before the admission broadcasts —
             # silently decoding with the wrong weights. Refuse instead.
+            # _lock is held across the guard AND the broadcast+unload so
+            # add_request can't resolve the slot in between; _io_lock is
+            # held by step() across its _adds pop, so a popped-but-not-
+            # yet-broadcast batch can't slip past the scan either.
             with self._lock:
                 if any(
                     a.adapter_name == name and not a.cancelled
@@ -253,8 +266,20 @@ class LockstepEngine:
                         f"adapter {name!r} has queued requests; retry after "
                         "they admit"
                     )
-            _broadcast(desc, is_source=True)
-            return self.inner.unload_adapter(name)
+                slot = self.inner._adapter_slots.get(name)
+                if slot is not None and self.inner._adapter_in_use_locked(
+                    slot
+                ):
+                    # Pre-broadcast mirror of Engine.unload_adapter's
+                    # in-use refusal: raising AFTER the broadcast would
+                    # leave every process refusing identically (states
+                    # stay consistent) but wastes a collective round.
+                    raise RuntimeError(
+                        f"adapter {name!r} has in-flight requests; retry "
+                        "after they finish"
+                    )
+                _broadcast(desc, is_source=True)
+                return self.inner.unload_adapter(name)
 
     def has_work(self) -> bool:
         with self._lock:
@@ -268,17 +293,8 @@ class LockstepEngine:
         on_admit=None,
     ) -> int:
         params = params or SamplingParams()
-        adapter_idx = 0
-        if adapter:
-            if self.inner._lora is None:
-                raise ValueError("LoRA is disabled (max_adapters=0)")
-            # Resolve to the inner slot index NOW (deterministic across
-            # processes — identical adapter-op order assigns identical
-            # slots); the descriptor ships the index.
-            slot = self.inner._adapter_slots.get(adapter)
-            if slot is None:
-                raise KeyError(f"adapter {adapter!r} not loaded")
-            adapter_idx = slot
+        if adapter and self.inner._lora is None:
+            raise ValueError("LoRA is disabled (max_adapters=0)")
         if len(prompt_tokens) == 0:
             raise ValueError("empty prompt")
         if len(prompt_tokens) >= self.inner.cfg.max_seq_len:
@@ -296,6 +312,20 @@ class LockstepEngine:
         )
         params = dataclasses.replace(params, seed=seed & 0xFFFFFFFF)
         with self._lock:
+            # Resolve to the inner slot index under _lock so it serializes
+            # with unload_adapter (which buys _lock for its entire
+            # guard→broadcast→unload sequence): either this admission is
+            # appended first (the unload guard sees it and refuses) or the
+            # unload completes first (the adapter is gone and we raise).
+            # The index is deterministic across processes — identical
+            # adapter-op order assigns identical slots; the descriptor
+            # ships the index.
+            adapter_idx = 0
+            if adapter:
+                slot = self.inner._adapter_slots.get(adapter)
+                if slot is None:
+                    raise KeyError(f"adapter {adapter!r} not loaded")
+                adapter_idx = slot
             rid = self._next_virtual_rid
             self._next_virtual_rid += 1
             if on_admit is not None:
@@ -334,7 +364,17 @@ class LockstepEngine:
             return True
 
     def step(self) -> list[StepEvent]:
-        """One lockstep iteration: broadcast buffered ops, apply, step."""
+        """One lockstep iteration: broadcast buffered ops, apply, step.
+
+        _io_lock is held from BEFORE the _adds pop: once an admission
+        batch leaves the buffer its resolved adapter indices must stay
+        valid until they broadcast, and unload_adapter (which serializes
+        on _io_lock) could otherwise free a slot in that window after
+        its buffered-admission scan found _adds already empty."""
+        with self._io_lock:
+            return self._step_locked()
+
+    def _step_locked(self) -> list[StepEvent]:
         with self._lock:
             # Resolve cancels that raced the previous step's broadcast
             # window: by now (single stepping thread) their rids are

@@ -612,7 +612,13 @@ class EngineServer:
         name = body.get("lora_name")
         if not name:
             return http._json(400, {"error": {"message": "lora_name required"}})
-        if self.engine.unload_adapter(name):
+        try:
+            ok = self.engine.unload_adapter(name)
+        except RuntimeError as e:
+            # In-flight requests still decode with this adapter; the
+            # caller (operator adapter reconcile) retries after drain.
+            return http._json(409, {"error": {"message": str(e)}})
+        if ok:
             return http._json(200, {"status": "unloaded", "lora_name": name})
         return http._json(404, {"error": {"message": f"adapter {name} not found"}})
 

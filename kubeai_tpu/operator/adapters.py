@@ -18,7 +18,7 @@ from typing import Protocol
 from kubeai_tpu.crd import metadata as md
 from kubeai_tpu.crd.model import Adapter, Model, ENGINE_KUBEAI_TPU, ENGINE_VLLM
 from kubeai_tpu.operator import k8sutils
-from kubeai_tpu.operator.engine_client import EngineClient
+from kubeai_tpu.operator.engine_client import EngineClient, EngineClientError
 from kubeai_tpu.operator.k8s.store import KubeStore
 
 LOADER_CONTAINER = "loader"
@@ -82,6 +82,19 @@ def reconcile_adapters(
             else:
                 to_ensure.append(adapter)
         to_remove = list(candidates.keys())
+        # Engine state is the removal source of truth, not labels: labels
+        # are removed before unload (drain ordering below), so an unload
+        # the engine refused with 409 (in-flight requests) must be found
+        # again on the requeue — by then its label is already gone.
+        try:
+            spec_names = {a.name for a in adapters}
+            for name in engine_client.list_lora_adapters(
+                addr, model.name
+            ):
+                if name not in spec_names and name not in to_remove:
+                    to_remove.append(name)
+        except EngineClientError:
+            pass  # listing is best-effort; label diff still drives removal
 
         for adapter in to_ensure:
             if engine == ENGINE_VLLM:
@@ -116,8 +129,12 @@ def reconcile_adapters(
             )
 
         for name in to_remove:
-            engine_client.unload_lora_adapter(addr, name, ignore_not_found=True)
+            # Label FIRST: the LB stops routing adapter traffic to this
+            # Pod, in-flight requests drain, and the engine's 409
+            # in-use refusal (if any) resolves on the backoff requeue —
+            # unload-first would livelock under sustained traffic.
             _remove_pod_label(store, pod, md.adapter_label(name))
+            engine_client.unload_lora_adapter(addr, name, ignore_not_found=True)
 
 
 def _update_pod_label(store: KubeStore, pod: dict, key: str, value: str) -> None:

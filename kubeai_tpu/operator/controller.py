@@ -272,6 +272,8 @@ class ControllerLoop:
         self._queue: "queue.Queue[tuple[str, str] | None]" = queue.Queue()
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # (ns, name) -> consecutive reconcile failures (backoff exponent).
+        self._failures: dict[tuple[str, str], int] = {}
 
     def start(self) -> None:
         self._threads = [
@@ -337,7 +339,27 @@ class ControllerLoop:
                 self._queue.put(p)
             try:
                 self.reconciler.reconcile(ns, name)
+                self._failures.pop((ns, name), None)
             except Exception:
                 logger.error(
                     "reconcile %s/%s failed:\n%s", ns, name, traceback.format_exc()
                 )
+                self._requeue_after_backoff(ns, name)
+
+    def _requeue_after_backoff(self, ns: str, name: str) -> None:
+        """Failed reconciles retry with exponential backoff instead of
+        waiting for the next watch event (which may never come — e.g. an
+        engine 409 while adapter requests drain). Parity with
+        controller-runtime's requeue-on-error semantics (the reference's
+        Reconcile returns err → backoff requeue)."""
+        n = self._failures.get((ns, name), 0)
+        self._failures[(ns, name)] = n + 1
+        delay = min(30.0, 0.5 * (2.0 ** n))
+
+        def _put():
+            if not self._stop.is_set():
+                self._queue.put((ns, name))
+
+        t = threading.Timer(delay, _put)
+        t.daemon = True
+        t.start()

@@ -40,6 +40,23 @@ class EngineClient:
         except OSError as e:
             raise EngineClientError(f"POST {url}: {e}") from e
 
+    def list_lora_adapters(self, addr: str, served_model_name: str) -> list[str]:
+        """Adapters the engine actually has loaded (GET /v1/models minus
+        the base model id). Lets the reconciler unload adapters whose Pod
+        label is already gone — labels are removed BEFORE unload so the
+        LB drains traffic first, and a 409-refused unload must still be
+        retried from engine state, not label state."""
+        req = urllib.request.Request(f"{addr}/v1/models")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                body = json.loads(resp.read().decode(errors="replace"))
+        except (OSError, ValueError) as e:
+            raise EngineClientError(f"GET {addr}/v1/models: {e}") from e
+        return [
+            m["id"] for m in body.get("data", [])
+            if m.get("id") and m["id"] != served_model_name
+        ]
+
     def load_lora_adapter(
         self,
         addr: str,

@@ -24,6 +24,14 @@ class FakeEngineClient:
     def unload_lora_adapter(self, addr, lora_name, ignore_not_found=False):
         self.unloaded.append((addr, lora_name))
 
+    def list_lora_adapters(self, addr, served_model_name):
+        # Mirror engine state: everything loaded minus everything unloaded.
+        gone = {(a, n) for a, n in self.unloaded}
+        return [
+            n for a, n, _ in self.loaded
+            if a == addr and (a, n) not in gone
+        ]
+
 
 @pytest.fixture
 def world():
@@ -237,6 +245,53 @@ def test_adapter_reconcile_loads_and_labels(world):
     pod = model_pods(store, "m4")[0]
     assert pod["metadata"]["name"] == pod_name  # same pod, no rollout
     assert md.adapter_label("fin") not in (pod["metadata"].get("labels") or {})
+
+
+def test_adapter_unload_retries_from_engine_state_after_409(world):
+    """Label removal happens BEFORE unload (drains LB traffic); if the
+    engine refuses with 409 (in-flight requests), the retry must rediscover
+    the adapter from engine state — its label is already gone."""
+    from kubeai_tpu.operator.engine_client import EngineClientError
+
+    store, _, rec, ec = world
+    mk_model(
+        store,
+        name="m409",
+        replicas=1,
+        adapters=[Adapter(name="fin", url="hf://org/fin-lora")],
+    )
+    rec.reconcile("default", "m409")
+    pod = model_pods(store, "m409")[0]
+    mark_ready(store, pod, ip="10.9.9.9")
+    rec.reconcile("default", "m409")
+    assert ec.loaded
+
+    refusals = {"n": 0}
+    real_unload = ec.unload_lora_adapter
+
+    def refusing_unload(addr, lora_name, ignore_not_found=False):
+        if refusals["n"] == 0:
+            refusals["n"] += 1
+            raise EngineClientError("HTTP 409: adapter has in-flight requests")
+        return real_unload(addr, lora_name, ignore_not_found=ignore_not_found)
+
+    ec.unload_lora_adapter = refusing_unload
+
+    m = store.get("Model", "default", "m409")
+    m["spec"]["adapters"] = []
+    store.update(m)
+    # First reconcile: label removed, unload refused (reconcile raises —
+    # the ControllerLoop requeues on this).
+    with pytest.raises(EngineClientError):
+        rec.reconcile("default", "m409")
+    pod = model_pods(store, "m409")[0]
+    assert md.adapter_label("fin") not in (pod["metadata"].get("labels") or {})
+    assert ec.unloaded == []  # engine still has it loaded
+
+    # Requeue retry: no label left, but list_lora_adapters still reports
+    # 'fin' → unload retried and succeeds.
+    rec.reconcile("default", "m409")
+    assert ec.unloaded == [("http://10.9.9.9:8000", "fin")]
 
 
 def test_address_override_annotations_flow_to_pod(world):

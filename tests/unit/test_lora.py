@@ -95,6 +95,35 @@ def test_unload_and_capacity(setup):
         eng.add_request([1, 2], GREEDY, adapter="ghost")
 
 
+def test_unload_refuses_while_in_flight(setup):
+    """Unloading an adapter with pending/active requests must refuse:
+    zeroing the slot mid-stream would silently flip the request to
+    base-model output (or, after a reload, another adapter's weights)."""
+    cfg, params, A, B = setup
+    eng = Engine(
+        "llama", cfg, params,
+        cfg=EngineConfig(num_slots=2, max_seq_len=64, max_adapters=1,
+                         max_lora_rank=8),
+    )
+    eng.load_adapter("fin", {"wq": (A, B)})
+    # max_tokens spans several decode chunks so the request is still
+    # active after the first step() (GREEDY's 6 fit in one chunk).
+    rid = eng.add_request(
+        [1, 2, 3], SamplingParams(temperature=0.0, max_tokens=40),
+        adapter="fin",
+    )
+    # Queued (pending) — refuse.
+    with pytest.raises(RuntimeError, match="in-flight"):
+        eng.unload_adapter("fin")
+    eng.step()  # admits + starts decoding — still refuse.
+    with pytest.raises(RuntimeError, match="in-flight"):
+        eng.unload_adapter("fin")
+    while eng.has_work():
+        eng.step()
+    assert eng.unload_adapter("fin")  # drained — now fine
+    assert rid is not None
+
+
 def test_lora_disabled_rejects_adapters(setup):
     cfg, params, A, B = setup
     eng = Engine("llama", cfg, params, cfg=EngineConfig(num_slots=2, max_seq_len=64))
