@@ -27,6 +27,10 @@ POD_GROUP_LABEL = "model-group-index"
 POD_HOST_LABEL = "model-host-index"
 
 ADAPTER_LABEL_DOMAIN = "adapter.kubeai.org"
+# Comma-separated adapter names whose routing label was removed but whose
+# engine unload hasn't succeeded yet (409 while requests drain). Keeps the
+# orphan discoverable across reconciles without querying every engine.
+ADAPTER_PENDING_UNLOAD_ANNOTATION = "adapter.kubeai.org/pending-unload"
 
 
 def adapter_label(adapter_id: str) -> str:

@@ -109,6 +109,12 @@ class EngineConfig:
     # with a second donated-buffer program in flight behind a pending
     # fetch; direct PJRT targets can enable it safely.
     pipeline: bool = False
+    # Pipeline parallelism (mesh pp axis > 1): decode microbatch count for
+    # the GPipe schedule. 0 = the pp stage count (steady-state utilization
+    # M/(M+P-1); raise toward num_slots for higher utilization at smaller
+    # per-tick batches). Requires a family with decode_step_paged_pp,
+    # paged cache mode, tp == sp == 1, and num_slots % M == 0.
+    pp_microbatches: int = 0
 
     def buckets(self) -> tuple[int, ...]:
         if self.prefill_buckets:
@@ -194,6 +200,54 @@ class Engine:
         self._seed_base = int.from_bytes(np.random.bytes(4), "little")
         self._steps = 0
 
+        # Resolve the cache mode: paged needs family support; otherwise
+        # fall back to the slot cache. Chunked prefill works in both modes
+        # (paged stages chunks in a one-slot buffer, then scatters).
+        self.cache_mode = cfg.cache_mode
+        self._spec = 0  # resolved speculation window (see below)
+        if cfg.cache_mode == "paged" and (
+            getattr(self.family, "decode_step_paged", None) is None
+        ):
+            self.cache_mode = "slot"
+        elif cfg.cache_mode not in ("paged", "slot"):
+            raise ValueError(f"unknown cache_mode {cfg.cache_mode!r}")
+
+        # Pipeline parallelism: stage-local layers + KV over the pp mesh
+        # axis (GPipe microbatched decode; see models/llama.py
+        # decode_step_paged_pp). v1 scope: paged cache, llama-family,
+        # pp composes with dp only.
+        self._pp = self.mesh.shape.get("pp", 1)
+        self._pp_microbatches = 0
+        if self._pp > 1:
+            if getattr(self.family, "decode_step_paged_pp", None) is None:
+                raise ValueError(
+                    f"family {self.family.name} does not support pipeline "
+                    "parallelism (no decode_step_paged_pp)"
+                )
+            if self.cache_mode != "paged":
+                raise ValueError("pipeline parallelism requires cache_mode='paged'")
+            if self.mesh.shape.get("tp", 1) != 1 or self.mesh.shape.get("sp", 1) != 1:
+                raise ValueError(
+                    "pipeline parallelism currently composes with dp only "
+                    "(tp and sp mesh axes must be 1)"
+                )
+            if cfg.quantization:
+                raise ValueError(
+                    "pipeline parallelism with quantization is not supported yet"
+                )
+            if model_cfg.num_layers % self._pp:
+                raise ValueError(
+                    f"{model_cfg.num_layers} layers not divisible by "
+                    f"pp={self._pp} stages"
+                )
+            m = cfg.pp_microbatches or self._pp
+            if cfg.num_slots % m:
+                raise ValueError(
+                    f"num_slots={cfg.num_slots} not divisible by "
+                    f"pp_microbatches={m}"
+                )
+            self._pp_microbatches = m
+
         # Quantize (optional), then shard params onto the mesh.
         specs = self.family.param_specs(model_cfg)
         if cfg.quantization == "int8":
@@ -220,17 +274,6 @@ class Engine:
                     for name, phys in rules.rules
                 )
             )
-        # Resolve the cache mode: paged needs family support; otherwise
-        # fall back to the slot cache. Chunked prefill works in both modes
-        # (paged stages chunks in a one-slot buffer, then scatters).
-        self.cache_mode = cfg.cache_mode
-        self._spec = 0  # resolved speculation window (see below)
-        if cfg.cache_mode == "paged" and (
-            getattr(self.family, "decode_step_paged", None) is None
-        ):
-            self.cache_mode = "slot"
-        elif cfg.cache_mode not in ("paged", "slot"):
-            raise ValueError(f"unknown cache_mode {cfg.cache_mode!r}")
 
         if self.cache_mode == "paged":
             from kubeai_tpu.engine.paged_cache import PageAllocator, PagedKVCache
@@ -238,9 +281,12 @@ class Engine:
             n_pages = cfg.effective_num_pages()
             max_pages = -(-cfg.max_seq_len // cfg.page_size)
             # Pages replicated across dp (page ids are global); KV heads on
-            # tp exactly like the slot cache.
+            # tp exactly like the slot cache; the layer axis shards over
+            # pp so each pipeline stage holds only its own layers' pages.
             pool_sharding = psh.named_sharding(
-                self.mesh, (None, None, None, psh.KV_HEADS, None), cache_rules
+                self.mesh,
+                (psh.LAYERS, None, None, psh.KV_HEADS, None),
+                cache_rules,
             )
             if n_pages - 1 < max_pages:
                 raise ValueError(
@@ -368,6 +414,7 @@ class Engine:
                 self.cache_mode == "paged"
                 and getattr(self.family, "decode_verify_paged", None)
                 is not None
+                and self._pp == 1  # verify kernel is not pp-staged
             ):
                 self._spec = cfg.speculate
             else:
@@ -574,7 +621,16 @@ class Engine:
         max_len = self.cfg.max_seq_len
         chunk = max(1, self.cfg.decode_chunk)
         page = self.cfg.page_size
-        decode_paged = fam.decode_step_paged
+        if self._pp > 1:
+            from functools import partial as _partial
+
+            decode_paged = _partial(
+                fam.decode_step_paged_pp,
+                mesh=self.mesh,
+                microbatches=self._pp_microbatches,
+            )
+        else:
+            decode_paged = fam.decode_step_paged
 
         def _prefill_admit(
             params, tokens, ints, floats, bt_rows, kp, vp, bt, state, lora

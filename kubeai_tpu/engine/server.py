@@ -80,6 +80,9 @@ class EngineServer:
         self.served_model_name = served_model_name
         self.metrics = EngineMetrics()
         self.adapter_fetcher = adapter_fetcher
+        # Adapter name -> source path/url it was loaded from. A load for a
+        # name whose source CHANGED reloads instead of short-circuiting.
+        self._adapter_sources: dict[str, str] = {}
         self.max_queue = max_queue
         self.request_timeout = request_timeout
         self._subscribers: dict[int, queue.Queue] = {}
@@ -587,11 +590,16 @@ class EngineServer:
         name = body.get("lora_name")
         if not name:
             return http._json(400, {"error": {"message": "lora_name required"}})
-        if name in self.engine.loaded_adapters():
-            return http._json(
-                200, {"status": "already loaded", "lora_name": name}
-            )
         path_or_url = body.get("lora_path") or body.get("lora_url") or ""
+        if name in self.engine.loaded_adapters():
+            # Idempotent only for the SAME source: a changed path/url means
+            # the adapter was updated (the operator re-sends on URL-hash
+            # change) and must actually reload — short-circuiting here
+            # would silently keep serving stale weights forever.
+            if self._adapter_sources.get(name) == path_or_url:
+                return http._json(
+                    200, {"status": "already loaded", "lora_name": name}
+                )
         try:
             if self.adapter_fetcher is not None:
                 weights = self.adapter_fetcher(name, path_or_url)
@@ -603,9 +611,17 @@ class EngineServer:
                     max_rank=self.engine.cfg.max_lora_rank,
                 )
             self.engine.load_adapter(name, weights)
+        except RuntimeError as e:
+            if "in-flight" in str(e):
+                # Reload refused while requests decode with the old
+                # version; the operator's backoff requeue retries.
+                return http._json(409, {"error": {"message": str(e)}})
+            logger.exception("adapter load failed")
+            return http._json(400, {"error": {"message": str(e)}})
         except Exception as e:
             logger.exception("adapter load failed")
             return http._json(400, {"error": {"message": str(e)}})
+        self._adapter_sources[name] = path_or_url
         return http._json(200, {"status": "loaded", "lora_name": name})
 
     def _handle_unload_adapter(self, http, body: dict):
@@ -619,6 +635,7 @@ class EngineServer:
             # caller (operator adapter reconcile) retries after drain.
             return http._json(409, {"error": {"message": str(e)}})
         if ok:
+            self._adapter_sources.pop(name, None)
             return http._json(200, {"status": "unloaded", "lora_name": name})
         return http._json(404, {"error": {"message": f"adapter {name} not found"}})
 

@@ -19,6 +19,7 @@ TP is the `tp` mesh axis and XLA's collectives, not an engine flag.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Any
 
 import jax
@@ -111,8 +112,9 @@ class LlamaConfig:
 
 
 def param_specs(cfg: LlamaConfig) -> dict:
-    """Logical sharding axes per parameter (leading None = stacked layers)."""
-    L = None  # layer axis: replicated across the mesh
+    """Logical sharding axes per parameter (leading axis = stacked layers,
+    sharded over the pp mesh axis when it exists — replicated otherwise)."""
+    L = sh.LAYERS
     layers = {
         "input_norm": (L, sh.EMBED),
         "wq": (L, sh.EMBED, sh.HEADS),
@@ -412,6 +414,52 @@ def decode_step(
     return logits, k_cache, v_cache
 
 
+def _paged_decode_layer(
+    x, scanned, cfg, inv_freq, msc, positions, lengths,
+    page_ids, offsets, block_tables, lora_idx,
+):
+    """One decode layer against per-layer page pools: project, rope,
+    scatter the new token's K/V through the block tables, attend over
+    resident pages, MLP. Shared by decode_step_paged (lax.scan over the
+    full stack) and decode_step_paged_pp (stage-local scan inside the
+    GPipe shard_map) so the two paths cannot drift numerically."""
+    from kubeai_tpu.ops.paged_attention import (
+        paged_decode_attention,
+        scatter_decode_token,
+    )
+
+    B = x.shape[0]
+    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    lp = scanned["p"]
+    lor = scanned.get("l")
+    kp, vp = scanned["kp"], scanned["vp"]
+    pos1 = positions[:, None]
+
+    def proj(h, w, target, bias=None):
+        out = jnp.einsum("be,eh->bh", h, _w(w))
+        if bias is not None:
+            out = out + bias
+        if lor is not None:
+            out = out + _lora_delta(
+                h, lor[target]["A"], lor[target]["B"], lora_idx
+            )
+        return out
+
+    h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+    q = proj(h, lp["wq"], "wq", lp.get("bq")).reshape(B, 1, H, D)
+    k = proj(h, lp["wk"], "wk", lp.get("bk")).reshape(B, 1, KVH, D)
+    v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, 1, KVH, D)
+    q = apply_rope(q, pos1, inv_freq, msc)[:, 0]  # [B, H, D]
+    k = apply_rope(k, pos1, inv_freq, msc)[:, 0]  # [B, KVH, D]
+    v = v[:, 0]
+    kp, vp = scatter_decode_token(kp, vp, k, v, page_ids, offsets)
+    attn = paged_decode_attention(q, kp, vp, block_tables, lengths)
+    x = x + proj(attn.reshape(B, H * D), lp["wo"], "wo")
+    h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+    x = x + _mlp(h2[:, None], lp["w_gate"], lp["w_up"], lp["w_down"])[:, 0]
+    return x, (kp, vp)
+
+
 def decode_step_paged(
     params: dict,
     cfg: LlamaConfig,
@@ -428,13 +476,83 @@ def decode_step_paged(
     pages (Pallas kernel on TPU; gather reference elsewhere). HBM traffic
     per step is O(sum of true lengths), not O(B * max_seq_len) — the
     reason paging beats the slot cache under mixed-length batches."""
-    from kubeai_tpu.ops.paged_attention import (
-        paged_decode_attention,
-        scatter_decode_token,
-        token_page_coords,
-    )
+    from kubeai_tpu.ops.paged_attention import token_page_coords
 
     B = tokens.shape[0]
+    page_size = k_pages.shape[2]
+    inv_freq = jnp.asarray(
+        rope_frequencies(
+            cfg.head_size, cfg.rope_theta, cfg.rope_scaling,
+            cfg.max_position_embeddings,
+        )
+    )
+    msc = rope_attention_scaling(cfg.rope_scaling)
+    x = params["embed"][tokens]  # [B, E]
+    lengths = positions + 1
+    page_ids, offsets = token_page_coords(block_tables, positions, page_size)
+
+    def layer(carry, scanned):
+        return _paged_decode_layer(
+            carry, scanned, cfg, inv_freq, msc, positions, lengths,
+            page_ids, offsets, block_tables, lora_idx,
+        )
+
+    xs = _scan_xs(params, lora)
+    xs["kp"] = k_pages
+    xs["vp"] = v_pages
+    x, (k_pages, v_pages) = jax.lax.scan(layer, x, xs)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = jnp.einsum(
+        "be,ve->bv", x, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
+    return logits, k_pages, v_pages
+
+
+def decode_step_paged_pp(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [B] one token per slot
+    positions: jnp.ndarray,  # [B]
+    k_pages: jnp.ndarray,  # [NL, P, page, KVH, D], layer axis sharded on pp
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, MP]
+    lora: dict | None = None,
+    lora_idx: jnp.ndarray | None = None,
+    *,
+    mesh,
+    microbatches: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pipeline-parallel paged decode: GPipe microbatching over the pp
+    mesh axis with STAGE-LOCAL KV. Stage s owns layers [s*NL/P, (s+1)*NL/P)
+    — both their weights and their page pools (the [NL, ...] leading axis
+    of params["layers"] and the pools shards over pp, see param_specs /
+    Engine pool_sharding) — so cache reads/writes never cross stages;
+    only [mb, E] activations hop stage-to-stage via ppermute.
+
+    Numerics are identical to decode_step_paged (tested): same per-layer
+    math, same scatter-before-attend ordering per microbatch; off-schedule
+    ticks compute on clamped duplicate microbatches and their cache writes
+    are redirected to reserved scratch page 0 (the same sink
+    token_page_coords uses for unallocated entries).
+
+    The reference has no PP anywhere (engines are single-Pod opaque,
+    internal/modelcontroller/pod_plan.go:28-156); SURVEY §2's
+    TPU-equivalents list makes PP for >8B this repo's obligation.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from kubeai_tpu.ops.paged_attention import token_page_coords
+    from kubeai_tpu.parallel.mesh import AXIS_PIPELINE
+
+    B = tokens.shape[0]
+    M = microbatches
+    if M < 1 or B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    n_stages = mesh.shape[AXIS_PIPELINE]
+    NL = k_pages.shape[0]
+    if NL % n_stages:
+        raise ValueError(f"{NL} layers not divisible by {n_stages} pp stages")
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
     page_size = k_pages.shape[2]
     inv_freq = jnp.asarray(
@@ -444,45 +562,93 @@ def decode_step_paged(
         )
     )
     msc = rope_attention_scaling(cfg.rope_scaling)
-    x = params["embed"][tokens]  # [B, E]
-    pos1 = positions[:, None]
     lengths = positions + 1
     page_ids, offsets = token_page_coords(block_tables, positions, page_size)
+    if lora_idx is None:
+        lora_idx = jnp.zeros((B,), jnp.int32)
 
-    def layer(carry, scanned):
-        x = carry
-        lp = scanned["p"]
-        lor = scanned.get("l")
-        kp, vp = scanned["kp"], scanned["vp"]
+    mb = B // M
 
-        def proj(h, w, target, bias=None):
-            out = jnp.einsum("be,eh->bh", h, _w(w))
-            if bias is not None:
-                out = out + bias
-            if lor is not None:
-                out = out + _lora_delta(
-                    h, lor[target]["A"], lor[target]["B"], lora_idx
-                )
-            return out
+    def mbt(a):
+        return a.reshape(M, mb, *a.shape[1:])
 
-        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-        q = proj(h, lp["wq"], "wq", lp.get("bq")).reshape(B, 1, H, D)
-        k = proj(h, lp["wk"], "wk", lp.get("bk")).reshape(B, 1, KVH, D)
-        v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, 1, KVH, D)
-        q = apply_rope(q, pos1, inv_freq, msc)[:, 0]  # [B, H, D]
-        k = apply_rope(k, pos1, inv_freq, msc)[:, 0]  # [B, KVH, D]
-        v = v[:, 0]
-        kp, vp = scatter_decode_token(kp, vp, k, v, page_ids, offsets)
-        attn = paged_decode_attention(q, kp, vp, block_tables, lengths)
-        x = x + proj(attn.reshape(B, H * D), lp["wo"], "wo")
-        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(h2[:, None], lp["w_gate"], lp["w_up"], lp["w_down"])[:, 0]
-        return x, (kp, vp)
+    x_mb = mbt(params["embed"][tokens])  # [M, mb, E]
+    pos_mb, len_mb = mbt(positions), mbt(lengths)
+    pid_mb, off_mb = mbt(page_ids), mbt(offsets)
+    bt_mb, lidx_mb = mbt(block_tables), mbt(lora_idx)
 
     xs = _scan_xs(params, lora)
-    xs["kp"] = k_pages
-    xs["vp"] = v_pages
-    x, (k_pages, v_pages) = jax.lax.scan(layer, x, xs)
+    xs_specs = jax.tree_util.tree_map(lambda _: P(AXIS_PIPELINE), xs)
+    rep = P()
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            xs_specs, P(AXIS_PIPELINE), P(AXIS_PIPELINE),
+            rep, rep, rep, rep, rep, rep, rep,
+        ),
+        out_specs=(rep, P(AXIS_PIPELINE), P(AXIS_PIPELINE)),
+        check_vma=False,
+    )
+    def run(xs, kp, vp, x_mb, pos_mb, len_mb, pid_mb, off_mb, bt_mb, lidx_mb):
+        stage = jax.lax.axis_index(AXIS_PIPELINE)
+        last = n_stages - 1
+        fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def local_layers(h, kp, vp, pos, lens, pid, off, bt, lidx):
+            """One pass through this stage's layer slice; returns updated
+            local pools. Same per-layer body as decode_step_paged
+            (_paged_decode_layer), so the paths cannot drift."""
+
+            def layer(carry, scanned):
+                return _paged_decode_layer(
+                    carry, scanned, cfg, inv_freq, msc, pos, lens,
+                    pid, off, bt, lidx,
+                )
+
+            xs_l = dict(xs)
+            xs_l["kp"] = kp
+            xs_l["vp"] = vp
+            y, (kp, vp) = jax.lax.scan(layer, h, xs_l)
+            return y, kp, vp
+
+        ticks = M + n_stages - 1
+
+        def tick(carry, t):
+            buf, kp, vp, out = carry
+            idx = jnp.clip(t - stage, 0, M - 1)
+            active = (t - stage >= 0) & (t - stage < M)
+            h = jnp.where(stage == 0, x_mb[jnp.clip(t, 0, M - 1)], buf)
+            # Off-schedule ticks recompute a clamped duplicate microbatch;
+            # their K/V writes sink into reserved scratch page 0.
+            pid = jnp.where(active, pid_mb[idx], 0)
+            off = jnp.where(active, off_mb[idx], 0)
+            y, kp, vp = local_layers(
+                h, kp, vp, pos_mb[idx], len_mb[idx], pid, off,
+                bt_mb[idx], lidx_mb[idx],
+            )
+            mb_out = t - last
+            store = (stage == last) & (mb_out >= 0)
+            out = jnp.where(
+                store, out.at[jnp.clip(mb_out, 0, M - 1)].set(y), out
+            )
+            buf = jax.lax.ppermute(y, AXIS_PIPELINE, fwd)
+            return (buf, kp, vp, out), None
+
+        zero = jnp.zeros_like(x_mb[0])
+        out0 = jnp.zeros_like(x_mb)
+        (_, kp, vp, out), _ = jax.lax.scan(
+            tick, (zero, kp, vp, out0), jnp.arange(ticks)
+        )
+        out = jnp.where(stage == last, out, jnp.zeros_like(out))
+        return jax.lax.psum(out, AXIS_PIPELINE), kp, vp
+
+    hidden, k_pages, v_pages = run(
+        xs, k_pages, v_pages, x_mb, pos_mb, len_mb, pid_mb, off_mb,
+        bt_mb, lidx_mb,
+    )
+    x = hidden.reshape(B, -1)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = jnp.einsum(
         "be,ve->bv", x, params["lm_head"],

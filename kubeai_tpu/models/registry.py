@@ -28,6 +28,7 @@ class ModelFamily:
         prefill: Callable,
         decode_step: Callable,
         decode_step_paged: Callable | None = None,
+        decode_step_paged_pp: Callable | None = None,
         decode_verify_paged: Callable | None = None,
         hf_architectures: tuple[str, ...] = (),
         feature: str = "TextGeneration",
@@ -44,6 +45,9 @@ class ModelFamily:
         # Paged-KV decode (block tables + page pools). None = family only
         # supports the slot cache; the engine falls back automatically.
         self.decode_step_paged = decode_step_paged
+        # Pipeline-parallel paged decode (stage-local KV over the pp mesh
+        # axis). None = family cannot serve on a pp>1 mesh.
+        self.decode_step_paged_pp = decode_step_paged_pp
         # Multi-position verify forward for speculative decoding (None =
         # speculation unsupported for this family).
         self.decode_verify_paged = decode_verify_paged
@@ -86,6 +90,7 @@ def _ensure_builtin() -> None:
             prefill=llama.prefill,
             decode_step=llama.decode_step,
             decode_step_paged=llama.decode_step_paged,
+            decode_step_paged_pp=llama.decode_step_paged_pp,
             decode_verify_paged=llama.decode_verify_paged,
             hf_architectures=("LlamaForCausalLM", "MistralForCausalLM"),
             hidden_states=llama.hidden_states,
@@ -105,6 +110,7 @@ def _ensure_builtin() -> None:
             prefill=llama.prefill,
             decode_step=llama.decode_step,
             decode_step_paged=llama.decode_step_paged,
+            decode_step_paged_pp=llama.decode_step_paged_pp,
             decode_verify_paged=llama.decode_verify_paged,
             hf_architectures=("Qwen2ForCausalLM",),
             hidden_states=llama.hidden_states,

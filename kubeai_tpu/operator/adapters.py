@@ -81,20 +81,38 @@ def reconcile_adapters(
                 candidates.pop(adapter.name, None)  # up to date
             else:
                 to_ensure.append(adapter)
-        to_remove = list(candidates.keys())
-        # Engine state is the removal source of truth, not labels: labels
-        # are removed before unload (drain ordering below), so an unload
-        # the engine refused with 409 (in-flight requests) must be found
-        # again on the requeue — by then its label is already gone.
-        try:
-            spec_names = {a.name for a in adapters}
-            for name in engine_client.list_lora_adapters(
-                addr, model.name
-            ):
-                if name not in spec_names and name not in to_remove:
-                    to_remove.append(name)
-        except EngineClientError:
-            pass  # listing is best-effort; label diff still drives removal
+        ensure_names = {a.name for a in to_ensure}
+        # Stale-hash adapters (URL changed) stay in `candidates` but must
+        # RELOAD in place (the engine reloads when the source changes),
+        # never load-then-unload.
+        to_remove = [n for n in candidates if n not in ensure_names]
+        pending = _pending_unloads(pod)
+        # Labels are removed BEFORE unload (drain ordering below), so an
+        # unload the engine refused with 409 (in-flight requests) must be
+        # rediscoverable on the requeue — by then its label is gone. The
+        # pending-unload annotation remembers it; the engine listing
+        # reconciles annotation state against what is actually loaded.
+        # Skipped entirely for adapter-free models (no per-reconcile GET).
+        if pending:
+            try:
+                loaded = set(
+                    engine_client.list_lora_adapters(addr, model.name)
+                )
+                spec_names = {a.name for a in adapters}
+                for name in sorted(pending):
+                    if name in spec_names:
+                        # Re-added to the spec before the unload stuck:
+                        # it is desired again, drop the tombstone.
+                        _clear_pending_unload(store, pod, name)
+                        continue
+                    if name in to_remove:
+                        continue
+                    if name in loaded:
+                        to_remove.append(name)
+                    else:
+                        _clear_pending_unload(store, pod, name)
+            except EngineClientError:
+                pass  # engine unreachable; retry on the next reconcile
 
         for adapter in to_ensure:
             if engine == ENGINE_VLLM:
@@ -132,9 +150,43 @@ def reconcile_adapters(
             # Label FIRST: the LB stops routing adapter traffic to this
             # Pod, in-flight requests drain, and the engine's 409
             # in-use refusal (if any) resolves on the backoff requeue —
-            # unload-first would livelock under sustained traffic.
+            # unload-first would livelock under sustained traffic. The
+            # pending-unload annotation keeps the orphan discoverable
+            # after the label is gone; cleared once the unload sticks.
             _remove_pod_label(store, pod, md.adapter_label(name))
+            _add_pending_unload(store, pod, name)
             engine_client.unload_lora_adapter(addr, name, ignore_not_found=True)
+            _clear_pending_unload(store, pod, name)
+
+
+def _pending_unloads(pod: dict) -> set[str]:
+    ann = ((pod.get("metadata") or {}).get("annotations") or {}).get(
+        md.ADAPTER_PENDING_UNLOAD_ANNOTATION, ""
+    )
+    return {n for n in ann.split(",") if n}
+
+
+def _set_pending_unloads(store: KubeStore, pod: dict, names: set[str]) -> None:
+    fresh = store.get("Pod", pod["metadata"]["namespace"], pod["metadata"]["name"])
+    anns = fresh["metadata"].setdefault("annotations", {})
+    if names:
+        anns[md.ADAPTER_PENDING_UNLOAD_ANNOTATION] = ",".join(sorted(names))
+    else:
+        anns.pop(md.ADAPTER_PENDING_UNLOAD_ANNOTATION, None)
+    store.update(fresh)
+    pod["metadata"].setdefault("annotations", {}).update(anns)
+    if not names:
+        (pod["metadata"].get("annotations") or {}).pop(
+            md.ADAPTER_PENDING_UNLOAD_ANNOTATION, None
+        )
+
+
+def _add_pending_unload(store: KubeStore, pod: dict, name: str) -> None:
+    _set_pending_unloads(store, pod, _pending_unloads(pod) | {name})
+
+
+def _clear_pending_unload(store: KubeStore, pod: dict, name: str) -> None:
+    _set_pending_unloads(store, pod, _pending_unloads(pod) - {name})
 
 
 def _update_pod_label(store: KubeStore, pod: dict, key: str, value: str) -> None:

@@ -23,10 +23,16 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from kubeai_tpu.parallel.mesh import AXIS_DATA, AXIS_SEQ, AXIS_TENSOR
+from kubeai_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_PIPELINE,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+)
 
 # Logical axis names used across models.
 BATCH = "batch"
+LAYERS = "layers"  # stacked-layer axis (pipeline stages shard it)
 SEQUENCE = "sequence"
 VOCAB = "vocab"
 EMBED = "embed"
@@ -44,6 +50,7 @@ class ShardingRules:
     """Map logical axis name -> physical mesh axis (or None = replicate)."""
 
     rules: tuple[tuple[str, str | None], ...] = (
+        (LAYERS, AXIS_PIPELINE),  # pp=1 meshes: axis size 1 → replicated
         (BATCH, AXIS_DATA),
         (SEQUENCE, AXIS_SEQ),
         (VOCAB, AXIS_TENSOR),

@@ -281,17 +281,54 @@ def test_adapter_unload_retries_from_engine_state_after_409(world):
     m["spec"]["adapters"] = []
     store.update(m)
     # First reconcile: label removed, unload refused (reconcile raises —
-    # the ControllerLoop requeues on this).
+    # the ControllerLoop requeues on this). The pending-unload annotation
+    # keeps the orphan discoverable.
     with pytest.raises(EngineClientError):
         rec.reconcile("default", "m409")
     pod = model_pods(store, "m409")[0]
     assert md.adapter_label("fin") not in (pod["metadata"].get("labels") or {})
+    assert "fin" in (pod["metadata"].get("annotations") or {}).get(
+        md.ADAPTER_PENDING_UNLOAD_ANNOTATION, ""
+    )
     assert ec.unloaded == []  # engine still has it loaded
 
-    # Requeue retry: no label left, but list_lora_adapters still reports
-    # 'fin' → unload retried and succeeds.
+    # Requeue retry: no label left, but the annotation + engine listing
+    # rediscover 'fin' → unload retried, succeeds, annotation cleared.
     rec.reconcile("default", "m409")
     assert ec.unloaded == [("http://10.9.9.9:8000", "fin")]
+    pod = model_pods(store, "m409")[0]
+    assert md.ADAPTER_PENDING_UNLOAD_ANNOTATION not in (
+        pod["metadata"].get("annotations") or {}
+    )
+
+
+def test_adapter_url_update_reloads_without_unload(world):
+    """Changing an adapter's URL must re-send the load (the engine reloads
+    in place when the source changes) and never unload the adapter the
+    spec still wants — load-then-unload would leave it missing."""
+    store, _, rec, ec = world
+    mk_model(
+        store,
+        name="mupd",
+        replicas=1,
+        adapters=[Adapter(name="fin", url="hf://org/fin-lora")],
+    )
+    rec.reconcile("default", "mupd")
+    pod = model_pods(store, "mupd")[0]
+    mark_ready(store, pod, ip="10.7.7.7")
+    rec.reconcile("default", "mupd")
+    assert len(ec.loaded) == 1
+
+    m = store.get("Model", "default", "mupd")
+    m["spec"]["adapters"] = [{"name": "fin", "url": "hf://org/fin-lora-v2"}]
+    store.update(m)
+    rec.reconcile("default", "mupd")
+    assert ec.loaded[-1] == ("http://10.7.7.7:8000", "fin", "hf://org/fin-lora-v2")
+    assert ec.unloaded == []  # reload in place, not load-then-unload
+    pod = model_pods(store, "mupd")[0]
+    from kubeai_tpu.operator import k8sutils
+    assert pod["metadata"]["labels"][md.adapter_label("fin")] == \
+        k8sutils.string_hash("hf://org/fin-lora-v2")
 
 
 def test_address_override_annotations_flow_to_pod(world):

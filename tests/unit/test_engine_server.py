@@ -202,6 +202,16 @@ def test_adapter_admin_flow(server):
     )["choices"][0]["text"]
     assert fin != base  # adapter changes generation
 
+    # A load for the SAME name with a DIFFERENT source must actually
+    # reload, not short-circuit — a URL update would otherwise serve
+    # stale weights forever while the operator records the new hash.
+    status, body = http_post(
+        addr(server),
+        "/v1/load_lora_adapter",
+        {"lora_name": "fin", "lora_url": "hf://org/fin-lora-v2"},
+    )
+    assert status == 200 and b"already" not in body
+
     # Unload.
     status, _ = http_post(
         addr(server), "/v1/unload_lora_adapter", {"lora_name": "fin"}

@@ -95,3 +95,90 @@ def test_pipeline_validation_errors(devices8):
         pipeline_forward(
             _synthetic_fn, params, jnp.ones((5, 16)), mesh, 2  # 5 % 2
         )
+
+
+# ---- pipeline parallelism as a SERVING path --------------------------------
+# The engine on a pp>1 mesh (stage-local layers + stage-local KV pages,
+# models/llama.py decode_step_paged_pp) must stream exactly what the
+# single-device engine streams.
+
+import dataclasses as _dc
+
+from kubeai_tpu.engine import Engine, EngineConfig
+from kubeai_tpu.engine.sampling import SamplingParams
+
+
+def _pp_world(devices, pp, num_layers=4, microbatches=0):
+    cfg = _dc.replace(llama.LlamaConfig.tiny(), num_layers=num_layers)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(
+        num_slots=4, max_seq_len=96, decode_chunk=4,
+        pp_microbatches=microbatches,
+    )
+    ref = Engine("llama", cfg, params, cfg=ecfg)
+    mesh = build_mesh(MeshConfig(pp=pp), devices=devices[:pp])
+    eng = Engine("llama", cfg, params, mesh=mesh, cfg=ecfg)
+    assert eng.cache_mode == "paged"
+    return cfg, params, ref, eng
+
+
+PP_PROMPTS = [
+    [1, 2, 3, 4, 5, 6, 7],
+    [9, 8, 7],
+    [11, 12, 13, 14, 15, 16, 17, 18, 19, 20, 21],
+    [30, 31],
+]
+
+
+@pytest.mark.parametrize("pp,microbatches", [(2, 0), (4, 0), (2, 4)])
+def test_engine_pp_matches_single_device(devices8, pp, microbatches):
+    _, _, ref, eng = _pp_world(devices8, pp, microbatches=microbatches)
+    sp = SamplingParams(temperature=0.0, max_tokens=24)
+    assert eng.generate(PP_PROMPTS, sp) == ref.generate(PP_PROMPTS, sp)
+
+
+def test_engine_pp_seeded_sampling_matches(devices8):
+    _, _, ref, eng = _pp_world(devices8, 2)
+    sp = SamplingParams(temperature=0.9, seed=13, max_tokens=16)
+    assert eng.generate(PP_PROMPTS, sp) == ref.generate(PP_PROMPTS, sp)
+
+
+def test_engine_pp_lora_matches(devices8):
+    cfg = _dc.replace(llama.LlamaConfig.tiny(), num_layers=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(5)
+    r = 4
+    E, H, D, NL = cfg.hidden_size, cfg.num_heads, cfg.head_size, cfg.num_layers
+    A = (rng.standard_normal((NL, E, r)) * 0.2).astype(np.float32)
+    B = (rng.standard_normal((NL, r, H * D)) * 0.2).astype(np.float32)
+    ecfg = EngineConfig(
+        num_slots=4, max_seq_len=96, decode_chunk=4, max_adapters=1,
+        max_lora_rank=8,
+    )
+    ref = Engine("llama", cfg, params, cfg=ecfg)
+    mesh = build_mesh(MeshConfig(pp=2), devices=devices8[:2])
+    eng = Engine("llama", cfg, params, mesh=mesh, cfg=ecfg)
+    for e in (ref, eng):
+        e.load_adapter("fin", {"wq": (A, B)})
+    sp = SamplingParams(temperature=0.0, max_tokens=20)
+    want = [ref.generate([p], sp, adapter="fin")[0] for p in PP_PROMPTS[:2]]
+    got = [eng.generate([p], sp, adapter="fin")[0] for p in PP_PROMPTS[:2]]
+    assert got == want
+
+
+def test_engine_pp_validation(devices8):
+    cfg = llama.LlamaConfig.tiny()  # 2 layers
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(pp=4), devices=devices8[:4])
+    with pytest.raises(ValueError, match="not divisible"):
+        Engine("llama", cfg, params, mesh=mesh,
+               cfg=EngineConfig(num_slots=4, max_seq_len=64))
+    mesh2 = build_mesh(MeshConfig(pp=2), devices=devices8[:2])
+    with pytest.raises(ValueError, match="paged"):
+        Engine("llama", cfg, params, mesh=mesh2,
+               cfg=EngineConfig(num_slots=4, max_seq_len=64,
+                                cache_mode="slot"))
+    with pytest.raises(ValueError, match="quantization"):
+        Engine("llama", cfg, params, mesh=mesh2,
+               cfg=EngineConfig(num_slots=4, max_seq_len=64,
+                                quantization="int8"))
