@@ -238,7 +238,11 @@ def main() -> None:
         + " prompts"
         # Label with what actually RAN (the engine downgrades silently
         # when speculation preconditions fail).
-        + (f", speculate={eng._spec}" if eng._spec else "")
+        + (
+            f", speculate={eng._spec}"
+            + ("/adaptive" if eng.cfg.spec_adaptive else "")
+            if eng._spec else ""
+        )
         + (f", {args.quantization}" if args.quantization else "")
         + f", chunk={eng.cfg.decode_chunk}"
         + ", 1 chip" + (" (smoke)" if args.smoke else "")

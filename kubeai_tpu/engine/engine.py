@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from collections import deque
 from typing import Any, NamedTuple
 
@@ -80,6 +81,16 @@ class EngineConfig:
     # decode_chunk, which matters on remote-dispatch transports. 0 = off.
     # Mutually exclusive with pipeline=True.
     speculate: int = 0
+    # Adaptive fallback (speculate > 0): speculation trades the fused
+    # decode_chunk scan for one device call per window, so on
+    # low-acceptance text it emits ~1 token per dispatch where chunk mode
+    # emits decode_chunk. Rather than guess the dispatch-latency/compute
+    # ratio, the engine MEASURES tokens/second of each mode (EMA over
+    # decode calls) and runs the faster one, re-probing the losing mode
+    # every spec_probe_every decode calls. Streams are identical in both
+    # modes (same seeded sampler), so switching is invisible to clients.
+    spec_adaptive: bool = True
+    spec_probe_every: int = 32
     prefill_buckets: tuple[int, ...] = ()  # default: powers of 2 up to max
     # Chunked prefill: prompts longer than this are prefilled in fixed
     # [1, prefill_chunk] steps — ONE compiled graph for every prompt
@@ -199,6 +210,12 @@ class Engine:
         # Base entropy for unseeded requests (per-request seed = base ^ rid).
         self._seed_base = int.from_bytes(np.random.bytes(4), "little")
         self._steps = 0
+        # Adaptive speculation: measured tokens/s EMA per decode mode
+        # ("spec" | "chunk"); None until a mode's SECOND call (the first
+        # includes compile and would poison the estimate).
+        self._mode_tps: dict[str, float | None] = {}
+        self._mode_calls: dict[str, int] = {}
+        self._decode_calls = 0
 
         # Resolve the cache mode: paged needs family support; otherwise
         # fall back to the slot cache. Chunked prefill works in both modes
@@ -1282,7 +1299,13 @@ class Engine:
         from kubeai_tpu.engine.paged_cache import OutOfPages
 
         # Lookahead: how far positions can advance in one device call.
-        chunk = (self._spec + 1) if self._spec else max(1, self.cfg.decode_chunk)
+        # Adaptive speculation may run EITHER mode this step, so cover both.
+        if self._spec:
+            chunk = self._spec + 1
+            if self.cfg.spec_adaptive:
+                chunk = max(chunk, max(1, self.cfg.decode_chunk))
+        else:
+            chunk = max(1, self.cfg.decode_chunk)
         for slot, req in sorted(
             self._active.items(), key=lambda kv: kv[1].rid
         ):
@@ -1362,6 +1385,39 @@ class Engine:
             self._release(req)
             return True
 
+    def _spec_pick(self) -> bool:
+        """Choose this decode call's mode (True = speculative window,
+        False = fused chunk). Epsilon-greedy over measured tokens/s:
+        sample each arm once, then run the winner, re-probing the loser
+        every cfg.spec_probe_every calls so a workload shift (e.g. the
+        batch turning repetitive) is noticed."""
+        if not self.cfg.spec_adaptive:
+            return True
+        self._decode_calls += 1
+        s = self._mode_tps.get("spec")
+        c = self._mode_tps.get("chunk")
+        if self._mode_calls.get("spec", 0) < 2:
+            return True
+        if self._mode_calls.get("chunk", 0) < 2:
+            return False
+        if self._decode_calls % max(2, self.cfg.spec_probe_every) == 0:
+            return s <= c  # probe the currently losing arm
+        return s > c
+
+    def _spec_observe(self, mode: str, tokens: int, dt: float) -> None:
+        """Fold one decode call's throughput into the mode's EMA. The
+        first call per mode is counted but not folded — it includes
+        compile time and would poison the estimate."""
+        calls = self._mode_calls.get(mode, 0) + 1
+        self._mode_calls[mode] = calls
+        if calls < 2 or dt <= 0 or tokens <= 0:
+            return
+        tps = tokens / dt
+        prev = self._mode_tps.get(mode)
+        self._mode_tps[mode] = (
+            tps if prev is None else 0.7 * prev + 0.3 * tps
+        )
+
     def step(self) -> list[StepEvent]:
         """Admit pending prefills, then run one fused decode chunk
         (cfg.decode_chunk model steps in a single device call).
@@ -1377,6 +1433,8 @@ class Engine:
             prev = self._inflight
             self._inflight = None
             current = None
+            decode_mode = None
+            t0 = time.perf_counter()
             if self._active:
                 if self.cache_mode == "paged":
                     self._ensure_decode_pages()
@@ -1385,7 +1443,8 @@ class Engine:
                             jnp.asarray(self._bt_host), self._bt_sharding
                         )
                         self._bt_dirty = False
-                    if self._spec:
+                    if self._spec and self._spec_pick():
+                        decode_mode = "spec"
                         (
                             choices,
                             n_emit,
@@ -1403,6 +1462,8 @@ class Engine:
                         )
                         toks_seq = ("spec", choices, n_emit)
                     else:
+                        if self._spec:
+                            decode_mode = "chunk"
                         (
                             toks_seq,
                             self.cache.k_pages,
@@ -1433,7 +1494,14 @@ class Engine:
             if prev is not None:
                 emitted.extend(self._process_chunk(prev))
             if current is not None:
-                emitted.extend(self._process_chunk(current))
+                evs = self._process_chunk(current)
+                emitted.extend(evs)
+                if decode_mode is not None:
+                    # Wall time covers dispatch + device + fetch — exactly
+                    # the cost the mode choice trades off.
+                    self._spec_observe(
+                        decode_mode, len(evs), time.perf_counter() - t0
+                    )
             return emitted
 
     def _process_chunk(self, inflight: tuple) -> list[StepEvent]:
@@ -1608,6 +1676,18 @@ class Engine:
                 self._lora[target]["A"] = bufA.at[slot].set(padA)
                 self._lora[target]["B"] = bufB.at[slot].set(padB)
             self._adapter_slots[name] = slot
+
+    def adapter_in_use(self, name: str) -> bool:
+        """True when the adapter is loaded and any pending/active request
+        references it. Advisory (state can change after return) — the
+        load/unload guards re-check under the lock; callers use it to
+        skip expensive work (e.g. weight downloads) that a 409 would
+        discard."""
+        if self._lora is None:
+            return False
+        with self._lock:
+            slot = self._adapter_slots.get(name)
+            return slot is not None and self._adapter_in_use_locked(slot)
 
     def _adapter_in_use_locked(self, slot: int) -> bool:
         """True when any pending/active request references the adapter

@@ -600,6 +600,14 @@ class EngineServer:
                 return http._json(
                     200, {"status": "already loaded", "lora_name": name}
                 )
+            if self.engine.adapter_in_use(name):
+                # A reload would be refused after the (possibly large)
+                # weight download; answer the 409 before fetching. The
+                # engine's own guard re-checks authoritatively.
+                return http._json(409, {"error": {"message": (
+                    f"adapter {name!r} has in-flight requests; retry "
+                    "after they finish"
+                )}})
         try:
             if self.adapter_fetcher is not None:
                 weights = self.adapter_fetcher(name, path_or_url)

@@ -553,11 +553,10 @@ def decode_step_paged_pp(
     NL = k_pages.shape[0]
     if NL % n_stages:
         raise ValueError(f"{NL} layers not divisible by {n_stages} pp stages")
-    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
     page_size = k_pages.shape[2]
     inv_freq = jnp.asarray(
         rope_frequencies(
-            D, cfg.rope_theta, cfg.rope_scaling,
+            cfg.head_size, cfg.rope_theta, cfg.rope_scaling,
             cfg.max_position_embeddings,
         )
     )

@@ -115,6 +115,15 @@ def reconcile_adapters(
                 pass  # engine unreachable; retry on the next reconcile
 
         for adapter in to_ensure:
+            if adapter.name in candidates:
+                # URL changed on a live adapter: drop the routing label
+                # BEFORE the reload so the LB stops sending it traffic and
+                # in-flight requests drain — otherwise the engine's 409
+                # in-use refusal repeats forever under sustained traffic
+                # (same drain-first reasoning as the removal loop below).
+                # The label returns, with the new hash, after the reload
+                # succeeds.
+                _remove_pod_label(store, pod, md.adapter_label(adapter.name))
             if engine == ENGINE_VLLM:
                 # Download via the loader sidecar, then point vLLM at the
                 # shared emptyDir path.
@@ -147,14 +156,15 @@ def reconcile_adapters(
             )
 
         for name in to_remove:
-            # Label FIRST: the LB stops routing adapter traffic to this
-            # Pod, in-flight requests drain, and the engine's 409
-            # in-use refusal (if any) resolves on the backoff requeue —
-            # unload-first would livelock under sustained traffic. The
-            # pending-unload annotation keeps the orphan discoverable
-            # after the label is gone; cleared once the unload sticks.
-            _remove_pod_label(store, pod, md.adapter_label(name))
+            # Tombstone FIRST (a crash after the label is gone but before
+            # the annotation lands would leak the adapter in the engine
+            # forever — orphan discovery is gated on the annotation), then
+            # the label (the LB stops routing adapter traffic, in-flight
+            # requests drain, and the engine's 409 in-use refusal resolves
+            # on the backoff requeue — unload-first would livelock under
+            # sustained traffic), then the unload itself.
             _add_pending_unload(store, pod, name)
+            _remove_pod_label(store, pod, md.adapter_label(name))
             engine_client.unload_lora_adapter(addr, name, ignore_not_found=True)
             _clear_pending_unload(store, pod, name)
 

@@ -331,6 +331,54 @@ def test_adapter_url_update_reloads_without_unload(world):
         k8sutils.string_hash("hf://org/fin-lora-v2")
 
 
+def test_adapter_url_update_drains_before_reload(world):
+    """A reload the engine refuses with 409 (requests still decode with
+    the old version) must first drop the routing label so traffic drains —
+    keeping it would livelock: traffic keeps the adapter busy forever."""
+    from kubeai_tpu.operator.engine_client import EngineClientError
+
+    store, _, rec, ec = world
+    mk_model(
+        store,
+        name="mdrain",
+        replicas=1,
+        adapters=[Adapter(name="fin", url="hf://org/fin-lora")],
+    )
+    rec.reconcile("default", "mdrain")
+    pod = model_pods(store, "mdrain")[0]
+    mark_ready(store, pod, ip="10.6.6.6")
+    rec.reconcile("default", "mdrain")
+
+    refusals = {"n": 0}
+    real_load = ec.load_lora_adapter
+
+    def refusing_load(addr, lora_name, lora_path="", lora_url="",
+                      ignore_already_loaded=False):
+        if lora_url.endswith("v2") and refusals["n"] == 0:
+            refusals["n"] += 1
+            raise EngineClientError("HTTP 409: adapter has in-flight requests")
+        return real_load(addr, lora_name, lora_path=lora_path,
+                         lora_url=lora_url,
+                         ignore_already_loaded=ignore_already_loaded)
+
+    ec.load_lora_adapter = refusing_load
+    m = store.get("Model", "default", "mdrain")
+    m["spec"]["adapters"] = [{"name": "fin", "url": "hf://org/fin-lora-v2"}]
+    store.update(m)
+    with pytest.raises(EngineClientError):
+        rec.reconcile("default", "mdrain")
+    pod = model_pods(store, "mdrain")[0]
+    # Label dropped before the refused reload: the LB drains the adapter.
+    assert md.adapter_label("fin") not in (pod["metadata"].get("labels") or {})
+    # Requeue retry (drained): reload succeeds, label returns w/ new hash.
+    rec.reconcile("default", "mdrain")
+    from kubeai_tpu.operator import k8sutils
+    pod = model_pods(store, "mdrain")[0]
+    assert pod["metadata"]["labels"][md.adapter_label("fin")] == \
+        k8sutils.string_hash("hf://org/fin-lora-v2")
+    assert ec.unloaded == []
+
+
 def test_address_override_annotations_flow_to_pod(world):
     store, _, rec, _ = world
     obj = mk_model(store, name="m5", replicas=1)

@@ -157,7 +157,7 @@ def test_speculative_greedy_matches_vanilla():
     ]
     sp = SamplingParams(temperature=0.0, max_tokens=30)
     want = _make("paged").generate(prompts, sp)
-    eng = _make("paged", speculate=4)
+    eng = _make("paged", speculate=4, spec_adaptive=False)
     assert eng._spec == 4
     got = eng.generate(prompts, sp)
     assert got == want
@@ -166,7 +166,7 @@ def test_speculative_greedy_matches_vanilla():
     long_prompt = ([3, 4, 5] * 40)[:110]
     sp2 = SamplingParams(temperature=0.0, max_tokens=64)
     want2 = _make("paged").generate([long_prompt], sp2)
-    got2 = _make("paged", speculate=4).generate([long_prompt], sp2)
+    got2 = _make("paged", speculate=4, spec_adaptive=False).generate([long_prompt], sp2)
     assert got2 == want2
 
 
@@ -178,14 +178,14 @@ def test_speculative_seeded_matches_vanilla():
     ]
     sp = SamplingParams(temperature=0.9, top_k=12, max_tokens=20, seed=77)
     want = _make("paged").generate(prompts, sp)
-    got = _make("paged", speculate=3).generate(prompts, sp)
+    got = _make("paged", speculate=3, spec_adaptive=False).generate(prompts, sp)
     assert got == want
 
 
 def test_speculative_accepts_on_repetitive_text():
     """On repetitive context the lookup proposals are right, so steps
     emit >1 token — fewer device steps than tokens."""
-    eng = _make("paged", speculate=4)
+    eng = _make("paged", speculate=4, spec_adaptive=False)
     prompt = ([11, 12, 13, 14, 15] * 10)[:45]
     sp = SamplingParams(temperature=0.0, max_tokens=24)
     out = eng.generate([prompt], sp)[0]
@@ -270,3 +270,50 @@ def test_chunked_prefill_nondivisible_tail():
             [prompt], sp
         )
         assert got == want, mode
+
+
+def test_adaptive_speculation_streams_match_vanilla():
+    """With spec_adaptive (default), the engine may interleave speculative
+    windows and fused chunks based on measured throughput — the emitted
+    stream must be identical to vanilla decoding either way."""
+    rng = np.random.default_rng(31)
+    prompts = [
+        ([4, 5, 6] * 15)[:33],               # repetitive: spec-friendly
+        rng.integers(1, CFG.vocab_size, 21).tolist(),  # random: chunk-friendly
+    ]
+    sp = SamplingParams(temperature=0.0, max_tokens=40)
+    want = _make("paged").generate(prompts, sp)
+    eng = _make("paged", speculate=4)  # spec_adaptive defaults True
+    got = eng.generate(prompts, sp)
+    assert got == want
+    # Both arms were sampled at least once (epsilon-greedy bootstrap).
+    assert eng._mode_calls.get("spec", 0) >= 1
+    assert eng._mode_calls.get("chunk", 0) >= 1
+
+
+def test_adaptive_pick_follows_measured_throughput():
+    """The mode chooser is epsilon-greedy on the tokens/s EMAs: after both
+    arms are sampled it runs the winner, probing the loser periodically."""
+    eng = _make("paged", speculate=4, spec_probe_every=8)
+    # Bootstrap: first two calls per arm (call 1 = compile, not folded).
+    assert eng._spec_pick() is True
+    eng._spec_observe("spec", 4, 1.0)
+    assert eng._spec_pick() is True
+    eng._spec_observe("spec", 4, 1.0)      # spec EMA = 4 tok/s
+    assert eng._spec_pick() is False
+    eng._spec_observe("chunk", 16, 1.0)
+    assert eng._spec_pick() is False
+    eng._spec_observe("chunk", 16, 1.0)    # chunk EMA = 16 tok/s
+    # Winner (chunk) runs; the losing arm is probed on the probe boundary.
+    picks = [eng._spec_pick() for _ in range(16)]
+    assert picks.count(False) >= 14           # chunk dominates
+    assert picks.count(True) >= 1             # spec re-probed
+    # A workload shift (spec suddenly fast) flips the choice after probes.
+    for _ in range(4):
+        eng._spec_observe("spec", 100, 1.0)
+    assert eng._spec_pick() is True
+
+
+def test_adaptive_off_always_speculates():
+    eng = _make("paged", speculate=4, spec_adaptive=False)
+    assert all(eng._spec_pick() for _ in range(50))
