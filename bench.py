@@ -135,6 +135,11 @@ def main() -> None:
         help="prompt-lookup speculative decoding window (0 = off)",
     )
     ap.add_argument(
+        "--spec-adaptive", choices=["on", "off"], default="on",
+        help="with --speculate: 'on' measures both modes and runs the "
+        "faster (production default); 'off' benchmarks PURE speculation",
+    )
+    ap.add_argument(
         "--quantization", default="", choices=["", "int8"],
         help="weight-only quantization",
     )
@@ -195,6 +200,7 @@ def main() -> None:
             max_seq_len=args.max_seq_len,
             cache_mode=args.cache_mode,
             speculate=args.speculate,
+            spec_adaptive=args.spec_adaptive == "on",
             quantization=args.quantization,
             decode_chunk=max(1, args.decode_chunk),
         ),

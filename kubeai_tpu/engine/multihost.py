@@ -191,6 +191,11 @@ class LockstepEngine:
     def loaded_adapters(self) -> list[str]:
         return self.inner.loaded_adapters()
 
+    def adapter_in_use(self, name: str) -> bool:
+        """Engine-surface parity: the server pre-checks this before
+        fetching reload weights. Advisory, like Engine.adapter_in_use."""
+        return self.inner.adapter_in_use(name)
+
     def load_adapter(self, name: str, adapter_weights: dict) -> None:
         """Lockstep adapter install: broadcast the op + padded weights to
         every process, then install locally. Synchronous — returns once

@@ -33,6 +33,7 @@ from kubeai_tpu.httpserver import DeepBacklogHTTPServer
 
 from kubeai_tpu.engine.engine import Engine, EngineConfig
 from kubeai_tpu.engine.sampling import SamplingParams
+from kubeai_tpu.metrics import tracing
 from kubeai_tpu.engine.tokenizer import Tokenizer, load_tokenizer
 from kubeai_tpu.metrics.registry import Counter, Gauge, Registry
 
@@ -99,7 +100,10 @@ class EngineServer:
             def log_message(self, *a):
                 pass
 
+            _last_status = 200  # recorded for the request span
+
             def _json(self, status: int, payload: dict, headers: dict | None = None):
+                self._last_status = status
                 body = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
@@ -149,23 +153,55 @@ class EngineServer:
                     return self._json(
                         400, {"error": {"message": f"bad JSON: {e}"}}
                     )
+                # Continue the trace the operator's proxy started (W3C
+                # traceparent), so one trace spans front door → engine.
+                span = tracing.tracer().start_span(
+                    f"engine {path}",
+                    parent=tracing.parse_traceparent(
+                        self.headers.get("traceparent")
+                    ),
+                    kind=tracing.KIND_SERVER,
+                    attributes={"http.route": path},
+                )
+                self._last_status = 200
                 try:
-                    if path == "/v1/chat/completions":
-                        return outer._handle_generate(self, body, chat=True)
-                    if path == "/v1/completions":
-                        return outer._handle_generate(self, body, chat=False)
-                    if path == "/v1/embeddings":
-                        return outer._handle_embeddings(self, body)
-                    if path == "/v1/load_lora_adapter":
-                        return outer._handle_load_adapter(self, body)
-                    if path == "/v1/unload_lora_adapter":
-                        return outer._handle_unload_adapter(self, body)
-                except BrokenPipeError:
-                    raise
-                except Exception as e:
-                    logger.exception("handler error")
-                    return self._json(500, {"error": {"message": str(e)}})
-                return self._json(404, {"error": {"message": "not found"}})
+                    try:
+                        if path == "/v1/chat/completions":
+                            return outer._handle_generate(self, body, chat=True)
+                        if path == "/v1/completions":
+                            return outer._handle_generate(self, body, chat=False)
+                        if path == "/v1/embeddings":
+                            return outer._handle_embeddings(self, body)
+                        if path == "/v1/load_lora_adapter":
+                            return outer._handle_load_adapter(self, body)
+                        if path == "/v1/unload_lora_adapter":
+                            return outer._handle_unload_adapter(self, body)
+                        return self._json(
+                            404, {"error": {"message": "not found"}}
+                        )
+                    except BrokenPipeError as e:
+                        span.set_attribute(
+                            "http.status_code", self._last_status
+                        )
+                        span.end(error=str(e) or "client disconnected")
+                        raise
+                    except Exception as e:
+                        logger.exception("handler error")
+                        return self._json(
+                            500, {"error": {"message": str(e)}}
+                        )
+                finally:
+                    # Handlers signal errors via returned 4xx/5xx JSON,
+                    # not exceptions — the span must reflect that, or
+                    # every refused request traces as a healthy OK.
+                    if not span.end_ns:
+                        span.set_attribute(
+                            "http.status_code", self._last_status
+                        )
+                        span.end(
+                            error=f"HTTP {self._last_status}"
+                            if self._last_status >= 400 else None
+                        )
 
         self.httpd = DeepBacklogHTTPServer((host, port), Handler)
         self._http_thread = threading.Thread(
@@ -836,6 +872,7 @@ def main(argv=None) -> int:
         host=args.host,
         port=args.port,
     )
+    tracing.configure(service_name=f"kubeai-tpu-engine.{args.served_model_name}")
     server.start()
     log.info("engine serving on %s:%d", args.host, server.port)
     try:

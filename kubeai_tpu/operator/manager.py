@@ -136,6 +136,12 @@ class Manager:
         return self.api_server.address
 
     def start(self) -> None:
+        # Live OTLP trace export when OTEL_EXPORTER_OTLP_ENDPOINT is set
+        # (propagation-only otherwise). The reference wires the OTel SDK
+        # but leaves tracing dormant (otel.go:40-47); here it's live.
+        from kubeai_tpu.metrics import tracing
+
+        tracing.configure(service_name="kubeai-tpu-operator")
         self.lb.start()
         self.controller_loop.start()
         self.leader.start()

@@ -39,6 +39,7 @@ access_log = logging.getLogger("kubeai.access")
 
 from kubeai_tpu.crd.model import Model
 from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
+from kubeai_tpu.metrics import tracing
 from kubeai_tpu.routing import apiutils
 from kubeai_tpu.routing.modelclient import ModelClient
 from kubeai_tpu.routing.proxy import ModelProxy
@@ -153,6 +154,20 @@ class OpenAIServer:
                     return self._respond_json(
                         404, {"error": {"message": f"unknown path {path}"}}
                     )
+                # Continue an incoming W3C trace or start one; downstream
+                # (proxy → engine Pod) receives THIS span as parent.
+                span = tracing.tracer().start_span(
+                    f"POST {normalized}",
+                    parent=tracing.parse_traceparent(
+                        headers.get("traceparent")
+                    ),
+                    kind=tracing.KIND_SERVER,
+                    attributes={
+                        "http.route": normalized,
+                        "request.id": request_id,
+                    },
+                )
+                headers["traceparent"] = span.context.traceparent()
                 length = int(self.headers.get("Content-Length", "0") or "0")
                 body = self.rfile.read(length) if length else b""
                 result = outer.proxy.handle(
@@ -161,6 +176,26 @@ class OpenAIServer:
                     body,
                     headers,
                 )
+                span.set_attribute("http.status_code", result.status)
+                # End the span when the BODY finishes, not when headers
+                # arrive: for SSE the generation streams long after
+                # proxy.handle returns, and a mid-stream failure must
+                # mark the root span, not leave it a clean few-ms OK.
+                err = (
+                    f"HTTP {result.status}" if result.status >= 500 else None
+                )
+                orig_chunks = result.chunks
+
+                def traced_chunks(orig=orig_chunks, span=span, err=err):
+                    try:
+                        yield from orig
+                    except BaseException as e:
+                        span.end(error=str(e) or type(e).__name__)
+                        raise
+                    else:
+                        span.end(error=err)
+
+                result.chunks = traced_chunks()
                 access_log.info(
                     "route=%s request_id=%s status=%d duration_ms=%.1f",
                     normalized, request_id, result.status,

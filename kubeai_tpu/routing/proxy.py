@@ -19,6 +19,7 @@ from typing import BinaryIO
 
 from kubeai_tpu.crd.model import LB_STRATEGY_PREFIX_HASH
 from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
+from kubeai_tpu.metrics import tracing
 from kubeai_tpu.routing import apiutils
 from kubeai_tpu.routing.loadbalancer import LoadBalancer, LoadBalancerTimeout
 from kubeai_tpu.routing.modelclient import (
@@ -119,6 +120,10 @@ class ModelProxy:
         prefix = preq.prefix[:prefix_len] if strategy == LB_STRATEGY_PREFIX_HASH else ""
 
         last_err: Exception | None = None
+        # Parent for every attempt span: the front door's server span
+        # (attempts are SIBLINGS — rebinding headers below must not make
+        # attempt N+1 a child of attempt N).
+        trace_parent = tracing.parse_traceparent(headers.get("traceparent"))
         for attempt in range(MAX_RETRIES):
             addr, done = self.lb.await_best_address(
                 model.name,
@@ -126,16 +131,41 @@ class ModelProxy:
                 prefix=prefix,
                 strategy=strategy,
             )
+            # One client span per attempt: retries show up as siblings
+            # under the front door's server span.
+            attempt_span = tracing.tracer().start_span(
+                "proxy.attempt",
+                parent=trace_parent,
+                kind=tracing.KIND_CLIENT,
+                attributes={
+                    "endpoint": addr,
+                    "attempt": attempt,
+                    "request.model": model.name,
+                },
+            )
+            # The engine continues the trace under THIS attempt.
+            headers = dict(headers, traceparent=attempt_span.context.traceparent())
             try:
                 resp, conn = _send(addr, path, preq, headers)
             except OSError as e:
+                attempt_span.end(error=str(e))
                 done()
                 last_err = e
                 logger.warning(
                     "attempt %d: connection to %s failed: %s", attempt, addr, e
                 )
                 continue
+            except Exception as e:
+                # e.g. http.client.BadStatusLine (engine died mid-response):
+                # not retryable here, but the attempt span must export and
+                # the endpoint's in-flight count must drop before the
+                # generic 502 path takes over.
+                attempt_span.end(error=str(e))
+                done()
+                raise
             if resp.status in RETRY_STATUSES and attempt < MAX_RETRIES - 1:
+                attempt_span.set_attribute("http.status_code", resp.status)
+                attempt_span.end(error=f"HTTP {resp.status} (retrying)")
                 retry_after = resp.getheader("Retry-After")
                 resp.read()
                 conn.close()
@@ -150,12 +180,16 @@ class ModelProxy:
                         pass
                 continue
             if resp.status >= 500:
+                attempt_span.set_attribute("http.status_code", resp.status)
+                attempt_span.end(error=f"HTTP {resp.status}")
                 resp.read()
                 conn.close()
                 done()
                 # Strip engine error details (reference: request.go:45-63).
                 return _error(resp.status, "upstream model server error")
 
+            attempt_span.set_attribute("http.status_code", resp.status)
+            attempt_span.end()
             resp_headers = [
                 (k, v)
                 for k, v in resp.getheaders()
@@ -184,7 +218,7 @@ def _send(addr: str, path: str, preq: apiutils.ParsedRequest, headers: dict):
         "Content-Type": preq.content_type,
         "Content-Length": str(len(preq.body)),
     }
-    for k in ("authorization", "accept", "x-request-id"):
+    for k in ("authorization", "accept", "x-request-id", "traceparent"):
         if k in headers:
             fwd[k] = headers[k]
     conn.request("POST", path, body=preq.body, headers=fwd)

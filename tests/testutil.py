@@ -28,6 +28,9 @@ class FakeEngine:
                 n = int(self.headers.get("Content-Length", 0))
                 req_body = self.rfile.read(n)
                 fake.requests.append((self.path, req_body))
+                fake.request_headers.append(
+                    {k.lower(): v for k, v in self.headers.items()}
+                )
                 status, payload = (fake.behavior or fake.default)(
                     self.path, req_body
                 )
@@ -39,6 +42,7 @@ class FakeEngine:
                 self.wfile.write(body)
 
         self.requests: list = []
+        self.request_headers: list = []
         self.behavior = behavior
         self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
         threading.Thread(target=self.httpd.serve_forever, daemon=True).start()

@@ -1,0 +1,247 @@
+"""OTel-compatible tracing: W3C traceparent propagation + OTLP/HTTP JSON
+export (reference keeps tracing dormant, otel.go:40-47 — ours is live, so
+the test bar is a real collector capture across the full proxy path)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from testutil import FakeEngine, http_post
+
+from kubeai_tpu.crd.model import LoadBalancing, Model, ModelSpec
+from kubeai_tpu.metrics import tracing
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.loadbalancer import LoadBalancer
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.routing.openai_server import OpenAIServer
+from kubeai_tpu.routing.proxy import ModelProxy
+
+
+class FakeCollector:
+    """Minimal OTLP/HTTP collector: captures POST /v1/traces JSON."""
+
+    def __init__(self):
+        coll = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n))
+                with coll._lock:
+                    coll.batches.append(payload)
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+        self.batches: list = []
+        self._lock = threading.Lock()
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def spans(self) -> list[dict]:
+        with self._lock:
+            return [
+                s
+                for b in self.batches
+                for rs in b["resourceSpans"]
+                for ss in rs["scopeSpans"]
+                for s in ss["spans"]
+            ]
+
+    def wait_spans(self, n: int, timeout: float = 10.0) -> list[dict]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            got = self.spans()
+            if len(got) >= n:
+                return got
+            time.sleep(0.05)
+        raise AssertionError(f"wanted {n} spans, got {len(self.spans())}")
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+# ---- traceparent ------------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    ctx = tracing.SpanContext("ab" * 16, "cd" * 8, 1)
+    parsed = tracing.parse_traceparent(ctx.traceparent())
+    assert (parsed.trace_id, parsed.span_id, parsed.flags) == (
+        "ab" * 16, "cd" * 8, 1
+    )
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        None,
+        "",
+        "junk",
+        "01-" + "a" * 32 + "-" + "b" * 16 + "-01",  # unknown version
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",  # zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # zero span id
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",  # short trace id
+    ],
+)
+def test_traceparent_rejects_malformed(bad):
+    assert tracing.parse_traceparent(bad) is None
+
+
+def test_span_ids_fresh_and_trace_continued():
+    t = tracing.Tracer()  # no endpoint: propagation only
+    root = t.start_span("root")
+    child = t.start_span("child", parent=root.context)
+    assert child.context.trace_id == root.context.trace_id
+    assert child.context.span_id != root.context.span_id
+    assert child.parent_span_id == root.context.span_id
+    root.end()
+    child.end()  # no exporter → nothing buffered, nothing raised
+    assert not t.exporting
+
+
+# ---- OTLP export ------------------------------------------------------------
+
+
+def test_export_otlp_json_shape():
+    coll = FakeCollector()
+    t = tracing.Tracer(
+        service_name="svc-test", endpoint=coll.endpoint,
+        flush_interval_s=0.1,
+    )
+    try:
+        root = t.start_span("parent", kind=tracing.KIND_SERVER,
+                            attributes={"http.route": "/x", "attempt": 2})
+        child = t.start_span("child", parent=root.context)
+        child.end()
+        root.end(error="boom")
+        spans = coll.wait_spans(2)
+        by_name = {s["name"]: s for s in spans}
+        p, c = by_name["parent"], by_name["child"]
+        assert p["traceId"] == c["traceId"] == root.context.trace_id
+        assert c["parentSpanId"] == p["spanId"]
+        assert "parentSpanId" not in p
+        assert p["kind"] == tracing.KIND_SERVER
+        assert int(p["endTimeUnixNano"]) >= int(p["startTimeUnixNano"])
+        attrs = {a["key"]: a["value"] for a in p["attributes"]}
+        assert attrs["http.route"] == {"stringValue": "/x"}
+        assert attrs["attempt"] == {"intValue": "2"}
+        assert attrs["error.message"] == {"stringValue": "boom"}
+        assert p["status"]["code"] == 2  # ERROR
+        assert c["status"]["code"] == 1  # OK
+        svc = coll.batches[0]["resourceSpans"][0]["resource"]["attributes"]
+        assert {"key": "service.name",
+                "value": {"stringValue": "svc-test"}} in svc
+    finally:
+        t.shutdown()
+        coll.stop()
+
+
+def test_export_survives_dead_collector():
+    t = tracing.Tracer(endpoint="http://127.0.0.1:1", flush_interval_s=0.05)
+    try:
+        for i in range(5):
+            t.start_span(f"s{i}").end()
+        deadline = time.monotonic() + 5
+        while t.dropped < 5 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert t.dropped >= 5  # counted, never raised into the caller
+    finally:
+        t.shutdown()
+
+
+# ---- one trace across front door -> proxy -> engine --------------------------
+
+
+def test_trace_spans_front_door_to_engine():
+    coll = FakeCollector()
+    tracing.configure(endpoint=coll.endpoint, flush_interval_s=0.1)
+    store = KubeStore()
+    lb = LoadBalancer(store, default_timeout=5)
+    mc = ModelClient(store)
+    server = OpenAIServer(ModelProxy(lb, mc), mc)
+    server.start()
+    eng = FakeEngine()
+    try:
+        store.create(Model(
+            name="m1",
+            spec=ModelSpec(
+                url="hf://org/x", engine="KubeAITPU",
+                features=["TextGeneration"], autoscaling_disabled=True,
+                replicas=1, load_balancing=LoadBalancing(),
+            ),
+        ).to_dict())
+        store.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": "model-m1-0", "namespace": "default",
+                "labels": {"model": "m1"},
+                "annotations": {"model-pod-ip": "127.0.0.1",
+                                "model-pod-port": str(eng.port)},
+            },
+            "status": {"conditions": [{"type": "Ready", "status": "True"}],
+                       "podIP": "127.0.0.1"},
+        })
+        lb.sync_model("m1")
+
+        client_trace = "a1" * 16
+        client_span = "b2" * 8
+        status, _ = http_post(
+            f"127.0.0.1:{server.port}",
+            "/openai/v1/completions",
+            {"model": "m1", "prompt": "hi"},
+            headers={"traceparent": f"00-{client_trace}-{client_span}-01"},
+        )
+        assert status == 200
+
+        # The engine received a traceparent CONTINUING the client's trace
+        # (same trace id, new span id).
+        tp = eng.request_headers[-1].get("traceparent", "")
+        got = tracing.parse_traceparent(tp)
+        assert got is not None and got.trace_id == client_trace
+        assert got.span_id != client_span
+
+        spans = coll.wait_spans(2)
+        by_name = {s["name"]: s for s in spans}
+        front = by_name["POST /openai/v1/completions"]
+        attempt = by_name["proxy.attempt"]
+        # One trace end-to-end, rooted at the client's span.
+        assert front["traceId"] == attempt["traceId"] == client_trace
+        assert front["parentSpanId"] == client_span
+        assert attempt["parentSpanId"] == front["spanId"]
+        # The engine's parent is the ATTEMPT span.
+        assert got.span_id == attempt["spanId"]
+        attrs = {a["key"]: a["value"] for a in attempt["attributes"]}
+        assert attrs["request.model"] == {"stringValue": "m1"}
+    finally:
+        server.stop()
+        lb.stop()
+        eng.stop()
+        coll.stop()
+        with tracing._default_lock:
+            if tracing._default is not None:
+                tracing._default.shutdown()
+            tracing._default = None
+
+
+def test_no_export_without_endpoint(monkeypatch):
+    monkeypatch.delenv("OTEL_EXPORTER_OTLP_ENDPOINT", raising=False)
+    monkeypatch.delenv("OTEL_EXPORTER_OTLP_TRACES_ENDPOINT", raising=False)
+    t = tracing.configure()
+    assert not t.exporting
+    t.start_span("x").end()  # must be inert, not an error
+    tracing._default = None
