@@ -11,6 +11,21 @@ Methodology: random-init Llama-3.2-1B-class weights (zero-egress image: no
 checkpoint downloads; throughput is weight-value-independent), all decode
 slots kept full (continuous batching steady state), timed after compile
 warm-up. `--smoke` runs a tiny config for quick sanity.
+
+RELAY DISCIPLINE (learned the hard way — rounds 1 and 2 both scored 0):
+the chip sits behind a single-tenant relay whose claims outlive a dead
+client. The rules, encoded in this file's structure:
+  1. The parent process NEVER touches JAX. Reachability is probed from a
+     throwaway subprocess; the measurement itself runs in a second
+     subprocess. A wedged relay can then never hang the process that must
+     print the JSON line.
+  2. A hung measurement gets SIGINT + a long grace period (KeyboardInterrupt
+     lets the JAX runtime tear down and release the claim), and SIGKILL only
+     as a last resort. Never `os._exit` in a process holding a claim — that
+     is exactly what wedged the relay in round 2 (see ROADMAP.md caveat).
+  3. Measure the primary bf16 number FIRST; risky variants (int8 cold
+     compiles, pipeline) only ever run after a result is already printed,
+     and only via --variant with a watchdog sized above compile time.
 """
 
 from __future__ import annotations
@@ -18,8 +33,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
+import subprocess
 import sys
-import threading
 import time
 
 
@@ -40,31 +56,22 @@ def llama_1b_cfg():
     )
 
 
-def _watchdog(seconds: float):
-    """The chip sits behind a relay that can wedge (stale claims survive
-    client death); a hung bench must still emit its one JSON line.
-    seconds <= 0 disables the watchdog."""
-    done = threading.Event()
-    if seconds <= 0:
-        return done
+def llama_8b_cfg():
+    from kubeai_tpu.models import llama
 
-    def trip():
-        if not done.wait(seconds):
-            print(
-                json.dumps(
-                    {
-                        "metric": "llama-1b-class decode throughput (TPU unreachable: watchdog fired)",
-                        "value": 0,
-                        "unit": "tok/s",
-                        "vs_baseline": 0,
-                    }
-                ),
-                flush=True,
-            )
-            os._exit(3)
-
-    threading.Thread(target=trip, daemon=True).start()
-    return done
+    # Llama-3-8B architecture (hidden 4096, 32 layers, GQA 32/8 heads).
+    # int8 weights ≈ 8 GB — fits one v5e chip's 16 GB HBM with KV room.
+    return llama.LlamaConfig(
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=500000.0,
+        max_position_embeddings=4096,
+    )
 
 
 def _tpu_reachable(timeout_s: float = 120.0) -> bool:
@@ -72,10 +79,6 @@ def _tpu_reachable(timeout_s: float = 120.0) -> bool:
     hang this process mid-dispatch (the relay holds single-tenant claims).
     On timeout the child gets SIGINT + a grace period before SIGKILL —
     a hard kill mid-claim is itself what wedges the chip."""
-    import signal
-    import subprocess
-    import sys
-
     code = (
         "import jax, jax.numpy as jnp; "
         "x = jnp.ones((8,8)); float(x.sum()); "
@@ -90,12 +93,7 @@ def _tpu_reachable(timeout_s: float = 120.0) -> bool:
     try:
         out, _ = p.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        p.send_signal(signal.SIGINT)
-        try:
-            p.communicate(timeout=15)
-        except subprocess.TimeoutExpired:
-            p.kill()
-            p.communicate()
+        _stop_child(p)
         return False
     if p.returncode != 0:
         return False
@@ -107,9 +105,39 @@ def _tpu_reachable(timeout_s: float = 120.0) -> bool:
     return False
 
 
-def main() -> None:
+def _stop_child(p: subprocess.Popen, grace_s: float = 60.0) -> str:
+    """SIGINT → long grace → SIGKILL. The grace period is what lets the
+    JAX runtime inside the child release the relay claim cleanly. Returns
+    whatever stdout the child produced — a measurement printed BEFORE the
+    hang (e.g. a result followed by a wedged teardown) must survive."""
+    out = ""
+    p.send_signal(signal.SIGINT)
+    try:
+        out, _ = p.communicate(timeout=grace_s)
+        return out or ""
+    except subprocess.TimeoutExpired:
+        pass
+    p.kill()
+    try:
+        out, _ = p.communicate(timeout=15)
+    except subprocess.TimeoutExpired:
+        pass
+    return out or ""
+
+
+def _parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true", help="tiny model, quick run")
+    ap.add_argument(
+        "--child", action="store_true",
+        help="(internal) run the measurement in THIS process; used by the "
+        "parent, which never imports JAX itself",
+    )
+    ap.add_argument(
+        "--model", default="1b", choices=["1b", "8b"],
+        help="model shape: 1b = Llama-3.2-1B-class proxy, 8b = Llama-3-8B "
+        "class (the BASELINE.md north-star shape; pair with int8 on one chip)",
+    )
     ap.add_argument("--slots", type=int, default=64)
     ap.add_argument("--prompt-len", type=int, default=128)
     ap.add_argument("--decode-steps", type=int, default=96)
@@ -118,6 +146,12 @@ def main() -> None:
         "--cpu", action="store_true",
         help="force the host CPU backend (also auto-selected when the TPU "
         "relay is unreachable, with the fallback named in the metric)",
+    )
+    ap.add_argument(
+        "--backend-note", default="",
+        help="(internal) metric-name backend annotation the parent passes "
+        "to the child (e.g. distinguishing operator-forced CPU from "
+        "relay-unreachable fallback)",
     )
     ap.add_argument(
         "--cache-mode", default="paged", choices=["paged", "slot"],
@@ -154,25 +188,30 @@ def main() -> None:
         default_watchdog = 900.0
     ap.add_argument(
         "--watchdog-seconds", type=float, default=default_watchdog,
-        help="emit a zero result and exit if the chip is silent this long (<=0 disables)",
+        help="parent-enforced limit on the measurement subprocess; on "
+        "expiry the child gets SIGINT + grace, and a zero line is emitted "
+        "(<=0 disables)",
     )
-    args = ap.parse_args()
+    return ap.parse_args(argv)
 
-    backend_note = ""
-    if args.cpu or os.environ.get("BENCH_FORCE_CPU") == "1":
+
+def _zero_line(reason: str) -> dict:
+    return {
+        "metric": f"llama decode throughput ({reason})",
+        "value": 0,
+        "unit": "tok/s",
+        "vs_baseline": 0,
+    }
+
+
+def _child_main(args) -> None:
+    """The actual measurement. Runs in a subprocess the parent can SIGINT;
+    prints the one JSON line on success (parent relays the last JSON line
+    it sees on stdout)."""
+    if args.cpu:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        backend_note = ", cpu backend (forced)"
-    elif not _tpu_reachable():
-        # A zero-value line helps nobody; measure the same code path on the
-        # host CPU and say so in the metric name.
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-        backend_note = ", CPU FALLBACK (TPU relay unreachable)"
-
-    done = _watchdog(args.watchdog_seconds)
 
     import numpy as np
 
@@ -180,6 +219,9 @@ def main() -> None:
     from kubeai_tpu.engine.sampling import SamplingParams
     from kubeai_tpu.models import llama
 
+    backend_note = args.backend_note or (
+        ", cpu backend (forced)" if args.cpu else ""
+    )
     if args.smoke:
         cfg = llama.LlamaConfig.tiny()
         args.slots, args.prompt_len, args.decode_steps = 4, 16, 20
@@ -187,8 +229,13 @@ def main() -> None:
         # Two warm-up steps at a large chunk would consume smoke's whole
         # 48-token budget before the timed loop runs (0 tok/s).
         args.decode_chunk = min(args.decode_chunk, 4)
+        model_name = "llama-tiny"
+    elif args.model == "8b":
+        cfg = llama_8b_cfg()
+        model_name = "llama-8b-class"
     else:
         cfg = llama_1b_cfg()
+        model_name = "llama-1b-class"
 
     params = llama.init_params(cfg)
     eng = Engine(
@@ -238,7 +285,7 @@ def main() -> None:
     toks_per_s = tokens / dt
     baseline = 2000.0  # BASELINE.json north-star: tok/s/chip on v5e
     result = {
-        "metric": "llama-1b-class decode throughput, continuous batching, "
+        "metric": f"{model_name} decode throughput, continuous batching, "
         f"bs={args.slots}, {args.cache_mode} kv cache, "
         + ("uniform" if args.uniform_prompts else "mixed")
         + " prompts"
@@ -257,8 +304,65 @@ def main() -> None:
         "unit": "tok/s",
         "vs_baseline": round(toks_per_s / baseline, 4),
     }
-    done.set()
-    print(json.dumps(result))
+    print(json.dumps(result), flush=True)
+
+
+def _parse_result(out: str) -> dict | None:
+    result = None
+    for line in (out or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                candidate = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(candidate, dict) and "value" in candidate:
+                result = candidate
+    return result
+
+
+def _run_measurement(argv: list[str], watchdog_s: float) -> dict | None:
+    """Spawn the measurement child, enforce the watchdog, return its JSON
+    result (the last JSON object line on its stdout) or None. A result the
+    child printed before hanging or crashing in teardown still counts —
+    the measurement itself was fine; only the relay teardown wasn't."""
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", *argv],
+        stdout=subprocess.PIPE,
+        stderr=sys.stderr,
+        text=True,
+    )
+    try:
+        out, _ = p.communicate(timeout=watchdog_s if watchdog_s > 0 else None)
+    except subprocess.TimeoutExpired:
+        out = _stop_child(p)
+    return _parse_result(out)
+
+
+def main() -> None:
+    args = _parse_args()
+    if args.child:
+        return _child_main(args)
+
+    # Parent: decide the backend WITHOUT importing JAX in this process.
+    argv = sys.argv[1:]
+    if os.environ.get("BENCH_FORCE_CPU") == "1" and "--cpu" not in argv:
+        argv = [*argv, "--cpu"]
+        args.cpu = True
+    if not args.cpu and not _tpu_reachable():
+        # A zero-value line helps nobody; measure the same code path on
+        # the host CPU and say so in the metric name.
+        argv = [
+            *argv, "--cpu",
+            "--backend-note", ", CPU FALLBACK (TPU relay unreachable)",
+        ]
+
+    result = _run_measurement(argv, args.watchdog_seconds)
+    if result is None:
+        print(json.dumps(_zero_line("measurement failed or watchdog fired")),
+              flush=True)
+        sys.exit(3)
+    print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

@@ -1390,7 +1390,18 @@ class Engine:
         False = fused chunk). Epsilon-greedy over measured tokens/s:
         sample each arm once, then run the winner, re-probing the loser
         every cfg.spec_probe_every calls so a workload shift (e.g. the
-        batch turning repetitive) is noticed."""
+        batch turning repetitive) is noticed.
+
+        Stream-stability caveat: mode invariance relies on both compiled
+        graphs producing the same sampled tokens. Greedy (temperature=0)
+        decoding is exactly mode-invariant (verify accepts iff tokens
+        match argmax). With temperature>0 the seeded sampler consumes the
+        same per-slot key sequence in both modes, but the two graphs may
+        differ in logits by ULPs on TPU, so a near-tie sample can flip at
+        a mode switch. That is within the API contract (sampling makes no
+        cross-process bitwise guarantee) but means tests asserting exact
+        seeded streams run on one mode; set spec_adaptive=False when
+        bitwise-stable seeded streams matter."""
         if not self.cfg.spec_adaptive:
             return True
         self._decode_calls += 1

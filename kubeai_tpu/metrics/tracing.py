@@ -47,10 +47,23 @@ _STATUS_OK = 1
 _STATUS_ERROR = 2
 
 
+# Module-private PRNG seeded from the OS: the global `random` is vulnerable
+# to user `random.seed()` calls, which would yield colliding trace/span ids
+# across processes. Forked children re-seed (a module-level Random is
+# otherwise duplicated across fork just like the global one).
+_id_rng = random.Random(int.from_bytes(os.urandom(16), "big"))
+if hasattr(os, "register_at_fork"):  # not on Windows
+    os.register_at_fork(
+        after_in_child=lambda: _id_rng.seed(
+            int.from_bytes(os.urandom(16), "big")
+        )
+    )
+
+
 def _rand_hex(nbytes: int) -> str:
-    # random (not uuid4) — cheap, and the spec only wants non-zero random.
+    # PRNG (not uuid4) — cheap, and the spec only wants non-zero random.
     while True:
-        h = random.getrandbits(nbytes * 8)
+        h = _id_rng.getrandbits(nbytes * 8)
         if h:
             return format(h, "0{}x".format(nbytes * 2))
 

@@ -115,18 +115,12 @@ def reconcile_adapters(
                 pass  # engine unreachable; retry on the next reconcile
 
         for adapter in to_ensure:
-            if adapter.name in candidates:
-                # URL changed on a live adapter: drop the routing label
-                # BEFORE the reload so the LB stops sending it traffic and
-                # in-flight requests drain — otherwise the engine's 409
-                # in-use refusal repeats forever under sustained traffic
-                # (same drain-first reasoning as the removal loop below).
-                # The label returns, with the new hash, after the reload
-                # succeeds.
-                _remove_pod_label(store, pod, md.adapter_label(adapter.name))
+            reload_in_place = adapter.name in candidates
             if engine == ENGINE_VLLM:
                 # Download via the loader sidecar, then point vLLM at the
-                # shared emptyDir path.
+                # shared emptyDir path. The fetch runs FIRST so a bad new
+                # URL fails before anything is drained or unloaded — the
+                # old adapter keeps serving through spec-update mistakes.
                 if not k8sutils.container_is_ready(pod, LOADER_CONTAINER):
                     raise ReturnEarly()
                 if pod_exec is not None:
@@ -136,24 +130,39 @@ def reconcile_adapters(
                         LOADER_CONTAINER,
                         ["load", adapter.url, adapter_dir(adapter)],
                     )
+                if reload_in_place:
+                    # vLLM cannot hot-reload a loaded lora_name (duplicate
+                    # load 400s "already loaded"), so a URL change must
+                    # drain (label off) + unload + fresh load. No unload
+                    # tombstone: the adapter stays in the spec, so a crash
+                    # window is re-ensured by the next reconcile, never
+                    # orphaned.
+                    _remove_pod_label(
+                        store, pod, md.adapter_label(adapter.name)
+                    )
+                    engine_client.unload_lora_adapter(
+                        addr, adapter.name, ignore_not_found=True
+                    )
                 engine_client.load_lora_adapter(
                     addr,
                     adapter.name,
                     lora_path=adapter_dir(adapter),
                     ignore_already_loaded=True,
                 )
+                _update_pod_label(
+                    store, pod, md.adapter_label(adapter.name),
+                    k8sutils.string_hash(adapter.url),
+                )
             else:
-                # TPU engine fetches the adapter itself from the URL.
-                engine_client.load_lora_adapter(
+                # TPU engine fetches the adapter itself from the URL and
+                # reloads in place when the source changes.
+                _load_or_drain(
+                    store, pod, engine_client, reload_in_place,
                     addr,
                     adapter.name,
+                    k8sutils.string_hash(adapter.url),
                     lora_url=adapter.url,
-                    ignore_already_loaded=True,
                 )
-            _update_pod_label(
-                store, pod, md.adapter_label(adapter.name),
-                k8sutils.string_hash(adapter.url),
-            )
 
         for name in to_remove:
             # Tombstone FIRST (a crash after the label is gone but before
@@ -167,6 +176,39 @@ def reconcile_adapters(
             _remove_pod_label(store, pod, md.adapter_label(name))
             engine_client.unload_lora_adapter(addr, name, ignore_not_found=True)
             _clear_pending_unload(store, pod, name)
+
+
+def _load_or_drain(
+    store: KubeStore,
+    pod: dict,
+    engine_client: EngineClient,
+    reload_in_place: bool,
+    addr: str,
+    name: str,
+    url_hash: str,
+    lora_url: str = "",
+) -> None:
+    """Load (or reload, on URL change) an in-tree-engine adapter, draining
+    ON DEMAND.
+
+    The TPU engine reloads in place when the source URL changes, so a
+    URL-change reload keeps the old routing label until the engine
+    actually refuses with an in-use 409 — dropping it eagerly converts a
+    bad spec update (fetch/load fails with 400/transport error) into an
+    indefinite routing outage while the old, still-loaded adapter would
+    have kept serving fine. On a 409 we drop the label so the LB drains
+    in-flight traffic and the backoff requeue retries; on any other
+    failure the old label (and the serving adapter) stay put."""
+    try:
+        engine_client.load_lora_adapter(
+            addr, name, lora_url=lora_url,
+            ignore_already_loaded=not reload_in_place,
+        )
+    except EngineClientError as e:
+        if reload_in_place and e.status == 409:
+            _remove_pod_label(store, pod, md.adapter_label(name))
+        raise
+    _update_pod_label(store, pod, md.adapter_label(name), url_hash)
 
 
 def _pending_unloads(pod: dict) -> set[str]:

@@ -353,8 +353,10 @@ class ControllerLoop:
         controller-runtime's requeue-on-error semantics (the reference's
         Reconcile returns err → backoff requeue)."""
         n = self._failures.get((ns, name), 0)
-        self._failures[(ns, name)] = n + 1
-        delay = min(30.0, 0.5 * (2.0 ** n))
+        # Cap the stored count: 2.0**1024 raises OverflowError, which would
+        # escape the worker's except handler and kill the reconcile loop.
+        self._failures[(ns, name)] = min(n + 1, 16)
+        delay = min(30.0, 0.5 * (2.0 ** min(n, 10)))
 
         def _put():
             if not self._stop.is_set():

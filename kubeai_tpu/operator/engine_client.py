@@ -18,7 +18,9 @@ import urllib.request
 
 
 class EngineClientError(RuntimeError):
-    pass
+    def __init__(self, message: str, status: int = 0):
+        super().__init__(message)
+        self.status = status  # HTTP status, 0 for transport errors
 
 
 class EngineClient:
@@ -76,7 +78,8 @@ class EngineClient:
         if ignore_already_loaded and "already" in text.lower():
             return
         raise EngineClientError(
-            f"load adapter {lora_name} at {addr}: HTTP {status}: {text[:200]}"
+            f"load adapter {lora_name} at {addr}: HTTP {status}: {text[:200]}",
+            status=status,
         )
 
     def unload_lora_adapter(
@@ -92,5 +95,6 @@ class EngineClient:
         if ignore_not_found and "not" in text.lower() and "found" in text.lower():
             return
         raise EngineClientError(
-            f"unload adapter {lora_name} at {addr}: HTTP {status}: {text[:200]}"
+            f"unload adapter {lora_name} at {addr}: HTTP {status}: {text[:200]}",
+            status=status,
         )

@@ -168,6 +168,20 @@ class OpenAIServer:
                     },
                 )
                 headers["traceparent"] = span.context.traceparent()
+                # Normally the chunk generator ends the span when the body
+                # finishes; this guard covers proxy.handle raising or the
+                # client disconnecting before the body loop iterates —
+                # otherwise the request never appears in traces. end() is
+                # idempotent, so the streamed-body path is unaffected.
+                try:
+                    self._do_proxied_post(normalized, headers, span, request_id, t0)
+                except BaseException as e:
+                    span.end(error=str(e) or type(e).__name__)
+                    raise
+                finally:
+                    span.end()
+
+            def _do_proxied_post(self, normalized, headers, span, request_id, t0):
                 length = int(self.headers.get("Content-Length", "0") or "0")
                 body = self.rfile.read(length) if length else b""
                 result = outer.proxy.handle(

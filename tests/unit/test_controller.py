@@ -356,7 +356,9 @@ def test_adapter_url_update_drains_before_reload(world):
                       ignore_already_loaded=False):
         if lora_url.endswith("v2") and refusals["n"] == 0:
             refusals["n"] += 1
-            raise EngineClientError("HTTP 409: adapter has in-flight requests")
+            raise EngineClientError(
+                "HTTP 409: adapter has in-flight requests", status=409
+            )
         return real_load(addr, lora_name, lora_path=lora_path,
                          lora_url=lora_url,
                          ignore_already_loaded=ignore_already_loaded)
@@ -377,6 +379,112 @@ def test_adapter_url_update_drains_before_reload(world):
     assert pod["metadata"]["labels"][md.adapter_label("fin")] == \
         k8sutils.string_hash("hf://org/fin-lora-v2")
     assert ec.unloaded == []
+
+
+def test_adapter_url_update_bad_url_keeps_old_label(world):
+    """A reload that fails for a NON-409 reason (e.g. the new URL 400s)
+    must leave the old routing label intact — the old, still-loaded
+    adapter keeps serving; dropping the label eagerly would convert a bad
+    spec update into an indefinite routing outage."""
+    from kubeai_tpu.operator.engine_client import EngineClientError
+
+    store, _, rec, ec = world
+    mk_model(
+        store,
+        name="mbad",
+        replicas=1,
+        adapters=[Adapter(name="fin", url="hf://org/fin-lora")],
+    )
+    rec.reconcile("default", "mbad")
+    pod = model_pods(store, "mbad")[0]
+    mark_ready(store, pod, ip="10.5.5.5")
+    rec.reconcile("default", "mbad")
+
+    real_load = ec.load_lora_adapter
+
+    def failing_load(addr, lora_name, lora_path="", lora_url="",
+                     ignore_already_loaded=False):
+        if lora_url.endswith("bogus"):
+            raise EngineClientError(
+                "HTTP 400: cannot fetch adapter", status=400
+            )
+        return real_load(addr, lora_name, lora_path=lora_path,
+                         lora_url=lora_url,
+                         ignore_already_loaded=ignore_already_loaded)
+
+    ec.load_lora_adapter = failing_load
+    m = store.get("Model", "default", "mbad")
+    m["spec"]["adapters"] = [{"name": "fin", "url": "hf://org/bogus"}]
+    store.update(m)
+    from kubeai_tpu.operator import k8sutils
+    for _ in range(3):  # every backoff retry keeps the old label serving
+        with pytest.raises(EngineClientError):
+            rec.reconcile("default", "mbad")
+        pod = model_pods(store, "mbad")[0]
+        assert pod["metadata"]["labels"][md.adapter_label("fin")] == \
+            k8sutils.string_hash("hf://org/fin-lora")
+
+
+def test_vllm_adapter_url_update_unload_reload(world):
+    """vLLM cannot hot-reload a loaded lora_name (duplicate load 400s), so
+    a URL change must fetch the new artifact FIRST (a bad URL then fails
+    before anything is drained), then drain + unload + fresh load."""
+    store, _, rec, ec = world
+
+    class FakeExec:
+        def __init__(self):
+            self.calls = []
+            self.fail_on = ""
+
+        def exec(self, namespace, pod, container, command):
+            if self.fail_on and self.fail_on in command[1]:
+                raise RuntimeError(f"fetch failed: {command[1]}")
+            self.calls.append(tuple(command))
+
+    fx = FakeExec()
+    rec.pod_exec = fx
+    mk_model(
+        store,
+        name="mvllm",
+        engine="VLLM",
+        resource_profile="cpu:1",
+        replicas=1,
+        adapters=[Adapter(name="fin", url="hf://org/fin-lora")],
+    )
+    rec.reconcile("default", "mvllm")
+    pod = model_pods(store, "mvllm")[0]
+    mark_ready(store, pod, ip="10.4.4.4")
+    fresh = store.get("Pod", "default", pod["metadata"]["name"])
+    fresh.setdefault("status", {})["containerStatuses"] = [
+        {"name": "loader", "ready": True}
+    ]
+    store.update(fresh)
+    rec.reconcile("default", "mvllm")
+    assert len(ec.loaded) == 1 and ec.unloaded == []
+
+    # URL change: fetch, then unload + reload; label carries the new hash.
+    m = store.get("Model", "default", "mvllm")
+    m["spec"]["adapters"] = [{"name": "fin", "url": "hf://org/fin-lora-v2"}]
+    store.update(m)
+    rec.reconcile("default", "mvllm")
+    assert fx.calls[-1][1] == "hf://org/fin-lora-v2"
+    assert ec.unloaded == [("http://10.4.4.4:8000", "fin")]
+    assert ec.loaded[-1][1] == "fin"
+    pod = model_pods(store, "mvllm")[0]
+    assert pod["metadata"]["labels"][md.adapter_label("fin")] == \
+        k8sutils.string_hash("hf://org/fin-lora-v2")
+
+    # Bad new URL: the fetch fails first; nothing unloaded, old label kept.
+    fx.fail_on = "bogus"
+    m = store.get("Model", "default", "mvllm")
+    m["spec"]["adapters"] = [{"name": "fin", "url": "hf://org/bogus"}]
+    store.update(m)
+    with pytest.raises(RuntimeError):
+        rec.reconcile("default", "mvllm")
+    assert len(ec.unloaded) == 1  # no second unload
+    pod = model_pods(store, "mvllm")[0]
+    assert pod["metadata"]["labels"][md.adapter_label("fin")] == \
+        k8sutils.string_hash("hf://org/fin-lora-v2")
 
 
 def test_address_override_annotations_flow_to_pod(world):
