@@ -313,12 +313,15 @@ def decode_step(params, cfg, tokens, positions, k_cache, v_cache,
 
 def decode_step_paged(params, cfg, tokens, positions, k_pages, v_pages,
                       block_tables, lora=None, lora_idx=None):
-    """Paged decode (block tables; see llama.decode_step_paged). The
-    per-layer sliding window rides the scan, so Gemma-2's alternating
-    local/global layers share one compiled graph."""
+    """Paged decode (block tables; see llama.decode_step_paged for the
+    fused-kernel layout rationale: pools stay outside the scan, the new
+    token rides as an extra attention column, and all layers' K/V write
+    back in one batched scatter). The per-layer sliding window rides the
+    scan, so Gemma-2's alternating local/global layers share one
+    compiled graph."""
     from kubeai_tpu.ops.paged_attention import (
-        paged_decode_attention,
-        scatter_decode_token,
+        batched_scatter_sequence,
+        paged_decode_attention_fused,
         token_page_coords,
     )
 
@@ -329,12 +332,11 @@ def decode_step_paged(params, cfg, tokens, positions, k_pages, v_pages,
     x = params["embed"][tokens].astype(jnp.float32)
     x = (x * (cfg.hidden_size ** 0.5)).astype(params["embed"].dtype)
     pos1 = positions[:, None]
-    lengths = positions + 1
     page_ids, offsets = token_page_coords(block_tables, positions, page_size)
 
     def layer(carry, scanned):
         x = carry
-        lp, kp, vp = scanned["p"], scanned["kp"], scanned["vp"]
+        lp = scanned["p"]
         h = _norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q = jnp.einsum("be,eh->bh", h, lp["wq"]).reshape(B, 1, H, D)
         k = jnp.einsum("be,eh->bh", h, lp["wk"]).reshape(B, 1, KVH, D)
@@ -342,9 +344,9 @@ def decode_step_paged(params, cfg, tokens, positions, k_pages, v_pages,
         q = apply_rope(q, pos1, inv_freq)[:, 0]
         k = apply_rope(k, pos1, inv_freq)[:, 0]
         v = v[:, 0]
-        kp, vp = scatter_decode_token(kp, vp, k, v, page_ids, offsets)
-        attn = paged_decode_attention(
-            q * (_q_scale(cfg) * D ** 0.5), kp, vp, block_tables, lengths,
+        attn = paged_decode_attention_fused(
+            q * (_q_scale(cfg) * D ** 0.5), k_pages, v_pages, k, v,
+            block_tables, positions, scanned["li"],
             logit_softcap=cfg.attn_logit_softcapping,
             window=scanned["win"] if cfg.sliding_window else None,
         )
@@ -357,14 +359,19 @@ def decode_step_paged(params, cfg, tokens, positions, k_pages, v_pages,
         if cfg.sandwich_norms:
             m_out = _norm(m_out, lp["post_mlp_norm"], cfg.rms_norm_eps)
         x = x + m_out
-        return x, (kp, vp)
+        return x, (k, v)
 
-    x, (k_pages, v_pages) = jax.lax.scan(
+    x, (k_all, v_all) = jax.lax.scan(
         layer, x,
         {
-            "p": params["layers"], "kp": k_pages, "vp": v_pages,
+            "p": params["layers"],
             "win": cfg.layer_windows(),
+            "li": jnp.arange(cfg.num_layers, dtype=jnp.int32),
         },
+    )
+    k_pages, v_pages = batched_scatter_sequence(
+        k_pages, v_pages, k_all[:, :, None], v_all[:, :, None],
+        page_ids[:, None], offsets[:, None],
     )
     x = _norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = jnp.einsum(

@@ -414,26 +414,14 @@ def decode_step(
     return logits, k_cache, v_cache
 
 
-def _paged_decode_layer(
-    x, scanned, cfg, inv_freq, msc, positions, lengths,
-    page_ids, offsets, block_tables, lora_idx,
-):
-    """One decode layer against per-layer page pools: project, rope,
-    scatter the new token's K/V through the block tables, attend over
-    resident pages, MLP. Shared by decode_step_paged (lax.scan over the
-    full stack) and decode_step_paged_pp (stage-local scan inside the
-    GPipe shard_map) so the two paths cannot drift numerically."""
-    from kubeai_tpu.ops.paged_attention import (
-        paged_decode_attention,
-        scatter_decode_token,
-    )
-
+def _decode_layer_qkv(x, lp, lor, cfg, inv_freq, msc, pos1, lora_idx):
+    """Shared decode-layer front half: norm, QKV projection (+bias/LoRA),
+    rope. Returns (q [B,H,D], k [B,KVH,D], v [B,KVH,D], proj) where proj
+    is reused for the output projection. One body for the fused path
+    (decode_step_paged) AND the pipeline path (_paged_decode_layer) so
+    the projection/LoRA math cannot drift between them."""
     B = x.shape[0]
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
-    lp = scanned["p"]
-    lor = scanned.get("l")
-    kp, vp = scanned["kp"], scanned["vp"]
-    pos1 = positions[:, None]
 
     def proj(h, w, target, bias=None):
         out = jnp.einsum("be,eh->bh", h, _w(w))
@@ -451,12 +439,44 @@ def _paged_decode_layer(
     v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, 1, KVH, D)
     q = apply_rope(q, pos1, inv_freq, msc)[:, 0]  # [B, H, D]
     k = apply_rope(k, pos1, inv_freq, msc)[:, 0]  # [B, KVH, D]
-    v = v[:, 0]
-    kp, vp = scatter_decode_token(kp, vp, k, v, page_ids, offsets)
-    attn = paged_decode_attention(q, kp, vp, block_tables, lengths)
+    return q, k, v[:, 0], proj
+
+
+def _decode_layer_finish(x, attn, lp, proj, cfg):
+    """Shared decode-layer back half: output projection, residual, MLP."""
+    B = x.shape[0]
+    H, D = cfg.num_heads, cfg.head_size
     x = x + proj(attn.reshape(B, H * D), lp["wo"], "wo")
     h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
     x = x + _mlp(h2[:, None], lp["w_gate"], lp["w_up"], lp["w_down"])[:, 0]
+    return x
+
+
+def _paged_decode_layer(
+    x, scanned, cfg, inv_freq, msc, positions, lengths,
+    page_ids, offsets, block_tables, lora_idx,
+):
+    """One decode layer against per-layer page pools: project, rope,
+    scatter the new token's K/V through the block tables, attend over
+    resident pages, MLP. Used by decode_step_paged_pp (stage-local scan
+    inside the GPipe shard_map), whose pools are stage-local scan
+    carries; the single-chip fused path (decode_step_paged) shares the
+    projection/MLP halves via _decode_layer_qkv/_decode_layer_finish but
+    attends through the fused kernel with a deferred scatter."""
+    from kubeai_tpu.ops.paged_attention import (
+        paged_decode_attention,
+        scatter_decode_token,
+    )
+
+    lp = scanned["p"]
+    lor = scanned.get("l")
+    kp, vp = scanned["kp"], scanned["vp"]
+    q, k, v, proj = _decode_layer_qkv(
+        x, lp, lor, cfg, inv_freq, msc, positions[:, None], lora_idx
+    )
+    kp, vp = scatter_decode_token(kp, vp, k, v, page_ids, offsets)
+    attn = paged_decode_attention(q, kp, vp, block_tables, lengths)
+    x = _decode_layer_finish(x, attn, lp, proj, cfg)
     return x, (kp, vp)
 
 
@@ -471,14 +491,26 @@ def decode_step_paged(
     lora: dict | None = None,
     lora_idx: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Decode step against the PAGED cache: the new token's K/V scatter
-    through the block tables and attention reads only each slot's resident
-    pages (Pallas kernel on TPU; gather reference elsewhere). HBM traffic
-    per step is O(sum of true lengths), not O(B * max_seq_len) — the
-    reason paging beats the slot cache under mixed-length batches."""
-    from kubeai_tpu.ops.paged_attention import token_page_coords
+    """Decode step against the PAGED cache, fused-kernel layout:
+
+    The stacked [NL, ...] page pools stay OUTSIDE the layer scan and are
+    read by the fused Pallas kernel straight from HBM via a
+    scalar-prefetched layer index — the old layout scanned the pools as
+    xs/ys, which round-tripped the entire pool (GBs) through slice +
+    re-stack every decode step and materialized each slice to feed the
+    opaque pallas_call. The new token's K/V is folded in as an extra
+    attention column (it is NOT in the pool yet), collected per layer,
+    and written back in ONE batched scatter after the scan — per-step
+    cache write traffic is O(NL * B) tokens, and read traffic is only
+    each slot's resident pages."""
+    from kubeai_tpu.ops.paged_attention import (
+        batched_scatter_sequence,
+        paged_decode_attention_fused,
+        token_page_coords,
+    )
 
     B = tokens.shape[0]
+    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
     page_size = k_pages.shape[2]
     inv_freq = jnp.asarray(
         rope_frequencies(
@@ -488,19 +520,48 @@ def decode_step_paged(
     )
     msc = rope_attention_scaling(cfg.rope_scaling)
     x = params["embed"][tokens]  # [B, E]
-    lengths = positions + 1
     page_ids, offsets = token_page_coords(block_tables, positions, page_size)
+    pos1 = positions[:, None]
 
     def layer(carry, scanned):
-        return _paged_decode_layer(
-            carry, scanned, cfg, inv_freq, msc, positions, lengths,
-            page_ids, offsets, block_tables, lora_idx,
+        x = carry
+        lp = scanned["p"]
+        lor = scanned.get("l")
+        li = scanned["li"]
+
+        def proj(h, w, target, bias=None):
+            out = jnp.einsum("be,eh->bh", h, _w(w))
+            if bias is not None:
+                out = out + bias
+            if lor is not None:
+                out = out + _lora_delta(
+                    h, lor[target]["A"], lor[target]["B"], lora_idx
+                )
+            return out
+
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q = proj(h, lp["wq"], "wq", lp.get("bq")).reshape(B, 1, H, D)
+        k = proj(h, lp["wk"], "wk", lp.get("bk")).reshape(B, 1, KVH, D)
+        v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, 1, KVH, D)
+        q = apply_rope(q, pos1, inv_freq, msc)[:, 0]  # [B, H, D]
+        k = apply_rope(k, pos1, inv_freq, msc)[:, 0]  # [B, KVH, D]
+        v = v[:, 0]
+        attn = paged_decode_attention_fused(
+            q, k_pages, v_pages, k, v, block_tables, positions, li
         )
+        x = x + proj(attn.reshape(B, H * D), lp["wo"], "wo")
+        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(h2[:, None], lp["w_gate"], lp["w_up"], lp["w_down"])[:, 0]
+        return x, (k, v)
 
     xs = _scan_xs(params, lora)
-    xs["kp"] = k_pages
-    xs["vp"] = v_pages
-    x, (k_pages, v_pages) = jax.lax.scan(layer, x, xs)
+    xs["li"] = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+    x, (k_all, v_all) = jax.lax.scan(layer, x, xs)
+    # One batched write for every layer's new token ([NL, B, KVH, D]).
+    k_pages, v_pages = batched_scatter_sequence(
+        k_pages, v_pages, k_all[:, :, None], v_all[:, :, None],
+        page_ids[:, None], offsets[:, None],
+    )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = jnp.einsum(
         "be,ve->bv", x, params["lm_head"],

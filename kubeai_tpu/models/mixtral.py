@@ -240,12 +240,12 @@ def decode_step(params, cfg, tokens, positions, k_cache, v_cache,
 
 def decode_step_paged(params, cfg, tokens, positions, k_pages, v_pages,
                       block_tables, lora=None, lora_idx=None):
-    """Paged decode (block tables; see llama.decode_step_paged): scatter
-    the new token's K/V through the tables, attend over resident pages,
-    MoE FFN unchanged."""
+    """Paged decode (block tables; see llama.decode_step_paged for the
+    fused-kernel layout: pools outside the scan, new token as an extra
+    attention column, one batched scatter after), MoE FFN unchanged."""
     from kubeai_tpu.ops.paged_attention import (
-        paged_decode_attention,
-        scatter_decode_token,
+        batched_scatter_sequence,
+        paged_decode_attention_fused,
         token_page_coords,
     )
 
@@ -255,12 +255,11 @@ def decode_step_paged(params, cfg, tokens, positions, k_pages, v_pages,
     inv_freq = jnp.asarray(rope_frequencies(D, cfg.rope_theta))
     x = params["embed"][tokens]
     pos1 = positions[:, None]
-    lengths = positions + 1
     page_ids, offsets = token_page_coords(block_tables, positions, page_size)
 
     def layer(carry, scanned):
         x = carry
-        lp, kp, vp = scanned["p"], scanned["kp"], scanned["vp"]
+        lp = scanned["p"]
         h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q = jnp.einsum("be,eh->bh", h, lp["wq"]).reshape(B, 1, H, D)
         k = jnp.einsum("be,eh->bh", h, lp["wk"]).reshape(B, 1, KVH, D)
@@ -268,15 +267,25 @@ def decode_step_paged(params, cfg, tokens, positions, k_pages, v_pages,
         q = apply_rope(q, pos1, inv_freq)[:, 0]
         k = apply_rope(k, pos1, inv_freq)[:, 0]
         v = v[:, 0]
-        kp, vp = scatter_decode_token(kp, vp, k, v, page_ids, offsets)
-        attn = paged_decode_attention(q, kp, vp, block_tables, lengths)
+        attn = paged_decode_attention_fused(
+            q, k_pages, v_pages, k, v, block_tables, positions,
+            scanned["li"],
+        )
         x = x + jnp.einsum("bh,he->be", attn.reshape(B, H * D), lp["wo"])
         h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
         x = x + _moe_ffn(h2[:, None], lp, cfg)[:, 0]
-        return x, (kp, vp)
+        return x, (k, v)
 
-    x, (k_pages, v_pages) = jax.lax.scan(
-        layer, x, {"p": params["layers"], "kp": k_pages, "vp": v_pages}
+    x, (k_all, v_all) = jax.lax.scan(
+        layer, x,
+        {
+            "p": params["layers"],
+            "li": jnp.arange(cfg.num_layers, dtype=jnp.int32),
+        },
+    )
+    k_pages, v_pages = batched_scatter_sequence(
+        k_pages, v_pages, k_all[:, :, None], v_all[:, :, None],
+        page_ids[:, None], offsets[:, None],
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = jnp.einsum(

@@ -133,22 +133,42 @@ def reconcile_adapters(
                 if reload_in_place:
                     # vLLM cannot hot-reload a loaded lora_name (duplicate
                     # load 400s "already loaded"), so a URL change must
-                    # drain (label off) + unload + fresh load. No unload
-                    # tombstone: the adapter stays in the spec, so a crash
-                    # window is re-ensured by the next reconcile, never
-                    # orphaned.
+                    # drain (label off) + unload + fresh load. A crash in
+                    # this window is re-ensured by the next reconcile: the
+                    # adapter stays in the spec, and the "already loaded"
+                    # recovery below resolves whichever half-state the
+                    # engine was left in.
                     _remove_pod_label(
                         store, pod, md.adapter_label(adapter.name)
                     )
                     engine_client.unload_lora_adapter(
                         addr, adapter.name, ignore_not_found=True
                     )
-                engine_client.load_lora_adapter(
-                    addr,
-                    adapter.name,
-                    lora_path=adapter_dir(adapter),
-                    ignore_already_loaded=True,
-                )
+                try:
+                    engine_client.load_lora_adapter(
+                        addr,
+                        adapter.name,
+                        lora_path=adapter_dir(adapter),
+                    )
+                except EngineClientError as e:
+                    if "already" not in str(e).lower():
+                        raise
+                    # "Already loaded" while the pod label is absent or
+                    # stale means the engine holds weights of UNKNOWN
+                    # vintage (the label hash is the only version record,
+                    # and vLLM loads from the same shared dir every time —
+                    # e.g. a prior reconcile crashed between label removal
+                    # and unload). Swallowing it would stamp the new hash
+                    # over stale weights forever; resolve by unload +
+                    # fresh load of the just-fetched artifact.
+                    engine_client.unload_lora_adapter(
+                        addr, adapter.name, ignore_not_found=True
+                    )
+                    engine_client.load_lora_adapter(
+                        addr,
+                        adapter.name,
+                        lora_path=adapter_dir(adapter),
+                    )
                 _update_pod_label(
                     store, pod, md.adapter_label(adapter.name),
                     k8sutils.string_hash(adapter.url),

@@ -571,6 +571,340 @@ def paged_verify_attention(
     return out.reshape(b, spec_k, h, d)
 
 
+# ---- fused decode kernel (stacked pools, deferred scatter) -------------------
+#
+# The decode-step redesign that closes the roofline gap (ROADMAP round-3
+# item 1). Three wastes in the original scatter-then-attend layer loop:
+#   1. lax.scan sliced each layer's [P, page, KVH, D] pool out of the
+#      stacked array and re-stacked the updated slice — a full KV-pool
+#      round-trip through HBM every decode step (~2 GB at bs=64/1B) even
+#      though only B tokens/layer actually change.
+#   2. pallas_call is opaque to XLA, so the sliced operand MATERIALIZES
+#      (no fusion into the kernel).
+#   3. Grid (slots, pages) ran one small page DMA per step — latency-
+#      bound, not bandwidth-bound.
+# The fused kernel fixes all three: it takes the FULL [NL, ...] pool plus
+# a scalar-prefetched layer index (the index map adds the layer offset —
+# no slicing, no materialization), attends the NEW token as an explicit
+# extra column merged at finalize (so the pool stays read-only and the
+# scatter defers to ONE batched write after the layer scan), and DMAs a
+# STRIP of pages per grid step with the slot dimension megacore-parallel.
+
+
+def _fused_attend_page(
+    q_ref, k_ref, valid, m_ref, l_ref, acc_ref, v_ref,
+    *, scale, logit_softcap, kvh,
+):
+    """Online-softmax update of all kv heads over one [page] block.
+    k_ref/v_ref are [1, 1, page, KVH, D] strip blocks."""
+    for kh in range(kvh):
+        q = q_ref[0, kh].astype(jnp.float32) * scale  # [G, D]
+        k = k_ref[0, 0, :, kh].astype(jnp.float32)  # [page, D]
+        v = v_ref[0, 0, :, kh].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [G, page]
+        if logit_softcap is not None:
+            s = jnp.tanh(s / logit_softcap) * logit_softcap
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_ref[kh]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[kh] = m_new
+        l_ref[kh] = l_ref[kh] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[kh] = acc_ref[kh] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+
+
+def _paged_fused_kernel(
+    # scalar-prefetch
+    bt_ref,  # [B, MP] int32 block tables
+    pos_ref,  # [B] int32 OLD lengths (the new token's position)
+    win_ref,  # [1] int32 sliding window (<= 0 = disabled)
+    layer_ref,  # [1] int32 layer index into the stacked pool
+    # blocks
+    q_ref,  # [1, KVH, G, D]
+    kn_ref,  # [1, KVH, D] the new token's K (not yet in the pool)
+    vn_ref,  # [1, KVH, D]
+    *refs,  # strip k blocks, strip v blocks [1, 1, page, KVH, D], then o_ref
+    # (scratch appended by pallas: m, l, acc)
+    page_size: int,
+    kvh: int,
+    group: int,
+    strip: int,
+    scale: float,
+    logit_softcap: float | None,
+):
+    k_refs = refs[:strip]
+    v_refs = refs[strip:2 * strip]
+    o_ref = refs[2 * strip]  # [1, KVH, G, D]
+    m_ref, l_ref, acc_ref = refs[2 * strip + 1:2 * strip + 4]
+
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+    ns = pl.num_programs(1)
+    pos = pos_ref[b]
+    win = win_ref[0]
+    length = pos + 1  # including the new token
+    n_pages = pl.cdiv(pos, page_size)  # pages holding OLD tokens
+    first = jnp.where(
+        win > 0, jnp.maximum(length - win, 0) // page_size, 0
+    )
+
+    @pl.when(s == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    for t in range(strip):
+        i = s * strip + t
+
+        @pl.when((i >= first) & (i < n_pages))
+        def _attend(i=i, t=t):
+            pcol = i * page_size + jax.lax.broadcasted_iota(
+                jnp.int32, (group, page_size), 1
+            )
+            valid = pcol < pos  # old tokens only; new token merged below
+            valid = valid & ((win <= 0) | (pcol >= length - win))
+            _fused_attend_page(
+                q_ref, k_refs[t], valid, m_ref, l_ref, acc_ref, v_refs[t],
+                scale=scale, logit_softcap=logit_softcap, kvh=kvh,
+            )
+
+    @pl.when(s == ns - 1)
+    def _finalize():
+        # Merge the new token as one extra column (always valid — it is
+        # the query's own position, inside any window), then normalize.
+        q = q_ref[0].astype(jnp.float32) * scale  # [KVH, G, D]
+        kn = kn_ref[0].astype(jnp.float32)  # [KVH, D]
+        vn = vn_ref[0].astype(jnp.float32)
+        s_new = jnp.sum(q * kn[:, None, :], axis=-1)  # [KVH, G]
+        if logit_softcap is not None:
+            s_new = jnp.tanh(s_new / logit_softcap) * logit_softcap
+        s_new = s_new[..., None]  # [KVH, G, 1]
+        m_prev = m_ref[:]
+        m_fin = jnp.maximum(m_prev, s_new)
+        p = jnp.exp(s_new - m_fin)
+        alpha = jnp.exp(m_prev - m_fin)
+        l_fin = l_ref[:] * alpha + p
+        acc_fin = acc_ref[:] * alpha + p * vn[:, None, :]
+        out = acc_fin / jnp.maximum(l_fin, 1e-30)  # [KVH, G, D]
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+def _fused_page_index(
+    b, s, bt_ref, pos_ref, win_ref, layer_ref, *, page_size, strip, t
+):
+    """Index map for strip member t: slot b's (s*strip + t)-th page of
+    layer layer_ref[0]. Outside the live range the index clamps to the
+    nearest live page so an unchanged block index elides the DMA."""
+    pos = pos_ref[b]
+    win = win_ref[0]
+    last = jnp.maximum(pl.cdiv(pos, page_size) - 1, 0)
+    first = jnp.where(
+        win > 0, jnp.maximum(pos + 1 - win, 0) // page_size, 0
+    )
+    clamped = jnp.clip(s * strip + t, first, last)
+    page_id = jnp.maximum(bt_ref[b, clamped], 0)
+    return layer_ref[0], page_id, 0, 0, 0
+
+
+# Pages fetched per grid step. 4 × 64-token pages ≈ 512 KB of K+V per
+# step at KVH=8/D=64/bf16 — enough DMA in flight to be bandwidth-bound
+# instead of latency-bound, without blowing VMEM.
+FUSED_STRIP = 4
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "logit_softcap", "interpret"),
+)
+def _paged_fused_pallas(
+    q,  # [B, KVH, G, D]
+    k_pages,  # [NL, P, page, KVH, D] FULL stacked pool
+    v_pages,
+    k_new,  # [B, KVH, D]
+    v_new,
+    block_tables,  # [B, MP]
+    positions,  # [B] old lengths
+    window,  # [1] int32
+    layer,  # [1] int32
+    *,
+    scale: float,
+    logit_softcap: float | None,
+    interpret: bool,
+):
+    b, kvh, g, d = q.shape
+    _, p, page, _, _ = k_pages.shape
+    mp = block_tables.shape[1]
+    strip = min(FUSED_STRIP, mp)
+    ns = -(-mp // strip)
+
+    kernel = functools.partial(
+        _paged_fused_kernel,
+        page_size=page,
+        kvh=kvh,
+        group=g,
+        strip=strip,
+        scale=scale,
+        logit_softcap=logit_softcap,
+    )
+    page_spec = [
+        pl.BlockSpec(
+            (1, 1, page, kvh, d),
+            functools.partial(
+                _fused_page_index, page_size=page, strip=strip, t=t
+            ),
+        )
+        for t in range(strip)
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(b, ns),
+        in_specs=[
+            pl.BlockSpec(
+                (1, kvh, g, d), lambda b_, s_, *refs: (b_, 0, 0, 0)
+            ),
+            pl.BlockSpec((1, kvh, d), lambda b_, s_, *refs: (b_, 0, 0)),
+            pl.BlockSpec((1, kvh, d), lambda b_, s_, *refs: (b_, 0, 0)),
+            *page_spec,  # k strip
+            *page_spec,  # v strip (same index maps)
+        ],
+        out_specs=pl.BlockSpec(
+            (1, kvh, g, d), lambda b_, s_, *refs: (b_, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((kvh, g, 1), jnp.float32),
+            pltpu.VMEM((kvh, g, 1), jnp.float32),
+            pltpu.VMEM((kvh, g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            # Slots are independent (scratch re-inits at s == 0 per slot):
+            # split them across the two TensorCores.
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        block_tables, positions, window, layer,
+        q, k_new, v_new,
+        *([k_pages] * strip), *([v_pages] * strip),
+    )
+    return out
+
+
+def ref_paged_decode_attention_fused(
+    q: jnp.ndarray,  # [B, H, D]
+    k_pages: jnp.ndarray,  # [NL, P, page, KVH, D] stacked pools
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,  # [B, KVH, D]
+    v_new: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, MP]
+    positions: jnp.ndarray,  # [B] OLD lengths (new token's position)
+    layer: jnp.ndarray,  # scalar int32
+    *,
+    scale: float | None = None,
+    logit_softcap: float | None = None,
+    window: jnp.ndarray | int | None = None,
+) -> jnp.ndarray:
+    """Reference semantics for the fused kernel: attention over the
+    resident pages of `layer` PLUS the new token as an explicit extra
+    column at position `positions`. Bit-equivalent (up to fp reorder) to
+    scatter-then-attend with lengths = positions + 1."""
+    b, h, d = q.shape
+    kvh = k_pages.shape[3]
+    kp = jax.lax.dynamic_index_in_dim(
+        k_pages, layer, axis=0, keepdims=False
+    )
+    vp = jax.lax.dynamic_index_in_dim(
+        v_pages, layer, axis=0, keepdims=False
+    )
+    bt = jnp.maximum(block_tables, 0)
+    k = kp[bt]  # [B, MP, page, KVH, D]
+    v = vp[bt]
+    mp, page = k.shape[1], k.shape[2]
+    L = mp * page
+    k = k.reshape(b, L, kvh, d)
+    v = v.reshape(b, L, kvh, d)
+    # Append the new token as column L.
+    k = jnp.concatenate([k, k_new[:, None].astype(k.dtype)], axis=1)
+    v = jnp.concatenate([v, v_new[:, None].astype(v.dtype)], axis=1)
+    scale = scale if scale is not None else d ** -0.5
+    qg = (q * scale).reshape(b, kvh, h // kvh, d)
+    logits = jnp.einsum(
+        "bkgd,blkd->bkgl", qg.astype(jnp.float32), k.astype(jnp.float32)
+    )
+    if logit_softcap is not None:
+        logits = jnp.tanh(logits / logit_softcap) * logit_softcap
+    col = jnp.arange(L + 1)
+    # Columns < positions are old tokens; column L is the new token.
+    mask = (col[None, :] < positions[:, None]) | (col[None, :] == L)
+    if window is not None:
+        win = jnp.asarray(window, jnp.int32)
+        lengths = positions + 1
+        in_win = (win <= 0) | (col[None, :] >= lengths[:, None] - win)
+        mask = mask & (in_win | (col[None, :] == L))
+    logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgl,blkd->bkgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, h, d).astype(q.dtype)
+
+
+def paged_decode_attention_fused(
+    q: jnp.ndarray,  # [B, H, D]
+    k_pages: jnp.ndarray,  # [NL, P, page, KVH, D] stacked pools
+    v_pages: jnp.ndarray,
+    k_new: jnp.ndarray,  # [B, KVH, D]
+    v_new: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, MP]
+    positions: jnp.ndarray,  # [B] OLD lengths
+    layer: jnp.ndarray | int,  # layer index into the stacked pool
+    *,
+    scale: float | None = None,
+    logit_softcap: float | None = None,
+    window: jnp.ndarray | int | None = None,
+    use_pallas: bool | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused paged decode attention: reads the layer's resident pages
+    straight out of the STACKED pool (no per-layer slice materialization)
+    and folds the not-yet-scattered new token in as an extra column, so
+    the caller can defer all KV-cache writes to one batched scatter after
+    the layer scan. See module docstring for why this is the fast path."""
+    b, h, d = q.shape
+    kvh = k_pages.shape[3]
+    scale = scale if scale is not None else d ** -0.5
+    layer_arr = jnp.asarray(layer, jnp.int32)
+    if use_pallas is None:
+        use_pallas = (
+            _HAS_PLTPU
+            and not interpret
+            and jax.default_backend() not in ("cpu",)
+            and paged_supported(d, k_pages.shape[2])
+        )
+    if not use_pallas and not interpret:
+        return ref_paged_decode_attention_fused(
+            q, k_pages, v_pages, k_new, v_new, block_tables, positions,
+            layer_arr, scale=scale, logit_softcap=logit_softcap,
+            window=window,
+        )
+    win_arr = jnp.asarray(
+        [0 if window is None else window], jnp.int32
+    ).reshape(1)
+    qg = q.reshape(b, kvh, h // kvh, d)
+    out = _paged_fused_pallas(
+        qg, k_pages, v_pages, k_new, v_new, block_tables, positions,
+        win_arr, layer_arr.reshape(1),
+        scale=scale, logit_softcap=logit_softcap, interpret=interpret,
+    )
+    return out.reshape(b, h, d)
+
+
 # ---- paged cache writes (decode + admission) ---------------------------------
 
 

@@ -197,9 +197,15 @@ class ModelProxy:
             ]
 
             def chunks(resp=resp, conn=conn, done=done):
+                # read1 (not read): read(n) on a chunked response BLOCKS
+                # until n bytes accumulate, which buffers ~160 small SSE
+                # events before anything reaches the client — destroying
+                # streaming TTFT/ITL through the proxy. read1 returns as
+                # soon as any data is available.
+                read = getattr(resp, "read1", resp.read)
                 try:
                     while True:
-                        chunk = resp.read(16384)
+                        chunk = read(16384)
                         if not chunk:
                             break
                         yield chunk

@@ -10,7 +10,9 @@ from kubeai_tpu.engine.paged_cache import PageAllocator, set_block_table
 from kubeai_tpu.ops.attention import decode_attention
 from kubeai_tpu.ops.paged_attention import (
     paged_decode_attention,
+    paged_decode_attention_fused,
     ref_paged_decode_attention,
+    ref_paged_decode_attention_fused,
     scatter_decode_token,
     scatter_sequence,
     sequence_page_coords,
@@ -90,6 +92,106 @@ def test_kernel_softcap_and_window():
                 q, kp, vp, bt, lengths, logit_softcap=cap
             )
             assert float(jnp.max(jnp.abs(got - full))) > 1e-4
+
+
+def _fused_setup(old_lengths, n_layers=3, seed=0):
+    """Stacked [NL, ...] pools holding each slot's OLD tokens, plus a new
+    token's K/V per layer that is NOT yet scattered."""
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    k_pages = np.zeros((n_layers, P, PAGE, KVH, D), np.float32)
+    v_pages = np.zeros((n_layers, P, PAGE, KVH, D), np.float32)
+    alloc = PageAllocator(P, PAGE, max_pages_per_slot=MP)
+    bt = jnp.full((B, MP), -1, jnp.int32)
+    for s, ln in enumerate(old_lengths):
+        pages = alloc.ensure(s, ln + 1)  # room for the new token
+        bt = set_block_table(bt, s, pages)
+        kv = rng.standard_normal((2, n_layers, ln, KVH, D)).astype(np.float32)
+        for t in range(ln):
+            k_pages[:, pages[t // PAGE], t % PAGE] = kv[0, :, t]
+            v_pages[:, pages[t // PAGE], t % PAGE] = kv[1, :, t]
+    k_new = jnp.asarray(rng.standard_normal((B, KVH, D)), jnp.float32)
+    v_new = jnp.asarray(rng.standard_normal((B, KVH, D)), jnp.float32)
+    return (
+        q, jnp.asarray(k_pages), jnp.asarray(v_pages), k_new, v_new, bt,
+        jnp.asarray(old_lengths, jnp.int32),
+    )
+
+
+def test_fused_reference_matches_scatter_then_attend():
+    """The fused path (pool read-only + new-token column) must equal the
+    original scatter-then-attend semantics with lengths = positions+1."""
+    q, kp, vp, kn, vn, bt, pos = _fused_setup([5, 17, 30], seed=7)
+    for layer in range(kp.shape[0]):
+        fused = ref_paged_decode_attention_fused(
+            q, kp, vp, kn, vn, bt, pos, jnp.int32(layer)
+        )
+        pids, offs = token_page_coords(bt, pos, PAGE)
+        kl, vl = scatter_decode_token(kp[layer], vp[layer], kn, vn, pids, offs)
+        want = ref_paged_decode_attention(q, kl, vl, bt, pos + 1)
+        np.testing.assert_allclose(
+            np.asarray(fused), np.asarray(want), atol=1e-5, rtol=1e-5
+        )
+
+
+def test_fused_kernel_matches_reference():
+    q, kp, vp, kn, vn, bt, pos = _fused_setup([5, 17, 30], seed=11)
+    for layer in (0, 2):
+        got = paged_decode_attention_fused(
+            q, kp, vp, kn, vn, bt, pos, layer,
+            use_pallas=True, interpret=True,
+        )
+        want = ref_paged_decode_attention_fused(
+            q, kp, vp, kn, vn, bt, pos, jnp.int32(layer)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+        )
+
+
+def test_fused_kernel_softcap_and_window():
+    q, kp, vp, kn, vn, bt, pos = _fused_setup([9, 26, 31], seed=13)
+    for cap, win in ((30.0, None), (None, 12), (50.0, 7)):
+        got = paged_decode_attention_fused(
+            q, kp, vp, kn, vn, bt, pos, 1,
+            logit_softcap=cap, window=win, use_pallas=True, interpret=True,
+        )
+        want = ref_paged_decode_attention_fused(
+            q, kp, vp, kn, vn, bt, pos, jnp.int32(1),
+            logit_softcap=cap, window=win,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-4
+        )
+        # Window semantics must also match scatter-then-attend.
+        pids, offs = token_page_coords(bt, pos, PAGE)
+        kl, vl = scatter_decode_token(kp[1], vp[1], kn, vn, pids, offs)
+        oracle = ref_paged_decode_attention(
+            q, kl, vl, bt, pos + 1, logit_softcap=cap, window=win
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(oracle), atol=1e-4, rtol=1e-4
+        )
+
+
+def test_fused_empty_slot_returns_value_of_new_token():
+    """A slot with zero old tokens attends only its own new token."""
+    q, kp, vp, kn, vn, bt, pos = _fused_setup([0, 8, 3], seed=17)
+    out = ref_paged_decode_attention_fused(
+        q, kp, vp, kn, vn, bt, pos, jnp.int32(0)
+    )
+    want0 = jnp.broadcast_to(
+        vn[0][:, None, :], (KVH, G, D)
+    ).reshape(H, D)
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.asarray(want0), atol=1e-5
+    )
+    got = paged_decode_attention_fused(
+        q, kp, vp, kn, vn, bt, pos, 0, use_pallas=True, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(out), atol=1e-4, rtol=1e-4
+    )
 
 
 def test_window_matches_dense_masked_oracle():

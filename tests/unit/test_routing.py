@@ -346,6 +346,86 @@ def test_5xx_details_stripped(stack):
     assert b"secret" not in data
 
 
+def test_sse_streams_incrementally_through_proxy(stack):
+    """A streaming response must reach the client chunk by chunk — the
+    proxy may not buffer SSE (regression: read(n) on a chunked upstream
+    blocked until n bytes accumulated, holding ~160 events back and
+    destroying TTFT/ITL through the proxy)."""
+    import http.client
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    store, lb, server, add_model, _ = stack
+    first_chunk_seen = threading.Event()
+    release_rest = threading.Event()
+
+    class StreamingEngine(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(p: bytes):
+                self.wfile.write(f"{len(p):x}\r\n".encode() + p + b"\r\n")
+
+            chunk(b"data: first\n\n")
+            # Hold the rest until the CLIENT has observed chunk one: if
+            # the proxy buffers, the client never sees it and the 5s
+            # wait below fails the test.
+            release_rest.wait(timeout=5)
+            chunk(b"data: second\n\n")
+            chunk(b"data: [DONE]\n\n")
+            self.wfile.write(b"0\r\n\r\n")
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), StreamingEngine)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        add_model(name="mstream")
+        # Point the pod at the streaming engine instead of the FakeEngine.
+        pods = store.list("Pod", "default", {"model": "mstream"})
+        pod = store.get("Pod", "default", pods[0]["metadata"]["name"])
+        pod["metadata"]["annotations"]["model-pod-port"] = str(
+            httpd.server_address[1]
+        )
+        store.update(pod)
+        lb.sync_model("mstream")
+
+        host, _, port = server.address.partition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        conn.request(
+            "POST", "/openai/v1/chat/completions",
+            body=json.dumps(
+                {"model": "mstream", "messages": [], "stream": True}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        got = resp.read1(16384)  # must yield BEFORE the engine finishes
+        assert b"first" in got, got
+        first_chunk_seen.set()
+        release_rest.set()
+        rest = b""
+        while b"[DONE]" not in rest:
+            piece = resp.read1(16384)
+            if not piece:
+                break
+            rest += piece
+        assert b"second" in rest and b"[DONE]" in rest
+        conn.close()
+    finally:
+        release_rest.set()
+        httpd.shutdown()
+        httpd.server_close()
+
+
 def test_least_load_spreads_across_backends(stack):
     """Concurrent in-flight requests must spread by least-load (sequential
     requests legitimately may all pick one backend: loads are equal)."""
