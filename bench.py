@@ -159,6 +159,13 @@ def _parse_args(argv=None):
         "only; slot = dense [slots, max_seq_len] reservation)",
     )
     ap.add_argument(
+        "--decode-kernel", default="", choices=["", "per_layer", "fused"],
+        help="paged decode attention layout ('' = auto: "
+        "$KUBEAI_TPU_DECODE_KERNEL, default per_layer — the "
+        "hardware-validated path; fused = deferred-scatter kernel, "
+        "opt-in until validated on chip)",
+    )
+    ap.add_argument(
         "--uniform-prompts", action="store_true",
         help="all prompts exactly --prompt-len (default: mixed lengths in "
         "[prompt-len/4, prompt-len], the serving-realistic case where "
@@ -246,6 +253,7 @@ def _child_main(args) -> None:
             num_slots=args.slots,
             max_seq_len=args.max_seq_len,
             cache_mode=args.cache_mode,
+            decode_kernel=args.decode_kernel,
             speculate=args.speculate,
             spec_adaptive=args.spec_adaptive == "on",
             quantization=args.quantization,
@@ -286,7 +294,12 @@ def _child_main(args) -> None:
     baseline = 2000.0  # BASELINE.json north-star: tok/s/chip on v5e
     result = {
         "metric": f"{model_name} decode throughput, continuous batching, "
-        f"bs={args.slots}, {args.cache_mode} kv cache, "
+        f"bs={args.slots}, {args.cache_mode} kv cache"
+        + (
+            f" ({eng.decode_kernel} kernel)"
+            if eng.cache_mode == "paged" else ""
+        )
+        + ", "
         + ("uniform" if args.uniform_prompts else "mixed")
         + " prompts"
         # Label with what actually RAN (the engine downgrades silently
@@ -339,6 +352,144 @@ def _run_measurement(argv: list[str], watchdog_s: float) -> dict | None:
     return _parse_result(out)
 
 
+def _requested_kernel(args) -> str:
+    """The decode kernel the child will actually resolve: explicit flag,
+    else the env override, else the per-layer default (mirrors
+    ops.paged_attention.resolve_decode_kernel without importing it —
+    the parent must stay JAX-free)."""
+    k = args.decode_kernel or os.environ.get(
+        "KUBEAI_TPU_DECODE_KERNEL", ""
+    ).strip().lower()
+    return k if k in ("per_layer", "fused") else "per_layer"
+
+
+def _tpu_ladder(argv: list[str], args) -> dict | None:
+    """Escalating measurement ladder (round-3 verdict: one hung kernel
+    must never zero a whole round again).
+
+      1. SANITY: smoke config on the chip, short watchdog. A hang here
+         (cheap to detect) steps the config down — per-layer kernel, then
+         the slot cache — before any expensive attempt runs.
+      2. FULL: the requested config, with whatever downgrades sanity
+         proved necessary.
+      3. FALLBACKS on a full-measurement hang: smaller decode chunk →
+         per-layer kernel → slot cache. Best full-config result wins; a
+         sanity (smoke) number is kept only as a last resort.
+
+    Attempts are tracked by their EFFECTIVE configuration — (kernel,
+    cache mode, chunk), with the kernel irrelevant under the slot cache —
+    so the ladder never re-runs a combination it already watched hang,
+    and never escalates back to a (kernel, cache) pair that hung even at
+    smoke scale. After every timeout the chip is re-probed — the relay
+    wedges for hours after a killed claim (ROADMAP caveat), so once it
+    stops answering, further attempts are pointless and the ladder
+    returns the best result it has."""
+    deadline = time.monotonic() + float(
+        os.environ.get("BENCH_TOTAL_BUDGET_S", "2100")
+    )
+    sanity_wd = float(os.environ.get("BENCH_SANITY_WATCHDOG_S", "300"))
+    sanity_result: dict | None = None
+
+    def key(kernel: str, cache: str, chunk: int | str) -> tuple:
+        # Slot-cache decode never touches the paged kernels, so the
+        # kernel choice does not change what executes.
+        return ("-" if cache == "slot" else kernel, cache, chunk)
+
+    def extras(kernel: str, cache: str, chunk: int | None) -> list[str]:
+        out = ["--decode-kernel", kernel, "--cache-mode", cache]
+        if chunk is not None:
+            out += ["--decode-chunk", str(chunk)]
+        return out
+
+    def remaining() -> float:
+        return deadline - time.monotonic()
+
+    def attempt(extra: list[str], watchdog: float, label: str) -> dict | None:
+        wd = min(watchdog, max(remaining(), 0))
+        if wd < 90:
+            print(f"bench: skipping {label} (budget exhausted)",
+                  file=sys.stderr, flush=True)
+            return None
+        print(f"bench: attempting {label} (watchdog {wd:.0f}s)",
+              file=sys.stderr, flush=True)
+        r = _run_measurement([*argv, *extra], wd)
+        ok = r is not None and r.get("value", 0) > 0
+        print(f"bench: {label} -> "
+              + (f"{r['value']} {r.get('unit', '')}" if ok else "FAILED"),
+              file=sys.stderr, flush=True)
+        return r if ok else None
+
+    def reprobe() -> bool:
+        if remaining() < 90:
+            return False
+        if _tpu_reachable(timeout_s=90.0):
+            return True
+        print("bench: relay stopped answering; ending ladder",
+              file=sys.stderr, flush=True)
+        return False
+
+    req_kernel = _requested_kernel(args)
+    req_cache = args.cache_mode
+    req_chunk = args.decode_chunk
+
+    # Stage 1: sanity. Find a (kernel, cache) pair that completes on the
+    # chip at smoke scale. (When the caller asked for --smoke, this IS
+    # the measurement.) A pair that hangs here is BROKEN — no full-scale
+    # attempt may escalate back to it.
+    broken: set[tuple] = set()
+    sanity_base = [] if args.smoke else ["--smoke"]
+    sane: tuple[str, str] | None = None
+    sanity_pairs = []
+    for pair in ((req_kernel, req_cache), ("per_layer", req_cache),
+                 ("per_layer", "slot")):
+        if key(*pair, "smoke") not in [key(*p, "smoke") for p in sanity_pairs]:
+            sanity_pairs.append(pair)
+    for kernel, cache in sanity_pairs:
+        r = attempt(
+            [*sanity_base, *extras(kernel, cache, None)], sanity_wd,
+            f"sanity/smoke (kernel={kernel}, cache={cache})",
+        )
+        if r is not None:
+            sanity_result = r
+            sane = (kernel, cache)
+            break
+        broken.add(key(kernel, cache, "smoke"))
+        if not reprobe():
+            return sanity_result
+    if sane is None:
+        return None  # nothing runs on this chip right now
+    if args.smoke:
+        return sanity_result
+
+    # Stages 2-3: full measurement with the sanity-validated pair, then
+    # step down. Candidates carrying a (kernel, cache) pair that hung at
+    # smoke scale, or repeating an effective config already watched
+    # failing at full scale, are skipped.
+    candidates = [
+        (sane[0], sane[1], req_chunk, "full config"),
+        (sane[0], sane[1], 8, "fallback (smaller chunk)"),
+        ("per_layer", req_cache, 8, "fallback (per-layer kernel, chunk=8)"),
+        ("per_layer", "slot", 8, "fallback (slot cache, chunk=8)"),
+    ]
+    tried: set[tuple] = set()
+    first = True
+    for kernel, cache, chunk, label in candidates:
+        k = key(kernel, cache, chunk)
+        if k in tried or key(kernel, cache, "smoke") in broken:
+            continue
+        if not first and not reprobe():
+            break
+        first = False
+        tried.add(k)
+        wd = args.watchdog_seconds if label == "full config" else min(
+            args.watchdog_seconds, 700
+        )
+        r = attempt(extras(kernel, cache, chunk), wd, label)
+        if r is not None:
+            return r
+    return sanity_result
+
+
 def main() -> None:
     args = _parse_args()
     if args.child:
@@ -349,7 +500,8 @@ def main() -> None:
     if os.environ.get("BENCH_FORCE_CPU") == "1" and "--cpu" not in argv:
         argv = [*argv, "--cpu"]
         args.cpu = True
-    if not args.cpu and not _tpu_reachable():
+    on_tpu = not args.cpu and _tpu_reachable()
+    if not args.cpu and not on_tpu:
         # A zero-value line helps nobody; measure the same code path on
         # the host CPU and say so in the metric name.
         argv = [
@@ -357,7 +509,22 @@ def main() -> None:
             "--backend-note", ", CPU FALLBACK (TPU relay unreachable)",
         ]
 
-    result = _run_measurement(argv, args.watchdog_seconds)
+    if on_tpu:
+        result = _tpu_ladder(argv, args)
+        if result is None:
+            # Ladder produced nothing (hangs, crashes, or a mid-way relay
+            # wedge): a CPU number through the identical code path beats
+            # a zero line.
+            result = _run_measurement(
+                [
+                    *argv, "--cpu",
+                    "--backend-note",
+                    ", CPU FALLBACK (TPU measurement failed)",
+                ],
+                args.watchdog_seconds,
+            )
+    else:
+        result = _run_measurement(argv, args.watchdog_seconds)
     if result is None:
         print(json.dumps(_zero_line("measurement failed or watchdog fired")),
               flush=True)

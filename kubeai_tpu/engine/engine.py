@@ -109,6 +109,13 @@ class EngineConfig:
     # Weight-only quantization: "" (bf16) or "int8" (per-channel symmetric;
     # halves HBM weight traffic on the memory-bound decode path).
     quantization: str = ""
+    # Paged decode attention layout: "" = auto ($KUBEAI_TPU_DECODE_KERNEL,
+    # default "per_layer"), "per_layer" = scatter-then-attend inside the
+    # layer scan (hardware-validated: 1975.5 tok/s/chip, round 2), "fused"
+    # = stacked-pool kernel with deferred scatter (roofline-better, but
+    # opt-in until validated on real hardware — its first on-chip dispatch
+    # hung).
+    decode_kernel: str = ""
     # LoRA hot-swap: number of simultaneously loaded adapters (0 disables
     # the LoRA path entirely — no extra compute in the compiled graphs).
     max_adapters: int = 0
@@ -228,6 +235,13 @@ class Engine:
             self.cache_mode = "slot"
         elif cfg.cache_mode not in ("paged", "slot"):
             raise ValueError(f"unknown cache_mode {cfg.cache_mode!r}")
+
+        # Paged decode attention layout ("" = $KUBEAI_TPU_DECODE_KERNEL,
+        # default per_layer — the hardware-validated path; "fused" is the
+        # deferred-scatter kernel, opt-in until a real-TPU A/B clears it).
+        from kubeai_tpu.ops.paged_attention import resolve_decode_kernel
+
+        self.decode_kernel = resolve_decode_kernel(cfg.decode_kernel)
 
         # Pipeline parallelism: stage-local layers + KV over the pp mesh
         # axis (GPipe microbatched decode; see models/llama.py
@@ -647,7 +661,11 @@ class Engine:
                 microbatches=self._pp_microbatches,
             )
         else:
-            decode_paged = fam.decode_step_paged
+            from functools import partial as _partial
+
+            decode_paged = _partial(
+                fam.decode_step_paged, attn_kernel=self.decode_kernel
+            )
 
         def _prefill_admit(
             params, tokens, ints, floats, bt_rows, kp, vp, bt, state, lora

@@ -312,19 +312,26 @@ def decode_step(params, cfg, tokens, positions, k_cache, v_cache,
 
 
 def decode_step_paged(params, cfg, tokens, positions, k_pages, v_pages,
-                      block_tables, lora=None, lora_idx=None):
-    """Paged decode (block tables; see llama.decode_step_paged for the
-    fused-kernel layout rationale: pools stay outside the scan, the new
-    token rides as an extra attention column, and all layers' K/V write
-    back in one batched scatter). The per-layer sliding window rides the
-    scan, so Gemma-2's alternating local/global layers share one
-    compiled graph."""
+                      block_tables, lora=None, lora_idx=None, *,
+                      attn_kernel=None):
+    """Paged decode (block tables). Attention layout per `attn_kernel`
+    (None = env default — see llama.decode_step_paged for the layouts:
+    "per_layer" scatter-then-attend with pools riding the scan is the
+    hardware-validated path; "fused" keeps pools outside the scan, the
+    new token rides as an extra attention column, and all layers' K/V
+    write back in one batched scatter). The per-layer sliding window
+    rides the scan, so Gemma-2's alternating local/global layers share
+    one compiled graph."""
     from kubeai_tpu.ops.paged_attention import (
         batched_scatter_sequence,
+        paged_decode_attention,
         paged_decode_attention_fused,
+        resolve_decode_kernel,
+        scatter_decode_token,
         token_page_coords,
     )
 
+    attn_kernel = resolve_decode_kernel(attn_kernel)
     B = tokens.shape[0]
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
     page_size = k_pages.shape[2]
@@ -333,23 +340,18 @@ def decode_step_paged(params, cfg, tokens, positions, k_pages, v_pages,
     x = (x * (cfg.hidden_size ** 0.5)).astype(params["embed"].dtype)
     pos1 = positions[:, None]
     page_ids, offsets = token_page_coords(block_tables, positions, page_size)
+    lengths = positions + 1
 
-    def layer(carry, scanned):
-        x = carry
-        lp = scanned["p"]
+    def layer_qkv(x, lp):
         h = _norm(x, lp["input_norm"], cfg.rms_norm_eps)
         q = jnp.einsum("be,eh->bh", h, lp["wq"]).reshape(B, 1, H, D)
         k = jnp.einsum("be,eh->bh", h, lp["wk"]).reshape(B, 1, KVH, D)
         v = jnp.einsum("be,eh->bh", h, lp["wv"]).reshape(B, 1, KVH, D)
         q = apply_rope(q, pos1, inv_freq)[:, 0]
         k = apply_rope(k, pos1, inv_freq)[:, 0]
-        v = v[:, 0]
-        attn = paged_decode_attention_fused(
-            q * (_q_scale(cfg) * D ** 0.5), k_pages, v_pages, k, v,
-            block_tables, positions, scanned["li"],
-            logit_softcap=cfg.attn_logit_softcapping,
-            window=scanned["win"] if cfg.sliding_window else None,
-        )
+        return q * (_q_scale(cfg) * D ** 0.5), k, v[:, 0]
+
+    def layer_finish(x, attn, lp):
         a_out = jnp.einsum("bh,he->be", attn.reshape(B, H * D), lp["wo"])
         if cfg.sandwich_norms:
             a_out = _norm(a_out, lp["post_attn_norm"], cfg.rms_norm_eps)
@@ -358,21 +360,54 @@ def decode_step_paged(params, cfg, tokens, positions, k_pages, v_pages,
         m_out = _mlp(h2[:, None], lp["w_gate"], lp["w_up"], lp["w_down"])[:, 0]
         if cfg.sandwich_norms:
             m_out = _norm(m_out, lp["post_mlp_norm"], cfg.rms_norm_eps)
-        x = x + m_out
-        return x, (k, v)
+        return x + m_out
 
-    x, (k_all, v_all) = jax.lax.scan(
-        layer, x,
-        {
-            "p": params["layers"],
-            "win": cfg.layer_windows(),
-            "li": jnp.arange(cfg.num_layers, dtype=jnp.int32),
-        },
-    )
-    k_pages, v_pages = batched_scatter_sequence(
-        k_pages, v_pages, k_all[:, :, None], v_all[:, :, None],
-        page_ids[:, None], offsets[:, None],
-    )
+    if attn_kernel == "per_layer":
+
+        def layer_pl(carry, scanned):
+            x, lp = carry, scanned["p"]
+            kp, vp = scanned["kp"], scanned["vp"]
+            q, k, v = layer_qkv(x, lp)
+            kp, vp = scatter_decode_token(kp, vp, k, v, page_ids, offsets)
+            attn = paged_decode_attention(
+                q, kp, vp, block_tables, lengths,
+                logit_softcap=cfg.attn_logit_softcapping,
+                window=scanned["win"] if cfg.sliding_window else None,
+            )
+            return layer_finish(x, attn, lp), (kp, vp)
+
+        x, (k_pages, v_pages) = jax.lax.scan(
+            layer_pl, x,
+            {
+                "p": params["layers"], "win": cfg.layer_windows(),
+                "kp": k_pages, "vp": v_pages,
+            },
+        )
+    else:
+
+        def layer(carry, scanned):
+            x, lp = carry, scanned["p"]
+            q, k, v = layer_qkv(x, lp)
+            attn = paged_decode_attention_fused(
+                q, k_pages, v_pages, k, v,
+                block_tables, positions, scanned["li"],
+                logit_softcap=cfg.attn_logit_softcapping,
+                window=scanned["win"] if cfg.sliding_window else None,
+            )
+            return layer_finish(x, attn, lp), (k, v)
+
+        x, (k_all, v_all) = jax.lax.scan(
+            layer, x,
+            {
+                "p": params["layers"],
+                "win": cfg.layer_windows(),
+                "li": jnp.arange(cfg.num_layers, dtype=jnp.int32),
+            },
+        )
+        k_pages, v_pages = batched_scatter_sequence(
+            k_pages, v_pages, k_all[:, :, None], v_all[:, :, None],
+            page_ids[:, None], offsets[:, None],
+        )
     x = _norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = jnp.einsum(
         "be,ve->bv", x, params["embed"], preferred_element_type=jnp.float32

@@ -29,6 +29,7 @@ live in the external image; charts/kubeai/values.yaml:45).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +44,32 @@ except ImportError:  # pragma: no cover
     _HAS_PLTPU = False
 
 NEG_INF = -1e30
+
+# Which decode-attention layout model families use when the caller doesn't
+# say. "per_layer" = scatter-then-attend inside the layer scan through
+# paged_decode_attention — the hardware-validated path (1975.5 tok/s/chip,
+# bs=64, 1B proxy, measured round 2). "fused" = stacked-pool kernel with a
+# deferred scatter (paged_decode_attention_fused) — roofline-better on
+# paper, but its first on-chip dispatch hung in round 3, so it stays
+# selectable-not-default until a real-TPU A/B validates it.
+DECODE_KERNEL_ENV = "KUBEAI_TPU_DECODE_KERNEL"
+_DECODE_KERNELS = ("per_layer", "fused")
+
+
+def default_decode_kernel() -> str:
+    mode = os.environ.get(DECODE_KERNEL_ENV, "").strip().lower()
+    return mode if mode in _DECODE_KERNELS else "per_layer"
+
+
+def resolve_decode_kernel(requested: str | None) -> str:
+    """Validate an explicit kernel choice; None/"" defers to the env var."""
+    if not requested:
+        return default_decode_kernel()
+    if requested not in _DECODE_KERNELS:
+        raise ValueError(
+            f"decode kernel {requested!r} not in {_DECODE_KERNELS}"
+        )
+    return requested
 
 
 def _accum_head(

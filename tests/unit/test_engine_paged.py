@@ -52,6 +52,29 @@ def test_slot_paged_equivalence_seeded_sampling():
     assert out_slot == out_paged
 
 
+def test_decode_kernel_selection_and_equivalence():
+    """Both paged attention layouts are selectable (EngineConfig and env
+    var) and emit identical greedy streams — the per-layer layout is the
+    hardware-validated default; the fused layout must match it exactly."""
+    prompts = _prompts(5)
+    sp = SamplingParams(temperature=0.0, max_tokens=12)
+    eng_pl = _make("paged", decode_kernel="per_layer")
+    eng_fused = _make("paged", decode_kernel="fused")
+    assert eng_pl.decode_kernel == "per_layer"
+    assert eng_fused.decode_kernel == "fused"
+    assert _make("paged").decode_kernel == "per_layer"  # auto default
+    assert eng_pl.generate(prompts, sp) == eng_fused.generate(prompts, sp)
+
+
+def test_decode_kernel_env_override(monkeypatch):
+    monkeypatch.setenv("KUBEAI_TPU_DECODE_KERNEL", "fused")
+    assert _make("paged").decode_kernel == "fused"
+    monkeypatch.setenv("KUBEAI_TPU_DECODE_KERNEL", "bogus")
+    assert _make("paged").decode_kernel == "per_layer"
+    with pytest.raises(ValueError):
+        _make("paged", decode_kernel="bogus")
+
+
 def test_pages_released_on_completion():
     eng = _make("paged")
     total = eng._alloc.free_pages
