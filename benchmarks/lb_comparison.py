@@ -62,6 +62,15 @@ def _load_client_module():
     return mod
 
 
+# Engine cost-model defaults — the single source for SimEngine, run_one,
+# and the CLI (drifting copies would make the script and direct SimEngine
+# use silently simulate different engines).
+DEFAULT_ENGINE_CONCURRENCY = 16
+DEFAULT_BASE_PREFILL_MS = 20.0
+DEFAULT_PER_CHAR_US = 50.0
+DEFAULT_ITL_S = 0.003
+
+
 class SimEngine:
     """Simulated OpenAI-compatible engine replica with prefix caching.
 
@@ -73,10 +82,10 @@ class SimEngine:
 
     def __init__(
         self,
-        concurrency: int = 16,
-        base_prefill_s: float = 0.020,
-        per_char_s: float = 0.00005,
-        itl_s: float = 0.003,
+        concurrency: int = DEFAULT_ENGINE_CONCURRENCY,
+        base_prefill_s: float = DEFAULT_BASE_PREFILL_MS / 1e3,
+        per_char_s: float = DEFAULT_PER_CHAR_US / 1e6,
+        itl_s: float = DEFAULT_ITL_S,
     ):
         eng = self
         self.sem = threading.Semaphore(concurrency)
@@ -234,23 +243,37 @@ def _mk_world(n_replicas: int, strategy: str, engines: list[SimEngine]):
 
 def run_one(
     strategy: str, threads: int, replicas: int, turns: int,
-    max_tokens: int, client,
+    max_tokens: int, client, *,
+    ramp_s: float = 0.0, per_char_us: float = DEFAULT_PER_CHAR_US,
+    base_prefill_ms: float = DEFAULT_BASE_PREFILL_MS,
+    engine_concurrency: int = DEFAULT_ENGINE_CONCURRENCY,
 ) -> dict:
-    engines = [SimEngine() for _ in range(replicas)]
+    engines = [
+        SimEngine(
+            concurrency=engine_concurrency,
+            base_prefill_s=base_prefill_ms / 1e3,
+            per_char_s=per_char_us / 1e6,
+        )
+        for _ in range(replicas)
+    ]
     store, mgr = _mk_world(replicas, strategy, engines)
     results = {"ttft": [], "itl": [], "out_chars": 0, "requests": 0,
                "errors": 0}
     lock = threading.Lock()
     base_url = f"http://{mgr.api_address}/openai"
-    t0 = time.perf_counter()
-    ts = [
-        threading.Thread(
-            target=client.run_conversation,
-            args=(base_url, "sim", turns, max_tokens, 1000 + i, results,
-                  lock),
+
+    def convo(i: int):
+        # Stagger arrivals across the ramp window: an all-at-t=0 herd
+        # measures queue-drain, not routing quality (the reference's
+        # client sustains arrivals over minutes).
+        if ramp_s > 0:
+            time.sleep(ramp_s * i / max(1, threads - 1))
+        client.run_conversation(
+            base_url, "sim", turns, max_tokens, 1000 + i, results, lock
         )
-        for i in range(threads)
-    ]
+
+    t0 = time.perf_counter()
+    ts = [threading.Thread(target=convo, args=(i,)) for i in range(threads)]
     for t in ts:
         t.start()
     for t in ts:
@@ -274,6 +297,13 @@ def run_one(
         "concurrency": threads,
         "replicas": replicas,
         "turns": turns,
+        # Full engine cost model + load shape, so a committed JSON alone
+        # is enough to reproduce the run.
+        "max_tokens": max_tokens,
+        "ramp_s": ramp_s,
+        "per_char_us": per_char_us,
+        "base_prefill_ms": base_prefill_ms,
+        "engine_concurrency": engine_concurrency,
         "requests": results["requests"],
         "errors": results["errors"],
         "wall_s": round(wall, 2),
@@ -286,6 +316,9 @@ def run_one(
         "mean_itl_ms": round(
             sum(results["itl"]) / max(1, len(results["itl"])) * 1e3, 2
         ),
+        # NOTE: with a ramp this is arrival-limited (most of `wall` IS
+        # the ramp window) — compare TTFT and cache-hit columns across
+        # runs, not this.
         "output_tok_per_s": round(out_tokens / wall, 1),
         "prefix_cache_hit_pct": round(100.0 * cached / max(1, total), 1),
         "per_engine_requests": per_engine,
@@ -301,6 +334,24 @@ def main():
     ap.add_argument("--replicas", type=int, default=8)
     ap.add_argument("--turns", type=int, default=4)
     ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument(
+        "--ramp-s", type=float, default=0.0,
+        help="stagger conversation starts across this window (0 = all at "
+        "once; an all-at-t=0 herd measures queue drain, not routing)",
+    )
+    ap.add_argument(
+        "--per-char-us", type=float, default=DEFAULT_PER_CHAR_US,
+        help="simulated prefill cost per uncached character (µs); raise "
+        "to model prefill-dominated engines (long-context regime)",
+    )
+    ap.add_argument(
+        "--base-prefill-ms", type=float, default=DEFAULT_BASE_PREFILL_MS
+    )
+    ap.add_argument(
+        "--engine-concurrency", type=int,
+        default=DEFAULT_ENGINE_CONCURRENCY,
+        help="bounded prefill admission per simulated replica",
+    )
     ap.add_argument("--json-out", default="")
     args = ap.parse_args()
 
@@ -316,6 +367,9 @@ def main():
         rep = run_one(
             strategy, args.threads, args.replicas, args.turns,
             args.max_tokens, client,
+            ramp_s=args.ramp_s, per_char_us=args.per_char_us,
+            base_prefill_ms=args.base_prefill_ms,
+            engine_concurrency=args.engine_concurrency,
         )
         reports.append(rep)
         print(json.dumps(rep), flush=True)
