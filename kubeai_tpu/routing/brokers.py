@@ -17,6 +17,13 @@ wire protocols directly:
       replicas compete for messages (gocloud natspubsub parity: core
       NATS is at-most-once; ack/nack are no-ops).
 
+  KafkaBroker (routing/kafka.py) — the Kafka binary protocol:
+      Metadata/Produce/Fetch with record-batch v2 + CRC32C, consumer
+      groups (JoinGroup/SyncGroup/Heartbeat, leader-computed range
+      assignment), committed offsets as the delivery cursor
+      (at-least-once: ack commits offset+1, nack rewinds the fetch
+      cursor).
+
 Both carry the reference's failure behavior: the receive path restarts
 its subscription with exponential backoff after transport errors
 (reference: messenger.go:98-127 recreates the subscription with backoff,
@@ -26,6 +33,7 @@ URL forms (config `messaging.streams`):
   gcppubsub://projects/P/subscriptions/S   (requestSubscription)
   gcppubsub://projects/P/topics/T          (responseTopic)
   nats://host:4222/subject                 (both)
+  kafka://host:9092/topic                  (both)
   plain names (no scheme)                  → in-memory MemBroker
 """
 
@@ -46,7 +54,7 @@ from kubeai_tpu.routing.messenger import Broker, MemBroker, Message
 
 logger = logging.getLogger(__name__)
 
-SUPPORTED_SCHEMES = ("mem", "gcppubsub", "nats")
+SUPPORTED_SCHEMES = ("mem", "gcppubsub", "nats", "kafka")
 
 # The reference aborts the process after 20 subscription restarts
 # (messenger.go:98) and lets the Pod restart. A library thread can't
@@ -72,6 +80,13 @@ def make_broker(url: str, **kwargs) -> Broker:
         parsed = urllib.parse.urlparse(url)
         return NATSBroker(
             parsed.hostname or "localhost", parsed.port or 4222, **kwargs
+        )
+    if scheme == "kafka":
+        from kubeai_tpu.routing.kafka import KafkaBroker
+
+        parsed = urllib.parse.urlparse(url)
+        return KafkaBroker(
+            parsed.hostname or "localhost", parsed.port or 9092, **kwargs
         )
     raise ValueError(
         f"unsupported messaging scheme {scheme!r} "

@@ -1,0 +1,1046 @@
+"""Kafka broker driver: the wire protocol, zero dependencies.
+
+The reference registers gocloud.dev's kafkapubsub driver (Sarama
+underneath) for kafka:// streams (reference: internal/manager/run.go:50).
+This driver speaks the Kafka binary protocol directly over TCP:
+
+  Metadata(v1)         partition leaders per topic
+  Produce(v3)          record-batch v2 (magic 2) with CRC32C, acks=all
+  Fetch(v4)            record-batch v2 decode, long-poll via max_wait
+  FindCoordinator(v0)  group coordinator discovery
+  JoinGroup/SyncGroup/Heartbeat/LeaveGroup(v0)
+                       consumer-group membership; the elected leader
+                       computes a range assignment over the topic's
+                       partitions (the standard "consumer" protocol
+                       embedded assignment encoding)
+  OffsetFetch(v1)/OffsetCommit(v2)
+                       committed offsets = delivery cursor
+
+Delivery semantics (gocloud kafkapubsub parity): at-least-once. A
+message's ack commits its offset+1 (monotonically — a late ack behind a
+newer one is a no-op); nack rewinds the partition's fetch cursor to the
+nacked offset so everything from it redelivers. The fetch loop restarts
+its session with exponential backoff after transport errors and rejoins
+the group on REBALANCE_IN_PROGRESS / UNKNOWN_MEMBER_ID /
+ILLEGAL_GENERATION, mirroring the reference's subscription-restart
+behavior (reference: internal/messenger/messenger.go:98-127).
+
+URL form (config `messaging.streams`):
+  kafka://host:9092/topic        (requestSubscription and responseTopic)
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import socket
+import struct
+import threading
+import time
+import urllib.parse
+
+from kubeai_tpu.routing.messenger import Message
+
+logger = logging.getLogger(__name__)
+
+# -- error codes the driver reacts to ------------------------------------------
+ERR_NONE = 0
+ERR_OFFSET_OUT_OF_RANGE = 1
+ERR_UNKNOWN_TOPIC = 3
+ERR_NOT_COORDINATOR = 16
+ERR_ILLEGAL_GENERATION = 22
+ERR_UNKNOWN_MEMBER_ID = 25
+ERR_REBALANCE_IN_PROGRESS = 27
+
+API_PRODUCE = 0
+API_FETCH = 1
+API_METADATA = 3
+API_OFFSET_COMMIT = 8
+API_OFFSET_FETCH = 9
+API_FIND_COORDINATOR = 10
+API_JOIN_GROUP = 11
+API_HEARTBEAT = 12
+API_LEAVE_GROUP = 13
+API_SYNC_GROUP = 14
+
+
+# -- CRC32C (Castagnoli), table-based ------------------------------------------
+
+def _crc32c_table():
+    poly = 0x82F63B78
+    table = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_CRC32C_TABLE = _crc32c_table()
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+# -- primitive codec -----------------------------------------------------------
+
+
+class Writer:
+    def __init__(self):
+        self.buf = bytearray()
+
+    def i8(self, v):  self.buf += struct.pack(">b", v); return self
+    def i16(self, v): self.buf += struct.pack(">h", v); return self
+    def i32(self, v): self.buf += struct.pack(">i", v); return self
+    def i64(self, v): self.buf += struct.pack(">q", v); return self
+    def u32(self, v): self.buf += struct.pack(">I", v); return self
+
+    def string(self, s: str | None):
+        if s is None:
+            return self.i16(-1)
+        b = s.encode()
+        self.i16(len(b))
+        self.buf += b
+        return self
+
+    def bytes_(self, b: bytes | None):
+        if b is None:
+            return self.i32(-1)
+        self.i32(len(b))
+        self.buf += b
+        return self
+
+    def array(self, items, fn):
+        self.i32(len(items))
+        for it in items:
+            fn(self, it)
+        return self
+
+    def varint(self, v: int):
+        """Zigzag varint (record encoding)."""
+        z = (v << 1) ^ (v >> 63)
+        while True:
+            b = z & 0x7F
+            z >>= 7
+            if z:
+                self.buf.append(b | 0x80)
+            else:
+                self.buf.append(b)
+                return self
+
+    def raw(self, b: bytes):
+        self.buf += b
+        return self
+
+    def done(self) -> bytes:
+        return bytes(self.buf)
+
+
+class Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise EOFError("short kafka frame")
+        out = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def i8(self):  return struct.unpack(">b", self._take(1))[0]
+    def i16(self): return struct.unpack(">h", self._take(2))[0]
+    def i32(self): return struct.unpack(">i", self._take(4))[0]
+    def i64(self): return struct.unpack(">q", self._take(8))[0]
+    def u32(self): return struct.unpack(">I", self._take(4))[0]
+
+    def string(self) -> str | None:
+        n = self.i16()
+        return None if n < 0 else self._take(n).decode()
+
+    def bytes_(self) -> bytes | None:
+        n = self.i32()
+        return None if n < 0 else self._take(n)
+
+    def array(self, fn) -> list:
+        n = self.i32()
+        return [fn(self) for _ in range(max(0, n))]
+
+    def varint(self) -> int:
+        shift = z = 0
+        while True:
+            b = self.data[self.pos]
+            self.pos += 1
+            z |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        return (z >> 1) ^ -(z & 1)
+
+    def remaining(self) -> int:
+        return len(self.data) - self.pos
+
+
+# -- record batch v2 -----------------------------------------------------------
+
+
+def encode_record_batch(values: list[bytes], timestamp_ms: int) -> bytes:
+    """One record-batch (magic 2) holding `values` as keyless records."""
+    records = Writer()
+    for i, v in enumerate(values):
+        body = Writer()
+        body.i8(0)  # attributes
+        body.varint(0)  # timestamp delta
+        body.varint(i)  # offset delta
+        body.varint(-1)  # null key
+        body.varint(len(v))
+        body.raw(v)
+        body.varint(0)  # no headers
+        rec = body.done()
+        records.varint(len(rec))
+        records.raw(rec)
+    recs = records.done()
+
+    # Everything after the CRC field is CRC32C'd.
+    after_crc = (
+        Writer()
+        .i16(0)  # attributes (no compression)
+        .i32(len(values) - 1)  # last offset delta
+        .i64(timestamp_ms)  # first timestamp
+        .i64(timestamp_ms)  # max timestamp
+        .i64(-1)  # producer id
+        .i16(-1)  # producer epoch
+        .i32(-1)  # base sequence
+        .i32(len(values))
+        .raw(recs)
+        .done()
+    )
+    w = Writer()
+    w.i64(0)  # base offset (broker assigns)
+    w.i32(4 + 1 + 4 + len(after_crc))  # batch length (after this field)
+    w.i32(-1)  # partition leader epoch
+    w.i8(2)  # magic
+    w.u32(crc32c(after_crc))
+    w.raw(after_crc)
+    return w.done()
+
+
+def decode_record_batches(data: bytes) -> list[tuple[int, bytes]]:
+    """[(absolute_offset, value), ...] from a fetch response record set.
+    Tolerates a trailing partial batch (brokers may truncate)."""
+    out = []
+    r = Reader(data)
+    while r.remaining() >= 61:  # minimal batch header
+        try:
+            base_offset = r.i64()
+            batch_len = r.i32()
+            if r.remaining() < batch_len:
+                break  # truncated tail
+            end = r.pos + batch_len
+            r.i32()  # partition leader epoch
+            magic = r.i8()
+            if magic != 2:
+                r.pos = end
+                continue
+            r.u32()  # crc (trusted: TCP checksums + tests cover encode)
+            r.i16()  # attributes
+            r.i32()  # last offset delta
+            r.i64()  # first timestamp
+            r.i64()  # max timestamp
+            r.i64()  # producer id
+            r.i16()  # producer epoch
+            r.i32()  # base sequence
+            n = r.i32()
+            for _ in range(n):
+                rec_len = r.varint()
+                rec_end = r.pos + rec_len
+                rr = Reader(r.data[r.pos:rec_end])
+                rr.i8()  # attributes
+                rr.varint()  # timestamp delta
+                off_delta = rr.varint()
+                klen = rr.varint()
+                if klen > 0:
+                    rr._take(klen)
+                vlen = rr.varint()
+                value = rr._take(vlen) if vlen >= 0 else b""
+                out.append((base_offset + off_delta, bytes(value)))
+                r.pos = rec_end
+            r.pos = end
+        except (EOFError, IndexError):
+            break
+    return out
+
+
+# -- connection ----------------------------------------------------------------
+
+
+class KafkaConn:
+    """One broker connection: framed request/response, synchronous (a
+    lock serializes callers — the driver's traffic is low-rate control
+    and batched fetches, not a throughput path)."""
+
+    def __init__(self, host: str, port: int, client_id: str, timeout: float):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.client_id = client_id
+        self._corr = 0
+        self._lock = threading.Lock()
+
+    def call(self, api_key: int, api_version: int, body: bytes) -> Reader:
+        with self._lock:
+            self._corr += 1
+            corr = self._corr
+            header = (
+                Writer()
+                .i16(api_key)
+                .i16(api_version)
+                .i32(corr)
+                .string(self.client_id)
+                .done()
+            )
+            frame = header + body
+            self.sock.sendall(struct.pack(">i", len(frame)) + frame)
+            raw = self._read_frame()
+        r = Reader(raw)
+        got = r.i32()
+        if got != corr:
+            raise ConnectionError(
+                f"kafka correlation mismatch: sent {corr}, got {got}"
+            )
+        return r
+
+    def _read_frame(self) -> bytes:
+        hdr = self._read_n(4)
+        (n,) = struct.unpack(">i", hdr)
+        return self._read_n(n)
+
+    def _read_n(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            chunk = self.sock.recv(n)
+            if not chunk:
+                raise ConnectionError("kafka connection closed")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# -- the broker ----------------------------------------------------------------
+
+# Shared restart/backoff policy (brokers.py documents the rationale);
+# imported rather than copied so the two can't drift. No circular import:
+# brokers.py pulls this module in lazily inside make_broker().
+from kubeai_tpu.routing.brokers import (  # noqa: E402
+    RESTARTS_LOG_EVERY,
+    _backoff,
+)
+
+API_LIST_OFFSETS = 2
+EARLIEST_TIMESTAMP = -2
+
+
+class _Rebalance(Exception):
+    """Group membership changed (REBALANCE_IN_PROGRESS / ILLEGAL_GENERATION
+    / UNKNOWN_MEMBER_ID): rejoin NOW on the same connections. Routing this
+    through the transport-error restart (new pool + growing backoff) makes
+    rebalances slower than the session timeout and live-locks the group."""
+
+
+class _PartitionCursor:
+    def __init__(self, offset: int):
+        self.fetch_offset = offset  # next offset to fetch
+        self.committed = offset  # next offset to commit
+        self.rewind_to: int | None = None  # set by nack
+        self.lock = threading.Lock()
+        # Serializes OffsetCommit RPCs for this partition: concurrent
+        # acks racing their commits could otherwise land out of order
+        # and regress the broker-side offset.
+        self.commit_lock = threading.Lock()
+
+
+class _ConnPool:
+    """Connections owned by ONE context (the publish path, or one
+    consumer loop's session). Pools are never shared across contexts: a
+    consumer restart tears down its own pool without injecting transport
+    errors into concurrent publishes or other topics' consumers."""
+
+    def __init__(self, client_id: str, timeout_s: float):
+        self.client_id = client_id
+        self.timeout_s = timeout_s
+        self._conns: dict[tuple[str, int], KafkaConn] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def get(self, host: str, port: int) -> KafkaConn:
+        key = (host, port)
+        with self._lock:
+            if self._closed:
+                raise ConnectionError("kafka pool closed")
+            conn = self._conns.get(key)
+            if conn is None:
+                conn = KafkaConn(host, port, self.client_id, self.timeout_s)
+                self._conns[key] = conn
+            return conn
+
+    def drop(self, host: str, port: int) -> None:
+        with self._lock:
+            conn = self._conns.pop((host, port), None)
+        if conn:
+            conn.close()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            c.close()
+
+
+class KafkaBroker:
+    """Broker-seam driver (publish/receive/close) over the Kafka wire
+    protocol. One instance per stream URL; topics/subscriptions
+    multiplex internally. See module docstring for semantics."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int = 9092,
+        group: str = "kubeai",
+        client_id: str = "kubeai-tpu",
+        session_timeout_ms: int = 10000,
+        fetch_max_wait_ms: int = 500,
+        fetch_max_bytes: int = 4 << 20,
+        timeout_s: float = 35.0,
+    ):
+        self.host, self.port = host, port
+        self.group = group
+        self.client_id = client_id
+        self.session_timeout_ms = session_timeout_ms
+        self.fetch_max_wait_ms = fetch_max_wait_ms
+        self.fetch_max_bytes = fetch_max_bytes
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._queues: dict[str, queue.Queue] = {}
+        self._consumers: dict[str, threading.Thread] = {}
+        self._pub_pool = _ConnPool(client_id, timeout_s)
+        self._consumer_pools: dict[str, _ConnPool] = {}
+        # topic -> (coord host, coord port, member id): live group
+        # memberships, so close() can LeaveGroup and trigger an immediate
+        # rebalance instead of waiting out the session timeout.
+        self._memberships: dict[str, tuple[str, int, str]] = {}
+        # topic -> {partition -> (host, port)}: leadership changes rarely,
+        # so publish() reuses it and refreshes only on produce/transport
+        # errors (a per-message Metadata round-trip would double publish
+        # latency).
+        self._leader_cache: dict[str, dict[int, tuple[str, int]]] = {}
+
+    @staticmethod
+    def topic_of(url: str) -> str:
+        if "://" in url:
+            return urllib.parse.urlparse(url).path.strip("/") or "default"
+        return url
+
+    # -- metadata ---------------------------------------------------------------
+
+    def _metadata(self, topic: str, pool: _ConnPool) -> dict:
+        """{partition -> (leader_host, leader_port)} plus partition list."""
+        r = pool.get(self.host, self.port).call(
+            API_METADATA, 1,
+            Writer().array([topic], lambda w, t: w.string(t)).done(),
+        )
+        brokers = {}
+        for _ in range(r.i32()):
+            node = r.i32()
+            host = r.string()
+            port = r.i32()
+            r.string()  # rack
+            brokers[node] = (host, port)
+        r.i32()  # controller id
+        leaders: dict[int, tuple[str, int]] = {}
+        for _ in range(r.i32()):  # topics
+            err = r.i16()
+            name = r.string()
+            r.i8()  # is_internal
+            for _ in range(r.i32()):  # partitions
+                perr = r.i16()
+                pid = r.i32()
+                leader = r.i32()
+                r.array(lambda rr: rr.i32())  # replicas
+                r.array(lambda rr: rr.i32())  # isr
+                if name == topic and perr == ERR_NONE and leader in brokers:
+                    leaders[pid] = brokers[leader]
+            if err not in (ERR_NONE,) and name == topic:
+                raise RuntimeError(f"kafka metadata for {topic}: error {err}")
+        if not leaders:
+            raise RuntimeError(f"kafka topic {topic}: no partition leaders")
+        with self._lock:
+            self._leader_cache[topic] = leaders
+        return leaders
+
+    def _cached_leaders(self, topic: str, pool: _ConnPool) -> dict:
+        with self._lock:
+            cached = self._leader_cache.get(topic)
+        return cached if cached else self._metadata(topic, pool)
+
+    def _invalidate_leaders(self, topic: str) -> None:
+        with self._lock:
+            self._leader_cache.pop(topic, None)
+
+    # -- Broker interface: publish ----------------------------------------------
+
+    def publish(self, topic_url: str, body: bytes) -> None:
+        topic = self.topic_of(topic_url)
+        leaders = self._cached_leaders(topic, self._pub_pool)
+        # Round-robin-by-time across partitions; ordering across requests
+        # is not part of the Broker contract (gocloud kafkapubsub also
+        # publishes keyless by default).
+        pid = sorted(leaders)[int(time.monotonic() * 1000) % len(leaders)]
+        host, port = leaders[pid]
+        batch = encode_record_batch([body], int(time.time() * 1000))
+        req = Writer()
+        req.string(None)  # transactional id
+        req.i16(-1)  # acks = all
+        req.i32(int(self.timeout_s * 1000))
+
+        def part(w, _):
+            w.i32(pid)
+            w.bytes_(batch)
+
+        def top(w, _):
+            w.string(topic)
+            w.array([None], part)
+
+        req.array([None], top)
+        try:
+            r = self._pub_pool.get(host, port).call(
+                API_PRODUCE, 3, req.done()
+            )
+        except OSError as e:
+            # Stale leadership is one cause of transport failure; next
+            # publish re-resolves it. The caller (Messenger) nacks, so
+            # the message redelivers.
+            self._invalidate_leaders(topic)
+            self._pub_pool.drop(host, port)
+            raise ConnectionError(f"kafka produce transport: {e}") from e
+        for _ in range(r.i32()):  # topics
+            r.string()
+            for _ in range(r.i32()):  # partitions
+                r.i32()  # partition
+                err = r.i16()
+                r.i64()  # base offset
+                r.i64()  # log append time
+                if err != ERR_NONE:
+                    self._invalidate_leaders(topic)
+                    raise RuntimeError(
+                        f"kafka produce {topic}/{pid}: error {err}"
+                    )
+
+    # -- Broker interface: receive ----------------------------------------------
+
+    def receive(self, sub_url: str, timeout: float) -> Message | None:
+        topic = self.topic_of(sub_url)
+        with self._lock:
+            if topic not in self._queues:
+                self._queues[topic] = queue.Queue(maxsize=64)
+                t = threading.Thread(
+                    target=self._consume_loop, args=(topic,), daemon=True
+                )
+                self._consumers[topic] = t
+                t.start()
+        try:
+            return self._queues[topic].get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._lock:
+            memberships = dict(self._memberships)
+            self._memberships.clear()
+        # Polite departure on fresh connections (the consumer threads may
+        # be mid-call on the shared ones): the coordinator rebalances the
+        # group immediately instead of waiting out the session timeout.
+        for host, port, member_id in memberships.values():
+            try:
+                conn = KafkaConn(host, port, self.client_id, 5.0)
+                conn.call(
+                    API_LEAVE_GROUP, 0,
+                    Writer().string(self.group).string(member_id).done(),
+                )
+                conn.close()
+            except OSError:
+                pass
+        self._pub_pool.close()
+        with self._lock:
+            pools = list(self._consumer_pools.values())
+            self._consumer_pools.clear()
+        for p in pools:
+            p.close()
+
+    # -- consumer group ---------------------------------------------------------
+
+    def _find_coordinator(self, pool: _ConnPool) -> tuple[KafkaConn, str, int]:
+        r = pool.get(self.host, self.port).call(
+            API_FIND_COORDINATOR, 0, Writer().string(self.group).done()
+        )
+        err = r.i16()
+        node = r.i32()
+        host = r.string()
+        port = r.i32()
+        if err != ERR_NONE:
+            raise RuntimeError(f"kafka find coordinator: error {err}")
+        return pool.get(host, port), host, port
+
+    def _join_group(
+        self, coord: KafkaConn, topic: str, member_id: str, pool: _ConnPool
+    ):
+        """JoinGroup phase; returns (generation, member_id, leader,
+        members). Kept separate from _sync_group so the broker-assigned
+        member id SURVIVES a failed sync — rejoining with a fresh id on
+        every rebalance creates a new member each time, which itself
+        bumps the generation and live-locks the group."""
+        meta = (  # consumer protocol subscription: version, topics, userdata
+            Writer()
+            .i16(0)
+            .array([topic], lambda w, t: w.string(t))
+            .bytes_(b"")
+            .done()
+        )
+        req = (
+            Writer()
+            .string(self.group)
+            .i32(self.session_timeout_ms)
+            .string(member_id)
+            .string("consumer")
+            .array(
+                [("range", meta)],
+                lambda w, p: w.string(p[0]).bytes_(p[1]),
+            )
+            .done()
+        )
+        r = coord.call(API_JOIN_GROUP, 0, req)
+        err = r.i16()
+        if err == ERR_UNKNOWN_MEMBER_ID and member_id:
+            return self._join_group(coord, topic, "", pool)
+        if err != ERR_NONE:
+            raise RuntimeError(f"kafka join group: error {err}")
+        generation = r.i32()
+        r.string()  # protocol
+        leader = r.string()
+        me = r.string()
+        members = [
+            (rr_id, rr_meta)
+            for rr_id, rr_meta in (
+                (r.string(), r.bytes_()) for _ in range(r.i32())
+            )
+        ]
+        return generation, me, leader, members
+
+    def _sync_group(
+        self, coord: KafkaConn, topic: str, generation: int, me: str,
+        leader: str, members, pool: _ConnPool,
+    ) -> list[int]:
+        """SyncGroup phase; returns this member's assigned partitions."""
+        assignments = []
+        if me == leader:
+            # Each member's metadata is a consumer-protocol subscription
+            # (version, topics, userdata). Range-assign EVERY subscribed
+            # topic's partitions among the members subscribed to it — the
+            # manager runs one group member per stream topic, so members
+            # of the shared group subscribe to different topics and an
+            # own-topic-only assignment would park the others forever.
+            subscribers: dict[str, list[str]] = {}
+            for mid, meta in members:
+                rr = Reader(meta or b"")
+                try:
+                    rr.i16()  # version
+                    for t in rr.array(lambda r2: r2.string()):
+                        subscribers.setdefault(t, []).append(mid)
+                except EOFError:
+                    continue
+            per_member: dict[str, list[tuple[str, list[int]]]] = {}
+            for t, mids in sorted(subscribers.items()):
+                parts = sorted(self._metadata(t, pool))
+                mids = sorted(mids)
+                per = -(-len(parts) // len(mids))
+                for i, mid in enumerate(mids):
+                    mine = parts[i * per:(i + 1) * per]
+                    if mine:
+                        per_member.setdefault(mid, []).append((t, mine))
+            for mid, _meta in members:
+                a = (
+                    Writer()
+                    .i16(0)
+                    .array(
+                        per_member.get(mid, []),
+                        lambda w, e: w.string(e[0]).array(
+                            e[1], lambda w2, p: w2.i32(p)
+                        ),
+                    )
+                    .bytes_(b"")
+                    .done()
+                )
+                assignments.append((mid, a))
+
+        sync = (
+            Writer()
+            .string(self.group)
+            .i32(generation)
+            .string(me)
+            .array(
+                assignments, lambda w, a: w.string(a[0]).bytes_(a[1])
+            )
+            .done()
+        )
+        r = coord.call(API_SYNC_GROUP, 0, sync)
+        err = r.i16()
+        if err in (
+            ERR_REBALANCE_IN_PROGRESS,
+            ERR_ILLEGAL_GENERATION,
+            ERR_UNKNOWN_MEMBER_ID,
+        ):
+            raise _Rebalance(f"sync group: error {err}")
+        if err != ERR_NONE:
+            raise RuntimeError(f"kafka sync group: error {err}")
+        blob = r.bytes_() or b""
+        mine: list[int] = []
+        if blob:
+            rr = Reader(blob)
+            rr.i16()  # version
+            for _ in range(rr.i32()):
+                t = rr.string()
+                ps = rr.array(lambda r2: r2.i32())
+                if t == topic:
+                    mine.extend(ps)
+        return mine
+
+    def _committed_offset(self, coord: KafkaConn, topic: str, pid: int) -> int:
+        req = (
+            Writer()
+            .string(self.group)
+            .array(
+                [(topic, [pid])],
+                lambda w, t: w.string(t[0]).array(
+                    t[1], lambda w2, p: w2.i32(p)
+                ),
+            )
+            .done()
+        )
+        r = coord.call(API_OFFSET_FETCH, 1, req)
+        offset = 0
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()  # partition
+                off = r.i64()
+                r.string()  # metadata
+                r.i16()  # error
+                if off >= 0:
+                    offset = off
+        return offset
+
+    def _commit(
+        self, coord: KafkaConn, topic: str, pid: int, offset: int,
+        generation: int, member_id: str,
+    ) -> None:
+        req = (
+            Writer()
+            .string(self.group)
+            .i32(generation)
+            .string(member_id)
+            .i64(-1)  # retention: broker default
+            .array(
+                [(topic, pid, offset)],
+                lambda w, t: w.string(t[0]).array(
+                    [t], lambda w2, tt: w2.i32(tt[1]).i64(tt[2]).string(None)
+                ),
+            )
+            .done()
+        )
+        r = coord.call(API_OFFSET_COMMIT, 2, req)
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()
+                err = r.i16()
+                if err != ERR_NONE:
+                    raise RuntimeError(f"kafka offset commit: error {err}")
+
+    # -- fetch loop -------------------------------------------------------------
+
+    def _consume_loop(self, topic: str) -> None:
+        restarts = 0
+        member_id = ""
+        while not self._stop.is_set():
+            # A fresh pool per session: the error path tears down only
+            # THIS consumer's connections — never the publish path's or
+            # another topic's (shared sockets would let one consumer's
+            # restart inject transport errors into everyone mid-call).
+            pool = _ConnPool(self.client_id, self.timeout_s)
+            with self._lock:
+                self._consumer_pools[topic] = pool
+            progressed: list = []
+            try:
+                coord, chost, cport = self._find_coordinator(pool)
+                # Membership loop: a rebalance rejoins immediately on the
+                # SAME session; only transport errors fall out to the
+                # backoff restart below.
+                while not self._stop.is_set():
+                    try:
+                        generation, member_id, leader, members = (
+                            self._join_group(coord, topic, member_id, pool)
+                        )
+                        with self._lock:
+                            self._memberships[topic] = (
+                                chost, cport, member_id
+                            )
+                        parts = self._sync_group(
+                            coord, topic, generation, member_id, leader,
+                            members, pool,
+                        )
+                        if not parts:
+                            # Overprovisioned group member: heartbeat
+                            # until a rebalance hands us partitions.
+                            self._idle_heartbeat(
+                                coord, topic, generation, member_id
+                            )
+                            continue
+                        cursors = {
+                            pid: _PartitionCursor(
+                                self._committed_offset(coord, topic, pid)
+                            )
+                            for pid in parts
+                        }
+                        self._fetch_until_error(
+                            topic, coord, cursors, generation, member_id,
+                            pool, on_progress=progressed.append,
+                        )
+                    except _Rebalance as e:
+                        logger.info(
+                            "kafka consumer %s rejoining: %s", topic, e
+                        )
+                        # Brief pause: the new generation's leader may
+                        # not have synced its assignments yet.
+                        if self._stop.wait(0.1):
+                            return
+            except Exception as e:
+                if self._stop.is_set():
+                    return
+                # A session that fetched successfully resets the backoff
+                # (brokers.py drivers reset on a successful pull the same
+                # way) — otherwise an old outage escalates every future
+                # transient blip to the 30 s cap forever.
+                restarts = 1 if progressed else restarts + 1
+                log = (
+                    logger.error
+                    if restarts % RESTARTS_LOG_EVERY == 0
+                    else logger.warning
+                )
+                log("kafka consumer %s restart %d: %s", topic, restarts, e)
+                self._invalidate_leaders(topic)
+                if self._stop.wait(_backoff(restarts)):
+                    return
+            finally:
+                pool.close()
+
+    def _idle_heartbeat(self, coord, topic, generation, member_id):
+        while not self._stop.is_set():
+            time.sleep(self.session_timeout_ms / 3000.0)
+            r = coord.call(
+                API_HEARTBEAT, 0,
+                Writer()
+                .string(self.group).i32(generation).string(member_id)
+                .done(),
+            )
+            if r.i16() != ERR_NONE:
+                return  # rejoin
+
+    def _earliest_offset(self, conn: KafkaConn, topic: str, pid: int) -> int:
+        """ListOffsets(earliest): the log-start offset — where a consumer
+        resumes after its committed offset was retention-truncated
+        (resetting to 0 would live-lock on a truncated log)."""
+        req = Writer()
+        req.i32(-1)  # replica id
+        req.array(
+            [(topic, pid)],
+            lambda w, t: w.string(t[0]).array(
+                [t[1]], lambda w2, p: w2.i32(p).i64(EARLIEST_TIMESTAMP)
+            ),
+        )
+        r = conn.call(API_LIST_OFFSETS, 1, req.done())
+        earliest = 0
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()  # partition
+                err = r.i16()
+                r.i64()  # timestamp
+                off = r.i64()
+                if err == ERR_NONE and off >= 0:
+                    earliest = off
+        return earliest
+
+    def _fetch_until_error(
+        self, topic: str, coord: KafkaConn, cursors, generation, member_id,
+        pool: _ConnPool, on_progress=lambda x=None: None,
+    ) -> None:
+        """Fetch/deliver/commit until a transport/membership error bubbles
+        up (caller rejoins). Heartbeats ride the same loop: every blocking
+        wait (fetch long-poll, full-queue put) is budgeted below the
+        heartbeat interval so a large idle assignment or a slow Messenger
+        can't starve the session past its timeout."""
+        leaders = self._metadata(topic, pool)
+        hb_interval = self.session_timeout_ms / 3000.0
+        last_hb = time.monotonic()
+
+        def heartbeat_if_due():
+            nonlocal last_hb
+            if time.monotonic() - last_hb < hb_interval:
+                return
+            r = coord.call(
+                API_HEARTBEAT, 0,
+                Writer()
+                .string(self.group).i32(generation).string(member_id)
+                .done(),
+            )
+            err = r.i16()
+            if err in (
+                ERR_REBALANCE_IN_PROGRESS,
+                ERR_ILLEGAL_GENERATION,
+                ERR_UNKNOWN_MEMBER_ID,
+            ):
+                raise _Rebalance(f"heartbeat: error {err}")
+            if err != ERR_NONE:
+                raise RuntimeError(f"kafka heartbeat: error {err}")
+            last_hb = time.monotonic()
+
+        # One fetch per LEADER covers all its partitions (per-partition
+        # sequential long-polls would take assigned_partitions ×
+        # fetch_max_wait per sweep).
+        by_leader: dict[tuple[str, int], list[int]] = {}
+        for pid in cursors:
+            by_leader.setdefault(leaders[pid], []).append(pid)
+
+        while not self._stop.is_set():
+            heartbeat_if_due()
+            for (host, port), pids in by_leader.items():
+                offsets = {}
+                for pid in pids:
+                    cur = cursors[pid]
+                    with cur.lock:
+                        if cur.rewind_to is not None:
+                            cur.fetch_offset = cur.rewind_to
+                            cur.rewind_to = None
+                        offsets[pid] = cur.fetch_offset
+                hb_budget_ms = int(
+                    max(hb_interval - (time.monotonic() - last_hb), 0.05)
+                    * 1000
+                )
+                req = Writer()
+                req.i32(-1)  # replica id
+                req.i32(min(self.fetch_max_wait_ms, hb_budget_ms))
+                req.i32(1)  # min bytes
+                req.i32(self.fetch_max_bytes)
+                req.i8(0)  # isolation: read uncommitted
+
+                def part(w, pid):
+                    w.i32(pid)
+                    w.i64(offsets[pid])
+                    w.i32(self.fetch_max_bytes)
+
+                def top(w, _):
+                    w.string(topic)
+                    w.array(pids, part)
+
+                req.array([None], top)
+                conn = pool.get(host, port)
+                r = conn.call(API_FETCH, 4, req.done())
+                on_progress(True)  # healthy session: caller resets backoff
+                r.i32()  # throttle
+                records: dict[int, list[tuple[int, bytes]]] = {}
+                for _ in range(r.i32()):
+                    r.string()
+                    for _ in range(r.i32()):
+                        pid = r.i32()
+                        err = r.i16()
+                        r.i64()  # high watermark
+                        r.i64()  # last stable offset
+                        r.array(lambda rr: (rr.i64(), rr.i64()))  # aborted
+                        blob = r.bytes_() or b""
+                        if err == ERR_OFFSET_OUT_OF_RANGE:
+                            start = self._earliest_offset(conn, topic, pid)
+                            cur = cursors[pid]
+                            with cur.lock:
+                                cur.fetch_offset = start
+                                cur.committed = start
+                            continue
+                        if err != ERR_NONE:
+                            raise RuntimeError(
+                                f"kafka fetch {topic}/{pid}: error {err}"
+                            )
+                        records[pid] = decode_record_batches(blob)
+                for pid, recs in records.items():
+                    cur = cursors[pid]
+                    for off, value in recs:
+                        if off < offsets[pid]:
+                            continue  # batch includes already-seen records
+                        msg = Message(
+                            value,
+                            on_ack=self._acker(
+                                coord, topic, pid, cur, off, generation,
+                                member_id,
+                            ),
+                            on_nack=self._nacker(cur, off),
+                        )
+                        while not self._stop.is_set():
+                            heartbeat_if_due()
+                            try:
+                                self._queues[topic].put(msg, timeout=0.5)
+                                break
+                            except queue.Full:
+                                continue
+                        with cur.lock:
+                            cur.fetch_offset = off + 1
+
+    def _acker(self, coord, topic, pid, cur, off, generation, member_id):
+        def ack():
+            with cur.lock:
+                if off + 1 <= cur.committed:
+                    return  # a later ack already covered this offset
+                cur.committed = off + 1
+            # The RPC is serialized per partition and always sends the
+            # LATEST committed value (re-read under the lock), so two
+            # concurrent acks can never land their commits out of order
+            # and regress the broker-side offset.
+            with cur.commit_lock:
+                with cur.lock:
+                    commit_val = cur.committed
+                try:
+                    self._commit(
+                        coord, topic, pid, commit_val, generation, member_id
+                    )
+                except Exception:
+                    logger.warning(
+                        "kafka offset commit failed (will redeliver after "
+                        "restart)", exc_info=True,
+                    )
+        return ack
+
+    def _nacker(self, cur, off):
+        def nack():
+            with cur.lock:
+                if cur.rewind_to is None or off < cur.rewind_to:
+                    cur.rewind_to = off
+        return nack

@@ -229,8 +229,11 @@ def test_scheme_parsing_and_factory():
     )
     b = make_broker("nats://somehost:4223/x")
     assert isinstance(b, NATSBroker) and b.port == 4223
+    from kubeai_tpu.routing.kafka import KafkaBroker
+
+    assert isinstance(make_broker("kafka://h:9092/t"), KafkaBroker)
     with pytest.raises(ValueError):
-        make_broker("kafka://h/t")
+        make_broker("sqs://queue-name")
 
 
 # ---- Pub/Sub driver ----------------------------------------------------------
@@ -330,12 +333,39 @@ def test_nats_reconnect_resubscribes(nats):
 # ---- full messenger suite over each driver -----------------------------------
 
 
-@pytest.fixture(params=["pubsub", "nats", "mem"])
+@pytest.fixture(params=["pubsub", "nats", "kafka", "mem"])
 def messenger_stack(request):
     """Messenger wired to a real driver + protocol fake per param."""
     from tests_messenger_common import build_messenger_world
 
-    if request.param == "pubsub":
+    if request.param == "kafka":
+        from test_kafka_broker import FakeKafka
+
+        from kubeai_tpu.routing.kafka import KafkaBroker
+
+        fake = FakeKafka()
+        broker = KafkaBroker(
+            "127.0.0.1", fake.port, session_timeout_ms=2000,
+            fetch_max_wait_ms=100,
+        )
+        sub = f"kafka://127.0.0.1:{fake.port}/req"
+        resp = f"kafka://127.0.0.1:{fake.port}/resp"
+
+        def inject(body):
+            broker.publish(sub, body)
+
+        def read_response(timeout=10.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                with fake.lock:
+                    msgs = list(fake.log("resp"))
+                if msgs:
+                    return msgs[-1]
+                time.sleep(0.05)
+            raise AssertionError("no response published")
+
+        cleanup = [broker.close, fake.close]
+    elif request.param == "pubsub":
         fake = FakePubSub()
         broker = GCPPubSubBroker(endpoint=fake.endpoint)
         sub, resp = SUB, TOPIC_RESP
