@@ -245,8 +245,10 @@ class Engine:
 
         # Pipeline parallelism: stage-local layers + KV over the pp mesh
         # axis (GPipe microbatched decode; see models/llama.py
-        # decode_step_paged_pp). v1 scope: paged cache, llama-family,
-        # pp composes with dp only.
+        # decode_step_paged_pp). Composes with dp AND tp — the pp
+        # shard_map is manual over pp only (axis_names), so Megatron tp
+        # sharding stays GSPMD-managed inside each stage (the 70B/v5e-8
+        # plan is pp=2 × tp=4). Scope: paged cache, llama-family, sp=1.
         self._pp = self.mesh.shape.get("pp", 1)
         self._pp_microbatches = 0
         if self._pp > 1:
@@ -257,10 +259,10 @@ class Engine:
                 )
             if self.cache_mode != "paged":
                 raise ValueError("pipeline parallelism requires cache_mode='paged'")
-            if self.mesh.shape.get("tp", 1) != 1 or self.mesh.shape.get("sp", 1) != 1:
+            if self.mesh.shape.get("sp", 1) != 1:
                 raise ValueError(
-                    "pipeline parallelism currently composes with dp only "
-                    "(tp and sp mesh axes must be 1)"
+                    "pipeline parallelism does not compose with sp yet "
+                    "(sp mesh axis must be 1)"
                 )
             if cfg.quantization:
                 raise ValueError(

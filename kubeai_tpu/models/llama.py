@@ -655,6 +655,27 @@ def decode_step_paged_pp(
     xs_specs = jax.tree_util.tree_map(lambda _: P(AXIS_PIPELINE), xs)
     rep = P()
 
+    # tp > 1 composes via PARTIAL-manual shard_map: manual collectives
+    # over pp only, while tp (Megatron-sharded projections and KV heads)
+    # stays under GSPMD, which keeps inserting its own collectives inside
+    # the stage body — this is what lets pp compose with tp (the
+    # 70B-on-v5e-8 plan: pp=2 × tp=4) without hand-writing the
+    # tensor-parallel psums. With tp == 1 the shard_map stays FULLY
+    # manual (the pre-composition behavior): partial-manual changes XLA's
+    # fusion choices inside the body, which reorders bf16 rounding enough
+    # to flip near-tie samples vs the single-device engine — keep pure-pp
+    # deployments bit-stable.
+    # NOTE: no jax.lax.psum over pp in the body — psum over the manual
+    # axis of a partial-manual shard_map crashes XLA's partitioners (both
+    # Shardy and GSPMD, jax 0.9: "Invalid binary instruction opcode
+    # copy"); the stage outputs are stacked via out_specs instead and the
+    # last stage selected outside.
+    tp_size = mesh.shape.get("tp", 1)
+    manual_kw = (
+        {"axis_names": {AXIS_PIPELINE}, "check_vma": True}
+        if tp_size > 1 else {"check_vma": False}
+    )
+
     @partial(
         jax.shard_map,
         mesh=mesh,
@@ -662,8 +683,10 @@ def decode_step_paged_pp(
             xs_specs, P(AXIS_PIPELINE), P(AXIS_PIPELINE),
             rep, rep, rep, rep, rep, rep, rep,
         ),
-        out_specs=(rep, P(AXIS_PIPELINE), P(AXIS_PIPELINE)),
-        check_vma=False,
+        out_specs=(
+            P(AXIS_PIPELINE), P(AXIS_PIPELINE), P(AXIS_PIPELINE),
+        ),
+        **manual_kw,
     )
     def run(xs, kp, vp, x_mb, pos_mb, len_mb, pid_mb, off_mb, bt_mb, lidx_mb):
         stage = jax.lax.axis_index(AXIS_PIPELINE)
@@ -710,19 +733,28 @@ def decode_step_paged_pp(
             buf = jax.lax.ppermute(y, AXIS_PIPELINE, fwd)
             return (buf, kp, vp, out), None
 
-        zero = jnp.zeros_like(x_mb[0])
-        out0 = jnp.zeros_like(x_mb)
+        # The activation buffer and output accumulator START identical on
+        # every stage but become stage-varying inside the scan (ppermute /
+        # stage-gated writes): mark them varying over pp up front so the
+        # scan carry types are stable under vma tracking.
+        zero = jax.lax.pcast(
+            jnp.zeros_like(x_mb[0]), AXIS_PIPELINE, to="varying"
+        )
+        out0 = jax.lax.pcast(
+            jnp.zeros_like(x_mb), AXIS_PIPELINE, to="varying"
+        )
         (_, kp, vp, out), _ = jax.lax.scan(
             tick, (zero, kp, vp, out0), jnp.arange(ticks)
         )
-        out = jnp.where(stage == last, out, jnp.zeros_like(out))
-        return jax.lax.psum(out, AXIS_PIPELINE), kp, vp
+        return out[None], kp, vp  # [1, M, mb, E] per stage
 
     hidden, k_pages, v_pages = run(
         xs, k_pages, v_pages, x_mb, pos_mb, len_mb, pid_mb, off_mb,
         bt_mb, lidx_mb,
     )
-    x = hidden.reshape(B, -1)
+    # hidden is [n_stages, M, mb, E]; only the LAST stage stored real
+    # microbatch outputs (the other stages' accumulators are zeros).
+    x = hidden[-1].reshape(B, -1)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = jnp.einsum(
         "be,ve->bv", x, params["lm_head"],

@@ -137,6 +137,86 @@ def test_engine_pp_matches_single_device(devices8, pp, microbatches):
     assert eng.generate(PP_PROMPTS, sp) == ref.generate(PP_PROMPTS, sp)
 
 
+@pytest.mark.parametrize("mesh_kw", [dict(pp=2, tp=2), dict(pp=2, tp=2, dp=2)])
+def test_engine_pp_tp_composed_matches_single_device(devices8, mesh_kw):
+    """pp × tp (the 70B/v5e-8 shape, pp=2×tp=4 scaled down): the pp
+    shard_map is manual over pp only, so Megatron tp sharding stays
+    GSPMD-managed inside each stage. Greedy streams must match the
+    single-device engine. float32 model: tp's GSPMD collectives inside
+    the manual region legitimately reorder float ops, and in bf16 a
+    random-init tiny model near-ties often enough to flip a greedy
+    argmax; in f32 a flip needs a ~1e-7 logit tie."""
+    cfg = _dc.replace(
+        llama.LlamaConfig.tiny(), num_layers=4, dtype=jnp.float32
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(
+        num_slots=4, max_seq_len=96, decode_chunk=4,
+        cache_dtype=jnp.float32,
+    )
+    ref = Engine("llama", cfg, params, cfg=ecfg)
+    n = 1
+    for v in mesh_kw.values():
+        n *= v
+    mesh = build_mesh(MeshConfig(**mesh_kw), devices=devices8[:n])
+    eng = Engine("llama", cfg, params, mesh=mesh, cfg=ecfg)
+    sp = SamplingParams(temperature=0.0, max_tokens=24)
+    assert eng.generate(PP_PROMPTS, sp) == ref.generate(PP_PROMPTS, sp)
+
+
+def test_decode_pp_tp_logits_match_single_device(devices8):
+    """Function-level pp×tp check with a fixed paged-cache state:
+    logits and (non-scratch) pool writes must match the single-device
+    per-layer path to f32 tolerance."""
+    import numpy as np
+
+    from kubeai_tpu.parallel import sharding as psh
+
+    cfg = _dc.replace(
+        llama.LlamaConfig.tiny(), num_layers=4, dtype=jnp.float32
+    )
+    params0 = llama.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(pp=2, tp=2), devices=devices8[:4])
+    params = psh.shard_params(
+        params0, llama.param_specs(cfg), mesh, psh.DEFAULT_RULES
+    )
+    B, NL, page = 4, 4, 16
+    KVH, D = cfg.num_kv_heads, cfg.head_size
+    n_pages = 1 + B * 2
+    pool_sh = psh.named_sharding(
+        mesh, (psh.LAYERS, None, None, psh.KV_HEADS, None),
+        psh.DEFAULT_RULES,
+    )
+    rng = np.random.default_rng(0)
+    kv0 = jnp.asarray(
+        rng.standard_normal((NL, n_pages, page, KVH, D)) * 0.1, jnp.float32
+    )
+    vv0 = jnp.asarray(
+        rng.standard_normal((NL, n_pages, page, KVH, D)) * 0.1, jnp.float32
+    )
+    kp = jax.device_put(kv0, pool_sh)
+    vp = jax.device_put(vv0, pool_sh)
+    bt = jnp.asarray([[1, 2], [3, 4], [5, 6], [7, 8]], jnp.int32)
+    tokens = jnp.asarray([1, 2, 3, 4], jnp.int32)
+    positions = jnp.asarray([20, 17, 9, 5], jnp.int32)
+    lg_pp, kp1, vp1 = llama.decode_step_paged_pp(
+        params, cfg, tokens, positions, kp, vp, bt,
+        mesh=mesh, microbatches=2,
+    )
+    lg, kp2, vp2 = llama.decode_step_paged(
+        params0, cfg, tokens, positions, kv0, vv0, bt,
+        attn_kernel="per_layer",
+    )
+    np.testing.assert_allclose(
+        np.asarray(lg_pp, np.float32), np.asarray(lg, np.float32), atol=1e-5
+    )
+    # Page 0 is the off-schedule scratch sink — it legitimately differs.
+    np.testing.assert_allclose(
+        np.asarray(kp1, np.float32)[:, 1:],
+        np.asarray(kp2, np.float32)[:, 1:], atol=1e-5,
+    )
+
+
 def test_engine_pp_seeded_sampling_matches(devices8):
     _, _, ref, eng = _pp_world(devices8, 2)
     sp = SamplingParams(temperature=0.9, seed=13, max_tokens=16)
