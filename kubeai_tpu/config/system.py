@@ -131,7 +131,18 @@ DEFAULT_MODEL_SERVERS: dict[str, dict[str, str]] = {
         "google-tpu": "kubeai-tpu/engine:latest-tpu",
         "cpu": "kubeai-tpu/engine:latest-cpu",
     },
-    "VLLM": {"default": "vllm/vllm-openai:v0.8.3"},
+    # Hardware-specific vLLM builds (reference: charts/kubeai/
+    # values.yaml:45-54): the CUDA default cannot serve CPU-only, arm64
+    # GH200, or ROCm nodes — profiles name the build they need and
+    # engines without that key fall back to their default.
+    "VLLM": {
+        "default": "vllm/vllm-openai:v0.8.3",
+        "nvidia-gpu": "vllm/vllm-openai:v0.8.3",
+        "cpu": "substratusai/vllm:v0.6.3.post1-cpu",
+        "google-tpu": "substratusai/vllm:v0.6.4.post1-tpu",
+        "gh200": "substratusai/vllm-gh200:v0.8.3",
+        "amd-gpu": "substratusai/vllm-rocm:nightly_main_20250120",
+    },
     "OLlama": {"default": "ollama/ollama:latest"},
     "FasterWhisper": {
         "default": "fedirz/faster-whisper-server:latest-cpu"
@@ -223,6 +234,7 @@ def default_resource_profiles() -> dict[str, ResourceProfile]:
     for the GKE TPU profiles; charts/kubeai/values.yaml for cpu/gpu)."""
     profiles = {
         "cpu": ResourceProfile(
+            image_name="cpu",
             requests={"cpu": "1", "memory": "2Gi"},
             limits={},
         ),
@@ -233,6 +245,33 @@ def default_resource_profiles() -> dict[str, ResourceProfile]:
             node_selector={"cloud.google.com/gke-accelerator": "nvidia-l4"},
         ),
     }
+    # The reference catalog's other GPU tiers (reference:
+    # charts/models/values.yaml resourceProfile usage) — same one-
+    # accelerator-per-unit semantics as nvidia-gpu-l4.
+    for name, image, selector in (
+        (
+            "nvidia-gpu-h100", "nvidia-gpu",
+            {"cloud.google.com/gke-accelerator": "nvidia-h100-80gb"},
+        ),
+        (
+            "nvidia-gpu-a100-80gb", "nvidia-gpu",
+            {"cloud.google.com/gke-accelerator": "nvidia-a100-80gb"},
+        ),
+        # GH200 is arm64 (Grace): needs the aarch64 CUDA build.
+        ("nvidia-gpu-gh200", "gh200", {"nvidia.com/gpu.family": "hopper"}),
+        ("nvidia-gpu-rtx4070-8gb", "nvidia-gpu", {}),
+    ):
+        profiles[name] = ResourceProfile(
+            image_name=image,
+            requests={"nvidia.com/gpu": "1"},
+            limits={"nvidia.com/gpu": "1"},
+            node_selector=selector,
+        )
+    profiles["amd-gpu-mi300x"] = ResourceProfile(
+        image_name="amd-gpu",  # ROCm build
+        requests={"amd.com/gpu": "1"},
+        limits={"amd.com/gpu": "1"},
+    )
     # One chip per profile unit: `resourceProfile: google-tpu-v5e-2x2:4`
     # multiplies to the slice's 4 chips (reference semantics,
     # charts/kubeai/values-gke.yaml:18-41 + charts/models/values.yaml:128).

@@ -87,7 +87,11 @@ def test_catalog_models_render(monkeypatch, tmp_path):
 
     with open(os.path.join(REPO, "catalog", "models.yaml")) as f:
         catalog = parse(f.read())["catalog"]
-    assert len(catalog) >= 30, f"catalog has only {len(catalog)} presets"
+    # Reference parity: charts/models/values.yaml ships ~48 presets.
+    assert len(catalog) >= 48, f"catalog has only {len(catalog)} presets"
+    from kubeai_tpu.config.system import default_resource_profiles
+
+    profiles = default_resource_profiles()
     for name, entry in catalog.items():
         spec = {k: v for k, v in entry.items() if k != "enabled"}
         m = Model.from_dict(
@@ -99,3 +103,6 @@ def test_catalog_models_render(monkeypatch, tmp_path):
             }
         )
         m.validate()
+        # Every preset must point at a deployable profile.
+        prof = entry["resourceProfile"].rsplit(":", 1)[0]
+        assert prof in profiles, f"{name}: unknown resourceProfile {prof}"
