@@ -257,8 +257,16 @@ def default_resource_profiles() -> dict[str, ResourceProfile]:
             "nvidia-gpu-a100-80gb", "nvidia-gpu",
             {"cloud.google.com/gke-accelerator": "nvidia-a100-80gb"},
         ),
-        # GH200 is arm64 (Grace): needs the aarch64 CUDA build.
-        ("nvidia-gpu-gh200", "gh200", {"nvidia.com/gpu.family": "hopper"}),
+        # GH200 is arm64 (Grace): needs the aarch64 CUDA build, and the
+        # arch selector keeps it OFF x86 Hopper (H100 shares the
+        # gpu.family=hopper feature label).
+        (
+            "nvidia-gpu-gh200", "gh200",
+            {
+                "nvidia.com/gpu.family": "hopper",
+                "kubernetes.io/arch": "arm64",
+            },
+        ),
         ("nvidia-gpu-rtx4070-8gb", "nvidia-gpu", {}),
     ):
         profiles[name] = ResourceProfile(

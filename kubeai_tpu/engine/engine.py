@@ -196,7 +196,19 @@ class Engine:
         cfg: EngineConfig = EngineConfig(),
         rules: psh.ShardingRules = psh.DEFAULT_RULES,
         eos_token_ids: tuple[int, ...] = (),
+        draft: tuple[Any, Any] | None = None,
     ):
+        """`draft`: optional (draft_cfg, draft_params) — a small same-family
+        model that PROPOSES the speculative window (cfg.speculate > 0)
+        instead of prompt-lookup. Prompt-lookup's acceptance collapses on
+        non-repetitive text; a draft model proposes from actual model
+        probabilities, so acceptance tracks draft/target agreement. The
+        draft keeps its own slot KV cache: each window feeds it the true
+        last emitted token at its true position, so accepted proposals'
+        KV (written during proposal) is correct and rejected positions
+        are masked (length = position+1) until overwritten. Verify
+        guarantees the emitted stream is exact regardless of proposal
+        quality."""
         self.family = (
             get_model_family(family) if isinstance(family, str) else family
         )
@@ -223,6 +235,11 @@ class Engine:
         self._mode_tps: dict[str, float | None] = {}
         self._mode_calls: dict[str, int] = {}
         self._decode_calls = 0
+        # Speculation acceptance: proposed/accepted counts over live
+        # slots (windows = spec steps × live slots). Reading it after a
+        # run answers "did the proposer earn its keep" — the draft's
+        # whole point vs prompt-lookup on non-repetitive text.
+        self.spec_stats = {"windows": 0, "proposed": 0, "accepted": 0}
 
         # Resolve the cache mode: paged needs family support; otherwise
         # fall back to the slot cache. Chunked prefill works in both modes
@@ -440,6 +457,7 @@ class Engine:
                 or _llama.prefill_chunk
             )
 
+        self._draft = None
         if cfg.speculate > 0:
             if cfg.pipeline:
                 raise ValueError("speculate and pipeline are mutually exclusive")
@@ -450,7 +468,53 @@ class Engine:
                 and self._pp == 1  # verify kernel is not pp-staged
             ):
                 self._spec = cfg.speculate
+                if draft is not None:
+                    if cfg.prefill_chunk:
+                        raise ValueError(
+                            "draft speculation with chunked prefill is "
+                            "not supported yet"
+                        )
+                    dcfg, dparams = draft
+                    self._draft_cfg = dcfg
+                    # Small drafts often have fewer KV heads than tp: fall
+                    # back to replicated KV heads for BOTH the draft's
+                    # params and its cache (the same GQA-on-TPU fallback
+                    # the main cache uses).
+                    dc_rules = rules
+                    if dcfg.num_kv_heads % max(
+                        self.mesh.shape.get("tp", 1), 1
+                    ):
+                        dc_rules = psh.ShardingRules(
+                            rules=tuple(
+                                (n, None if n == psh.KV_HEADS else p)
+                                for n, p in rules.rules
+                            )
+                        )
+                    self._draft_params = psh.shard_params(
+                        dparams, self.family.param_specs(dcfg), self.mesh,
+                        dc_rules,
+                    )
+                    self._draft_sharding = psh.named_sharding(
+                        self.mesh, KVCache.logical_axes(), dc_rules
+                    )
+                    dc = KVCache.create(
+                        dcfg.num_layers, cfg.num_slots, cfg.max_seq_len,
+                        dcfg.num_kv_heads, dcfg.head_size, cfg.cache_dtype,
+                        sharding=self._draft_sharding,
+                    )
+                    self._dk, self._dv = dc.k, dc.v
+                    self._draft = True
             else:
+                if draft is not None:
+                    # A draft is explicit caller intent (weights were
+                    # loaded for it) — dropping it silently would hide
+                    # the misconfiguration.
+                    raise ValueError(
+                        "draft model provided but speculation is "
+                        f"unavailable (cache_mode={self.cache_mode!r}, "
+                        f"pp={self._pp}, family verify="
+                        f"{getattr(self.family, 'decode_verify_paged', None) is not None})"
+                    )
                 import logging
 
                 logging.getLogger(__name__).warning(
@@ -460,6 +524,10 @@ class Engine:
                     getattr(self.family, "decode_verify_paged", None)
                     is not None,
                 )
+        elif draft is not None:
+            raise ValueError(
+                "draft model provided but cfg.speculate == 0"
+            )
 
         self._build_jits(cache_sharding)
 
@@ -828,6 +896,97 @@ class Engine:
                 out_shardings=(
                     None, None, pool_sharding, pool_sharding, None,
                 ),
+            )
+
+        if self._draft:
+            dcfg = self._draft_cfg
+            gamma = self._spec
+            dsh = self._draft_sharding
+            decode_draft = fam.decode_step
+
+            def _draft_propose(dparams, dk, dv, tokens, positions):
+                """γ+1 greedy draft steps in ONE device call: the chain
+                starts from the true last emitted token at its true
+                position (keeping the draft's slot KV consistent — see
+                Engine.__init__ docstring) and each step's argmax feeds
+                the next. The chain runs one step PAST the last proposal
+                so proposal γ's own KV is written too: on a fully
+                accepted window that token is emitted and the next
+                window resumes AFTER it — without the extra step its
+                position would be a permanent hole in the draft cache,
+                silently poisoning every later window's proposals.
+                Returns proposals [B, γ] (the extra step's output is
+                dropped)."""
+
+                def step_fn(carry, _):
+                    tok, pos, dk, dv = carry
+                    logits, dk, dv = decode_draft(
+                        dparams, dcfg, tok, pos, dk, dv
+                    )
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    nxt_pos = jnp.minimum(pos + 1, max_len - 1)
+                    return (nxt, nxt_pos, dk, dv), nxt
+
+                (_, _, dk, dv), props = jax.lax.scan(
+                    step_fn,
+                    (tokens, positions, dk, dv),
+                    None,
+                    length=gamma + 1,
+                )
+                return jnp.moveaxis(props, 0, 1)[:, :gamma], dk, dv
+
+            self._draft_propose_jit = jax.jit(
+                _draft_propose,
+                donate_argnums=(1, 2),
+                out_shardings=(None, dsh, dsh),
+            )
+
+            draft_prefill = self._resolve_prefill()  # sp-aware, like target
+
+            def _draft_admit(dparams, tokens, lengths, slots, dk, dv):
+                """Draft prefill for an admission group: the draft's slot
+                rows must hold the prompt KV before the first window
+                (padding rows use slot = num_slots; the OOB scatter
+                drops them)."""
+                _, k_all, v_all = draft_prefill(
+                    dparams, dcfg, tokens, lengths
+                )
+                S = tokens.shape[1]
+                dk = dk.at[:, slots, :S].set(k_all.astype(dk.dtype))
+                dv = dv.at[:, slots, :S].set(v_all.astype(dv.dtype))
+                return dk, dv
+
+            self._draft_admit_jit = jax.jit(
+                _draft_admit,
+                donate_argnums=(4, 5),
+                out_shardings=(dsh, dsh),
+            )
+
+            def _draft_catchup(dparams, dk, dv, inputs, positions):
+                """Teacher-forced draft pass over a chunk-mode window's
+                emitted tokens. Adaptive switching runs whole windows in
+                chunk mode, which advances sequences WITHOUT writing
+                draft KV — without this pass the draft cache desyncs
+                permanently after the first chunk window and acceptance
+                silently collapses for the rest of each request's life.
+                `inputs` is [chunk, B]: the pre-window last token, then
+                the window's emitted tokens except its last (which is the
+                next call's input)."""
+
+                def step_fn(carry, tok):
+                    pos, dk, dv = carry
+                    _, dk, dv = decode_draft(dparams, dcfg, tok, pos, dk, dv)
+                    return (jnp.minimum(pos + 1, max_len - 1), dk, dv), None
+
+                (_, dk, dv), _ = jax.lax.scan(
+                    step_fn, (positions, dk, dv), inputs
+                )
+                return dk, dv
+
+            self._draft_catchup_jit = jax.jit(
+                _draft_catchup,
+                donate_argnums=(1, 2),
+                out_shardings=(dsh, dsh),
             )
 
         if self.cfg.prefill_chunk > 0:
@@ -1212,6 +1371,15 @@ class Engine:
             self._state,
             self._lora,
         )
+        if self._draft:
+            self._dk, self._dv = self._draft_admit_jit(
+                self._draft_params,
+                jnp.asarray(tokens),
+                jnp.asarray(ints[:, 0]),
+                jnp.asarray(ints[:, 1]),
+                self._dk,
+                self._dv,
+            )
         return np.asarray(toks_dev)[:A]
 
     def _finish_admission(
@@ -1476,6 +1644,18 @@ class Engine:
                         self._bt_dirty = False
                     if self._spec and self._spec_pick():
                         decode_mode = "spec"
+                        if self._draft:
+                            proposals, self._dk, self._dv = (
+                                self._draft_propose_jit(
+                                    self._draft_params,
+                                    self._dk,
+                                    self._dv,
+                                    self._state["tokens"],
+                                    self._state["positions"],
+                                )
+                            )
+                        else:
+                            proposals = jnp.asarray(self._build_proposals())
                         (
                             choices,
                             n_emit,
@@ -1488,13 +1668,17 @@ class Engine:
                             self.cache.v_pages,
                             self.cache.block_tables,
                             self._state,
-                            jnp.asarray(self._build_proposals()),
+                            proposals,
                             self._lora,
                         )
                         toks_seq = ("spec", choices, n_emit)
                     else:
                         if self._spec:
                             decode_mode = "chunk"
+                        pre_tokens = pre_positions = None
+                        if self._draft:
+                            pre_tokens = self._state["tokens"]
+                            pre_positions = self._state["positions"]
                         (
                             toks_seq,
                             self.cache.k_pages,
@@ -1508,6 +1692,17 @@ class Engine:
                             self._state,
                             self._lora,
                         )
+                        if self._draft:
+                            # Keep the draft cache in lockstep with the
+                            # chunk the target just decoded (see
+                            # _draft_catchup).
+                            inputs = jnp.concatenate(
+                                [pre_tokens[None], toks_seq[:-1]], axis=0
+                            )
+                            self._dk, self._dv = self._draft_catchup_jit(
+                                self._draft_params, self._dk, self._dv,
+                                inputs, pre_positions,
+                            )
                 else:
                     toks_seq, self.cache.k, self.cache.v, self._state = (
                         self._decode_jit(
@@ -1568,6 +1763,9 @@ class Engine:
         for slot, req in chunk_slots:
             if req.done:
                 continue
+            self.spec_stats["windows"] += 1
+            self.spec_stats["proposed"] += self._spec
+            self.spec_stats["accepted"] += int(n_emit[slot]) - 1
             for j in range(int(n_emit[slot])):
                 tok = int(choices[slot, j])
                 req.out_tokens.append(tok)
