@@ -316,6 +316,20 @@ class ControllerLoop:
             if item is None:
                 return
             _event, obj = item
+            if _event == "RELIST":
+                # Watch gap (410 Gone relist): deletions in the gap left
+                # no event — re-enqueue every live Model so reconciles
+                # converge from the fresh snapshot.
+                try:
+                    for m in self.store.list("Model"):
+                        meta = m.get("metadata") or {}
+                        self._queue.put(
+                            (meta.get("namespace", "default"),
+                             meta.get("name", ""))
+                        )
+                except Exception:
+                    logger.warning("relist resync failed", exc_info=True)
+                continue
             self._enqueue_obj(obj)
 
     def _work_loop(self) -> None:

@@ -199,5 +199,36 @@ class RestKubeClient:
                             "resourceVersion", rv
                         )
                         q.put((ev.get("type", "MODIFIED"), obj))
+            except urllib.error.HTTPError as e:
+                e.read()
+                if e.code == 410:
+                    # 410 Gone: our resourceVersion was compacted away.
+                    # Retrying with the stale rv would 410 forever; the
+                    # protocol answer is RELIST. A "RELIST" sentinel goes
+                    # first — creations/updates in the gap are subsumed
+                    # by the snapshot's synthetic MODIFIEDs, but
+                    # DELETIONS leave no object to emit, so consumers
+                    # must full-resync on the sentinel. The relist
+                    # retries with backoff until it succeeds: resuming
+                    # "from now" after a failed relist would silently
+                    # drop the gap.
+                    rv = self._relist_into(kind, q)
+                else:
+                    self._stop.wait(2.0)
             except OSError:
                 self._stop.wait(2.0)  # reconnect with backoff
+
+    def _relist_into(self, kind: str, q: queue.Queue) -> str:
+        while not self._stop.is_set():
+            try:
+                out = self._req("GET", self._route(kind, None))
+                break
+            except (OSError, NotFound):
+                self._stop.wait(2.0)
+        else:
+            return ""
+        q.put(("RELIST", {"kind": kind, "metadata": {}}))
+        for it in out.get("items", []):
+            it.setdefault("kind", kind)
+            q.put(("MODIFIED", it))
+        return (out.get("metadata") or {}).get("resourceVersion", "")

@@ -206,6 +206,29 @@ class KubeStore:
                 return
             del self._objects[k]
             self._emit("DELETED", obj)
+            self._collect_garbage_locked(m)
+
+    def _collect_garbage_locked(self, owner_meta: dict) -> None:
+        """Cascade-delete dependents whose ownerReference matches the
+        deleted object (the real cluster's garbage collector; the
+        reference relies on it for Pod cleanup via controller refs).
+        Strictly uid-matched, like the real GC — name fallbacks would
+        cascade on unrelated same-named objects."""
+        uid = owner_meta.get("uid")
+        if not uid:
+            return
+        victims = [
+            key for key, o in self._objects.items()
+            if any(
+                ref.get("uid") == uid
+                for ref in (meta(o).get("ownerReferences") or [])
+            )
+        ]
+        for kind_v, ns_v, name_v in victims:
+            try:
+                self.delete(kind_v, ns_v, name_v)
+            except NotFound:
+                pass
 
     def delete_all_of(
         self,

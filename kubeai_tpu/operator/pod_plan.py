@@ -87,6 +87,11 @@ def calculate_pod_plan(
     desired_pod["metadata"].pop("name", None)
     desired_pod["metadata"]["generateName"] = f"model-{model.name}-{expected_hash}-"
     k8sutils.set_label(desired_pod, md.POD_HASH_LABEL, expected_hash)
+    # The controller ownerReference is set ONCE, by PodPlan.execute
+    # (k8sutils.set_owner_reference) — a second controller=true ref here
+    # would be rejected by a real apiserver. Garbage collection of pods
+    # on Model deletion rides that reference (store/envtest implement
+    # the cluster GC's uid-matched cascade).
 
     pods = sort_pods_by_deletion_order(all_pods, expected_hash)
 

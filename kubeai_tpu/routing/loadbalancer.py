@@ -187,6 +187,11 @@ class LoadBalancer:
             if item is None:
                 return
             _event, pod = item
+            if _event == "RELIST":
+                # Watch gap (410 Gone relist): deletions in the gap left
+                # no event, so rebuild every group from the snapshot.
+                self.sync_all()
+                continue
             model = k8sutils.get_label(pod, md.POD_MODEL_LABEL)
             if model:
                 self.sync_model(model, pod["metadata"].get("namespace", "default"))

@@ -164,17 +164,16 @@ def test_rollout_on_spec_change(world):
 
 
 def test_deletion_removes_pods(world):
+    """Pods carry a controller ownerReference (pod_plan), so deleting
+    the Model garbage-collects them — the real cluster's GC behavior,
+    which the store and the envtest server both implement."""
     store, _, rec, _ = world
     mk_model(store, replicas=2)
     rec.reconcile("default", "m1")
+    assert len(model_pods(store)) == 2
     store.delete("Model", "default", "m1")
-    # No finalizers -> object gone; reconcile of leftover pods happens via
-    # delete_all_of in the deletion path before removal... the object is
-    # already gone here, so simulate the controller's pod cleanup pass:
-    rec.reconcile("default", "m1")
-    # Pods are orphaned but the reference deletes them in the deletion
-    # path; with no finalizer the Model vanished instantly. Re-list:
     assert store.try_get("Model", "default", "m1") is None
+    assert model_pods(store) == []  # cascade-deleted, not orphaned
 
 
 def test_cache_flow_with_manual_job_completion(world):
