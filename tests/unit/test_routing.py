@@ -355,7 +355,6 @@ def test_sse_streams_incrementally_through_proxy(stack):
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     store, lb, server, add_model, _ = stack
-    first_chunk_seen = threading.Event()
     release_rest = threading.Event()
 
     class StreamingEngine(BaseHTTPRequestHandler):
@@ -410,7 +409,6 @@ def test_sse_streams_incrementally_through_proxy(stack):
         assert resp.status == 200
         got = resp.read1(16384)  # must yield BEFORE the engine finishes
         assert b"first" in got, got
-        first_chunk_seen.set()
         release_rest.set()
         rest = b""
         while b"[DONE]" not in rest:
