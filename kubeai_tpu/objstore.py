@@ -229,6 +229,60 @@ class GCSClient:
             conn.close()
 
 
+def sigv4_sign(
+    method: str,
+    path: str,
+    query: str,
+    extra_headers: dict[str, str],
+    payload_hash: str,
+    *,
+    service: str,
+    region: str,
+    host: str,
+    access_key: str,
+    secret_key: str,
+) -> dict:
+    """AWS Signature Version 4 over (host, x-amz-date, extra_headers) —
+    shared by the S3 object store and the SQS messenger driver (same
+    algorithm, different service strings and signed-header sets)."""
+    now = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    headers = {"host": host, "x-amz-date": amz_date}
+    headers.update({k.lower(): v for k, v in extra_headers.items()})
+    names = sorted(headers)
+    canonical_headers = "".join(f"{k}:{headers[k]}\n" for k in names)
+    signed = ";".join(names)
+    canonical = "\n".join(
+        [method, path, query, canonical_headers, signed, payload_hash]
+    )
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    to_sign = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            scope,
+            hashlib.sha256(canonical.encode()).hexdigest(),
+        ]
+    )
+
+    def hm(key, msg):
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = hm(("AWS4" + secret_key).encode(), datestamp)
+    k = hm(k, region)
+    k = hm(k, service)
+    k = hm(k, "aws4_request")
+    sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+    out = {k: v for k, v in extra_headers.items()}
+    out["x-amz-date"] = amz_date
+    out["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed}, Signature={sig}"
+    )
+    return out
+
+
 class S3Client:
     """S3 REST (path-style) with optional SigV4 signing."""
 
@@ -261,44 +315,13 @@ class S3Client:
         """AWS Signature Version 4 (headers-only, single-chunk)."""
         if not self.access_key or not self.secret_key:
             return {}  # unsigned: fakes/public buckets
-        now = datetime.datetime.now(datetime.timezone.utc)
-        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
-        datestamp = now.strftime("%Y%m%d")
-        host = self._host()
-        canonical_headers = (
-            f"host:{host}\nx-amz-content-sha256:{payload_hash}\n"
-            f"x-amz-date:{amz_date}\n"
+        return sigv4_sign(
+            method, path, query,
+            {"x-amz-content-sha256": payload_hash},
+            payload_hash,
+            service="s3", region=self.region, host=self._host(),
+            access_key=self.access_key, secret_key=self.secret_key,
         )
-        signed = "host;x-amz-content-sha256;x-amz-date"
-        canonical = "\n".join(
-            [method, path, query, canonical_headers, signed, payload_hash]
-        )
-        scope = f"{datestamp}/{self.region}/s3/aws4_request"
-        to_sign = "\n".join(
-            [
-                "AWS4-HMAC-SHA256",
-                amz_date,
-                scope,
-                hashlib.sha256(canonical.encode()).hexdigest(),
-            ]
-        )
-
-        def hm(key, msg):
-            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
-
-        k = hm(("AWS4" + self.secret_key).encode(), datestamp)
-        k = hm(k, self.region)
-        k = hm(k, "s3")
-        k = hm(k, "aws4_request")
-        sig = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
-        return {
-            "x-amz-date": amz_date,
-            "x-amz-content-sha256": payload_hash,
-            "Authorization": (
-                f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
-                f"SignedHeaders={signed}, Signature={sig}"
-            ),
-        }
 
     EMPTY_SHA = hashlib.sha256(b"").hexdigest()
 

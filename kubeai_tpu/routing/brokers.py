@@ -17,6 +17,10 @@ wire protocols directly:
       replicas compete for messages (gocloud natspubsub parity: core
       NATS is at-most-once; ack/nack are no-ops).
 
+  SQSBroker (routing/sqs.py) — the SQS JSON protocol with shared SigV4
+      signing: ReceiveMessage long-poll pull, DeleteMessage ack,
+      ChangeMessageVisibility(0) nack (gocloud awssnssqs parity).
+
   KafkaBroker (routing/kafka.py) — the Kafka binary protocol:
       Metadata/Produce/Fetch with record-batch v2 + CRC32C, consumer
       groups (JoinGroup/SyncGroup/Heartbeat, leader-computed range
@@ -34,6 +38,7 @@ URL forms (config `messaging.streams`):
   gcppubsub://projects/P/topics/T          (responseTopic)
   nats://host:4222/subject                 (both)
   kafka://host:9092/topic                  (both)
+  sqs://sqs.REGION.amazonaws.com/ACCT/q    (both; routing/sqs.py)
   plain names (no scheme)                  → in-memory MemBroker
 """
 
@@ -54,7 +59,7 @@ from kubeai_tpu.routing.messenger import Broker, MemBroker, Message
 
 logger = logging.getLogger(__name__)
 
-SUPPORTED_SCHEMES = ("mem", "gcppubsub", "nats", "kafka")
+SUPPORTED_SCHEMES = ("mem", "gcppubsub", "nats", "kafka", "sqs")
 
 # The reference aborts the process after 20 subscription restarts
 # (messenger.go:98) and lets the Pod restart. A library thread can't
@@ -88,6 +93,21 @@ def make_broker(url: str, **kwargs) -> Broker:
         return KafkaBroker(
             parsed.hostname or "localhost", parsed.port or 9092, **kwargs
         )
+    if scheme == "sqs":
+        from kubeai_tpu.routing.sqs import SQSBroker
+
+        # The queue URL's host carries the region
+        # (sqs.REGION.amazonaws.com) — signing with $AWS_REGION's default
+        # against a different-region host would 403 on every call.
+        parsed = urllib.parse.urlparse(url)
+        host_parts = (parsed.hostname or "").split(".")
+        if (
+            "region" not in kwargs
+            and len(host_parts) >= 4
+            and host_parts[0] == "sqs"
+        ):
+            kwargs["region"] = host_parts[1]
+        return SQSBroker(**kwargs)
     raise ValueError(
         f"unsupported messaging scheme {scheme!r} "
         f"(supported: {', '.join(SUPPORTED_SCHEMES)})"

@@ -232,8 +232,13 @@ def test_scheme_parsing_and_factory():
     from kubeai_tpu.routing.kafka import KafkaBroker
 
     assert isinstance(make_broker("kafka://h:9092/t"), KafkaBroker)
+    from kubeai_tpu.routing.sqs import SQSBroker
+
+    assert isinstance(
+        make_broker("sqs://sqs.us-east-1.amazonaws.com/1/q"), SQSBroker
+    )
     with pytest.raises(ValueError):
-        make_broker("sqs://queue-name")
+        make_broker("rabbit://queue-name")
 
 
 # ---- Pub/Sub driver ----------------------------------------------------------
@@ -333,12 +338,38 @@ def test_nats_reconnect_resubscribes(nats):
 # ---- full messenger suite over each driver -----------------------------------
 
 
-@pytest.fixture(params=["pubsub", "nats", "kafka", "mem"])
+@pytest.fixture(params=["pubsub", "nats", "kafka", "sqs", "mem"])
 def messenger_stack(request):
     """Messenger wired to a real driver + protocol fake per param."""
     from tests_messenger_common import build_messenger_world
 
-    if request.param == "kafka":
+    if request.param == "sqs":
+        from test_sqs_broker import FakeSQS
+
+        from kubeai_tpu.routing.sqs import SQSBroker
+
+        fake = FakeSQS()
+        broker = SQSBroker(endpoint=fake.endpoint, wait_seconds=1)
+        sub = "sqs://sqs.us-east-1.amazonaws.com/123/req"
+        resp = "sqs://sqs.us-east-1.amazonaws.com/123/resp"
+
+        def inject(body):
+            broker.publish(sub, body)
+
+        def read_response(timeout=10.0):
+            import base64 as _b64
+
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                with fake.lock:
+                    msgs = list(fake._queue(broker.queue_url(resp)))
+                if msgs:
+                    return _b64.b64decode(msgs[-1]["Body"])
+                time.sleep(0.05)
+            raise AssertionError("no response published")
+
+        cleanup = [broker.close, fake.close]
+    elif request.param == "kafka":
         from test_kafka_broker import FakeKafka
 
         from kubeai_tpu.routing.kafka import KafkaBroker

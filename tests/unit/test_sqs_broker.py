@@ -1,0 +1,228 @@
+"""SQS driver against an in-process protocol fake (JSON protocol:
+X-Amz-Target dispatch, receipt handles, visibility timeouts)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubeai_tpu.routing.sqs import SQSBroker
+
+
+class FakeSQS:
+    """Single-endpoint SQS speaking the JSON protocol. Messages carry
+    receipt handles and visibility timeouts; nack (visibility 0) makes
+    them immediately receivable again."""
+
+    def __init__(self):
+        self.queues: dict[str, list[dict]] = {}  # path -> messages
+        self.lock = threading.Lock()
+        self.fail_next_receives = 0
+        self.saw_auth: list[str] = []
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                target = self.headers.get("X-Amz-Target", "")
+                auth = self.headers.get("Authorization")
+                if auth:
+                    outer.saw_auth.append(auth)
+                action = target.split(".")[-1]
+                code, payload = outer.handle(action, body)
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/x-amz-json-1.0")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def _queue(self, queue_url: str) -> list[dict]:
+        import urllib.parse
+
+        path = urllib.parse.urlparse(queue_url).path
+        return self.queues.setdefault(path, [])
+
+    def handle(self, action: str, body: dict):
+        with self.lock:
+            q = self._queue(body.get("QueueUrl", "/"))
+            if action == "SendMessage":
+                q.append(
+                    {
+                        "Body": body["MessageBody"],
+                        "ReceiptHandle": uuid.uuid4().hex,
+                        "visible_at": 0.0,
+                    }
+                )
+                return 200, {"MessageId": uuid.uuid4().hex}
+            if action == "ReceiveMessage":
+                if self.fail_next_receives > 0:
+                    self.fail_next_receives -= 1
+                    return 500, {"__type": "InternalFailure"}
+                deadline = time.time() + min(
+                    float(body.get("WaitTimeSeconds", 0)), 2.0
+                )
+                while True:
+                    now = time.time()
+                    ready = [m for m in q if m["visible_at"] <= now]
+                    if ready or time.time() >= deadline:
+                        break
+                    self.lock.release()
+                    try:
+                        time.sleep(0.05)
+                    finally:
+                        self.lock.acquire()
+                out = []
+                for m in ready[: int(body.get("MaxNumberOfMessages", 1))]:
+                    m["visible_at"] = now + 30.0  # in flight
+                    out.append(
+                        {
+                            "Body": m["Body"],
+                            "ReceiptHandle": m["ReceiptHandle"],
+                        }
+                    )
+                return 200, ({"Messages": out} if out else {})
+            if action == "DeleteMessage":
+                handle = body["ReceiptHandle"]
+                q[:] = [m for m in q if m["ReceiptHandle"] != handle]
+                return 200, {}
+            if action == "ChangeMessageVisibility":
+                handle = body["ReceiptHandle"]
+                for m in q:
+                    if m["ReceiptHandle"] == handle:
+                        m["visible_at"] = time.time() + float(
+                            body.get("VisibilityTimeout", 0)
+                        )
+                return 200, {}
+            return 400, {"__type": "InvalidAction"}
+
+
+@pytest.fixture
+def sqs():
+    fake = FakeSQS()
+    broker = SQSBroker(endpoint=fake.endpoint, wait_seconds=1)
+    yield fake, broker
+    broker.close()
+    fake.close()
+
+
+URL = "sqs://sqs.us-east-1.amazonaws.com/123456789/requests"
+
+
+def test_factory_scheme():
+    from kubeai_tpu.routing.brokers import make_broker
+
+    b = make_broker(URL, endpoint="http://127.0.0.1:1")
+    assert isinstance(b, SQSBroker)
+    assert b.queue_url(URL) == "http://127.0.0.1:1/123456789/requests"
+    # Without an endpoint override the stream URL IS the queue URL.
+    assert SQSBroker(endpoint=None, access_key="", secret_key="").queue_url(
+        URL
+    ) == "https://sqs.us-east-1.amazonaws.com/123456789/requests"
+    # The region rides the queue URL's host — signing must use it, not
+    # the env default.
+    b2 = make_broker(
+        "sqs://sqs.ap-southeast-2.amazonaws.com/9/q",
+        endpoint="http://127.0.0.1:1",
+    )
+    assert b2.region == "ap-southeast-2"
+
+
+def test_publish_receive_ack_deletes(sqs):
+    fake, broker = sqs
+    broker.publish(URL, b"hello \x00 binary")
+    msg = broker.receive(URL, timeout=10)
+    assert msg is not None and msg.body == b"hello \x00 binary"
+    msg.ack()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        with fake.lock:
+            if not fake._queue(broker.queue_url(URL)):
+                break
+        time.sleep(0.05)
+    with fake.lock:
+        assert fake._queue(broker.queue_url(URL)) == []  # DeleteMessage hit
+    assert broker.receive(URL, timeout=0.3) is None
+
+
+def test_nack_redelivers(sqs):
+    fake, broker = sqs
+    broker.publish(URL, b"retry-me")
+    msg = broker.receive(URL, timeout=10)
+    assert msg is not None
+    msg.nack()  # visibility 0 -> immediately receivable again
+    again = broker.receive(URL, timeout=10)
+    assert again is not None and again.body == b"retry-me"
+    again.ack()
+
+
+def test_pull_survives_server_errors(sqs):
+    fake, broker = sqs
+    fake.fail_next_receives = 3
+    broker.publish(URL, b"after-outage")
+    msg = broker.receive(URL, timeout=20)
+    assert msg is not None and msg.body == b"after-outage"
+    assert fake.fail_next_receives == 0
+
+
+def test_foreign_raw_body_passes_through(sqs):
+    fake, broker = sqs
+    with fake.lock:
+        fake._queue(broker.queue_url(URL)).append(
+            {
+                "Body": "not base64!!",
+                "ReceiptHandle": "h1",
+                "visible_at": 0.0,
+            }
+        )
+    msg = broker.receive(URL, timeout=10)
+    assert msg is not None and msg.body == b"not base64!!"
+
+
+def test_sigv4_headers_sent_when_credentialed(sqs):
+    fake, _ = sqs
+    broker = SQSBroker(
+        endpoint=fake.endpoint, access_key="AKID", secret_key="SECRET",
+        region="eu-west-1", wait_seconds=1,
+    )
+    try:
+        broker.publish(URL, b"signed")
+        assert fake.saw_auth, "no Authorization header reached the server"
+        auth = fake.saw_auth[-1]
+        assert "AWS4-HMAC-SHA256" in auth
+        assert "eu-west-1/sqs/aws4_request" in auth
+        assert "content-type;host;x-amz-date;x-amz-target" in auth
+    finally:
+        broker.close()
+
+
+def test_base64_roundtrip_on_wire(sqs):
+    fake, broker = sqs
+    broker.publish(URL, b"\x01\x02")
+    with fake.lock:
+        stored = fake._queue(broker.queue_url(URL))[0]["Body"]
+    assert base64.b64decode(stored) == b"\x01\x02"
