@@ -1,0 +1,564 @@
+"""RabbitMQ messenger driver: AMQP 0-9-1 on the wire, zero dependencies.
+
+The reference registers gocloud.dev's rabbitpubsub driver for rabbit://
+streams (reference: internal/manager/run.go:47-52). This driver speaks
+AMQP 0-9-1 directly over TCP:
+
+  handshake        protocol header → Connection.Start/StartOk (PLAIN) →
+                   Tune/TuneOk → Open/OpenOk
+  per-queue        its own channel: Queue.Declare (durable), then
+                   Basic.Consume; publishes ride channel 1 through the
+                   default exchange (routing key = queue name)
+  delivery         Basic.Deliver + content header + body frames →
+                   bounded local queue (flow control: the broker keeps
+                   the backlog; unacked messages redeliver on nack or
+                   connection loss)
+  ack/nack         Basic.Ack / Basic.Nack(requeue=true) — gocloud
+                   rabbitpubsub parity
+
+The reader thread reconnects with exponential backoff and re-declares +
+re-consumes every queue (the reference's subscription-restart behavior,
+internal/messenger/messenger.go:98-127).
+
+URL forms (config `messaging.streams`):
+  rabbit://host:5672/queue-name     (gocloud scheme)
+  amqp://host:5672/queue-name
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import socket
+import struct
+import threading
+import time
+import urllib.parse
+
+from kubeai_tpu.routing.brokers import RESTARTS_LOG_EVERY, _backoff
+from kubeai_tpu.routing.messenger import Message
+
+logger = logging.getLogger(__name__)
+
+FRAME_METHOD = 1
+FRAME_HEADER = 2
+FRAME_BODY = 3
+FRAME_HEARTBEAT = 8
+FRAME_END = 0xCE
+
+# (class, method) ids used.
+CONN_START = (10, 10)
+CONN_START_OK = (10, 11)
+CONN_TUNE = (10, 30)
+CONN_TUNE_OK = (10, 31)
+CONN_OPEN = (10, 40)
+CONN_OPEN_OK = (10, 41)
+CONN_CLOSE = (10, 50)
+CONN_CLOSE_OK = (10, 51)
+CHAN_OPEN = (20, 10)
+CHAN_OPEN_OK = (20, 11)
+CHAN_CLOSE = (20, 40)
+CHAN_CLOSE_OK = (20, 41)
+BASIC_QOS = (60, 10)
+BASIC_QOS_OK = (60, 11)
+QUEUE_DECLARE = (50, 10)
+QUEUE_DECLARE_OK = (50, 11)
+BASIC_CONSUME = (60, 20)
+BASIC_CONSUME_OK = (60, 21)
+BASIC_PUBLISH = (60, 40)
+BASIC_DELIVER = (60, 60)
+BASIC_ACK = (60, 80)
+BASIC_NACK = (60, 120)
+
+
+def short_str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">B", len(b)) + b
+
+
+def long_str(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+def read_short_str(buf: bytes, pos: int) -> tuple[str, int]:
+    n = buf[pos]
+    return buf[pos + 1:pos + 1 + n].decode(), pos + 1 + n
+
+
+def read_long_str(buf: bytes, pos: int) -> tuple[bytes, int]:
+    (n,) = struct.unpack_from(">I", buf, pos)
+    return buf[pos + 4:pos + 4 + n], pos + 4 + n
+
+
+def method_frame(channel: int, cls: int, meth: int, args: bytes) -> bytes:
+    payload = struct.pack(">HH", cls, meth) + args
+    return (
+        struct.pack(">BHI", FRAME_METHOD, channel, len(payload))
+        + payload
+        + bytes([FRAME_END])
+    )
+
+
+def content_frames(channel: int, body: bytes) -> bytes:
+    header = struct.pack(">HHQH", 60, 0, len(body), 0)  # no properties
+    out = (
+        struct.pack(">BHI", FRAME_HEADER, channel, len(header))
+        + header
+        + bytes([FRAME_END])
+    )
+    if body:
+        out += (
+            struct.pack(">BHI", FRAME_BODY, channel, len(body))
+            + body
+            + bytes([FRAME_END])
+        )
+    return out
+
+
+class AMQPBroker:
+    """Broker-seam driver (publish/receive/close) over AMQP 0-9-1."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int = 5672,
+        username: str = "guest",
+        password: str = "guest",
+        vhost: str = "/",
+        timeout_s: float = 30.0,
+    ):
+        self.host, self.port = host, port
+        self.username, self.password = username, password
+        self.vhost = vhost
+        self.timeout_s = timeout_s
+        self._sock: socket.socket | None = None
+        self._wlock = threading.Lock()
+        self._lock = threading.Lock()
+        self._queues: dict[str, queue.Queue] = {}  # queue name -> local q
+        self._channels: dict[int, str] = {}  # channel -> consumed queue
+        self._next_channel = 2  # 1 is the publish channel
+        self._declared: set[str] = set()
+        self._pub_channel_open = False  # channel 1, (re)opened per conn
+        # Connection generation: bumps on reconnect so ack/nack closures
+        # from deliveries of a DEAD connection become no-ops (their
+        # delivery tags are meaningless on the new connection; a stale
+        # Basic.Ack would draw Channel.Close 406 from a real broker).
+        self._gen = 0
+        # Per-channel prefetch == the local queue bound, so the broker
+        # never pushes more than the local queue can hold and the reader
+        # thread's put can't stall the whole connection.
+        self.prefetch = 64
+        self._stop = threading.Event()
+        self._reader: threading.Thread | None = None
+        # Pending synchronous replies: (channel, cls, meth) -> Event+args.
+        self._replies: dict[tuple[int, int, int], bytes] = {}
+        self._reply_cond = threading.Condition(self._lock)
+
+    @staticmethod
+    def queue_of(url: str) -> str:
+        if "://" in url:
+            return urllib.parse.urlparse(url).path.strip("/") or "default"
+        return url
+
+    # -- connection -------------------------------------------------------------
+
+    def _send(self, data: bytes) -> None:
+        with self._wlock:
+            sock = self._sock
+            if sock is None:
+                raise ConnectionError("AMQP not connected")
+            sock.sendall(data)
+
+    def _call(self, channel: int, cls: int, meth: int, args: bytes,
+              expect: tuple[int, int]) -> bytes:
+        """Send a synchronous method and wait for its reply method."""
+        key = (channel, *expect)
+        with self._lock:
+            self._replies.pop(key, None)
+        self._send(method_frame(channel, cls, meth, args))
+        end = time.monotonic() + self.timeout_s
+        with self._reply_cond:
+            # Absolute deadline: notify_all fires for EVERY reply on any
+            # channel, and restarting the window per wakeup would let a
+            # lost reply block far past timeout_s.
+            while key not in self._replies:
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    raise ConnectionError(
+                        f"AMQP timeout waiting for {expect}"
+                    )
+                self._reply_cond.wait(timeout=remaining)
+        with self._lock:
+            return self._replies.pop(key)
+
+    def _connect_locked(self) -> None:
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout_s
+        )
+        sock.sendall(b"AMQP\x00\x00\x09\x01")
+        self._sock = sock
+        if self._reader is None or not self._reader.is_alive():
+            self._reader = threading.Thread(
+                target=self._read_loop, daemon=True
+            )
+            self._reader.start()
+
+    def _handshake(self) -> None:
+        """Runs in the reader thread after Connection.Start arrives."""
+        plain = b"\x00" + self.username.encode() + b"\x00" + self.password.encode()
+        args = (
+            b"\x00\x00\x00\x00"  # empty client-properties table
+            + short_str("PLAIN")
+            + long_str(plain)
+            + short_str("en_US")
+        )
+        self._send(method_frame(0, *CONN_START_OK, args))
+
+    def _ensure_connected(self) -> None:
+        with self._lock:
+            if self._sock is None:
+                self._connect_locked()
+        # Wait for Connection.OpenOk (reader completes the handshake).
+        end = time.monotonic() + self.timeout_s
+        with self._reply_cond:
+            while (0, *CONN_OPEN_OK) not in self._replies:
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    raise ConnectionError("AMQP handshake timed out")
+                self._reply_cond.wait(timeout=remaining)
+
+    def _ensure_channel(self, channel: int) -> None:
+        self._call(channel, *CHAN_OPEN, short_str(""), CHAN_OPEN_OK)
+
+    def _declare(self, channel: int, qname: str) -> None:
+        args = (
+            struct.pack(">H", 0)  # ticket
+            + short_str(qname)
+            + bytes([0b00000010])  # durable
+            + b"\x00\x00\x00\x00"  # empty arguments table
+        )
+        self._call(channel, *QUEUE_DECLARE, args, QUEUE_DECLARE_OK)
+
+    # -- Broker interface -------------------------------------------------------
+
+    def publish(self, topic_url: str, body: bytes) -> None:
+        qname = self.queue_of(topic_url)
+        self._ensure_connected()
+        with self._lock:
+            chan_open = self._pub_channel_open
+        if not chan_open:
+            # A real broker treats any method on an unopened channel as
+            # a protocol violation — channel 1 must Channel.Open per
+            # connection (the flag resets on reconnect).
+            self._ensure_channel(1)
+            with self._lock:
+                self._pub_channel_open = True
+        with self._lock:
+            declared = qname in self._declared
+        if not declared:
+            self._declare(1, qname)
+            with self._lock:
+                self._declared.add(qname)
+        args = (
+            struct.pack(">H", 0)
+            + short_str("")  # default exchange
+            + short_str(qname)  # routing key = queue
+            + bytes([0])  # mandatory/immediate off
+        )
+        self._send(
+            method_frame(1, *BASIC_PUBLISH, args) + content_frames(1, body)
+        )
+
+    def receive(self, sub_url: str, timeout: float) -> Message | None:
+        qname = self.queue_of(sub_url)
+        with self._lock:
+            known = qname in self._queues
+            if not known:
+                self._queues[qname] = queue.Queue(maxsize=self.prefetch)
+        if not known:
+            try:
+                self._ensure_connected()
+                self._start_consumer(qname)
+            except Exception:
+                # Setup failed: forget the queue so the NEXT receive
+                # retries the whole setup — leaving it registered would
+                # poll an empty local queue forever (a silently dead
+                # subscription).
+                with self._lock:
+                    self._queues.pop(qname, None)
+                    for ch, q in list(self._channels.items()):
+                        if q == qname:
+                            del self._channels[ch]
+                raise
+        try:
+            return self._queues[qname].get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _start_consumer(self, qname: str) -> None:
+        with self._lock:
+            channel = self._next_channel
+            self._next_channel += 1
+            self._channels[channel] = qname
+        self._ensure_channel(channel)
+        self._declare(channel, qname)
+        # Prefetch bounds the broker's pushes to what the local queue
+        # can hold, so a slow consumer can't stall the reader thread.
+        self._call(
+            channel, *BASIC_QOS,
+            struct.pack(">IHB", 0, self.prefetch, 0), BASIC_QOS_OK,
+        )
+        args = (
+            struct.pack(">H", 0)
+            + short_str(qname)
+            + short_str(f"ctag-{channel}")
+            + bytes([0])  # no-local/no-ack/exclusive/no-wait off
+            + b"\x00\x00\x00\x00"
+        )
+        self._call(channel, *BASIC_CONSUME, args, BASIC_CONSUME_OK)
+
+    def close(self) -> None:
+        self._stop.set()
+        with self._lock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)  # wake the blocked reader
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- reader -----------------------------------------------------------------
+
+    def _read_frame(self, sock) -> tuple[int, int, bytes]:
+        hdr = self._read_n(sock, 7)
+        ftype, channel, size = struct.unpack(">BHI", hdr)
+        payload = self._read_n(sock, size)
+        end = self._read_n(sock, 1)
+        if end[0] != FRAME_END:
+            raise ConnectionError("AMQP frame desync")
+        return ftype, channel, payload
+
+    @staticmethod
+    def _read_n(sock, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = sock.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("AMQP connection closed")
+            out += chunk
+        return out
+
+    def _read_loop(self) -> None:
+        restarts = 0
+        pending: dict[int, dict] = {}  # channel -> partial delivery
+        while not self._stop.is_set():
+            sock = self._sock
+            if sock is None:
+                if self._stop.wait(0.2):
+                    return
+                continue
+            try:
+                ftype, channel, payload = self._read_frame(sock)
+                restarts = 0
+                if ftype == FRAME_HEARTBEAT:
+                    self._send(
+                        struct.pack(">BHI", FRAME_HEARTBEAT, 0, 0)
+                        + bytes([FRAME_END])
+                    )
+                    continue
+                if ftype == FRAME_METHOD:
+                    cls, meth = struct.unpack_from(">HH", payload, 0)
+                    args = payload[4:]
+                    self._on_method(channel, cls, meth, args, pending)
+                elif ftype == FRAME_HEADER:
+                    d = pending.get(channel)
+                    if d is not None:
+                        (d["size"],) = struct.unpack_from(">Q", payload, 4)
+                        d["body"] = b""
+                        if d["size"] == 0:
+                            self._complete_delivery(channel, pending)
+                elif ftype == FRAME_BODY:
+                    d = pending.get(channel)
+                    if d is not None:
+                        d["body"] += payload
+                        if len(d["body"]) >= d["size"]:
+                            self._complete_delivery(channel, pending)
+            except Exception as e:
+                if self._stop.is_set():
+                    return
+                restarts += 1
+                log = (
+                    logger.error
+                    if restarts % RESTARTS_LOG_EVERY == 0
+                    else logger.warning
+                )
+                log("AMQP connection lost (reconnect %d): %s", restarts, e)
+                with self._lock:
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    self._replies.clear()
+                    self._pub_channel_open = False
+                    # Old deliveries' ack/nack closures become no-ops:
+                    # their tags belong to the dead connection.
+                    self._gen += 1
+                pending.clear()
+                if self._stop.wait(_backoff(restarts)):
+                    return
+                try:
+                    with self._lock:
+                        # A publisher's _ensure_connected may have
+                        # reconnected during the backoff — opening a
+                        # second connection here would leak its socket.
+                        if self._sock is None:
+                            self._connect_locked()
+                    # Redo handshake + consumers from this (reader)
+                    # thread's perspective: the new reader loop instance
+                    # handles Start; we re-register consumers once
+                    # OpenOk lands (driven by _on_method below).
+                except Exception:
+                    with self._lock:
+                        self._sock = None
+
+    def _on_method(
+        self, channel: int, cls: int, meth: int, args: bytes, pending
+    ) -> None:
+        if (cls, meth) == CONN_START:
+            self._handshake()
+            return
+        if (cls, meth) == CONN_TUNE:
+            self._send(
+                method_frame(
+                    0, *CONN_TUNE_OK,
+                    struct.pack(">HIH", 0, 0, 0),  # no limits, no heartbeat
+                )
+            )
+            self._send(
+                method_frame(
+                    0, *CONN_OPEN,
+                    short_str(self.vhost) + short_str("") + bytes([0]),
+                )
+            )
+            return
+        if (cls, meth) == CONN_OPEN_OK:
+            with self._reply_cond:
+                self._replies[(0, *CONN_OPEN_OK)] = args
+                self._reply_cond.notify_all()
+            # Reconnect path: re-open channels + re-consume every queue.
+            with self._lock:
+                consumers = dict(self._channels)
+                self._declared.clear()
+            for ch, qname in consumers.items():
+                try:
+                    self._reconsume(ch, qname)
+                except Exception:
+                    logger.warning(
+                        "AMQP re-consume %s failed", qname, exc_info=True
+                    )
+            return
+        if (cls, meth) == BASIC_DELIVER:
+            pos = 0
+            _ctag, pos = read_short_str(args, pos)
+            (delivery_tag,) = struct.unpack_from(">Q", args, pos)
+            pending[channel] = {"tag": delivery_tag, "size": None, "body": b""}
+            return
+        if (cls, meth) == CONN_CLOSE:
+            self._send(method_frame(0, *CONN_CLOSE_OK, b""))
+            raise ConnectionError("server closed the AMQP connection")
+        if (cls, meth) == CHAN_CLOSE:
+            # Channel-level error (e.g. 406 on a stale ack): answer
+            # CloseOk, then treat it as a connection restart — the
+            # reconnect path re-opens every channel and re-consumes,
+            # which is simpler and safer than per-channel repair.
+            self._send(method_frame(channel, *CHAN_CLOSE_OK, b""))
+            raise ConnectionError(
+                f"server closed AMQP channel {channel}: {args[:64]!r}"
+            )
+        # Synchronous replies (ChannelOpenOk, DeclareOk, ConsumeOk, ...).
+        with self._reply_cond:
+            self._replies[(channel, cls, meth)] = args
+            self._reply_cond.notify_all()
+
+    def _reconsume(self, channel: int, qname: str) -> None:
+        """Re-establish one consumer on an existing channel number after
+        a reconnect (runs inline in the reader thread — uses the async
+        sends only, waiting via the replies map would deadlock the
+        reader, so fire-and-forget: the server's -Ok methods land in the
+        replies map and are ignored)."""
+        self._send(method_frame(channel, *CHAN_OPEN, short_str("")))
+        self._send(
+            method_frame(
+                channel, *QUEUE_DECLARE,
+                struct.pack(">H", 0) + short_str(qname)
+                + bytes([0b00000010]) + b"\x00\x00\x00\x00",
+            )
+        )
+        self._send(
+            method_frame(
+                channel, *BASIC_QOS,
+                struct.pack(">IHB", 0, self.prefetch, 0),
+            )
+        )
+        self._send(
+            method_frame(
+                channel, *BASIC_CONSUME,
+                struct.pack(">H", 0) + short_str(qname)
+                + short_str(f"ctag-{channel}") + bytes([0])
+                + b"\x00\x00\x00\x00",
+            )
+        )
+
+    def _complete_delivery(self, channel: int, pending: dict) -> None:
+        d = pending.pop(channel)
+        qname = self._channels.get(channel)
+        if qname is None:
+            return
+        tag = d["tag"]
+        gen = self._gen
+        msg = Message(
+            bytes(d["body"]),
+            on_ack=lambda: self._ack(channel, tag, gen),
+            on_nack=lambda: self._nack(channel, tag, gen),
+        )
+        q = self._queues.get(qname)
+        if q is None:
+            return
+        while not self._stop.is_set():
+            try:
+                q.put(msg, timeout=1.0)
+                return
+            except queue.Full:
+                continue
+
+    def _ack(self, channel: int, tag: int, gen: int) -> None:
+        if gen != self._gen:
+            return  # stale tag from a dead connection; it redelivers
+        try:
+            self._send(
+                method_frame(
+                    channel, *BASIC_ACK, struct.pack(">QB", tag, 0)
+                )
+            )
+        except Exception:
+            logger.warning("AMQP ack failed (will redeliver)", exc_info=True)
+
+    def _nack(self, channel: int, tag: int, gen: int) -> None:
+        if gen != self._gen:
+            return  # connection died: the broker requeued it already
+        try:
+            # requeue=true -> immediate redelivery (gocloud parity).
+            self._send(
+                method_frame(
+                    channel, *BASIC_NACK,
+                    struct.pack(">QB", tag, 0b00000010),
+                )
+            )
+        except Exception:
+            logger.warning("AMQP nack failed", exc_info=True)

@@ -39,6 +39,7 @@ URL forms (config `messaging.streams`):
   nats://host:4222/subject                 (both)
   kafka://host:9092/topic                  (both)
   sqs://sqs.REGION.amazonaws.com/ACCT/q    (both; routing/sqs.py)
+  rabbit://host:5672/queue (or amqp://)    (both; routing/amqp.py)
   plain names (no scheme)                  → in-memory MemBroker
 """
 
@@ -59,7 +60,7 @@ from kubeai_tpu.routing.messenger import Broker, MemBroker, Message
 
 logger = logging.getLogger(__name__)
 
-SUPPORTED_SCHEMES = ("mem", "gcppubsub", "nats", "kafka", "sqs")
+SUPPORTED_SCHEMES = ("mem", "gcppubsub", "nats", "kafka", "sqs", "rabbit", "amqp")
 
 # The reference aborts the process after 20 subscription restarts
 # (messenger.go:98) and lets the Pod restart. A library thread can't
@@ -77,30 +78,36 @@ def make_broker(url: str, **kwargs) -> Broker:
     """Build a broker for a stream URL. One broker per stream; brokers
     multiplex subscriptions/topics internally."""
     scheme = scheme_of(url)
+    parsed = urllib.parse.urlparse(url if "://" in url else "mem://" + url)
+    host = parsed.hostname or "localhost"
     if scheme == "mem":
         return MemBroker()
     if scheme == "gcppubsub":
         return GCPPubSubBroker(**kwargs)
     if scheme == "nats":
-        parsed = urllib.parse.urlparse(url)
-        return NATSBroker(
-            parsed.hostname or "localhost", parsed.port or 4222, **kwargs
-        )
+        return NATSBroker(host, parsed.port or 4222, **kwargs)
     if scheme == "kafka":
         from kubeai_tpu.routing.kafka import KafkaBroker
 
-        parsed = urllib.parse.urlparse(url)
-        return KafkaBroker(
-            parsed.hostname or "localhost", parsed.port or 9092, **kwargs
-        )
+        return KafkaBroker(host, parsed.port or 9092, **kwargs)
+    if scheme in ("rabbit", "amqp"):
+        from kubeai_tpu.routing.amqp import AMQPBroker
+
+        # amqp:// URLs conventionally carry credentials; dropping them
+        # would always authenticate as guest/guest, which production
+        # RabbitMQ restricts to localhost.
+        if parsed.username and "username" not in kwargs:
+            kwargs["username"] = urllib.parse.unquote(parsed.username)
+        if parsed.password and "password" not in kwargs:
+            kwargs["password"] = urllib.parse.unquote(parsed.password)
+        return AMQPBroker(host, parsed.port or 5672, **kwargs)
     if scheme == "sqs":
         from kubeai_tpu.routing.sqs import SQSBroker
 
         # The queue URL's host carries the region
         # (sqs.REGION.amazonaws.com) — signing with $AWS_REGION's default
         # against a different-region host would 403 on every call.
-        parsed = urllib.parse.urlparse(url)
-        host_parts = (parsed.hostname or "").split(".")
+        host_parts = host.split(".")
         if (
             "region" not in kwargs
             and len(host_parts) >= 4

@@ -237,8 +237,11 @@ def test_scheme_parsing_and_factory():
     assert isinstance(
         make_broker("sqs://sqs.us-east-1.amazonaws.com/1/q"), SQSBroker
     )
+    from kubeai_tpu.routing.amqp import AMQPBroker
+
+    assert isinstance(make_broker("rabbit://h:5672/q"), AMQPBroker)
     with pytest.raises(ValueError):
-        make_broker("rabbit://queue-name")
+        make_broker("azuresb://topic-name")
 
 
 # ---- Pub/Sub driver ----------------------------------------------------------
@@ -338,12 +341,34 @@ def test_nats_reconnect_resubscribes(nats):
 # ---- full messenger suite over each driver -----------------------------------
 
 
-@pytest.fixture(params=["pubsub", "nats", "kafka", "sqs", "mem"])
+@pytest.fixture(params=["pubsub", "nats", "kafka", "sqs", "rabbit", "mem"])
 def messenger_stack(request):
     """Messenger wired to a real driver + protocol fake per param."""
     from tests_messenger_common import build_messenger_world
 
-    if request.param == "sqs":
+    if request.param == "rabbit":
+        from test_amqp_broker import FakeRabbit
+
+        from kubeai_tpu.routing.amqp import AMQPBroker
+
+        fake = FakeRabbit()
+        broker = AMQPBroker("127.0.0.1", fake.port)
+        sub = f"rabbit://127.0.0.1:{fake.port}/req"
+        resp = f"rabbit://127.0.0.1:{fake.port}/resp"
+        listener = AMQPBroker("127.0.0.1", fake.port)
+
+        def inject(body):
+            broker.publish(sub, body)
+
+        def read_response(timeout=10.0):
+            msg = listener.receive(resp, timeout=timeout)
+            assert msg is not None, "no response published"
+            msg.ack()
+            return msg.body
+
+        listener.receive(resp, timeout=0.2)  # pre-subscribe
+        cleanup = [broker.close, listener.close, fake.close]
+    elif request.param == "sqs":
         from test_sqs_broker import FakeSQS
 
         from kubeai_tpu.routing.sqs import SQSBroker
