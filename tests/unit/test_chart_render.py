@@ -73,6 +73,31 @@ def test_rendered_config_loads_through_real_parser(tmp_path):
     assert cfg.resource_profiles  # defaults kick in
 
 
+def test_rendered_manifests_fresh():
+    """deploy/rendered/kubeai-tpu.yaml is the committed default install
+    (the `helm install` equivalent, see deploy/chart/README.md); it must
+    always match a fresh render of the chart sources."""
+    import io
+    import json
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        render_mod.main([])
+    fresh = buf.getvalue()
+    committed = open(
+        os.path.join(REPO, "deploy", "rendered", "kubeai-tpu.yaml")
+    ).read()
+    assert fresh == committed, (
+        "deploy/rendered/kubeai-tpu.yaml is stale — regenerate with "
+        "`python deploy/chart/render.py > deploy/rendered/kubeai-tpu.yaml`"
+    )
+    kinds = [
+        json.loads(d)["kind"] for d in committed.split("\n---\n") if d.strip()
+    ]
+    assert {"Namespace", "Deployment", "Service", "Role"} <= set(kinds)
+
+
 def test_catalog_models_render(monkeypatch, tmp_path):
     # Write a small catalog with one enabled entry and point the module
     # at it via the repo layout (use the real catalog: at least one entry
