@@ -195,6 +195,9 @@ class AMQPBroker:
         sock = socket.create_connection(
             (self.host, self.port), timeout=self.timeout_s
         )
+        # Connect timeout only — as a read timeout it would make every
+        # idle period look like a dead connection and churn reconnects.
+        sock.settimeout(None)
         sock.sendall(b"AMQP\x00\x00\x09\x01")
         self._sock = sock
         if self._reader is None or not self._reader.is_alive():

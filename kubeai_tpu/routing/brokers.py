@@ -40,6 +40,7 @@ URL forms (config `messaging.streams`):
   kafka://host:9092/topic                  (both)
   sqs://sqs.REGION.amazonaws.com/ACCT/q    (both; routing/sqs.py)
   rabbit://host:5672/queue (or amqp://)    (both; routing/amqp.py)
+  azuresb://NS.servicebus.windows.net/q    (both; routing/amqp10.py)
   plain names (no scheme)                  → in-memory MemBroker
 """
 
@@ -60,7 +61,9 @@ from kubeai_tpu.routing.messenger import Broker, MemBroker, Message
 
 logger = logging.getLogger(__name__)
 
-SUPPORTED_SCHEMES = ("mem", "gcppubsub", "nats", "kafka", "sqs", "rabbit", "amqp")
+SUPPORTED_SCHEMES = (
+    "mem", "gcppubsub", "nats", "kafka", "sqs", "rabbit", "amqp", "azuresb",
+)
 
 # The reference aborts the process after 20 subscription restarts
 # (messenger.go:98) and lets the Pod restart. A library thread can't
@@ -101,6 +104,10 @@ def make_broker(url: str, **kwargs) -> Broker:
         if parsed.password and "password" not in kwargs:
             kwargs["password"] = urllib.parse.unquote(parsed.password)
         return AMQPBroker(host, parsed.port or 5672, **kwargs)
+    if scheme == "azuresb":
+        from kubeai_tpu.routing.amqp10 import AzureSBBroker
+
+        return AzureSBBroker(host, parsed.port, **kwargs)
     if scheme == "sqs":
         from kubeai_tpu.routing.sqs import SQSBroker
 

@@ -37,11 +37,13 @@ class CHWBL:
         self.metrics = metrics
         self._hashes: list[int] = []  # sorted ring points
         self._ring: dict[int, str] = {}  # point -> endpoint
+        self._members: set[str] = set()  # O(1) membership
 
     def _point(self, endpoint: str, i: int) -> int:
         return xxhash64(f"{endpoint}{i}".encode())
 
     def add(self, endpoint: str) -> None:
+        self._members.add(endpoint)
         for i in range(self.replication):
             h = self._point(endpoint, i)
             if h in self._ring:
@@ -50,6 +52,7 @@ class CHWBL:
             bisect.insort(self._hashes, h)
 
     def remove(self, endpoint: str) -> None:
+        self._members.discard(endpoint)
         for i in range(self.replication):
             h = self._point(endpoint, i)
             if self._ring.get(h) == endpoint:
@@ -59,7 +62,9 @@ class CHWBL:
                     self._hashes.pop(idx)
 
     def __contains__(self, endpoint: str) -> bool:
-        return any(True for e in self._ring.values() if e == endpoint)
+        # O(1): the LB checks membership on every sync; scanning all
+        # replication × N ring values was O(R·N) per check.
+        return endpoint in self._members
 
     def get(
         self,

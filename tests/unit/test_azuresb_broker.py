@@ -1,0 +1,446 @@
+"""Azure Service Bus driver against an in-process AMQP 1.0 fake.
+
+The fake's type DECODER is written independently of the driver's codec
+(its own constructor-byte switch), so a symmetric encode/decode bug in
+amqp10.py cannot cancel out; its outgoing frames reuse the driver's
+encode() (the driver's decode path is exercised against real-broker
+layouts by the codec unit tests below)."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from kubeai_tpu.routing.amqp10 import (
+    AMQP_HDR,
+    SASL_HDR,
+    AzureSBBroker,
+    Described,
+    Sym,
+    decode,
+    encode,
+    frame,
+    perf,
+)
+
+# ---- independent mini-decoder (fake side) ------------------------------------
+
+
+def fdecode(buf, pos=0):
+    c = buf[pos]
+    pos += 1
+    if c == 0x00:
+        desc, pos = fdecode(buf, pos)
+        val, pos = fdecode(buf, pos)
+        return ("described", desc, val), pos
+    if c == 0x40:
+        return None, pos
+    if c == 0x41:
+        return True, pos
+    if c == 0x42:
+        return False, pos
+    if c in (0x43, 0x44):
+        return 0, pos
+    if c in (0x50, 0x52, 0x53):
+        return buf[pos], pos + 1
+    if c == 0x60:
+        return struct.unpack_from(">H", buf, pos)[0], pos + 2
+    if c == 0x70:
+        return struct.unpack_from(">I", buf, pos)[0], pos + 4
+    if c == 0x80:
+        return struct.unpack_from(">Q", buf, pos)[0], pos + 8
+    if c in (0xA0, 0xA1, 0xA3):
+        n = buf[pos]
+        raw = bytes(buf[pos + 1:pos + 1 + n])
+        pos += 1 + n
+        return (raw.decode() if c != 0xA0 else raw), pos
+    if c in (0xB0, 0xB1, 0xB3):
+        (n,) = struct.unpack_from(">I", buf, pos)
+        raw = bytes(buf[pos + 4:pos + 4 + n])
+        pos += 4 + n
+        return (raw.decode() if c != 0xB0 else raw), pos
+    if c == 0x45:
+        return [], pos
+    if c == 0xC0:
+        size, count = buf[pos], buf[pos + 1]
+        end = pos + 1 + size
+        pos += 2
+        out = []
+        for _ in range(count):
+            v, pos = fdecode(buf, pos)
+            out.append(v)
+        return out, end
+    if c == 0xD0:
+        size, count = struct.unpack_from(">II", buf, pos)
+        end = pos + 4 + size
+        pos += 8
+        out = []
+        for _ in range(count):
+            v, pos = fdecode(buf, pos)
+            out.append(v)
+        return out, end
+    raise ValueError(f"fake cannot decode 0x{c:02x}")
+
+
+class FakeServiceBus:
+    """Single-connection-at-a-time AMQP 1.0 queue broker."""
+
+    def __init__(self):
+        self.queues: dict[str, list[bytes]] = {}
+        self.unsettled: dict[int, tuple[str, bytes]] = {}  # did -> (q, body)
+        self.lock = threading.Lock()
+        self.connections = 0
+        self.saw_sasl: list = []
+        self._conns: list[socket.socket] = []
+        # GLOBAL consumer registry: queue -> [(send fn, handle, link)] —
+        # publishes on one connection must pump receivers on OTHERS (the
+        # messenger's publish/subscribe brokers are separate connections).
+        self.consumers: dict[str, list] = {}
+        self._next_did = 0
+        self._stop = threading.Event()
+        self.srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.srv.bind(("127.0.0.1", 0))
+        self.srv.listen(8)
+        self.port = self.srv.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    @property
+    def endpoint(self):
+        return f"127.0.0.1:{self.port}"
+
+    def close(self):
+        self._stop.set()
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+        self.drop_connections()
+
+    def drop_connections(self):
+        with self.lock:
+            conns, self._conns = self._conns, []
+            self.consumers.clear()
+            for did, (q, body) in self.unsettled.items():
+                self.queues.setdefault(q, []).insert(0, body)
+            self.unsettled.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _recv_n(conn, n):
+        out = b""
+        while len(out) < n:
+            chunk = conn.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("closed")
+            out += chunk
+        return out
+
+    def _recv_frame(self, conn):
+        size, doff, ftype, ch = struct.unpack(">IBBH", self._recv_n(conn, 8))
+        body = self._recv_n(conn, size - 8)
+        return ftype, ch, body[(doff - 2) * 4:]
+
+    def _accept(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            with self.lock:
+                self.connections += 1
+                self._conns.append(conn)
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _pump(self, qname):
+        """Deliver to ANY connection's consumers of qname (publisher and
+        subscriber are different connections in the messenger stack)."""
+        while True:
+            with self.lock:
+                entries = [
+                    e for e in self.consumers.get(qname, [])
+                    if e["credit"] > 0
+                ]
+                if not entries or not self.queues.get(qname):
+                    return
+                entry = entries[0]
+                body = self.queues[qname].pop(0)
+                self._next_did += 1
+                did = self._next_did
+                self.unsettled[did] = (qname, body)
+                entry["credit"] -= 1
+            payload = encode(Described(0x75, body))
+            try:
+                entry["send"](
+                    frame(
+                        0,
+                        perf(
+                            0x14,
+                            [entry["handle"], did,
+                             struct.pack(">I", did), 0, False, False],
+                        ),
+                        payload,
+                    )
+                )
+            except OSError:
+                with self.lock:
+                    if self.unsettled.pop(did, None):
+                        self.queues.setdefault(qname, []).insert(0, body)
+                    if entry in self.consumers.get(qname, []):
+                        self.consumers[qname].remove(entry)
+                return
+
+    def _serve(self, conn):
+        links: dict[int, dict] = {}  # handle -> consumer/sender entry
+        wlock = threading.Lock()
+
+        def send(data):
+            with wlock:
+                conn.sendall(data)
+
+        try:
+            assert self._recv_n(conn, 8) == SASL_HDR
+            send(SASL_HDR)
+            send(
+                frame(
+                    0, perf(0x40, [Sym("PLAIN"), Sym("ANONYMOUS")]),
+                    sasl=True,
+                )
+            )
+            while True:
+                ftype, ch, body = self._recv_frame(conn)
+                p, pos = fdecode(body)
+                _, code, fields = p
+                if code == 0x41:  # sasl-init
+                    with self.lock:
+                        self.saw_sasl.append(fields)
+                    send(frame(0, perf(0x44, [0]), sasl=True))
+                    break
+            assert self._recv_n(conn, 8) == AMQP_HDR
+            send(AMQP_HDR)
+            while not self._stop.is_set():
+                ftype, ch, body = self._recv_frame(conn)
+                if not body:
+                    continue
+                p, pos = fdecode(body)
+                payload = body[pos:]
+                _, code, fields = p
+
+                def fld(i, default=None):
+                    return (
+                        fields[i]
+                        if len(fields) > i and fields[i] is not None
+                        else default
+                    )
+
+                if code == 0x10:  # open
+                    send(frame(0, perf(0x10, ["fake-sb"])))
+                elif code == 0x11:  # begin
+                    send(frame(0, perf(0x11, [0, 0, 2 ** 16, 2 ** 16])))
+                elif code == 0x12:  # attach
+                    handle = fld(1)
+                    receiver = bool(fld(2))
+                    if receiver:
+                        _, _, src = fields[5]  # described source
+                        qname = src[0]
+                    else:
+                        _, _, tgt = fields[6]  # described target
+                        qname = tgt[0]
+                    entry = {
+                        "queue": qname, "receiver": receiver,
+                        "credit": 0, "handle": handle, "send": send,
+                    }
+                    links[handle] = entry
+                    with self.lock:
+                        self.queues.setdefault(qname, [])
+                        if receiver:
+                            self.consumers.setdefault(qname, []).append(
+                                entry
+                            )
+                    # Echo the attach (opposite role), then grant sender
+                    # credit.
+                    send(frame(0, perf(0x12, [fld(0), handle, not receiver])))
+                    if not receiver:
+                        send(
+                            frame(
+                                0,
+                                perf(0x13, [0, 2 ** 16, 0, 2 ** 16,
+                                            handle, 0, 100]),
+                            )
+                        )
+                elif code == 0x13:  # flow (receiver grants credit)
+                    handle = fld(4)
+                    if handle is not None and handle in links:
+                        with self.lock:
+                            links[handle]["credit"] = fld(6, 0)
+                        self._pump(links[handle]["queue"])
+                elif code == 0x14:  # transfer (publish)
+                    handle = fld(0)
+                    did_client = fld(1, 0)
+                    qname = links[handle]["queue"]
+                    spos = 0
+                    data = b""
+                    while spos < len(payload):
+                        s, spos = fdecode(payload, spos)
+                        if isinstance(s, tuple) and s[0] == "described":
+                            if isinstance(s[2], (bytes, bytearray)):
+                                data += bytes(s[2])
+                    with self.lock:
+                        self.queues.setdefault(qname, []).append(data)
+                    # Settle the client's delivery (accepted).
+                    send(
+                        frame(
+                            0,
+                            perf(
+                                0x15,
+                                [True, did_client, did_client, True,
+                                 Described(0x24, [])],
+                            ),
+                        )
+                    )
+                    self._pump(qname)
+                elif code == 0x15:  # disposition from receiver
+                    first = fld(1, 0)
+                    last = fld(2, first)
+                    state = fld(4)
+                    accepted = (
+                        isinstance(state, tuple) and state[1] == 0x24
+                    )
+                    requeued = set()
+                    with self.lock:
+                        for did in range(first, last + 1):
+                            entry = self.unsettled.pop(did, None)
+                            if entry and not accepted:  # released
+                                q, b = entry
+                                self.queues.setdefault(q, []).insert(0, b)
+                                requeued.add(q)
+                    for q in requeued:
+                        self._pump(q)
+        except (ConnectionError, AssertionError, OSError, IndexError):
+            pass
+        finally:
+            with self.lock:
+                for entry in links.values():
+                    lst = self.consumers.get(entry["queue"], [])
+                    if entry in lst:
+                        lst.remove(entry)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+# ---- codec unit tests --------------------------------------------------------
+
+
+def test_codec_roundtrip_against_independent_decoder():
+    cases = [
+        None, True, False, 0, 5, 300, "hello", Sym("PLAIN"), b"\x01\x02",
+        ["a", 1, None], [],
+        Described(0x24, []),
+        Described(0x75, b"payload" * 50),
+        ["x" * 300, b"y" * 300],
+    ]
+    for v in cases:
+        blob = encode(v)
+        got, pos = fdecode(blob)
+        assert pos == len(blob), v
+        blob2 = encode(v)
+        got2, pos2 = decode(blob2)
+        assert pos2 == len(blob2), v
+
+
+def test_frame_layout():
+    f = frame(0, perf(0x10, ["cid", "host"]))
+    size, doff, ftype, ch = struct.unpack(">IBBH", f[:8])
+    assert size == len(f) and doff == 2 and ftype == 0 and ch == 0
+    p, _ = fdecode(f[8:])
+    assert p[1] == 0x10 and p[2][0] == "cid"
+
+
+# ---- driver vs fake ----------------------------------------------------------
+
+
+@pytest.fixture
+def sb():
+    fake = FakeServiceBus()
+    broker = AzureSBBroker(
+        "ns.servicebus.windows.net", endpoint=fake.endpoint,
+        key_name="policy", key="secretkey", timeout_s=10,
+    )
+    yield fake, broker
+    broker.close()
+    fake.close()
+
+
+URL = "azuresb://ns.servicebus.windows.net/requests"
+
+
+def test_factory_scheme():
+    from kubeai_tpu.routing.brokers import make_broker
+
+    b = make_broker(URL, endpoint="127.0.0.1:1")
+    assert isinstance(b, AzureSBBroker)
+    assert b.host == "127.0.0.1" and b.port == 1
+    assert AzureSBBroker.queue_of(URL) == "requests"
+
+
+def test_publish_receive_ack(sb):
+    fake, broker = sb
+    broker.publish(URL, b"hello \x00 sb")
+    msg = broker.receive(URL, timeout=10)
+    assert msg is not None and msg.body == b"hello \x00 sb"
+    msg.ack()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        with fake.lock:
+            if not fake.unsettled:
+                break
+        time.sleep(0.05)
+    with fake.lock:
+        assert not fake.unsettled  # accepted disposition landed
+    assert broker.receive(URL, timeout=0.3) is None
+    # SASL PLAIN carried the SAS key name/key.
+    assert fake.saw_sasl and fake.saw_sasl[0][0] == "PLAIN"
+    assert b"\x00policy\x00secretkey" in fake.saw_sasl[0][1]
+
+
+def test_nack_releases_and_redelivers(sb):
+    fake, broker = sb
+    broker.publish(URL, b"retry-me")
+    msg = broker.receive(URL, timeout=10)
+    assert msg is not None
+    msg.nack()  # released -> immediate redelivery
+    again = broker.receive(URL, timeout=10)
+    assert again is not None and again.body == b"retry-me"
+    again.ack()
+
+
+def test_reconnect_redelivers_unsettled(sb):
+    fake, broker = sb
+    broker.publish(URL, b"survives")
+    msg = broker.receive(URL, timeout=10)
+    assert msg is not None and msg.body == b"survives"
+    first = fake.connections
+    fake.drop_connections()  # do NOT ack first
+    deadline = time.time() + 20
+    got = None
+    while got is None and time.time() < deadline:
+        got = broker.receive(URL, timeout=0.5)
+    assert got is not None and got.body == b"survives"
+    got.ack()
+    assert fake.connections > first

@@ -240,8 +240,13 @@ def test_scheme_parsing_and_factory():
     from kubeai_tpu.routing.amqp import AMQPBroker
 
     assert isinstance(make_broker("rabbit://h:5672/q"), AMQPBroker)
+    from kubeai_tpu.routing.amqp10 import AzureSBBroker
+
+    assert isinstance(
+        make_broker("azuresb://ns.servicebus.windows.net/q"), AzureSBBroker
+    )
     with pytest.raises(ValueError):
-        make_broker("azuresb://topic-name")
+        make_broker("zeromq://topic-name")
 
 
 # ---- Pub/Sub driver ----------------------------------------------------------
@@ -341,12 +346,43 @@ def test_nats_reconnect_resubscribes(nats):
 # ---- full messenger suite over each driver -----------------------------------
 
 
-@pytest.fixture(params=["pubsub", "nats", "kafka", "sqs", "rabbit", "mem"])
+@pytest.fixture(
+    params=["pubsub", "nats", "kafka", "sqs", "rabbit", "azuresb", "mem"]
+)
 def messenger_stack(request):
     """Messenger wired to a real driver + protocol fake per param."""
     from tests_messenger_common import build_messenger_world
 
-    if request.param == "rabbit":
+    if request.param == "azuresb":
+        from test_azuresb_broker import FakeServiceBus
+
+        from kubeai_tpu.routing.amqp10 import AzureSBBroker
+
+        fake = FakeServiceBus()
+
+        def mk():
+            return AzureSBBroker(
+                "ns.servicebus.windows.net", endpoint=fake.endpoint,
+                timeout_s=10,
+            )
+
+        broker = mk()
+        listener = mk()
+        sub = "azuresb://ns.servicebus.windows.net/req"
+        resp = "azuresb://ns.servicebus.windows.net/resp"
+
+        def inject(body):
+            broker.publish(sub, body)
+
+        def read_response(timeout=10.0):
+            msg = listener.receive(resp, timeout=timeout)
+            assert msg is not None, "no response published"
+            msg.ack()
+            return msg.body
+
+        listener.receive(resp, timeout=0.2)  # pre-subscribe
+        cleanup = [broker.close, listener.close, fake.close]
+    elif request.param == "rabbit":
         from test_amqp_broker import FakeRabbit
 
         from kubeai_tpu.routing.amqp import AMQPBroker
