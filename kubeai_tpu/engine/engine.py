@@ -131,7 +131,8 @@ class EngineConfig:
     # the GPipe schedule. 0 = the pp stage count (steady-state utilization
     # M/(M+P-1); raise toward num_slots for higher utilization at smaller
     # per-tick batches). Requires a family with decode_step_paged_pp,
-    # paged cache mode, tp == sp == 1, and num_slots % M == 0.
+    # paged cache mode, sp == 1, and num_slots % M == 0; composes with
+    # dp, tp, and int8 quantization.
     pp_microbatches: int = 0
 
     def buckets(self) -> tuple[int, ...]:
@@ -280,10 +281,6 @@ class Engine:
                 raise ValueError(
                     "pipeline parallelism does not compose with sp yet "
                     "(sp mesh axis must be 1)"
-                )
-            if cfg.quantization:
-                raise ValueError(
-                    "pipeline parallelism with quantization is not supported yet"
                 )
             if model_cfg.num_layers % self._pp:
                 raise ValueError(

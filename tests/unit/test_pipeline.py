@@ -258,7 +258,20 @@ def test_engine_pp_validation(devices8):
         Engine("llama", cfg, params, mesh=mesh2,
                cfg=EngineConfig(num_slots=4, max_seq_len=64,
                                 cache_mode="slot"))
-    with pytest.raises(ValueError, match="quantization"):
-        Engine("llama", cfg, params, mesh=mesh2,
-               cfg=EngineConfig(num_slots=4, max_seq_len=64,
-                                quantization="int8"))
+
+
+def test_engine_pp_int8_matches_single_device_int8(devices8):
+    """int8 weight-only quantization composes with pp: the quantized
+    stacked layer tree (w8 + scales, all with the leading [NL] axis)
+    shards over pp exactly like bf16 layers, and _w() dequantizes inside
+    each stage. Streams must match the single-device int8 engine."""
+    cfg = _dc.replace(llama.LlamaConfig.tiny(), num_layers=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(
+        num_slots=4, max_seq_len=96, decode_chunk=4, quantization="int8"
+    )
+    ref = Engine("llama", cfg, params, cfg=ecfg)
+    mesh = build_mesh(MeshConfig(pp=2), devices=devices8[:2])
+    eng = Engine("llama", cfg, params, mesh=mesh, cfg=ecfg)
+    sp = SamplingParams(temperature=0.0, max_tokens=16)
+    assert eng.generate(PP_PROMPTS, sp) == ref.generate(PP_PROMPTS, sp)
