@@ -751,6 +751,24 @@ def main(argv=None) -> int:
         "--pipeline", action="store_true",
         help="overlap decode chunks with host processing (direct PJRT targets)",
     )
+    ap.add_argument(
+        "--speculate", type=int, default=0,
+        help="speculative-decoding window (0 = off); prompt-lookup "
+        "proposals unless --draft-url provides a draft model",
+    )
+    ap.add_argument(
+        "--spec-adaptive", choices=["on", "off"], default="on",
+        help="measure speculative vs chunk decode and run the faster",
+    )
+    ap.add_argument(
+        "--draft-url", default="",
+        help="small SAME-FAMILY draft model whose chain proposes the "
+        "speculative window (requires --speculate > 0); any model URL "
+        "scheme --model-url accepts",
+    )
+    ap.add_argument(
+        "--draft-dir", default="", help="pre-downloaded draft cache dir"
+    )
     args = ap.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -812,6 +830,22 @@ def main(argv=None) -> int:
 
     params = _load_params(family.name, model_dir, model_cfg)
 
+    draft = None
+    if args.draft_url:
+        if args.speculate <= 0:
+            raise SystemExit("--draft-url requires --speculate > 0")
+        draft_dir = resolve_model_dir(args.draft_url, args.draft_dir)
+        draft_hf = load_hf_config(draft_dir)
+        draft_arch = (draft_hf.get("architectures") or [arch])[0]
+        if get_model_family(draft_arch) is not family:
+            raise SystemExit(
+                f"draft model family ({draft_arch}) must match the "
+                f"target's ({arch})"
+            )
+        draft_cfg = family.config_from_hf(draft_hf)
+        draft = (draft_cfg, _load_params(family.name, draft_dir, draft_cfg))
+        log.info("loaded draft model (%s) from %s", draft_arch, draft_dir)
+
     mesh = (
         mesh_from_topology(args.tpu_topology)
         if args.tpu_topology
@@ -833,8 +867,11 @@ def main(argv=None) -> int:
             decode_chunk=args.decode_chunk,
             pipeline=args.pipeline,
             quantization=args.quantization,
+            speculate=args.speculate,
+            spec_adaptive=args.spec_adaptive == "on",
         ),
         eos_token_ids=tuple(getattr(tokenizer, "eos_token_ids", ())),
+        draft=draft,
     )
 
     if multihost and args.process_id != 0:

@@ -94,3 +94,55 @@ def test_server_main_subprocess(checkpoint):
     finally:
         proc.send_signal(signal.SIGKILL)
         proc.wait(timeout=10)
+
+
+def test_server_main_draft_speculation(checkpoint):
+    """The serving plumbing for draft-model speculation: --speculate +
+    --draft-url load a second (same-family) model and serve through the
+    speculative path. Target-as-draft keeps the run cheap; stream
+    exactness is covered by the unit tier (test_draft_spec)."""
+    port = 18478
+    env = dict(os.environ)
+    env["KUBEAI_FORCE_CPU"] = "1"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-c",
+            "import jax; jax.config.update('jax_platforms','cpu'); "
+            "from kubeai_tpu.engine.server import main; import sys; "
+            f"sys.exit(main(['--model-url', {checkpoint!r}, "
+            f"'--served-model-name', 'tiny', '--port', '{port}', "
+            "'--host', '127.0.0.1', '--num-slots', '2', "
+            "'--max-seq-len', '64', '--max-adapters', '0', "
+            "'--speculate', '3', '--spec-adaptive', 'off', "
+            f"'--draft-url', {checkpoint!r}]))",
+        ],
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        def healthy():
+            if proc.poll() is not None:
+                out = proc.stdout.read().decode(errors="replace")
+                raise AssertionError(f"server died:\n{out[-2000:]}")
+            try:
+                return http_get(f"127.0.0.1:{port}", "/health", timeout=2)[0] == 200
+            except OSError:
+                return False
+
+        eventually(healthy, timeout=180, interval=0.5, msg="server healthy")
+        status, body = http_post(
+            f"127.0.0.1:{port}",
+            "/v1/completions",
+            {"model": "tiny", "prompt": "abab", "max_tokens": 6,
+             "temperature": 0},
+            timeout=120,
+        )
+        assert status == 200, body
+        assert json.loads(body)["choices"][0]["finish_reason"] in (
+            "length", "stop",
+        )
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
