@@ -1,0 +1,138 @@
+"""Standalone real-TPU probe for the fused paged-decode kernel.
+
+The round-3 bench hang happened INSIDE the first engine step dispatching
+`paged_decode_attention_fused` — compile succeeded, execution never
+returned (BENCH_r03.json). This probe follows the round-3 verdict's
+prescription: validate the kernel with a minutes-long standalone
+pallas_call at tiny shapes BEFORE any engine integration, escalating
+size only after the previous tier returns, then A/B it against the
+per-layer kernel. Every stage runs in a fresh subprocess with a SIGINT
+watchdog (relay discipline: a hard kill mid-claim wedges the chip —
+see ROADMAP.md).
+
+Run FOREGROUND on the machine with the chip:
+
+    python scripts/probe_fused_kernel.py            # full ladder
+    python scripts/probe_fused_kernel.py --stage 0  # just the tiniest
+
+Prints one line per stage; on a hang the stage is reported and the
+ladder stops (smaller = earlier suspect localization). Suspects, from
+the verdict: the (B, strips) grid with dimension_semantics
+("parallel", "arbitrary"), the 2×strip aliased full-pool operands, and
+the per-strip BlockSpec index maps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import subprocess
+import sys
+import time
+
+STAGES = [
+    # (B, layers, pages, page_size, kvh, head_dim, max_pages, label)
+    (1, 1, 2, 8, 1, 64, 1, "minimal: 1 slot, 1 layer, 1 page read"),
+    (4, 2, 12, 16, 2, 64, 2, "tiny: multi-slot, multi-layer"),
+    (8, 4, 40, 64, 8, 64, 4, "small: real page size, GQA heads"),
+    (64, 16, 520, 64, 8, 64, 8, "bench-shaped: 1B-proxy geometry"),
+]
+
+CHILD = r"""
+import sys, time
+import jax, jax.numpy as jnp
+import numpy as np
+
+B, NL, P, page, KVH, D, MP = map(int, sys.argv[1:8])
+mode = sys.argv[8]  # fused | per_layer
+from kubeai_tpu.ops.paged_attention import (
+    paged_decode_attention, paged_decode_attention_fused,
+)
+
+rng = np.random.default_rng(0)
+H = KVH * 4
+q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.bfloat16)
+kp = jnp.asarray(rng.standard_normal((NL, P, page, KVH, D)), jnp.bfloat16)
+vp = jnp.asarray(rng.standard_normal((NL, P, page, KVH, D)), jnp.bfloat16)
+kn = jnp.asarray(rng.standard_normal((B, KVH, D)), jnp.bfloat16)
+vn = jnp.asarray(rng.standard_normal((B, KVH, D)), jnp.bfloat16)
+bt = jnp.asarray(
+    rng.permutation(P - 1)[: B * MP].reshape(B, MP) + 1, jnp.int32
+)
+positions = jnp.asarray(rng.integers(0, MP * page - 1, B), jnp.int32)
+
+if mode == "fused":
+    fn = jax.jit(lambda q, kp, vp, kn, vn, bt, pos: paged_decode_attention_fused(
+        q, kp, vp, kn, vn, bt, pos, 0))
+    args = (q, kp, vp, kn, vn, bt, positions)
+else:
+    lengths = positions + 1
+    fn = jax.jit(lambda q, kp, vp, bt, ln: paged_decode_attention(
+        q, kp[0], vp[0], bt, ln))
+    args = (q, kp, vp, bt, lengths)
+
+t0 = time.perf_counter()
+out = fn(*args)
+out.block_until_ready()
+compile_s = time.perf_counter() - t0
+# Timed: 30 iterations post-compile.
+t0 = time.perf_counter()
+for _ in range(30):
+    out = fn(*args)
+out.block_until_ready()
+dt = (time.perf_counter() - t0) / 30
+print(f"RESULT {mode} compile={compile_s:.1f}s step={dt*1e6:.0f}us",
+      flush=True)
+"""
+
+
+def run_stage(idx: int, mode: str, watchdog: float) -> str | None:
+    B, NL, P, page, KVH, D, MP = STAGES[idx][:7]
+    p = subprocess.Popen(
+        [sys.executable, "-c", CHILD,
+         str(B), str(NL), str(P), str(page), str(KVH), str(D), str(MP),
+         mode],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        out, _ = p.communicate(timeout=watchdog)
+    except subprocess.TimeoutExpired:
+        p.send_signal(signal.SIGINT)  # let JAX release the relay claim
+        try:
+            out, _ = p.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out = ""
+        return None
+    for line in (out or "").splitlines():
+        if line.startswith("RESULT"):
+            return line
+    print((out or "")[-1500:], file=sys.stderr)
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stage", type=int, default=-1,
+                    help="run only this stage index (-1 = full ladder)")
+    ap.add_argument("--watchdog", type=float, default=240.0)
+    ap.add_argument("--modes", default="fused,per_layer")
+    args = ap.parse_args()
+
+    stages = [args.stage] if args.stage >= 0 else range(len(STAGES))
+    for idx in stages:
+        label = STAGES[idx][7]
+        for mode in args.modes.split(","):
+            t0 = time.time()
+            r = run_stage(idx, mode, args.watchdog)
+            if r is None:
+                print(f"stage {idx} ({label}) [{mode}]: HUNG after "
+                      f"{time.time()-t0:.0f}s — stopping ladder")
+                return 1
+            print(f"stage {idx} ({label}) [{mode}]: {r}")
+    print("ladder complete — record the A/B in ROADMAP.md")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
