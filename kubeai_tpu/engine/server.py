@@ -62,6 +62,42 @@ class EngineMetrics:
         self.requests_total = Counter(
             "kubeai_engine_requests_total", "Requests served.", self.registry
         )
+        self.slots_active = Gauge(
+            "kubeai_engine_slots_active",
+            "Decode slots currently occupied.",
+            self.registry,
+        )
+        self.requests_pending = Gauge(
+            "kubeai_engine_requests_pending",
+            "Requests queued for a free slot.",
+            self.registry,
+        )
+        self.spec_proposed = Gauge(
+            "kubeai_engine_spec_proposed_tokens_total",
+            "Speculative tokens proposed (prompt-lookup or draft).",
+            self.registry,
+        )
+        self.spec_accepted = Gauge(
+            "kubeai_engine_spec_accepted_tokens_total",
+            "Speculative tokens accepted by verify.",
+            self.registry,
+        )
+
+    def sync_engine(self, engine) -> None:
+        """Snapshot engine serving state at scrape time (the engine owns
+        these counters; re-plumbing every step through the metrics would
+        couple the hot loop to the registry lock)."""
+        inner = getattr(engine, "inner", engine)  # LockstepEngine proxies
+        active = getattr(inner, "_active", None)
+        pending = getattr(inner, "_pending", None)
+        if active is not None:
+            self.slots_active.set(len(active))
+        if pending is not None:
+            self.requests_pending.set(len(pending))
+        stats = getattr(inner, "spec_stats", None)
+        if stats:
+            self.spec_proposed.set(stats["proposed"])
+            self.spec_accepted.set(stats["accepted"])
 
 
 class EngineServer:
@@ -120,6 +156,7 @@ class EngineServer:
                         return self._json(200, {"status": "ok"})
                     return self._json(503, {"status": "unhealthy"})
                 if path == "/metrics":
+                    outer.metrics.sync_engine(outer.engine)
                     body = outer.metrics.registry.expose().encode()
                     self.send_response(200)
                     self.send_header(

@@ -60,6 +60,10 @@ def test_health_metrics_models(server):
     assert http_get(addr(server), "/health")[0] == 200
     status, body = http_get(addr(server), "/metrics")
     assert status == 200 and b"kubeai_engine" in body
+    # Serving-state gauges snapshot the engine at scrape time.
+    assert b"kubeai_engine_slots_active" in body
+    assert b"kubeai_engine_requests_pending" in body
+    assert b"kubeai_engine_spec_accepted_tokens_total" in body
     status, body = http_get(addr(server), "/v1/models")
     ids = [m["id"] for m in json.loads(body)["data"]]
     assert "tiny-llama" in ids
