@@ -317,6 +317,10 @@ class NATSBroker:
 
     def _connect_locked(self) -> None:
         sock = socket.create_connection((self.host, self.port), timeout=10)
+        # Connect timeout only: as a read timeout, any subject idle for
+        # >10 s (NATS server PINGs default to ~2 min) would look like a
+        # dead connection and churn reconnects forever.
+        sock.settimeout(None)
         f = sock.makefile("rb")
         info = f.readline()  # INFO {...}
         if not info.startswith(b"INFO"):
