@@ -174,7 +174,14 @@ class RestKubeClient:
         return q
 
     def _watch_loop(self, kind: str, q: queue.Queue) -> None:
-        rv = ""
+        # Reflector bootstrap: LIST first, then watch from the list's
+        # resourceVersion (controller-runtime's ListWatch semantics).
+        # Starting at rv="" would mean "from now" — objects created after
+        # watch() returned but before the HTTP stream established were
+        # silently missed (the round-4 m0-lost-ADDED bug). The snapshot
+        # arrives as a RELIST sentinel + synthetic MODIFIEDs, the same
+        # shape consumers already resync on after a 410.
+        rv = self._relist_into(kind, q)
         while not self._stop.is_set():
             path = self._route(kind, None) + "?watch=true"
             if rv:
