@@ -266,7 +266,8 @@ class Engine:
         # decode_step_paged_pp). Composes with dp AND tp — the pp
         # shard_map is manual over pp only (axis_names), so Megatron tp
         # sharding stays GSPMD-managed inside each stage (the 70B/v5e-8
-        # plan is pp=2 × tp=4). Scope: paged cache, llama-family, sp=1.
+        # plan is pp=2 × tp=4). Composes with sp too (ring-attention
+        # prefill; see below). Scope: paged cache, llama-family.
         self._pp = self.mesh.shape.get("pp", 1)
         self._pp_microbatches = 0
         if self._pp > 1:
@@ -277,11 +278,11 @@ class Engine:
                 )
             if self.cache_mode != "paged":
                 raise ValueError("pipeline parallelism requires cache_mode='paged'")
-            if self.mesh.shape.get("sp", 1) != 1:
-                raise ValueError(
-                    "pipeline parallelism does not compose with sp yet "
-                    "(sp mesh axis must be 1)"
-                )
+            # sp composes: prefill runs ring attention over the sp axis
+            # (resolve_prefill binds the mesh) while the pp decode
+            # shard_map simply replicates its per-tick microbatch inputs
+            # over sp — decode is single-token, so the sequence axis has
+            # nothing to shard there.
             if model_cfg.num_layers % self._pp:
                 raise ValueError(
                     f"{model_cfg.num_layers} layers not divisible by "
@@ -462,7 +463,11 @@ class Engine:
                 self.cache_mode == "paged"
                 and getattr(self.family, "decode_verify_paged", None)
                 is not None
-                and self._pp == 1  # verify kernel is not pp-staged
+                and (
+                    self._pp == 1
+                    or getattr(self.family, "decode_verify_paged_pp", None)
+                    is not None
+                )
             ):
                 self._spec = cfg.speculate
                 if draft is not None:
@@ -470,6 +475,16 @@ class Engine:
                         raise ValueError(
                             "draft speculation with chunked prefill is "
                             "not supported yet"
+                        )
+                    if self._pp > 1:
+                        # The draft runs the non-pp decode path; its
+                        # layer stack would shard over pp and every
+                        # draft step would all-gather it. Prompt-lookup
+                        # speculation is the pp-compatible mode.
+                        raise ValueError(
+                            "draft-model speculation does not compose "
+                            "with pipeline parallelism (use prompt-"
+                            "lookup speculation: speculate>0, no draft)"
                         )
                     dcfg, dparams = draft
                     self._draft_cfg = dcfg
@@ -839,7 +854,16 @@ class Engine:
 
         if self._spec:
             gamma = self._spec
-            verify = fam.decode_verify_paged
+            if self._pp > 1:
+                from functools import partial as _partial
+
+                verify = _partial(
+                    fam.decode_verify_paged_pp,
+                    mesh=self.mesh,
+                    microbatches=self._pp_microbatches,
+                )
+            else:
+                verify = fam.decode_verify_paged
 
             def _spec_step(params, kp, vp, bt, state, proposals, lora):
                 """One speculative step: verify [last_token, γ proposals]

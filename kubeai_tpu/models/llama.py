@@ -792,6 +792,63 @@ def trunk_layer(x: jnp.ndarray, lp: dict, cfg: LlamaConfig) -> jnp.ndarray:
     return x + _mlp(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
 
 
+def _verify_page_coords(block_tables, positions, K, page_size):
+    """Page coords for all K window positions per slot: ([B, K], [B, K])."""
+    from kubeai_tpu.ops.paged_attention import token_page_coords
+
+    ids_list, offs_list = [], []
+    for k_i in range(K):
+        ids, offs = token_page_coords(
+            block_tables, positions + k_i, page_size
+        )
+        ids_list.append(ids)
+        offs_list.append(offs)
+    return jnp.stack(ids_list, axis=1), jnp.stack(offs_list, axis=1)
+
+
+def _paged_verify_layer(
+    carry, scanned, cfg, inv_freq, msc, pos_k, page_ids, offsets,
+    block_tables, positions, lora_idx,
+):
+    """One verify layer over a [B, K, E] window against the paged cache.
+    Shared by decode_verify_paged (layer scan over the full stack) and
+    decode_verify_paged_pp (stage-local layer scans) so the speculative
+    math cannot drift between the single-mesh and pipeline paths — the
+    same anti-drift guarantee _paged_decode_layer gives vanilla decode."""
+    from kubeai_tpu.ops.paged_attention import paged_verify_attention
+
+    x = carry
+    B, K, _ = x.shape
+    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    lp = scanned["p"]
+    lor = scanned.get("l")
+    kp, vp = scanned["kp"], scanned["vp"]
+
+    def proj(h, w, target, bias=None):
+        out = jnp.einsum("bke,eh->bkh", h, _w(w))
+        if bias is not None:
+            out = out + bias
+        if lor is not None:
+            out = out + _lora_delta(
+                h, lor[target]["A"], lor[target]["B"], lora_idx
+            )
+        return out
+
+    h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+    q = proj(h, lp["wq"], "wq", lp.get("bq")).reshape(B, K, H, D)
+    k = proj(h, lp["wk"], "wk", lp.get("bk")).reshape(B, K, KVH, D)
+    v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, K, KVH, D)
+    q = apply_rope(q, pos_k, inv_freq, msc)
+    k = apply_rope(k, pos_k, inv_freq, msc)
+    kp = kp.at[page_ids, offsets].set(k.astype(kp.dtype))
+    vp = vp.at[page_ids, offsets].set(v.astype(vp.dtype))
+    attn = paged_verify_attention(q, kp, vp, block_tables, positions)
+    x = x + proj(attn.reshape(B, K, H * D), lp["wo"], "wo")
+    h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+    x = x + _mlp(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+    return x, (kp, vp)
+
+
 def decode_verify_paged(
     params: dict,
     cfg: LlamaConfig,
@@ -811,70 +868,183 @@ def decode_verify_paged(
     longest matching proposal prefix (engine.py speculative mode).
     Attention dispatches to the multi-query paged Pallas kernel on TPU,
     gather reference elsewhere (ops/paged_attention.py)."""
-    from kubeai_tpu.ops.paged_attention import (
-        paged_verify_attention,
-        token_page_coords,
-    )
-
     B, K = tokens.shape
-    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
     page_size = k_pages.shape[2]
     inv_freq = jnp.asarray(
         rope_frequencies(
-            D, cfg.rope_theta, cfg.rope_scaling,
+            cfg.head_size, cfg.rope_theta, cfg.rope_scaling,
             cfg.max_position_embeddings,
         )
     )
     msc = rope_attention_scaling(cfg.rope_scaling)
     pos_k = positions[:, None] + jnp.arange(K)[None, :]  # [B, K]
     x = params["embed"][tokens]  # [B, K, E]
-    # Page coords for all K window positions per slot.
-    ids_list, offs_list = [], []
-    for k_i in range(K):
-        ids, offs = token_page_coords(
-            block_tables, positions + k_i, page_size
-        )
-        ids_list.append(ids)
-        offs_list.append(offs)
-    page_ids = jnp.stack(ids_list, axis=1)  # [B, K]
-    offsets = jnp.stack(offs_list, axis=1)
+    page_ids, offsets = _verify_page_coords(
+        block_tables, positions, K, page_size
+    )
 
     def layer(carry, scanned):
-        x = carry
-        lp = scanned["p"]
-        lor = scanned.get("l")
-        kp, vp = scanned["kp"], scanned["vp"]
-
-        def proj(h, w, target, bias=None):
-            out = jnp.einsum("bke,eh->bkh", h, _w(w))
-            if bias is not None:
-                out = out + bias
-            if lor is not None:
-                out = out + _lora_delta(
-                    h, lor[target]["A"], lor[target]["B"], lora_idx
-                )
-            return out
-
-        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
-        q = proj(h, lp["wq"], "wq", lp.get("bq")).reshape(B, K, H, D)
-        k = proj(h, lp["wk"], "wk", lp.get("bk")).reshape(B, K, KVH, D)
-        v = proj(h, lp["wv"], "wv", lp.get("bv")).reshape(B, K, KVH, D)
-        q = apply_rope(q, pos_k, inv_freq, msc)
-        k = apply_rope(k, pos_k, inv_freq, msc)
-        kp = kp.at[page_ids, offsets].set(k.astype(kp.dtype))
-        vp = vp.at[page_ids, offsets].set(v.astype(vp.dtype))
-        attn = paged_verify_attention(
-            q, kp, vp, block_tables, positions
+        return _paged_verify_layer(
+            carry, scanned, cfg, inv_freq, msc, pos_k, page_ids, offsets,
+            block_tables, positions, lora_idx,
         )
-        x = x + proj(attn.reshape(B, K, H * D), lp["wo"], "wo")
-        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
-        return x, (kp, vp)
 
     xs = _scan_xs(params, lora)
     xs["kp"] = k_pages
     xs["vp"] = v_pages
     x, (k_pages, v_pages) = jax.lax.scan(layer, x, xs)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = jnp.einsum(
+        "bke,ve->bkv", x, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
+    return logits, k_pages, v_pages
+
+
+def decode_verify_paged_pp(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jnp.ndarray,  # [B, K] — last emitted token + K-1 proposals
+    positions: jnp.ndarray,  # [B]
+    k_pages: jnp.ndarray,  # [NL, P, page, KVH, D], layer axis sharded on pp
+    v_pages: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, MP]
+    lora: dict | None = None,
+    lora_idx: jnp.ndarray | None = None,
+    *,
+    mesh,
+    microbatches: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Speculative verify under pipeline parallelism: the same GPipe
+    schedule as decode_step_paged_pp (stage-local layers + stage-local KV,
+    [mb, K, E] activations hopping via ppermute), with the per-layer math
+    shared through _paged_verify_layer — so a pp engine speculates with
+    the identical accept/reject semantics the single-mesh engine has.
+    Off-schedule ticks recompute clamped duplicate microbatches; their
+    cache writes sink into reserved scratch page 0.
+
+    Reference analog: none (the reference has neither PP nor speculation —
+    vLLM flags ride Model.spec.args, api/k8s/v1/model_types.go:85-90);
+    SURVEY §2's TPU-equivalents list makes both this repo's obligation.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from kubeai_tpu.parallel.mesh import AXIS_PIPELINE
+
+    B, K = tokens.shape
+    M = microbatches
+    if M < 1 or B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    n_stages = mesh.shape[AXIS_PIPELINE]
+    NL = k_pages.shape[0]
+    if NL % n_stages:
+        raise ValueError(f"{NL} layers not divisible by {n_stages} pp stages")
+    page_size = k_pages.shape[2]
+    inv_freq = jnp.asarray(
+        rope_frequencies(
+            cfg.head_size, cfg.rope_theta, cfg.rope_scaling,
+            cfg.max_position_embeddings,
+        )
+    )
+    msc = rope_attention_scaling(cfg.rope_scaling)
+    pos_k = positions[:, None] + jnp.arange(K)[None, :]  # [B, K]
+    page_ids, offsets = _verify_page_coords(
+        block_tables, positions, K, page_size
+    )
+    if lora_idx is None:
+        lora_idx = jnp.zeros((B,), jnp.int32)
+
+    mb = B // M
+
+    def mbt(a):
+        return a.reshape(M, mb, *a.shape[1:])
+
+    x_mb = mbt(params["embed"][tokens])  # [M, mb, K, E]
+    pos_mb, posk_mb = mbt(positions), mbt(pos_k)
+    pid_mb, off_mb = mbt(page_ids), mbt(offsets)
+    bt_mb, lidx_mb = mbt(block_tables), mbt(lora_idx)
+
+    xs = _scan_xs(params, lora)
+    xs_specs = jax.tree_util.tree_map(lambda _: P(AXIS_PIPELINE), xs)
+    rep = P()
+
+    # Same partial-manual vs fully-manual split as decode_step_paged_pp
+    # (and the same XLA landmines documented there).
+    tp_size = mesh.shape.get("tp", 1)
+    manual_kw = (
+        {"axis_names": {AXIS_PIPELINE}, "check_vma": True}
+        if tp_size > 1 else {"check_vma": False}
+    )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            xs_specs, P(AXIS_PIPELINE), P(AXIS_PIPELINE),
+            rep, rep, rep, rep, rep, rep, rep,
+        ),
+        out_specs=(
+            P(AXIS_PIPELINE), P(AXIS_PIPELINE), P(AXIS_PIPELINE),
+        ),
+        **manual_kw,
+    )
+    def run(xs, kp, vp, x_mb, pos_mb, posk_mb, pid_mb, off_mb, bt_mb, lidx_mb):
+        stage = jax.lax.axis_index(AXIS_PIPELINE)
+        last = n_stages - 1
+        fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def local_layers(h, kp, vp, pos, posk, pid, off, bt, lidx):
+            def layer(carry, scanned):
+                return _paged_verify_layer(
+                    carry, scanned, cfg, inv_freq, msc, posk, pid, off,
+                    bt, pos, lidx,
+                )
+
+            xs_l = dict(xs)
+            xs_l["kp"] = kp
+            xs_l["vp"] = vp
+            y, (kp, vp) = jax.lax.scan(layer, h, xs_l)
+            return y, kp, vp
+
+        ticks = M + n_stages - 1
+
+        def tick(carry, t):
+            buf, kp, vp, out = carry
+            idx = jnp.clip(t - stage, 0, M - 1)
+            active = (t - stage >= 0) & (t - stage < M)
+            h = jnp.where(stage == 0, x_mb[jnp.clip(t, 0, M - 1)], buf)
+            pid = jnp.where(active, pid_mb[idx], 0)
+            off = jnp.where(active, off_mb[idx], 0)
+            y, kp, vp = local_layers(
+                h, kp, vp, pos_mb[idx], posk_mb[idx], pid, off,
+                bt_mb[idx], lidx_mb[idx],
+            )
+            mb_out = t - last
+            store = (stage == last) & (mb_out >= 0)
+            out = jnp.where(
+                store, out.at[jnp.clip(mb_out, 0, M - 1)].set(y), out
+            )
+            buf = jax.lax.ppermute(y, AXIS_PIPELINE, fwd)
+            return (buf, kp, vp, out), None
+
+        zero = jax.lax.pcast(
+            jnp.zeros_like(x_mb[0]), AXIS_PIPELINE, to="varying"
+        )
+        out0 = jax.lax.pcast(
+            jnp.zeros_like(x_mb), AXIS_PIPELINE, to="varying"
+        )
+        (_, kp, vp, out), _ = jax.lax.scan(
+            tick, (zero, kp, vp, out0), jnp.arange(ticks)
+        )
+        return out[None], kp, vp  # [1, M, mb, K, E] per stage
+
+    hidden, k_pages, v_pages = run(
+        xs, k_pages, v_pages, x_mb, pos_mb, posk_mb, pid_mb, off_mb,
+        bt_mb, lidx_mb,
+    )
+    # hidden is [n_stages, M, mb, K, E]; only the LAST stage stored real
+    # outputs.
+    x = hidden[-1].reshape(B, K, -1)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = jnp.einsum(
         "bke,ve->bkv", x, params["lm_head"],

@@ -30,6 +30,7 @@ class ModelFamily:
         decode_step_paged: Callable | None = None,
         decode_step_paged_pp: Callable | None = None,
         decode_verify_paged: Callable | None = None,
+        decode_verify_paged_pp: Callable | None = None,
         hf_architectures: tuple[str, ...] = (),
         feature: str = "TextGeneration",
         hidden_states=None,
@@ -51,6 +52,8 @@ class ModelFamily:
         # Multi-position verify forward for speculative decoding (None =
         # speculation unsupported for this family).
         self.decode_verify_paged = decode_verify_paged
+        # Pipeline-staged verify (None = no speculation on a pp>1 mesh).
+        self.decode_verify_paged_pp = decode_verify_paged_pp
         self.hf_architectures = hf_architectures
         self.feature = feature
 
@@ -92,6 +95,7 @@ def _ensure_builtin() -> None:
             decode_step_paged=llama.decode_step_paged,
             decode_step_paged_pp=llama.decode_step_paged_pp,
             decode_verify_paged=llama.decode_verify_paged,
+            decode_verify_paged_pp=llama.decode_verify_paged_pp,
             hf_architectures=("LlamaForCausalLM", "MistralForCausalLM"),
             hidden_states=llama.hidden_states,
         )
@@ -112,6 +116,7 @@ def _ensure_builtin() -> None:
             decode_step_paged=llama.decode_step_paged,
             decode_step_paged_pp=llama.decode_step_paged_pp,
             decode_verify_paged=llama.decode_verify_paged,
+            decode_verify_paged_pp=llama.decode_verify_paged_pp,
             hf_architectures=("Qwen2ForCausalLM",),
             hidden_states=llama.hidden_states,
         )

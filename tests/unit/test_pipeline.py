@@ -131,6 +131,7 @@ PP_PROMPTS = [
 
 
 @pytest.mark.parametrize("pp,microbatches", [(2, 0), (4, 0), (2, 4)])
+@pytest.mark.slow
 def test_engine_pp_matches_single_device(devices8, pp, microbatches):
     _, _, ref, eng = _pp_world(devices8, pp, microbatches=microbatches)
     sp = SamplingParams(temperature=0.0, max_tokens=24)
@@ -138,6 +139,7 @@ def test_engine_pp_matches_single_device(devices8, pp, microbatches):
 
 
 @pytest.mark.parametrize("mesh_kw", [dict(pp=2, tp=2), dict(pp=2, tp=2, dp=2)])
+@pytest.mark.slow
 def test_engine_pp_tp_composed_matches_single_device(devices8, mesh_kw):
     """pp × tp (the 70B/v5e-8 shape, pp=2×tp=4 scaled down): the pp
     shard_map is manual over pp only, so Megatron tp sharding stays
@@ -164,6 +166,7 @@ def test_engine_pp_tp_composed_matches_single_device(devices8, mesh_kw):
     assert eng.generate(PP_PROMPTS, sp) == ref.generate(PP_PROMPTS, sp)
 
 
+@pytest.mark.slow
 def test_decode_pp_tp_logits_match_single_device(devices8):
     """Function-level pp×tp check with a fixed paged-cache state:
     logits and (non-scratch) pool writes must match the single-device
@@ -217,12 +220,14 @@ def test_decode_pp_tp_logits_match_single_device(devices8):
     )
 
 
+@pytest.mark.slow
 def test_engine_pp_seeded_sampling_matches(devices8):
     _, _, ref, eng = _pp_world(devices8, 2)
     sp = SamplingParams(temperature=0.9, seed=13, max_tokens=16)
     assert eng.generate(PP_PROMPTS, sp) == ref.generate(PP_PROMPTS, sp)
 
 
+@pytest.mark.slow
 def test_engine_pp_lora_matches(devices8):
     cfg = _dc.replace(llama.LlamaConfig.tiny(), num_layers=4)
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
@@ -260,6 +265,7 @@ def test_engine_pp_validation(devices8):
                                 cache_mode="slot"))
 
 
+@pytest.mark.slow
 def test_engine_pp_int8_matches_single_device_int8(devices8):
     """int8 weight-only quantization composes with pp: the quantized
     stacked layer tree (w8 + scales, all with the leading [NL] axis)
@@ -275,3 +281,90 @@ def test_engine_pp_int8_matches_single_device_int8(devices8):
     eng = Engine("llama", cfg, params, mesh=mesh, cfg=ecfg)
     sp = SamplingParams(temperature=0.0, max_tokens=16)
     assert eng.generate(PP_PROMPTS, sp) == ref.generate(PP_PROMPTS, sp)
+
+
+# ---- round-5 compositions: pp × sp, speculation under pp -------------------
+
+
+@pytest.mark.slow
+def test_engine_pp_sp_matches_single_device(devices8):
+    """pp × sp: ring-attention prefill over the sp axis composing with
+    GPipe-staged decode over pp. Decode microbatch inputs replicate over
+    sp (decode is single-token; sequence has nothing to shard), so the
+    stream must match the single-device engine bit-exactly. f32 model:
+    the ring's online-softmax accumulation order differs from dense
+    prefill, and bf16 near-ties on a random-init tiny model would flip
+    greedy argmax."""
+    cfg = _dc.replace(
+        llama.LlamaConfig.tiny(), num_layers=4, dtype=jnp.float32
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(
+        num_slots=4, max_seq_len=96, decode_chunk=4,
+        cache_dtype=jnp.float32,
+    )
+    ref = Engine("llama", cfg, params, cfg=ecfg)
+    mesh = build_mesh(MeshConfig(pp=2, sp=2), devices=devices8[:4])
+    eng = Engine("llama", cfg, params, mesh=mesh, cfg=ecfg)
+    sp = SamplingParams(temperature=0.0, max_tokens=16)
+    assert eng.generate(PP_PROMPTS, sp) == ref.generate(PP_PROMPTS, sp)
+
+
+@pytest.mark.parametrize("mesh_kw", [dict(pp=2), dict(pp=2, tp=2)])
+@pytest.mark.slow
+def test_engine_pp_speculation_matches_vanilla(devices8, mesh_kw):
+    """Prompt-lookup speculation under pipeline parallelism
+    (decode_verify_paged_pp: GPipe-staged verify with stage-local KV)
+    must emit the exact vanilla stream — same accept/reject semantics as
+    the single-mesh verify, which shares its per-layer body."""
+    cfg = _dc.replace(
+        llama.LlamaConfig.tiny(), num_layers=4, dtype=jnp.float32
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    base = dict(num_slots=4, max_seq_len=96, cache_dtype=jnp.float32)
+    n = 1
+    for v in mesh_kw.values():
+        n *= v
+    mesh = build_mesh(MeshConfig(**mesh_kw), devices=devices8[:n])
+    ref = Engine("llama", cfg, params, cfg=EngineConfig(**base))
+    eng = Engine(
+        "llama", cfg, params, mesh=mesh,
+        cfg=EngineConfig(speculate=3, spec_adaptive=False, **base),
+    )
+    sp = SamplingParams(temperature=0.0, max_tokens=16)
+    assert eng.generate(PP_PROMPTS, sp) == ref.generate(PP_PROMPTS, sp)
+
+
+@pytest.mark.slow
+def test_engine_pp_speculation_accepts_on_repetitive_text(devices8):
+    """Acceptance (not just equivalence): on repetitive context the
+    staged verify must compress tokens into fewer decode steps, proving
+    the pp verify path actually accepts proposals."""
+    cfg = _dc.replace(llama.LlamaConfig.tiny(), num_layers=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(pp=2), devices=devices8[:2])
+    eng = Engine(
+        "llama", cfg, params, mesh=mesh,
+        cfg=EngineConfig(
+            num_slots=4, max_seq_len=96, speculate=4, spec_adaptive=False,
+        ),
+    )
+    prompt = ([11, 12, 13, 14, 15] * 10)[:45]
+    out = eng.generate([prompt], SamplingParams(temperature=0.0, max_tokens=24))[0]
+    assert len(out) == 24
+    assert eng._steps < 24, f"no acceptance under pp: {eng._steps} steps"
+    assert eng.spec_stats["accepted"] > 0
+
+
+def test_engine_pp_draft_rejected(devices8):
+    """A draft model under pp is a misconfiguration (the draft's layer
+    stack would shard over pp and all-gather every step) — explicit
+    error, not silent fallback."""
+    cfg = _dc.replace(llama.LlamaConfig.tiny(), num_layers=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(pp=2), devices=devices8[:2])
+    with pytest.raises(ValueError, match="pipeline"):
+        Engine(
+            "llama", cfg, params, mesh=mesh, draft=(cfg, params),
+            cfg=EngineConfig(num_slots=4, max_seq_len=96, speculate=3),
+        )
