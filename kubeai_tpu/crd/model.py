@@ -134,6 +134,14 @@ class ModelSpec:
     files: list[File] = dataclasses.field(default_factory=list)
     priority_class_name: str = ""
     owner: str = ""
+    # Speculative decoding (in-tree engine only; no reference analog —
+    # there, engine features ride spec.args, model_types.go:85-90):
+    # speculativeTokens > 0 turns on prompt-lookup speculation;
+    # draftUrl additionally loads a small same-family draft model that
+    # proposes instead of the lookup (engine flags --speculate /
+    # --draft-url, kubeai_tpu/engine/server.py).
+    speculative_tokens: int = 0
+    draft_url: str = ""
 
     def url_scheme(self) -> str:
         return self.url.split("://", 1)[0] if "://" in self.url else ""
@@ -179,6 +187,30 @@ class ModelSpec:
             raise ValidationError(
                 "adapters only supported with VLLM or KubeAITPU engines"
             )
+        if self.speculative_tokens < 0:
+            raise ValidationError("speculativeTokens must be >= 0")
+        if (
+            self.speculative_tokens or self.draft_url
+        ) and self.engine != ENGINE_KUBEAI_TPU:
+            raise ValidationError(
+                "speculativeTokens/draftUrl require the KubeAITPU engine"
+            )
+        if self.draft_url:
+            if self.speculative_tokens < 1:
+                # Mirrors the engine-server flag contract (--draft-url
+                # requires --speculate > 0, kubeai_tpu/engine/server.py).
+                raise ValidationError(
+                    "draftUrl requires speculativeTokens >= 1"
+                )
+            draft_scheme = (
+                self.draft_url.split("://", 1)[0]
+                if "://" in self.draft_url else ""
+            )
+            if draft_scheme not in ("hf", "pvc", "s3", "gs", "oss"):
+                raise ValidationError(
+                    'draftUrl must use "hf://", "pvc://", "s3://", '
+                    f'"gs://", or "oss://", got {self.draft_url!r}'
+                )
         if self.target_requests < 1:
             raise ValidationError("targetRequests must be >= 1")
         if self.scale_down_delay_seconds < 0:
@@ -345,6 +377,8 @@ class Model:
                 ],
                 priority_class_name=spec.get("priorityClassName", ""),
                 owner=spec.get("owner", ""),
+                speculative_tokens=int(spec.get("speculativeTokens", 0) or 0),
+                draft_url=spec.get("draftUrl", ""),
             ),
             status=ModelStatus(
                 replicas_all=int(
@@ -401,4 +435,8 @@ def _spec_to_dict(s: ModelSpec) -> dict:
         d["priorityClassName"] = s.priority_class_name
     if s.owner:
         d["owner"] = s.owner
+    if s.speculative_tokens:
+        d["speculativeTokens"] = s.speculative_tokens
+    if s.draft_url:
+        d["draftUrl"] = s.draft_url
     return d

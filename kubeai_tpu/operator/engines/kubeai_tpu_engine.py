@@ -41,6 +41,12 @@ def kubeai_tpu_pod(model: Model, cfg: System, mcfg: ModelConfig, suffix: str) ->
         args += ["--tpu-topology", mcfg.tpu_topology]
     if mcfg.cache_dir:
         args += ["--model-dir", mcfg.cache_dir]
+    # Speculative decoding from first-class spec fields (CRD validates
+    # draftUrl implies speculativeTokens >= 1 and the KubeAITPU engine).
+    if model.spec.speculative_tokens > 0:
+        args += ["--speculate", str(model.spec.speculative_tokens)]
+    if model.spec.draft_url:
+        args += ["--draft-url", model.spec.draft_url]
     # Adapters are NOT baked into the spec: they hot-swap through the
     # /v1/load_lora_adapter admin API (see operator/adapters.py), so adapter
     # changes never trigger a pod rollout.

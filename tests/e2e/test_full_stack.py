@@ -281,3 +281,159 @@ def test_gs_model_served_through_operator(tmp_path, monkeypatch):
             srv.stop()
     finally:
         fake.close()
+
+
+def test_draft_model_served_through_operator(tmp_path):
+    """Round-5 verdict #6, the full chain: a Model with FIRST-CLASS
+    draftUrl/speculativeTokens fields → controller renders the engine pod
+    → the pod's EXACT rendered args boot a real engine-server subprocess
+    (weight locations redirected to a local checkpoint via the cache-dir
+    override flags, the same mechanism cacheProfile uses) → the operator
+    proxy routes a completion to it → the engine's metrics prove the
+    speculative path accepted proposals (target-as-draft ⇒ near-total
+    acceptance)."""
+    import signal
+    import subprocess
+    import sys
+
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HFLlamaConfig
+    from transformers import LlamaForCausalLM
+
+    hf_cfg = HFLlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        rope_theta=10000.0, max_position_embeddings=512,
+    )
+    torch.manual_seed(0)
+    ckpt = tmp_path / "spec-ckpt"
+    LlamaForCausalLM(hf_cfg).save_pretrained(str(ckpt), safe_serialization=True)
+
+    store = KubeStore()
+    cfg = System()
+    cfg.allow_pod_address_override = True
+    mgr = Manager(store, cfg)
+    mgr.start()
+    port = 18481
+    proc = None
+    try:
+        m = Model(
+            name="spec-model",
+            spec=ModelSpec(
+                url="hf://org/tiny-target",
+                engine="KubeAITPU",
+                features=["TextGeneration"],
+                min_replicas=1,
+                max_replicas=1,
+                speculative_tokens=3,
+                draft_url="hf://org/tiny-draft",
+                args=["--num-slots", "2", "--max-seq-len", "64",
+                      "--max-adapters", "0", "--spec-adaptive", "off"],
+            ),
+            annotations={
+                md.MODEL_POD_IP_ANNOTATION: "127.0.0.1",
+                md.MODEL_POD_PORT_ANNOTATION: str(port),
+            },
+        )
+        m.spec.validate()
+        store.create(m.to_dict())
+
+        def rendered_args():
+            pods = store.list(
+                "Pod", "default", {md.POD_MODEL_LABEL: "spec-model"}
+            )
+            if not pods:
+                return None
+            return pods[0]["spec"]["containers"][0]["args"]
+
+        args = eventually(rendered_args, msg="controller rendered engine pod")
+        # The first-class spec fields became engine flags.
+        assert args[args.index("--speculate") + 1] == "3"
+        assert args[args.index("--draft-url") + 1] == "hf://org/tiny-draft"
+
+        # Boot the rendered args verbatim; later flags win in argparse, so
+        # the test appends only the local-port and local-weights overrides
+        # (what a cacheProfile mount provides in a real pod).
+        boot = args + [
+            "--host", "127.0.0.1", "--port", str(port),
+            "--model-dir", str(ckpt), "--draft-dir", str(ckpt),
+        ]
+        env = dict(os.environ)
+        env["KUBEAI_FORCE_CPU"] = "1"
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-c",
+                "import jax; jax.config.update('jax_platforms','cpu'); "
+                "from kubeai_tpu.engine.server import main; import sys; "
+                f"sys.exit(main({boot!r}))",
+            ],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+
+        def healthy():
+            if proc.poll() is not None:
+                out = proc.stdout.read().decode(errors="replace")
+                raise AssertionError(f"server died:\n{out[-2000:]}")
+            # Mark the controller's pod Ready so the LB routes to the
+            # (annotated) subprocess address.
+            for pod in store.list(
+                "Pod", "default", {md.POD_MODEL_LABEL: "spec-model"}
+            ):
+                pod.setdefault("status", {})["conditions"] = [
+                    {"type": "Ready", "status": "True"},
+                    {"type": "PodScheduled", "status": "True"},
+                ]
+                pod["status"]["podIP"] = "127.0.0.1"
+                try:
+                    store.update(pod)
+                except Exception:
+                    pass
+            try:
+                return http_get(
+                    f"127.0.0.1:{port}", "/health", timeout=2
+                )[0] == 200
+            except OSError:
+                return False
+
+        eventually(healthy, timeout=240, interval=0.5, msg="draft engine healthy")
+
+        def chat_ok():
+            status, data = http_post(
+                mgr.api_address,
+                "/openai/v1/chat/completions",
+                {
+                    "model": "spec-model",
+                    "messages": [{"role": "user", "content": "abababab"}],
+                    "max_tokens": 8,
+                    "temperature": 0,
+                },
+                timeout=120,
+            )
+            return json.loads(data) if status == 200 else None
+
+        payload = eventually(chat_ok, timeout=60, msg="chat via proxy")
+        assert payload["choices"][0]["message"]["content"]
+
+        # spec_stats through the engine's metrics endpoint: the draft
+        # proposed and the target accepted (same weights ⇒ acceptance).
+        status, body = http_get(f"127.0.0.1:{port}", "/metrics")
+        assert status == 200
+        metrics = {}
+        for line in body.decode().splitlines():
+            if line and not line.startswith("#"):
+                k, _, v = line.rpartition(" ")
+                try:
+                    metrics[k.split("{")[0]] = float(v)
+                except ValueError:
+                    pass
+        assert metrics.get("kubeai_engine_spec_proposed_tokens_total", 0) > 0
+        assert metrics.get("kubeai_engine_spec_accepted_tokens_total", 0) > 0
+    finally:
+        if proc is not None:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        mgr.stop()

@@ -54,6 +54,12 @@ def test_valid_model_passes():
         {"files": [File(path="/a", content="x"), File(path="/a", content="y")]},
         {"adapters": [Adapter(name="Bad_Name", url="hf://x")]},
         {"adapters": [Adapter(name="a", url="hf://x"), Adapter(name="a", url="hf://y")]},
+        {"speculative_tokens": -1},
+        {"speculative_tokens": 3, "engine": "VLLM"},
+        {"draft_url": "hf://org/draft"},  # requires speculativeTokens >= 1
+        {"draft_url": "ollama://draft", "speculative_tokens": 2},
+        {"draft_url": "hf://org/draft", "speculative_tokens": 2,
+         "engine": "VLLM"},
     ],
 )
 def test_invalid_specs_rejected(mutation):
@@ -89,6 +95,15 @@ def test_cache_profile_immutable():
     new2 = valid_model(cache_profile="efs", url="hf://other/repo")
     with pytest.raises(ValidationError):
         new2.validate_update(old)
+
+
+def test_speculation_fields_valid():
+    valid_model(speculative_tokens=4).validate()
+    valid_model(speculative_tokens=4, draft_url="hf://org/draft").validate()
+    m = valid_model(speculative_tokens=4, draft_url="hf://org/draft")
+    m2 = Model.from_dict(m.to_dict())
+    assert m2.spec.speculative_tokens == 4
+    assert m2.spec.draft_url == "hf://org/draft"
 
 
 def test_model_dict_roundtrip():

@@ -143,3 +143,14 @@ def test_pvc_source_mounts_readonly(cfg):
     mounts = [m_ for m_ in container(pod)["volumeMounts"]
               if m_["name"] == "model-pvc"]
     assert mounts[0]["readOnly"] is True
+
+
+def test_kubeai_tpu_renderer_speculation_flags(cfg):
+    m = mk("KubeAITPU", "hf://org/model", speculative_tokens=4,
+           draft_url="hf://org/draft")
+    args = container(render(cfg, m))["args"]
+    assert args[args.index("--speculate") + 1] == "4"
+    assert args[args.index("--draft-url") + 1] == "hf://org/draft"
+    # Absent fields render no flags (vanilla decode).
+    args2 = container(render(cfg, mk("KubeAITPU", "hf://org/model")))["args"]
+    assert "--speculate" not in args2 and "--draft-url" not in args2
