@@ -1,0 +1,369 @@
+"""Subset Go-template renderer for the Helm chart (charts/kubeai-tpu).
+
+This environment has no `helm` binary, but the chart must stay truthful:
+`helm template` on a real machine has to produce exactly the manifests
+`deploy/chart/render.py` (the kubectl path) emits. This module implements
+the strict subset of text/template + sprig the chart's templates use, so a
+unit test can render the chart and diff it against the Python renderer —
+the golden guarantee the chart ships under.
+
+Supported syntax (anything else raises):
+  {{ pipeline }}  {{- pipeline }}  {{ pipeline -}}     (whitespace trim)
+  {{ if pipeline }} ... {{ else }} ... {{ end }}
+  {{ $var := pipeline }}
+  terms: .Path.To.Value  $var  "string"  123  (call ...)
+  functions: dict set toJson toYaml nindent indent quote default eq
+  pipelines: a | fn | fn arg   (piped value appended as the last arg,
+  exactly Go's semantics)
+
+Faithfulness notes:
+  - toJson matches Go's encoding/json: keys sorted, no spaces, HTML
+    characters escaped (\\u003c etc.) — the embedded system-config string
+    must be byte-identical between helm and render.py.
+  - `if` truthiness matches Go templates: false/0/""/nil/empty map/list.
+
+Reference: charts/kubeai templates in the upstream project
+(charts/kubeai/templates/*.yaml) are full Helm; this chart deliberately
+constrains itself to the subset above so the parity test can exist.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any
+
+__all__ = ["render_template", "render_chart"]
+
+
+# ---------------------------------------------------------------- lexing
+
+_ACTION = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}", re.S)
+
+
+def _split_actions(text: str) -> list[tuple[str, str]]:
+    """-> [(kind, payload)]: kind in {'text', 'action'}; trim markers are
+    applied to the surrounding text segments here, Go-style ({{- trims
+    ALL preceding whitespace, -}} all following)."""
+    parts: list[tuple[str, str]] = []
+    pos = 0
+    for m in _ACTION.finditer(text):
+        pre = text[pos:m.start()]
+        if m.group(0).startswith("{{-"):
+            pre = pre.rstrip(" \t\n\r")
+        parts.append(("text", pre))
+        parts.append(("action", m.group(1)))
+        pos = m.end()
+        if m.group(0).endswith("-}}"):
+            nxt = _ACTION.search(text, pos)
+            limit = nxt.start() if nxt else len(text)
+            trimmed = text[pos:limit].lstrip(" \t\n\r")
+            parts.append(("text", trimmed))
+            pos = limit
+    parts.append(("text", text[pos:]))
+    return parts
+
+
+_TOKEN = re.compile(
+    r'''"(?:[^"\\]|\\.)*"   # string literal
+      | -?\d+               # int literal
+      | \$[A-Za-z_][\w]*    # variable
+      | \.[A-Za-z_][\w.]*   # path
+      | [A-Za-z_][\w]*      # ident (function name / keyword)
+      | \| | \( | \) | :=
+    ''',
+    re.X,
+)
+
+
+def _tokens(src: str) -> list[str]:
+    toks: list[str] = []
+    pos = 0
+    while pos < len(src):
+        if src[pos] in " \t\n\r":
+            pos += 1
+            continue
+        m = _TOKEN.match(src, pos)
+        if not m:
+            raise ValueError(
+                f"unsupported template syntax near {src[pos:pos + 40]!r}"
+            )
+        toks.append(m.group(0))
+        pos = m.end()
+    return toks
+
+
+# ---------------------------------------------------------------- parsing
+
+class _Text:
+    def __init__(self, s: str):
+        self.s = s
+
+
+class _Pipe:
+    def __init__(self, cmds: list[list[Any]]):
+        self.cmds = cmds  # each cmd: list of term tokens/sub-pipes
+
+
+class _Assign:
+    def __init__(self, var: str, pipe: "_Pipe"):
+        self.var, self.pipe = var, pipe
+
+
+class _If:
+    def __init__(self, cond: "_Pipe"):
+        self.cond = cond
+        self.body: list[Any] = []
+        self.orelse: list[Any] = []
+
+
+class _Call:
+    """Parenthesized sub-expression."""
+
+    def __init__(self, pipe: "_Pipe"):
+        self.pipe = pipe
+
+
+def _parse_pipeline(toks: list[str], i: int) -> tuple[_Pipe, int]:
+    cmds: list[list[Any]] = []
+    cmd: list[Any] = []
+    while i < len(toks):
+        t = toks[i]
+        if t == "|":
+            cmds.append(cmd)
+            cmd = []
+            i += 1
+        elif t == "(":
+            sub, i = _parse_pipeline(toks, i + 1)
+            if i >= len(toks) or toks[i] != ")":
+                raise ValueError("unbalanced parens in template expression")
+            cmd.append(_Call(sub))
+            i += 1
+        elif t == ")":
+            break
+        else:
+            cmd.append(t)
+            i += 1
+    cmds.append(cmd)
+    return _Pipe(cmds), i
+
+
+def _parse(text: str) -> list[Any]:
+    nodes: list[Any] = []
+    stack: list[_If] = []
+
+    def sink() -> list[Any]:
+        if not stack:
+            return nodes
+        node = stack[-1]
+        return node.orelse if getattr(node, "_in_else", False) else node.body
+
+    for kind, payload in _split_actions(text):
+        if kind == "text":
+            if payload:
+                sink().append(_Text(payload))
+            continue
+        toks = _tokens(payload)
+        if not toks:
+            continue
+        if toks[0] == "if":
+            pipe, j = _parse_pipeline(toks, 1)
+            if j != len(toks):
+                raise ValueError(f"trailing tokens in if: {payload!r}")
+            node = _If(pipe)
+            sink().append(node)
+            stack.append(node)
+        elif toks[0] == "else":
+            if not stack or len(toks) != 1:
+                raise ValueError(f"unsupported else form: {payload!r}")
+            stack[-1]._in_else = True  # type: ignore[attr-defined]
+        elif toks[0] == "end":
+            if not stack:
+                raise ValueError("unmatched {{ end }}")
+            stack.pop()
+        elif len(toks) >= 2 and toks[0].startswith("$") and toks[1] == ":=":
+            pipe, j = _parse_pipeline(toks, 2)
+            if j != len(toks):
+                raise ValueError(f"trailing tokens in assignment: {payload!r}")
+            sink().append(_Assign(toks[0], pipe))
+        else:
+            pipe, j = _parse_pipeline(toks, 0)
+            if j != len(toks):
+                raise ValueError(f"trailing tokens in action: {payload!r}")
+            sink().append(pipe)
+    if stack:
+        raise ValueError("unclosed {{ if }} block")
+    return nodes
+
+
+# ------------------------------------------------------------- evaluation
+
+def _truthy(v: Any) -> bool:
+    if v is None or v is False:
+        return False
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return v != 0
+    if isinstance(v, (str, dict, list, tuple)):
+        return len(v) > 0
+    return True
+
+
+def _go_json(v: Any) -> str:
+    out = json.dumps(
+        v, separators=(",", ":"), sort_keys=True, ensure_ascii=False
+    )
+    # encoding/json HTML-escapes these even inside strings.
+    return (
+        out.replace("&", "\\u0026").replace("<", "\\u003c").replace(">", "\\u003e")
+    )
+
+
+def _to_yaml(v: Any) -> str:
+    import yaml
+
+    return yaml.safe_dump(v, default_flow_style=False, sort_keys=False).rstrip("\n")
+
+
+def _fn(name: str, args: list[Any]) -> Any:
+    if name == "dict":
+        if len(args) % 2:
+            raise ValueError("dict needs key/value pairs")
+        return {args[i]: args[i + 1] for i in range(0, len(args), 2)}
+    if name == "set":
+        d, k, v = args
+        d[k] = v
+        return d
+    if name == "toJson":
+        (v,) = args
+        return _go_json(v)
+    if name == "toYaml":
+        (v,) = args
+        return _to_yaml(v)
+    if name == "nindent":
+        n, v = args
+        return "\n" + _fn("indent", [n, v])
+    if name == "indent":
+        n, v = args
+        pad = " " * int(n)
+        return "\n".join(pad + line for line in str(v).split("\n"))
+    if name == "quote":
+        (v,) = args
+        return '"' + str(v).replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if name == "default":
+        dflt, v = args
+        return v if _truthy(v) else dflt
+    if name == "eq":
+        a, b = args
+        return a == b
+    raise ValueError(f"unsupported template function {name!r}")
+
+
+class _Renderer:
+    def __init__(self, context: dict):
+        self.ctx = context
+        self.vars: dict[str, Any] = {}
+
+    def _term(self, t: Any) -> Any:
+        if isinstance(t, _Call):
+            return self._pipe(t.pipe)
+        if isinstance(t, str):
+            if t.startswith('"'):
+                return json.loads(t)
+            if re.fullmatch(r"-?\d+", t):
+                return int(t)
+            if t.startswith("$"):
+                if t not in self.vars:
+                    raise ValueError(f"undefined template variable {t}")
+                return self.vars[t]
+            if t.startswith("."):
+                cur: Any = self.ctx
+                for part in t[1:].split("."):
+                    if isinstance(cur, dict):
+                        cur = cur.get(part)
+                    else:
+                        cur = None
+                return cur
+            if t in ("true", "false"):
+                return t == "true"
+        raise ValueError(f"cannot evaluate term {t!r}")
+
+    def _cmd(self, cmd: list[Any], piped: Any = ...) -> Any:
+        if not cmd:
+            raise ValueError("empty command in pipeline")
+        head = cmd[0]
+        is_fn = (
+            isinstance(head, str)
+            and re.fullmatch(r"[A-Za-z_]\w*", head)
+            and head not in ("true", "false")
+        )
+        if is_fn:
+            args = [self._term(a) for a in cmd[1:]]
+            if piped is not ...:
+                args.append(piped)
+            return _fn(head, args)
+        if len(cmd) != 1:
+            raise ValueError(f"unexpected arguments after value term: {cmd!r}")
+        if piped is not ...:
+            raise ValueError(f"cannot pipe into non-function {head!r}")
+        return self._term(head)
+
+    def _pipe(self, pipe: _Pipe) -> Any:
+        val = self._cmd(pipe.cmds[0])
+        for cmd in pipe.cmds[1:]:
+            val = self._cmd(cmd, piped=val)
+        return val
+
+    def render(self, nodes: list[Any]) -> str:
+        out: list[str] = []
+        for node in nodes:
+            if isinstance(node, _Text):
+                out.append(node.s)
+            elif isinstance(node, _Assign):
+                self.vars[node.var] = self._pipe(node.pipe)
+            elif isinstance(node, _If):
+                branch = node.body if _truthy(self._pipe(node.cond)) else node.orelse
+                out.append(self.render(branch))
+            elif isinstance(node, _Pipe):
+                v = self._pipe(node)
+                if v is None:
+                    v = ""
+                elif v is True or v is False:
+                    v = "true" if v else "false"
+                out.append(str(v))
+            else:
+                raise ValueError(f"unknown node {node!r}")
+        return "".join(out)
+
+
+def render_template(text: str, values: dict, chart: dict | None = None) -> str:
+    ctx = {
+        "Values": values,
+        "Chart": chart or {},
+        "Release": {"Name": "kubeai-tpu", "Service": "Helm"},
+    }
+    return _Renderer(ctx).render(_parse(text))
+
+
+def render_chart(chart_dir: str, values: dict) -> list[dict]:
+    """Render every template in the chart with the given values; returns
+    the parsed manifest documents (templates whose guard renders nothing
+    are dropped, like `helm template`)."""
+    import yaml
+
+    chart_meta: dict = {}
+    chart_yaml = os.path.join(chart_dir, "Chart.yaml")
+    if os.path.exists(chart_yaml):
+        with open(chart_yaml) as f:
+            chart_meta = yaml.safe_load(f) or {}
+    docs: list[dict] = []
+    tdir = os.path.join(chart_dir, "templates")
+    for name in sorted(os.listdir(tdir)):
+        if not name.endswith((".yaml", ".yml", ".tpl")) or name.startswith("_"):
+            continue
+        with open(os.path.join(tdir, name)) as f:
+            rendered = render_template(f.read(), values, chart_meta)
+        for doc in yaml.safe_load_all(rendered):
+            if doc:
+                docs.append(doc)
+    return docs

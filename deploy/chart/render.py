@@ -26,6 +26,7 @@ sys.path.insert(0, REPO)
 from kubeai_tpu.config.system import _parse_config_text  # noqa: E402
 
 HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)  # for minihelm (chart-parity serializer)
 
 
 def deep_merge(dst: dict, src: dict) -> dict:
@@ -78,14 +79,10 @@ def render(values: dict, include_models: bool = False) -> list[dict]:
     docs.append({"apiVersion": "v1", "kind": "Namespace",
                  "metadata": {"name": ns}})
 
-    # CRD travels verbatim (deploy/crd-model.yaml is the source of truth
-    # incl. CEL rules); emitted as a passthrough document marker so
-    # `kubectl apply -f deploy/crd-model.yaml -f <(render.py)` composes.
-    docs.append({
-        "apiVersion": "v1", "kind": "ConfigMap",
-        "metadata": _meta("kubeai-tpu-crd-pointer", ns),
-        "data": {"apply-first": "deploy/crd-model.yaml"},
-    })
+    # The CRD is NOT part of this render: kubectl users apply
+    # deploy/crd-model.yaml first (deploy/chart/README.md step 1) and
+    # helm users get it from charts/kubeai-tpu/crds/ — matching `helm
+    # template`, which also excludes crds/ from its output.
 
     docs.append({"apiVersion": "v1", "kind": "ServiceAccount",
                  "metadata": _meta("kubeai-tpu", ns)})
@@ -136,10 +133,15 @@ def render(values: dict, include_models: bool = False) -> list[dict]:
     for key in ("resourceProfiles", "cacheProfiles", "messaging"):
         if values.get(key):
             sys_cfg[key] = values[key]
+    # Serialized exactly like Go's encoding/json (sorted keys, no
+    # spaces, HTML escapes) so the Helm chart's `toJson` emits the
+    # identical string — the chart-parity test diffs the two byte-wise.
+    from minihelm import _go_json
+
     docs.append({
         "apiVersion": "v1", "kind": "ConfigMap",
         "metadata": _meta("kubeai-tpu-config", ns),
-        "data": {"config.yaml": json.dumps(sys_cfg, indent=2)},
+        "data": {"config.yaml": _go_json(sys_cfg)},
     })
 
     if values.get("secrets", {}).get("huggingface", {}).get("create"):

@@ -1,0 +1,129 @@
+"""Helm chart ⇔ kubectl renderer parity (round-5 verdict #4).
+
+charts/kubeai-tpu is a real installable Helm chart. This environment has
+no helm binary, so the golden guarantee is enforced with
+deploy/chart/minihelm.py — a strict interpreter of exactly the
+text/template+sprig subset the chart's templates use: rendering the chart
+with any values must produce the same manifests deploy/chart/render.py
+emits for those values. Reference: charts/kubeai/Chart.yaml + templates.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+CHART = os.path.join(REPO, "charts", "kubeai-tpu")
+
+sys.path.insert(0, os.path.join(REPO, "deploy", "chart"))
+import minihelm  # noqa: E402
+
+spec = importlib.util.spec_from_file_location(
+    "chart_render", os.path.join(REPO, "deploy", "chart", "render.py")
+)
+render_mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(render_mod)
+
+
+def _canon(docs):
+    return sorted(
+        (json.dumps(d, sort_keys=True) for d in docs),
+    )
+
+
+def _assert_parity(sets):
+    values = render_mod.load_values(None, sets)
+    helm_docs = minihelm.render_chart(CHART, values)
+    py_docs = render_mod.render(values)
+    assert _canon(helm_docs) == _canon(py_docs), (
+        "helm-template output diverged from deploy/chart/render.py for "
+        f"--set {sets!r}"
+    )
+
+
+def test_chart_matches_renderer_default_values():
+    _assert_parity([])
+
+
+def test_chart_matches_renderer_all_optionals_on():
+    _assert_parity(
+        [
+            "namespace=prod",
+            "operator.image=me/op:v9",
+            "operator.replicas=3",
+            "operator.apiPort=9000",
+            "operator.metricsPort=9090",
+            "ingress.enabled=true",
+            "ingress.className=nginx",
+            "ingress.host=api.example.com",
+            "metrics.podMonitor.enabled=true",
+            "metrics.podMonitor.labels.release=prom",
+            "secrets.huggingface.create=true",
+            "secrets.huggingface.token=hf_abc",
+            "resourceProfiles.cpu.requests.cpu=1",
+            "cacheProfiles.standard.sharedFilesystem.storageClassName=premium",
+        ]
+    )
+
+
+def test_chart_values_match_kubectl_values():
+    """One values surface, two install paths: the chart's values.yaml and
+    deploy/chart/values.yaml must stay identical."""
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        chart_vals = yaml.safe_load(f)
+    with open(os.path.join(REPO, "deploy", "chart", "values.yaml")) as f:
+        kubectl_vals = yaml.safe_load(f)
+    assert chart_vals == kubectl_vals
+
+
+def test_chart_crd_matches_source_of_truth():
+    with open(os.path.join(CHART, "crds", "model.yaml")) as f:
+        chart_crd = f.read()
+    with open(os.path.join(REPO, "deploy", "crd-model.yaml")) as f:
+        src = f.read()
+    assert chart_crd == src, (
+        "charts/kubeai-tpu/crds/model.yaml is stale — re-copy from "
+        "deploy/crd-model.yaml"
+    )
+
+
+def test_chart_metadata():
+    with open(os.path.join(CHART, "Chart.yaml")) as f:
+        meta = yaml.safe_load(f)
+    assert meta["apiVersion"] == "v2"
+    assert meta["name"] == "kubeai-tpu"
+    assert meta["version"]
+
+
+def test_embedded_config_is_go_json():
+    """The system-config document inside the ConfigMap must be valid Go
+    encoding/json output (sorted keys, no whitespace) so real `helm
+    template` — which uses Go's toJson — matches render.py byte-wise."""
+    values = render_mod.load_values(None, [])
+    docs = minihelm.render_chart(CHART, values)
+    cm = next(
+        d for d in docs
+        if d["kind"] == "ConfigMap"
+        and d["metadata"]["name"] == "kubeai-tpu-config"
+    )
+    raw = cm["data"]["config.yaml"]
+    parsed = json.loads(raw)
+    assert raw == minihelm._go_json(parsed)
+    assert "modelServers" in parsed
+
+
+def test_engine_rejects_unknown_function():
+    with pytest.raises(ValueError):
+        minihelm.render_template("{{ lookup \"v1\" }}", {})
+
+
+def test_engine_if_else_and_trim():
+    out = minihelm.render_template(
+        "a\n{{- if .Values.x }}\nyes\n{{- else }}\nno\n{{- end }}\n",
+        {"x": False},
+    )
+    assert out == "a\nno\n"
