@@ -30,3 +30,31 @@ def devices8():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="include tests marked slow (jit-heavy; excluded by default "
+        "so the fast tier stays a sub-5-minute signal — reference parity: "
+        "its unit tier runs in seconds, Makefile:77-84)",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: jit/compile-heavy test; excluded from the default fast "
+        "tier, run with --runslow or -m slow",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow") or "slow" in (config.option.markexpr or ""):
+        return
+    skip = pytest.mark.skip(
+        reason="slow tier (pass --runslow to include)"
+    )
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -55,6 +55,7 @@ def _prompts(n, seed=42):
     ]
 
 
+@pytest.mark.slow
 def test_draft_spec_greedy_matches_vanilla():
     prompts = _prompts(5)
     sp = SamplingParams(temperature=0.0, max_tokens=16)
@@ -64,6 +65,7 @@ def test_draft_spec_greedy_matches_vanilla():
     assert eng.generate(prompts, sp) == want
 
 
+@pytest.mark.slow
 def test_draft_spec_seeded_matches_vanilla():
     prompts = _prompts(4, seed=9)
     sp = SamplingParams(temperature=0.9, top_k=20, max_tokens=12, seed=31)
@@ -74,6 +76,7 @@ def test_draft_spec_seeded_matches_vanilla():
     assert got == want
 
 
+@pytest.mark.slow
 def test_draft_spec_multiple_batches_reuse_slots():
     """Slot reuse: draft KV rows from a finished request must not leak
     into the next request admitted to the same slot."""
@@ -85,6 +88,7 @@ def test_draft_spec_multiple_batches_reuse_slots():
         assert eng.generate(prompts, sp) == want.generate(prompts, sp)
 
 
+@pytest.mark.slow
 def test_self_draft_acceptance_is_total_where_lookup_collapses():
     """Target-as-draft on random (non-repetitive) prompts: greedy
     proposals are the target's own argmax chain, so every window accepts
@@ -149,6 +153,7 @@ def test_draft_without_speculation_rejected():
         )
 
 
+@pytest.mark.slow
 def test_adaptive_chunk_windows_keep_draft_synced():
     """spec_adaptive (the default) interleaves chunk-mode windows, which
     advance sequences without the draft proposing; the catch-up pass must

@@ -26,6 +26,7 @@ def tiny_engine():
 GREEDY = SamplingParams(temperature=0.0, max_tokens=8)
 
 
+@pytest.mark.slow
 def test_greedy_generation_deterministic(tiny_engine):
     prompt = [1, 2, 3, 4, 5]
     out1 = tiny_engine.generate([prompt], GREEDY)[0]
@@ -90,6 +91,7 @@ def test_top_p_zero_degrades_to_greedy(tiny_engine):
     assert got == want
 
 
+@pytest.mark.slow
 def test_max_tokens_and_eos():
     cfg = llama.LlamaConfig.tiny()
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
@@ -112,6 +114,7 @@ def test_max_tokens_and_eos():
     assert out == [first]  # stopped immediately at EOS
 
 
+@pytest.mark.slow
 def test_sharded_engine_tp_matches_single(devices8):
     """TP over a 4-device mesh must give identical greedy tokens."""
     cfg = llama.LlamaConfig.tiny()
@@ -126,6 +129,7 @@ def test_sharded_engine_tp_matches_single(devices8):
     assert out1 == out4
 
 
+@pytest.mark.slow
 def test_pipelined_stepping_equivalent():
     """pipeline=True must emit the identical token stream, one chunk late."""
     cfg = llama.LlamaConfig.tiny()
@@ -155,6 +159,7 @@ def test_pipelined_stepping_equivalent():
     assert evs[-1].finished and evs[-1].finish_reason == "length"
 
 
+@pytest.mark.slow
 def test_int8_quantized_engine_close_to_bf16():
     """int8 weight-only quantization: engine runs and greedy outputs stay
     highly consistent with full precision on short generations."""
@@ -185,6 +190,7 @@ def test_int8_quantized_engine_close_to_bf16():
         assert q8tp.generate(prompts, GREEDY) == got
 
 
+@pytest.mark.slow
 def test_chunked_prefill_matches_bucketed():
     """prefill_chunk engine path == whole-prompt path, greedy-token exact."""
     cfg = llama.LlamaConfig.tiny()
@@ -201,6 +207,7 @@ def test_chunked_prefill_matches_bucketed():
     assert got == want
 
 
+@pytest.mark.slow
 def test_chunked_prefill_with_lora_and_seeds():
     import numpy as np
 

@@ -35,6 +35,7 @@ def test_paged_is_default_for_llama():
     ).cache_mode == "paged"
 
 
+@pytest.mark.slow
 def test_slot_paged_equivalence_greedy():
     """Same prompts, greedy: identical token streams from both caches."""
     prompts = _prompts(6)
@@ -44,6 +45,7 @@ def test_slot_paged_equivalence_greedy():
     assert out_slot == out_paged
 
 
+@pytest.mark.slow
 def test_slot_paged_equivalence_seeded_sampling():
     prompts = _prompts(4, np.random.default_rng(7))
     sp = SamplingParams(temperature=0.9, top_k=20, max_tokens=10, seed=123)
@@ -52,6 +54,7 @@ def test_slot_paged_equivalence_seeded_sampling():
     assert out_slot == out_paged
 
 
+@pytest.mark.slow
 def test_decode_kernel_selection_and_equivalence():
     """Both paged attention layouts are selectable (EngineConfig and env
     var) and emit identical greedy streams — the per-layer layout is the
@@ -75,6 +78,7 @@ def test_decode_kernel_env_override(monkeypatch):
         _make("paged", decode_kernel="bogus")
 
 
+@pytest.mark.slow
 def test_pages_released_on_completion():
     eng = _make("paged")
     total = eng._alloc.free_pages
@@ -83,6 +87,7 @@ def test_pages_released_on_completion():
     assert eng._alloc.free_pages == total  # all pages returned
 
 
+@pytest.mark.slow
 def test_oversubscribed_pool_defers_admission():
     # Pool holds ~1.5 max sequences; 4 slots want in. Admission defers,
     # everyone completes eventually.
@@ -92,6 +97,7 @@ def test_oversubscribed_pool_defers_admission():
     assert all(len(o) == 8 for o in outs)
 
 
+@pytest.mark.slow
 def test_preemption_recompute_matches_unconstrained():
     """Decode-time pool exhaustion preempts the youngest request; its
     recompute resume must reproduce exactly the unconstrained stream."""
@@ -117,6 +123,7 @@ def test_pool_too_small_for_one_sequence_rejected():
         _make("paged", num_pages=4)  # < max_seq_len/page_size + scratch
 
 
+@pytest.mark.slow
 def test_cancel_frees_pages():
     eng = _make("paged")
     total = eng._alloc.free_pages
@@ -129,6 +136,7 @@ def test_cancel_frees_pages():
     eng.step()  # stale block-table rows must not crash the next step
 
 
+@pytest.mark.slow
 def test_ring_prefill_serving_path(monkeypatch):
     """Sequence parallelism is a SERVING path: an engine whose mesh has
     sp>1 prefills with ring attention (sequence sharded over sp, K/V
@@ -167,6 +175,7 @@ def test_ring_prefill_serving_path(monkeypatch):
     assert got == want
 
 
+@pytest.mark.slow
 def test_speculative_greedy_matches_vanilla():
     """Prompt-lookup speculation emits EXACTLY the vanilla stream —
     greedy, including repetitive prompts where acceptance is high and a
@@ -193,6 +202,7 @@ def test_speculative_greedy_matches_vanilla():
     assert got2 == want2
 
 
+@pytest.mark.slow
 def test_speculative_seeded_matches_vanilla():
     rng = np.random.default_rng(22)
     prompts = [
@@ -205,6 +215,7 @@ def test_speculative_seeded_matches_vanilla():
     assert got == want
 
 
+@pytest.mark.slow
 def test_speculative_accepts_on_repetitive_text():
     """On repetitive context the lookup proposals are right, so steps
     emit >1 token — fewer device steps than tokens."""
@@ -250,6 +261,7 @@ def test_ngram_indexed_matches_scan_proposer():
         assert list(got) == list(want), req.ctx_len
 
 
+@pytest.mark.slow
 def test_chunked_prefill_paged_matches_whole_prompt():
     """prefill_chunk in PAGED mode (staged chunks -> page scatter) emits
     exactly the whole-prompt paged stream, greedy and seeded; short
@@ -268,6 +280,7 @@ def test_chunked_prefill_paged_matches_whole_prompt():
         assert chunked.generate(prompts, sp) == want
 
 
+@pytest.mark.slow
 def test_chunked_prefill_paged_preemption_resume():
     """A preempted long-prompt request re-admits through the chunked
     path with its forced token; the stream must match unconstrained."""
@@ -279,6 +292,7 @@ def test_chunked_prefill_paged_preemption_resume():
     assert tight.generate(prompts, sp) == want
 
 
+@pytest.mark.slow
 def test_chunked_prefill_nondivisible_tail():
     """ceil(plen/C)*C > max_seq_len used to make the final chunk's
     dynamic_update_slice CLAMP its start and silently corrupt staged KV;
@@ -295,6 +309,7 @@ def test_chunked_prefill_nondivisible_tail():
         assert got == want, mode
 
 
+@pytest.mark.slow
 def test_adaptive_speculation_streams_match_vanilla():
     """With spec_adaptive (default), the engine may interleave speculative
     windows and fused chunks based on measured throughput — the emitted

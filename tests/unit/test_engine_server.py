@@ -69,6 +69,7 @@ def test_health_metrics_models(server):
     assert "tiny-llama" in ids
 
 
+@pytest.mark.slow
 def test_completion_roundtrip(server):
     status, body = http_post(
         addr(server),

@@ -684,6 +684,7 @@ def test_resume_after_retention_truncation(kafka):
     msg.ack()
 
 
+@pytest.mark.slow
 def test_two_members_split_partitions():
     fake = FakeKafka(partitions=2)
     b1 = KafkaBroker(

@@ -26,6 +26,7 @@ def setup():
     return cfg, params, A, B
 
 
+@pytest.mark.slow
 def test_adapter_matches_merged_weights(setup):
     cfg, params, A, B = setup
     eng = Engine(
@@ -55,6 +56,7 @@ def test_adapter_matches_merged_weights(setup):
     assert with_adapter != base_out  # the adapter actually does something
 
 
+@pytest.mark.slow
 def test_mixed_batch_base_and_adapter(setup):
     """One decode batch serving base + adapter rows simultaneously."""
     cfg, params, A, B = setup
@@ -95,6 +97,7 @@ def test_unload_and_capacity(setup):
         eng.add_request([1, 2], GREEDY, adapter="ghost")
 
 
+@pytest.mark.slow
 def test_unload_refuses_while_in_flight(setup):
     """Unloading an adapter with pending/active requests must refuse:
     zeroing the slot mid-stream would silently flip the request to

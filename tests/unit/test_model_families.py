@@ -48,6 +48,7 @@ def _roundtrip(family_name, hf_model, out_dir, prompt=(3, 14, 15, 92, 65)):
     assert ours_gen == out[0, len(prompt):].tolist()
 
 
+@pytest.mark.slow
 def test_qwen2_parity(tmp_path):
     torch = pytest.importorskip("torch")
     from transformers import Qwen2Config, Qwen2ForCausalLM
@@ -65,6 +66,7 @@ def test_qwen2_parity(tmp_path):
     _roundtrip("qwen", model, tmp_path)
 
 
+@pytest.mark.slow
 def test_gemma_parity(tmp_path):
     torch = pytest.importorskip("torch")
     from transformers import GemmaConfig as HFGemmaConfig
@@ -82,6 +84,7 @@ def test_gemma_parity(tmp_path):
     _roundtrip("gemma", model, tmp_path)
 
 
+@pytest.mark.slow
 def test_mixtral_parity(tmp_path):
     torch = pytest.importorskip("torch")
     from transformers import MixtralConfig as HFMixtralConfig
@@ -100,6 +103,7 @@ def test_mixtral_parity(tmp_path):
     _roundtrip("mixtral", model, tmp_path)
 
 
+@pytest.mark.slow
 def test_mixtral_expert_parallel_matches_single(devices8):
     """EP: experts sharded over the tp axis give identical outputs."""
     from kubeai_tpu.models import mixtral
@@ -115,6 +119,7 @@ def test_mixtral_expert_parallel_matches_single(devices8):
     assert eng1.generate(prompts, GREEDY) == eng4.generate(prompts, GREEDY)
 
 
+@pytest.mark.slow
 def test_gemma2_parity(tmp_path):
     """Gemma-2: sandwich norms + attention/final logit softcapping."""
     torch = pytest.importorskip("torch")
@@ -134,6 +139,7 @@ def test_gemma2_parity(tmp_path):
     _roundtrip("gemma", model, tmp_path)
 
 
+@pytest.mark.slow
 def test_gemma2_sliding_window_parity(tmp_path):
     """Gemma-2 sliding-window attention ENFORCED: HF parity with a window
     smaller than the sequence (alternating local/global layers), plus a
@@ -186,6 +192,7 @@ def test_gemma2_sliding_window_parity(tmp_path):
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_gemma_mixtral_paged_equivalence():
     """Slot-vs-paged decode equivalence for the non-llama families
     (gemma2 incl. alternating sliding-window layers; mixtral MoE)."""
@@ -236,6 +243,7 @@ def test_gemma_mixtral_paged_equivalence():
     ],
     ids=["linear", "yarn", "llama3"],
 )
+@pytest.mark.slow
 def test_rope_scaling_variant_parity(tmp_path, rope_scaling):
     """Context-extension rope variants match HF exactly (logits + greedy),
     with prompts LONGER than original_max_position_embeddings (32) so

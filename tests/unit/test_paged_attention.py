@@ -149,6 +149,7 @@ def test_fused_kernel_matches_reference():
         )
 
 
+@pytest.mark.slow
 def test_fused_kernel_softcap_and_window():
     q, kp, vp, kn, vn, bt, pos = _fused_setup([9, 26, 31], seed=13)
     for cap, win in ((30.0, None), (None, 12), (50.0, 7)):
@@ -277,6 +278,7 @@ def test_allocator_oversubscription_and_rollback():
     assert alloc.free_pages == 4
 
 
+@pytest.mark.slow
 def test_verify_kernel_matches_reference():
     """Multi-query verify kernel (interpret mode) vs the gather
     reference, incl. softcap/window and ragged base positions."""

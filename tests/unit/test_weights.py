@@ -62,6 +62,7 @@ def test_load_and_logits_parity_with_hf(hf_checkpoint):
     )
 
 
+@pytest.mark.slow
 def test_greedy_generation_matches_hf(hf_checkpoint):
     import torch
 
@@ -116,6 +117,7 @@ def test_resolve_model_dir_pvc_and_local(tmp_path):
     assert resolve_model_dir("hf://x", model_dir="/cache/dir") == "/cache/dir"
 
 
+@pytest.mark.slow
 def test_native_checkpoint_roundtrip(tmp_path):
     """Orbax save/restore of the engine's native param tree."""
     import jax

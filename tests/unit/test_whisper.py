@@ -38,6 +38,7 @@ def hf_whisper(tmp_path_factory):
     return str(d), model, hf_cfg
 
 
+@pytest.mark.slow
 def test_whisper_logits_parity(hf_whisper):
     import torch
     from kubeai_tpu.engine.weights import load_hf_config, load_params
@@ -64,6 +65,7 @@ def test_whisper_logits_parity(hf_whisper):
     np.testing.assert_allclose(np.asarray(ours), theirs, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_whisper_greedy_transcribe_matches_hf(hf_whisper):
     import torch
 
@@ -118,6 +120,7 @@ def test_audio_frontend_wav_roundtrip():
     assert np.isfinite(mel).all()
 
 
+@pytest.mark.slow
 def test_transcription_server_end_to_end():
     """Multipart WAV upload through the HTTP surface."""
     import http.client
