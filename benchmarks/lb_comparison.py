@@ -188,7 +188,87 @@ class SimEngine:
             pass  # client gone
 
 
-def _mk_world(n_replicas: int, strategy: str, engines: list[SimEngine]):
+class RealEngineReplica:
+    """A REAL in-tree engine replica (tiny Llama, byte tokenizer, CPU)
+    behind the same pod-annotation wiring SimEngine uses — the throughput
+    axis of the comparison (round-5 verdict #8): with real engines the
+    tok/s and TTFT columns measure the production serving path end to
+    end (front door → proxy → LB → EngineServer → continuous batching),
+    not a cost model. Exposes the same counters SimEngine does; prefix
+    counters stay 0 (the in-tree engine has no automatic prefix cache —
+    CHWBL affinity exists for engines that do, reference:
+    docs/benchmarks/prefix-aware-load-balancing.md)."""
+
+    # The governing knobs of a real replica (recorded in the report in
+    # place of the simulator's cost model). Byte tokenizer ⇒ one token
+    # per character: a 4-turn conversation (system + growing history)
+    # runs ~1k tokens, hence the max_seq_len.
+    NUM_SLOTS = 8
+    MAX_SEQ_LEN = 2048
+    DECODE_CHUNK = 8
+
+    def __init__(self, shared=None):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from kubeai_tpu.engine import Engine, EngineConfig
+        from kubeai_tpu.engine.server import EngineServer
+        from kubeai_tpu.engine.tokenizer import ByteTokenizer
+        from kubeai_tpu.models import llama
+
+        if shared is None:
+            tok = ByteTokenizer()
+            cfg = llama.LlamaConfig.tiny(vocab_size=tok.vocab_size)
+            shared = (tok, cfg, llama.init_params(cfg))
+        self.shared = shared
+        tok, cfg, params = shared
+        self._srv = EngineServer(
+            Engine(
+                "llama", cfg, params,
+                cfg=EngineConfig(
+                    num_slots=self.NUM_SLOTS,
+                    max_seq_len=self.MAX_SEQ_LEN,
+                    decode_chunk=self.DECODE_CHUNK,
+                ),
+                eos_token_ids=tok.eos_token_ids,
+            ),
+            tok, "sim", host="127.0.0.1", port=0,
+        )
+        self._srv.start()
+        self.cached_chars = 0
+        self.total_chars = 0
+
+    @property
+    def port(self) -> int:
+        return self._srv.port
+
+    def _metric(self, name: str) -> float:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{self.port}/metrics", timeout=10
+        ) as r:
+            for line in r.read().decode().splitlines():
+                if line.startswith(name):
+                    try:
+                        return float(line.rpartition(" ")[2])
+                    except ValueError:
+                        pass
+        return 0.0
+
+    @property
+    def requests(self) -> int:
+        return int(self._metric("kubeai_engine_requests_total"))
+
+    @property
+    def generated_tokens(self) -> int:
+        return int(self._metric("kubeai_engine_generated_tokens_total"))
+
+    def stop(self):
+        self._srv.stop()
+
+
+def _mk_world(n_replicas: int, strategy: str, engines: list):
     store = KubeStore()
     cfg = System()
     cfg.allow_pod_address_override = True
@@ -247,16 +327,48 @@ def run_one(
     ramp_s: float = 0.0, per_char_us: float = DEFAULT_PER_CHAR_US,
     base_prefill_ms: float = DEFAULT_BASE_PREFILL_MS,
     engine_concurrency: int = DEFAULT_ENGINE_CONCURRENCY,
+    real_engines: bool = False,
 ) -> dict:
-    engines = [
-        SimEngine(
-            concurrency=engine_concurrency,
-            base_prefill_s=base_prefill_ms / 1e3,
-            per_char_s=per_char_us / 1e6,
-        )
-        for _ in range(replicas)
-    ]
+    if real_engines:
+        engines = []
+        shared = None
+        for _ in range(replicas):
+            e = RealEngineReplica(shared)
+            shared = e.shared
+            engines.append(e)
+    else:
+        engines = [
+            SimEngine(
+                concurrency=engine_concurrency,
+                base_prefill_s=base_prefill_ms / 1e3,
+                per_char_s=per_char_us / 1e6,
+            )
+            for _ in range(replicas)
+        ]
     store, mgr = _mk_world(replicas, strategy, engines)
+    tokens_baseline = 0
+    if real_engines:
+        # Warm each replica's compile caches (prefill buckets + decode
+        # chunk) with one same-shaped conversation DIRECTLY at its port,
+        # so the timed phase measures serving, not XLA compilation — the
+        # production analog is the readiness-probe warm-up window.
+        warm = {"ttft": [], "itl": [], "out_chars": 0, "requests": 0,
+                "errors": 0}
+        wlock = threading.Lock()
+        for i, e in enumerate(engines):
+            client.run_conversation(
+                f"http://127.0.0.1:{e.port}", "sim", turns, max_tokens,
+                7000 + i, warm, wlock,
+            )
+        if warm["errors"]:
+            # A failed warm-up would silently leave XLA compilation inside
+            # the timed numbers the report claims exclude it.
+            raise RuntimeError(
+                f"{warm['errors']} warm-up request(s) failed; timed phase "
+                "would measure compilation"
+            )
+        tokens_baseline = sum(e.generated_tokens for e in engines)
+        requests_baseline = [e.requests for e in engines]
     results = {"ttft": [], "itl": [], "out_chars": 0, "requests": 0,
                "errors": 0}
     lock = threading.Lock()
@@ -290,20 +402,43 @@ def run_one(
     per_engine = [e.requests for e in engines]
     cached = sum(e.cached_chars for e in engines)
     total = sum(e.total_chars for e in engines)
-    # Tokens are synthetic ("tokN "): chars/5.6 approximates the count.
-    out_tokens = results["out_chars"] / 5.6
+    if real_engines:
+        # Warm-up traffic went directly to each port, not through the LB —
+        # exclude it from the routing spread like the token counters do.
+        per_engine = [
+            n - base for n, base in zip(per_engine, requests_baseline)
+        ]
+        # Byte tokenizer: the engines' own generated-token counters are
+        # exact (and match out_chars 1:1); warm-up tokens excluded.
+        out_tokens = sum(e.generated_tokens for e in engines) - tokens_baseline
+    else:
+        # Tokens are synthetic ("tokN "): chars/5.6 approximates the count.
+        out_tokens = results["out_chars"] / 5.6
     report = {
         "strategy": strategy,
+        "engines": "real" if real_engines else "simulated",
         "concurrency": threads,
         "replicas": replicas,
         "turns": turns,
-        # Full engine cost model + load shape, so a committed JSON alone
-        # is enough to reproduce the run.
+        # Full engine parameters + load shape, so a committed JSON alone
+        # is enough to reproduce the run: the simulator's cost model in
+        # sim mode, the real replica's governing knobs in real mode (the
+        # cost-model kwargs are ignored there and would mislead).
         "max_tokens": max_tokens,
         "ramp_s": ramp_s,
-        "per_char_us": per_char_us,
-        "base_prefill_ms": base_prefill_ms,
-        "engine_concurrency": engine_concurrency,
+        **(
+            {
+                "num_slots": RealEngineReplica.NUM_SLOTS,
+                "max_seq_len": RealEngineReplica.MAX_SEQ_LEN,
+                "decode_chunk": RealEngineReplica.DECODE_CHUNK,
+            }
+            if real_engines
+            else {
+                "per_char_us": per_char_us,
+                "base_prefill_ms": base_prefill_ms,
+                "engine_concurrency": engine_concurrency,
+            }
+        ),
         "requests": results["requests"],
         "errors": results["errors"],
         "wall_s": round(wall, 2),
@@ -352,6 +487,13 @@ def main():
         default=DEFAULT_ENGINE_CONCURRENCY,
         help="bounded prefill admission per simulated replica",
     )
+    ap.add_argument(
+        "--real-engines", action="store_true",
+        help="back the proxy tier with REAL in-tree engine replicas "
+        "(tiny Llama, CPU) instead of the cost model: tok/s and TTFT "
+        "then measure the production serving path end to end. Size "
+        "--threads to the host (each replica really decodes)",
+    )
     ap.add_argument("--json-out", default="")
     args = ap.parse_args()
 
@@ -370,6 +512,7 @@ def main():
             ramp_s=args.ramp_s, per_char_us=args.per_char_us,
             base_prefill_ms=args.base_prefill_ms,
             engine_concurrency=args.engine_concurrency,
+            real_engines=args.real_engines,
         )
         reports.append(rep)
         print(json.dumps(rep), flush=True)
