@@ -207,6 +207,11 @@ class RealEngineReplica:
     MAX_SEQ_LEN = 2048
     DECODE_CHUNK = 8
 
+    # Real replicas run with automatic prefix caching ON (the production
+    # config; the reference's benchmark replicas ran vLLM's APC) — this
+    # is what lets PrefixHash routing translate into skipped prefill.
+    PREFILL_CHUNK = 128
+
     def __init__(self, shared=None):
         import jax
 
@@ -229,14 +234,14 @@ class RealEngineReplica:
                     num_slots=self.NUM_SLOTS,
                     max_seq_len=self.MAX_SEQ_LEN,
                     decode_chunk=self.DECODE_CHUNK,
+                    prefill_chunk=self.PREFILL_CHUNK,
+                    prefix_cache=True,
                 ),
                 eos_token_ids=tok.eos_token_ids,
             ),
             tok, "sim", host="127.0.0.1", port=0,
         )
         self._srv.start()
-        self.cached_chars = 0
-        self.total_chars = 0
 
     @property
     def port(self) -> int:
@@ -263,6 +268,16 @@ class RealEngineReplica:
     @property
     def generated_tokens(self) -> int:
         return int(self._metric("kubeai_engine_generated_tokens_total"))
+
+    @property
+    def cached_chars(self) -> int:
+        # Byte tokenizer: tokens == chars, so the engine's prefix-cache
+        # counters drop into SimEngine's hit-rate accounting directly.
+        return int(self._metric("kubeai_engine_prefix_cached_tokens_total"))
+
+    @property
+    def total_chars(self) -> int:
+        return int(self._metric("kubeai_engine_prefix_prompt_tokens_total"))
 
     def stop(self):
         self._srv.stop()
@@ -369,6 +384,8 @@ def run_one(
             )
         tokens_baseline = sum(e.generated_tokens for e in engines)
         requests_baseline = [e.requests for e in engines]
+        cached_baseline = sum(e.cached_chars for e in engines)
+        total_baseline = sum(e.total_chars for e in engines)
     results = {"ttft": [], "itl": [], "out_chars": 0, "requests": 0,
                "errors": 0}
     lock = threading.Lock()
@@ -408,6 +425,8 @@ def run_one(
         per_engine = [
             n - base for n, base in zip(per_engine, requests_baseline)
         ]
+        cached -= cached_baseline
+        total -= total_baseline
         # Byte tokenizer: the engines' own generated-token counters are
         # exact (and match out_chars 1:1); warm-up tokens excluded.
         out_tokens = sum(e.generated_tokens for e in engines) - tokens_baseline

@@ -100,6 +100,18 @@ class EngineConfig:
     # in a one-slot buffer and scatters pages on the final chunk.
     # Requires family support.
     prefill_chunk: int = 0
+    # Automatic prefix caching (paged mode + prefill_chunk > 0): full
+    # prompt pages register under a content-hash chain (adapter-aware)
+    # when a request completes admission; a later prompt with the same
+    # page-aligned prefix ADOPTS those pages read-only and prefills only
+    # its suffix — shared system prompts and multi-turn histories skip
+    # most prefill compute. Zero-reference pages park in an LRU idle
+    # pool and are evicted only when the free list runs dry, so caching
+    # never reduces servable capacity. This is the per-replica half of
+    # the reference's prefix-caching story (its cross-replica half, the
+    # CHWBL router, ships in routing/chwbl.py; reference headline:
+    # docs/benchmarks/prefix-aware-load-balancing.md).
+    prefix_cache: bool = False
     cache_dtype: Any = jnp.bfloat16
     # Decode steps fused into one device call (lax.scan). Amortizes host
     # dispatch — critical when the chip sits behind an RPC tunnel. Tokens a
@@ -131,8 +143,9 @@ class EngineConfig:
     # the GPipe schedule. 0 = the pp stage count (steady-state utilization
     # M/(M+P-1); raise toward num_slots for higher utilization at smaller
     # per-tick batches). Requires a family with decode_step_paged_pp,
-    # paged cache mode, sp == 1, and num_slots % M == 0; composes with
-    # dp, tp, and int8 quantization.
+    # paged cache mode, and num_slots % M == 0; composes with dp, tp, sp
+    # (ring-attention prefill), int8 quantization, and prompt-lookup
+    # speculation.
     pp_microbatches: int = 0
 
     def buckets(self) -> tuple[int, ...]:
@@ -363,6 +376,22 @@ class Engine:
             self._alloc = PageAllocator(
                 n_pages, cfg.page_size, max_pages_per_slot=max_pages
             )
+            self._prefix_cache = bool(cfg.prefix_cache)
+            if self._prefix_cache:
+                if cfg.prefill_chunk <= 0:
+                    raise ValueError(
+                        "prefix_cache needs prefill_chunk > 0 (cache hits "
+                        "prefill only the uncached suffix, which runs "
+                        "through the staged-chunk path)"
+                    )
+                if self._pp > 1:
+                    raise ValueError(
+                        "prefix_cache does not compose with pipeline "
+                        "parallelism yet"
+                    )
+            self.prefix_stats = {
+                "lookups": 0, "hit_tokens": 0, "prompt_tokens": 0,
+            }
             # Host mirror of the block tables: page growth/release edits
             # this; one small [slots, MP] transfer syncs the device copy
             # before the next decode dispatch (_bt_dirty).
@@ -394,6 +423,15 @@ class Engine:
                     self._stage_sharding,
                 )
         else:
+            if cfg.prefix_cache:
+                raise ValueError(
+                    "prefix_cache requires cache_mode='paged' (pages are "
+                    "the sharing unit)"
+                )
+            self._prefix_cache = False
+            self.prefix_stats = {
+                "lookups": 0, "hit_tokens": 0, "prompt_tokens": 0,
+            }
             cache_sharding = psh.named_sharding(
                 self.mesh, KVCache.logical_axes(), cache_rules
             )
@@ -425,6 +463,9 @@ class Engine:
         # Loading an adapter updates a buffer slice — never a recompile.
         self._lora = None
         self._adapter_slots: dict[str, int] = {}
+        # slot index -> weight generation (prefix-cache hash seed; index
+        # 0 = base model, generation fixed at 0).
+        self._adapter_gen: dict[int, int] = {}
         if cfg.max_adapters > 0:
             if not hasattr(self.family, "init_lora_buffers"):
                 from kubeai_tpu.models import llama as _llama
@@ -1091,6 +1132,33 @@ class Engine:
                 ),
             )
 
+            if self._prefix_cache:
+                S = self.cfg.max_seq_len
+
+                def _stage_from_pages(kp, vp, bt_row, ks, vs):
+                    """Materialize a block-table row's pages into the
+                    staging buffers (prefix-cache hit: the adopted prefix
+                    becomes the context the suffix chunks attend over).
+                    Static shapes: the whole row gathers every call;
+                    junk past the cached length is masked by the chunk
+                    graph's causal frontier and overwritten by the
+                    suffix compute."""
+                    row = jnp.maximum(bt_row, 0)
+                    gk = kp[:, row]  # [NL, MP, page, KVH, D]
+                    gv = vp[:, row]
+                    nl, mp, pg, kvh, d = gk.shape
+                    ks = gk.reshape(nl, mp * pg, kvh, d)[:, :S]
+                    vs = gv.reshape(nl, mp * pg, kvh, d)[:, :S]
+                    return ks.astype(self.cfg.cache_dtype), vs.astype(
+                        self.cfg.cache_dtype
+                    )
+
+                self._stage_from_pages_jit = jax.jit(
+                    _stage_from_pages,
+                    donate_argnums=(3, 4),
+                    out_shardings=(stage_sharding, stage_sharding),
+                )
+
     # ---- public API ---------------------------------------------------------
 
     def add_request(
@@ -1230,9 +1298,12 @@ class Engine:
         emitted: list[StepEvent] = []
         C = self.cfg.prefill_chunk
         while self._pending and self._free_slots:
-            batch: list[tuple[_Request, int, list[int], int, bool]] = []
+            batch: list[
+                tuple[_Request, int, list[int], int, bool, list[bytes] | None]
+            ] = []
             bucket = None
             chunked = None  # long prompt diverted to the staged-chunk path
+            prefix_hit = None  # cached prefix diverted to the suffix path
             while (
                 self._pending
                 and self._free_slots
@@ -1245,11 +1316,46 @@ class Engine:
                     else req.prompt
                 )
                 plen = len(seq)
+                hashes = None
+                if self._prefix_cache and not resumed:
+                    # Memoized per request: a head-of-line admission
+                    # deferred by OutOfPages would otherwise re-hash its
+                    # whole prompt every engine step. (Safe across steps:
+                    # adapter swaps refuse while a pending request
+                    # references the slot, so the generation in the seed
+                    # cannot change under a queued request.)
+                    hashes = getattr(req, "_apc_hashes", None)
+                    if hashes is None:
+                        hashes = self._prefix_hashes(seq, req.adapter_idx)
+                        req._apc_hashes = hashes
+                    # Cap the hit twice over: at least the final token
+                    # must compute (its logits seed the first sample),
+                    # and cached_len + prefill_chunk must fit inside the
+                    # staging buffer — a padded suffix chunk starting
+                    # past max_seq_len - C would have its
+                    # dynamic_update_slice start CLAMPED, silently
+                    # writing KV at the wrong offset and then scattering
+                    # it into shared pages.
+                    cap = min(
+                        (plen - 1) // self.cfg.page_size,
+                        max(
+                            0,
+                            (self.cfg.max_seq_len - C)
+                            // self.cfg.page_size,
+                        ),
+                    )
+                    hit = self._alloc.lookup(hashes[:cap])
+                    if hit:
+                        # One-at-a-time (staging buffer); flush any
+                        # batch first and take the hit next iteration.
+                        if not batch:
+                            prefix_hit = (req, seq, plen, hashes, hit)
+                        break
                 if C > 0 and plen > C:
                     # Chunked admission is one-at-a-time (the staging
                     # buffer holds one sequence); flush any batch first.
                     if not batch:
-                        chunked = (req, seq, plen, resumed)
+                        chunked = (req, seq, plen, resumed, hashes)
                     break
                 b = self._bucket(plen)
                 if bucket is None:
@@ -1265,9 +1371,29 @@ class Engine:
                 self._free_slots.pop()
                 req.slot = slot
                 self._set_bt_row(slot, pages)
-                batch.append((req, slot, seq, plen, resumed))
+                batch.append((req, slot, seq, plen, resumed, hashes))
+            if prefix_hit is not None:
+                req, seq, plen, hashes, hit = prefix_hit
+                slot = self._free_slots[-1]
+                self._alloc.adopt(slot, hit)
+                try:
+                    pages = self._alloc.ensure(slot, plen)
+                except OutOfPages:
+                    self._alloc.unadopt(slot)
+                    break  # defer; nothing held
+                self._pending.popleft()
+                self._free_slots.pop()
+                req.slot = slot
+                self._set_bt_row(slot, pages)
+                cached_len = len(hit) * self.cfg.page_size
+                tok = self._admit_prefix_hit(req, slot, seq, plen, cached_len)
+                self._note_prefix_admission(req, slot, plen, cached_len, hashes)
+                ev = self._finish_admission(req, slot, plen, tok, False)
+                if ev is not None:
+                    emitted.append(ev)
+                continue
             if chunked is not None:
-                req, seq, plen, resumed = chunked
+                req, seq, plen, resumed, hashes = chunked
                 slot = self._free_slots[-1]
                 try:
                     pages = self._alloc.ensure(slot, plen)
@@ -1278,6 +1404,8 @@ class Engine:
                 req.slot = slot
                 self._set_bt_row(slot, pages)
                 tok = self._admit_chunked_paged(req, slot, seq, plen, C)
+                if not resumed:
+                    self._note_prefix_admission(req, slot, plen, 0, hashes)
                 ev = self._finish_admission(req, slot, plen, tok, resumed)
                 if ev is not None:
                     emitted.append(ev)
@@ -1285,11 +1413,108 @@ class Engine:
             if not batch:
                 break
             toks = self._admit_paged_batch(batch, bucket)
-            for (req, slot, _seq, plen, resumed), tok in zip(batch, toks):
+            for (req, slot, _seq, plen, resumed, hashes), tok in zip(
+                batch, toks
+            ):
+                if not resumed:
+                    self._note_prefix_admission(req, slot, plen, 0, hashes)
                 ev = self._finish_admission(req, slot, plen, int(tok), resumed)
                 if ev is not None:
                     emitted.append(ev)
         return emitted
+
+    def _prefix_hashes(self, tokens: list[int], adapter_idx: int) -> list[bytes]:
+        """Page-aligned content-hash chain over a prompt. Seeded with the
+        adapter slot AND its weight generation, so hot-swapping new
+        weights into a reused adapter index can never hit stale KV."""
+        import hashlib
+
+        ps = self.cfg.page_size
+        gen = self._adapter_gen.get(adapter_idx, 0)
+        h = hashlib.blake2b(
+            f"apc1:{adapter_idx}:{gen}".encode(), digest_size=16
+        ).digest()
+        arr = np.asarray(tokens, np.int32)
+        out = []
+        for i in range(len(tokens) // ps):
+            h = hashlib.blake2b(
+                h + arr[i * ps : (i + 1) * ps].tobytes(), digest_size=16
+            ).digest()
+            out.append(h)
+        return out
+
+    def _note_prefix_admission(
+        self, req: _Request, slot: int, plen: int,
+        cached_len: int, hashes: list[bytes] | None,
+    ) -> None:
+        """Account a fresh admission and publish its immutable full
+        prompt pages (pages decode will never write: the first decode
+        token lands at position plen, i.e. page plen // page_size).
+        `hashes` is the chain the admission loop already computed (None
+        when the prefix cache is off). Must run BEFORE _finish_admission
+        — a request that finishes on its first token releases the slot
+        there, and registration is what lets the released pages park in
+        the cache."""
+        if not self._prefix_cache or hashes is None:
+            return
+        self.prefix_stats["lookups"] += 1
+        self.prefix_stats["hit_tokens"] += cached_len
+        self.prefix_stats["prompt_tokens"] += plen
+        n_reg = plen // self.cfg.page_size
+        if n_reg == 0:
+            return
+        self._alloc.register(
+            hashes[:n_reg], self._alloc.pages_for(slot)[:n_reg]
+        )
+
+    def _admit_prefix_hit(
+        self, req: _Request, slot: int, seq: list[int], plen: int,
+        cached_len: int,
+    ) -> int:
+        """Admission with an adopted cached prefix: materialize the
+        prefix pages into the staging buffers, then prefill ONLY the
+        suffix through the staged-chunk path (the final chunk scatters
+        the staged sequence and samples the first token, exactly as
+        chunked admission does)."""
+        self._stage_k, self._stage_v = self._stage_from_pages_jit(
+            self.cache.k_pages,
+            self.cache.v_pages,
+            jnp.asarray(self._bt_host[slot]),
+            self._stage_k,
+            self._stage_v,
+        )
+        C = self.cfg.prefill_chunk
+        arr = np.asarray(seq, np.int32)
+        mids = []
+        s = cached_len
+        while plen - s > C:
+            mids.append((s, arr[None, s : s + C]))
+            s += C
+        # INVARIANT: no chunk may start before cached_len. The adopted
+        # prefix pages are SHARED read-only; recomputing their positions
+        # here would run a different XLA program than the one that
+        # produced them (chunk graph vs bucketed prefill), and the final
+        # chunk's scatter would then write not-bit-identical bf16 into
+        # pages other requests are concurrently reading. Recompute
+        # overlap is only safe WITHIN the suffix (same chunk graph,
+        # deterministic), so short suffixes pad forward from cached_len
+        # instead of back-aligning into the cached region. (The scatter
+        # still rewrites the prefix pages, but with values GATHERED from
+        # those very pages — bit-identical by construction.)
+        if plen - cached_len >= C:
+            last = (plen - C, arr[None, plen - C : plen])
+        else:
+            # The admission-loop hit cap guarantees this chunk fits the
+            # staging buffer; a clamped dynamic_update_slice start would
+            # write KV at the wrong offset and scatter it into shared
+            # pages.
+            assert cached_len + C <= self.cfg.max_seq_len, (
+                cached_len, C, self.cfg.max_seq_len,
+            )
+            padded = np.zeros((1, C), np.int32)
+            padded[0, : plen - cached_len] = arr[cached_len:plen]
+            last = (cached_len, padded)
+        return self._run_staged_chunks(req, slot, plen, mids, last)
 
     def _admit_chunked_paged(
         self, req: _Request, slot: int, seq: list[int], plen: int, C: int
@@ -1297,7 +1522,16 @@ class Engine:
         """Chunked prefill in paged mode: chunks accumulate in the one-slot
         staging buffer; the final chunk scatters the whole staged sequence
         through the slot's freshly-allocated block-table row."""
-        mids, (last_start, last_tokens) = self._chunk_plan(seq, plen, C)
+        mids, last = self._chunk_plan(seq, plen, C)
+        return self._run_staged_chunks(req, slot, plen, mids, last)
+
+    def _run_staged_chunks(
+        self, req: _Request, slot: int, plen: int, mids, last
+    ) -> int:
+        """Run a staged-chunk schedule (mid chunks, then the scattering
+        final chunk) — shared by chunked admission and prefix-cache-hit
+        suffix prefill so the two paths cannot drift."""
+        last_start, last_tokens = last
         for start, tokens in mids:
             self._stage_k, self._stage_v = self._stage_chunk_mid_jit(
                 self.params,
@@ -1360,7 +1594,7 @@ class Engine:
         ints[:, 0] = 1
         ints[:, 1] = self.cfg.num_slots
         floats[:, 1] = 1.0
-        for i, (req, slot, seq, plen, _resumed) in enumerate(batch):
+        for i, (req, slot, seq, plen, _resumed, _hashes) in enumerate(batch):
             tokens[i, :plen] = seq
             ints[i] = [
                 plen,
@@ -1926,6 +2160,9 @@ class Engine:
                 self._lora[target]["A"] = bufA.at[slot].set(padA)
                 self._lora[target]["B"] = bufB.at[slot].set(padB)
             self._adapter_slots[name] = slot
+            # New weights in this slot index: prefix-cache entries hashed
+            # under the old generation must never hit again.
+            self._adapter_gen[slot] = self._adapter_gen.get(slot, 0) + 1
 
     def adapter_in_use(self, name: str) -> bool:
         """True when the adapter is loaded and any pending/active request
@@ -1966,6 +2203,7 @@ class Engine:
                     "they finish"
                 )
             del self._adapter_slots[name]
+            self._adapter_gen[slot] = self._adapter_gen.get(slot, 0) + 1
             for target in self._lora:
                 bufA = self._lora[target]["A"]
                 bufB = self._lora[target]["B"]

@@ -84,29 +84,65 @@ class SequenceTooLong(RuntimeError):
 
 
 class PageAllocator:
-    """Host-side free-list. The device never sees allocation — only the
-    resulting block tables.
+    """Host-side free-list with optional prefix-cache sharing. The device
+    never sees allocation — only the resulting block tables.
 
     Page 0 is RESERVED as a scratch page and never handed out: jit-safe
     ops clamp unallocated block-table entries (-1) to 0, so reads hit
-    masked junk and writes land in scratch — never in a live sequence."""
+    masked junk and writes land in scratch — never in a live sequence.
+
+    Prefix caching (the vLLM automatic-prefix-cache idea, host-side
+    bookkeeping only): immutable full-page prompt prefixes register under
+    a content-hash chain. A later prompt whose leading pages hash to a
+    registered chain ADOPTS those pages read-only instead of recomputing
+    them — pages then carry a slot refcount, and pages whose refcount
+    drops to zero park in an LRU idle pool (still lookupable) that the
+    free path evicts from only when the free list runs dry. The reference
+    exploits engine prefix caches only ACROSS replicas (CHWBL routing,
+    docs/benchmarks/prefix-aware-load-balancing.md); this gives the
+    in-tree engine the per-replica half of that headline."""
 
     def __init__(
         self, num_pages: int, page_size: int,
         max_pages_per_slot: int | None = None,
     ):
+        from collections import OrderedDict
+
         self.page_size = page_size
         self.max_pages_per_slot = max_pages_per_slot
         self._free = list(range(1, num_pages))  # page 0 reserved
         # slot -> allocated page ids, in order.
         self._owned: dict[int, list[int]] = {}
+        # Prefix-cache state. A page is in exactly one of: _free, owned
+        # (refcount >= 1), or _idle (refcount 0 but still registered).
+        self._ref: dict[int, int] = {}
+        self._hash_to_page: dict[bytes, int] = {}
+        self._page_to_hash: dict[int, bytes] = {}
+        self._idle: "OrderedDict[int, None]" = OrderedDict()  # LRU -> MRU
 
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        """Pages an ensure() can still obtain (idle cached pages are
+        reclaimable by eviction)."""
+        return len(self._free) + len(self._idle)
+
+    @property
+    def cached_idle_pages(self) -> int:
+        return len(self._idle)
 
     def pages_for(self, slot: int) -> list[int]:
         return list(self._owned.get(slot, []))
+
+    def _take_free(self) -> int | None:
+        if self._free:
+            return self._free.pop()
+        if self._idle:
+            page, _ = self._idle.popitem(last=False)  # evict LRU
+            h = self._page_to_hash.pop(page)
+            del self._hash_to_page[h]
+            del self._ref[page]
+            return page
+        return None
 
     def ensure(self, slot: int, length: int) -> list[int]:
         """Grow slot's allocation to cover `length` tokens. Returns the page
@@ -120,19 +156,83 @@ class PageAllocator:
                 f"{self.max_pages_per_slot}"
             )
         owned = self._owned.setdefault(slot, [])
+        # Capacity check BEFORE touching the idle cache: _take_free
+        # destroys an evicted page's hash entries, so an allocation that
+        # cannot succeed must not strip the cache on its way to the
+        # OutOfPages it was always going to raise.
+        if need - len(owned) > len(self._free) + len(self._idle):
+            raise OutOfPages(
+                f"page pool exhausted ({need} needed for slot {slot})"
+            )
         taken: list[int] = []
         while len(owned) + len(taken) < need:
-            if not self._free:
-                self._free.extend(taken)  # roll back: hold nothing on failure
+            page = self._take_free()
+            if page is None:  # unreachable given the check above
+                self._free.extend(taken)
                 raise OutOfPages(
                     f"page pool exhausted ({need} needed for slot {slot})"
                 )
-            taken.append(self._free.pop())
+            taken.append(page)
+        for page in taken:
+            self._ref[page] = 1
         owned.extend(taken)
         return list(owned)
 
+    def _decref(self, page: int) -> None:
+        n = self._ref.get(page, 1) - 1
+        if n > 0:
+            self._ref[page] = n
+        elif page in self._page_to_hash:
+            # Still registered: park in the idle LRU, content intact.
+            self._ref[page] = 0
+            self._idle[page] = None
+        else:
+            self._ref.pop(page, None)
+            self._free.append(page)
+
     def release(self, slot: int) -> None:
-        self._free.extend(self._owned.pop(slot, []))
+        for page in self._owned.pop(slot, []):
+            self._decref(page)
+
+    # ---- prefix cache ------------------------------------------------------
+
+    def lookup(self, hashes: list[bytes]) -> list[int]:
+        """Longest registered prefix of the hash chain -> its pages, in
+        order. Hit pages are NOT reserved — call adopt() to take refs."""
+        pages: list[int] = []
+        for h in hashes:
+            page = self._hash_to_page.get(h)
+            if page is None:
+                break
+            pages.append(page)
+        return pages
+
+    def adopt(self, slot: int, pages: list[int]) -> None:
+        """Prepend shared pages to slot's allocation (before any ensure()
+        growth), taking a reference on each; idle pages come off the LRU."""
+        owned = self._owned.setdefault(slot, [])
+        assert not owned, "adopt() must seed an empty slot"
+        for page in pages:
+            self._ref[page] = self._ref.get(page, 0) + 1
+            self._idle.pop(page, None)
+        owned.extend(pages)
+
+    def unadopt(self, slot: int) -> None:
+        """Roll back an adopt() whose follow-up ensure() failed."""
+        for page in self._owned.pop(slot, []):
+            self._decref(page)
+
+    def register(self, hashes: list[bytes], pages: list[int]) -> None:
+        """Publish a slot's immutable full prompt pages under their chain
+        hashes. First registration of a hash wins (concurrent identical
+        prompts produce identical content anyway); a page already
+        registered under another hash keeps its original entry."""
+        for h, page in zip(hashes, pages):
+            if h in self._hash_to_page or page in self._page_to_hash:
+                continue
+            self._hash_to_page[h] = page
+            self._page_to_hash[page] = h
+
 
 
 def set_block_table(

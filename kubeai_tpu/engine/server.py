@@ -82,6 +82,16 @@ class EngineMetrics:
             "Speculative tokens accepted by verify.",
             self.registry,
         )
+        self.prefix_hit_tokens = Gauge(
+            "kubeai_engine_prefix_cached_tokens_total",
+            "Prompt tokens served from the prefix cache (skipped prefill).",
+            self.registry,
+        )
+        self.prefix_prompt_tokens = Gauge(
+            "kubeai_engine_prefix_prompt_tokens_total",
+            "Prompt tokens seen by prefix-cache admission.",
+            self.registry,
+        )
 
     def sync_engine(self, engine) -> None:
         """Snapshot engine serving state at scrape time (the engine owns
@@ -98,6 +108,10 @@ class EngineMetrics:
         if stats:
             self.spec_proposed.set(stats["proposed"])
             self.spec_accepted.set(stats["accepted"])
+        pstats = getattr(inner, "prefix_stats", None)
+        if pstats:
+            self.prefix_hit_tokens.set(pstats["hit_tokens"])
+            self.prefix_prompt_tokens.set(pstats["prompt_tokens"])
 
 
 class EngineServer:
@@ -806,7 +820,20 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--draft-dir", default="", help="pre-downloaded draft cache dir"
     )
+    ap.add_argument(
+        "--prefill-chunk", type=int, default=0,
+        help="chunked prefill size (0 = whole-prompt bucketed prefill); "
+        "one compiled graph for every prompt length",
+    )
+    ap.add_argument(
+        "--prefix-cache", action="store_true",
+        help="automatic prefix caching: shared prompt prefixes skip "
+        "prefill (pairs with the router's PrefixHash affinity). Implies "
+        "--prefill-chunk 512 when unset",
+    )
     args = ap.parse_args(argv)
+    if args.prefix_cache and args.prefill_chunk <= 0:
+        args.prefill_chunk = min(512, args.max_seq_len)
 
     logging.basicConfig(level=logging.INFO)
     log = logging.getLogger("kubeai-tpu-engine")
@@ -906,6 +933,8 @@ def main(argv=None) -> int:
             quantization=args.quantization,
             speculate=args.speculate,
             spec_adaptive=args.spec_adaptive == "on",
+            prefill_chunk=args.prefill_chunk,
+            prefix_cache=args.prefix_cache,
         ),
         eos_token_ids=tuple(getattr(tokenizer, "eos_token_ids", ())),
         draft=draft,
