@@ -336,6 +336,7 @@ class Engine:
                 )
             )
 
+        self.prefix_stats = {"lookups": 0, "hit_tokens": 0, "prompt_tokens": 0}
         if self.cache_mode == "paged":
             from kubeai_tpu.engine.paged_cache import PageAllocator, PagedKVCache
 
@@ -389,9 +390,20 @@ class Engine:
                         "prefix_cache does not compose with pipeline "
                         "parallelism yet"
                     )
-            self.prefix_stats = {
-                "lookups": 0, "hit_tokens": 0, "prompt_tokens": 0,
-            }
+                if (cfg.max_seq_len - cfg.prefill_chunk) // cfg.page_size < 1:
+                    # The adoptable prefix is capped at max_seq_len -
+                    # prefill_chunk (the padded suffix chunk must fit the
+                    # staging buffer); at or past the cap the cache can
+                    # NEVER hit and every admission pays pure hashing
+                    # overhead.
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "prefix_cache is inert: prefill_chunk=%d leaves "
+                        "no adoptable pages under max_seq_len=%d "
+                        "(page_size=%d) — shrink prefill_chunk",
+                        cfg.prefill_chunk, cfg.max_seq_len, cfg.page_size,
+                    )
             # Host mirror of the block tables: page growth/release edits
             # this; one small [slots, MP] transfer syncs the device copy
             # before the next decode dispatch (_bt_dirty).
@@ -429,9 +441,6 @@ class Engine:
                     "the sharing unit)"
                 )
             self._prefix_cache = False
-            self.prefix_stats = {
-                "lookups": 0, "hit_tokens": 0, "prompt_tokens": 0,
-            }
             cache_sharding = psh.named_sharding(
                 self.mesh, KVCache.logical_axes(), cache_rules
             )

@@ -829,11 +829,13 @@ def main(argv=None) -> int:
         "--prefix-cache", action="store_true",
         help="automatic prefix caching: shared prompt prefixes skip "
         "prefill (pairs with the router's PrefixHash affinity). Implies "
-        "--prefill-chunk 512 when unset",
+        "a prefill chunk of min(512, max-seq-len/4) when unset — the "
+        "adoptable prefix is capped at max-seq-len minus the chunk, so "
+        "the chunk must stay well under the context",
     )
     args = ap.parse_args(argv)
     if args.prefix_cache and args.prefill_chunk <= 0:
-        args.prefill_chunk = min(512, args.max_seq_len)
+        args.prefill_chunk = max(32, min(512, args.max_seq_len // 4))
 
     logging.basicConfig(level=logging.INFO)
     log = logging.getLogger("kubeai-tpu-engine")

@@ -146,3 +146,66 @@ def test_server_main_draft_speculation(checkpoint):
     finally:
         proc.send_signal(signal.SIGKILL)
         proc.wait(timeout=10)
+
+
+def test_server_main_prefix_cache(checkpoint):
+    """--prefix-cache end to end through the process entrypoint: two
+    same-prefix completions, the second served with cached prompt pages
+    (visible on /metrics). Stream exactness is covered by the unit tier
+    (test_prefix_cache)."""
+    port = 18479
+    env = dict(os.environ)
+    env["KUBEAI_FORCE_CPU"] = "1"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-c",
+            "import jax; jax.config.update('jax_platforms','cpu'); "
+            "from kubeai_tpu.engine.server import main; import sys; "
+            f"sys.exit(main(['--model-url', {checkpoint!r}, "
+            f"'--served-model-name', 'tiny', '--port', '{port}', "
+            "'--host', '127.0.0.1', '--num-slots', '2', "
+            "'--max-seq-len', '256', '--max-adapters', '0', "
+            "'--prefix-cache', '--prefill-chunk', '32']))",
+        ],
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        def healthy():
+            if proc.poll() is not None:
+                out = proc.stdout.read().decode(errors="replace")
+                raise AssertionError(f"server died:\n{out[-2000:]}")
+            try:
+                return http_get(f"127.0.0.1:{port}", "/health", timeout=2)[0] == 200
+            except OSError:
+                return False
+
+        eventually(healthy, timeout=180, interval=0.5, msg="server healthy")
+        shared = "x" * 70
+        outs = []
+        for tail in ("aaa", "bbb"):
+            status, body = http_post(
+                f"127.0.0.1:{port}",
+                "/v1/completions",
+                {"model": "tiny", "prompt": shared + tail, "max_tokens": 4,
+                 "temperature": 0},
+                timeout=120,
+            )
+            assert status == 200, body
+            outs.append(json.loads(body)["choices"][0]["text"])
+        status, body = http_get(f"127.0.0.1:{port}", "/metrics")
+        metrics = {}
+        for line in body.decode().splitlines():
+            if line and not line.startswith("#"):
+                k, _, v = line.rpartition(" ")
+                try:
+                    metrics[k] = float(v)
+                except ValueError:
+                    pass
+        assert metrics.get("kubeai_engine_prefix_cached_tokens_total", 0) >= 64
+        assert metrics.get("kubeai_engine_prefix_prompt_tokens_total", 0) > 0
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
