@@ -521,11 +521,6 @@ class Engine:
             ):
                 self._spec = cfg.speculate
                 if draft is not None:
-                    if cfg.prefill_chunk:
-                        raise ValueError(
-                            "draft speculation with chunked prefill is "
-                            "not supported yet"
-                        )
                     if self._pp > 1:
                         # The draft runs the non-pp decode path; its
                         # layer stack would shard over pp and every
@@ -1060,6 +1055,42 @@ class Engine:
                 out_shardings=(dsh, dsh),
             )
 
+            if self.cfg.prefill_chunk > 0:
+                draft_chunk_fn = self._chunk_fn
+
+                def _dslot_slice(c, slot):
+                    nl, _, L, kvh, d = c.shape
+                    sl = jax.lax.dynamic_slice(
+                        c, (0, slot, 0, 0, 0), (nl, 1, L, kvh, d)
+                    )
+                    return sl[:, 0]
+
+                def _dslot_write(c, slot, sl):
+                    return jax.lax.dynamic_update_slice(
+                        c, sl[:, None].astype(c.dtype), (0, slot, 0, 0, 0)
+                    )
+
+                def _draft_chunk(dparams, tokens, ints, dk, dv):
+                    """One chunk of draft prefill into the draft's slot
+                    row — lets chunked/prefix-hit TARGET admissions keep
+                    the draft cache in sync (the batched path's
+                    whole-prompt _draft_admit can't serve them). `ints`
+                    packs [start, length, slot]."""
+                    start, length, slot = ints[0], ints[1], ints[2]
+                    ks = _dslot_slice(dk, slot)
+                    vs = _dslot_slice(dv, slot)
+                    _, ks, vs = draft_chunk_fn(
+                        dparams, dcfg, tokens, start, length, ks, vs,
+                        want_logits=False,
+                    )
+                    return _dslot_write(dk, slot, ks), _dslot_write(dv, slot, vs)
+
+                self._draft_chunk_jit = jax.jit(
+                    _draft_chunk,
+                    donate_argnums=(3, 4),
+                    out_shardings=(dsh, dsh),
+                )
+
         if self.cfg.prefill_chunk > 0:
             from kubeai_tpu.ops.paged_attention import (
                 scatter_sequence,
@@ -1523,6 +1554,7 @@ class Engine:
             padded = np.zeros((1, C), np.int32)
             padded[0, : plen - cached_len] = arr[cached_len:plen]
             last = (cached_len, padded)
+        self._draft_admit_chunked(seq, plen, slot)
         return self._run_staged_chunks(req, slot, plen, mids, last)
 
     def _admit_chunked_paged(
@@ -1532,7 +1564,32 @@ class Engine:
         staging buffer; the final chunk scatters the whole staged sequence
         through the slot's freshly-allocated block-table row."""
         mids, last = self._chunk_plan(seq, plen, C)
+        self._draft_admit_chunked(seq, plen, slot)
         return self._run_staged_chunks(req, slot, plen, mids, last)
+
+    def _draft_admit_chunked(self, seq: list[int], plen: int, slot: int) -> None:
+        """Chunk the whole prompt into the draft's slot row (the draft
+        shares no pages with the target's prefix cache, so even a
+        cache-hit admission prefills the draft over the FULL sequence —
+        the draft is a fraction of the target's cost)."""
+        if not self._draft:
+            return
+        C = self.cfg.prefill_chunk
+        if plen >= C:
+            mids, last = self._chunk_plan(seq, plen, C)
+            chunks = [*mids, last]
+        else:
+            padded = np.zeros((1, C), np.int32)
+            padded[0, :plen] = np.asarray(seq, np.int32)
+            chunks = [(0, padded)]
+        for start, tokens in chunks:
+            self._dk, self._dv = self._draft_chunk_jit(
+                self._draft_params,
+                jnp.asarray(tokens),
+                jnp.asarray([start, plen, slot], jnp.int32),
+                self._dk,
+                self._dv,
+            )
 
     def _run_staged_chunks(
         self, req: _Request, slot: int, plen: int, mids, last

@@ -134,11 +134,41 @@ def test_self_draft_acceptance_is_total_where_lookup_collapses():
     assert draft_rate > lookup_rate + 0.5, (draft_rate, lookup_rate)
 
 
-def test_draft_with_chunked_prefill_rejected():
-    with pytest.raises(ValueError, match="chunked prefill"):
-        _mk(
-            speculate=3, draft=(DRAFT_CFG, DRAFT_PARAMS), prefill_chunk=16
-        )
+@pytest.mark.slow
+def test_draft_with_chunked_prefill_matches_vanilla():
+    """Round-5 composition: chunked TARGET admission keeps the draft's
+    slot cache in sync via the draft's own chunked prefill
+    (_draft_admit_chunked), so long prompts stream exactly like vanilla
+    with a disagreeing draft."""
+    rng = np.random.default_rng(17)
+    prompts = [
+        rng.integers(1, CFG.vocab_size, n).tolist() for n in (50, 90, 12)
+    ]
+    sp = SamplingParams(temperature=0.0, max_tokens=16)
+    want = _mk().generate(prompts, sp)
+    eng = _mk(speculate=3, draft=(DRAFT_CFG, DRAFT_PARAMS), prefill_chunk=16)
+    assert eng.generate(prompts, sp) == want
+
+
+@pytest.mark.slow
+def test_draft_with_prefix_cache_accepts():
+    """--draft-url + --prefix-cache coexist: a prefix-hit admission
+    still draft-prefills the FULL prompt (the draft shares no pages), so
+    target-as-draft acceptance stays total and streams stay exact."""
+    rng = np.random.default_rng(19)
+    system = rng.integers(1, CFG.vocab_size, 48).tolist()
+    prompts = [system + rng.integers(1, CFG.vocab_size, 10).tolist()
+               for _ in range(2)]
+    sp = SamplingParams(temperature=0.0, max_tokens=12)
+    want = _mk().generate(prompts, sp)
+    eng = _mk(
+        speculate=3, draft=(CFG, PARAMS),  # target-as-draft: 100% agree
+        prefill_chunk=16, prefix_cache=True,
+    )
+    assert eng.generate(prompts, sp) == want
+    assert eng.prefix_stats["hit_tokens"] > 0  # second prompt hit
+    s = eng.spec_stats
+    assert s["accepted"] == s["proposed"]  # ceiling acceptance held
 
 
 def test_draft_without_speculation_rejected():
