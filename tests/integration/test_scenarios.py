@@ -235,27 +235,33 @@ def test_autoscaler_state_survives_restart():
             threads = [threading.Thread(target=hold) for _ in range(4)]
             for t in threads:
                 t.start()
+            def persisted_with_demand():
+                # The FIRST persisted snapshot can legitimately carry
+                # average 0 (a tick that fired before the load ramped,
+                # common under CPU starvation) — wait for a snapshot
+                # that actually recorded demand, which is what the
+                # restart must preload. Exceptions (e.g. NotFound before
+                # the first persist) propagate: eventually() retries and
+                # reports the last one on timeout.
+                cm = store.get(
+                    "ConfigMap", "default",
+                    cfg.model_autoscaling.state_configmap_name,
+                )
+                state = json.loads(cm["data"]["state"])
+                if state.get("st", {}).get("average", 0) > 0:
+                    return state
+                return None
+
             try:
-                eventually(
-                    lambda: (
-                        store.get(
-                            "ConfigMap", "default",
-                            cfg.model_autoscaling.state_configmap_name,
-                        )
-                        or None
-                    ),
-                    timeout=15, msg="state configmap written",
+                state = eventually(
+                    persisted_with_demand,
+                    timeout=30, msg="state configmap records demand",
                 )
             finally:
                 stop.set()
                 for t in threads:
                     t.join(timeout=5)
-            cm = store.get(
-                "ConfigMap", "default",
-                cfg.model_autoscaling.state_configmap_name,
-            )
-            state = json.loads(cm["data"]["state"])
-            assert state.get("st", {}).get("average", 0) > 0
+            assert state["st"]["average"] > 0
 
         mgr.stop()
         # A new manager on the same store preloads the persisted state.
