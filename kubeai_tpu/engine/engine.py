@@ -491,19 +491,11 @@ class Engine:
         # builders reuse it.
         self._chunk_fn = None
         if cfg.prefill_chunk > 0:
-            if (
-                not hasattr(self.family, "prefill_chunk")
-                and self.family.name != "llama"
-            ):
+            self._chunk_fn = getattr(self.family, "prefill_chunk", None)
+            if self._chunk_fn is None:
                 raise ValueError(
                     f"family {self.family.name} does not support chunked prefill"
                 )
-            from kubeai_tpu.models import llama as _llama
-
-            self._chunk_fn = (
-                getattr(self.family, "prefill_chunk", None)
-                or _llama.prefill_chunk
-            )
 
         self._draft = None
         if cfg.speculate > 0:

@@ -31,6 +31,7 @@ class ModelFamily:
         decode_step_paged_pp: Callable | None = None,
         decode_verify_paged: Callable | None = None,
         decode_verify_paged_pp: Callable | None = None,
+        prefill_chunk: Callable | None = None,
         hf_architectures: tuple[str, ...] = (),
         feature: str = "TextGeneration",
         hidden_states=None,
@@ -54,6 +55,9 @@ class ModelFamily:
         self.decode_verify_paged = decode_verify_paged
         # Pipeline-staged verify (None = no speculation on a pp>1 mesh).
         self.decode_verify_paged_pp = decode_verify_paged_pp
+        # Incremental chunked prefill (None = whole-prompt prefill only;
+        # chunked prefill is also the prefix cache's suffix path).
+        self.prefill_chunk = prefill_chunk
         self.hf_architectures = hf_architectures
         self.feature = feature
 
@@ -96,6 +100,7 @@ def _ensure_builtin() -> None:
             decode_step_paged_pp=llama.decode_step_paged_pp,
             decode_verify_paged=llama.decode_verify_paged,
             decode_verify_paged_pp=llama.decode_verify_paged_pp,
+            prefill_chunk=llama.prefill_chunk,
             hf_architectures=("LlamaForCausalLM", "MistralForCausalLM"),
             hidden_states=llama.hidden_states,
         )
@@ -117,6 +122,10 @@ def _ensure_builtin() -> None:
             decode_step_paged_pp=llama.decode_step_paged_pp,
             decode_verify_paged=llama.decode_verify_paged,
             decode_verify_paged_pp=llama.decode_verify_paged_pp,
+            # Qwen2 is the llama computation with q/k/v biases, which
+            # the chunk graph carries (lp.get("bq") projections) — so
+            # chunked prefill and the prefix cache work unchanged.
+            prefill_chunk=llama.prefill_chunk,
             hf_architectures=("Qwen2ForCausalLM",),
             hidden_states=llama.hidden_states,
         )

@@ -316,3 +316,29 @@ def test_prefix_cache_near_max_seq_len_prompt():
     np.testing.assert_array_equal(
         np.asarray(eng.cache.k_pages[:, pages]), before_k
     )
+
+
+@pytest.mark.slow
+def test_prefix_cache_qwen_family():
+    """Qwen (llama computation + q/k/v biases) supports chunked prefill
+    and therefore the prefix cache — regression for the family-name
+    gate that excluded it."""
+    import dataclasses as dc
+
+    qcfg = dc.replace(llama.LlamaConfig.tiny(), attention_bias=True)
+    qparams = llama.init_params(qcfg, jax.random.PRNGKey(2))
+    rng = np.random.default_rng(13)
+    system = rng.integers(1, qcfg.vocab_size, 48).tolist()
+    prompts = [system + rng.integers(1, qcfg.vocab_size, 12).tolist()
+               for _ in range(2)]
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+    base = dict(num_slots=2, max_seq_len=256, page_size=16, prefill_chunk=32)
+    want = Engine("qwen", qcfg, qparams, cfg=EngineConfig(**base)).generate(
+        prompts, sp
+    )
+    eng = Engine(
+        "qwen", qcfg, qparams,
+        cfg=EngineConfig(prefix_cache=True, **base),
+    )
+    assert eng.generate(prompts, sp) == want
+    assert eng.prefix_stats["hit_tokens"] > 0
