@@ -25,9 +25,12 @@ import jax
 import jax.numpy as jnp
 
 from kubeai_tpu.models.registry import ModelFamily, register_model_family
-from kubeai_tpu.ops.attention import decode_attention
+from kubeai_tpu.ops.attention import (
+    causal_prefill_attention,
+    chunked_prefill_attention,
+    decode_attention,
+)
 from kubeai_tpu.models.llama import _prefill_attention
-from kubeai_tpu.ops.attention import causal_prefill_attention
 from kubeai_tpu.ops.norms import rms_norm
 from kubeai_tpu.ops.rope import apply_rope, rope_frequencies
 from kubeai_tpu.parallel import sharding as sh
@@ -416,6 +419,86 @@ def decode_step_paged(params, cfg, tokens, positions, k_pages, v_pages,
     return logits, k_pages, v_pages
 
 
+def prefill_chunk(
+    params,
+    cfg: GemmaConfig,
+    tokens: jnp.ndarray,  # [1, C] one chunk (right-padded on the last chunk)
+    start: jnp.ndarray,  # scalar int32: absolute position of tokens[:, 0]
+    length: jnp.ndarray,  # scalar int32: true total prompt length
+    k_slot: jnp.ndarray,  # [NL, L, KVH, D] this slot's cache
+    v_slot: jnp.ndarray,
+    want_logits: bool = False,
+    lora=None,  # accepted for signature parity; gemma carries no LoRA
+    lora_idx=None,
+):
+    """Chunked incremental prefill for Gemma 1/2 (same contract as
+    llama.prefill_chunk): one [1, C] graph per chunk against the slot
+    cache, causal-frontier masking by absolute position — plus Gemma's
+    specifics (embed normalizer, query scale, logit softcaps, sandwich
+    norms, per-layer sliding-window alternation). Enables the engine's
+    chunked admission and prefix cache for the gemma family; equivalence
+    vs whole-prompt prefill is test-enforced."""
+    B, C = tokens.shape
+    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    inv_freq = jnp.asarray(rope_frequencies(D, cfg.rope_theta))
+    positions = start + jnp.arange(C)[None, :]
+    x = params["embed"][tokens].astype(jnp.float32)
+    x = (x * (cfg.hidden_size ** 0.5)).astype(params["embed"].dtype)
+
+    def layer(x, scanned):
+        lp, win = scanned["p"], scanned["win"]
+        kc, vc = scanned["kc"], scanned["vc"]  # [L, KVH, D]
+        h = _norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q = jnp.einsum("bse,eh->bsh", h, lp["wq"]).reshape(B, C, H, D)
+        k = jnp.einsum("bse,eh->bsh", h, lp["wk"]).reshape(B, C, KVH, D)
+        v = jnp.einsum("bse,eh->bsh", h, lp["wv"]).reshape(B, C, KVH, D)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        kc = jax.lax.dynamic_update_slice(
+            kc, k[0].astype(kc.dtype), (start, 0, 0)
+        )
+        vc = jax.lax.dynamic_update_slice(
+            vc, v[0].astype(vc.dtype), (start, 0, 0)
+        )
+        attn = chunked_prefill_attention(
+            q * (_q_scale(cfg) * D ** 0.5), kc[None], vc[None], start[None],
+            logit_softcap=cfg.attn_logit_softcapping,
+            window=win if cfg.sliding_window else None,
+        )
+        a_out = jnp.einsum(
+            "bsh,he->bse", attn.reshape(B, C, H * D), lp["wo"]
+        )
+        if cfg.sandwich_norms:
+            a_out = _norm(a_out, lp["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + a_out
+        h2 = _norm(x, lp["pre_mlp_norm"], cfg.rms_norm_eps)
+        m_out = _mlp(h2, lp["w_gate"], lp["w_up"], lp["w_down"])
+        if cfg.sandwich_norms:
+            m_out = _norm(m_out, lp["post_mlp_norm"], cfg.rms_norm_eps)
+        x = x + m_out
+        return x, {"kc": kc, "vc": vc}
+
+    x, caches = jax.lax.scan(
+        layer, x,
+        {
+            "p": params["layers"], "win": cfg.layer_windows(),
+            "kc": k_slot, "vc": v_slot,
+        },
+    )
+    k_slot, v_slot = caches["kc"], caches["vc"]
+    if not want_logits:
+        return None, k_slot, v_slot
+    x = _norm(x, params["final_norm"], cfg.rms_norm_eps)
+    idx = jnp.clip(length - 1 - start, 0, C - 1)
+    last = jax.lax.dynamic_slice(x, (0, idx, 0), (1, 1, x.shape[-1]))[:, 0]
+    logits = jnp.einsum(
+        "be,ve->bv", last, params["embed"],
+        preferred_element_type=jnp.float32,
+    )
+    logits = _softcap(logits, cfg.final_logit_softcapping)
+    return logits, k_slot, v_slot
+
+
 register_model_family(
     ModelFamily(
         "gemma",
@@ -426,6 +509,7 @@ register_model_family(
         prefill=prefill,
         decode_step=decode_step,
         decode_step_paged=decode_step_paged,
+        prefill_chunk=prefill_chunk,
         hf_architectures=("GemmaForCausalLM", "Gemma2ForCausalLM"),
     )
 )

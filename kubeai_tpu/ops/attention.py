@@ -78,8 +78,14 @@ def chunked_prefill_attention(
     chunk_start: jnp.ndarray,  # [B] absolute position of q[:, 0]
     *,
     scale: float | None = None,
+    logit_softcap: float | None = None,  # Gemma-2 tanh capping
+    window: jnp.ndarray | int | None = None,  # sliding window; <= 0 = off
 ) -> jnp.ndarray:
-    """Attention of a prefill chunk against the full cache prefix (causal)."""
+    """Attention of a prefill chunk against the full cache prefix (causal).
+
+    Softcap/window follow the same order as causal_prefill_attention /
+    decode_attention (cap the raw logits, then mask), so a chunked Gemma
+    prefill is bit-consistent with the whole-prompt path."""
     b, s, h, d = q.shape
     kvh = k_cache.shape[2]
     scale = scale if scale is not None else d ** -0.5
@@ -87,9 +93,17 @@ def chunked_prefill_attention(
     logits = jnp.einsum(
         "bqkgd,blkd->bkgql", qg.astype(jnp.float32), k_cache.astype(jnp.float32)
     )
+    if logit_softcap is not None:
+        logits = jnp.tanh(logits / logit_softcap) * logit_softcap
     q_pos = chunk_start[:, None] + jnp.arange(s)[None, :]  # [B, Sq]
     l_pos = jnp.arange(k_cache.shape[1])  # [L]
     mask = q_pos[:, :, None] >= l_pos[None, None, :]  # [B, Sq, L]
+    if window is not None:
+        win = jnp.asarray(window, jnp.int32)
+        mask = mask & (
+            (win <= 0)
+            | (q_pos[:, :, None] - l_pos[None, None, :] < win)
+        )
     logits = jnp.where(mask[:, None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgql,blkd->bqkgd", probs, v_cache.astype(jnp.float32))

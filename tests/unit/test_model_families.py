@@ -1,6 +1,8 @@
 """Gemma / Qwen2 / Mixtral parity against the HF reference implementations
 and engine integration for each family."""
 
+import dataclasses as dc
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -297,3 +299,100 @@ def test_dynamic_ntk_frequencies_rescale():
     np.testing.assert_allclose(
         rope_frequencies(32, 10000.0, {"rope_type": "default"}), base
     )
+
+
+# ---- gemma chunked prefill (round 5: enables chunked admission + the
+# prefix cache for the family) ------------------------------------------------
+
+
+def _gemma_chunk_vs_whole(cfg, seed=3):
+    from kubeai_tpu.models import gemma as G
+
+    rng = np.random.default_rng(seed)
+    params = G.init_params(cfg, jax.random.PRNGKey(seed))
+    S, L = 50, 64
+    tokens = rng.integers(1, cfg.vocab_size, S)
+    want_logits, k_want, v_want = G.prefill(
+        params, cfg, jnp.asarray(tokens[None]), jnp.asarray([S])
+    )
+    C = 16
+    k_slot = jnp.zeros((cfg.num_layers, L, cfg.num_kv_heads, cfg.head_dim),
+                       jnp.float32)
+    v_slot = jnp.zeros_like(k_slot)
+    logits = None
+    n_chunks = -(-S // C)
+    for i in range(n_chunks):
+        start = i * C if i < n_chunks - 1 else S - C
+        chunk = tokens[start:start + C]
+        logits, k_slot, v_slot = G.prefill_chunk(
+            params, cfg, jnp.asarray(chunk[None]), jnp.asarray(start),
+            jnp.asarray(S), k_slot, v_slot,
+            want_logits=(i == n_chunks - 1),
+        )
+    np.testing.assert_allclose(
+        np.asarray(k_slot[:, :S]),
+        np.asarray(k_want[:, 0], np.float32),
+        atol=2e-2, rtol=2e-2,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(want_logits), atol=2e-2, rtol=2e-2
+    )
+    assert int(jnp.argmax(logits)) == int(jnp.argmax(want_logits))
+
+
+@pytest.mark.slow
+def test_gemma_prefill_chunk_matches_whole_prompt():
+    from kubeai_tpu.models import gemma as G
+
+    cfg = dc.replace(G.GemmaConfig.tiny(), dtype=jnp.float32)
+    _gemma_chunk_vs_whole(cfg)
+
+
+@pytest.mark.slow
+def test_gemma2_prefill_chunk_matches_whole_prompt():
+    """Gemma-2 specifics through the chunk graph: sandwich norms, logit
+    softcaps, query scale, and the per-layer sliding-window alternation
+    with a window SMALLER than the prompt."""
+    from kubeai_tpu.models import gemma as G
+
+    cfg = dc.replace(
+        G.GemmaConfig.tiny(), dtype=jnp.float32, sandwich_norms=True,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        query_pre_attn_scalar=16.0, sliding_window=8,
+    )
+    _gemma_chunk_vs_whole(cfg, seed=5)
+
+
+@pytest.mark.slow
+def test_gemma2_engine_chunked_and_prefix_cache():
+    """The engine's chunked admission AND prefix cache serve gemma2
+    exactly like whole-prompt admission."""
+    from kubeai_tpu.engine import Engine, EngineConfig
+    from kubeai_tpu.engine.sampling import SamplingParams
+    from kubeai_tpu.models import gemma as G
+
+    cfg = dc.replace(
+        G.GemmaConfig.tiny(), sandwich_norms=True,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        query_pre_attn_scalar=16.0, sliding_window=8,
+    )
+    params = G.init_params(cfg, jax.random.PRNGKey(1))
+    rng = np.random.default_rng(7)
+    system = rng.integers(1, cfg.vocab_size, 48).tolist()
+    prompts = [system + rng.integers(1, cfg.vocab_size, 12).tolist()
+               for _ in range(2)]
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+    base = dict(num_slots=2, max_seq_len=256, page_size=16)
+    want = Engine("gemma", cfg, params, cfg=EngineConfig(**base)).generate(
+        prompts, sp
+    )
+    chunked = Engine(
+        "gemma", cfg, params, cfg=EngineConfig(prefill_chunk=32, **base)
+    )
+    assert chunked.generate(prompts, sp) == want
+    apc = Engine(
+        "gemma", cfg, params,
+        cfg=EngineConfig(prefill_chunk=32, prefix_cache=True, **base),
+    )
+    assert apc.generate(prompts, sp) == want
+    assert apc.prefix_stats["hit_tokens"] > 0
