@@ -322,6 +322,68 @@ def decode_step_paged(params, cfg, tokens, positions, k_pages, v_pages,
     return logits, k_pages, v_pages
 
 
+def prefill_chunk(
+    params,
+    cfg: MixtralConfig,
+    tokens: jnp.ndarray,  # [1, C] one chunk (right-padded on the last chunk)
+    start: jnp.ndarray,  # scalar int32: absolute position of tokens[:, 0]
+    length: jnp.ndarray,  # scalar int32: true total prompt length
+    k_slot: jnp.ndarray,  # [NL, L, KVH, D] this slot's cache
+    v_slot: jnp.ndarray,
+    want_logits: bool = False,
+    lora=None,  # accepted for signature parity; mixtral carries no LoRA
+    lora_idx=None,
+):
+    """Chunked incremental prefill for Mixtral (llama-pattern attention
+    chunk + the dense top-k MoE FFN, which is shape-generic over the
+    chunk's [1, C, E]). Enables chunked admission and the prefix cache
+    for the MoE family; equivalence vs whole-prompt prefill is
+    test-enforced."""
+    from kubeai_tpu.ops.attention import chunked_prefill_attention
+
+    B, C = tokens.shape
+    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
+    inv_freq = jnp.asarray(rope_frequencies(D, cfg.rope_theta))
+    positions = start + jnp.arange(C)[None, :]
+    x = params["embed"][tokens]
+
+    def layer(x, scanned):
+        lp = scanned["p"]
+        kc, vc = scanned["kc"], scanned["vc"]  # [L, KVH, D]
+        h = rms_norm(x, lp["input_norm"], cfg.rms_norm_eps)
+        q = jnp.einsum("bse,eh->bsh", h, lp["wq"]).reshape(B, C, H, D)
+        k = jnp.einsum("bse,eh->bsh", h, lp["wk"]).reshape(B, C, KVH, D)
+        v = jnp.einsum("bse,eh->bsh", h, lp["wv"]).reshape(B, C, KVH, D)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        kc = jax.lax.dynamic_update_slice(
+            kc, k[0].astype(kc.dtype), (start, 0, 0)
+        )
+        vc = jax.lax.dynamic_update_slice(
+            vc, v[0].astype(vc.dtype), (start, 0, 0)
+        )
+        attn = chunked_prefill_attention(q, kc[None], vc[None], start[None])
+        x = x + jnp.einsum("bsh,he->bse", attn.reshape(B, C, H * D), lp["wo"])
+        h2 = rms_norm(x, lp["post_attn_norm"], cfg.rms_norm_eps)
+        x = x + _moe_ffn(h2, lp, cfg)
+        return x, {"kc": kc, "vc": vc}
+
+    x, caches = jax.lax.scan(
+        layer, x, {"p": params["layers"], "kc": k_slot, "vc": v_slot}
+    )
+    k_slot, v_slot = caches["kc"], caches["vc"]
+    if not want_logits:
+        return None, k_slot, v_slot
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    idx = jnp.clip(length - 1 - start, 0, C - 1)
+    last = jax.lax.dynamic_slice(x, (0, idx, 0), (1, 1, x.shape[-1]))[:, 0]
+    logits = jnp.einsum(
+        "be,ve->bv", last, params["lm_head"],
+        preferred_element_type=jnp.float32,
+    )
+    return logits, k_slot, v_slot
+
+
 register_model_family(
     ModelFamily(
         "mixtral",
@@ -332,6 +394,7 @@ register_model_family(
         prefill=prefill,
         decode_step=decode_step,
         decode_step_paged=decode_step_paged,
+        prefill_chunk=prefill_chunk,
         hf_architectures=("MixtralForCausalLM",),
     )
 )

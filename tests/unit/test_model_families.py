@@ -396,3 +396,32 @@ def test_gemma2_engine_chunked_and_prefix_cache():
     )
     assert apc.generate(prompts, sp) == want
     assert apc.prefix_stats["hit_tokens"] > 0
+
+
+@pytest.mark.slow
+def test_mixtral_engine_chunked_and_prefix_cache():
+    """Mixtral (dense top-k MoE) through the engine's chunked admission
+    and prefix cache — streams exact vs whole-prompt admission."""
+    from kubeai_tpu.models import mixtral as MX
+
+    cfg = MX.MixtralConfig.tiny()
+    params = MX.init_params(cfg, jax.random.PRNGKey(1))
+    rng = np.random.default_rng(8)
+    system = rng.integers(1, cfg.vocab_size, 48).tolist()
+    prompts = [system + rng.integers(1, cfg.vocab_size, 12).tolist()
+               for _ in range(2)]
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+    base = dict(num_slots=2, max_seq_len=256, page_size=16)
+    want = Engine("mixtral", cfg, params, cfg=EngineConfig(**base)).generate(
+        prompts, sp
+    )
+    chunked = Engine(
+        "mixtral", cfg, params, cfg=EngineConfig(prefill_chunk=32, **base)
+    )
+    assert chunked.generate(prompts, sp) == want
+    apc = Engine(
+        "mixtral", cfg, params,
+        cfg=EngineConfig(prefill_chunk=32, prefix_cache=True, **base),
+    )
+    assert apc.generate(prompts, sp) == want
+    assert apc.prefix_stats["hit_tokens"] > 0
