@@ -32,8 +32,12 @@ _WORKER = textwrap.dedent(
     mesh = build_mesh(
         MeshConfig(dp=2, sp=1, tp=4), devices=jax.devices()
     )  # GLOBAL 8-device mesh spanning both processes
+    # prefix_cache on: every leg runs through APC-enabled admission,
+    # and a dedicated leg below proves the cache's host-side state stays
+    # in lockstep across processes (deterministic hashing + free-list).
     ecfg = EngineConfig(num_slots=4, max_seq_len=64, page_size=16,
-                        decode_chunk=4, max_adapters=1)
+                        decode_chunk=4, max_adapters=1,
+                        prefill_chunk=16, prefix_cache=True)
     eng = Engine("llama", cfg, params, mesh=mesh, cfg=ecfg)
 
     prompts = [[1, 2, 3, 4], [9, 8, 7], [5, 6]]
@@ -49,6 +53,11 @@ _WORKER = textwrap.dedent(
     adapter_weights = {"wq": (A, Bm)}
     lora_prompt = [2, 4, 6, 8]
     lsp = SamplingParams(temperature=0.0, max_tokens=8)
+    # Prefix-cache leg prompts: second shares a full 16-token page with
+    # the first (defined once so the lockstep and oracle legs cannot
+    # drift).
+    ap1 = [7] * 20 + [1, 2, 3]
+    ap2 = [7] * 20 + [4, 5]
 
     if pid == 0:
         from kubeai_tpu.engine.multihost import LockstepEngine
@@ -76,8 +85,13 @@ _WORKER = textwrap.dedent(
         while ls.has_work():
             base_toks += [e.token for e in ls.step() if e.rid == brid]
         assert ls.unload_adapter("fin")
+        # Prefix-cache leg: the hit must replay identically on the
+        # worker.
+        apc_outs = ls.generate([ap1, ap2], lsp)
         ls.shutdown()
         print("LOCKSTEP-OUTS", outs)
+        print("LOCKSTEP-APC", apc_outs)
+        print("LOCKSTEP-APC-STATS", dict(ls.inner.prefix_stats))
         print("LOCKSTEP-CANCEL-TOKENS", len(got))
         print("LOCKSTEP-LORA", lora_toks)
         print("LOCKSTEP-BASE", base_toks)
@@ -86,6 +100,7 @@ _WORKER = textwrap.dedent(
 
         worker_loop(eng)
         print("WORKER-DONE")
+        print("WORKER-APC-STATS", dict(eng.prefix_stats))
 
     # Oracle: a PLAIN SPMD run on the SAME global mesh — both processes
     # execute identical generate() calls directly (classic same-program
@@ -96,10 +111,13 @@ _WORKER = textwrap.dedent(
     ref.load_adapter("fin", adapter_weights)
     ref_lora = ref.generate([lora_prompt], lsp, adapter="fin")[0]
     ref_base = ref.generate([lora_prompt], lsp)[0]
+    ref.unload_adapter("fin")
+    ref_apc = ref.generate([ap1, ap2], lsp)
     if pid == 0:
         print("REF-OUTS", ref_outs)
         print("REF-LORA", ref_lora)
         print("REF-BASE", ref_base)
+        print("REF-APC", ref_apc)
     print(f"PROC-{pid}-OK")
     """
 )
@@ -157,3 +175,14 @@ def test_lockstep_serving_two_processes(tmp_path):
     assert grab("LOCKSTEP-LORA") == grab("REF-LORA")
     assert grab("LOCKSTEP-BASE") == grab("REF-BASE")
     assert grab("LOCKSTEP-LORA") != grab("LOCKSTEP-BASE")
+    # Prefix cache under lockstep: streams match the SPMD oracle, the
+    # hit actually happened, and the WORKER's host-side cache state is
+    # identical to host 0's (op-determinism of the allocator).
+    assert grab("LOCKSTEP-APC") == grab("REF-APC")
+    stats = grab("LOCKSTEP-APC-STATS")
+    assert stats["hit_tokens"] >= 16
+    worker_stats = next(
+        ln for ln in outs[1].splitlines()
+        if ln.startswith("WORKER-APC-STATS")
+    )
+    assert eval(worker_stats[len("WORKER-APC-STATS "):]) == stats
