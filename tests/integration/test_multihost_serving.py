@@ -153,9 +153,9 @@ def test_lockstep_serving_two_processes(tmp_path):
 
     # The lockstep run produced full-length streams for all 3 prompts
     # and they exactly match the plain-SPMD oracle on the same mesh.
-    def grab(prefix):
+    def grab(prefix, stream=0):
         line = next(
-            ln for ln in outs[0].splitlines() if ln.startswith(prefix)
+            ln for ln in outs[stream].splitlines() if ln.startswith(prefix)
         )
         return eval(line[len(prefix) + 1:])
 
@@ -181,8 +181,4 @@ def test_lockstep_serving_two_processes(tmp_path):
     assert grab("LOCKSTEP-APC") == grab("REF-APC")
     stats = grab("LOCKSTEP-APC-STATS")
     assert stats["hit_tokens"] >= 16
-    worker_stats = next(
-        ln for ln in outs[1].splitlines()
-        if ln.startswith("WORKER-APC-STATS")
-    )
-    assert eval(worker_stats[len("WORKER-APC-STATS "):]) == stats
+    assert grab("WORKER-APC-STATS", stream=1) == stats

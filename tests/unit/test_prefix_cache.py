@@ -342,3 +342,26 @@ def test_prefix_cache_qwen_family():
     )
     assert eng.generate(prompts, sp) == want
     assert eng.prefix_stats["hit_tokens"] > 0
+
+
+@pytest.mark.slow
+def test_prefix_cache_preemption_with_shared_pages():
+    """Decode-time pool exhaustion with the cache on: preempted victims
+    hold ADOPTED (shared) pages, so preemption decrefs rather than
+    frees, resumes recompute without the cache (forced-token path), and
+    streams still exactly match the unconstrained engine."""
+    rng = np.random.default_rng(23)
+    system = rng.integers(1, CFG.vocab_size, 32).tolist()
+    prompts = [system + rng.integers(1, CFG.vocab_size, 4).tolist()
+               for _ in range(3)]
+    sp = SamplingParams(temperature=0.0, max_tokens=120)
+    want = _mk(num_slots=3).generate(prompts, sp)
+    # 120-token generations need ~10 pages per sequence (30 total) but
+    # the pool holds 16 usable -> decode-time preemption while the
+    # system-prefix pages are shared between live slots.
+    tight = _mk(prefix_cache=True, num_slots=3, num_pages=1 + 16)
+    assert tight.generate(prompts, sp) == want
+    # Allocator bookkeeping intact after the churn: everything released,
+    # cache survivors are idle, refcounts drained.
+    assert all(v == 0 for v in tight._alloc._ref.values())
+    assert tight._alloc.free_pages == 16
