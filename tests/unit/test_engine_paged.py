@@ -355,3 +355,30 @@ def test_adaptive_pick_follows_measured_throughput():
 def test_adaptive_off_always_speculates():
     eng = _make("paged", speculate=4, spec_adaptive=False)
     assert all(eng._spec_pick() for _ in range(50))
+
+
+@pytest.mark.slow
+def test_speculation_on_sp_mesh_matches_single_device():
+    """Speculation composes with sequence parallelism: ring-attention
+    prefill over sp + the speculative verify (GSPMD over the same mesh)
+    emit the vanilla single-device stream — greedy on a repetitive
+    prompt where acceptance is high."""
+    devs = jax.devices()
+    if len(devs) < 2:
+        import pytest as _pytest
+
+        _pytest.skip("needs 2 virtual devices")
+    from kubeai_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    repetitive = ([7, 8, 9, 10] * 12)[:40]
+    prompts = [repetitive, [1, 2, 3, 4]]
+    sp_param = SamplingParams(temperature=0.0, max_tokens=12)
+    want = _make("paged", num_slots=2).generate(prompts, sp_param)
+    mesh = build_mesh(MeshConfig(sp=2), devices=devs[:2])
+    eng = Engine(
+        "llama", CFG, PARAMS, mesh=mesh,
+        cfg=EngineConfig(num_slots=2, max_seq_len=128, page_size=16,
+                         speculate=4, spec_adaptive=False),
+    )
+    assert eng.generate(prompts, sp_param) == want
+    assert eng.spec_stats["accepted"] > 0
