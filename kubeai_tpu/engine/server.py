@@ -7,6 +7,7 @@ Endpoints (OpenAI-compatible surface + the admin seam the operator uses):
   GET  /v1/models
   GET  /health                ← readiness/liveness probes
   GET  /metrics               ← Prometheus text (engine counters)
+  GET  /v1/state              ← admin snapshot (occupancy, spec/prefix stats)
   POST /v1/load_lora_adapter  ← operator adapter orchestration
   POST /v1/unload_lora_adapter   (reference: internal/vllmclient/client.go)
 
@@ -97,21 +98,31 @@ class EngineMetrics:
         """Snapshot engine serving state at scrape time (the engine owns
         these counters; re-plumbing every step through the metrics would
         couple the hot loop to the registry lock)."""
-        inner = getattr(engine, "inner", engine)  # LockstepEngine proxies
-        active = getattr(inner, "_active", None)
-        pending = getattr(inner, "_pending", None)
-        if active is not None:
-            self.slots_active.set(len(active))
-        if pending is not None:
-            self.requests_pending.set(len(pending))
-        stats = getattr(inner, "spec_stats", None)
+        snap = engine_state_snapshot(engine)
+        self.slots_active.set(snap["slots_active"])
+        self.requests_pending.set(snap["requests_pending"])
+        stats = snap["spec_stats"]
         if stats:
             self.spec_proposed.set(stats["proposed"])
             self.spec_accepted.set(stats["accepted"])
-        pstats = getattr(inner, "prefix_stats", None)
+        pstats = snap["prefix_stats"]
         if pstats:
             self.prefix_hit_tokens.set(pstats["hit_tokens"])
             self.prefix_prompt_tokens.set(pstats["prompt_tokens"])
+
+
+def engine_state_snapshot(engine) -> dict:
+    """Serving-state snapshot shared by /metrics and /v1/state. Occupancy
+    comes from the OUTER engine (LockstepEngine's num_pending includes
+    adds buffered for the next broadcast — the same counts admission
+    uses); spec/prefix stats live only on the inner engine."""
+    inner = getattr(engine, "inner", engine)  # LockstepEngine proxies
+    return {
+        "slots_active": engine.num_active,
+        "requests_pending": engine.num_pending,
+        "spec_stats": dict(getattr(inner, "spec_stats", {}) or {}),
+        "prefix_stats": dict(getattr(inner, "prefix_stats", {}) or {}),
+    }
 
 
 class EngineServer:
@@ -192,6 +203,20 @@ class EngineServer:
                         for a in outer.engine.loaded_adapters()
                     ]
                     return self._json(200, {"object": "list", "data": data})
+                if path == "/v1/state":
+                    # Admin snapshot of serving state: what an operator
+                    # (or a human) polls to see batching occupancy and
+                    # the speculation/prefix-cache effectiveness without
+                    # parsing Prometheus text.
+                    return self._json(
+                        200,
+                        {
+                            "model": outer.served_model_name,
+                            "healthy": outer.healthy(),
+                            "adapters": outer.engine.loaded_adapters(),
+                            **engine_state_snapshot(outer.engine),
+                        },
+                    )
                 return self._json(404, {"error": {"message": "not found"}})
 
             def do_POST(self):

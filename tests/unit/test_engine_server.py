@@ -67,6 +67,15 @@ def test_health_metrics_models(server):
     status, body = http_get(addr(server), "/v1/models")
     ids = [m["id"] for m in json.loads(body)["data"]]
     assert "tiny-llama" in ids
+    # Admin state snapshot: occupancy + speculation/prefix effectiveness
+    # as JSON (what the serving docs point operators at).
+    status, body = http_get(addr(server), "/v1/state")
+    assert status == 200
+    state = json.loads(body)
+    assert state["model"] == "tiny-llama"
+    assert state["healthy"] is True
+    assert "slots_active" in state and "requests_pending" in state
+    assert "spec_stats" in state and "prefix_stats" in state
 
 
 @pytest.mark.slow
