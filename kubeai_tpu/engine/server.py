@@ -367,11 +367,26 @@ class EngineServer:
                 },
             )
         display, adapter = resolved
+        # n > 1: independent choices as concurrent engine requests. JSON
+        # integers only (OpenAI rejects non-integral n; int() would
+        # silently truncate 2.9); None means the client omitted it.
+        raw_n = body.get("n")
+        if raw_n is None:
+            n = 1
+        elif isinstance(raw_n, bool) or not isinstance(raw_n, int):
+            n = 0  # falls through to the 400 below
+        else:
+            n = raw_n
+        if not 1 <= n <= 8:
+            return http._json(
+                400, {"error": {"message": "n must be an integer in 1..8"}}
+            )
         # Bounded admission: past this depth requests would only pile onto
         # the pending deque and blow the 600s budget anyway — shed early
         # so the LB retries another replica (reference front-door survives
-        # 8000 conc because vLLM sheds; we do our own shedding).
-        if self.engine.num_pending >= self.max_queue:
+        # 8000 conc because vLLM sheds; we do our own shedding). All n
+        # choices count against the bound.
+        if self.engine.num_pending + n > self.max_queue:
             return http._json(
                 429,
                 {"error": {"message": "engine queue full, retry later"}},
@@ -419,51 +434,72 @@ class EngineServer:
             ),
         )
         stream = bool(body.get("stream", False))
+        # Each choice gets a derived seed so explicit-seed requests stay
+        # deterministic AND diverse. With the prefix cache on, choices
+        # 2..n hit choice 1's freshly registered prompt pages, so the
+        # extra prefills are mostly free.
+        import dataclasses as _dc
 
-        sub: queue.Queue = queue.Queue()
-
-        def register(rid: int) -> None:
-            # Runs under the engine lock, before the request is visible to
-            # step(): no StepEvent can be emitted unsubscribed.
-            with self._sub_lock:
-                self._subscribers[rid] = sub
-
+        reqs: list[tuple[int, queue.Queue, SamplingParams]] = []
         try:
-            rid = self.engine.add_request(
-                prompt_ids, sp, adapter=adapter, on_admit=register
-            )
+            for i in range(n):
+                sub_i: queue.Queue = queue.Queue()
+                sp_i = (
+                    sp if i == 0 or sp.seed is None
+                    else _dc.replace(sp, seed=sp.seed + i)
+                )
+
+                def register(rid: int, _sub=sub_i) -> None:
+                    # Runs under the engine lock, before the request is
+                    # visible to step(): no StepEvent can be emitted
+                    # unsubscribed.
+                    with self._sub_lock:
+                        self._subscribers[rid] = _sub
+
+                rid_i = self.engine.add_request(
+                    prompt_ids, sp_i, adapter=adapter, on_admit=register
+                )
+                reqs.append((rid_i, sub_i, sp_i))
         except KeyError as e:
             # Adapter unloaded between _resolve_model and admission.
+            for rid_i, _, _ in reqs:
+                self.engine.cancel(rid_i)
+                with self._sub_lock:
+                    self._subscribers.pop(rid_i, None)
             return http._json(404, {"error": {"message": str(e)}})
         # Metrics only after successful admission, so a failed add_request
         # can't drift the gauge or inflate the counters.
         self.metrics.requests_total.inc(model=display)
         self.metrics.active_requests.inc()
-        self.metrics.prompt_tokens.inc(len(prompt_ids))
+        self.metrics.prompt_tokens.inc(len(prompt_ids) * n)
         self._work.set()
         try:
             if stream:
-                self._stream_response(http, rid, sub, sp, display, chat)
+                self._stream_response(http, reqs, display, chat)
             else:
-                self._unary_response(http, rid, sub, sp, display, chat, len(prompt_ids))
+                self._unary_response(http, reqs, display, chat, len(prompt_ids))
         finally:
-            # Client gone / handler done: release the batch slot if the
+            # Client gone / handler done: release the batch slots if any
             # request is still decoding (no-op after normal completion).
-            self.engine.cancel(rid)
-            with self._sub_lock:
-                self._subscribers.pop(rid, None)
+            for rid_i, _, _ in reqs:
+                self.engine.cancel(rid_i)
+                with self._sub_lock:
+                    self._subscribers.pop(rid_i, None)
             self.metrics.active_requests.dec()
 
-    def _collect(self, rid, sub, sp, on_delta=None):
+    def _collect(self, rid, sub, sp, on_delta=None, deadline=None):
         """Drain tokens; detokenize incrementally; apply stop strings.
         Returns (text, finish_reason, n_generated_tokens).
 
         request_timeout is a TOTAL budget for the request, not a per-token
-        gap — a slow drip must not hold a batch slot indefinitely."""
+        gap — a slow drip must not hold a batch slot indefinitely. With
+        n > 1 the caller passes ONE deadline shared by every choice so
+        the whole HTTP request stays inside a single budget."""
         tokens: list[int] = []
         emitted_len = 0
         finish = "length"
-        deadline = time.monotonic() + self.request_timeout
+        if deadline is None:
+            deadline = time.monotonic() + self.request_timeout
         while True:
             try:
                 remaining = deadline - time.monotonic()
@@ -506,59 +542,67 @@ class EngineServer:
             on_delta(text[emitted_len:])
         return text, finish, len(tokens)
 
-    def _unary_response(self, http, rid, sub, sp, display, chat, n_prompt):
+    def _unary_response(self, http, reqs, display, chat, n_prompt):
         # Usage counts the tokens actually generated (re-encoding the text
         # diverges around merges/special tokens and from the
-        # generated_tokens metric).
-        text, finish, completion_tokens = self._collect(rid, sub, sp)
-        if finish == "timeout":
-            if completion_tokens == 0:
-                # No first token within the budget — stalled OR merely
-                # backlogged; either way this replica can't serve it now.
-                # 503 (not 500) so the proxy retries a different replica.
-                return http._json(
-                    503,
-                    {"error": {"message": "engine produced no tokens within "
-                               f"{self.request_timeout}s"}},
-                    headers={"Retry-After": "1"},
-                )
-            finish = "length"  # partial result; valid OpenAI finish value
-        created = int(time.time())
-        usage = {
-            "prompt_tokens": n_prompt,
-            "completion_tokens": completion_tokens,
-            "total_tokens": n_prompt + completion_tokens,
-        }
-        rid_s = f"cmpl-{uuid.uuid4().hex[:24]}"
-        if chat:
-            payload = {
-                "id": rid_s,
-                "object": "chat.completion",
-                "created": created,
-                "model": display,
-                "choices": [
+        # generated_tokens metric). Choices decode CONCURRENTLY in the
+        # engine; draining them in index order is fine — later choices'
+        # events buffer in their queues meanwhile.
+        choices = []
+        total_completion = 0
+        any_timeout = False
+        deadline = time.monotonic() + self.request_timeout
+        for i, (rid, sub, sp_i) in enumerate(reqs):
+            text, finish, completion_tokens = self._collect(
+                rid, sub, sp_i, deadline=deadline
+            )
+            if finish == "timeout":
+                any_timeout = True
+                finish = "length"  # partial result; valid OpenAI value
+            total_completion += completion_tokens
+            if chat:
+                choices.append(
                     {
-                        "index": 0,
+                        "index": i,
                         "message": {"role": "assistant", "content": text},
                         "finish_reason": finish,
                     }
-                ],
-                "usage": usage,
-            }
-        else:
-            payload = {
-                "id": rid_s,
-                "object": "text_completion",
-                "created": created,
-                "model": display,
-                "choices": [
-                    {"index": 0, "text": text, "finish_reason": finish}
-                ],
-                "usage": usage,
-            }
+                )
+            else:
+                choices.append(
+                    {"index": i, "text": text, "finish_reason": finish}
+                )
+        if any_timeout and total_completion == 0:
+            # No choice produced a single token within the budget —
+            # stalled OR merely backlogged; either way this replica can't
+            # serve it now. 503 (not 500) so the proxy retries a
+            # different replica (nothing is on the wire yet in unary).
+            return http._json(
+                503,
+                {"error": {"message": "engine produced no tokens within "
+                           f"{self.request_timeout}s"}},
+                headers={"Retry-After": "1"},
+            )
+        usage = {
+            "prompt_tokens": n_prompt,
+            "completion_tokens": total_completion,
+            "total_tokens": n_prompt + total_completion,
+        }
+        payload = {
+            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion" if chat else "text_completion",
+            "created": int(time.time()),
+            "model": display,
+            "choices": choices,
+            "usage": usage,
+        }
         http._json(200, payload)
 
-    def _stream_response(self, http, rid, sub, sp, display, chat):
+    def _stream_response(self, http, reqs, display, chat):
+        """SSE stream. With n > 1 the choices stream SEQUENTIALLY in index
+        order (each chunk carries its index, which is all the protocol
+        requires); later choices decode concurrently and buffer while an
+        earlier one streams."""
         http.send_response(200)
         http.send_header("Content-Type", "text/event-stream")
         http.send_header("Cache-Control", "no-cache")
@@ -572,51 +616,49 @@ class EngineServer:
             http.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
             http.wfile.flush()
 
-        def on_delta(delta_text: str):
-            if chat:
-                choice = {
-                    "index": 0,
-                    "delta": {"content": delta_text},
-                    "finish_reason": None,
-                }
-                obj = {
+        def send_choice(choice: dict):
+            send_chunk(
+                {
                     "id": rid_s,
-                    "object": "chat.completion.chunk",
+                    "object": (
+                        "chat.completion.chunk" if chat else "text_completion"
+                    ),
                     "created": created,
                     "model": display,
                     "choices": [choice],
                 }
-            else:
-                obj = {
-                    "id": rid_s,
-                    "object": "text_completion",
-                    "created": created,
-                    "model": display,
-                    "choices": [
-                        {"index": 0, "text": delta_text, "finish_reason": None}
-                    ],
-                }
-            send_chunk(obj)
+            )
 
-        _text, finish, _n = self._collect(rid, sub, sp, on_delta=on_delta)
-        if finish == "timeout":
-            # Headers are already on the wire; the best we can do is a
-            # valid finish value on the final chunk.
-            finish = "length"
-        final_choice = (
-            {"index": 0, "delta": {}, "finish_reason": finish}
-            if chat
-            else {"index": 0, "text": "", "finish_reason": finish}
-        )
-        send_chunk(
-            {
-                "id": rid_s,
-                "object": "chat.completion.chunk" if chat else "text_completion",
-                "created": created,
-                "model": display,
-                "choices": [final_choice],
-            }
-        )
+        deadline = time.monotonic() + self.request_timeout
+        for i, (rid, sub, sp_i) in enumerate(reqs):
+
+            def on_delta(delta_text: str, _i=i):
+                if chat:
+                    send_choice(
+                        {
+                            "index": _i,
+                            "delta": {"content": delta_text},
+                            "finish_reason": None,
+                        }
+                    )
+                else:
+                    send_choice(
+                        {"index": _i, "text": delta_text,
+                         "finish_reason": None}
+                    )
+
+            _text, finish, _n = self._collect(
+                rid, sub, sp_i, on_delta=on_delta, deadline=deadline
+            )
+            if finish == "timeout":
+                # Headers are already on the wire; the best we can do is a
+                # valid finish value on the final chunk.
+                finish = "length"
+            send_choice(
+                {"index": i, "delta": {}, "finish_reason": finish}
+                if chat
+                else {"index": i, "text": "", "finish_reason": finish}
+            )
         done = b"data: [DONE]\n\n"
         http.wfile.write(f"{len(done):x}\r\n".encode() + done + b"\r\n")
         http.wfile.write(b"0\r\n\r\n")
