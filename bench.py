@@ -185,6 +185,33 @@ def _parse_args(argv=None):
         "paging wins)",
     )
     ap.add_argument(
+        "--measure", default="decode", choices=["decode", "prefill"],
+        help="what to measure: 'decode' = steady-state decode tok/s (the "
+        "headline); 'prefill' = admission throughput in prompt tok/s over "
+        "shared-prefix traffic — pair with/without --prefix-cache for the "
+        "on-chip APC A/B (requests share a prompt-len-sized system "
+        "prefix with small unique tails)",
+    )
+    ap.add_argument(
+        "--prefix-cache", action="store_true",
+        help="enable automatic prefix caching (implies a prefill chunk "
+        "of max(32, min(512, max-seq-len/4)) when --prefill-chunk unset)",
+    )
+    ap.add_argument(
+        "--prefill-chunk", type=int, default=0,
+        help="chunked prefill size (0 = whole-prompt bucketed prefill, "
+        "unless --prefix-cache implies one)",
+    )
+    ap.add_argument(
+        "--requests", type=int, default=0,
+        help="(--measure prefill) admissions to time; default 4x slots",
+    )
+    ap.add_argument(
+        "--page-size", type=int, default=64,
+        help="KV page size (full pages are the prefix-cache sharing "
+        "unit: a shared prefix shorter than one page can never hit)",
+    )
+    ap.add_argument(
         "--speculate", type=int, default=0,
         help="prompt-lookup speculative decoding window (0 = off)",
     )
@@ -249,6 +276,13 @@ def _child_main(args) -> None:
         # Two warm-up steps at a large chunk would consume smoke's whole
         # 48-token budget before the timed loop runs (0 tok/s).
         args.decode_chunk = min(args.decode_chunk, 4)
+        # Full pages are the prefix-cache sharing unit: the default
+        # 64-token page exceeds smoke's whole 16-token prefix, which
+        # would make a prefix_cache=on smoke line structurally unable
+        # to hit while still claiming to measure the cache.
+        args.page_size = min(args.page_size, 8)
+        if args.prefill_chunk > 0:
+            args.prefill_chunk = min(args.prefill_chunk, 8)
         model_name = "llama-tiny"
     elif args.model == "8b":
         cfg = llama_8b_cfg()
@@ -257,6 +291,17 @@ def _child_main(args) -> None:
         cfg = llama_1b_cfg()
         model_name = "llama-1b-class"
 
+    prefill_chunk = args.prefill_chunk
+    if prefill_chunk <= 0 and (
+        args.prefix_cache or args.measure == "prefill"
+    ):
+        # Chunk BOTH arms of a prefill A/B identically — the cache-off
+        # arm on whole-prompt prefill would conflate chunking overhead
+        # with cache benefit.
+        prefill_chunk = max(32, min(512, args.max_seq_len // 4))
+        if args.smoke:
+            prefill_chunk = 8
+    args.prefill_chunk = prefill_chunk
     params = llama.init_params(cfg)
     eng = Engine(
         "llama",
@@ -271,8 +316,14 @@ def _child_main(args) -> None:
             spec_adaptive=args.spec_adaptive == "on",
             quantization=args.quantization,
             decode_chunk=max(1, args.decode_chunk),
+            prefill_chunk=prefill_chunk,
+            prefix_cache=args.prefix_cache,
+            page_size=args.page_size,
         ),
     )
+
+    if args.measure == "prefill":
+        return _measure_prefill(args, eng, cfg, model_name, backend_note)
 
     rng = np.random.default_rng(0)
     gen_budget = args.max_seq_len - args.prompt_len
@@ -343,6 +394,81 @@ def _child_main(args) -> None:
         elif steps >= args.decode_steps:
             break
     emit(tokens, dt, partial=False)
+
+
+def _measure_prefill(args, eng, cfg, model_name, backend_note) -> None:
+    """Admission throughput over shared-prefix traffic: every request is
+    an args.prompt_len system prefix plus a small unique tail — the
+    serving shape CHWBL routes at a replica. With --prefix-cache the
+    engine prefills only the tails after the first admission; without it
+    every prompt pays the full prefill. Emits cumulative prompt-tok/s
+    lines per admission wave (watchdog-surviving, like decode mode)."""
+    import numpy as np
+
+    from kubeai_tpu.engine.sampling import SamplingParams
+
+    rng = np.random.default_rng(0)
+    system = rng.integers(0, cfg.vocab_size, args.prompt_len).tolist()
+    tail = 8
+    n_requests = args.requests or args.slots * 4
+    sp = SamplingParams(temperature=0.0, max_tokens=1)
+
+    # Warm-up: compile the prefill/chunk graphs, then (cache on) a
+    # SECOND request that registers-then-HITS the shared prefix so the
+    # hit-admission path (gather + suffix chunks) also compiles outside
+    # the timed region — the cache-on arm must not pay its compile
+    # inside the very number the A/B showcases.
+    warmups = 2 if args.prefix_cache else 1
+    for _ in range(warmups):
+        eng.add_request(
+            system + rng.integers(0, cfg.vocab_size, tail).tolist(), sp
+        )
+        while eng.has_work():
+            eng.step()
+    hit0 = eng.prefix_stats["hit_tokens"]
+    prompt0 = eng.prefix_stats["prompt_tokens"]
+
+    def emit(tokens: int, dt: float, partial: bool) -> None:
+        rate = tokens / dt if dt > 0 else 0.0
+        line = {
+            "metric": f"{model_name} prefill admission throughput, "
+            f"shared {args.prompt_len}-token prefix + {tail}-token tails, "
+            f"prefix_cache={'on' if args.prefix_cache else 'off'}, "
+            f"bs={args.slots}, {args.cache_mode} kv cache, "
+            f"chunk={args.prefill_chunk}, page={args.page_size}"
+            + (" (smoke)" if args.smoke else "") + backend_note,
+            "value": round(rate, 2),
+            "unit": "prompt tok/s",
+            # No reference baseline exists for admission throughput; the
+            # A/B partner run is the comparison.
+            "vs_baseline": 0,
+        }
+        if partial:
+            line["partial_window_s"] = round(dt, 2)
+        if args.prefix_cache:
+            # Timed-region deltas (the cumulative engine stats include
+            # the untimed warm-up admissions).
+            line["hit_tokens"] = eng.prefix_stats["hit_tokens"] - hit0
+            line["prompt_tokens"] = (
+                eng.prefix_stats["prompt_tokens"] - prompt0
+            )
+        print(json.dumps(line), flush=True)
+
+    t0 = time.perf_counter()
+    done_tokens = 0
+    submitted = 0
+    while submitted < n_requests:
+        wave = min(args.slots, n_requests - submitted)
+        for _ in range(wave):
+            eng.add_request(
+                system + rng.integers(0, cfg.vocab_size, tail).tolist(), sp
+            )
+        submitted += wave
+        while eng.has_work():
+            eng.step()
+        done_tokens += wave * (args.prompt_len + tail)
+        emit(done_tokens, time.perf_counter() - t0, partial=True)
+    emit(done_tokens, time.perf_counter() - t0, partial=False)
 
 
 def _result_line(args, eng, model_name, backend_note, toks_per_s, baseline):
@@ -470,7 +596,13 @@ def _tpu_ladder(argv: list[str], args) -> dict | None:
             return None
         print(f"bench: attempting {label} (watchdog {wd:.0f}s)",
               file=sys.stderr, flush=True)
-        r = _run_measurement([*argv, *extra], wd)
+        base = argv
+        if "slot" in extra:
+            # prefix_cache requires the paged cache; a slot-cache rung
+            # keeping the flag would fail at Engine init every time
+            # instead of giving the ladder its cache-free answer.
+            base = [a for a in argv if a != "--prefix-cache"]
+        r = _run_measurement([*base, *extra], wd)
         ok = r is not None and r.get("value", 0) > 0
         print(f"bench: {label} -> "
               + (f"{r['value']} {r.get('unit', '')}" if ok else "FAILED"),

@@ -94,3 +94,29 @@ def test_child_emits_interim_then_final_lines():
     assert lines[-1]["value"] > 0
     # The parent's parser lands on the final (authoritative) line.
     assert bench._parse_result(out.stdout)["value"] == lines[-1]["value"]
+
+
+@pytest.mark.slow
+def test_prefill_measure_mode_reports_cache_ab():
+    """--measure prefill: admission throughput over shared-prefix
+    traffic, with hit accounting when the cache is on — the on-chip APC
+    A/B tool."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    base = [
+        sys.executable, os.path.join(REPO, "bench.py"),
+        "--child", "--smoke", "--cpu", "--measure", "prefill",
+        "--page-size", "8", "--prefill-chunk", "8",
+    ]
+    out = subprocess.run(
+        base + ["--prefix-cache"], capture_output=True, text=True,
+        timeout=300, env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(l) for l in out.stdout.splitlines()
+             if l.strip().startswith("{")]
+    final = lines[-1]
+    assert final["unit"] == "prompt tok/s"
+    assert final["value"] > 0
+    assert final["hit_tokens"] > 0  # shared prefix actually hit
+    assert "partial_window_s" not in final
+    assert "partial_window_s" in lines[0]  # watchdog-surviving interims
