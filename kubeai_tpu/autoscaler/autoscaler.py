@@ -14,18 +14,29 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 import urllib.request
 
 from kubeai_tpu.autoscaler.leader import LeaderElection
 from kubeai_tpu.autoscaler.movingaverage import SimpleMovingAverage
 from kubeai_tpu.config import System
 from kubeai_tpu.crd.model import Model
-from kubeai_tpu.metrics.registry import parse_prometheus_text
+from kubeai_tpu.metrics import tracing
+from kubeai_tpu.metrics.registry import (
+    DEFAULT_METRICS,
+    Metrics,
+    parse_prometheus_text,
+)
 from kubeai_tpu.operator.k8s.store import KubeStore, NotFound
 from kubeai_tpu.routing.loadbalancer import LoadBalancer
 from kubeai_tpu.routing.modelclient import ModelClient
 
 logger = logging.getLogger(__name__)
+
+# One structured JSON record per (tick, model): the autoscaler's decision
+# trail. Ship this logger to your aggregator to answer "why did model X
+# scale at 14:03" without replaying metrics.
+decision_log = logging.getLogger("kubeai.autoscaler.decisions")
 
 ACTIVE_METRIC = "kubeai_inference_requests_active"
 
@@ -61,6 +72,7 @@ class Autoscaler:
         lb: LoadBalancer,
         leader: LeaderElection,
         namespace: str = "default",
+        metrics: Metrics = DEFAULT_METRICS,
     ):
         self.store = store
         self.cfg = cfg
@@ -68,6 +80,10 @@ class Autoscaler:
         self.lb = lb
         self.leader = leader
         self.namespace = namespace
+        self.metrics = metrics
+        # Most recent tick's decision records (one dict per model) — the
+        # in-process view of what decision_log just emitted.
+        self.last_decisions: list[dict] = []
         self.interval = cfg.model_autoscaling.interval_seconds
         self.window_count = cfg.model_autoscaling.average_window_count
         self._averages: dict[str, SimpleMovingAverage] = {}
@@ -114,24 +130,64 @@ class Autoscaler:
         addrs = self._self_metric_addrs()
         if not addrs:
             return
-        totals = scrape_active_requests(addrs)
+        with tracing.tracer().start_span(
+            "autoscaler.tick", kind=tracing.KIND_INTERNAL
+        ) as span:
+            t0 = time.monotonic()
+            totals = scrape_active_requests(addrs)
+            scrape_s = time.monotonic() - t0
+            # The scrape duration lands in the histogram AND on the tick
+            # span — traces and metrics must tell the same story.
+            self.metrics.autoscaler_scrape_duration.observe(scrape_s)
+            span.set_attribute("scrape.duration_s", scrape_s)
+            span.set_attribute("scrape.replicas", len(addrs))
+            span.set_attribute("models", len(models))
 
-        next_averages: dict[str, SimpleMovingAverage] = {}
-        for model in models:
-            if model.spec.autoscaling_disabled:
-                continue
-            active = totals.get(model.name, 0.0)
-            avg_tracker = self._avg_for(model.name)
-            avg = avg_tracker.next(active)
-            next_averages[model.name] = avg_tracker
-            desired = -(-avg // model.spec.target_requests)  # ceil
-            self.model_client.scale(model.name, int(desired))
+            decisions: list[dict] = []
+            next_averages: dict[str, SimpleMovingAverage] = {}
+            for model in models:
+                if model.spec.autoscaling_disabled:
+                    continue
+                active = totals.get(model.name, 0.0)
+                avg_tracker = self._avg_for(model.name)
+                avg = avg_tracker.next(active)
+                next_averages[model.name] = avg_tracker
+                desired = int(-(-avg // model.spec.target_requests))  # ceil
+                applied = self.model_client.scale(model.name, desired)
+                votes = self.model_client.consecutive_scale_downs(model.name)
+                record = {
+                    "ts": time.time(),
+                    "model": model.name,
+                    "signal": active,
+                    "average": avg,
+                    "target_requests": model.spec.target_requests,
+                    "computed_replicas": desired,
+                    "applied_replicas": applied,
+                    "scale_down_votes": votes,
+                    "scrape_duration_s": scrape_s,
+                    "scraped_replicas": len(addrs),
+                }
+                decisions.append(record)
+                decision_log.info(json.dumps(record, sort_keys=True))
+                self.metrics.autoscaler_signal.set(active, model=model.name)
+                self.metrics.autoscaler_average.set(avg, model=model.name)
+                self.metrics.autoscaler_desired_replicas.set(
+                    desired, model=model.name
+                )
+                self.metrics.autoscaler_applied_replicas.set(
+                    applied, model=model.name
+                )
+                self.metrics.autoscaler_scale_down_votes.set(
+                    votes, model=model.name
+                )
+            self.last_decisions = decisions
+            self.metrics.autoscaler_ticks.inc()
 
-        # Keep state only for models that still exist — deleted models'
-        # averages must not accumulate in memory or the state ConfigMap
-        # (reference: autoscaler.go:115,159-163 rebuilds state per tick).
-        self._averages = next_averages
-        self._save_state()
+            # Keep state only for models that still exist — deleted models'
+            # averages must not accumulate in memory or the state ConfigMap
+            # (reference: autoscaler.go:115,159-163 rebuilds state per tick).
+            self._averages = next_averages
+            self._save_state()
 
     def _self_metric_addrs(self) -> list[str]:
         if self.cfg.fixed_self_metric_addrs:

@@ -42,6 +42,13 @@ from kubeai_tpu.parallel import sharding as psh
 from kubeai_tpu.parallel.mesh import single_device_mesh
 
 
+def _now() -> float:
+    """Monotonic clock behind the engine's latency telemetry (queue-wait,
+    prefill, TTFT, ITL, e2e). A module-level hook so fake-clock tests can
+    monkeypatch ONE symbol and get deterministic timings."""
+    return time.monotonic()
+
+
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
     num_slots: int = 8
@@ -196,6 +203,13 @@ class _Request:
     ctx_len: int = 0
     ngram_idx: Any = None  # {n: {ngram tuple -> last start index}}
     ngram_upto: Any = None  # {n: window starts indexed so far}
+    # Lifecycle timestamps (_now() clock) for the latency telemetry the
+    # serve loop drains into histograms. t_enqueue doubles as the "e2e not
+    # yet recorded" flag (zeroed after recording); t_admit_start survives
+    # preemption so a resumed request keeps its ORIGINAL queue-wait.
+    t_enqueue: float = 0.0
+    t_admit_start: float = 0.0
+    t_prev_token: float = 0.0
 
 
 class Engine:
@@ -254,6 +268,16 @@ class Engine:
         # run answers "did the proposer earn its keep" — the draft's
         # whole point vs prompt-lookup on non-repetitive text.
         self.spec_stats = {"windows": 0, "proposed": 0, "accepted": 0}
+        # Request-lifecycle latency observations, (kind, seconds) with
+        # kind ∈ {queue_wait, prefill, ttft, itl, e2e}. The serve loop
+        # drains these into histograms (drain_timing) — the engine core
+        # never touches a metrics registry, so the hot loop stays free of
+        # registry locks.
+        self._timing: list[tuple[str, float]] = []
+        # Snapshot of the most recent step() for per-decode-step gauges:
+        # running batch size, waiting-queue depth, tokens emitted, wall
+        # duration.
+        self.last_step_stats: dict[str, float] = {}
 
         # Resolve the cache mode: paged needs family support; otherwise
         # fall back to the slot cache. Chunked prefill works in both modes
@@ -341,6 +365,7 @@ class Engine:
             from kubeai_tpu.engine.paged_cache import PageAllocator, PagedKVCache
 
             n_pages = cfg.effective_num_pages()
+            self._n_pages = n_pages
             max_pages = -(-cfg.max_seq_len // cfg.page_size)
             # Pages replicated across dp (page ids are global); KV heads on
             # tp exactly like the slot cache; the layer axis shards over
@@ -1234,6 +1259,7 @@ class Engine:
                 seed=seed,
                 adapter_idx=adapter_idx,
                 stop_token_ids=self.eos_token_ids,
+                t_enqueue=_now(),
             )
             self._requests[rid] = req
             if on_admit is not None:
@@ -1256,11 +1282,45 @@ class Engine:
     def num_pending(self) -> int:
         return len(self._pending)
 
+    def drain_timing(self) -> list[tuple[str, float]]:
+        """Pop the accumulated latency observations: (kind, seconds) with
+        kind ∈ {queue_wait, prefill, ttft, itl, e2e}. The serve loop (and
+        the /metrics scrape) observes these into the server's histograms;
+        draining transfers ownership so each record lands exactly once."""
+        with self._lock:
+            out, self._timing = self._timing, []
+        return out
+
+    def kv_utilization(self) -> float:
+        """Fraction of KV-cache capacity in use: allocated pages over the
+        pool (paged mode) or occupied token positions over total slot
+        capacity (slot mode). Pages parked idle in the prefix cache count
+        as free — they are reclaimable by any admission."""
+        if self.cache_mode == "paged":
+            total = self._n_pages - 1  # page 0 is reserved scratch
+            if total <= 0:
+                return 0.0
+            return 1.0 - self._alloc.free_pages / total
+        cap = self.cfg.num_slots * self.cfg.max_seq_len
+        if cap <= 0:
+            return 0.0
+        return sum(r.position for r in self._active.values()) / cap
+
     def _bucket(self, n: int) -> int:
         for b in self.cfg.buckets():
             if n <= b:
                 return b
         return self.cfg.max_seq_len
+
+    def _pop_pending(self) -> _Request:
+        """Dequeue the head request for admission, stamping the moment it
+        left the queue (queue-wait = this minus t_enqueue; prefill = first
+        token minus this). A preempted request keeps its original stamp —
+        its re-prefill is recompute, not a second queue wait."""
+        req = self._pending.popleft()
+        if not req.t_admit_start:
+            req.t_admit_start = _now()
+        return req
 
     def _admit_pending(self) -> list[StepEvent]:
         """Prefill pending requests into free slots. Returns emitted tokens."""
@@ -1275,7 +1335,7 @@ class Engine:
             resumed = False
             seq = req.prompt
             plen = len(seq)
-            self._pending.popleft()
+            self._pop_pending()
             self._free_slots.pop()
             req.slot = slot
             C = self.cfg.prefill_chunk
@@ -1399,7 +1459,7 @@ class Engine:
                     pages = self._alloc.ensure(slot, plen)
                 except OutOfPages:
                     break  # defer; ensure() rolled back
-                self._pending.popleft()
+                self._pop_pending()
                 self._free_slots.pop()
                 req.slot = slot
                 self._set_bt_row(slot, pages)
@@ -1413,7 +1473,7 @@ class Engine:
                 except OutOfPages:
                     self._alloc.unadopt(slot)
                     break  # defer; nothing held
-                self._pending.popleft()
+                self._pop_pending()
                 self._free_slots.pop()
                 req.slot = slot
                 self._set_bt_row(slot, pages)
@@ -1431,7 +1491,7 @@ class Engine:
                     pages = self._alloc.ensure(slot, plen)
                 except OutOfPages:
                     break  # defer; ensure() rolled back
-                self._pending.popleft()
+                self._pop_pending()
                 self._free_slots.pop()
                 req.slot = slot
                 self._set_bt_row(slot, pages)
@@ -1708,6 +1768,16 @@ class Engine:
             req.last_token = tok
             self._active[slot] = req
             return None
+        # First token of a fresh admission: the whole front half of the
+        # request lifecycle resolves here — queue wait (enqueue → dequeue),
+        # prefill (dequeue → first token), TTFT (enqueue → first token).
+        now = _now()
+        self._timing.append(
+            ("queue_wait", max(0.0, req.t_admit_start - req.t_enqueue))
+        )
+        self._timing.append(("prefill", max(0.0, now - req.t_admit_start)))
+        self._timing.append(("ttft", max(0.0, now - req.t_enqueue)))
+        req.t_prev_token = now
         req.out_tokens.append(tok)
         req.position = plen
         req.last_token = tok
@@ -1851,6 +1921,13 @@ class Engine:
         self._pending.appendleft(victim)
 
     def _release(self, req: _Request) -> None:
+        # Completed requests (not cancellations — a disconnect says
+        # nothing about generation latency) record their e2e duration.
+        # t_enqueue doubles as the once-only flag: cancel() then a
+        # resumed-done _finish_admission both land here.
+        if req.finish_reason in ("stop", "length") and req.t_enqueue:
+            self._timing.append(("e2e", max(0.0, _now() - req.t_enqueue)))
+            req.t_enqueue = 0.0
         # A preempted request can finish (stop/cancel) while waiting in
         # the pending queue — drop it there too, or re-admission would
         # resurrect a done request that leaks its slot and pages forever.
@@ -2041,6 +2118,15 @@ class Engine:
                     self._spec_observe(
                         decode_mode, len(evs), time.perf_counter() - t0
                     )
+            # Per-decode-step snapshot for the serve loop's gauges. Plain
+            # attribute write (already under the engine lock): the metrics
+            # registry is never touched from this hot path.
+            self.last_step_stats = {
+                "batch_size": len(self._active),
+                "waiting": len(self._pending),
+                "tokens": len(emitted),
+                "duration_s": time.perf_counter() - t0,
+            }
             return emitted
 
     def _process_chunk(self, inflight: tuple) -> list[StepEvent]:
@@ -2050,10 +2136,19 @@ class Engine:
         toks_seq = np.asarray(jax.device_get(toks_seq))  # [chunk, B]
         emitted: list[StepEvent] = []
         for k in range(toks_seq.shape[0]):
+            # One timestamp per fused decode step: its tokens became
+            # host-visible together, so intra-step ITL is genuinely ~0 and
+            # the first token after a chunk boundary carries the gap.
+            now = _now()
             for slot, req in chunk_slots:
                 if req.done:
                     continue  # surplus chunk tokens discarded
                 tok = int(toks_seq[k, slot])
+                if req.t_prev_token:
+                    self._timing.append(
+                        ("itl", max(0.0, now - req.t_prev_token))
+                    )
+                req.t_prev_token = now
                 req.out_tokens.append(tok)
                 req.position += 1
                 req.last_token = tok
@@ -2073,6 +2168,7 @@ class Engine:
         choices = np.asarray(jax.device_get(choices))  # [B, γ+1]
         n_emit = np.asarray(jax.device_get(n_emit))  # [B]
         emitted: list[StepEvent] = []
+        now = _now()  # one verify forward produced the whole window
         for slot, req in chunk_slots:
             if req.done:
                 continue
@@ -2081,6 +2177,11 @@ class Engine:
             self.spec_stats["accepted"] += int(n_emit[slot]) - 1
             for j in range(int(n_emit[slot])):
                 tok = int(choices[slot, j])
+                if req.t_prev_token:
+                    self._timing.append(
+                        ("itl", max(0.0, now - req.t_prev_token))
+                    )
+                req.t_prev_token = now
                 req.out_tokens.append(tok)
                 req.position += 1
                 req.last_token = tok

@@ -36,10 +36,24 @@ from kubeai_tpu.engine.engine import Engine, EngineConfig
 from kubeai_tpu.engine.sampling import SamplingParams
 from kubeai_tpu.metrics import tracing
 from kubeai_tpu.engine.tokenizer import Tokenizer, load_tokenizer
-from kubeai_tpu.metrics.registry import Counter, Gauge, Registry
+from kubeai_tpu.metrics.registry import Counter, Gauge, Histogram, Registry
 
 logger = logging.getLogger(__name__)
 
+
+# Request-phase latencies: cover sub-ms tiny-model CPU tests through the
+# 600s request budget.
+REQUEST_LATENCY_BUCKETS_S = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+)
+# Inter-token gaps sit orders of magnitude below request latencies —
+# fused decode chunks emit most tokens ~0 apart, chunk boundaries land in
+# the ms range, and anything past 2.5s is a stall worth seeing.
+ITL_BUCKETS_S = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
 
 
 class EngineMetrics:
@@ -93,11 +107,87 @@ class EngineMetrics:
             "Prompt tokens seen by prefix-cache admission.",
             self.registry,
         )
+        # -- request-lifecycle latency histograms --------------------------
+        self.queue_wait = Histogram(
+            "kubeai_engine_queue_wait_seconds",
+            "Time a request waited in the pending queue before its "
+            "prefill was dispatched.",
+            self.registry,
+            buckets=REQUEST_LATENCY_BUCKETS_S,
+        )
+        self.prefill = Histogram(
+            "kubeai_engine_prefill_seconds",
+            "Prefill dispatch to first sampled token (compute only; "
+            "queue wait excluded).",
+            self.registry,
+            buckets=REQUEST_LATENCY_BUCKETS_S,
+        )
+        self.ttft = Histogram(
+            "kubeai_engine_ttft_seconds",
+            "Engine time-to-first-token: request enqueue to first sampled "
+            "token (queue wait + prefill).",
+            self.registry,
+            buckets=REQUEST_LATENCY_BUCKETS_S,
+        )
+        self.itl = Histogram(
+            "kubeai_engine_inter_token_latency_seconds",
+            "Gap between consecutive emitted tokens of one request. "
+            "Tokens inside one fused decode chunk surface together, so "
+            "the distribution is bimodal: ~0 intra-chunk, the device-step "
+            "time at chunk boundaries.",
+            self.registry,
+            buckets=ITL_BUCKETS_S,
+        )
+        self.e2e = Histogram(
+            "kubeai_engine_e2e_seconds",
+            "Request enqueue to final token for completed (stop/length) "
+            "requests; cancellations are excluded.",
+            self.registry,
+            buckets=REQUEST_LATENCY_BUCKETS_S,
+        )
+        self._timing_hist = {
+            "queue_wait": self.queue_wait,
+            "prefill": self.prefill,
+            "ttft": self.ttft,
+            "itl": self.itl,
+            "e2e": self.e2e,
+        }
+        # -- per-decode-step engine-loop gauges ----------------------------
+        self.batch_size = Gauge(
+            "kubeai_engine_batch_size",
+            "Running batch size (occupied decode slots) at the last "
+            "engine step.",
+            self.registry,
+        )
+        self.kv_utilization = Gauge(
+            "kubeai_engine_kv_cache_utilization",
+            "Fraction of KV-cache capacity in use (pages allocated / "
+            "pool, or token positions / slot capacity).",
+            self.registry,
+        )
+        self.tokens_per_step = Gauge(
+            "kubeai_engine_tokens_per_step",
+            "Tokens emitted by the last engine step (all requests).",
+            self.registry,
+        )
+        self.step_duration = Gauge(
+            "kubeai_engine_step_duration_seconds",
+            "Wall duration of the last engine step's decode dispatch + "
+            "fetch.",
+            self.registry,
+        )
+
+    def observe_timing(self, kind: str, seconds: float) -> None:
+        h = self._timing_hist.get(kind)
+        if h is not None:
+            h.observe(seconds)
 
     def sync_engine(self, engine) -> None:
-        """Snapshot engine serving state at scrape time (the engine owns
-        these counters; re-plumbing every step through the metrics would
-        couple the hot loop to the registry lock)."""
+        """Snapshot engine serving state (the engine owns these counters;
+        it records plain host-side values and this method moves them into
+        the registry). Called from the serve loop after each step AND at
+        /metrics scrape time, so the histograms are current even when the
+        loop has gone idle."""
         snap = engine_state_snapshot(engine)
         self.slots_active.set(snap["slots_active"])
         self.requests_pending.set(snap["requests_pending"])
@@ -109,6 +199,17 @@ class EngineMetrics:
         if pstats:
             self.prefix_hit_tokens.set(pstats["hit_tokens"])
             self.prefix_prompt_tokens.set(pstats["prompt_tokens"])
+        inner = getattr(engine, "inner", engine)  # LockstepEngine proxies
+        drain = getattr(inner, "drain_timing", None)
+        if drain is not None:
+            for kind, seconds in drain():
+                self.observe_timing(kind, seconds)
+        step_stats = snap["last_step"]
+        if step_stats:
+            self.batch_size.set(step_stats.get("batch_size", 0))
+            self.tokens_per_step.set(step_stats.get("tokens", 0))
+            self.step_duration.set(step_stats.get("duration_s", 0.0))
+        self.kv_utilization.set(snap["kv_utilization"])
 
 
 def engine_state_snapshot(engine) -> dict:
@@ -117,9 +218,12 @@ def engine_state_snapshot(engine) -> dict:
     adds buffered for the next broadcast — the same counts admission
     uses); spec/prefix stats live only on the inner engine."""
     inner = getattr(engine, "inner", engine)  # LockstepEngine proxies
+    kvu = getattr(inner, "kv_utilization", None)
     return {
         "slots_active": engine.num_active,
         "requests_pending": engine.num_pending,
+        "kv_utilization": kvu() if kvu is not None else 0.0,
+        "last_step": dict(getattr(inner, "last_step_stats", {}) or {}),
         "spec_stats": dict(getattr(inner, "spec_stats", {}) or {}),
         "prefix_stats": dict(getattr(inner, "prefix_stats", {}) or {}),
     }
@@ -231,14 +335,21 @@ class EngineServer:
                     )
                 # Continue the trace the operator's proxy started (W3C
                 # traceparent), so one trace spans front door → engine.
+                # The propagated X-Request-Id lands on the span: one id
+                # follows the request front door → proxy attempt → engine.
+                attrs = {"http.route": path}
+                req_id = self.headers.get("X-Request-Id")
+                if req_id:
+                    attrs["request.id"] = req_id
                 span = tracing.tracer().start_span(
                     f"engine {path}",
                     parent=tracing.parse_traceparent(
                         self.headers.get("traceparent")
                     ),
                     kind=tracing.KIND_SERVER,
-                    attributes={"http.route": path},
+                    attributes=attrs,
                 )
+                self.current_span = span
                 self._last_status = 200
                 try:
                     try:
@@ -322,6 +433,11 @@ class EngineServer:
                         q = self._subscribers.get(ev.rid)
                     if q is not None:
                         q.put(ev)
+                # Per-decode-step telemetry: drain the engine's latency
+                # records into histograms and refresh the occupancy/KV
+                # gauges while they are live (a scrape between steps then
+                # sees the batch as it ran, not as it idles).
+                self.metrics.sync_engine(self.engine)
                 self._last_progress = time.time()
             except Exception:
                 # A dead serving loop must flip /health so the liveness
@@ -473,12 +589,21 @@ class EngineServer:
         self.metrics.active_requests.inc()
         self.metrics.prompt_tokens.inc(len(prompt_ids) * n)
         self._work.set()
+        t0 = time.monotonic()
+        span = getattr(http, "current_span", None)
         try:
             if stream:
-                self._stream_response(http, reqs, display, chat)
+                self._stream_response(http, reqs, display, chat, t0=t0,
+                                      span=span)
             else:
                 self._unary_response(http, reqs, display, chat, len(prompt_ids))
         finally:
+            # The duration the TTFT/e2e histograms see must also be
+            # readable off the trace — spans and metrics have to agree.
+            if span is not None and not span.end_ns:
+                span.set_attribute(
+                    "request.duration_s", time.monotonic() - t0
+                )
             # Client gone / handler done: release the batch slots if any
             # request is still decoding (no-op after normal completion).
             for rid_i, _, _ in reqs:
@@ -598,7 +723,7 @@ class EngineServer:
         }
         http._json(200, payload)
 
-    def _stream_response(self, http, reqs, display, chat):
+    def _stream_response(self, http, reqs, display, chat, t0=None, span=None):
         """SSE stream. With n > 1 the choices stream SEQUENTIALLY in index
         order (each chunk carries its index, which is all the protocol
         requires); later choices decode concurrently and buffer while an
@@ -630,9 +755,16 @@ class EngineServer:
             )
 
         deadline = time.monotonic() + self.request_timeout
+        ttft_seen = [False]
         for i, (rid, sub, sp_i) in enumerate(reqs):
 
             def on_delta(delta_text: str, _i=i):
+                if not ttft_seen[0]:
+                    ttft_seen[0] = True
+                    if span is not None and t0 is not None:
+                        span.set_attribute(
+                            "request.ttft_s", time.monotonic() - t0
+                        )
                 if chat:
                     send_choice(
                         {

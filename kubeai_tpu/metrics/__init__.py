@@ -18,4 +18,7 @@ from kubeai_tpu.metrics.registry import (
     INFERENCE_REQUESTS_TOTAL,
     CHWBL_LOOKUPS,
     CHWBL_DISPLACEMENTS,
+    LATENCY_BUCKETS_S,
+    lint_registry,
+    parse_prometheus_text,
 )

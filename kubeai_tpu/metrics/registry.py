@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import re
 import threading
 from collections import defaultdict
 
@@ -14,6 +15,24 @@ def _fmt_labels(labels: dict[str, str]) -> str:
         for k, v in sorted(labels.items())
     )
     return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    """Exposition value: integral floats render bare (`25`, not `25.0`);
+    everything else uses repr's shortest round-trip form so large counters
+    survive expose() → parse (the %g default truncates past 6 digits)."""
+    if v == int(v) and abs(v) < 2**53:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _fmt_le(bound: float) -> str:
+    """Canonical `le` label value: `%g`-style (`0.005`, `1`, `+Inf`) so
+    int and float bucket bounds render identically."""
+    b = float(bound)
+    if b == float("inf"):
+        return "+Inf"
+    return f"{b:g}"
 
 
 class _Metric:
@@ -45,7 +64,8 @@ class _Metric:
                 lines.append(f"{self.name} 0")
             for k, v in sorted(self._values.items()):
                 lines.append(
-                    f"{self.name}{_fmt_labels(self._label_keys[k])} {v:g}"
+                    f"{self.name}{_fmt_labels(self._label_keys[k])} "
+                    f"{_fmt_value(v)}"
                 )
             return lines
 
@@ -89,11 +109,27 @@ class Histogram(_Metric):
             k = self._key(labels)
             if k not in self._bucket_counts:
                 self._bucket_counts[k] = [0] * len(self.buckets)
+            # Per-bucket (non-cumulative) counts: only the first bucket
+            # that fits increments; collect() produces the cumulative
+            # `le` series. Incrementing every bucket >= value here would
+            # double-cumulate at collect time.
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     self._bucket_counts[k][i] += 1
+                    break
             self._sums[k] += value
             self._counts[k] += 1
+
+    def get(self, **labels) -> float:
+        """Observation COUNT for the label set (the scalar `_Metric.get`
+        would silently read the unused `_values` dict and always say 0)."""
+        with self._lock:
+            return float(self._counts.get(tuple(sorted(labels.items())), 0))
+
+    def sum_for(self, **labels) -> float:
+        """Sum of observed values for the label set."""
+        with self._lock:
+            return float(self._sums.get(tuple(sorted(labels.items())), 0.0))
 
     def collect(self) -> list[str]:
         with self._lock:
@@ -107,13 +143,20 @@ class Histogram(_Metric):
                 for i, b in enumerate(self.buckets):
                     cum += self._bucket_counts[k][i]
                     lines.append(
-                        f"{self.name}_bucket{_fmt_labels({**labels, 'le': b})} {cum}"
+                        f"{self.name}_bucket"
+                        f"{_fmt_labels({**labels, 'le': _fmt_le(b)})} {cum}"
                     )
                 lines.append(
-                    f"{self.name}_bucket{_fmt_labels({**labels, 'le': '+Inf'})} {self._counts[k]}"
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels({**labels, 'le': '+Inf'})} {self._counts[k]}"
                 )
-                lines.append(f"{self.name}_sum{_fmt_labels(labels)} {self._sums[k]:g}")
-                lines.append(f"{self.name}_count{_fmt_labels(labels)} {self._counts[k]}")
+                lines.append(
+                    f"{self.name}_sum{_fmt_labels(labels)} "
+                    f"{_fmt_value(self._sums[k])}"
+                )
+                lines.append(
+                    f"{self.name}_count{_fmt_labels(labels)} {self._counts[k]}"
+                )
             return lines
 
 
@@ -126,6 +169,11 @@ class Registry:
         with self._lock:
             self._metrics.append(m)
 
+    @property
+    def metrics(self) -> list[_Metric]:
+        with self._lock:
+            return list(self._metrics)
+
     def expose(self) -> str:
         out: list[str] = []
         with self._lock:
@@ -133,6 +181,42 @@ class Registry:
         for m in metrics:
             out.extend(m.collect())
         return "\n".join(out) + "\n"
+
+
+_METRIC_NAME_RE = re.compile(r"^kubeai_[a-z0-9_]+$")
+
+
+def lint_registry(registry: Registry) -> list[str]:
+    """Metric-name hygiene for one registry: names match
+    `^kubeai_[a-z0-9_]+$` and are unique, counters end in `_total`,
+    histograms in `_seconds`. Returns human-readable violations (empty =
+    clean); a unit test walks every instrument bundle through this so new
+    instruments can't silently drift from the naming scheme."""
+    errors: list[str] = []
+    seen: set[str] = set()
+    for m in registry.metrics:
+        if not _METRIC_NAME_RE.match(m.name):
+            errors.append(
+                f"{m.name}: does not match ^kubeai_[a-z0-9_]+$"
+            )
+        if m.name in seen:
+            errors.append(f"{m.name}: duplicate metric name in registry")
+        seen.add(m.name)
+        if isinstance(m, Histogram):
+            if not m.name.endswith("_seconds"):
+                errors.append(f"{m.name}: histogram must end in _seconds")
+        elif isinstance(m, Counter):
+            if not m.name.endswith("_total"):
+                errors.append(f"{m.name}: counter must end in _total")
+    return errors
+
+
+# Request-latency buckets: sub-ms (cache hits, tiny models) through the
+# proxy's 600s request budget — an LLM completion legitimately runs minutes.
+LATENCY_BUCKETS_S = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+)
 
 
 class Metrics:
@@ -163,6 +247,72 @@ class Metrics:
         self.chwbl_displacements = Counter(
             "kubeai_chwbl_displacements_total",
             "CHWBL lookups displaced past the hashed endpoint by the bounded-load rule.",
+            self.registry,
+        )
+        # -- front-door request lifecycle (per model) ----------------------
+        self.request_duration = Histogram(
+            "kubeai_inference_request_duration_seconds",
+            "End-to-end front-door request duration per model (receipt to "
+            "last body byte).",
+            self.registry,
+            buckets=LATENCY_BUCKETS_S,
+        )
+        self.request_ttft = Histogram(
+            "kubeai_inference_ttft_seconds",
+            "Time from front-door receipt to the first response body chunk "
+            "per model (streaming time-to-first-token).",
+            self.registry,
+            buckets=LATENCY_BUCKETS_S,
+        )
+        self.proxy_attempts = Counter(
+            "kubeai_proxy_attempts_total",
+            "Proxy attempts per model (retries make this exceed requests).",
+            self.registry,
+        )
+        self.proxy_retries = Counter(
+            "kubeai_proxy_retries_total",
+            "Proxy attempts that failed and were retried on another "
+            "endpoint, per model.",
+            self.registry,
+        )
+        # -- autoscaler decision telemetry ---------------------------------
+        self.autoscaler_ticks = Counter(
+            "kubeai_autoscaler_ticks_total",
+            "Completed autoscaler ticks on this replica (leader only).",
+            self.registry,
+        )
+        self.autoscaler_scrape_duration = Histogram(
+            "kubeai_autoscaler_scrape_duration_seconds",
+            "Wall time of one tick's metrics scrape across all operator "
+            "replicas.",
+            self.registry,
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0),
+        )
+        self.autoscaler_signal = Gauge(
+            "kubeai_autoscaler_active_requests",
+            "Aggregated active-request signal per model at the last tick.",
+            self.registry,
+        )
+        self.autoscaler_average = Gauge(
+            "kubeai_autoscaler_average_active_requests",
+            "Moving average of the active-request signal per model.",
+            self.registry,
+        )
+        self.autoscaler_desired_replicas = Gauge(
+            "kubeai_autoscaler_desired_replicas",
+            "Replicas computed from the moving average (before hysteresis "
+            "and min/max clamping).",
+            self.registry,
+        )
+        self.autoscaler_applied_replicas = Gauge(
+            "kubeai_autoscaler_applied_replicas",
+            "Replicas actually applied to the Model spec at the last tick.",
+            self.registry,
+        )
+        self.autoscaler_scale_down_votes = Gauge(
+            "kubeai_autoscaler_consecutive_scale_downs",
+            "Consecutive scale-down votes pending per model (hysteresis "
+            "state; resets on apply or on any non-down tick).",
             self.registry,
         )
 
@@ -197,7 +347,7 @@ def parse_prometheus_text(text: str) -> dict[tuple[str, tuple], float]:
                 if "=" not in pair:
                     continue
                 k, v = pair.split("=", 1)
-                labels.append((k, v.strip('"')))
+                labels.append((k, _unquote_label_value(v)))
             out[(name, tuple(sorted(labels)))] = value
         else:
             out[(name_part, ())] = value
@@ -205,9 +355,20 @@ def parse_prometheus_text(text: str) -> dict[tuple[str, tuple], float]:
 
 
 def _split_label_pairs(s: str) -> list[str]:
-    pairs, cur, in_q = [], "", False
+    """Split `k1="v1",k2="v2"` on commas outside quoted values. Tracks
+    the backslash escape state: an escaped quote (`\\"`) inside a value —
+    which `_fmt_labels`'s own escaping produces — must NOT toggle the
+    in-quotes flag, or every value containing a quote fails to
+    round-trip through `parse_prometheus_text`."""
+    pairs, cur, in_q, esc = [], "", False, False
     for ch in s:
-        if ch == '"':
+        if esc:
+            cur += ch
+            esc = False
+        elif ch == "\\" and in_q:
+            cur += ch
+            esc = True
+        elif ch == '"':
             in_q = not in_q
             cur += ch
         elif ch == "," and not in_q:
@@ -218,3 +379,21 @@ def _split_label_pairs(s: str) -> list[str]:
     if cur:
         pairs.append(cur)
     return pairs
+
+
+def _unquote_label_value(v: str) -> str:
+    """Strip one layer of quotes and undo exposition-format escaping
+    (`\\\\` → `\\`, `\\"` → `"`, `\\n` → newline)."""
+    if len(v) >= 2 and v[0] == '"' and v[-1] == '"':
+        v = v[1:-1]
+    out, i = [], 0
+    while i < len(v):
+        ch = v[i]
+        if ch == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append("\n" if nxt == "n" else nxt)
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)

@@ -225,6 +225,12 @@ class Tracer:
         for batch in iter(self._drain, []):  # final flush
             self._send(batch)
 
+    def _ack(self, batch: list[Span]) -> None:
+        """Mark drained spans done on the queue's task counter — what
+        flush() joins on."""
+        for _ in batch:
+            self._q.task_done()
+
     def _payload(self, batch: list[Span]) -> dict:
         return {
             "resourceSpans": [{
@@ -278,15 +284,31 @@ class Tracer:
             if now - self._err_logged > 60:  # throttle
                 self._err_logged = now
                 logger.warning("OTLP trace export failed: %s", e)
+        finally:
+            self._ack(batch)
 
     def flush(self, timeout_s: float = 5.0) -> None:
-        """Push buffered spans out now (tests, shutdown)."""
-        if not self._thread:
+        """Push buffered spans out now (tests, shutdown).
+
+        Returns immediately when no exporter thread is alive — nothing
+        will ever drain the queue, so spinning on it could only burn the
+        whole timeout. Otherwise waits on the queue's task counter
+        (Queue.join with a deadline) instead of sleep-polling emptiness:
+        empty() flips before the last batch is SENT, and polling wakes
+        20ms late per batch where the condition wakes exactly when the
+        exporter acks."""
+        t = self._thread
+        if t is None or not t.is_alive():
             return
         deadline = time.monotonic() + timeout_s
-        while not self._q.empty() and time.monotonic() < deadline:
-            self._wake.set()
-            time.sleep(0.02)
+        self._wake.set()
+        with self._q.all_tasks_done:
+            while self._q.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                self._wake.set()
+                self._q.all_tasks_done.wait(min(remaining, 0.1))
 
     def shutdown(self) -> None:
         self._stop.set()

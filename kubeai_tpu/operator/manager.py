@@ -85,6 +85,7 @@ class Manager:
             self.lb,
             self.leader,
             namespace=self.namespace,
+            metrics=self.metrics,
         )
         self.proxy = ModelProxy(self.lb, self.model_client, metrics=self.metrics)
         self.api_server = OpenAIServer(

@@ -72,9 +72,11 @@ class ModelClient:
                 except Conflict:
                     continue
 
-    def scale(self, name: str, replicas: int) -> None:
+    def scale(self, name: str, replicas: int) -> int:
         """Bounded scale with consecutive-scale-down hysteresis
-        (reference: scale.go:43-100)."""
+        (reference: scale.go:43-100). Returns the replica count in effect
+        AFTER the call (current when hysteresis suppressed the change) —
+        the autoscaler's decision log records computed vs. applied."""
         with self._scale_lock:
             try:
                 obj = self.store.get("Model", self.namespace, name)
@@ -89,7 +91,7 @@ class ModelClient:
             current = spec.get("replicas") or 0
             if replicas == current:
                 self._consecutive_scale_downs[name] = 0
-                return
+                return current
             if replicas < current:
                 model = Model.from_dict(obj)
                 required = self._required_consecutive(model)
@@ -97,13 +99,20 @@ class ModelClient:
                     self._consecutive_scale_downs.get(name, 0) + 1
                 )
                 if self._consecutive_scale_downs[name] < required:
-                    return
+                    return current
             self._consecutive_scale_downs[name] = 0
             spec["replicas"] = replicas
             try:
                 self.store.update(obj)
             except Conflict:
-                pass  # next tick retries
+                return current  # next tick retries
+            return replicas
+
+    def consecutive_scale_downs(self, name: str) -> int:
+        """Pending scale-down votes for a model (hysteresis state; 0 when
+        the last tick held or scaled up)."""
+        with self._scale_lock:
+            return self._consecutive_scale_downs.get(name, 0)
 
     # injected by the autoscaler (interval-dependent); default 1 = immediate.
     required_consecutive_scale_downs_fn = None

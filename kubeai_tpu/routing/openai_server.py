@@ -199,22 +199,45 @@ class OpenAIServer:
                     f"HTTP {result.status}" if result.status >= 500 else None
                 )
                 orig_chunks = result.chunks
+                # Per-model lifecycle histograms measured at the SAME
+                # boundaries the span attributes record, so traces and
+                # histograms agree: TTFT at the first body chunk, e2e
+                # duration when the body (streamed or unary) completes.
+                model = getattr(result, "model", "") or "unknown"
+
+                def _finish(error=None):
+                    duration = time.monotonic() - t0
+                    span.set_attribute("http.duration_s", duration)
+                    outer.metrics.request_duration.observe(
+                        duration, model=model
+                    )
+                    access_log.info(
+                        "route=%s request_id=%s model=%s status=%d "
+                        "duration_ms=%.1f",
+                        normalized, request_id, model, result.status,
+                        duration * 1e3,
+                    )
+                    span.end(error=error)
 
                 def traced_chunks(orig=orig_chunks, span=span, err=err):
+                    first = True
                     try:
-                        yield from orig
+                        for chunk in orig:
+                            if first and chunk:
+                                first = False
+                                ttft = time.monotonic() - t0
+                                span.set_attribute("http.ttft_s", ttft)
+                                outer.metrics.request_ttft.observe(
+                                    ttft, model=model
+                                )
+                            yield chunk
                     except BaseException as e:
-                        span.end(error=str(e) or type(e).__name__)
+                        _finish(error=str(e) or type(e).__name__)
                         raise
                     else:
-                        span.end(error=err)
+                        _finish(error=err)
 
                 result.chunks = traced_chunks()
-                access_log.info(
-                    "route=%s request_id=%s status=%d duration_ms=%.1f",
-                    normalized, request_id, result.status,
-                    (time.monotonic() - t0) * 1e3,
-                )
                 self.send_response(result.status)
                 self.send_header("X-Request-Id", request_id)
                 has_length = any(

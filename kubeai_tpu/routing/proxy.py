@@ -41,10 +41,16 @@ RETRY_STATUSES = (429, 500, 502, 503, 504)
 class ProxyResult:
     """What the HTTP layer needs to respond: status, headers, body iterator."""
 
-    def __init__(self, status: int, headers: list[tuple[str, str]], chunks):
+    def __init__(
+        self, status: int, headers: list[tuple[str, str]], chunks,
+        model: str = "",
+    ):
         self.status = status
         self.headers = headers
         self.chunks = chunks  # iterator of bytes
+        # Resolved model name ("" when lookup failed) — lets the front
+        # door label its duration/TTFT histograms per model.
+        self.model = model
 
 
 class ModelProxy:
@@ -90,14 +96,21 @@ class ModelProxy:
             result = self._proxy_with_retries(path, preq, model, headers)
         except LoadBalancerTimeout:
             _done()
-            return _error(503, "no model endpoints became ready in time")
+            return _error(
+                503, "no model endpoints became ready in time",
+                model=model.name,
+            )
         except Exception:
             _done()
-            logger.exception("proxy failure for model %s", model.name)
-            return _error(502, "upstream failure")
+            logger.exception(
+                "proxy failure for model %s (request_id=%s)",
+                model.name, headers.get("x-request-id", ""),
+            )
+            return _error(502, "upstream failure", model=model.name)
 
         # Wrap the body iterator so active-count drops when fully streamed.
         orig = result.chunks
+        result.model = model.name
 
         def wrapped():
             try:
@@ -120,11 +133,15 @@ class ModelProxy:
         prefix = preq.prefix[:prefix_len] if strategy == LB_STRATEGY_PREFIX_HASH else ""
 
         last_err: Exception | None = None
+        request_id = headers.get("x-request-id", "")
         # Parent for every attempt span: the front door's server span
         # (attempts are SIBLINGS — rebinding headers below must not make
         # attempt N+1 a child of attempt N).
         trace_parent = tracing.parse_traceparent(headers.get("traceparent"))
         for attempt in range(MAX_RETRIES):
+            if attempt > 0:
+                self.metrics.proxy_retries.inc(model=model.name)
+            self.metrics.proxy_attempts.inc(model=model.name)
             addr, done = self.lb.await_best_address(
                 model.name,
                 adapter=preq.adapter,
@@ -132,16 +149,20 @@ class ModelProxy:
                 strategy=strategy,
             )
             # One client span per attempt: retries show up as siblings
-            # under the front door's server span.
+            # under the front door's server span, each carrying the
+            # request id so a slow request is traceable end to end.
+            attempt_attrs = {
+                "endpoint": addr,
+                "attempt": attempt,
+                "request.model": model.name,
+            }
+            if request_id:
+                attempt_attrs["request.id"] = request_id
             attempt_span = tracing.tracer().start_span(
                 "proxy.attempt",
                 parent=trace_parent,
                 kind=tracing.KIND_CLIENT,
-                attributes={
-                    "endpoint": addr,
-                    "attempt": attempt,
-                    "request.model": model.name,
-                },
+                attributes=attempt_attrs,
             )
             # The engine continues the trace under THIS attempt.
             headers = dict(headers, traceparent=attempt_span.context.traceparent())
@@ -152,7 +173,9 @@ class ModelProxy:
                 done()
                 last_err = e
                 logger.warning(
-                    "attempt %d: connection to %s failed: %s", attempt, addr, e
+                    "attempt %d: connection to %s failed: %s "
+                    "(model=%s request_id=%s)",
+                    attempt, addr, e, model.name, request_id,
                 )
                 continue
             except Exception as e:
@@ -166,6 +189,11 @@ class ModelProxy:
             if resp.status in RETRY_STATUSES and attempt < MAX_RETRIES - 1:
                 attempt_span.set_attribute("http.status_code", resp.status)
                 attempt_span.end(error=f"HTTP {resp.status} (retrying)")
+                logger.warning(
+                    "attempt %d: %s returned HTTP %d, retrying "
+                    "(model=%s request_id=%s)",
+                    attempt, addr, resp.status, model.name, request_id,
+                )
                 retry_after = resp.getheader("Retry-After")
                 resp.read()
                 conn.close()
@@ -213,7 +241,9 @@ class ModelProxy:
                     conn.close()
                     done()
 
-            return ProxyResult(resp.status, resp_headers, chunks())
+            return ProxyResult(
+                resp.status, resp_headers, chunks(), model=model.name
+            )
         raise last_err or RuntimeError("retries exhausted")
 
 
@@ -231,7 +261,7 @@ def _send(addr: str, path: str, preq: apiutils.ParsedRequest, headers: dict):
     return conn.getresponse(), conn
 
 
-def _error(status: int, message: str) -> ProxyResult:
+def _error(status: int, message: str, model: str = "") -> ProxyResult:
     import json
 
     body = json.dumps({"error": {"message": message, "code": status}}).encode()
@@ -239,4 +269,5 @@ def _error(status: int, message: str) -> ProxyResult:
         status,
         [("Content-Type", "application/json"), ("Content-Length", str(len(body)))],
         iter([body]),
+        model=model,
     )

@@ -1,0 +1,195 @@
+"""Metrics registry: exposition-format round-trip fidelity, histogram
+`le` normalization, and metric-name hygiene for every instrument bundle.
+
+The registry is the autoscaling TRANSPORT (the leader scrapes every
+replica's /metrics and decodes it with parse_prometheus_text), so
+expose() → parse must be lossless — including label values containing
+quotes, backslashes, and commas, which _fmt_labels escapes and the
+parser must faithfully unescape."""
+
+import random
+
+import pytest
+
+from kubeai_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Metrics,
+    Registry,
+    _split_label_pairs,
+    lint_registry,
+    parse_prometheus_text,
+)
+
+
+# ---- round-trip ---------------------------------------------------------------
+
+NASTY_VALUES = [
+    "plain",
+    'quote"inside',
+    "back\\slash",
+    "comma,inside",
+    "trailing\\",
+    'mix\\",bo\\th"',
+    "=equals=",
+    '"',
+    "\\",
+]
+
+
+def test_counter_gauge_roundtrip_nasty_labels():
+    reg = Registry()
+    c = Counter("kubeai_rt_total", "c", reg)
+    g = Gauge("kubeai_rt_gauge", "g", reg)
+    for i, v in enumerate(NASTY_VALUES):
+        c.inc(i + 1, model=v)
+        g.set(i * 2.5, model=v, zone=v[::-1])
+    parsed = parse_prometheus_text(reg.expose())
+    for i, v in enumerate(NASTY_VALUES):
+        assert parsed[("kubeai_rt_total", (("model", v),))] == i + 1
+        key = tuple(sorted([("model", v), ("zone", v[::-1])]))
+        assert parsed[("kubeai_rt_gauge", key)] == i * 2.5
+
+
+def test_histogram_roundtrip_recovers_buckets_sum_count():
+    reg = Registry()
+    h = Histogram(
+        "kubeai_rt_seconds", "h", reg, buckets=(0.1, 1.0, 10.0)
+    )
+    for val in (0.05, 0.5, 5.0, 50.0):
+        h.observe(val, model='m"1')
+    parsed = parse_prometheus_text(reg.expose())
+
+    def bucket(le):
+        key = tuple(sorted([("le", le), ("model", 'm"1')]))
+        return parsed[("kubeai_rt_seconds_bucket", key)]
+
+    assert bucket("0.1") == 1
+    assert bucket("1") == 2
+    assert bucket("10") == 3
+    assert bucket("+Inf") == 4
+    assert parsed[("kubeai_rt_seconds_count", (("model", 'm"1'),))] == 4
+    assert parsed[("kubeai_rt_seconds_sum", (("model", 'm"1'),))] == (
+        pytest.approx(55.55)
+    )
+
+
+def test_roundtrip_property_random_labels():
+    """Property-style sweep: random label sets over an alphabet loaded
+    with the exposition format's special characters must survive
+    expose() → parse exactly."""
+    rng = random.Random(7)
+    alphabet = 'ab\\",=x '
+    reg = Registry()
+    c = Counter("kubeai_prop_total", "c", reg)
+    expected = {}
+    for i in range(60):
+        val = "".join(
+            rng.choice(alphabet) for _ in range(rng.randrange(1, 9))
+        )
+        c.inc(1, model=val, idx=str(i))
+        key = tuple(sorted([("model", val), ("idx", str(i))]))
+        expected[key] = expected.get(key, 0) + 1
+    parsed = parse_prometheus_text(reg.expose())
+    for key, count in expected.items():
+        assert parsed[("kubeai_prop_total", key)] == count
+
+
+def test_large_counter_values_do_not_truncate():
+    # %g would render 123456789 as 1.23457e+08 — a real token counter
+    # passes 1e6 within minutes.
+    reg = Registry()
+    c = Counter("kubeai_big_total", "c", reg)
+    c.inc(123_456_789, model="m")
+    parsed = parse_prometheus_text(reg.expose())
+    assert parsed[("kubeai_big_total", (("model", "m"),))] == 123_456_789
+
+
+def test_split_label_pairs_tracks_escape_state():
+    # An escaped quote inside a value must not toggle the in-quotes flag.
+    pairs = _split_label_pairs('a="x\\",y",b="z"')
+    assert pairs == ['a="x\\",y"', 'b="z"']
+    # Escaped backslash before the closing quote.
+    pairs = _split_label_pairs('a="x\\\\",b="z"')
+    assert pairs == ['a="x\\\\"', 'b="z"']
+
+
+# ---- histogram semantics ------------------------------------------------------
+
+
+def test_histogram_le_rendering_is_g_style():
+    reg = Registry()
+    h = Histogram("kubeai_le_seconds", "h", reg)  # default buckets
+    h.observe(0.003)
+    text = reg.expose()
+    assert 'le="0.005"' in text
+    assert 'le="1"' in text  # int bucket renders bare
+    assert 'le="1.0"' not in text
+    assert 'le="+Inf"' in text
+    # Float-typed integral bounds normalize identically.
+    reg2 = Registry()
+    h2 = Histogram(
+        "kubeai_le2_seconds", "h", reg2, buckets=(1.0, 2.0)
+    )
+    h2.observe(0.5)
+    assert 'le="1"' in reg2.expose()
+
+
+def test_histogram_get_returns_observation_count():
+    h = Histogram("kubeai_get_seconds", "h", None)
+    assert h.get() == 0
+    h.observe(0.2)
+    h.observe(0.4)
+    h.observe(9.0, model="m")
+    assert h.get() == 2
+    assert h.get(model="m") == 1
+    assert h.sum_for() == pytest.approx(0.6)
+    assert h.sum_for(model="m") == pytest.approx(9.0)
+
+
+def test_histogram_bucket_counts_cumulative_once():
+    h = Histogram("kubeai_cum_seconds", "h", None, buckets=(1.0, 2.0))
+    h.observe(0.5)
+    lines = h.collect()
+    by_le = {
+        line.split(" ")[0]: int(line.split(" ")[1])
+        for line in lines
+        if "_bucket" in line
+    }
+    # One observation <= 1.0 must count exactly once in every le >= it.
+    assert by_le['kubeai_cum_seconds_bucket{le="1"}'] == 1
+    assert by_le['kubeai_cum_seconds_bucket{le="2"}'] == 1
+    assert by_le['kubeai_cum_seconds_bucket{le="+Inf"}'] == 1
+
+
+# ---- metric-name hygiene ------------------------------------------------------
+
+
+def _bundle_registries():
+    yield "operator", Metrics().registry
+    from kubeai_tpu.engine.server import EngineMetrics
+
+    yield "engine", EngineMetrics().registry
+
+
+def test_every_instrument_bundle_passes_hygiene():
+    """New instruments can't silently drift from the naming scheme:
+    ^kubeai_[a-z0-9_]+$, unique per registry, counters end in _total,
+    histograms in _seconds."""
+    for name, reg in _bundle_registries():
+        assert lint_registry(reg) == [], f"{name} bundle failed hygiene"
+
+
+def test_lint_catches_violations():
+    reg = Registry()
+    Counter("kubeai_bad_counter", "no _total suffix", reg)
+    Histogram("kubeai_bad_hist", "no _seconds suffix", reg)
+    Gauge("not_kubeai_prefixed", "bad prefix", reg)
+    Gauge("kubeai_dup", "", reg)
+    Gauge("kubeai_dup", "", reg)
+    errs = "\n".join(lint_registry(reg))
+    assert "kubeai_bad_counter" in errs
+    assert "kubeai_bad_hist" in errs
+    assert "not_kubeai_prefixed" in errs
+    assert "duplicate" in errs

@@ -1,0 +1,275 @@
+"""Request-lifecycle observability across the front door, proxy, and
+autoscaler: per-model duration/TTFT histograms, retry counters, request-id
+correlation between spans and metrics, and per-tick autoscaler decision
+records."""
+
+import json
+import logging
+
+import pytest
+
+from testutil import (
+    FakeEngine,
+    FakeMetricsServer,
+    eventually,
+    http_post,
+    ready_pod_manifest,
+)
+
+from kubeai_tpu.autoscaler import Autoscaler
+from kubeai_tpu.config import System
+from kubeai_tpu.crd.model import LoadBalancing, Model, ModelSpec
+from kubeai_tpu.metrics import Metrics, tracing
+from kubeai_tpu.metrics.registry import parse_prometheus_text
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.loadbalancer import LoadBalancer
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.routing.openai_server import OpenAIServer
+from kubeai_tpu.routing.proxy import ModelProxy
+
+
+@pytest.fixture
+def world():
+    """Front door + proxy + one ready fake engine, with an ISOLATED
+    Metrics bundle so histogram counts are exact per test."""
+    store = KubeStore()
+    lb = LoadBalancer(store, default_timeout=5)
+    mc = ModelClient(store)
+    metrics = Metrics()
+    server = OpenAIServer(
+        ModelProxy(lb, mc, metrics=metrics), mc, metrics=metrics
+    )
+    server.start()
+    eng = FakeEngine()
+    store.create(Model(
+        name="m1",
+        spec=ModelSpec(
+            url="hf://org/x", engine="KubeAITPU",
+            features=["TextGeneration"], autoscaling_disabled=True,
+            replicas=1, load_balancing=LoadBalancing(),
+        ),
+    ).to_dict())
+    store.create(ready_pod_manifest("m1", 0, eng.port))
+    lb.sync_model("m1")
+    yield server, metrics, eng
+    server.stop()
+    lb.stop()
+    eng.stop()
+
+
+def test_front_door_duration_and_ttft_histograms_per_model(world):
+    server, metrics, eng = world
+    status, _ = http_post(
+        f"127.0.0.1:{server.port}",
+        "/openai/v1/completions",
+        {"model": "m1", "prompt": "hi"},
+    )
+    assert status == 200
+    # The duration observation lands when the server-side chunk generator
+    # exhausts — a hair after the client sees the last body byte.
+    eventually(
+        lambda: metrics.request_duration.get(model="m1") == 1,
+        msg="request_duration observed",
+    )
+    assert metrics.request_ttft.get(model="m1") == 1
+    assert metrics.proxy_attempts.get(model="m1") == 1
+    assert metrics.proxy_retries.get(model="m1") == 0
+    # TTFT (first body chunk) cannot exceed full duration.
+    assert metrics.request_ttft.sum_for(model="m1") <= (
+        metrics.request_duration.sum_for(model="m1")
+    )
+    # And they ride the operator /metrics endpoint the autoscaler scrapes.
+    parsed = parse_prometheus_text(metrics.registry.expose())
+    assert parsed[
+        ("kubeai_inference_request_duration_seconds_count",
+         (("model", "m1"),))
+    ] == 1
+    assert parsed[
+        ("kubeai_inference_ttft_seconds_count", (("model", "m1"),))
+    ] == 1
+
+
+def test_retry_counters_count_failed_attempts(world):
+    server, metrics, eng = world
+    calls = []
+
+    def flaky(path, body):
+        calls.append(path)
+        if len(calls) == 1:
+            return 503, {"error": {"message": "shedding"}}
+        return 200, {"ok": True}
+
+    eng.behavior = flaky
+    status, _ = http_post(
+        f"127.0.0.1:{server.port}",
+        "/openai/v1/completions",
+        {"model": "m1", "prompt": "hi"},
+    )
+    assert status == 200
+    assert len(calls) == 2
+    assert metrics.proxy_attempts.get(model="m1") == 2
+    assert metrics.proxy_retries.get(model="m1") == 1
+    eventually(
+        lambda: metrics.request_duration.get(model="m1") == 1,
+        msg="one duration observation despite the retry",
+    )
+
+
+def test_spans_carry_request_id_and_timing_attributes(world):
+    from test_tracing import FakeCollector
+
+    server, metrics, eng = world
+    coll = FakeCollector()
+    tracing.configure(endpoint=coll.endpoint, flush_interval_s=0.1)
+    try:
+        status, _ = http_post(
+            f"127.0.0.1:{server.port}",
+            "/openai/v1/completions",
+            {"model": "m1", "prompt": "hi"},
+            headers={"X-Request-Id": "req-observe-1"},
+        )
+        assert status == 200
+        spans = coll.wait_spans(2)
+        by_name = {s["name"]: s for s in spans}
+        front = by_name["POST /openai/v1/completions"]
+        attempt = by_name["proxy.attempt"]
+        f_attrs = {a["key"]: a["value"] for a in front["attributes"]}
+        a_attrs = {a["key"]: a["value"] for a in attempt["attributes"]}
+        # One id follows the request across tiers.
+        assert f_attrs["request.id"] == {"stringValue": "req-observe-1"}
+        assert a_attrs["request.id"] == {"stringValue": "req-observe-1"}
+        # ...and the engine received it for ITS span to stamp.
+        assert eng.request_headers[-1].get("x-request-id") == "req-observe-1"
+        # Timings recorded in metrics land as span attributes and agree
+        # with the histogram sums.
+        dur = f_attrs["http.duration_s"]["doubleValue"]
+        ttft = f_attrs["http.ttft_s"]["doubleValue"]
+        assert 0 <= ttft <= dur
+        assert metrics.request_duration.sum_for(model="m1") == (
+            pytest.approx(dur)
+        )
+        assert metrics.request_ttft.sum_for(model="m1") == (
+            pytest.approx(ttft)
+        )
+    finally:
+        coll.stop()
+        with tracing._default_lock:
+            if tracing._default is not None:
+                tracing._default.shutdown()
+            tracing._default = None
+
+
+# ---- autoscaler decision telemetry --------------------------------------------
+
+
+class AlwaysLeader:
+    is_leader = True
+
+
+def _metrics_text(model: str, active: float) -> str:
+    return (
+        "# TYPE kubeai_inference_requests_active gauge\n"
+        f'kubeai_inference_requests_active{{model="{model}"}} {active}\n'
+    )
+
+
+def test_autoscaler_emits_decision_record_and_gauges(caplog):
+    srv = FakeMetricsServer(_metrics_text("m1", 25))
+    store = KubeStore()
+    cfg = System()
+    cfg.model_autoscaling.interval_seconds = 10
+    cfg.model_autoscaling.time_window_seconds = 10
+    cfg.fixed_self_metric_addrs = [srv.addr]
+    cfg.default_and_validate()
+    mc = ModelClient(store)
+    lb = LoadBalancer(store)
+    metrics = Metrics()
+    store.create(Model(
+        name="m1",
+        spec=ModelSpec(
+            url="hf://org/x", engine="KubeAITPU",
+            min_replicas=0, max_replicas=10, replicas=0,
+            target_requests=10, scale_down_delay_seconds=0,
+        ),
+    ).to_dict())
+    scaler = Autoscaler(
+        store, cfg, mc, lb, AlwaysLeader(), metrics=metrics
+    )
+    try:
+        with caplog.at_level(
+            logging.INFO, logger="kubeai.autoscaler.decisions"
+        ):
+            scaler.tick()
+        # One structured record for the model this tick.
+        assert len(scaler.last_decisions) == 1
+        rec = scaler.last_decisions[0]
+        assert rec["model"] == "m1"
+        assert rec["signal"] == 25.0
+        assert rec["average"] == pytest.approx(25.0)
+        assert rec["computed_replicas"] == 3  # ceil(25/10)
+        assert rec["applied_replicas"] == 3
+        assert rec["scale_down_votes"] == 0
+        assert rec["scrape_duration_s"] >= 0
+        # The same record went out as one JSON log line.
+        decision_lines = [
+            r.message for r in caplog.records
+            if r.name == "kubeai.autoscaler.decisions"
+        ]
+        assert len(decision_lines) == 1
+        logged = json.loads(decision_lines[0])
+        assert logged["model"] == "m1"
+        assert logged["computed_replicas"] == 3
+        assert logged["applied_replicas"] == 3
+        # Gauges mirror the record on the operator registry.
+        assert metrics.autoscaler_signal.get(model="m1") == 25.0
+        assert metrics.autoscaler_average.get(model="m1") == (
+            pytest.approx(25.0)
+        )
+        assert metrics.autoscaler_desired_replicas.get(model="m1") == 3
+        assert metrics.autoscaler_applied_replicas.get(model="m1") == 3
+        assert metrics.autoscaler_ticks.get() == 1
+        assert metrics.autoscaler_scrape_duration.get() == 1
+    finally:
+        srv.stop()
+
+
+def test_autoscaler_decision_records_hysteresis_suppression():
+    """A suppressed scale-down shows computed < applied plus a vote — the
+    'why didn't it scale down' question the decision log exists for."""
+    srv = FakeMetricsServer(_metrics_text("m1", 100))
+    store = KubeStore()
+    cfg = System()
+    cfg.model_autoscaling.interval_seconds = 10
+    cfg.model_autoscaling.time_window_seconds = 10
+    cfg.fixed_self_metric_addrs = [srv.addr]
+    cfg.default_and_validate()
+    mc = ModelClient(store)
+    lb = LoadBalancer(store)
+    metrics = Metrics()
+    store.create(Model(
+        name="m1",
+        spec=ModelSpec(
+            url="hf://org/x", engine="KubeAITPU",
+            min_replicas=0, max_replicas=20, replicas=0,
+            target_requests=10, scale_down_delay_seconds=20,
+        ),
+    ).to_dict())
+    scaler = Autoscaler(
+        store, cfg, mc, lb, AlwaysLeader(), metrics=metrics
+    )
+    try:
+        scaler.tick()  # 100 active -> 10 replicas
+        assert scaler.last_decisions[0]["applied_replicas"] == 10
+        srv.text = _metrics_text("m1", 0)  # load vanishes
+        scaler.tick()  # first down-vote: suppressed by hysteresis
+        rec = scaler.last_decisions[0]
+        assert rec["computed_replicas"] == 0
+        assert rec["applied_replicas"] == 10  # held
+        assert rec["scale_down_votes"] == 1
+        assert metrics.autoscaler_scale_down_votes.get(model="m1") == 1
+        scaler.tick()  # second vote: applied
+        rec = scaler.last_decisions[0]
+        assert rec["applied_replicas"] == 0
+        assert rec["scale_down_votes"] == 0
+    finally:
+        srv.stop()

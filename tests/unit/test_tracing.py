@@ -164,6 +164,45 @@ def test_export_survives_dead_collector():
         t.shutdown()
 
 
+def test_flush_returns_immediately_without_exporter_thread():
+    t = tracing.Tracer()  # no endpoint, no thread
+    t0 = time.monotonic()
+    t.flush(timeout_s=5.0)
+    assert time.monotonic() - t0 < 0.5  # no busy-spin on a dead queue
+
+
+def test_flush_returns_when_exporter_thread_dead():
+    """Spans buffered after shutdown will never drain; flush must notice
+    the dead thread instead of spinning out its whole timeout."""
+    t = tracing.Tracer(endpoint="http://127.0.0.1:1", flush_interval_s=60)
+    t.shutdown()
+    assert not t._thread.is_alive()
+    # Enqueue spans the dead thread will never drain.
+    for i in range(3):
+        t.start_span(f"orphan{i}").end()
+    assert not t._q.empty()
+    t0 = time.monotonic()
+    t.flush(timeout_s=5.0)
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_flush_pushes_buffered_spans_promptly():
+    """With a long flush interval, flush() must wake the exporter and
+    wait for the SEND to complete (not merely for the queue to empty)."""
+    coll = FakeCollector()
+    t = tracing.Tracer(endpoint=coll.endpoint, flush_interval_s=60)
+    try:
+        for i in range(4):
+            t.start_span(f"f{i}").end()
+        t0 = time.monotonic()
+        t.flush(timeout_s=10.0)
+        assert time.monotonic() - t0 < 5  # well under the 60s interval
+        assert len(coll.spans()) == 4  # already SENT when flush returned
+    finally:
+        t.shutdown()
+        coll.stop()
+
+
 # ---- one trace across front door -> proxy -> engine --------------------------
 
 
