@@ -40,6 +40,11 @@ decision_log = logging.getLogger("kubeai.autoscaler.decisions")
 
 ACTIVE_METRIC = "kubeai_inference_requests_active"
 
+# Engine scheduler queue-pressure gauges (kubeai_tpu/engine/server.py
+# EngineMetrics), scraped off each model's engine endpoints.
+QUEUE_DEPTH_METRIC = "kubeai_engine_queue_depth"
+QUEUE_OLDEST_WAIT_METRIC = "kubeai_engine_queue_oldest_wait_seconds"
+
 
 def scrape_active_requests(addrs: list[str], timeout: float = 5.0) -> dict[str, float]:
     """Aggregate the active-request gauge across operator replicas
@@ -63,6 +68,38 @@ def scrape_active_requests(addrs: list[str], timeout: float = 5.0) -> dict[str, 
     return totals
 
 
+def scrape_queue_pressure(addrs: list[str], timeout: float = 5.0) -> dict:
+    """Best-effort scrape of one model's ENGINE endpoints for the
+    scheduler's queue-pressure gauges. Returns
+    ``{"depth": total, "oldest_wait_s": max, "per_class": {class: depth}}``.
+
+    Unlike the active-request scrape (where a missing operator replica
+    zeroing the signal must fail the tick), engine endpoints churn by
+    design during scale-up/down — an unreachable engine is skipped and
+    the boost signal degrades conservatively (toward no boost) instead of
+    failing the whole tick."""
+    depth = 0.0
+    oldest = 0.0
+    per_class: dict[str, float] = {}
+    for addr in addrs:
+        url = f"http://{addr}/metrics"
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                text = resp.read().decode()
+        except OSError as e:
+            logger.debug("queue-pressure scrape skipped %s: %s", url, e)
+            continue
+        for (name, labels), value in parse_prometheus_text(text).items():
+            if name == QUEUE_DEPTH_METRIC:
+                depth += value
+                cls = dict(labels).get("class", "")
+                if cls:
+                    per_class[cls] = per_class.get(cls, 0.0) + value
+            elif name == QUEUE_OLDEST_WAIT_METRIC:
+                oldest = max(oldest, value)
+    return {"depth": depth, "oldest_wait_s": oldest, "per_class": per_class}
+
+
 class Autoscaler:
     def __init__(
         self,
@@ -84,6 +121,8 @@ class Autoscaler:
         # Most recent tick's decision records (one dict per model) — the
         # in-process view of what decision_log just emitted.
         self.last_decisions: list[dict] = []
+        # Injectable for tests (fake engine endpoints without sockets).
+        self.queue_scraper = scrape_queue_pressure
         self.interval = cfg.model_autoscaling.interval_seconds
         self.window_count = cfg.model_autoscaling.average_window_count
         self._averages: dict[str, SimpleMovingAverage] = {}
@@ -153,6 +192,25 @@ class Autoscaler:
                 avg = avg_tracker.next(active)
                 next_averages[model.name] = avg_tracker
                 desired = int(-(-avg // model.spec.target_requests))  # ceil
+                # Queue-pressure boost: requests waiting in the engines'
+                # schedulers are demand the active-request gauge cannot
+                # see (they are not active yet). When the oldest waiter
+                # has aged past the configured bound, fold queued depth
+                # into the demand estimate — a saturated replica set
+                # otherwise plateaus at "looks fully utilized" while its
+                # queues (and TTFT) grow without bound.
+                queue = self.queue_scraper(
+                    self.lb.group(model.name).addresses()
+                )
+                threshold = (
+                    self.cfg.model_autoscaling.queue_pressure_max_wait_seconds
+                )
+                if threshold > 0 and queue["oldest_wait_s"] >= threshold:
+                    desired = max(
+                        desired,
+                        int(-(-(avg + queue["depth"])
+                              // model.spec.target_requests)),
+                    )
                 applied = self.model_client.scale(model.name, desired)
                 votes = self.model_client.consecutive_scale_downs(model.name)
                 record = {
@@ -166,6 +224,9 @@ class Autoscaler:
                     "scale_down_votes": votes,
                     "scrape_duration_s": scrape_s,
                     "scraped_replicas": len(addrs),
+                    "queue_depth": queue["depth"],
+                    "queue_oldest_wait_s": queue["oldest_wait_s"],
+                    "queue_per_class": dict(queue["per_class"]),
                 }
                 decisions.append(record)
                 decision_log.info(json.dumps(record, sort_keys=True))
@@ -179,6 +240,12 @@ class Autoscaler:
                 )
                 self.metrics.autoscaler_scale_down_votes.set(
                     votes, model=model.name
+                )
+                self.metrics.autoscaler_queue_depth.set(
+                    queue["depth"], model=model.name
+                )
+                self.metrics.autoscaler_queue_oldest_wait.set(
+                    queue["oldest_wait_s"], model=model.name
                 )
             self.last_decisions = decisions
             self.metrics.autoscaler_ticks.inc()

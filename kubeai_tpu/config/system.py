@@ -72,6 +72,12 @@ class ModelAutoscaling:
     interval_seconds: float = 10.0
     time_window_seconds: float = 600.0
     state_configmap_name: str = "kubeai-autoscaler-state"
+    # Queue-pressure boost (kubeai_tpu/scheduling): when a model's oldest
+    # queued request is at least this old (seconds), the engines' queued
+    # depth counts as unmet demand on top of the active-request average —
+    # a saturated-but-steady replica set stops looking "done scaling".
+    # 0 disables the boost.
+    queue_pressure_max_wait_seconds: float = 3.0
 
     @property
     def average_window_count(self) -> int:
@@ -200,6 +206,8 @@ class System:
             raise ConfigError("modelAutoscaling.interval must be > 0")
         if self.model_autoscaling.time_window_seconds < self.model_autoscaling.interval_seconds:
             raise ConfigError("modelAutoscaling.timeWindow must be >= interval")
+        if self.model_autoscaling.queue_pressure_max_wait_seconds < 0:
+            raise ConfigError("modelAutoscaling.queuePressureMaxWait must be >= 0")
         if self.model_rollouts.surge < 0:
             raise ConfigError("modelRollouts.surge must be >= 0")
         for name, prof in self.resource_profiles.items():
@@ -465,6 +473,9 @@ def system_from_dict(data: dict) -> System:
             time_window_seconds=_seconds(a.get("timeWindow", 600)),
             state_configmap_name=a.get(
                 "stateConfigMapName", "kubeai-autoscaler-state"
+            ),
+            queue_pressure_max_wait_seconds=_seconds(
+                a.get("queuePressureMaxWait", 3)
             ),
         )
     if "modelRollouts" in data:

@@ -110,6 +110,63 @@ class LoadBalancing:
         self.prefix_hash.validate()
 
 
+# Priority classes of the in-tree engine's scheduler
+# (kubeai_tpu/scheduling/scheduler.py PRIORITY_CLASSES — duplicated here so
+# the CRD layer stays import-light and admission errors mention CRD terms).
+SCHEDULING_PRIORITY_CLASSES = ("realtime", "standard", "batch")
+
+
+@dataclasses.dataclass
+class Scheduling:
+    """SLO-aware queue discipline for the in-tree engine (no reference
+    analog — the reference delegates queueing to vLLM). Rendered as
+    engine flags --default-priority / --queue-shares / --max-deadline-ms
+    (kubeai_tpu/operator/engines/kubeai_tpu_engine.py)."""
+
+    # Priority class for requests without an X-Priority header.
+    # "" = engine default ("standard").
+    default_priority: str = ""
+    # class -> guaranteed fraction of dispatches while backlogged, e.g.
+    # {"batch": 0.05} keeps batch work trickling under realtime load.
+    queue_shares: dict[str, float] = dataclasses.field(default_factory=dict)
+    # Cap on client X-Deadline-Ms values AND the default admission
+    # deadline when none is sent. 0 disables deadline admission.
+    max_deadline_ms: int = 0
+
+    def enabled(self) -> bool:
+        return bool(
+            self.default_priority or self.queue_shares or self.max_deadline_ms
+        )
+
+    def validate(self) -> None:
+        if (
+            self.default_priority
+            and self.default_priority not in SCHEDULING_PRIORITY_CLASSES
+        ):
+            raise ValidationError(
+                "scheduling.defaultPriority must be one of "
+                f"{SCHEDULING_PRIORITY_CLASSES}, got {self.default_priority!r}"
+            )
+        for cls, share in self.queue_shares.items():
+            if cls not in SCHEDULING_PRIORITY_CLASSES:
+                raise ValidationError(
+                    f"scheduling.queueShares: unknown class {cls!r}"
+                )
+            try:
+                share = float(share)
+            except (TypeError, ValueError):
+                raise ValidationError(
+                    f"scheduling.queueShares[{cls!r}] must be a number"
+                )
+            if not 0.0 <= share < 1.0:
+                raise ValidationError(
+                    f"scheduling.queueShares[{cls!r}] must be in [0, 1), "
+                    f"got {share}"
+                )
+        if self.max_deadline_ms < 0:
+            raise ValidationError("scheduling.maxDeadlineMs must be >= 0")
+
+
 @dataclasses.dataclass
 class ModelSpec:
     """(reference: api/k8s/v1/model_types.go:36-144)"""
@@ -142,6 +199,8 @@ class ModelSpec:
     # --draft-url, kubeai_tpu/engine/server.py).
     speculative_tokens: int = 0
     draft_url: str = ""
+    # SLO-aware queue discipline (in-tree engine only).
+    scheduling: Scheduling = dataclasses.field(default_factory=Scheduling)
 
     def url_scheme(self) -> str:
         return self.url.split("://", 1)[0] if "://" in self.url else ""
@@ -211,6 +270,11 @@ class ModelSpec:
                     'draftUrl must use "hf://", "pvc://", "s3://", '
                     f'"gs://", or "oss://", got {self.draft_url!r}'
                 )
+        self.scheduling.validate()
+        if self.scheduling.enabled() and self.engine != ENGINE_KUBEAI_TPU:
+            raise ValidationError(
+                "spec.scheduling requires the KubeAITPU engine"
+            )
         if self.target_requests < 1:
             raise ValidationError("targetRequests must be >= 1")
         if self.scale_down_delay_seconds < 0:
@@ -379,6 +443,22 @@ class Model:
                 owner=spec.get("owner", ""),
                 speculative_tokens=int(spec.get("speculativeTokens", 0) or 0),
                 draft_url=spec.get("draftUrl", ""),
+                scheduling=Scheduling(
+                    default_priority=(
+                        (spec.get("scheduling") or {}).get("defaultPriority", "")
+                    ),
+                    queue_shares={
+                        k: float(v)
+                        for k, v in (
+                            (spec.get("scheduling") or {}).get("queueShares")
+                            or {}
+                        ).items()
+                    },
+                    max_deadline_ms=int(
+                        (spec.get("scheduling") or {}).get("maxDeadlineMs", 0)
+                        or 0
+                    ),
+                ),
             ),
             status=ModelStatus(
                 replicas_all=int(
@@ -439,4 +519,13 @@ def _spec_to_dict(s: ModelSpec) -> dict:
         d["speculativeTokens"] = s.speculative_tokens
     if s.draft_url:
         d["draftUrl"] = s.draft_url
+    if s.scheduling.enabled():
+        sched: dict[str, Any] = {}
+        if s.scheduling.default_priority:
+            sched["defaultPriority"] = s.scheduling.default_priority
+        if s.scheduling.queue_shares:
+            sched["queueShares"] = dict(s.scheduling.queue_shares)
+        if s.scheduling.max_deadline_ms:
+            sched["maxDeadlineMs"] = s.scheduling.max_deadline_ms
+        d["scheduling"] = sched
     return d

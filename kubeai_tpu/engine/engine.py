@@ -27,7 +27,6 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from collections import deque
 from typing import Any, NamedTuple
 
 import jax
@@ -40,6 +39,11 @@ from kubeai_tpu.engine.sampling import SamplingParams, sample
 from kubeai_tpu.models.registry import ModelFamily, get_model_family
 from kubeai_tpu.parallel import sharding as psh
 from kubeai_tpu.parallel.mesh import single_device_mesh
+from kubeai_tpu.scheduling.scheduler import (
+    CLASS_RANK,
+    CLASS_STANDARD,
+    RequestScheduler,
+)
 
 
 def _now() -> float:
@@ -189,6 +193,11 @@ class _Request:
     params: SamplingParams
     seed: int
     adapter_idx: int = 0  # 0 = no adapter
+    # Scheduling identity: the priority class the scheduler resolved for
+    # this request (preemption prefers evicting the lowest class) and the
+    # fairness key it was queued under.
+    priority: str = CLASS_STANDARD
+    client: str = ""
     out_tokens: list[int] = dataclasses.field(default_factory=list)
     slot: int = -1
     position: int = 0  # absolute position of the next token to decode
@@ -225,6 +234,7 @@ class Engine:
         rules: psh.ShardingRules = psh.DEFAULT_RULES,
         eos_token_ids: tuple[int, ...] = (),
         draft: tuple[Any, Any] | None = None,
+        scheduler: RequestScheduler | None = None,
     ):
         """`draft`: optional (draft_cfg, draft_params) — a small same-family
         model that PROPOSES the speculative window (cfg.speculate > 0)
@@ -247,7 +257,10 @@ class Engine:
         self.eos_token_ids = eos_token_ids
         self._lock = threading.Lock()
         self._next_rid = 0
-        self._pending: deque[_Request] = deque()
+        # SLO-aware pending queue: priority bands with strict precedence,
+        # WFQ within a band keyed by client, deadline-aware admission
+        # (kubeai_tpu/scheduling). Replaces the former FIFO deque.
+        self._sched = scheduler if scheduler is not None else RequestScheduler()
         self._active: dict[int, _Request] = {}  # slot -> request
         self._requests: dict[int, _Request] = {}
         self._free_slots = list(range(cfg.num_slots))
@@ -1224,12 +1237,21 @@ class Engine:
         params: SamplingParams | None = None,
         adapter: str | None = None,
         on_admit=None,
+        priority: str | None = None,
+        client: str = "",
+        deadline_ms: float | None = None,
     ) -> int:
         """Queue a request. `on_admit(rid)` runs under the engine lock
         before the request becomes visible to `step()` — callers use it to
         register event subscribers without racing a concurrent serve loop
         (a request admitted and finished before registration would
-        otherwise drop its events)."""
+        otherwise drop its events).
+
+        Scheduling: `priority` is a class name (None = the scheduler
+        policy's default), `client` the WFQ fairness key, `deadline_ms`
+        an admission deadline — a deadline the scheduler judges
+        infeasible given queue state and the measured drain rate raises
+        `DeadlineInfeasible` and the request is NOT queued."""
         params = params or SamplingParams()
         adapter_idx = 0
         if adapter:
@@ -1258,6 +1280,7 @@ class Engine:
                 params=params,
                 seed=seed,
                 adapter_idx=adapter_idx,
+                client=client,
                 stop_token_ids=self.eos_token_ids,
                 t_enqueue=_now(),
             )
@@ -1268,11 +1291,22 @@ class Engine:
                 except BaseException:
                     del self._requests[rid]
                     raise
-            self._pending.append(req)
+            try:
+                req.priority = self._sched.submit(
+                    req,
+                    priority=priority,
+                    client=client,
+                    deadline_ms=deadline_ms,
+                )
+            except BaseException:
+                # Shed at enqueue (DeadlineInfeasible) or invalid
+                # scheduling args: the request never becomes visible.
+                del self._requests[rid]
+                raise
             return rid
 
     def has_work(self) -> bool:
-        return bool(self._pending or self._active or self._inflight)
+        return bool(len(self._sched) or self._active or self._inflight)
 
     @property
     def num_active(self) -> int:
@@ -1280,7 +1314,12 @@ class Engine:
 
     @property
     def num_pending(self) -> int:
-        return len(self._pending)
+        return len(self._sched)
+
+    @property
+    def scheduler(self) -> RequestScheduler:
+        """The request scheduler (queue-pressure snapshots, retry hints)."""
+        return self._sched
 
     def drain_timing(self) -> list[tuple[str, float]]:
         """Pop the accumulated latency observations: (kind, seconds) with
@@ -1313,11 +1352,12 @@ class Engine:
         return self.cfg.max_seq_len
 
     def _pop_pending(self) -> _Request:
-        """Dequeue the head request for admission, stamping the moment it
-        left the queue (queue-wait = this minus t_enqueue; prefill = first
-        token minus this). A preempted request keeps its original stamp —
-        its re-prefill is recompute, not a second queue wait."""
-        req = self._pending.popleft()
+        """Dequeue the scheduler's next request for admission, stamping
+        the moment it left the queue (queue-wait = this minus t_enqueue;
+        prefill = first token minus this). A preempted request keeps its
+        original stamp — its re-prefill is recompute, not a second queue
+        wait."""
+        req = self._sched.pop()
         if not req.t_admit_start:
             req.t_admit_start = _now()
         return req
@@ -1327,8 +1367,8 @@ class Engine:
         if self.cache_mode == "paged":
             return self._admit_pending_paged()
         emitted = []
-        while self._pending and self._free_slots:
-            req = self._pending[0]
+        while len(self._sched) and self._free_slots:
+            req = self._sched.peek()
             slot = self._free_slots[-1]
             # Preemption/resume only exists in paged mode; slot-mode
             # pending requests always start fresh.
@@ -1389,7 +1429,7 @@ class Engine:
 
         emitted: list[StepEvent] = []
         C = self.cfg.prefill_chunk
-        while self._pending and self._free_slots:
+        while len(self._sched) and self._free_slots:
             batch: list[
                 tuple[_Request, int, list[int], int, bool, list[bytes] | None]
             ] = []
@@ -1397,11 +1437,11 @@ class Engine:
             chunked = None  # long prompt diverted to the staged-chunk path
             prefix_hit = None  # cached prefix diverted to the suffix path
             while (
-                self._pending
+                len(self._sched)
                 and self._free_slots
                 and len(batch) < max(1, self.cfg.max_admit_batch)
             ):
-                req = self._pending[0]
+                req = self._sched.peek()
                 resumed = bool(req.out_tokens)
                 seq = (
                     req.prompt + req.out_tokens[:-1] if resumed
@@ -1893,7 +1933,15 @@ class Engine:
                     ]
                     if not victims:  # cannot happen (init invariant)
                         raise
-                    self._preempt(max(victims, key=lambda r: r.rid))
+                    # Victim selection: lowest priority class first (a
+                    # batch request must never evict a realtime one),
+                    # youngest within a class (least progress lost).
+                    self._preempt(max(
+                        victims,
+                        key=lambda r: (
+                            CLASS_RANK.get(r.priority, 0), r.rid
+                        ),
+                    ))
                     continue
                 break
             if len(pages) != before:
@@ -1918,7 +1966,7 @@ class Engine:
         self._alloc.release(slot)
         self._bt_host[slot] = -1
         self._bt_dirty = True
-        self._pending.appendleft(victim)
+        self._sched.requeue_front(victim)
 
     def _release(self, req: _Request) -> None:
         # Completed requests (not cancellations — a disconnect says
@@ -1931,8 +1979,7 @@ class Engine:
         # A preempted request can finish (stop/cancel) while waiting in
         # the pending queue — drop it there too, or re-admission would
         # resurrect a done request that leaks its slot and pages forever.
-        if req in self._pending:
-            self._pending.remove(req)
+        self._sched.remove(req)
         if req.slot >= 0:
             self._active.pop(req.slot, None)
             self._free_slots.append(req.slot)
@@ -1956,8 +2003,7 @@ class Engine:
             req = self._requests.get(rid)
             if req is None:
                 return False
-            if req in self._pending:
-                self._pending.remove(req)
+            self._sched.remove(req)
             req.done = True
             req.finish_reason = "cancelled"
             self._release(req)
@@ -2118,14 +2164,21 @@ class Engine:
                     self._spec_observe(
                         decode_mode, len(evs), time.perf_counter() - t0
                     )
+            step_s = time.perf_counter() - t0
+            # Feed the scheduler's drain-rate estimator: completed
+            # requests per second of engine-step wall time. Deadline
+            # feasibility and the computed Retry-After both divide queue
+            # depth by this rate.
+            finished = sum(1 for ev in emitted if ev.finished)
+            self._sched.observe_service(finished, step_s)
             # Per-decode-step snapshot for the serve loop's gauges. Plain
             # attribute write (already under the engine lock): the metrics
             # registry is never touched from this hot path.
             self.last_step_stats = {
                 "batch_size": len(self._active),
-                "waiting": len(self._pending),
+                "waiting": len(self._sched),
                 "tokens": len(emitted),
-                "duration_s": time.perf_counter() - t0,
+                "duration_s": step_s,
             }
             return emitted
 
@@ -2342,7 +2395,7 @@ class Engine:
         load/unload guards here and LockstepEngine's pre-broadcast
         mirror."""
         return any(
-            r.adapter_idx == slot for r in self._pending
+            r.adapter_idx == slot for r in self._sched.items()
         ) or any(r.adapter_idx == slot for r in self._active.values())
 
     def unload_adapter(self, name: str) -> bool:

@@ -296,7 +296,17 @@ class LockstepEngine:
         params: SamplingParams | None = None,
         adapter: str | None = None,
         on_admit=None,
+        priority: str | None = None,
+        client: str = "",
+        deadline_ms: float | None = None,
     ) -> int:
+        # Scheduling args are accepted for API parity with Engine but not
+        # broadcast: lockstep admission must replay in identical order on
+        # every host, so multi-host replicas keep FIFO ordering (every
+        # inner scheduler sees the same default-class submissions and WFQ
+        # degenerates to arrival order). Queue-full shedding still
+        # applies at the HTTP layer; per-class precedence and deadline
+        # shedding are single-host features for now.
         params = params or SamplingParams()
         if adapter and self.inner._lora is None:
             raise ValueError("LoRA is disabled (max_adapters=0)")

@@ -37,6 +37,10 @@ from kubeai_tpu.engine.sampling import SamplingParams
 from kubeai_tpu.metrics import tracing
 from kubeai_tpu.engine.tokenizer import Tokenizer, load_tokenizer
 from kubeai_tpu.metrics.registry import Counter, Gauge, Histogram, Registry
+from kubeai_tpu.scheduling import (
+    DeadlineInfeasible,
+    PRIORITY_CLASSES,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -176,6 +180,41 @@ class EngineMetrics:
             "fetch.",
             self.registry,
         )
+        # -- scheduler queue-pressure signal (per priority class) ----------
+        self.queue_depth = Gauge(
+            "kubeai_engine_queue_depth",
+            "Requests waiting in the scheduler, per priority class — the "
+            "autoscaler's queue-pressure depth signal.",
+            self.registry,
+        )
+        self.queue_oldest_wait = Gauge(
+            "kubeai_engine_queue_oldest_wait_seconds",
+            "Age of the oldest waiting request per priority class — the "
+            "autoscaler's queue-pressure staleness signal.",
+            self.registry,
+        )
+        self.queue_admitted = Gauge(
+            "kubeai_engine_queue_admitted_total",
+            "Requests dispatched out of the scheduler per priority class.",
+            self.registry,
+        )
+        self.queue_shed = Gauge(
+            "kubeai_engine_queue_shed_total",
+            "Requests shed at enqueue (infeasible deadline) per priority "
+            "class.",
+            self.registry,
+        )
+        self.queue_mean_wait = Gauge(
+            "kubeai_engine_queue_mean_wait_seconds",
+            "Mean queue wait of dispatched requests per priority class.",
+            self.registry,
+        )
+        self.sched_service_rate = Gauge(
+            "kubeai_engine_sched_service_rate",
+            "Scheduler drain-rate estimate (requests/second) used for "
+            "deadline feasibility and the computed Retry-After.",
+            self.registry,
+        )
 
     def observe_timing(self, kind: str, seconds: float) -> None:
         h = self._timing_hist.get(kind)
@@ -210,6 +249,21 @@ class EngineMetrics:
             self.tokens_per_step.set(step_stats.get("tokens", 0))
             self.step_duration.set(step_stats.get("duration_s", 0.0))
         self.kv_utilization.set(snap["kv_utilization"])
+        sched = snap.get("scheduler") or {}
+        for cls, stats in (sched.get("classes") or {}).items():
+            self.queue_depth.set(stats["depth"], **{"class": cls})
+            self.queue_oldest_wait.set(
+                stats["oldest_wait_s"], **{"class": cls}
+            )
+            self.queue_admitted.set(
+                stats["admitted_total"], **{"class": cls}
+            )
+            self.queue_shed.set(stats["shed_total"], **{"class": cls})
+            self.queue_mean_wait.set(
+                stats["mean_queue_wait_s"], **{"class": cls}
+            )
+        if sched:
+            self.sched_service_rate.set(sched.get("service_rate", 0.0))
 
 
 def engine_state_snapshot(engine) -> dict:
@@ -219,6 +273,7 @@ def engine_state_snapshot(engine) -> dict:
     uses); spec/prefix stats live only on the inner engine."""
     inner = getattr(engine, "inner", engine)  # LockstepEngine proxies
     kvu = getattr(inner, "kv_utilization", None)
+    sched = getattr(inner, "scheduler", None)
     return {
         "slots_active": engine.num_active,
         "requests_pending": engine.num_pending,
@@ -226,6 +281,9 @@ def engine_state_snapshot(engine) -> dict:
         "last_step": dict(getattr(inner, "last_step_stats", {}) or {}),
         "spec_stats": dict(getattr(inner, "spec_stats", {}) or {}),
         "prefix_stats": dict(getattr(inner, "prefix_stats", {}) or {}),
+        # Queue-pressure snapshot: per-class depth/oldest-wait/admitted/
+        # shed plus drain rate and the current computed retry hint.
+        "scheduler": sched.snapshot() if sched is not None else {},
     }
 
 
@@ -240,12 +298,19 @@ class EngineServer:
         adapter_fetcher=None,  # (name, url) -> adapter weight tree
         max_queue: int = 256,
         request_timeout: float = 600.0,
+        default_priority: str = "standard",
+        max_deadline_ms: int = 0,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.served_model_name = served_model_name
         self.metrics = EngineMetrics()
         self.adapter_fetcher = adapter_fetcher
+        # Scheduling defaults (CRD `scheduling:` block, rendered as engine
+        # flags): applied when the request carries no X-Priority /
+        # X-Deadline-Ms headers; max_deadline_ms caps client deadlines.
+        self.default_priority = default_priority
+        self.max_deadline_ms = max_deadline_ms
         # Adapter name -> source path/url it was loaded from. A load for a
         # name whose source CHANGED reloads instead of short-circuiting.
         self._adapter_sources: dict[str, str] = {}
@@ -497,17 +562,24 @@ class EngineServer:
             return http._json(
                 400, {"error": {"message": "n must be an integer in 1..8"}}
             )
-        # Bounded admission: past this depth requests would only pile onto
-        # the pending deque and blow the 600s budget anyway — shed early
-        # so the LB retries another replica (reference front-door survives
-        # 8000 conc because vLLM sheds; we do our own shedding). All n
-        # choices count against the bound.
-        if self.engine.num_pending + n > self.max_queue:
-            return http._json(
-                429,
-                {"error": {"message": "engine queue full, retry later"}},
-                headers={"Retry-After": "1"},
+        # Scheduling identity from headers (the front door and messenger
+        # propagate these): priority class, admission deadline, WFQ
+        # fairness key. Defaults come from the CRD scheduling block.
+        try:
+            priority, deadline_ms, sched_client = self._parse_scheduling(
+                http.headers, adapter
             )
+        except ValueError as e:
+            return http._json(400, {"error": {"message": str(e)}})
+        # Bounded admission: past this depth requests would only pile onto
+        # the scheduler and blow the 600s budget anyway — shed early so
+        # the LB retries another replica (reference front-door survives
+        # 8000 conc because vLLM sheds; we do our own shedding). All n
+        # choices count against the bound. The Retry-After is COMPUTED
+        # (queue depth ÷ measured drain rate) and the body carries
+        # per-class depths so clients and the LB can back off honestly.
+        if self.engine.num_pending + n > self.max_queue:
+            return self._shed_response(http, "engine queue full, retry later")
 
         if chat:
             messages = body.get("messages") or []
@@ -520,11 +592,6 @@ class EngineServer:
         if not prompt_ids:
             prompt_ids = [0]
 
-        max_tokens = int(
-            body.get("max_tokens")
-            or body.get("max_completion_tokens")
-            or 128
-        )
         room = self.engine.cfg.max_seq_len - len(prompt_ids) - 1
         if room <= 0:
             return http._json(
@@ -538,17 +605,13 @@ class EngineServer:
                     }
                 },
             )
-        sp = SamplingParams(
-            temperature=float(body.get("temperature", 1.0)),
-            top_k=int(body.get("top_k", 0)),
-            top_p=float(body.get("top_p", 1.0)),
-            max_tokens=min(max_tokens, room),
-            seed=body.get("seed"),
-            stop=tuple(
-                [body["stop"]] if isinstance(body.get("stop"), str)
-                else body.get("stop") or []
-            ),
-        )
+        # Sampling-parameter validation: malformed values must 400 with a
+        # clear message, never surface as a 500 traceback (and
+        # max_tokens: 0 is invalid, not a silent default).
+        try:
+            sp = self._parse_sampling(body, room)
+        except ValueError as e:
+            return http._json(400, {"error": {"message": str(e)}})
         stream = bool(body.get("stream", False))
         # Each choice gets a derived seed so explicit-seed requests stay
         # deterministic AND diverse. With the prefix cache on, choices
@@ -573,9 +636,22 @@ class EngineServer:
                         self._subscribers[rid] = _sub
 
                 rid_i = self.engine.add_request(
-                    prompt_ids, sp_i, adapter=adapter, on_admit=register
+                    prompt_ids, sp_i, adapter=adapter, on_admit=register,
+                    priority=priority, client=sched_client,
+                    deadline_ms=deadline_ms,
                 )
                 reqs.append((rid_i, sub_i, sp_i))
+        except DeadlineInfeasible as e:
+            # Shed at enqueue: the deadline cannot be met given queue
+            # state and the measured drain rate. Cancel any sibling
+            # choices that did make it in.
+            for rid_i, _, _ in reqs:
+                self.engine.cancel(rid_i)
+                with self._sub_lock:
+                    self._subscribers.pop(rid_i, None)
+            return self._shed_response(
+                http, str(e), retry_after=e.retry_after
+            )
         except KeyError as e:
             # Adapter unloaded between _resolve_model and admission.
             for rid_i, _, _ in reqs:
@@ -611,6 +687,119 @@ class EngineServer:
                 with self._sub_lock:
                     self._subscribers.pop(rid_i, None)
             self.metrics.active_requests.dec()
+
+    # -- scheduling & validation helpers ---------------------------------------
+
+    def _scheduler(self):
+        inner = getattr(self.engine, "inner", self.engine)
+        return getattr(inner, "scheduler", None)
+
+    def _parse_scheduling(self, headers, adapter):
+        """Resolve (priority, deadline_ms, client) from request headers +
+        CRD-defaulted server settings. Raises ValueError on malformed
+        values (the caller answers 400)."""
+        raw_prio = (headers.get("X-Priority") or "").strip().lower()
+        if raw_prio and raw_prio not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"X-Priority must be one of {'/'.join(PRIORITY_CLASSES)}, "
+                f"got {raw_prio!r}"
+            )
+        priority = raw_prio or self.default_priority
+        deadline_ms = None
+        raw_ddl = (headers.get("X-Deadline-Ms") or "").strip()
+        if raw_ddl:
+            try:
+                deadline_ms = float(raw_ddl)
+            except ValueError:
+                raise ValueError(
+                    f"X-Deadline-Ms must be a number of milliseconds, "
+                    f"got {raw_ddl!r}"
+                )
+            if deadline_ms <= 0:
+                raise ValueError("X-Deadline-Ms must be > 0")
+        if deadline_ms is None and self.max_deadline_ms > 0:
+            # The CRD cap doubles as the default deadline: every request
+            # gets feasibility-checked against the operator's bound.
+            deadline_ms = float(self.max_deadline_ms)
+        elif deadline_ms is not None and self.max_deadline_ms > 0:
+            deadline_ms = min(deadline_ms, float(self.max_deadline_ms))
+        # WFQ fairness key: explicit client id, else the adapter (tenant
+        # workloads commonly map 1:1 to adapters), else one shared key.
+        client = (headers.get("X-Client-Id") or "").strip() or (adapter or "")
+        return priority, deadline_ms, client
+
+    @staticmethod
+    def _parse_sampling(body: dict, room: int) -> SamplingParams:
+        """Validate OpenAI sampling fields; raises ValueError with a
+        client-readable message on malformed input."""
+
+        def _number(key, default, *, lo=None, hi=None, integer=False):
+            raw = body.get(key)
+            if raw is None:
+                return default
+            if isinstance(raw, bool) or not isinstance(raw, (int, float)):
+                raise ValueError(f"{key} must be a number, got {raw!r}")
+            if integer and not isinstance(raw, int):
+                raise ValueError(f"{key} must be an integer, got {raw!r}")
+            v = raw
+            if lo is not None and v < lo:
+                raise ValueError(f"{key} must be >= {lo}, got {v}")
+            if hi is not None and v > hi:
+                raise ValueError(f"{key} must be <= {hi}, got {v}")
+            return v
+
+        max_tokens = body.get("max_tokens")
+        if max_tokens is None:
+            max_tokens = body.get("max_completion_tokens")
+        if max_tokens is None:
+            max_tokens = 128
+        elif isinstance(max_tokens, bool) or not isinstance(max_tokens, int):
+            raise ValueError(
+                f"max_tokens must be a positive integer, got {max_tokens!r}"
+            )
+        elif max_tokens < 1:
+            # 0 is a client bug — defaulting it to 128 would silently
+            # burn a slot for output the client said it doesn't want.
+            raise ValueError(
+                f"max_tokens must be >= 1, got {max_tokens}"
+            )
+        temperature = float(_number("temperature", 1.0, lo=0.0))
+        top_p = float(_number("top_p", 1.0, hi=1.0))
+        if top_p <= 0.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        top_k = int(_number("top_k", 0, lo=0, integer=True))
+        return SamplingParams(
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+            max_tokens=min(max_tokens, room),
+            seed=body.get("seed"),
+            stop=tuple(
+                [body["stop"]] if isinstance(body.get("stop"), str)
+                else body.get("stop") or []
+            ),
+        )
+
+    def _shed_response(self, http, message: str, retry_after: float | None = None):
+        """429 with a COMPUTED Retry-After (queue depth ÷ drain rate, from
+        the scheduler — never a constant) and per-class queue depths in
+        the body, so clients and the LB can make informed retry
+        decisions."""
+        sched = self._scheduler()
+        if retry_after is None:
+            retry_after = sched.retry_after() if sched is not None else 1.0
+        depths = sched.class_depths() if sched is not None else {}
+        return http._json(
+            429,
+            {
+                "error": {"message": message},
+                "queue": {
+                    "depths": depths,
+                    "retry_after_s": round(retry_after, 3),
+                },
+            },
+            headers={"Retry-After": f"{retry_after:.3f}"},
+        )
 
     def _collect(self, rid, sub, sp, on_delta=None, deadline=None):
         """Drain tokens; detokenize incrementally; apply stop strings.
@@ -1025,6 +1214,29 @@ def main(argv=None) -> int:
         "one compiled graph for every prompt length",
     )
     ap.add_argument(
+        "--default-priority", default="standard",
+        choices=list(PRIORITY_CLASSES),
+        help="priority class for requests without an X-Priority header "
+        "(CRD scheduling.defaultPriority)",
+    )
+    ap.add_argument(
+        "--max-deadline-ms", type=int, default=0,
+        help="cap on client X-Deadline-Ms values, and the default "
+        "deadline when none is sent; 0 disables deadline admission "
+        "(CRD scheduling.maxDeadlineMs)",
+    )
+    ap.add_argument(
+        "--queue-shares", default="",
+        help="per-class dispatch shares guaranteeing lower bands a "
+        "fraction of admissions under sustained higher-priority load, "
+        "e.g. 'standard=0.3,batch=0.05' (CRD scheduling.queueShares)",
+    )
+    ap.add_argument(
+        "--max-queue", type=int, default=256,
+        help="pending-queue depth past which requests are shed with 429 "
+        "and a computed Retry-After",
+    )
+    ap.add_argument(
         "--prefix-cache", action="store_true",
         help="automatic prefix caching: shared prompt prefixes skip "
         "prefill (pairs with the router's PrefixHash affinity). Implies "
@@ -1116,6 +1328,24 @@ def main(argv=None) -> int:
         if args.tpu_topology
         else single_device_mesh()
     )
+    from kubeai_tpu.scheduling import RequestScheduler, SchedulingPolicy
+
+    shares: dict[str, float] = {}
+    if args.queue_shares:
+        for pair in args.queue_shares.split(","):
+            pair = pair.strip()
+            if not pair:
+                continue
+            cls, _, share = pair.partition("=")
+            shares[cls.strip()] = float(share)
+    scheduler = RequestScheduler(
+        SchedulingPolicy(
+            default_priority=args.default_priority,
+            queue_shares=shares,
+            max_deadline_ms=args.max_deadline_ms,
+        )
+    )
+
     tokenizer = load_tokenizer(model_dir)
     multihost = args.num_processes > 1
     engine = Engine(
@@ -1139,6 +1369,7 @@ def main(argv=None) -> int:
         ),
         eos_token_ids=tuple(getattr(tokenizer, "eos_token_ids", ())),
         draft=draft,
+        scheduler=scheduler,
     )
 
     if multihost and args.process_id != 0:
@@ -1175,6 +1406,9 @@ def main(argv=None) -> int:
         args.served_model_name,
         host=args.host,
         port=args.port,
+        max_queue=args.max_queue,
+        default_priority=args.default_priority,
+        max_deadline_ms=args.max_deadline_ms,
     )
     tracing.configure(service_name=f"kubeai-tpu-engine.{args.served_model_name}")
     server.start()

@@ -315,6 +315,18 @@ class Metrics:
             "state; resets on apply or on any non-down tick).",
             self.registry,
         )
+        self.autoscaler_queue_depth = Gauge(
+            "kubeai_autoscaler_queue_depth",
+            "Total requests waiting in the model's engine schedulers at "
+            "the last tick (queue-pressure demand signal).",
+            self.registry,
+        )
+        self.autoscaler_queue_oldest_wait = Gauge(
+            "kubeai_autoscaler_queue_oldest_wait_seconds",
+            "Age of the oldest queued request across the model's engines "
+            "at the last tick (queue-pressure staleness signal).",
+            self.registry,
+        )
 
 
 # Process-default bundle (single-replica processes, ad-hoc use).

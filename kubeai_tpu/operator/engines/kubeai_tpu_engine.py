@@ -47,6 +47,21 @@ def kubeai_tpu_pod(model: Model, cfg: System, mcfg: ModelConfig, suffix: str) ->
         args += ["--speculate", str(model.spec.speculative_tokens)]
     if model.spec.draft_url:
         args += ["--draft-url", model.spec.draft_url]
+    # SLO scheduling policy from the CRD scheduling: block (validated to
+    # the engine's priority classes at admission).
+    sched = model.spec.scheduling
+    if sched.default_priority:
+        args += ["--default-priority", sched.default_priority]
+    if sched.max_deadline_ms:
+        args += ["--max-deadline-ms", str(sched.max_deadline_ms)]
+    if sched.queue_shares:
+        args += [
+            "--queue-shares",
+            ",".join(
+                f"{cls}={share:g}"
+                for cls, share in sorted(sched.queue_shares.items())
+            ),
+        ]
     # Adapters are NOT baked into the spec: they hot-swap through the
     # /v1/load_lora_adapter admin API (see operator/adapters.py), so adapter
     # changes never trigger a pod rollout.

@@ -22,6 +22,7 @@ malformed-message flood backs off (reference: messenger.go:98-178).
 
 from __future__ import annotations
 
+import inspect
 import json
 import logging
 import queue
@@ -40,6 +41,29 @@ from kubeai_tpu.routing.modelclient import (
 logger = logging.getLogger(__name__)
 
 DEFAULT_PATH = "/v1/completions"
+
+# Message-metadata keys mapped onto the SLO-scheduling headers the engine
+# parses (kubeai_tpu/scheduling): async requests carry the same priority/
+# deadline/fairness identity as HTTP ones, so a batch pipeline publishing
+# messages competes in the same queue discipline as interactive clients.
+METADATA_SCHEDULING_KEYS = (
+    ("priority", "X-Priority"),
+    ("deadline_ms", "X-Deadline-Ms"),
+    ("client_id", "X-Client-Id"),
+)
+
+
+def scheduling_headers(metadata: dict) -> dict[str, str]:
+    """Extract scheduling headers from a message's metadata block.
+    Values are stringified verbatim — validation happens at the engine
+    (a bad class/deadline answers 400, which flows back on the response
+    topic like any other client error)."""
+    headers: dict[str, str] = {}
+    for key, header in METADATA_SCHEDULING_KEYS:
+        value = metadata.get(key)
+        if value is not None and value != "":
+            headers[header] = str(value)
+    return headers
 
 
 class Message:
@@ -128,6 +152,16 @@ class Messenger:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._http_send = http_send or self._default_http_send
+        # Backward-compatible seam: older injected senders take
+        # (addr, path, body); scheduling-aware ones add a headers kwarg.
+        try:
+            params = inspect.signature(self._http_send).parameters
+            self._send_takes_headers = "headers" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values()
+            )
+        except (TypeError, ValueError):  # builtins / C callables
+            self._send_takes_headers = False
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._receive_loop, daemon=True)
@@ -236,7 +270,13 @@ class Messenger:
                 strategy=model.spec.load_balancing.strategy,
             )
             try:
-                status, resp_body = self._http_send(addr, path, preq.body)
+                if self._send_takes_headers:
+                    status, resp_body = self._http_send(
+                        addr, path, preq.body,
+                        headers=scheduling_headers(metadata),
+                    )
+                else:
+                    status, resp_body = self._http_send(addr, path, preq.body)
             finally:
                 done()
         except LoadBalancerTimeout:
@@ -284,7 +324,9 @@ class Messenger:
             return False
 
     @staticmethod
-    def _default_http_send(addr: str, path: str, body: bytes) -> tuple[int, bytes]:
+    def _default_http_send(
+        addr: str, path: str, body: bytes, headers: dict | None = None
+    ) -> tuple[int, bytes]:
         """Plain non-streaming POST (reference: messenger.go:285-306)."""
         import http.client
 
@@ -295,7 +337,7 @@ class Messenger:
                 "POST",
                 path,
                 body=body,
-                headers={"Content-Type": "application/json"},
+                headers={"Content-Type": "application/json", **(headers or {})},
             )
             resp = conn.getresponse()
             return resp.status, resp.read()

@@ -156,16 +156,24 @@ class OpenAIServer:
                     )
                 # Continue an incoming W3C trace or start one; downstream
                 # (proxy → engine Pod) receives THIS span as parent.
+                span_attrs = {
+                    "http.route": normalized,
+                    "request.id": request_id,
+                }
+                # Scheduling headers ride through to the engine (proxy
+                # forwards them) and land on the span so a shed or slow
+                # request's class/deadline is visible end to end.
+                if headers.get("x-priority"):
+                    span_attrs["request.priority"] = headers["x-priority"]
+                if headers.get("x-deadline-ms"):
+                    span_attrs["request.deadline_ms"] = headers["x-deadline-ms"]
                 span = tracing.tracer().start_span(
                     f"POST {normalized}",
                     parent=tracing.parse_traceparent(
                         headers.get("traceparent")
                     ),
                     kind=tracing.KIND_SERVER,
-                    attributes={
-                        "http.route": normalized,
-                        "request.id": request_id,
-                    },
+                    attributes=span_attrs,
                 )
                 headers["traceparent"] = span.context.traceparent()
                 # Normally the chunk generator ends the span when the body

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import http.client
 import logging
+import random
 import time
 from typing import BinaryIO
 
@@ -36,6 +37,13 @@ MAX_RETRIES = 3
 # full — the retry re-runs AwaitBestAddress, which lands on a less-loaded
 # replica (body replay already buffered).
 RETRY_STATUSES = (429, 500, 502, 503, 504)
+
+# SLO-scheduling headers forwarded to engines (and stamped on spans):
+# priority class, admission deadline, WFQ fairness key.
+SCHEDULING_HEADERS = ("x-priority", "x-deadline-ms", "x-client-id")
+
+# Jitter source for the Retry-After backoff (monkeypatchable in tests).
+_jitter = random.random
 
 
 class ProxyResult:
@@ -158,6 +166,10 @@ class ModelProxy:
             }
             if request_id:
                 attempt_attrs["request.id"] = request_id
+            if headers.get("x-priority"):
+                attempt_attrs["request.priority"] = headers["x-priority"]
+            if headers.get("x-deadline-ms"):
+                attempt_attrs["request.deadline_ms"] = headers["x-deadline-ms"]
             attempt_span = tracing.tracer().start_span(
                 "proxy.attempt",
                 parent=trace_parent,
@@ -201,11 +213,19 @@ class ModelProxy:
                 # A shedding replica (429/503 + Retry-After) asked for
                 # backoff; under prefix-hash an immediate re-pick can land
                 # on the same replica, so honor a short pause (capped).
+                # JITTERED: a burst of concurrently-shed requests sleeping
+                # the same duration would re-pick in a synchronized
+                # stampede and — under prefix-hash — land on the same
+                # replica again; spreading each sleep over [0.5, 1.0]× the
+                # hint desynchronizes the herd while staying within the
+                # backoff the replica asked for.
                 if retry_after and resp.status in (429, 503):
                     try:
-                        time.sleep(min(float(retry_after), 2.0))
+                        base = min(float(retry_after), 2.0)
                     except ValueError:
                         pass
+                    else:
+                        time.sleep(base * (0.5 + 0.5 * _jitter()))
                 continue
             if resp.status >= 500:
                 attempt_span.set_attribute("http.status_code", resp.status)
@@ -254,7 +274,10 @@ def _send(addr: str, path: str, preq: apiutils.ParsedRequest, headers: dict):
         "Content-Type": preq.content_type,
         "Content-Length": str(len(preq.body)),
     }
-    for k in ("authorization", "accept", "x-request-id", "traceparent"):
+    for k in (
+        "authorization", "accept", "x-request-id", "traceparent",
+        *SCHEDULING_HEADERS,
+    ):
         if k in headers:
             fwd[k] = headers[k]
     conn.request("POST", path, body=preq.body, headers=fwd)

@@ -1,0 +1,33 @@
+"""SLO-aware request scheduling & admission control.
+
+The serving-path queue discipline that coordinated-autoscaling work
+assumes exists ("Taming the Chaos", arXiv:2508.19559) and that
+serverless-inference schedulers make central (SLINFER, arXiv:2507.00507):
+requests carry a priority class and an optional deadline, the pending
+queue orders by them (strict precedence between bands, weighted fair
+queueing within a band), and work whose deadline is infeasible given
+queue state and measured service rates is shed at enqueue with an honest,
+computed retry hint.
+"""
+
+from kubeai_tpu.scheduling.scheduler import (
+    CLASS_BATCH,
+    CLASS_RANK,
+    CLASS_REALTIME,
+    CLASS_STANDARD,
+    DeadlineInfeasible,
+    PRIORITY_CLASSES,
+    RequestScheduler,
+    SchedulingPolicy,
+)
+
+__all__ = [
+    "CLASS_BATCH",
+    "CLASS_RANK",
+    "CLASS_REALTIME",
+    "CLASS_STANDARD",
+    "DeadlineInfeasible",
+    "PRIORITY_CLASSES",
+    "RequestScheduler",
+    "SchedulingPolicy",
+]

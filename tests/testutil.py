@@ -13,7 +13,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 class FakeEngine:
     """Scripted engine backend. `behavior(path, body) -> (status, payload)`
-    overrides the default echo response."""
+    — or `(status, payload, headers)` — overrides the default echo
+    response."""
 
     def __init__(self, behavior=None):
         fake = self
@@ -31,13 +32,17 @@ class FakeEngine:
                 fake.request_headers.append(
                     {k.lower(): v for k, v in self.headers.items()}
                 )
-                status, payload = (fake.behavior or fake.default)(
+                result = (fake.behavior or fake.default)(
                     self.path, req_body
                 )
+                status, payload = result[0], result[1]
+                extra_headers = result[2] if len(result) > 2 else {}
                 body = json.dumps(payload).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in extra_headers.items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 

@@ -300,3 +300,117 @@ def test_messenger_receive_loop_end_to_end(msg_world):
         assert json.loads(resp.body)["metadata"]["id"] == 42
     finally:
         msgr.stop()
+
+
+def test_messenger_maps_metadata_to_scheduling_headers(msg_world):
+    """Message metadata (priority/deadline_ms/client_id) maps onto the
+    same X-Priority/X-Deadline-Ms/X-Client-Id headers the HTTP path
+    uses, so async requests compete in the engine's queue discipline."""
+    store, broker, lb, msgr, sent = msg_world
+    _ready_pod(store, lb)
+    captured = []
+
+    def send_with_headers(addr, path, body, headers=None):
+        captured.append(headers)
+        return 200, json.dumps({"ok": True}).encode()
+
+    msgr2 = Messenger(
+        broker, "requests", "responses", lb,
+        msgr.model_client, http_send=send_with_headers,
+    )
+    msg = Message(
+        json.dumps(
+            {
+                "metadata": {
+                    "priority": "batch",
+                    "deadline_ms": 60000,
+                    "client_id": "pipeline-7",
+                    "trace": "t-2",
+                },
+                "path": "/v1/completions",
+                "body": {"model": "m1", "prompt": "hi"},
+            }
+        ).encode()
+    )
+    assert msgr2.handle_request(msg) is False and msg.acked is True
+    assert captured == [
+        {
+            "X-Priority": "batch",
+            "X-Deadline-Ms": "60000",
+            "X-Client-Id": "pipeline-7",
+        }
+    ]
+    # Metadata without scheduling keys maps to no headers (and legacy
+    # 3-arg senders — like msg_world's fake_send — keep working, which
+    # test_messenger_roundtrip covers).
+    msg2 = Message(
+        json.dumps(
+            {"metadata": {"trace": "t-3"},
+             "body": {"model": "m1", "prompt": "hi"}}
+        ).encode()
+    )
+    assert msgr2.handle_request(msg2) is False
+    assert captured[-1] == {}
+
+
+def test_autoscaler_queue_pressure_boosts_desired_replicas():
+    """Requests waiting in the engines' schedulers count as unmet demand
+    once the oldest waiter ages past queuePressureMaxWait: the tick's
+    desired replicas rise above what the active-request average alone
+    would give, and the decision record carries the queue signal."""
+    srv = FakeMetricsServer(metrics_text("m1", 10))  # avg 10 -> 1 replica
+    try:
+        store, cfg, scaler = make_world([srv], interval=10, window=10)
+        cfg.model_autoscaling.queue_pressure_max_wait_seconds = 3.0
+        # 35 queued + oldest waiter 5s > 3s bound -> ceil((10+35)/10) = 5.
+        scaler.queue_scraper = lambda addrs: {
+            "depth": 35.0,
+            "oldest_wait_s": 5.0,
+            "per_class": {"standard": 30.0, "batch": 5.0},
+        }
+        scaler.tick()
+        assert store.get("Model", "default", "m1")["spec"]["replicas"] == 5
+        rec = scaler.last_decisions[0]
+        assert rec["computed_replicas"] == 5
+        assert rec["queue_depth"] == 35.0
+        assert rec["queue_oldest_wait_s"] == 5.0
+        assert rec["queue_per_class"] == {"standard": 30.0, "batch": 5.0}
+
+        # Young queue (oldest waiter under the bound): no boost — queued
+        # work that is draining promptly is not unmet demand.
+        scaler2_servers = [FakeMetricsServer(metrics_text("m1", 10))]
+        store2, cfg2, scaler2 = make_world(scaler2_servers, interval=10, window=10)
+        scaler2.queue_scraper = lambda addrs: {
+            "depth": 35.0, "oldest_wait_s": 0.5, "per_class": {}
+        }
+        scaler2.tick()
+        assert store2.get("Model", "default", "m1")["spec"]["replicas"] == 1
+        for s in scaler2_servers:
+            s.stop()
+    finally:
+        srv.stop()
+
+
+def test_scrape_queue_pressure_parses_engine_gauges():
+    """The queue-pressure scrape sums per-class depth across engines,
+    takes the max oldest-wait, and skips unreachable endpoints instead
+    of failing the tick."""
+    from kubeai_tpu.autoscaler.autoscaler import scrape_queue_pressure
+
+    text = (
+        "# TYPE kubeai_engine_queue_depth gauge\n"
+        'kubeai_engine_queue_depth{class="realtime"} 2\n'
+        'kubeai_engine_queue_depth{class="standard"} 3\n'
+        "# TYPE kubeai_engine_queue_oldest_wait_seconds gauge\n"
+        'kubeai_engine_queue_oldest_wait_seconds{class="standard"} 4.5\n'
+    )
+    srvs = [FakeMetricsServer(text), FakeMetricsServer(text)]
+    try:
+        addrs = [s.addr for s in srvs] + ["127.0.0.1:1"]  # one dead
+        out = scrape_queue_pressure(addrs, timeout=2)
+        assert out["depth"] == 10.0
+        assert out["oldest_wait_s"] == 4.5
+        assert out["per_class"] == {"realtime": 4.0, "standard": 6.0}
+    finally:
+        for s in srvs:
+            s.stop()

@@ -161,3 +161,63 @@ messaging:
     assert d["resourceProfiles"]["cpu"]["requests"]["memory"] == "4Gi"
     assert d["modelRollouts"]["surge"] == 1
     assert d["messaging"]["streams"][0]["responseTopic"] == "mem://responses"
+
+
+def test_scheduling_block_valid_and_roundtrip():
+    from kubeai_tpu.crd.model import Scheduling
+
+    m = valid_model(
+        scheduling=Scheduling(
+            default_priority="realtime",
+            queue_shares={"standard": 0.3, "batch": 0.05},
+            max_deadline_ms=30000,
+        )
+    )
+    m.validate()
+    d = m.to_dict()
+    assert d["spec"]["scheduling"] == {
+        "defaultPriority": "realtime",
+        "queueShares": {"standard": 0.3, "batch": 0.05},
+        "maxDeadlineMs": 30000,
+    }
+    back = Model.from_dict(d)
+    assert back.spec.scheduling == m.spec.scheduling
+    # Default (disabled) scheduling is omitted from the manifest.
+    assert "scheduling" not in valid_model().to_dict()["spec"]
+    assert Model.from_dict(valid_model().to_dict()).spec.scheduling.enabled() is False
+
+
+@pytest.mark.parametrize(
+    "sched_kw, engine",
+    [
+        ({"default_priority": "urgent"}, "KubeAITPU"),
+        ({"queue_shares": {"nope": 0.1}}, "KubeAITPU"),
+        ({"queue_shares": {"batch": 1.0}}, "KubeAITPU"),
+        ({"queue_shares": {"batch": -0.1}}, "KubeAITPU"),
+        ({"max_deadline_ms": -1}, "KubeAITPU"),
+        # scheduling: is an in-tree engine feature (like speculation).
+        ({"default_priority": "realtime"}, "VLLM"),
+    ],
+)
+def test_scheduling_block_invalid(sched_kw, engine):
+    from kubeai_tpu.crd.model import Scheduling
+
+    kw = {"scheduling": Scheduling(**sched_kw), "engine": engine}
+    if engine == "VLLM":
+        kw["resource_profile"] = ""
+    with pytest.raises(ValidationError):
+        valid_model(**kw).validate()
+
+
+def test_queue_pressure_config_parses_and_validates():
+    sys_obj = system_from_dict(
+        {"modelAutoscaling": {"interval": "5s", "timeWindow": "60s",
+                              "queuePressureMaxWait": "7s"}}
+    )
+    assert sys_obj.model_autoscaling.queue_pressure_max_wait_seconds == 7.0
+    sys_obj.default_and_validate()
+    from kubeai_tpu.config.system import ConfigError
+
+    sys_obj.model_autoscaling.queue_pressure_max_wait_seconds = -1
+    with pytest.raises(ConfigError):
+        sys_obj.default_and_validate()

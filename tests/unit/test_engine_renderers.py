@@ -154,3 +154,25 @@ def test_kubeai_tpu_renderer_speculation_flags(cfg):
     # Absent fields render no flags (vanilla decode).
     args2 = container(render(cfg, mk("KubeAITPU", "hf://org/model")))["args"]
     assert "--speculate" not in args2 and "--draft-url" not in args2
+
+
+def test_kubeai_tpu_renderer_scheduling_flags(cfg):
+    from kubeai_tpu.crd.model import Scheduling
+
+    m = mk(
+        "KubeAITPU", "hf://org/repo",
+        scheduling=Scheduling(
+            default_priority="realtime",
+            queue_shares={"standard": 0.3, "batch": 0.05},
+            max_deadline_ms=30000,
+        ),
+    )
+    args = container(render(cfg, m))["args"]
+    assert args[args.index("--default-priority") + 1] == "realtime"
+    assert args[args.index("--max-deadline-ms") + 1] == "30000"
+    assert args[args.index("--queue-shares") + 1] == "batch=0.05,standard=0.3"
+    # No scheduling block -> no flags (engine defaults apply).
+    plain = container(render(cfg, mk("KubeAITPU", "hf://org/repo")))["args"]
+    assert "--default-priority" not in plain
+    assert "--queue-shares" not in plain
+    assert "--max-deadline-ms" not in plain

@@ -549,3 +549,69 @@ def test_scale_from_zero_via_proxy(stack):
     lb.sync_model("m0")
     t.join(timeout=5)
     assert result["resp"][0] == 200
+
+
+# ---- SLO-scheduling header propagation & shed backoff -----------------------
+
+
+def test_scheduling_headers_forwarded_to_engine(stack):
+    """X-Priority / X-Deadline-Ms / X-Client-Id ride through the proxy to
+    the engine (which parses them for priority/deadline admission)."""
+    _, _, server, add_model, engines = stack
+    add_model()
+    status, _ = http_post(
+        server.address,
+        "/openai/v1/completions",
+        {"model": "m1", "prompt": "x"},
+        headers={
+            "X-Priority": "realtime",
+            "X-Deadline-Ms": "1500",
+            "X-Client-Id": "tenant-a",
+        },
+    )
+    assert status == 200
+    seen = engines[0].request_headers[-1]
+    assert seen.get("x-priority") == "realtime"
+    assert seen.get("x-deadline-ms") == "1500"
+    assert seen.get("x-client-id") == "tenant-a"
+
+
+def test_retry_after_sleep_is_jittered(stack, monkeypatch):
+    """Shed backoff sleeps base*(0.5 + 0.5*jitter): concurrently-shed
+    requests must NOT all sleep the same duration (synchronized re-pick
+    stampede lands on the same replica under prefix-hash)."""
+    from kubeai_tpu.routing import proxy as proxy_mod
+
+    _, _, server, add_model, engines = stack
+    add_model()
+    eng = engines[0]
+    sleeps: list[float] = []
+    monkeypatch.setattr(
+        proxy_mod.time, "sleep", lambda s: sleeps.append(s)
+    )
+
+    def run_once(jitter_value):
+        calls = {"n": 0}
+
+        def shedding(path, body):
+            calls["n"] += 1
+            if calls["n"] < 2:
+                return 429, {"error": "shed"}, {"Retry-After": "2.0"}
+            return 200, {"ok": True}
+
+        eng.behavior = shedding
+        monkeypatch.setattr(proxy_mod, "_jitter", lambda: jitter_value)
+        status, _ = _post(
+            server, "/openai/v1/completions", {"model": "m1", "prompt": "x"}
+        )
+        assert status == 200
+
+    run_once(1.0)
+    run_once(0.0)
+    assert len(sleeps) == 2
+    # Retry-After 2.0 capped at 2.0: jitter 1.0 -> full 2.0s, jitter 0.0
+    # -> half. Two shed requests with different jitter draws sleep
+    # differently — no herd.
+    assert sleeps[0] == pytest.approx(2.0)
+    assert sleeps[1] == pytest.approx(1.0)
+    assert sleeps[0] != sleeps[1]
