@@ -1,0 +1,342 @@
+"""Deterministic resilience simulation — no JAX, no sockets.
+
+Drives a real load-balancer `Group` (circuit breakers on a fake clock)
+through a 3-endpoint kill / recover / flap schedule using the proxy's
+retry discipline (≤3 attempts, exclude-set on retry, concurrent request
+waves so LeastLoad actually spreads), and reports the invariants the
+resilience layer promises:
+
+  * breaker correctness: zero requests are ever routed to an endpoint
+    whose circuit is open;
+  * availability floor: with 1 of 3 endpoints hard-down, ≥ 99% of
+    requests succeed using at most one extra attempt each;
+  * fail-fast: when EVERY endpoint's circuit is open, the pick raises
+    `NoHealthyEndpoints` immediately (with per-endpoint error context)
+    instead of hanging to the scale-from-zero timeout;
+  * half-open probes are singular: while one probe is in flight, no
+    second request reaches the recovering endpoint;
+  * recovery: a recovered endpoint rejoins the rotation after one
+    successful probe, and a flapping endpoint keeps overall availability
+    at the floor.
+
+`tests/unit/test_resilience.py::test_resilience_simulation_invariants`
+asserts these on a small configuration, so breaker regressions fail
+tier-1 instead of only showing up during a production incident. Run
+directly for the full-size report:
+
+    python benchmarks/resilience_sim.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.routing.health import BreakerPolicy
+from kubeai_tpu.routing.loadbalancer import (
+    Group,
+    LoadBalancerTimeout,
+    NoHealthyEndpoints,
+)
+from kubeai_tpu.testing.faults import FakeClock, Fault, FaultPlan
+
+ENDPOINTS = ("a:1", "b:1", "c:1")
+MAX_ATTEMPTS = 3  # mirrors proxy.MAX_RETRIES
+
+
+def _run_wave(group, is_down, sporadic, concurrency, dispatches):
+    """One wave of `concurrency` concurrent requests, each following the
+    proxy's retry discipline: pick (excluding already-failed addresses),
+    hold the in-flight slot while the whole wave picks (this is what
+    spreads LeastLoad), then resolve outcomes and retry the failures.
+
+    Returns (ok_flags, attempts_used, open_picks, fail_fasts)."""
+    ok = [False] * concurrency
+    attempts_used = [0] * concurrency
+    open_picks = 0
+    fail_fasts = 0
+    pending: list[tuple[int, set]] = [(i, set()) for i in range(concurrency)]
+    for _wave in range(MAX_ATTEMPTS):
+        picks = []
+        for i, failed in pending:
+            try:
+                addr, done = group.get_best_addr(
+                    "LeastLoad", "", "", timeout=0.5, exclude=failed,
+                )
+            except NoHealthyEndpoints:
+                fail_fasts += 1
+                continue
+            if group.snapshot()["endpoints"][addr]["state"] == "open":
+                open_picks += 1  # invariant violation: recorded, not raised
+            attempts_used[i] += 1
+            picks.append((i, failed, addr, done))
+        retry: list[tuple[int, set]] = []
+        for i, failed, addr, done in picks:
+            fault = None
+            if is_down(addr):
+                fault = "connect_error"
+            elif sporadic is not None and sporadic.on_attempt(addr) is not None:
+                fault = "5xx"
+            if fault is None:
+                done(outcome="success")
+                ok[i] = True
+                dispatches[addr] = dispatches.get(addr, 0) + 1
+            else:
+                done(outcome=fault, error=f"injected {fault} at {addr}")
+                failed.add(addr)
+                retry.append((i, failed))
+        pending = retry
+        if not pending:
+            break
+    return ok, attempts_used, open_picks, fail_fasts
+
+
+def run_sim(
+    waves_per_phase: int = 200,
+    concurrency: int = 3,
+    dt: float = 0.05,
+    open_seconds: float = 5.0,
+    flap_period: int = 20,
+    seed: int = 7,
+) -> dict:
+    """Three phases of `waves_per_phase` waves (each `concurrency`
+    concurrent requests), clock advancing `dt` per wave:
+
+      one_down — endpoint b refuses every connection (crashed replica);
+      recovered — all endpoints healthy, plus a sporadic 503 on endpoint
+                  a every 29th attempt (blips that must NOT trip the
+                  breaker);
+      flap — endpoint c alternates dead/alive every `flap_period` waves
+             (crash-looping replica).
+    """
+    clock = FakeClock()
+    policy = BreakerPolicy(
+        window=10,
+        consecutive_failures=3,
+        failure_rate=0.5,
+        min_samples=5,
+        open_seconds=open_seconds,
+    )
+    group = Group(
+        metrics=Metrics(), model="sim", breaker=policy, clock=clock,
+    )
+    group.reconcile_endpoints({ep: set() for ep in ENDPOINTS})
+
+    phases = ("one_down", "recovered", "flap")
+    stats = {
+        p: {
+            "requests": 0, "success": 0, "fail_fasts": 0,
+            "attempts_hist": {1: 0, 2: 0, 3: 0},
+            "dispatches": {},
+        }
+        for p in phases
+    }
+    open_picks_total = 0
+    sporadic = FaultPlan(
+        [Fault("a:1", "http", every=29, status=503)], seed=seed
+    )
+
+    for phase in phases:
+        for w in range(waves_per_phase):
+            if phase == "one_down":
+                def is_down(addr):
+                    return addr == "b:1"
+            elif phase == "recovered":
+                def is_down(addr):
+                    return False
+            else:
+                flapping = (w // flap_period) % 2 == 0
+                def is_down(addr, flapping=flapping):
+                    return addr == "c:1" and flapping
+            ok, attempts, open_picks, fail_fasts = _run_wave(
+                group, is_down,
+                sporadic if phase == "recovered" else None,
+                concurrency, stats[phase]["dispatches"],
+            )
+            st = stats[phase]
+            st["requests"] += concurrency
+            st["success"] += sum(ok)
+            st["fail_fasts"] += fail_fasts
+            for a in attempts:
+                if a:
+                    st["attempts_hist"][a] += 1
+            open_picks_total += open_picks
+            clock.advance(dt)
+
+    summary = {
+        "phases": {
+            p: {
+                "requests": st["requests"],
+                "success_rate": st["success"] / st["requests"],
+                "fail_fasts": st["fail_fasts"],
+                "attempts_hist": st["attempts_hist"],
+                "max_attempts": max(
+                    (a for a, n in st["attempts_hist"].items() if n),
+                    default=0,
+                ),
+                "dispatches": st["dispatches"],
+            }
+            for p, st in stats.items()
+        },
+        "open_circuit_picks": open_picks_total,
+        "b_state_after_recovery": (
+            group.snapshot()["endpoints"]["b:1"]["state"]
+        ),
+        "b_serves_after_recovery": (
+            stats["recovered"]["dispatches"].get("b:1", 0)
+        ),
+        "fail_fast": _check_fail_fast(open_seconds),
+        "probe_singular": _check_probe_singularity(open_seconds),
+        "snapshot": group.snapshot(),
+    }
+    return summary
+
+
+def _check_fail_fast(open_seconds: float) -> dict:
+    """All three endpoints down: once the breakers trip, the pick must
+    raise NoHealthyEndpoints IMMEDIATELY (with per-endpoint error
+    context), never hang to the LoadBalancerTimeout."""
+    clock = FakeClock()
+    group = Group(
+        metrics=Metrics(), model="sim-alldown",
+        breaker=BreakerPolicy(consecutive_failures=2, open_seconds=open_seconds),
+        clock=clock,
+    )
+    group.reconcile_endpoints({ep: set() for ep in ENDPOINTS})
+    # Trip every breaker.
+    for _ in range(2):
+        for ep in ENDPOINTS:
+            failed = set(ENDPOINTS) - {ep}
+            addr, done = group.get_best_addr(
+                "LeastLoad", "", "", timeout=0.5, exclude=failed
+            )
+            done(outcome="connect_error", error=f"injected: {addr} refused")
+    result = {"raised": False, "has_context": False, "hung": False}
+    import time as _time
+
+    t0 = _time.monotonic()
+    try:
+        # A generous timeout that fail-fast must NOT consume.
+        group.get_best_addr("LeastLoad", "", "", timeout=30.0)
+    except NoHealthyEndpoints as e:
+        result["raised"] = True
+        result["has_context"] = all(ep in str(e) for ep in ENDPOINTS)
+    except LoadBalancerTimeout:
+        pass
+    result["hung"] = (_time.monotonic() - t0) > 1.0
+    return result
+
+
+def _check_probe_singularity(open_seconds: float) -> dict:
+    """An open circuit past its backoff admits exactly ONE probe: while
+    the probe is in flight no other request may reach the endpoint, and
+    the probe's outcome decides re-admission."""
+    clock = FakeClock()
+    group = Group(
+        metrics=Metrics(), model="sim-probe",
+        breaker=BreakerPolicy(consecutive_failures=2, open_seconds=open_seconds),
+        clock=clock,
+    )
+    group.reconcile_endpoints({"a:1": set(), "b:1": set()})
+    # Trip b.
+    for _ in range(2):
+        addr, done = group.get_best_addr(
+            "LeastLoad", "", "", timeout=0.5, exclude={"a:1"}
+        )
+        done(outcome="connect_error", error="injected")
+    # Hold one request on a so the recovering b is the LeastLoad choice
+    # once its backoff elapses.
+    _a_addr, a_done = group.get_best_addr("LeastLoad", "", "", timeout=0.5)
+    clock.advance(open_seconds + 0.1)  # backoff elapsed → probe eligible
+    probe_addr, probe_done = group.get_best_addr(
+        "LeastLoad", "", "", timeout=0.5
+    )
+    singular = True
+    # While the probe is in flight, 20 more picks: none may reach b.
+    for _ in range(20):
+        addr, done = group.get_best_addr("LeastLoad", "", "", timeout=0.5)
+        if addr == "b:1":
+            singular = False
+        done(outcome="success")
+    state_during = group.snapshot()["endpoints"]["b:1"]["state"]
+    probe_done(outcome="success")  # probe succeeds → circuit closes
+    a_done(outcome="success")
+    state_after = group.snapshot()["endpoints"]["b:1"]["state"]
+    return {
+        "probe_went_to_open_endpoint": probe_addr == "b:1",
+        "singular": singular,
+        "state_during_probe": state_during,
+        "closed_after_probe_success": state_after == "closed",
+    }
+
+
+def check_invariants(summary: dict) -> list[str]:
+    """Returns a list of violated invariants (empty = all hold)."""
+    errors = []
+    if summary["open_circuit_picks"] != 0:
+        errors.append(
+            f"routing: {summary['open_circuit_picks']} request(s) were "
+            "routed to an open-circuit endpoint"
+        )
+    one_down = summary["phases"]["one_down"]
+    if one_down["success_rate"] < 0.99:
+        errors.append(
+            "availability: 1-of-3 hard-down success rate "
+            f"{one_down['success_rate']:.4f} < 0.99"
+        )
+    if one_down["max_attempts"] > 2:
+        errors.append(
+            "availability: a request under 1-of-3 loss needed "
+            f"{one_down['max_attempts']} attempts (> one extra)"
+        )
+    for phase in ("recovered", "flap"):
+        rate = summary["phases"][phase]["success_rate"]
+        if rate < 0.99:
+            errors.append(f"{phase}: success rate {rate:.4f} < 0.99")
+    if summary["b_serves_after_recovery"] == 0:
+        errors.append(
+            "recovery: endpoint b never rejoined the rotation after "
+            "its circuit should have re-closed"
+        )
+    if summary["b_state_after_recovery"] != "closed":
+        errors.append(
+            "recovery: endpoint b's circuit is "
+            f"{summary['b_state_after_recovery']!r} after the recovered "
+            "phase (want closed)"
+        )
+    ff = summary["fail_fast"]
+    if not ff["raised"]:
+        errors.append("fail-fast: all-endpoints-open did not raise "
+                      "NoHealthyEndpoints")
+    if not ff["has_context"]:
+        errors.append("fail-fast: the 503 context is missing per-endpoint "
+                      "last-seen errors")
+    if ff["hung"]:
+        errors.append("fail-fast: the pick blocked instead of failing "
+                      "immediately")
+    ps = summary["probe_singular"]
+    if not ps["probe_went_to_open_endpoint"]:
+        errors.append("half-open: the post-backoff probe did not go to the "
+                      "recovering endpoint")
+    if not ps["singular"]:
+        errors.append("half-open: a second request reached the endpoint "
+                      "while the probe was in flight")
+    if not ps["closed_after_probe_success"]:
+        errors.append("half-open: a successful probe did not close the "
+                      "circuit")
+    return errors
+
+
+def main() -> int:
+    summary = run_sim()
+    errors = check_invariants(summary)
+    print(json.dumps({"summary": summary, "violations": errors}, indent=2))
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -10,5 +10,6 @@ from kubeai_tpu.config.system import (
     Messaging,
     MessageStream,
     LeaderElectionConfig,
+    Resilience,
     load_config_file,
 )

@@ -129,6 +129,26 @@ class LeaderElectionConfig:
     retry_period_seconds: float = 2.0
 
 
+@dataclasses.dataclass
+class Resilience:
+    """Fault-handling defaults for the serving path (no reference analog
+    — the reference's proxy retries blind with a hardcoded 300s timeout,
+    internal/modelproxy/handler.go). Per-model overrides live on the
+    Model CRD (`loadBalancing.circuitBreaker`, `drainTimeoutSeconds`)."""
+
+    # Proxy attempt timeouts: TCP connect, then first response header.
+    connect_timeout_seconds: float = 2.0
+    response_header_timeout_seconds: float = 300.0
+    # Circuit-breaker defaults (kubeai_tpu/routing/health.BreakerPolicy).
+    breaker_window: int = 20
+    breaker_consecutive_failures: int = 3
+    breaker_failure_rate: float = 0.5
+    breaker_min_samples: int = 5
+    breaker_open_seconds: float = 10.0
+    # Engine graceful-drain budget (SIGTERM → in-flight completion).
+    drain_timeout_seconds: float = 30.0
+
+
 DEFAULT_MODEL_SERVERS: dict[str, dict[str, str]] = {
     # engine -> imageName -> image (reference: charts/kubeai/values.yaml:40-60).
     # The TPU engine serves from this repo's image; CPU variant for e2e tests.
@@ -191,6 +211,7 @@ class System:
     leader_election: LeaderElectionConfig = dataclasses.field(
         default_factory=LeaderElectionConfig
     )
+    resilience: Resilience = dataclasses.field(default_factory=Resilience)
     metrics_addr: str = ":8080"
     api_addr: str = ":8000"
     allow_pod_address_override: bool = False  # test hook (reference: main_test.go:258)
@@ -210,6 +231,25 @@ class System:
             raise ConfigError("modelAutoscaling.queuePressureMaxWait must be >= 0")
         if self.model_rollouts.surge < 0:
             raise ConfigError("modelRollouts.surge must be >= 0")
+        r = self.resilience
+        if r.connect_timeout_seconds <= 0:
+            raise ConfigError("resilience.connectTimeout must be > 0")
+        if r.response_header_timeout_seconds <= 0:
+            raise ConfigError("resilience.responseHeaderTimeout must be > 0")
+        if r.breaker_window < 1:
+            raise ConfigError("resilience.breakerWindow must be >= 1")
+        if r.breaker_consecutive_failures < 0:
+            raise ConfigError(
+                "resilience.breakerConsecutiveFailures must be >= 0"
+            )
+        if not 0.0 < r.breaker_failure_rate:
+            raise ConfigError("resilience.breakerFailureRate must be > 0")
+        if r.breaker_min_samples < 1:
+            raise ConfigError("resilience.breakerMinSamples must be >= 1")
+        if r.breaker_open_seconds <= 0:
+            raise ConfigError("resilience.breakerOpenSeconds must be > 0")
+        if r.drain_timeout_seconds <= 0:
+            raise ConfigError("resilience.drainTimeout must be > 0")
         for name, prof in self.resource_profiles.items():
             if not isinstance(prof, ResourceProfile):
                 raise ConfigError(f"resourceProfiles[{name}] invalid")
@@ -513,6 +553,22 @@ def system_from_dict(data: dict) -> System:
             lease_duration_seconds=_seconds(le.get("leaseDuration", 15)),
             renew_deadline_seconds=_seconds(le.get("renewDeadline", 10)),
             retry_period_seconds=_seconds(le.get("retryPeriod", 2)),
+        )
+    if "resilience" in data:
+        r = data["resilience"]
+        sys_obj.resilience = Resilience(
+            connect_timeout_seconds=_seconds(r.get("connectTimeout", 2)),
+            response_header_timeout_seconds=_seconds(
+                r.get("responseHeaderTimeout", 300)
+            ),
+            breaker_window=int(r.get("breakerWindow", 20)),
+            breaker_consecutive_failures=int(
+                r.get("breakerConsecutiveFailures", 3)
+            ),
+            breaker_failure_rate=float(r.get("breakerFailureRate", 0.5)),
+            breaker_min_samples=int(r.get("breakerMinSamples", 5)),
+            breaker_open_seconds=_seconds(r.get("breakerOpenSeconds", 10)),
+            drain_timeout_seconds=_seconds(r.get("drainTimeout", 30)),
         )
     if "metricsAddr" in data:
         sys_obj.metrics_addr = data["metricsAddr"]

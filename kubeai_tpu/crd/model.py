@@ -98,16 +98,63 @@ class PrefixHash:
 
 
 @dataclasses.dataclass
+class CircuitBreakerSpec:
+    """Per-model circuit-breaker tuning (no reference analog — the
+    reference trusts readiness probes alone). Every field defaults to 0
+    meaning "inherit the system config `resilience:` default"; set
+    fields override per model (kubeai_tpu/routing/health.py holds the
+    state machine)."""
+
+    # Sliding window of attempt outcomes considered by the rate rule.
+    window: int = 0
+    # Trip after this many consecutive failures.
+    consecutive_failures: int = 0
+    # Trip when >= minSamples outcomes are windowed and the failure
+    # fraction reaches this rate (percent-free fraction in (0, 1]).
+    failure_rate: float = 0.0
+    min_samples: int = 0
+    # Seconds an open circuit waits before admitting a half-open probe.
+    open_seconds: float = 0.0
+
+    def enabled(self) -> bool:
+        return bool(
+            self.window or self.consecutive_failures or self.failure_rate
+            or self.min_samples or self.open_seconds
+        )
+
+    def validate(self) -> None:
+        if self.window < 0:
+            raise ValidationError("circuitBreaker.window must be >= 0")
+        if self.consecutive_failures < 0:
+            raise ValidationError(
+                "circuitBreaker.consecutiveFailures must be >= 0"
+            )
+        if not 0.0 <= self.failure_rate <= 1.0:
+            raise ValidationError(
+                "circuitBreaker.failureRate must be in [0, 1], got "
+                f"{self.failure_rate}"
+            )
+        if self.min_samples < 0:
+            raise ValidationError("circuitBreaker.minSamples must be >= 0")
+        if self.open_seconds < 0:
+            raise ValidationError("circuitBreaker.openSeconds must be >= 0")
+
+
+@dataclasses.dataclass
 class LoadBalancing:
     """(reference: api/k8s/v1/model_types.go:172-188)"""
 
     strategy: str = LB_STRATEGY_LEAST_LOAD
     prefix_hash: PrefixHash = dataclasses.field(default_factory=PrefixHash)
+    circuit_breaker: CircuitBreakerSpec = dataclasses.field(
+        default_factory=CircuitBreakerSpec
+    )
 
     def validate(self) -> None:
         if self.strategy not in (LB_STRATEGY_LEAST_LOAD, LB_STRATEGY_PREFIX_HASH):
             raise ValidationError(f"unknown loadBalancing.strategy {self.strategy!r}")
         self.prefix_hash.validate()
+        self.circuit_breaker.validate()
 
 
 # Priority classes of the in-tree engine's scheduler
@@ -201,6 +248,12 @@ class ModelSpec:
     draft_url: str = ""
     # SLO-aware queue discipline (in-tree engine only).
     scheduling: Scheduling = dataclasses.field(default_factory=Scheduling)
+    # Graceful-drain budget: seconds an engine waits for in-flight
+    # generations after SIGTERM / POST /v1/drain before terminating the
+    # remainder. 0 = the system config `resilience.drainTimeout`
+    # default. Rendered as the engine's --drain-timeout flag plus the
+    # Pod's terminationGracePeriodSeconds and preStop hook.
+    drain_timeout_seconds: int = 0
 
     def url_scheme(self) -> str:
         return self.url.split("://", 1)[0] if "://" in self.url else ""
@@ -274,6 +327,12 @@ class ModelSpec:
         if self.scheduling.enabled() and self.engine != ENGINE_KUBEAI_TPU:
             raise ValidationError(
                 "spec.scheduling requires the KubeAITPU engine"
+            )
+        if self.drain_timeout_seconds < 0:
+            raise ValidationError("drainTimeoutSeconds must be >= 0")
+        if self.drain_timeout_seconds and self.engine != ENGINE_KUBEAI_TPU:
+            raise ValidationError(
+                "spec.drainTimeoutSeconds requires the KubeAITPU engine"
             )
         if self.target_requests < 1:
             raise ValidationError("targetRequests must be >= 1")
@@ -397,6 +456,7 @@ class Model:
         status = d.get("status", {}) or {}
         lb = spec.get("loadBalancing", {}) or {}
         ph = lb.get("prefixHash", {}) or {}
+        cb = lb.get("circuitBreaker", {}) or {}
         return Model(
             name=meta.get("name", ""),
             namespace=meta.get("namespace", "default"),
@@ -434,6 +494,15 @@ class Model:
                         replication=int(ph.get("replication", 256)),
                         prefix_char_length=int(ph.get("prefixCharLength", 100)),
                     ),
+                    circuit_breaker=CircuitBreakerSpec(
+                        window=int(cb.get("window", 0) or 0),
+                        consecutive_failures=int(
+                            cb.get("consecutiveFailures", 0) or 0
+                        ),
+                        failure_rate=float(cb.get("failureRate", 0) or 0),
+                        min_samples=int(cb.get("minSamples", 0) or 0),
+                        open_seconds=float(cb.get("openSeconds", 0) or 0),
+                    ),
                 ),
                 files=[
                     File(path=f.get("path", ""), content=f.get("content", ""))
@@ -443,6 +512,9 @@ class Model:
                 owner=spec.get("owner", ""),
                 speculative_tokens=int(spec.get("speculativeTokens", 0) or 0),
                 draft_url=spec.get("draftUrl", ""),
+                drain_timeout_seconds=int(
+                    spec.get("drainTimeoutSeconds", 0) or 0
+                ),
                 scheduling=Scheduling(
                     default_priority=(
                         (spec.get("scheduling") or {}).get("defaultPriority", "")
@@ -509,6 +581,22 @@ def _spec_to_dict(s: ModelSpec) -> dict:
             "prefixCharLength": s.load_balancing.prefix_hash.prefix_char_length,
         },
     }
+    cb = s.load_balancing.circuit_breaker
+    if cb.enabled():
+        cbd: dict[str, Any] = {}
+        if cb.window:
+            cbd["window"] = cb.window
+        if cb.consecutive_failures:
+            cbd["consecutiveFailures"] = cb.consecutive_failures
+        if cb.failure_rate:
+            cbd["failureRate"] = cb.failure_rate
+        if cb.min_samples:
+            cbd["minSamples"] = cb.min_samples
+        if cb.open_seconds:
+            cbd["openSeconds"] = cb.open_seconds
+        d["loadBalancing"]["circuitBreaker"] = cbd
+    if s.drain_timeout_seconds:
+        d["drainTimeoutSeconds"] = s.drain_timeout_seconds
     if s.files:
         d["files"] = [{"path": f.path, "content": f.content} for f in s.files]
     if s.priority_class_name:

@@ -221,6 +221,11 @@ class _Request:
     t_prev_token: float = 0.0
 
 
+class EngineDraining(RuntimeError):
+    """Raised by add_request once drain has begun: the server answers
+    503 + Retry-After so the LB moves the request to another replica."""
+
+
 class Engine:
     """Single-model, single-mesh continuous-batching engine."""
 
@@ -257,6 +262,11 @@ class Engine:
         self.eos_token_ids = eos_token_ids
         self._lock = threading.Lock()
         self._next_rid = 0
+        # Graceful drain: once set, add_request refuses (EngineDraining)
+        # while in-flight generations run to completion — the server's
+        # drain sequence flips this before it stops the HTTP front so
+        # the admission race window is closed at the source.
+        self._draining = False
         # SLO-aware pending queue: priority bands with strict precedence,
         # WFQ within a band keyed by client, deadline-aware admission
         # (kubeai_tpu/scheduling). Replaces the former FIFO deque.
@@ -1267,6 +1277,8 @@ class Engine:
                 f"prompt length {len(prompt_tokens)} >= max_seq_len {self.cfg.max_seq_len}"
             )
         with self._lock:
+            if self._draining:
+                raise EngineDraining("engine is draining")
             rid = self._next_rid
             self._next_rid += 1
             seed = (
@@ -1304,6 +1316,16 @@ class Engine:
                 del self._requests[rid]
                 raise
             return rid
+
+    def begin_drain(self) -> None:
+        """Stop admitting new requests; queued + active work continues
+        until finished (or the server's drain budget terminates it)."""
+        with self._lock:
+            self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     def has_work(self) -> bool:
         return bool(len(self._sched) or self._active or self._inflight)

@@ -32,7 +32,12 @@ from http.server import BaseHTTPRequestHandler
 
 from kubeai_tpu.httpserver import DeepBacklogHTTPServer
 
-from kubeai_tpu.engine.engine import Engine, EngineConfig
+from kubeai_tpu.engine.engine import (
+    Engine,
+    EngineConfig,
+    EngineDraining,
+    StepEvent,
+)
 from kubeai_tpu.engine.sampling import SamplingParams
 from kubeai_tpu.metrics import tracing
 from kubeai_tpu.engine.tokenizer import Tokenizer, load_tokenizer
@@ -215,6 +220,19 @@ class EngineMetrics:
             "deadline feasibility and the computed Retry-After.",
             self.registry,
         )
+        # -- graceful drain ------------------------------------------------
+        self.draining = Gauge(
+            "kubeai_engine_draining",
+            "1 while the server is draining (refusing new work, "
+            "completing in-flight generations), else 0.",
+            self.registry,
+        )
+        self.drain_terminated = Gauge(
+            "kubeai_engine_drain_terminated_requests_total",
+            "In-flight requests terminated because the drain budget "
+            "expired before they completed.",
+            self.registry,
+        )
 
     def observe_timing(self, kind: str, seconds: float) -> None:
         h = self._timing_hist.get(kind)
@@ -300,6 +318,7 @@ class EngineServer:
         request_timeout: float = 600.0,
         default_priority: str = "standard",
         max_deadline_ms: int = 0,
+        drain_timeout: float = 30.0,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
@@ -320,6 +339,14 @@ class EngineServer:
         self._sub_lock = threading.Lock()
         self._stop = threading.Event()
         self._work = threading.Event()
+        # Graceful drain (SIGTERM / POST /v1/drain): refuse new work with
+        # 503 + Retry-After, finish in-flight generations up to
+        # drain_timeout, then terminate the stragglers cleanly.
+        self.drain_timeout = drain_timeout
+        self._draining = threading.Event()
+        self._drained = threading.Event()
+        self._drain_started = 0.0
+        self._drain_thread: threading.Thread | None = None
         self._loop_thread = threading.Thread(target=self._serve_loop, daemon=True)
 
         outer = self
@@ -346,9 +373,19 @@ class EngineServer:
             def do_GET(self):
                 path = self.path.split("?")[0]
                 if path == "/health":
+                    if outer.draining:
+                        # The LB's health view must eject this replica
+                        # while the drain runs.
+                        return self._json(
+                            503, {"status": "draining", "draining": True}
+                        )
                     if outer.healthy():
                         return self._json(200, {"status": "ok"})
                     return self._json(503, {"status": "unhealthy"})
+                if path == "/v1/drain":
+                    # kubelet preStop httpGet can only send GET — the
+                    # drain trigger accepts it alongside the POST form.
+                    return self._json(202, outer.begin_drain())
                 if path == "/metrics":
                     outer.metrics.sync_engine(outer.engine)
                     body = outer.metrics.registry.expose().encode()
@@ -382,6 +419,7 @@ class EngineServer:
                         {
                             "model": outer.served_model_name,
                             "healthy": outer.healthy(),
+                            "draining": outer.draining,
                             "adapters": outer.engine.loaded_adapters(),
                             **engine_state_snapshot(outer.engine),
                         },
@@ -418,6 +456,8 @@ class EngineServer:
                 self._last_status = 200
                 try:
                     try:
+                        if path == "/v1/drain":
+                            return self._json(202, outer.begin_drain())
                         if path == "/v1/chat/completions":
                             return outer._handle_generate(self, body, chat=True)
                         if path == "/v1/completions":
@@ -519,6 +559,119 @@ class EngineServer:
     def healthy(self) -> bool:
         return not self._loop_dead and not self._stop.is_set()
 
+    # -- graceful drain ---------------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def begin_drain(self) -> dict:
+        """Start the drain sequence (idempotent): stop admitting, let
+        in-flight generations finish, terminate stragglers when the
+        budget runs out. Returns the status payload /v1/drain answers."""
+        if not self._draining.is_set():
+            self._drain_started = time.monotonic()
+            self._draining.set()
+            self.metrics.draining.set(1)
+            # Close the admission race at the engine too: a request that
+            # slipped past the handler's check still gets refused.
+            inner = getattr(self.engine, "inner", self.engine)
+            begin = getattr(inner, "begin_drain", None)
+            if begin is not None:
+                begin()
+            self._work.set()
+            self._drain_thread = threading.Thread(
+                target=self._drain_worker, daemon=True
+            )
+            self._drain_thread.start()
+            logger.info(
+                "drain started: %d active, %d pending, budget %.1fs",
+                self.engine.num_active, self.engine.num_pending,
+                self.drain_timeout,
+            )
+        return {
+            "draining": True,
+            "active": self.engine.num_active,
+            "pending": self.engine.num_pending,
+            "drain_timeout_s": self.drain_timeout,
+            "elapsed_s": round(time.monotonic() - self._drain_started, 3),
+        }
+
+    def _drain_worker(self) -> None:
+        deadline = self._drain_started + self.drain_timeout
+        while time.monotonic() < deadline:
+            with self._sub_lock:
+                streams = len(self._subscribers)
+            if (
+                streams == 0
+                and self.engine.num_active == 0
+                and self.engine.num_pending == 0
+            ):
+                self._drained.set()
+                logger.info(
+                    "drain complete: all in-flight work finished in %.2fs",
+                    time.monotonic() - self._drain_started,
+                )
+                return
+            time.sleep(0.02)
+        # Budget exhausted: terminate the remaining streams CLEANLY — a
+        # kill sentinel per subscriber makes its collector emit a final
+        # chunk and release the slot, instead of the process exit
+        # snapping TCP connections mid-token.
+        with self._sub_lock:
+            leftovers = list(self._subscribers.items())
+        for rid, sub in leftovers:
+            self.engine.cancel(rid)
+            sub.put(
+                StepEvent(
+                    rid=rid, token=-1, finished=True,
+                    finish_reason="cancelled",
+                )
+            )
+        if leftovers:
+            self.metrics.drain_terminated.set(len(leftovers))
+            logger.warning(
+                "drain budget (%.1fs) expired: terminated %d in-flight "
+                "request(s)", self.drain_timeout, len(leftovers),
+            )
+        # Give the collectors a moment to flush their final chunks.
+        flush_deadline = time.monotonic() + 2.0
+        while time.monotonic() < flush_deadline:
+            with self._sub_lock:
+                if not self._subscribers:
+                    break
+            time.sleep(0.02)
+        self._drained.set()
+
+    def wait_drained(self, timeout: float | None = None) -> bool:
+        """Block until the drain sequence finished (True) or `timeout`
+        elapsed (False). The process entrypoint exits on True."""
+        return self._drained.wait(
+            timeout=self.drain_timeout + 5.0 if timeout is None else timeout
+        )
+
+    def _drain_refusal(self, http):
+        """503 for work arriving during drain: computed Retry-After (the
+        remaining drain budget — by then kubelet has restarted us or the
+        LB moved on) and Connection: close so the client's keep-alive
+        doesn't pin a dying server."""
+        remaining = max(
+            1.0,
+            self._drain_started + self.drain_timeout - time.monotonic(),
+        )
+        http.close_connection = True
+        return http._json(
+            503,
+            {
+                "error": {"message": "server is draining, retry elsewhere"},
+                "draining": True,
+            },
+            headers={
+                "Retry-After": f"{remaining:.0f}",
+                "Connection": "close",
+            },
+        )
+
     # -- request handling -------------------------------------------------------
 
     def _resolve_model(self, requested: str) -> tuple[str, str | None] | None:
@@ -535,6 +688,8 @@ class EngineServer:
         return None
 
     def _handle_generate(self, http, body: dict, chat: bool):
+        if self._draining.is_set():
+            return self._drain_refusal(http)
         model_field = str(body.get("model") or self.served_model_name)
         resolved = self._resolve_model(model_field)
         if resolved is None:
@@ -652,6 +807,13 @@ class EngineServer:
             return self._shed_response(
                 http, str(e), retry_after=e.retry_after
             )
+        except EngineDraining:
+            # Drain began between the handler check and admission.
+            for rid_i, _, _ in reqs:
+                self.engine.cancel(rid_i)
+                with self._sub_lock:
+                    self._subscribers.pop(rid_i, None)
+            return self._drain_refusal(http)
         except KeyError as e:
             # Adapter unloaded between _resolve_model and admission.
             for rid_i, _, _ in reqs:
@@ -823,6 +985,12 @@ class EngineServer:
             except queue.Empty:
                 # Stalled engine or abandoned stream: stop decoding now —
                 # otherwise the request keeps a batch slot to max_tokens.
+                self.engine.cancel(rid)
+                finish = "timeout"
+                break
+            if ev.token < 0:
+                # Drain-kill sentinel: the drain budget expired; end this
+                # stream cleanly with whatever was generated so far.
                 self.engine.cancel(rid)
                 finish = "timeout"
                 break
@@ -1237,6 +1405,12 @@ def main(argv=None) -> int:
         "and a computed Retry-After",
     )
     ap.add_argument(
+        "--drain-timeout", type=float, default=30.0,
+        help="graceful-drain budget in seconds: after SIGTERM or POST "
+        "/v1/drain, in-flight generations get this long to finish "
+        "before being terminated (CRD spec.drainTimeoutSeconds)",
+    )
+    ap.add_argument(
         "--prefix-cache", action="store_true",
         help="automatic prefix caching: shared prompt prefixes skip "
         "prefill (pairs with the router's PrefixHash affinity). Implies "
@@ -1409,17 +1583,42 @@ def main(argv=None) -> int:
         max_queue=args.max_queue,
         default_priority=args.default_priority,
         max_deadline_ms=args.max_deadline_ms,
+        drain_timeout=args.drain_timeout,
     )
     tracing.configure(service_name=f"kubeai-tpu-engine.{args.served_model_name}")
     server.start()
     log.info("engine serving on %s:%d", args.host, server.port)
+
+    # SIGTERM (pod deletion / rollout) triggers the graceful drain: stop
+    # admitting, flip /health so the LB ejects us, finish in-flight work
+    # within --drain-timeout, then exit. The renderer sets
+    # terminationGracePeriodSeconds above this budget so kubelet's KILL
+    # never races the drain.
+    import signal
+
+    exit_evt = threading.Event()
+
+    def _drain_and_exit():
+        server.begin_drain()
+        server.wait_drained()
+        exit_evt.set()
+
+    def _on_sigterm(signum, frame):
+        log.info("SIGTERM: draining (budget %.1fs)", args.drain_timeout)
+        threading.Thread(target=_drain_and_exit, daemon=True).start()
+
     try:
-        while True:
-            time.sleep(5)
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded/test use)
+    try:
+        while not exit_evt.wait(timeout=0.5):
+            pass
     except KeyboardInterrupt:
-        server.stop()
-        if multihost:
-            engine.shutdown()  # release the workers
+        pass
+    server.stop()
+    if multihost:
+        engine.shutdown()  # release the workers
     return 0
 
 

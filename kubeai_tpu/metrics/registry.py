@@ -54,6 +54,14 @@ class _Metric:
         with self._lock:
             return self._values.get(tuple(sorted(labels.items())), 0.0)
 
+    def remove(self, **labels) -> None:
+        """Drop one label-set's series (endpoint churn would otherwise
+        accrete stale series forever on long-lived registries)."""
+        with self._lock:
+            k = tuple(sorted(labels.items()))
+            self._values.pop(k, None)
+            self._label_keys.pop(k, None)
+
     def collect(self) -> list[str]:
         with self._lock:
             lines = [
@@ -273,6 +281,31 @@ class Metrics:
             "kubeai_proxy_retries_total",
             "Proxy attempts that failed and were retried on another "
             "endpoint, per model.",
+            self.registry,
+        )
+        # -- resilience: circuit breaker + fault accounting ----------------
+        self.lb_circuit_state = Gauge(
+            "kubeai_lb_circuit_state",
+            "Per-endpoint circuit breaker state: 0 closed, 1 half-open, "
+            "2 open.",
+            self.registry,
+        )
+        self.lb_circuit_ejections = Counter(
+            "kubeai_lb_circuit_ejections_total",
+            "Times an endpoint's circuit tripped open (ejected from the "
+            "load-balancer candidate set).",
+            self.registry,
+        )
+        self.proxy_midstream_failures = Counter(
+            "kubeai_proxy_midstream_failures_total",
+            "Streams that died after headers were sent (terminal SSE "
+            "error event emitted), per model.",
+            self.registry,
+        )
+        self.proxy_deadline_exhausted = Counter(
+            "kubeai_proxy_deadline_exhausted_total",
+            "Requests whose X-Deadline-Ms budget ran out before a retry "
+            "could be attempted, per model.",
             self.registry,
         )
         # -- autoscaler decision telemetry ---------------------------------

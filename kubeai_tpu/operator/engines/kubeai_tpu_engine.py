@@ -47,6 +47,16 @@ def kubeai_tpu_pod(model: Model, cfg: System, mcfg: ModelConfig, suffix: str) ->
         args += ["--speculate", str(model.spec.speculative_tokens)]
     if model.spec.draft_url:
         args += ["--draft-url", model.spec.draft_url]
+    # Graceful drain: CRD drainTimeoutSeconds, defaulted from the system
+    # config resilience block. The same number drives the engine's
+    # --drain-timeout, the preStop drain trigger, and (plus slack for
+    # the final flush) terminationGracePeriodSeconds — so kubelet's KILL
+    # can never race the in-flight completions the engine is waiting on.
+    drain_timeout = int(
+        model.spec.drain_timeout_seconds
+        or cfg.resilience.drain_timeout_seconds
+    )
+    args += ["--drain-timeout", str(drain_timeout)]
     # SLO scheduling policy from the CRD scheduling: block (validated to
     # the engine's priority classes at admission).
     sched = model.spec.scheduling
@@ -95,6 +105,16 @@ def kubeai_tpu_pod(model: Model, cfg: System, mcfg: ModelConfig, suffix: str) ->
             "periodSeconds": 30,
             "failureThreshold": 3,
         },
+        # preStop fires BEFORE kubelet sends SIGTERM: the drain endpoint
+        # flips /health to 503 (LB ejection) and stops admission while
+        # routing still points here — no request lands on a dying Pod.
+        # (kubelet's httpGet hook can only GET; the server accepts GET
+        # /v1/drain for exactly this.)
+        "lifecycle": {
+            "preStop": {
+                "httpGet": {"path": "/v1/drain", "port": PORT},
+            },
+        },
     }
     if cfg.model_server_pods.container_security_context:
         container["securityContext"] = cfg.model_server_pods.container_security_context
@@ -103,6 +123,10 @@ def kubeai_tpu_pod(model: Model, cfg: System, mcfg: ModelConfig, suffix: str) ->
 
     pod["spec"]["containers"] = [container]
     pod["spec"]["volumes"] = volumes
+    # Drain budget + 15s slack for the terminated-straggler flush and
+    # process teardown; kubelet's default 30s would KILL mid-drain for
+    # any model configured above it.
+    pod["spec"]["terminationGracePeriodSeconds"] = drain_timeout + 15
     pod["metadata"]["annotations"]["model-pod-port"] = str(PORT)
     return pod
 

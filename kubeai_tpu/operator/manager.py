@@ -62,7 +62,20 @@ class Manager:
         # Per-replica instrument bundle: embedded multi-replica setups must
         # not share counters (the leader scrapes every replica and sums).
         self.metrics = Metrics()
-        self.lb = LoadBalancer(self.store, metrics=self.metrics)
+        from kubeai_tpu.routing.health import BreakerPolicy
+
+        res = self.cfg.resilience
+        default_breaker = BreakerPolicy(
+            window=res.breaker_window,
+            consecutive_failures=res.breaker_consecutive_failures,
+            failure_rate=res.breaker_failure_rate,
+            min_samples=res.breaker_min_samples,
+            open_seconds=res.breaker_open_seconds,
+        )
+        self.lb = LoadBalancer(
+            self.store, metrics=self.metrics,
+            default_breaker=default_breaker,
+        )
         self.model_client = ModelClient(self.store, self.namespace)
         self.reconciler = ModelReconciler(
             self.store,
@@ -87,7 +100,15 @@ class Manager:
             namespace=self.namespace,
             metrics=self.metrics,
         )
-        self.proxy = ModelProxy(self.lb, self.model_client, metrics=self.metrics)
+        from kubeai_tpu.routing.proxy import ProxyTimeouts
+
+        self.proxy = ModelProxy(
+            self.lb, self.model_client, metrics=self.metrics,
+            timeouts=ProxyTimeouts(
+                connect_s=res.connect_timeout_seconds,
+                response_header_s=res.response_header_timeout_seconds,
+            ),
+        )
         self.api_server = OpenAIServer(
             self.proxy,
             self.model_client,

@@ -1,0 +1,195 @@
+"""Passive endpoint health tracking + circuit breaking for the load
+balancer (no reference analog — the reference KubeAI leans entirely on
+Kubernetes readiness probes, internal/modelproxy/handler.go retries
+blind; here the proxy reports every attempt outcome and the breaker
+ejects endpoints faster than kubelet can notice).
+
+State machine per endpoint:
+
+    CLOSED ──(consecutive failures OR failure rate over window)──▶ OPEN
+    OPEN ──(open_seconds backoff elapsed)──▶ HALF_OPEN
+    HALF_OPEN ──(single probe succeeds)──▶ CLOSED
+    HALF_OPEN ──(probe fails)──▶ OPEN (backoff restarts)
+
+Half-open admits exactly ONE probe request: availability requires the
+endpoint to have zero requests in flight, so while the probe is on the
+wire no second request can be routed there — singularity falls out of
+the in-flight accounting instead of a separate token that could leak.
+
+Outcome vocabulary (what the proxy reports):
+
+    success        2xx/4xx response (the endpoint answered coherently)
+    connect_error  TCP connect refused/reset/unreachable
+    timeout        connect or response-header deadline exceeded
+    5xx            HTTP 500/502/503/504 from the engine
+    midstream      connection died partway through a streamed body
+    shed           HTTP 429 flow control — NOT a breaker failure (the
+                   endpoint is healthy, just busy)
+
+All time flows through an injectable clock so the fault-injection sim
+and the unit tests drive the breaker deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+
+STATE_CLOSED = "closed"
+STATE_OPEN = "open"
+STATE_HALF_OPEN = "half_open"
+
+OUTCOME_SUCCESS = "success"
+OUTCOME_CONNECT_ERROR = "connect_error"
+OUTCOME_TIMEOUT = "timeout"
+OUTCOME_5XX = "5xx"
+OUTCOME_MIDSTREAM = "midstream"
+OUTCOME_SHED = "shed"
+
+# Outcomes that count against the breaker. 429 shed is flow control from
+# a live engine — tripping on it would eject healthy-but-busy replicas
+# and amplify the overload onto the survivors.
+FAILURE_OUTCOMES = frozenset(
+    (OUTCOME_CONNECT_ERROR, OUTCOME_TIMEOUT, OUTCOME_5XX, OUTCOME_MIDSTREAM)
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BreakerPolicy:
+    """Thresholds for one endpoint's breaker. Defaults come from the
+    system config `resilience:` block; the Model CRD's
+    `loadBalancing.circuitBreaker` overrides per model."""
+
+    # Sliding window of most-recent attempt outcomes considered by the
+    # failure-rate rule.
+    window: int = 20
+    # Trip after this many consecutive failures (0 disables the rule).
+    consecutive_failures: int = 3
+    # Trip when at least min_samples outcomes are in the window and the
+    # failure fraction reaches this rate (>= 1.0 disables the rule).
+    failure_rate: float = 0.5
+    min_samples: int = 5
+    # Seconds an open circuit waits before admitting a half-open probe.
+    open_seconds: float = 10.0
+
+    def validate(self) -> None:
+        if self.window < 1:
+            raise ValueError("breaker window must be >= 1")
+        if self.consecutive_failures < 0:
+            raise ValueError("breaker consecutiveFailures must be >= 0")
+        if not 0.0 < self.failure_rate:
+            raise ValueError("breaker failureRate must be > 0")
+        if self.min_samples < 1:
+            raise ValueError("breaker minSamples must be >= 1")
+        if self.open_seconds <= 0:
+            raise ValueError("breaker openSeconds must be > 0")
+
+
+class EndpointHealth:
+    """One endpoint's outcome window + breaker state. NOT thread-safe on
+    its own — the owning Group serializes access under its condition
+    lock (the same lock that guards in-flight accounting, which the
+    half-open probe rule reads)."""
+
+    __slots__ = (
+        "policy", "clock", "state", "_window", "_consecutive",
+        "_opened_at", "ejections", "last_error",
+    )
+
+    def __init__(
+        self,
+        policy: BreakerPolicy | None = None,
+        clock=time.monotonic,
+    ):
+        self.policy = policy or BreakerPolicy()
+        self.clock = clock
+        self.state = STATE_CLOSED
+        self._window: deque[bool] = deque(maxlen=self.policy.window)
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self.ejections = 0
+        self.last_error = ""
+
+    def set_policy(self, policy: BreakerPolicy) -> None:
+        if policy == self.policy:
+            return
+        self.policy = policy
+        # Re-window without losing recent history.
+        self._window = deque(self._window, maxlen=policy.window)
+
+    def available(self, in_flight: int = 0) -> bool:
+        """May a request be routed here right now? Open circuits whose
+        backoff elapsed count as available ONLY while nothing is in
+        flight — that one admitted request IS the half-open probe."""
+        if self.state == STATE_CLOSED:
+            return True
+        if self.state == STATE_OPEN:
+            if self.clock() - self._opened_at < self.policy.open_seconds:
+                return False
+            return in_flight == 0
+        # HALF_OPEN: the probe is singular.
+        return in_flight == 0
+
+    def on_pick(self) -> None:
+        """Called when the Group routes a request here. An open circuit
+        past its backoff transitions to half-open — the caller verified
+        availability (and therefore probe singularity) first."""
+        if self.state == STATE_OPEN:
+            self.state = STATE_HALF_OPEN
+
+    def record(self, outcome: str, error: str = "") -> bool:
+        """Fold one attempt outcome in. Returns True when the state
+        CHANGED (the caller refreshes metrics / wakes waiters)."""
+        if outcome == OUTCOME_SHED:
+            return False  # flow control; no breaker signal either way
+        failed = outcome in FAILURE_OUTCOMES
+        self._window.append(failed)
+        if failed:
+            self._consecutive += 1
+            self.last_error = error or outcome
+        else:
+            self._consecutive = 0
+        if self.state == STATE_HALF_OPEN:
+            # The probe's outcome decides re-admission outright.
+            if failed:
+                self._trip()
+            else:
+                self._reset()
+            return True
+        if self.state == STATE_CLOSED and failed and self._should_trip():
+            self._trip()
+            return True
+        return False
+
+    def _should_trip(self) -> bool:
+        p = self.policy
+        if p.consecutive_failures and self._consecutive >= p.consecutive_failures:
+            return True
+        if p.failure_rate < 1.0 and len(self._window) >= p.min_samples:
+            rate = sum(self._window) / len(self._window)
+            if rate >= p.failure_rate:
+                return True
+        return False
+
+    def _trip(self) -> None:
+        self.state = STATE_OPEN
+        self._opened_at = self.clock()
+        self.ejections += 1
+
+    def _reset(self) -> None:
+        self.state = STATE_CLOSED
+        self._consecutive = 0
+        self._window.clear()
+        self.last_error = ""
+
+    def snapshot(self) -> dict:
+        return {
+            "state": self.state,
+            "ejections": self.ejections,
+            "consecutive_failures": self._consecutive,
+            "window_failure_rate": (
+                sum(self._window) / len(self._window) if self._window else 0.0
+            ),
+            "last_error": self.last_error,
+        }

@@ -11,12 +11,23 @@ labels — reference: load_balancer.go:53-140). Strategies:
 `await_best_address` BLOCKS until an endpoint exists — the scale-from-zero
 hold (reference: group.go:53-94 broadcast channel; here a Condition).
 Returns a completion callback that decrements in-flight counters.
+
+Resilience (no reference analog — the reference trusts readiness probes):
+each endpoint carries a passive-health circuit breaker (routing/health.py)
+fed by the proxy's attempt outcomes. Open circuits are excluded from the
+pick; when every endpoint is open the pick FAILS FAST with
+`NoHealthyEndpoints` (rather than hanging to the scale-from-zero timeout)
+carrying last-seen error context for the 503 body. Retries pass an
+`exclude` set so an attempt never re-picks the exact address that just
+failed — unless that would leave nowhere to go (single-replica groups
+still retry in place rather than fail).
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Callable
+import time
+from typing import Callable, Iterable
 
 from kubeai_tpu.crd import metadata as md
 from kubeai_tpu.crd.model import (
@@ -26,10 +37,39 @@ from kubeai_tpu.operator import k8sutils
 from kubeai_tpu.operator.k8s.store import KubeStore
 from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
 from kubeai_tpu.routing.chwbl import make_ring
+from kubeai_tpu.routing.health import (
+    STATE_CLOSED,
+    STATE_HALF_OPEN,
+    STATE_OPEN,
+    BreakerPolicy,
+    EndpointHealth,
+)
 
 
 class LoadBalancerTimeout(TimeoutError):
     pass
+
+
+class NoHealthyEndpoints(LoadBalancerTimeout):
+    """Endpoints exist but every circuit is open (within backoff): fail
+    fast instead of blocking — the caller answers 503 immediately with
+    the per-endpoint last-seen errors so clients see WHY."""
+
+    def __init__(self, model: str, last_errors: dict[str, str]):
+        self.model = model
+        self.last_errors = dict(last_errors)
+        detail = "; ".join(
+            f"{addr}: {err or 'unknown failure'}"
+            for addr, err in sorted(last_errors.items())
+        )
+        super().__init__(
+            f"all endpoints have open circuits ({detail})"
+            if detail else "all endpoints have open circuits"
+        )
+
+
+# Numeric encoding of breaker state for the /metrics gauge.
+_STATE_VALUE = {STATE_CLOSED: 0, STATE_HALF_OPEN: 1, STATE_OPEN: 2}
 
 
 # Operator replicas self-identify with this label; the LB collects their
@@ -41,16 +81,24 @@ SELF_METRICS_ADDR_ANNOTATION = "kubeai.org/metrics-addr"
 
 
 class _Endpoint:
-    __slots__ = ("address", "adapters", "in_flight")
+    __slots__ = ("address", "adapters", "in_flight", "health")
 
-    def __init__(self, address: str, adapters: set[str]):
+    def __init__(
+        self,
+        address: str,
+        adapters: set[str],
+        policy: BreakerPolicy | None = None,
+        clock=time.monotonic,
+    ):
         self.address = address
         self.adapters = adapters
         self.in_flight = 0
+        self.health = EndpointHealth(policy, clock=clock)
 
 
 class Group:
-    """Per-model endpoint set with in-flight accounting and a blocking wait
+    """Per-model endpoint set with in-flight accounting, passive-health
+    circuit breaking, and a blocking wait
     (reference: internal/loadbalancer/group.go)."""
 
     def __init__(
@@ -58,6 +106,9 @@ class Group:
         load_factor: float = 1.25,
         replication: int = 256,
         metrics: Metrics = DEFAULT_METRICS,
+        model: str = "",
+        breaker: BreakerPolicy | None = None,
+        clock=time.monotonic,
     ):
         self._cond = threading.Condition()
         self._endpoints: dict[str, _Endpoint] = {}
@@ -65,25 +116,57 @@ class Group:
             load_factor=load_factor, replication=replication, metrics=metrics
         )
         self.total_in_flight = 0
+        self.model = model
+        self.metrics = metrics
+        self.breaker_policy = breaker or BreakerPolicy()
+        self._clock = clock
+        # Endpoints removed by reconcile while requests were still in
+        # flight: their done() callbacks must keep draining the group
+        # totals, and the snapshot must show them until they empty.
+        self._retired: dict[int, _Endpoint] = {}
+
+    def set_breaker_policy(self, policy: BreakerPolicy) -> None:
+        with self._cond:
+            if policy == self.breaker_policy:
+                return
+            self.breaker_policy = policy
+            for ep in self._endpoints.values():
+                ep.health.set_policy(policy)
 
     def reconcile_endpoints(self, observed: dict[str, set[str]]) -> None:
-        """observed: address -> adapter names. Broadcasts on any addition
-        so blocked requests wake (reference: group.go:108-137)."""
+        """observed: address -> adapter names. Broadcasts on ANY change:
+        additions wake the scale-from-zero hold (reference: group.go:
+        108-137), removals wake waiters whose candidate/exclude predicate
+        just changed so they re-evaluate instead of sleeping on a stale
+        view."""
         with self._cond:
-            added = False
+            changed = False
             for addr, adapters in observed.items():
                 ep = self._endpoints.get(addr)
                 if ep is None:
-                    self._endpoints[addr] = _Endpoint(addr, set(adapters))
+                    self._endpoints[addr] = _Endpoint(
+                        addr, set(adapters),
+                        policy=self.breaker_policy, clock=self._clock,
+                    )
                     self._chwbl.add(addr)
-                    added = True
+                    changed = True
                 else:
                     ep.adapters = set(adapters)
             for addr in list(self._endpoints):
                 if addr not in observed:
-                    del self._endpoints[addr]
+                    ep = self._endpoints.pop(addr)
                     self._chwbl.remove(addr)
-            if added:
+                    changed = True
+                    self._drop_breaker_metrics(addr)
+                    if ep.in_flight > 0:
+                        # Requests are still bound to this endpoint
+                        # object; park it so done() bookkeeping stays
+                        # visible until the last one drains (the leak:
+                        # an ejected endpoint silently vanishing while
+                        # its active count never reached zero in any
+                        # snapshot).
+                        self._retired[id(ep)] = ep
+            if changed:
                 self._cond.notify_all()
 
     def addresses(self) -> list[str]:
@@ -96,35 +179,138 @@ class Group:
         adapter: str,
         prefix: str,
         timeout: float,
-    ) -> tuple[str, Callable[[], None]]:
-        """Block until a suitable endpoint exists; account the request."""
+        exclude: Iterable[str] | None = None,
+    ) -> tuple[str, Callable[..., None]]:
+        """Block until a suitable endpoint exists; account the request.
+
+        `exclude` is the retry path's do-not-repick set: excluded
+        addresses are avoided while any other available endpoint exists,
+        and ignored otherwise (a single-replica group must still retry in
+        place rather than starve). Raises `NoHealthyEndpoints` without
+        waiting when endpoints exist but every circuit is open."""
+        excluded = frozenset(exclude or ())
+        deadline = time.monotonic() + timeout
         with self._cond:
-            deadline_ok = self._cond.wait_for(
-                lambda: bool(self._candidates(adapter)), timeout=timeout
-            )
-            if not deadline_ok:
-                raise LoadBalancerTimeout(
-                    f"no endpoint became ready within {timeout}s"
-                )
-            addr = self._pick(strategy, adapter, prefix)
-            ep = self._endpoints[addr]
-            ep.in_flight += 1
-            self.total_in_flight += 1
+            while True:
+                eps = self._candidates(adapter)
+                if eps:
+                    avail = [
+                        e for e in eps if e.health.available(e.in_flight)
+                    ]
+                    if not avail:
+                        # Fail fast: blocking would just burn the whole
+                        # scale-from-zero budget against dead replicas.
+                        raise NoHealthyEndpoints(
+                            self.model,
+                            {
+                                e.address: e.health.last_error
+                                for e in eps
+                                if e.health.state != STATE_CLOSED
+                            },
+                        )
+                    picks = [
+                        e for e in avail if e.address not in excluded
+                    ] or avail
+                    addr = self._pick(
+                        strategy, adapter, prefix,
+                        {e.address for e in picks},
+                    )
+                    ep = self._endpoints[addr]
+                    # An open circuit past its backoff transitions to
+                    # half-open here; in_flight == 0 was required by
+                    # available(), so this request IS the single probe.
+                    ep.health.on_pick()
+                    self._sync_breaker_metrics(ep)
+                    ep.in_flight += 1
+                    self.total_in_flight += 1
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise LoadBalancerTimeout(
+                        f"no endpoint became ready within {timeout}s"
+                    )
+                self._cond.wait(timeout=remaining)
 
         done_called = threading.Event()
 
-        def done(ep=ep) -> None:
+        def done(outcome: str | None = None, error: str = "", ep=ep) -> None:
+            """Release the in-flight slot. `outcome` (health.py outcome
+            vocabulary) additionally feeds the endpoint's breaker; a bare
+            done() only releases accounting (legacy callers, cancelled
+            work)."""
             if done_called.is_set():
                 return
             done_called.set()
             with self._cond:
-                # Decrement the endpoint OBJECT acquired above, not a lookup:
-                # if the endpoint was removed and re-added mid-request, a
-                # lookup would push the fresh endpoint's counter negative.
+                # Decrement the endpoint OBJECT acquired above, not a
+                # lookup: if the endpoint was removed and re-added
+                # mid-request, a lookup would push the fresh endpoint's
+                # counter negative.
                 ep.in_flight -= 1
                 self.total_in_flight -= 1
+                if ep.in_flight <= 0 and id(ep) in self._retired:
+                    del self._retired[id(ep)]
+                changed = False
+                if outcome is not None:
+                    changed = ep.health.record(outcome, error)
+                    if self._endpoints.get(ep.address) is ep:
+                        self._sync_breaker_metrics(ep)
+                # A freed slot can admit the half-open probe; a state
+                # change alters the candidate set — either way waiters
+                # must re-evaluate.
+                if changed or ep.health.state != STATE_CLOSED:
+                    self._cond.notify_all()
 
         return addr, done
+
+    def report_outcome(self, addr: str, outcome: str, error: str = "") -> None:
+        """Fold an outcome in for an attempt that is no longer holding an
+        in-flight slot (e.g. a mid-stream death noticed after done()
+        already ran). Unknown addresses are ignored — the endpoint may
+        have been reconciled away."""
+        with self._cond:
+            ep = self._endpoints.get(addr)
+            if ep is None:
+                return
+            if ep.health.record(outcome, error):
+                self._sync_breaker_metrics(ep)
+                self._cond.notify_all()
+
+    def _sync_breaker_metrics(self, ep: _Endpoint) -> None:
+        self.metrics.lb_circuit_state.set(
+            _STATE_VALUE[ep.health.state],
+            model=self.model, endpoint=ep.address,
+        )
+        ejections = self.metrics.lb_circuit_ejections
+        recorded = ejections.get(model=self.model, endpoint=ep.address)
+        if ep.health.ejections > recorded:
+            ejections.inc(
+                ep.health.ejections - recorded,
+                model=self.model, endpoint=ep.address,
+            )
+
+    def _drop_breaker_metrics(self, addr: str) -> None:
+        self.metrics.lb_circuit_state.remove(
+            model=self.model, endpoint=addr
+        )
+
+    def snapshot(self) -> dict:
+        """Breaker + in-flight state for the LB state snapshot."""
+        with self._cond:
+            return {
+                "total_in_flight": self.total_in_flight,
+                "endpoints": {
+                    ep.address: {
+                        "in_flight": ep.in_flight,
+                        "adapters": sorted(ep.adapters),
+                        **ep.health.snapshot(),
+                    }
+                    for ep in self._endpoints.values()
+                },
+                "retired_in_flight": sum(
+                    ep.in_flight for ep in self._retired.values()
+                ),
+            }
 
     def _candidates(self, adapter: str) -> list[_Endpoint]:
         eps = list(self._endpoints.values())
@@ -133,17 +319,19 @@ class Group:
             return with_adapter
         return eps
 
-    def _pick(self, strategy: str, adapter: str, prefix: str) -> str:
+    def _pick(
+        self, strategy: str, adapter: str, prefix: str,
+        allowed: set[str],
+    ) -> str:
         if strategy == LB_STRATEGY_PREFIX_HASH and prefix:
             loads = {a: e.in_flight for a, e in self._endpoints.items()}
-            adapter_eps = (
-                {e.address for e in self._candidates(adapter)} if adapter else None
-            )
-            addr = self._chwbl.get(prefix, loads, adapter_eps)
+            addr = self._chwbl.get(prefix, loads, allowed)
             if addr is not None:
                 return addr
         # LeastLoad (and PrefixHash fallback when no prefix/ring).
-        candidates = self._candidates(adapter)
+        candidates = [
+            e for e in self._candidates(adapter) if e.address in allowed
+        ]
         best = min(candidates, key=lambda e: e.in_flight)
         return best.address
 
@@ -157,10 +345,12 @@ class LoadBalancer:
         store: KubeStore,
         default_timeout: float = 600.0,
         metrics: Metrics = DEFAULT_METRICS,
+        default_breaker: BreakerPolicy | None = None,
     ):
         self.store = store
         self.default_timeout = default_timeout
         self.metrics = metrics
+        self.default_breaker = default_breaker or BreakerPolicy()
         self._lock = threading.Lock()
         self._groups: dict[str, Group] = {}
         self._self_ips: list[str] = []
@@ -263,8 +453,23 @@ class LoadBalancer:
     def group(self, model: str) -> Group:
         with self._lock:
             if model not in self._groups:
-                self._groups[model] = Group(metrics=self.metrics)
+                self._groups[model] = Group(
+                    metrics=self.metrics,
+                    model=model,
+                    breaker=self.default_breaker,
+                )
             return self._groups[model]
+
+    def set_breaker_policy(self, model: str, policy: BreakerPolicy) -> None:
+        """Apply a (CRD-derived) breaker policy to a model's group; cheap
+        when unchanged, so the proxy calls it per request."""
+        self.group(model).set_breaker_policy(policy)
+
+    def state(self) -> dict:
+        """Per-model breaker/in-flight snapshot (admin/debug surface)."""
+        with self._lock:
+            groups = dict(self._groups)
+        return {model: g.snapshot() for model, g in groups.items()}
 
     # -- API (reference: load_balancer.go:182-204) -----------------------------
 
@@ -283,8 +488,10 @@ class LoadBalancer:
         prefix: str = "",
         strategy: str = "LeastLoad",
         timeout: float | None = None,
-    ) -> tuple[str, Callable[[], None]]:
+        exclude: Iterable[str] | None = None,
+    ) -> tuple[str, Callable[..., None]]:
         return self.group(model).get_best_addr(
             strategy, adapter, prefix,
             timeout=self.default_timeout if timeout is None else timeout,
+            exclude=exclude,
         )

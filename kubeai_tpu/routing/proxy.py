@@ -8,21 +8,49 @@ scale-from-zero → await endpoint (blocks) → proxy with ≤3 attempts on
 are replaced with a generic message so internal details don't leak
 (reference: request.go:45-63). Streaming (SSE) passes through chunk by
 chunk — the body is piped, never buffered.
+
+Resilience (beyond the reference's blind 3-retry loop):
+  * every attempt outcome (success / connect_error / timeout / 5xx /
+    midstream / shed) feeds the endpoint's circuit breaker in the load
+    balancer, and retries pass the failed addresses as an exclude set so
+    an attempt never re-picks the exact endpoint that just failed;
+  * timeouts are split (TCP connect vs response header) and come from
+    the system config `resilience:` block instead of a hardcoded 300 s;
+  * `X-Deadline-Ms` bounds the whole retry budget — the proxy never
+    retries (or sleeps a backoff) past the client's deadline, it reports
+    the last failure instead;
+  * a connection that dies mid-SSE emits a terminal `error` event (and a
+    `finish_reason: "error"` chunk for chat) instead of truncating the
+    stream silently.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import http.client
+import json
 import logging
 import random
 import time
-from typing import BinaryIO
 
 from kubeai_tpu.crd.model import LB_STRATEGY_PREFIX_HASH
 from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
 from kubeai_tpu.metrics import tracing
 from kubeai_tpu.routing import apiutils
-from kubeai_tpu.routing.loadbalancer import LoadBalancer, LoadBalancerTimeout
+from kubeai_tpu.routing.health import (
+    OUTCOME_5XX,
+    OUTCOME_CONNECT_ERROR,
+    OUTCOME_MIDSTREAM,
+    OUTCOME_SHED,
+    OUTCOME_SUCCESS,
+    OUTCOME_TIMEOUT,
+    BreakerPolicy,
+)
+from kubeai_tpu.routing.loadbalancer import (
+    LoadBalancer,
+    LoadBalancerTimeout,
+    NoHealthyEndpoints,
+)
 from kubeai_tpu.routing.modelclient import (
     AdapterNotFound,
     ModelClient,
@@ -46,6 +74,17 @@ SCHEDULING_HEADERS = ("x-priority", "x-deadline-ms", "x-client-id")
 _jitter = random.random
 
 
+@dataclasses.dataclass(frozen=True)
+class ProxyTimeouts:
+    """Attempt timeouts (system config `resilience:` block). Connect
+    covers the TCP handshake; response_header covers request write +
+    time-to-first-response-byte (an engine legitimately decodes for
+    minutes before its unary response, hence the generous default)."""
+
+    connect_s: float = 2.0
+    response_header_s: float = 300.0
+
+
 class ProxyResult:
     """What the HTTP layer needs to respond: status, headers, body iterator."""
 
@@ -67,10 +106,14 @@ class ModelProxy:
         lb: LoadBalancer,
         model_client: ModelClient,
         metrics: Metrics = DEFAULT_METRICS,
+        timeouts: ProxyTimeouts | None = None,
+        default_breaker: BreakerPolicy | None = None,
     ):
         self.lb = lb
         self.model_client = model_client
         self.metrics = metrics
+        self.timeouts = timeouts or ProxyTimeouts()
+        self.default_breaker = default_breaker or lb.default_breaker
 
     def handle(
         self, path: str, body: bytes, headers: dict[str, str]
@@ -90,6 +133,12 @@ class ModelProxy:
         except AdapterNotFound:
             return _error(404, f"adapter not found: {preq.model}_{preq.adapter}")
 
+        # The CRD's circuitBreaker block (merged over the system
+        # defaults) configures this model's endpoint breakers.
+        self.lb.set_breaker_policy(
+            model.name, self._breaker_policy(model)
+        )
+
         self.metrics.inference_requests_active.inc(model=model.name)
         self.metrics.inference_requests_total.inc(model=model.name)
         decremented = [False]
@@ -102,6 +151,16 @@ class ModelProxy:
         try:
             self.model_client.scale_at_least_one_replica(model.name)
             result = self._proxy_with_retries(path, preq, model, headers)
+        except NoHealthyEndpoints as e:
+            # Fail fast: every endpoint's circuit is open. Surface the
+            # last-seen per-endpoint errors so the client (and whoever
+            # reads the 503 body) sees WHY, not just "try later".
+            _done()
+            return _error(
+                503,
+                f"no healthy model endpoints: {e}",
+                model=model.name,
+            )
         except LoadBalancerTimeout:
             _done()
             return _error(
@@ -129,6 +188,21 @@ class ModelProxy:
         result.chunks = wrapped()
         return result
 
+    def _breaker_policy(self, model) -> BreakerPolicy:
+        cb = model.spec.load_balancing.circuit_breaker
+        d = self.default_breaker
+        if not cb.enabled():
+            return d
+        return BreakerPolicy(
+            window=cb.window or d.window,
+            consecutive_failures=(
+                cb.consecutive_failures or d.consecutive_failures
+            ),
+            failure_rate=cb.failure_rate or d.failure_rate,
+            min_samples=cb.min_samples or d.min_samples,
+            open_seconds=cb.open_seconds or d.open_seconds,
+        )
+
     def _proxy_with_retries(
         self,
         path: str,
@@ -141,7 +215,40 @@ class ModelProxy:
         prefix = preq.prefix[:prefix_len] if strategy == LB_STRATEGY_PREFIX_HASH else ""
 
         last_err: Exception | None = None
+        last_desc = ""
         request_id = headers.get("x-request-id", "")
+        # Client deadline = the whole request's retry budget: no attempt,
+        # retry, or backoff sleep may start past it.
+        budget_deadline: float | None = None
+        raw_deadline = (headers.get("x-deadline-ms") or "").strip()
+        if raw_deadline:
+            try:
+                ms = float(raw_deadline)
+            except ValueError:
+                ms = 0.0
+            if ms > 0:
+                budget_deadline = time.monotonic() + ms / 1000.0
+
+        def budget_left() -> float | None:
+            if budget_deadline is None:
+                return None
+            return budget_deadline - time.monotonic()
+
+        def deadline_exhausted(attempt: int) -> ProxyResult:
+            self.metrics.proxy_deadline_exhausted.inc(model=model.name)
+            return _error(
+                504,
+                f"deadline of {raw_deadline}ms exhausted after "
+                f"{attempt + 1} attempt(s); last failure: "
+                f"{last_desc or 'none'}",
+                model=model.name,
+            )
+
+        # Addresses that failed THIS request: the retry pick excludes
+        # them (unless that would leave nowhere to go), so a retry never
+        # lands on the exact endpoint that just failed even before its
+        # breaker trips.
+        failed_addrs: set[str] = set()
         # Parent for every attempt span: the front door's server span
         # (attempts are SIBLINGS — rebinding headers below must not make
         # attempt N+1 a child of attempt N).
@@ -150,11 +257,16 @@ class ModelProxy:
             if attempt > 0:
                 self.metrics.proxy_retries.inc(model=model.name)
             self.metrics.proxy_attempts.inc(model=model.name)
+            remaining = budget_left()
+            if remaining is not None and remaining <= 0:
+                return deadline_exhausted(attempt - 1)
             addr, done = self.lb.await_best_address(
                 model.name,
                 adapter=preq.adapter,
                 prefix=prefix,
                 strategy=strategy,
+                timeout=remaining,
+                exclude=failed_addrs,
             )
             # One client span per attempt: retries show up as siblings
             # under the front door's server span, each carrying the
@@ -179,11 +291,22 @@ class ModelProxy:
             # The engine continues the trace under THIS attempt.
             headers = dict(headers, traceparent=attempt_span.context.traceparent())
             try:
-                resp, conn = _send(addr, path, preq, headers)
+                resp, conn = _send(
+                    addr, path, preq, headers,
+                    connect_timeout=self.timeouts.connect_s,
+                    read_timeout=self.timeouts.response_header_s,
+                )
             except OSError as e:
+                fault = (
+                    OUTCOME_TIMEOUT if isinstance(e, TimeoutError)
+                    else OUTCOME_CONNECT_ERROR
+                )
+                attempt_span.set_attribute("fault.class", fault)
                 attempt_span.end(error=str(e))
-                done()
+                done(outcome=fault, error=f"{fault}: {e}")
+                failed_addrs.add(addr)
                 last_err = e
+                last_desc = f"{addr}: {fault} ({e})"
                 logger.warning(
                     "attempt %d: connection to %s failed: %s "
                     "(model=%s request_id=%s)",
@@ -195,11 +318,16 @@ class ModelProxy:
                 # not retryable here, but the attempt span must export and
                 # the endpoint's in-flight count must drop before the
                 # generic 502 path takes over.
+                attempt_span.set_attribute(
+                    "fault.class", OUTCOME_CONNECT_ERROR
+                )
                 attempt_span.end(error=str(e))
-                done()
+                done(outcome=OUTCOME_CONNECT_ERROR, error=str(e))
                 raise
             if resp.status in RETRY_STATUSES and attempt < MAX_RETRIES - 1:
+                outcome = OUTCOME_SHED if resp.status == 429 else OUTCOME_5XX
                 attempt_span.set_attribute("http.status_code", resp.status)
+                attempt_span.set_attribute("fault.class", outcome)
                 attempt_span.end(error=f"HTTP {resp.status} (retrying)")
                 logger.warning(
                     "attempt %d: %s returned HTTP %d, retrying "
@@ -209,7 +337,18 @@ class ModelProxy:
                 retry_after = resp.getheader("Retry-After")
                 resp.read()
                 conn.close()
-                done()
+                done(
+                    outcome=outcome,
+                    error=f"HTTP {resp.status}",
+                )
+                if outcome is OUTCOME_5XX:
+                    failed_addrs.add(addr)
+                last_desc = f"{addr}: HTTP {resp.status}"
+                remaining = budget_left()
+                if remaining is not None and remaining <= 0:
+                    # Never retry past the client's deadline — report
+                    # the last outcome instead.
+                    return deadline_exhausted(attempt)
                 # A shedding replica (429/503 + Retry-After) asked for
                 # backoff; under prefix-hash an immediate re-pick can land
                 # on the same replica, so honor a short pause (capped).
@@ -218,33 +357,52 @@ class ModelProxy:
                 # stampede and — under prefix-hash — land on the same
                 # replica again; spreading each sleep over [0.5, 1.0]× the
                 # hint desynchronizes the herd while staying within the
-                # backoff the replica asked for.
+                # backoff the replica asked for. Non-numeric Retry-After
+                # values (RFC 7231 allows HTTP-dates) are ignored rather
+                # than parsed: an immediate re-pick beats a crash.
                 if retry_after and resp.status in (429, 503):
                     try:
                         base = min(float(retry_after), 2.0)
                     except ValueError:
                         pass
                     else:
+                        # Cumulative backoff may not eat the deadline:
+                        # cap the sleep at the remaining budget.
+                        if remaining is not None:
+                            base = min(base, max(0.0, remaining))
                         time.sleep(base * (0.5 + 0.5 * _jitter()))
                 continue
             if resp.status >= 500:
                 attempt_span.set_attribute("http.status_code", resp.status)
+                attempt_span.set_attribute("fault.class", OUTCOME_5XX)
                 attempt_span.end(error=f"HTTP {resp.status}")
                 resp.read()
                 conn.close()
-                done()
+                done(outcome=OUTCOME_5XX, error=f"HTTP {resp.status}")
                 # Strip engine error details (reference: request.go:45-63).
                 return _error(resp.status, "upstream model server error")
 
             attempt_span.set_attribute("http.status_code", resp.status)
             attempt_span.end()
+            if resp.status == 429:
+                # Shed on the LAST attempt: the engine's 429 body (per-
+                # class queue depths + computed Retry-After) passes
+                # through untouched so clients can back off honestly.
+                done(outcome=OUTCOME_SHED, error="HTTP 429")
             resp_headers = [
                 (k, v)
                 for k, v in resp.getheaders()
                 if k.lower() not in ("transfer-encoding", "connection")
             ]
+            is_sse = any(
+                k.lower() == "content-type"
+                and v.lower().startswith("text/event-stream")
+                for k, v in resp_headers
+            )
+            is_chat = path.startswith("/v1/chat/")
 
-            def chunks(resp=resp, conn=conn, done=done):
+            def chunks(resp=resp, conn=conn, done=done, addr=addr,
+                       is_sse=is_sse, is_chat=is_chat):
                 # read1 (not read): read(n) on a chunked response BLOCKS
                 # until n bytes accumulate, which buffers ~160 small SSE
                 # events before anything reaches the client — destroying
@@ -257,9 +415,38 @@ class ModelProxy:
                         if not chunk:
                             break
                         yield chunk
-                finally:
+                except GeneratorExit:
+                    # Client walked away mid-stream: release the slot
+                    # with no health outcome — the endpoint did nothing
+                    # wrong.
                     conn.close()
                     done()
+                    raise
+                except Exception as e:
+                    # The engine connection died partway through the
+                    # body. Silence here would truncate an SSE stream
+                    # with no terminal signal; emit one and record the
+                    # fault against the endpoint's health window.
+                    conn.close()
+                    done(
+                        outcome=OUTCOME_MIDSTREAM,
+                        error=f"mid-stream: {e}",
+                    )
+                    self.metrics.proxy_midstream_failures.inc(
+                        model=model.name
+                    )
+                    logger.warning(
+                        "mid-stream failure from %s: %s "
+                        "(model=%s request_id=%s)",
+                        addr, e, model.name, request_id,
+                    )
+                    if not is_sse:
+                        raise  # unary body: nothing valid left to send
+                    yield from _sse_error_tail(model.name, is_chat, e)
+                    return
+                else:
+                    conn.close()
+                    done(outcome=OUTCOME_SUCCESS)
 
             return ProxyResult(
                 resp.status, resp_headers, chunks(), model=model.name
@@ -267,9 +454,49 @@ class ModelProxy:
         raise last_err or RuntimeError("retries exhausted")
 
 
-def _send(addr: str, path: str, preq: apiutils.ParsedRequest, headers: dict):
+def _sse_error_tail(model_name: str, is_chat: bool, exc: Exception):
+    """Terminal SSE events for a stream whose upstream died: a final
+    chunk with `finish_reason: "error"` for chat streams, then an
+    explicit `error` event, then [DONE] — clients see a terminated
+    stream, never a silent truncation."""
+    if is_chat:
+        final = {
+            "object": "chat.completion.chunk",
+            "model": model_name,
+            "choices": [
+                {"index": 0, "delta": {}, "finish_reason": "error"}
+            ],
+        }
+        yield f"data: {json.dumps(final)}\n\n".encode()
+    err = {
+        "error": {
+            "message": f"upstream connection lost mid-stream: {exc}",
+            "type": "upstream_error",
+            "code": 502,
+        }
+    }
+    yield f"event: error\ndata: {json.dumps(err)}\n\n".encode()
+    yield b"data: [DONE]\n\n"
+
+
+def _send(
+    addr: str,
+    path: str,
+    preq: apiutils.ParsedRequest,
+    headers: dict,
+    connect_timeout: float = 2.0,
+    read_timeout: float = 300.0,
+):
+    """Open a connection with DISTINCT connect / response-header budgets:
+    a dead host must fail in ~connect_timeout, while a busy engine still
+    gets read_timeout to produce response headers."""
     host, _, port = addr.partition(":")
-    conn = http.client.HTTPConnection(host, int(port or 80), timeout=300)
+    conn = http.client.HTTPConnection(
+        host, int(port or 80), timeout=connect_timeout
+    )
+    conn.connect()
+    if conn.sock is not None:
+        conn.sock.settimeout(read_timeout)
     fwd = {
         "Content-Type": preq.content_type,
         "Content-Length": str(len(preq.body)),
@@ -285,8 +512,6 @@ def _send(addr: str, path: str, preq: apiutils.ParsedRequest, headers: dict):
 
 
 def _error(status: int, message: str, model: str = "") -> ProxyResult:
-    import json
-
     body = json.dumps({"error": {"message": message, "code": status}}).encode()
     return ProxyResult(
         status,

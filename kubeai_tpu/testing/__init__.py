@@ -1,0 +1,13 @@
+"""Deterministic test doubles for the resilience suite."""
+
+from kubeai_tpu.testing.faults import (
+    FAULT_CONNECT_ERROR,
+    FAULT_DIE_MID_STREAM,
+    FAULT_HTTP,
+    FAULT_STALL,
+    FAULT_TIMEOUT,
+    FakeClock,
+    Fault,
+    FaultPlan,
+    faulty_send,
+)

@@ -1,0 +1,211 @@
+"""Deterministic fault injection for the serving path.
+
+A `FaultPlan` is a seeded, schedule-driven description of what breaks
+when — "endpoint B refuses connections for attempts 2–5", "endpoint A
+503s every 3rd request", "die after 7 SSE chunks", "stall 10 s before
+headers" — consulted once per proxy attempt. Two consumption modes:
+
+  * `faulty_send(plan, real_send)` wraps the proxy's `_send` so unit
+    tests drive the REAL retry/breaker path over real sockets, with the
+    plan deciding which attempts fail and how
+    (`monkeypatch.setattr(proxy_mod, "_send", faulty_send(plan, _send))`);
+  * the fast-tier simulation (`benchmarks/resilience_sim.py`) consults
+    `plan.on_attempt` directly against a fake-clock `Group`, no sockets.
+
+Everything is deterministic: the schedule is positional (per-endpoint
+attempt counters), and the only randomness flows from the plan's seed.
+The plan records every decision in `plan.log` so a failing test can
+print exactly which attempt hit which fault.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from collections import defaultdict
+
+FAULT_CONNECT_ERROR = "connect_error"
+FAULT_TIMEOUT = "timeout"
+FAULT_HTTP = "http"
+FAULT_DIE_MID_STREAM = "die_mid_stream"
+FAULT_STALL = "stall"
+
+FAULT_KINDS = (
+    FAULT_CONNECT_ERROR,
+    FAULT_TIMEOUT,
+    FAULT_HTTP,
+    FAULT_DIE_MID_STREAM,
+    FAULT_STALL,
+)
+
+
+class FakeClock:
+    """Injectable monotonic clock for breaker/backoff determinism."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@dataclasses.dataclass
+class Fault:
+    """One scheduled failure mode for one endpoint.
+
+    Matching is positional over the endpoint's attempt counter (1-based):
+    either a `start..end` range (end=None → forever) or `every` (fire on
+    every Nth attempt; overrides the range). `endpoint="*"` matches all.
+    """
+
+    endpoint: str
+    kind: str
+    start: int = 1
+    end: int | None = None
+    every: int = 0
+    status: int = 503            # kind="http": response status
+    body: dict | None = None     # kind="http": JSON body (default error)
+    headers: dict | None = None  # kind="http": extra response headers
+    after_chunks: int = 1        # kind="die_mid_stream": chunks before death
+    stall_s: float = 0.0         # kind="stall": pre-header stall
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+
+    def matches(self, n: int) -> bool:
+        if self.every:
+            return n % self.every == 0
+        return self.start <= n and (self.end is None or n <= self.end)
+
+
+class FaultPlan:
+    """Schedule of faults + per-endpoint attempt counters + decision log."""
+
+    def __init__(self, faults: list[Fault] | tuple[Fault, ...] = (), seed: int = 0):
+        import random
+
+        self.faults = list(faults)
+        self.rng = random.Random(seed)
+        self.counts: dict[str, int] = defaultdict(int)
+        # (endpoint, attempt_number_at_endpoint, fault_kind_or_None)
+        self.log: list[tuple[str, int, str | None]] = []
+
+    def on_attempt(self, endpoint: str) -> Fault | None:
+        """Advance the endpoint's attempt counter and return the fault
+        (first match wins) this attempt should suffer, if any."""
+        self.counts[endpoint] += 1
+        n = self.counts[endpoint]
+        for f in self.faults:
+            if f.endpoint not in ("*", endpoint):
+                continue
+            if f.matches(n):
+                self.log.append((endpoint, n, f.kind))
+                return f
+        self.log.append((endpoint, n, None))
+        return None
+
+
+# ---- proxy-send wrapper ------------------------------------------------------
+
+
+class _FakeConn:
+    def close(self) -> None:
+        pass
+
+
+class _FakeResponse:
+    """Just enough of http.client.HTTPResponse for the proxy."""
+
+    def __init__(self, status: int, body: bytes, headers: dict[str, str]):
+        self.status = status
+        self._body = body
+        self._headers = dict(headers)
+        self._read = False
+
+    def getheader(self, name: str, default=None):
+        for k, v in self._headers.items():
+            if k.lower() == name.lower():
+                return v
+        return default
+
+    def getheaders(self):
+        return list(self._headers.items())
+
+    def read(self, n: int = -1) -> bytes:
+        if self._read:
+            return b""
+        self._read = True
+        return self._body
+
+    read1 = read
+
+
+class _DyingResponse:
+    """Wraps a real response; its body read raises after N chunks — the
+    injected mid-stream connection death."""
+
+    def __init__(self, resp, after_chunks: int):
+        self._resp = resp
+        self._left = after_chunks
+
+    def __getattr__(self, name):
+        return getattr(self._resp, name)
+
+    def _dying_read(self, inner, n: int = -1) -> bytes:
+        if self._left <= 0:
+            raise ConnectionResetError("injected mid-stream death")
+        chunk = inner(n)
+        if chunk:
+            self._left -= 1
+        return chunk
+
+    def read(self, n: int = -1) -> bytes:
+        return self._dying_read(self._resp.read, n)
+
+    def read1(self, n: int = -1) -> bytes:
+        inner = getattr(self._resp, "read1", self._resp.read)
+        return self._dying_read(inner, n)
+
+
+def faulty_send(plan: FaultPlan, real_send, clock=time.sleep):
+    """Wrap the proxy's `_send` with the plan. Attempts the plan leaves
+    alone pass through untouched; faulted attempts raise/respond the way
+    the real failure would, so the proxy's classification, breaker
+    feeding, and retry behavior are exercised for real."""
+
+    def send(addr: str, path: str, preq, headers: dict, **kw):
+        f = plan.on_attempt(addr)
+        if f is None:
+            return real_send(addr, path, preq, headers, **kw)
+        if f.kind == FAULT_CONNECT_ERROR:
+            raise ConnectionRefusedError(f"injected: {addr} refused connection")
+        if f.kind == FAULT_TIMEOUT:
+            raise TimeoutError(f"injected: {addr} timed out before headers")
+        if f.kind == FAULT_STALL:
+            clock(f.stall_s)
+            return real_send(addr, path, preq, headers, **kw)
+        if f.kind == FAULT_HTTP:
+            body = json.dumps(
+                f.body
+                if f.body is not None
+                else {"error": {"message": f"injected HTTP {f.status}"}}
+            ).encode()
+            resp = _FakeResponse(
+                f.status, body,
+                {
+                    "Content-Type": "application/json",
+                    "Content-Length": str(len(body)),
+                    **(f.headers or {}),
+                },
+            )
+            return resp, _FakeConn()
+        # die_mid_stream: real connection, poisoned body.
+        resp, conn = real_send(addr, path, preq, headers, **kw)
+        return _DyingResponse(resp, f.after_chunks), conn
+
+    return send

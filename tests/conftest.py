@@ -47,6 +47,11 @@ def pytest_configure(config):
         "slow: jit/compile-heavy test; excluded from the default fast "
         "tier, run with --runslow or -m slow",
     )
+    config.addinivalue_line(
+        "markers",
+        "resilience: fault-injection / circuit-breaker / drain suite "
+        "(runs in the fast tier; select with -m resilience)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,719 @@
+"""Fault-tolerant serving path: circuit breaking, exclude-set retries,
+deadline budgets, mid-stream failure signaling, graceful drain, and the
+deterministic fault-injection harness (kubeai_tpu/testing/faults.py +
+benchmarks/resilience_sim.py). Everything here is seeded/fake-clocked —
+no real accelerator, no flaky timing beyond generous local-socket I/O."""
+
+import json
+import os
+import sys
+import threading
+import time
+import types
+import queue as queue_mod
+
+import pytest
+
+from testutil import FakeEngine, http_get, http_post
+
+from kubeai_tpu.crd.model import (
+    CircuitBreakerSpec,
+    LoadBalancing,
+    Model,
+    ModelSpec,
+)
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.health import (
+    OUTCOME_5XX,
+    OUTCOME_CONNECT_ERROR,
+    OUTCOME_SHED,
+    OUTCOME_SUCCESS,
+    BreakerPolicy,
+    EndpointHealth,
+)
+from kubeai_tpu.routing.loadbalancer import (
+    Group,
+    LoadBalancer,
+    NoHealthyEndpoints,
+)
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.routing.openai_server import OpenAIServer
+from kubeai_tpu.routing.proxy import ModelProxy
+from kubeai_tpu.routing import proxy as proxy_mod
+from kubeai_tpu.testing.faults import (
+    FakeClock,
+    Fault,
+    FaultPlan,
+    faulty_send,
+)
+
+pytestmark = pytest.mark.resilience
+
+
+# ---- breaker state machine (fake clock, no sockets) --------------------------
+
+
+def _health(clock, **overrides):
+    policy = BreakerPolicy(
+        **{
+            "window": 10, "consecutive_failures": 3,
+            "failure_rate": 0.5, "min_samples": 5, "open_seconds": 5.0,
+            **overrides,
+        }
+    )
+    return EndpointHealth(policy, clock=clock)
+
+
+def test_breaker_trips_on_consecutive_failures():
+    clock = FakeClock()
+    h = _health(clock)
+    for _ in range(2):
+        h.record(OUTCOME_CONNECT_ERROR, "refused")
+        assert h.state == "closed"
+    h.record(OUTCOME_CONNECT_ERROR, "refused")
+    assert h.state == "open"
+    assert h.ejections == 1
+    assert not h.available(in_flight=0)
+    # Backoff elapsed: exactly one probe (in_flight must be 0).
+    clock.advance(5.1)
+    assert h.available(in_flight=0)
+    assert not h.available(in_flight=1)
+
+
+def test_breaker_trips_on_failure_rate():
+    clock = FakeClock()
+    h = _health(clock, consecutive_failures=0)  # rate rule only
+    # Alternate success/failure: consecutive never reaches 3, but the
+    # window rate hits 0.5 with >= 5 samples.
+    outcomes = [OUTCOME_5XX, OUTCOME_SUCCESS] * 3
+    for o in outcomes:
+        h.record(o, "injected")
+    assert h.state == "open"
+
+
+def test_breaker_shed_is_not_a_failure():
+    clock = FakeClock()
+    h = _health(clock, consecutive_failures=1)
+    h.record(OUTCOME_SHED, "HTTP 429")
+    assert h.state == "closed"  # flow control never ejects a live engine
+
+
+def test_breaker_half_open_probe_outcomes():
+    clock = FakeClock()
+    h = _health(clock, consecutive_failures=1, open_seconds=2.0)
+    h.record(OUTCOME_CONNECT_ERROR, "boom")
+    assert h.state == "open"
+    clock.advance(2.1)
+    h.on_pick()  # the probe
+    assert h.state == "half_open"
+    h.record(OUTCOME_CONNECT_ERROR, "still dead")
+    assert h.state == "open"  # probe failed → backoff restarts
+    assert h.ejections == 2
+    assert not h.available(in_flight=0)  # fresh backoff
+    clock.advance(2.1)
+    h.on_pick()
+    h.record(OUTCOME_SUCCESS)
+    assert h.state == "closed"  # probe succeeded → re-admitted
+
+
+# ---- group pick path ---------------------------------------------------------
+
+
+def _tripped_group(clock, addrs=("a:1", "b:1"), trip=()):
+    g = Group(
+        metrics=Metrics(), model="m",
+        breaker=BreakerPolicy(consecutive_failures=1, open_seconds=5.0),
+        clock=clock,
+    )
+    g.reconcile_endpoints({a: set() for a in addrs})
+    for addr in trip:
+        picked, done = g.get_best_addr(
+            "LeastLoad", "", "", timeout=1,
+            exclude=set(addrs) - {addr},
+        )
+        assert picked == addr
+        done(outcome=OUTCOME_CONNECT_ERROR, error=f"injected: {addr} down")
+    return g
+
+
+def test_group_never_routes_to_open_circuit():
+    clock = FakeClock()
+    g = _tripped_group(clock, trip=("b:1",))
+    assert g.snapshot()["endpoints"]["b:1"]["state"] == "open"
+    for _ in range(20):
+        addr, done = g.get_best_addr("LeastLoad", "", "", timeout=1)
+        assert addr == "a:1"
+        done(outcome=OUTCOME_SUCCESS)
+
+
+def test_group_fails_fast_when_all_circuits_open():
+    clock = FakeClock()
+    g = _tripped_group(clock, trip=("a:1", "b:1"))
+    t0 = time.monotonic()
+    with pytest.raises(NoHealthyEndpoints) as ei:
+        g.get_best_addr("LeastLoad", "", "", timeout=30)
+    assert time.monotonic() - t0 < 1.0  # failed fast, not after 30s
+    # Last-seen error context for the 503 body.
+    assert "a:1" in str(ei.value) and "b:1" in str(ei.value)
+    assert "injected" in str(ei.value)
+
+
+def test_group_exclude_set_avoids_failed_addr():
+    clock = FakeClock()
+    g = _tripped_group(clock)
+    addr, done = g.get_best_addr(
+        "LeastLoad", "", "", timeout=1, exclude={"a:1"}
+    )
+    assert addr == "b:1"
+    done()
+    # Exclusion covering EVERY candidate is ignored: a single-replica
+    # group retries in place rather than failing.
+    addr, done = g.get_best_addr(
+        "LeastLoad", "", "", timeout=1, exclude={"a:1", "b:1"}
+    )
+    assert addr in ("a:1", "b:1")
+    done()
+
+
+def test_group_reconcile_drains_inflight_bookkeeping():
+    """Satellite: an endpoint removed while requests are active must
+    keep its done() bookkeeping visible (retired set) and drain the
+    group totals to zero — never leak total_in_flight."""
+    clock = FakeClock()
+    g = Group(metrics=Metrics(), model="m", clock=clock)
+    g.reconcile_endpoints({"a:1": set(), "b:1": set()})
+    addr, done = g.get_best_addr("LeastLoad", "", "", timeout=1)
+    assert g.total_in_flight == 1
+    # The endpoint disappears (pod deleted) while the request runs.
+    g.reconcile_endpoints({x: set() for x in ("a:1", "b:1") if x != addr})
+    snap = g.snapshot()
+    assert addr not in snap["endpoints"]
+    assert snap["retired_in_flight"] == 1
+    assert g.total_in_flight == 1
+    done(outcome=OUTCOME_SUCCESS)
+    snap = g.snapshot()
+    assert g.total_in_flight == 0
+    assert snap["retired_in_flight"] == 0
+    # Flap: the address comes back as a FRESH endpoint; the old done()
+    # (idempotent) must not corrupt the new object's counters.
+    g.reconcile_endpoints({"a:1": set(), "b:1": set()})
+    done()
+    assert g.snapshot()["endpoints"][addr]["in_flight"] == 0
+    assert g.total_in_flight == 0
+
+
+def test_group_removal_wakes_blocked_waiters():
+    """A waiter blocked on an adapter that only a removed endpoint
+    carried must re-evaluate on removal (notify), not sleep out its
+    whole timeout on a stale candidate view."""
+    g = Group(metrics=Metrics(), model="m")
+    g.reconcile_endpoints({"a:1": set()})
+    result = {}
+
+    def waiter():
+        try:
+            addr, done = g.get_best_addr("LeastLoad", "lora", "", timeout=5)
+            result["addr"] = addr
+            done()
+        except Exception as e:
+            result["err"] = e
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    g.reconcile_endpoints({"a:1": set(), "b:1": {"lora"}})
+    t.join(timeout=5)
+    assert result.get("addr") == "b:1"
+
+
+def test_breaker_metrics_exported():
+    clock = FakeClock()
+    metrics = Metrics()
+    g = Group(
+        metrics=metrics, model="m1",
+        breaker=BreakerPolicy(consecutive_failures=1, open_seconds=5.0),
+        clock=clock,
+    )
+    g.reconcile_endpoints({"a:1": set(), "b:1": set()})
+    addr, done = g.get_best_addr(
+        "LeastLoad", "", "", timeout=1, exclude={"a:1"}
+    )
+    done(outcome=OUTCOME_CONNECT_ERROR, error="down")
+    text = metrics.registry.expose()
+    assert (
+        'kubeai_lb_circuit_state{endpoint="b:1",model="m1"} 2' in text
+    )
+    assert (
+        'kubeai_lb_circuit_ejections_total{endpoint="b:1",model="m1"} 1'
+        in text
+    )
+    # Removal drops the state series (no stale endpoint cardinality).
+    g.reconcile_endpoints({"a:1": set()})
+    assert '"b:1"' not in metrics.lb_circuit_state.collect()[-1]
+
+
+# ---- fault plan --------------------------------------------------------------
+
+
+def test_fault_plan_schedule_is_deterministic():
+    plan = FaultPlan(
+        [
+            Fault("b:1", "connect_error", start=2, end=4),
+            Fault("a:1", "http", every=3, status=503),
+        ]
+    )
+    got = []
+    for ep in ("b:1", "b:1", "b:1", "b:1", "b:1"):
+        f = plan.on_attempt(ep)
+        got.append(f.kind if f else None)
+    assert got == [None, "connect_error", "connect_error", "connect_error", None]
+    got_a = []
+    for _ in range(6):
+        f = plan.on_attempt("a:1")
+        got_a.append(f.kind if f else None)
+    assert got_a == [None, None, "http", None, None, "http"]
+    # Every decision is logged for post-mortem printing.
+    assert len(plan.log) == 11
+
+
+def test_fault_plan_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        Fault("a:1", "explode")
+
+
+# ---- full proxy path with injected faults ------------------------------------
+
+
+@pytest.fixture
+def stack():
+    """store + LB + proxy + openai server backed by FakeEngines, with a
+    per-test breaker default that uses a tight open backoff."""
+    store = KubeStore()
+    lb = LoadBalancer(store, default_timeout=5)
+    mc = ModelClient(store)
+    server = OpenAIServer(ModelProxy(lb, mc), mc)
+    server.start()
+    engines: list[FakeEngine] = []
+
+    def add_model(name="m1", engines_n=1, circuit_breaker=None):
+        m = Model(
+            name=name,
+            spec=ModelSpec(
+                url="hf://org/x",
+                engine="KubeAITPU",
+                features=["TextGeneration"],
+                autoscaling_disabled=True,
+                replicas=engines_n,
+                load_balancing=LoadBalancing(
+                    circuit_breaker=circuit_breaker or CircuitBreakerSpec()
+                ),
+            ),
+        )
+        store.create(m.to_dict())
+        for i in range(engines_n):
+            eng = FakeEngine()
+            engines.append(eng)
+            store.create(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Pod",
+                    "metadata": {
+                        "name": f"model-{name}-{i}",
+                        "namespace": "default",
+                        "labels": {"model": name},
+                        "annotations": {
+                            "model-pod-ip": "127.0.0.1",
+                            "model-pod-port": str(eng.port),
+                        },
+                    },
+                    "status": {
+                        "conditions": [{"type": "Ready", "status": "True"}],
+                        "podIP": "127.0.0.1",
+                    },
+                }
+            )
+        lb.sync_model(name)
+        return engines
+
+    yield store, lb, server, add_model, engines
+    server.stop()
+    lb.stop()
+    for e in engines:
+        e.stop()
+
+
+def _post(server, path, payload, headers=None):
+    return http_post(server.address, path, payload, timeout=10, headers=headers)
+
+
+def test_one_dead_endpoint_retry_lands_elsewhere(stack, monkeypatch):
+    """1 of 3 endpoints refuses connections: every request succeeds with
+    at most one extra attempt, and after the breaker trips the dead
+    endpoint stops receiving attempts at all."""
+    _, lb, server, add_model, engines = stack
+    add_model(engines_n=3)
+    # Serial requests + LeastLoad always pick the same first endpoint;
+    # kill exactly THAT one so every request starts on the dead replica
+    # until the breaker ejects it.
+    dead, _done = lb.await_best_address("m1")
+    _done()
+    plan = FaultPlan([Fault(dead, "connect_error")])
+    monkeypatch.setattr(
+        proxy_mod, "_send", faulty_send(plan, proxy_mod._send)
+    )
+    for _ in range(30):
+        status, _ = _post(
+            server, "/openai/v1/completions", {"model": "m1", "prompt": "x"}
+        )
+        assert status == 200
+    # Default policy trips after 3 consecutive failures; with the
+    # exclude-set each request costs the dead endpoint at most one
+    # attempt, so its attempt counter stays pinned at the threshold and
+    # every request succeeded with AT MOST ONE extra attempt.
+    assert plan.counts[dead] == 3
+    snap = lb.group("m1").snapshot()
+    assert snap["endpoints"][dead]["state"] == "open"
+    # Attempt accounting: 30 successes + the 3 failed attempts.
+    assert sum(plan.counts.values()) == 33
+
+
+def test_all_endpoints_open_returns_503_with_context(stack, monkeypatch):
+    _, lb, server, add_model, engines = stack
+    add_model(
+        engines_n=2,
+        circuit_breaker=CircuitBreakerSpec(consecutive_failures=1),
+    )
+    plan = FaultPlan([Fault("*", "connect_error")])
+    monkeypatch.setattr(
+        proxy_mod, "_send", faulty_send(plan, proxy_mod._send)
+    )
+    # First request trips both breakers (attempt → fail → exclude →
+    # retry other → fail).
+    status, _ = _post(
+        server, "/openai/v1/completions", {"model": "m1", "prompt": "x"}
+    )
+    assert status in (502, 503)
+    # Now every circuit is open: fail fast with last-seen error context.
+    t0 = time.monotonic()
+    status, body = _post(
+        server, "/openai/v1/completions", {"model": "m1", "prompt": "x"}
+    )
+    assert status == 503
+    assert time.monotonic() - t0 < 2.0
+    msg = json.loads(body)["error"]["message"]
+    assert "no healthy model endpoints" in msg
+    assert "injected" in msg  # the per-endpoint last error rode along
+
+
+def test_deadline_budget_stops_retries(stack, monkeypatch):
+    """X-Deadline-Ms bounds the retry budget: once the first (slow,
+    failing) attempt eats it, the proxy reports the outcome as 504
+    instead of burning more attempts."""
+    _, _, server, add_model, engines = stack
+    add_model()
+    eng = engines[0]
+    calls = {"n": 0}
+
+    def slow_5xx(path, body):
+        calls["n"] += 1
+        time.sleep(0.15)
+        return 503, {"error": "boom"}
+
+    eng.behavior = slow_5xx
+    status, body = _post(
+        server, "/openai/v1/completions",
+        {"model": "m1", "prompt": "x"},
+        headers={"X-Deadline-Ms": "100"},
+    )
+    assert status == 504
+    msg = json.loads(body)["error"]["message"]
+    assert "deadline" in msg and "100" in msg
+    assert calls["n"] == 1  # no retry past the client's deadline
+
+
+def test_midstream_death_emits_terminal_sse_error(stack, monkeypatch):
+    """A connection dying mid-SSE must yield a finish_reason: "error"
+    chunk + a terminal `error` event + [DONE] — never silent truncation
+    — and the fault lands on the endpoint's health window."""
+    import http.client
+    import socket
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    store, lb, server, add_model, _ = stack
+
+    class DyingStreamEngine(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            p = b'data: {"choices": [{"index": 0, "delta": {"content": "hi"}}]}\n\n'
+            self.wfile.write(f"{len(p):x}\r\n".encode() + p + b"\r\n")
+            self.wfile.flush()
+            # Die without ever terminating the chunked body (shutdown,
+            # not close: rfile/wfile hold the fd, so close alone never
+            # sends FIN and the peer would block instead of seeing EOF).
+            self.connection.shutdown(socket.SHUT_RDWR)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), DyingStreamEngine)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        add_model(name="mdie")
+        pods = store.list("Pod", "default", {"model": "mdie"})
+        pod = store.get("Pod", "default", pods[0]["metadata"]["name"])
+        pod["metadata"]["annotations"]["model-pod-port"] = str(
+            httpd.server_address[1]
+        )
+        store.update(pod)
+        lb.sync_model("mdie")
+
+        host, _, port = server.address.partition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        conn.request(
+            "POST", "/openai/v1/chat/completions",
+            body=json.dumps(
+                {"model": "mdie", "messages": [], "stream": True}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        raw = resp.read().decode()
+        conn.close()
+        assert '"content": "hi"' in raw  # the real chunk got through
+        assert '"finish_reason": "error"' in raw
+        assert "event: error" in raw
+        assert "mid-stream" in raw
+        assert raw.rstrip().endswith("data: [DONE]")
+        # The fault was recorded against the endpoint's health window.
+        addr = f"127.0.0.1:{httpd.server_address[1]}"
+        snap = lb.group("mdie").snapshot()
+        assert snap["endpoints"][addr]["consecutive_failures"] >= 1
+        assert "mid-stream" in snap["endpoints"][addr]["last_error"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ---- graceful drain (scripted engine; no JAX compile in the loop) ------------
+
+
+class _ScriptedEngine:
+    """Pure-python Engine stand-in: one token per step per request, a
+    fixed per-step delay — deterministic in-flight durations for drain
+    tests without compiling anything."""
+
+    def __init__(self, step_delay=0.005):
+        self.cfg = types.SimpleNamespace(max_seq_len=4096)
+        self.step_delay = step_delay
+        self._lock = threading.Lock()
+        self._next = 0
+        self._reqs: dict[int, int] = {}
+        self._draining = False
+
+    def loaded_adapters(self):
+        return []
+
+    def add_request(self, prompt, sp, adapter=None, on_admit=None,
+                    priority=None, client="", deadline_ms=None):
+        from kubeai_tpu.engine.engine import EngineDraining
+
+        with self._lock:
+            if self._draining:
+                raise EngineDraining("engine is draining")
+            rid = self._next
+            self._next += 1
+            if on_admit is not None:
+                on_admit(rid)
+            self._reqs[rid] = sp.max_tokens
+            return rid
+
+    def begin_drain(self):
+        with self._lock:
+            self._draining = True
+
+    def cancel(self, rid):
+        with self._lock:
+            return self._reqs.pop(rid, None) is not None
+
+    def has_work(self):
+        return bool(self._reqs)
+
+    def step(self):
+        from kubeai_tpu.engine.engine import StepEvent
+
+        time.sleep(self.step_delay)
+        evs = []
+        with self._lock:
+            for rid in list(self._reqs):
+                self._reqs[rid] -= 1
+                finished = self._reqs[rid] <= 0
+                evs.append(
+                    StepEvent(
+                        rid=rid, token=0x61 + (rid % 20), finished=finished,
+                        finish_reason="stop" if finished else "",
+                    )
+                )
+                if finished:
+                    del self._reqs[rid]
+        return evs
+
+    @property
+    def num_active(self):
+        return len(self._reqs)
+
+    @property
+    def num_pending(self):
+        return 0
+
+
+@pytest.fixture
+def drain_server():
+    from kubeai_tpu.engine.server import EngineServer
+    from kubeai_tpu.engine.tokenizer import ByteTokenizer
+
+    def make(drain_timeout=5.0, step_delay=0.005):
+        srv = EngineServer(
+            _ScriptedEngine(step_delay=step_delay),
+            ByteTokenizer(),
+            "scripted",
+            host="127.0.0.1",
+            port=0,
+            drain_timeout=drain_timeout,
+        )
+        srv.start()
+        made.append(srv)
+        return srv
+
+    made: list = []
+    yield make
+    for srv in made:
+        srv.stop()
+
+
+def _stream_request(addr, max_tokens, results, key):
+    import http.client
+
+    host, _, port = addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    conn.request(
+        "POST", "/v1/completions",
+        body=json.dumps(
+            {"prompt": "hello", "max_tokens": max_tokens, "stream": True}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    results[key] = {"status": resp.status, "body": resp.read().decode()}
+    conn.close()
+
+
+def test_drain_completes_inflight_and_refuses_new(drain_server):
+    srv = drain_server(drain_timeout=10.0)
+    addr = f"127.0.0.1:{srv.port}"
+    results: dict = {}
+    threads = [
+        threading.Thread(
+            target=_stream_request, args=(addr, 60, results, i)
+        )
+        for i in range(3)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(0.05)  # streams are in flight
+
+    # Trigger the drain (the POST form; GET is the preStop alias).
+    status, body = http_post(addr, "/v1/drain", {})
+    assert status == 202
+    assert json.loads(body)["draining"] is True
+
+    # The LB's health view flips immediately.
+    status, body = http_get(addr, "/health")
+    assert status == 503
+    assert json.loads(body)["draining"] is True
+
+    # New work: 503 + Retry-After + Connection: close.
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    conn.request(
+        "POST", "/v1/completions",
+        body=json.dumps({"prompt": "new", "max_tokens": 4}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    assert resp.status == 503
+    assert resp.getheader("Retry-After") is not None
+    assert (resp.getheader("Connection") or "").lower() == "close"
+    assert json.loads(resp.read())["draining"] is True
+    conn.close()
+
+    # In-flight generations ran to COMPLETION within the budget.
+    for t in threads:
+        t.join(timeout=15)
+    assert srv.wait_drained(timeout=15)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0  # inside the drain budget
+    for i in range(3):
+        assert results[i]["status"] == 200
+        assert '"finish_reason": "stop"' in results[i]["body"]
+        assert "data: [DONE]" in results[i]["body"]
+    # GET /v1/drain (the kubelet preStop httpGet alias) stays 202.
+    assert http_get(addr, "/v1/drain")[0] == 202
+
+
+def test_drain_budget_expiry_terminates_streams_cleanly(drain_server):
+    # 1000 tokens × 20ms/step ≈ 20s of work against a 0.3s budget: the
+    # drain must terminate the stream CLEANLY (valid final chunk + DONE).
+    srv = drain_server(drain_timeout=0.3, step_delay=0.02)
+    addr = f"127.0.0.1:{srv.port}"
+    results: dict = {}
+    t = threading.Thread(
+        target=_stream_request, args=(addr, 1000, results, "r")
+    )
+    t.start()
+    time.sleep(0.1)
+    assert http_post(addr, "/v1/drain", {})[0] == 202
+    assert srv.wait_drained(timeout=10)
+    t.join(timeout=10)
+    assert results["r"]["status"] == 200
+    body = results["r"]["body"]
+    # Terminated, not truncated: a final chunk with a valid finish
+    # reason and the [DONE] sentinel both made it out.
+    assert '"finish_reason": "length"' in body
+    assert "data: [DONE]" in body
+    assert srv.metrics.drain_terminated.get() == 1
+
+
+# ---- simulation invariants (benchmarks/resilience_sim.py) --------------------
+
+
+def test_resilience_simulation_invariants():
+    """The kill/recover/flap simulation's invariants hold on a small
+    configuration — breaker regressions fail tier-1 instead of only
+    showing up during a production incident."""
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from benchmarks.resilience_sim import check_invariants, run_sim
+
+    summary = run_sim(waves_per_phase=80)
+    violations = check_invariants(summary)
+    assert violations == [], violations
+    # Spot-check the headline numbers, not just the pass/fail bits.
+    one_down = summary["phases"]["one_down"]
+    assert one_down["success_rate"] >= 0.99
+    assert one_down["max_attempts"] <= 2
+    assert summary["open_circuit_picks"] == 0
+    assert summary["probe_singular"]["singular"] is True

@@ -608,10 +608,74 @@ def test_retry_after_sleep_is_jittered(stack, monkeypatch):
 
     run_once(1.0)
     run_once(0.0)
-    assert len(sleeps) == 2
+    run_once(0.5)
+    assert len(sleeps) == 3
     # Retry-After 2.0 capped at 2.0: jitter 1.0 -> full 2.0s, jitter 0.0
-    # -> half. Two shed requests with different jitter draws sleep
-    # differently — no herd.
+    # -> half, jitter 0.5 -> midpoint. Two shed requests with different
+    # jitter draws sleep differently — no herd — and every sleep stays
+    # inside the [0.5, 1.0]× band of the hint the replica asked for.
     assert sleeps[0] == pytest.approx(2.0)
     assert sleeps[1] == pytest.approx(1.0)
+    assert sleeps[2] == pytest.approx(1.5)
     assert sleeps[0] != sleeps[1]
+    for s in sleeps:
+        assert 0.5 * 2.0 <= s <= 2.0
+
+
+def test_retry_after_non_numeric_is_ignored(stack, monkeypatch):
+    """RFC 7231 allows HTTP-date Retry-After values; the proxy must not
+    crash on (or sleep for) anything it can't parse as seconds — it just
+    re-picks immediately."""
+    from kubeai_tpu.routing import proxy as proxy_mod
+
+    _, _, server, add_model, engines = stack
+    add_model()
+    eng = engines[0]
+    sleeps: list[float] = []
+    monkeypatch.setattr(proxy_mod.time, "sleep", lambda s: sleeps.append(s))
+    calls = {"n": 0}
+
+    def shedding(path, body):
+        calls["n"] += 1
+        if calls["n"] < 2:
+            return 429, {"error": "shed"}, {
+                "Retry-After": "Wed, 21 Oct 2015 07:28:00 GMT"
+            }
+        return 200, {"ok": True}
+
+    eng.behavior = shedding
+    status, _ = _post(
+        server, "/openai/v1/completions", {"model": "m1", "prompt": "x"}
+    )
+    assert status == 200 and calls["n"] == 2
+    assert sleeps == []  # unparseable hint -> no backoff sleep
+
+
+def test_429_body_passes_through_with_class_depths(stack, monkeypatch):
+    """An engine that sheds on EVERY attempt: the final 429 passes
+    through with its body intact — per-class queue depths and the
+    computed retry_after_s reach the client, not a stripped shell."""
+    from kubeai_tpu.routing import proxy as proxy_mod
+
+    _, _, server, add_model, engines = stack
+    add_model()
+    monkeypatch.setattr(proxy_mod.time, "sleep", lambda s: None)
+    shed_body = {
+        "error": {"message": "engine queue full, retry later"},
+        "queue": {
+            "depths": {"realtime": 0, "standard": 7, "batch": 2},
+            "retry_after_s": 1.25,
+        },
+    }
+    engines[0].behavior = lambda p, b: (
+        429, shed_body, {"Retry-After": "1.25"}
+    )
+    status, data = _post(
+        server, "/openai/v1/completions", {"model": "m1", "prompt": "x"}
+    )
+    assert status == 429
+    payload = json.loads(data)
+    assert payload["queue"]["depths"] == {
+        "realtime": 0, "standard": 7, "batch": 2
+    }
+    assert payload["queue"]["retry_after_s"] == 1.25
