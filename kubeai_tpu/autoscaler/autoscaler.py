@@ -44,21 +44,64 @@ ACTIVE_METRIC = "kubeai_inference_requests_active"
 # EngineMetrics), scraped off each model's engine endpoints.
 QUEUE_DEPTH_METRIC = "kubeai_engine_queue_depth"
 QUEUE_OLDEST_WAIT_METRIC = "kubeai_engine_queue_oldest_wait_seconds"
+# Per-role scaling signals (disaggregated serving).
+KV_UTILIZATION_METRIC = "kubeai_engine_kv_cache_utilization"
+SLOTS_ACTIVE_METRIC = "kubeai_engine_slots_active"
+SLOT_CAPACITY_METRIC = "kubeai_engine_slot_capacity"
+TTFT_SUM_METRIC = "kubeai_engine_ttft_seconds_sum"
+TTFT_COUNT_METRIC = "kubeai_engine_ttft_seconds_count"
 
 
-def scrape_active_requests(addrs: list[str], timeout: float = 5.0) -> dict[str, float]:
-    """Aggregate the active-request gauge across operator replicas
-    (reference: modelautoscaler/metrics.go:15-71)."""
-    totals: dict[str, float] = {}
-    for addr in addrs:
-        url = f"http://{addr}/metrics"
+def _fetch_metrics(addr: str, timeout: float) -> str:
+    with urllib.request.urlopen(
+        f"http://{addr}/metrics", timeout=timeout
+    ) as resp:
+        return resp.read().decode()
+
+
+def _scrape_all(
+    addrs: list[str], timeout: float, fetch=None
+) -> dict[str, "str | Exception"]:
+    """Fetch every address CONCURRENTLY. Each endpoint gets the full
+    per-request timeout, but the wall cost of the whole sweep is one
+    slow endpoint, not their sum — serial scraping let a few dead
+    endpoints eat most of the tick interval. Returns
+    {addr: exposition text | the exception that fetch raised}."""
+    fetch = fetch or _fetch_metrics
+    results: dict[str, str | Exception] = {}
+    if not addrs:
+        return results
+    if len(addrs) == 1:
         try:
-            with urllib.request.urlopen(url, timeout=timeout) as resp:
-                text = resp.read().decode()
-        except OSError as e:
-            # A missing replica must not zero the signal: raise so the tick
-            # is skipped (reference treats scrape errors as tick failures).
-            raise RuntimeError(f"scraping {url}: {e}") from e
+            results[addrs[0]] = fetch(addrs[0], timeout)
+        except Exception as e:  # noqa: BLE001 — classified by callers
+            results[addrs[0]] = e
+        return results
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=min(16, len(addrs))) as pool:
+        futures = {addr: pool.submit(fetch, addr, timeout) for addr in addrs}
+        for addr, fut in futures.items():
+            try:
+                results[addr] = fut.result()
+            except Exception as e:  # noqa: BLE001
+                results[addr] = e
+    return results
+
+
+def scrape_active_requests(
+    addrs: list[str], timeout: float = 5.0, fetch=None
+) -> dict[str, float]:
+    """Aggregate the active-request gauge across operator replicas
+    (reference: modelautoscaler/metrics.go:15-71). Endpoints are scraped
+    concurrently; ANY failure still fails the tick (a missing replica
+    must not silently zero the signal)."""
+    totals: dict[str, float] = {}
+    for addr, text in _scrape_all(addrs, timeout, fetch).items():
+        if isinstance(text, Exception):
+            raise RuntimeError(
+                f"scraping http://{addr}/metrics: {text}"
+            ) from text
         for (name, labels), value in parse_prometheus_text(text).items():
             if name != ACTIVE_METRIC:
                 continue
@@ -68,9 +111,11 @@ def scrape_active_requests(addrs: list[str], timeout: float = 5.0) -> dict[str, 
     return totals
 
 
-def scrape_queue_pressure(addrs: list[str], timeout: float = 5.0) -> dict:
-    """Best-effort scrape of one model's ENGINE endpoints for the
-    scheduler's queue-pressure gauges. Returns
+def scrape_queue_pressure(
+    addrs: list[str], timeout: float = 5.0, fetch=None
+) -> dict:
+    """Best-effort CONCURRENT scrape of one model's ENGINE endpoints for
+    the scheduler's queue-pressure gauges. Returns
     ``{"depth": total, "oldest_wait_s": max, "per_class": {class: depth}}``.
 
     Unlike the active-request scrape (where a missing operator replica
@@ -81,13 +126,11 @@ def scrape_queue_pressure(addrs: list[str], timeout: float = 5.0) -> dict:
     depth = 0.0
     oldest = 0.0
     per_class: dict[str, float] = {}
-    for addr in addrs:
-        url = f"http://{addr}/metrics"
-        try:
-            with urllib.request.urlopen(url, timeout=timeout) as resp:
-                text = resp.read().decode()
-        except OSError as e:
-            logger.debug("queue-pressure scrape skipped %s: %s", url, e)
+    for addr, text in _scrape_all(addrs, timeout, fetch).items():
+        if isinstance(text, Exception):
+            logger.debug(
+                "queue-pressure scrape skipped %s: %s", addr, text
+            )
             continue
         for (name, labels), value in parse_prometheus_text(text).items():
             if name == QUEUE_DEPTH_METRIC:
@@ -98,6 +141,52 @@ def scrape_queue_pressure(addrs: list[str], timeout: float = 5.0) -> dict:
             elif name == QUEUE_OLDEST_WAIT_METRIC:
                 oldest = max(oldest, value)
     return {"depth": depth, "oldest_wait_s": oldest, "per_class": per_class}
+
+
+def scrape_role_signals(
+    addrs: list[str], timeout: float = 5.0, fetch=None
+) -> dict:
+    """Concurrent best-effort scrape of one ROLE's engine endpoints for
+    the disaggregated scaling signals: queue depth / oldest wait / mean
+    TTFT (prefill pressure) and KV utilization / slot occupancy (decode
+    pressure). Unreachable endpoints are skipped — role pools churn by
+    design while the autoscaler acts on them."""
+    out = {
+        "endpoints": 0,
+        "depth": 0.0,
+        "oldest_wait_s": 0.0,
+        "kv_utilization": 0.0,
+        "slots_active": 0.0,
+        "slot_capacity": 0.0,
+        "ttft_mean_s": 0.0,
+    }
+    kv_samples: list[float] = []
+    ttft_sum = ttft_count = 0.0
+    for addr, text in _scrape_all(addrs, timeout, fetch).items():
+        if isinstance(text, Exception):
+            logger.debug("role scrape skipped %s: %s", addr, text)
+            continue
+        out["endpoints"] += 1
+        for (name, labels), value in parse_prometheus_text(text).items():
+            if name == QUEUE_DEPTH_METRIC:
+                out["depth"] += value
+            elif name == QUEUE_OLDEST_WAIT_METRIC:
+                out["oldest_wait_s"] = max(out["oldest_wait_s"], value)
+            elif name == KV_UTILIZATION_METRIC:
+                kv_samples.append(value)
+            elif name == SLOTS_ACTIVE_METRIC:
+                out["slots_active"] += value
+            elif name == SLOT_CAPACITY_METRIC:
+                out["slot_capacity"] += value
+            elif name == TTFT_SUM_METRIC:
+                ttft_sum += value
+            elif name == TTFT_COUNT_METRIC:
+                ttft_count += value
+    if kv_samples:
+        out["kv_utilization"] = sum(kv_samples) / len(kv_samples)
+    if ttft_count > 0:
+        out["ttft_mean_s"] = ttft_sum / ttft_count
+    return out
 
 
 class Autoscaler:
@@ -123,6 +212,7 @@ class Autoscaler:
         self.last_decisions: list[dict] = []
         # Injectable for tests (fake engine endpoints without sockets).
         self.queue_scraper = scrape_queue_pressure
+        self.role_scraper = scrape_role_signals
         self.interval = cfg.model_autoscaling.interval_seconds
         self.window_count = cfg.model_autoscaling.average_window_count
         self._averages: dict[str, SimpleMovingAverage] = {}
@@ -191,6 +281,16 @@ class Autoscaler:
                 avg_tracker = self._avg_for(model.name)
                 avg = avg_tracker.next(active)
                 next_averages[model.name] = avg_tracker
+                if model.spec.disaggregation.enabled:
+                    # Disaggregated pod groups scale per role from their
+                    # own bottleneck signals; spec.replicas is not the
+                    # control surface for them.
+                    record = self._disagg_decisions(
+                        model, active, avg, scrape_s, len(addrs)
+                    )
+                    decisions.append(record)
+                    decision_log.info(json.dumps(record, sort_keys=True))
+                    continue
                 desired = int(-(-avg // model.spec.target_requests))  # ceil
                 # Queue-pressure boost: requests waiting in the engines'
                 # schedulers are demand the active-request gauge cannot
@@ -255,6 +355,101 @@ class Autoscaler:
             # (reference: autoscaler.go:115,159-163 rebuilds state per tick).
             self._averages = next_averages
             self._save_state()
+
+    def _disagg_decisions(
+        self, model, active: float, avg: float,
+        scrape_s: float, scraped_replicas: int,
+    ) -> dict:
+        """Per-role desired replicas for one disaggregated model.
+
+        Prefill is queue-shaped: scale for the prefills WAITING (depth /
+        target per replica), boosted when the oldest waiter or the mean
+        TTFT has aged past bounds — by then every queued request is
+        eating TTFT budget. Decode is occupancy-shaped: scale to keep
+        max(KV-pool utilization, slot occupancy) at the target fraction —
+        decode replicas die by running out of pages/slots, not by queue
+        depth. Both land in the Model's role annotations via
+        ModelClient.scale_role (hysteresis + CRD bounds applied there)."""
+        from kubeai_tpu.crd import metadata as md
+
+        dis = model.spec.disaggregation
+        group = self.lb.group(model.name)
+        pre_addrs = group.addresses(role=md.ROLE_PREFILL)
+        dec_addrs = group.addresses(role=md.ROLE_DECODE)
+        pre = self.role_scraper(pre_addrs)
+        dec = self.role_scraper(dec_addrs)
+        threshold = (
+            self.cfg.model_autoscaling.queue_pressure_max_wait_seconds
+        )
+
+        n_pre = max(1, len(pre_addrs))
+        desired_pre = int(-(-pre["depth"] // max(1, dis.prefill_target_queue)))
+        if threshold > 0 and pre["oldest_wait_s"] >= threshold:
+            desired_pre = max(desired_pre, n_pre + 1)
+        if (
+            dis.prefill_target_ttft_seconds > 0
+            and pre["ttft_mean_s"] > dis.prefill_target_ttft_seconds
+        ):
+            desired_pre = max(desired_pre, n_pre + 1)
+        applied_pre = self.model_client.scale_role(
+            model.name, md.ROLE_PREFILL, desired_pre
+        )
+
+        n_dec = max(1, len(dec_addrs))
+        slot_occ = (
+            dec["slots_active"] / dec["slot_capacity"]
+            if dec["slot_capacity"] > 0 else 0.0
+        )
+        util = max(dec["kv_utilization"], slot_occ)
+        desired_dec = int(
+            -(-(n_dec * util) // dis.decode_target_utilization)
+        ) if util > 0 else 1
+        desired_dec = max(1, desired_dec)
+        applied_dec = self.model_client.scale_role(
+            model.name, md.ROLE_DECODE, desired_dec
+        )
+
+        for role, desired, applied, signal in (
+            (md.ROLE_PREFILL, desired_pre, applied_pre, pre["depth"]),
+            (md.ROLE_DECODE, desired_dec, applied_dec, util),
+        ):
+            self.metrics.autoscaler_role_desired_replicas.set(
+                desired, model=model.name, role=role
+            )
+            self.metrics.autoscaler_role_applied_replicas.set(
+                applied, model=model.name, role=role
+            )
+            self.metrics.autoscaler_role_signal.set(
+                signal, model=model.name, role=role
+            )
+        self.metrics.autoscaler_signal.set(active, model=model.name)
+        self.metrics.autoscaler_average.set(avg, model=model.name)
+        return {
+            "ts": time.time(),
+            "model": model.name,
+            "disaggregated": True,
+            "signal": active,
+            "average": avg,
+            "scrape_duration_s": scrape_s,
+            "scraped_replicas": scraped_replicas,
+            "roles": {
+                md.ROLE_PREFILL: {
+                    "endpoints": len(pre_addrs),
+                    "queue_depth": pre["depth"],
+                    "queue_oldest_wait_s": pre["oldest_wait_s"],
+                    "ttft_mean_s": pre["ttft_mean_s"],
+                    "computed_replicas": desired_pre,
+                    "applied_replicas": applied_pre,
+                },
+                md.ROLE_DECODE: {
+                    "endpoints": len(dec_addrs),
+                    "kv_utilization": dec["kv_utilization"],
+                    "slot_occupancy": slot_occ,
+                    "computed_replicas": desired_dec,
+                    "applied_replicas": applied_dec,
+                },
+            },
+        }
 
     def _self_metric_addrs(self) -> list[str]:
         if self.cfg.fixed_self_metric_addrs:

@@ -26,6 +26,22 @@ MODEL_POD_SERVING_ANNOTATION = "model-pod-serving"
 POD_GROUP_LABEL = "model-group-index"
 POD_HOST_LABEL = "model-host-index"
 
+# Disaggregated serving (kubeai_tpu/disagg): a replica's serving role.
+# Unified replicas carry no role label; prefill/decode pod groups are
+# rendered with it and the LB keeps per-role endpoint groups keyed on it.
+POD_ROLE_LABEL = "model-role"
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_UNIFIED = "unified"
+DISAGG_ROLES = (ROLE_PREFILL, ROLE_DECODE)
+
+
+def role_replicas_annotation(role: str) -> str:
+    """Model annotation holding the autoscaler's per-role replica count
+    for disaggregated pod groups (spec.replicas stays the unified knob)."""
+    return f"{GROUP}/{role}-replicas"
+
+
 ADAPTER_LABEL_DOMAIN = "adapter.kubeai.org"
 # Comma-separated adapter names whose routing label was removed but whose
 # engine unload hasn't succeeded yet (409 while requests drain). Keeps the

@@ -215,6 +215,89 @@ class Scheduling:
 
 
 @dataclasses.dataclass
+class RoleScaling:
+    """Replica bounds for one disaggregated role's pod group. The
+    autoscaler writes the applied count into a Model annotation
+    (crd.metadata.role_replicas_annotation); these bounds clamp it."""
+
+    min_replicas: int = 1
+    max_replicas: int | None = None
+
+    def validate(self, role: str) -> None:
+        if self.min_replicas < 1:
+            # Disaggregated groups do not scale to zero: a pool with no
+            # prefill (or no decode) replicas can serve nothing, and the
+            # proxy's fallback would silently absorb the whole model.
+            raise ValidationError(
+                f"disaggregation.{role}.minReplicas must be >= 1"
+            )
+        if self.max_replicas is not None and self.max_replicas < self.min_replicas:
+            raise ValidationError(
+                f"disaggregation.{role}.maxReplicas must be >= minReplicas"
+            )
+
+
+@dataclasses.dataclass
+class Disaggregation:
+    """Disaggregated prefill/decode serving (kubeai_tpu/disagg; no
+    reference analog — the reference's vLLM replicas are monolithic).
+    When enabled, the operator renders TWO pod groups (role labels
+    prefill/decode, engine flag --role), the LB routes the two-hop
+    prefill→decode flow, and the autoscaler scales each role from its
+    own bottleneck signal: prefill from queue depth/oldest-wait/TTFT,
+    decode from KV utilization and active-slot occupancy."""
+
+    enabled: bool = False
+    prefill: RoleScaling = dataclasses.field(default_factory=RoleScaling)
+    decode: RoleScaling = dataclasses.field(default_factory=RoleScaling)
+    # Queued prefills per prefill replica before another replica is asked
+    # for (the prefill-role demand target).
+    prefill_target_queue: int = 4
+    # Mean engine TTFT (seconds) past which prefill is considered
+    # pressured regardless of queue depth. 0 disables the TTFT signal.
+    prefill_target_ttft_seconds: float = 0.0
+    # KV-pool / slot-occupancy fraction the decode group scales to hold.
+    decode_target_utilization: float = 0.8
+    # Transfer limits: serialized-handoff size cap (0 = unlimited) and
+    # the prefill engine's push timeout toward the decode pool.
+    max_transfer_mb: int = 0
+    transfer_timeout_seconds: float = 30.0
+
+    def role(self, role: str) -> RoleScaling:
+        if role == "prefill":
+            return self.prefill
+        if role == "decode":
+            return self.decode
+        raise KeyError(role)
+
+    def validate(self) -> None:
+        if not self.enabled:
+            return
+        self.prefill.validate("prefill")
+        self.decode.validate("decode")
+        if self.prefill_target_queue < 1:
+            raise ValidationError(
+                "disaggregation.prefillTargetQueue must be >= 1"
+            )
+        if self.prefill_target_ttft_seconds < 0:
+            raise ValidationError(
+                "disaggregation.prefillTargetTtftSeconds must be >= 0"
+            )
+        if not 0.0 < self.decode_target_utilization <= 1.0:
+            raise ValidationError(
+                "disaggregation.decodeTargetUtilization must be in (0, 1]"
+            )
+        if self.max_transfer_mb < 0:
+            raise ValidationError(
+                "disaggregation.maxTransferMB must be >= 0"
+            )
+        if self.transfer_timeout_seconds <= 0:
+            raise ValidationError(
+                "disaggregation.transferTimeoutSeconds must be > 0"
+            )
+
+
+@dataclasses.dataclass
 class ModelSpec:
     """(reference: api/k8s/v1/model_types.go:36-144)"""
 
@@ -248,6 +331,10 @@ class ModelSpec:
     draft_url: str = ""
     # SLO-aware queue discipline (in-tree engine only).
     scheduling: Scheduling = dataclasses.field(default_factory=Scheduling)
+    # Disaggregated prefill/decode serving (in-tree engine only).
+    disaggregation: Disaggregation = dataclasses.field(
+        default_factory=Disaggregation
+    )
     # Graceful-drain budget: seconds an engine waits for in-flight
     # generations after SIGTERM / POST /v1/drain before terminating the
     # remainder. 0 = the system config `resilience.drainTimeout`
@@ -328,6 +415,11 @@ class ModelSpec:
             raise ValidationError(
                 "spec.scheduling requires the KubeAITPU engine"
             )
+        self.disaggregation.validate()
+        if self.disaggregation.enabled and self.engine != ENGINE_KUBEAI_TPU:
+            raise ValidationError(
+                "spec.disaggregation requires the KubeAITPU engine"
+            )
         if self.drain_timeout_seconds < 0:
             raise ValidationError("drainTimeoutSeconds must be >= 0")
         if self.drain_timeout_seconds and self.engine != ENGINE_KUBEAI_TPU:
@@ -365,6 +457,25 @@ class ModelSpec:
                 raise ValidationError(f"duplicate adapter {a.name}")
             seen_adapters.add(a.name)
         self.load_balancing.validate()
+
+
+def disagg_role_replicas(model: "Model", role: str) -> int:
+    """The replica count a disaggregated role's pod group should run:
+    the autoscaler's annotation when present, else the role's floor —
+    always clamped into the CRD bounds (and never below 1; a role pool
+    at zero can serve nothing)."""
+    from kubeai_tpu.crd import metadata as md
+
+    rs = model.spec.disaggregation.role(role)
+    raw = model.annotations.get(md.role_replicas_annotation(role))
+    try:
+        n = int(raw) if raw is not None else rs.min_replicas
+    except (TypeError, ValueError):
+        n = rs.min_replicas
+    n = max(n, rs.min_replicas, 1)
+    if rs.max_replicas is not None:
+        n = min(n, rs.max_replicas)
+    return n
 
 
 @dataclasses.dataclass
@@ -457,6 +568,15 @@ class Model:
         lb = spec.get("loadBalancing", {}) or {}
         ph = lb.get("prefixHash", {}) or {}
         cb = lb.get("circuitBreaker", {}) or {}
+        dis = spec.get("disaggregation", {}) or {}
+
+        def _role_scaling(key: str) -> RoleScaling:
+            r = dis.get(key) or {}
+            return RoleScaling(
+                min_replicas=int(r.get("minReplicas", 1) or 1),
+                max_replicas=r.get("maxReplicas"),
+            )
+
         return Model(
             name=meta.get("name", ""),
             namespace=meta.get("namespace", "default"),
@@ -529,6 +649,24 @@ class Model:
                     max_deadline_ms=int(
                         (spec.get("scheduling") or {}).get("maxDeadlineMs", 0)
                         or 0
+                    ),
+                ),
+                disaggregation=Disaggregation(
+                    enabled=bool(dis.get("enabled", False)),
+                    prefill=_role_scaling("prefill"),
+                    decode=_role_scaling("decode"),
+                    prefill_target_queue=int(
+                        dis.get("prefillTargetQueue", 4) or 4
+                    ),
+                    prefill_target_ttft_seconds=float(
+                        dis.get("prefillTargetTtftSeconds", 0) or 0
+                    ),
+                    decode_target_utilization=float(
+                        dis.get("decodeTargetUtilization", 0.8) or 0.8
+                    ),
+                    max_transfer_mb=int(dis.get("maxTransferMB", 0) or 0),
+                    transfer_timeout_seconds=float(
+                        dis.get("transferTimeoutSeconds", 30) or 30
                     ),
                 ),
             ),
@@ -616,4 +754,31 @@ def _spec_to_dict(s: ModelSpec) -> dict:
         if s.scheduling.max_deadline_ms:
             sched["maxDeadlineMs"] = s.scheduling.max_deadline_ms
         d["scheduling"] = sched
+    if s.disaggregation.enabled:
+        dis = s.disaggregation
+
+        def _role_dict(r: RoleScaling) -> dict:
+            out: dict[str, Any] = {"minReplicas": r.min_replicas}
+            if r.max_replicas is not None:
+                out["maxReplicas"] = r.max_replicas
+            return out
+
+        d["disaggregation"] = {
+            "enabled": True,
+            "prefill": _role_dict(dis.prefill),
+            "decode": _role_dict(dis.decode),
+            "prefillTargetQueue": dis.prefill_target_queue,
+            "decodeTargetUtilization": dis.decode_target_utilization,
+            **(
+                {"prefillTargetTtftSeconds": dis.prefill_target_ttft_seconds}
+                if dis.prefill_target_ttft_seconds
+                else {}
+            ),
+            **(
+                {"maxTransferMB": dis.max_transfer_mb}
+                if dis.max_transfer_mb
+                else {}
+            ),
+            "transferTimeoutSeconds": dis.transfer_timeout_seconds,
+        }
     return d

@@ -1,0 +1,45 @@
+"""Disaggregated prefill/decode serving.
+
+Long prefills and short decode steps have opposite resource shapes:
+prefill is compute-bound and bursty, decode is memory-bandwidth-bound
+and latency-sensitive. Co-batching them on one replica lets a prefill
+burst stall every in-flight stream's next token. This package splits
+them: prefill-role engines run (chunked) prefill and EXPORT the paged
+KV as a `KVHandoff`; decode-role engines IMPORT handoffs straight into
+slots and only ever run decode steps. The router orchestrates the
+two-hop flow (routing/proxy.py), the operator renders per-role pod
+groups (operator/controller.py), and the autoscaler scales each role
+from its own bottleneck signal (autoscaler/autoscaler.py).
+
+Roles (crd.metadata.ROLE_*): "prefill", "decode", and the default
+"unified" which serves both phases monolithically — the fallback pool
+when no disaggregated capacity exists.
+"""
+
+from kubeai_tpu.disagg.handoff import (
+    HandoffError,
+    KVHandoff,
+    deserialize,
+    serialize,
+)
+from kubeai_tpu.disagg.transport import (
+    HandoffStore,
+    HTTPTransport,
+    InProcessTransport,
+    TransferError,
+    TransferResult,
+    read_chunked_body,
+)
+
+__all__ = [
+    "HandoffError",
+    "KVHandoff",
+    "serialize",
+    "deserialize",
+    "HandoffStore",
+    "HTTPTransport",
+    "InProcessTransport",
+    "TransferError",
+    "TransferResult",
+    "read_chunked_body",
+]

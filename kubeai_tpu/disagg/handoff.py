@@ -1,0 +1,201 @@
+"""KV handoff: the wire format of a prefilled request.
+
+A `KVHandoff` is everything a decode-role engine needs to resume a
+request at its FIRST decode step without re-running prefill: the token
+ids (prompt), the first sampled token, the per-layer paged KV written by
+prefill, the sampling state (seed, temperature, top-k/p, budget) and the
+page-aligned prefix-hash chain (so a decode engine with the prefix cache
+enabled can publish the imported pages).
+
+Serialization is dtype- and page-layout-preserving: the K/V pages ship
+as raw buffer bytes in the exporting pool's dtype and page size, with
+geometry in a JSON header. Import re-pages into the receiving pool's own
+page size by flattening to token order first — the VALUES are copied
+bit-exactly either way, which is what makes a disaggregated stream
+token-identical to a unified run (same KV bytes + same seeded sampler +
+same decode graph ⇒ same logits ⇒ same tokens).
+
+Wire format (all integers little-endian):
+
+    b"KVH1" | u32 header_len | header JSON (utf-8) | K bytes | V bytes
+
+    header: version, dtype, num_layers, kv_heads, head_dim, page_size,
+            n_pages, plen, token_ids, first_token, first_finish,
+            sampling {seed, temperature, top_k, top_p, max_tokens, stop},
+            prefix_hashes (hex), adapter, client, priority, model
+
+K/V arrays are [num_layers, n_pages, page_size, kv_heads, head_dim]
+packed pages covering exactly the sequence (the partial last page ships
+whole; junk past `plen` is masked by position on the decode side exactly
+as it is in the exporting pool).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import struct
+
+import numpy as np
+
+MAGIC = b"KVH1"
+VERSION = 1
+
+
+class HandoffError(ValueError):
+    """Malformed or incompatible handoff blob."""
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """Dtype by canonical name. bfloat16 lives in ml_dtypes (what JAX
+    arrays convert to under np.asarray), not numpy proper."""
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    try:
+        return np.dtype(name)
+    except TypeError as e:
+        raise HandoffError(f"unknown KV dtype {name!r}") from e
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    token_ids: list[int]  # the prefilled sequence (prompt tokens)
+    first_token: int  # sampled at prefill; decode resumes after it
+    first_finish: str  # "" | "stop" | "length": finished at token one
+    page_size: int
+    dtype: str  # "bfloat16" | "float32" | ...
+    k_pages: np.ndarray  # [NL, n_pages, page, KVH, D]
+    v_pages: np.ndarray
+    # Sampling state: the decode engine continues the SAME seeded sampler
+    # the prefill engine's first sample came from.
+    seed: int
+    temperature: float
+    top_k: int
+    top_p: float
+    max_tokens: int
+    stop: tuple[str, ...] = ()
+    # Page-aligned content-hash chain (hex) over the prompt — lets a
+    # prefix-cache-enabled decode pool publish the imported pages.
+    prefix_hashes: tuple[str, ...] = ()
+    adapter: str = ""
+    client: str = ""
+    priority: str = ""
+    model: str = ""
+
+    @property
+    def plen(self) -> int:
+        return len(self.token_ids)
+
+    def contiguous_kv(self) -> tuple[np.ndarray, np.ndarray]:
+        """Flatten the packed pages to token order [NL, plen, KVH, D] —
+        the page-size-independent view import scatters from."""
+        nl, n_pages, page, kvh, d = self.k_pages.shape
+        k = self.k_pages.reshape(nl, n_pages * page, kvh, d)[:, : self.plen]
+        v = self.v_pages.reshape(nl, n_pages * page, kvh, d)[:, : self.plen]
+        return k, v
+
+    def nbytes(self) -> int:
+        return int(self.k_pages.nbytes + self.v_pages.nbytes)
+
+
+def serialize(h: KVHandoff) -> bytes:
+    nl, n_pages, page, kvh, d = h.k_pages.shape
+    if h.v_pages.shape != h.k_pages.shape:
+        raise HandoffError(
+            f"K/V shape mismatch: {h.k_pages.shape} vs {h.v_pages.shape}"
+        )
+    header = {
+        "version": VERSION,
+        "dtype": h.dtype,
+        "num_layers": nl,
+        "n_pages": n_pages,
+        "page_size": page,
+        "kv_heads": kvh,
+        "head_dim": d,
+        "plen": h.plen,
+        "token_ids": list(map(int, h.token_ids)),
+        "first_token": int(h.first_token),
+        "first_finish": h.first_finish,
+        "sampling": {
+            "seed": int(h.seed),
+            "temperature": float(h.temperature),
+            "top_k": int(h.top_k),
+            "top_p": float(h.top_p),
+            "max_tokens": int(h.max_tokens),
+            "stop": list(h.stop),
+        },
+        "prefix_hashes": list(h.prefix_hashes),
+        "adapter": h.adapter,
+        "client": h.client,
+        "priority": h.priority,
+        "model": h.model,
+    }
+    hdr = json.dumps(header).encode()
+    k = np.ascontiguousarray(h.k_pages)
+    v = np.ascontiguousarray(h.v_pages)
+    return b"".join(
+        [MAGIC, struct.pack("<I", len(hdr)), hdr, k.tobytes(), v.tobytes()]
+    )
+
+
+def deserialize(blob: bytes) -> KVHandoff:
+    if len(blob) < 8 or blob[:4] != MAGIC:
+        raise HandoffError("not a KV handoff blob (bad magic)")
+    (hdr_len,) = struct.unpack("<I", blob[4:8])
+    if len(blob) < 8 + hdr_len:
+        raise HandoffError("truncated handoff header")
+    try:
+        header = json.loads(blob[8 : 8 + hdr_len])
+    except json.JSONDecodeError as e:
+        raise HandoffError(f"bad handoff header: {e}") from e
+    if header.get("version") != VERSION:
+        raise HandoffError(
+            f"unsupported handoff version {header.get('version')!r}"
+        )
+    dtype = _resolve_dtype(header["dtype"])
+    shape = (
+        header["num_layers"],
+        header["n_pages"],
+        header["page_size"],
+        header["kv_heads"],
+        header["head_dim"],
+    )
+    count = int(np.prod(shape))
+    body = blob[8 + hdr_len :]
+    expected = 2 * count * dtype.itemsize
+    if len(body) != expected:
+        raise HandoffError(
+            f"handoff body is {len(body)} bytes, expected {expected}"
+        )
+    k = np.frombuffer(body[: count * dtype.itemsize], dtype=dtype).reshape(
+        shape
+    )
+    v = np.frombuffer(body[count * dtype.itemsize :], dtype=dtype).reshape(
+        shape
+    )
+    plen = int(header["plen"])
+    if not 0 < plen <= header["n_pages"] * header["page_size"]:
+        raise HandoffError(f"plen {plen} outside shipped pages")
+    s = header.get("sampling") or {}
+    return KVHandoff(
+        token_ids=[int(t) for t in header["token_ids"]],
+        first_token=int(header["first_token"]),
+        first_finish=str(header.get("first_finish", "")),
+        page_size=int(header["page_size"]),
+        dtype=str(header["dtype"]),
+        k_pages=k,
+        v_pages=v,
+        seed=int(s.get("seed", 0)),
+        temperature=float(s.get("temperature", 1.0)),
+        top_k=int(s.get("top_k", 0)),
+        top_p=float(s.get("top_p", 1.0)),
+        max_tokens=int(s.get("max_tokens", 16)),
+        stop=tuple(s.get("stop") or ()),
+        prefix_hashes=tuple(header.get("prefix_hashes") or ()),
+        adapter=str(header.get("adapter", "")),
+        client=str(header.get("client", "")),
+        priority=str(header.get("priority", "")),
+        model=str(header.get("model", "")),
+    )

@@ -226,6 +226,13 @@ class EngineDraining(RuntimeError):
     503 + Retry-After so the LB moves the request to another replica."""
 
 
+class EngineBusy(RuntimeError):
+    """Raised by the synchronous disaggregation paths (export_handoff /
+    import_handoff) when no slot or KV pages are free RIGHT NOW: unlike
+    add_request there is no queue to park in, so the server sheds with
+    429 and the router re-picks a less-loaded replica."""
+
+
 class Engine:
     """Single-model, single-mesh continuous-batching engine."""
 
@@ -384,6 +391,15 @@ class Engine:
             )
 
         self.prefix_stats = {"lookups": 0, "hit_tokens": 0, "prompt_tokens": 0}
+        # Disaggregation accounting (cumulative; the server converts
+        # these to counters): handoffs exported after prefill, handoffs
+        # imported into decode slots, KV bytes in each direction.
+        self.disagg_stats = {
+            "exported": 0,
+            "imported": 0,
+            "exported_bytes": 0,
+            "imported_bytes": 0,
+        }
         if self.cache_mode == "paged":
             from kubeai_tpu.engine.paged_cache import PageAllocator, PagedKVCache
 
@@ -935,6 +951,46 @@ class Engine:
             _decode_chunk,
             donate_argnums=(1, 2),
             out_shardings=(None, pool_sharding, pool_sharding, None),
+        )
+
+        from kubeai_tpu.ops.paged_attention import (
+            scatter_sequence as _scatter_seq,
+            sequence_page_coords as _seq_coords,
+        )
+
+        def _import_handoff(ks, vs, ints, floats, bt_row, kp, vp, bt, state):
+            """Admit a prefilled KV handoff into a slot WITHOUT any
+            prefill compute: scatter the (max_seq_len-padded) imported
+            sequence through the freshly allocated block-table row and
+            set the slot's decode state so the next decode step resumes
+            exactly where the exporting engine's sampler left off.
+            `ints` packs [length, slot, seed, top_k, adapter,
+            first_token]; `floats` packs [temp, top_p]. Positions >=
+            length scatter into the reserved scratch page 0."""
+            length, slot = ints[0], ints[1]
+            seed = ints[2].astype(jnp.uint32)
+            topk, adapter, first = ints[3], ints[4], ints[5]
+            temp, topp = floats[0], floats[1]
+            page_ids, offsets = _seq_coords(bt_row, length, max_len, page)
+            kp, vp = _scatter_seq(kp, vp, ks, vs, page_ids, offsets)
+            bt = bt.at[slot].set(bt_row)
+            state = dict(
+                tokens=state["tokens"].at[slot].set(first),
+                positions=state["positions"].at[slot].set(length),
+                seeds=state["seeds"].at[slot].set(seed),
+                temp=state["temp"].at[slot].set(temp),
+                topk=state["topk"].at[slot].set(topk),
+                topp=state["topp"].at[slot].set(topp),
+                lora_idx=state["lora_idx"].at[slot].set(adapter),
+            )
+            return kp, vp, bt, state
+
+        self._import_handoff_jit = jax.jit(
+            _import_handoff,
+            donate_argnums=(5, 6),
+            out_shardings=(
+                pool_sharding, pool_sharding, self._bt_sharding, None,
+            ),
         )
 
         if self._spec:
@@ -2030,6 +2086,307 @@ class Engine:
             req.finish_reason = "cancelled"
             self._release(req)
             return True
+
+    # ---- disaggregated serving: KV handoff export / import ------------------
+
+    def export_handoff(
+        self,
+        prompt_tokens: list[int],
+        params: SamplingParams | None = None,
+        adapter: str | None = None,
+        client: str = "",
+        priority: str = "",
+        model_name: str = "",
+    ):
+        """Prefill-role serving: run (chunked) prefill for one request
+        SYNCHRONOUSLY, sample its first token, and return a `KVHandoff`
+        carrying the paged KV + sampling state — instead of entering
+        decode. The slot and pages are borrowed only for the duration of
+        this call; with the prefix cache enabled the prompt pages park in
+        the idle pool on release, so repeated shared prefixes skip most
+        of the prefill compute exactly as unified admission does.
+
+        Raises EngineBusy when no slot/pages are free right now (the
+        server sheds 429 and the router re-picks) and EngineDraining once
+        drain has begun."""
+        from kubeai_tpu.disagg.handoff import KVHandoff
+        from kubeai_tpu.engine.paged_cache import OutOfPages
+
+        if self.cache_mode != "paged":
+            raise RuntimeError(
+                "KV handoff export requires cache_mode='paged' (pages are "
+                "the transfer unit)"
+            )
+        params = params or SamplingParams()
+        adapter_idx = 0
+        if adapter:
+            if self._lora is None:
+                raise ValueError("LoRA is disabled (max_adapters=0)")
+            if adapter not in self._adapter_slots:
+                raise KeyError(f"adapter {adapter!r} not loaded")
+            adapter_idx = self._adapter_slots[adapter]
+        seq = list(prompt_tokens)
+        plen = len(seq)
+        if plen == 0:
+            raise ValueError("empty prompt")
+        if plen >= self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt length {plen} >= max_seq_len {self.cfg.max_seq_len}"
+            )
+        with self._lock:
+            if self._draining:
+                raise EngineDraining("engine is draining")
+            if not self._free_slots:
+                raise EngineBusy("no free prefill slot")
+            rid = self._next_rid
+            self._next_rid += 1
+            seed = (
+                params.seed if params.seed is not None
+                else (self._seed_base ^ rid)
+            ) & 0xFFFFFFFF
+            slot = self._free_slots.pop()
+            try:
+                pages = self._alloc.ensure(slot, plen)
+            except OutOfPages:
+                self._free_slots.append(slot)
+                raise EngineBusy("KV page pool exhausted")
+            try:
+                self._set_bt_row(slot, pages)
+                req = _Request(
+                    rid=rid, prompt=seq, params=params, seed=seed,
+                    adapter_idx=adapter_idx, client=client,
+                    stop_token_ids=self.eos_token_ids,
+                )
+                t0 = _now()
+                C = self.cfg.prefill_chunk
+                hashes = self._prefix_hashes(seq, adapter_idx)
+                if C > 0 and plen > C:
+                    tok = self._admit_chunked_paged(req, slot, seq, plen, C)
+                else:
+                    tok = int(
+                        self._admit_paged_batch(
+                            [(req, slot, seq, plen, False, None)],
+                            self._bucket(plen),
+                        )[0]
+                    )
+                self._timing.append(("prefill", max(0.0, _now() - t0)))
+                self._timing.append(("ttft", max(0.0, _now() - t0)))
+                # Gather the sequence's pages to host IN TABLE ORDER: the
+                # packed-page blob is position-major by construction.
+                idx = jnp.asarray(pages, jnp.int32)
+                k_host = np.asarray(
+                    jax.device_get(self.cache.k_pages[:, idx])
+                )
+                v_host = np.asarray(
+                    jax.device_get(self.cache.v_pages[:, idx])
+                )
+                if self._prefix_cache:
+                    # Publish the prompt pages before release so they park
+                    # in the idle LRU instead of returning to the free
+                    # list — the prefill-pool half of prefix caching.
+                    self._note_prefix_admission(req, slot, plen, 0, hashes)
+            finally:
+                self._alloc.release(slot)
+                self._bt_host[slot] = -1
+                self._bt_dirty = True
+                self._free_slots.append(slot)
+            first_finish = ""
+            if tok in self.eos_token_ids:
+                first_finish = "stop"
+            elif params.max_tokens <= 1:
+                first_finish = "length"
+            handoff = KVHandoff(
+                token_ids=seq,
+                first_token=tok,
+                first_finish=first_finish,
+                page_size=self.cfg.page_size,
+                dtype=np.dtype(self.cfg.cache_dtype).name,
+                k_pages=k_host,
+                v_pages=v_host,
+                seed=seed,
+                temperature=params.temperature,
+                top_k=params.top_k,
+                top_p=params.top_p,
+                max_tokens=params.max_tokens,
+                stop=tuple(params.stop),
+                prefix_hashes=tuple(h.hex() for h in hashes),
+                adapter=adapter or "",
+                client=client,
+                priority=priority,
+                model=model_name,
+            )
+            self.disagg_stats["exported"] += 1
+            self.disagg_stats["exported_bytes"] += handoff.nbytes()
+            return handoff
+
+    def import_handoff(self, handoff, on_admit=None) -> tuple[int, StepEvent]:
+        """Decode-role serving: admit a prefilled handoff DIRECTLY into a
+        slot — scatter its KV through a fresh block-table row and set the
+        slot's sampler state — bypassing every prefill graph. Returns
+        (rid, first_event): the first token was sampled by the exporting
+        engine, so the caller forwards `first_event` to its subscriber
+        itself (step() only emits tokens decoded HERE). `on_admit(rid)`
+        runs under the engine lock before the slot becomes visible to
+        step(), exactly like add_request's hook.
+
+        The decode stream is token-identical to a unified run: the pages
+        hold bit-identical KV bytes, the slot state resumes the same
+        seeded sampler at the same position, and decode runs the same
+        compiled graph."""
+        from kubeai_tpu.disagg.handoff import HandoffError
+
+        if self.cache_mode != "paged":
+            raise RuntimeError(
+                "KV handoff import requires cache_mode='paged'"
+            )
+        mcfg = self.model_cfg
+        nl, _n_pages, _page, kvh, d = handoff.k_pages.shape
+        if (nl, kvh, d) != (
+            mcfg.num_layers, mcfg.num_kv_heads, mcfg.head_size,
+        ):
+            raise HandoffError(
+                f"handoff geometry [{nl}L,{kvh}KVH,{d}D] does not match "
+                f"this model [{mcfg.num_layers}L,{mcfg.num_kv_heads}KVH,"
+                f"{mcfg.head_size}D]"
+            )
+        plen = handoff.plen
+        if plen >= self.cfg.max_seq_len:
+            raise HandoffError(
+                f"handoff length {plen} >= max_seq_len {self.cfg.max_seq_len}"
+            )
+        params = SamplingParams(
+            temperature=handoff.temperature,
+            top_k=handoff.top_k,
+            top_p=handoff.top_p,
+            max_tokens=handoff.max_tokens,
+            seed=handoff.seed,
+            stop=tuple(handoff.stop),
+        )
+        with self._lock:
+            if self._draining:
+                raise EngineDraining("engine is draining")
+            adapter_idx = 0
+            if handoff.adapter:
+                if (
+                    self._lora is None
+                    or handoff.adapter not in self._adapter_slots
+                ):
+                    raise KeyError(
+                        f"adapter {handoff.adapter!r} not loaded here"
+                    )
+                adapter_idx = self._adapter_slots[handoff.adapter]
+            rid = self._next_rid
+            self._next_rid += 1
+            first_ev = StepEvent(
+                rid, int(handoff.first_token),
+                bool(handoff.first_finish), handoff.first_finish,
+            )
+            if handoff.first_finish:
+                # Finished at its very first token: nothing to decode, no
+                # slot to occupy — the caller just emits the final event.
+                if on_admit is not None:
+                    on_admit(rid)
+                self.disagg_stats["imported"] += 1
+                self.disagg_stats["imported_bytes"] += handoff.nbytes()
+                return rid, first_ev
+            if not self._free_slots:
+                raise EngineBusy("no free decode slot")
+            from kubeai_tpu.engine.paged_cache import OutOfPages
+
+            slot = self._free_slots.pop()
+            try:
+                pages = self._alloc.ensure(slot, plen)
+            except OutOfPages:
+                self._free_slots.append(slot)
+                raise EngineBusy("KV page pool exhausted")
+            now = _now()
+            req = _Request(
+                rid=rid,
+                prompt=list(handoff.token_ids),
+                params=params,
+                seed=handoff.seed,
+                adapter_idx=adapter_idx,
+                priority=handoff.priority or CLASS_STANDARD,
+                client=handoff.client,
+                out_tokens=[int(handoff.first_token)],
+                slot=slot,
+                position=plen,
+                last_token=int(handoff.first_token),
+                stop_token_ids=self.eos_token_ids,
+                t_enqueue=now,
+                t_admit_start=now,
+                t_prev_token=now,
+            )
+            self._requests[rid] = req
+            if on_admit is not None:
+                try:
+                    on_admit(rid)
+                except BaseException:
+                    del self._requests[rid]
+                    self._alloc.release(slot)
+                    self._free_slots.append(slot)
+                    raise
+            self._set_bt_row(slot, pages)
+            # Re-page into THIS pool's layout: flatten to token order,
+            # zero-pad to max_seq_len (the scatter's static shape) and
+            # push through the import graph. Values are copied bit-exact;
+            # a dtype mismatch casts (and is caught by tests that assert
+            # token identity across matching-dtype pools).
+            k_seq, v_seq = handoff.contiguous_kv()
+            pad = np.zeros(
+                (nl, self.cfg.max_seq_len, kvh, d), dtype=k_seq.dtype
+            )
+            k_pad, v_pad = pad.copy(), pad
+            k_pad[:, :plen] = k_seq
+            v_pad[:, :plen] = v_seq
+            ints = jnp.asarray(
+                [
+                    plen,
+                    slot,
+                    int(np.uint32(handoff.seed & 0xFFFFFFFF).view(np.int32)),
+                    params.top_k,
+                    adapter_idx,
+                    int(handoff.first_token),
+                ],
+                jnp.int32,
+            )
+            floats = jnp.asarray(
+                [params.temperature, params.top_p], jnp.float32
+            )
+            (
+                self.cache.k_pages,
+                self.cache.v_pages,
+                self.cache.block_tables,
+                self._state,
+            ) = self._import_handoff_jit(
+                jnp.asarray(k_pad, self.cfg.cache_dtype),
+                jnp.asarray(v_pad, self.cfg.cache_dtype),
+                ints,
+                floats,
+                jnp.asarray(self._bt_host[slot]),
+                self.cache.k_pages,
+                self.cache.v_pages,
+                self.cache.block_tables,
+                self._state,
+            )
+            # _set_bt_row marked the host mirror dirty; the import graph
+            # also set the device row, so the next step's device_put is
+            # redundant but harmless (and still needed if OTHER slots'
+            # rows changed since the last dispatch).
+            if self._prefix_cache and handoff.prefix_hashes:
+                n_reg = min(
+                    plen // self.cfg.page_size, len(handoff.prefix_hashes)
+                )
+                if n_reg > 0:
+                    self._alloc.register(
+                        [bytes.fromhex(h) for h in
+                         handoff.prefix_hashes[:n_reg]],
+                        pages[:n_reg],
+                    )
+            self._active[slot] = req
+            self.disagg_stats["imported"] += 1
+            self.disagg_stats["imported_bytes"] += handoff.nbytes()
+            return rid, first_ev
 
     def _spec_pick(self) -> bool:
         """Choose this decode call's mode (True = speculative window,

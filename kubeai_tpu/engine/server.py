@@ -106,14 +106,51 @@ class EngineMetrics:
             "Speculative tokens accepted by verify.",
             self.registry,
         )
-        self.prefix_hit_tokens = Gauge(
+        # Monotonically-growing totals exported with COUNTER semantics
+        # (they were Gauges once — a `_total` metric that can be `set()`
+        # backward breaks every rate() over it); sync_engine folds the
+        # engine's cumulative stats in as deltas.
+        self.prefix_hit_tokens = Counter(
             "kubeai_engine_prefix_cached_tokens_total",
             "Prompt tokens served from the prefix cache (skipped prefill).",
             self.registry,
         )
-        self.prefix_prompt_tokens = Gauge(
+        self.prefix_prompt_tokens = Counter(
             "kubeai_engine_prefix_prompt_tokens_total",
             "Prompt tokens seen by prefix-cache admission.",
+            self.registry,
+        )
+        # -- disaggregated serving: KV handoff transfer ---------------------
+        self.kv_handoffs = Counter(
+            "kubeai_engine_kv_handoffs_total",
+            "KV handoffs by direction: exported after prefill (prefill "
+            "role) / imported into decode slots (decode role).",
+            self.registry,
+        )
+        self.kv_transfer_bytes = Counter(
+            "kubeai_engine_kv_transfer_bytes_total",
+            "Serialized KV handoff bytes moved, by direction "
+            "(export = pushed to a decode pool, import = received on "
+            "/v1/kv/import).",
+            self.registry,
+        )
+        self.kv_transfer_seconds = Histogram(
+            "kubeai_engine_kv_transfer_seconds",
+            "Wall time of one KV handoff transfer (chunked HTTP push or "
+            "receive), by direction.",
+            self.registry,
+            buckets=REQUEST_LATENCY_BUCKETS_S,
+        )
+        self.role_info = Gauge(
+            "kubeai_engine_role",
+            "1 for this replica's serving role label "
+            "(prefill/decode/unified).",
+            self.registry,
+        )
+        self.slot_capacity = Gauge(
+            "kubeai_engine_slot_capacity",
+            "Configured decode slots — with kubeai_engine_batch_size this "
+            "gives the autoscaler slot occupancy.",
             self.registry,
         )
         # -- request-lifecycle latency histograms --------------------------
@@ -254,9 +291,44 @@ class EngineMetrics:
             self.spec_accepted.set(stats["accepted"])
         pstats = snap["prefix_stats"]
         if pstats:
-            self.prefix_hit_tokens.set(pstats["hit_tokens"])
-            self.prefix_prompt_tokens.set(pstats["prompt_tokens"])
+            # Counter semantics over cumulative engine-side stats: fold in
+            # the delta since the last sync (never set, never backward).
+            self.prefix_hit_tokens.inc(
+                max(0.0, pstats["hit_tokens"] - self.prefix_hit_tokens.get())
+            )
+            self.prefix_prompt_tokens.inc(
+                max(
+                    0.0,
+                    pstats["prompt_tokens"]
+                    - self.prefix_prompt_tokens.get(),
+                )
+            )
         inner = getattr(engine, "inner", engine)  # LockstepEngine proxies
+        dstats = getattr(inner, "disagg_stats", None)
+        if dstats:
+            for direction, count_key, bytes_key in (
+                ("export", "exported", "exported_bytes"),
+                ("import", "imported", "imported_bytes"),
+            ):
+                self.kv_handoffs.inc(
+                    max(
+                        0.0,
+                        dstats[count_key]
+                        - self.kv_handoffs.get(direction=direction),
+                    ),
+                    direction=direction,
+                )
+                self.kv_transfer_bytes.inc(
+                    max(
+                        0.0,
+                        dstats[bytes_key]
+                        - self.kv_transfer_bytes.get(direction=direction),
+                    ),
+                    direction=direction,
+                )
+        slots = getattr(getattr(inner, "cfg", None), "num_slots", None)
+        if slots is not None:
+            self.slot_capacity.set(slots)
         drain = getattr(inner, "drain_timing", None)
         if drain is not None:
             for kind, seconds in drain():
@@ -319,11 +391,28 @@ class EngineServer:
         default_priority: str = "standard",
         max_deadline_ms: int = 0,
         drain_timeout: float = 30.0,
+        role: str = "unified",
+        max_transfer_mb: int = 0,
+        transfer_timeout: float = 30.0,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.served_model_name = served_model_name
         self.metrics = EngineMetrics()
+        # Disaggregated serving role: "prefill" turns every generate into
+        # prefill→handoff (pushed to the decode address the router names);
+        # "decode"/"unified" accept handoffs on /v1/kv/import and admit
+        # them via X-Disagg-Handoff. "unified" also serves normally — the
+        # router's fallback pool.
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"unknown engine role {role!r}")
+        self.role = role
+        self.max_transfer_bytes = max(0, int(max_transfer_mb)) * 1024 * 1024
+        self.transfer_timeout = transfer_timeout
+        from kubeai_tpu.disagg.transport import HandoffStore
+
+        self._handoffs = HandoffStore()
+        self.metrics.role_info.set(1, role=role)
         self.adapter_fetcher = adapter_fetcher
         # Scheduling defaults (CRD `scheduling:` block, rendered as engine
         # flags): applied when the request carries no X-Priority /
@@ -420,6 +509,8 @@ class EngineServer:
                             "model": outer.served_model_name,
                             "healthy": outer.healthy(),
                             "draining": outer.draining,
+                            "role": outer.role,
+                            "pending_handoffs": len(outer._handoffs),
                             "adapters": outer.engine.loaded_adapters(),
                             **engine_state_snapshot(outer.engine),
                         },
@@ -428,6 +519,10 @@ class EngineServer:
 
             def do_POST(self):
                 path = self.path.split("?")[0]
+                if path == "/v1/kv/import":
+                    # Binary (possibly chunked) upload: reads its own
+                    # body — the JSON decode below must not touch it.
+                    return outer._handle_kv_import(self)
                 n = int(self.headers.get("Content-Length", 0) or 0)
                 raw = self.rfile.read(n) if n else b""
                 try:
@@ -690,6 +785,14 @@ class EngineServer:
     def _handle_generate(self, http, body: dict, chat: bool):
         if self._draining.is_set():
             return self._drain_refusal(http)
+        if self.role == "prefill":
+            # A prefill-role engine NEVER enters decode: every generate
+            # becomes prefill → KV handoff pushed to the decode address
+            # the router named.
+            return self._handle_prefill_generate(http, body, chat)
+        hid = (http.headers.get("X-Disagg-Handoff") or "").strip()
+        if hid:
+            return self._handle_decode_from_handoff(http, body, chat, hid)
         model_field = str(body.get("model") or self.served_model_name)
         resolved = self._resolve_model(model_field)
         if resolved is None:
@@ -941,6 +1044,241 @@ class EngineServer:
                 else body.get("stop") or []
             ),
         )
+
+    # -- disaggregated serving (kubeai_tpu/disagg) ------------------------------
+
+    def _handle_prefill_generate(self, http, body: dict, chat: bool):
+        """Prefill role: tokenize → chunked prefill → export the paged-KV
+        handoff → push it to the decode engine the router named
+        (X-Disagg-Transfer) → answer a small JSON receipt the router
+        turns into the decode hop."""
+        from kubeai_tpu.disagg.transport import HTTPTransport, TransferError
+        from kubeai_tpu.engine.engine import EngineBusy
+
+        target = (http.headers.get("X-Disagg-Transfer") or "").strip()
+        if not target:
+            return http._json(
+                400,
+                {"error": {"message": (
+                    "prefill-role engine requires X-Disagg-Transfer: "
+                    "<decode host:port> (the router supplies it)"
+                )}},
+            )
+        model_field = str(body.get("model") or self.served_model_name)
+        resolved = self._resolve_model(model_field)
+        if resolved is None:
+            return http._json(
+                404,
+                {"error": {"message": f"model {model_field!r} not found"}},
+            )
+        display, adapter = resolved
+        raw_n = body.get("n")
+        if raw_n not in (None, 1):
+            # n > 1 decodes n independent streams from ONE prefill; the
+            # two-hop path hands off a single sampler state, so the
+            # router routes multi-choice requests to the unified pool.
+            return http._json(
+                400,
+                {"error": {"message":
+                           "n > 1 is not supported on the disaggregated "
+                           "path; use a unified endpoint"}},
+            )
+        if chat:
+            messages = body.get("messages") or []
+            prompt_ids = self.tokenizer.apply_chat_template(messages)
+        else:
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, list):
+                prompt = prompt[0] if prompt else ""
+            prompt_ids = self.tokenizer.encode(str(prompt))
+        if not prompt_ids:
+            prompt_ids = [0]
+        room = self.engine.cfg.max_seq_len - len(prompt_ids) - 1
+        if room <= 0:
+            return http._json(
+                400,
+                {"error": {"message": (
+                    f"prompt too long: {len(prompt_ids)} tokens >= "
+                    f"context {self.engine.cfg.max_seq_len}"
+                )}},
+            )
+        try:
+            sp = self._parse_sampling(body, room)
+            priority, _deadline, client = self._parse_scheduling(
+                http.headers, adapter
+            )
+        except ValueError as e:
+            return http._json(400, {"error": {"message": str(e)}})
+        try:
+            handoff = self.engine.export_handoff(
+                prompt_ids, sp, adapter=adapter, client=client,
+                priority=priority, model_name=display,
+            )
+        except EngineBusy as e:
+            return self._shed_response(http, str(e))
+        except EngineDraining:
+            return self._drain_refusal(http)
+        except KeyError as e:
+            return http._json(404, {"error": {"message": str(e)}})
+        self.metrics.requests_total.inc(model=display)
+        self.metrics.prompt_tokens.inc(len(prompt_ids))
+        if (
+            self.max_transfer_bytes
+            and handoff.nbytes() > self.max_transfer_bytes
+        ):
+            return http._json(
+                413,
+                {"error": {"message": (
+                    f"handoff of {handoff.nbytes()} bytes exceeds the "
+                    f"{self.max_transfer_bytes}-byte transfer limit"
+                )}},
+            )
+        hid = (http.headers.get("X-Handoff-Id") or "").strip() or None
+        try:
+            result = HTTPTransport(
+                target, timeout=self.transfer_timeout
+            ).send(handoff, handoff_id=hid)
+        except TransferError as e:
+            logger.warning("handoff push to %s failed: %s", target, e)
+            return http._json(502, {"error": {"message": str(e)}})
+        self.metrics.kv_transfer_seconds.observe(
+            result.seconds, direction="export"
+        )
+        return http._json(
+            200,
+            {
+                "object": "kv.handoff",
+                "handoff_id": result.handoff_id,
+                "decode_addr": target,
+                "model": display,
+                "prompt_tokens": len(prompt_ids),
+                "first_token": handoff.first_token,
+                "transfer": {
+                    "bytes": result.bytes,
+                    "seconds": round(result.seconds, 6),
+                },
+            },
+        )
+
+    def _handle_kv_import(self, http):
+        """POST /v1/kv/import — receive a serialized handoff (chunked
+        upload) into the bounded handoff store; the follow-up generate
+        request references it via X-Disagg-Handoff."""
+        from kubeai_tpu.disagg.handoff import HandoffError, deserialize
+        from kubeai_tpu.disagg.transport import (
+            TransferError,
+            read_chunked_body,
+        )
+
+        if self.role == "prefill":
+            return http._json(
+                400,
+                {"error": {"message":
+                           "prefill-role engines do not accept handoffs"}},
+            )
+        if self._draining.is_set():
+            return self._drain_refusal(http)
+        t0 = time.monotonic()
+        try:
+            te = (http.headers.get("Transfer-Encoding") or "").lower()
+            if "chunked" in te:
+                blob = read_chunked_body(
+                    http.rfile, max_bytes=self.max_transfer_bytes
+                )
+            else:
+                n = int(http.headers.get("Content-Length", 0) or 0)
+                if self.max_transfer_bytes and n > self.max_transfer_bytes:
+                    raise TransferError(
+                        f"upload of {n} bytes exceeds the "
+                        f"{self.max_transfer_bytes}-byte transfer limit"
+                    )
+                blob = http.rfile.read(n) if n else b""
+        except TransferError as e:
+            http.close_connection = True  # unread body bytes may remain
+            return http._json(413, {"error": {"message": str(e)}})
+        try:
+            handoff = deserialize(blob)
+        except HandoffError as e:
+            return http._json(400, {"error": {"message": str(e)}})
+        hid = self._handoffs.put(
+            handoff, (http.headers.get("X-Handoff-Id") or "").strip() or None
+        )
+        seconds = time.monotonic() - t0
+        # Bytes are counted at engine import time (disagg_stats via
+        # sync_engine) so in-process and HTTP transfers land in the same
+        # counter; only the receive latency is observed here.
+        self.metrics.kv_transfer_seconds.observe(seconds, direction="import")
+        return http._json(
+            200, {"handoff_id": hid, "bytes": len(blob)}
+        )
+
+    def _handle_decode_from_handoff(self, http, body: dict, chat: bool, hid: str):
+        """Decode role: admit a previously imported handoff straight into
+        a slot (no prefill graph runs) and stream from its first decode
+        step. The handoff's first token was sampled by the prefill
+        engine — it is emitted here as the stream's first event."""
+        from kubeai_tpu.disagg.handoff import HandoffError
+        from kubeai_tpu.engine.engine import EngineBusy
+
+        handoff = self._handoffs.pop(hid)
+        if handoff is None:
+            return http._json(
+                404,
+                {"error": {"message": f"unknown handoff id {hid!r} "
+                           "(expired or already consumed)"}},
+            )
+        display = handoff.model or self.served_model_name
+        sp = SamplingParams(
+            temperature=handoff.temperature,
+            top_k=handoff.top_k,
+            top_p=handoff.top_p,
+            max_tokens=handoff.max_tokens,
+            seed=handoff.seed,
+            stop=tuple(handoff.stop),
+        )
+        sub: queue.Queue = queue.Queue()
+
+        def register(rid: int) -> None:
+            with self._sub_lock:
+                self._subscribers[rid] = sub
+
+        try:
+            rid, first_ev = self.engine.import_handoff(
+                handoff, on_admit=register
+            )
+        except EngineBusy as e:
+            return self._shed_response(http, str(e))
+        except EngineDraining:
+            return self._drain_refusal(http)
+        except KeyError as e:
+            return http._json(404, {"error": {"message": str(e)}})
+        except HandoffError as e:
+            return http._json(400, {"error": {"message": str(e)}})
+        sub.put(first_ev)
+        self.metrics.requests_total.inc(model=display)
+        self.metrics.active_requests.inc()
+        self.metrics.prompt_tokens.inc(handoff.plen)
+        self._work.set()
+        stream = bool(body.get("stream", False))
+        t0 = time.monotonic()
+        span = getattr(http, "current_span", None)
+        reqs = [(rid, sub, sp)]
+        try:
+            if stream:
+                self._stream_response(http, reqs, display, chat, t0=t0,
+                                      span=span)
+            else:
+                self._unary_response(http, reqs, display, chat, handoff.plen)
+        finally:
+            if span is not None and not span.end_ns:
+                span.set_attribute(
+                    "request.duration_s", time.monotonic() - t0
+                )
+                span.set_attribute("disagg.handoff_id", hid)
+            self.engine.cancel(rid)
+            with self._sub_lock:
+                self._subscribers.pop(rid, None)
+            self.metrics.active_requests.dec()
 
     def _shed_response(self, http, message: str, retry_after: float | None = None):
         """429 with a COMPUTED Retry-After (queue depth ÷ drain rate, from
@@ -1411,6 +1749,26 @@ def main(argv=None) -> int:
         "before being terminated (CRD spec.drainTimeoutSeconds)",
     )
     ap.add_argument(
+        "--role", default="unified",
+        choices=["unified", "prefill", "decode"],
+        help="disaggregated serving role: prefill engines run chunked "
+        "prefill and push a KV handoff to the decode pool instead of "
+        "entering decode; decode engines admit handoffs directly into "
+        "slots (POST /v1/kv/import + X-Disagg-Handoff), bypassing the "
+        "prefill graphs (CRD spec.disaggregation)",
+    )
+    ap.add_argument(
+        "--max-transfer-mb", type=int, default=0,
+        help="cap on one serialized KV handoff (0 = unlimited); uploads "
+        "and exports past it answer 413 "
+        "(CRD disaggregation.maxTransferMB)",
+    )
+    ap.add_argument(
+        "--transfer-timeout", type=float, default=30.0,
+        help="prefill-role push budget toward the decode pool's "
+        "/v1/kv/import (CRD disaggregation.transferTimeoutSeconds)",
+    )
+    ap.add_argument(
         "--prefix-cache", action="store_true",
         help="automatic prefix caching: shared prompt prefixes skip "
         "prefill (pairs with the router's PrefixHash affinity). Implies "
@@ -1584,6 +1942,9 @@ def main(argv=None) -> int:
         default_priority=args.default_priority,
         max_deadline_ms=args.max_deadline_ms,
         drain_timeout=args.drain_timeout,
+        role=args.role,
+        max_transfer_mb=args.max_transfer_mb,
+        transfer_timeout=args.transfer_timeout,
     )
     tracing.configure(service_name=f"kubeai-tpu-engine.{args.served_model_name}")
     server.start()

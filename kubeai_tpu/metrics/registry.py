@@ -308,6 +308,20 @@ class Metrics:
             "could be attempted, per model.",
             self.registry,
         )
+        # -- disaggregated serving (two-hop prefill→decode) ----------------
+        self.proxy_disagg_requests = Counter(
+            "kubeai_proxy_disagg_requests_total",
+            "Requests served via the two-hop prefill→decode flow, per "
+            "model.",
+            self.registry,
+        )
+        self.proxy_disagg_fallback = Counter(
+            "kubeai_proxy_disagg_fallback_total",
+            "Disaggregation-enabled requests that fell back to the "
+            "unified pool (no role endpoints, open circuits, or a failed "
+            "hop), per model.",
+            self.registry,
+        )
         # -- autoscaler decision telemetry ---------------------------------
         self.autoscaler_ticks = Counter(
             "kubeai_autoscaler_ticks_total",
@@ -358,6 +372,27 @@ class Metrics:
             "kubeai_autoscaler_queue_oldest_wait_seconds",
             "Age of the oldest queued request across the model's engines "
             "at the last tick (queue-pressure staleness signal).",
+            self.registry,
+        )
+        # -- per-role autoscaling (disaggregated prefill/decode groups) ----
+        self.autoscaler_role_desired_replicas = Gauge(
+            "kubeai_autoscaler_role_desired_replicas",
+            "Desired replicas per disaggregated role computed at the last "
+            "tick (prefill from queue/TTFT pressure, decode from KV and "
+            "slot occupancy), before hysteresis/clamping.",
+            self.registry,
+        )
+        self.autoscaler_role_applied_replicas = Gauge(
+            "kubeai_autoscaler_role_applied_replicas",
+            "Replicas actually applied to the role's replica annotation "
+            "at the last tick.",
+            self.registry,
+        )
+        self.autoscaler_role_signal = Gauge(
+            "kubeai_autoscaler_role_signal",
+            "The role's raw bottleneck signal at the last tick: queued "
+            "prefills (prefill role) or pool utilization fraction "
+            "(decode role).",
             self.registry,
         )
 

@@ -22,7 +22,7 @@ import traceback
 
 from kubeai_tpu.config import System
 from kubeai_tpu.crd import metadata as md
-from kubeai_tpu.crd.model import Model
+from kubeai_tpu.crd.model import Model, disagg_role_replicas
 from kubeai_tpu.operator import adapters as adapters_mod
 from kubeai_tpu.operator import cache as cache_mod
 from kubeai_tpu.operator import files as files_mod
@@ -115,7 +115,9 @@ class ModelReconciler:
         n_all, ready = self._replica_counts(pods, mcfg)
         self._patch_status(model, replicas_all=n_all, replicas_ready=ready)
 
-        if mcfg.num_hosts > 1:
+        if model.spec.disaggregation.enabled and mcfg.num_hosts <= 1:
+            plan = self._plan_disagg(model, mcfg, pods)
+        elif mcfg.num_hosts > 1:
             plan = self._plan_multihost(model, model_obj, mcfg, pods)
         else:
             desired_pod = render_pod(model, self.cfg, mcfg, "x")
@@ -199,6 +201,62 @@ class ModelReconciler:
             return rendered
 
         return calculate_group_pod_plan(pods, model, render_group, mcfg.num_hosts)
+
+    def _plan_disagg(self, model, mcfg, pods):
+        """Disaggregated prefill/decode: render one desired pod PER ROLE
+        (role label + --role flag) and diff each role's pod set against
+        its own replica count — the autoscaler's per-role annotation,
+        clamped to the CRD bounds. spec.replicas stays the unified knob
+        and is ignored here; stray unified/unknown-role pods (a model
+        that just flipped disaggregation on) are deleted."""
+        import copy as _copy
+
+        from kubeai_tpu.operator.engines.kubeai_tpu_engine import (
+            kubeai_tpu_pod,
+        )
+        from kubeai_tpu.operator.pod_plan import PodPlan, calculate_pod_plan
+
+        by_role: dict[str, list[dict]] = {}
+        strays: list[dict] = []
+        for p in pods:
+            role = k8sutils.get_label(p, md.POD_ROLE_LABEL)
+            if role in md.DISAGG_ROLES:
+                by_role.setdefault(role, []).append(p)
+            else:
+                strays.append(p)
+
+        to_create: list[dict] = []
+        to_delete: list[dict] = list(strays)
+        to_remain: list[dict] = []
+        details = [
+            f"deleting roleless pod {p['metadata']['name']}" for p in strays
+        ]
+        for role in md.DISAGG_ROLES:
+            desired_pod = kubeai_tpu_pod(model, self.cfg, mcfg, "x", role=role)
+            self._apply_model_annotations(model, desired_pod)
+            if self.cfg.model_server_pods.json_patches:
+                desired_pod = apply_json_patches(
+                    self.cfg.model_server_pods.json_patches, desired_pod
+                )
+            # calculate_pod_plan reads spec.replicas: hand it a copy of
+            # the model with the ROLE's replica count in that seat.
+            role_model = _copy.deepcopy(model)
+            role_model.spec.replicas = disagg_role_replicas(model, role)
+            plan = calculate_pod_plan(
+                by_role.get(role, []), role_model, desired_pod,
+                self.cfg.model_rollouts.surge,
+            )
+            to_create += plan.to_create
+            to_delete += plan.to_delete
+            to_remain += plan.to_remain
+            details += [f"{role}: {d}" for d in plan.details]
+        return PodPlan(
+            model=model,
+            to_create=to_create,
+            to_delete=to_delete,
+            to_remain=to_remain,
+            details=details,
+        )
 
     def _apply_self_labels(self, model_obj: dict) -> bool:
         """Feature labels on the Model itself

@@ -25,7 +25,14 @@ from kubeai_tpu.operator.engines.common import (
 PORT = 8000
 
 
-def kubeai_tpu_pod(model: Model, cfg: System, mcfg: ModelConfig, suffix: str) -> dict:
+def kubeai_tpu_pod(
+    model: Model, cfg: System, mcfg: ModelConfig, suffix: str,
+    role: str = "",
+) -> dict:
+    """`role` renders one pod of a disaggregated group: the engine gets
+    `--role prefill|decode` (+ transfer limits from the CRD block) and
+    the pod carries the model-role label the LB's per-role endpoint
+    groups key on. "" renders the classic unified replica."""
     pod = base_pod(model, cfg, mcfg, suffix)
     env, volumes, mounts = source_env_and_volumes(model, cfg, mcfg)
     fvols, fmounts = files_volume(model, f"model-{model.name}-files")
@@ -72,6 +79,20 @@ def kubeai_tpu_pod(model: Model, cfg: System, mcfg: ModelConfig, suffix: str) ->
                 for cls, share in sorted(sched.queue_shares.items())
             ),
         ]
+    # Disaggregated serving role (CRD disaggregation: block): the engine
+    # flag plus the pod label the LB's role groups key on.
+    if role:
+        from kubeai_tpu.crd import metadata as md
+
+        args += ["--role", role]
+        dis = model.spec.disaggregation
+        if dis.max_transfer_mb:
+            args += ["--max-transfer-mb", str(dis.max_transfer_mb)]
+        if dis.transfer_timeout_seconds:
+            args += [
+                "--transfer-timeout", f"{dis.transfer_timeout_seconds:g}",
+            ]
+        pod["metadata"]["labels"][md.POD_ROLE_LABEL] = role
     # Adapters are NOT baked into the spec: they hot-swap through the
     # /v1/load_lora_adapter admin API (see operator/adapters.py), so adapter
     # changes never trigger a pod rollout.

@@ -56,31 +56,50 @@ def merge_model_adapter(model: str, adapter: str) -> str:
 
 def first_n_chars(s: str, n: int) -> str:
     """Rune-safe prefix (reference: apiutils/request.go:227-230). Python
-    strings are code points already, so slicing is safe."""
-    return s[:n]
+    strings are code points, so the slice can never split a surrogate
+    PAIR (json.loads combines valid pairs into one astral code point) —
+    but a LONE surrogate that arrived via invalid \\uDxxx JSON escapes
+    survives decoding and would crash every downstream utf-8 encode
+    (the CHWBL ring hashes the prefix's bytes). Sanitize those to the
+    replacement character so hashing is total AND deterministic — both
+    sides of the router see the same bytes for the same wire input."""
+    cut = s[:n]
+    try:
+        cut.encode("utf-8")
+    except UnicodeEncodeError:
+        cut = cut.encode("utf-8", "replace").decode("utf-8")
+    return cut
 
 
 def _message_text(content) -> str:
-    """Extract text from an OpenAI message content (string or parts list)."""
+    """Extract text from an OpenAI message content (string or parts
+    list). Empty parts are dropped before joining so ["a"] and
+    ["a", ""] — the same rendered prompt — hash to the same prefix."""
     if isinstance(content, str):
         return content
     if isinstance(content, list):
-        return " ".join(
+        parts = [
             p.get("text", "") for p in content
             if isinstance(p, dict) and p.get("type") == "text"
-        )
+        ]
+        return " ".join(p for p in parts if p)
     return ""
 
 
 def extract_prefix(path: str, body: dict, n: int) -> str:
-    """First user-message text (chat) / first prompt (completions), first
-    n chars — the CHWBL hash input."""
+    """First NON-EMPTY user-message text (chat) / first prompt
+    (completions), first n chars — the CHWBL hash input. Messages whose
+    content renders to "" (empty string, image-only part lists, null
+    content) are skipped: they contribute no prompt bytes, so keying the
+    route on them would scatter identical prompts across replicas."""
     if n <= 0:
         return ""
     if "chat/completions" in path:
         for msg in body.get("messages") or []:
             if isinstance(msg, dict) and msg.get("role") == "user":
-                return first_n_chars(_message_text(msg.get("content")), n)
+                text = _message_text(msg.get("content"))
+                if text:
+                    return first_n_chars(text, n)
         return ""
     prompt = body.get("prompt", "")
     if isinstance(prompt, list):

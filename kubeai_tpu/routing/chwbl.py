@@ -86,8 +86,12 @@ class CHWBL:
         def load_ok(ep: str) -> bool:
             return total == 0 or loads.get(ep, 0) <= threshold
 
+        # surrogatepass: a lone-surrogate key (invalid JSON escapes the
+        # front door passed through) must hash deterministically, never
+        # raise — apiutils sanitizes its prefixes, but CHWBL is also used
+        # with raw keys.
         start = bisect.bisect_left(
-            self._hashes, xxhash64(key.encode())
+            self._hashes, xxhash64(key.encode("utf-8", "surrogatepass"))
         ) % len(self._hashes)
         # The default is the FIRST endpoint in ring order that can serve the
         # request (has the adapter); it is returned when no serving-capable

@@ -81,7 +81,7 @@ SELF_METRICS_ADDR_ANNOTATION = "kubeai.org/metrics-addr"
 
 
 class _Endpoint:
-    __slots__ = ("address", "adapters", "in_flight", "health")
+    __slots__ = ("address", "adapters", "in_flight", "health", "role")
 
     def __init__(
         self,
@@ -89,11 +89,16 @@ class _Endpoint:
         adapters: set[str],
         policy: BreakerPolicy | None = None,
         clock=time.monotonic,
+        role: str = md.ROLE_UNIFIED,
     ):
         self.address = address
         self.adapters = adapters
         self.in_flight = 0
         self.health = EndpointHealth(policy, clock=clock)
+        # Disaggregated serving role from the pod's model-role label:
+        # "prefill" / "decode", or "unified" (no label). Role-filtered
+        # picks drive the proxy's two-hop prefill→decode flow.
+        self.role = role
 
 
 class Group:
@@ -133,25 +138,36 @@ class Group:
             for ep in self._endpoints.values():
                 ep.health.set_policy(policy)
 
-    def reconcile_endpoints(self, observed: dict[str, set[str]]) -> None:
-        """observed: address -> adapter names. Broadcasts on ANY change:
-        additions wake the scale-from-zero hold (reference: group.go:
-        108-137), removals wake waiters whose candidate/exclude predicate
-        just changed so they re-evaluate instead of sleeping on a stale
-        view."""
+    def reconcile_endpoints(
+        self,
+        observed: dict[str, set[str]],
+        roles: dict[str, str] | None = None,
+    ) -> None:
+        """observed: address -> adapter names; roles: address -> serving
+        role (absent/"" = unified). Broadcasts on ANY change: additions
+        wake the scale-from-zero hold (reference: group.go:108-137),
+        removals and role flips wake waiters whose candidate/exclude
+        predicate just changed so they re-evaluate instead of sleeping on
+        a stale view."""
+        roles = roles or {}
         with self._cond:
             changed = False
             for addr, adapters in observed.items():
+                role = roles.get(addr) or md.ROLE_UNIFIED
                 ep = self._endpoints.get(addr)
                 if ep is None:
                     self._endpoints[addr] = _Endpoint(
                         addr, set(adapters),
                         policy=self.breaker_policy, clock=self._clock,
+                        role=role,
                     )
                     self._chwbl.add(addr)
                     changed = True
                 else:
                     ep.adapters = set(adapters)
+                    if ep.role != role:
+                        ep.role = role
+                        changed = True
             for addr in list(self._endpoints):
                 if addr not in observed:
                     ep = self._endpoints.pop(addr)
@@ -169,9 +185,20 @@ class Group:
             if changed:
                 self._cond.notify_all()
 
-    def addresses(self) -> list[str]:
+    def addresses(self, role: str = "") -> list[str]:
         with self._cond:
-            return list(self._endpoints)
+            if not role:
+                return list(self._endpoints)
+            return [
+                a for a, e in self._endpoints.items() if e.role == role
+            ]
+
+    def has_role(self, role: str) -> bool:
+        """True when any endpoint carries the role — the proxy's cheap
+        "does a disaggregated pool exist" probe before committing to the
+        two-hop flow."""
+        with self._cond:
+            return any(e.role == role for e in self._endpoints.values())
 
     def get_best_addr(
         self,
@@ -180,19 +207,21 @@ class Group:
         prefix: str,
         timeout: float,
         exclude: Iterable[str] | None = None,
+        role: str = "",
     ) -> tuple[str, Callable[..., None]]:
         """Block until a suitable endpoint exists; account the request.
 
         `exclude` is the retry path's do-not-repick set: excluded
         addresses are avoided while any other available endpoint exists,
         and ignored otherwise (a single-replica group must still retry in
-        place rather than starve). Raises `NoHealthyEndpoints` without
+        place rather than starve). `role` restricts the candidate set to
+        one serving role ("" = any). Raises `NoHealthyEndpoints` without
         waiting when endpoints exist but every circuit is open."""
         excluded = frozenset(exclude or ())
         deadline = time.monotonic() + timeout
         with self._cond:
             while True:
-                eps = self._candidates(adapter)
+                eps = self._candidates(adapter, role)
                 if eps:
                     avail = [
                         e for e in eps if e.health.available(e.in_flight)
@@ -214,6 +243,7 @@ class Group:
                     addr = self._pick(
                         strategy, adapter, prefix,
                         {e.address for e in picks},
+                        role,
                     )
                     ep = self._endpoints[addr]
                     # An open circuit past its backoff transitions to
@@ -303,6 +333,7 @@ class Group:
                     ep.address: {
                         "in_flight": ep.in_flight,
                         "adapters": sorted(ep.adapters),
+                        "role": ep.role,
                         **ep.health.snapshot(),
                     }
                     for ep in self._endpoints.values()
@@ -312,8 +343,10 @@ class Group:
                 ),
             }
 
-    def _candidates(self, adapter: str) -> list[_Endpoint]:
+    def _candidates(self, adapter: str, role: str = "") -> list[_Endpoint]:
         eps = list(self._endpoints.values())
+        if role:
+            eps = [e for e in eps if e.role == role]
         if adapter:
             with_adapter = [e for e in eps if adapter in e.adapters]
             return with_adapter
@@ -321,7 +354,7 @@ class Group:
 
     def _pick(
         self, strategy: str, adapter: str, prefix: str,
-        allowed: set[str],
+        allowed: set[str], role: str = "",
     ) -> str:
         if strategy == LB_STRATEGY_PREFIX_HASH and prefix:
             loads = {a: e.in_flight for a, e in self._endpoints.items()}
@@ -330,7 +363,8 @@ class Group:
                 return addr
         # LeastLoad (and PrefixHash fallback when no prefix/ring).
         candidates = [
-            e for e in self._candidates(adapter) if e.address in allowed
+            e for e in self._candidates(adapter, role)
+            if e.address in allowed
         ]
         best = min(candidates, key=lambda e: e.in_flight)
         return best.address
@@ -421,6 +455,7 @@ class LoadBalancer:
 
     def sync_model(self, model: str, namespace: str = "default") -> None:
         observed: dict[str, set[str]] = {}
+        roles: dict[str, str] = {}
         for pod in self.store.list(
             "Pod", namespace, {md.POD_MODEL_LABEL: model}
         ):
@@ -447,8 +482,12 @@ class LoadBalancer:
             for k in (pod["metadata"].get("labels") or {}):
                 if k.startswith(prefix):
                     adapters.add(k[len(prefix):])
-            observed[f"{ip}:{port}"] = adapters
-        self.group(model).reconcile_endpoints(observed)
+            addr = f"{ip}:{port}"
+            observed[addr] = adapters
+            role = k8sutils.get_label(pod, md.POD_ROLE_LABEL)
+            if role:
+                roles[addr] = role
+        self.group(model).reconcile_endpoints(observed, roles=roles)
 
     def group(self, model: str) -> Group:
         with self._lock:
@@ -489,9 +528,11 @@ class LoadBalancer:
         strategy: str = "LeastLoad",
         timeout: float | None = None,
         exclude: Iterable[str] | None = None,
+        role: str = "",
     ) -> tuple[str, Callable[..., None]]:
         return self.group(model).get_best_addr(
             strategy, adapter, prefix,
             timeout=self.default_timeout if timeout is None else timeout,
             exclude=exclude,
+            role=role,
         )

@@ -108,6 +108,46 @@ class ModelClient:
                 return current  # next tick retries
             return replicas
 
+    def scale_role(self, name: str, role: str, replicas: int) -> int:
+        """Per-role scaling for disaggregated pod groups: writes the
+        role's replica annotation (the controller's _plan_disagg reads
+        it), clamped to the CRD disaggregation bounds, with the same
+        consecutive-scale-down hysteresis as unified scaling. Returns
+        the count in effect after the call."""
+        from kubeai_tpu.crd import metadata as md
+        from kubeai_tpu.crd.model import disagg_role_replicas
+
+        key = f"{name}/{role}"
+        with self._scale_lock:
+            try:
+                obj = self.store.get("Model", self.namespace, name)
+            except NotFound:
+                raise ModelNotFound(name)
+            model = Model.from_dict(obj)
+            rs = model.spec.disaggregation.role(role)
+            replicas = max(replicas, rs.min_replicas, 1)
+            if rs.max_replicas is not None:
+                replicas = min(replicas, rs.max_replicas)
+            current = disagg_role_replicas(model, role)
+            if replicas == current:
+                self._consecutive_scale_downs[key] = 0
+                return current
+            if replicas < current:
+                required = self._required_consecutive(model)
+                self._consecutive_scale_downs[key] = (
+                    self._consecutive_scale_downs.get(key, 0) + 1
+                )
+                if self._consecutive_scale_downs[key] < required:
+                    return current
+            self._consecutive_scale_downs[key] = 0
+            ann = obj["metadata"].setdefault("annotations", {})
+            ann[md.role_replicas_annotation(role)] = str(replicas)
+            try:
+                self.store.update(obj)
+            except Conflict:
+                return current  # next tick retries
+            return replicas
+
     def consecutive_scale_downs(self, name: str) -> int:
         """Pending scale-down votes for a model (hysteresis state; 0 when
         the last tick held or scaled up)."""

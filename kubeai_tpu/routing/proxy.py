@@ -33,6 +33,7 @@ import logging
 import random
 import time
 
+from kubeai_tpu.crd import metadata as md_roles
 from kubeai_tpu.crd.model import LB_STRATEGY_PREFIX_HASH
 from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
 from kubeai_tpu.metrics import tracing
@@ -69,6 +70,16 @@ RETRY_STATUSES = (429, 500, 502, 503, 504)
 # SLO-scheduling headers forwarded to engines (and stamped on spans):
 # priority class, admission deadline, WFQ fairness key.
 SCHEDULING_HEADERS = ("x-priority", "x-deadline-ms", "x-client-id")
+
+# Disaggregated two-hop flow (kubeai_tpu/disagg): the proxy names the
+# decode endpoint the prefill engine must push its KV handoff to, then
+# references the handoff on the decode hop.
+DISAGG_TRANSFER_HEADER = "X-Disagg-Transfer"
+DISAGG_HANDOFF_HEADER = "X-Disagg-Handoff"
+# Short non-blocking pick budget for role groups: a disaggregated pool
+# either exists now or the request falls back to unified — it must never
+# burn the scale-from-zero hold against an empty role group.
+DISAGG_PICK_TIMEOUT_S = 0.05
 
 # Jitter source for the Retry-After backoff (monkeypatchable in tests).
 _jitter = random.random
@@ -253,6 +264,30 @@ class ModelProxy:
         # (attempts are SIBLINGS — rebinding headers below must not make
         # attempt N+1 a child of attempt N).
         trace_parent = tracing.parse_traceparent(headers.get("traceparent"))
+
+        # Disaggregated prefill/decode: when the model opted in AND both
+        # role pools have routable endpoints, serve via the two-hop flow;
+        # ANY failure along it falls back to the loop below (the handoff
+        # is recomputed — fallback must never depend on disagg state).
+        # The fallback pick is role-restricted: prefill-role engines
+        # cannot serve plain generates, so route to the unified pool, or
+        # failing that the decode pool (which serves monolithically).
+        fallback_role = ""
+        if model.spec.disaggregation.enabled:
+            result = self._try_disagg(
+                path, preq, model, headers, strategy, prefix,
+                budget_left, request_id, trace_parent,
+            )
+            if result is not None:
+                return result
+            self.metrics.proxy_disagg_fallback.inc(model=model.name)
+            group = self.lb.group(model.name)
+            fallback_role = (
+                md_roles.ROLE_UNIFIED
+                if group.has_role(md_roles.ROLE_UNIFIED)
+                else md_roles.ROLE_DECODE
+            )
+
         for attempt in range(MAX_RETRIES):
             if attempt > 0:
                 self.metrics.proxy_retries.inc(model=model.name)
@@ -267,6 +302,7 @@ class ModelProxy:
                 strategy=strategy,
                 timeout=remaining,
                 exclude=failed_addrs,
+                role=fallback_role,
             )
             # One client span per attempt: retries show up as siblings
             # under the front door's server span, each carrying the
@@ -384,74 +420,261 @@ class ModelProxy:
 
             attempt_span.set_attribute("http.status_code", resp.status)
             attempt_span.end()
-            if resp.status == 429:
-                # Shed on the LAST attempt: the engine's 429 body (per-
-                # class queue depths + computed Retry-After) passes
-                # through untouched so clients can back off honestly.
-                done(outcome=OUTCOME_SHED, error="HTTP 429")
-            resp_headers = [
-                (k, v)
-                for k, v in resp.getheaders()
-                if k.lower() not in ("transfer-encoding", "connection")
-            ]
-            is_sse = any(
-                k.lower() == "content-type"
-                and v.lower().startswith("text/event-stream")
-                for k, v in resp_headers
-            )
-            is_chat = path.startswith("/v1/chat/")
-
-            def chunks(resp=resp, conn=conn, done=done, addr=addr,
-                       is_sse=is_sse, is_chat=is_chat):
-                # read1 (not read): read(n) on a chunked response BLOCKS
-                # until n bytes accumulate, which buffers ~160 small SSE
-                # events before anything reaches the client — destroying
-                # streaming TTFT/ITL through the proxy. read1 returns as
-                # soon as any data is available.
-                read = getattr(resp, "read1", resp.read)
-                try:
-                    while True:
-                        chunk = read(16384)
-                        if not chunk:
-                            break
-                        yield chunk
-                except GeneratorExit:
-                    # Client walked away mid-stream: release the slot
-                    # with no health outcome — the endpoint did nothing
-                    # wrong.
-                    conn.close()
-                    done()
-                    raise
-                except Exception as e:
-                    # The engine connection died partway through the
-                    # body. Silence here would truncate an SSE stream
-                    # with no terminal signal; emit one and record the
-                    # fault against the endpoint's health window.
-                    conn.close()
-                    done(
-                        outcome=OUTCOME_MIDSTREAM,
-                        error=f"mid-stream: {e}",
-                    )
-                    self.metrics.proxy_midstream_failures.inc(
-                        model=model.name
-                    )
-                    logger.warning(
-                        "mid-stream failure from %s: %s "
-                        "(model=%s request_id=%s)",
-                        addr, e, model.name, request_id,
-                    )
-                    if not is_sse:
-                        raise  # unary body: nothing valid left to send
-                    yield from _sse_error_tail(model.name, is_chat, e)
-                    return
-                else:
-                    conn.close()
-                    done(outcome=OUTCOME_SUCCESS)
-
-            return ProxyResult(
-                resp.status, resp_headers, chunks(), model=model.name
+            return self._forward_response(
+                resp, conn, done, addr, model.name, path, request_id
             )
         raise last_err or RuntimeError("retries exhausted")
+
+    def _try_disagg(
+        self, path, preq, model, headers, strategy, prefix,
+        budget_left, request_id, trace_parent,
+    ) -> ProxyResult | None:
+        """One two-hop prefill→decode attempt. Returns None whenever the
+        disaggregated path cannot (or should not) serve this request —
+        the caller falls back to the unified retry loop. Circuit-breaker
+        discipline is inherited from the role-filtered pick: an open
+        decode circuit is never handed a handoff (get_best_addr excludes
+        it, and raises NoHealthyEndpoints when the whole role pool is
+        open — which we translate into fallback, not failure)."""
+        if not path.startswith(("/v1/chat/completions", "/v1/completions")):
+            return None
+        group = self.lb.group(model.name)
+        if not (
+            group.has_role(md_roles.ROLE_PREFILL)
+            and group.has_role(md_roles.ROLE_DECODE)
+        ):
+            return None
+        try:
+            parsed = json.loads(preq.body or b"{}")
+        except json.JSONDecodeError:
+            return None
+        n = parsed.get("n") if isinstance(parsed, dict) else None
+        if isinstance(n, int) and not isinstance(n, bool) and n > 1:
+            # Multi-choice requests need n sampler states from one
+            # prefill; the handoff carries exactly one. Unified serves
+            # them.
+            return None
+        remaining = budget_left()
+        if remaining is not None and remaining <= 0:
+            return None
+        # Decode endpoint FIRST: the prefill engine pushes the handoff
+        # to it, so its address is part of the prefill request.
+        try:
+            d_addr, d_done = group.get_best_addr(
+                "LeastLoad", preq.adapter, "",
+                timeout=DISAGG_PICK_TIMEOUT_S, role=md_roles.ROLE_DECODE,
+            )
+        except (NoHealthyEndpoints, LoadBalancerTimeout):
+            return None
+        try:
+            # Prefill keeps the model's configured strategy + prefix so
+            # PrefixHash affinity lands shared prefixes on the prefill
+            # replica that already has their pages cached.
+            p_addr, p_done = group.get_best_addr(
+                strategy, preq.adapter, prefix,
+                timeout=DISAGG_PICK_TIMEOUT_S, role=md_roles.ROLE_PREFILL,
+            )
+        except (NoHealthyEndpoints, LoadBalancerTimeout):
+            d_done()
+            return None
+
+        span_attrs = {
+            "request.model": model.name,
+            "disagg.prefill_endpoint": p_addr,
+            "disagg.decode_endpoint": d_addr,
+        }
+        if request_id:
+            span_attrs["request.id"] = request_id
+
+        # ---- hop 1: prefill + handoff push ------------------------------
+        p_span = tracing.tracer().start_span(
+            "proxy.disagg.prefill",
+            parent=trace_parent,
+            kind=tracing.KIND_CLIENT,
+            attributes=span_attrs,
+        )
+        hop_headers = dict(
+            headers, traceparent=p_span.context.traceparent()
+        )
+        try:
+            resp, conn = _send(
+                p_addr, path, preq, hop_headers,
+                connect_timeout=self.timeouts.connect_s,
+                read_timeout=self.timeouts.response_header_s,
+                extra_headers={DISAGG_TRANSFER_HEADER: d_addr},
+            )
+        except OSError as e:
+            fault = (
+                OUTCOME_TIMEOUT if isinstance(e, TimeoutError)
+                else OUTCOME_CONNECT_ERROR
+            )
+            p_span.set_attribute("fault.class", fault)
+            p_span.end(error=str(e))
+            p_done(outcome=fault, error=f"{fault}: {e}")
+            d_done()
+            return None
+        if resp.status != 200:
+            body = resp.read()
+            conn.close()
+            outcome = (
+                OUTCOME_SHED if resp.status == 429
+                else OUTCOME_5XX if resp.status >= 500
+                else OUTCOME_SUCCESS  # a coherent 4xx answer
+            )
+            p_span.set_attribute("http.status_code", resp.status)
+            p_span.end(error=f"HTTP {resp.status}")
+            p_done(outcome=outcome, error=f"HTTP {resp.status}")
+            d_done()
+            logger.warning(
+                "disagg prefill hop to %s returned HTTP %d, falling back "
+                "to unified (model=%s request_id=%s body=%r)",
+                p_addr, resp.status, model.name, request_id, body[:200],
+            )
+            return None
+        try:
+            receipt = json.loads(resp.read() or b"{}")
+        except json.JSONDecodeError:
+            receipt = {}
+        conn.close()
+        handoff_id = str(receipt.get("handoff_id") or "")
+        p_span.set_attribute("http.status_code", 200)
+        if handoff_id:
+            p_span.set_attribute("disagg.handoff_id", handoff_id)
+        p_span.end()
+        p_done(outcome=OUTCOME_SUCCESS)
+        if not handoff_id:
+            d_done()
+            return None
+
+        # ---- hop 2: decode from the handoff -----------------------------
+        remaining = budget_left()
+        if remaining is not None and remaining <= 0:
+            d_done()
+            return None
+        d_span = tracing.tracer().start_span(
+            "proxy.disagg.decode",
+            parent=trace_parent,
+            kind=tracing.KIND_CLIENT,
+            attributes={**span_attrs, "disagg.handoff_id": handoff_id},
+        )
+        hop_headers = dict(
+            headers, traceparent=d_span.context.traceparent()
+        )
+        try:
+            resp, conn = _send(
+                d_addr, path, preq, hop_headers,
+                connect_timeout=self.timeouts.connect_s,
+                read_timeout=self.timeouts.response_header_s,
+                extra_headers={DISAGG_HANDOFF_HEADER: handoff_id},
+            )
+        except OSError as e:
+            fault = (
+                OUTCOME_TIMEOUT if isinstance(e, TimeoutError)
+                else OUTCOME_CONNECT_ERROR
+            )
+            d_span.set_attribute("fault.class", fault)
+            d_span.end(error=str(e))
+            d_done(outcome=fault, error=f"{fault}: {e}")
+            return None
+        if resp.status != 200:
+            resp.read()
+            conn.close()
+            outcome = (
+                OUTCOME_SHED if resp.status == 429
+                else OUTCOME_5XX if resp.status >= 500
+                else OUTCOME_SUCCESS
+            )
+            d_span.set_attribute("http.status_code", resp.status)
+            d_span.end(error=f"HTTP {resp.status}")
+            d_done(outcome=outcome, error=f"HTTP {resp.status}")
+            logger.warning(
+                "disagg decode hop to %s returned HTTP %d, falling back "
+                "to unified (model=%s request_id=%s)",
+                d_addr, resp.status, model.name, request_id,
+            )
+            return None
+        d_span.set_attribute("http.status_code", resp.status)
+        d_span.end()
+        self.metrics.proxy_disagg_requests.inc(model=model.name)
+        return self._forward_response(
+            resp, conn, d_done, d_addr, model.name, path, request_id
+        )
+
+    def _forward_response(
+        self, resp, conn, done, addr, model_name, path, request_id
+    ) -> ProxyResult:
+        """Pipe an accepted upstream response through to the client:
+        headers minus hop-by-hop fields, body chunk by chunk, the final
+        outcome fed to the endpoint's breaker. Shared by the unified
+        attempt loop and the disaggregated decode hop so mid-stream
+        fault handling cannot drift between the two paths."""
+        if resp.status == 429:
+            # Shed on the LAST attempt: the engine's 429 body (per-
+            # class queue depths + computed Retry-After) passes
+            # through untouched so clients can back off honestly.
+            done(outcome=OUTCOME_SHED, error="HTTP 429")
+        resp_headers = [
+            (k, v)
+            for k, v in resp.getheaders()
+            if k.lower() not in ("transfer-encoding", "connection")
+        ]
+        is_sse = any(
+            k.lower() == "content-type"
+            and v.lower().startswith("text/event-stream")
+            for k, v in resp_headers
+        )
+        is_chat = path.startswith("/v1/chat/")
+
+        def chunks(resp=resp, conn=conn, done=done, addr=addr,
+                   is_sse=is_sse, is_chat=is_chat):
+            # read1 (not read): read(n) on a chunked response BLOCKS
+            # until n bytes accumulate, which buffers ~160 small SSE
+            # events before anything reaches the client — destroying
+            # streaming TTFT/ITL through the proxy. read1 returns as
+            # soon as any data is available.
+            read = getattr(resp, "read1", resp.read)
+            try:
+                while True:
+                    chunk = read(16384)
+                    if not chunk:
+                        break
+                    yield chunk
+            except GeneratorExit:
+                # Client walked away mid-stream: release the slot
+                # with no health outcome — the endpoint did nothing
+                # wrong.
+                conn.close()
+                done()
+                raise
+            except Exception as e:
+                # The engine connection died partway through the
+                # body. Silence here would truncate an SSE stream
+                # with no terminal signal; emit one and record the
+                # fault against the endpoint's health window.
+                conn.close()
+                done(
+                    outcome=OUTCOME_MIDSTREAM,
+                    error=f"mid-stream: {e}",
+                )
+                self.metrics.proxy_midstream_failures.inc(
+                    model=model_name
+                )
+                logger.warning(
+                    "mid-stream failure from %s: %s "
+                    "(model=%s request_id=%s)",
+                    addr, e, model_name, request_id,
+                )
+                if not is_sse:
+                    raise  # unary body: nothing valid left to send
+                yield from _sse_error_tail(model_name, is_chat, e)
+                return
+            else:
+                conn.close()
+                done(outcome=OUTCOME_SUCCESS)
+
+        return ProxyResult(
+            resp.status, resp_headers, chunks(), model=model_name
+        )
 
 
 def _sse_error_tail(model_name: str, is_chat: bool, exc: Exception):
@@ -486,6 +709,7 @@ def _send(
     headers: dict,
     connect_timeout: float = 2.0,
     read_timeout: float = 300.0,
+    extra_headers: dict | None = None,
 ):
     """Open a connection with DISTINCT connect / response-header budgets:
     a dead host must fail in ~connect_timeout, while a busy engine still
@@ -507,6 +731,8 @@ def _send(
     ):
         if k in headers:
             fwd[k] = headers[k]
+    if extra_headers:
+        fwd.update(extra_headers)
     conn.request("POST", path, body=preq.body, headers=fwd)
     return conn.getresponse(), conn
 

@@ -52,6 +52,12 @@ def pytest_configure(config):
         "resilience: fault-injection / circuit-breaker / drain suite "
         "(runs in the fast tier; select with -m resilience)",
     )
+    config.addinivalue_line(
+        "markers",
+        "disagg: disaggregated prefill/decode serving suite — KV "
+        "handoff, role routing, per-role scaling (runs in the fast "
+        "tier; select with -m disagg)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

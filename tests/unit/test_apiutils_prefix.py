@@ -1,0 +1,147 @@
+"""Prefix-extraction edge cases (routing/apiutils): OpenAI content-part
+arrays, astral/surrogate code points at the cut boundary, empty
+messages — the inputs where the CHWBL routing key and the engine's
+prefix cache could drift apart or crash."""
+
+import json
+
+import pytest
+
+from kubeai_tpu.routing import apiutils
+from kubeai_tpu.routing.chwbl import CHWBL
+
+
+CHAT = "/v1/chat/completions"
+COMP = "/v1/completions"
+
+
+def _chat(*messages):
+    return {"messages": list(messages)}
+
+
+def test_content_part_arrays_match_plain_strings():
+    """List-form content (OpenAI content parts) must hash like the
+    equivalent plain string — same prompt bytes, same replica."""
+    plain = apiutils.extract_prefix(
+        CHAT, _chat({"role": "user", "content": "hello world"}), 100
+    )
+    parts = apiutils.extract_prefix(
+        CHAT,
+        _chat({
+            "role": "user",
+            "content": [
+                {"type": "text", "text": "hello"},
+                {"type": "text", "text": "world"},
+            ],
+        }),
+        100,
+    )
+    assert plain == parts == "hello world"
+
+
+def test_content_parts_skip_empty_and_non_text():
+    prefix = apiutils.extract_prefix(
+        CHAT,
+        _chat({
+            "role": "user",
+            "content": [
+                {"type": "image_url", "image_url": {"url": "http://x"}},
+                {"type": "text", "text": ""},
+                {"type": "text", "text": "actual"},
+                {"type": "text", "text": ""},
+            ],
+        }),
+        100,
+    )
+    # Empty parts contribute no separator: ["", "actual", ""] and
+    # ["actual"] are the same rendered prompt.
+    assert prefix == "actual"
+
+
+def test_empty_user_messages_are_skipped():
+    """A user message that renders to no text must not pin the route:
+    scanning continues to the first message with actual prompt bytes."""
+    body = _chat(
+        {"role": "user", "content": ""},
+        {"role": "user", "content": None},
+        {"role": "user", "content": [{"type": "image_url"}]},
+        {"role": "user", "content": "real prompt"},
+    )
+    assert apiutils.extract_prefix(CHAT, body, 100) == "real prompt"
+    # All-empty: no prefix (LeastLoad fallback), not a crash.
+    assert apiutils.extract_prefix(
+        CHAT, _chat({"role": "user", "content": ""}), 100
+    ) == ""
+    assert apiutils.extract_prefix(CHAT, _chat(), 100) == ""
+
+
+def test_surrogate_pair_emoji_not_split_at_boundary():
+    """json.loads combines a \\ud83d\\ude00 surrogate pair into ONE
+    astral code point, so a cut that lands "between" the halves in
+    UTF-16 terms keeps the whole emoji in Python — and the prefix must
+    still encode (the ring hashes its UTF-8 bytes)."""
+    body = json.loads('{"messages": [{"role": "user", '
+                      '"content": "ab\\ud83d\\ude00cd"}]}')
+    # n=3: a, b, and the full emoji (one code point).
+    prefix = apiutils.extract_prefix(CHAT, body, 3)
+    assert prefix == "ab\U0001F600"[:3]
+    prefix.encode("utf-8")  # must be encodable
+    # Identical cuts hash identically (routing stability).
+    assert prefix == apiutils.extract_prefix(CHAT, body, 3)
+
+
+def test_lone_surrogate_sanitized_not_crashing():
+    """Invalid JSON escapes (a LONE high surrogate) survive json.loads
+    as unpaired code points; the prefix must sanitize them so hashing
+    never raises UnicodeEncodeError mid-request."""
+    body = json.loads('{"prompt": "ab\\ud83dcd"}')
+    prefix = apiutils.extract_prefix(COMP, body, 100)
+    prefix.encode("utf-8")  # sanitized: always encodable
+    assert prefix.startswith("ab") and prefix.endswith("cd")
+    # Cut exactly ON the lone surrogate.
+    cut = apiutils.first_n_chars(json.loads('"ab\\ud83d"'), 3)
+    cut.encode("utf-8")
+    # And the ring itself is total even for raw surrogate keys.
+    ring = CHWBL(replication=4)
+    ring.add("e1:1")
+    assert ring.get("ab\ud83d", {"e1:1": 0}) == "e1:1"
+
+
+def test_prompt_list_and_non_string_forms():
+    assert apiutils.extract_prefix(COMP, {"prompt": ["first", "second"]},
+                                   100) == "first"
+    assert apiutils.extract_prefix(COMP, {"prompt": []}, 100) == ""
+    assert apiutils.extract_prefix(COMP, {"prompt": [[1, 2, 3]]}, 100) == ""
+    assert apiutils.extract_prefix(COMP, {"prompt": 42}, 100) == ""
+
+
+def test_first_n_chars_counts_code_points():
+    s = "\U0001F600" * 5
+    assert apiutils.first_n_chars(s, 2) == s[:2]
+    assert len(apiutils.first_n_chars(s, 2)) == 2
+    assert apiutils.first_n_chars("abc", 0) == ""
+
+
+def test_parse_request_prefix_consistency_with_parts():
+    """End to end through parse_request: string and part-list bodies of
+    the same prompt produce the same CHWBL prefix, so both land on the
+    same replica (whose engine prefix cache hashes the same prompt)."""
+    a = apiutils.parse_request(
+        json.dumps({
+            "model": "m", "messages": [
+                {"role": "user", "content": "shared system prompt tail"},
+            ],
+        }).encode(),
+        CHAT, {},
+    )
+    b = apiutils.parse_request(
+        json.dumps({
+            "model": "m", "messages": [
+                {"role": "user", "content": [
+                    {"type": "text", "text": "shared system prompt tail"},
+                ]},
+            ],
+        }).encode(),
+        CHAT, {},
+    )
+    assert a.prefix == b.prefix != ""

@@ -414,3 +414,64 @@ def test_scrape_queue_pressure_parses_engine_gauges():
     finally:
         for s in srvs:
             s.stop()
+
+
+def test_scrapes_run_concurrently_not_serially():
+    """Regression for the serial-scrape tick stall: N slow endpoints
+    must cost ~one per-request latency, not N of them. The fetcher is
+    injected (no sockets): each call sleeps a simulated latency and
+    stamps start/end times; concurrency shows up as overlapping
+    intervals and a wall time far below the serial sum."""
+    import threading
+    import time as _time
+
+    from kubeai_tpu.autoscaler.autoscaler import (
+        scrape_active_requests,
+        scrape_queue_pressure,
+    )
+
+    LATENCY = 0.15
+    N = 6
+    lock = threading.Lock()
+    spans: list[tuple[float, float]] = []
+
+    def slow_fetch(addr, timeout):
+        t0 = _time.monotonic()
+        _time.sleep(LATENCY)
+        with lock:
+            spans.append((t0, _time.monotonic()))
+        return (
+            "# TYPE kubeai_inference_requests_active gauge\n"
+            'kubeai_inference_requests_active{model="m1"} 1\n'
+            "# TYPE kubeai_engine_queue_depth gauge\n"
+            'kubeai_engine_queue_depth{class="standard"} 1\n'
+        )
+
+    addrs = [f"10.0.0.{i}:8080" for i in range(N)]
+    t0 = _time.monotonic()
+    totals = scrape_active_requests(addrs, timeout=2, fetch=slow_fetch)
+    wall = _time.monotonic() - t0
+    assert totals == {"m1": float(N)}
+    # Serial would take N * LATENCY = 0.9s; concurrent ~LATENCY.
+    assert wall < N * LATENCY * 0.6, f"scrape took {wall:.2f}s (serial?)"
+    overlapping = any(
+        a0 < b1 and b0 < a1
+        for i, (a0, a1) in enumerate(spans)
+        for (b0, b1) in spans[i + 1:]
+    )
+    assert overlapping, "no two fetches overlapped in time"
+
+    # Dead endpoints stall the queue-pressure scrape by ONE timeout,
+    # not one per endpoint (they run concurrently and are skipped).
+    def flaky_fetch(addr, timeout):
+        if addr.endswith(":1"):
+            _time.sleep(LATENCY)
+            raise OSError("connection refused")
+        return slow_fetch(addr, timeout)
+
+    dead = [f"10.0.1.{i}:1" for i in range(4)]
+    t0 = _time.monotonic()
+    out = scrape_queue_pressure(addrs + dead, timeout=2, fetch=flaky_fetch)
+    wall = _time.monotonic() - t0
+    assert out["depth"] == float(N)
+    assert wall < (N + len(dead)) * LATENCY * 0.6
