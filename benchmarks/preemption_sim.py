@@ -1,0 +1,398 @@
+"""Deterministic preemption-chaos simulation — no JAX, no sockets.
+
+Spot/preemptible TPU slices make replica loss the steady state. This sim
+drives the three layers that make replica death invisible through their
+failure schedules on a fake clock and reports the invariants the
+preemption-tolerance work promises:
+
+  * stream resume: with >= 2 replicas and single-replica preemption,
+    ZERO client-visible stream errors — every mid-stream death resumes
+    on another endpoint (proxy discipline: breaker exclude-set, bounded
+    resume count) and the delivered token sequence has no gap and no
+    duplicate;
+  * self-healing: every preempted / crash-looping pod is delete-and-
+    replaced by the REAL `ModelReconciler` pod-health pass within the
+    repair-backoff bound (fake monotonic + wall clocks injected);
+  * watchdog wins the race: a wedged-but-accepting engine is ejected
+    from the LB via the step watchdog (flip /health → kubelet restart →
+    pod replacement) strictly before the proxy's circuit breaker could
+    even theoretically open on response-header timeouts.
+
+`tests/unit/test_preemption.py::test_preemption_simulation_invariants`
+asserts these on a small configuration in tier-1. Run directly for the
+full-size report:
+
+    python benchmarks/preemption_sim.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeai_tpu.config import System
+from kubeai_tpu.crd.model import Model, ModelSpec
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.operator.controller import ModelReconciler
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.health import BreakerPolicy
+from kubeai_tpu.routing.loadbalancer import (
+    Group,
+    LoadBalancerTimeout,
+    NoHealthyEndpoints,
+)
+from kubeai_tpu.testing.faults import FakeClock
+
+MAX_STREAM_RESUMES = 3  # mirrors proxy.MAX_STREAM_RESUMES
+
+
+# ---- phase 1: transparent stream resume --------------------------------------
+
+
+def run_stream_phase(
+    n_endpoints: int = 3,
+    n_streams: int = 90,
+    tokens_per_stream: int = 40,
+    kill_every: int = 5,
+    kill_at_token: int = 17,
+    down_seconds: float = 3.0,
+    dt: float = 0.2,
+) -> dict:
+    """Every `kill_every`-th stream has its serving replica preempted
+    mid-generation (the replica then stays down `down_seconds` — the
+    operator's repair window). The client model follows the proxy's
+    resume discipline: record the midstream outcome against the breaker,
+    exclude the dead address, re-dispatch a continuation from the exact
+    token where the stream died, bounded by MAX_STREAM_RESUMES."""
+    clock = FakeClock()
+    group = Group(
+        metrics=Metrics(), model="sim", clock=clock,
+        breaker=BreakerPolicy(
+            window=10, consecutive_failures=3, failure_rate=0.5,
+            min_samples=5, open_seconds=2.0,
+        ),
+    )
+    endpoints = [f"ep{i}:1" for i in range(n_endpoints)]
+    group.reconcile_endpoints({e: set() for e in endpoints})
+    down_until = {e: -1.0 for e in endpoints}
+
+    client_errors = 0
+    resumed_streams = 0
+    broken_sequences = 0
+    resumes_used_max = 0
+    for s in range(n_streams):
+        delivered: list[int] = []
+        failed: set[str] = set()
+        pos = 0
+        dispatches = 0
+        killed_once = False
+        ok = False
+        while dispatches < 1 + MAX_STREAM_RESUMES:
+            try:
+                addr, done = group.get_best_addr(
+                    "LeastLoad", "", "", timeout=0.2, exclude=failed
+                )
+            except (NoHealthyEndpoints, LoadBalancerTimeout):
+                break
+            dispatches += 1
+            if down_until[addr] > clock():
+                # Replica is gone but the breaker hasn't ejected it yet:
+                # the attempt fails before any byte (pre-stream retry).
+                done(outcome="connect_error", error="replica preempted")
+                failed.add(addr)
+                continue
+            kill_here = (
+                s % kill_every == 0
+                and not killed_once
+                and pos <= kill_at_token < tokens_per_stream
+                # Single-replica preemption at a time — the phase's
+                # premise: never take a second replica while one is
+                # still down.
+                and all(du <= clock() for du in down_until.values())
+            )
+            stop_at = kill_at_token if kill_here else tokens_per_stream
+            while pos < stop_at:
+                delivered.append(pos)
+                pos += 1
+            if kill_here:
+                # Mid-stream death: replica preempted while decoding.
+                done(outcome="midstream", error="injected preemption")
+                down_until[addr] = clock() + down_seconds
+                failed.add(addr)
+                killed_once = True
+                resumed_streams += 1
+                continue  # continuation re-dispatch from `pos`
+            done(outcome="success")
+            ok = True
+            break
+        resumes_used_max = max(resumes_used_max, dispatches - 1)
+        if not ok:
+            client_errors += 1
+        elif delivered != list(range(tokens_per_stream)):
+            broken_sequences += 1
+        clock.advance(dt)
+    return {
+        "streams": n_streams,
+        "client_errors": client_errors,
+        "resumed_streams": resumed_streams,
+        "broken_sequences": broken_sequences,
+        "resumes_used_max": resumes_used_max,
+    }
+
+
+# ---- phase 2: self-healing operator repair -----------------------------------
+
+
+def _mk_model(store: KubeStore, replicas: int) -> None:
+    m = Model(
+        name="sim",
+        spec=ModelSpec(
+            url="hf://org/model",
+            engine="KubeAITPU",
+            features=["TextGeneration"],
+            resource_profile="google-tpu-v5e-1x1:1",
+            autoscaling_disabled=True,
+            replicas=replicas,
+        ),
+    )
+    m.validate()
+    store.create(m.to_dict())
+
+
+def _mark_ready(store: KubeStore, pod: dict, wall: FakeClock) -> None:
+    fresh = store.get(
+        "Pod", pod["metadata"]["namespace"], pod["metadata"]["name"]
+    )
+    fresh.setdefault("status", {})["conditions"] = [
+        {"type": "Ready", "status": "True"},
+        {"type": "PodScheduled", "status": "True"},
+    ]
+    fresh["status"]["phase"] = "Running"
+    store.update(fresh)
+
+
+def _break_pod(store: KubeStore, pod: dict, mode: str) -> None:
+    fresh = store.get(
+        "Pod", pod["metadata"]["namespace"], pod["metadata"]["name"]
+    )
+    status = fresh.setdefault("status", {})
+    if mode == "preempt":
+        status["phase"] = "Failed"
+        status["reason"] = "Preempted"
+        status["conditions"] = [{"type": "Ready", "status": "False"}]
+    else:  # crashloop
+        status["phase"] = "Running"
+        status["conditions"] = [{"type": "Ready", "status": "False"}]
+        status["containerStatuses"] = [
+            {
+                "name": "server",
+                "restartCount": 7,
+                "state": {"waiting": {"reason": "CrashLoopBackOff"}},
+            }
+        ]
+    store.update(fresh)
+
+
+def run_repair_phase(
+    replicas: int = 3, rounds: int = 6, step_s: float = 1.0
+) -> dict:
+    """Alternating preemption / crash-loop kills against a live replica
+    set, repaired by the REAL reconciler pod-health pass on fake clocks.
+    Measures how long each broken pod survives (clock time between the
+    break and its replacement) against the repair-backoff bound."""
+    store = KubeStore()
+    cfg = System()
+    cfg.allow_pod_address_override = True
+    cfg.default_and_validate()
+    clock = FakeClock(100.0)  # monotonic-ish: repair backoff spacing
+    wall = FakeClock(1_000_000.0)  # wall-ish: pod age comparisons
+    metrics = Metrics()
+    rec = ModelReconciler(
+        store, cfg, metrics=metrics, clock=clock, wall=wall
+    )
+    _mk_model(store, replicas)
+    rec.reconcile("default", "sim")
+    for pod in store.list("Pod", "default", {"model": "sim"}):
+        _mark_ready(store, pod, wall)
+    rec.reconcile("default", "sim")
+
+    bound_s = cfg.resilience.repair_backoff_max_seconds + step_s
+    repair_delays: list[float] = []
+    unrepaired = 0
+    for rnd in range(rounds):
+        pods = store.list("Pod", "default", {"model": "sim"})
+        victim = pods[rnd % len(pods)]
+        victim_name = victim["metadata"]["name"]
+        _break_pod(store, victim, "preempt" if rnd % 2 == 0 else "crashloop")
+        t0 = clock()
+        # The watch would requeue on the pod MODIFIED event; the sim
+        # drives reconcile directly, advancing the clocks until the
+        # victim is gone (repair backoff may defer a pass or two).
+        for _ in range(int(bound_s / step_s) + 2):
+            rec.reconcile("default", "sim")
+            names = {
+                p["metadata"]["name"]
+                for p in store.list("Pod", "default", {"model": "sim"})
+            }
+            if victim_name not in names:
+                break
+            clock.advance(step_s)
+            wall.advance(step_s)
+        names = {
+            p["metadata"]["name"]
+            for p in store.list("Pod", "default", {"model": "sim"})
+        }
+        if victim_name in names:
+            unrepaired += 1
+            continue
+        repair_delays.append(clock() - t0)
+        # Fresh replacements come up Ready before the next round.
+        for pod in store.list("Pod", "default", {"model": "sim"}):
+            _mark_ready(store, pod, wall)
+        rec.reconcile("default", "sim")
+        clock.advance(step_s)
+        wall.advance(step_s)
+
+    model = store.get("Model", "default", "sim")
+    conds = {
+        c["type"]: c for c in model["status"].get("conditions", [])
+    }
+    return {
+        "rounds": rounds,
+        "unrepaired": unrepaired,
+        "repair_delays_s": repair_delays,
+        "max_repair_delay_s": max(repair_delays, default=0.0),
+        "backoff_bound_s": bound_s,
+        "replacements_total": sum(
+            metrics.controller_pod_replacements.get(
+                model="sim", reason=reason
+            )
+            for reason in ("SpotPreemption", "CrashLoopBackOff")
+        ),
+        "final_conditions": {
+            t: {"status": c["status"], "reason": c["reason"]}
+            for t, c in conds.items()
+        },
+    }
+
+
+# ---- phase 3: watchdog beats the breaker -------------------------------------
+
+
+def run_watchdog_phase(reconcile_notice_s: float = 10.0) -> dict:
+    """A WEDGED engine (accepts connections, never produces response
+    headers) is the breaker's worst case: every proxy attempt fails only
+    after the response-header timeout, so even fully parallel attempts
+    cannot open the circuit before ONE header timeout elapses (and a
+    serial client takes consecutive_failures of them). The step watchdog
+    must eject the pod — /health flip, nonzero exit, kubelet restart,
+    LB watch removal — strictly before that earliest opening."""
+    r = System().resilience
+    watchdog_fire_s = r.watchdog_timeout_seconds
+    lb_eject_s = watchdog_fire_s + reconcile_notice_s
+    breaker_open_earliest_s = r.response_header_timeout_seconds
+    breaker_open_serial_s = (
+        r.breaker_consecutive_failures * r.response_header_timeout_seconds
+    )
+    # Mechanism check on a fake-clocked Group: dropping the endpoint at
+    # lb_eject_s leaves the breaker still closed (it never saw an
+    # outcome — the wedged attempts are still waiting on headers).
+    clock = FakeClock()
+    group = Group(metrics=Metrics(), model="sim-wedge", clock=clock)
+    group.reconcile_endpoints({"wedged:1": set(), "ok:1": set()})
+    clock.advance(lb_eject_s)
+    group.reconcile_endpoints({"ok:1": set()})  # operator replaced the pod
+    ejected = "wedged:1" not in group.snapshot()["endpoints"]
+    return {
+        "watchdog_fire_s": watchdog_fire_s,
+        "lb_eject_s": lb_eject_s,
+        "breaker_open_earliest_s": breaker_open_earliest_s,
+        "breaker_open_serial_s": breaker_open_serial_s,
+        "ejected_before_breaker": (
+            ejected and lb_eject_s < breaker_open_earliest_s
+        ),
+    }
+
+
+# ---- invariants --------------------------------------------------------------
+
+
+def run_sim(**kw) -> dict:
+    return {
+        "streams": run_stream_phase(
+            **{k: v for k, v in kw.items() if k in (
+                "n_endpoints", "n_streams", "tokens_per_stream",
+                "kill_every", "kill_at_token", "down_seconds", "dt",
+            )}
+        ),
+        "repair": run_repair_phase(
+            **{k: v for k, v in kw.items() if k in (
+                "replicas", "rounds", "step_s",
+            )}
+        ),
+        "watchdog": run_watchdog_phase(),
+    }
+
+
+def check_invariants(summary: dict) -> list[str]:
+    """Returns a list of violated invariants (empty = all hold)."""
+    errors = []
+    st = summary["streams"]
+    if st["client_errors"] != 0:
+        errors.append(
+            f"stream resume: {st['client_errors']} client-visible stream "
+            "error(s) under single-replica preemption with >= 2 replicas"
+        )
+    if st["broken_sequences"] != 0:
+        errors.append(
+            f"stream resume: {st['broken_sequences']} stream(s) had token "
+            "gaps or duplicates after resume"
+        )
+    if st["resumed_streams"] == 0:
+        errors.append("stream resume: the kill schedule never fired "
+                      "(sim is not exercising resume)")
+    rp = summary["repair"]
+    if rp["unrepaired"] != 0:
+        errors.append(
+            f"self-healing: {rp['unrepaired']} broken pod(s) were never "
+            "replaced"
+        )
+    if rp["max_repair_delay_s"] > rp["backoff_bound_s"]:
+        errors.append(
+            "self-healing: a repair took "
+            f"{rp['max_repair_delay_s']:.1f}s > backoff bound "
+            f"{rp['backoff_bound_s']:.1f}s"
+        )
+    if rp["replacements_total"] < rp["rounds"] - rp["unrepaired"]:
+        errors.append(
+            "self-healing: kubeai_controller_pod_replacements_total "
+            f"({rp['replacements_total']}) undercounts repairs"
+        )
+    ready = rp["final_conditions"].get("Ready", {})
+    if ready.get("status") != "True":
+        errors.append(
+            f"self-healing: Model Ready condition is {ready} after the "
+            "last repair round (want True/AllReplicasReady)"
+        )
+    wd = summary["watchdog"]
+    if not wd["ejected_before_breaker"]:
+        errors.append(
+            "watchdog: LB ejection at "
+            f"{wd['lb_eject_s']:.0f}s does not beat the breaker's "
+            f"earliest opening at {wd['breaker_open_earliest_s']:.0f}s"
+        )
+    return errors
+
+
+def main() -> int:
+    summary = run_sim()
+    errors = check_invariants(summary)
+    print(json.dumps({"summary": summary, "violations": errors}, indent=2))
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

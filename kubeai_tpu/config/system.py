@@ -147,6 +147,23 @@ class Resilience:
     breaker_open_seconds: float = 10.0
     # Engine graceful-drain budget (SIGTERM → in-flight completion).
     drain_timeout_seconds: float = 30.0
+    # Engine step watchdog: with work active and no step progress for
+    # this long, the engine flips /health and exits nonzero so kubelet
+    # restarts the pod. Must stay well under the time the circuit
+    # breaker would need to notice a wedged-but-accepting engine
+    # (breaker_consecutive_failures × response_header_timeout).
+    watchdog_timeout_seconds: float = 120.0
+    # Self-healing pod reconciliation: a Pending pod unscheduled past
+    # this deadline is delete-and-replaced (fresh scheduling dice after
+    # a spot-node reclaim) ...
+    pod_pending_deadline_seconds: float = 300.0
+    # ... a container at/over this restart count counts as crash-looping
+    # even before kubelet labels it CrashLoopBackOff ...
+    pod_restart_threshold: int = 3
+    # ... and repeated repairs of one model back off exponentially
+    # (base × 2^n, capped) so a poisoned spec can't thrash pods.
+    repair_backoff_base_seconds: float = 5.0
+    repair_backoff_max_seconds: float = 300.0
 
 
 DEFAULT_MODEL_SERVERS: dict[str, dict[str, str]] = {
@@ -250,6 +267,22 @@ class System:
             raise ConfigError("resilience.breakerOpenSeconds must be > 0")
         if r.drain_timeout_seconds <= 0:
             raise ConfigError("resilience.drainTimeout must be > 0")
+        if r.watchdog_timeout_seconds < 0:
+            raise ConfigError("resilience.watchdogTimeout must be >= 0")
+        if r.pod_pending_deadline_seconds < 0:
+            raise ConfigError(
+                "resilience.podPendingDeadline must be >= 0"
+            )
+        if r.pod_restart_threshold < 0:
+            raise ConfigError(
+                "resilience.podRestartThreshold must be >= 0"
+            )
+        if r.repair_backoff_base_seconds <= 0:
+            raise ConfigError("resilience.repairBackoffBase must be > 0")
+        if r.repair_backoff_max_seconds < r.repair_backoff_base_seconds:
+            raise ConfigError(
+                "resilience.repairBackoffMax must be >= repairBackoffBase"
+            )
         for name, prof in self.resource_profiles.items():
             if not isinstance(prof, ResourceProfile):
                 raise ConfigError(f"resourceProfiles[{name}] invalid")
@@ -569,6 +602,17 @@ def system_from_dict(data: dict) -> System:
             breaker_min_samples=int(r.get("breakerMinSamples", 5)),
             breaker_open_seconds=_seconds(r.get("breakerOpenSeconds", 10)),
             drain_timeout_seconds=_seconds(r.get("drainTimeout", 30)),
+            watchdog_timeout_seconds=_seconds(r.get("watchdogTimeout", 120)),
+            pod_pending_deadline_seconds=_seconds(
+                r.get("podPendingDeadline", 300)
+            ),
+            pod_restart_threshold=int(r.get("podRestartThreshold", 3)),
+            repair_backoff_base_seconds=_seconds(
+                r.get("repairBackoffBase", 5)
+            ),
+            repair_backoff_max_seconds=_seconds(
+                r.get("repairBackoffMax", 300)
+            ),
         )
     if "metricsAddr" in data:
         sys_obj.metrics_addr = data["metricsAddr"]

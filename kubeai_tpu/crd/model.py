@@ -480,11 +480,17 @@ def disagg_role_replicas(model: "Model", role: str) -> int:
 
 @dataclasses.dataclass
 class ModelStatus:
-    """(reference: api/k8s/v1/model_types.go:226-239)"""
+    """(reference: api/k8s/v1/model_types.go:226-239; `conditions` has no
+    reference analog — the reference Model publishes bare replica counts)."""
 
     replicas_all: int = 0
     replicas_ready: int = 0
     cache_loaded: bool = False
+    # Kubernetes-style conditions maintained by the reconciler's
+    # pod-health pass: Ready / Progressing / Degraded, each a dict with
+    # stable `type` / `status` ("True"/"False") / `reason` / `message`
+    # keys (reasons documented in docs/concepts/resilience.md).
+    conditions: list[dict] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -557,6 +563,11 @@ class Model:
                     "ready": self.status.replicas_ready,
                 },
                 "cache": {"loaded": self.status.cache_loaded},
+                **(
+                    {"conditions": [dict(c) for c in self.status.conditions]}
+                    if self.status.conditions
+                    else {}
+                ),
             },
         }
 
@@ -678,6 +689,10 @@ class Model:
                     ((status.get("replicas") or {}).get("ready", 0))
                 ),
                 cache_loaded=bool((status.get("cache") or {}).get("loaded", False)),
+                conditions=[
+                    dict(c) for c in (status.get("conditions") or [])
+                    if isinstance(c, dict)
+                ],
             ),
         )
 

@@ -673,10 +673,12 @@ class Engine:
         def _prefill_admit(params, tokens, ints, floats, ck, cv, state, lora):
             """Fused prefill → cache insert → first-token sample → slot-state
             update: ONE device call per admitted request. `ints` packs
-            [length, slot, seed, top_k, adapter]; `floats` packs
-            [temp, top_p] — two small transfers instead of seven."""
+            [length, slot, seed, top_k, adapter, forced]; `floats` packs
+            [temp, top_p] — two small transfers instead of seven.
+            forced >= 0 overrides the sampled token (preemption / stream
+            resume — cross-graph re-sampling could diverge by ULPs)."""
             length, slot, seed, topk = ints[0], ints[1], ints[2], ints[3]
-            adapter = ints[4]
+            adapter, forced = ints[4], ints[5]
             temp, topp = floats[0], floats[1]
             if lora is None:
                 logits, k_all, v_all = prefill_fn(
@@ -696,6 +698,7 @@ class Engine:
                 topk[None],
                 topp[None],
             )[0]
+            tok = jnp.where(forced >= 0, forced, tok)
             state = dict(
                 tokens=state["tokens"].at[slot].set(tok),
                 positions=state["positions"].at[slot].set(length),
@@ -791,6 +794,7 @@ class Engine:
             def _chunk_last(params, tokens, ints, floats, ck, cv, state, lora):
                 start, slot, length = ints[0], ints[1], ints[2]
                 adapter, seed, topk = ints[3], ints[4], ints[5]
+                forced = ints[6]
                 temp, topp = floats[0], floats[1]
                 ks, vs = _slot_slice(ck, slot), _slot_slice(cv, slot)
                 logits, ks, vs = chunk_fn(
@@ -809,6 +813,7 @@ class Engine:
                     topk[None],
                     topp[None],
                 )[0]
+                tok = jnp.where(forced >= 0, forced, tok)
                 state = dict(
                     tokens=state["tokens"].at[slot].set(tok),
                     positions=state["positions"].at[slot].set(length),
@@ -1306,6 +1311,7 @@ class Engine:
         priority: str | None = None,
         client: str = "",
         deadline_ms: float | None = None,
+        resume_tokens: list[int] | None = None,
     ) -> int:
         """Queue a request. `on_admit(rid)` runs under the engine lock
         before the request becomes visible to `step()` — callers use it to
@@ -1317,8 +1323,35 @@ class Engine:
         policy's default), `client` the WFQ fairness key, `deadline_ms`
         an admission deadline — a deadline the scheduler judges
         infeasible given queue state and the measured drain rate raises
-        `DeadlineInfeasible` and the request is NOT queued."""
+        `DeadlineInfeasible` and the request is NOT queued.
+
+        Continuation: `resume_tokens` is a generation prefix already
+        emitted by another replica (proxy stream resume after a
+        preemption). The request admits through the same recompute path
+        preemption uses — prefill prompt + prefix[:-1] with the first
+        token FORCED to prefix[-1] — and step() emits only NEW tokens.
+        Because the sampler is seeded and position-folded (stateless
+        given (seed, position)), a seeded or greedy continuation is
+        token-identical to the uninterrupted stream; unseeded sampling
+        resumes with this replica's entropy and stays merely plausible."""
         params = params or SamplingParams()
+        resume = [int(t) for t in (resume_tokens or [])]
+        if resume:
+            if len(resume) >= params.max_tokens:
+                raise ValueError(
+                    f"resume prefix of {len(resume)} tokens >= max_tokens "
+                    f"{params.max_tokens}: nothing left to generate"
+                )
+            if len(prompt_tokens) + len(resume) >= self.cfg.max_seq_len:
+                raise ValueError(
+                    f"prompt + resume prefix length "
+                    f"{len(prompt_tokens) + len(resume)} >= max_seq_len "
+                    f"{self.cfg.max_seq_len}"
+                )
+            if resume[-1] in self.eos_token_ids:
+                raise ValueError(
+                    "resume prefix already ends at a stop token"
+                )
         adapter_idx = 0
         if adapter:
             if self._lora is None:
@@ -1349,6 +1382,9 @@ class Engine:
                 seed=seed,
                 adapter_idx=adapter_idx,
                 client=client,
+                # A non-empty out_tokens prefix is what admission reads as
+                # "resumed" — the same seat preemption re-admission uses.
+                out_tokens=resume,
                 stop_token_ids=self.eos_token_ids,
                 t_enqueue=_now(),
             )
@@ -1448,17 +1484,20 @@ class Engine:
         while len(self._sched) and self._free_slots:
             req = self._sched.peek()
             slot = self._free_slots[-1]
-            # Preemption/resume only exists in paged mode; slot-mode
-            # pending requests always start fresh.
-            resumed = False
-            seq = req.prompt
+            # Resume (stream continuation / preemption recompute): the
+            # prefix re-prefills as context with the last emitted token
+            # FORCED — same contract as the paged path.
+            resumed = bool(req.out_tokens)
+            seq = (
+                req.prompt + req.out_tokens[:-1] if resumed else req.prompt
+            )
             plen = len(seq)
             self._pop_pending()
             self._free_slots.pop()
             req.slot = slot
             C = self.cfg.prefill_chunk
             if C > 0 and plen > C:
-                tok = self._admit_chunked(req, slot, plen, C)
+                tok = self._admit_chunked(req, slot, seq, plen, C)
                 ev = self._finish_admission(req, slot, plen, tok, resumed)
                 if ev is not None:
                     emitted.append(ev)
@@ -1479,6 +1518,7 @@ class Engine:
                             int(np.uint32(req.seed).view(np.int32)),
                             req.params.top_k,
                             req.adapter_idx,
+                            req.out_tokens[-1] if resumed else -1,
                         ],
                         jnp.int32,
                     ),
@@ -1923,12 +1963,14 @@ class Engine:
         ]
         return mids, (plen - C, arr[None, plen - C : plen])
 
-    def _admit_chunked(self, req: _Request, slot: int, plen: int, C: int) -> int:
+    def _admit_chunked(
+        self, req: _Request, slot: int, seq: list[int], plen: int, C: int
+    ) -> int:
         """Prefill a long prompt chunk-by-chunk into the slot cache; the
-        final chunk also samples the first token and updates slot state."""
-        mids, (last_start, last_tokens) = self._chunk_plan(
-            req.prompt, plen, C
-        )
+        final chunk also samples the first token and updates slot state.
+        `seq` includes a resume prefix when the request is a continuation
+        (the forced token then overrides the sample)."""
+        mids, (last_start, last_tokens) = self._chunk_plan(seq, plen, C)
         for start, tokens in mids:
             self.cache.k, self.cache.v = self._prefill_chunk_mid_jit(
                 self.params,
@@ -1952,6 +1994,7 @@ class Engine:
                         req.adapter_idx,
                         int(np.uint32(req.seed).view(np.int32)),
                         req.params.top_k,
+                        req.out_tokens[-1] if req.out_tokens else -1,
                     ],
                     jnp.int32,
                 ),

@@ -299,7 +299,16 @@ class LockstepEngine:
         priority: str | None = None,
         client: str = "",
         deadline_ms: float | None = None,
+        resume_tokens: list[int] | None = None,
     ) -> int:
+        if resume_tokens:
+            # Continuation admission would have to replay the resume
+            # prefix identically on every host; until the descriptor
+            # carries it, multi-host replicas refuse and the proxy falls
+            # back to the terminal-error tail.
+            raise ValueError(
+                "stream resume is not supported on multi-host replicas"
+            )
         # Scheduling args are accepted for API parity with Engine but not
         # broadcast: lockstep admission must replay in identical order on
         # every host, so multi-host replicas keep FIFO ordering (every

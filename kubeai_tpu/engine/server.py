@@ -270,6 +270,18 @@ class EngineMetrics:
             "expired before they completed.",
             self.registry,
         )
+        # -- step watchdog ---------------------------------------------------
+        self.watchdog_wedged = Gauge(
+            "kubeai_engine_watchdog_wedged",
+            "1 after the step watchdog detected a hung device step "
+            "(health flipped, restart requested), else 0.",
+            self.registry,
+        )
+        self.watchdog_stalls = Counter(
+            "kubeai_engine_watchdog_stalls_total",
+            "Hung-device-step detections by the engine watchdog.",
+            self.registry,
+        )
 
     def observe_timing(self, kind: str, seconds: float) -> None:
         h = self._timing_hist.get(kind)
@@ -394,6 +406,8 @@ class EngineServer:
         role: str = "unified",
         max_transfer_mb: int = 0,
         transfer_timeout: float = 30.0,
+        watchdog_timeout: float = 0.0,
+        watchdog_action=None,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
@@ -436,6 +450,14 @@ class EngineServer:
         self._drained = threading.Event()
         self._drain_started = 0.0
         self._drain_thread: threading.Thread | None = None
+        # Step watchdog: a hung device step (work active, no step
+        # progress past watchdog_timeout) flips /health and fires
+        # watchdog_action — in production that exits nonzero so kubelet
+        # restarts the pod; tests inject a recorder. 0 disables.
+        self.watchdog_timeout = watchdog_timeout
+        self._watchdog_action = watchdog_action
+        self._wedged = False
+        self._watchdog_thread: threading.Thread | None = None
         self._loop_thread = threading.Thread(target=self._serve_loop, daemon=True)
 
         outer = self
@@ -470,6 +492,10 @@ class EngineServer:
                         )
                     if outer.healthy():
                         return self._json(200, {"status": "ok"})
+                    if outer._wedged:
+                        return self._json(
+                            503, {"status": "wedged", "wedged": True}
+                        )
                     return self._json(503, {"status": "unhealthy"})
                 if path == "/v1/drain":
                     # kubelet preStop httpGet can only send GET — the
@@ -604,6 +630,11 @@ class EngineServer:
     def start(self) -> None:
         self._loop_thread.start()
         self._http_thread.start()
+        if self.watchdog_timeout > 0:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, daemon=True
+            )
+            self._watchdog_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -638,7 +669,7 @@ class EngineServer:
                 # gauges while they are live (a scrape between steps then
                 # sees the batch as it ran, not as it idles).
                 self.metrics.sync_engine(self.engine)
-                self._last_progress = time.time()
+                self._last_progress = time.monotonic()
             except Exception:
                 # A dead serving loop must flip /health so the liveness
                 # probe restarts the Pod (the blocking LB then stops
@@ -652,7 +683,61 @@ class EngineServer:
     _last_progress = 0.0
 
     def healthy(self) -> bool:
-        return not self._loop_dead and not self._stop.is_set()
+        return (
+            not self._loop_dead
+            and not self._wedged
+            and not self._stop.is_set()
+        )
+
+    # -- step watchdog ----------------------------------------------------------
+
+    @property
+    def wedged(self) -> bool:
+        return self._wedged
+
+    def _watchdog_loop(self) -> None:
+        """Detect a hung device step: work is active but the serve loop
+        made no step progress for watchdog_timeout. A crashed loop
+        already flips /health (_loop_dead); this catches the worse case
+        where step() never RETURNS — a wedged XLA dispatch or a dead
+        remote-chip tunnel — which no exception handler can see. On
+        detection /health flips (the LB ejects long before the circuit
+        breaker could accumulate response-header timeouts) and
+        watchdog_action runs (production: exit nonzero → kubelet
+        restarts the pod)."""
+        poll = max(0.01, min(self.watchdog_timeout / 4.0, 1.0))
+        busy_since: float | None = None
+        while not self._stop.wait(timeout=poll):
+            try:
+                busy = self.engine.has_work()
+            except Exception:
+                busy = False
+            now = time.monotonic()
+            if not busy:
+                busy_since = None
+                continue
+            if busy_since is None:
+                # Work just (re)appeared: stall time counts from here,
+                # not from a _last_progress stamped before an idle gap.
+                busy_since = now
+            stalled_for = now - max(self._last_progress, busy_since)
+            if stalled_for <= self.watchdog_timeout:
+                continue
+            self._wedged = True
+            self.metrics.watchdog_wedged.set(1)
+            self.metrics.watchdog_stalls.inc()
+            logger.error(
+                "watchdog: no engine step progress for %.1fs with work "
+                "active (%d active, %d pending) — flipping /health and "
+                "requesting restart",
+                stalled_for, self.engine.num_active, self.engine.num_pending,
+            )
+            if self._watchdog_action is not None:
+                try:
+                    self._watchdog_action()
+                except Exception:
+                    logger.exception("watchdog action failed")
+            return
 
     # -- graceful drain ---------------------------------------------------------
 
@@ -820,6 +905,20 @@ class EngineServer:
             return http._json(
                 400, {"error": {"message": "n must be an integer in 1..8"}}
             )
+        # Continuation request (proxy stream resume after a replica
+        # death): `kubeai_resume` carries the tokens another replica
+        # already emitted plus how many CHARACTERS of their text reached
+        # the client — the stream resumes exactly at that boundary.
+        resume_tokens: list[int] = []
+        resume_emitted: int | None = None
+        raw_resume = body.get("kubeai_resume")
+        if raw_resume is not None:
+            err = self._validate_resume(raw_resume, n)
+            if err is not None:
+                return http._json(400, {"error": {"message": err}})
+            resume_tokens = [int(t) for t in raw_resume["token_ids"]]
+            if "emitted" in raw_resume:
+                resume_emitted = int(raw_resume["emitted"])
         # Scheduling identity from headers (the front door and messenger
         # propagate these): priority class, admission deadline, WFQ
         # fairness key. Defaults come from the CRD scheduling block.
@@ -863,6 +962,14 @@ class EngineServer:
                     }
                 },
             )
+        if resume_tokens and len(resume_tokens) >= room:
+            return http._json(
+                400,
+                {"error": {"message": (
+                    f"resume prefix of {len(resume_tokens)} tokens leaves "
+                    f"no room under context {self.engine.cfg.max_seq_len}"
+                )}},
+            )
         # Sampling-parameter validation: malformed values must 400 with a
         # clear message, never surface as a 500 traceback (and
         # max_tokens: 0 is invalid, not a silent default).
@@ -870,6 +977,14 @@ class EngineServer:
             sp = self._parse_sampling(body, room)
         except ValueError as e:
             return http._json(400, {"error": {"message": str(e)}})
+        if resume_tokens and len(resume_tokens) >= sp.max_tokens:
+            return http._json(
+                400,
+                {"error": {"message": (
+                    f"resume prefix of {len(resume_tokens)} tokens >= "
+                    f"max_tokens {sp.max_tokens}: nothing left to generate"
+                )}},
+            )
         stream = bool(body.get("stream", False))
         # Each choice gets a derived seed so explicit-seed requests stay
         # deterministic AND diverse. With the prefix cache on, choices
@@ -893,10 +1008,16 @@ class EngineServer:
                     with self._sub_lock:
                         self._subscribers[rid] = _sub
 
+                # kwargs-gated so engine stand-ins (tests) that predate
+                # continuation support keep working untouched.
+                resume_kw = (
+                    {"resume_tokens": resume_tokens}
+                    if resume_tokens and i == 0 else {}
+                )
                 rid_i = self.engine.add_request(
                     prompt_ids, sp_i, adapter=adapter, on_admit=register,
                     priority=priority, client=sched_client,
-                    deadline_ms=deadline_ms,
+                    deadline_ms=deadline_ms, **resume_kw,
                 )
                 reqs.append((rid_i, sub_i, sp_i))
         except DeadlineInfeasible as e:
@@ -924,6 +1045,14 @@ class EngineServer:
                 with self._sub_lock:
                     self._subscribers.pop(rid_i, None)
             return http._json(404, {"error": {"message": str(e)}})
+        except ValueError as e:
+            # Residual continuation validation (e.g. a resume prefix that
+            # already ends at a stop token, or a multi-host replica).
+            for rid_i, _, _ in reqs:
+                self.engine.cancel(rid_i)
+                with self._sub_lock:
+                    self._subscribers.pop(rid_i, None)
+            return http._json(400, {"error": {"message": str(e)}})
         # Metrics only after successful admission, so a failed add_request
         # can't drift the gauge or inflate the counters.
         self.metrics.requests_total.inc(model=display)
@@ -935,9 +1064,14 @@ class EngineServer:
         try:
             if stream:
                 self._stream_response(http, reqs, display, chat, t0=t0,
-                                      span=span)
+                                      span=span,
+                                      resume_tokens=resume_tokens,
+                                      resume_emitted=resume_emitted)
             else:
-                self._unary_response(http, reqs, display, chat, len(prompt_ids))
+                self._unary_response(http, reqs, display, chat,
+                                     len(prompt_ids),
+                                     resume_tokens=resume_tokens,
+                                     resume_emitted=resume_emitted)
         finally:
             # The duration the TTFT/e2e histograms see must also be
             # readable off the trace — spans and metrics have to agree.
@@ -954,6 +1088,29 @@ class EngineServer:
             self.metrics.active_requests.dec()
 
     # -- scheduling & validation helpers ---------------------------------------
+
+    def _validate_resume(self, raw_resume, n: int) -> str | None:
+        """Shape-check a `kubeai_resume` continuation block; returns a
+        client-readable error string or None when valid."""
+        if getattr(self.engine, "is_lockstep", False):
+            return "stream resume is not supported on multi-host replicas"
+        if not isinstance(raw_resume, dict):
+            return "kubeai_resume must be an object"
+        if n != 1:
+            return "kubeai_resume requires n == 1"
+        toks = raw_resume.get("token_ids")
+        if not isinstance(toks, list) or not toks or not all(
+            isinstance(t, int) and not isinstance(t, bool) for t in toks
+        ):
+            return "kubeai_resume.token_ids must be a non-empty int list"
+        emitted = raw_resume.get("emitted")
+        if emitted is not None and (
+            isinstance(emitted, bool)
+            or not isinstance(emitted, int)
+            or emitted < 0
+        ):
+            return "kubeai_resume.emitted must be an int >= 0"
+        return None
 
     def _scheduler(self):
         inner = getattr(self.engine, "inner", self.engine)
@@ -1301,16 +1458,34 @@ class EngineServer:
             headers={"Retry-After": f"{retry_after:.3f}"},
         )
 
-    def _collect(self, rid, sub, sp, on_delta=None, deadline=None):
+    def _collect(self, rid, sub, sp, on_delta=None, deadline=None,
+                 resume_tokens=(), resume_emitted=None):
         """Drain tokens; detokenize incrementally; apply stop strings.
-        Returns (text, finish_reason, n_generated_tokens).
+        Returns (text, finish_reason, n_completion_tokens).
 
         request_timeout is a TOTAL budget for the request, not a per-token
         gap — a slow drip must not hold a batch slot indefinitely. With
         n > 1 the caller passes ONE deadline shared by every choice so
-        the whole HTTP request stays inside a single budget."""
-        tokens: list[int] = []
-        emitted_len = 0
+        the whole HTTP request stays inside a single budget.
+
+        Continuation: `resume_tokens` seeds the token buffer so stop
+        strings and detokenization see the FULL completion, while
+        on_delta only fires past `resume_emitted` characters (what the
+        dead stream already delivered to the client — defaults to the
+        whole resumed text). on_delta receives (delta_text, new_tokens):
+        the tokens consumed since its previous call, which streaming
+        chunks expose as `token_ids` so the proxy can resume THIS stream
+        too if it dies."""
+        tokens: list[int] = list(resume_tokens)
+        sent_tokens = len(tokens)
+        if tokens:
+            base_text = self.tokenizer.decode(tokens)
+            emitted_len = (
+                len(base_text) if resume_emitted is None
+                else max(0, min(int(resume_emitted), len(base_text)))
+            )
+        else:
+            emitted_len = 0
         finish = "length"
         if deadline is None:
             deadline = time.monotonic() + self.request_timeout
@@ -1345,24 +1520,28 @@ class EngineServer:
                     break
             if stop_hit is not None:
                 if on_delta and stop_hit > emitted_len:
-                    on_delta(text[emitted_len:stop_hit])
+                    on_delta(text[emitted_len:stop_hit],
+                             tokens[sent_tokens:])
+                    sent_tokens = len(tokens)
                 self.engine.cancel(rid)
                 return text[:stop_hit], "stop", len(tokens)
             if on_delta and len(text) > emitted_len:
                 # Hold back a partial UTF-8 replacement char at the tail.
                 safe = text[:-1] if text.endswith("�") else text
                 if len(safe) > emitted_len:
-                    on_delta(safe[emitted_len:])
+                    on_delta(safe[emitted_len:], tokens[sent_tokens:])
+                    sent_tokens = len(tokens)
                     emitted_len = len(safe)
             if ev.finished:
                 finish = ev.finish_reason or "stop"
                 break
         text = self.tokenizer.decode(tokens)
         if on_delta and len(text) > emitted_len:
-            on_delta(text[emitted_len:])
+            on_delta(text[emitted_len:], tokens[sent_tokens:])
         return text, finish, len(tokens)
 
-    def _unary_response(self, http, reqs, display, chat, n_prompt):
+    def _unary_response(self, http, reqs, display, chat, n_prompt,
+                        resume_tokens=(), resume_emitted=None):
         # Usage counts the tokens actually generated (re-encoding the text
         # diverges around merges/special tokens and from the
         # generated_tokens metric). Choices decode CONCURRENTLY in the
@@ -1374,7 +1553,9 @@ class EngineServer:
         deadline = time.monotonic() + self.request_timeout
         for i, (rid, sub, sp_i) in enumerate(reqs):
             text, finish, completion_tokens = self._collect(
-                rid, sub, sp_i, deadline=deadline
+                rid, sub, sp_i, deadline=deadline,
+                resume_tokens=resume_tokens if i == 0 else (),
+                resume_emitted=resume_emitted if i == 0 else None,
             )
             if finish == "timeout":
                 any_timeout = True
@@ -1418,11 +1599,17 @@ class EngineServer:
         }
         http._json(200, payload)
 
-    def _stream_response(self, http, reqs, display, chat, t0=None, span=None):
+    def _stream_response(self, http, reqs, display, chat, t0=None, span=None,
+                         resume_tokens=(), resume_emitted=None):
         """SSE stream. With n > 1 the choices stream SEQUENTIALLY in index
         order (each chunk carries its index, which is all the protocol
         requires); later choices decode concurrently and buffer while an
-        earlier one streams."""
+        earlier one streams.
+
+        Every content chunk carries a top-level `token_ids` field — the
+        raw tokens behind its delta — which OpenAI clients ignore and
+        the routing proxy accumulates so it can resume the stream as a
+        continuation request when this replica dies mid-generation."""
         http.send_response(200)
         http.send_header("Content-Type", "text/event-stream")
         http.send_header("Cache-Control", "no-cache")
@@ -1436,7 +1623,7 @@ class EngineServer:
             http.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
             http.wfile.flush()
 
-        def send_choice(choice: dict):
+        def send_choice(choice: dict, token_ids=()):
             send_chunk(
                 {
                     "id": rid_s,
@@ -1446,6 +1633,10 @@ class EngineServer:
                     "created": created,
                     "model": display,
                     "choices": [choice],
+                    **(
+                        {"token_ids": [int(t) for t in token_ids]}
+                        if token_ids else {}
+                    ),
                 }
             )
 
@@ -1453,7 +1644,7 @@ class EngineServer:
         ttft_seen = [False]
         for i, (rid, sub, sp_i) in enumerate(reqs):
 
-            def on_delta(delta_text: str, _i=i):
+            def on_delta(delta_text: str, new_tokens=(), _i=i):
                 if not ttft_seen[0]:
                     ttft_seen[0] = True
                     if span is not None and t0 is not None:
@@ -1466,16 +1657,20 @@ class EngineServer:
                             "index": _i,
                             "delta": {"content": delta_text},
                             "finish_reason": None,
-                        }
+                        },
+                        token_ids=new_tokens,
                     )
                 else:
                     send_choice(
                         {"index": _i, "text": delta_text,
-                         "finish_reason": None}
+                         "finish_reason": None},
+                        token_ids=new_tokens,
                     )
 
             _text, finish, _n = self._collect(
-                rid, sub, sp_i, on_delta=on_delta, deadline=deadline
+                rid, sub, sp_i, on_delta=on_delta, deadline=deadline,
+                resume_tokens=resume_tokens if i == 0 else (),
+                resume_emitted=resume_emitted if i == 0 else None,
             )
             if finish == "timeout":
                 # Headers are already on the wire; the best we can do is a
@@ -1749,6 +1944,13 @@ def main(argv=None) -> int:
         "before being terminated (CRD spec.drainTimeoutSeconds)",
     )
     ap.add_argument(
+        "--watchdog-timeout", type=float, default=120.0,
+        help="step-watchdog budget in seconds: with work active and no "
+        "engine step progress for this long, /health flips and the "
+        "process exits nonzero so Kubernetes restarts the pod "
+        "(system config resilience.watchdogTimeout); 0 disables",
+    )
+    ap.add_argument(
         "--role", default="unified",
         choices=["unified", "prefill", "decode"],
         help="disaggregated serving role: prefill engines run chunked "
@@ -1932,6 +2134,15 @@ def main(argv=None) -> int:
     engine.generate([[1, 2, 3]], SamplingParams(temperature=0.0, max_tokens=2))
     log.info("warm-up complete")
 
+    def _watchdog_exit():
+        # The watchdog already flipped /health; exiting nonzero hands the
+        # pod to kubelet's restart policy — a wedged XLA dispatch cannot
+        # be recovered in-process.
+        log.error(
+            "engine watchdog: hung device step — exiting 3 for restart"
+        )
+        os._exit(3)
+
     server = EngineServer(
         engine,
         tokenizer,
@@ -1945,6 +2156,8 @@ def main(argv=None) -> int:
         role=args.role,
         max_transfer_mb=args.max_transfer_mb,
         transfer_timeout=args.transfer_timeout,
+        watchdog_timeout=args.watchdog_timeout,
+        watchdog_action=_watchdog_exit,
     )
     tracing.configure(service_name=f"kubeai-tpu-engine.{args.served_model_name}")
     server.start()

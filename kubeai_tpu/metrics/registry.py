@@ -298,8 +298,23 @@ class Metrics:
         )
         self.proxy_midstream_failures = Counter(
             "kubeai_proxy_midstream_failures_total",
-            "Streams that died after headers were sent (terminal SSE "
-            "error event emitted), per model.",
+            "Streams whose upstream connection died after headers were "
+            "sent (each one is either resumed on another endpoint or "
+            "terminated with the SSE error event), per model.",
+            self.registry,
+        )
+        self.proxy_stream_resumes = Counter(
+            "kubeai_proxy_stream_resumes_total",
+            "Mid-stream deaths transparently resumed on another endpoint "
+            "via a continuation request (client saw one uninterrupted "
+            "stream), per model.",
+            self.registry,
+        )
+        self.proxy_stream_resume_failures = Counter(
+            "kubeai_proxy_stream_resume_failures_total",
+            "Mid-stream deaths whose resume budget or endpoint pool ran "
+            "dry — the client got the terminal SSE error event, per "
+            "model.",
             self.registry,
         )
         self.proxy_deadline_exhausted = Counter(
@@ -320,6 +335,19 @@ class Metrics:
             "Disaggregation-enabled requests that fell back to the "
             "unified pool (no role endpoints, open circuits, or a failed "
             "hop), per model.",
+            self.registry,
+        )
+        # -- controller repair / failure observability ---------------------
+        self.controller_consecutive_failures = Gauge(
+            "kubeai_controller_consecutive_failures",
+            "Consecutive reconcile failures per model (0 after a clean "
+            "pass) — the backoff-requeue exponent.",
+            self.registry,
+        )
+        self.controller_pod_replacements = Counter(
+            "kubeai_controller_pod_replacements_total",
+            "Pods delete-and-replaced by the self-healing pod-health "
+            "pass, per model and classification reason.",
             self.registry,
         )
         # -- autoscaler decision telemetry ---------------------------------

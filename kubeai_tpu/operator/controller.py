@@ -17,12 +17,15 @@ from __future__ import annotations
 
 import logging
 import queue
+import random
 import threading
+import time
 import traceback
 
 from kubeai_tpu.config import System
 from kubeai_tpu.crd import metadata as md
 from kubeai_tpu.crd.model import Model, disagg_role_replicas
+from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
 from kubeai_tpu.operator import adapters as adapters_mod
 from kubeai_tpu.operator import cache as cache_mod
 from kubeai_tpu.operator import files as files_mod
@@ -35,6 +38,23 @@ from kubeai_tpu.operator.pod_plan import calculate_pod_plan
 
 logger = logging.getLogger(__name__)
 
+# Requeue-backoff jitter source (monkeypatchable in tests): N models
+# failing on the same cause must not requeue in lockstep.
+_jitter = random.random
+
+# Model.status.conditions vocabulary — stable strings tests and docs
+# (docs/concepts/resilience.md) rely on.
+COND_READY = "Ready"
+COND_PROGRESSING = "Progressing"
+COND_DEGRADED = "Degraded"
+REASON_ALL_READY = "AllReplicasReady"
+REASON_NOT_READY = "ReplicasNotReady"
+REASON_SCALED_TO_ZERO = "ScaledToZero"
+REASON_WAITING = "WaitingForReplicas"
+REASON_REPAIRING = "ReplacingFailedPods"
+REASON_STABLE = "Stable"
+REASON_HEALTHY = "Healthy"
+
 
 class ModelReconciler:
     def __init__(
@@ -43,11 +63,23 @@ class ModelReconciler:
         cfg: System,
         engine_client: EngineClient | None = None,
         pod_exec: adapters_mod.PodExec | None = None,
+        metrics: Metrics = DEFAULT_METRICS,
+        clock=time.monotonic,
+        wall=time.time,
     ):
         self.store = store
         self.cfg = cfg
         self.engine_client = engine_client or EngineClient()
         self.pod_exec = pod_exec
+        self.metrics = metrics
+        # Two clocks, both injectable: `clock` (monotonic) spaces repair
+        # backoff; `wall` compares against pod creationTimestamps (the
+        # store stamps wall time) for the stuck-Pending deadline.
+        self._clock = clock
+        self._wall = wall
+        # (ns, name) -> (consecutive repair passes, last repair at
+        # `clock` time): the per-model delete-and-replace backoff state.
+        self._repair_state: dict[tuple[str, str], tuple[int, float]] = {}
 
     # -- reconcile ------------------------------------------------------------
 
@@ -112,8 +144,19 @@ class ModelReconciler:
         pods = self.store.list(
             "Pod", model.namespace, {md.POD_MODEL_LABEL: model.name}
         )
+        # Self-healing pass: classify preempted / crash-looping /
+        # stuck-Pending pods, delete-and-replace them (per-model backoff),
+        # and surface the result through status.conditions.
+        pods, degraded, repaired = self._pod_health_pass(model, pods)
         n_all, ready = self._replica_counts(pods, mcfg)
-        self._patch_status(model, replicas_all=n_all, replicas_ready=ready)
+        self._patch_status(
+            model,
+            replicas_all=n_all,
+            replicas_ready=ready,
+            conditions=self._conditions(
+                model, mcfg, ready, degraded, repaired
+            ),
+        )
 
         if model.spec.disaggregation.enabled and mcfg.num_hosts <= 1:
             plan = self._plan_disagg(model, mcfg, pods)
@@ -136,13 +179,129 @@ class ModelReconciler:
             )
             n_all, ready = self._replica_counts(pods, mcfg)
             self._patch_status(
-                model, replicas_all=n_all, replicas_ready=ready
+                model,
+                replicas_all=n_all,
+                replicas_ready=ready,
+                conditions=self._conditions(
+                    model, mcfg, ready, degraded, repaired
+                ),
             )
             return  # adapter pass runs on the next event, against fresh pods
 
         adapters_mod.reconcile_adapters(
             self.store, model, plan.to_remain, self.engine_client, self.pod_exec
         )
+
+    # -- self-healing pod health pass ------------------------------------------
+
+    def _pod_health_pass(
+        self, model: Model, pods: list[dict]
+    ) -> tuple[list[dict], list[tuple[str, str]], bool]:
+        """Classify every pod (k8sutils.classify_pod_failure) and
+        delete-and-replace the broken ones: deleting here shrinks the
+        list the pod plan sees, so the SAME reconcile pass renders the
+        replacements — a preempted spot replica is back under one pass,
+        not one watch-event round trip per pod.
+
+        Repeated repairs back off exponentially per model (base × 2^n,
+        capped): a spec that kills every pod it renders must not thrash
+        the cluster. Within backoff the broken pods are left in place
+        (still reported Degraded) so the plan does not double-replace.
+
+        Returns (surviving pods, [(pod name, reason)...], repaired?)."""
+        r = self.cfg.resilience
+        key = (model.namespace, model.name)
+        now = self._clock()
+        broken: list[tuple[dict, str]] = []
+        healthy: list[dict] = []
+        for p in pods:
+            reason = k8sutils.classify_pod_failure(
+                p,
+                now=self._wall(),
+                pending_deadline_s=r.pod_pending_deadline_seconds,
+                restart_threshold=r.pod_restart_threshold,
+            )
+            if reason is None:
+                healthy.append(p)
+            else:
+                broken.append((p, reason))
+        if not broken:
+            st = self._repair_state.get(key)
+            if st and now - st[1] > r.repair_backoff_max_seconds:
+                # Quiet past the max backoff: the failure streak is over.
+                self._repair_state.pop(key, None)
+            return pods, [], False
+        degraded = [(p["metadata"]["name"], reason) for p, reason in broken]
+        count, last = self._repair_state.get(key, (0, 0.0))
+        backoff = min(
+            r.repair_backoff_max_seconds,
+            r.repair_backoff_base_seconds * (2.0 ** min(count, 10)),
+        )
+        if count and now - last < backoff:
+            return pods, degraded, False
+        for p, reason in broken:
+            name = p["metadata"]["name"]
+            try:
+                self.store.delete("Pod", model.namespace, name)
+            except NotFound:
+                pass
+            self.metrics.controller_pod_replacements.inc(
+                model=model.name, reason=reason
+            )
+            logger.warning(
+                "pod-health: replacing pod %s/%s (%s) for model %s "
+                "(repair streak %d)",
+                model.namespace, name, reason, model.name, count + 1,
+            )
+        self._repair_state[key] = (count + 1, now)
+        return healthy, degraded, True
+
+    def _conditions(
+        self,
+        model: Model,
+        mcfg,
+        ready: int,
+        degraded: list[tuple[str, str]],
+        repaired: bool,
+    ) -> list[dict]:
+        """Ready / Progressing / Degraded with stable reasons (module
+        constants). `degraded` is the pod-health pass's classification
+        list; `repaired` marks that replacements were issued this pass."""
+        if model.spec.disaggregation.enabled and mcfg.num_hosts <= 1:
+            desired = sum(
+                disagg_role_replicas(model, role) for role in md.DISAGG_ROLES
+            )
+        else:
+            desired = model.spec.replicas or 0
+        conds = []
+        if desired == 0:
+            conds.append(_cond(COND_READY, False, REASON_SCALED_TO_ZERO,
+                               "0 replicas desired"))
+        elif ready >= desired:
+            conds.append(_cond(COND_READY, True, REASON_ALL_READY,
+                               f"{ready}/{desired} replicas ready"))
+        else:
+            conds.append(_cond(COND_READY, False, REASON_NOT_READY,
+                               f"{ready}/{desired} replicas ready"))
+        if repaired:
+            conds.append(_cond(
+                COND_PROGRESSING, True, REASON_REPAIRING,
+                "replacing failed pods: " + _degraded_msg(degraded),
+            ))
+        elif ready < desired:
+            conds.append(_cond(COND_PROGRESSING, True, REASON_WAITING,
+                               f"{ready}/{desired} replicas ready"))
+        else:
+            conds.append(_cond(COND_PROGRESSING, False, REASON_STABLE,
+                               "replica set stable"))
+        if degraded:
+            conds.append(_cond(
+                COND_DEGRADED, True, degraded[0][1], _degraded_msg(degraded),
+            ))
+        else:
+            conds.append(_cond(COND_DEGRADED, False, REASON_HEALTHY,
+                               "all pods healthy"))
+        return conds
 
     # -- helpers --------------------------------------------------------------
 
@@ -312,10 +471,27 @@ class ModelReconciler:
                 patch["status"]["replicas"]["ready"] = kwargs["replicas_ready"]
         if "cache_loaded" in kwargs:
             patch["status"]["cache"] = {"loaded": kwargs["cache_loaded"]}
+        if "conditions" in kwargs:
+            # Replaced wholesale (list merge would interleave stale
+            # entries); no timestamps — deterministic content only.
+            patch["status"]["conditions"] = kwargs["conditions"]
         try:
             self.store.patch_merge("Model", model.namespace, model.name, patch)
         except NotFound:
             pass
+
+
+def _cond(type_: str, status: bool, reason: str, message: str) -> dict:
+    return {
+        "type": type_,
+        "status": "True" if status else "False",
+        "reason": reason,
+        "message": message,
+    }
+
+
+def _degraded_msg(degraded: list[tuple[str, str]]) -> str:
+    return "; ".join(f"{name}: {reason}" for name, reason in degraded)
 
 
 class ControllerLoop:
@@ -411,12 +587,27 @@ class ControllerLoop:
                 self._queue.put(p)
             try:
                 self.reconciler.reconcile(ns, name)
-                self._failures.pop((ns, name), None)
+                if self._failures.pop((ns, name), None) is not None:
+                    self._metrics.controller_consecutive_failures.set(
+                        0, model=name
+                    )
             except Exception:
                 logger.error(
                     "reconcile %s/%s failed:\n%s", ns, name, traceback.format_exc()
                 )
                 self._requeue_after_backoff(ns, name)
+
+    @property
+    def _metrics(self) -> Metrics:
+        return getattr(self.reconciler, "metrics", DEFAULT_METRICS)
+
+    def _backoff_delay(self, n: int) -> float:
+        """Exponential backoff for the n-th consecutive failure, JITTERED
+        over [0.5, 1.0]× — N models failing on the same cause (a bad
+        image tag, a quota hit) would otherwise requeue in lockstep and
+        hammer the apiserver/engines in synchronized waves."""
+        base = min(30.0, 0.5 * (2.0 ** min(n, 10)))
+        return base * (0.5 + 0.5 * _jitter())
 
     def _requeue_after_backoff(self, ns: str, name: str) -> None:
         """Failed reconciles retry with exponential backoff instead of
@@ -428,7 +619,10 @@ class ControllerLoop:
         # Cap the stored count: 2.0**1024 raises OverflowError, which would
         # escape the worker's except handler and kill the reconcile loop.
         self._failures[(ns, name)] = min(n + 1, 16)
-        delay = min(30.0, 0.5 * (2.0 ** min(n, 10)))
+        self._metrics.controller_consecutive_failures.set(
+            self._failures[(ns, name)], model=name
+        )
+        delay = self._backoff_delay(n)
 
         def _put():
             if not self._stop.is_set():

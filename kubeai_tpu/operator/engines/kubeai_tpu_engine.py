@@ -64,6 +64,13 @@ def kubeai_tpu_pod(
         or cfg.resilience.drain_timeout_seconds
     )
     args += ["--drain-timeout", str(drain_timeout)]
+    # Step watchdog: a hung device step flips /health and exits nonzero
+    # so kubelet restarts the pod long before the router's circuit
+    # breaker could accumulate response-header timeouts.
+    args += [
+        "--watchdog-timeout",
+        f"{cfg.resilience.watchdog_timeout_seconds:g}",
+    ]
     # SLO scheduling policy from the CRD scheduling: block (validated to
     # the engine's priority classes at admission).
     sched = model.spec.scheduling

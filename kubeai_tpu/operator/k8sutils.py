@@ -52,6 +52,127 @@ def container_is_ready(pod: dict, container_name: str) -> bool:
     return False
 
 
+# -- pod-failure classification (self-healing reconcile pass) -----------------
+#
+# Stable reason vocabulary: these strings surface in Model.status.conditions
+# (Degraded.reason) and the kubeai_controller_pod_replacements_total metric's
+# `reason` label — tests assert on them, change requires a doc update
+# (docs/concepts/resilience.md).
+
+REASON_SPOT_PREEMPTION = "SpotPreemption"
+REASON_EVICTED = "Evicted"
+REASON_DISRUPTED = "Disrupted"
+REASON_POD_FAILED = "PodFailed"
+REASON_CRASHLOOP = "CrashLoopBackOff"
+REASON_STUCK_PENDING = "StuckPending"
+
+# status.reason / DisruptionTarget-condition reasons that mean the node
+# (or scheduler) took the pod — GKE spot/preemptible reclaim lands here.
+_PREEMPTION_STATUS_REASONS = frozenset(
+    ("Preempted", "Shutdown", "NodeShutdown", "Terminated", "NodeLost")
+)
+_PREEMPTION_CONDITION_REASONS = frozenset(
+    (
+        "PreemptionByScheduler",
+        "TerminationByKubelet",
+        "DeletionByPodGC",
+        "NodeShutdown",
+    )
+)
+
+
+def pod_phase(pod: dict) -> str:
+    return str((pod.get("status") or {}).get("phase") or "")
+
+
+def pod_is_terminating(pod: dict) -> bool:
+    """deletionTimestamp set: already on its way out — never a repair
+    candidate (deleting it again would just race the kubelet)."""
+    return bool((pod.get("metadata") or {}).get("deletionTimestamp"))
+
+
+def pod_disruption_reason(pod: dict) -> str | None:
+    """Classify an externally-killed pod: spot preemption / node
+    shutdown, API eviction, or a plain Failed phase. None when the pod
+    shows no disruption signal (including when status is missing)."""
+    status = pod.get("status") or {}
+    raw = str(status.get("reason") or "")
+    if raw in _PREEMPTION_STATUS_REASONS:
+        return REASON_SPOT_PREEMPTION
+    if raw == "Evicted":
+        return REASON_EVICTED
+    for cond in status.get("conditions") or []:
+        if (
+            cond.get("type") == "DisruptionTarget"
+            and cond.get("status") == "True"
+        ):
+            cr = str(cond.get("reason") or "")
+            if cr in _PREEMPTION_CONDITION_REASONS:
+                return REASON_SPOT_PREEMPTION
+            if cr == "EvictionByEvictionAPI":
+                return REASON_EVICTED
+            # Unknown disruption reasons are still disruptions — the pod
+            # is being taken, whatever the API calls it this release.
+            return REASON_DISRUPTED
+    if status.get("phase") == "Failed":
+        return REASON_POD_FAILED
+    return None
+
+
+def pod_is_crashlooping(pod: dict, restart_threshold: int = 3) -> bool:
+    """CrashLoopBackOff waiting state on any container, or a restart
+    count at/over the threshold (covers watchdog exit loops that kubelet
+    has not yet labeled CrashLoopBackOff). containerStatuses entries
+    with no `state` contribute only their restartCount."""
+    for cs in (pod.get("status") or {}).get("containerStatuses") or []:
+        state = cs.get("state") or {}
+        waiting = state.get("waiting") or {}
+        if waiting.get("reason") == "CrashLoopBackOff":
+            return True
+        try:
+            restarts = int(cs.get("restartCount") or 0)
+        except (TypeError, ValueError):
+            restarts = 0
+        if restart_threshold > 0 and restarts >= restart_threshold:
+            return True
+    return False
+
+
+def pod_stuck_pending(pod: dict, now: float, deadline_s: float) -> bool:
+    """Pending, unscheduled, and older than the schedule deadline — the
+    cluster is never going to place it (typical on a reclaimed spot node
+    pool); delete-and-replace rolls fresh scheduling dice."""
+    if deadline_s <= 0:
+        return False
+    if pod_phase(pod) != "Pending" or pod_is_scheduled(pod):
+        return False
+    created = (pod.get("metadata") or {}).get("creationTimestamp")
+    if not isinstance(created, (int, float)):
+        return False
+    return (now - float(created)) > deadline_s
+
+
+def classify_pod_failure(
+    pod: dict,
+    now: float,
+    pending_deadline_s: float = 300.0,
+    restart_threshold: int = 3,
+) -> str | None:
+    """The pod-health pass's single entry point: returns a stable repair
+    reason (REASON_*) when the pod should be delete-and-replaced, else
+    None. Terminating pods are NEVER classified as repairable."""
+    if pod_is_terminating(pod):
+        return None
+    reason = pod_disruption_reason(pod)
+    if reason is not None:
+        return reason
+    if pod_is_crashlooping(pod, restart_threshold=restart_threshold):
+        return REASON_CRASHLOOP
+    if pod_stuck_pending(pod, now, pending_deadline_s):
+        return REASON_STUCK_PENDING
+    return None
+
+
 def job_is_complete(job: dict) -> bool:
     """(reference: internal/k8sutils/jobs.go)"""
     for cond in (job.get("status") or {}).get("conditions", []):

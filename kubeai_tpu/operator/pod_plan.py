@@ -62,6 +62,9 @@ def sort_pods_by_deletion_order(pods: list[dict], expected_hash: str) -> list[di
 
     def key(pod: dict):
         return (
+            # Disrupted pods (spot preemption / eviction / Failed) first:
+            # they serve nothing and their node may already be gone.
+            k8sutils.pod_disruption_reason(pod) is None,
             k8sutils.pod_is_ready(pod),  # not ready first
             k8sutils.pod_is_scheduled(pod),  # unscheduled first
             k8sutils.get_label(pod, md.POD_HASH_LABEL) == expected_hash,  # old hash first

@@ -461,6 +461,12 @@ class LoadBalancer:
         ):
             if not k8sutils.pod_is_ready(pod):
                 continue
+            # Preempted / evicted pods are ejected the moment the watch
+            # sees the disruption — a spot reclaim can leave Ready=True
+            # stale for seconds, and waiting for the circuit breaker to
+            # accumulate connect failures costs real requests.
+            if k8sutils.pod_disruption_reason(pod) is not None:
+                continue
             # Multi-host worker Pods participate in the mesh but do not
             # serve HTTP; only host-0 is an endpoint.
             if (

@@ -19,9 +19,16 @@ Resilience (beyond the reference's blind 3-retry loop):
   * `X-Deadline-Ms` bounds the whole retry budget — the proxy never
     retries (or sleeps a backoff) past the client's deadline, it reports
     the last failure instead;
-  * a connection that dies mid-SSE emits a terminal `error` event (and a
-    `finish_reason: "error"` chunk for chat) instead of truncating the
-    stream silently.
+  * a connection that dies mid-SSE is RESUMED transparently: the proxy
+    accumulates each stream's emitted tokens (engine chunks carry a
+    `token_ids` field) and re-dispatches a continuation request — prompt
+    plus the already-emitted prefix — to a healthy endpoint, stitching
+    the new stream so the client sees one uninterrupted response. Seeded
+    and greedy streams resume token-identically (the engine's sampler is
+    stateless given (seed, position)). Only when the resume budget or
+    the endpoint pool is exhausted does the stream fall back to the
+    terminal `error` event (+ `finish_reason: "error"` chunk for chat)
+    instead of truncating silently.
 """
 
 from __future__ import annotations
@@ -32,6 +39,7 @@ import json
 import logging
 import random
 import time
+from typing import Any
 
 from kubeai_tpu.crd import metadata as md_roles
 from kubeai_tpu.crd.model import LB_STRATEGY_PREFIX_HASH
@@ -84,6 +92,13 @@ DISAGG_PICK_TIMEOUT_S = 0.05
 # Jitter source for the Retry-After backoff (monkeypatchable in tests).
 _jitter = random.random
 
+# Mid-stream resume: total continuation dispatches one stream may burn
+# (every dispatch — successful or not — counts), and the pick budget when
+# the client set no deadline. Bounded so a flapping fleet degrades to the
+# terminal error tail instead of retrying forever on a held connection.
+MAX_STREAM_RESUMES = 3
+RESUME_PICK_TIMEOUT_S = 15.0
+
 
 @dataclasses.dataclass(frozen=True)
 class ProxyTimeouts:
@@ -109,6 +124,77 @@ class ProxyResult:
         # Resolved model name ("" when lookup failed) — lets the front
         # door label its duration/TTFT histograms per model.
         self.model = model
+
+
+class _SSEAccumulator:
+    """Incremental SSE parser over the proxied byte stream. Feeds on the
+    same chunks the client receives and extracts what a continuation
+    request needs: the emitted token ids (from the engine chunks'
+    `token_ids` field), how many characters of completion text reached
+    the client, and whether the stream already finished ([DONE] /
+    finish_reason) — a finished stream is never resumed."""
+
+    __slots__ = ("_buf", "token_ids", "emitted_chars", "done_seen",
+                 "finished")
+
+    def __init__(self):
+        self._buf = b""
+        self.token_ids: list[int] = []
+        self.emitted_chars = 0
+        self.done_seen = False
+        self.finished = False
+
+    def feed(self, chunk: bytes) -> None:
+        self._buf += chunk
+        while True:
+            idx = self._buf.find(b"\n\n")
+            if idx < 0:
+                return
+            event, self._buf = self._buf[:idx], self._buf[idx + 2:]
+            for line in event.splitlines():
+                if not line.startswith(b"data:"):
+                    continue
+                data = line[5:].strip()
+                if data == b"[DONE]":
+                    self.done_seen = True
+                    continue
+                try:
+                    obj = json.loads(data)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(obj, dict):
+                    continue
+                for t in obj.get("token_ids") or []:
+                    if isinstance(t, int) and not isinstance(t, bool):
+                        self.token_ids.append(t)
+                for ch in obj.get("choices") or []:
+                    if not isinstance(ch, dict):
+                        continue
+                    if "delta" in ch:
+                        txt = (ch.get("delta") or {}).get("content")
+                    else:
+                        txt = ch.get("text")
+                    if isinstance(txt, str):
+                        self.emitted_chars += len(txt)
+                    if ch.get("finish_reason"):
+                        self.finished = True
+
+
+@dataclasses.dataclass
+class _ResumeCtx:
+    """Everything a mid-stream continuation dispatch needs, captured at
+    attempt time so the body iterator (consumed long after
+    _proxy_with_retries returned) can still re-enter the routing path."""
+
+    preq: apiutils.ParsedRequest
+    headers: dict
+    strategy: str
+    prefix: str
+    budget_left: Any
+    failed: set
+    role: str
+    trace_parent: Any
+    resume_attempts: int = 0
 
 
 class ModelProxy:
@@ -420,8 +506,19 @@ class ModelProxy:
 
             attempt_span.set_attribute("http.status_code", resp.status)
             attempt_span.end()
+            failed_addrs.add(addr)  # a resume must not re-pick this addr
             return self._forward_response(
-                resp, conn, done, addr, model.name, path, request_id
+                resp, conn, done, addr, model.name, path, request_id,
+                resume=_ResumeCtx(
+                    preq=preq,
+                    headers=headers,
+                    strategy=strategy,
+                    prefix=prefix,
+                    budget_left=budget_left,
+                    failed=failed_addrs,
+                    role=fallback_role,
+                    trace_parent=trace_parent,
+                ),
             )
         raise last_err or RuntimeError("retries exhausted")
 
@@ -601,13 +698,20 @@ class ModelProxy:
         )
 
     def _forward_response(
-        self, resp, conn, done, addr, model_name, path, request_id
+        self, resp, conn, done, addr, model_name, path, request_id,
+        resume: _ResumeCtx | None = None,
     ) -> ProxyResult:
         """Pipe an accepted upstream response through to the client:
         headers minus hop-by-hop fields, body chunk by chunk, the final
         outcome fed to the endpoint's breaker. Shared by the unified
         attempt loop and the disaggregated decode hop so mid-stream
-        fault handling cannot drift between the two paths."""
+        fault handling cannot drift between the two paths.
+
+        With `resume` (unified path only), a single-choice SSE stream
+        that dies mid-body is transparently continued on another
+        endpoint instead of terminated: the accumulated token prefix is
+        re-dispatched as a continuation request and the new stream is
+        stitched in place — the client sees one response and one [DONE]."""
         if resp.status == 429:
             # Shed on the LAST attempt: the engine's 429 body (per-
             # class queue depths + computed Retry-After) passes
@@ -625,56 +729,204 @@ class ModelProxy:
         )
         is_chat = path.startswith("/v1/chat/")
 
+        # Resume eligibility: a streaming single-choice generate whose
+        # body the continuation request can extend. Multi-choice streams
+        # interleave per-choice token prefixes, so they keep the
+        # terminal-error contract.
+        parsed_body = None
+        if (
+            resume is not None
+            and is_sse
+            and path.startswith(("/v1/chat/completions", "/v1/completions"))
+        ):
+            try:
+                parsed_body = json.loads(resume.preq.body or b"{}")
+            except json.JSONDecodeError:
+                parsed_body = None
+            if not (
+                isinstance(parsed_body, dict)
+                and parsed_body.get("stream")
+                and parsed_body.get("n") in (None, 1)
+            ):
+                parsed_body = None
+
         def chunks(resp=resp, conn=conn, done=done, addr=addr,
                    is_sse=is_sse, is_chat=is_chat):
-            # read1 (not read): read(n) on a chunked response BLOCKS
-            # until n bytes accumulate, which buffers ~160 small SSE
-            # events before anything reaches the client — destroying
-            # streaming TTFT/ITL through the proxy. read1 returns as
-            # soon as any data is available.
-            read = getattr(resp, "read1", resp.read)
-            try:
-                while True:
-                    chunk = read(16384)
-                    if not chunk:
-                        break
-                    yield chunk
-            except GeneratorExit:
-                # Client walked away mid-stream: release the slot
-                # with no health outcome — the endpoint did nothing
-                # wrong.
-                conn.close()
-                done()
-                raise
-            except Exception as e:
-                # The engine connection died partway through the
-                # body. Silence here would truncate an SSE stream
-                # with no terminal signal; emit one and record the
-                # fault against the endpoint's health window.
-                conn.close()
-                done(
-                    outcome=OUTCOME_MIDSTREAM,
-                    error=f"mid-stream: {e}",
-                )
-                self.metrics.proxy_midstream_failures.inc(
-                    model=model_name
-                )
-                logger.warning(
-                    "mid-stream failure from %s: %s "
-                    "(model=%s request_id=%s)",
-                    addr, e, model_name, request_id,
-                )
-                if not is_sse:
-                    raise  # unary body: nothing valid left to send
-                yield from _sse_error_tail(model_name, is_chat, e)
-                return
-            else:
-                conn.close()
-                done(outcome=OUTCOME_SUCCESS)
+            acc = _SSEAccumulator() if parsed_body is not None else None
+            cur_resp, cur_conn, cur_done, cur_addr = resp, conn, done, addr
+            while True:
+                # read1 (not read): read(n) on a chunked response BLOCKS
+                # until n bytes accumulate, which buffers ~160 small SSE
+                # events before anything reaches the client — destroying
+                # streaming TTFT/ITL through the proxy. read1 returns as
+                # soon as any data is available.
+                read = getattr(cur_resp, "read1", cur_resp.read)
+                try:
+                    while True:
+                        chunk = read(16384)
+                        if not chunk:
+                            cur_conn.close()
+                            cur_done(outcome=OUTCOME_SUCCESS)
+                            return
+                        if acc is not None:
+                            acc.feed(chunk)
+                        yield chunk
+                except GeneratorExit:
+                    # Client walked away mid-stream: release the slot
+                    # with no health outcome — the endpoint did nothing
+                    # wrong.
+                    cur_conn.close()
+                    cur_done()
+                    raise
+                except Exception as e:
+                    # The engine connection died partway through the
+                    # body. Record the fault against the endpoint's
+                    # health window, then try to RESUME the stream on
+                    # another endpoint; only a dry resume budget (or an
+                    # unresumable stream) falls back to the terminal
+                    # error tail — never a silent truncation.
+                    cur_conn.close()
+                    cur_done(
+                        outcome=OUTCOME_MIDSTREAM,
+                        error=f"mid-stream: {e}",
+                    )
+                    self.metrics.proxy_midstream_failures.inc(
+                        model=model_name
+                    )
+                    logger.warning(
+                        "mid-stream failure from %s: %s "
+                        "(model=%s request_id=%s)",
+                        cur_addr, e, model_name, request_id,
+                    )
+                    if not is_sse:
+                        raise  # unary body: nothing valid left to send
+                    if acc is not None:
+                        if acc.done_seen:
+                            return  # protocol complete; nothing was lost
+                        if acc.finished:
+                            # Only [DONE] was lost; complete the protocol.
+                            yield b"data: [DONE]\n\n"
+                            return
+                        resume.failed.add(cur_addr)
+                        nxt = self._resume_stream(
+                            resume, acc, parsed_body, path, model_name,
+                            request_id,
+                        )
+                        if nxt is not None:
+                            cur_resp, cur_conn, cur_done, cur_addr = nxt
+                            continue
+                        self.metrics.proxy_stream_resume_failures.inc(
+                            model=model_name
+                        )
+                    yield from _sse_error_tail(model_name, is_chat, e)
+                    return
 
         return ProxyResult(
             resp.status, resp_headers, chunks(), model=model_name
         )
+
+    def _resume_stream(
+        self, ctx: _ResumeCtx, acc: _SSEAccumulator, parsed_body: dict,
+        path: str, model_name: str, request_id: str,
+    ):
+        """Dispatch a continuation request for a dead stream: pick a
+        healthy endpoint (circuit-breaker exclude-set honored), POST the
+        original body plus the `kubeai_resume` prefix, and hand back the
+        new (resp, conn, done, addr) to stitch into the client's stream.
+        Bounded by MAX_STREAM_RESUMES dispatches and the client's
+        X-Deadline-Ms budget; returns None when neither allows another
+        attempt — the caller falls back to the terminal error tail."""
+        while ctx.resume_attempts < MAX_STREAM_RESUMES:
+            remaining = ctx.budget_left()
+            if remaining is not None and remaining <= 0:
+                return None
+            timeout = (
+                RESUME_PICK_TIMEOUT_S if remaining is None
+                else min(remaining, RESUME_PICK_TIMEOUT_S)
+            )
+            try:
+                addr, done = self.lb.await_best_address(
+                    model_name,
+                    adapter=ctx.preq.adapter,
+                    prefix=ctx.prefix,
+                    strategy=ctx.strategy,
+                    timeout=timeout,
+                    exclude=ctx.failed,
+                    role=ctx.role,
+                )
+            except (NoHealthyEndpoints, LoadBalancerTimeout):
+                return None
+            ctx.resume_attempts += 1
+            body = dict(parsed_body)
+            body["kubeai_resume"] = {
+                "token_ids": list(acc.token_ids),
+                "emitted": acc.emitted_chars,
+            }
+            preq = dataclasses.replace(
+                ctx.preq, body=json.dumps(body).encode()
+            )
+            span_attrs = {
+                "endpoint": addr,
+                "resume.attempt": ctx.resume_attempts,
+                "resume.tokens": len(acc.token_ids),
+                "request.model": model_name,
+            }
+            if request_id:
+                span_attrs["request.id"] = request_id
+            span = tracing.tracer().start_span(
+                "proxy.resume",
+                parent=ctx.trace_parent,
+                kind=tracing.KIND_CLIENT,
+                attributes=span_attrs,
+            )
+            hop_headers = dict(
+                ctx.headers, traceparent=span.context.traceparent()
+            )
+            try:
+                resp, conn = _send(
+                    addr, path, preq, hop_headers,
+                    connect_timeout=self.timeouts.connect_s,
+                    read_timeout=self.timeouts.response_header_s,
+                )
+            except OSError as e:
+                fault = (
+                    OUTCOME_TIMEOUT if isinstance(e, TimeoutError)
+                    else OUTCOME_CONNECT_ERROR
+                )
+                span.set_attribute("fault.class", fault)
+                span.end(error=str(e))
+                done(outcome=fault, error=f"{fault}: {e}")
+                ctx.failed.add(addr)
+                continue
+            if resp.status != 200:
+                outcome = (
+                    OUTCOME_SHED if resp.status == 429
+                    else OUTCOME_5XX if resp.status >= 500
+                    else OUTCOME_SUCCESS  # coherent 4xx answer
+                )
+                span.set_attribute("http.status_code", resp.status)
+                span.end(error=f"HTTP {resp.status}")
+                resp.read()
+                conn.close()
+                done(outcome=outcome, error=f"HTTP {resp.status}")
+                if 400 <= resp.status < 500 and resp.status != 429:
+                    # The continuation itself was rejected (e.g. a
+                    # multi-host replica): another endpoint would answer
+                    # the same.
+                    return None
+                ctx.failed.add(addr)
+                continue
+            span.set_attribute("http.status_code", 200)
+            span.end()
+            self.metrics.proxy_stream_resumes.inc(model=model_name)
+            logger.info(
+                "resumed stream on %s after %d emitted token(s) "
+                "(attempt %d, model=%s request_id=%s)",
+                addr, len(acc.token_ids), ctx.resume_attempts,
+                model_name, request_id,
+            )
+            return resp, conn, done, addr
+        return None
 
 
 def _sse_error_tail(model_name: str, is_chat: bool, exc: Exception):

@@ -71,6 +71,12 @@ class Fault:
     body: dict | None = None     # kind="http": JSON body (default error)
     headers: dict | None = None  # kind="http": extra response headers
     after_chunks: int = 1        # kind="die_mid_stream": chunks before death
+    # kind="die_mid_stream": die at an SSE EVENT boundary after exactly
+    # this many complete `\n\n`-terminated events (overrides
+    # after_chunks). Deterministic regardless of TCP segmentation — the
+    # read-counting after_chunks mode can deliver a whole fast stream in
+    # one read and never fire.
+    after_events: int = 0
     stall_s: float = 0.0         # kind="stall": pre-header stall
 
     def __post_init__(self):
@@ -172,6 +178,43 @@ class _DyingResponse:
         return self._dying_read(inner, n)
 
 
+class _EventDyingResponse:
+    """Wraps a real SSE response; body reads return ONE complete
+    `\\n\\n`-terminated event at a time and raise once `after_events`
+    events have been delivered — a deterministic mid-stream death at an
+    event boundary, independent of how TCP segmented the stream."""
+
+    def __init__(self, resp, after_events: int):
+        self._resp = resp
+        self._left = after_events
+        self._buf = b""
+
+    def __getattr__(self, name):
+        return getattr(self._resp, name)
+
+    def _read_event(self, inner) -> bytes:
+        if self._left <= 0:
+            raise ConnectionResetError("injected mid-stream death")
+        while b"\n\n" not in self._buf:
+            chunk = inner(16384)
+            if not chunk:
+                # Upstream finished before the quota: flush the tail.
+                out, self._buf = self._buf, b""
+                return out
+            self._buf += chunk
+        idx = self._buf.index(b"\n\n") + 2
+        out, self._buf = self._buf[:idx], self._buf[idx:]
+        self._left -= 1
+        return out
+
+    def read(self, n: int = -1) -> bytes:
+        return self._read_event(self._resp.read)
+
+    def read1(self, n: int = -1) -> bytes:
+        inner = getattr(self._resp, "read1", None) or self._resp.read
+        return self._read_event(inner)
+
+
 def faulty_send(plan: FaultPlan, real_send, clock=time.sleep):
     """Wrap the proxy's `_send` with the plan. Attempts the plan leaves
     alone pass through untouched; faulted attempts raise/respond the way
@@ -206,6 +249,8 @@ def faulty_send(plan: FaultPlan, real_send, clock=time.sleep):
             return resp, _FakeConn()
         # die_mid_stream: real connection, poisoned body.
         resp, conn = real_send(addr, path, preq, headers, **kw)
+        if f.after_events:
+            return _EventDyingResponse(resp, f.after_events), conn
         return _DyingResponse(resp, f.after_chunks), conn
 
     return send

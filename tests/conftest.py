@@ -58,6 +58,12 @@ def pytest_configure(config):
         "handoff, role routing, per-role scaling (runs in the fast "
         "tier; select with -m disagg)",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: preemption-tolerance suite — transparent stream resume, "
+        "self-healing pod repair, engine step watchdog (runs in the "
+        "fast tier; select with -m chaos)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
