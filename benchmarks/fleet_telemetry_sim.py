@@ -1,0 +1,514 @@
+"""Deterministic fleet-telemetry simulation — no JAX, no sockets.
+
+Builds a synthetic fleet (N unified models × M replicas plus one
+disaggregated prefill/decode model) on a fake clock, renders each
+endpoint's scripted signals as REAL Prometheus exposition text (with
+trailing timestamps and +Inf buckets, exactly what a production scrape
+returns), and drives the REAL FleetStateAggregator, UsageMeter, and
+Autoscaler over it. One endpoint is DEAD (never answers) and one goes
+STALE mid-run (answers, then stops).
+
+Invariants (asserted in tier-1 by tests/unit/test_fleet_telemetry.py):
+
+  * snapshot coverage & convergence: every live endpoint of every model
+    (≥ 2 models) appears in the snapshot with per-role signals and chip
+    inventory; two sweeps over frozen signals produce identical
+    per-model views;
+  * staleness is FLAGGED, never silently merged: the dead endpoint and
+    the gone-stale endpoint appear with `stale: true` + the scrape
+    error, and the per-model aggregates exclude them exactly;
+  * tenant token accounting is EXACT: the usage ledger equals the
+    synthetic token emission integer-for-integer;
+  * aggregator-fed autoscaler decisions EQUAL direct-scrape decisions
+    for every model (unified boost path and per-role disagg path), with
+    the aggregator world actually reading the aggregator.
+
+Run directly for a human-readable report:
+
+    python benchmarks/fleet_telemetry_sim.py
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeai_tpu.autoscaler import Autoscaler
+from kubeai_tpu.autoscaler.autoscaler import (
+    scrape_queue_pressure,
+    scrape_role_signals,
+)
+from kubeai_tpu.config import System
+from kubeai_tpu.crd.model import Disaggregation, Model, ModelSpec
+from kubeai_tpu.fleet import FleetStateAggregator, UsageMeter
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.loadbalancer import LoadBalancer
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.testing.faults import FakeClock
+
+N_MODELS = 2           # unified models m0, m1
+REPLICAS = 3           # endpoints per unified model
+DEAD_ADDR = "10.0.0.0:8000"      # m0 replica 0: never answers
+STALE_ADDR = "10.0.1.0:8000"     # m1 replica 0: answers, then stops
+STALE_AFTER_TICK = 4
+TICKS = 8
+
+
+class Endpoint:
+    """Scripted signals for one serving endpoint, rendered as exposition
+    text the way a real engine's /metrics does — including trailing
+    sample timestamps and a histogram +Inf bucket, which the hardened
+    parser must swallow."""
+
+    def __init__(self, model: str, idx: int, role: str = "unified"):
+        self.model = model
+        self.idx = idx
+        self.role = role
+        self.signals = {
+            "depth_standard": 0.0,
+            "depth_batch": 0.0,
+            "oldest_wait_s": 0.0,
+            "kv_utilization": 0.0,
+            "slots_active": 0.0,
+            "slot_capacity": 32.0,
+            "ttft_sum": 0.0,
+            "ttft_count": 0.0,
+            "active": 0.0,
+        }
+
+    def advance(self, tick: int) -> None:
+        s = self.signals
+        base = (self.idx + 1) * (tick + 1)
+        if self.role == "prefill":
+            # Prefill pressure grows with the tick: queued prefills and
+            # mean TTFT climb so the role autoscaler has to act.
+            s["depth_standard"] = float(3 * (tick + 1))
+            s["oldest_wait_s"] = 0.5 * tick
+            s["ttft_sum"] += 0.4 * (tick + 1)
+            s["ttft_count"] += 1.0
+        elif self.role == "decode":
+            s["kv_utilization"] = min(0.95, 0.2 + 0.1 * tick)
+            s["slots_active"] = float(min(30, 4 * (tick + 1)))
+        else:
+            s["depth_standard"] = float(base % 7)
+            s["depth_batch"] = float(base % 3)
+            # m1 ages past the 3s queue-pressure bound mid-run so the
+            # unified boost path fires and must agree across worlds.
+            s["oldest_wait_s"] = (
+                4.0 + tick if self.model == "m1" else 0.5
+            )
+            s["kv_utilization"] = (base % 10) / 10.0
+            s["slots_active"] = float(base % 32)
+            s["ttft_sum"] += 0.05 * base
+            s["ttft_count"] += 2.0
+            s["active"] = float(base % 5)
+
+    def exposition(self) -> str:
+        s = self.signals
+        ts = " 1722772800000"  # trailing timestamp: must be tolerated
+        lines = [
+            "# TYPE kubeai_engine_queue_depth gauge",
+            f'kubeai_engine_queue_depth{{class="standard"}} '
+            f"{s['depth_standard']}{ts}",
+            f'kubeai_engine_queue_depth{{class="batch"}} '
+            f"{s['depth_batch']}",
+            f'kubeai_engine_queue_oldest_wait_seconds{{class="standard"}} '
+            f"{s['oldest_wait_s']}",
+            f"kubeai_engine_kv_cache_utilization {s['kv_utilization']}",
+            f"kubeai_engine_slots_active {s['slots_active']}",
+            f"kubeai_engine_slot_capacity {s['slot_capacity']}",
+            f"kubeai_engine_ttft_seconds_sum {s['ttft_sum']}",
+            f"kubeai_engine_ttft_seconds_count {s['ttft_count']}",
+            f'kubeai_engine_ttft_seconds_bucket{{le="0.25"}} '
+            f"{s['ttft_count'] * 0.5}",
+            f'kubeai_engine_ttft_seconds_bucket{{le="+Inf"}} '
+            f"{s['ttft_count']}{ts}",
+            f"kubeai_engine_active_requests {s['active']}",
+        ]
+        return "\n".join(lines) + "\n"
+
+    def state(self) -> dict:
+        return {
+            "model": self.model,
+            "healthy": True,
+            "draining": False,
+            "role": self.role,
+        }
+
+
+def _pod(model: str, idx: int, addr: str, role: str | None = None,
+         chips: int = 4, topology: str = "2x2") -> dict:
+    ip, _, port = addr.partition(":")
+    labels = {"model": model}
+    if role:
+        labels["model-role"] = role
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"model-{model}-{idx}" + (f"-{role}" if role else ""),
+            "namespace": "default",
+            "labels": labels,
+            "annotations": {
+                "model-pod-ip": ip,
+                "model-pod-port": port,
+            },
+        },
+        "spec": {
+            "nodeSelector": {
+                "cloud.google.com/gke-tpu-accelerator":
+                    "tpu-v5-lite-podslice",
+                "cloud.google.com/gke-tpu-topology": topology,
+            },
+            "containers": [{
+                "name": "server",
+                "resources": {
+                    "requests": {"google.com/tpu": str(chips)},
+                    "limits": {"google.com/tpu": str(chips)},
+                },
+            }],
+        },
+        "status": {
+            "conditions": [{"type": "Ready", "status": "True"}],
+            "podIP": ip,
+        },
+    }
+
+
+class FleetWorld:
+    """One complete in-process fleet: store + LB + models + scripted
+    endpoints. Built identically for the aggregator-fed and the
+    direct-scrape autoscaler worlds so their decisions are comparable."""
+
+    def __init__(self):
+        self.clock = FakeClock(1000.0)
+        self.store = KubeStore()
+        self.cfg = System()
+        self.cfg.fixed_self_metric_addrs = ["self:1"]
+        self.cfg.default_and_validate()
+        self.mc = ModelClient(self.store)
+        self.lb = LoadBalancer(self.store)
+        self.metrics = Metrics()
+        self.endpoints: dict[str, Endpoint] = {}
+        self.tick_no = 0
+
+        spec_common = dict(
+            url="hf://org/x", engine="KubeAITPU",
+            features=["TextGeneration"], min_replicas=0, max_replicas=10,
+            replicas=REPLICAS, target_requests=10,
+            scale_down_delay_seconds=0,
+        )
+        for i in range(N_MODELS):
+            name = f"m{i}"
+            self.store.create(
+                Model(name=name, spec=ModelSpec(**spec_common)).to_dict()
+            )
+            for j in range(REPLICAS):
+                addr = f"10.0.{i}.{j}:8000"
+                self.endpoints[addr] = Endpoint(name, j)
+                self.store.create(_pod(name, j, addr))
+        # One disaggregated model with explicit prefill/decode pools.
+        self.store.create(
+            Model(
+                name="m-disagg",
+                spec=ModelSpec(
+                    **{**spec_common, "replicas": 0},
+                    disaggregation=Disaggregation(
+                        enabled=True,
+                        prefill_target_queue=4,
+                        prefill_target_ttft_seconds=0.5,
+                        decode_target_utilization=0.8,
+                    ),
+                ),
+            ).to_dict()
+        )
+        for j, role in ((0, "prefill"), (1, "prefill"),
+                        (2, "decode"), (3, "decode")):
+            addr = f"10.0.9.{j}:8000"
+            self.endpoints[addr] = Endpoint("m-disagg", j, role=role)
+            self.store.create(
+                _pod("m-disagg", j, addr, role=role, chips=8,
+                     topology="2x4")
+            )
+        self.lb.sync_all()
+
+    # -- scripted fetch (the no-sockets transport) -------------------------
+
+    def _reachable(self, addr: str) -> bool:
+        if addr == DEAD_ADDR:
+            return False
+        if addr == STALE_ADDR and self.tick_no >= STALE_AFTER_TICK:
+            return False
+        return True
+
+    def fetch_metrics(self, addr: str, timeout: float) -> str:
+        if not self._reachable(addr):
+            raise ConnectionRefusedError(f"{addr} is down")
+        return self.endpoints[addr].exposition()
+
+    def fetch_state(self, addr: str, timeout: float) -> dict:
+        if not self._reachable(addr):
+            raise ConnectionRefusedError(f"{addr} is down")
+        return self.endpoints[addr].state()
+
+    def advance(self) -> None:
+        self.tick_no += 1
+        self.clock.advance(1.0)
+        for ep in self.endpoints.values():
+            ep.advance(self.tick_no)
+
+    def active_totals(self) -> dict[str, float]:
+        totals: dict[str, float] = {}
+        for addr, ep in self.endpoints.items():
+            if not self._reachable(addr):
+                continue
+            totals[ep.model] = (
+                totals.get(ep.model, 0.0) + ep.signals["active"]
+            )
+        return totals
+
+    def make_autoscaler(self, fleet=None) -> Autoscaler:
+        class AlwaysLeader:
+            is_leader = True
+
+        a = Autoscaler(
+            self.store, self.cfg, self.mc, self.lb, AlwaysLeader(),
+            metrics=self.metrics,
+        )
+        a.active_scraper = lambda addrs: self.active_totals()
+        a.queue_scraper = lambda addrs: scrape_queue_pressure(
+            addrs, fetch=self.fetch_metrics
+        )
+        a.role_scraper = lambda addrs: scrape_role_signals(
+            addrs, fetch=self.fetch_metrics
+        )
+        a.fleet = fleet
+        return a
+
+
+def _strip_volatile(decisions: list[dict]) -> list[dict]:
+    out = []
+    for d in decisions:
+        d = copy.deepcopy(d)
+        d.pop("ts", None)
+        d.pop("scrape_duration_s", None)
+        d.pop("telemetry_source", None)
+        out.append(d)
+    return sorted(out, key=lambda d: d["model"])
+
+
+def run_sim(ticks: int = TICKS) -> dict:
+    """Run the full scenario; returns measured facts for the tier-1
+    invariant assertions (and the __main__ report)."""
+    # -- two identical worlds: aggregator-fed vs direct-scrape ----------
+    agg_world = FleetWorld()
+    direct_world = FleetWorld()
+    usage = UsageMeter(metrics=agg_world.metrics)
+    aggregator = FleetStateAggregator(
+        lb=agg_world.lb,
+        model_client=agg_world.mc,
+        store=agg_world.store,
+        namespace="default",
+        metrics=agg_world.metrics,
+        usage=usage,
+        interval_s=1.0,
+        staleness_s=2.5,
+        fetch_metrics=agg_world.fetch_metrics,
+        fetch_state=agg_world.fetch_state,
+        clock=agg_world.clock,
+    )
+    scaler_agg = agg_world.make_autoscaler(fleet=aggregator)
+    scaler_direct = direct_world.make_autoscaler(fleet=None)
+
+    # -- synthetic tenant traffic (exact-integer ledger check) ----------
+    emitted: dict[tuple[str, str], dict] = {}
+    decision_pairs: list[tuple[list[dict], list[dict]]] = []
+    snapshots: list[dict] = []
+    for _ in range(ticks):
+        agg_world.advance()
+        direct_world.advance()
+        snap = aggregator.collect()
+        snapshots.append(snap)
+        # Tenant traffic: deterministic token counts per tenant×model.
+        t = agg_world.tick_no
+        for tenant, model, p, c in (
+            ("acme", "m0", 100 + t, 10 * t),
+            ("acme", "m1", 7, 3),
+            ("globex", "m0", 55, 5 + t),
+        ):
+            usage.record(
+                tenant, model, prompt_tokens=p, completion_tokens=c,
+                stream_seconds=0.25, shed=(t % 3 == 0),
+            )
+            e = emitted.setdefault(
+                (tenant, model),
+                {"requests": 0, "prompt_tokens": 0,
+                 "completion_tokens": 0, "shed": 0},
+            )
+            e["requests"] += 1
+            e["prompt_tokens"] += p
+            e["completion_tokens"] += c
+            e["shed"] += 1 if t % 3 == 0 else 0
+        scaler_agg.tick()
+        scaler_direct.tick()
+        decision_pairs.append(
+            (
+                _strip_volatile(scaler_agg.last_decisions),
+                _strip_volatile(scaler_direct.last_decisions),
+            )
+        )
+
+    # Convergence probe: two sweeps over frozen signals must agree on
+    # every per-model view (ts and duration legitimately differ).
+    snap_a = aggregator.collect()
+    snap_b = aggregator.collect()
+
+    return {
+        "snapshots": snapshots,
+        "final": snap_b,
+        "frozen_pair": (snap_a, snap_b),
+        "decision_pairs": decision_pairs,
+        "agg_sources": [
+            d.get("telemetry_source")
+            for d in scaler_agg.last_decisions
+        ],
+        "usage_summary": usage.summary(),
+        "emitted": emitted,
+        "ticks": ticks,
+    }
+
+
+# -- invariant checks (imported by tests/unit/test_fleet_telemetry.py) --------
+
+
+def check_coverage(result: dict) -> None:
+    snap = result["final"]
+    assert len(snap["models"]) >= 2, "needs >= 2 models"
+    assert set(snap["models"]) == {"m0", "m1", "m-disagg"}
+    for name, entry in snap["models"].items():
+        live = [
+            a for a, e in entry["endpoints"].items() if not e["stale"]
+        ]
+        assert entry["endpoints"], f"{name}: no endpoints in snapshot"
+        for addr, e in entry["endpoints"].items():
+            if not e["stale"]:
+                assert "queue_depth" in e and "kv_utilization" in e, (
+                    f"{name}/{addr}: missing per-endpoint signals"
+                )
+        assert live, f"{name}: no live endpoints"
+    # Per-role signals + chip inventory present.
+    dis = snap["models"]["m-disagg"]
+    assert set(dis["replicas"]) == {"prefill", "decode"}
+    assert set(dis["roles"]) == {"prefill", "decode"}
+    assert dis["roles"]["decode"]["kv_utilization"] > 0
+    assert snap["chips"]["total"] == (
+        N_MODELS * REPLICAS * 4 + 4 * 8
+    ), "chip inventory must sum pod google.com/tpu requests"
+    assert "tpu-v5-lite-podslice/2x2" in snap["chips"]["by_shape"]
+    assert "tpu-v5-lite-podslice/2x4" in snap["chips"]["by_shape"]
+
+
+def check_convergence(result: dict) -> None:
+    a, b = result["frozen_pair"]
+    va = {m: e for m, e in a["models"].items()}
+    vb = {m: e for m, e in b["models"].items()}
+    # age_s moves with the clock only if the clock moved — it didn't.
+    assert va == vb, "frozen signals must produce identical model views"
+
+
+def check_staleness(result: dict) -> None:
+    snap = result["final"]
+    m0 = snap["models"]["m0"]
+    dead = m0["endpoints"][DEAD_ADDR]
+    assert dead["stale"] is True and dead["error"], (
+        "dead endpoint must be flagged stale with its error"
+    )
+    assert DEAD_ADDR in m0["stale_endpoints"]
+    # Aggregates exclude it EXACTLY: depth == sum over its live peers.
+    live_depth = sum(
+        e["queue_depth"] for a, e in m0["endpoints"].items()
+        if not e["stale"]
+    )
+    assert m0["queue"]["depth"] == live_depth
+    # The endpoint that died mid-run: fresh before, stale after.
+    first = result["snapshots"][0]
+    assert first["models"]["m1"]["endpoints"][STALE_ADDR]["stale"] is False
+    m1 = snap["models"]["m1"]
+    assert m1["endpoints"][STALE_ADDR]["stale"] is True
+    assert STALE_ADDR in m1["stale_endpoints"]
+    assert snap["stale_total"] >= 2
+
+
+def check_tenant_accounting(result: dict) -> None:
+    summary = result["usage_summary"]
+    for (tenant, model), want in result["emitted"].items():
+        got = summary["tenants"][tenant]["models"][model]
+        for key in ("requests", "prompt_tokens", "completion_tokens",
+                    "shed"):
+            assert got[key] == want[key], (
+                f"{tenant}/{model}.{key}: ledger {got[key]} != emitted "
+                f"{want[key]}"
+            )
+    total_tokens = sum(
+        w["prompt_tokens"] + w["completion_tokens"]
+        for w in result["emitted"].values()
+    )
+    got_total = (
+        summary["totals"]["prompt_tokens"]
+        + summary["totals"]["completion_tokens"]
+    )
+    assert got_total == total_tokens, "ledger total must match emission"
+
+
+def check_autoscaler_equivalence(result: dict) -> None:
+    for i, (agg, direct) in enumerate(result["decision_pairs"]):
+        assert agg == direct, (
+            f"tick {i}: aggregator-fed decisions diverge from "
+            f"direct-scrape:\n{json.dumps(agg, indent=1, sort_keys=True)}"
+            f"\nvs\n{json.dumps(direct, indent=1, sort_keys=True)}"
+        )
+    # And the aggregator world really read the aggregator (no silent
+    # fallback making the equality vacuous).
+    for src in result["agg_sources"]:
+        if isinstance(src, dict):  # disagg: per-role sources
+            assert set(src.values()) == {"aggregator"}, src
+        else:
+            assert src == "aggregator", src
+
+
+ALL_CHECKS = (
+    check_coverage,
+    check_convergence,
+    check_staleness,
+    check_tenant_accounting,
+    check_autoscaler_equivalence,
+)
+
+
+def main() -> int:
+    result = run_sim()
+    for chk in ALL_CHECKS:
+        chk(result)
+        print(f"PASS {chk.__name__}")
+    snap = result["final"]
+    print(json.dumps(
+        {
+            "models": list(snap["models"]),
+            "endpoints_total": snap["endpoints_total"],
+            "stale_total": snap["stale_total"],
+            "chips": snap["chips"],
+            "tenant_totals": result["usage_summary"]["totals"],
+            "ticks": result["ticks"],
+        },
+        indent=2, sort_keys=True,
+    ))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,28 +111,20 @@ def scrape_active_requests(
     return totals
 
 
-def scrape_queue_pressure(
-    addrs: list[str], timeout: float = 5.0, fetch=None
+def aggregate_queue_pressure(
+    parsed_by_addr: dict[str, dict],
 ) -> dict:
-    """Best-effort CONCURRENT scrape of one model's ENGINE endpoints for
-    the scheduler's queue-pressure gauges. Returns
-    ``{"depth": total, "oldest_wait_s": max, "per_class": {class: depth}}``.
-
-    Unlike the active-request scrape (where a missing operator replica
-    zeroing the signal must fail the tick), engine endpoints churn by
-    design during scale-up/down — an unreachable engine is skipped and
-    the boost signal degrades conservatively (toward no boost) instead of
-    failing the whole tick."""
+    """Fold per-endpoint parsed `/metrics` into the queue-pressure
+    signal: ``{"depth": total, "oldest_wait_s": max, "per_class":
+    {class: depth}}``. Shared by the direct scraper below AND the fleet
+    aggregator (kubeai_tpu/fleet) — one aggregation, so an
+    aggregator-fed tick decides exactly what a direct-scrape tick
+    would."""
     depth = 0.0
     oldest = 0.0
     per_class: dict[str, float] = {}
-    for addr, text in _scrape_all(addrs, timeout, fetch).items():
-        if isinstance(text, Exception):
-            logger.debug(
-                "queue-pressure scrape skipped %s: %s", addr, text
-            )
-            continue
-        for (name, labels), value in parse_prometheus_text(text).items():
+    for parsed in parsed_by_addr.values():
+        for (name, labels), value in parsed.items():
             if name == QUEUE_DEPTH_METRIC:
                 depth += value
                 cls = dict(labels).get("class", "")
@@ -143,14 +135,10 @@ def scrape_queue_pressure(
     return {"depth": depth, "oldest_wait_s": oldest, "per_class": per_class}
 
 
-def scrape_role_signals(
-    addrs: list[str], timeout: float = 5.0, fetch=None
-) -> dict:
-    """Concurrent best-effort scrape of one ROLE's engine endpoints for
-    the disaggregated scaling signals: queue depth / oldest wait / mean
-    TTFT (prefill pressure) and KV utilization / slot occupancy (decode
-    pressure). Unreachable endpoints are skipped — role pools churn by
-    design while the autoscaler acts on them."""
+def aggregate_role_signals(parsed_by_addr: dict[str, dict]) -> dict:
+    """Fold per-endpoint parsed `/metrics` into one role's scaling
+    signals (queue/TTFT pressure for prefill, KV/slot occupancy for
+    decode). Shared by the direct scraper and the fleet aggregator."""
     out = {
         "endpoints": 0,
         "depth": 0.0,
@@ -162,12 +150,9 @@ def scrape_role_signals(
     }
     kv_samples: list[float] = []
     ttft_sum = ttft_count = 0.0
-    for addr, text in _scrape_all(addrs, timeout, fetch).items():
-        if isinstance(text, Exception):
-            logger.debug("role scrape skipped %s: %s", addr, text)
-            continue
+    for parsed in parsed_by_addr.values():
         out["endpoints"] += 1
-        for (name, labels), value in parse_prometheus_text(text).items():
+        for (name, labels), value in parsed.items():
             if name == QUEUE_DEPTH_METRIC:
                 out["depth"] += value
             elif name == QUEUE_OLDEST_WAIT_METRIC:
@@ -187,6 +172,43 @@ def scrape_role_signals(
     if ttft_count > 0:
         out["ttft_mean_s"] = ttft_sum / ttft_count
     return out
+
+
+def _parse_reachable(
+    addrs: list[str], timeout: float, fetch, what: str
+) -> dict[str, dict]:
+    """Scrape + parse, skipping unreachable endpoints (engine pools
+    churn by design while the autoscaler acts on them — the signal
+    degrades conservatively instead of failing the tick)."""
+    parsed: dict[str, dict] = {}
+    for addr, text in _scrape_all(addrs, timeout, fetch).items():
+        if isinstance(text, Exception):
+            logger.debug("%s scrape skipped %s: %s", what, addr, text)
+            continue
+        parsed[addr] = parse_prometheus_text(text)
+    return parsed
+
+
+def scrape_queue_pressure(
+    addrs: list[str], timeout: float = 5.0, fetch=None
+) -> dict:
+    """Best-effort CONCURRENT scrape of one model's ENGINE endpoints for
+    the scheduler's queue-pressure gauges (the aggregator-miss fallback
+    path)."""
+    return aggregate_queue_pressure(
+        _parse_reachable(addrs, timeout, fetch, "queue-pressure")
+    )
+
+
+def scrape_role_signals(
+    addrs: list[str], timeout: float = 5.0, fetch=None
+) -> dict:
+    """Concurrent best-effort scrape of one ROLE's engine endpoints for
+    the disaggregated scaling signals (the aggregator-miss fallback
+    path)."""
+    return aggregate_role_signals(
+        _parse_reachable(addrs, timeout, fetch, "role")
+    )
 
 
 class Autoscaler:
@@ -213,6 +235,12 @@ class Autoscaler:
         # Injectable for tests (fake engine endpoints without sockets).
         self.queue_scraper = scrape_queue_pressure
         self.role_scraper = scrape_role_signals
+        self.active_scraper = scrape_active_requests
+        # Fleet telemetry plane (kubeai_tpu/fleet): when wired, per-model
+        # engine signals come from the aggregator's snapshot instead of
+        # a fresh scrape per model per tick; a stale/missing snapshot
+        # falls back to the direct scrape.
+        self.fleet = None
         self.interval = cfg.model_autoscaling.interval_seconds
         self.window_count = cfg.model_autoscaling.average_window_count
         self._averages: dict[str, SimpleMovingAverage] = {}
@@ -263,7 +291,7 @@ class Autoscaler:
             "autoscaler.tick", kind=tracing.KIND_INTERNAL
         ) as span:
             t0 = time.monotonic()
-            totals = scrape_active_requests(addrs)
+            totals = self.active_scraper(addrs)
             scrape_s = time.monotonic() - t0
             # The scrape duration lands in the histogram AND on the tick
             # span — traces and metrics must tell the same story.
@@ -299,9 +327,7 @@ class Autoscaler:
                 # into the demand estimate — a saturated replica set
                 # otherwise plateaus at "looks fully utilized" while its
                 # queues (and TTFT) grow without bound.
-                queue = self.queue_scraper(
-                    self.lb.group(model.name).addresses()
-                )
+                queue, queue_src = self._queue_signals(model.name)
                 threshold = (
                     self.cfg.model_autoscaling.queue_pressure_max_wait_seconds
                 )
@@ -327,6 +353,7 @@ class Autoscaler:
                     "queue_depth": queue["depth"],
                     "queue_oldest_wait_s": queue["oldest_wait_s"],
                     "queue_per_class": dict(queue["per_class"]),
+                    "telemetry_source": queue_src,
                 }
                 decisions.append(record)
                 decision_log.info(json.dumps(record, sort_keys=True))
@@ -356,6 +383,31 @@ class Autoscaler:
             self._averages = next_averages
             self._save_state()
 
+    # -- engine-signal reads (aggregator-first, direct-scrape fallback) --------
+
+    def _queue_signals(self, model_name: str) -> tuple[dict, str]:
+        """One model's queue-pressure signals and where they came from
+        ("aggregator" | "scrape"). The aggregator answers from its last
+        fleet sweep; a stale/missing snapshot degrades to the same
+        direct scrape the pre-fleet autoscaler ran."""
+        if self.fleet is not None:
+            queue = self.fleet.queue_pressure(model_name)
+            if queue is not None:
+                return queue, "aggregator"
+        return (
+            self.queue_scraper(self.lb.group(model_name).addresses()),
+            "scrape",
+        )
+
+    def _role_signals(
+        self, model_name: str, role: str, addrs: list[str]
+    ) -> tuple[dict, str]:
+        if self.fleet is not None:
+            sig = self.fleet.role_signals(model_name, role)
+            if sig is not None:
+                return sig, "aggregator"
+        return self.role_scraper(addrs), "scrape"
+
     def _disagg_decisions(
         self, model, active: float, avg: float,
         scrape_s: float, scraped_replicas: int,
@@ -376,8 +428,12 @@ class Autoscaler:
         group = self.lb.group(model.name)
         pre_addrs = group.addresses(role=md.ROLE_PREFILL)
         dec_addrs = group.addresses(role=md.ROLE_DECODE)
-        pre = self.role_scraper(pre_addrs)
-        dec = self.role_scraper(dec_addrs)
+        pre, pre_src = self._role_signals(
+            model.name, md.ROLE_PREFILL, pre_addrs
+        )
+        dec, dec_src = self._role_signals(
+            model.name, md.ROLE_DECODE, dec_addrs
+        )
         threshold = (
             self.cfg.model_autoscaling.queue_pressure_max_wait_seconds
         )
@@ -432,6 +488,10 @@ class Autoscaler:
             "average": avg,
             "scrape_duration_s": scrape_s,
             "scraped_replicas": scraped_replicas,
+            "telemetry_source": {
+                md.ROLE_PREFILL: pre_src,
+                md.ROLE_DECODE: dec_src,
+            },
             "roles": {
                 md.ROLE_PREFILL: {
                     "endpoints": len(pre_addrs),

@@ -308,6 +308,16 @@ class Engine:
         # running batch size, waiting-queue depth, tokens emitted, wall
         # duration.
         self.last_step_stats: dict[str, float] = {}
+        # Per-phase step profiler (kubeai_tpu/fleet/profiler): step()
+        # fills `_phase_scratch` with monotonic phase durations and
+        # closes each step into the profiler's ring; the serve loop
+        # drains it into the kubeai_engine_step_phase_seconds histogram
+        # and POST /v1/profile reads the ring. Plain float bookkeeping
+        # under the engine lock — no registry in the hot path.
+        from kubeai_tpu.fleet.profiler import StepProfiler
+
+        self.profiler = StepProfiler()
+        self._phase_scratch: dict[str, float] | None = None
 
         # Resolve the cache mode: paged needs family support; otherwise
         # fall back to the slot cache. Chunked prefill works in both modes
@@ -2216,12 +2226,16 @@ class Engine:
                 self._timing.append(("ttft", max(0.0, _now() - t0)))
                 # Gather the sequence's pages to host IN TABLE ORDER: the
                 # packed-page blob is position-major by construction.
+                _kv_t0 = time.perf_counter()
                 idx = jnp.asarray(pages, jnp.int32)
                 k_host = np.asarray(
                     jax.device_get(self.cache.k_pages[:, idx])
                 )
                 v_host = np.asarray(
                     jax.device_get(self.cache.v_pages[:, idx])
+                )
+                self.profiler.observe(
+                    "kv_transfer", time.perf_counter() - _kv_t0
                 )
                 if self._prefix_cache:
                     # Publish the prompt pages before release so they park
@@ -2375,6 +2389,7 @@ class Engine:
             # push through the import graph. Values are copied bit-exact;
             # a dtype mismatch casts (and is caught by tests that assert
             # token identity across matching-dtype pools).
+            _kv_t0 = time.perf_counter()
             k_seq, v_seq = handoff.contiguous_kv()
             pad = np.zeros(
                 (nl, self.cfg.max_seq_len, kvh, d), dtype=k_seq.dtype
@@ -2411,6 +2426,9 @@ class Engine:
                 self.cache.v_pages,
                 self.cache.block_tables,
                 self._state,
+            )
+            self.profiler.observe(
+                "kv_transfer", time.perf_counter() - _kv_t0
             )
             # _set_bt_row marked the host mirror dirty; the import graph
             # also set the device row, so the next step's device_put is
@@ -2486,12 +2504,22 @@ class Engine:
         Returns a list of StepEvents in emission order.
         """
         with self._lock:
+            # Per-phase timeline for this step (fleet/profiler.py):
+            # prefill = admission pass, schedule = host bookkeeping
+            # before the decode dispatch, decode = jit DISPATCH (async;
+            # the device wait lands in host_sync at device_get inside
+            # _process_chunk), sample = host token emission.
+            phases: dict[str, float] = {}
+            self._phase_scratch = phases
+            _admit_t0 = time.perf_counter()
             emitted = self._admit_pending()
+            phases["prefill"] = time.perf_counter() - _admit_t0
             prev = self._inflight
             self._inflight = None
             current = None
             decode_mode = None
             t0 = time.perf_counter()
+            _dec_t0 = t0
             if self._active:
                 if self.cache_mode == "paged":
                     self._ensure_decode_pages()
@@ -2500,6 +2528,8 @@ class Engine:
                             jnp.asarray(self._bt_host), self._bt_sharding
                         )
                         self._bt_dirty = False
+                    _dec_t0 = time.perf_counter()
+                    phases["schedule"] = _dec_t0 - t0
                     if self._spec and self._spec_pick():
                         decode_mode = "spec"
                         if self._draft:
@@ -2562,12 +2592,17 @@ class Engine:
                                 inputs, pre_positions,
                             )
                 else:
+                    _dec_t0 = time.perf_counter()
                     toks_seq, self.cache.k, self.cache.v, self._state = (
                         self._decode_jit(
                             self.params, self.cache.k, self.cache.v,
                             self._state, self._lora,
                         )
                     )
+                phases["decode"] = (
+                    phases.get("decode", 0.0)
+                    + (time.perf_counter() - _dec_t0)
+                )
                 self._steps += 1
                 current = (toks_seq, list(self._active.items()))
                 if self.cfg.pipeline:
@@ -2602,13 +2637,26 @@ class Engine:
                 "tokens": len(emitted),
                 "duration_s": step_s,
             }
+            self._phase_scratch = None
+            # Record only steps that DID something — an idle poll's
+            # all-zero timeline would just dilute the ring.
+            if emitted or current is not None or prev is not None:
+                self.profiler.observe_step(
+                    phases,
+                    tokens=len(emitted),
+                    batch=len(self._active),
+                    duration_s=step_s,
+                )
             return emitted
 
     def _process_chunk(self, inflight: tuple) -> list[StepEvent]:
         toks_seq, chunk_slots = inflight
         if isinstance(toks_seq, tuple) and toks_seq[0] == "spec":
             return self._process_spec(toks_seq[1], toks_seq[2], chunk_slots)
+        _sync_t0 = time.perf_counter()
         toks_seq = np.asarray(jax.device_get(toks_seq))  # [chunk, B]
+        self._note_phase("host_sync", time.perf_counter() - _sync_t0)
+        _sample_t0 = time.perf_counter()
         emitted: list[StepEvent] = []
         for k in range(toks_seq.shape[0]):
             # One timestamp per fused decode step: its tokens became
@@ -2633,15 +2681,26 @@ class Engine:
                 )
                 if finished:
                     self._release(req)
+        self._note_phase("sample", time.perf_counter() - _sample_t0)
         return emitted
+
+    def _note_phase(self, phase: str, seconds: float) -> None:
+        """Accumulate a phase duration into the CURRENT step's timeline
+        (no-op outside step(); always under the engine lock)."""
+        ph = self._phase_scratch
+        if ph is not None:
+            ph[phase] = ph.get(phase, 0.0) + seconds
 
     def _process_spec(
         self, choices, n_emit, chunk_slots
     ) -> list[StepEvent]:
         """Emit each slot's accepted+corrected tokens (1..γ+1 per step).
         A stop mid-window discards the remainder, like chunk surplus."""
+        _sync_t0 = time.perf_counter()
         choices = np.asarray(jax.device_get(choices))  # [B, γ+1]
         n_emit = np.asarray(jax.device_get(n_emit))  # [B]
+        self._note_phase("host_sync", time.perf_counter() - _sync_t0)
+        _sample_t0 = time.perf_counter()
         emitted: list[StepEvent] = []
         now = _now()  # one verify forward produced the whole window
         for slot, req in chunk_slots:
@@ -2667,6 +2726,7 @@ class Engine:
                 if finished:
                     self._release(req)
                     break
+        self._note_phase("sample", time.perf_counter() - _sample_t0)
         return emitted
 
     def _build_proposals(self) -> np.ndarray:

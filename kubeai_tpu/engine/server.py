@@ -41,7 +41,13 @@ from kubeai_tpu.engine.engine import (
 from kubeai_tpu.engine.sampling import SamplingParams
 from kubeai_tpu.metrics import tracing
 from kubeai_tpu.engine.tokenizer import Tokenizer, load_tokenizer
-from kubeai_tpu.metrics.registry import Counter, Gauge, Histogram, Registry
+from kubeai_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    TracingDroppedSpans,
+)
 from kubeai_tpu.scheduling import (
     DeadlineInfeasible,
     PRIORITY_CLASSES,
@@ -222,6 +228,22 @@ class EngineMetrics:
             "fetch.",
             self.registry,
         )
+        # -- engine step profiler (kubeai_tpu/fleet/profiler) ---------------
+        self.step_phase = Histogram(
+            "kubeai_engine_step_phase_seconds",
+            "Wall time per engine-step phase (label `phase`: schedule / "
+            "prefill / decode / sample / host_sync / kv_transfer) — "
+            "the per-phase answer to 'why is ITL high'. decode is the "
+            "async jit DISPATCH; the device wait surfaces as host_sync.",
+            self.registry,
+            buckets=ITL_BUCKETS_S,
+        )
+        self.tracing_dropped = TracingDroppedSpans(
+            "kubeai_tracing_dropped_spans_total",
+            "Spans dropped by the OTLP exporter (queue full or exporter "
+            "thread dead) instead of blocking the request path.",
+            self.registry,
+        )
         # -- scheduler queue-pressure signal (per priority class) ----------
         self.queue_depth = Gauge(
             "kubeai_engine_queue_depth",
@@ -345,6 +367,10 @@ class EngineMetrics:
         if drain is not None:
             for kind, seconds in drain():
                 self.observe_timing(kind, seconds)
+        prof = getattr(inner, "profiler", None)
+        if prof is not None:
+            for phase, seconds in prof.drain():
+                self.step_phase.observe(seconds, phase=phase)
         step_stats = snap["last_step"]
         if step_stats:
             self.batch_size.set(step_stats.get("batch_size", 0))
@@ -579,6 +605,8 @@ class EngineServer:
                     try:
                         if path == "/v1/drain":
                             return self._json(202, outer.begin_drain())
+                        if path == "/v1/profile":
+                            return outer._handle_profile(self, body)
                         if path == "/v1/chat/completions":
                             return outer._handle_generate(self, body, chat=True)
                         if path == "/v1/completions":
@@ -849,6 +877,88 @@ class EngineServer:
             headers={
                 "Retry-After": f"{remaining:.0f}",
                 "Connection": "close",
+            },
+        )
+
+    # -- step profiling (kubeai_tpu/fleet/profiler) -----------------------------
+
+    def _handle_profile(self, http, body: dict):
+        """POST /v1/profile — capture an N-step per-phase timeline.
+
+        Body (all optional): `steps` (how many step records to return,
+        default 16), `fresh` (true = wait for that many NEW steps up to
+        `timeout_s` before answering; false = answer from the ring
+        immediately), `jax_trace` (additionally wrap the capture window
+        in `jax.profiler.trace` when a real device is present — no-op
+        safe on CPU, the response carries the trace dir or null)."""
+        from kubeai_tpu.fleet.profiler import phase_totals
+
+        inner = getattr(self.engine, "inner", self.engine)
+        prof = getattr(inner, "profiler", None)
+        if prof is None:
+            return http._json(
+                400,
+                {"error": {"message": "engine exposes no step profiler"}},
+            )
+        steps = body.get("steps", 16)
+        if (
+            isinstance(steps, bool)
+            or not isinstance(steps, int)
+            or not 1 <= steps <= 10_000
+        ):
+            return http._json(
+                400,
+                {"error": {"message": "steps must be an int in 1..10000"}},
+            )
+        timeout_s = body.get("timeout_s", 10.0)
+        if (
+            isinstance(timeout_s, bool)
+            or not isinstance(timeout_s, (int, float))
+            or not 0 < timeout_s <= 120
+        ):
+            return http._json(
+                400,
+                {"error": {"message": "timeout_s must be in (0, 120]"}},
+            )
+        fresh = bool(body.get("fresh", False))
+        trace_dir = None
+        if body.get("jax_trace"):
+            # Device-level tracing rides along when the runtime supports
+            # it; on CPU (or a runtime without the profiler service) this
+            # degrades to the host-side phase timeline alone.
+            import tempfile
+
+            try:
+                import jax
+
+                trace_dir = tempfile.mkdtemp(prefix="kubeai-profile-")
+                jax.profiler.start_trace(trace_dir)
+            except Exception:  # noqa: BLE001 — profiling must not 500
+                trace_dir = None
+        captured = 0
+        try:
+            if fresh:
+                captured = prof.wait_for_steps(steps, float(timeout_s))
+        finally:
+            if trace_dir is not None:
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001
+                    trace_dir = None
+        records = prof.recent(steps)
+        return http._json(
+            200,
+            {
+                "object": "engine.profile",
+                "model": self.served_model_name,
+                "steps_requested": steps,
+                "steps_captured": captured if fresh else len(records),
+                "steps_completed_total": prof.steps_completed,
+                "phase_totals_s": phase_totals(records),
+                "steps": records,
+                "jax_trace_dir": trace_dir,
             },
         )
 

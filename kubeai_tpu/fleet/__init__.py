@@ -1,0 +1,40 @@
+"""Fleet telemetry plane: cluster state aggregation, per-tenant usage
+metering, and engine step profiling.
+
+Three pillars (see docs/concepts/observability.md — Fleet telemetry):
+
+  - `FleetStateAggregator` — one concurrent sweep over every serving
+    endpoint's `/metrics` + `/v1/state`, joined with the operator's pod
+    inventory into a timestamped snapshot with explicit staleness;
+    exposed as `GET /v1/fleet/state`, `kubeai_fleet_*` gauges, and a
+    snapshot ring (`/v1/fleet/history`). The autoscaler reads it
+    instead of re-scraping, with direct-scrape fallback.
+  - `UsageMeter` — per-tenant×model token/request/stream/shed ledger
+    (`kubeai_tenant_*` counters, `GET /v1/usage`).
+  - `StepProfiler` — per-phase Engine.step timeline
+    (`kubeai_engine_step_phase_seconds`, `POST /v1/profile`).
+"""
+
+from kubeai_tpu.fleet.aggregator import (
+    FleetStateAggregator,
+    endpoint_signals,
+    hist_quantiles,
+)
+from kubeai_tpu.fleet.metering import (
+    ANONYMOUS_TENANT,
+    UsageMeter,
+    tenant_of,
+)
+from kubeai_tpu.fleet.profiler import PHASES, StepProfiler, phase_totals
+
+__all__ = [
+    "ANONYMOUS_TENANT",
+    "FleetStateAggregator",
+    "PHASES",
+    "StepProfiler",
+    "UsageMeter",
+    "endpoint_signals",
+    "hist_quantiles",
+    "phase_totals",
+    "tenant_of",
+]

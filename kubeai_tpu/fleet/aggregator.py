@@ -1,0 +1,543 @@
+"""FleetStateAggregator: one place that can see the whole fleet.
+
+Before this existed, fleet state was scattered and transient: the
+autoscaler re-scraped every model's engines each tick and threw the
+samples away, the LB knew endpoints but not their signals, and the
+operator knew pods but not their load. The aggregator runs one
+concurrent sweep over every serving endpoint's `/metrics` +
+`/v1/state`, joins it with the operator's pod inventory (slice shape
+from `google.com/tpu` requests, `model-role` labels, Ready/disruption
+conditions), and publishes a timestamped `FleetSnapshot`:
+
+  - per-model / per-role replica counts and aggregate signals (queue
+    depth, oldest wait, TTFT/ITL quantiles, KV/slot utilization),
+  - per-endpoint signal detail with explicit STALENESS: a failed scrape
+    keeps the endpoint visible with its last-good data flagged stale —
+    never silently merged into aggregates, never silently dropped,
+  - cluster chip inventory by slice shape,
+  - a ring buffer of recent snapshots (`/v1/fleet/history`) so the
+    future capacity planner and prewarm forecaster have a time series
+    to regress on.
+
+The aggregates are computed by the SAME functions the autoscaler's
+direct scrapers use (`aggregate_queue_pressure` / `aggregate_role_
+signals` in kubeai_tpu/autoscaler/autoscaler.py), so an aggregator-fed
+tick decides exactly what a direct-scrape tick would — asserted by
+benchmarks/fleet_telemetry_sim.py in tier-1. Consumers read through a
+freshness bound: a stale snapshot returns None and the caller falls
+back to its direct scrape.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import threading
+import time
+import urllib.request
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+from kubeai_tpu.autoscaler.autoscaler import (
+    KV_UTILIZATION_METRIC,
+    QUEUE_DEPTH_METRIC,
+    QUEUE_OLDEST_WAIT_METRIC,
+    SLOT_CAPACITY_METRIC,
+    SLOTS_ACTIVE_METRIC,
+    aggregate_queue_pressure,
+    aggregate_role_signals,
+)
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.metrics.registry import (
+    DEFAULT_METRICS,
+    Metrics,
+    parse_prometheus_text,
+)
+from kubeai_tpu.operator import k8sutils
+
+logger = logging.getLogger(__name__)
+
+TTFT_HIST = "kubeai_engine_ttft_seconds"
+ITL_HIST = "kubeai_engine_inter_token_latency_seconds"
+ACTIVE_REQUESTS_METRIC = "kubeai_engine_active_requests"
+
+
+def _default_fetch_metrics(addr: str, timeout: float) -> str:
+    with urllib.request.urlopen(
+        f"http://{addr}/metrics", timeout=timeout
+    ) as resp:
+        return resp.read().decode()
+
+
+def _default_fetch_state(addr: str, timeout: float) -> dict:
+    with urllib.request.urlopen(
+        f"http://{addr}/v1/state", timeout=timeout
+    ) as resp:
+        return json.loads(resp.read().decode())
+
+
+def hist_quantiles(
+    parsed: dict, name: str, qs: tuple[float, ...] = (0.5, 0.95, 0.99)
+) -> dict:
+    """Approximate quantiles from one endpoint's cumulative histogram
+    buckets (each quantile reports its bucket's upper bound — the
+    standard Prometheus-side estimate). Returns {} when the histogram
+    has no observations."""
+    buckets: list[tuple[float, float]] = []
+    total = 0.0
+    total_sum = 0.0
+    for (metric, labels), value in parsed.items():
+        if metric == f"{name}_bucket":
+            le = dict(labels).get("le", "")
+            try:
+                bound = float(le)
+            except ValueError:
+                continue
+            buckets.append((bound, value))
+        elif metric == f"{name}_count":
+            total = value
+        elif metric == f"{name}_sum":
+            total_sum = value
+    if total <= 0 or not buckets:
+        return {}
+    buckets.sort(key=lambda b: b[0])
+    out = {
+        "count": total,
+        "mean_s": round(total_sum / total, 9),
+    }
+    for q in qs:
+        target = q * total
+        est = buckets[-1][0]
+        for bound, cum in buckets:
+            if cum >= target:
+                est = bound
+                break
+        if math.isinf(est):
+            # The quantile lands past the largest finite bucket; report
+            # that bound rather than a meaningless +Inf.
+            finite = [b for b, _ in buckets if not math.isinf(b)]
+            est = finite[-1] if finite else float("inf")
+        out[f"p{int(q * 100)}_s"] = est
+    return out
+
+
+def endpoint_signals(parsed: dict) -> dict:
+    """Per-endpoint scalar signals extracted from one `/metrics` parse —
+    the snapshot's per-endpoint detail view."""
+    depth = 0.0
+    per_class: dict[str, float] = {}
+    oldest = 0.0
+    kv_util = 0.0
+    slots_active = 0.0
+    slot_capacity = 0.0
+    active = 0.0
+    for (name, labels), value in parsed.items():
+        if name == QUEUE_DEPTH_METRIC:
+            depth += value
+            cls = dict(labels).get("class", "")
+            if cls:
+                per_class[cls] = per_class.get(cls, 0.0) + value
+        elif name == QUEUE_OLDEST_WAIT_METRIC:
+            oldest = max(oldest, value)
+        elif name == KV_UTILIZATION_METRIC:
+            kv_util = value
+        elif name == SLOTS_ACTIVE_METRIC:
+            slots_active = value
+        elif name == SLOT_CAPACITY_METRIC:
+            slot_capacity = value
+        elif name == ACTIVE_REQUESTS_METRIC:
+            active = value
+    return {
+        "queue_depth": depth,
+        "queue_per_class": per_class,
+        "queue_oldest_wait_s": oldest,
+        "kv_utilization": kv_util,
+        "slots_active": slots_active,
+        "slot_capacity": slot_capacity,
+        "active_requests": active,
+        "ttft": hist_quantiles(parsed, TTFT_HIST),
+        "itl": hist_quantiles(parsed, ITL_HIST),
+    }
+
+
+class FleetStateAggregator:
+    """Background fleet-state collector + snapshot ring.
+
+    `fetch_metrics(addr, timeout) -> str` and
+    `fetch_state(addr, timeout) -> dict` are injectable (tests and the
+    deterministic sim drive the aggregator with no sockets); `clock` is
+    the wall clock behind timestamps and staleness (FakeClock in the
+    sim)."""
+
+    def __init__(
+        self,
+        lb,
+        model_client,
+        store=None,
+        namespace: str = "default",
+        metrics: Metrics = DEFAULT_METRICS,
+        usage=None,
+        interval_s: float = 5.0,
+        staleness_s: float | None = None,
+        history: int = 120,
+        scrape_timeout_s: float = 5.0,
+        fetch_metrics=None,
+        fetch_state=None,
+        clock=time.time,
+    ):
+        self.lb = lb
+        self.model_client = model_client
+        self.store = store
+        self.namespace = namespace
+        self.metrics = metrics
+        self.usage = usage
+        self.interval_s = interval_s
+        # Endpoint data AND snapshots older than this are stale:
+        # endpoints drop out of aggregates, consumer reads return None
+        # (→ direct-scrape fallback).
+        self.staleness_s = (
+            staleness_s if staleness_s is not None else 3.0 * interval_s
+        )
+        self.scrape_timeout_s = scrape_timeout_s
+        self._fetch_metrics = fetch_metrics or _default_fetch_metrics
+        self._fetch_state = fetch_state or _default_fetch_state
+        self._clock = clock
+        self._lock = threading.Lock()
+        # Serializes whole sweeps: the background loop and an on-demand
+        # state_payload() refresh must not interleave gauge updates.
+        self._collect_lock = threading.Lock()
+        # addr -> {"parsed", "state", "ts" (last SUCCESS), "error"}
+        self._endpoint_cache: dict[str, dict] = {}
+        self._snapshots: deque[dict] = deque(maxlen=history)
+        self._prev_series: dict[str, tuple] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.collect()
+            except Exception as e:
+                logger.warning("fleet collection failed: %s", e)
+
+    # -- one sweep -------------------------------------------------------------
+
+    def _scrape_endpoint(self, addr: str):
+        """(parsed_metrics, state) or the exception that broke either
+        fetch — /metrics is the signal source, /v1/state the admin
+        detail; both must land for the endpoint to count as fresh."""
+        text = self._fetch_metrics(addr, self.scrape_timeout_s)
+        parsed = parse_prometheus_text(text)
+        try:
+            state = self._fetch_state(addr, self.scrape_timeout_s)
+        except Exception:  # noqa: BLE001 — state detail is best-effort
+            state = {}
+        return parsed, state
+
+    def collect(self) -> dict:
+        """Run one synchronous fleet sweep and publish the snapshot."""
+        with self._collect_lock:
+            return self._collect_locked()
+
+    def _collect_locked(self) -> dict:
+        t0 = time.monotonic()
+        now = self._clock()
+        models = self.model_client.list_all_models()
+        # Endpoint topology from the LB's live groups (role labels
+        # included); pods the LB has ejected are already absent here.
+        topology: dict[str, dict[str, dict]] = {}
+        all_addrs: set[str] = set()
+        for model in models:
+            eps = self.lb.group(model.name).snapshot()["endpoints"]
+            topology[model.name] = eps
+            all_addrs.update(eps)
+
+        results: dict[str, object] = {}
+        if all_addrs:
+            addrs = sorted(all_addrs)
+            if len(addrs) == 1:
+                try:
+                    results[addrs[0]] = self._scrape_endpoint(addrs[0])
+                except Exception as e:  # noqa: BLE001 — flagged stale
+                    results[addrs[0]] = e
+            else:
+                with ThreadPoolExecutor(
+                    max_workers=min(16, len(addrs))
+                ) as pool:
+                    futures = {
+                        a: pool.submit(self._scrape_endpoint, a)
+                        for a in addrs
+                    }
+                    for a, fut in futures.items():
+                        try:
+                            results[a] = fut.result()
+                        except Exception as e:  # noqa: BLE001
+                            results[a] = e
+
+        with self._lock:
+            for addr, res in results.items():
+                if isinstance(res, Exception):
+                    entry = self._endpoint_cache.setdefault(
+                        addr, {"parsed": None, "state": {}, "ts": None}
+                    )
+                    entry["error"] = f"{type(res).__name__}: {res}"
+                else:
+                    parsed, state = res
+                    self._endpoint_cache[addr] = {
+                        "parsed": parsed,
+                        "state": state,
+                        "ts": now,
+                        "error": None,
+                    }
+            # Endpoints no model routes to anymore leave the cache — the
+            # per-endpoint staleness view must not accrete retirees.
+            for addr in list(self._endpoint_cache):
+                if addr not in all_addrs:
+                    del self._endpoint_cache[addr]
+            cache = {a: dict(e) for a, e in self._endpoint_cache.items()}
+
+        per_model_pods, chips = self._pod_inventory()
+        snap_models: dict[str, dict] = {}
+        stale_total = 0
+        endpoints_total = 0
+        for model in models:
+            eps = topology.get(model.name, {})
+            endpoints_total += len(eps)
+            ep_entries: dict[str, dict] = {}
+            fresh_parsed: dict[str, dict] = {}
+            roles_present: dict[str, dict[str, dict]] = {}
+            replicas: dict[str, int] = {}
+            stale_addrs: list[str] = []
+            for addr, lb_view in eps.items():
+                role = lb_view.get("role") or md.ROLE_UNIFIED
+                replicas[role] = replicas.get(role, 0) + 1
+                cached = cache.get(addr) or {
+                    "parsed": None, "state": {}, "ts": None,
+                    "error": "never scraped",
+                }
+                age = (
+                    None if cached["ts"] is None
+                    else max(0.0, now - cached["ts"])
+                )
+                stale = (
+                    cached["parsed"] is None
+                    or age is None
+                    or age > self.staleness_s
+                    or (cached.get("error") and age > 0)
+                )
+                # A scrape that failed THIS sweep but whose data is
+                # within bound stays usable — flagged, not merged-fresh:
+                # the entry carries the error and its age.
+                usable = cached["parsed"] is not None and (
+                    age is not None and age <= self.staleness_s
+                )
+                entry = {
+                    "role": role,
+                    "stale": bool(stale),
+                    "age_s": None if age is None else round(age, 3),
+                    "error": cached.get("error"),
+                    "in_flight": lb_view.get("in_flight", 0),
+                }
+                if cached["parsed"] is not None:
+                    entry.update(endpoint_signals(cached["parsed"]))
+                    state = cached.get("state") or {}
+                    for k in ("healthy", "draining", "pending_handoffs"):
+                        if k in state:
+                            entry[k] = state[k]
+                ep_entries[addr] = entry
+                if stale:
+                    stale_addrs.append(addr)
+                if usable and not stale:
+                    fresh_parsed[addr] = cached["parsed"]
+                    roles_present.setdefault(role, {})[addr] = (
+                        cached["parsed"]
+                    )
+            stale_total += len(stale_addrs)
+            snap_models[model.name] = {
+                "endpoints": ep_entries,
+                "replicas": replicas,
+                "queue": aggregate_queue_pressure(fresh_parsed),
+                "roles": {
+                    role: aggregate_role_signals(parsed_by_addr)
+                    for role, parsed_by_addr in roles_present.items()
+                },
+                "stale_endpoints": sorted(stale_addrs),
+                "pods": per_model_pods.get(model.name, {}),
+            }
+
+        snapshot = {
+            "ts": now,
+            "models": snap_models,
+            "chips": chips,
+            "endpoints_total": endpoints_total,
+            "stale_total": stale_total,
+            "collection_duration_s": round(time.monotonic() - t0, 6),
+        }
+        if self.usage is not None:
+            snapshot["tenants"] = self.usage.summary()
+        with self._lock:
+            self._snapshots.append(snapshot)
+        self._update_gauges(snapshot)
+        self.metrics.fleet_collections.inc()
+        self.metrics.fleet_collection_duration.observe(
+            snapshot["collection_duration_s"]
+        )
+        return snapshot
+
+    def _pod_inventory(self) -> tuple[dict, dict]:
+        """Join the operator's pod view: per-model readiness/disruption
+        counts and the cluster chip inventory by slice shape."""
+        per_model: dict[str, dict] = {}
+        by_shape: dict[str, int] = {}
+        pods_by_shape: dict[str, int] = {}
+        total_chips = 0
+        if self.store is None:
+            return per_model, {
+                "total": 0, "by_shape": {}, "pods_by_shape": {},
+            }
+        for pod in self.store.list("Pod", self.namespace):
+            model = k8sutils.get_label(pod, md.POD_MODEL_LABEL)
+            if not model:
+                continue
+            role = (
+                k8sutils.get_label(pod, md.POD_ROLE_LABEL)
+                or md.ROLE_UNIFIED
+            )
+            chips = k8sutils.pod_chip_count(pod)
+            shape = k8sutils.pod_slice_shape(pod)
+            entry = per_model.setdefault(
+                model,
+                {
+                    "total": 0, "ready": 0, "disrupted": 0,
+                    "chips": 0, "by_role": {}, "by_shape": {},
+                },
+            )
+            entry["total"] += 1
+            entry["chips"] += chips
+            entry["by_role"][role] = entry["by_role"].get(role, 0) + 1
+            entry["by_shape"][shape] = entry["by_shape"].get(shape, 0) + 1
+            if k8sutils.pod_is_ready(pod):
+                entry["ready"] += 1
+            if k8sutils.pod_disruption_reason(pod) is not None:
+                entry["disrupted"] += 1
+            by_shape[shape] = by_shape.get(shape, 0) + chips
+            pods_by_shape[shape] = pods_by_shape.get(shape, 0) + 1
+            total_chips += chips
+        return per_model, {
+            "total": total_chips,
+            "by_shape": by_shape,
+            "pods_by_shape": pods_by_shape,
+        }
+
+    # -- gauges (with label-churn hygiene) --------------------------------------
+
+    def _update_gauges(self, snap: dict) -> None:
+        m = self.metrics
+        new_series: dict[str, tuple] = {}
+
+        def set_(gauge, value, **labels):
+            gauge.set(value, **labels)
+            new_series.setdefault(gauge.name, (gauge, set()))[1].add(
+                tuple(sorted(labels.items()))
+            )
+
+        for name, entry in snap["models"].items():
+            for role, count in entry["replicas"].items():
+                set_(m.fleet_endpoints, count, model=name, role=role)
+            set_(
+                m.fleet_stale_endpoints,
+                len(entry["stale_endpoints"]), model=name,
+            )
+            set_(m.fleet_queue_depth, entry["queue"]["depth"], model=name)
+            for role, sig in entry["roles"].items():
+                set_(
+                    m.fleet_kv_utilization,
+                    sig["kv_utilization"], model=name, role=role,
+                )
+        for shape, chips in snap["chips"]["by_shape"].items():
+            set_(m.fleet_chips, chips, shape=shape)
+        m.fleet_snapshot_ts.set(snap["ts"])
+        # Retired label sets (model deleted, role gone, shape drained)
+        # must not linger as frozen series.
+        for name, (gauge, keys) in self._prev_series.items():
+            current = (
+                new_series.get(name, (gauge, set()))[1]
+            )
+            for k in keys - current:
+                gauge.remove(**dict(k))
+        self._prev_series = new_series
+
+    # -- consumer API ----------------------------------------------------------
+
+    def snapshot(self) -> dict | None:
+        with self._lock:
+            return self._snapshots[-1] if self._snapshots else None
+
+    def history(self, n: int | None = None) -> list[dict]:
+        with self._lock:
+            snaps = list(self._snapshots)
+        return snaps if n is None else snaps[-n:]
+
+    def _fresh_model(self, model: str) -> dict | None:
+        snap = self.snapshot()
+        if snap is None:
+            return None
+        if self._clock() - snap["ts"] > self.staleness_s:
+            return None
+        return snap["models"].get(model)
+
+    def queue_pressure(self, model: str) -> dict | None:
+        """The autoscaler's queue-pressure read: same shape as
+        `scrape_queue_pressure`, or None when the snapshot is stale or
+        the model unknown (→ caller falls back to direct scrape)."""
+        entry = self._fresh_model(model)
+        if entry is None:
+            return None
+        q = entry["queue"]
+        return {
+            "depth": q["depth"],
+            "oldest_wait_s": q["oldest_wait_s"],
+            "per_class": dict(q["per_class"]),
+        }
+
+    def role_signals(self, model: str, role: str) -> dict | None:
+        """Per-role scaling signals: same shape as
+        `scrape_role_signals`, or None when stale/unknown."""
+        entry = self._fresh_model(model)
+        if entry is None:
+            return None
+        sig = entry["roles"].get(role)
+        if sig is None:
+            # A fresh snapshot with no live endpoints of this role is an
+            # answer, not a miss: the same empty aggregate a direct
+            # scrape of zero addresses yields.
+            if role in entry["replicas"]:
+                return None
+            return aggregate_role_signals({})
+        return dict(sig)
+
+    def state_payload(self) -> dict:
+        """`GET /v1/fleet/state`: the latest snapshot, collected anew
+        when none exists or the latest is past the staleness bound."""
+        snap = self.snapshot()
+        if snap is None or self._clock() - snap["ts"] > self.staleness_s:
+            snap = self.collect()
+        age = max(0.0, self._clock() - snap["ts"])
+        payload = {"object": "fleet.state", "age_s": round(age, 3)}
+        payload.update(snap)
+        if self.usage is not None and "tenants" not in payload:
+            payload["tenants"] = self.usage.summary()
+        return payload

@@ -1,0 +1,158 @@
+"""Per-tenant usage metering: the enforcement-ready ledger behind
+future quotas (ROADMAP item 5).
+
+Every request through the front door or messenger is attributed to a
+tenant — the `X-Client-Id` header (the same WFQ fairness key the
+scheduler uses), or a stable digest of the API-key principal when only
+an Authorization header is present, or `anonymous`. A `UsageMeter`
+accumulates prompt/completion tokens, request counts, stream-seconds,
+and shed/429 counts per tenant×model, mirrored to `kubeai_tenant_*`
+counters and summarized by `GET /v1/usage`.
+
+The ledger keeps EXACT integer token counts (the counters are floats by
+exposition necessity); billing-grade accounting must not depend on float
+accumulation staying integral.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+from kubeai_tpu.metrics.registry import DEFAULT_METRICS, Metrics
+
+ANONYMOUS_TENANT = "anonymous"
+
+
+def tenant_of(headers: dict) -> str:
+    """Resolve the tenant identity from request headers (lowercase keys,
+    as the front door normalizes them): explicit `X-Client-Id` wins, an
+    API-key principal (`Authorization: Bearer ...`) becomes a stable
+    `key-<digest>` pseudonym (the raw key must never become a metric
+    label), else `anonymous`."""
+    cid = (headers.get("x-client-id") or "").strip()
+    if cid:
+        return cid
+    auth = (headers.get("authorization") or "").strip()
+    if auth.lower().startswith("bearer "):
+        key = auth[7:].strip()
+        if key:
+            return "key-" + hashlib.sha256(key.encode()).hexdigest()[:12]
+    return ANONYMOUS_TENANT
+
+
+def _zero() -> dict:
+    return {
+        "requests": 0,
+        "prompt_tokens": 0,
+        "completion_tokens": 0,
+        "stream_seconds": 0.0,
+        "shed": 0,
+    }
+
+
+class UsageMeter:
+    """Thread-safe tenant×model usage ledger + `kubeai_tenant_*` counter
+    mirror. One instance per operator replica (shared by the front door
+    and every messenger stream)."""
+
+    def __init__(self, metrics: Metrics = DEFAULT_METRICS):
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._ledger: dict[tuple[str, str], dict] = {}
+
+    def record(
+        self,
+        tenant: str,
+        model: str,
+        *,
+        prompt_tokens: int = 0,
+        completion_tokens: int = 0,
+        requests: int = 1,
+        stream_seconds: float = 0.0,
+        shed: bool = False,
+    ) -> None:
+        tenant = tenant or ANONYMOUS_TENANT
+        model = model or "unknown"
+        with self._lock:
+            entry = self._ledger.setdefault((tenant, model), _zero())
+            entry["requests"] += int(requests)
+            entry["prompt_tokens"] += int(prompt_tokens)
+            entry["completion_tokens"] += int(completion_tokens)
+            entry["stream_seconds"] += float(stream_seconds)
+            if shed:
+                entry["shed"] += 1
+        m = self.metrics
+        labels = {"tenant": tenant, "model": model}
+        if requests:
+            m.tenant_requests.inc(requests, **labels)
+        if prompt_tokens:
+            m.tenant_prompt_tokens.inc(prompt_tokens, **labels)
+        if completion_tokens:
+            m.tenant_completion_tokens.inc(completion_tokens, **labels)
+        if stream_seconds:
+            m.tenant_stream_seconds.inc(stream_seconds, **labels)
+        if shed:
+            m.tenant_shed.inc(**labels)
+
+    def record_response(
+        self,
+        tenant: str,
+        model: str,
+        status: int,
+        usage: dict | None = None,
+        stream_seconds: float = 0.0,
+        completion_tokens: int | None = None,
+    ) -> None:
+        """Attribute one completed HTTP exchange: token counts from the
+        response's OpenAI `usage` block when present (unary), or from
+        counted stream tokens (SSE); a 429 counts as a shed."""
+        usage = usage if isinstance(usage, dict) else {}
+
+        def _int(key: str) -> int:
+            v = usage.get(key)
+            return v if isinstance(v, int) and not isinstance(v, bool) else 0
+
+        self.record(
+            tenant,
+            model,
+            prompt_tokens=_int("prompt_tokens"),
+            completion_tokens=(
+                _int("completion_tokens")
+                if completion_tokens is None else int(completion_tokens)
+            ),
+            stream_seconds=stream_seconds,
+            shed=status == 429,
+        )
+
+    def summary(self, tenant: str | None = None) -> dict:
+        """The `/v1/usage` payload: per-tenant per-model entries plus
+        per-tenant and global totals. `tenant` filters to one tenant."""
+        with self._lock:
+            items = [
+                (t, m, dict(e)) for (t, m), e in self._ledger.items()
+                if tenant is None or t == tenant
+            ]
+        tenants: dict[str, dict] = {}
+        totals = _zero()
+        for t, m, entry in items:
+            bucket = tenants.setdefault(
+                t, {"models": {}, "totals": _zero()}
+            )
+            bucket["models"][m] = entry
+            for k in entry:
+                bucket["totals"][k] += entry[k]
+                totals[k] += entry[k]
+        for bucket in tenants.values():
+            bucket["totals"]["stream_seconds"] = round(
+                bucket["totals"]["stream_seconds"], 6
+            )
+        totals["stream_seconds"] = round(totals["stream_seconds"], 6)
+        return {
+            "object": "usage.summary",
+            "tenants": tenants,
+            "totals": totals,
+        }
+
+    def totals(self) -> dict:
+        return self.summary()["totals"]

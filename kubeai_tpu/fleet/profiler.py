@@ -1,0 +1,126 @@
+"""Engine step profiler: a per-phase monotonic timeline of Engine.step.
+
+The engine's step loop is the hot path everyone blames when ITL climbs,
+but until now it exported only one number per step (wall duration) — the
+answer to "why is ITL high" required guesswork. The profiler breaks each
+step into phases:
+
+  schedule   — host-side bookkeeping before the decode dispatch (page
+               allocation, block-table upload, speculation arm pick)
+  prefill    — the admission pass (scheduler pops + prefill compute)
+  decode     — the decode/speculation jit DISPATCH (async under JAX; the
+               device wait surfaces in host_sync)
+  host_sync  — jax.device_get of the decode chunk (device wall time the
+               host actually waits for)
+  sample     — host-side token emission (stop checks, slot release)
+  kv_transfer — paged-KV handoff export/import (disaggregated serving;
+               recorded outside the step timeline)
+
+The engine records plain floats under its own lock — it never touches a
+metrics registry from the hot path (same discipline as `Engine._timing`).
+The serve loop drains pending observations into the per-phase histogram
+(`kubeai_engine_step_phase_seconds`), and a bounded ring of recent step
+records backs `POST /v1/profile` on the engine server.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+
+# Canonical phase vocabulary (metric label values; docs list them).
+PHASES = (
+    "schedule", "prefill", "decode", "sample", "host_sync", "kv_transfer",
+)
+
+
+class StepProfiler:
+    """Bounded ring of per-step phase timelines + a drainable list of
+    (phase, seconds) observations for histogram export. Thread-safe; all
+    methods are cheap enough for the engine lock's critical section."""
+
+    def __init__(self, maxlen: int = 256, wall=time.time):
+        self._cond = threading.Condition()
+        self._ring: deque[dict] = deque(maxlen=maxlen)
+        self._pending: list[tuple[str, float]] = []
+        self._wall = wall
+        self.steps_completed = 0
+
+    def observe(self, phase: str, seconds: float) -> None:
+        """One standalone phase observation (e.g. a KV handoff transfer
+        that happens outside the step loop)."""
+        with self._cond:
+            self._pending.append((phase, float(seconds)))
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    def observe_step(
+        self,
+        phases: dict[str, float],
+        tokens: int = 0,
+        batch: int = 0,
+        duration_s: float = 0.0,
+    ) -> None:
+        """Close one step's record into the ring and queue its phases for
+        histogram export. Wakes /v1/profile waiters."""
+        with self._cond:
+            self.steps_completed += 1
+            self._ring.append(
+                {
+                    "step": self.steps_completed,
+                    "ts": self._wall(),
+                    "tokens": int(tokens),
+                    "batch": int(batch),
+                    "duration_s": round(float(duration_s), 9),
+                    "phases_s": {
+                        k: round(float(v), 9) for k, v in phases.items()
+                    },
+                }
+            )
+            self._pending.extend(
+                (k, float(v)) for k, v in phases.items()
+            )
+            self._cond.notify_all()
+
+    def drain(self) -> list[tuple[str, float]]:
+        """Hand pending (phase, seconds) observations to the caller (the
+        serve loop's histogram sync); clears the queue."""
+        with self._cond:
+            out, self._pending = self._pending, []
+            return out
+
+    def recent(self, n: int | None = None) -> list[dict]:
+        with self._cond:
+            records = list(self._ring)
+        return records if n is None else records[-n:]
+
+    def wait_for_steps(self, n: int, timeout_s: float) -> int:
+        """Block until `n` NEW steps complete (or timeout); returns how
+        many actually did. Backs /v1/profile's fresh-capture mode."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._cond:
+            start = self.steps_completed
+            while self.steps_completed - start < n:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=min(remaining, 0.25))
+            return self.steps_completed - start
+
+
+def phase_totals(records: list[dict]) -> dict[str, float]:
+    """Sum each phase across step records — the profile response's
+    roll-up (which phase dominates the window)."""
+    totals: dict[str, float] = {}
+    for rec in records:
+        for k, v in (rec.get("phases_s") or {}).items():
+            totals[k] = totals.get(k, 0.0) + float(v)
+    return {k: round(v, 9) for k, v in totals.items()}

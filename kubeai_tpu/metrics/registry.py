@@ -86,6 +86,22 @@ class Counter(_Metric):
             self._values[self._key(labels)] += amount
 
 
+class TracingDroppedSpans(Counter):
+    """Live view of the process tracer's dropped-span count (export
+    queue full, or exporter thread dead). Synced at collect time so
+    every registry in the process (operator bundle, engine bundle)
+    exposes the same truth without the tracer knowing about registries."""
+
+    def collect(self) -> list[str]:
+        from kubeai_tpu.metrics import tracing
+
+        t = tracing._default
+        dropped = float(t.dropped) if t is not None else 0.0
+        with self._lock:
+            self._values[self._key({})] = dropped
+        return super().collect()
+
+
 class Gauge(_Metric):
     TYPE = "gauge"
 
@@ -133,6 +149,18 @@ class Histogram(_Metric):
         would silently read the unused `_values` dict and always say 0)."""
         with self._lock:
             return float(self._counts.get(tuple(sorted(labels.items())), 0))
+
+    def remove(self, **labels) -> None:
+        """Drop one label-set's series INCLUDING its bucket/sum/count
+        state — the base remove only clears `_values`, which histograms
+        don't use, so label churn would accrete series forever."""
+        with self._lock:
+            k = tuple(sorted(labels.items()))
+            self._values.pop(k, None)
+            self._label_keys.pop(k, None)
+            self._bucket_counts.pop(k, None)
+            self._sums.pop(k, None)
+            self._counts.pop(k, None)
 
     def sum_for(self, **labels) -> float:
         """Sum of observed values for the label set."""
@@ -423,6 +451,92 @@ class Metrics:
             "(decode role).",
             self.registry,
         )
+        # -- fleet telemetry plane (kubeai_tpu/fleet) -----------------------
+        self.fleet_collections = Counter(
+            "kubeai_fleet_collections_total",
+            "Completed fleet-state aggregation sweeps.",
+            self.registry,
+        )
+        self.fleet_collection_duration = Histogram(
+            "kubeai_fleet_collection_duration_seconds",
+            "Wall time of one fleet sweep (all endpoints scraped "
+            "concurrently, so this tracks the slowest endpoint).",
+            self.registry,
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0),
+        )
+        self.fleet_endpoints = Gauge(
+            "kubeai_fleet_endpoints",
+            "Live serving endpoints at the last fleet sweep, per model "
+            "and role.",
+            self.registry,
+        )
+        self.fleet_stale_endpoints = Gauge(
+            "kubeai_fleet_stale_endpoints",
+            "Endpoints whose telemetry is stale (scrape failed or data "
+            "older than the staleness bound) at the last sweep, per "
+            "model — stale endpoints are flagged and excluded from "
+            "aggregates, never silently merged.",
+            self.registry,
+        )
+        self.fleet_queue_depth = Gauge(
+            "kubeai_fleet_queue_depth",
+            "Fleet-aggregated scheduler queue depth per model (fresh "
+            "endpoints only) at the last sweep.",
+            self.registry,
+        )
+        self.fleet_kv_utilization = Gauge(
+            "kubeai_fleet_kv_utilization",
+            "Mean KV-cache utilization per model and role at the last "
+            "sweep.",
+            self.registry,
+        )
+        self.fleet_chips = Gauge(
+            "kubeai_fleet_chips",
+            "Cluster chip inventory by slice shape (from the pods' "
+            "google.com/tpu requests), at the last sweep.",
+            self.registry,
+        )
+        self.fleet_snapshot_ts = Gauge(
+            "kubeai_fleet_snapshot_timestamp_seconds",
+            "Unix timestamp of the latest fleet snapshot (scrape-side "
+            "age = now - this).",
+            self.registry,
+        )
+        # -- per-tenant usage metering (kubeai_tpu/fleet/metering) ----------
+        self.tenant_requests = Counter(
+            "kubeai_tenant_requests_total",
+            "Requests attributed per tenant and model (X-Client-Id, "
+            "API-key principal digest, or 'anonymous').",
+            self.registry,
+        )
+        self.tenant_prompt_tokens = Counter(
+            "kubeai_tenant_prompt_tokens_total",
+            "Prompt tokens consumed per tenant and model.",
+            self.registry,
+        )
+        self.tenant_completion_tokens = Counter(
+            "kubeai_tenant_completion_tokens_total",
+            "Completion tokens generated per tenant and model.",
+            self.registry,
+        )
+        self.tenant_stream_seconds = Counter(
+            "kubeai_tenant_stream_seconds_total",
+            "Seconds of open SSE stream time per tenant and model.",
+            self.registry,
+        )
+        self.tenant_shed = Counter(
+            "kubeai_tenant_shed_total",
+            "Requests answered 429 (shed/rate-limited) per tenant and "
+            "model.",
+            self.registry,
+        )
+        # -- tracing export health ------------------------------------------
+        self.tracing_dropped_spans = TracingDroppedSpans(
+            "kubeai_tracing_dropped_spans_total",
+            "Spans dropped by the OTLP exporter (queue full or exporter "
+            "thread dead) instead of blocking the request path.",
+            self.registry,
+        )
 
 
 # Process-default bundle (single-replica processes, ad-hoc use).
@@ -436,30 +550,66 @@ CHWBL_DISPLACEMENTS = DEFAULT_METRICS.chwbl_displacements
 
 def parse_prometheus_text(text: str) -> dict[tuple[str, tuple], float]:
     """Parse exposition text into {(metric, ((label,val),...)): value} —
-    the autoscaler's scrape decoder (reference: modelautoscaler/metrics.go)."""
+    the scrape decoder behind the autoscaler and the fleet aggregator
+    (reference: modelautoscaler/metrics.go).
+
+    Tolerates real-world exposition the aggregator will meet on the
+    wire: `+Inf`/`NaN` sample values, exponent-format floats, trailing
+    millisecond timestamps after the value, and `}`/whitespace inside
+    quoted label values. Unparseable lines are skipped, never raised —
+    one weird family must not blind the whole scrape."""
     out: dict[tuple[str, tuple], float] = {}
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        try:
-            name_part, value_s = line.rsplit(" ", 1)
-            value = float(value_s)
-        except ValueError:
-            continue
-        if "{" in name_part:
-            name, rest = name_part.split("{", 1)
-            rest = rest.rstrip("}")
-            labels = []
-            for pair in _split_label_pairs(rest):
+        labels: list[tuple[str, str]] = []
+        brace = line.find("{")
+        if brace != -1 and (
+            " " not in line[:brace] and "\t" not in line[:brace]
+        ):
+            name = line[:brace]
+            closed = _find_label_close(line, brace + 1)
+            if closed < 0:
+                continue  # unterminated label block
+            for pair in _split_label_pairs(line[brace + 1:closed]):
                 if "=" not in pair:
                     continue
                 k, v = pair.split("=", 1)
-                labels.append((k, _unquote_label_value(v)))
-            out[(name, tuple(sorted(labels)))] = value
+                labels.append((k.strip(), _unquote_label_value(v)))
+            tail = line[closed + 1:]
         else:
-            out[(name_part, ())] = value
+            name, _, tail = line.partition(" ")
+        parts = tail.split()
+        if not name or not parts:
+            continue
+        try:
+            # float() natively accepts +Inf/-Inf/NaN and exponent forms.
+            value = float(parts[0])
+        except ValueError:
+            continue
+        # parts[1], when present, is the optional sample timestamp — it
+        # must not be mistaken for the value (the old rsplit was).
+        out[(name, tuple(sorted(labels)))] = value
     return out
+
+
+def _find_label_close(line: str, start: int) -> int:
+    """Index of the `}` closing the label block opened before `start`,
+    honoring quotes and backslash escapes (a quoted label value may
+    legally contain `}`). -1 when unterminated."""
+    in_q = esc = False
+    for i in range(start, len(line)):
+        ch = line[i]
+        if esc:
+            esc = False
+        elif ch == "\\" and in_q:
+            esc = True
+        elif ch == '"':
+            in_q = not in_q
+        elif ch == "}" and not in_q:
+            return i
+    return -1
 
 
 def _split_label_pairs(s: str) -> list[str]:

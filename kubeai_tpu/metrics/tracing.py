@@ -199,6 +199,15 @@ class Tracer:
     def _record(self, span: Span) -> None:
         if not self.endpoint:
             return
+        t = self._thread
+        if t is None or not t.is_alive():
+            # Nothing will ever drain the queue: enqueueing would just
+            # strand the span (and eventually wedge flush callers on a
+            # growing task counter). Count it as dropped — the
+            # kubeai_tracing_dropped_spans_total counter surfaces the
+            # dead exporter instead of silence.
+            self.dropped += 1
+            return
         try:
             self._q.put_nowait(span)
         except queue.Full:

@@ -173,6 +173,45 @@ def classify_pod_failure(
     return None
 
 
+# -- chip inventory (fleet telemetry: kubeai_tpu/fleet/aggregator) ------------
+
+
+def pod_chip_count(pod: dict) -> int:
+    """Total `google.com/tpu` chips this pod requests across its
+    containers (limits win over requests, per scheduler semantics)."""
+    total = 0
+    for c in ((pod.get("spec") or {}).get("containers") or []):
+        res = c.get("resources") or {}
+        v = (res.get("limits") or {}).get("google.com/tpu") or (
+            res.get("requests") or {}
+        ).get("google.com/tpu")
+        try:
+            total += int(v) if v is not None else 0
+        except (TypeError, ValueError):
+            continue
+    return total
+
+
+def pod_slice_shape(pod: dict) -> str:
+    """Human-stable slice-shape key for the chip inventory: the GKE TPU
+    accelerator + ICI topology node selectors when present (e.g.
+    `tpu-v5-lite-podslice/2x4`), else the chip count alone (`tpu-4`),
+    else `cpu`."""
+    sel = (pod.get("spec") or {}).get("nodeSelector") or {}
+    accel = sel.get("cloud.google.com/gke-tpu-accelerator")
+    topo = sel.get("cloud.google.com/gke-tpu-topology")
+    if accel and topo:
+        return f"{accel}/{topo}"
+    if accel:
+        return str(accel)
+    chips = pod_chip_count(pod)
+    if topo:
+        return f"tpu/{topo}"
+    if chips:
+        return f"tpu-{chips}"
+    return "cpu"
+
+
 def job_is_complete(job: dict) -> bool:
     """(reference: internal/k8sutils/jobs.go)"""
     for cond in (job.get("status") or {}).get("conditions", []):

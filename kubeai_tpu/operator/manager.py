@@ -109,12 +109,32 @@ class Manager:
                 response_header_s=res.response_header_timeout_seconds,
             ),
         )
+        # Fleet telemetry plane (kubeai_tpu/fleet): tenant usage ledger +
+        # background fleet-state aggregator. The autoscaler's per-model
+        # engine reads go through the aggregator's snapshot (stale →
+        # direct-scrape fallback), the front door serves /v1/fleet/* and
+        # /v1/usage from them.
+        from kubeai_tpu.fleet import FleetStateAggregator, UsageMeter
+
+        self.usage = UsageMeter(metrics=self.metrics)
+        self.fleet = FleetStateAggregator(
+            lb=self.lb,
+            model_client=self.model_client,
+            store=self.store,
+            namespace=self.namespace,
+            metrics=self.metrics,
+            usage=self.usage,
+            interval_s=self.cfg.model_autoscaling.interval_seconds / 2.0,
+        )
+        self.autoscaler.fleet = self.fleet
         self.api_server = OpenAIServer(
             self.proxy,
             self.model_client,
             host=self.api_host,
             port=self.api_port,
             metrics=self.metrics,
+            fleet=self.fleet,
+            usage=self.usage,
         )
         self.messengers: list[Messenger] = []
         # One broker per stream, chosen by URL scheme (gcppubsub://,
@@ -149,6 +169,7 @@ class Manager:
                     max_handlers=stream.max_handlers,
                     error_max_backoff=self.cfg.messaging.error_max_backoff_seconds,
                     metrics=self.metrics,
+                    usage=self.usage,
                 )
             )
         self.broker = default_broker
@@ -167,6 +188,7 @@ class Manager:
         self.lb.start()
         self.controller_loop.start()
         self.leader.start()
+        self.fleet.start()
         self.autoscaler.start()
         self.api_server.start()
         for m in self.messengers:
@@ -223,6 +245,7 @@ class Manager:
                 pass
         self.api_server.stop()
         self.autoscaler.stop()
+        self.fleet.stop()
         self.leader.stop()
         self.controller_loop.stop()
         self.lb.stop()

@@ -320,7 +320,15 @@ class Group:
             )
 
     def _drop_breaker_metrics(self, addr: str) -> None:
+        # BOTH per-endpoint series go: a reconciled-away endpoint's
+        # frozen state gauge AND its ejection counter would otherwise
+        # accrete forever on a long-lived registry as pods churn (a
+        # re-added address starts a fresh breaker, so the counter
+        # restarting from zero is the truthful series).
         self.metrics.lb_circuit_state.remove(
+            model=self.model, endpoint=addr
+        )
+        self.metrics.lb_circuit_ejections.remove(
             model=self.model, endpoint=addr
         )
 

@@ -133,8 +133,14 @@ class Messenger:
         error_max_backoff: float = 30.0,
         http_send=None,  # injectable for tests
         metrics: Metrics = DEFAULT_METRICS,
+        usage=None,
     ):
         self.metrics = metrics
+        # Per-tenant usage metering (kubeai_tpu/fleet/metering): async
+        # requests carry the same tenant identity as HTTP ones via
+        # metadata.client_id, so a batch pipeline's tokens land in the
+        # same ledger interactive traffic does.
+        self.usage = usage
         self.broker = broker
         self.request_subscription = request_subscription
         self.response_topic = response_topic
@@ -294,6 +300,16 @@ class Messenger:
             parsed = json.loads(resp_body)
         except json.JSONDecodeError:
             parsed = {"raw": resp_body.decode(errors="replace")}
+        if self.usage is not None:
+            self.usage.record_response(
+                str(metadata.get("client_id") or "") or None,
+                model.name,
+                status,
+                usage=(
+                    parsed.get("usage")
+                    if isinstance(parsed, dict) else None
+                ),
+            )
         if self._respond(metadata, status, parsed):
             msg.ack()
             return False

@@ -16,8 +16,15 @@ Mux:
         expanding adapters into model ids (reference: openaiserver/models.go:13-109)
 
 Plus operator endpoints:
-  GET /metrics  → Prometheus exposition (the autoscaler's transport)
+  GET /metrics        → Prometheus exposition (the autoscaler's transport)
   GET /healthz
+  GET /v1/fleet/state   → fleet telemetry snapshot (kubeai_tpu/fleet)
+  GET /v1/fleet/history → ring buffer of recent snapshots
+  GET /v1/usage?tenant= → per-tenant usage ledger summary
+
+Tenant attribution: every proxied request is attributed to a tenant
+(`X-Client-Id`, API-key principal digest, or `anonymous`) and its token
+usage / stream time / shed count is folded into the UsageMeter.
 
 Built on ThreadingHTTPServer: each request thread may block in the load
 balancer's scale-from-zero wait without stalling others.
@@ -91,10 +98,17 @@ class OpenAIServer:
         host: str = "127.0.0.1",
         port: int = 0,
         metrics: Metrics = DEFAULT_METRICS,
+        fleet=None,
+        usage=None,
     ):
         self.proxy = proxy
         self.model_client = model_client
         self.metrics = metrics
+        # Fleet telemetry plane (kubeai_tpu/fleet): the aggregator backs
+        # /v1/fleet/*, the usage meter attributes every request to a
+        # tenant and backs /v1/usage. Both optional (embedded tests).
+        self.fleet = fleet
+        self.usage = usage
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -128,6 +142,44 @@ class OpenAIServer:
                     return
                 if path == "/healthz":
                     return self._respond_json(200, {"status": "ok"})
+                if path in ("/v1/fleet/state", "/openai/v1/fleet/state"):
+                    if outer.fleet is None:
+                        return self._respond_json(
+                            404,
+                            {"error": {"message":
+                                       "fleet telemetry not configured"}},
+                        )
+                    return self._respond_json(
+                        200, outer.fleet.state_payload()
+                    )
+                if path in ("/v1/fleet/history", "/openai/v1/fleet/history"):
+                    if outer.fleet is None:
+                        return self._respond_json(
+                            404,
+                            {"error": {"message":
+                                       "fleet telemetry not configured"}},
+                        )
+                    return self._respond_json(
+                        200,
+                        {
+                            "object": "fleet.history",
+                            "snapshots": outer.fleet.history(),
+                        },
+                    )
+                if path in ("/v1/usage", "/openai/v1/usage"):
+                    if outer.usage is None:
+                        return self._respond_json(
+                            404,
+                            {"error": {"message":
+                                       "usage metering not configured"}},
+                        )
+                    from urllib.parse import parse_qs, urlsplit
+
+                    qs = parse_qs(urlsplit(self.path).query)
+                    tenant = (qs.get("tenant") or [None])[0]
+                    return self._respond_json(
+                        200, outer.usage.summary(tenant)
+                    )
                 self._respond_json(404, {"error": {"message": "not found"}})
 
             def _handle_models(self):
@@ -212,6 +264,48 @@ class OpenAIServer:
                 # histograms agree: TTFT at the first body chunk, e2e
                 # duration when the body (streamed or unary) completes.
                 model = getattr(result, "model", "") or "unknown"
+                # Tenant usage attribution (kubeai_tpu/fleet/metering):
+                # unary JSON answers carry an OpenAI `usage` block the
+                # meter parses; SSE streams are counted by their engine
+                # `token_ids` fields plus stream-open seconds.
+                is_sse = any(
+                    k.lower() == "content-type"
+                    and v.lower().startswith("text/event-stream")
+                    for k, v in result.headers
+                )
+                tenant = ""
+                sse_acc = None
+                json_buf = None
+                if outer.usage is not None:
+                    from kubeai_tpu.fleet.metering import tenant_of
+                    from kubeai_tpu.routing.proxy import _SSEAccumulator
+
+                    tenant = tenant_of(headers)
+                    if is_sse:
+                        sse_acc = _SSEAccumulator()
+                    elif result.status == 200:
+                        json_buf = bytearray()
+
+                def _meter(duration: float) -> None:
+                    if outer.usage is None:
+                        return
+                    usage_block = None
+                    completion = None
+                    if sse_acc is not None:
+                        completion = len(sse_acc.token_ids)
+                    elif json_buf:
+                        try:
+                            usage_block = json.loads(
+                                bytes(json_buf)
+                            ).get("usage")
+                        except (json.JSONDecodeError, AttributeError):
+                            usage_block = None
+                    outer.usage.record_response(
+                        tenant, model, result.status,
+                        usage=usage_block,
+                        stream_seconds=duration if is_sse else 0.0,
+                        completion_tokens=completion,
+                    )
 
                 def _finish(error=None):
                     duration = time.monotonic() - t0
@@ -219,6 +313,7 @@ class OpenAIServer:
                     outer.metrics.request_duration.observe(
                         duration, model=model
                     )
+                    _meter(duration)
                     access_log.info(
                         "route=%s request_id=%s model=%s status=%d "
                         "duration_ms=%.1f",
@@ -238,6 +333,13 @@ class OpenAIServer:
                                 outer.metrics.request_ttft.observe(
                                     ttft, model=model
                                 )
+                            if sse_acc is not None:
+                                sse_acc.feed(chunk)
+                            elif (
+                                json_buf is not None
+                                and len(json_buf) < (1 << 22)
+                            ):
+                                json_buf.extend(chunk)
                             yield chunk
                     except BaseException as e:
                         _finish(error=str(e) or type(e).__name__)

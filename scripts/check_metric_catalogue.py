@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Metric-catalogue drift check.
+
+Collects every `kubeai_*` metric name registered by the codebase's
+instrument bundles (the operator `Metrics` bundle and the engine's
+`EngineMetrics`) and diffs them against the catalogue in
+docs/concepts/observability.md:
+
+  - a REGISTERED metric missing from the doc fails (the catalogue rots
+    the moment an instrument lands undocumented);
+  - a DOCUMENTED metric that no longer exists fails (stale docs are
+    worse than none).
+
+The doc may use trailing-`*` wildcards (`kubeai_engine_spec_*`) to cover
+a family. Histograms are matched by base name; the doc may also mention
+derived exposition series (`_bucket`/`_sum`/`_count`), which resolve to
+their base metric.
+
+Run directly (exit 1 on drift) or import `check()` — a tier-1 test wires
+it in so the catalogue can't rot again.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC_PATH = os.path.join(REPO_ROOT, "docs", "concepts", "observability.md")
+
+_NAME_RE = re.compile(r"kubeai_[a-z0-9_]+\*?")
+
+# Doc tokens that match the metric-name shape but aren't metrics (the
+# package path shows up in prose as `kubeai_tpu/fleet` etc.).
+_NOT_METRICS = frozenset({"kubeai_tpu"})
+
+
+_DECL_RE = re.compile(
+    r"(?:Counter|Gauge|Histogram|TracingDroppedSpans)\(\s*"
+    r"[\"'](kubeai_[a-z0-9_]+)[\"']",
+    re.S,
+)
+
+
+def registered_metric_names() -> set[str]:
+    """Every kubeai_* metric the codebase can register: the two live
+    instrument bundles (instantiated, so computed names are real) plus a
+    static scan for instruments declared outside any bundle (e.g. the
+    whisper transcription server's per-instance counters)."""
+    sys.path.insert(0, REPO_ROOT)
+    from kubeai_tpu.engine.server import EngineMetrics
+    from kubeai_tpu.metrics.registry import Metrics
+
+    names: set[str] = set()
+    for reg in (Metrics().registry, EngineMetrics().registry):
+        for m in reg.metrics:
+            names.add(m.name)
+    pkg = os.path.join(REPO_ROOT, "kubeai_tpu")
+    for root, _dirs, files in os.walk(pkg):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(root, fname)) as f:
+                names.update(_DECL_RE.findall(f.read()))
+    return names
+
+
+def documented_metric_names(doc_path: str = DOC_PATH):
+    with open(doc_path) as f:
+        text = f.read()
+    exact: set[str] = set()
+    wildcards: set[str] = set()
+    for name in _NAME_RE.findall(text):
+        if name in _NOT_METRICS:
+            continue
+        if name.endswith("*"):
+            wildcards.add(name.rstrip("*"))
+        else:
+            exact.add(name)
+    return exact, wildcards
+
+
+def _base_name(doc_name: str) -> str:
+    """Map a documented derived-series name (`_bucket`/`_sum`/`_count`)
+    back to its histogram's base name."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if doc_name.endswith(suffix):
+            return doc_name[: -len(suffix)]
+    return doc_name
+
+
+def check(doc_path: str = DOC_PATH) -> list[str]:
+    """Returns human-readable drift violations (empty = catalogue and
+    registries agree)."""
+    registered = registered_metric_names()
+    exact, wildcards = documented_metric_names(doc_path)
+
+    def documented(name: str) -> bool:
+        return name in exact or any(name.startswith(w) for w in wildcards)
+
+    errors: list[str] = []
+    for name in sorted(registered):
+        if not documented(name):
+            errors.append(
+                f"{name}: registered in the codebase but missing from "
+                f"{os.path.relpath(doc_path, REPO_ROOT)}"
+            )
+    derivable = registered | {
+        f"{n}{s}" for n in registered for s in ("_bucket", "_sum", "_count")
+    }
+    for name in sorted(exact):
+        if name not in derivable and _base_name(name) not in registered:
+            errors.append(
+                f"{name}: documented in the catalogue but no such metric "
+                "is registered anymore"
+            )
+    for prefix in sorted(wildcards):
+        if not any(n.startswith(prefix) for n in registered):
+            errors.append(
+                f"{prefix}*: wildcard documented but no registered "
+                "metric matches it"
+            )
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    if errors:
+        print("metric catalogue drift detected:")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print(
+        f"metric catalogue in sync "
+        f"({len(registered_metric_names())} registered metrics)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,6 +64,12 @@ def pytest_configure(config):
         "self-healing pod repair, engine step watchdog (runs in the "
         "fast tier; select with -m chaos)",
     )
+    config.addinivalue_line(
+        "markers",
+        "telemetry: fleet telemetry plane suite — state aggregator, "
+        "tenant usage metering, step profiler, fake-clock fleet sim "
+        "(runs in the fast tier; select with -m telemetry)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

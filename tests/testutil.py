@@ -73,6 +73,63 @@ class FakeEngine:
         self.httpd.server_close()
 
 
+class FakeTelemetryEngine:
+    """Scripted serving-endpoint telemetry: GET /metrics serves Prom
+    text, GET /v1/state serves a JSON snapshot — what the fleet
+    aggregator sweeps. `metrics_text`/`state` are mutable; set `dead`
+    to make every request drop the connection (a dead endpoint the
+    aggregator must flag stale, not merge)."""
+
+    def __init__(self, metrics_text: str = "", state: dict | None = None):
+        srv = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if srv.dead:
+                    self.connection.close()
+                    return
+                if self.path == "/metrics":
+                    body = srv.metrics_text.encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path == "/v1/state":
+                    body = json.dumps(srv.state).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.metrics_text = metrics_text
+        self.state = state or {}
+        self.dead = False
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def port(self):
+        return self.httpd.server_address[1]
+
+    @property
+    def addr(self):
+        h, p = self.httpd.server_address[:2]
+        return f"{h}:{p}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
 class FakeMetricsServer:
     """Static Prom-text server (reference: hack/vllm-mock-metrics/main.go)."""
 

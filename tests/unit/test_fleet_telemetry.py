@@ -1,0 +1,597 @@
+"""Fleet telemetry plane: state aggregator (snapshots, staleness,
+/v1/fleet/*), per-tenant usage metering (/v1/usage, kubeai_tenant_*),
+and the engine step profiler (/v1/profile, per-phase histograms) —
+deterministic sim invariants plus real-HTTP acceptance."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from testutil import (
+    FakeEngine,
+    FakeTelemetryEngine,
+    eventually,
+    http_get,
+    http_post,
+    ready_pod_manifest,
+)
+
+from kubeai_tpu.fleet import (
+    FleetStateAggregator,
+    StepProfiler,
+    UsageMeter,
+    hist_quantiles,
+    phase_totals,
+    tenant_of,
+)
+from kubeai_tpu.metrics.registry import Metrics, parse_prometheus_text
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.loadbalancer import LoadBalancer
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.routing.openai_server import OpenAIServer
+from kubeai_tpu.routing.proxy import ModelProxy
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+)
+
+pytestmark = pytest.mark.telemetry
+
+
+# ---- deterministic fleet sim (benchmarks/fleet_telemetry_sim.py) -------------
+
+
+def test_fleet_sim_invariants():
+    """Tier-1 contract: snapshot coverage/convergence, staleness flagged
+    not merged, exact tenant token accounting, and aggregator-fed ==
+    direct-scrape autoscaler decisions."""
+    from benchmarks.fleet_telemetry_sim import ALL_CHECKS, run_sim
+
+    result = run_sim()
+    for check in ALL_CHECKS:
+        check(result)
+
+
+# ---- tenant attribution + usage meter ----------------------------------------
+
+
+def test_tenant_of_resolution():
+    assert tenant_of({"x-client-id": "acme"}) == "acme"
+    # API-key principal: stable digest, never the raw key.
+    t1 = tenant_of({"authorization": "Bearer sk-secret-123"})
+    t2 = tenant_of({"authorization": "Bearer sk-secret-123"})
+    assert t1 == t2 and t1.startswith("key-")
+    assert "sk-secret-123" not in t1
+    assert tenant_of({}) == "anonymous"
+    assert tenant_of({"authorization": "Basic abc"}) == "anonymous"
+    # Explicit client id wins over the auth principal.
+    assert tenant_of(
+        {"x-client-id": "acme", "authorization": "Bearer k"}
+    ) == "acme"
+
+
+def test_usage_meter_ledger_and_counters():
+    metrics = Metrics()
+    meter = UsageMeter(metrics=metrics)
+    meter.record("acme", "m1", prompt_tokens=100, completion_tokens=20,
+                 stream_seconds=1.5)
+    meter.record("acme", "m1", prompt_tokens=1, completion_tokens=2,
+                 shed=True)
+    meter.record("globex", "m2", prompt_tokens=7)
+    s = meter.summary()
+    acme = s["tenants"]["acme"]["models"]["m1"]
+    assert acme == {
+        "requests": 2, "prompt_tokens": 101, "completion_tokens": 22,
+        "stream_seconds": 1.5, "shed": 1,
+    }
+    assert s["totals"]["prompt_tokens"] == 108
+    # Tenant filter.
+    only = meter.summary("globex")
+    assert list(only["tenants"]) == ["globex"]
+    assert only["totals"]["prompt_tokens"] == 7
+    # Counter mirror rides /metrics with tenant+model labels.
+    parsed = parse_prometheus_text(metrics.registry.expose())
+    assert parsed[(
+        "kubeai_tenant_prompt_tokens_total",
+        (("model", "m1"), ("tenant", "acme")),
+    )] == 101
+    assert parsed[(
+        "kubeai_tenant_shed_total",
+        (("model", "m1"), ("tenant", "acme")),
+    )] == 1
+
+
+def test_usage_meter_record_response_parses_openai_usage():
+    meter = UsageMeter(metrics=Metrics())
+    meter.record_response(
+        "t1", "m1", 200,
+        usage={"prompt_tokens": 9, "completion_tokens": 4,
+               "total_tokens": 13},
+    )
+    meter.record_response("t1", "m1", 429)  # shed, no usage block
+    got = meter.summary()["tenants"]["t1"]["models"]["m1"]
+    assert got["prompt_tokens"] == 9 and got["completion_tokens"] == 4
+    assert got["shed"] == 1 and got["requests"] == 2
+
+
+# ---- step profiler (unit) -----------------------------------------------------
+
+
+def test_step_profiler_ring_drain_and_wait():
+    prof = StepProfiler(maxlen=4, wall=lambda: 123.0)
+    for i in range(6):
+        prof.observe_step(
+            {"decode": 0.01 * (i + 1), "sample": 0.001},
+            tokens=i, batch=2, duration_s=0.02,
+        )
+    prof.observe("kv_transfer", 0.5)
+    recent = prof.recent()
+    assert len(recent) == 4  # bounded ring
+    assert [r["step"] for r in recent] == [3, 4, 5, 6]
+    assert recent[-1]["phases_s"]["decode"] == pytest.approx(0.06)
+    # drain() hands every queued (phase, seconds) pair exactly once.
+    drained = prof.drain()
+    assert ("kv_transfer", 0.5) in drained
+    assert len([p for p, _ in drained if p == "decode"]) == 6
+    assert prof.drain() == []
+    # wait_for_steps returns promptly once enough NEW steps complete.
+    assert prof.wait_for_steps(1, timeout_s=0.01) == 0  # nothing new
+    totals = phase_totals(recent)
+    assert totals["decode"] == pytest.approx(0.03 + 0.04 + 0.05 + 0.06)
+
+
+def test_hist_quantiles_from_buckets():
+    text = (
+        'h_bucket{le="0.1"} 50\n'
+        'h_bucket{le="1"} 90\n'
+        'h_bucket{le="+Inf"} 100\n'
+        "h_sum 42.0\n"
+        "h_count 100\n"
+    )
+    q = hist_quantiles(parse_prometheus_text(text), "h")
+    assert q["count"] == 100 and q["mean_s"] == pytest.approx(0.42)
+    assert q["p50_s"] == 0.1
+    assert q["p95_s"] == 1.0
+    # p99 lands past the largest finite bucket → largest finite bound.
+    assert q["p99_s"] == 1.0
+    assert hist_quantiles({}, "h") == {}
+
+
+# ---- real-HTTP acceptance: /v1/fleet/state + /v1/usage ------------------------
+
+
+def _exposition(depth=2.0, oldest=0.5, kv=0.4, slots=3.0, cap=8.0):
+    return (
+        f'kubeai_engine_queue_depth{{class="standard"}} {depth}\n'
+        f'kubeai_engine_queue_oldest_wait_seconds{{class="standard"}} '
+        f"{oldest}\n"
+        f"kubeai_engine_kv_cache_utilization {kv}\n"
+        f"kubeai_engine_slots_active {slots}\n"
+        f"kubeai_engine_slot_capacity {cap}\n"
+        "kubeai_engine_ttft_seconds_sum 5.0\n"
+        "kubeai_engine_ttft_seconds_count 10\n"
+        'kubeai_engine_ttft_seconds_bucket{le="0.5"} 8\n'
+        'kubeai_engine_ttft_seconds_bucket{le="+Inf"} 10\n'
+    )
+
+
+@pytest.fixture
+def fleet_world():
+    """Front door + aggregator over two models: m1 (two unified
+    endpoints, one of which is DEAD) and m2 (disaggregated prefill +
+    decode endpoints), pods carrying google.com/tpu chip requests."""
+    from benchmarks.fleet_telemetry_sim import _pod
+    from kubeai_tpu.crd.model import LoadBalancing, Model, ModelSpec
+
+    store = KubeStore()
+    lb = LoadBalancer(store, default_timeout=5)
+    mc = ModelClient(store)
+    metrics = Metrics()
+    usage = UsageMeter(metrics=metrics)
+    engines = []
+
+    def spec(**kw):
+        return ModelSpec(
+            url="hf://org/x", engine="KubeAITPU",
+            features=["TextGeneration"], autoscaling_disabled=True,
+            replicas=1, load_balancing=LoadBalancing(), **kw,
+        )
+
+    store.create(Model(name="m1", spec=spec()).to_dict())
+    store.create(Model(name="m2", spec=spec()).to_dict())
+
+    live = FakeTelemetryEngine(
+        _exposition(depth=3.0), {"healthy": True, "draining": False}
+    )
+    engines.append(live)
+    store.create(_pod("m1", 0, live.addr, chips=4))
+    # Dead endpoint: a real port with nothing listening.
+    dead = FakeTelemetryEngine(_exposition())
+    dead_addr = dead.addr
+    dead.stop()
+    store.create(_pod("m1", 1, dead_addr, chips=4))
+    for j, role in ((0, "prefill"), (1, "decode")):
+        eng = FakeTelemetryEngine(
+            _exposition(depth=5.0 if role == "prefill" else 0.0,
+                        kv=0.7 if role == "decode" else 0.0),
+            {"healthy": True, "role": role},
+        )
+        engines.append(eng)
+        store.create(_pod("m2", j, eng.addr, role=role, chips=8))
+    lb.sync_all()
+
+    fleet = FleetStateAggregator(
+        lb=lb, model_client=mc, store=store, metrics=metrics,
+        usage=usage, interval_s=5.0, scrape_timeout_s=2.0,
+    )
+    server = OpenAIServer(
+        ModelProxy(lb, mc, metrics=metrics), mc,
+        metrics=metrics, fleet=fleet, usage=usage,
+    )
+    server.start()
+    yield server, fleet, usage, metrics, dead_addr, store
+    server.stop()
+    lb.stop()
+    for e in engines:
+        e.stop()
+
+
+def test_fleet_state_endpoint_real_http(fleet_world):
+    """Acceptance: GET /v1/fleet/state covers every live endpoint of
+    two models with per-role signals, chip inventory, and per-tenant
+    usage; the dead endpoint is flagged stale, not merged."""
+    server, fleet, usage, metrics, dead_addr, _store = fleet_world
+    usage.record("acme", "m1", prompt_tokens=11, completion_tokens=3)
+    status, body = http_get(
+        f"127.0.0.1:{server.port}", "/v1/fleet/state", timeout=30
+    )
+    assert status == 200
+    snap = json.loads(body)
+    assert set(snap["models"]) == {"m1", "m2"}
+    m1 = snap["models"]["m1"]
+    live = [a for a, e in m1["endpoints"].items() if not e["stale"]]
+    assert len(live) == 1
+    assert live[0] != dead_addr
+    assert m1["endpoints"][live[0]]["queue_depth"] == 3.0
+    assert m1["endpoints"][live[0]]["healthy"] is True
+    # The dead endpoint appears, flagged, with its error — and the
+    # aggregate excludes it.
+    assert m1["endpoints"][dead_addr]["stale"] is True
+    assert m1["endpoints"][dead_addr]["error"]
+    assert dead_addr in m1["stale_endpoints"]
+    assert m1["queue"]["depth"] == 3.0
+    # Per-role signals on the disaggregated model.
+    m2 = snap["models"]["m2"]
+    assert m2["replicas"] == {"prefill": 1, "decode": 1}
+    assert m2["roles"]["prefill"]["depth"] == 5.0
+    assert m2["roles"]["decode"]["kv_utilization"] == pytest.approx(0.7)
+    # TTFT quantiles extracted from histogram buckets.
+    live_ep = m1["endpoints"][live[0]]
+    assert live_ep["ttft"]["p50_s"] == 0.5
+    # Chip inventory from pod google.com/tpu requests.
+    assert snap["chips"]["total"] == 4 + 4 + 8 + 8
+    # Per-tenant usage rides the snapshot.
+    assert snap["tenants"]["tenants"]["acme"]["models"]["m1"][
+        "prompt_tokens"
+    ] == 11
+    # Fleet gauges exported with the same facts.
+    parsed = parse_prometheus_text(metrics.registry.expose())
+    assert parsed[(
+        "kubeai_fleet_stale_endpoints", (("model", "m1"),)
+    )] == 1
+    assert parsed[("kubeai_fleet_endpoints",
+                   (("model", "m2"), ("role", "prefill")))] == 1
+
+
+def test_fleet_history_ring(fleet_world):
+    server, fleet, *_ = fleet_world
+    fleet.collect()
+    fleet.collect()
+    status, body = http_get(
+        f"127.0.0.1:{server.port}", "/v1/fleet/history", timeout=30
+    )
+    assert status == 200
+    hist = json.loads(body)
+    assert len(hist["snapshots"]) == 2
+    assert hist["snapshots"][0]["ts"] <= hist["snapshots"][1]["ts"]
+
+
+def test_front_door_attributes_unary_usage(fleet_world):
+    """The front door parses unary responses' usage blocks and
+    attributes them to the X-Client-Id tenant; /v1/usage serves the
+    ledger."""
+    from kubeai_tpu.crd.model import LoadBalancing, Model, ModelSpec
+
+    server, _fleet, usage, metrics, _dead, store = fleet_world
+    eng = FakeEngine(behavior=lambda path, body: (200, {
+        "object": "chat.completion", "model": "m3",
+        "usage": {"prompt_tokens": 21, "completion_tokens": 8,
+                  "total_tokens": 29},
+    }))
+    try:
+        # A dedicated model backed by a generate-capable engine (m1's
+        # endpoints only serve telemetry).
+        store.create(Model(
+            name="m3",
+            spec=ModelSpec(
+                url="hf://org/x", engine="KubeAITPU",
+                features=["TextGeneration"], autoscaling_disabled=True,
+                replicas=1, load_balancing=LoadBalancing(),
+            ),
+        ).to_dict())
+        store.create(ready_pod_manifest("m3", 0, eng.port))
+        server.proxy.lb.sync_model("m3")
+        status, _ = http_post(
+            f"127.0.0.1:{server.port}",
+            "/openai/v1/completions",
+            {"model": "m3", "prompt": "hi"},
+            headers={"X-Client-Id": "tenant-a"},
+        )
+        assert status == 200
+        eventually(
+            lambda: usage.summary("tenant-a")["totals"]["requests"] == 1,
+            msg="usage recorded",
+        )
+        got = usage.summary("tenant-a")["tenants"]["tenant-a"]["models"][
+            "m3"
+        ]
+        assert got["prompt_tokens"] == 21
+        assert got["completion_tokens"] == 8
+        status, body = http_get(
+            f"127.0.0.1:{server.port}", "/v1/usage?tenant=tenant-a"
+        )
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["totals"]["prompt_tokens"] == 21
+        # And the tenant counters ride /metrics.
+        parsed = parse_prometheus_text(metrics.registry.expose())
+        assert parsed[(
+            "kubeai_tenant_requests_total",
+            (("model", "m3"), ("tenant", "tenant-a")),
+        )] == 1
+    finally:
+        eng.stop()
+
+
+def test_fleet_endpoints_404_when_unconfigured():
+    store = KubeStore()
+    lb = LoadBalancer(store, default_timeout=1)
+    mc = ModelClient(store)
+    server = OpenAIServer(ModelProxy(lb, mc, metrics=Metrics()), mc,
+                          metrics=Metrics())
+    server.start()
+    try:
+        assert http_get(
+            f"127.0.0.1:{server.port}", "/v1/fleet/state"
+        )[0] == 404
+        assert http_get(
+            f"127.0.0.1:{server.port}", "/v1/usage"
+        )[0] == 404
+    finally:
+        server.stop()
+        lb.stop()
+
+
+# ---- aggregator consumer API: freshness + fallback ----------------------------
+
+
+def test_aggregator_freshness_gates_consumer_reads():
+    """A stale snapshot answers None (the autoscaler then falls back to
+    its direct scrape); a fresh one answers the same shape the direct
+    scraper returns."""
+    from benchmarks.fleet_telemetry_sim import FleetWorld
+
+    world = FleetWorld()
+    agg = FleetStateAggregator(
+        lb=world.lb, model_client=world.mc, store=world.store,
+        metrics=world.metrics, interval_s=1.0, staleness_s=2.0,
+        fetch_metrics=world.fetch_metrics,
+        fetch_state=world.fetch_state, clock=world.clock,
+    )
+    assert agg.queue_pressure("m0") is None  # no snapshot yet
+    world.advance()
+    agg.collect()
+    q = agg.queue_pressure("m0")
+    assert q is not None and set(q) == {
+        "depth", "oldest_wait_s", "per_class"
+    }
+    sig = agg.role_signals("m-disagg", "prefill")
+    assert sig is not None and sig["endpoints"] == 2
+    world.clock.advance(5.0)  # past staleness bound
+    assert agg.queue_pressure("m0") is None
+    assert agg.role_signals("m-disagg", "prefill") is None
+
+
+# ---- real engine: step profiler over HTTP -------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_engine_server():
+    import jax
+
+    from kubeai_tpu.engine import Engine, EngineConfig
+    from kubeai_tpu.engine.server import EngineServer
+    from kubeai_tpu.engine.tokenizer import ByteTokenizer
+    from kubeai_tpu.models import llama
+
+    tok = ByteTokenizer()
+    cfg = llama.LlamaConfig.tiny(vocab_size=tok.vocab_size)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    engine = Engine(
+        "llama", cfg, params,
+        cfg=EngineConfig(num_slots=4, max_seq_len=128, decode_chunk=4),
+        eos_token_ids=tok.eos_token_ids,
+    )
+    srv = EngineServer(engine, tok, "tiny-llama", host="127.0.0.1", port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_profile_endpoint_reports_real_multistep_phases(
+    tiny_engine_server,
+):
+    """Acceptance: a real multi-step run (CPU backend) yields a
+    per-phase timeline via POST /v1/profile, and the per-phase
+    histograms land on /metrics."""
+    addr = f"127.0.0.1:{tiny_engine_server.port}"
+    status, _body = http_post(
+        addr, "/v1/completions",
+        {"model": "tiny-llama", "prompt": "hello", "max_tokens": 8,
+         "temperature": 0},
+        timeout=120,
+    )
+    assert status == 200
+    status, body = http_post(addr, "/v1/profile", {"steps": 32})
+    assert status == 200
+    prof = json.loads(body)
+    assert prof["object"] == "engine.profile"
+    # 8 tokens at decode_chunk=4 → at least 2 decode steps recorded.
+    assert prof["steps_completed_total"] >= 2
+    steps = prof["steps"]
+    assert len(steps) >= 2
+    decode_steps = [s for s in steps if "decode" in s["phases_s"]]
+    assert decode_steps, "no step recorded a decode phase"
+    for s in decode_steps:
+        for phase, seconds in s["phases_s"].items():
+            assert seconds >= 0.0
+        assert s["ts"] > 0 and s["duration_s"] >= 0
+    # The admission step carries the prefill phase.
+    assert any(
+        s["phases_s"].get("prefill", 0) > 0 for s in steps
+    ), "no step recorded prefill time"
+    # host_sync (the device_get wait) must appear — that's where device
+    # time surfaces on the host timeline.
+    assert any("host_sync" in s["phases_s"] for s in steps)
+    assert prof["phase_totals_s"].get("decode", 0) > 0
+    assert prof["jax_trace_dir"] is None
+    # Per-phase histograms on /metrics with observations.
+    status, body = http_get(addr, "/metrics")
+    assert status == 200
+    parsed = parse_prometheus_text(body.decode())
+    decode_count = parsed.get(
+        ("kubeai_engine_step_phase_seconds_count", (("phase", "decode"),))
+    )
+    assert decode_count and decode_count >= 2
+    assert parsed.get(
+        ("kubeai_engine_step_phase_seconds_count",
+         (("phase", "prefill"),))
+    )
+
+
+def test_profile_fresh_capture_waits_for_new_steps(tiny_engine_server):
+    """fresh=true answers only after NEW steps complete — issue a
+    concurrent generation and profile its window."""
+    import threading
+
+    addr = f"127.0.0.1:{tiny_engine_server.port}"
+    results = {}
+
+    def generate():
+        results["gen"] = http_post(
+            addr, "/v1/completions",
+            {"model": "tiny-llama", "prompt": "stream me",
+             "max_tokens": 12, "temperature": 0},
+            timeout=120,
+        )
+
+    t = threading.Thread(target=generate)
+    t.start()
+    status, body = http_post(
+        addr, "/v1/profile",
+        {"steps": 2, "fresh": True, "timeout_s": 60},
+        timeout=120,
+    )
+    t.join(timeout=120)
+    assert status == 200
+    prof = json.loads(body)
+    assert prof["steps_captured"] >= 2
+    assert results["gen"][0] == 200
+
+
+def test_profile_validates_input(tiny_engine_server):
+    addr = f"127.0.0.1:{tiny_engine_server.port}"
+    assert http_post(addr, "/v1/profile", {"steps": 0})[0] == 400
+    assert http_post(addr, "/v1/profile", {"steps": "ten"})[0] == 400
+    assert http_post(
+        addr, "/v1/profile", {"timeout_s": 600}
+    )[0] == 400
+
+
+def test_front_door_sse_metering_counts_stream_tokens(
+    tiny_engine_server,
+):
+    """Full stack: front door → proxy → REAL engine SSE stream. The
+    meter counts completion tokens off the stream's token_ids chunks
+    and records stream seconds."""
+    from kubeai_tpu.crd.model import LoadBalancing, Model, ModelSpec
+
+    store = KubeStore()
+    lb = LoadBalancer(store, default_timeout=10)
+    mc = ModelClient(store)
+    metrics = Metrics()
+    usage = UsageMeter(metrics=metrics)
+    server = OpenAIServer(
+        ModelProxy(lb, mc, metrics=metrics), mc,
+        metrics=metrics, usage=usage,
+    )
+    server.start()
+    try:
+        store.create(Model(
+            name="tiny-llama",
+            spec=ModelSpec(
+                url="hf://org/x", engine="KubeAITPU",
+                features=["TextGeneration"], autoscaling_disabled=True,
+                replicas=1, load_balancing=LoadBalancing(),
+            ),
+        ).to_dict())
+        store.create(ready_pod_manifest(
+            "tiny-llama", 0, tiny_engine_server.port
+        ))
+        lb.sync_model("tiny-llama")
+        n_tokens = 6
+        status, body = http_post(
+            f"127.0.0.1:{server.port}",
+            "/openai/v1/completions",
+            {"model": "tiny-llama", "prompt": "hi", "stream": True,
+             "max_tokens": n_tokens, "temperature": 0},
+            headers={"X-Client-Id": "streamer"},
+            timeout=120,
+        )
+        assert status == 200
+        assert b"[DONE]" in body
+        eventually(
+            lambda: usage.summary("streamer")["totals"]["requests"] == 1,
+            msg="stream metered",
+        )
+        got = usage.summary("streamer")["tenants"]["streamer"]["models"][
+            "tiny-llama"
+        ]
+        assert got["completion_tokens"] == n_tokens
+        assert got["stream_seconds"] > 0
+    finally:
+        server.stop()
+        lb.stop()
+
+
+# ---- manager wiring -----------------------------------------------------------
+
+
+def test_manager_wires_fleet_plane():
+    from kubeai_tpu.config import System
+    from kubeai_tpu.operator.manager import Manager
+
+    cfg = System()
+    cfg.fixed_self_metric_addrs = ["127.0.0.1:1"]
+    mgr = Manager(store=KubeStore(), cfg=cfg)
+    assert mgr.autoscaler.fleet is mgr.fleet
+    assert mgr.api_server.fleet is mgr.fleet
+    assert mgr.api_server.usage is mgr.usage
+    assert mgr.fleet.usage is mgr.usage
+    for messenger in mgr.messengers:
+        assert messenger.usage is mgr.usage

