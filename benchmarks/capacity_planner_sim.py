@@ -1,0 +1,651 @@
+"""Deterministic capacity-planner simulation — no JAX, no sockets.
+
+Builds a synthetic fleet on a fake clock — five models across the three
+scheduling classes (a realtime model under growing SLO pressure, a
+standard model, a batch model holding chips, a disaggregated
+prefill/decode model, and a 1-chip "tiny" model) — over ONE
+heterogeneous chip pool (1-, 4-, and 8-chip slice shapes from Node
+allocatable capacity), and drives the REAL FleetStateAggregator,
+CapacityPlanner, and Autoscaler over scripted Prometheus exposition.
+
+Two scenarios share the model set:
+
+  * ABUNDANT — the chip budget exceeds every desire: the plan must be a
+    no-op (allocations equal the uncoordinated autoscaler's desires,
+    nothing preempted or throttled) and the autoscaler must actually
+    scale through the plan (`scaling_source: "planner"`).
+  * CONSTRAINED — the budget cannot fit the sum of desires: batch-class
+    replicas must be preempted (and their pods annotation-marked for
+    pod_plan's deletion ordering) before the realtime model is ever
+    throttled, replicas must be right-sized onto the cheapest feasible
+    slice shape, the disagg pair must shrink jointly, and total
+    allocated chips must never exceed the inventory.
+
+Invariants (asserted in tier-1 by tests/unit/test_capacity_planner.py):
+
+  (a) no realtime-class SLO violation persists while idle chips exist
+      that could host a feasible replica;
+  (b) batch-class models are preempted before realtime-class models are
+      ever throttled;
+  (c) total allocated chips never exceed the inventory (per shape too);
+  (d) with an abundant chip budget the planner's allocations equal the
+      uncoordinated autoscaler's desires (no-op equivalence);
+  plus: stale-snapshot safety (the autoscaler falls back to its direct
+      per-model path and the plan stops answering), preemption victims
+      marked for pod_plan, and joint prefill/decode damping.
+
+Run directly for a human-readable report:
+
+    python benchmarks/capacity_planner_sim.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeai_tpu.autoscaler import Autoscaler
+from kubeai_tpu.autoscaler.autoscaler import (
+    scrape_queue_pressure,
+    scrape_role_signals,
+)
+from kubeai_tpu.config import System
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import (
+    Disaggregation,
+    Model,
+    ModelSpec,
+    Scheduling,
+)
+from kubeai_tpu.fleet import CapacityPlanner, FleetStateAggregator
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.loadbalancer import LoadBalancer
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.testing.faults import FakeClock
+
+ACCEL = "tpu-v5-lite-podslice"
+SHAPE_1 = f"{ACCEL}/1x1"
+SHAPE_4 = f"{ACCEL}/2x2"
+SHAPE_8 = f"{ACCEL}/2x4"
+
+TICKS = 5
+
+# (shape, chips_per_node, node_count) — the heterogeneous pool.
+CONSTRAINED_NODES = ((SHAPE_1, 1, 4), (SHAPE_4, 4, 4), (SHAPE_8, 8, 2))
+ABUNDANT_NODES = ((SHAPE_1, 1, 8), (SHAPE_4, 4, 20), (SHAPE_8, 8, 6))
+
+
+class Endpoint:
+    """Scripted signals for one serving endpoint, rendered as real
+    Prometheus exposition text (what a production scrape returns)."""
+
+    def __init__(self, model: str, role: str = "unified"):
+        self.model = model
+        self.role = role
+        self.signals = {
+            "depth": 0.0,
+            "oldest_wait_s": 0.0,
+            "kv_utilization": 0.0,
+            "slots_active": 0.0,
+            "slot_capacity": 32.0,
+            "ttft_sum": 0.0,
+            "ttft_count": 0.0,
+            "active": 0.0,
+        }
+
+    def advance(self, tick: int) -> None:
+        s = self.signals
+        if self.model == "rt":
+            # Realtime pressure ramps: the active signal grows and the
+            # oldest queued request ages past the 3s queue-pressure
+            # bound — an SLO violation the planner must relieve.
+            s["active"] = float(min(35, 5 + 10 * tick))
+            s["depth"] = 3.0
+            s["oldest_wait_s"] = 5.0
+        elif self.model == "std":
+            s["active"] = 8.0
+        elif self.model == "batch":
+            s["active"] = 10.0  # per endpoint; demand sustains current
+        elif self.model == "tiny":
+            s["active"] = 5.0
+        elif self.role == "prefill":
+            s["depth"] = 12.0
+            s["oldest_wait_s"] = 5.0
+            s["ttft_sum"] += 0.2
+            s["ttft_count"] += 1.0
+        elif self.role == "decode":
+            s["kv_utilization"] = 0.9
+            s["slots_active"] = 16.0
+
+    def exposition(self) -> str:
+        s = self.signals
+        return "\n".join(
+            [
+                'kubeai_engine_queue_depth{class="standard"} '
+                f"{s['depth']}",
+                "kubeai_engine_queue_oldest_wait_seconds "
+                f"{s['oldest_wait_s']}",
+                f"kubeai_engine_kv_cache_utilization {s['kv_utilization']}",
+                f"kubeai_engine_slots_active {s['slots_active']}",
+                f"kubeai_engine_slot_capacity {s['slot_capacity']}",
+                f"kubeai_engine_ttft_seconds_sum {s['ttft_sum']}",
+                f"kubeai_engine_ttft_seconds_count {s['ttft_count']}",
+                f"kubeai_engine_active_requests {s['active']}",
+            ]
+        ) + "\n"
+
+    def state(self) -> dict:
+        return {"model": self.model, "healthy": True, "role": self.role}
+
+
+def _pod(model: str, idx: int, addr: str, role: str | None = None,
+         chips: int = 4, topology: str = "2x2", created: float = 0.0) -> dict:
+    ip, _, port = addr.partition(":")
+    labels = {"model": model}
+    if role:
+        labels["model-role"] = role
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"model-{model}-{idx}" + (f"-{role}" if role else ""),
+            "namespace": "default",
+            "labels": labels,
+            "annotations": {"model-pod-ip": ip, "model-pod-port": port},
+            "creationTimestamp": created,
+        },
+        "spec": {
+            "nodeSelector": {
+                "cloud.google.com/gke-tpu-accelerator": ACCEL,
+                "cloud.google.com/gke-tpu-topology": topology,
+            },
+            "containers": [{
+                "name": "server",
+                "resources": {"limits": {"google.com/tpu": str(chips)}},
+            }],
+        },
+        "status": {
+            "conditions": [{"type": "Ready", "status": "True"}],
+            "podIP": ip,
+        },
+    }
+
+
+def _node(name: str, shape_topology: str, chips: int) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {
+            "name": name,
+            "labels": {
+                "cloud.google.com/gke-tpu-accelerator": ACCEL,
+                "cloud.google.com/gke-tpu-topology": shape_topology,
+            },
+        },
+        "status": {"allocatable": {"google.com/tpu": str(chips)}},
+    }
+
+
+class PlannerWorld:
+    """One complete in-process fleet: store (+Nodes) + LB + models +
+    scripted endpoints + aggregator (+ optionally the planner)."""
+
+    def __init__(self, nodes=CONSTRAINED_NODES, with_planner: bool = True):
+        self.clock = FakeClock(1000.0)
+        self.store = KubeStore()
+        self.cfg = System()
+        self.cfg.fixed_self_metric_addrs = ["self:1"]
+        # window == interval → the moving average IS the signal; the
+        # scripted ramps translate 1:1 into desires.
+        self.cfg.model_autoscaling.interval_seconds = 10.0
+        self.cfg.model_autoscaling.time_window_seconds = 10.0
+        self.cfg.default_and_validate()
+        self.mc = ModelClient(self.store)
+        self.lb = LoadBalancer(self.store)
+        self.metrics = Metrics()
+        self.endpoints: dict[str, Endpoint] = {}
+        self.tick_no = 0
+
+        for shape, chips, count in nodes:
+            topo = shape.split("/", 1)[1]
+            for i in range(count):
+                self.store.create(
+                    _node(f"node-{topo}-{i}", topo, chips)
+                )
+
+        common = dict(
+            url="hf://org/x", engine="KubeAITPU",
+            features=["TextGeneration"], min_replicas=0, max_replicas=10,
+            target_requests=10, scale_down_delay_seconds=0,
+        )
+
+        def add_model(name, replicas, cls, chips=4, topology="2x2",
+                      **extra):
+            self.store.create(
+                Model(
+                    name=name,
+                    spec=ModelSpec(
+                        **common, replicas=replicas,
+                        scheduling=Scheduling(default_priority=cls),
+                        **extra,
+                    ),
+                ).to_dict()
+            )
+            for j in range(replicas):
+                addr = f"10.{len(self.endpoints)}.0.{j}:8000"
+                self.endpoints[addr] = Endpoint(name)
+                self.store.create(
+                    _pod(name, j, addr, chips=chips, topology=topology,
+                         created=float(j))
+                )
+
+        add_model("rt", 1, "realtime")
+        add_model("std", 1, "standard")
+        add_model("batch", 3, "batch")
+        add_model("tiny", 1, "standard", chips=1, topology="1x1")
+        # Disaggregated standard-class model: one prefill + one decode.
+        self.store.create(
+            Model(
+                name="dis",
+                spec=ModelSpec(
+                    **common, replicas=0,
+                    scheduling=Scheduling(default_priority="standard"),
+                    disaggregation=Disaggregation(
+                        enabled=True, prefill_target_queue=4,
+                        decode_target_utilization=0.8,
+                    ),
+                ),
+            ).to_dict()
+        )
+        for j, role in ((0, "prefill"), (1, "decode")):
+            addr = f"10.9.0.{j}:8000"
+            self.endpoints[addr] = Endpoint("dis", role=role)
+            self.store.create(
+                _pod("dis", j, addr, role=role, created=float(j))
+            )
+        self.lb.sync_all()
+
+        self.aggregator = FleetStateAggregator(
+            lb=self.lb, model_client=self.mc, store=self.store,
+            metrics=self.metrics, interval_s=1.0, staleness_s=2.5,
+            fetch_metrics=self.fetch_metrics, fetch_state=self.fetch_state,
+            clock=self.clock,
+        )
+
+        class AlwaysLeader:
+            is_leader = True
+
+        self.scaler = Autoscaler(
+            self.store, self.cfg, self.mc, self.lb, AlwaysLeader(),
+            metrics=self.metrics,
+        )
+        self.scaler.active_scraper = lambda addrs: self.active_totals()
+        self.scaler.queue_scraper = lambda addrs: scrape_queue_pressure(
+            addrs, fetch=self.fetch_metrics
+        )
+        self.scaler.role_scraper = lambda addrs: scrape_role_signals(
+            addrs, fetch=self.fetch_metrics
+        )
+        self.scaler.fleet = self.aggregator
+
+        self.planner = None
+        if with_planner:
+            self.planner = CapacityPlanner(
+                fleet=self.aggregator, model_client=self.mc,
+                store=self.store, cfg=self.cfg, metrics=self.metrics,
+                interval_s=1.0, staleness_s=2.5, clock=self.clock,
+            )
+            self.planner.avg_lookup = self.scaler.current_average
+            self.scaler.planner = self.planner
+
+    # -- scripted transport ------------------------------------------------
+
+    def fetch_metrics(self, addr: str, timeout: float = 5.0) -> str:
+        return self.endpoints[addr].exposition()
+
+    def fetch_state(self, addr: str, timeout: float = 5.0) -> dict:
+        return self.endpoints[addr].state()
+
+    def active_totals(self) -> dict[str, float]:
+        totals: dict[str, float] = {}
+        for ep in self.endpoints.values():
+            totals[ep.model] = totals.get(ep.model, 0.0) + ep.signals["active"]
+        return totals
+
+    def advance(self) -> None:
+        self.tick_no += 1
+        self.clock.advance(1.0)
+        for ep in self.endpoints.values():
+            ep.advance(self.tick_no)
+
+    def run_tick(self) -> dict | None:
+        """One full control tick: sweep the fleet, scale (consulting the
+        PREVIOUS plan, as in production), then re-plan on the fresh
+        averages. Returns the new plan (None without a planner)."""
+        self.advance()
+        self.aggregator.collect()
+        self.scaler.tick()
+        if self.planner is not None:
+            return self.planner.tick()
+        return None
+
+
+def run_sim(ticks: int = TICKS) -> dict:
+    """Run both scenarios; returns measured facts for the tier-1
+    invariant assertions (and the __main__ report)."""
+    # -- abundant: planner world + an identical uncoordinated world ------
+    abundant = PlannerWorld(nodes=ABUNDANT_NODES, with_planner=True)
+    direct = PlannerWorld(nodes=ABUNDANT_NODES, with_planner=False)
+    abundant_pairs = []  # (plan, direct last_decisions) per tick
+    abundant_decisions = []
+    for _ in range(ticks):
+        plan = abundant.run_tick()
+        direct.run_tick()
+        abundant_pairs.append((plan, list(direct.scaler.last_decisions)))
+        abundant_decisions.append(list(abundant.scaler.last_decisions))
+
+    # -- constrained: same models, small heterogeneous pool --------------
+    con = PlannerWorld(nodes=CONSTRAINED_NODES, with_planner=True)
+    con_plans = []
+    for _ in range(ticks):
+        con_plans.append(con.run_tick())
+
+    batch_pods = con.store.list("Pod", "default", {"model": "batch"})
+    marked = sorted(
+        p["metadata"]["name"] for p in batch_pods
+        if k8sutils.get_annotation(p, md.PLANNER_PREEMPT_ANNOTATION)
+    )
+
+    # -- staleness: freeze the aggregator, age the clock past the bound --
+    con.clock.advance(10.0)
+    stale_plan_result = con.planner.tick()
+    con.advance()  # signals move but nothing re-sweeps the fleet
+    con.scaler.tick()
+    stale_decisions = list(con.scaler.last_decisions)
+    stale_alloc = con.planner.allocation_for("rt")
+
+    return {
+        "ticks": ticks,
+        "abundant_pairs": abundant_pairs,
+        "abundant_decisions": abundant_decisions,
+        "abundant_budget": sum(c * n for _, c, n in ABUNDANT_NODES),
+        "constrained_plans": con_plans,
+        "constrained_budget": sum(c * n for _, c, n in CONSTRAINED_NODES),
+        "batch_marked_pods": marked,
+        "batch_pods": batch_pods,
+        "stale_plan_result": stale_plan_result,
+        "stale_decisions": stale_decisions,
+        "stale_alloc": stale_alloc,
+        "stale_ticks_metric": con.metrics.planner_stale_ticks.get(),
+    }
+
+
+# -- invariant checks (imported by tests/unit/test_capacity_planner.py) -------
+
+
+def _feasible_free_chips(plan: dict, cpr: int) -> int:
+    """Free chips on shapes that could actually host a cpr-chip replica."""
+    slice_chips = plan["budget"]["slice_chips"]
+    return sum(
+        free for shape, free in plan["free_chips"]["by_shape"].items()
+        if slice_chips.get(shape, 0) >= cpr
+    )
+
+
+def check_no_realtime_starvation(result: dict) -> None:
+    """(a) A realtime model is only ever throttled when no idle chips
+    could host one of its replicas — and in this scenario the budget
+    always can, so its SLO pressure is fully relieved."""
+    saw_pressure = False
+    for plan in result["constrained_plans"]:
+        if plan is None:
+            continue
+        for name, rec in plan["models"].items():
+            if rec["kind"] == "fixed" or rec["class"] != "realtime":
+                continue
+            saw_pressure = saw_pressure or rec["slo_pressure"]
+            if rec["throttled_replicas"] > 0:
+                assert _feasible_free_chips(
+                    plan, rec["chips_per_replica"]
+                ) < rec["chips_per_replica"], (
+                    f"{name} throttled while feasible chips sit idle"
+                )
+    final = result["constrained_plans"][-1]
+    rt = final["models"]["rt"]
+    assert saw_pressure, "scenario must exercise realtime SLO pressure"
+    assert rt["allocated_replicas"] == rt["target_replicas"] > 1, (
+        "realtime demand must be fully allocated under contention"
+    )
+
+
+def check_batch_preempted_first(result: dict) -> None:
+    """(b) Whenever any realtime model is throttled, every batch model
+    is already down to its floor; and the scenario actually preempts."""
+    preempted = False
+    for plan in result["constrained_plans"]:
+        if plan is None:
+            continue
+        rt_throttled = any(
+            rec["throttled_replicas"] > 0
+            for rec in plan["models"].values()
+            if rec["kind"] != "fixed" and rec["class"] == "realtime"
+        )
+        for name, rec in plan["models"].items():
+            if rec["kind"] == "fixed" or rec["class"] != "batch":
+                continue
+            if rec["preempted_replicas"] > 0:
+                preempted = True
+            if rt_throttled:
+                assert rec["allocated_replicas"] <= rec.get("floor", 0), (
+                    f"{name} holds chips while realtime is throttled"
+                )
+        # Stronger: batch holds NOTHING while higher classes are
+        # throttled at all.
+        any_higher_throttled = any(
+            rec["throttled_replicas"] > 0
+            for rec in plan["models"].values()
+            if rec["kind"] != "fixed"
+            and rec["class"] in ("realtime", "standard")
+        )
+        if any_higher_throttled:
+            for rec in plan["models"].values():
+                if rec["kind"] != "fixed" and rec["class"] == "batch":
+                    assert rec["allocated_replicas"] == 0
+    final = result["constrained_plans"][-1]
+    assert preempted, "scenario must actually preempt batch replicas"
+    assert final["models"]["batch"]["preempted_replicas"] > 0
+    rt = final["models"]["rt"]
+    assert rt["allocated_replicas"] == rt["target_replicas"], (
+        "preempted chips must reach the realtime model"
+    )
+
+
+def check_chip_budget_respected(result: dict) -> None:
+    """(c) Total allocated chips never exceed the inventory — in both
+    scenarios, per shape too."""
+    for plans in (result["constrained_plans"],
+                  [p for p, _ in result["abundant_pairs"]]):
+        for plan in plans:
+            if plan is None:
+                continue
+            assert (
+                plan["allocated_chips"]["total"] <= plan["budget"]["total"]
+            )
+            for shape, used in plan["allocated_chips"]["by_shape"].items():
+                assert used <= plan["budget"]["by_shape"][shape], shape
+                assert plan["free_chips"]["by_shape"][shape] >= 0, shape
+
+
+def check_noop_equivalence(result: dict) -> None:
+    """(d) Abundant budget: the plan allocates exactly what the
+    uncoordinated autoscaler desires — nothing throttled, nothing
+    preempted — and the autoscaler really scales through the plan."""
+    for tick, (plan, direct_decisions) in enumerate(
+        result["abundant_pairs"]
+    ):
+        assert plan is not None, f"tick {tick}: no plan"
+        by_model = {d["model"]: d for d in direct_decisions}
+        for name, rec in plan["models"].items():
+            if rec["kind"] == "fixed":
+                continue
+            assert rec["throttled_replicas"] == 0, (name, tick)
+            assert rec["preempted_replicas"] == 0, (name, tick)
+            d = by_model[name]
+            if rec["kind"] == "disagg":
+                for role in ("prefill", "decode"):
+                    want = d["roles"][role]["computed_replicas"]
+                    got = rec["allocated_roles"][role]
+                    assert got == max(1, want), (
+                        f"tick {tick}: {name}/{role} plan {got} != "
+                        f"direct desire {want}"
+                    )
+            else:
+                want = d["computed_replicas"]
+                got = rec["allocated_replicas"]
+                assert got == want, (
+                    f"tick {tick}: {name} plan {got} != direct desire "
+                    f"{want}"
+                )
+    # From the second tick on a fresh plan exists, so the autoscaler
+    # must be applying it (planner as the scaling source).
+    for decisions in result["abundant_decisions"][1:]:
+        for d in decisions:
+            assert d["scaling_source"] == "planner", d["model"]
+            assert d["telemetry_source"] is not None
+
+
+def check_right_sizing(result: dict) -> None:
+    """Replicas land on the cheapest slice shape that can host them:
+    the 1-chip model on the 1-chip shape (even with big slices free in
+    the abundant world), 4-chip replicas never on the 1-chip shape."""
+    for plans in ([p for p, _ in result["abundant_pairs"]],
+                  result["constrained_plans"]):
+        final = plans[-1]
+        tiny = final["models"]["tiny"]
+        assert set(tiny["shapes"]) == {SHAPE_1}, tiny["shapes"]
+        for name, rec in final["models"].items():
+            if rec["chips_per_replica"] > 1:
+                assert SHAPE_1 not in rec["shapes"], (name, rec["shapes"])
+    # Under contention the cheap 4-chip pool fills before the 8-chip
+    # pool and infeasible 1-chip slices stay idle.
+    final = result["constrained_plans"][-1]
+    assert final["free_chips"]["by_shape"][SHAPE_4] == 0
+    assert final["free_chips"]["by_shape"][SHAPE_1] > 0
+
+
+def check_joint_disagg_damping(result: dict) -> None:
+    """Under chip pressure the disagg pair shrinks jointly: both roles
+    stay above their floors and share the shortfall instead of one role
+    being chopped to make room for the other."""
+    final = result["constrained_plans"][-1]
+    dis = final["models"]["dis"]
+    assert dis["kind"] == "disagg"
+    pre, dec = dis["allocated_roles"]["prefill"], dis["allocated_roles"]["decode"]
+    tp, td = dis["target_roles"]["prefill"], dis["target_roles"]["decode"]
+    assert dis["throttled_replicas"] > 0, "scenario must squeeze disagg"
+    assert pre >= 1 and dec >= 1, "both roles must keep their floor"
+    assert pre < tp and dec < td, (
+        "the shortfall must be shared across roles, not dumped on one"
+    )
+    # Fill fractions within one grant of each other (ratio damping).
+    assert abs(pre / tp - dec / td) <= max(1 / tp, 1 / td) + 1e-9
+
+
+def check_preemption_marks(result: dict) -> None:
+    """Preemption picks are written onto pods for pod_plan: every
+    deleted-beyond-allocation batch pod carries the annotation, and the
+    deletion ordering puts marked pods first."""
+    from kubeai_tpu.operator.pod_plan import sort_pods_by_deletion_order
+
+    final = result["constrained_plans"][-1]
+    batch = final["models"]["batch"]
+    n_del = batch["current_replicas"] - batch["allocated_replicas"]
+    assert len(result["batch_marked_pods"]) == n_del > 0
+    pods = [dict(p) for p in result["batch_pods"]]
+    ordered = sort_pods_by_deletion_order(pods, "whatever")
+    first = {
+        p["metadata"]["name"] for p in ordered[:len(result["batch_marked_pods"])]
+    }
+    assert first == set(result["batch_marked_pods"]), (
+        "marked victims must sort to the front of the deletion order"
+    )
+
+
+def check_stale_snapshot_fallback(result: dict) -> None:
+    """Planner staleness safety: a stale fleet snapshot stops the plan
+    (stale-tick counter moves, allocation_for answers None) and the
+    autoscaler falls back to its direct per-model path."""
+    assert result["stale_plan_result"] is None
+    assert result["stale_ticks_metric"] >= 1
+    assert result["stale_alloc"] is None
+    assert result["stale_decisions"], "stale tick must still decide"
+    for d in result["stale_decisions"]:
+        assert d["scaling_source"] == "direct", d["model"]
+        # Aggregator stale → the telemetry came from a direct scrape.
+        src = d["telemetry_source"]
+        if isinstance(src, dict):
+            assert set(src.values()) == {"scrape"}, src
+        else:
+            assert src == "scrape", src
+
+
+def check_decision_records(result: dict) -> None:
+    """Plan decision records mirror Autoscaler.last_decisions: one per
+    model with ts + telemetry source + the allocation arithmetic."""
+    final = result["constrained_plans"][-1]
+    for name, rec in final["models"].items():
+        assert rec["model"] == name
+        assert rec["telemetry_source"] == "aggregator"
+        assert "ts" in rec and "snapshot_age_s" in rec
+        assert rec["class"] in ("realtime", "standard", "batch")
+
+
+ALL_CHECKS = (
+    check_no_realtime_starvation,
+    check_batch_preempted_first,
+    check_chip_budget_respected,
+    check_noop_equivalence,
+    check_right_sizing,
+    check_joint_disagg_damping,
+    check_preemption_marks,
+    check_stale_snapshot_fallback,
+    check_decision_records,
+)
+
+
+def main() -> int:
+    result = run_sim()
+    for chk in ALL_CHECKS:
+        chk(result)
+        print(f"PASS {chk.__name__}")
+    final = result["constrained_plans"][-1]
+    print(json.dumps(
+        {
+            "constrained_budget": final["budget"],
+            "allocated": final["allocated_chips"],
+            "free": final["free_chips"],
+            "preemptions": final["preemptions"],
+            "models": {
+                name: {
+                    k: rec[k]
+                    for k in (
+                        "class", "kind", "chips_allocated",
+                    )
+                }
+                for name, rec in final["models"].items()
+            },
+            "batch_marked_pods": result["batch_marked_pods"],
+            "ticks": result["ticks"],
+        },
+        indent=2, sort_keys=True,
+    ))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,86 @@ TTFT_SUM_METRIC = "kubeai_engine_ttft_seconds_sum"
 TTFT_COUNT_METRIC = "kubeai_engine_ttft_seconds_count"
 
 
+def ceil_div(x: float, y: float) -> int:
+    """Ceiling division as an int — the autoscaler's replicas-from-signal
+    idiom (`ceil(signal / target)`), shared by the per-model path, the
+    per-role disagg path, and the fleet capacity planner. The divisor is
+    a *target* (requests per replica, utilization fraction): zero or
+    negative targets are configuration bugs, not demand, so they raise
+    instead of silently returning garbage."""
+    if y <= 0:
+        raise ValueError(f"ceil_div divisor must be > 0, got {y!r}")
+    return int(-(-x // y))
+
+
+def desired_unified_replicas(
+    avg: float,
+    queue: dict,
+    target_requests: int,
+    queue_pressure_max_wait_s: float,
+) -> int:
+    """One unified model's unconstrained desired replicas: the active-
+    request average over its per-replica target, boosted by queued depth
+    once the oldest waiter has aged past the configured bound (queued
+    requests are demand the active gauge cannot see). Shared by
+    Autoscaler.tick and the fleet capacity planner so a planner-fed tick
+    wants exactly what a direct tick would."""
+    desired = ceil_div(avg, target_requests)
+    if (
+        queue_pressure_max_wait_s > 0
+        and queue["oldest_wait_s"] >= queue_pressure_max_wait_s
+    ):
+        desired = max(
+            desired, ceil_div(avg + queue["depth"], target_requests)
+        )
+    return desired
+
+
+def desired_prefill_replicas(
+    sig: dict,
+    n_endpoints: int,
+    dis,
+    queue_pressure_max_wait_s: float,
+) -> int:
+    """Prefill-role desire: scale for the prefills WAITING (depth over
+    the per-replica queue target), +1 replica past the current pool when
+    the oldest waiter or the mean TTFT has aged past bounds — by then
+    every queued request is eating TTFT budget."""
+    n_pre = max(1, n_endpoints)
+    desired = ceil_div(sig["depth"], max(1, dis.prefill_target_queue))
+    if (
+        queue_pressure_max_wait_s > 0
+        and sig["oldest_wait_s"] >= queue_pressure_max_wait_s
+    ):
+        desired = max(desired, n_pre + 1)
+    if (
+        dis.prefill_target_ttft_seconds > 0
+        and sig["ttft_mean_s"] > dis.prefill_target_ttft_seconds
+    ):
+        desired = max(desired, n_pre + 1)
+    return desired
+
+
+def desired_decode_replicas(
+    sig: dict, n_endpoints: int, dis
+) -> tuple[int, float, float]:
+    """Decode-role desire: keep max(KV-pool utilization, slot occupancy)
+    at the target fraction — decode replicas die by running out of
+    pages/slots, not by queue depth. Returns (desired, slot_occupancy,
+    utilization) so callers can log the raw signal."""
+    n_dec = max(1, n_endpoints)
+    slot_occ = (
+        sig["slots_active"] / sig["slot_capacity"]
+        if sig["slot_capacity"] > 0 else 0.0
+    )
+    util = max(sig["kv_utilization"], slot_occ)
+    desired = (
+        ceil_div(n_dec * util, dis.decode_target_utilization)
+        if util > 0 else 1
+    )
+    return max(1, desired), slot_occ, util
+
+
 def _fetch_metrics(addr: str, timeout: float) -> str:
     with urllib.request.urlopen(
         f"http://{addr}/metrics", timeout=timeout
@@ -241,6 +321,11 @@ class Autoscaler:
         # a fresh scrape per model per tick; a stale/missing snapshot
         # falls back to the direct scrape.
         self.fleet = None
+        # Cluster-wide capacity planner (kubeai_tpu/fleet/planner): when
+        # wired, the planner's bin-packed allocation overrides this
+        # model's own desire before ModelClient.scale/scale_role; a
+        # stale or missing plan falls back to direct per-model scaling.
+        self.planner = None
         self.interval = cfg.model_autoscaling.interval_seconds
         self.window_count = cfg.model_autoscaling.average_window_count
         self._averages: dict[str, SimpleMovingAverage] = {}
@@ -319,7 +404,6 @@ class Autoscaler:
                     decisions.append(record)
                     decision_log.info(json.dumps(record, sort_keys=True))
                     continue
-                desired = int(-(-avg // model.spec.target_requests))  # ceil
                 # Queue-pressure boost: requests waiting in the engines'
                 # schedulers are demand the active-request gauge cannot
                 # see (they are not active yet). When the oldest waiter
@@ -328,16 +412,23 @@ class Autoscaler:
                 # otherwise plateaus at "looks fully utilized" while its
                 # queues (and TTFT) grow without bound.
                 queue, queue_src = self._queue_signals(model.name)
-                threshold = (
-                    self.cfg.model_autoscaling.queue_pressure_max_wait_seconds
+                desired = desired_unified_replicas(
+                    avg, queue, model.spec.target_requests,
+                    self.cfg.model_autoscaling.queue_pressure_max_wait_seconds,
                 )
-                if threshold > 0 and queue["oldest_wait_s"] >= threshold:
-                    desired = max(
-                        desired,
-                        int(-(-(avg + queue["depth"])
-                              // model.spec.target_requests)),
-                    )
-                applied = self.model_client.scale(model.name, desired)
+                # Cluster capacity plan override: a fresh plan's
+                # bin-packed allocation wins over this model's solo
+                # desire (the planner already saw the desire's inputs
+                # plus every OTHER model's); stale/no plan = the
+                # pre-planner direct path.
+                alloc = self._plan_allocation(model.name)
+                if alloc is not None and "replicas" in alloc:
+                    target = int(alloc["replicas"])
+                    scaling_source = "planner"
+                else:
+                    target = desired
+                    scaling_source = "direct"
+                applied = self.model_client.scale(model.name, target)
                 votes = self.model_client.consecutive_scale_downs(model.name)
                 record = {
                     "ts": time.time(),
@@ -354,7 +445,10 @@ class Autoscaler:
                     "queue_oldest_wait_s": queue["oldest_wait_s"],
                     "queue_per_class": dict(queue["per_class"]),
                     "telemetry_source": queue_src,
+                    "scaling_source": scaling_source,
                 }
+                if scaling_source == "planner":
+                    record["planner_replicas"] = target
                 decisions.append(record)
                 decision_log.info(json.dumps(record, sort_keys=True))
                 self.metrics.autoscaler_signal.set(active, model=model.name)
@@ -382,6 +476,29 @@ class Autoscaler:
             # (reference: autoscaler.go:115,159-163 rebuilds state per tick).
             self._averages = next_averages
             self._save_state()
+
+    # -- capacity-plan consultation (planner-first, direct fallback) -----------
+
+    def _plan_allocation(self, model_name: str) -> dict | None:
+        """The fleet planner's arbitrated allocation for one model, or
+        None when there is no planner, the plan is stale, or the model
+        is not under plan control (→ the caller scales directly). A
+        planner crash must degrade to direct scaling, never fail the
+        tick."""
+        if self.planner is None:
+            return None
+        try:
+            return self.planner.allocation_for(model_name)
+        except Exception as e:  # noqa: BLE001 — planner is advisory
+            logger.warning("capacity plan lookup failed: %s", e)
+            return None
+
+    def current_average(self, model_name: str) -> float | None:
+        """This model's moving-average signal as of the last tick — the
+        capacity planner reads it so plan desires use the SAME smoothed
+        signal the direct scaling path uses."""
+        avg = self._averages.get(model_name)
+        return avg.average() if avg is not None else None
 
     # -- engine-signal reads (aggregator-first, direct-scrape fallback) --------
 
@@ -438,31 +555,30 @@ class Autoscaler:
             self.cfg.model_autoscaling.queue_pressure_max_wait_seconds
         )
 
-        n_pre = max(1, len(pre_addrs))
-        desired_pre = int(-(-pre["depth"] // max(1, dis.prefill_target_queue)))
-        if threshold > 0 and pre["oldest_wait_s"] >= threshold:
-            desired_pre = max(desired_pre, n_pre + 1)
-        if (
-            dis.prefill_target_ttft_seconds > 0
-            and pre["ttft_mean_s"] > dis.prefill_target_ttft_seconds
-        ):
-            desired_pre = max(desired_pre, n_pre + 1)
+        desired_pre = desired_prefill_replicas(
+            pre, len(pre_addrs), dis, threshold
+        )
+        desired_dec, slot_occ, util = desired_decode_replicas(
+            dec, len(dec_addrs), dis
+        )
+        # Capacity plan override: the planner damps the prefill/decode
+        # pair JOINTLY (both roles shrink toward their desired ratio
+        # under chip pressure) — per-role direct scaling is the stale-
+        # plan fallback.
+        alloc = self._plan_allocation(model.name)
+        roles_alloc = (alloc or {}).get("roles") or {}
+        if md.ROLE_PREFILL in roles_alloc and md.ROLE_DECODE in roles_alloc:
+            target_pre = int(roles_alloc[md.ROLE_PREFILL])
+            target_dec = int(roles_alloc[md.ROLE_DECODE])
+            scaling_source = "planner"
+        else:
+            target_pre, target_dec = desired_pre, desired_dec
+            scaling_source = "direct"
         applied_pre = self.model_client.scale_role(
-            model.name, md.ROLE_PREFILL, desired_pre
+            model.name, md.ROLE_PREFILL, target_pre
         )
-
-        n_dec = max(1, len(dec_addrs))
-        slot_occ = (
-            dec["slots_active"] / dec["slot_capacity"]
-            if dec["slot_capacity"] > 0 else 0.0
-        )
-        util = max(dec["kv_utilization"], slot_occ)
-        desired_dec = int(
-            -(-(n_dec * util) // dis.decode_target_utilization)
-        ) if util > 0 else 1
-        desired_dec = max(1, desired_dec)
         applied_dec = self.model_client.scale_role(
-            model.name, md.ROLE_DECODE, desired_dec
+            model.name, md.ROLE_DECODE, target_dec
         )
 
         for role, desired, applied, signal in (
@@ -492,6 +608,7 @@ class Autoscaler:
                 md.ROLE_PREFILL: pre_src,
                 md.ROLE_DECODE: dec_src,
             },
+            "scaling_source": scaling_source,
             "roles": {
                 md.ROLE_PREFILL: {
                     "endpoints": len(pre_addrs),

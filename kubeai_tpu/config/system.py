@@ -90,6 +90,24 @@ class ModelAutoscaling:
 
 
 @dataclasses.dataclass
+class CapacityPlanning:
+    """Cluster-wide coordinated capacity planner
+    (kubeai_tpu/fleet/planner; no reference analog — the reference
+    scales every model independently). When enabled, the planner
+    bin-packs every model's desired replicas onto the cluster chip
+    budget by scheduling class and the autoscaler applies the plan's
+    allocations instead of its solo desires (direct scaling remains the
+    stale-plan fallback)."""
+
+    enabled: bool = True
+    # Planning cadence. 0 = follow modelAutoscaling.interval.
+    interval_seconds: float = 0.0
+    # Whether the planner marks preemption-victim pods
+    # (kubeai.org/planner-preempt) for pod_plan's deletion ordering.
+    preemption: bool = True
+
+
+@dataclasses.dataclass
 class ModelRollouts:
     """Surge pods during rollout (reference: internal/config/system.go:114-117)."""
 
@@ -218,6 +236,9 @@ class System:
     model_autoscaling: ModelAutoscaling = dataclasses.field(
         default_factory=ModelAutoscaling
     )
+    capacity_planning: CapacityPlanning = dataclasses.field(
+        default_factory=CapacityPlanning
+    )
     model_rollouts: ModelRollouts = dataclasses.field(
         default_factory=ModelRollouts
     )
@@ -246,6 +267,8 @@ class System:
             raise ConfigError("modelAutoscaling.timeWindow must be >= interval")
         if self.model_autoscaling.queue_pressure_max_wait_seconds < 0:
             raise ConfigError("modelAutoscaling.queuePressureMaxWait must be >= 0")
+        if self.capacity_planning.interval_seconds < 0:
+            raise ConfigError("capacityPlanning.interval must be >= 0")
         if self.model_rollouts.surge < 0:
             raise ConfigError("modelRollouts.surge must be >= 0")
         r = self.resilience
@@ -550,6 +573,13 @@ def system_from_dict(data: dict) -> System:
             queue_pressure_max_wait_seconds=_seconds(
                 a.get("queuePressureMaxWait", 3)
             ),
+        )
+    if "capacityPlanning" in data:
+        cp = data["capacityPlanning"]
+        sys_obj.capacity_planning = CapacityPlanning(
+            enabled=bool(cp.get("enabled", True)),
+            interval_seconds=_seconds(cp.get("interval", 0)),
+            preemption=bool(cp.get("preemption", True)),
         )
     if "modelRollouts" in data:
         sys_obj.model_rollouts = ModelRollouts(

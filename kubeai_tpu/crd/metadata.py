@@ -42,6 +42,15 @@ def role_replicas_annotation(role: str) -> str:
     return f"{GROUP}/{role}-replicas"
 
 
+# Capacity planner (kubeai_tpu/fleet/planner): pods the cluster-wide
+# planner picked as preemption victims — chips reclaimed for a
+# higher-scheduling-class model. pod_plan's deletion ordering deletes
+# marked pods first, so the replicas that die when the autoscaler applies
+# the planner's shrunken allocation are exactly the planner's picks.
+# Value: the planner's stable reason string (e.g. "CapacityPreemption").
+PLANNER_PREEMPT_ANNOTATION = "kubeai.org/planner-preempt"
+PREEMPT_REASON_CAPACITY = "CapacityPreemption"
+
 ADAPTER_LABEL_DOMAIN = "adapter.kubeai.org"
 # Comma-separated adapter names whose routing label was removed but whose
 # engine unload hasn't succeeded yet (409 while requests drain). Keeps the

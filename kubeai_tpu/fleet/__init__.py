@@ -13,12 +13,27 @@ Three pillars (see docs/concepts/observability.md — Fleet telemetry):
     (`kubeai_tenant_*` counters, `GET /v1/usage`).
   - `StepProfiler` — per-phase Engine.step timeline
     (`kubeai_engine_step_phase_seconds`, `POST /v1/profile`).
+
+Plus the consumer that makes the aggregated state actionable:
+
+  - `CapacityPlanner` — cluster-wide coordinated capacity planning
+    (docs/concepts/capacity-planning.md): priority bin-packing of every
+    model's replicas onto the heterogeneous chip budget, scheduling-
+    class preemption, slice right-sizing, and joint prefill/decode
+    damping; `kubeai_planner_*` gauges, `GET /v1/fleet/plan`, and an
+    override channel into the autoscaler.
 """
 
 from kubeai_tpu.fleet.aggregator import (
     FleetStateAggregator,
     endpoint_signals,
     hist_quantiles,
+)
+from kubeai_tpu.fleet.planner import (
+    CapacityPlanner,
+    SCHEDULING_CLASSES,
+    model_chips_per_replica,
+    model_scheduling_class,
 )
 from kubeai_tpu.fleet.metering import (
     ANONYMOUS_TENANT,
@@ -29,12 +44,16 @@ from kubeai_tpu.fleet.profiler import PHASES, StepProfiler, phase_totals
 
 __all__ = [
     "ANONYMOUS_TENANT",
+    "CapacityPlanner",
     "FleetStateAggregator",
     "PHASES",
+    "SCHEDULING_CLASSES",
     "StepProfiler",
     "UsageMeter",
     "endpoint_signals",
     "hist_quantiles",
+    "model_chips_per_replica",
+    "model_scheduling_class",
     "phase_totals",
     "tenant_of",
 ]

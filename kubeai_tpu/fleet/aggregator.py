@@ -397,6 +397,37 @@ class FleetStateAggregator:
         )
         return snapshot
 
+    def _node_budget(self) -> dict:
+        """Cluster chip BUDGET by slice shape, from Node allocatable
+        capacity — what the scheduler could place, as opposed to the
+        pod inventory below, which is what is currently requested. A
+        cluster whose store carries no Node objects reports a zero
+        budget; consumers (the capacity planner) treat that as
+        'budget unknown — plan unconstrained'."""
+        shapes: dict[str, dict] = {}
+        total = 0
+        for node in self.store.list("Node"):
+            chips = k8sutils.node_chip_capacity(node)
+            if chips <= 0:
+                continue
+            shape = k8sutils.node_slice_shape(node)
+            entry = shapes.setdefault(
+                shape, {"chips": 0, "nodes": 0, "slice_chips": chips}
+            )
+            entry["chips"] += chips
+            entry["nodes"] += 1
+            # One Node = one schedulable slice of this shape; a replica
+            # cannot span slices, so the per-slice chip count bounds the
+            # largest replica this shape can host.
+            entry["slice_chips"] = max(entry["slice_chips"], chips)
+            total += chips
+        return {
+            "total": total,
+            "by_shape": {s: e["chips"] for s, e in shapes.items()},
+            "nodes_by_shape": {s: e["nodes"] for s, e in shapes.items()},
+            "slice_chips": {s: e["slice_chips"] for s, e in shapes.items()},
+        }
+
     def _pod_inventory(self) -> tuple[dict, dict]:
         """Join the operator's pod view: per-model readiness/disruption
         counts and the cluster chip inventory by slice shape."""
@@ -407,6 +438,10 @@ class FleetStateAggregator:
         if self.store is None:
             return per_model, {
                 "total": 0, "by_shape": {}, "pods_by_shape": {},
+                "budget": {
+                    "total": 0, "by_shape": {}, "nodes_by_shape": {},
+                    "slice_chips": {},
+                },
             }
         for pod in self.store.list("Pod", self.namespace):
             model = k8sutils.get_label(pod, md.POD_MODEL_LABEL)
@@ -440,6 +475,7 @@ class FleetStateAggregator:
             "total": total_chips,
             "by_shape": by_shape,
             "pods_by_shape": pods_by_shape,
+            "budget": self._node_budget(),
         }
 
     # -- gauges (with label-churn hygiene) --------------------------------------

@@ -1,0 +1,778 @@
+"""CapacityPlanner: cluster-wide coordinated capacity planning.
+
+Every model's autoscaler computes its desired replicas independently —
+nothing arbitrates when the sum of desires exceeds the cluster chip
+budget, so realtime models can starve behind batch models while idle
+chips sit on the wrong slice shape. The planner closes that gap: each
+planning tick it consumes the latest fleet snapshot (queue pressure,
+TTFT, KV/slot utilization per model+role — already aggregated by
+`FleetStateAggregator`) plus the chip inventory of heterogeneous slice
+shapes, computes each model's unconstrained desire with the SAME math
+the per-model autoscaler uses (`desired_unified_replicas` /
+`desired_prefill_replicas` / `desired_decode_replicas` in
+kubeai_tpu/autoscaler/autoscaler.py), then bin-packs replicas under the
+chip budget by scheduling class:
+
+  - classes allocate in strict priority order (realtime → standard →
+    batch), so batch-class replicas are preempted to free chips before a
+    realtime-class model under SLO pressure is ever throttled;
+  - CRD `minReplicas` floors are honored first across ALL classes (a
+    guarantee is a guarantee), then demand water-fills per class one
+    replica per model per round — fair within a class, deterministic;
+  - each replica is right-sized onto the CHEAPEST slice shape that can
+    host it (smallest per-slice chip count ≥ the model's chips per
+    replica), spilling to larger shapes only when the cheap pool runs
+    dry;
+  - disaggregated models damp the prefill/decode pair JOINTLY: under
+    chip pressure the role with the lowest allocated/desired fraction is
+    granted next, so both roles shrink toward their desired ratio
+    instead of one role being chopped.
+
+The resulting allocation is an override channel into the autoscaler:
+`Autoscaler` consults `allocation_for(model)` before calling
+`ModelClient.scale`/`scale_role`, and falls back to its direct per-model
+path whenever the plan (or the snapshot behind it) is stale. Decisions
+are published three ways, mirroring the autoscaler's decision trail:
+`kubeai_planner_*` gauges, `GET /v1/fleet/plan`, and one structured JSON
+record per (tick, model) on the `kubeai.planner.decisions` logger
+(`last_decisions` holds the in-process view). Preemption picks are
+honored by the operator: victim pods get the
+`kubeai.org/planner-preempt` annotation and pod_plan deletes them first.
+
+A cluster whose store carries no Node objects (or whose nodes expose no
+`google.com/tpu` capacity) has an UNKNOWN budget: the planner then plans
+unconstrained — allocations equal desires, nothing is preempted — which
+is exactly the pre-planner behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+from kubeai_tpu.autoscaler.autoscaler import (
+    aggregate_role_signals,
+    desired_decode_replicas,
+    desired_prefill_replicas,
+    desired_unified_replicas,
+)
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.metrics.registry import DEFAULT_METRICS, Metrics
+from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator.k8s.store import Conflict, NotFound
+
+logger = logging.getLogger(__name__)
+
+# One structured JSON record per (tick, model): the planner's decision
+# trail, same contract as kubeai.autoscaler.decisions.
+decision_log = logging.getLogger("kubeai.planner.decisions")
+
+# Strict priority order: earlier classes take chips first; later classes
+# are preempted first. Mirrors the engine scheduler's priority bands
+# (kubeai_tpu/scheduling) — a model's class is its CRD
+# `scheduling.defaultPriority` (standard when unset).
+SCHEDULING_CLASSES = ("realtime", "standard", "batch")
+
+
+def model_scheduling_class(model) -> str:
+    cls = model.spec.scheduling.default_priority or "standard"
+    return cls if cls in SCHEDULING_CLASSES else "standard"
+
+
+def model_chips_per_replica(model, cfg, pods_entry: dict | None) -> int:
+    """Chips one replica of this model occupies: observed from its live
+    pods' `google.com/tpu` requests when any exist, else derived from
+    its resource profile (`name:count` multiplies the profile's chip
+    request), else 1 — a model the planner cannot size still costs
+    SOMETHING, or an unsizable model would bin-pack for free."""
+    pods_entry = pods_entry or {}
+    total = pods_entry.get("total") or 0
+    chips = pods_entry.get("chips") or 0
+    if total > 0 and chips > 0:
+        return max(1, round(chips / total))
+    if cfg is not None and model.spec.resource_profile:
+        name, _, count_s = model.spec.resource_profile.partition(":")
+        prof = (cfg.resource_profiles or {}).get(name)
+        try:
+            count = max(1, int(count_s))
+        except (TypeError, ValueError):
+            count = 1
+        if prof is not None:
+            v = (prof.limits or {}).get(k8sutils.TPU_RESOURCE) or (
+                prof.requests or {}
+            ).get(k8sutils.TPU_RESOURCE)
+            per = k8sutils.parse_chip_quantity(v, where=f"profile {name}")
+            if per > 0:
+                return per * count
+    return 1
+
+
+class _ShapePool:
+    """Mutable free-chip accounting for one slice shape during packing."""
+
+    __slots__ = ("shape", "slice_chips", "chips", "free")
+
+    def __init__(self, shape: str, slice_chips: int, chips: int):
+        self.shape = shape
+        self.slice_chips = slice_chips
+        self.chips = chips
+        self.free = chips
+
+
+class CapacityPlanner:
+    """Fleet-level replica arbiter over one `FleetStateAggregator`.
+
+    `avg_lookup(model_name) -> float | None` is injectable: the manager
+    wires it to `Autoscaler.current_average` so plan desires use the
+    same smoothed active-request signal the direct scaling path uses
+    (falling back to the snapshot's instantaneous active-request sum).
+    `clock` drives plan timestamps and staleness (FakeClock in the
+    deterministic sim)."""
+
+    def __init__(
+        self,
+        fleet,
+        model_client,
+        store=None,
+        cfg=None,
+        namespace: str = "default",
+        metrics: Metrics = DEFAULT_METRICS,
+        leader=None,
+        interval_s: float = 10.0,
+        staleness_s: float | None = None,
+        preemption_enabled: bool = True,
+        budget_override: dict | None = None,
+        clock=time.time,
+    ):
+        self.fleet = fleet
+        self.model_client = model_client
+        self.store = store
+        self.cfg = cfg
+        self.namespace = namespace
+        self.metrics = metrics
+        self.leader = leader
+        self.interval_s = interval_s
+        # Plans (and the snapshots they came from) older than this are
+        # stale: allocation_for returns None and the autoscaler scales
+        # directly. Same 3×interval default as the aggregator.
+        self.staleness_s = (
+            staleness_s if staleness_s is not None else 3.0 * interval_s
+        )
+        self.preemption_enabled = preemption_enabled
+        # {shape: {"chips": N, "slice_chips": c}} — overrides the
+        # snapshot's Node-derived budget (clusters where the operator
+        # cannot list Nodes configure capacity explicitly).
+        self.budget_override = budget_override
+        self.avg_lookup = None
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._plan: dict | None = None
+        self.last_decisions: list[dict] = []
+        self._prev_series: dict[str, tuple] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — next tick retries
+                logger.warning("capacity planning tick failed: %s", e)
+
+    # -- one planning tick -----------------------------------------------------
+
+    def tick(self, force: bool = False) -> dict | None:
+        """Plan from the aggregator's latest snapshot. Returns the plan,
+        or None when not leader (unless forced) or the snapshot is
+        stale/missing — in which case the previous plan ages out and the
+        autoscaler falls back to direct scaling."""
+        if not force and self.leader is not None and not self.leader.is_leader:
+            return None
+        snap = self.fleet.snapshot() if self.fleet is not None else None
+        now = self._clock()
+        if snap is None or now - snap["ts"] > self.staleness_s:
+            self.metrics.planner_stale_ticks.inc()
+            return None
+        plan = self.plan_from_snapshot(snap)
+        with self._lock:
+            self._plan = plan
+            self.last_decisions = list(plan["models"].values())
+        self._publish(plan)
+        if self.store is not None and self.preemption_enabled:
+            try:
+                self._mark_preemption_victims(plan)
+            except Exception as e:  # noqa: BLE001 — marking is advisory
+                logger.warning("preemption marking failed: %s", e)
+        self.metrics.planner_ticks.inc()
+        return plan
+
+    # -- desires ---------------------------------------------------------------
+
+    def _threshold(self) -> float:
+        if self.cfg is not None:
+            return self.cfg.model_autoscaling.queue_pressure_max_wait_seconds
+        return 3.0
+
+    def _unified_desire(self, model, entry: dict) -> dict:
+        avg = self.avg_lookup(model.name) if self.avg_lookup else None
+        if avg is None:
+            avg = sum(
+                e.get("active_requests", 0.0)
+                for e in (entry.get("endpoints") or {}).values()
+                if not e.get("stale")
+            )
+        queue = entry.get("queue") or {
+            "depth": 0.0, "oldest_wait_s": 0.0, "per_class": {},
+        }
+        threshold = self._threshold()
+        desired = desired_unified_replicas(
+            avg, queue, model.spec.target_requests, threshold
+        )
+        floor = model.spec.min_replicas
+        target = max(desired, floor)
+        if model.spec.max_replicas is not None:
+            target = min(target, model.spec.max_replicas)
+        return {
+            "kind": "unified",
+            "signal": avg,
+            "desired": desired,
+            "target": target,
+            "floor": floor,
+            "slo_pressure": bool(
+                threshold > 0 and queue["oldest_wait_s"] >= threshold
+            ),
+            "queue_depth": queue["depth"],
+            "queue_oldest_wait_s": queue["oldest_wait_s"],
+        }
+
+    def _disagg_desire(self, model, entry: dict) -> dict:
+        dis = model.spec.disaggregation
+        replicas = entry.get("replicas") or {}
+        roles = entry.get("roles") or {}
+        pre_sig = roles.get(md.ROLE_PREFILL) or aggregate_role_signals({})
+        dec_sig = roles.get(md.ROLE_DECODE) or aggregate_role_signals({})
+        threshold = self._threshold()
+        desired_pre = desired_prefill_replicas(
+            pre_sig, replicas.get(md.ROLE_PREFILL, 0), dis, threshold
+        )
+        desired_dec, slot_occ, util = desired_decode_replicas(
+            dec_sig, replicas.get(md.ROLE_DECODE, 0), dis
+        )
+        desired_roles = {
+            md.ROLE_PREFILL: desired_pre, md.ROLE_DECODE: desired_dec,
+        }
+        floor_roles: dict[str, int] = {}
+        target_roles: dict[str, int] = {}
+        for role, desired in desired_roles.items():
+            rs = dis.role(role)
+            floor = max(1, rs.min_replicas)
+            target = max(desired, floor)
+            if rs.max_replicas is not None:
+                target = min(target, rs.max_replicas)
+            floor_roles[role] = floor
+            target_roles[role] = target
+        return {
+            "kind": "disagg",
+            "signal": pre_sig["depth"],
+            "desired_roles": desired_roles,
+            "target_roles": target_roles,
+            "floor_roles": floor_roles,
+            "slo_pressure": bool(
+                (threshold > 0 and pre_sig["oldest_wait_s"] >= threshold)
+                or (
+                    dis.prefill_target_ttft_seconds > 0
+                    and pre_sig["ttft_mean_s"]
+                    > dis.prefill_target_ttft_seconds
+                )
+            ),
+            "kv_utilization": util,
+            "slot_occupancy": slot_occ,
+        }
+
+    # -- bin-packing -----------------------------------------------------------
+
+    def _pools(self, snap: dict) -> list[_ShapePool]:
+        if self.budget_override is not None:
+            src = {
+                shape: (
+                    int(b.get("chips", 0)),
+                    int(b.get("slice_chips", b.get("chips", 0))),
+                )
+                for shape, b in self.budget_override.items()
+            }
+        else:
+            budget = (snap.get("chips") or {}).get("budget") or {}
+            src = {
+                shape: (
+                    int(chips),
+                    int((budget.get("slice_chips") or {}).get(shape, chips)),
+                )
+                for shape, chips in (budget.get("by_shape") or {}).items()
+            }
+        pools = [
+            _ShapePool(shape, slice_chips, chips)
+            for shape, (chips, slice_chips) in src.items()
+            if chips > 0
+        ]
+        # Cheapest slice first: right-sizing tries the smallest slice
+        # that can host the replica before spilling to bigger iron.
+        pools.sort(key=lambda p: (p.slice_chips, p.shape))
+        return pools
+
+    @staticmethod
+    def _place(pools: list[_ShapePool], chips: int) -> str | None:
+        for p in pools:
+            if p.slice_chips >= chips and p.free >= chips:
+                p.free -= chips
+                return p.shape
+        return None
+
+    @staticmethod
+    def _next_role(e: dict) -> str | None:
+        """The disagg role to grant next: lowest allocated/target
+        fraction first, so both roles fill (and shrink) toward the
+        desired ratio jointly instead of per-role."""
+        best, best_frac = None, None
+        for role in md.DISAGG_ROLES:
+            target = e["target_roles"][role]
+            if e["alloc_roles"][role] >= target:
+                continue
+            frac = e["alloc_roles"][role] / target
+            if best is None or frac < best_frac:
+                best, best_frac = role, frac
+        return best
+
+    def _grant_rounds(
+        self, entries: list[dict], pools: list[_ShapePool],
+        to_floor: bool,
+    ) -> None:
+        """Water-fill: one replica per model per round until either the
+        target (floor or full) is met everywhere or nothing fits."""
+        progressed = True
+        while progressed:
+            progressed = False
+            for e in entries:
+                if e["kind"] == "disagg":
+                    role = None
+                    if to_floor:
+                        for r in md.DISAGG_ROLES:
+                            if e["alloc_roles"][r] < min(
+                                e["floor_roles"][r], e["target_roles"][r]
+                            ):
+                                role = r
+                                break
+                    else:
+                        role = self._next_role(e)
+                    if role is None:
+                        continue
+                    shape = self._place(pools, e["chips_per_replica"])
+                    if shape is None:
+                        continue
+                    e["alloc_roles"][role] += 1
+                    e["shapes"][shape] = e["shapes"].get(shape, 0) + 1
+                    progressed = True
+                else:
+                    limit = (
+                        min(e["floor"], e["target"]) if to_floor
+                        else e["target"]
+                    )
+                    if e["alloc"] >= limit:
+                        continue
+                    shape = self._place(pools, e["chips_per_replica"])
+                    if shape is None:
+                        continue
+                    e["alloc"] += 1
+                    e["shapes"][shape] = e["shapes"].get(shape, 0) + 1
+                    progressed = True
+
+    def plan_from_snapshot(self, snap: dict) -> dict:
+        now = self._clock()
+        models = self.model_client.list_all_models()
+        pools = self._pools(snap)
+        budget_known = bool(pools)
+        budget_total = sum(p.chips for p in pools)
+
+        entries: list[dict] = []
+        for model in sorted(models, key=lambda m: m.name):
+            entry = (snap.get("models") or {}).get(model.name) or {}
+            pods_entry = entry.get("pods") or {}
+            cpr = model_chips_per_replica(model, self.cfg, pods_entry)
+            cls = model_scheduling_class(model)
+            replicas = entry.get("replicas") or {}
+            if model.spec.autoscaling_disabled:
+                # Not under plan control, but its chips are spoken for:
+                # reserve them off the top so arbitration sees the true
+                # remaining budget.
+                current = pods_entry.get("total") or (
+                    model.spec.replicas or 0
+                )
+                e = {
+                    "kind": "fixed", "model": model.name, "class": cls,
+                    "chips_per_replica": cpr, "current": current,
+                    "alloc": current, "shapes": {},
+                }
+                for _ in range(current):
+                    shape = self._place(pools, cpr)
+                    if shape is None:
+                        break
+                    e["shapes"][shape] = e["shapes"].get(shape, 0) + 1
+                entries.append(e)
+                continue
+            if model.spec.disaggregation.enabled:
+                d = self._disagg_desire(model, entry)
+                by_role = pods_entry.get("by_role") or {}
+                d["current_roles"] = {
+                    role: by_role.get(role) or replicas.get(role, 0)
+                    for role in md.DISAGG_ROLES
+                }
+                d["alloc_roles"] = {role: 0 for role in md.DISAGG_ROLES}
+            else:
+                d = self._unified_desire(model, entry)
+                d["current"] = pods_entry.get("total") or sum(
+                    replicas.values()
+                ) or (model.spec.replicas or 0)
+                d["alloc"] = 0
+            d.update(
+                model=model.name, **{"class": cls},
+                chips_per_replica=cpr, shapes={},
+            )
+            entries.append(d)
+
+        planned = [e for e in entries if e["kind"] != "fixed"]
+        if budget_known:
+            # Floors are CRD guarantees — honored across ALL classes
+            # first (in priority order), then demand water-fills per
+            # class so batch demand only sees what realtime left over.
+            for cls in SCHEDULING_CLASSES:
+                self._grant_rounds(
+                    [e for e in planned if e["class"] == cls], pools,
+                    to_floor=True,
+                )
+            for cls in SCHEDULING_CLASSES:
+                self._grant_rounds(
+                    [e for e in planned if e["class"] == cls], pools,
+                    to_floor=False,
+                )
+        else:
+            # Unknown budget: plan unconstrained (allocation == desire,
+            # no preemption) — exactly the pre-planner behavior.
+            for e in planned:
+                if e["kind"] == "disagg":
+                    e["alloc_roles"] = dict(e["target_roles"])
+                else:
+                    e["alloc"] = e["target"]
+
+        records: dict[str, dict] = {}
+        chips_allocated = 0
+        preemptions: list[dict] = []
+        for e in entries:
+            base = {
+                "ts": now,
+                "model": e["model"],
+                "class": e["class"],
+                "kind": e["kind"],
+                "chips_per_replica": e["chips_per_replica"],
+                "shapes": dict(e["shapes"]),
+                "telemetry_source": "aggregator",
+                "snapshot_age_s": round(max(0.0, now - snap["ts"]), 3),
+            }
+            if e["kind"] == "fixed":
+                chips = e["alloc"] * e["chips_per_replica"]
+                base.update(
+                    current_replicas=e["current"],
+                    allocated_replicas=e["alloc"],
+                    chips_allocated=chips,
+                )
+            elif e["kind"] == "disagg":
+                alloc_total = sum(e["alloc_roles"].values())
+                chips = alloc_total * e["chips_per_replica"]
+                preempted = {
+                    role: max(
+                        0,
+                        min(e["current_roles"][role],
+                            e["target_roles"][role])
+                        - e["alloc_roles"][role],
+                    )
+                    for role in md.DISAGG_ROLES
+                }
+                throttled = sum(
+                    max(0, e["target_roles"][r] - e["alloc_roles"][r])
+                    for r in md.DISAGG_ROLES
+                )
+                base.update(
+                    signal=e["signal"],
+                    slo_pressure=e["slo_pressure"],
+                    desired_roles=dict(e["desired_roles"]),
+                    target_roles=dict(e["target_roles"]),
+                    allocated_roles=dict(e["alloc_roles"]),
+                    current_roles=dict(e["current_roles"]),
+                    kv_utilization=e["kv_utilization"],
+                    slot_occupancy=e["slot_occupancy"],
+                    throttled_replicas=throttled,
+                    preempted_replicas=sum(preempted.values()),
+                    preempted_roles=preempted,
+                    chips_allocated=chips,
+                )
+            else:
+                chips = e["alloc"] * e["chips_per_replica"]
+                preempted = max(
+                    0, min(e["current"], e["target"]) - e["alloc"]
+                )
+                base.update(
+                    signal=e["signal"],
+                    slo_pressure=e["slo_pressure"],
+                    queue_depth=e["queue_depth"],
+                    queue_oldest_wait_s=e["queue_oldest_wait_s"],
+                    desired_replicas=e["desired"],
+                    target_replicas=e["target"],
+                    allocated_replicas=e["alloc"],
+                    current_replicas=e["current"],
+                    throttled_replicas=max(0, e["target"] - e["alloc"]),
+                    preempted_replicas=preempted,
+                    chips_allocated=chips,
+                )
+            chips_allocated += chips
+            if base.get("preempted_replicas"):
+                preemptions.append(
+                    {
+                        "model": e["model"],
+                        "class": e["class"],
+                        "replicas": base["preempted_replicas"],
+                    }
+                )
+            records[e["model"]] = base
+
+        return {
+            "ts": now,
+            "snapshot_ts": snap["ts"],
+            "telemetry_source": "aggregator",
+            "budget_known": budget_known,
+            "budget": {
+                "total": budget_total,
+                "by_shape": {p.shape: p.chips for p in pools},
+                "slice_chips": {p.shape: p.slice_chips for p in pools},
+            },
+            "allocated_chips": {
+                "total": chips_allocated,
+                "by_shape": {
+                    p.shape: p.chips - p.free for p in pools
+                },
+            },
+            "free_chips": {
+                "total": max(0, budget_total - chips_allocated),
+                "by_shape": {p.shape: p.free for p in pools},
+            },
+            "preemptions": preemptions,
+            "models": records,
+        }
+
+    # -- preemption marking (pod_plan honors the annotation) -------------------
+
+    def _mark_preemption_victims(self, plan: dict) -> None:
+        """Annotate the pods the plan takes away so pod_plan deletes
+        exactly them first; strip the mark from pods no longer picked so
+        a recovered model's deletions revert to the generic ordering."""
+        for name, rec in plan["models"].items():
+            if rec["kind"] == "fixed":
+                continue
+            pods = self.store.list(
+                "Pod", self.namespace, {md.POD_MODEL_LABEL: name}
+            )
+            victims: set[str] = set()
+            if rec["kind"] == "disagg":
+                for role in md.DISAGG_ROLES:
+                    if not rec["preempted_roles"].get(role):
+                        continue
+                    n_del = max(
+                        0,
+                        rec["current_roles"][role]
+                        - rec["allocated_roles"][role],
+                    )
+                    role_pods = [
+                        p for p in pods
+                        if k8sutils.get_label(p, md.POD_ROLE_LABEL) == role
+                    ]
+                    victims.update(self._pick_victims(role_pods, n_del))
+            elif rec.get("preempted_replicas"):
+                n_del = max(
+                    0, rec["current_replicas"] - rec["allocated_replicas"]
+                )
+                victims.update(self._pick_victims(pods, n_del))
+            for pod in pods:
+                pod_name = pod["metadata"]["name"]
+                ann = (pod.get("metadata") or {}).get("annotations") or {}
+                marked = md.PLANNER_PREEMPT_ANNOTATION in ann
+                want = pod_name in victims
+                if marked == want:
+                    continue
+                if want:
+                    pod["metadata"].setdefault("annotations", {})[
+                        md.PLANNER_PREEMPT_ANNOTATION
+                    ] = md.PREEMPT_REASON_CAPACITY
+                else:
+                    pod["metadata"]["annotations"].pop(
+                        md.PLANNER_PREEMPT_ANNOTATION, None
+                    )
+                try:
+                    self.store.update(pod)
+                except (Conflict, NotFound):
+                    continue  # next tick re-marks against fresh state
+
+    @staticmethod
+    def _pick_victims(pods: list[dict], n: int) -> list[str]:
+        """Youngest non-terminating pods first — the least-warm replicas
+        (matching the generic ordering's final tiebreak, but pinned by
+        the planner so the choice survives whatever else the reconcile
+        is doing)."""
+        if n <= 0:
+            return []
+        candidates = [
+            p for p in pods if not k8sutils.pod_is_terminating(p)
+        ]
+        candidates.sort(
+            key=lambda p: -(
+                (p.get("metadata") or {}).get("creationTimestamp") or 0
+            )
+        )
+        return [p["metadata"]["name"] for p in candidates[:n]]
+
+    # -- publishing ------------------------------------------------------------
+
+    def _publish(self, plan: dict) -> None:
+        m = self.metrics
+        new_series: dict[str, tuple] = {}
+
+        def set_(gauge, value, **labels):
+            gauge.set(value, **labels)
+            new_series.setdefault(gauge.name, (gauge, set()))[1].add(
+                tuple(sorted(labels.items()))
+            )
+
+        for name, rec in plan["models"].items():
+            decision_log.info(json.dumps(rec, sort_keys=True))
+            if rec["kind"] == "disagg":
+                for role in md.DISAGG_ROLES:
+                    set_(
+                        m.planner_desired_replicas,
+                        rec["desired_roles"][role], model=name, role=role,
+                    )
+                    set_(
+                        m.planner_allocated_replicas,
+                        rec["allocated_roles"][role], model=name, role=role,
+                    )
+            else:
+                role = md.ROLE_UNIFIED
+                if rec["kind"] == "fixed":
+                    set_(
+                        m.planner_allocated_replicas,
+                        rec["allocated_replicas"], model=name, role=role,
+                    )
+                else:
+                    set_(
+                        m.planner_desired_replicas,
+                        rec["desired_replicas"], model=name, role=role,
+                    )
+                    set_(
+                        m.planner_allocated_replicas,
+                        rec["allocated_replicas"], model=name, role=role,
+                    )
+            if rec["kind"] != "fixed":
+                set_(
+                    m.planner_throttled_replicas,
+                    rec["throttled_replicas"], model=name,
+                )
+                set_(
+                    m.planner_preempted_replicas,
+                    rec["preempted_replicas"], model=name,
+                )
+                if rec["preempted_replicas"]:
+                    m.planner_preemptions.inc(
+                        rec["preempted_replicas"], model=name
+                    )
+        for shape, chips in plan["allocated_chips"]["by_shape"].items():
+            set_(m.planner_chips_allocated, chips, shape=shape)
+        for shape, chips in plan["free_chips"]["by_shape"].items():
+            set_(m.planner_chips_free, chips, shape=shape)
+        m.planner_plan_ts.set(plan["ts"])
+        # Retired label sets (model deleted, shape drained) must not
+        # linger as frozen series.
+        for name, (gauge, keys) in self._prev_series.items():
+            current = new_series.get(name, (gauge, set()))[1]
+            for k in keys - current:
+                gauge.remove(**dict(k))
+        self._prev_series = new_series
+
+    # -- consumer API ----------------------------------------------------------
+
+    def current_plan(self) -> dict | None:
+        with self._lock:
+            return self._plan
+
+    def _fresh_plan(self) -> dict | None:
+        plan = self.current_plan()
+        if plan is None:
+            return None
+        if self._clock() - plan["ts"] > self.staleness_s:
+            return None
+        return plan
+
+    def allocation_for(self, model_name: str) -> dict | None:
+        """The autoscaler's override read: the fresh plan's allocation
+        for one model (`{"replicas": n}` unified, `{"roles": {...}}`
+        disaggregated), or None when the plan is stale/missing or the
+        model is not under plan control (→ direct scaling fallback)."""
+        plan = self._fresh_plan()
+        if plan is None:
+            return None
+        rec = plan["models"].get(model_name)
+        if rec is None or rec["kind"] == "fixed":
+            return None
+        if rec["kind"] == "disagg":
+            return {
+                "roles": dict(rec["allocated_roles"]),
+                "class": rec["class"],
+                "plan_ts": plan["ts"],
+            }
+        return {
+            "replicas": rec["allocated_replicas"],
+            "class": rec["class"],
+            "plan_ts": plan["ts"],
+        }
+
+    def plan_payload(self) -> dict:
+        """`GET /v1/fleet/plan`: the latest plan, recomputed when none
+        exists or the latest aged out (forced past the leader gate — a
+        read must answer on any replica that can see a snapshot)."""
+        plan = self._fresh_plan()
+        if plan is None:
+            self.tick(force=True)
+            plan = self.current_plan()
+        if plan is None:
+            return {
+                "object": "fleet.plan",
+                "plan_available": False,
+                "stale": True,
+            }
+        age = max(0.0, self._clock() - plan["ts"])
+        payload = {
+            "object": "fleet.plan",
+            "plan_available": True,
+            "stale": age > self.staleness_s,
+            "age_s": round(age, 3),
+        }
+        payload.update(plan)
+        return payload

@@ -502,6 +502,67 @@ class Metrics:
             "age = now - this).",
             self.registry,
         )
+        # -- cluster capacity planner (kubeai_tpu/fleet/planner) ------------
+        self.planner_ticks = Counter(
+            "kubeai_planner_ticks_total",
+            "Completed capacity-planning ticks (a fresh fleet snapshot "
+            "was bin-packed into a plan).",
+            self.registry,
+        )
+        self.planner_stale_ticks = Counter(
+            "kubeai_planner_stale_ticks_total",
+            "Planning ticks skipped because the fleet snapshot was stale "
+            "or missing (the autoscaler falls back to direct per-model "
+            "scaling while this grows).",
+            self.registry,
+        )
+        self.planner_preemptions = Counter(
+            "kubeai_planner_preemptions_total",
+            "Replicas preempted by the capacity plan per model (chips "
+            "reclaimed for a higher scheduling class).",
+            self.registry,
+        )
+        self.planner_desired_replicas = Gauge(
+            "kubeai_planner_desired_replicas",
+            "Unconstrained desired replicas per model and role in the "
+            "latest plan (what the model wants before the chip budget).",
+            self.registry,
+        )
+        self.planner_allocated_replicas = Gauge(
+            "kubeai_planner_allocated_replicas",
+            "Replicas the latest plan allocated per model and role under "
+            "the chip budget (the autoscaler's override target).",
+            self.registry,
+        )
+        self.planner_throttled_replicas = Gauge(
+            "kubeai_planner_throttled_replicas",
+            "Desired-but-unallocated replicas per model in the latest "
+            "plan (demand the chip budget could not fit).",
+            self.registry,
+        )
+        self.planner_preempted_replicas = Gauge(
+            "kubeai_planner_preempted_replicas",
+            "Currently-running replicas the latest plan takes away from "
+            "this model despite remaining demand (preemption picks).",
+            self.registry,
+        )
+        self.planner_chips_allocated = Gauge(
+            "kubeai_planner_chips_allocated",
+            "Chips the latest plan allocated per slice shape.",
+            self.registry,
+        )
+        self.planner_chips_free = Gauge(
+            "kubeai_planner_chips_free",
+            "Chips the latest plan left idle per slice shape.",
+            self.registry,
+        )
+        self.planner_plan_ts = Gauge(
+            "kubeai_planner_plan_timestamp_seconds",
+            "Unix timestamp of the latest capacity plan (plan age = "
+            "now - this; the autoscaler ignores plans past the "
+            "staleness bound).",
+            self.registry,
+        )
         # -- per-tenant usage metering (kubeai_tpu/fleet/metering) ----------
         self.tenant_requests = Counter(
             "kubeai_tenant_requests_total",

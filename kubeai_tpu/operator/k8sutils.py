@@ -4,6 +4,9 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
+
+logger = logging.getLogger(__name__)
 
 
 def pod_hash(pod_spec: dict) -> str:
@@ -173,23 +176,81 @@ def classify_pod_failure(
     return None
 
 
-# -- chip inventory (fleet telemetry: kubeai_tpu/fleet/aggregator) ------------
+# -- chip inventory (fleet telemetry: kubeai_tpu/fleet/aggregator;
+#    chip budget: kubeai_tpu/fleet/planner) -----------------------------------
+
+TPU_RESOURCE = "google.com/tpu"
+TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+
+
+def parse_chip_quantity(v, where: str = "") -> int:
+    """Parse one `google.com/tpu` resource quantity. TPU chips are whole
+    devices, so anything that isn't a non-negative integer (after
+    tolerating the `4.0` float spelling) is malformed: warn and count 0
+    rather than raising — a single bad pod manifest must not blind the
+    whole chip inventory."""
+    if v is None:
+        return 0
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        logger.warning(
+            "malformed %s quantity %r%s: counting 0 chips",
+            TPU_RESOURCE, v, f" on {where}" if where else "",
+        )
+        return 0
+    if f < 0 or f != int(f):
+        logger.warning(
+            "non-integral %s quantity %r%s: counting 0 chips",
+            TPU_RESOURCE, v, f" on {where}" if where else "",
+        )
+        return 0
+    return int(f)
 
 
 def pod_chip_count(pod: dict) -> int:
     """Total `google.com/tpu` chips this pod requests across its
-    containers (limits win over requests, per scheduler semantics)."""
+    containers (limits win over requests, per scheduler semantics).
+    Malformed manifests — resources that aren't mappings, quantities
+    that aren't integers — contribute 0 with a warning, never an
+    exception."""
+    name = ((pod.get("metadata") or {}).get("name")) or "?"
     total = 0
     for c in ((pod.get("spec") or {}).get("containers") or []):
-        res = c.get("resources") or {}
-        v = (res.get("limits") or {}).get("google.com/tpu") or (
-            res.get("requests") or {}
-        ).get("google.com/tpu")
-        try:
-            total += int(v) if v is not None else 0
-        except (TypeError, ValueError):
+        if not isinstance(c, dict):
             continue
+        res = c.get("resources")
+        if not isinstance(res, dict):
+            if res is not None:
+                logger.warning(
+                    "pod %s: container resources is %s, not a mapping; "
+                    "counting 0 chips", name, type(res).__name__,
+                )
+            continue
+        limits = res.get("limits")
+        requests = res.get("requests")
+        v = None
+        if isinstance(limits, dict):
+            v = limits.get(TPU_RESOURCE)
+        if v is None and isinstance(requests, dict):
+            v = requests.get(TPU_RESOURCE)
+        total += parse_chip_quantity(v, where=f"pod {name}")
     return total
+
+
+def _slice_shape(selectors: dict, chips: int) -> str:
+    accel = selectors.get(TPU_ACCELERATOR_LABEL)
+    topo = selectors.get(TPU_TOPOLOGY_LABEL)
+    if accel and topo:
+        return f"{accel}/{topo}"
+    if accel:
+        return str(accel)
+    if topo:
+        return f"tpu/{topo}"
+    if chips:
+        return f"tpu-{chips}"
+    return "cpu"
 
 
 def pod_slice_shape(pod: dict) -> str:
@@ -198,18 +259,29 @@ def pod_slice_shape(pod: dict) -> str:
     `tpu-v5-lite-podslice/2x4`), else the chip count alone (`tpu-4`),
     else `cpu`."""
     sel = (pod.get("spec") or {}).get("nodeSelector") or {}
-    accel = sel.get("cloud.google.com/gke-tpu-accelerator")
-    topo = sel.get("cloud.google.com/gke-tpu-topology")
-    if accel and topo:
-        return f"{accel}/{topo}"
-    if accel:
-        return str(accel)
-    chips = pod_chip_count(pod)
-    if topo:
-        return f"tpu/{topo}"
-    if chips:
-        return f"tpu-{chips}"
-    return "cpu"
+    return _slice_shape(sel, pod_chip_count(pod))
+
+
+def node_chip_capacity(node: dict) -> int:
+    """`google.com/tpu` chips one Node offers (allocatable wins over
+    capacity — that's what the scheduler can actually place). Malformed
+    quantities count 0 with a warning, like pod_chip_count."""
+    name = ((node.get("metadata") or {}).get("name")) or "?"
+    status = node.get("status") or {}
+    for key in ("allocatable", "capacity"):
+        res = status.get(key)
+        if isinstance(res, dict) and TPU_RESOURCE in res:
+            return parse_chip_quantity(
+                res.get(TPU_RESOURCE), where=f"node {name}"
+            )
+    return 0
+
+
+def node_slice_shape(node: dict) -> str:
+    """Slice-shape key for one Node, from its GKE TPU labels (same
+    vocabulary as pod_slice_shape, so pod demand and node budget join)."""
+    labels = (node.get("metadata") or {}).get("labels") or {}
+    return _slice_shape(labels, node_chip_capacity(node))
 
 
 def job_is_complete(job: dict) -> bool:

@@ -114,7 +114,11 @@ class Manager:
         # engine reads go through the aggregator's snapshot (stale →
         # direct-scrape fallback), the front door serves /v1/fleet/* and
         # /v1/usage from them.
-        from kubeai_tpu.fleet import FleetStateAggregator, UsageMeter
+        from kubeai_tpu.fleet import (
+            CapacityPlanner,
+            FleetStateAggregator,
+            UsageMeter,
+        )
 
         self.usage = UsageMeter(metrics=self.metrics)
         self.fleet = FleetStateAggregator(
@@ -127,6 +131,31 @@ class Manager:
             interval_s=self.cfg.model_autoscaling.interval_seconds / 2.0,
         )
         self.autoscaler.fleet = self.fleet
+        # Cluster-wide capacity planner (kubeai_tpu/fleet/planner):
+        # bin-packs every model's desire onto the chip budget each tick;
+        # the autoscaler applies its allocations (stale plan → direct
+        # scaling), the front door serves it at /v1/fleet/plan.
+        self.planner = None
+        if self.cfg.capacity_planning.enabled:
+            self.planner = CapacityPlanner(
+                fleet=self.fleet,
+                model_client=self.model_client,
+                store=self.store,
+                cfg=self.cfg,
+                namespace=self.namespace,
+                metrics=self.metrics,
+                leader=self.leader,
+                interval_s=(
+                    self.cfg.capacity_planning.interval_seconds
+                    or self.cfg.model_autoscaling.interval_seconds
+                ),
+                preemption_enabled=self.cfg.capacity_planning.preemption,
+            )
+            # Plan desires smooth over the SAME moving average the
+            # direct scaling path uses — abundant chips must mean the
+            # plan is a no-op, not a subtly different controller.
+            self.planner.avg_lookup = self.autoscaler.current_average
+            self.autoscaler.planner = self.planner
         self.api_server = OpenAIServer(
             self.proxy,
             self.model_client,
@@ -135,6 +164,7 @@ class Manager:
             metrics=self.metrics,
             fleet=self.fleet,
             usage=self.usage,
+            planner=self.planner,
         )
         self.messengers: list[Messenger] = []
         # One broker per stream, chosen by URL scheme (gcppubsub://,
@@ -189,6 +219,8 @@ class Manager:
         self.controller_loop.start()
         self.leader.start()
         self.fleet.start()
+        if self.planner is not None:
+            self.planner.start()
         self.autoscaler.start()
         self.api_server.start()
         for m in self.messengers:
@@ -245,6 +277,8 @@ class Manager:
                 pass
         self.api_server.stop()
         self.autoscaler.stop()
+        if self.planner is not None:
+            self.planner.stop()
         self.fleet.stop()
         self.leader.stop()
         self.controller_loop.stop()

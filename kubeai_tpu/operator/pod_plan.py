@@ -62,7 +62,14 @@ def sort_pods_by_deletion_order(pods: list[dict], expected_hash: str) -> list[di
 
     def key(pod: dict):
         return (
-            # Disrupted pods (spot preemption / eviction / Failed) first:
+            # Capacity-planner preemption victims first: when the fleet
+            # planner shrinks this model to free chips for a higher
+            # scheduling class, the pods that die must be exactly its
+            # picks, not whichever pod the generic ordering reaches.
+            # With no plan present every pod lacks the annotation and
+            # the ordering below is unchanged.
+            not k8sutils.get_annotation(pod, md.PLANNER_PREEMPT_ANNOTATION),
+            # Disrupted pods (spot preemption / eviction / Failed) next:
             # they serve nothing and their node may already be gone.
             k8sutils.pod_disruption_reason(pod) is None,
             k8sutils.pod_is_ready(pod),  # not ready first

@@ -20,6 +20,7 @@ Plus operator endpoints:
   GET /healthz
   GET /v1/fleet/state   → fleet telemetry snapshot (kubeai_tpu/fleet)
   GET /v1/fleet/history → ring buffer of recent snapshots
+  GET /v1/fleet/plan    → latest capacity plan (kubeai_tpu/fleet/planner)
   GET /v1/usage?tenant= → per-tenant usage ledger summary
 
 Tenant attribution: every proxied request is attributed to a tenant
@@ -100,15 +101,18 @@ class OpenAIServer:
         metrics: Metrics = DEFAULT_METRICS,
         fleet=None,
         usage=None,
+        planner=None,
     ):
         self.proxy = proxy
         self.model_client = model_client
         self.metrics = metrics
         # Fleet telemetry plane (kubeai_tpu/fleet): the aggregator backs
         # /v1/fleet/*, the usage meter attributes every request to a
-        # tenant and backs /v1/usage. Both optional (embedded tests).
+        # tenant and backs /v1/usage, the capacity planner backs
+        # /v1/fleet/plan. All optional (embedded tests).
         self.fleet = fleet
         self.usage = usage
+        self.planner = planner
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -165,6 +169,16 @@ class OpenAIServer:
                             "object": "fleet.history",
                             "snapshots": outer.fleet.history(),
                         },
+                    )
+                if path in ("/v1/fleet/plan", "/openai/v1/fleet/plan"):
+                    if outer.planner is None:
+                        return self._respond_json(
+                            404,
+                            {"error": {"message":
+                                       "capacity planner not configured"}},
+                        )
+                    return self._respond_json(
+                        200, outer.planner.plan_payload()
                     )
                 if path in ("/v1/usage", "/openai/v1/usage"):
                     if outer.usage is None:

@@ -70,6 +70,13 @@ def pytest_configure(config):
         "tenant usage metering, step profiler, fake-clock fleet sim "
         "(runs in the fast tier; select with -m telemetry)",
     )
+    config.addinivalue_line(
+        "markers",
+        "planner: cluster capacity-planner suite — priority bin-packing "
+        "onto the chip budget, scheduling-class preemption, slice "
+        "right-sizing, fake-clock planner sim (runs in the fast tier; "
+        "select with -m planner)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -391,6 +391,60 @@ def test_autoscaler_queue_pressure_boosts_desired_replicas():
         srv.stop()
 
 
+def test_autoscaler_applies_plan_override_and_falls_back():
+    """The capacity plan is an override channel: a fresh allocation wins
+    over the model's solo desire (scaling_source: planner); a planner
+    answering None — stale plan, unknown model — reverts to the direct
+    path, and a crashing planner must not fail the tick."""
+
+    class StubPlanner:
+        def __init__(self):
+            self.alloc = {"replicas": 7, "class": "standard",
+                          "plan_ts": 1.0}
+
+        def allocation_for(self, name):
+            if isinstance(self.alloc, Exception):
+                raise self.alloc
+            return self.alloc
+
+    srv = FakeMetricsServer(metrics_text("m1", 20))  # solo desire: 2
+    try:
+        store, cfg, scaler = make_world([srv], interval=10, window=10)
+        planner = StubPlanner()
+        scaler.planner = planner
+        scaler.tick()
+        assert store.get("Model", "default", "m1")["spec"]["replicas"] == 7
+        rec = scaler.last_decisions[0]
+        assert rec["scaling_source"] == "planner"
+        assert rec["planner_replicas"] == 7
+        assert rec["computed_replicas"] == 2  # solo desire still logged
+
+        planner.alloc = None  # stale plan → direct fallback
+        scaler.tick()
+        assert store.get("Model", "default", "m1")["spec"]["replicas"] == 2
+        assert scaler.last_decisions[0]["scaling_source"] == "direct"
+
+        planner.alloc = RuntimeError("planner exploded")
+        scaler.tick()  # must not raise; direct path again
+        assert scaler.last_decisions[0]["scaling_source"] == "direct"
+    finally:
+        srv.stop()
+
+
+def test_ceil_div_matches_inline_idiom():
+    """ceil_div replaced the int(-(-x // y)) idiom across the scaler —
+    same values over the signal ranges the paths feed it."""
+    from kubeai_tpu.autoscaler.autoscaler import ceil_div
+
+    for x in (0, 1, 9, 10, 11, 99.5, 100.0):
+        for y in (1, 3, 10):
+            assert ceil_div(x, y) == int(-(-x // y))
+    with pytest.raises(ValueError):
+        ceil_div(1, 0)
+    with pytest.raises(ValueError):
+        ceil_div(1, -1)
+
+
 def test_scrape_queue_pressure_parses_engine_gauges():
     """The queue-pressure scrape sums per-class depth across engines,
     takes the max oldest-wait, and skips unreachable endpoints instead

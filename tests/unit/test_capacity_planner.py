@@ -1,0 +1,438 @@
+"""Cluster-wide capacity planner: priority bin-packing onto the chip
+budget, scheduling-class preemption, slice right-sizing, staleness
+fallback — deterministic sim invariants plus focused unit tests, plus
+the satellite hardening suites (ceil_div, pod_chip_count)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from testutil import http_get
+
+from kubeai_tpu.autoscaler.autoscaler import ceil_div
+from kubeai_tpu.config import System
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import Model, ModelSpec, Scheduling
+from kubeai_tpu.fleet import (
+    CapacityPlanner,
+    model_chips_per_replica,
+    model_scheduling_class,
+)
+from kubeai_tpu.metrics.registry import Metrics
+from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.loadbalancer import LoadBalancer
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.routing.openai_server import OpenAIServer
+from kubeai_tpu.routing.proxy import ModelProxy
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+)
+
+pytestmark = pytest.mark.planner
+
+
+# ---- deterministic sim (benchmarks/capacity_planner_sim.py) ------------------
+
+
+def test_capacity_planner_sim_invariants():
+    """Tier-1 contract: (a) no realtime SLO violation persists while
+    feasible chips sit idle, (b) batch preempted before realtime is
+    throttled, (c) allocated chips never exceed the inventory, (d)
+    abundant budget = no-op equivalence with the uncoordinated
+    autoscaler — plus right-sizing, joint disagg damping, preemption
+    marking, and stale-snapshot fallback."""
+    from benchmarks.capacity_planner_sim import ALL_CHECKS, run_sim
+
+    result = run_sim()
+    for check in ALL_CHECKS:
+        check(result)
+
+
+# ---- ceil_div (shared replicas-from-signal idiom) ----------------------------
+
+
+def test_ceil_div_values():
+    assert ceil_div(0, 1) == 0
+    assert ceil_div(1, 1) == 1
+    assert ceil_div(7, 2) == 4
+    assert ceil_div(8, 2) == 4
+    assert ceil_div(0.1, 1) == 1
+    assert ceil_div(35, 10) == 4
+    assert ceil_div(2.7, 0.8) == 4  # float target (utilization fraction)
+
+
+def test_ceil_div_zero_divisor_raises():
+    with pytest.raises(ValueError):
+        ceil_div(5, 0)
+
+
+def test_ceil_div_negative_divisor_raises():
+    with pytest.raises(ValueError):
+        ceil_div(5, -2)
+
+
+# ---- pod_chip_count hardening (satellite) ------------------------------------
+
+
+def _pod_with_resources(resources):
+    return {
+        "metadata": {"name": "p"},
+        "spec": {"containers": [{"name": "c", "resources": resources}]},
+    }
+
+
+def test_pod_chip_count_valid_shapes():
+    assert k8sutils.pod_chip_count(
+        _pod_with_resources({"limits": {"google.com/tpu": "4"}})
+    ) == 4
+    assert k8sutils.pod_chip_count(
+        _pod_with_resources({"requests": {"google.com/tpu": 8}})
+    ) == 8
+    # Limits win over requests (scheduler semantics).
+    assert k8sutils.pod_chip_count(
+        _pod_with_resources({
+            "limits": {"google.com/tpu": "2"},
+            "requests": {"google.com/tpu": "8"},
+        })
+    ) == 2
+    # The `4.0` float spelling of an integral quantity is tolerated.
+    assert k8sutils.pod_chip_count(
+        _pod_with_resources({"limits": {"google.com/tpu": "4.0"}})
+    ) == 4
+
+
+@pytest.mark.parametrize(
+    "resources",
+    [
+        {"limits": {"google.com/tpu": "four"}},  # non-numeric string
+        {"limits": {"google.com/tpu": "500m"}},  # milli-quantity
+        {"limits": {"google.com/tpu": "2.5"}},   # fractional chip
+        {"limits": {"google.com/tpu": "-4"}},    # negative
+        {"limits": {"google.com/tpu": None}},    # explicit null
+        {"limits": "bogus"},                     # limits not a mapping
+        "bogus",                                 # resources not a mapping
+        {},                                      # absent requests/limits
+        None,                                    # resources absent
+    ],
+)
+def test_pod_chip_count_malformed_counts_zero(resources):
+    """Every malformed shape returns 0 with a warning — never raises —
+    so one bad manifest cannot blind the fleet chip inventory."""
+    assert k8sutils.pod_chip_count(_pod_with_resources(resources)) == 0
+
+
+def test_pod_chip_count_malformed_container_does_not_blind_others():
+    pod = {
+        "metadata": {"name": "p"},
+        "spec": {"containers": [
+            {"name": "bad", "resources": {"limits": {"google.com/tpu": "x"}}},
+            {"name": "good", "resources": {"limits": {"google.com/tpu": "4"}}},
+            "not-a-container",
+        ]},
+    }
+    assert k8sutils.pod_chip_count(pod) == 4
+
+
+def test_pod_chip_count_missing_spec():
+    assert k8sutils.pod_chip_count({}) == 0
+    assert k8sutils.pod_chip_count({"spec": {}}) == 0
+
+
+def test_node_chip_capacity_and_shape():
+    node = {
+        "metadata": {"name": "n", "labels": {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": "2x4",
+        }},
+        "status": {"allocatable": {"google.com/tpu": "8"}},
+    }
+    assert k8sutils.node_chip_capacity(node) == 8
+    assert k8sutils.node_slice_shape(node) == "tpu-v5-lite-podslice/2x4"
+    # Allocatable wins over capacity; malformed counts zero.
+    node["status"] = {
+        "allocatable": {"google.com/tpu": "oops"},
+        "capacity": {"google.com/tpu": "8"},
+    }
+    assert k8sutils.node_chip_capacity(node) == 0
+    assert k8sutils.node_chip_capacity({"metadata": {"name": "n"}}) == 0
+
+
+# ---- planner unit behavior ---------------------------------------------------
+
+
+def _mk_model(name, cls="standard", replicas=1, **kw):
+    return Model(
+        name=name,
+        spec=ModelSpec(
+            url="hf://org/x", engine="KubeAITPU",
+            features=["TextGeneration"], replicas=replicas,
+            min_replicas=0, max_replicas=10, target_requests=10,
+            scale_down_delay_seconds=0,
+            scheduling=Scheduling(default_priority=cls),
+            **kw,
+        ),
+    )
+
+
+def test_model_scheduling_class_defaults():
+    assert model_scheduling_class(_mk_model("a", "realtime")) == "realtime"
+    assert model_scheduling_class(_mk_model("a", "batch")) == "batch"
+    m = _mk_model("a")
+    m.spec.scheduling.default_priority = ""
+    assert model_scheduling_class(m) == "standard"
+
+
+def test_model_chips_per_replica_sources():
+    m = _mk_model("a")
+    # Observed pods win.
+    assert model_chips_per_replica(
+        m, None, {"total": 2, "chips": 8}
+    ) == 4
+    # Resource-profile fallback: name:count multiplies the profile chips.
+    cfg = System()
+    cfg.default_and_validate()
+    from kubeai_tpu.config.system import ResourceProfile
+
+    cfg.resource_profiles["tpu-v5e"] = ResourceProfile(
+        requests={"google.com/tpu": "4"}
+    )
+    m.spec.resource_profile = "tpu-v5e:2"
+    assert model_chips_per_replica(m, cfg, {}) == 8
+    # Nothing sizable → 1 (a replica still costs something).
+    m.spec.resource_profile = ""
+    assert model_chips_per_replica(m, cfg, {}) == 1
+
+
+class _StubFleet:
+    def __init__(self, snap):
+        self.snap = snap
+
+    def snapshot(self):
+        return self.snap
+
+
+def _snapshot(ts, models=None, budget=None):
+    return {
+        "ts": ts,
+        "models": models or {},
+        "chips": {
+            "total": 0, "by_shape": {}, "pods_by_shape": {},
+            "budget": budget or {
+                "total": 0, "by_shape": {}, "nodes_by_shape": {},
+                "slice_chips": {},
+            },
+        },
+    }
+
+
+def _planner(store, snap, clock_now=1000.0, **kw):
+    mc = ModelClient(store)
+    return CapacityPlanner(
+        fleet=_StubFleet(snap), model_client=mc, store=store,
+        metrics=Metrics(), interval_s=1.0, staleness_s=3.0,
+        clock=lambda: clock_now, **kw,
+    )
+
+
+def test_unknown_budget_plans_unconstrained():
+    """A cluster with no Node chip capacity has an unknown budget: the
+    plan allocates every desire, preempts nothing — pre-planner
+    behavior, not a zero-capacity lockdown."""
+    store = KubeStore()
+    store.create(_mk_model("m", "batch", replicas=3).to_dict())
+    snap = _snapshot(1000.0)
+    p = _planner(store, snap)
+    plan = p.tick()
+    assert plan is not None and plan["budget_known"] is False
+    rec = plan["models"]["m"]
+    assert rec["allocated_replicas"] == rec["target_replicas"]
+    assert rec["preempted_replicas"] == 0
+    assert p.allocation_for("m") == {
+        "replicas": rec["allocated_replicas"], "class": "batch",
+        "plan_ts": plan["ts"],
+    }
+
+
+def test_fixed_models_reserve_chips_off_the_top():
+    """An autoscaling-disabled model is not under plan control but its
+    chips reduce what arbitration can hand out."""
+    store = KubeStore()
+    fixed = _mk_model("fixed", "standard", replicas=2)
+    fixed.spec.autoscaling_disabled = True
+    store.create(fixed.to_dict())
+    store.create(_mk_model("wants", "realtime", replicas=1).to_dict())
+    budget = {
+        "total": 12, "by_shape": {"s4": 12}, "nodes_by_shape": {"s4": 3},
+        "slice_chips": {"s4": 4},
+    }
+    models = {
+        "fixed": {"pods": {"total": 2, "chips": 8},
+                  "replicas": {"unified": 2}, "endpoints": {},
+                  "queue": {"depth": 0, "oldest_wait_s": 0,
+                            "per_class": {}}},
+        "wants": {"pods": {"total": 1, "chips": 4},
+                  "replicas": {"unified": 1},
+                  "endpoints": {
+                      "a:1": {"stale": False, "active_requests": 25.0},
+                  },
+                  "queue": {"depth": 0, "oldest_wait_s": 0,
+                            "per_class": {}}},
+    }
+    p = _planner(store, _snapshot(1000.0, models, budget))
+    plan = p.tick()
+    f = plan["models"]["fixed"]
+    assert f["kind"] == "fixed" and f["chips_allocated"] == 8
+    assert p.allocation_for("fixed") is None  # not under plan control
+    w = plan["models"]["wants"]
+    # 25 active / 10 target = 3 desired, but only 4 chips remain after
+    # the fixed reservation.
+    assert w["desired_replicas"] == 3
+    assert w["allocated_replicas"] == 1
+    assert w["throttled_replicas"] == 2
+    assert plan["allocated_chips"]["total"] == 12
+
+
+def test_allocation_for_goes_stale_with_the_clock():
+    store = KubeStore()
+    store.create(_mk_model("m", "standard", replicas=1).to_dict())
+    now = {"t": 1000.0}
+    mc = ModelClient(store)
+    p = CapacityPlanner(
+        fleet=_StubFleet(_snapshot(1000.0)), model_client=mc,
+        store=store, metrics=Metrics(), interval_s=1.0, staleness_s=3.0,
+        clock=lambda: now["t"],
+    )
+    assert p.tick() is not None
+    assert p.allocation_for("m") is not None
+    now["t"] = 1010.0  # plan aged past staleness
+    assert p.allocation_for("m") is None
+    # And a stale SNAPSHOT refuses to plan at all.
+    assert p.tick() is None
+    assert p.metrics.planner_stale_ticks.get() >= 1
+
+
+def test_leader_gating_and_forced_tick():
+    class Follower:
+        is_leader = False
+
+    store = KubeStore()
+    store.create(_mk_model("m").to_dict())
+    p = _planner(store, _snapshot(1000.0), leader=Follower())
+    assert p.tick() is None  # followers do not plan...
+    assert p.tick(force=True) is not None  # ...unless forced (reads)
+
+
+def test_plan_endpoint_real_http():
+    """Acceptance: GET /v1/fleet/plan serves the latest plan with the
+    budget/allocation arithmetic; 404 when no planner is configured."""
+    store = KubeStore()
+    store.create(_mk_model("m", "realtime", replicas=1).to_dict())
+    metrics = Metrics()
+    mc = ModelClient(store)
+    lb = LoadBalancer(store)
+    budget = {
+        "total": 8, "by_shape": {"s4": 8}, "nodes_by_shape": {"s4": 2},
+        "slice_chips": {"s4": 4},
+    }
+    models = {
+        "m": {"pods": {"total": 1, "chips": 4},
+              "replicas": {"unified": 1},
+              "endpoints": {"a:1": {"stale": False,
+                                    "active_requests": 15.0}},
+              "queue": {"depth": 0, "oldest_wait_s": 0, "per_class": {}}},
+    }
+    planner = CapacityPlanner(
+        fleet=_StubFleet(_snapshot(1000.0, models, budget)),
+        model_client=mc, store=store, metrics=metrics,
+        interval_s=1.0, staleness_s=3.0, clock=lambda: 1000.0,
+    )
+    server = OpenAIServer(
+        ModelProxy(lb, mc, metrics=metrics), mc, metrics=metrics,
+        planner=planner,
+    )
+    server.start()
+    try:
+        status, body = http_get(
+            f"127.0.0.1:{server.port}", "/v1/fleet/plan", timeout=30
+        )
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["object"] == "fleet.plan"
+        assert payload["plan_available"] is True
+        assert payload["budget"]["total"] == 8
+        assert payload["models"]["m"]["allocated_replicas"] == 2
+        assert payload["models"]["m"]["telemetry_source"] == "aggregator"
+
+        bare = OpenAIServer(
+            ModelProxy(lb, mc, metrics=metrics), mc, metrics=metrics
+        )
+        bare.start()
+        try:
+            status, _ = http_get(
+                f"127.0.0.1:{bare.port}", "/v1/fleet/plan", timeout=30
+            )
+            assert status == 404
+        finally:
+            bare.stop()
+    finally:
+        server.stop()
+
+
+def test_preempt_annotation_round_trip():
+    """Victim marking is idempotent and self-clearing: pods marked while
+    preempted, unmarked once the model is no longer squeezed."""
+    store = KubeStore()
+    store.create(_mk_model("b", "batch", replicas=2).to_dict())
+    for j in range(2):
+        store.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {
+                "name": f"model-b-{j}", "namespace": "default",
+                "labels": {md.POD_MODEL_LABEL: "b"},
+                "creationTimestamp": float(j),
+            },
+            "spec": {"containers": [{
+                "name": "s",
+                "resources": {"limits": {"google.com/tpu": "4"}},
+            }]},
+            "status": {},
+        })
+    budget = {
+        "total": 4, "by_shape": {"s4": 4}, "nodes_by_shape": {"s4": 1},
+        "slice_chips": {"s4": 4},
+    }
+    models = {
+        "b": {"pods": {"total": 2, "chips": 8},
+              "replicas": {"unified": 2},
+              "endpoints": {"a:1": {"stale": False,
+                                    "active_requests": 20.0}},
+              "queue": {"depth": 0, "oldest_wait_s": 0, "per_class": {}}},
+    }
+    p = _planner(store, _snapshot(1000.0, models, budget))
+    plan = p.tick()
+    rec = plan["models"]["b"]
+    assert rec["desired_replicas"] == 2 and rec["allocated_replicas"] == 1
+    assert rec["preempted_replicas"] == 1
+    marked = [
+        pod["metadata"]["name"]
+        for pod in store.list("Pod", "default")
+        if k8sutils.get_annotation(pod, md.PLANNER_PREEMPT_ANNOTATION)
+    ]
+    assert marked == ["model-b-1"], "youngest pod is the victim"
+    # Demand collapses → allocation covers current → marks clear.
+    models["b"]["endpoints"]["a:1"]["active_requests"] = 0.0
+    models["b"]["pods"] = {"total": 1, "chips": 4}
+    models["b"]["replicas"] = {"unified": 1}
+    p.tick()
+    marked = [
+        pod["metadata"]["name"]
+        for pod in store.list("Pod", "default")
+        if k8sutils.get_annotation(pod, md.PLANNER_PREEMPT_ANNOTATION)
+    ]
+    assert marked == []

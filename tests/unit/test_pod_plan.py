@@ -148,6 +148,79 @@ def test_deletion_order_full_priority():
     ]
 
 
+def test_planner_preempt_marks_delete_first():
+    """Capacity-planner preemption victims (kubeai.org/planner-preempt)
+    beat every other deletion criterion — a marked READY up-to-date pod
+    deletes before not-ready, unscheduled, and old-hash pods."""
+    h = current_hash()
+    marked = mk_pod("marked-ready", h, ready=True, created=1)
+    marked["metadata"]["annotations"] = {
+        md.PLANNER_PREEMPT_ANNOTATION: md.PREEMPT_REASON_CAPACITY
+    }
+    pods = [
+        mk_pod("ready", h, ready=True, created=2),
+        mk_pod("ready-oldhash", "old", ready=True, created=5),
+        mk_pod("unscheduled", h, ready=False, scheduled=False, created=3),
+        mk_pod("notready", h, ready=False, scheduled=True, created=2),
+        marked,
+    ]
+    ordered = [
+        p["metadata"]["name"] for p in sort_pods_by_deletion_order(pods, h)
+    ]
+    assert ordered[0] == "marked-ready"
+    assert ordered[1:] == ["unscheduled", "notready", "ready-oldhash",
+                           "ready"]
+
+
+def test_planner_preempt_marked_pod_is_the_scale_down_choice():
+    """When the autoscaler applies a shrunken plan allocation, the pod
+    the plan deletes is exactly the marked victim, not the youngest."""
+    h = current_hash()
+    victim = mk_pod("victim-oldest", h, ready=True, created=1)
+    victim["metadata"]["annotations"] = {
+        md.PLANNER_PREEMPT_ANNOTATION: md.PREEMPT_REASON_CAPACITY
+    }
+    pods = [
+        victim,
+        mk_pod("keeper-young", h, ready=True, created=10),
+        mk_pod("keeper-mid", h, ready=True, created=5),
+    ]
+    plan = calculate_pod_plan(pods, mk_model(replicas=2), desired_pod(),
+                              surge=1)
+    assert [p["metadata"]["name"] for p in plan.to_delete] == [
+        "victim-oldest"
+    ]
+    assert not plan.to_create
+
+
+def test_deletion_order_stable_without_plan_annotations():
+    """Regression guard: with no planner marks present the ordering is
+    byte-identical to the pre-planner priority (disrupted → not-ready →
+    unscheduled → old-hash → youngest)."""
+    h = current_hash()
+    disrupted = mk_pod("disrupted", h, ready=False, created=7)
+    disrupted["status"]["reason"] = "Preempted"
+    pods = [
+        mk_pod("ready-old-age", h, ready=True, created=1),
+        mk_pod("ready-young", h, ready=True, created=10),
+        mk_pod("ready-oldhash", "old", ready=True, created=5),
+        mk_pod("unscheduled", h, ready=False, scheduled=False, created=3),
+        mk_pod("notready", h, ready=False, scheduled=True, created=2),
+        disrupted,
+    ]
+    ordered = [
+        p["metadata"]["name"] for p in sort_pods_by_deletion_order(pods, h)
+    ]
+    assert ordered == [
+        "disrupted",
+        "unscheduled",
+        "notready",
+        "ready-oldhash",
+        "ready-young",
+        "ready-old-age",
+    ]
+
+
 def test_json_patch_applies_to_rendered_pod():
     from kubeai_tpu.operator.patch import apply_json_patches
 
