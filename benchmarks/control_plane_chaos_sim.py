@@ -1,0 +1,677 @@
+"""Deterministic control-plane chaos simulation — fake clocks, no JAX.
+
+PR 3/5 proved the data path survives endpoint death; this sim proves the
+CONTROL PLANE survives its own failure modes. Four phases drive the real
+operator components (ModelReconciler, ModelClient, ActuationGovernor,
+LeaderElection, RestKubeClient against FakeKubeApiServer) through
+scheduled chaos and report the invariants the fault-tolerance work
+promises:
+
+  * split-brain: two operators share one store; leadership hands over
+    mid-flight. ZERO duplicate actuations — the fenced (expired) leader
+    creates and deletes nothing, ever;
+  * corrupt/stale telemetry: a scale request driven by a corrupt fleet
+    snapshot can never scale a model to zero, and healthy-pod deletions
+    never exceed the per-model/cluster disruption budget per window;
+    with the snapshot fully stale, static stability holds — zero
+    healthy pods die;
+  * API-server storms: the reconciler converges through a 409 conflict
+    storm and a 429 rate-limit storm (Retry-After honored) within the
+    client's bounded retry budget, over real HTTP;
+  * crash/restart: an operator restart with stale telemetry rehydrates
+    last-known-good state from cluster annotations and deletes ZERO
+    healthy pods, and an in-flight repair backoff survives the restart
+    (no duplicate repairs).
+
+`tests/unit/test_control_plane.py` asserts these invariants in tier-1.
+Run directly for the full report:
+
+    python benchmarks/control_plane_chaos_sim.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeai_tpu.config import System
+from kubeai_tpu.config.system import GovernorConfig
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import Model, ModelSpec
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.autoscaler.leader import LeaderElection
+from kubeai_tpu.operator.controller import ModelReconciler
+from kubeai_tpu.operator.governor import ActuationGovernor, NotLeader
+from kubeai_tpu.operator.k8s import rest as rest_mod
+from kubeai_tpu.operator.k8s.envtest import FakeKubeApiServer
+from kubeai_tpu.operator.k8s.rest import RestKubeClient
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.testing.faults import ApiFault, ApiFaultPlan, FakeClock
+
+
+class StubFleet:
+    """Controllable telemetry-coverage source with the aggregator's
+    `model_coverage` contract: (coverage fraction, snapshot_fresh)."""
+
+    def __init__(self, coverage: float = 1.0, fresh: bool = True):
+        self.coverage = coverage
+        self.fresh = fresh
+
+    def model_coverage(self, model: str):
+        return (self.coverage, self.fresh)
+
+
+def _mk_model(
+    store, name: str = "sim", replicas: int = 2, min_replicas: int = 0
+) -> None:
+    m = Model(
+        name=name,
+        spec=ModelSpec(
+            url="hf://org/model",
+            engine="KubeAITPU",
+            features=["TextGeneration"],
+            resource_profile="google-tpu-v5e-1x1:1",
+            autoscaling_disabled=False,
+            min_replicas=min_replicas,
+            replicas=replicas,
+            scale_down_delay_seconds=0,
+        ),
+    )
+    m.validate()
+    store.create(m.to_dict())
+
+
+def _mark_all_ready(store, model: str = "sim") -> None:
+    for pod in store.list("Pod", "default", {md.POD_MODEL_LABEL: model}):
+        fresh = store.get("Pod", "default", pod["metadata"]["name"])
+        fresh.setdefault("status", {})["conditions"] = [
+            {"type": "Ready", "status": "True"},
+            {"type": "PodScheduled", "status": "True"},
+        ]
+        fresh["status"]["phase"] = "Running"
+        store.update(fresh)
+
+
+def _pod_names(store, model: str = "sim") -> set[str]:
+    return {
+        p["metadata"]["name"]
+        for p in store.list("Pod", "default", {md.POD_MODEL_LABEL: model})
+    }
+
+
+# ---- phase 1: dual-operator split-brain --------------------------------------
+
+
+def run_split_brain_phase(replicas: int = 2) -> dict:
+    """Two full reconcile stacks (A and B) on one store, each fenced by
+    its own LeaderElection against the SAME Lease. A holds leadership
+    and actuates; then A is partitioned (stops renewing), B takes the
+    lease over, and both keep reconciling. Every create/delete is
+    counted per operator: the handover must produce exactly one
+    operator's worth of actuation — zero duplicates."""
+    store = KubeStore()
+    cfg = System()
+    cfg.default_and_validate()
+    mono = FakeClock(100.0)
+    wall = FakeClock(1_000_000.0)
+
+    def mk_operator(identity: str):
+        metrics = Metrics()
+        leader = LeaderElection(
+            store, identity, lease_duration=15.0, retry_period=2.0,
+            renew_deadline=10.0, metrics=metrics, clock=mono, wall=wall,
+        )
+        gov = ActuationGovernor(
+            cfg=GovernorConfig(), leader=leader, store=store,
+            metrics=metrics, clock=mono,
+        )
+        rec = ModelReconciler(
+            store, cfg, metrics=metrics, clock=mono, wall=wall,
+            governor=gov,
+        )
+        return leader, gov, rec, metrics
+
+    leader_a, _gov_a, rec_a, metrics_a = mk_operator("op-a")
+    leader_b, _gov_b, rec_b, metrics_b = mk_operator("op-b")
+
+    def reconcile(rec) -> bool:
+        """True when the pass actuated (not fenced)."""
+        try:
+            rec.reconcile("default", "sim")
+            return True
+        except NotLeader:
+            return False
+
+    fenced_attempts = 0
+    _mk_model(store, replicas=replicas)
+    # A wins the election and actuates; B is standby and must not.
+    leader_a._try_acquire_or_renew()
+    leader_b._try_acquire_or_renew()
+    assert leader_a.is_leader and not leader_b.is_leader
+    if not reconcile(rec_b):
+        fenced_attempts += 1
+    reconcile(rec_a)
+    _mark_all_ready(store)
+    reconcile(rec_a)
+
+    # Partition A: it stops renewing. Clocks advance past the lease
+    # duration; B takes over; A's local fence expires strictly before
+    # B could have acquired (renew_deadline < lease_duration).
+    mono.advance(16.0)
+    wall.advance(16.0)
+    leader_b._try_acquire_or_renew()
+    handover_ok = leader_b.is_leader and not leader_a.fence_valid()
+
+    # Both keep reconciling the converged world — and then a rollback
+    # temptation: A (stale leader) also tries to act on a model whose
+    # pods B already manages. A must be fenced on every attempt.
+    for _ in range(3):
+        if not reconcile(rec_a):
+            fenced_attempts += 1
+        reconcile(rec_b)
+
+    def count(metrics, action):
+        return metrics.governor_actions.get(action=action, model="sim")
+
+    creates = count(metrics_a, "create") + count(metrics_b, "create")
+    deletes = count(metrics_a, "delete") + count(metrics_b, "delete")
+    return {
+        "replicas_desired": replicas,
+        "pods_final": len(_pod_names(store)),
+        "creates_total": int(creates),
+        "creates_by_stale_leader": int(count(metrics_a, "create")) if (
+            not leader_a.is_leader
+        ) else int(count(metrics_b, "create")),
+        "deletes_total": int(deletes),
+        "fenced_attempts": fenced_attempts,
+        "fenced_writes_metric": int(
+            metrics_a.leader_fenced_writes.get()
+            + metrics_b.leader_fenced_writes.get()
+        ),
+        "handover_ok": bool(handover_ok),
+        "duplicate_actuations": int(creates) - replicas + int(deletes),
+    }
+
+
+# ---- phase 2: corrupt / stale telemetry vs. budgets --------------------------
+
+
+def run_telemetry_phase(
+    start_replicas: int = 6,
+    model_budget: int = 2,
+    cluster_budget: int = 3,
+    window_s: float = 60.0,
+) -> dict:
+    """A corrupt fleet snapshot (coverage ~0, but 'fresh') drives a
+    scale-to-zero request: the governor must clamp it to one replica,
+    and the reconciler's healthy-pod deletions must never exceed the
+    per-model budget per window (convergence happens across windows).
+    Then the snapshot goes fully STALE: static stability — zero healthy
+    pods deleted. Finally two models under one cluster budget: their
+    combined deletions per window respect the cluster bound."""
+    cfg = System()
+    cfg.default_and_validate()
+    mono = FakeClock(100.0)
+    wall = FakeClock(1_000_000.0)
+    gcfg = GovernorConfig(
+        window_seconds=window_s,
+        model_disruption_budget=model_budget,
+        cluster_disruption_budget=cluster_budget,
+        min_telemetry_coverage=0.9,
+    )
+
+    store = KubeStore()
+    fleet = StubFleet(coverage=1.0, fresh=True)
+    metrics = Metrics()
+    gov = ActuationGovernor(
+        cfg=gcfg, fleet=fleet, store=store, metrics=metrics, clock=mono,
+    )
+    rec = ModelReconciler(
+        store, cfg, metrics=metrics, clock=mono, wall=wall, governor=gov,
+    )
+    client = ModelClient(store)
+    client.governor = gov
+
+    _mk_model(store, replicas=start_replicas)
+    rec.reconcile("default", "sim")
+    _mark_all_ready(store)
+    rec.reconcile("default", "sim")
+    assert len(_pod_names(store)) == start_replicas
+
+    # Corrupt snapshot: telemetry coverage collapses but the snapshot
+    # itself is fresh — a plausible mass-scale-down trigger.
+    fleet.coverage = 0.05
+    applied = client.scale("sim", 0)
+    spec_replicas = store.get("Model", "default", "sim")["spec"]["replicas"]
+
+    deletions_per_window: list[int] = []
+    pods_trace: list[int] = [len(_pod_names(store))]
+    min_pods_seen = len(_pod_names(store))
+    for _ in range(4):
+        before = len(_pod_names(store))
+        # Several reconcile passes within ONE window share the budget.
+        for _ in range(3):
+            rec.reconcile("default", "sim")
+            min_pods_seen = min(min_pods_seen, len(_pod_names(store)))
+        after = len(_pod_names(store))
+        deletions_per_window.append(before - after)
+        pods_trace.append(after)
+        mono.advance(window_s + 1.0)
+        wall.advance(window_s + 1.0)
+
+    converged_pods = len(_pod_names(store))
+
+    # Fully stale snapshot: static stability. Rebuild a fresh world and
+    # try the same scale-down with telemetry gone dark.
+    store2 = KubeStore()
+    fleet2 = StubFleet(coverage=1.0, fresh=True)
+    metrics2 = Metrics()
+    mono2 = FakeClock(100.0)
+    wall2 = FakeClock(1_000_000.0)
+    gov2 = ActuationGovernor(
+        cfg=gcfg, fleet=fleet2, store=store2, metrics=metrics2,
+        clock=mono2,
+    )
+    rec2 = ModelReconciler(
+        store2, cfg, metrics=metrics2, clock=mono2, wall=wall2,
+        governor=gov2,
+    )
+    client2 = ModelClient(store2)
+    client2.governor = gov2
+    _mk_model(store2, replicas=start_replicas)
+    rec2.reconcile("default", "sim")
+    _mark_all_ready(store2)
+    rec2.reconcile("default", "sim")
+    fleet2.fresh = False  # aggregator dead: no snapshot at all
+    stale_applied = client2.scale("sim", 1)
+    stale_spec = store2.get("Model", "default", "sim")["spec"]["replicas"]
+    for _ in range(3):
+        rec2.reconcile("default", "sim")
+    stale_pods = len(_pod_names(store2))
+    static_holds = int(metrics2.governor_static_holds.get(model="sim"))
+
+    # Cluster budget across two models in one window.
+    store3 = KubeStore()
+    fleet3 = StubFleet(coverage=1.0, fresh=True)
+    metrics3 = Metrics()
+    mono3 = FakeClock(100.0)
+    wall3 = FakeClock(1_000_000.0)
+    gov3 = ActuationGovernor(
+        cfg=GovernorConfig(
+            window_seconds=window_s,
+            model_disruption_budget=10,
+            cluster_disruption_budget=cluster_budget,
+            min_telemetry_coverage=0.9,
+        ),
+        fleet=fleet3, store=store3, metrics=metrics3, clock=mono3,
+    )
+    rec3 = ModelReconciler(
+        store3, cfg, metrics=metrics3, clock=mono3, wall=wall3,
+        governor=gov3,
+    )
+    client3 = ModelClient(store3)
+    client3.governor = gov3
+    for name in ("ma", "mb"):
+        _mk_model(store3, name=name, replicas=4)
+        rec3.reconcile("default", name)
+        _mark_all_ready(store3, name)
+        rec3.reconcile("default", name)
+    for name in ("ma", "mb"):
+        client3.scale(name, 1)
+        rec3.reconcile("default", name)
+    cluster_deletions = sum(
+        4 - len(_pod_names(store3, name)) for name in ("ma", "mb")
+    )
+
+    return {
+        "start_replicas": start_replicas,
+        "model_budget": model_budget,
+        "cluster_budget": cluster_budget,
+        "scale_to_zero_applied": applied,
+        "spec_after_corrupt_scale": spec_replicas,
+        "deletions_per_window": deletions_per_window,
+        "pods_trace": pods_trace,
+        "min_pods_seen": min_pods_seen,
+        "converged_pods": converged_pods,
+        "stale_scale_applied": stale_applied,
+        "stale_spec_replicas": stale_spec,
+        "stale_pods_final": stale_pods,
+        "stale_static_holds": static_holds,
+        "cluster_deletions_one_window": cluster_deletions,
+    }
+
+
+# ---- phase 3: API-server conflict + rate-limit storms ------------------------
+
+
+def run_storm_phase(
+    replicas: int = 2,
+    conflict_storm: int = 3,
+    storm_429: int = 2,
+    storm_5xx: int = 2,
+) -> dict:
+    """The real reconciler drives the real RestKubeClient over real HTTP
+    against the conformance fake API server, which 409s the first
+    `conflict_storm` status PATCHes, 429s (with Retry-After) the first
+    `storm_429` requests per pod verb, and 500s the first `storm_5xx`
+    pod LISTs. The reconciler must converge to the desired replica set
+    within the client's bounded retry budget — no retry exhaustion, no
+    unbounded sleeps."""
+    plan = ApiFaultPlan(
+        [
+            ApiFault(
+                method="PATCH", plural="models", kind="http", status=409,
+                reason="Conflict", message="injected conflict storm",
+                start=1, end=conflict_storm,
+            ),
+            ApiFault(
+                method="POST", plural="pods", kind="http", status=429,
+                reason="TooManyRequests", headers={"Retry-After": "0.01"},
+                start=1, end=storm_429,
+            ),
+            ApiFault(
+                method="GET", plural="pods", watch=False, kind="http",
+                status=500, reason="InternalError",
+                start=1, end=storm_5xx,
+            ),
+        ]
+    )
+    srv = FakeKubeApiServer(fault_plan=plan)
+    delays: list[float] = []
+    client = RestKubeClient(
+        srv.url, token="t", max_attempts=5,
+        backoff_base=0.01, backoff_max=0.05,
+    )
+    client.metrics = Metrics()
+    client._sleep = lambda s: delays.append(s)
+    prev_jitter = rest_mod._jitter
+    rest_mod._jitter = lambda: 1.0  # deterministic backoff
+    try:
+        cfg = System()
+        cfg.default_and_validate()
+        rec = ModelReconciler(client, cfg, metrics=Metrics())
+        _mk_model(client, replicas=replicas)
+        rec.reconcile("default", "sim")
+        pods = len(_pod_names(client))
+    finally:
+        rest_mod._jitter = prev_jitter
+        srv.close()
+    m = client.metrics
+    return {
+        "replicas_desired": replicas,
+        "pods_final": pods,
+        "retries_conflict": int(
+            m.kubeclient_retries.get(verb="PATCH", reason="conflict")
+        ),
+        "retries_429": int(
+            m.kubeclient_retries.get(verb="POST", reason="429")
+        ),
+        "retries_5xx": int(
+            m.kubeclient_retries.get(verb="GET", reason="5xx")
+        ),
+        "retry_exhausted": int(
+            sum(
+                m.kubeclient_retry_exhausted.get(verb=v)
+                for v in ("GET", "POST", "PUT", "PATCH", "DELETE")
+            )
+        ),
+        "sleeps": delays,
+        "max_sleep_s": max(delays, default=0.0),
+        "backoff_cap_s": 0.05,
+        "retry_after_honored": 0.01 in delays,
+    }
+
+
+# ---- phase 4: operator crash / restart ---------------------------------------
+
+
+def run_restart_phase(replicas: int = 3) -> dict:
+    """Operator 1 runs a healthy model (telemetry fresh), applying a
+    scale and recording last-known-good state on the cluster; it also
+    starts a repair-backoff streak. Then it CRASHES — every in-memory
+    structure is gone. Operator 2 boots against the same store with
+    telemetry now STALE: it must rehydrate last-known-good from
+    annotations, hold all scale-downs, delete zero healthy pods, and
+    honor the persisted repair backoff instead of issuing a duplicate
+    repair."""
+    cfg = System()
+    cfg.default_and_validate()
+    gcfg = GovernorConfig(min_telemetry_coverage=0.9)
+    store = KubeStore()
+    wall = FakeClock(1_000_000.0)
+
+    # ---- operator 1 (healthy life) ----
+    mono1 = FakeClock(100.0)
+    fleet1 = StubFleet(coverage=1.0, fresh=True)
+    metrics1 = Metrics()
+    gov1 = ActuationGovernor(
+        cfg=gcfg, fleet=fleet1, store=store, metrics=metrics1, clock=mono1,
+    )
+    rec1 = ModelReconciler(
+        store, cfg, metrics=metrics1, clock=mono1, wall=wall, governor=gov1,
+    )
+    client1 = ModelClient(store)
+    client1.governor = gov1
+    _mk_model(store, replicas=1)
+    client1.scale("sim", replicas)  # healthy apply → lkg annotation
+    rec1.reconcile("default", "sim")
+    _mark_all_ready(store)
+    rec1.reconcile("default", "sim")
+
+    # Start a repair streak: one pod breaks; op1 repairs it (streak=1,
+    # persisted), and its replacement breaks again just before the crash.
+    victim = sorted(_pod_names(store))[0]
+    pod = store.get("Pod", "default", victim)
+    pod["status"] = {
+        "phase": "Failed", "reason": "Preempted",
+        "conditions": [{"type": "Ready", "status": "False"}],
+    }
+    store.update(pod)
+    rec1.reconcile("default", "sim")
+    repairs_op1 = int(
+        metrics1.controller_pod_replacements.get(
+            model="sim", reason="SpotPreemption"
+        )
+    )
+    _mark_all_ready(store)
+    new_victim = sorted(_pod_names(store))[0]
+    pod = store.get("Pod", "default", new_victim)
+    pod["status"] = {
+        "phase": "Failed", "reason": "Preempted",
+        "conditions": [{"type": "Ready", "status": "False"}],
+    }
+    store.update(pod)
+    wall.advance(1.0)
+
+    # ---- CRASH: operator 2 boots; telemetry is stale ----
+    mono2 = FakeClock(5000.0)  # fresh process: unrelated monotonic origin
+    fleet2 = StubFleet(coverage=0.0, fresh=False)
+    metrics2 = Metrics()
+    gov2 = ActuationGovernor(
+        cfg=gcfg, fleet=fleet2, store=store, metrics=metrics2, clock=mono2,
+    )
+    rehydrated = gov2.rehydrate()
+    rec2 = ModelReconciler(
+        store, cfg, metrics=metrics2, clock=mono2, wall=wall, governor=gov2,
+    )
+    client2 = ModelClient(store)
+    client2.governor = gov2
+
+    healthy_before = _pod_names(store) - {new_victim}
+    # A cold autoscaler (empty moving average) would want zero.
+    client2.scale("sim", 0)
+    rec2.reconcile("default", "sim")
+    repairs_immediately_after_restart = int(
+        metrics2.controller_pod_replacements.get(
+            model="sim", reason="SpotPreemption"
+        )
+    )
+    healthy_after = _pod_names(store) - {new_victim}
+    spec_after = store.get("Model", "default", "sim")["spec"]["replicas"]
+
+    # Past the persisted backoff the repair proceeds (still zero healthy
+    # deletions — repair is exempt from budgets but not from sanity).
+    mono2.advance(60.0)
+    wall.advance(60.0)
+    rec2.reconcile("default", "sim")
+    repairs_after_backoff = int(
+        metrics2.controller_pod_replacements.get(
+            model="sim", reason="SpotPreemption"
+        )
+    )
+    healthy_deleted = len(healthy_before - _pod_names(store))
+    return {
+        "replicas": replicas,
+        "lkg_rehydrated_models": rehydrated,
+        "lkg_entry": gov2._lkg.get("sim"),
+        "repairs_op1": repairs_op1,
+        "repairs_immediately_after_restart": repairs_immediately_after_restart,
+        "repairs_after_backoff": repairs_after_backoff,
+        "healthy_pods_deleted_after_restart": healthy_deleted,
+        "spec_after_restart_scale_attempt": spec_after,
+        "budgeted_deletes_after_restart": int(
+            metrics2.governor_actions.get(action="delete", model="sim")
+        ),
+    }
+
+
+# ---- harness -----------------------------------------------------------------
+
+
+def run_sim(**kw) -> dict:
+    return {
+        "split_brain": run_split_brain_phase(
+            **{k: v for k, v in kw.items() if k in ("replicas",)}
+        ),
+        "telemetry": run_telemetry_phase(),
+        "storms": run_storm_phase(),
+        "restart": run_restart_phase(),
+    }
+
+
+def check_invariants(summary: dict) -> list[str]:
+    """Returns a list of violated invariants (empty = all hold)."""
+    errors: list[str] = []
+    sb = summary["split_brain"]
+    if not sb["handover_ok"]:
+        errors.append("split-brain: leadership handover did not complete")
+    if sb["duplicate_actuations"] != 0:
+        errors.append(
+            f"split-brain: {sb['duplicate_actuations']} duplicate "
+            "actuation(s) — a fenced operator wrote"
+        )
+    if sb["creates_by_stale_leader"] != 0:
+        errors.append(
+            "split-brain: the non-leader/stale operator created pods"
+        )
+    if sb["pods_final"] != sb["replicas_desired"]:
+        errors.append(
+            f"split-brain: {sb['pods_final']} pods != desired "
+            f"{sb['replicas_desired']}"
+        )
+    if sb["fenced_attempts"] == 0 or sb["fenced_writes_metric"] == 0:
+        errors.append("split-brain: fencing never fired (sim inert)")
+
+    tl = summary["telemetry"]
+    if tl["spec_after_corrupt_scale"] < 1:
+        errors.append(
+            "telemetry: a corrupt snapshot scaled the model to zero"
+        )
+    if tl["min_pods_seen"] < 1:
+        errors.append("telemetry: the pod set hit zero under corrupt scale")
+    if any(d > tl["model_budget"] for d in tl["deletions_per_window"]):
+        errors.append(
+            "telemetry: per-window deletions "
+            f"{tl['deletions_per_window']} exceed the model budget "
+            f"{tl['model_budget']}"
+        )
+    if tl["converged_pods"] != 1:
+        errors.append(
+            f"telemetry: converged at {tl['converged_pods']} pods, want 1 "
+            "(budget must rate-limit, not block forever)"
+        )
+    if tl["stale_pods_final"] != tl["start_replicas"]:
+        errors.append(
+            "telemetry: static stability failed — stale snapshot deleted "
+            f"{tl['start_replicas'] - tl['stale_pods_final']} pod(s)"
+        )
+    if tl["stale_spec_replicas"] != tl["start_replicas"]:
+        errors.append(
+            "telemetry: a stale snapshot changed spec.replicas "
+            f"({tl['stale_spec_replicas']})"
+        )
+    if tl["stale_static_holds"] == 0:
+        errors.append("telemetry: static-stability hold never fired")
+    if tl["cluster_deletions_one_window"] > tl["cluster_budget"]:
+        errors.append(
+            "telemetry: cluster-wide deletions "
+            f"{tl['cluster_deletions_one_window']} exceed the cluster "
+            f"budget {tl['cluster_budget']}"
+        )
+
+    st = summary["storms"]
+    if st["pods_final"] != st["replicas_desired"]:
+        errors.append(
+            f"storms: reconciler did not converge ({st['pods_final']} "
+            f"pods != {st['replicas_desired']})"
+        )
+    if st["retry_exhausted"] != 0:
+        errors.append(
+            f"storms: {st['retry_exhausted']} request(s) exhausted the "
+            "retry budget"
+        )
+    if not (st["retries_conflict"] and st["retries_429"] and st["retries_5xx"]):
+        errors.append("storms: a storm never fired (sim inert)")
+    if st["max_sleep_s"] > st["backoff_cap_s"]:
+        errors.append(
+            f"storms: a backoff sleep ({st['max_sleep_s']}s) exceeded "
+            f"the cap ({st['backoff_cap_s']}s)"
+        )
+    if not st["retry_after_honored"]:
+        errors.append("storms: the 429 Retry-After header was not honored")
+
+    rs = summary["restart"]
+    if rs["healthy_pods_deleted_after_restart"] != 0:
+        errors.append(
+            "restart: "
+            f"{rs['healthy_pods_deleted_after_restart']} healthy pod(s) "
+            "deleted after operator crash/restart"
+        )
+    if rs["budgeted_deletes_after_restart"] != 0:
+        errors.append("restart: budgeted deletions fired while blind")
+    if rs["spec_after_restart_scale_attempt"] != rs["replicas"]:
+        errors.append(
+            "restart: a blind restart changed spec.replicas to "
+            f"{rs['spec_after_restart_scale_attempt']}"
+        )
+    if rs["lkg_rehydrated_models"] < 1 or rs["lkg_entry"] != {
+        "replicas": rs["replicas"]
+    }:
+        errors.append(
+            f"restart: last-known-good not rehydrated ({rs['lkg_entry']})"
+        )
+    if rs["repairs_immediately_after_restart"] != 0:
+        errors.append(
+            "restart: duplicate repair issued inside the persisted "
+            "backoff window"
+        )
+    if rs["repairs_after_backoff"] < 1:
+        errors.append(
+            "restart: the repair never proceeded after the backoff"
+        )
+    return errors
+
+
+def main() -> int:
+    summary = run_sim()
+    errors = check_invariants(summary)
+    print(json.dumps({"summary": summary, "violations": errors}, indent=2))
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

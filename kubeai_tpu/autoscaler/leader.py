@@ -2,13 +2,26 @@
 
 A `Lease` object in the store records holder + renew time; candidates
 race to acquire/renew it. `is_leader` is the atomic flag the autoscaler
-checks each tick (reference: autoscaler.go:101)."""
+checks each tick (reference: autoscaler.go:101).
+
+Beyond the reference, leadership here also FENCES actuation: every
+destructive write the operator issues (pod create/delete, scale-down,
+preemption marks — see kubeai_tpu/operator/governor.py) first checks
+`fence_valid()`, which requires the lease to be held AND to have been
+renewed within `renew_deadline` seconds of local monotonic time. A
+leader that loses the API server (or is partitioned away while another
+replica takes the lease) therefore stops actuating on its own clock,
+before its stale writes can fight the new leader's — the classic
+fencing-token discipline, applied with local renew-recency because the
+store interface carries no token the server would check.
+"""
 
 from __future__ import annotations
 
 import threading
 import time
 
+from kubeai_tpu.metrics.registry import DEFAULT_METRICS, Metrics
 from kubeai_tpu.operator.k8s.store import Conflict, KubeStore, NotFound
 
 LEASE_NAME = "kubeai.org.leader"
@@ -22,19 +35,52 @@ class LeaderElection:
         namespace: str = "default",
         lease_duration: float = 15.0,
         retry_period: float = 2.0,
+        renew_deadline: float | None = None,
+        metrics: Metrics = DEFAULT_METRICS,
+        clock=time.monotonic,
+        wall=time.time,
     ):
         self.store = store
         self.identity = identity
         self.namespace = namespace
         self.lease_duration = lease_duration
         self.retry_period = retry_period
+        # How long past the last successful renew an actuation fence
+        # stays valid. Strictly shorter than lease_duration: this
+        # replica must stop actuating BEFORE another replica can
+        # legitimately take the lease over.
+        self.renew_deadline = (
+            renew_deadline if renew_deadline is not None
+            else lease_duration * 2.0 / 3.0
+        )
+        self.metrics = metrics
+        self._clock = clock
+        self._wall = wall
         self._is_leader = threading.Event()
+        self._last_renew: float | None = None  # local monotonic time
+        self._listeners: list = []  # fn(is_leader: bool)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     @property
     def is_leader(self) -> bool:
         return self._is_leader.is_set()
+
+    def fence_valid(self) -> bool:
+        """True while actuation writes are safe: the lease is held and
+        was renewed recently enough that no other replica can have
+        acquired it yet. The governor consults this before every
+        destructive batch; an expired leader's writes are dropped."""
+        if not self._is_leader.is_set():
+            return False
+        last = self._last_renew
+        return last is not None and self._clock() - last <= self.renew_deadline
+
+    def add_listener(self, fn) -> None:
+        """Register fn(is_leader) called on every leadership transition
+        (the manager wires a controller resync on acquisition so work
+        enqueued while not leader converges immediately)."""
+        self._listeners.append(fn)
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -46,18 +92,37 @@ class LeaderElection:
             self._thread.join(timeout=5)
         if self.is_leader:
             self._release()
+            self._set_leader(False)
+
+    def _set_leader(self, leader: bool) -> None:
+        was = self._is_leader.is_set()
+        if leader:
+            self._last_renew = self._clock()
+            self._is_leader.set()
+        else:
             self._is_leader.clear()
+        if was == leader:
+            return
+        self.metrics.leader_is_leader.set(1.0 if leader else 0.0)
+        self.metrics.leader_transitions.inc(
+            direction="acquired" if leader else "lost"
+        )
+        for fn in list(self._listeners):
+            try:
+                fn(leader)
+            except Exception:  # noqa: BLE001 — listeners are advisory
+                pass
 
     def _run(self) -> None:
         while not self._stop.is_set():
             try:
                 self._try_acquire_or_renew()
             except Exception:
-                self._is_leader.clear()
+                self._set_leader(False)
             self._stop.wait(self.retry_period)
 
     def _try_acquire_or_renew(self) -> None:
-        now = time.time()
+        now = self._wall()
         try:
             lease = self.store.get("Lease", self.namespace, LEASE_NAME)
         except NotFound:
@@ -76,9 +141,9 @@ class LeaderElection:
                         },
                     }
                 )
-                self._is_leader.set()
+                self._set_leader(True)
             except Conflict:
-                self._is_leader.clear()
+                self._set_leader(False)
             return
 
         spec = lease.get("spec", {})
@@ -91,11 +156,11 @@ class LeaderElection:
             spec["renewTime"] = now
             try:
                 self.store.update(lease)
-                self._is_leader.set()
+                self._set_leader(True)
             except Conflict:
-                self._is_leader.clear()
+                self._set_leader(False)
         else:
-            self._is_leader.clear()
+            self._set_leader(False)
 
     def _release(self) -> None:
         try:

@@ -108,6 +108,35 @@ class CapacityPlanning:
 
 
 @dataclasses.dataclass
+class GovernorConfig:
+    """Actuation safety governor (kubeai_tpu/operator/governor; no
+    reference analog — the reference trusts its own control loop).
+    Every destructive control-plane action (healthy-pod deletion,
+    scale-down, planner preemption marks) flows through the governor,
+    which enforces per-model and cluster-wide disruption budgets per
+    sliding time window, refuses scale-to-zero and preemption when
+    fleet-telemetry coverage is below `minTelemetryCoverage`, and holds
+    last-known-good replica counts (static stability) while telemetry
+    is absent or stale."""
+
+    enabled: bool = True
+    # Sliding budget window. Budgets are BUDGETED (healthy/ready) pod
+    # disruptions only — replacing pods that are already broken is
+    # repair, not disruption, and is never budget-limited.
+    window_seconds: float = 60.0
+    # Max healthy-pod disruptions per model per window.
+    model_disruption_budget: int = 10
+    # Max healthy-pod disruptions cluster-wide per window.
+    cluster_disruption_budget: int = 50
+    # Minimum fraction of a model's endpoints with fresh telemetry
+    # required before the governor allows scale-to-zero or planner
+    # preemption of that model. 0 disarms the coverage gate (and the
+    # static-stability hold that rides on it) — the compatible default;
+    # fleets that run the aggregator set e.g. 0.5.
+    min_telemetry_coverage: float = 0.0
+
+
+@dataclasses.dataclass
 class ModelRollouts:
     """Surge pods during rollout (reference: internal/config/system.go:114-117)."""
 
@@ -182,6 +211,12 @@ class Resilience:
     # (base × 2^n, capped) so a poisoned spec can't thrash pods.
     repair_backoff_base_seconds: float = 5.0
     repair_backoff_max_seconds: float = 300.0
+    # Kube API client retries (RestKubeClient): transient 5xx/429 and
+    # connection errors retry with capped exponential backoff + jitter
+    # (Retry-After honored when the server sends one).
+    kubeclient_max_attempts: int = 5
+    kubeclient_backoff_base_seconds: float = 0.2
+    kubeclient_backoff_max_seconds: float = 5.0
 
 
 DEFAULT_MODEL_SERVERS: dict[str, dict[str, str]] = {
@@ -239,6 +274,9 @@ class System:
     capacity_planning: CapacityPlanning = dataclasses.field(
         default_factory=CapacityPlanning
     )
+    governor: GovernorConfig = dataclasses.field(
+        default_factory=GovernorConfig
+    )
     model_rollouts: ModelRollouts = dataclasses.field(
         default_factory=ModelRollouts
     )
@@ -269,6 +307,19 @@ class System:
             raise ConfigError("modelAutoscaling.queuePressureMaxWait must be >= 0")
         if self.capacity_planning.interval_seconds < 0:
             raise ConfigError("capacityPlanning.interval must be >= 0")
+        g = self.governor
+        if g.window_seconds <= 0:
+            raise ConfigError("governor.window must be > 0")
+        if g.model_disruption_budget < 0:
+            raise ConfigError("governor.modelDisruptionBudget must be >= 0")
+        if g.cluster_disruption_budget < 0:
+            raise ConfigError(
+                "governor.clusterDisruptionBudget must be >= 0"
+            )
+        if not 0.0 <= g.min_telemetry_coverage <= 1.0:
+            raise ConfigError(
+                "governor.minTelemetryCoverage must be in [0, 1]"
+            )
         if self.model_rollouts.surge < 0:
             raise ConfigError("modelRollouts.surge must be >= 0")
         r = self.resilience
@@ -305,6 +356,15 @@ class System:
         if r.repair_backoff_max_seconds < r.repair_backoff_base_seconds:
             raise ConfigError(
                 "resilience.repairBackoffMax must be >= repairBackoffBase"
+            )
+        if r.kubeclient_max_attempts < 1:
+            raise ConfigError("resilience.kubeclientMaxAttempts must be >= 1")
+        if r.kubeclient_backoff_base_seconds <= 0:
+            raise ConfigError("resilience.kubeclientBackoffBase must be > 0")
+        if r.kubeclient_backoff_max_seconds < r.kubeclient_backoff_base_seconds:
+            raise ConfigError(
+                "resilience.kubeclientBackoffMax must be >= "
+                "kubeclientBackoffBase"
             )
         for name, prof in self.resource_profiles.items():
             if not isinstance(prof, ResourceProfile):
@@ -581,6 +641,19 @@ def system_from_dict(data: dict) -> System:
             interval_seconds=_seconds(cp.get("interval", 0)),
             preemption=bool(cp.get("preemption", True)),
         )
+    if "governor" in data:
+        g = data["governor"]
+        sys_obj.governor = GovernorConfig(
+            enabled=bool(g.get("enabled", True)),
+            window_seconds=_seconds(g.get("window", 60)),
+            model_disruption_budget=int(g.get("modelDisruptionBudget", 10)),
+            cluster_disruption_budget=int(
+                g.get("clusterDisruptionBudget", 50)
+            ),
+            min_telemetry_coverage=float(
+                g.get("minTelemetryCoverage", 0.0)
+            ),
+        )
     if "modelRollouts" in data:
         sys_obj.model_rollouts = ModelRollouts(
             surge=int(data["modelRollouts"].get("surge", 1))
@@ -642,6 +715,13 @@ def system_from_dict(data: dict) -> System:
             ),
             repair_backoff_max_seconds=_seconds(
                 r.get("repairBackoffMax", 300)
+            ),
+            kubeclient_max_attempts=int(r.get("kubeclientMaxAttempts", 5)),
+            kubeclient_backoff_base_seconds=_seconds(
+                r.get("kubeclientBackoffBase", 0.2)
+            ),
+            kubeclient_backoff_max_seconds=_seconds(
+                r.get("kubeclientBackoffMax", 5)
             ),
         )
     if "metricsAddr" in data:

@@ -51,6 +51,17 @@ def role_replicas_annotation(role: str) -> str:
 PLANNER_PREEMPT_ANNOTATION = "kubeai.org/planner-preempt"
 PREEMPT_REASON_CAPACITY = "CapacityPreemption"
 
+# Actuation governor (kubeai_tpu/operator/governor): the last replica
+# shape applied under healthy telemetry, persisted on the Model so a
+# restarted operator rehydrates its static-stability floor before the
+# first tick. Value: JSON {"replicas": n} or {"roles": {role: n}}.
+LAST_KNOWN_GOOD_ANNOTATION = "kubeai.org/last-known-good-replicas"
+
+# Self-healing repair-backoff state (kubeai_tpu/operator/controller):
+# JSON {"count": n, "last": wall_ts} persisted on the Model so an
+# operator restart mid-backoff cannot issue duplicate repairs.
+REPAIR_STATE_ANNOTATION = "kubeai.org/repair-state"
+
 ADAPTER_LABEL_DOMAIN = "adapter.kubeai.org"
 # Comma-separated adapter names whose routing label was removed but whose
 # engine unload hasn't succeeded yet (409 while requests drain). Keeps the

@@ -406,7 +406,16 @@ class FleetStateAggregator:
         'budget unknown — plan unconstrained'."""
         shapes: dict[str, dict] = {}
         total = 0
-        for node in self.store.list("Node"):
+        try:
+            nodes = self.store.list("Node")
+        except Exception as e:  # noqa: BLE001 — budget stays unknown
+            # A cluster where the operator cannot list Nodes (RBAC, or
+            # an API server without the route) must not kill the whole
+            # fleet sweep — the chip budget is simply unknown and the
+            # planner plans unconstrained.
+            logger.debug("node budget unavailable: %s", e)
+            nodes = []
+        for node in nodes:
             chips = k8sutils.node_chip_capacity(node)
             if chips <= 0:
                 continue
@@ -534,6 +543,25 @@ class FleetStateAggregator:
         if self._clock() - snap["ts"] > self.staleness_s:
             return None
         return snap["models"].get(model)
+
+    def model_coverage(self, model: str) -> tuple[float | None, bool]:
+        """The actuation governor's telemetry-coverage read:
+        (fraction of the model's endpoints whose telemetry is fresh in
+        the latest snapshot, snapshot_is_fresh). Coverage is None when
+        there is no fresh snapshot or the model is unknown to it, and
+        vacuously 1.0 for a model with zero endpoints (nothing to
+        know)."""
+        snap = self.snapshot()
+        if snap is None or self._clock() - snap["ts"] > self.staleness_s:
+            return None, False
+        entry = snap["models"].get(model)
+        if entry is None:
+            return None, True
+        eps = entry.get("endpoints") or {}
+        if not eps:
+            return 1.0, True
+        fresh = sum(1 for e in eps.values() if not e.get("stale"))
+        return fresh / len(eps), True
 
     def queue_pressure(self, model: str) -> dict | None:
         """The autoscaler's queue-pressure read: same shape as

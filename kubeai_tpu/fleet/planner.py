@@ -145,6 +145,7 @@ class CapacityPlanner:
         preemption_enabled: bool = True,
         budget_override: dict | None = None,
         clock=time.time,
+        governor=None,
     ):
         self.fleet = fleet
         self.model_client = model_client
@@ -165,6 +166,12 @@ class CapacityPlanner:
         # snapshot's Node-derived budget (clusters where the operator
         # cannot list Nodes configure capacity explicitly).
         self.budget_override = budget_override
+        # Actuation governor (operator/governor): preemption marks are
+        # fenced on lease validity and gated on telemetry coverage; the
+        # permissive default never refuses.
+        from kubeai_tpu.operator import governor as governor_mod
+
+        self.governor = governor or governor_mod.PERMISSIVE
         self.avg_lookup = None
         self._clock = clock
         self._lock = threading.Lock()
@@ -585,15 +592,22 @@ class CapacityPlanner:
     def _mark_preemption_victims(self, plan: dict) -> None:
         """Annotate the pods the plan takes away so pod_plan deletes
         exactly them first; strip the mark from pods no longer picked so
-        a recovered model's deletions revert to the generic ordering."""
+        a recovered model's deletions revert to the generic ordering.
+
+        Every record — including `fixed` (autoscaling-disabled) models
+        and models the governor refuses preemption for — still runs the
+        unmark sweep: a `kubeai.org/planner-preempt` annotation from an
+        outdated tick must never linger where the current plan (or the
+        governor) no longer selects a victim, or
+        `sort_pods_by_deletion_order` would act on stale picks."""
         for name, rec in plan["models"].items():
-            if rec["kind"] == "fixed":
-                continue
             pods = self.store.list(
                 "Pod", self.namespace, {md.POD_MODEL_LABEL: name}
             )
             victims: set[str] = set()
-            if rec["kind"] == "disagg":
+            if rec["kind"] == "fixed":
+                pass  # not under plan control: clear stale marks only
+            elif rec["kind"] == "disagg":
                 for role in md.DISAGG_ROLES:
                     if not rec["preempted_roles"].get(role):
                         continue
@@ -612,6 +626,11 @@ class CapacityPlanner:
                     0, rec["current_replicas"] - rec["allocated_replicas"]
                 )
                 victims.update(self._pick_victims(pods, n_del))
+            if victims and not self.governor.allow_preemption(name):
+                # Governor refused (stale telemetry, low coverage, or an
+                # invalid lease): mark nothing — and fall through so any
+                # marks from an earlier tick are stripped too.
+                victims = set()
             for pod in pods:
                 pod_name = pod["metadata"]["name"]
                 ann = (pod.get("metadata") or {}).get("annotations") or {}

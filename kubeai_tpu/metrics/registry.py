@@ -378,6 +378,77 @@ class Metrics:
             "pass, per model and classification reason.",
             self.registry,
         )
+        # -- actuation safety governor (operator/governor) -----------------
+        self.governor_actions = Counter(
+            "kubeai_governor_actions_total",
+            "Destructive control-plane actions authorized by the "
+            "governor, per action kind and model.",
+            self.registry,
+        )
+        self.governor_denied = Counter(
+            "kubeai_governor_denied_total",
+            "Destructive control-plane actions the governor refused, per "
+            "action kind, model, and denial reason (budget exhaustion, "
+            "stale telemetry, coverage below threshold, invalid lease).",
+            self.registry,
+        )
+        self.governor_budget_remaining = Gauge(
+            "kubeai_governor_budget_remaining",
+            "Healthy-pod disruptions still allowed in the current "
+            "sliding window (scope=cluster), updated on every budget "
+            "consultation.",
+            self.registry,
+        )
+        self.governor_telemetry_coverage = Gauge(
+            "kubeai_governor_telemetry_coverage",
+            "Fraction of the model's endpoints with fresh fleet "
+            "telemetry at the governor's last coverage check.",
+            self.registry,
+        )
+        self.governor_static_holds = Counter(
+            "kubeai_governor_static_stability_holds_total",
+            "Scale-downs held at the last-known-good replica count "
+            "because fleet telemetry was absent or stale, per model.",
+            self.registry,
+        )
+        # -- leader election / actuation fencing ---------------------------
+        self.leader_is_leader = Gauge(
+            "kubeai_leader_is_leader",
+            "1 while this replica holds the leadership lease, else 0.",
+            self.registry,
+        )
+        self.leader_transitions = Counter(
+            "kubeai_leader_transitions_total",
+            "Leadership acquisitions and losses observed by this "
+            "replica (direction label: acquired|lost).",
+            self.registry,
+        )
+        self.leader_fenced_writes = Counter(
+            "kubeai_leader_fenced_writes_total",
+            "Actuation batches dropped because the leadership lease was "
+            "expired or not held at write time (split-brain fencing).",
+            self.registry,
+        )
+        # -- kube API client retries (operator/k8s/rest) -------------------
+        self.kubeclient_retries = Counter(
+            "kubeai_kubeclient_retry_attempts_total",
+            "Kube API requests retried after a transient failure, per "
+            "HTTP verb and failure reason (429, 5xx, connection error, "
+            "conflict).",
+            self.registry,
+        )
+        self.kubeclient_retry_exhausted = Counter(
+            "kubeai_kubeclient_retry_exhausted_total",
+            "Kube API requests that failed after exhausting the retry "
+            "budget, per HTTP verb.",
+            self.registry,
+        )
+        self.kubeclient_watch_reconnects = Counter(
+            "kubeai_kubeclient_watch_reconnects_total",
+            "Watch stream reconnects per kind (each reconnect waits a "
+            "capped exponential backoff with jitter).",
+            self.registry,
+        )
         # -- autoscaler decision telemetry ---------------------------------
         self.autoscaler_ticks = Counter(
             "kubeai_autoscaler_ticks_total",

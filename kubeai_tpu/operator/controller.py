@@ -15,6 +15,7 @@ owned Pods/Jobs/PVCs enqueue the owning Model
 
 from __future__ import annotations
 
+import json
 import logging
 import queue
 import random
@@ -29,7 +30,9 @@ from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
 from kubeai_tpu.operator import adapters as adapters_mod
 from kubeai_tpu.operator import cache as cache_mod
 from kubeai_tpu.operator import files as files_mod
+from kubeai_tpu.operator import governor as governor_mod
 from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator.governor import NotLeader
 from kubeai_tpu.operator.engine_client import EngineClient
 from kubeai_tpu.operator.engines import render_pod, resolve_model_config
 from kubeai_tpu.operator.k8s.store import Conflict, KubeStore, NotFound
@@ -66,12 +69,17 @@ class ModelReconciler:
         metrics: Metrics = DEFAULT_METRICS,
         clock=time.monotonic,
         wall=time.time,
+        governor: governor_mod.ActuationGovernor | None = None,
     ):
         self.store = store
         self.cfg = cfg
         self.engine_client = engine_client or EngineClient()
         self.pod_exec = pod_exec
         self.metrics = metrics
+        # Every destructive action this reconciler takes flows through
+        # the governor (fencing + disruption budgets); the permissive
+        # default keeps directly-constructed reconcilers ungoverned.
+        self.governor = governor or governor_mod.PERMISSIVE
         # Two clocks, both injectable: `clock` (monotonic) spaces repair
         # backoff; `wall` compares against pod creationTimestamps (the
         # store stamps wall time) for the stuck-Pending deadline.
@@ -113,8 +121,11 @@ class ModelReconciler:
 
         # Deletion path (reference: model_controller.go:112-133).
         if model.deletion_timestamp is not None:
-            self.store.delete_all_of(
-                "Pod", model.namespace, {md.POD_MODEL_LABEL: model.name}
+            self.governor.delete_model_pods(
+                self.store,
+                model.namespace,
+                {md.POD_MODEL_LABEL: model.name},
+                model=model.name,
             )
             if mcfg.num_hosts > 1:
                 from kubeai_tpu.operator.engines.kubeai_tpu_engine import (
@@ -173,7 +184,7 @@ class ModelReconciler:
                 pods, model, desired_pod, self.cfg.model_rollouts.surge
             )
         if plan.contains_actions():
-            plan.execute(self.store, model_obj)
+            plan.execute(self.store, model_obj, governor=self.governor)
             pods = self.store.list(
                 "Pod", model.namespace, {md.POD_MODEL_LABEL: model.name}
             )
@@ -230,21 +241,30 @@ class ModelReconciler:
             if st and now - st[1] > r.repair_backoff_max_seconds:
                 # Quiet past the max backoff: the failure streak is over.
                 self._repair_state.pop(key, None)
+                self._persist_repair_state(model, None)
             return pods, [], False
         degraded = [(p["metadata"]["name"], reason) for p, reason in broken]
-        count, last = self._repair_state.get(key, (0, 0.0))
+        count, last = (
+            self._repair_state.get(key)
+            or self._rehydrate_repair_state(model)
+        )
         backoff = min(
             r.repair_backoff_max_seconds,
             r.repair_backoff_base_seconds * (2.0 ** min(count, 10)),
         )
         if count and now - last < backoff:
+            # Remember the rehydrated streak so a restart mid-backoff
+            # keeps honoring it instead of re-reading each pass.
+            self._repair_state[key] = (count, last)
             return pods, degraded, False
         for p, reason in broken:
             name = p["metadata"]["name"]
-            try:
-                self.store.delete("Pod", model.namespace, name)
-            except NotFound:
-                pass
+            # Repair of an already-broken pod: fenced but never
+            # budget-limited (the governor counts it as `repair`).
+            self.governor.delete_pod(
+                self.store, model.namespace, name,
+                model=model.name, reason=reason, budgeted=False,
+            )
             self.metrics.controller_pod_replacements.inc(
                 model=model.name, reason=reason
             )
@@ -254,7 +274,44 @@ class ModelReconciler:
                 model.namespace, name, reason, model.name, count + 1,
             )
         self._repair_state[key] = (count + 1, now)
+        self._persist_repair_state(model, count + 1)
         return healthy, degraded, True
+
+    def _rehydrate_repair_state(self, model: Model) -> tuple[int, float]:
+        """A restarted operator must not forget an in-flight repair
+        backoff (it would instantly issue duplicate repairs): the streak
+        is persisted as a Model annotation in wall time and mapped back
+        onto this process's monotonic clock here."""
+        raw = model.annotations.get(md.REPAIR_STATE_ANNOTATION)
+        if not raw:
+            return (0, 0.0)
+        try:
+            entry = json.loads(raw)
+            count = int(entry["count"])
+            last_wall = float(entry["last"])
+        except (TypeError, KeyError, ValueError, json.JSONDecodeError):
+            return (0, 0.0)
+        elapsed = max(0.0, self._wall() - last_wall)
+        return (count, self._clock() - elapsed)
+
+    def _persist_repair_state(self, model: Model, count: int | None) -> None:
+        """Write (or clear, count=None) the repair-streak annotation.
+        Best-effort: a failed write only costs restart continuity."""
+        value = (
+            None if count is None
+            else json.dumps({"count": count, "last": self._wall()})
+        )
+        if value is None and md.REPAIR_STATE_ANNOTATION not in model.annotations:
+            return
+        try:
+            self.store.patch_merge(
+                "Model", model.namespace, model.name,
+                {"metadata": {"annotations": {
+                    md.REPAIR_STATE_ANNOTATION: value,
+                }}},
+            )
+        except (NotFound, Conflict):
+            pass
 
     def _conditions(
         self,
@@ -442,9 +499,12 @@ class ModelReconciler:
         mx = spec.get("maxReplicas")
         replicas = spec.get("replicas")
         if replicas is None or replicas < mn:
+            # ungoverned: clamp UP to the CRD minReplicas floor — never
+            # shrinks capacity (scripts/check_actuation_paths.py)
             spec["replicas"] = mn
             return True
         if mx is not None and replicas > mx:
+            # ungoverned: clamp to the user's own CRD maxReplicas bound
             spec["replicas"] = mx
             return True
         return False
@@ -527,6 +587,16 @@ class ControllerLoop:
         for t in self._threads:
             t.join(timeout=5)
 
+    def resync(self) -> None:
+        """Re-enqueue every live Model — called on leadership
+        acquisition so work that was fenced while not leader converges
+        immediately instead of waiting for the next watch event."""
+        try:
+            for obj in self.store.list("Model"):
+                self._enqueue_obj(obj)
+        except Exception:
+            logger.warning("leader resync failed", exc_info=True)
+
     def _enqueue_obj(self, obj: dict) -> None:
         kind = obj.get("kind")
         meta = obj.get("metadata") or {}
@@ -591,6 +661,11 @@ class ControllerLoop:
                     self._metrics.controller_consecutive_failures.set(
                         0, model=name
                     )
+            except NotLeader:
+                # Not an error: this replica keeps its caches warm but
+                # never actuates. The work requeues with backoff; the
+                # leadership-acquisition resync converges it promptly.
+                self._requeue_after_backoff(ns, name, count_failure=False)
             except Exception:
                 logger.error(
                     "reconcile %s/%s failed:\n%s", ns, name, traceback.format_exc()
@@ -609,20 +684,29 @@ class ControllerLoop:
         base = min(30.0, 0.5 * (2.0 ** min(n, 10)))
         return base * (0.5 + 0.5 * _jitter())
 
-    def _requeue_after_backoff(self, ns: str, name: str) -> None:
+    def _requeue_after_backoff(
+        self, ns: str, name: str, count_failure: bool = True
+    ) -> None:
         """Failed reconciles retry with exponential backoff instead of
         waiting for the next watch event (which may never come — e.g. an
         engine 409 while adapter requests drain). Parity with
         controller-runtime's requeue-on-error semantics (the reference's
-        Reconcile returns err → backoff requeue)."""
+        Reconcile returns err → backoff requeue). `count_failure=False`
+        requeues without growing the failure streak (fenced non-leader
+        reconciles are healthy, not failing)."""
         n = self._failures.get((ns, name), 0)
-        # Cap the stored count: 2.0**1024 raises OverflowError, which would
-        # escape the worker's except handler and kill the reconcile loop.
-        self._failures[(ns, name)] = min(n + 1, 16)
-        self._metrics.controller_consecutive_failures.set(
-            self._failures[(ns, name)], model=name
-        )
-        delay = self._backoff_delay(n)
+        if count_failure:
+            # Cap the stored count: 2.0**1024 raises OverflowError, which
+            # would escape the worker's except handler and kill the
+            # reconcile loop.
+            self._failures[(ns, name)] = min(n + 1, 16)
+            self._metrics.controller_consecutive_failures.set(
+                self._failures[(ns, name)], model=name
+            )
+        # Fenced requeues pace at a fixed modest delay (the n=2 rung)
+        # rather than the hot first-failure rung: a standby replica
+        # re-checks leadership every couple of seconds per model.
+        delay = self._backoff_delay(n if count_failure else max(n, 2))
 
         def _put():
             if not self._stop.is_set():

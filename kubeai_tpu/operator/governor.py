@@ -1,0 +1,398 @@
+"""Actuation safety governor: the one gate every destructive
+control-plane action passes through.
+
+PRs 3/5 made the *data path* survive endpoint death; PR 7 gave the
+control plane the power to shrink models and mark pods for preemptive
+deletion fleet-wide. That power needs a governor: a corrupt fleet
+snapshot, a split-brain second operator, or a crash-looping control
+loop must never be able to mass-delete healthy serving capacity. Three
+disciplines, enforced here and nowhere else:
+
+  * **Disruption budgets.** Deleting a HEALTHY (ready, undisrupted) pod
+    consumes one unit of a per-model and a cluster-wide budget over a
+    sliding time window. Replacing already-broken pods is repair, not
+    disruption — never budget-limited. When a budget is exhausted the
+    deletion is refused (and counted in `kubeai_governor_denied_total`);
+    the pod plan simply converges over later windows.
+  * **Telemetry gates / static stability.** When armed
+    (`governor.minTelemetryCoverage > 0` and a fleet aggregator is
+    wired), scale-to-zero and planner preemption require the model's
+    endpoint-telemetry coverage to meet the threshold, and while the
+    fleet snapshot is absent or stale the governor holds last-known-good
+    replica counts: scale-downs and budgeted deletions are refused
+    outright until telemetry returns.
+  * **Lease fencing.** Every actuation batch checks
+    `LeaderElection.fence_valid()` first: a replica whose lease expired
+    (or that never held one) raises `NotLeader` and its writes are
+    dropped — dual operators cannot fight over the same pods.
+
+The governor is also the restart-rehydration point: last-known-good
+replica counts are persisted as a Model annotation and re-read by
+`rehydrate()` before the operator's first tick, so a control-plane
+crash never causes scale thrash.
+
+A governor constructed with no config (`ActuationGovernor()`) is
+PERMISSIVE: fence-valid, no budgets, no gates — the default for
+components wired outside a `Manager` (unit tests, ad-hoc tools). The
+static-analysis gate `scripts/check_actuation_paths.py` fails tier-1
+when a pod-deletion call site appears outside this module.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from collections import deque
+
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.metrics.registry import DEFAULT_METRICS, Metrics
+from kubeai_tpu.operator.k8s.store import NotFound
+
+logger = logging.getLogger(__name__)
+
+# Action vocabulary (metric label values; stable strings).
+ACTION_DELETE = "delete"
+ACTION_CREATE = "create"
+ACTION_REPAIR = "repair"
+ACTION_MODEL_TEARDOWN = "model_teardown"
+ACTION_SCALE_DOWN = "scale_down"
+ACTION_SCALE_TO_ZERO = "scale_to_zero"
+ACTION_PREEMPT_MARK = "preempt_mark"
+
+# Denial-reason vocabulary.
+DENY_LEASE = "lease-invalid"
+DENY_MODEL_BUDGET = "model-budget-exhausted"
+DENY_CLUSTER_BUDGET = "cluster-budget-exhausted"
+DENY_STALE = "telemetry-stale"
+DENY_COVERAGE = "telemetry-coverage"
+
+
+class NotLeader(RuntimeError):
+    """Raised when an actuation batch is attempted without a valid
+    leadership fence; callers requeue and retry after the next election
+    round instead of writing."""
+
+
+class ActuationGovernor:
+    """See module docstring. `cfg` is a `config.GovernorConfig` (None =
+    permissive); `fleet` a `FleetStateAggregator` (coverage source);
+    `leader` a `LeaderElection` (fencing); `store` enables
+    last-known-good annotation persistence; `clock` is monotonic and
+    injectable (FakeClock in the chaos sim)."""
+
+    def __init__(
+        self,
+        cfg=None,
+        fleet=None,
+        leader=None,
+        store=None,
+        namespace: str = "default",
+        metrics: Metrics = DEFAULT_METRICS,
+        clock=time.monotonic,
+    ):
+        self.cfg = cfg
+        self.fleet = fleet
+        self.leader = leader
+        self.store = store
+        self.namespace = namespace
+        self.metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        # Sliding window of budgeted disruptions: (clock time, model).
+        self._window: deque[tuple[float, str]] = deque()
+        # model -> last-known-good replica shape:
+        # {"replicas": n} or {"roles": {role: n}}.
+        self._lkg: dict[str, dict] = {}
+
+    # -- state predicates ------------------------------------------------------
+
+    @property
+    def enabled(self) -> bool:
+        return self.cfg is not None and bool(self.cfg.enabled)
+
+    @property
+    def armed(self) -> bool:
+        """Telemetry gating active: enabled, a coverage threshold set,
+        and a fleet aggregator wired to answer it."""
+        return (
+            self.enabled
+            and self.cfg.min_telemetry_coverage > 0
+            and self.fleet is not None
+        )
+
+    def fence_valid(self) -> bool:
+        return self.leader is None or self.leader.fence_valid()
+
+    def check_fence(self) -> None:
+        """Raise `NotLeader` (and count the fenced batch) unless this
+        replica holds a fresh leadership lease."""
+        if self.fence_valid():
+            return
+        self.metrics.leader_fenced_writes.inc()
+        raise NotLeader(
+            "actuation fenced: leadership lease not held or expired"
+        )
+
+    # -- telemetry coverage ----------------------------------------------------
+
+    def _coverage(self, model: str) -> tuple[float | None, bool]:
+        """(model endpoint-telemetry coverage, snapshot_fresh). Coverage
+        None when the snapshot doesn't know the model."""
+        cov, fresh = self.fleet.model_coverage(model)
+        if cov is not None:
+            self.metrics.governor_telemetry_coverage.set(cov, model=model)
+        return cov, fresh
+
+    # -- disruption budgets ----------------------------------------------------
+
+    def _remaining_locked(self, model: str) -> tuple[int, int]:
+        now = self._clock()
+        horizon = now - self.cfg.window_seconds
+        while self._window and self._window[0][0] <= horizon:
+            self._window.popleft()
+        used_model = sum(1 for _, m in self._window if m == model)
+        return (
+            self.cfg.model_disruption_budget - used_model,
+            self.cfg.cluster_disruption_budget - len(self._window),
+        )
+
+    def budget_remaining(self, model: str) -> tuple[int, int]:
+        """(per-model, cluster-wide) disruptions still allowed in the
+        current window. Unlimited (a large sentinel) when disabled."""
+        if not self.enabled:
+            return (1 << 30, 1 << 30)
+        with self._lock:
+            return self._remaining_locked(model)
+
+    def _consume_budget(self, model: str) -> str | None:
+        """Take one budgeted disruption, or return the denial reason."""
+        with self._lock:
+            model_rem, cluster_rem = self._remaining_locked(model)
+            if model_rem <= 0:
+                return DENY_MODEL_BUDGET
+            if cluster_rem <= 0:
+                return DENY_CLUSTER_BUDGET
+            self._window.append((self._clock(), model))
+            self.metrics.governor_budget_remaining.set(
+                cluster_rem - 1, scope="cluster"
+            )
+        return None
+
+    def _deny(self, action: str, model: str, reason: str) -> None:
+        self.metrics.governor_denied.inc(
+            action=action, model=model, reason=reason
+        )
+        logger.warning(
+            "governor denied %s for model %s: %s", action, model, reason
+        )
+
+    def _allow(self, action: str, model: str) -> None:
+        self.metrics.governor_actions.inc(action=action, model=model)
+
+    # -- pod actuation ---------------------------------------------------------
+
+    def delete_pod(
+        self,
+        store,
+        namespace: str,
+        name: str,
+        *,
+        model: str = "",
+        reason: str = "",
+        budgeted: bool = True,
+    ) -> bool:
+        """Fence-checked, budget-limited pod deletion. `budgeted=False`
+        marks a repair of an already-broken pod (never budget-limited).
+        Returns True when the pod was deleted (or already gone), False
+        when the governor refused."""
+        self.check_fence()
+        action = ACTION_DELETE if budgeted else ACTION_REPAIR
+        if self.enabled and budgeted:
+            if self.armed:
+                _cov, fresh = self._coverage(model)
+                if not fresh:
+                    # Static stability: no healthy pod dies while the
+                    # control plane is flying blind.
+                    self.metrics.governor_static_holds.inc(model=model)
+                    self._deny(action, model, DENY_STALE)
+                    return False
+            denied = self._consume_budget(model)
+            if denied is not None:
+                self._deny(action, model, denied)
+                return False
+        try:
+            store.delete("Pod", namespace, name)
+        except NotFound:
+            pass
+        self._allow(action, model)
+        return True
+
+    def delete_model_pods(
+        self, store, namespace: str, selector: dict, *, model: str
+    ) -> int:
+        """Model-deletion teardown: the user asked for the model to go,
+        so budgets don't apply — but the write is still fenced."""
+        self.check_fence()
+        n = store.delete_all_of("Pod", namespace, selector)
+        self._allow(ACTION_MODEL_TEARDOWN, model)
+        return n
+
+    def create_pod(self, store, pod: dict, *, model: str = "") -> dict:
+        """Pod creation is fenced (a non-leader must not race the leader
+        to create replicas) but never budgeted."""
+        self.check_fence()
+        created = store.create(pod)
+        self._allow(ACTION_CREATE, model)
+        return created
+
+    # -- scaling ---------------------------------------------------------------
+
+    def govern_scale(
+        self, model: str, current: int, target: int
+    ) -> tuple[int, str | None]:
+        """Gate one replica-count change about to be written to the
+        Model spec. Scale-ups and no-ops pass through; scale-downs are
+        fenced, held at last-known-good while telemetry is stale, and
+        refused the final step to zero when coverage is below the
+        threshold. Returns (allowed_target, denial_reason|None)."""
+        if target >= current or not self.enabled:
+            return target, None
+        action = ACTION_SCALE_TO_ZERO if target == 0 else ACTION_SCALE_DOWN
+        if not self.fence_valid():
+            self.metrics.leader_fenced_writes.inc()
+            self._deny(action, model, DENY_LEASE)
+            return current, DENY_LEASE
+        if self.armed:
+            cov, fresh = self._coverage(model)
+            if not fresh:
+                held = self._lkg_replicas(model)
+                hold_at = max(current, held) if held is not None else current
+                self.metrics.governor_static_holds.inc(model=model)
+                self._deny(action, model, DENY_STALE)
+                return hold_at, DENY_STALE
+            if (
+                target == 0
+                and cov is not None
+                and cov < self.cfg.min_telemetry_coverage
+            ):
+                self._deny(action, model, DENY_COVERAGE)
+                # Shrinking is fine; disappearing is not: clamp to one.
+                return 1, DENY_COVERAGE
+        self._allow(action, model)
+        return target, None
+
+    def allow_preemption(self, model: str) -> bool:
+        """Whether the capacity planner may mark this model's pods as
+        preemption victims right now (fence + coverage gate)."""
+        if not self.fence_valid():
+            self.metrics.leader_fenced_writes.inc()
+            self._deny(ACTION_PREEMPT_MARK, model, DENY_LEASE)
+            return False
+        if not self.armed:
+            return True
+        cov, fresh = self._coverage(model)
+        if not fresh:
+            self._deny(ACTION_PREEMPT_MARK, model, DENY_STALE)
+            return False
+        if cov is not None and cov < self.cfg.min_telemetry_coverage:
+            self._deny(ACTION_PREEMPT_MARK, model, DENY_COVERAGE)
+            return False
+        self._allow(ACTION_PREEMPT_MARK, model)
+        return True
+
+    # -- last-known-good persistence / restart rehydration ---------------------
+
+    def _lkg_replicas(self, model: str) -> int | None:
+        entry = self._lkg.get(model)
+        if not entry:
+            return None
+        if "replicas" in entry:
+            return int(entry["replicas"])
+        roles = entry.get("roles") or {}
+        return sum(int(v) for v in roles.values()) if roles else None
+
+    def note_applied(
+        self,
+        model: str,
+        replicas: int | None = None,
+        roles: dict[str, int] | None = None,
+    ) -> None:
+        """Record a replica count that was applied under healthy
+        conditions — the static-stability floor a restarted operator
+        rehydrates. Persisted as a Model annotation (best-effort) so it
+        survives a control-plane crash."""
+        if not self.enabled:
+            return
+        if self.armed:
+            _cov, fresh = self._coverage(model)
+            if not fresh:
+                return  # never learn a "good" count from blind ticks
+        entry: dict = {}
+        if replicas is not None:
+            entry["replicas"] = int(replicas)
+        if roles:
+            # Merge per-role updates (scale_role writes one role at a
+            # time) so one role's apply never forgets the other's.
+            prev_roles = (self._lkg.get(model) or {}).get("roles") or {}
+            entry["roles"] = {
+                **prev_roles, **{r: int(n) for r, n in roles.items()},
+            }
+        if not entry or self._lkg.get(model) == entry:
+            return
+        self._lkg[model] = entry
+        if self.store is None:
+            return
+        try:
+            self.store.patch_merge(
+                "Model",
+                self.namespace,
+                model,
+                {
+                    "metadata": {
+                        "annotations": {
+                            md.LAST_KNOWN_GOOD_ANNOTATION: json.dumps(
+                                entry, sort_keys=True
+                            )
+                        }
+                    }
+                },
+            )
+        except Exception as e:  # noqa: BLE001 — persistence is best-effort
+            logger.debug("lkg annotation write failed for %s: %s", model, e)
+
+    def rehydrate(self) -> int:
+        """Re-read last-known-good annotations from every Model before
+        the first tick — the restarted operator's memory of what a
+        healthy fleet looked like. Returns the number of models
+        rehydrated."""
+        if self.store is None:
+            return 0
+        n = 0
+        try:
+            models = self.store.list("Model", self.namespace)
+        except Exception as e:  # noqa: BLE001 — rehydration is best-effort
+            logger.warning("governor rehydration list failed: %s", e)
+            return 0
+        for obj in models:
+            meta = obj.get("metadata") or {}
+            raw = (meta.get("annotations") or {}).get(
+                md.LAST_KNOWN_GOOD_ANNOTATION
+            )
+            if not raw:
+                continue
+            try:
+                entry = json.loads(raw)
+            except (TypeError, json.JSONDecodeError):
+                continue
+            if isinstance(entry, dict) and entry:
+                self._lkg[meta.get("name", "")] = entry
+                n += 1
+        return n
+
+
+# Permissive instance for components wired without a Manager: every call
+# site still ROUTES through the governor (the static gate requires it),
+# it just never refuses.
+PERMISSIVE = ActuationGovernor()

@@ -32,6 +32,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -460,6 +461,8 @@ _PLURALS = {
     "pods": "Pod",
     "configmaps": "ConfigMap",
     "persistentvolumeclaims": "PersistentVolumeClaim",
+    "services": "Service",
+    "nodes": "Node",
     "jobs": "Job",
     "leases": "Lease",
     "models": "Model",
@@ -470,9 +473,22 @@ class FakeKubeApiServer:
     """See module docstring. `crd_path` enables server-side Model
     admission; `watch_close_every` closes each watch connection after N
     events (clients must resume); `compact()` discards watch history so
-    stale resumes get 410 Gone."""
+    stale resumes get 410 Gone; `fault_plan` (a
+    kubeai_tpu.testing.faults.ApiFaultPlan) injects deterministic
+    server-side faults — 429 storms with Retry-After, 409 conflict
+    storms, 5xx, dropped connections, pre-response stalls — per
+    (method, resource, watch?) request schedule, so client retry paths
+    are chaos-tested over real HTTP."""
 
-    def __init__(self, crd_path: str | None = None, watch_close_every: int = 0):
+    def __init__(
+        self,
+        crd_path: str | None = None,
+        watch_close_every: int = 0,
+        fault_plan=None,
+        fault_sleep=None,
+    ):
+        self.fault_plan = fault_plan
+        self._fault_sleep = fault_sleep  # injectable stall clock
         self.lock = threading.RLock()
         self.objects: dict[tuple[str, str, str], dict] = {}
         self.rv = 0
@@ -554,11 +570,16 @@ class FakeKubeApiServer:
             "message": message,
         }
 
-    def _send(self, handler, code: int, payload: dict) -> None:
+    def _send(
+        self, handler, code: int, payload: dict,
+        headers: dict | None = None,
+    ) -> None:
         body = json.dumps(payload).encode()
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            handler.send_header(k, str(v))
         handler.end_headers()
         try:
             handler.wfile.write(body)
@@ -587,6 +608,10 @@ class FakeKubeApiServer:
             self._send(handler, 404, self._status(404, "NotFound", "bad path"))
             return
         self.requests.append(f"{method} {handler.path}")
+        if self.fault_plan is not None and not self._apply_fault(
+            handler, method, plural, q
+        ):
+            return
         if plural not in _PLURALS:
             self._send(
                 handler, 404,
@@ -621,6 +646,35 @@ class FakeKubeApiServer:
                 self._delete(handler, plural, ns, name)
         except BrokenPipeError:
             pass
+
+    def _apply_fault(self, handler, method: str, plural: str, q) -> bool:
+        """Consult the fault plan for this request. Returns True when
+        handling should proceed normally (possibly after a stall),
+        False when the fault already answered (or dropped) the
+        request."""
+        from kubeai_tpu.testing import faults as faults_mod
+
+        fault = self.fault_plan.on_request(
+            method, plural, q.get("watch") == "true"
+        )
+        if fault is None:
+            return True
+        if fault.kind == faults_mod.API_FAULT_DROP:
+            try:
+                handler.connection.close()
+            except OSError:
+                pass
+            return False
+        if fault.kind == faults_mod.API_FAULT_STALL:
+            (self._fault_sleep or time.sleep)(fault.stall_s)
+            return True
+        self._send(
+            handler,
+            fault.status,
+            self._status(fault.status, fault.reason, fault.message),
+            headers=fault.headers,
+        )
+        return False
 
     # -- CRUD ---------------------------------------------------------------
 

@@ -13,7 +13,9 @@ same manifest dicts KubeStore holds, so every controller-path component
 from __future__ import annotations
 
 import json
+import logging
 import queue
+import random
 import ssl
 import threading
 import urllib.error
@@ -21,15 +23,25 @@ import urllib.parse
 import urllib.request
 from typing import Callable, Iterable
 
+from kubeai_tpu.metrics.registry import DEFAULT_METRICS, Metrics
 from kubeai_tpu.operator.k8s.store import Conflict, Invalid, NotFound
 
+logger = logging.getLogger(__name__)
+
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# Backoff jitter source (monkeypatchable in tests, like
+# ControllerLoop._jitter): N clients retrying the same API-server brownout
+# must not hammer it in lockstep waves.
+_jitter = random.random
 
 # kind -> (api_prefix, plural, namespaced)
 KIND_ROUTES = {
     "Pod": ("/api/v1", "pods", True),
     "ConfigMap": ("/api/v1", "configmaps", True),
     "PersistentVolumeClaim": ("/api/v1", "persistentvolumeclaims", True),
+    "Service": ("/api/v1", "services", True),
+    "Node": ("/api/v1", "nodes", False),
     "Job": ("/apis/batch/v1", "jobs", True),
     "Lease": ("/apis/coordination.k8s.io/v1", "leases", True),
     "Model": ("/apis/kubeai.org/v1", "models", True),
@@ -37,9 +49,26 @@ KIND_ROUTES = {
 
 
 class RestKubeClient:
-    def __init__(self, base_url: str, token: str, ca_file: str | None = None):
+    def __init__(
+        self,
+        base_url: str,
+        token: str,
+        ca_file: str | None = None,
+        max_attempts: int = 5,
+        backoff_base: float = 0.2,
+        backoff_max: float = 5.0,
+        metrics: Metrics = DEFAULT_METRICS,
+    ):
         self.base_url = base_url.rstrip("/")
         self.token = token
+        # Transient-failure retry policy: 429 (honoring Retry-After),
+        # 5xx, and connection errors (non-POST only — a connect error
+        # mid-POST may have been processed) retry up to `max_attempts`
+        # with capped exponential backoff + jitter.
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.metrics = metrics
         if ca_file:
             self._ctx = ssl.create_default_context(cafile=ca_file)
         else:
@@ -47,6 +76,16 @@ class RestKubeClient:
         self._watchers: list[tuple[tuple[str, ...] | None, queue.Queue]] = []
         self._watch_threads: list[threading.Thread] = []
         self._stop = threading.Event()
+
+    def _sleep(self, seconds: float) -> None:
+        """Interruptible backoff sleep (fake-timer tests override)."""
+        self._stop.wait(seconds)
+
+    def _backoff_delay(self, attempt: int) -> float:
+        """Jittered capped exponential delay before retry `attempt`
+        (1-based): min(max, base·2^(n-1)) × [0.5, 1.0)."""
+        base = min(self.backoff_max, self.backoff_base * (2.0 ** (attempt - 1)))
+        return base * (0.5 + 0.5 * _jitter())
 
     @staticmethod
     def in_cluster() -> "RestKubeClient":
@@ -74,25 +113,72 @@ class RestKubeClient:
         self, method: str, path: str, body: dict | None = None,
         content_type: str = "application/json",
     ) -> dict:
+        """One API request with transient-failure retries. Terminal
+        statuses map to the store's exception vocabulary immediately
+        (404→NotFound, 409→Conflict, 400/422→Invalid); 429 retries after
+        the server's Retry-After (capped), 5xx and connection errors
+        retry on the capped exponential backoff. POSTs never retry
+        connection errors — the server may have processed the create."""
         url = self.base_url + path
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Authorization", f"Bearer {self.token}")
-        req.add_header("Accept", "application/json")
-        if data is not None:
-            req.add_header("Content-Type", content_type)
+        last_exc: Exception | None = None
+        for attempt in range(1, self.max_attempts + 1):
+            req = urllib.request.Request(url, data=data, method=method)
+            req.add_header("Authorization", f"Bearer {self.token}")
+            req.add_header("Accept", "application/json")
+            if data is not None:
+                req.add_header("Content-Type", content_type)
+            try:
+                with urllib.request.urlopen(
+                    req, context=self._ctx, timeout=30
+                ) as r:
+                    return json.loads(r.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                detail = e.read().decode(errors="replace")[:500]
+                if e.code == 404:
+                    raise NotFound(detail)
+                if e.code == 409:
+                    raise Conflict(detail)
+                if e.code in (400, 422):
+                    raise Invalid(detail)
+                last_exc = e
+                if e.code == 429:
+                    reason = "429"
+                    delay = self._retry_after_delay(e, attempt)
+                elif 500 <= e.code < 600:
+                    reason = "5xx"
+                    delay = self._backoff_delay(attempt)
+                else:
+                    raise
+            except (TimeoutError, OSError) as e:
+                # urllib.error.URLError subclasses OSError; both mean the
+                # request may never have reached the server.
+                if method == "POST":
+                    raise
+                last_exc = e
+                reason = "connection"
+                delay = self._backoff_delay(attempt)
+            if attempt >= self.max_attempts or self._stop.is_set():
+                break
+            self.metrics.kubeclient_retries.inc(verb=method, reason=reason)
+            logger.debug(
+                "kube API %s %s attempt %d failed (%s), retrying in %.3fs",
+                method, path, attempt, reason, delay,
+            )
+            self._sleep(delay)
+        self.metrics.kubeclient_retry_exhausted.inc(verb=method)
+        raise last_exc  # type: ignore[misc]
+
+    def _retry_after_delay(self, e, attempt: int) -> float:
+        """429 delay: the server's Retry-After when present (capped at
+        the backoff ceiling), else the normal backoff schedule."""
+        ra = e.headers.get("Retry-After") if e.headers is not None else None
         try:
-            with urllib.request.urlopen(req, context=self._ctx, timeout=30) as r:
-                return json.loads(r.read() or b"{}")
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")[:500]
-            if e.code == 404:
-                raise NotFound(detail)
-            if e.code == 409:
-                raise Conflict(detail)
-            if e.code in (400, 422):
-                raise Invalid(detail)
-            raise
+            if ra is not None:
+                return min(max(0.0, float(ra)), self.backoff_max)
+        except (TypeError, ValueError):
+            pass
+        return self._backoff_delay(attempt)
 
     # -- store interface ------------------------------------------------------
 
@@ -137,12 +223,31 @@ class RestKubeClient:
         )
 
     def patch_merge(self, kind: str, namespace: str, name: str, patch: dict) -> dict:
-        return self._req(
-            "PATCH",
-            f"{self._route(kind, namespace)}/{name}",
-            patch,
-            content_type="application/merge-patch+json",
-        )
+        """Merge patch with bounded conflict retry: a 409 (server-side
+        write race — conflict storms in chaos tests) re-reads the object
+        (fresh rv/existence) and reapplies the same merge patch, since a
+        merge patch carries no resourceVersion of its own."""
+        path = f"{self._route(kind, namespace)}/{name}"
+        last: Conflict | None = None
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return self._req(
+                    "PATCH", path, patch,
+                    content_type="application/merge-patch+json",
+                )
+            except Conflict as e:
+                last = e
+                if attempt >= self.max_attempts or self._stop.is_set():
+                    break
+                self.metrics.kubeclient_retries.inc(
+                    verb="PATCH", reason="conflict"
+                )
+                # Fresh GET: surfaces NotFound if the object vanished
+                # mid-storm and lets the server settle the racing write.
+                self.get(kind, namespace, name)
+                self._sleep(self._backoff_delay(attempt))
+        self.metrics.kubeclient_retry_exhausted.inc(verb="PATCH")
+        raise last  # type: ignore[misc]
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         self._req("DELETE", f"{self._route(kind, namespace)}/{name}")
@@ -182,6 +287,7 @@ class RestKubeClient:
         # arrives as a RELIST sentinel + synthetic MODIFIEDs, the same
         # shape consumers already resync on after a 410.
         rv = self._relist_into(kind, q)
+        failures = 0  # consecutive broken connections → backoff exponent
         while not self._stop.is_set():
             path = self._route(kind, None) + "?watch=true"
             if rv:
@@ -200,6 +306,9 @@ class RestKubeClient:
                             ev = json.loads(line)
                         except json.JSONDecodeError:
                             continue
+                        # A live event stream means the server is healthy:
+                        # the next reconnect starts the schedule over.
+                        failures = 0
                         obj = ev.get("object") or {}
                         obj.setdefault("kind", kind)
                         rv = (obj.get("metadata") or {}).get(
@@ -221,17 +330,28 @@ class RestKubeClient:
                     # drop the gap.
                     rv = self._relist_into(kind, q)
                 else:
-                    self._stop.wait(2.0)
+                    failures = self._watch_wait(kind, failures)
             except OSError:
-                self._stop.wait(2.0)  # reconnect with backoff
+                failures = self._watch_wait(kind, failures)
+
+    def _watch_wait(self, kind: str, failures: int) -> int:
+        """Capped exponential backoff + jitter between watch reconnects
+        (the fixed 2 s sleep made every client re-dial a browned-out
+        API server in lockstep). Returns the grown failure count."""
+        failures = min(failures + 1, 16)
+        self.metrics.kubeclient_watch_reconnects.inc(kind=kind)
+        delay = min(30.0, 0.5 * (2.0 ** (failures - 1)))
+        self._sleep(delay * (0.5 + 0.5 * _jitter()))
+        return failures
 
     def _relist_into(self, kind: str, q: queue.Queue) -> str:
+        failures = 0
         while not self._stop.is_set():
             try:
                 out = self._req("GET", self._route(kind, None))
                 break
             except (OSError, NotFound):
-                self._stop.wait(2.0)
+                failures = self._watch_wait(kind, failures)
         else:
             return ""
         q.put(("RELIST", {"kind": kind, "metadata": {}}))

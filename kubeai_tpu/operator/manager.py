@@ -90,6 +90,15 @@ class Manager:
             namespace=self.namespace,
             lease_duration=self.cfg.leader_election.lease_duration_seconds,
             retry_period=self.cfg.leader_election.retry_period_seconds,
+            renew_deadline=self.cfg.leader_election.renew_deadline_seconds,
+            metrics=self.metrics,
+        )
+        # Leadership acquisition resyncs the controller: reconciles that
+        # were fenced while standby converge immediately, not at the
+        # next watch event.
+        self.leader.add_listener(
+            lambda is_leader: self.controller_loop.resync()
+            if is_leader else None
         )
         self.autoscaler = Autoscaler(
             self.store,
@@ -131,6 +140,23 @@ class Manager:
             interval_s=self.cfg.model_autoscaling.interval_seconds / 2.0,
         )
         self.autoscaler.fleet = self.fleet
+        # Actuation safety governor (kubeai_tpu/operator/governor):
+        # every destructive action — pod deletion in the reconciler,
+        # scale-down writes, planner preemption marks — flows through
+        # it: disruption budgets, telemetry-coverage gates with static
+        # stability, and leadership-lease fencing.
+        from kubeai_tpu.operator.governor import ActuationGovernor
+
+        self.governor = ActuationGovernor(
+            cfg=self.cfg.governor if self.cfg.governor.enabled else None,
+            fleet=self.fleet,
+            leader=self.leader,
+            store=self.store,
+            namespace=self.namespace,
+            metrics=self.metrics,
+        )
+        self.reconciler.governor = self.governor
+        self.model_client.governor = self.governor
         # Cluster-wide capacity planner (kubeai_tpu/fleet/planner):
         # bin-packs every model's desire onto the chip budget each tick;
         # the autoscaler applies its allocations (stale plan → direct
@@ -150,6 +176,7 @@ class Manager:
                     or self.cfg.model_autoscaling.interval_seconds
                 ),
                 preemption_enabled=self.cfg.capacity_planning.preemption,
+                governor=self.governor,
             )
             # Plan desires smooth over the SAME moving average the
             # direct scaling path uses — abundant chips must mean the
@@ -215,6 +242,11 @@ class Manager:
         from kubeai_tpu.metrics import tracing
 
         tracing.configure(service_name="kubeai-tpu-operator")
+        # Restart rehydration BEFORE the first tick: last-known-good
+        # replica counts come back from cluster annotations so a
+        # control-plane crash never causes scale thrash or duplicate
+        # repairs.
+        self.governor.rehydrate()
         self.lb.start()
         self.controller_loop.start()
         self.leader.start()
@@ -264,6 +296,8 @@ class Manager:
     def stop(self) -> None:
         if self._self_pod_name:
             try:
+                # ungoverned: the operator's own bookkeeping self-pod,
+                # not serving capacity (scripts/check_actuation_paths.py)
                 self.store.delete("Pod", self.namespace, self._self_pod_name)
             except Exception:
                 pass

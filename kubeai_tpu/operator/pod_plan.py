@@ -34,23 +34,40 @@ class PodPlan:
     def contains_actions(self) -> bool:
         return bool(self.to_create or self.to_delete)
 
-    def execute(self, store: KubeStore, model_obj: dict) -> bool:
-        """Apply the plan. Returns True if anything changed."""
+    def execute(self, store: KubeStore, model_obj: dict, governor=None) -> bool:
+        """Apply the plan through the actuation governor (lease fencing
+        + disruption budgets for healthy pods). Returns True if anything
+        changed. A budget-refused deletion simply waits for a later
+        window; the fence raising `NotLeader` aborts the whole batch."""
+        from kubeai_tpu.operator import governor as governor_mod
+
+        gov = governor if governor is not None else governor_mod.PERMISSIVE
+        # The batch is fenced as a unit: an expired leader writes nothing.
+        gov.check_fence()
         changed = False
+        model_name = self.model.name
         # Delete before create (reference: pod_plan.go:179).
         for pod in self.to_delete:
-            try:
-                store.delete(
-                    "Pod", pod["metadata"]["namespace"], pod["metadata"]["name"]
-                )
-            except NotFound:
-                pass
-            changed = True
+            # Deleting a pod that is already broken (not ready, or
+            # disrupted) is repair; only healthy serving capacity
+            # consumes disruption budget.
+            budgeted = (
+                k8sutils.pod_is_ready(pod)
+                and k8sutils.pod_disruption_reason(pod) is None
+            )
+            if gov.delete_pod(
+                store,
+                pod["metadata"]["namespace"],
+                pod["metadata"]["name"],
+                model=model_name,
+                budgeted=budgeted,
+            ):
+                changed = True
         for pod in self.to_create:
             pod = copy.deepcopy(pod)
             k8sutils.set_owner_reference(model_obj, pod)
             try:
-                store.create(pod)
+                gov.create_pod(store, pod, model=model_name)
             except Conflict:
                 pass
             changed = True

@@ -29,6 +29,11 @@ class ModelClient:
         # model -> consecutive scale-down requests (hysteresis;
         # reference: modelclient/scale.go:43-100).
         self._consecutive_scale_downs: dict[str, int] = {}
+        # Actuation governor (operator/governor): when wired by the
+        # manager, every scale-DOWN about to be written is fenced on
+        # lease validity and gated on telemetry coverage (scale-ups and
+        # scale-from-zero stay ungated — any replica may wake a model).
+        self.governor = None
 
     def lookup_model(
         self, name: str, adapter: str = "", selectors: dict[str, str] | None = None
@@ -65,6 +70,8 @@ class ModelClient:
                     return
                 if (spec.get("replicas") or 0) > 0:
                     return
+                # ungoverned: scale-from-zero wake-up — adds capacity,
+                # any replica may issue it (check_actuation_paths.py)
                 spec["replicas"] = 1
                 try:
                     self.store.update(obj)
@@ -100,12 +107,21 @@ class ModelClient:
                 )
                 if self._consecutive_scale_downs[name] < required:
                     return current
+                if self.governor is not None:
+                    replicas, _denied = self.governor.govern_scale(
+                        name, current, replicas
+                    )
+                    if replicas >= current:
+                        return current  # held (stale telemetry / fence)
             self._consecutive_scale_downs[name] = 0
+            # governed: scale-downs passed ActuationGovernor.govern_scale
             spec["replicas"] = replicas
             try:
                 self.store.update(obj)
             except Conflict:
                 return current  # next tick retries
+            if self.governor is not None:
+                self.governor.note_applied(name, replicas=replicas)
             return replicas
 
     def scale_role(self, name: str, role: str, replicas: int) -> int:
@@ -139,6 +155,12 @@ class ModelClient:
                 )
                 if self._consecutive_scale_downs[key] < required:
                     return current
+                if self.governor is not None:
+                    replicas, _denied = self.governor.govern_scale(
+                        name, current, replicas
+                    )
+                    if replicas >= current:
+                        return current  # held (stale telemetry / fence)
             self._consecutive_scale_downs[key] = 0
             ann = obj["metadata"].setdefault("annotations", {})
             ann[md.role_replicas_annotation(role)] = str(replicas)
@@ -146,6 +168,8 @@ class ModelClient:
                 self.store.update(obj)
             except Conflict:
                 return current  # next tick retries
+            if self.governor is not None:
+                self.governor.note_applied(name, roles={role: replicas})
             return replicas
 
     def consecutive_scale_downs(self, name: str) -> int:

@@ -1,11 +1,16 @@
 """Deterministic test doubles for the resilience suite."""
 
 from kubeai_tpu.testing.faults import (
+    API_FAULT_DROP,
+    API_FAULT_HTTP,
+    API_FAULT_STALL,
     FAULT_CONNECT_ERROR,
     FAULT_DIE_MID_STREAM,
     FAULT_HTTP,
     FAULT_STALL,
     FAULT_TIMEOUT,
+    ApiFault,
+    ApiFaultPlan,
     FakeClock,
     Fault,
     FaultPlan,

@@ -116,6 +116,91 @@ class FaultPlan:
         return None
 
 
+# ---- API-server fault plan ---------------------------------------------------
+#
+# The control-plane analog of FaultPlan: a deterministic, schedule-driven
+# description of what the (fake) kube-apiserver does to which requests —
+# "the first 3 PATCHes to models 409", "every pod LIST 429s with
+# Retry-After: 0.05 for attempts 1-10", "watch GETs stall 5 s". Consumed
+# by FakeKubeApiServer (kubeai_tpu/operator/k8s/envtest.py) so
+# RestKubeClient's retry/backoff/conflict-retry paths are exercised
+# against real HTTP, and by benchmarks/control_plane_chaos_sim.py.
+
+API_FAULT_HTTP = "http"       # respond with `status` (+ headers/message)
+API_FAULT_DROP = "drop"       # close the connection without responding
+API_FAULT_STALL = "stall"     # sleep stall_s, then handle normally
+
+API_FAULT_KINDS = (API_FAULT_HTTP, API_FAULT_DROP, API_FAULT_STALL)
+
+
+@dataclasses.dataclass
+class ApiFault:
+    """One scheduled failure mode for one (method, resource) pair.
+
+    Matching is positional over the (method, plural, watch?) request
+    counter (1-based), `start..end` range (end=None → forever) or
+    `every` Nth. `method="*"` / `plural="*"` match all; `watch` narrows
+    to watch GETs (True), non-watch requests (False), or both (None).
+    """
+
+    method: str = "*"
+    plural: str = "*"
+    watch: bool | None = None
+    kind: str = API_FAULT_HTTP
+    status: int = 500
+    headers: dict | None = None   # e.g. {"Retry-After": "0.05"}
+    message: str = "injected fault"
+    reason: str = "InternalError"
+    start: int = 1
+    end: int | None = None
+    every: int = 0
+    stall_s: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in API_FAULT_KINDS:
+            raise ValueError(f"unknown API fault kind {self.kind!r}")
+
+    def matches_request(self, method: str, plural: str, watch: bool) -> bool:
+        if self.method not in ("*", method):
+            return False
+        if self.plural not in ("*", plural):
+            return False
+        if self.watch is not None and self.watch != watch:
+            return False
+        return True
+
+    def matches_count(self, n: int) -> bool:
+        if self.every:
+            return n % self.every == 0
+        return self.start <= n and (self.end is None or n <= self.end)
+
+
+class ApiFaultPlan:
+    """Schedule of API faults + per-(method, plural, watch) request
+    counters + decision log — deterministic, like FaultPlan."""
+
+    def __init__(self, faults: list[ApiFault] | tuple[ApiFault, ...] = ()):
+        self.faults = list(faults)
+        self.counts: dict[tuple[str, str, bool], int] = defaultdict(int)
+        # (method, plural, watch, count, fault_kind_or_None)
+        self.log: list[tuple[str, str, bool, int, str | None]] = []
+
+    def on_request(
+        self, method: str, plural: str, watch: bool = False
+    ) -> ApiFault | None:
+        key = (method, plural, bool(watch))
+        self.counts[key] += 1
+        n = self.counts[key]
+        for f in self.faults:
+            if f.matches_request(method, plural, bool(watch)) and (
+                f.matches_count(n)
+            ):
+                self.log.append((method, plural, bool(watch), n, f.kind))
+                return f
+        self.log.append((method, plural, bool(watch), n, None))
+        return None
+
+
 # ---- proxy-send wrapper ------------------------------------------------------
 
 

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Actuation-path gate: no destructive control-plane call site may
+bypass the governor.
+
+The actuation safety governor (kubeai_tpu/operator/governor.py) is only
+a safety property if EVERY destructive call site routes through it — a
+single new `store.delete("Pod", ...)` elsewhere reopens the mass-
+self-harm hole PR 8 closed. This gate scans kubeai_tpu/ for:
+
+  - Pod deletions: `.delete("Pod"` / `.delete_all_of("Pod"` (literal
+    kind, possibly across a line break);
+  - replica-spec writes: `spec["replicas"] = ...`.
+
+A hit is a violation unless it is
+
+  - inside `operator/governor.py` (the governor IS the gate), or
+  - inside `operator/k8s/` (the client/store/envtest implementations the
+    governor calls through), or
+  - annotated with a reviewed pragma on the same or the preceding line:
+    `# governed:` (the call is reached only via the governor) or
+    `# ungoverned: <reason>` (explicitly reviewed as out of scope, e.g.
+    the manager's own bookkeeping self-pod).
+
+Run directly (exit 1 on violations) or import `check()` — a tier-1 test
+wires it in so a new unguarded actuation path fails CI.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO_ROOT, "kubeai_tpu")
+
+# Files allowed to touch pods/spec directly.
+_EXEMPT_PARTS = (
+    os.path.join("operator", "governor.py"),
+    os.path.join("operator", "k8s") + os.sep,
+)
+
+_PATTERNS = (
+    re.compile(r"\.delete\(\s*[\"']Pod[\"']", re.S),
+    re.compile(r"\.delete_all_of\(\s*[\"']Pod[\"']", re.S),
+    re.compile(r"spec\[[\"']replicas[\"']\]\s*=", re.S),
+)
+
+_PRAGMA = re.compile(r"#\s*(un)?governed\b")
+
+
+def _exempt_file(rel: str) -> bool:
+    return any(part in rel for part in _EXEMPT_PARTS)
+
+
+def _has_pragma(lines: list[str], lineno: int) -> bool:
+    """Pragma on the matched line or either of the two lines above it
+    (multi-line call sites put the comment above the statement)."""
+    for i in range(max(0, lineno - 3), lineno):
+        if _PRAGMA.search(lines[i]):
+            return True
+    return False
+
+
+def check(pkg: str = PKG) -> list[str]:
+    """Returns human-readable violations (empty = every destructive
+    call site is governed or explicitly reviewed)."""
+    violations: list[str] = []
+    for root, _dirs, files in os.walk(pkg):
+        if "__pycache__" in root:
+            continue
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, REPO_ROOT)
+            if _exempt_file(rel):
+                continue
+            with open(path) as f:
+                text = f.read()
+            lines = text.splitlines()
+            for pat in _PATTERNS:
+                for m in pat.finditer(text):
+                    lineno = text.count("\n", 0, m.start()) + 1
+                    if _has_pragma(lines, lineno):
+                        continue
+                    snippet = lines[lineno - 1].strip()[:80]
+                    violations.append(
+                        f"{rel}:{lineno}: unguarded actuation path "
+                        f"`{snippet}` — route it through "
+                        "ActuationGovernor (operator/governor.py) or "
+                        "annotate `# governed:`/`# ungoverned: <reason>`"
+                    )
+    return sorted(set(violations))
+
+
+def main() -> int:
+    violations = check()
+    if violations:
+        print("unguarded actuation paths detected:")
+        for v in violations:
+            print(f"  - {v}")
+        return 1
+    print("all destructive actuation paths route through the governor")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

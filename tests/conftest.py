@@ -77,6 +77,13 @@ def pytest_configure(config):
         "right-sizing, fake-clock planner sim (runs in the fast tier; "
         "select with -m planner)",
     )
+    config.addinivalue_line(
+        "markers",
+        "controlplane: control-plane fault-tolerance suite — actuation "
+        "governor budgets/gates, leader-election fencing, kube-client "
+        "retry storms, fake-clock chaos sim (runs in the fast tier; "
+        "select with -m controlplane)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):
