@@ -1,0 +1,381 @@
+"""Deterministic cluster-KV-sharing simulation — no JAX, no sockets.
+
+Plays the same multi-turn chat workload (conversations whose prompts
+grow turn over turn and share a common system prefix) against the same
+replica fleet twice on a fake clock:
+
+  * BASELINE: classic CHWBL prefix-hash routing, per-replica prefix
+    caches only. A request spilled off its hash target by the bounded
+    load threshold lands on a replica that holds none of its pages and
+    pays the full prefill; a conversation's history is re-prefilled on
+    every replica it ever touches.
+  * SHARING: the full cluster tier. Every replica advertises its held
+    page-hash chains; routing goes through the REAL load-balancer
+    Group's longest-held-prefix pick (same bounded-load threshold), and
+    a serving replica missing pages fetches them from the deepest
+    closed-circuit holder (the REAL `Group.kv_holder` gate) instead of
+    recomputing — unless the request's deadline budget can't cover the
+    transfer, in which case it recomputes locally.
+
+Mid-run one replica's circuit is tripped open while its (still
+advertised) holdings stay in the pushed map, so the sim exercises the
+holder gate with a live temptation. A recurring slice of requests
+carries a zero fetch budget, exercising the deadline gate.
+
+Page-hash chains are the REAL `page_hash_chain` fold (bit-identical to
+the engine's `_prefix_hashes`), capped at the engine's admission limit.
+
+Invariants (asserted in tier-1 by tests/unit/test_kv_sharing_sim.py):
+
+  * the sharing fleet prefills STRICTLY fewer tokens than baseline on
+    the identical workload (the tier's reason to exist);
+  * zero peer fetches issued to an open-circuit peer;
+  * zero peer fetches issued past the request's deadline budget;
+  * mean TTFT no worse than baseline (pages transfer faster than they
+    recompute);
+  * the run is deterministic: same inputs, byte-identical report.
+
+Run directly for the full-size report:
+
+    python benchmarks/kv_sharing_sim.py
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+from collections import OrderedDict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeai_tpu.crd.model import LB_STRATEGY_PREFIX_HASH
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.routing.health import STATE_CLOSED, BreakerPolicy
+from kubeai_tpu.routing.loadbalancer import Group, NoHealthyEndpoints
+from kubeai_tpu.routing.prefixchain import page_hash_chain
+from kubeai_tpu.testing.faults import FakeClock
+
+PAGE = 16  # tokens per KV page
+PREFILL_RATE = 64  # tokens prefilled per tick
+FETCH_PAGES_PER_TICK = 8  # peer-transfer bandwidth (pages/tick)
+DECODE_TICKS = 4  # fixed decode tail per request
+SYS_TOKENS = [7] * (4 * PAGE)  # shared system prefix: 4 full pages
+
+
+def _user_turn(conv: int, turn: int) -> list[int]:
+    return [(1009 * conv + 53 * turn + j) % 50021 for j in range(48)]
+
+
+def _assistant_turn(conv: int, turn: int) -> list[int]:
+    return [(7919 * conv + 97 * turn + j) % 50021 for j in range(32)]
+
+
+class _Arrival:
+    __slots__ = ("tick", "conv", "turn", "prompt_ids", "history_ids", "budget")
+
+    def __init__(self, tick, conv, turn, prompt_ids, history_ids, budget):
+        self.tick = tick
+        self.conv = conv
+        self.turn = turn
+        self.prompt_ids = prompt_ids  # tokens the request prefills over
+        self.history_ids = history_ids  # prompt + response: cached after
+        self.budget = budget  # fetch-deadline budget in ticks
+
+
+def _workload(
+    n_convs: int, n_turns: int, turn_gap: int, tight_every: int
+) -> list[_Arrival]:
+    """Deterministic multi-turn arrivals: conversation c's turn t lands
+    at `t*turn_gap + c`, so each round's requests overlap in flight and
+    the bounded-load threshold actually bites. Every `tight_every`-th
+    request (fleet-wide order) carries a zero fetch budget — its TTFT
+    deadline leaves no room for a peer transfer."""
+    arrivals: list[_Arrival] = []
+    rid = 0
+    for turn in range(n_turns):
+        for conv in range(n_convs):
+            history: list[int] = list(SYS_TOKENS)
+            for prev in range(turn):
+                history += _user_turn(conv, prev)
+                history += _assistant_turn(conv, prev)
+            prompt = history + _user_turn(conv, turn)
+            after = prompt + _assistant_turn(conv, turn)
+            budget = 0 if rid % tight_every == tight_every - 1 else 10
+            arrivals.append(
+                _Arrival(turn * turn_gap + conv, conv, turn, prompt, after,
+                         budget)
+            )
+            rid += 1
+    return arrivals
+
+
+class _Replica:
+    """One replica's prefix cache: an LRU of held page hashes, the same
+    shape `PageAllocator` exposes through `holdings()`."""
+
+    def __init__(self, addr: str, cache_pages: int):
+        self.addr = addr
+        self.cache_pages = cache_pages
+        self.held: OrderedDict[str, bool] = OrderedDict()
+
+    def held_depth(self, chain: list[str]) -> int:
+        depth = 0
+        for h in chain:
+            if h not in self.held:
+                break
+            depth += 1
+        return depth
+
+    def insert(self, hashes: list[str]) -> None:
+        for h in hashes:
+            self.held[h] = True
+            self.held.move_to_end(h)
+        while len(self.held) > self.cache_pages:
+            self.held.popitem(last=False)  # LRU eviction
+
+
+def _run_fleet(
+    arrivals: list[_Arrival],
+    n_replicas: int,
+    cache_pages: int,
+    sharing: bool,
+    trip_at: int,
+) -> dict:
+    clock = FakeClock()
+    group = Group(
+        metrics=Metrics(), model="sim",
+        breaker=BreakerPolicy(consecutive_failures=1, open_seconds=1e9),
+        clock=clock,
+    )
+    replicas = [
+        _Replica(f"replica-{i}:1", cache_pages) for i in range(n_replicas)
+    ]
+    by_addr = {r.addr: r for r in replicas}
+    group.reconcile_endpoints({r.addr: set() for r in replicas})
+
+    dead_addr = replicas[0].addr if n_replicas > 1 else None
+    tripped = False
+
+    prefill_tokens = 0
+    ttfts: list[int] = []
+    fetch_attempts = 0
+    fetched_pages = 0
+    deadline_gated = 0
+    fetches_past_deadline = 0
+    fetches_to_open_circuit = 0
+    open_circuit_picks = 0
+    holder_route_picks = 0
+    dead_holdings_advertised = False
+
+    active: list[tuple[int, object, str]] = []  # (finish_tick, done, addr)
+    queue = sorted(arrivals, key=lambda a: (a.tick, a.conv))
+    ai = 0
+    now = 0
+    while ai < len(queue) or active:
+        clock.advance(1.0)
+
+        # Fleet-aggregator collect loop: push every replica's holdings
+        # each tick (interval well inside the holdings TTL). The DEAD
+        # replica keeps advertising — the holder gate, not the push,
+        # must keep fetches away from it.
+        if sharing:
+            holdings = {r.addr: list(r.held) for r in replicas}
+            group.set_kv_holdings(holdings)
+            if tripped and dead_addr and holdings.get(dead_addr):
+                dead_holdings_advertised = True
+
+        if dead_addr is not None and not tripped and now == trip_at:
+            addr, done = group.get_best_addr(
+                "LeastLoad", "", "", timeout=0.0,
+                exclude=[r.addr for r in replicas if r.addr != dead_addr],
+            )
+            done(outcome="connect_error", error="simulated replica death")
+            tripped = True
+
+        still: list[tuple[int, object, str]] = []
+        for finish, done, addr in active:
+            if finish <= now:
+                # Streams that were mid-flight on the dead replica when
+                # its circuit tripped finish without feeding the breaker
+                # (their success must not half-close the open circuit).
+                if tripped and addr == dead_addr:
+                    done()
+                else:
+                    done(outcome="success")
+            else:
+                still.append((finish, done, addr))
+        active = still
+
+        while ai < len(queue) and queue[ai].tick <= now:
+            req = queue[ai]
+            ai += 1
+            ids = req.prompt_ids
+            full_chain = page_hash_chain(ids, PAGE)
+            chain = full_chain[: max(0, (len(ids) - 1) // PAGE)]
+            try:
+                addr, done = group.get_best_addr(
+                    LB_STRATEGY_PREFIX_HASH, "", f"conv-{req.conv}",
+                    timeout=0.0, chain=chain if sharing else None,
+                )
+            except NoHealthyEndpoints:
+                queue.append(req)  # retry next tick (keep sort stable)
+                queue.sort(key=lambda a: (a.tick, a.conv))
+                continue
+            ep_state = group.snapshot()["endpoints"][addr]["state"]
+            if ep_state != STATE_CLOSED:
+                open_circuit_picks += 1
+            replica = by_addr[addr]
+            local = replica.held_depth(chain)
+            if local > 0:
+                holder_route_picks += 1
+
+            covered = local
+            fetch_cost = 0
+            if sharing and local < len(chain):
+                peer, depth = group.kv_holder(chain, exclude={addr})
+                if peer is not None and depth > local:
+                    pages = depth - local
+                    cost = math.ceil(pages / FETCH_PAGES_PER_TICK)
+                    if cost > req.budget:
+                        # Deadline gate: the transfer won't land inside
+                        # the request's TTFT budget — recompute locally.
+                        deadline_gated += 1
+                    else:
+                        peer_state = (
+                            group.snapshot()["endpoints"]
+                            .get(peer, {"state": "gone"})["state"]
+                        )
+                        if peer_state != STATE_CLOSED:
+                            fetches_to_open_circuit += 1
+                        if cost > req.budget:
+                            fetches_past_deadline += 1
+                        fetch_attempts += 1
+                        fetched_pages += pages
+                        covered = depth
+                        fetch_cost = cost
+
+            tokens = len(ids) - covered * PAGE
+            prefill_tokens += tokens
+            prefill_ticks = math.ceil(tokens / PREFILL_RATE)
+            ttfts.append(fetch_cost + prefill_ticks)
+            # After serving, the replica holds every full page of the
+            # post-response history (what the engine's prefix cache
+            # registers as the stream retires).
+            replica.insert(page_hash_chain(req.history_ids, PAGE))
+            active.append(
+                (now + fetch_cost + prefill_ticks + DECODE_TICKS, done, addr)
+            )
+
+        now += 1
+        if now > 100_000:
+            raise RuntimeError("kv-sharing sim did not converge")
+
+    return {
+        "completed": len(ttfts),
+        "prefill_tokens": prefill_tokens,
+        "mean_ttft": sum(ttfts) / max(1, len(ttfts)),
+        "fetch_attempts": fetch_attempts,
+        "fetched_pages": fetched_pages,
+        "deadline_gated_fetches": deadline_gated,
+        "fetches_past_deadline": fetches_past_deadline,
+        "fetches_to_open_circuit": fetches_to_open_circuit,
+        "open_circuit_picks": open_circuit_picks,
+        "holder_route_picks": holder_route_picks,
+        "circuit_tripped": tripped,
+        "dead_holdings_advertised": dead_holdings_advertised,
+    }
+
+
+def run_sim(
+    n_convs: int = 12,
+    n_turns: int = 6,
+    n_replicas: int = 4,
+    cache_pages: int = 512,
+    turn_gap: int = 14,
+    tight_every: int = 3,
+) -> dict:
+    arrivals = _workload(n_convs, n_turns, turn_gap, tight_every)
+    trip_at = (n_turns * turn_gap) // 2
+    baseline = _run_fleet(
+        arrivals, n_replicas, cache_pages, sharing=False, trip_at=trip_at
+    )
+    sharing = _run_fleet(
+        arrivals, n_replicas, cache_pages, sharing=True, trip_at=trip_at
+    )
+    return {
+        "params": {
+            "n_convs": n_convs,
+            "n_turns": n_turns,
+            "n_replicas": n_replicas,
+            "cache_pages": cache_pages,
+            "turn_gap": turn_gap,
+            "tight_every": tight_every,
+            "page_size": PAGE,
+        },
+        "baseline": baseline,
+        "sharing": sharing,
+    }
+
+
+def check_invariants(summary: dict) -> list[str]:
+    """Empty list = every cluster-KV-sharing promise held."""
+    errors: list[str] = []
+    base, share = summary["baseline"], summary["sharing"]
+    n = summary["params"]["n_convs"] * summary["params"]["n_turns"]
+    for name, run in (("baseline", base), ("sharing", share)):
+        if run["completed"] != n:
+            errors.append(
+                f"lost requests: {name} completed {run['completed']}/{n}"
+            )
+        if not run["circuit_tripped"]:
+            errors.append(f"{name}: replica-death scenario never armed")
+        if run["open_circuit_picks"] != 0:
+            errors.append(
+                f"{name}: {run['open_circuit_picks']} pick(s) routed to an "
+                "open-circuit replica"
+            )
+    if share["prefill_tokens"] >= base["prefill_tokens"]:
+        errors.append(
+            "sharing did not reduce fleet prefill: "
+            f"{share['prefill_tokens']} >= {base['prefill_tokens']} tokens"
+        )
+    if share["mean_ttft"] > base["mean_ttft"]:
+        errors.append(
+            f"TTFT regressed: sharing mean {share['mean_ttft']:.2f} > "
+            f"baseline mean {base['mean_ttft']:.2f}"
+        )
+    if share["fetches_to_open_circuit"] != 0:
+        errors.append(
+            f"{share['fetches_to_open_circuit']} fetch(es) issued to an "
+            "open-circuit peer"
+        )
+    if share["fetches_past_deadline"] != 0:
+        errors.append(
+            f"{share['fetches_past_deadline']} fetch(es) issued past the "
+            "request deadline budget"
+        )
+    # Contrast guards: a sim that never tempts its gates proves nothing.
+    if share["fetch_attempts"] == 0:
+        errors.append("no peer fetches occurred — sim lost its contrast")
+    if share["deadline_gated_fetches"] == 0:
+        errors.append("the deadline gate was never exercised")
+    if not share["dead_holdings_advertised"]:
+        errors.append(
+            "the dead replica's holdings were never advertised after the "
+            "trip — the open-circuit holder gate went untested"
+        )
+    return errors
+
+
+if __name__ == "__main__":
+    summary = run_sim()
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    problems = check_invariants(summary)
+    if problems:
+        print("\nINVARIANT VIOLATIONS:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        sys.exit(1)
+    print("\nall invariants held")

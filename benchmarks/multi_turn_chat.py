@@ -9,6 +9,21 @@ Usage:
       --model MODEL --threads 32 --turns 4 --max-tokens 64
 
 Prints a JSON report (mean/p50/p90 TTFT ms, mean ITL ms, output tok/s).
+
+A/B mode for the cluster KV-sharing tier: point `--ab-base-url` at a
+second, sharing-disabled deployment of the same model and the harness
+replays the IDENTICAL seeded workload against both fleets back to back.
+With `--engine-urls` / `--ab-engine-urls` (comma-separated direct
+engine addresses) it also scrapes each fleet's engine /metrics before
+and after its run and reports FLEET PREFILL TOKENS — prompt tokens
+actually prefilled, net of prefix-cache hits — plus the peer-fetch
+counters, the numbers the sharing tier exists to move:
+
+  python benchmarks/multi_turn_chat.py --model M \
+      --base-url http://sharing-lb:8000/openai \
+      --engine-urls http://eng-a:9000,http://eng-b:9000 \
+      --ab-base-url http://baseline-lb:8000/openai \
+      --ab-engine-urls http://base-a:9000,http://base-b:9000
 """
 
 from __future__ import annotations
@@ -91,23 +106,56 @@ def run_conversation(base_url, model, turns, max_tokens, seed, results, lock):
             results["requests"] += 1
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--base-url", default="http://127.0.0.1:8000/openai")
-    ap.add_argument("--model", required=True)
-    ap.add_argument("--threads", type=int, default=16)
-    ap.add_argument("--turns", type=int, default=4)
-    ap.add_argument("--max-tokens", type=int, default=64)
-    ap.add_argument("--seed", type=int, default=0)
-    args = ap.parse_args()
+# Engine counters the A/B report diffs per arm (summed across engines
+# and label sets): prompt tokens minus prefix-cache-hit tokens = tokens
+# actually prefilled; the kv_fetch family sizes the peer-transfer work
+# that replaced recompute.
+_FLEET_COUNTERS = (
+    "kubeai_engine_prompt_tokens_total",
+    "kubeai_engine_prefix_cached_tokens_total",
+    "kubeai_kv_fetch_attempts_total",
+    "kubeai_kv_fetch_bytes_total",
+    "kubeai_kv_fetch_failures_total",
+)
 
+
+def _scrape_counters(engine_urls: list[str]) -> dict[str, float]:
+    totals = dict.fromkeys(_FLEET_COUNTERS, 0.0)
+    for url in engine_urls:
+        req = urllib.request.Request(f"{url.rstrip('/')}/metrics")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            for line in resp.read().decode("utf-8", "replace").splitlines():
+                if line.startswith("#"):
+                    continue
+                for name in _FLEET_COUNTERS:
+                    if line.startswith(name) and (
+                        line[len(name)] in ("{", " ")
+                    ):
+                        try:
+                            totals[name] += float(line.rsplit(" ", 1)[1])
+                        except (ValueError, IndexError):
+                            pass
+    return totals
+
+
+def _pct(xs, p):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+
+def run_arm(base_url: str, engine_urls: list[str], args) -> dict:
+    """One load run against one fleet. The same --seed produces the
+    byte-identical conversation workload on every arm."""
+    before = _scrape_counters(engine_urls) if engine_urls else None
     results = {"ttft": [], "itl": [], "out_chars": 0, "requests": 0, "errors": 0}
     lock = threading.Lock()
     t0 = time.perf_counter()
     threads = [
         threading.Thread(
             target=run_conversation,
-            args=(args.base_url, args.model, args.turns, args.max_tokens,
+            args=(base_url, args.model, args.turns, args.max_tokens,
                   args.seed * 1000 + i, results, lock),
         )
         for i in range(args.threads)
@@ -118,27 +166,87 @@ def main():
         t.join()
     wall = time.perf_counter() - t0
 
-    def pct(xs, p):
-        if not xs:
-            return None
-        xs = sorted(xs)
-        return xs[min(len(xs) - 1, int(p * len(xs)))]
-
     report = {
+        "base_url": base_url,
         "requests": results["requests"],
         "errors": results["errors"],
         "wall_s": round(wall, 2),
         "mean_ttft_ms": round(statistics.mean(results["ttft"]) * 1e3, 2)
         if results["ttft"] else None,
-        "p50_ttft_ms": round(pct(results["ttft"], 0.5) * 1e3, 2)
+        "p50_ttft_ms": round(_pct(results["ttft"], 0.5) * 1e3, 2)
         if results["ttft"] else None,
-        "p90_ttft_ms": round(pct(results["ttft"], 0.9) * 1e3, 2)
+        "p90_ttft_ms": round(_pct(results["ttft"], 0.9) * 1e3, 2)
         if results["ttft"] else None,
         "mean_itl_ms": round(statistics.mean(results["itl"]) * 1e3, 2)
         if results["itl"] else None,
         "output_chars_per_s": round(results["out_chars"] / wall, 1),
     }
-    print(json.dumps(report))
+    if before is not None:
+        after = _scrape_counters(engine_urls)
+        delta = {k: after[k] - before[k] for k in _FLEET_COUNTERS}
+        prompt = delta["kubeai_engine_prompt_tokens_total"]
+        cached = delta["kubeai_engine_prefix_cached_tokens_total"]
+        report["fleet_prompt_tokens"] = int(prompt)
+        report["fleet_prefix_cached_tokens"] = int(cached)
+        report["fleet_prefill_tokens"] = int(prompt - cached)
+        report["kv_fetch_attempts"] = int(
+            delta["kubeai_kv_fetch_attempts_total"]
+        )
+        report["kv_fetch_bytes"] = int(delta["kubeai_kv_fetch_bytes_total"])
+        report["kv_fetch_failures"] = int(
+            delta["kubeai_kv_fetch_failures_total"]
+        )
+    return report
+
+
+def _urls(csv: str) -> list[str]:
+    return [u.strip() for u in csv.split(",") if u.strip()]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base-url", default="http://127.0.0.1:8000/openai")
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--threads", type=int, default=16)
+    ap.add_argument("--turns", type=int, default=4)
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--engine-urls", default="",
+        help="comma-separated direct engine addresses behind --base-url; "
+        "enables the fleet prefill-token / kv-fetch counter diff",
+    )
+    ap.add_argument(
+        "--ab-base-url", default="",
+        help="second fleet (sharing disabled) to replay the identical "
+        "seeded workload against — enables the A/B report",
+    )
+    ap.add_argument(
+        "--ab-engine-urls", default="",
+        help="engine addresses behind --ab-base-url",
+    )
+    args = ap.parse_args()
+
+    sharing = run_arm(args.base_url, _urls(args.engine_urls), args)
+    if not args.ab_base_url:
+        print(json.dumps(sharing))
+        return
+
+    baseline = run_arm(args.ab_base_url, _urls(args.ab_engine_urls), args)
+    report = {"sharing": sharing, "baseline": baseline}
+    if "fleet_prefill_tokens" in sharing and "fleet_prefill_tokens" in baseline:
+        saved = (
+            baseline["fleet_prefill_tokens"] - sharing["fleet_prefill_tokens"]
+        )
+        report["prefill_tokens_saved"] = saved
+        report["prefill_tokens_saved_pct"] = round(
+            100.0 * saved / baseline["fleet_prefill_tokens"], 2
+        ) if baseline["fleet_prefill_tokens"] else None
+    if sharing["mean_ttft_ms"] and baseline["mean_ttft_ms"]:
+        report["ttft_delta_ms"] = round(
+            sharing["mean_ttft_ms"] - baseline["mean_ttft_ms"], 2
+        )
+    print(json.dumps(report, indent=2))
 
 
 if __name__ == "__main__":

@@ -298,6 +298,47 @@ class Disaggregation:
 
 
 @dataclasses.dataclass
+class KVSharing:
+    """Cluster-shared prefix/KV cache tier (in-tree engine only; no
+    reference analog). When enabled, replicas publish their held
+    page-hash chains through /v1/state, the LB routes base-model
+    requests to the endpoint holding the deepest matching chain
+    (falling back to classic CHWBL when the holdings map is stale or
+    empty), and the serving replica pulls the common-prefix KV pages
+    from the holding peer over the chunked-HTTP page-export transport
+    instead of recomputing them."""
+
+    enabled: bool = False
+    # KV page size in tokens — must match the engine's --page-size so
+    # the front-door chain hashes line up with the engine's prefix
+    # cache keys.
+    page_size: int = 16
+    # Optional tokenizer directory for the front-door chain computer.
+    # Empty = the deterministic byte tokenizer (matches an engine
+    # serving without a model directory).
+    tokenizer_dir: str = ""
+    # Serialized page-export size cap per fetch (0 = unlimited) and the
+    # requester's fetch timeout toward the holding peer.
+    max_transfer_mb: int = 0
+    fetch_timeout_seconds: float = 5.0
+    # Optional object-store URL evicted idle pages spill to (and are
+    # re-filled from). Empty = in-memory spill only.
+    spill_url: str = ""
+
+    def validate(self) -> None:
+        if not self.enabled:
+            return
+        if self.page_size < 1:
+            raise ValidationError("kvSharing.pageSize must be >= 1")
+        if self.max_transfer_mb < 0:
+            raise ValidationError("kvSharing.maxTransferMB must be >= 0")
+        if self.fetch_timeout_seconds <= 0:
+            raise ValidationError(
+                "kvSharing.fetchTimeoutSeconds must be > 0"
+            )
+
+
+@dataclasses.dataclass
 class ModelSpec:
     """(reference: api/k8s/v1/model_types.go:36-144)"""
 
@@ -335,6 +376,8 @@ class ModelSpec:
     disaggregation: Disaggregation = dataclasses.field(
         default_factory=Disaggregation
     )
+    # Cluster-shared prefix/KV cache tier (in-tree engine only).
+    kv_sharing: KVSharing = dataclasses.field(default_factory=KVSharing)
     # Graceful-drain budget: seconds an engine waits for in-flight
     # generations after SIGTERM / POST /v1/drain before terminating the
     # remainder. 0 = the system config `resilience.drainTimeout`
@@ -419,6 +462,11 @@ class ModelSpec:
         if self.disaggregation.enabled and self.engine != ENGINE_KUBEAI_TPU:
             raise ValidationError(
                 "spec.disaggregation requires the KubeAITPU engine"
+            )
+        self.kv_sharing.validate()
+        if self.kv_sharing.enabled and self.engine != ENGINE_KUBEAI_TPU:
+            raise ValidationError(
+                "spec.kvSharing requires the KubeAITPU engine"
             )
         if self.drain_timeout_seconds < 0:
             raise ValidationError("drainTimeoutSeconds must be >= 0")
@@ -580,6 +628,7 @@ class Model:
         ph = lb.get("prefixHash", {}) or {}
         cb = lb.get("circuitBreaker", {}) or {}
         dis = spec.get("disaggregation", {}) or {}
+        kvs = spec.get("kvSharing", {}) or {}
 
         def _role_scaling(key: str) -> RoleScaling:
             r = dis.get(key) or {}
@@ -679,6 +728,16 @@ class Model:
                     transfer_timeout_seconds=float(
                         dis.get("transferTimeoutSeconds", 30) or 30
                     ),
+                ),
+                kv_sharing=KVSharing(
+                    enabled=bool(kvs.get("enabled", False)),
+                    page_size=int(kvs.get("pageSize", 16) or 16),
+                    tokenizer_dir=kvs.get("tokenizerDir", ""),
+                    max_transfer_mb=int(kvs.get("maxTransferMB", 0) or 0),
+                    fetch_timeout_seconds=float(
+                        kvs.get("fetchTimeoutSeconds", 5) or 5
+                    ),
+                    spill_url=kvs.get("spillURL", ""),
                 ),
             ),
             status=ModelStatus(
@@ -795,5 +854,23 @@ def _spec_to_dict(s: ModelSpec) -> dict:
                 else {}
             ),
             "transferTimeoutSeconds": dis.transfer_timeout_seconds,
+        }
+    if s.kv_sharing.enabled:
+        kvs = s.kv_sharing
+        d["kvSharing"] = {
+            "enabled": True,
+            "pageSize": kvs.page_size,
+            **(
+                {"tokenizerDir": kvs.tokenizer_dir}
+                if kvs.tokenizer_dir
+                else {}
+            ),
+            **(
+                {"maxTransferMB": kvs.max_transfer_mb}
+                if kvs.max_transfer_mb
+                else {}
+            ),
+            "fetchTimeoutSeconds": kvs.fetch_timeout_seconds,
+            **({"spillURL": kvs.spill_url} if kvs.spill_url else {}),
         }
     return d

@@ -41,6 +41,12 @@ import numpy as np
 MAGIC = b"KVH1"
 VERSION = 1
 
+# Partial-chain page export (the cluster KV-sharing tier): same framing
+# as KVH1 but a distinct magic, so the two blob kinds can never be
+# confused — a KVP1 blob carries CACHE CONTENT (idle-pool prefix pages
+# keyed by their hash chain), not a request in flight.
+PAGES_MAGIC = b"KVP1"
+
 
 class HandoffError(ValueError):
     """Malformed or incompatible handoff blob."""
@@ -197,5 +203,111 @@ def deserialize(blob: bytes) -> KVHandoff:
         adapter=str(header.get("adapter", "")),
         client=str(header.get("client", "")),
         priority=str(header.get("priority", "")),
+        model=str(header.get("model", "")),
+    )
+
+
+@dataclasses.dataclass
+class KVPageExport:
+    """A run of consecutive prefix pages keyed by their hash chain — the
+    transfer unit of the cluster KV-sharing tier. Unlike `KVHandoff`
+    (one request's full state), this carries only CACHE CONTENT: every
+    shipped page is a FULL page whose bytes are immutable under the
+    chain hash, so the importer can park them unowned in its idle pool
+    and let ordinary admission adopt them. An empty export (zero pages)
+    is valid and round-trips — it is how a holder answers "I no longer
+    hold any of that chain"."""
+
+    prefix_hashes: tuple[str, ...]  # hex chain, one hash per page
+    page_size: int
+    dtype: str
+    k_pages: np.ndarray  # [NL, n_pages, page, KVH, D]
+    v_pages: np.ndarray
+    model: str = ""
+
+    @property
+    def n_pages(self) -> int:
+        return int(self.k_pages.shape[1])
+
+    def nbytes(self) -> int:
+        return int(self.k_pages.nbytes + self.v_pages.nbytes)
+
+
+def serialize_pages(e: KVPageExport) -> bytes:
+    nl, n_pages, page, kvh, d = e.k_pages.shape
+    if e.v_pages.shape != e.k_pages.shape:
+        raise HandoffError(
+            f"K/V shape mismatch: {e.k_pages.shape} vs {e.v_pages.shape}"
+        )
+    if len(e.prefix_hashes) != n_pages:
+        raise HandoffError(
+            f"{len(e.prefix_hashes)} hashes for {n_pages} pages"
+        )
+    header = {
+        "version": VERSION,
+        "dtype": e.dtype,
+        "num_layers": nl,
+        "n_pages": n_pages,
+        "page_size": page,
+        "kv_heads": kvh,
+        "head_dim": d,
+        "prefix_hashes": list(e.prefix_hashes),
+        "model": e.model,
+    }
+    hdr = json.dumps(header).encode()
+    k = np.ascontiguousarray(e.k_pages)
+    v = np.ascontiguousarray(e.v_pages)
+    return b"".join(
+        [PAGES_MAGIC, struct.pack("<I", len(hdr)), hdr, k.tobytes(),
+         v.tobytes()]
+    )
+
+
+def deserialize_pages(blob: bytes) -> KVPageExport:
+    if len(blob) < 8 or blob[:4] != PAGES_MAGIC:
+        raise HandoffError("not a KV page-export blob (bad magic)")
+    (hdr_len,) = struct.unpack("<I", blob[4:8])
+    if len(blob) < 8 + hdr_len:
+        raise HandoffError("truncated page-export header")
+    try:
+        header = json.loads(blob[8 : 8 + hdr_len])
+    except json.JSONDecodeError as e:
+        raise HandoffError(f"bad page-export header: {e}") from e
+    if header.get("version") != VERSION:
+        raise HandoffError(
+            f"unsupported page-export version {header.get('version')!r}"
+        )
+    dtype = _resolve_dtype(header["dtype"])
+    shape = (
+        header["num_layers"],
+        header["n_pages"],
+        header["page_size"],
+        header["kv_heads"],
+        header["head_dim"],
+    )
+    count = int(np.prod(shape))
+    body = blob[8 + hdr_len :]
+    expected = 2 * count * dtype.itemsize
+    if len(body) != expected:
+        raise HandoffError(
+            f"page-export body is {len(body)} bytes, expected {expected}"
+        )
+    k = np.frombuffer(body[: count * dtype.itemsize], dtype=dtype).reshape(
+        shape
+    )
+    v = np.frombuffer(body[count * dtype.itemsize :], dtype=dtype).reshape(
+        shape
+    )
+    hashes = tuple(header.get("prefix_hashes") or ())
+    if len(hashes) != header["n_pages"]:
+        raise HandoffError(
+            f"{len(hashes)} hashes for {header['n_pages']} pages"
+        )
+    return KVPageExport(
+        prefix_hashes=hashes,
+        page_size=int(header["page_size"]),
+        dtype=str(header["dtype"]),
+        k_pages=k,
+        v_pages=v,
         model=str(header.get("model", "")),
     )

@@ -410,6 +410,17 @@ class Engine:
             "exported_bytes": 0,
             "imported_bytes": 0,
         }
+        # Cluster KV-sharing accounting (cumulative, server folds into
+        # counters): partial-chain pages served to peers / seeded from
+        # peers, and objstore spill/fill traffic.
+        self.kv_share_stats = {
+            "exported_pages": 0,
+            "exported_bytes": 0,
+            "imported_pages": 0,
+            "imported_bytes": 0,
+            "spilled_pages": 0,
+            "filled_pages": 0,
+        }
         if self.cache_mode == "paged":
             from kubeai_tpu.engine.paged_cache import PageAllocator, PagedKVCache
 
@@ -2448,6 +2459,180 @@ class Engine:
             self.disagg_stats["imported"] += 1
             self.disagg_stats["imported_bytes"] += handoff.nbytes()
             return rid, first_ev
+
+    # ---- cluster KV-sharing tier ------------------------------------------
+
+    def prefix_holdings(self) -> list[str]:
+        """Every chain hash (hex) this replica's prefix cache currently
+        holds — published via /v1/state so the fleet aggregator can build
+        the who-holds-which-prefix map. Advisory: routing hints built on
+        it can go stale without harming correctness (admission re-checks
+        through lookup())."""
+        if self.cache_mode != "paged" or not self._prefix_cache:
+            return []
+        with self._lock:
+            return [h.hex() for h in self._alloc.holdings()]
+
+    def cached_prefix_depth(self, hashes_hex: list[str]) -> int:
+        """How many leading pages of the chain are held locally right
+        now — what a peer fetch would NOT need to transfer."""
+        if self.cache_mode != "paged" or not self._prefix_cache:
+            return 0
+        try:
+            hashes = [bytes.fromhex(h) for h in hashes_hex]
+        except ValueError:
+            return 0
+        with self._lock:
+            return len(self._alloc.lookup(hashes))
+
+    def compute_prefix_chain(self, tokens: list[int]) -> list[str]:
+        """Base-model page-hash chain (hex) for a token sequence — the
+        engine-side oracle the front door's chain computation must match."""
+        return [h.hex() for h in self._prefix_hashes(list(tokens), 0)]
+
+    def export_prefix_pages(self, hashes_hex: list[str], max_bytes: int = 0):
+        """Serve a peer's partial-chain fetch: gather the longest locally
+        held prefix of the requested chain (optionally truncated to a
+        transfer-size cap) to host and wrap it as a `KVPageExport`. Pages
+        are copied under the engine lock, so the bytes are a consistent
+        snapshot; an empty export means "hold nothing of that chain".
+        Base-model chains only — per-replica LoRA slot seeds make adapter
+        chains incomparable across replicas."""
+        from kubeai_tpu.disagg.handoff import KVPageExport
+
+        if self.cache_mode != "paged" or not self._prefix_cache:
+            return None
+        try:
+            hashes = [bytes.fromhex(h) for h in hashes_hex]
+        except ValueError:
+            return None
+        mcfg = self.model_cfg
+        ps = self.cfg.page_size
+        dtype = np.dtype(self.cfg.cache_dtype)
+        page_nbytes = (
+            2 * mcfg.num_layers * ps * mcfg.num_kv_heads * mcfg.head_size
+            * dtype.itemsize
+        )
+        with self._lock:
+            pages = self._alloc.lookup(hashes)
+            if max_bytes > 0:
+                pages = pages[: max_bytes // page_nbytes]
+            n = len(pages)
+            if n:
+                idx = jnp.asarray(pages, jnp.int32)
+                k_host = np.asarray(jax.device_get(self.cache.k_pages[:, idx]))
+                v_host = np.asarray(jax.device_get(self.cache.v_pages[:, idx]))
+            else:
+                shape = (
+                    mcfg.num_layers, 0, ps, mcfg.num_kv_heads, mcfg.head_size,
+                )
+                k_host = np.zeros(shape, dtype)
+                v_host = np.zeros(shape, dtype)
+            self.kv_share_stats["exported_pages"] += n
+            self.kv_share_stats["exported_bytes"] += n * page_nbytes
+        return KVPageExport(
+            prefix_hashes=tuple(hashes_hex[:n]),
+            page_size=ps,
+            dtype=dtype.name,
+            k_pages=k_host,
+            v_pages=v_host,
+        )
+
+    def import_prefix_pages(self, export, source: str = "peer") -> int:
+        """Seed fetched prefix pages into the idle pool, unowned: the next
+        admission whose chain matches adopts them through the ordinary
+        lookup()/adopt() path, so a stale or partial import can only cost
+        recompute, never correctness. Geometry, page size AND dtype must
+        match exactly — a cast would alter KV values while the chain hash
+        still vouches for the original content, silently breaking
+        token-identity with the no-sharing baseline. Returns the number of
+        pages actually seeded (0 when the pool refuses or everything was
+        already held)."""
+        from kubeai_tpu.disagg.handoff import HandoffError
+
+        if self.cache_mode != "paged" or not self._prefix_cache:
+            return 0
+        if export.n_pages == 0:
+            return 0
+        mcfg = self.model_cfg
+        nl, _n, page, kvh, d = export.k_pages.shape
+        if (nl, kvh, d) != (
+            mcfg.num_layers, mcfg.num_kv_heads, mcfg.head_size,
+        ):
+            raise HandoffError(
+                f"page export geometry [{nl}L,{kvh}KVH,{d}D] does not "
+                f"match this model [{mcfg.num_layers}L,"
+                f"{mcfg.num_kv_heads}KVH,{mcfg.head_size}D]"
+            )
+        if page != self.cfg.page_size:
+            raise HandoffError(
+                f"page size {page} != local {self.cfg.page_size} (chain "
+                "hashes are page-size-dependent; no re-paging is possible)"
+            )
+        if export.dtype != np.dtype(self.cfg.cache_dtype).name:
+            raise HandoffError(
+                f"KV dtype {export.dtype} != local cache dtype "
+                f"{np.dtype(self.cfg.cache_dtype).name}; casting would "
+                "break token-identity"
+            )
+        try:
+            hashes = [bytes.fromhex(h) for h in export.prefix_hashes]
+        except ValueError as e:
+            raise HandoffError(f"bad chain hash: {e}") from e
+        with self._lock:
+            seeded = self._alloc.seed_unowned(hashes)
+            if seeded is None:
+                return 0
+            write = [(i, p) for i, p in enumerate(seeded) if p is not None]
+            if write:
+                idx = jnp.asarray([p for _, p in write], jnp.int32)
+                src = np.ascontiguousarray(
+                    export.k_pages[:, [i for i, _ in write]]
+                )
+                self.cache.k_pages = self.cache.k_pages.at[:, idx].set(
+                    jnp.asarray(src, self.cfg.cache_dtype)
+                )
+                src = np.ascontiguousarray(
+                    export.v_pages[:, [i for i, _ in write]]
+                )
+                self.cache.v_pages = self.cache.v_pages.at[:, idx].set(
+                    jnp.asarray(src, self.cfg.cache_dtype)
+                )
+            key = "imported_pages" if source == "peer" else "filled_pages"
+            self.kv_share_stats[key] += len(write)
+            if source == "peer":
+                self.kv_share_stats["imported_bytes"] += (
+                    len(write) * 2 * nl * page * kvh * d
+                    * np.dtype(self.cfg.cache_dtype).itemsize
+                )
+            return len(write)
+
+    def enable_kv_spill(self, store) -> None:
+        """Wire idle-pool eviction to an objstore spill: just before an
+        evicted page's registration is destroyed, its K/V bytes are
+        snapshotted to `store` keyed by the chain hash, so a later fetch
+        for an evicted hot prefix can FILL from the store instead of
+        recomputing. The hook runs under the engine lock on the eviction
+        path and must never raise (the allocator also guards it)."""
+        from kubeai_tpu.disagg.handoff import KVPageExport, serialize_pages
+
+        def _spill(page: int, h: bytes) -> None:
+            idx = jnp.asarray([page], jnp.int32)
+            k = np.asarray(jax.device_get(self.cache.k_pages[:, idx]))
+            v = np.asarray(jax.device_get(self.cache.v_pages[:, idx]))
+            blob = serialize_pages(
+                KVPageExport(
+                    prefix_hashes=(h.hex(),),
+                    page_size=self.cfg.page_size,
+                    dtype=np.dtype(self.cfg.cache_dtype).name,
+                    k_pages=k,
+                    v_pages=v,
+                )
+            )
+            store.put(h.hex(), blob)
+            self.kv_share_stats["spilled_pages"] += 1
+
+        self._alloc.on_evict = _spill
 
     def _spec_pick(self) -> bool:
         """Choose this decode call's mode (True = speculative window,

@@ -119,6 +119,11 @@ class PageAllocator:
         self._hash_to_page: dict[bytes, int] = {}
         self._page_to_hash: dict[int, bytes] = {}
         self._idle: "OrderedDict[int, None]" = OrderedDict()  # LRU -> MRU
+        # Optional spill hook: called as on_evict(page, hash) just before
+        # an idle page's registration is destroyed by eviction, while the
+        # device page still holds the registered content. Wired by the
+        # engine when KV objstore spill is enabled; must never raise.
+        self.on_evict = None
 
     @property
     def free_pages(self) -> int:
@@ -137,9 +142,20 @@ class PageAllocator:
         if self._free:
             return self._free.pop()
         if self._idle:
+            # Eviction MUST strip both hash mappings atomically with the
+            # idle-pool removal: once holdings are published cluster-wide
+            # a stale _hash_to_page entry would let lookup() adopt a page
+            # whose content has been overwritten by its new owner —
+            # silently corrupting token-identity. Regression-tested in
+            # tests/unit/test_paged_cache.py.
             page, _ = self._idle.popitem(last=False)  # evict LRU
             h = self._page_to_hash.pop(page)
             del self._hash_to_page[h]
+            if self.on_evict is not None:
+                try:
+                    self.on_evict(page, h)
+                except Exception:
+                    pass
             del self._ref[page]
             return page
         return None
@@ -232,6 +248,51 @@ class PageAllocator:
                 continue
             self._hash_to_page[h] = page
             self._page_to_hash[page] = h
+
+    def holdings(self) -> list[bytes]:
+        """Every chain hash currently registered (owned-and-registered or
+        parked idle) — the replica's advertisable prefix-cache contents.
+        Advisory only: routing built on this is a hint; admission always
+        re-verifies through lookup(), so staleness can cost performance
+        but never correctness."""
+        return list(self._hash_to_page.keys())
+
+    def seed_unowned(self, hashes: list[bytes]) -> list[int] | None:
+        """Allocate pages for externally fetched prefix content (peer KV
+        fetch / objstore fill): one page per NOVEL hash, registered and
+        parked straight into the idle LRU with refcount 0 — no slot owns
+        them; the next admission adopts them through the ordinary
+        lookup()/adopt() path. Returns the page ids aligned with `hashes`
+        (None entries mark hashes that were already registered locally and
+        need no write), or None if the pool cannot supply every novel page
+        (partial seeding is rolled back so a failed fetch holds nothing).
+        """
+        # Novelty is decided ONCE, before any page is taken: taking pages
+        # can evict idle entries, which may deregister a hash classified
+        # as already-held — it must still consume no page (its chain link
+        # just breaks, shortening future lookups; never a correctness
+        # issue because admission re-verifies content by hash).
+        novel = {h for h in hashes if h not in self._hash_to_page}
+        taken: list[int] = []
+        for _ in range(len(novel)):
+            page = self._take_free()
+            if page is None:
+                self._free.extend(taken)
+                return None
+            taken.append(page)
+        it = iter(taken)
+        out: list[int | None] = []
+        for h in hashes:
+            if h not in novel:
+                out.append(None)
+                continue
+            page = next(it)
+            self._hash_to_page[h] = page
+            self._page_to_hash[page] = h
+            self._ref[page] = 0
+            self._idle[page] = None
+            out.append(page)
+        return out
 
 
 

@@ -147,6 +147,33 @@ class EngineMetrics:
             self.registry,
             buckets=REQUEST_LATENCY_BUCKETS_S,
         )
+        # -- cluster KV-sharing tier (peer prefix fetch / objstore spill) ---
+        self.kv_fetch_attempts = Counter(
+            "kubeai_kv_fetch_attempts_total",
+            "Prefix KV fetches attempted, by source (peer = /v1/kv/export "
+            "on the holding replica, spill = objstore fill).",
+            self.registry,
+        )
+        self.kv_fetch_bytes = Counter(
+            "kubeai_kv_fetch_bytes_total",
+            "Serialized prefix-page bytes fetched from peers or the "
+            "objstore spill tier instead of recomputing prefill.",
+            self.registry,
+        )
+        self.kv_fetch_failures = Counter(
+            "kubeai_kv_fetch_failures_total",
+            "Prefix KV fetches that failed (timeout, peer death, "
+            "malformed blob, pool refusal) and fell back to recompute, "
+            "by source.",
+            self.registry,
+        )
+        self.kv_share_pages = Counter(
+            "kubeai_engine_kv_share_pages_total",
+            "Cluster KV-sharing page movement by direction: exported "
+            "(served to a peer), imported (seeded from a peer), spilled "
+            "(evicted to objstore), filled (restored from objstore).",
+            self.registry,
+        )
         self.role_info = Gauge(
             "kubeai_engine_role",
             "1 for this replica's serving role label "
@@ -360,6 +387,22 @@ class EngineMetrics:
                     ),
                     direction=direction,
                 )
+        kstats = getattr(inner, "kv_share_stats", None)
+        if kstats:
+            for direction, key in (
+                ("exported", "exported_pages"),
+                ("imported", "imported_pages"),
+                ("spilled", "spilled_pages"),
+                ("filled", "filled_pages"),
+            ):
+                self.kv_share_pages.inc(
+                    max(
+                        0.0,
+                        kstats[key]
+                        - self.kv_share_pages.get(direction=direction),
+                    ),
+                    direction=direction,
+                )
         slots = getattr(getattr(inner, "cfg", None), "num_slots", None)
         if slots is not None:
             self.slot_capacity.set(slots)
@@ -409,6 +452,7 @@ def engine_state_snapshot(engine) -> dict:
         "last_step": dict(getattr(inner, "last_step_stats", {}) or {}),
         "spec_stats": dict(getattr(inner, "spec_stats", {}) or {}),
         "prefix_stats": dict(getattr(inner, "prefix_stats", {}) or {}),
+        "kv_share": dict(getattr(inner, "kv_share_stats", {}) or {}),
         # Queue-pressure snapshot: per-class depth/oldest-wait/admitted/
         # shed plus drain rate and the current computed retry hint.
         "scheduler": sched.snapshot() if sched is not None else {},
@@ -434,6 +478,9 @@ class EngineServer:
         transfer_timeout: float = 30.0,
         watchdog_timeout: float = 0.0,
         watchdog_action=None,
+        kv_sharing: bool = False,
+        kv_fetch_timeout: float = 5.0,
+        kv_spill_store=None,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
@@ -452,6 +499,20 @@ class EngineServer:
         from kubeai_tpu.disagg.transport import HandoffStore
 
         self._handoffs = HandoffStore()
+        # Cluster KV-sharing tier: publish prefix holdings in /v1/state,
+        # serve peers' partial-chain fetches on /v1/kv/export, and pull
+        # missing prefix pages from the X-KV-Source peer (or the objstore
+        # spill store) before admission instead of recomputing prefill.
+        self.kv_sharing = bool(kv_sharing)
+        self.kv_fetch_timeout = kv_fetch_timeout
+        self.kv_spill = kv_spill_store
+        if self.kv_spill is not None:
+            spill_wire = getattr(engine, "enable_kv_spill", None)
+            if spill_wire is None:
+                inner = getattr(engine, "inner", None)
+                spill_wire = getattr(inner, "enable_kv_spill", None)
+            if spill_wire is not None:
+                spill_wire(self.kv_spill)
         self.metrics.role_info.set(1, role=role)
         self.adapter_fetcher = adapter_fetcher
         # Scheduling defaults (CRD `scheduling:` block, rendered as engine
@@ -564,6 +625,13 @@ class EngineServer:
                             "role": outer.role,
                             "pending_handoffs": len(outer._handoffs),
                             "adapters": outer.engine.loaded_adapters(),
+                            "kv_sharing": outer.kv_sharing,
+                            # Held page-hash chains (hex): the fleet
+                            # aggregator joins these into the cluster
+                            # who-holds-which-prefix map. Computed only
+                            # here (not per step) — it walks the whole
+                            # registered-page table.
+                            "kv_holdings": outer.kv_holdings(),
                             **engine_state_snapshot(outer.engine),
                         },
                     )
@@ -607,6 +675,8 @@ class EngineServer:
                             return self._json(202, outer.begin_drain())
                         if path == "/v1/profile":
                             return outer._handle_profile(self, body)
+                        if path == "/v1/kv/export":
+                            return outer._handle_kv_export(self, body)
                         if path == "/v1/chat/completions":
                             return outer._handle_generate(self, body, chat=True)
                         if path == "/v1/completions":
@@ -1095,6 +1165,14 @@ class EngineServer:
                     f"max_tokens {sp.max_tokens}: nothing left to generate"
                 )}},
             )
+        if self.kv_sharing and adapter is None and not resume_tokens:
+            # Peer/objstore KV prefix fetch BEFORE admission: on success
+            # the pages sit unowned in the idle pool and the ordinary
+            # prefix-hit admission path below adopts them — on any
+            # failure this returns silently and prefill recomputes.
+            # Base-model requests only: per-replica LoRA slot seeds make
+            # adapter chains incomparable across replicas.
+            self._maybe_fetch_prefix(http.headers, prompt_ids, deadline_ms)
         stream = bool(body.get("stream", False))
         # Each choice gets a derived seed so explicit-seed requests stay
         # deterministic AND diverse. With the prefix cache on, choices
@@ -1478,6 +1556,199 @@ class EngineServer:
         return http._json(
             200, {"handoff_id": hid, "bytes": len(blob)}
         )
+
+    # -- cluster KV-sharing tier -----------------------------------------------
+
+    def kv_holdings(self) -> list[str]:
+        """Held page-hash chains (hex) for /v1/state, empty when sharing
+        is off (no point shipping the table to the aggregator then)."""
+        if not self.kv_sharing:
+            return []
+        inner = getattr(self.engine, "inner", self.engine)
+        holdings = getattr(inner, "prefix_holdings", None)
+        return holdings() if holdings is not None else []
+
+    def _handle_kv_export(self, http, body: dict):
+        """POST /v1/kv/export — serve a peer's partial-chain prefix fetch:
+        JSON {"prefix_hashes": [hex...], "max_bytes": N} in, a KVP1 page
+        blob out (possibly empty when nothing of the chain is held). The
+        transfer cap is the tighter of the caller's max_bytes and this
+        server's own transfer limit."""
+        from kubeai_tpu.disagg.handoff import serialize_pages
+
+        if not self.kv_sharing:
+            return http._json(
+                404, {"error": {"message": "KV sharing is not enabled"}}
+            )
+        if self._draining.is_set():
+            return self._drain_refusal(http)
+        hashes = body.get("prefix_hashes")
+        if not isinstance(hashes, list) or not all(
+            isinstance(h, str) for h in hashes
+        ):
+            return http._json(
+                400,
+                {"error": {"message": "prefix_hashes must be a hex list"}},
+            )
+        max_bytes = body.get("max_bytes", 0)
+        if isinstance(max_bytes, bool) or not isinstance(max_bytes, int):
+            max_bytes = 0
+        cap = max(0, max_bytes)
+        if self.max_transfer_bytes:
+            cap = (
+                min(cap, self.max_transfer_bytes)
+                if cap else self.max_transfer_bytes
+            )
+        inner = getattr(self.engine, "inner", self.engine)
+        export_fn = getattr(inner, "export_prefix_pages", None)
+        export = export_fn(hashes, cap) if export_fn is not None else None
+        if export is None:
+            return http._json(
+                400,
+                {"error": {"message": (
+                    "prefix export unavailable (paged prefix cache off "
+                    "or malformed chain)"
+                )}},
+            )
+        blob = serialize_pages(export)
+        http._last_status = 200
+        http.send_response(200)
+        http.send_header("Content-Type", "application/octet-stream")
+        http.send_header("Content-Length", str(len(blob)))
+        http.send_header("X-KV-Pages", str(export.n_pages))
+        http.end_headers()
+        http.wfile.write(blob)
+
+    def _maybe_fetch_prefix(
+        self, headers, prompt_ids: list[int], deadline_ms: int
+    ) -> None:
+        """Best-effort prefix KV fetch before admission: compute the
+        prompt's chain, and when a peer (X-KV-Source, supplied by the
+        router only for closed-circuit holders) or the objstore spill
+        store holds pages past the local cached depth, pull and seed them
+        so admission's ordinary prefix-hit path skips that prefill.
+        Unconditional-fallback contract: every failure path returns
+        silently and the request recomputes — this method can cost
+        latency (bounded by the deadline budget and kv_fetch_timeout)
+        but never correctness."""
+        import http.client as _hc
+
+        from kubeai_tpu.disagg.handoff import (
+            HandoffError,
+            deserialize_pages,
+        )
+
+        inner = getattr(self.engine, "inner", self.engine)
+        compute = getattr(inner, "compute_prefix_chain", None)
+        depth_fn = getattr(inner, "cached_prefix_depth", None)
+        import_fn = getattr(inner, "import_prefix_pages", None)
+        if compute is None or depth_fn is None or import_fn is None:
+            return
+        t0 = time.monotonic()
+        # deadline_ms is None when deadline admission is off entirely.
+        budget_s = (
+            deadline_ms / 1000.0 if deadline_ms and deadline_ms > 0 else None
+        )
+
+        def budget_left() -> float | None:
+            if budget_s is None:
+                return None
+            return budget_s - (time.monotonic() - t0)
+
+        try:
+            chain = compute(prompt_ids)
+        except Exception:
+            return
+        # Mirror admission's hit cap: pages past it can never be adopted
+        # (the final token must compute its own logits), so fetching them
+        # would be pure transfer waste.
+        ps = self.engine.cfg.page_size
+        chain = chain[: max(0, (len(prompt_ids) - 1) // ps)]
+        if not chain:
+            return
+        depth = depth_fn(chain)
+        if depth >= len(chain):
+            return  # full local hit; nothing to fetch
+        missing = chain[depth:]
+        source = (headers.get("X-KV-Source") or "").strip()
+        if source:
+            left = budget_left()
+            if left is not None and left <= 0:
+                return
+            self.metrics.kv_fetch_attempts.inc(source="peer")
+            timeout = self.kv_fetch_timeout
+            if left is not None:
+                timeout = min(timeout, left)
+            conn = None
+            try:
+                payload = json.dumps(
+                    {
+                        "prefix_hashes": missing,
+                        "max_bytes": self.max_transfer_bytes,
+                    }
+                ).encode()
+                conn = _hc.HTTPConnection(source, timeout=timeout)
+                conn.request(
+                    "POST", "/v1/kv/export", body=payload,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    resp.read()
+                    raise OSError(f"peer answered {resp.status}")
+                blob = resp.read()
+                if (
+                    self.max_transfer_bytes
+                    and len(blob) > self.max_transfer_bytes
+                ):
+                    raise OSError(
+                        f"peer blob of {len(blob)} bytes exceeds the "
+                        f"{self.max_transfer_bytes}-byte transfer limit"
+                    )
+                left = budget_left()
+                if left is not None and left <= 0:
+                    raise OSError("deadline budget exhausted mid-fetch")
+                export = deserialize_pages(blob)
+                n = import_fn(export, source="peer")
+                if n > 0:
+                    self.metrics.kv_fetch_bytes.inc(len(blob))
+                    return
+            except Exception as e:
+                # Broad by contract: a peer dying MID-TRANSFER surfaces
+                # as http.client.IncompleteRead (an HTTPException, not an
+                # OSError) and a corrupt blob as HandoffError — all of it
+                # must degrade to recompute, never fail the request.
+                logger.warning("peer KV fetch from %s failed: %s", source, e)
+                self.metrics.kv_fetch_failures.inc(source="peer")
+            finally:
+                if conn is not None:
+                    conn.close()
+        if self.kv_spill is None:
+            return
+        # Objstore fill: single-page blobs keyed by chain hash, imported
+        # one page at a time so a partial fill still shortens prefill.
+        filled = 0
+        self.metrics.kv_fetch_attempts.inc(source="spill")
+        for h in missing:
+            left = budget_left()
+            if left is not None and left <= 0:
+                break
+            try:
+                blob = self.kv_spill.get(h)
+            except Exception:
+                blob = None
+            if blob is None:
+                break  # chain must stay consecutive; stop at first miss
+            try:
+                export = deserialize_pages(blob)
+                if import_fn(export, source="spill") == 0:
+                    break
+            except (HandoffError, ValueError):
+                break
+            filled += 1
+            self.metrics.kv_fetch_bytes.inc(len(blob))
+        if filled == 0:
+            self.metrics.kv_fetch_failures.inc(source="spill")
 
     def _handle_decode_from_handoff(self, http, body: dict, chat: bool, hid: str):
         """Decode role: admit a previously imported handoff straight into
@@ -2088,7 +2359,29 @@ def main(argv=None) -> int:
         "adoptable prefix is capped at max-seq-len minus the chunk, so "
         "the chunk must stay well under the context",
     )
+    ap.add_argument(
+        "--kv-sharing", action="store_true",
+        help="cluster-shared prefix/KV tier: publish held page-hash "
+        "chains via /v1/state, serve peer page exports on "
+        "/v1/kv/export, and pull common-prefix pages from the "
+        "X-KV-Source peer before prefill; implies --prefix-cache "
+        "(holdings live in the paged prefix cache) "
+        "(CRD spec.kvSharing)",
+    )
+    ap.add_argument(
+        "--kv-fetch-timeout", type=float, default=5.0,
+        help="budget for one peer KV-page fetch "
+        "(CRD kvSharing.fetchTimeoutSeconds)",
+    )
+    ap.add_argument(
+        "--kv-spill-url", default="",
+        help="object-store URL evicted idle KV pages spill to and are "
+        "re-filled from; empty = in-memory spill "
+        "(CRD kvSharing.spillURL)",
+    )
     args = ap.parse_args(argv)
+    if args.kv_sharing:
+        args.prefix_cache = True
     if args.prefix_cache and args.prefill_chunk <= 0:
         args.prefill_chunk = max(32, min(512, args.max_seq_len // 4))
 
@@ -2172,6 +2465,7 @@ def main(argv=None) -> int:
         if args.tpu_topology
         else single_device_mesh()
     )
+    from kubeai_tpu.objstore import KVSpillStore
     from kubeai_tpu.scheduling import RequestScheduler, SchedulingPolicy
 
     shares: dict[str, float] = {}
@@ -2268,6 +2562,11 @@ def main(argv=None) -> int:
         transfer_timeout=args.transfer_timeout,
         watchdog_timeout=args.watchdog_timeout,
         watchdog_action=_watchdog_exit,
+        kv_sharing=args.kv_sharing,
+        kv_fetch_timeout=args.kv_fetch_timeout,
+        kv_spill_store=(
+            KVSpillStore(args.kv_spill_url) if args.kv_sharing else None
+        ),
     )
     tracing.configure(service_name=f"kubeai-tpu-engine.{args.served_model_name}")
     server.start()

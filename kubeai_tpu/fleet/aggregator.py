@@ -354,7 +354,10 @@ class FleetStateAggregator:
                 if cached["parsed"] is not None:
                     entry.update(endpoint_signals(cached["parsed"]))
                     state = cached.get("state") or {}
-                    for k in ("healthy", "draining", "pending_handoffs"):
+                    for k in (
+                        "healthy", "draining", "pending_handoffs",
+                        "kv_sharing", "kv_holdings",
+                    ):
                         if k in state:
                             entry[k] = state[k]
                 ep_entries[addr] = entry
@@ -366,6 +369,23 @@ class FleetStateAggregator:
                         cached["parsed"]
                     )
             stale_total += len(stale_addrs)
+            # Push the fresh who-holds-which-prefix map into the LB for
+            # longest-held-prefix routing. Stale endpoints are simply
+            # absent; an all-stale sweep pushes {} and the pick's own
+            # freshness TTL handles the aggregator itself going dark.
+            push = getattr(self.lb, "update_kv_holdings", None)
+            if push is not None:
+                holdings = {
+                    addr: e["kv_holdings"]
+                    for addr, e in ep_entries.items()
+                    if not e["stale"]
+                    and e.get("kv_sharing")
+                    and e.get("kv_holdings")
+                }
+                if holdings or any(
+                    e.get("kv_sharing") for e in ep_entries.values()
+                ):
+                    push(model.name, holdings)
             snap_models[model.name] = {
                 "endpoints": ep_entries,
                 "replicas": replicas,

@@ -285,6 +285,24 @@ class Metrics:
             "CHWBL lookups displaced past the hashed endpoint by the bounded-load rule.",
             self.registry,
         )
+        # -- cluster KV-sharing: longest-held-prefix routing ----------------
+        # Route-time PREDICTION counters; compare against the engine's
+        # kubeai_engine_prefix_cached_tokens_total (actual admission hits)
+        # to measure how honest the fleet holdings map is.
+        self.lb_prefix_route_hits = Counter(
+            "kubeai_lb_prefix_route_hits_total",
+            "Picks routed to an endpoint advertising at least one held "
+            "page of the request's chain (predicted prefix hit), per "
+            "model.",
+            self.registry,
+        )
+        self.lb_prefix_route_misses = Counter(
+            "kubeai_lb_prefix_route_misses_total",
+            "Chain-carrying picks that fell back to classic CHWBL "
+            "(stale/empty holdings map or no load-bounded holder), per "
+            "model.",
+            self.registry,
+        )
         # -- front-door request lifecycle (per model) ----------------------
         self.request_duration = Histogram(
             "kubeai_inference_request_duration_seconds",

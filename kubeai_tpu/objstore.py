@@ -451,3 +451,88 @@ def upload_dir(src_dir: str, url: str, client=None) -> list[str]:
             client.put_from_file(bucket, key, full)
             uploaded.append(key)
     return uploaded
+
+
+class KVSpillStore:
+    """Spill/fill store for evicted hot-prefix KV pages (the objstore leg
+    of the cluster KV-sharing tier). Each entry is one serialized
+    single-page `KVPageExport` blob keyed by its chain hash (hex), so a
+    fill is a plain GET and needs no index.
+
+    Two backends behind one interface:
+      - in-memory LRU (url=""): the default and the test surface — spill
+        stays a node-local optimization with a hard byte cap;
+      - object store (gs://, s3://, oss://): pages persist as
+        `<prefix>/<hash>.kvp` objects via the zero-dependency clients
+        above, shared fleet-wide.
+
+    Every method is best-effort by contract: the callers (eviction hook,
+    fetch fallback) treat any failure as a miss and recompute.
+    """
+
+    def __init__(self, url: str = "", max_bytes: int = 256 << 20):
+        from collections import OrderedDict
+
+        self.url = url
+        self.max_bytes = max_bytes
+        self._mem: "OrderedDict[str, bytes]" = OrderedDict()
+        self._mem_bytes = 0
+        self.puts = 0
+        self.gets = 0
+        self.hits = 0
+
+    def _key(self, hash_hex: str) -> tuple[str, str]:
+        _scheme, bucket, prefix = parse_url(self.url)
+        name = f"{hash_hex}.kvp"
+        return bucket, f"{prefix.rstrip('/')}/{name}" if prefix else name
+
+    def put(self, hash_hex: str, blob: bytes) -> None:
+        self.puts += 1
+        if not self.url:
+            if len(blob) > self.max_bytes:
+                return
+            old = self._mem.pop(hash_hex, None)
+            if old is not None:
+                self._mem_bytes -= len(old)
+            self._mem[hash_hex] = blob
+            self._mem_bytes += len(blob)
+            while self._mem_bytes > self.max_bytes and self._mem:
+                _h, dropped = self._mem.popitem(last=False)
+                self._mem_bytes -= len(dropped)
+            return
+        import tempfile
+
+        bucket, key = self._key(hash_hex)
+        client = client_for(self.url)
+        with tempfile.NamedTemporaryFile(delete=False) as f:
+            f.write(blob)
+            tmp = f.name
+        try:
+            client.put_from_file(bucket, key, tmp)
+        finally:
+            os.unlink(tmp)
+
+    def get(self, hash_hex: str) -> bytes | None:
+        self.gets += 1
+        if not self.url:
+            blob = self._mem.get(hash_hex)
+            if blob is not None:
+                self._mem.move_to_end(hash_hex)
+                self.hits += 1
+            return blob
+        import tempfile
+
+        bucket, key = self._key(hash_hex)
+        client = client_for(self.url)
+        tmp = tempfile.mktemp()
+        try:
+            client.get_to_file(bucket, key, tmp)
+            with open(tmp, "rb") as f:
+                blob = f.read()
+            self.hits += 1
+            return blob
+        except Exception:
+            return None
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)

@@ -100,6 +100,19 @@ def kubeai_tpu_pod(
                 "--transfer-timeout", f"{dis.transfer_timeout_seconds:g}",
             ]
         pod["metadata"]["labels"][md.POD_ROLE_LABEL] = role
+    # Cluster KV sharing (CRD kvSharing: block): the engine publishes
+    # held page-hash chains, serves peer page exports, and pulls
+    # common-prefix pages from the proxy-suggested X-KV-Source peer.
+    # --kv-sharing implies --prefix-cache engine-side.
+    kvs = model.spec.kv_sharing
+    if kvs.enabled:
+        args += ["--kv-sharing"]
+        if kvs.fetch_timeout_seconds:
+            args += ["--kv-fetch-timeout", f"{kvs.fetch_timeout_seconds:g}"]
+        if kvs.max_transfer_mb:
+            args += ["--max-transfer-mb", str(kvs.max_transfer_mb)]
+        if kvs.spill_url:
+            args += ["--kv-spill-url", kvs.spill_url]
     # Adapters are NOT baked into the spec: they hot-swap through the
     # /v1/load_lora_adapter admin API (see operator/adapters.py), so adapter
     # changes never trigger a pod rollout.

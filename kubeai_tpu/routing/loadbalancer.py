@@ -120,11 +120,20 @@ class Group:
         self._chwbl = make_ring(
             load_factor=load_factor, replication=replication, metrics=metrics
         )
+        self.load_factor = load_factor
         self.total_in_flight = 0
         self.model = model
         self.metrics = metrics
         self.breaker_policy = breaker or BreakerPolicy()
         self._clock = clock
+        # Cluster KV-sharing: advertised prefix holdings per endpoint
+        # (addr -> set of held chain hashes, hex), pushed by the fleet
+        # aggregator after each collect. Advisory and freshness-gated —
+        # past the TTL the longest-held-prefix pick disables itself and
+        # routing degrades byte-identically to classic CHWBL.
+        self._kv_holdings: dict[str, frozenset[str]] = {}
+        self._kv_holdings_ts: float | None = None
+        self.kv_holdings_ttl_s = 15.0
         # Endpoints removed by reconcile while requests were still in
         # flight: their done() callbacks must keep draining the group
         # totals, and the snapshot must show them until they empty.
@@ -185,6 +194,55 @@ class Group:
             if changed:
                 self._cond.notify_all()
 
+    def set_kv_holdings(self, holdings: dict[str, Iterable[str]]) -> None:
+        """Replace the advertised prefix-holdings map (fleet-aggregator
+        push after each collect; stale endpoints simply don't appear)."""
+        with self._cond:
+            self._kv_holdings = {
+                a: frozenset(h) for a, h in holdings.items() if h
+            }
+            self._kv_holdings_ts = self._clock()
+
+    def _holdings_fresh(self) -> bool:
+        return (
+            self._kv_holdings_ts is not None
+            and self._clock() - self._kv_holdings_ts
+            <= self.kv_holdings_ttl_s
+        )
+
+    def _chain_depth(self, chain: list[str], held: frozenset[str]) -> int:
+        depth = 0
+        for h in chain:
+            if h not in held:
+                break
+            depth += 1
+        return depth
+
+    def kv_holder(
+        self, chain: list[str], exclude: Iterable[str] = ()
+    ) -> tuple[str | None, int]:
+        """Deepest advertised CLOSED-CIRCUIT holder of the chain — the
+        proxy's X-KV-Source hint for the serving replica's peer fetch.
+        Open or half-open endpoints are never suggested (an open-circuit
+        peer must receive no fetch traffic; half-open gets exactly its
+        one probe request, not a side-channel transfer). Returns
+        (address, depth) or (None, 0)."""
+        excluded = frozenset(exclude or ())
+        with self._cond:
+            if not self._holdings_fresh():
+                return None, 0
+            best, best_depth = None, 0
+            for addr in sorted(self._kv_holdings):
+                if addr in excluded:
+                    continue
+                ep = self._endpoints.get(addr)
+                if ep is None or ep.health.state != STATE_CLOSED:
+                    continue
+                depth = self._chain_depth(chain, self._kv_holdings[addr])
+                if depth > best_depth:
+                    best, best_depth = addr, depth
+            return best, best_depth
+
     def addresses(self, role: str = "") -> list[str]:
         with self._cond:
             if not role:
@@ -208,6 +266,7 @@ class Group:
         timeout: float,
         exclude: Iterable[str] | None = None,
         role: str = "",
+        chain: list[str] | None = None,
     ) -> tuple[str, Callable[..., None]]:
         """Block until a suitable endpoint exists; account the request.
 
@@ -215,8 +274,12 @@ class Group:
         addresses are avoided while any other available endpoint exists,
         and ignored otherwise (a single-replica group must still retry in
         place rather than starve). `role` restricts the candidate set to
-        one serving role ("" = any). Raises `NoHealthyEndpoints` without
-        waiting when endpoints exist but every circuit is open."""
+        one serving role ("" = any). `chain` is the request's page-hash
+        chain (hex) for models on the KV-sharing tier: when the fleet
+        holdings map is fresh, the pick prefers the load-bounded endpoint
+        holding the deepest matching chain and falls back to classic
+        CHWBL otherwise. Raises `NoHealthyEndpoints` without waiting when
+        endpoints exist but every circuit is open."""
         excluded = frozenset(exclude or ())
         deadline = time.monotonic() + timeout
         with self._cond:
@@ -244,6 +307,7 @@ class Group:
                         strategy, adapter, prefix,
                         {e.address for e in picks},
                         role,
+                        chain,
                     )
                     ep = self._endpoints[addr]
                     # An open circuit past its backoff transitions to
@@ -362,8 +426,17 @@ class Group:
 
     def _pick(
         self, strategy: str, adapter: str, prefix: str,
-        allowed: set[str], role: str = "",
+        allowed: set[str], role: str = "", chain: list[str] | None = None,
     ) -> str:
+        if chain:
+            addr = self._pick_longest_held(chain, allowed)
+            if addr is not None:
+                self.metrics.lb_prefix_route_hits.inc(model=self.model)
+                return addr
+            # Miss: stale/empty holdings map or no endpoint within the
+            # load bound holds any of the chain — classic CHWBL below,
+            # byte-identical to a request that carried no chain.
+            self.metrics.lb_prefix_route_misses.inc(model=self.model)
         if strategy == LB_STRATEGY_PREFIX_HASH and prefix:
             loads = {a: e.in_flight for a, e in self._endpoints.items()}
             addr = self._chwbl.get(prefix, loads, allowed)
@@ -376,6 +449,33 @@ class Group:
         ]
         best = min(candidates, key=lambda e: e.in_flight)
         return best.address
+
+    def _pick_longest_held(
+        self, chain: list[str], allowed: set[str]
+    ) -> str | None:
+        """Longest-held-prefix pick: the allowed endpoint advertising the
+        deepest leading match of the chain, subject to the SAME bounded-
+        load threshold CHWBL enforces (a hot prefix must not stampede its
+        holder). None when the map is stale or nothing within the bound
+        holds a single page — the caller falls back to classic CHWBL."""
+        if not self._holdings_fresh():
+            return None
+        loads = {a: e.in_flight for a, e in self._endpoints.items()}
+        total = sum(loads.values())
+        n = max(len(loads), 1)
+        threshold = (total + 1) / n * self.load_factor
+
+        best, best_depth = None, 0
+        for addr in sorted(allowed):
+            held = self._kv_holdings.get(addr)
+            if not held:
+                continue
+            if total and loads.get(addr, 0) > threshold:
+                continue
+            depth = self._chain_depth(chain, held)
+            if depth > best_depth:
+                best, best_depth = addr, depth
+        return best
 
 
 class LoadBalancer:
@@ -518,6 +618,19 @@ class LoadBalancer:
         when unchanged, so the proxy calls it per request."""
         self.group(model).set_breaker_policy(policy)
 
+    def update_kv_holdings(
+        self, model: str, holdings: dict[str, Iterable[str]]
+    ) -> None:
+        """Fleet-aggregator push: the fresh who-holds-which-prefix map
+        for one model's endpoints."""
+        self.group(model).set_kv_holdings(holdings)
+
+    def kv_holder(
+        self, model: str, chain: list[str], exclude: Iterable[str] = ()
+    ) -> tuple[str | None, int]:
+        """Deepest closed-circuit holder of the chain for X-KV-Source."""
+        return self.group(model).kv_holder(chain, exclude)
+
     def state(self) -> dict:
         """Per-model breaker/in-flight snapshot (admin/debug surface)."""
         with self._lock:
@@ -543,10 +656,12 @@ class LoadBalancer:
         timeout: float | None = None,
         exclude: Iterable[str] | None = None,
         role: str = "",
+        chain: list[str] | None = None,
     ) -> tuple[str, Callable[..., None]]:
         return self.group(model).get_best_addr(
             strategy, adapter, prefix,
             timeout=self.default_timeout if timeout is None else timeout,
             exclude=exclude,
             role=role,
+            chain=chain,
         )

@@ -1,0 +1,88 @@
+"""Front-door page-hash chain computation — the routing half of the
+cluster KV-sharing tier.
+
+The engine keys its prefix cache by a page-aligned content-hash chain
+over the PROMPT TOKENS (engine.py `_prefix_hashes`): a blake2b-16 chain
+seeded `apc1:<adapter_idx>:<generation>`, folded one full page of int32
+token ids at a time. For longest-held-prefix routing the front door must
+produce the SAME chain the serving engine would — which means the same
+tokenization (`apply_chat_template` for chat, `encode` for completions)
+and the same hash fold, bit for bit. `tests/unit/test_kv_sharing.py`
+asserts parity against the live engine.
+
+Base-model chains only (`adapter_idx=0, gen=0`): LoRA adapters occupy
+per-replica slot indices, so adapter chains are incomparable across
+replicas — adapter requests keep the classic char-prefix CHWBL key.
+
+The tokenizer comes from the same `load_tokenizer` seam the engine uses:
+a model directory shared with (or mirroring) the engine's yields the
+HuggingFace tokenizer; no directory yields the deterministic
+ByteTokenizer both sides agree on in offline tests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from kubeai_tpu.engine.tokenizer import load_tokenizer
+
+CHAIN_SEED_PREFIX = "apc1"
+
+
+def page_hash_chain(
+    token_ids: list[int],
+    page_size: int,
+    adapter_idx: int = 0,
+    gen: int = 0,
+) -> list[str]:
+    """Hex blake2b-16 chain over full pages of `token_ids` — must stay
+    bit-identical to engine.py `_prefix_hashes`."""
+    h = hashlib.blake2b(
+        f"{CHAIN_SEED_PREFIX}:{adapter_idx}:{gen}".encode(), digest_size=16
+    ).digest()
+    arr = np.asarray(token_ids, np.int32)
+    out: list[str] = []
+    for i in range(len(token_ids) // page_size):
+        h = hashlib.blake2b(
+            h + arr[i * page_size : (i + 1) * page_size].tobytes(),
+            digest_size=16,
+        ).digest()
+        out.append(h.hex())
+    return out
+
+
+class ChainComputer:
+    """Per-model chain oracle for the proxy: tokenizes a request body
+    exactly as the engine server's generate handler does and hashes the
+    result. Construction is cheap for the ByteTokenizer path; HF
+    tokenizers load once and are reused across requests."""
+
+    def __init__(self, page_size: int, tokenizer_dir: str = ""):
+        if page_size <= 0:
+            raise ValueError(f"page_size must be positive, got {page_size}")
+        self.page_size = page_size
+        self.tokenizer = load_tokenizer(tokenizer_dir or "")
+
+    def prompt_ids(self, body: dict, chat: bool) -> list[int]:
+        """Replicates EngineServer._handle_generate tokenization,
+        including the empty-prompt [0] default."""
+        if chat:
+            messages = body.get("messages") or []
+            ids = self.tokenizer.apply_chat_template(messages)
+        else:
+            prompt = body.get("prompt", "")
+            if isinstance(prompt, list):
+                prompt = prompt[0] if prompt else ""
+            ids = self.tokenizer.encode(str(prompt))
+        return ids or [0]
+
+    def chain_for_request(self, body: dict, chat: bool) -> list[str]:
+        """The request's routable chain: full-page hashes capped at the
+        engine's admission hit limit ((plen-1)//page_size — the final
+        token always computes its own logits), so routing never chases
+        pages no engine could adopt."""
+        ids = self.prompt_ids(body, chat)
+        chain = page_hash_chain(ids, self.page_size)
+        return chain[: max(0, (len(ids) - 1) // self.page_size)]

@@ -65,6 +65,7 @@ from kubeai_tpu.routing.modelclient import (
     ModelClient,
     ModelNotFound,
 )
+from kubeai_tpu.routing.prefixchain import ChainComputer
 
 logger = logging.getLogger(__name__)
 
@@ -84,6 +85,13 @@ SCHEDULING_HEADERS = ("x-priority", "x-deadline-ms", "x-client-id")
 # references the handoff on the decode hop.
 DISAGG_TRANSFER_HEADER = "X-Disagg-Transfer"
 DISAGG_HANDOFF_HEADER = "X-Disagg-Handoff"
+# Cluster KV-sharing: the proxy names the deepest closed-circuit holder
+# of the request's page-hash chain; the serving replica pulls the
+# common-prefix KV pages from it (engine /v1/kv/export) instead of
+# recomputing. Purely advisory — the engine verifies every adopted page
+# against its own hash chain, so a wrong hint costs a wasted fetch,
+# never a wrong token.
+KV_SOURCE_HEADER = "X-KV-Source"
 # Short non-blocking pick budget for role groups: a disaggregated pool
 # either exists now or the request falls back to unified — it must never
 # burn the scale-from-zero hold against an empty role group.
@@ -211,6 +219,9 @@ class ModelProxy:
         self.metrics = metrics
         self.timeouts = timeouts or ProxyTimeouts()
         self.default_breaker = default_breaker or lb.default_breaker
+        # KV-sharing chain computers, one per (pageSize, tokenizerDir)
+        # so a spec change mid-run picks up a fresh tokenizer.
+        self._chain_computers: dict[tuple[int, str], ChainComputer] = {}
 
     def handle(
         self, path: str, body: bytes, headers: dict[str, str]
@@ -300,6 +311,38 @@ class ModelProxy:
             open_seconds=cb.open_seconds or d.open_seconds,
         )
 
+    def _kv_chain(
+        self, model, preq: apiutils.ParsedRequest, path: str
+    ) -> list[str] | None:
+        """The request's page-hash chain for longest-held-prefix routing,
+        or None whenever KV sharing doesn't apply (model opted out,
+        adapter request — adapter chains are per-replica and
+        incomparable — or a non-generate path). Tokenizer trouble
+        degrades to classic routing, never to a failed request."""
+        kvs = model.spec.kv_sharing
+        if not kvs.enabled or preq.adapter:
+            return None
+        if not path.startswith(("/v1/chat/completions", "/v1/completions")):
+            return None
+        try:
+            body = json.loads(preq.body or b"{}")
+            if not isinstance(body, dict):
+                return None
+            key = (kvs.page_size, kvs.tokenizer_dir)
+            cc = self._chain_computers.get(key)
+            if cc is None:
+                cc = ChainComputer(kvs.page_size, kvs.tokenizer_dir)
+                self._chain_computers[key] = cc
+            return cc.chain_for_request(
+                body, chat=path.startswith("/v1/chat/completions")
+            )
+        except Exception:
+            logger.exception(
+                "kv-sharing chain computation failed for model %s; "
+                "falling back to classic routing", model.name,
+            )
+            return None
+
     def _proxy_with_retries(
         self,
         path: str,
@@ -310,6 +353,9 @@ class ModelProxy:
         strategy = model.spec.load_balancing.strategy
         prefix_len = model.spec.load_balancing.prefix_hash.prefix_char_length
         prefix = preq.prefix[:prefix_len] if strategy == LB_STRATEGY_PREFIX_HASH else ""
+        # Cluster KV sharing: one chain per request, computed up front —
+        # every retry routes (and hints X-KV-Source) from the same chain.
+        kv_chain = self._kv_chain(model, preq, path)
 
         last_err: Exception | None = None
         last_desc = ""
@@ -389,7 +435,21 @@ class ModelProxy:
                 timeout=remaining,
                 exclude=failed_addrs,
                 role=fallback_role,
+                chain=kv_chain,
             )
+            # Even the holder itself may serve the request (best case: no
+            # fetch at all); the hint only matters when the pick landed
+            # elsewhere, so the serving address is excluded from it. An
+            # address that already failed this request is excluded too —
+            # a flaky serving path is no better as a transfer source.
+            kv_extra = None
+            if kv_chain:
+                holder, _depth = self.lb.kv_holder(
+                    model.name, kv_chain,
+                    exclude={addr, *failed_addrs},
+                )
+                if holder:
+                    kv_extra = {KV_SOURCE_HEADER: holder}
             # One client span per attempt: retries show up as siblings
             # under the front door's server span, each carrying the
             # request id so a slow request is traceable end to end.
@@ -417,6 +477,7 @@ class ModelProxy:
                     addr, path, preq, headers,
                     connect_timeout=self.timeouts.connect_s,
                     read_timeout=self.timeouts.response_header_s,
+                    extra_headers=kv_extra,
                 )
             except OSError as e:
                 fault = (

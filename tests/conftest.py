@@ -84,6 +84,13 @@ def pytest_configure(config):
         "retry storms, fake-clock chaos sim (runs in the fast tier; "
         "select with -m controlplane)",
     )
+    config.addinivalue_line(
+        "markers",
+        "kvshare: cluster-shared prefix/KV cache tier suite — holdings "
+        "publication, longest-held-prefix routing, peer page fetch, "
+        "spill/fill, token-identity, fake-clock fleet sim (runs in the "
+        "fast tier; select with -m kvshare)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,126 @@
+"""KVP1 page-export wire format: partial-chain cache-content transfers
+must round-trip byte-exactly (the importer's pages feed straight into
+decode — any corruption is a token-identity bug), and every malformed or
+truncated blob must fail typed so a mid-transfer peer death degrades to
+a clean recompute, never a partial import."""
+
+import numpy as np
+import pytest
+
+from kubeai_tpu.disagg.handoff import (
+    HandoffError,
+    KVPageExport,
+    PAGES_MAGIC,
+    deserialize_pages,
+    serialize_pages,
+)
+from kubeai_tpu.routing.prefixchain import ChainComputer, page_hash_chain
+
+pytestmark = pytest.mark.kvshare
+
+NL, PAGE, KVH, D = 2, 8, 2, 16
+
+
+def mk_export(n_pages: int, dtype: str = "float32") -> KVPageExport:
+    rng = np.random.default_rng(n_pages)
+    shape = (NL, n_pages, PAGE, KVH, D)
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        np_dtype = ml_dtypes.bfloat16
+    else:
+        np_dtype = np.dtype(dtype)
+    k = rng.standard_normal(shape).astype(np_dtype)
+    v = rng.standard_normal(shape).astype(np_dtype)
+    hashes = tuple(f"{i:032x}" for i in range(n_pages))
+    return KVPageExport(
+        prefix_hashes=hashes, page_size=PAGE, dtype=dtype,
+        k_pages=k, v_pages=v, model="m",
+    )
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("n_pages", [1, 3])
+def test_roundtrip_byte_exact(n_pages, dtype):
+    e = mk_export(n_pages, dtype)
+    out = deserialize_pages(serialize_pages(e))
+    assert out.prefix_hashes == e.prefix_hashes
+    assert out.page_size == PAGE
+    assert out.dtype == dtype  # dtype string survives (no silent cast)
+    assert out.k_pages.tobytes() == e.k_pages.tobytes()
+    assert out.v_pages.tobytes() == e.v_pages.tobytes()
+    assert out.model == "m"
+
+
+def test_empty_chain_roundtrips():
+    # Zero pages is a VALID answer ("I no longer hold any of that
+    # chain") and must survive the wire without special-casing.
+    e = mk_export(0)
+    out = deserialize_pages(serialize_pages(e))
+    assert out.n_pages == 0
+    assert out.prefix_hashes == ()
+    assert out.nbytes() == 0
+
+
+def test_hash_count_must_match_pages():
+    e = mk_export(2)
+    e = KVPageExport(
+        prefix_hashes=e.prefix_hashes[:1], page_size=PAGE,
+        dtype=e.dtype, k_pages=e.k_pages, v_pages=e.v_pages,
+    )
+    with pytest.raises(HandoffError, match="hashes for"):
+        serialize_pages(e)
+
+
+def test_kv_shape_mismatch_rejected():
+    e = mk_export(2)
+    e = KVPageExport(
+        prefix_hashes=e.prefix_hashes, page_size=PAGE, dtype=e.dtype,
+        k_pages=e.k_pages, v_pages=e.v_pages[:, :1],
+    )
+    with pytest.raises(HandoffError, match="shape mismatch"):
+        serialize_pages(e)
+
+
+def test_truncated_blob_fails_typed():
+    """Mid-transfer peer death = a short read. Every truncation point
+    must raise HandoffError (caught by the fetch path, which falls back
+    to recompute) — never return a partially valid export."""
+    blob = serialize_pages(mk_export(2))
+    for cut in (0, 3, 6, 20, len(blob) // 2, len(blob) - 1):
+        with pytest.raises(HandoffError):
+            deserialize_pages(blob[:cut])
+    # Flipped magic and trailing garbage fail too.
+    with pytest.raises(HandoffError):
+        deserialize_pages(b"XXXX" + blob[4:])
+    with pytest.raises(HandoffError):
+        deserialize_pages(blob + b"\x00" * 7)
+    assert blob[:4] == PAGES_MAGIC  # sanity: we cut a real blob
+
+
+def test_chain_caps_at_admission_limit():
+    """Sub-page-boundary prompts produce NO routable chain: the final
+    prompt token must compute its own logits, so a prompt of exactly
+    page_size tokens still has zero adoptable (and fetchable) pages —
+    the front door must agree with the engine's admission cap."""
+    cc = ChainComputer(page_size=4)
+    # ByteTokenizer: 1 token per byte.
+    assert cc.chain_for_request({"prompt": "ab"}, chat=False) == []
+    assert cc.chain_for_request({"prompt": "abcd"}, chat=False) == []
+    one = cc.chain_for_request({"prompt": "abcde"}, chat=False)
+    assert len(one) == 1
+    # And the chain is the pure hash of the first full page.
+    ids = cc.prompt_ids({"prompt": "abcde"}, chat=False)
+    assert one == page_hash_chain(ids, 4)[:1]
+
+
+def test_chain_is_content_addressed():
+    """Equal prefixes share hashes; diverging pages diverge from the
+    divergence point on (the cumulative fold covers all prior pages)."""
+    a = page_hash_chain(list(range(16)), 4)
+    b = page_hash_chain(list(range(8)) + [99] * 8, 4)
+    assert a[:2] == b[:2]
+    assert a[2] != b[2] and a[3] != b[3]
+    # Different adapter generation -> a disjoint chain namespace.
+    c = page_hash_chain(list(range(16)), 4, gen=1)
+    assert set(a).isdisjoint(c)

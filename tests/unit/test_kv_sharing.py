@@ -1,0 +1,408 @@
+"""Cluster-shared prefix/KV cache tier: front-door chain parity with the
+engine, longest-held-prefix routing (with byte-identical classic-CHWBL
+degradation on stale holdings), holdings publication through the fleet
+aggregator, and the acceptance bar — peer KV-page fetch over real HTTP
+that is token-identical to the no-sharing baseline, including mid-fetch
+peer death degrading to a clean recompute."""
+
+import json
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import pytest
+
+from testutil import FakeTelemetryEngine, http_get, http_post
+
+from kubeai_tpu.crd.model import (
+    KVSharing,
+    LoadBalancing,
+    Model,
+    ModelSpec,
+)
+from kubeai_tpu.disagg.handoff import serialize_pages
+from kubeai_tpu.engine import Engine, EngineConfig
+from kubeai_tpu.engine.server import EngineServer
+from kubeai_tpu.engine.tokenizer import ByteTokenizer
+from kubeai_tpu.metrics.registry import Metrics
+from kubeai_tpu.models import llama
+from kubeai_tpu.objstore import KVSpillStore
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.loadbalancer import Group, LoadBalancer
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.routing.prefixchain import ChainComputer, page_hash_chain
+
+pytestmark = pytest.mark.kvshare
+
+TOK = ByteTokenizer()
+PAGE = 16
+# > 2 full pages of byte tokens so the routable chain is non-trivial.
+PROMPT = "the quick brown fox jumps over the lazy dog, twice"
+
+
+# ---- front-door chain parity -------------------------------------------------
+
+
+def test_chain_computer_matches_engine_oracle():
+    """The bit-for-bit contract: the proxy's chain for a request equals
+    the serving engine's chain for the tokens that request admits with —
+    wrong by one bit and longest-held routing never hits."""
+    cfg = llama.LlamaConfig.tiny(vocab_size=TOK.vocab_size)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = Engine(
+        "llama", cfg, params,
+        cfg=EngineConfig(
+            num_slots=2, max_seq_len=128, page_size=PAGE,
+            prefill_chunk=32, decode_chunk=4, prefix_cache=True,
+        ),
+        eos_token_ids=TOK.eos_token_ids,
+    )
+    cc = ChainComputer(page_size=PAGE)
+    for body, chat in (
+        ({"prompt": PROMPT}, False),
+        ({"prompt": ""}, False),  # empty-prompt [0] default
+        ({"messages": [{"role": "user", "content": PROMPT}]}, True),
+    ):
+        ids = cc.prompt_ids(body, chat)
+        full = eng.compute_prefix_chain(ids)
+        assert page_hash_chain(ids, PAGE) == full
+        cap = max(0, (len(ids) - 1) // PAGE)
+        assert cc.chain_for_request(body, chat) == full[:cap]
+
+
+# ---- longest-held-prefix routing --------------------------------------------
+
+
+def _chain(n=4, salt=0):
+    return page_hash_chain(list(range(salt, salt + n * 8)), 8)
+
+
+def test_longest_held_pick_prefers_deepest_holder():
+    metrics = Metrics()
+    g = Group(model="m", metrics=metrics)
+    g.reconcile_endpoints({"a:1": set(), "b:1": set(), "c:1": set()})
+    chain = _chain(4)
+    g.set_kv_holdings({"a:1": chain[:1], "b:1": chain[:3], "c:1": _chain(4, 99)})
+    addr, done = g.get_best_addr("LeastLoad", "", "", timeout=1, chain=chain)
+    assert addr == "b:1"  # depth 3 beats depth 1; c holds a foreign chain
+    done()
+    assert metrics.lb_prefix_route_hits.get(model="m") == 1
+    assert metrics.lb_prefix_route_misses.get(model="m") == 0
+
+
+def test_longest_held_pick_respects_chwbl_load_bound():
+    """A hot prefix must not stampede its holder: past the CHWBL bounded-
+    load threshold the holder is skipped and the pick degrades."""
+    g = Group(model="m", metrics=Metrics())
+    g.reconcile_endpoints({"a:1": set(), "b:1": set()})
+    chain = _chain(4)
+    g.set_kv_holdings({"a:1": chain})
+    picks = []
+    dones = []
+    for _ in range(6):
+        addr, done = g.get_best_addr(
+            "LeastLoad", "", "", timeout=1, chain=chain
+        )
+        picks.append(addr)
+        dones.append(done)
+    # The holder takes the first picks, but once its in-flight load
+    # crosses (total+1)/n * load_factor the spill goes to b.
+    assert picks[0] == "a:1"
+    assert "b:1" in picks
+    for d in dones:
+        d()
+
+
+def test_stale_holdings_degrade_to_classic_chwbl_byte_identically():
+    """Regression for the freshness gate: with the holdings map past its
+    TTL, a chain-carrying request must route EXACTLY like a chainless
+    one — same strategy, same ring, same pick sequence."""
+    now = [0.0]
+    clock = lambda: now[0]
+    eps = {"a:1": set(), "b:1": set(), "c:1": set()}
+    chain = _chain(4)
+
+    m_with = Metrics()
+    g_with = Group(model="m", metrics=m_with, clock=clock)
+    g_with.reconcile_endpoints(dict(eps))
+    g_with.set_kv_holdings({"a:1": chain})
+    g_ref = Group(model="m", metrics=Metrics(), clock=clock)
+    g_ref.reconcile_endpoints(dict(eps))
+
+    now[0] = g_with.kv_holdings_ttl_s + 1.0  # holdings now stale
+
+    picks_with, picks_ref = [], []
+    for i in range(8):
+        prefix = f"tenant-{i % 3}"
+        a, d = g_with.get_best_addr(
+            "PrefixHash", "", prefix, timeout=1, chain=chain
+        )
+        picks_with.append(a)  # keep in flight: loads evolve identically
+        b, _ = g_ref.get_best_addr("PrefixHash", "", prefix, timeout=1)
+        picks_ref.append(b)
+    assert picks_with == picks_ref
+    assert m_with.lb_prefix_route_hits.get(model="m") == 0
+    assert m_with.lb_prefix_route_misses.get(model="m") == 8
+
+
+def test_kv_holder_never_suggests_open_circuit_peer():
+    from kubeai_tpu.routing.health import BreakerPolicy
+
+    g = Group(
+        model="m", metrics=Metrics(),
+        breaker=BreakerPolicy(consecutive_failures=1, open_seconds=60.0),
+    )
+    g.reconcile_endpoints({"a:1": set(), "b:1": set()})
+    chain = _chain(4)
+    g.set_kv_holdings({"a:1": chain, "b:1": chain[:1]})
+    assert g.kv_holder(chain) == ("a:1", 4)
+    # Trip a's breaker: the deepest holder is out; the shallow CLOSED
+    # holder is suggested instead.
+    addr, done = g.get_best_addr("LeastLoad", "", "", timeout=1)
+    while addr != "a:1":
+        done()
+        addr, done = g.get_best_addr("LeastLoad", "", "", timeout=1)
+    done(outcome="connect_error", error="boom")
+    assert g.kv_holder(chain) == ("b:1", 1)
+    # exclude covers the serving replica itself.
+    assert g.kv_holder(chain, exclude={"b:1"}) == (None, 0)
+
+
+def test_aggregator_pushes_holdings_into_lb():
+    """/v1/state kv_holdings → aggregator sweep → LB holdings map →
+    kv_holder, end to end over real HTTP state endpoints."""
+    from kubeai_tpu.fleet.aggregator import FleetStateAggregator
+    from tests.unit.test_disagg import _pod
+
+    chain = _chain(3)
+    store = KubeStore()
+    lb = LoadBalancer(store, default_timeout=5)
+    mc = ModelClient(store)
+    spec = ModelSpec(
+        url="hf://org/x", engine="KubeAITPU",
+        features=["TextGeneration"], autoscaling_disabled=True,
+        replicas=1, load_balancing=LoadBalancing(),
+        kv_sharing=KVSharing(enabled=True, page_size=8),
+    )
+    store.create(Model(name="m1", spec=spec).to_dict())
+    holder = FakeTelemetryEngine(
+        "kubeai_engine_slots_active 1\n",
+        {"healthy": True, "kv_sharing": True, "kv_holdings": chain},
+    )
+    empty = FakeTelemetryEngine(
+        "kubeai_engine_slots_active 0\n",
+        {"healthy": True, "kv_sharing": True, "kv_holdings": []},
+    )
+    try:
+        store.create(_pod("m1-0", "m1", holder.port))
+        store.create(_pod("m1-1", "m1", empty.port))
+        lb.sync_all()
+        fleet = FleetStateAggregator(
+            lb=lb, model_client=mc, store=store, metrics=Metrics(),
+        )
+        snap = fleet.collect()
+        ep = snap["models"]["m1"]["endpoints"][holder.addr]
+        assert ep["kv_sharing"] is True and ep["kv_holdings"] == chain
+        assert lb.kv_holder("m1", chain) == (holder.addr, 3)
+        # A deeper foreign chain matches nothing → no holder.
+        assert lb.kv_holder("m1", _chain(3, 7)) == (None, 0)
+    finally:
+        lb.stop()
+        holder.stop()
+        empty.stop()
+
+
+# ---- real-HTTP fleet: peer fetch token identity ------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """Three EngineServers over ONE tiny llama: two KV-sharing replicas
+    (a, b) and a sharing-off baseline. Real sockets, so the /v1/kv/export
+    transport and the X-KV-Source fetch path are what's under test."""
+    cfg = llama.LlamaConfig.tiny(vocab_size=TOK.vocab_size)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ecfg = EngineConfig(
+        num_slots=4, max_seq_len=128, page_size=PAGE,
+        prefill_chunk=32, decode_chunk=4, prefix_cache=True,
+    )
+    servers = {}
+    for name, sharing in (("a", True), ("b", True), ("base", False)):
+        eng = Engine(
+            "llama", cfg, params, cfg=ecfg, eos_token_ids=TOK.eos_token_ids
+        )
+        srv = EngineServer(
+            eng, TOK, "tiny", host="127.0.0.1", port=0,
+            kv_sharing=sharing,
+            kv_spill_store=KVSpillStore() if sharing else None,
+        )
+        srv.start()
+        servers[name] = srv
+    yield servers
+    for srv in servers.values():
+        srv.stop()
+
+
+def _addr(srv):
+    return f"127.0.0.1:{srv.port}"
+
+
+def _gen(srv, req, headers=None):
+    st, body = http_post(
+        _addr(srv), "/v1/completions", req, headers=headers
+    )
+    assert st == 200, body
+    return json.loads(body)["choices"][0]
+
+
+@pytest.mark.parametrize(
+    "sampling",
+    [
+        {"temperature": 0, "seed": 11},
+        {"temperature": 0.8, "top_k": 8, "seed": 11},
+    ],
+    ids=["greedy", "seeded-sampling"],
+)
+def test_peer_fetch_token_identical_to_baseline(fleet, sampling):
+    """The acceptance bar: replica b, serving a prompt whose prefix
+    pages it pulls from peer a, streams byte-identically to the
+    sharing-disabled baseline — over real HTTP."""
+    # Prompts must differ from the FIRST token across tests sharing this
+    # fleet: a common leading page would already be held by replica b
+    # from an earlier test, and a full local hit skips the fetch.
+    prompt = f"t={sampling['temperature']} {PROMPT}"
+    req = {"model": "tiny", "prompt": prompt, "max_tokens": 16, **sampling}
+    ref = _gen(fleet["base"], req)
+    # Warm a: after this completes, a's prefix cache holds the prompt's
+    # full pages (parked idle on release) and advertises them.
+    _gen(fleet["a"], req)
+    st, body = http_get(_addr(fleet["a"]), "/v1/state")
+    state = json.loads(body)
+    assert state["kv_sharing"] is True
+    chain = ChainComputer(PAGE).chain_for_request(req, chat=False)
+    assert chain and set(chain) <= set(state["kv_holdings"])
+
+    b_inner = getattr(fleet["b"].engine, "inner", fleet["b"].engine)
+    before = b_inner.kv_share_stats["imported_pages"]
+    got = _gen(fleet["b"], req, headers={"X-KV-Source": _addr(fleet["a"])})
+    assert got["text"] == ref["text"]
+    assert got["finish_reason"] == ref["finish_reason"]
+    # The fetch really happened (not a silent local recompute)...
+    assert b_inner.kv_share_stats["imported_pages"] > before
+    a_inner = getattr(fleet["a"].engine, "inner", fleet["a"].engine)
+    assert a_inner.kv_share_stats["exported_pages"] > 0
+    # ...and the engine metrics saw it.
+    assert fleet["b"].metrics.kv_fetch_bytes.get() > 0
+
+
+def test_dead_peer_degrades_to_recompute(fleet):
+    """X-KV-Source pointing at a dead port: the fetch fails, the counter
+    rises, and the request recomputes token-identically."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead = f"127.0.0.1:{s.getsockname()[1]}"
+    req = {"model": "tiny",
+           "prompt": "an entirely different tale about a dead peer port",
+           "max_tokens": 12, "temperature": 0, "seed": 5}
+    ref = _gen(fleet["base"], req)
+    fails = fleet["b"].metrics.kv_fetch_failures.get(source="peer")
+    got = _gen(fleet["b"], req, headers={"X-KV-Source": dead})
+    assert got["text"] == ref["text"]
+    assert fleet["b"].metrics.kv_fetch_failures.get(source="peer") > fails
+
+
+def test_mid_transfer_peer_death_degrades_to_recompute(fleet):
+    """A peer that dies MID-BLOB (full Content-Length, half the bytes,
+    connection closed) must surface as a failed fetch and a clean
+    recompute — never a partial import, never a failed request."""
+    from kubeai_tpu.disagg.handoff import KVPageExport
+    import numpy as np
+
+    blob = serialize_pages(
+        KVPageExport(
+            prefix_hashes=("00" * 16,), page_size=PAGE, dtype="float32",
+            k_pages=np.zeros((2, 1, PAGE, 2, 8), np.float32),
+            v_pages=np.zeros((2, 1, PAGE, 2, 8), np.float32),
+        )
+    )
+
+    class HalfBlob(BaseHTTPRequestHandler):
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob[: len(blob) // 2])
+            self.wfile.flush()
+            self.connection.close()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), HalfBlob)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        peer = f"127.0.0.1:{httpd.server_address[1]}"
+        req = {"model": "tiny",
+               "prompt": "yet another story where a peer dies mid-blob",
+               "max_tokens": 12, "temperature": 0, "seed": 9}
+        ref = _gen(fleet["base"], req)
+        fails = fleet["b"].metrics.kv_fetch_failures.get(source="peer")
+        got = _gen(fleet["b"], req, headers={"X-KV-Source": peer})
+        assert got["text"] == ref["text"]
+        assert (
+            fleet["b"].metrics.kv_fetch_failures.get(source="peer") > fails
+        )
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_export_endpoint_surface(fleet):
+    # Sharing-off replicas don't serve exports.
+    st, _ = http_post(
+        _addr(fleet["base"]), "/v1/kv/export",
+        {"prefix_hashes": [], "max_bytes": 0},
+    )
+    assert st == 404
+    # Malformed chain is a 400, not a crash.
+    st, _ = http_post(
+        _addr(fleet["a"]), "/v1/kv/export", {"prefix_hashes": "zzz"}
+    )
+    assert st == 400
+    # An unheld chain answers an EMPTY export, status 200.
+    st, body = http_post(
+        _addr(fleet["a"]), "/v1/kv/export",
+        {"prefix_hashes": ["ff" * 16], "max_bytes": 0},
+    )
+    assert st == 200
+    from kubeai_tpu.disagg.handoff import deserialize_pages
+
+    assert deserialize_pages(body).n_pages == 0
+    # The sharing-off baseline publishes no holdings.
+    st, body = http_get(_addr(fleet["base"]), "/v1/state")
+    state = json.loads(body)
+    assert state["kv_sharing"] is False and state["kv_holdings"] == []
+
+
+def test_in_process_export_import_token_identity(fleet):
+    """Same acceptance bar without the HTTP layer: export from a's
+    engine, import into b's, serve locally — byte-identical to base."""
+    prompt = "in-process sharing check over two replicas here"
+    req = {"model": "tiny", "prompt": prompt, "max_tokens": 10,
+           "temperature": 0.7, "top_k": 4, "seed": 21}
+    ref = _gen(fleet["base"], req)
+    _gen(fleet["a"], req)  # warm a
+    a_inner = getattr(fleet["a"].engine, "inner", fleet["a"].engine)
+    b_inner = getattr(fleet["b"].engine, "inner", fleet["b"].engine)
+    ids = TOK.encode(prompt)
+    chain = a_inner.compute_prefix_chain(ids)[: (len(ids) - 1) // PAGE]
+    export = a_inner.export_prefix_pages(chain)
+    assert export is not None and export.n_pages == len(chain) > 0
+    assert b_inner.import_prefix_pages(export) >= 0
+    assert b_inner.cached_prefix_depth(chain) == len(chain)
+    got = _gen(fleet["b"], req)  # no X-KV-Source: hits the seeded pages
+    assert got["text"] == ref["text"]

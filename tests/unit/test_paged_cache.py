@@ -170,3 +170,77 @@ def test_page_reuse_after_release_no_leakage():
     # Beyond length 6, stale 99s may remain — that's exactly what the
     # length mask exists for; assert the valid prefix is clean.
     assert not np.any(np.asarray(gk)[:, 1, :6] == 99.0)
+
+
+# ---- prefix-cache eviction bookkeeping (cluster KV-sharing audit) -----------
+#
+# Once holdings are published cluster-wide, a stale _hash_to_page entry
+# surviving eviction would let lookup() adopt a page whose content was
+# overwritten by its new owner — a silent token-identity corruption. These
+# tests pin the invariant: eviction strips BOTH hash mappings atomically
+# with the idle-pool removal.
+
+
+def _alloc_with_idle(num_pages=5):
+    """Allocator with slot 0's registered pages parked in the idle LRU."""
+    alloc = PageAllocator(num_pages=num_pages, page_size=8)
+    pages = alloc.ensure(0, 16)  # 2 pages
+    hashes = [b"h0" * 8, b"h1" * 8]
+    alloc.register(hashes, pages)
+    alloc.release(0)  # registered pages park idle, ref 0
+    assert alloc.cached_idle_pages == 2
+    return alloc, pages, hashes
+
+
+def test_eviction_strips_hash_mappings():
+    alloc, pages, hashes = _alloc_with_idle()
+    # 2 plain-free pages remain; taking 3 forces one LRU eviction.
+    alloc.ensure(1, 24)
+    evicted = pages[0]  # LRU = first parked
+    assert evicted not in alloc._page_to_hash
+    assert hashes[0] not in alloc._hash_to_page
+    assert alloc.lookup(hashes) == []  # chain head gone -> full miss
+    # The surviving idle page keeps BOTH mappings.
+    assert alloc._hash_to_page[hashes[1]] == pages[1]
+    assert alloc._page_to_hash[pages[1]] == hashes[1]
+    # And holdings() mirrors the registration state exactly.
+    assert alloc.holdings() == [hashes[1]]
+
+
+def test_eviction_fires_spill_hook_then_deregisters():
+    alloc, pages, hashes = _alloc_with_idle()
+    seen = []
+    alloc.on_evict = lambda page, h: seen.append((page, h))
+    alloc.ensure(1, 24)
+    assert seen == [(pages[0], hashes[0])]
+    # A raising hook must not break allocation or leak mappings.
+    alloc.on_evict = lambda page, h: 1 / 0
+    alloc.ensure(2, 8)  # evicts the second idle page
+    assert hashes[1] not in alloc._hash_to_page
+    assert pages[1] not in alloc._page_to_hash
+
+
+def test_seed_unowned_parks_idle_and_adoptable():
+    alloc = PageAllocator(num_pages=5, page_size=8)
+    hashes = [b"a" * 16, b"b" * 16]
+    seeded = alloc.seed_unowned(hashes)
+    assert seeded is not None and all(p is not None for p in seeded)
+    assert alloc.cached_idle_pages == 2
+    assert alloc.holdings() == hashes
+    # Ordinary admission path adopts the seeded chain.
+    hit = alloc.lookup(hashes)
+    assert hit == seeded
+    alloc.adopt(0, hit)
+    assert alloc.cached_idle_pages == 0
+    assert alloc.pages_for(0) == seeded
+    # Already-registered hashes consume no page and come back None.
+    again = alloc.seed_unowned([hashes[0], b"c" * 16])
+    assert again[0] is None and again[1] is not None
+
+
+def test_seed_unowned_rolls_back_on_exhaustion():
+    alloc = PageAllocator(num_pages=3, page_size=8)  # 2 usable pages
+    before = alloc.free_pages
+    assert alloc.seed_unowned([b"x" * 16, b"y" * 16, b"z" * 16]) is None
+    assert alloc.free_pages == before  # nothing held by the failed seed
+    assert alloc.holdings() == []
