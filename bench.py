@@ -225,6 +225,12 @@ def _parse_args(argv=None):
         help="weight-only quantization",
     )
     ap.add_argument(
+        "--kv-dtype", default="", choices=["", "bfloat16", "int8"],
+        help="paged KV cache storage dtype (int8 = quantized pages: "
+        "~2x slot capacity at equal HBM; requires --cache-mode paged "
+        "and no --speculate)",
+    )
+    ap.add_argument(
         "--decode-chunk", type=int, default=32,
         help="decode steps fused into one device call (amortizes dispatch "
         "latency, which dominates through the TPU relay tunnel)",
@@ -315,6 +321,7 @@ def _child_main(args) -> None:
             speculate=args.speculate,
             spec_adaptive=args.spec_adaptive == "on",
             quantization=args.quantization,
+            kv_dtype=args.kv_dtype,
             decode_chunk=max(1, args.decode_chunk),
             prefill_chunk=prefill_chunk,
             prefix_cache=args.prefix_cache,
@@ -490,6 +497,7 @@ def _result_line(args, eng, model_name, backend_note, toks_per_s, baseline):
             if eng._spec else ""
         )
         + (f", {args.quantization}" if args.quantization else "")
+        + (f", kv={args.kv_dtype}" if args.kv_dtype else "")
         + f", chunk={eng.cfg.decode_chunk}"
         + ", 1 chip" + (" (smoke)" if args.smoke else "")
         + backend_note,
@@ -598,10 +606,14 @@ def _tpu_ladder(argv: list[str], args) -> dict | None:
               file=sys.stderr, flush=True)
         base = argv
         if "slot" in extra:
-            # prefix_cache requires the paged cache; a slot-cache rung
-            # keeping the flag would fail at Engine init every time
-            # instead of giving the ladder its cache-free answer.
+            # prefix_cache and int8 KV require the paged cache; a
+            # slot-cache rung keeping either flag would fail at Engine
+            # init every time instead of giving the ladder its
+            # cache-free answer.
             base = [a for a in argv if a != "--prefix-cache"]
+            while "--kv-dtype" in base:
+                i = base.index("--kv-dtype")
+                del base[i:i + 2]
         r = _run_measurement([*base, *extra], wd)
         ok = r is not None and r.get("value", 0) > 0
         print(f"bench: {label} -> "

@@ -1,0 +1,564 @@
+"""Deterministic int8-KV capacity/bytes simulation — no JAX, no sockets.
+
+Answers the quantized-paged-KV tier's three promises with measured
+numbers on a fake clock, at the geometry the feature actually targets
+(Llama-8B-class KV heads: head_dim 128 — NOT the tiny tier-1 proxy,
+whose head_dim 16 caps the capacity factor at 1.6):
+
+  * CAPACITY — at an identical HBM budget, the int8 page pool (1-byte
+    values + per-token-per-head f32 scales) holds >= 1.9x the tokens and
+    >= 1.9x the decode slots of the bf16 pool. The exact factor is
+    2D/(D+4) = 1.9394 at D=128 (ops/kv_quant.kv_capacity_factor; the
+    tier-1 test pins this module's constant to the real function).
+  * WIRE BYTES — replaying ONE identical disagg/sharing/spill trace
+    through the REAL KVH1/KVP1 serializers (disagg/handoff.py) in both
+    dtypes, the int8 arm ships strictly fewer bytes in every category
+    (prefill->decode handoffs, peer prefix-page fetches, spill-store
+    writes), and every int8 blob round-trips byte-identically
+    (serialize -> deserialize -> serialize) — re-quantization on the
+    wire would show up here as a diff.
+  * DECODE PHASE — a memory-bandwidth cost model of the paged-attention
+    read (the decode step is HBM-bound; int8 halves the bytes but adds a
+    dequant multiply per element) driven through the REAL StepProfiler
+    and the `kubeai_engine_step_phase_seconds` histogram: the int8 arm's
+    decode phase must not regress over the identical step schedule.
+
+Plus the control-plane consequence: two REAL CapacityPlanner worlds
+(fleet/planner.py) over the same 12-chip budget and the same resident
+load, differing only in the advertised KV capacity. The bf16 replica's
+KV-utilization signal demands a decode replica the budget cannot host
+(throttled); the int8 replica's halved utilization fits exactly — the
+plan's decision records show the int8 replica fitting where bf16 did
+not.
+
+Invariants (asserted in tier-1 by tests/unit/test_kv_quant_sim.py):
+
+  * token and slot capacity ratios >= 1.9 at equal HBM;
+  * int8 wire bytes strictly below bf16 in every category;
+  * int8 blobs byte-identical across a wire round-trip;
+  * no decode-phase regression in kubeai_engine_step_phase_seconds;
+  * planner: bf16 throttled > 0, int8 throttled == 0 with allocation ==
+    target, chip budget respected in both worlds;
+  * the run is deterministic: same inputs, byte-identical report.
+
+Run directly for the full JSON report:
+
+    python benchmarks/kv_quant_sim.py
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeai_tpu.disagg.handoff import (
+    KVHandoff,
+    KVPageExport,
+    deserialize,
+    deserialize_pages,
+    serialize,
+    serialize_pages,
+)
+from kubeai_tpu.fleet.planner import CapacityPlanner
+from kubeai_tpu.fleet.profiler import StepProfiler
+from kubeai_tpu.metrics.registry import Gauge, Histogram, Registry
+from kubeai_tpu.testing.faults import FakeClock
+
+# ---- target geometry (Llama-8B-class KV: GQA 8 heads x 128 dims) -------------
+
+NUM_LAYERS = 32
+KV_HEADS = 8
+HEAD_DIM = 128
+PAGE = 16  # tokens per KV page
+MAX_SEQ_LEN = 4096  # one decode slot's page-table reservation
+HBM_KV_BUDGET = 6 * 2**30  # bytes of HBM granted to the KV pool
+SCALE_BYTES = 4  # one f32 scale per (token, head)
+
+# 2D/(D+scale_bytes): pinned to ops/kv_quant.kv_capacity_factor by the
+# tier-1 test (the sim itself stays JAX-free).
+CAPACITY_FACTOR = 2 * HEAD_DIM / (HEAD_DIM + SCALE_BYTES)
+
+# ---- decode-phase cost model -------------------------------------------------
+
+HBM_BW_BYTES_PER_S = 819e9  # v5e HBM bandwidth
+DEQUANT_S_PER_ELEM = 2e-13  # int8->bf16 multiply, amortized per element
+DECODE_STEPS = 48
+DECODE_BATCH = 12  # resident sequences during the phase comparison
+
+# ---- wire-trace geometry (small arrays, real serializers) --------------------
+
+WIRE_NL = 4
+WIRE_KVH = 2
+
+# The sim's own instrument bundle, mirroring the engine gauges the
+# /v1/state consumers read (EngineMetrics in engine/server.py). Declared
+# with the engine metric names so scripts/check_metric_catalogue.py —
+# whose static scan covers benchmarks/ — pins them to the catalogue.
+SIM_REGISTRY = Registry()
+KV_CACHE_BYTES = Gauge(
+    "kubeai_engine_kv_cache_bytes",
+    "Resident KV page-pool bytes (values + quantization scales)",
+    SIM_REGISTRY,
+)
+KV_QUANT_ENABLED = Gauge(
+    "kubeai_engine_kv_quant_enabled",
+    "1 when the paged KV cache stores int8 pages, else 0",
+    SIM_REGISTRY,
+)
+KV_QUANT_CAPACITY_FACTOR = Gauge(
+    "kubeai_engine_kv_quant_capacity_factor",
+    "Token capacity multiplier of the configured KV dtype vs bf16",
+    SIM_REGISTRY,
+)
+STEP_PHASE_SECONDS = Histogram(
+    "kubeai_engine_step_phase_seconds",
+    "Modeled engine step phase durations (sim arms labeled by kv dtype)",
+    SIM_REGISTRY,
+)
+
+
+def bytes_per_token(dtype: str) -> int:
+    """Resident bytes one token's K+V rows cost across all layers."""
+    values = 2 * NUM_LAYERS * KV_HEADS * HEAD_DIM  # K and V
+    if dtype == "int8":
+        return values + 2 * NUM_LAYERS * KV_HEADS * SCALE_BYTES
+    return values * 2  # bf16
+
+
+def pool_capacity(dtype: str) -> dict:
+    """Whole-page pool capacity at the fixed HBM budget — the same
+    arithmetic Engine.kv_cache_info reports from a live pool."""
+    page_bytes = PAGE * bytes_per_token(dtype)
+    num_pages = HBM_KV_BUDGET // page_bytes
+    tokens = num_pages * PAGE
+    return {
+        "dtype": dtype,
+        "bytes_per_token": bytes_per_token(dtype),
+        "num_pages": int(num_pages),
+        "token_capacity": int(tokens),
+        "slot_capacity": int(tokens // MAX_SEQ_LEN),
+        "pool_bytes": int(num_pages * page_bytes),
+    }
+
+
+# ---- wire trace --------------------------------------------------------------
+
+
+def _trace_events() -> list[tuple[str, int]]:
+    """One deterministic disagg/sharing/spill trace: (kind, size) where
+    size is prompt tokens for handoffs and page counts for fetch/spill."""
+    events: list[tuple[str, int]] = []
+    for i in range(8):
+        events.append(("handoff", 96 + 32 * (i % 4) + 7 * i))
+    for i in range(6):
+        events.append(("fetch", 2 + (i % 3)))
+    for i in range(4):
+        events.append(("spill", 3 + (i % 2)))
+    return events
+
+
+def _wire_arrays(dtype: str, n_pages: int, seed: int):
+    """Deterministic page content for one blob: (k, v, k_scales,
+    v_scales). bf16 lives in ml_dtypes (what np.asarray(jax_array)
+    yields), so the trace exercises the exact dtype the engine ships."""
+    shape = (WIRE_NL, n_pages, PAGE, WIRE_KVH, HEAD_DIM)
+    n = int(np.prod(shape))
+    base = (np.arange(n, dtype=np.int64) * 2654435761 + seed * 40503) % 255
+    if dtype == "int8":
+        k = (base.reshape(shape) - 127).astype(np.int8)
+        v = ((254 - base).reshape(shape) - 127).astype(np.int8)
+        sshape = shape[:-1]
+        sn = int(np.prod(sshape))
+        sbase = (np.arange(sn, dtype=np.int64) * 69069 + seed) % 1000
+        ks = (sbase.reshape(sshape).astype(np.float32) + 1.0) / 1024.0
+        vs = (999 - sbase).reshape(sshape).astype(np.float32) / 1024.0 + 0.001
+        return k, v, ks, vs
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    k = ((base.reshape(shape) - 127) / 16.0).astype(bf16)
+    v = ((127 - base.reshape(shape)) / 16.0).astype(bf16)
+    return k, v, None, None
+
+
+def _handoff_blob(dtype: str, plen: int, seed: int) -> bytes:
+    n_pages = math.ceil(plen / PAGE)
+    k, v, ks, vs = _wire_arrays(dtype, n_pages, seed)
+    h = KVHandoff(
+        token_ids=[(seed * 131 + j) % 50021 for j in range(plen)],
+        first_token=(seed * 17) % 50021,
+        first_finish="",
+        page_size=PAGE,
+        dtype=dtype,
+        k_pages=k,
+        v_pages=v,
+        seed=seed,
+        temperature=0.0,
+        top_k=0,
+        top_p=1.0,
+        max_tokens=64,
+        model="sim",
+        k_scales=ks,
+        v_scales=vs,
+    )
+    return serialize(h)
+
+
+def _pages_blob(dtype: str, n_pages: int, seed: int) -> bytes:
+    k, v, ks, vs = _wire_arrays(dtype, n_pages, seed)
+    e = KVPageExport(
+        prefix_hashes=tuple(f"{seed:08x}{p:08x}" for p in range(n_pages)),
+        page_size=PAGE,
+        dtype=dtype,
+        k_pages=k,
+        v_pages=v,
+        model="sim",
+        k_scales=ks,
+        v_scales=vs,
+    )
+    return serialize_pages(e)
+
+
+def run_wire_trace(dtype: str) -> dict:
+    """Replay the trace through the real serializers; verify every blob
+    survives a wire round-trip byte-identically (for int8 this is the
+    no-re-quantization guarantee: values and scales ship verbatim)."""
+    totals = {"handoff": 0, "fetch": 0, "spill": 0}
+    counts = {"handoff": 0, "fetch": 0, "spill": 0}
+    roundtrip_ok = True
+    for seed, (kind, size) in enumerate(_trace_events()):
+        if kind == "handoff":
+            blob = _handoff_blob(dtype, size, seed)
+            h2 = deserialize(blob)
+            again = serialize(h2)
+        else:
+            blob = _pages_blob(dtype, size, seed)
+            e2 = deserialize_pages(blob)
+            again = serialize_pages(e2)
+        roundtrip_ok = roundtrip_ok and (again == blob)
+        totals[kind] += len(blob)
+        counts[kind] += 1
+    return {
+        "dtype": dtype,
+        "bytes": totals,
+        "events": counts,
+        "total_bytes": sum(totals.values()),
+        "roundtrip_byte_identical": roundtrip_ok,
+    }
+
+
+# ---- decode-phase model ------------------------------------------------------
+
+
+def run_decode_phases(dtype: str) -> dict:
+    """Drive the REAL StepProfiler over an identical step schedule in
+    both arms. Per step, the paged-attention read streams every resident
+    token's K+V rows from HBM (the decode step's bound); the int8 arm
+    reads ~half the bytes but pays a dequant multiply per element."""
+    clock = FakeClock(1000.0)
+    prof = StepProfiler(maxlen=DECODE_STEPS, wall=clock)
+    quant = dtype == "int8"
+    values_per_token = 2 * NUM_LAYERS * KV_HEADS * HEAD_DIM
+    for step in range(DECODE_STEPS):
+        resident = DECODE_BATCH * (256 + 16 * step)  # growing sequences
+        read_bytes = resident * bytes_per_token(dtype)
+        decode_s = read_bytes / HBM_BW_BYTES_PER_S
+        if quant:
+            decode_s += resident * values_per_token * DEQUANT_S_PER_ELEM
+        phases = {
+            "schedule": 0.0002,
+            "decode": decode_s,
+            "host_sync": 0.0004,
+            "sample": 0.0003,
+        }
+        prof.observe_step(
+            phases, tokens=DECODE_BATCH, batch=DECODE_BATCH,
+            duration_s=sum(phases.values()),
+        )
+        clock.advance(sum(phases.values()))
+    for phase, seconds in prof.drain():
+        STEP_PHASE_SECONDS.observe(seconds, phase=phase, kv_dtype=dtype)
+    records = prof.recent()
+    return {
+        "dtype": dtype,
+        "steps": len(records),
+        "decode_phase_total_s": round(
+            sum(r["phases_s"]["decode"] for r in records), 9
+        ),
+        "decode_phase_per_step_s": [
+            r["phases_s"]["decode"] for r in records
+        ],
+    }
+
+
+# ---- planner worlds ----------------------------------------------------------
+
+SHAPE = "tpu-v5-lite-podslice/2x2"
+CHIP_BUDGET = 12
+CHIPS_PER_REPLICA = 4
+N_PREFILL = 1
+N_DECODE = 2
+RESIDENT_TOKENS = 88_000  # fleet-wide resident KV load, both worlds
+
+
+def _sim_model(name: str):
+    from kubeai_tpu.crd.model import Disaggregation, Model, ModelSpec
+
+    return Model(
+        name=name,
+        spec=ModelSpec(
+            url="hf://org/x",
+            engine="KubeAITPU",
+            features=["TextGeneration"],
+            min_replicas=0,
+            max_replicas=10,
+            target_requests=10,
+            disaggregation=Disaggregation(
+                enabled=True,
+                prefill_target_queue=4,
+                decode_target_utilization=0.8,
+            ),
+        ),
+    )
+
+
+class _FakeFleet:
+    """Minimal FleetStateAggregator stand-in: one fresh snapshot whose
+    decode-role signals carry the KV capacity the engine advertises."""
+
+    def __init__(self, clock, model: str, cap: dict):
+        self._clock = clock
+        self._model = model
+        self._cap = cap
+
+    def snapshot(self) -> dict:
+        slot_capacity = N_DECODE * self._cap["slot_capacity"]
+        kv_util = RESIDENT_TOKENS / (
+            N_DECODE * self._cap["token_capacity"]
+        )
+        # Active sequences sized so slot occupancy stays below the KV
+        # signal: decode replicas die by running out of pages first.
+        slots_active = min(slot_capacity * 0.5, 10.0)
+        decode_sig = {
+            "endpoints": N_DECODE,
+            "depth": 0.0,
+            "oldest_wait_s": 0.0,
+            "kv_utilization": kv_util,
+            "slots_active": slots_active,
+            "slot_capacity": float(slot_capacity),
+            "ttft_mean_s": 0.1,
+        }
+        prefill_sig = {
+            "endpoints": N_PREFILL,
+            "depth": 2.0,
+            "oldest_wait_s": 0.5,
+            "kv_utilization": 0.0,
+            "slots_active": 0.0,
+            "slot_capacity": 0.0,
+            "ttft_mean_s": 0.1,
+        }
+        total = N_PREFILL + N_DECODE
+        return {
+            "ts": self._clock(),
+            "models": {
+                self._model: {
+                    "replicas": {
+                        "prefill": N_PREFILL, "decode": N_DECODE,
+                    },
+                    "roles": {
+                        "prefill": prefill_sig, "decode": decode_sig,
+                    },
+                    "pods": {
+                        "total": total,
+                        "chips": total * CHIPS_PER_REPLICA,
+                        "by_role": {
+                            "prefill": N_PREFILL, "decode": N_DECODE,
+                        },
+                    },
+                },
+            },
+        }
+
+
+def run_planner_world(dtype: str) -> dict:
+    """One REAL CapacityPlanner tick over the fixed chip budget, fed the
+    KV capacity this dtype's pool advertises. Returns the model's plan
+    decision record plus the budget accounting."""
+    from kubeai_tpu.metrics.registry import Metrics
+
+    clock = FakeClock(2000.0)
+    cap = pool_capacity(dtype)
+    name = f"chat-{dtype}"
+    model = _sim_model(name)
+    fleet = _FakeFleet(clock, name, cap)
+
+    class _Models:
+        def list_all_models(self):
+            return [model]
+
+    planner = CapacityPlanner(
+        fleet=fleet,
+        model_client=_Models(),
+        metrics=Metrics(),
+        interval_s=1.0,
+        preemption_enabled=False,
+        budget_override={
+            SHAPE: {
+                "chips": CHIP_BUDGET, "slice_chips": CHIPS_PER_REPLICA,
+            },
+        },
+        clock=clock,
+    )
+    plan = planner.tick(force=True)
+    rec = plan["models"][name]
+    return {
+        "dtype": dtype,
+        "kv_utilization": rec["kv_utilization"],
+        "slot_capacity": N_DECODE * cap["slot_capacity"],
+        "desired_roles": rec["desired_roles"],
+        "target_roles": rec["target_roles"],
+        "allocated_roles": rec["allocated_roles"],
+        "throttled_replicas": rec["throttled_replicas"],
+        "chips_allocated": plan["allocated_chips"]["total"],
+        "chip_budget": plan["budget"]["total"],
+        "decision_record": rec,
+    }
+
+
+# ---- the full sim ------------------------------------------------------------
+
+
+def run_sim() -> dict:
+    capacity = {d: pool_capacity(d) for d in ("bfloat16", "int8")}
+    for d, cap in capacity.items():
+        KV_CACHE_BYTES.set(cap["pool_bytes"], kv_dtype=d)
+        KV_QUANT_ENABLED.set(1.0 if d == "int8" else 0.0, kv_dtype=d)
+        KV_QUANT_CAPACITY_FACTOR.set(
+            CAPACITY_FACTOR if d == "int8" else 1.0, kv_dtype=d
+        )
+    wire = {d: run_wire_trace(d) for d in ("bfloat16", "int8")}
+    phases = {d: run_decode_phases(d) for d in ("bfloat16", "int8")}
+    planner = {d: run_planner_world(d) for d in ("bfloat16", "int8")}
+    return {
+        "geometry": {
+            "num_layers": NUM_LAYERS,
+            "kv_heads": KV_HEADS,
+            "head_dim": HEAD_DIM,
+            "page_size": PAGE,
+            "max_seq_len": MAX_SEQ_LEN,
+            "hbm_kv_budget_bytes": HBM_KV_BUDGET,
+            "capacity_factor": CAPACITY_FACTOR,
+        },
+        "capacity": capacity,
+        "wire": wire,
+        "decode_phases": phases,
+        "planner": planner,
+    }
+
+
+def check_invariants(summary: dict) -> list[str]:
+    """Empty list = every quantized-KV promise held."""
+    errors: list[str] = []
+    bf, q8 = summary["capacity"]["bfloat16"], summary["capacity"]["int8"]
+
+    token_ratio = q8["token_capacity"] / bf["token_capacity"]
+    slot_ratio = q8["slot_capacity"] / bf["slot_capacity"]
+    if token_ratio < 1.9:
+        errors.append(
+            f"token capacity ratio {token_ratio:.4f} < 1.9 at equal HBM"
+        )
+    if slot_ratio < 1.9:
+        errors.append(
+            f"slot capacity ratio {slot_ratio:.4f} < 1.9 at equal HBM"
+        )
+    for cap in (bf, q8):
+        if cap["pool_bytes"] > HBM_KV_BUDGET:
+            errors.append(
+                f"{cap['dtype']} pool overruns the HBM budget: "
+                f"{cap['pool_bytes']} > {HBM_KV_BUDGET}"
+            )
+
+    wbf, wq8 = summary["wire"]["bfloat16"], summary["wire"]["int8"]
+    if wbf["events"] != wq8["events"]:
+        errors.append("wire arms replayed different traces")
+    for kind, n in wq8["events"].items():
+        if n == 0:
+            errors.append(f"wire trace has no {kind} events — no contrast")
+        if wq8["bytes"][kind] >= wbf["bytes"][kind]:
+            errors.append(
+                f"int8 did not reduce {kind} bytes: "
+                f"{wq8['bytes'][kind]} >= {wbf['bytes'][kind]}"
+            )
+    for arm in (wbf, wq8):
+        if not arm["roundtrip_byte_identical"]:
+            errors.append(
+                f"{arm['dtype']} blobs did not survive the wire "
+                "round-trip byte-identically"
+            )
+
+    pbf = summary["decode_phases"]["bfloat16"]
+    pq8 = summary["decode_phases"]["int8"]
+    if pq8["decode_phase_total_s"] > pbf["decode_phase_total_s"]:
+        errors.append(
+            "decode phase regressed under int8: "
+            f"{pq8['decode_phase_total_s']} > {pbf['decode_phase_total_s']}"
+        )
+    worse_steps = sum(
+        1
+        for a, b in zip(
+            pq8["decode_phase_per_step_s"], pbf["decode_phase_per_step_s"]
+        )
+        if a > b
+    )
+    if worse_steps:
+        errors.append(
+            f"{worse_steps} step(s) slower under int8 on the identical "
+            "schedule"
+        )
+
+    plbf, plq8 = summary["planner"]["bfloat16"], summary["planner"]["int8"]
+    if plbf["throttled_replicas"] <= 0:
+        errors.append(
+            "bf16 world was never throttled — the planner scenario lost "
+            "its contrast"
+        )
+    if plq8["throttled_replicas"] != 0:
+        errors.append(
+            f"int8 replica did not fit: {plq8['throttled_replicas']} "
+            "replica(s) throttled"
+        )
+    if plq8["allocated_roles"] != plq8["target_roles"]:
+        errors.append(
+            "int8 allocation fell short of target: "
+            f"{plq8['allocated_roles']} != {plq8['target_roles']}"
+        )
+    if plq8["slot_capacity"] < 1.9 * plbf["slot_capacity"]:
+        errors.append(
+            "planner did not see the doubled slot capacity: "
+            f"{plq8['slot_capacity']} vs {plbf['slot_capacity']}"
+        )
+    for world in (plbf, plq8):
+        if world["chips_allocated"] > world["chip_budget"]:
+            errors.append(
+                f"{world['dtype']} plan overran the chip budget: "
+                f"{world['chips_allocated']} > {world['chip_budget']}"
+            )
+    return errors
+
+
+if __name__ == "__main__":
+    summary = run_sim()
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    problems = check_invariants(summary)
+    if problems:
+        print("\nINVARIANT VIOLATIONS:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        sys.exit(1)
+    print("\nall invariants held")

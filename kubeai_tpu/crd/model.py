@@ -338,6 +338,31 @@ class KVSharing:
             )
 
 
+KV_CACHE_DTYPES = ("bfloat16", "int8")
+
+
+@dataclasses.dataclass
+class KVCacheSpec:
+    """Paged KV-cache storage configuration (in-tree engine only).
+    dtype "int8" stores pages quantized with per-token-per-head scales
+    (engine flag --kv-dtype): ~2x slot capacity at equal HBM and half
+    the KV bytes on every disagg handoff, peer prefix fetch and
+    objstore spill. Replicas of one model must agree on the dtype —
+    bf16 and int8 pools refuse each other's KV on the wire rather
+    than cast."""
+
+    dtype: str = ""  # "" = engine default (bfloat16)
+
+    def enabled(self) -> bool:
+        return bool(self.dtype)
+
+    def validate(self) -> None:
+        if self.dtype and self.dtype not in KV_CACHE_DTYPES:
+            raise ValidationError(
+                f"kvCache.dtype must be one of {list(KV_CACHE_DTYPES)}"
+            )
+
+
 @dataclasses.dataclass
 class ModelSpec:
     """(reference: api/k8s/v1/model_types.go:36-144)"""
@@ -378,6 +403,8 @@ class ModelSpec:
     )
     # Cluster-shared prefix/KV cache tier (in-tree engine only).
     kv_sharing: KVSharing = dataclasses.field(default_factory=KVSharing)
+    # Paged KV-cache storage dtype (in-tree engine only).
+    kv_cache: KVCacheSpec = dataclasses.field(default_factory=KVCacheSpec)
     # Graceful-drain budget: seconds an engine waits for in-flight
     # generations after SIGTERM / POST /v1/drain before terminating the
     # remainder. 0 = the system config `resilience.drainTimeout`
@@ -467,6 +494,16 @@ class ModelSpec:
         if self.kv_sharing.enabled and self.engine != ENGINE_KUBEAI_TPU:
             raise ValidationError(
                 "spec.kvSharing requires the KubeAITPU engine"
+            )
+        self.kv_cache.validate()
+        if self.kv_cache.enabled() and self.engine != ENGINE_KUBEAI_TPU:
+            raise ValidationError(
+                "spec.kvCache requires the KubeAITPU engine"
+            )
+        if self.kv_cache.dtype == "int8" and self.speculative_tokens:
+            raise ValidationError(
+                "kvCache.dtype=int8 does not compose with "
+                "speculativeTokens (the verify kernels read bf16 pools)"
             )
         if self.drain_timeout_seconds < 0:
             raise ValidationError("drainTimeoutSeconds must be >= 0")
@@ -629,6 +666,7 @@ class Model:
         cb = lb.get("circuitBreaker", {}) or {}
         dis = spec.get("disaggregation", {}) or {}
         kvs = spec.get("kvSharing", {}) or {}
+        kvc = spec.get("kvCache", {}) or {}
 
         def _role_scaling(key: str) -> RoleScaling:
             r = dis.get(key) or {}
@@ -738,6 +776,9 @@ class Model:
                         kvs.get("fetchTimeoutSeconds", 5) or 5
                     ),
                     spill_url=kvs.get("spillURL", ""),
+                ),
+                kv_cache=KVCacheSpec(
+                    dtype=kvc.get("dtype", "") or "",
                 ),
             ),
             status=ModelStatus(
@@ -873,4 +914,6 @@ def _spec_to_dict(s: ModelSpec) -> dict:
             "fetchTimeoutSeconds": kvs.fetch_timeout_seconds,
             **({"spillURL": kvs.spill_url} if kvs.spill_url else {}),
         }
+    if s.kv_cache.enabled():
+        d["kvCache"] = {"dtype": s.kv_cache.dtype}
     return d

@@ -22,12 +22,27 @@ Wire format (all integers little-endian):
     header: version, dtype, num_layers, kv_heads, head_dim, page_size,
             n_pages, plen, token_ids, first_token, first_finish,
             sampling {seed, temperature, top_k, top_p, max_tokens, stop},
-            prefix_hashes (hex), adapter, client, priority, model
+            prefix_hashes (hex), adapter, client, priority, model,
+            kv_quant (int8 pools only)
 
 K/V arrays are [num_layers, n_pages, page_size, kv_heads, head_dim]
 packed pages covering exactly the sequence (the partial last page ships
 whole; junk past `plen` is masked by position on the decode side exactly
 as it is in the exporting pool).
+
+Quantized pools (dtype "int8", ops/kv_quant.py): the header carries a
+`kv_quant` block {"scheme": "int8-token-head", "scale_dtype": "float32"}
+and the body grows two trailing scale arrays,
+
+    ... | K bytes | V bytes | K scales | V scales
+
+each [num_layers, n_pages, page_size, kv_heads] f32 — the per-token-
+per-head scales travel WITH their pages, so a quantized handoff or page
+export round-trips byte-exactly (the importer scatters the int8 values
+and scales verbatim; nothing is ever re-quantized on the wire). A peer
+whose pool dtype differs must refuse with `HandoffError` — casting in
+either direction would silently alter KV values the exporter's chain
+hashes and token stream vouch for.
 """
 
 from __future__ import annotations
@@ -50,6 +65,51 @@ PAGES_MAGIC = b"KVP1"
 
 class HandoffError(ValueError):
     """Malformed or incompatible handoff blob."""
+
+
+# The one quantization scheme the wire speaks (ops/kv_quant.py): int8
+# values with per-token-per-head float32 scales. The header block names
+# it explicitly so a future coarser scheme can't be confused for it.
+KV_QUANT_SCHEME = "int8-token-head"
+_SCALE_DTYPE = np.dtype(np.float32)
+
+
+def _quant_header(dtype: str, k_scales, v_scales) -> dict | None:
+    """Validate scale presence against the dtype and build the header
+    block (None for unquantized blobs — the wire stays v1-compatible)."""
+    if dtype == "int8":
+        if k_scales is None or v_scales is None:
+            raise HandoffError("int8 KV requires k_scales/v_scales")
+        return {"scheme": KV_QUANT_SCHEME, "scale_dtype": "float32"}
+    if k_scales is not None or v_scales is not None:
+        raise HandoffError(
+            f"scales supplied for non-quantized dtype {dtype!r}"
+        )
+    return None
+
+
+def _check_quant_block(header: dict, kind: str) -> bool:
+    """True when the blob is quantized; typed refusal on any mismatch
+    between the dtype and the kv_quant block."""
+    quant = header.get("kv_quant")
+    if header.get("dtype") == "int8":
+        if not isinstance(quant, dict):
+            raise HandoffError(f"int8 {kind} is missing its kv_quant block")
+        if quant.get("scheme") != KV_QUANT_SCHEME:
+            raise HandoffError(
+                f"unsupported KV quant scheme {quant.get('scheme')!r}"
+            )
+        if quant.get("scale_dtype", "float32") != "float32":
+            raise HandoffError(
+                f"unsupported scale dtype {quant.get('scale_dtype')!r}"
+            )
+        return True
+    if quant is not None:
+        raise HandoffError(
+            f"kv_quant block on non-int8 {kind} "
+            f"(dtype {header.get('dtype')!r})"
+        )
+    return False
 
 
 def _resolve_dtype(name: str) -> np.dtype:
@@ -89,10 +149,18 @@ class KVHandoff:
     client: str = ""
     priority: str = ""
     model: str = ""
+    # Int8 pools only: per-token-per-head f32 scales riding with their
+    # pages, [NL, n_pages, page, KVH]. None for unquantized handoffs.
+    k_scales: np.ndarray | None = None
+    v_scales: np.ndarray | None = None
 
     @property
     def plen(self) -> int:
         return len(self.token_ids)
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scales is not None
 
     def contiguous_kv(self) -> tuple[np.ndarray, np.ndarray]:
         """Flatten the packed pages to token order [NL, plen, KVH, D] —
@@ -102,8 +170,19 @@ class KVHandoff:
         v = self.v_pages.reshape(nl, n_pages * page, kvh, d)[:, : self.plen]
         return k, v
 
+    def contiguous_scales(self) -> tuple[np.ndarray, np.ndarray]:
+        """Token-order view [NL, plen, KVH] of the scale arrays (int8
+        handoffs only) — scattered alongside contiguous_kv()."""
+        nl, n_pages, page, kvh = self.k_scales.shape
+        ks = self.k_scales.reshape(nl, n_pages * page, kvh)[:, : self.plen]
+        vs = self.v_scales.reshape(nl, n_pages * page, kvh)[:, : self.plen]
+        return ks, vs
+
     def nbytes(self) -> int:
-        return int(self.k_pages.nbytes + self.v_pages.nbytes)
+        n = int(self.k_pages.nbytes + self.v_pages.nbytes)
+        if self.quantized:
+            n += int(self.k_scales.nbytes + self.v_scales.nbytes)
+        return n
 
 
 def serialize(h: KVHandoff) -> bytes:
@@ -111,6 +190,12 @@ def serialize(h: KVHandoff) -> bytes:
     if h.v_pages.shape != h.k_pages.shape:
         raise HandoffError(
             f"K/V shape mismatch: {h.k_pages.shape} vs {h.v_pages.shape}"
+        )
+    quant = _quant_header(h.dtype, h.k_scales, h.v_scales)
+    if quant is not None and h.k_scales.shape != (nl, n_pages, page, kvh):
+        raise HandoffError(
+            f"scale shape {h.k_scales.shape} does not match pages "
+            f"{(nl, n_pages, page, kvh)}"
         )
     header = {
         "version": VERSION,
@@ -138,12 +223,20 @@ def serialize(h: KVHandoff) -> bytes:
         "priority": h.priority,
         "model": h.model,
     }
+    if quant is not None:
+        header["kv_quant"] = quant
     hdr = json.dumps(header).encode()
     k = np.ascontiguousarray(h.k_pages)
     v = np.ascontiguousarray(h.v_pages)
-    return b"".join(
-        [MAGIC, struct.pack("<I", len(hdr)), hdr, k.tobytes(), v.tobytes()]
-    )
+    parts = [MAGIC, struct.pack("<I", len(hdr)), hdr, k.tobytes(), v.tobytes()]
+    if quant is not None:
+        parts.append(
+            np.ascontiguousarray(h.k_scales, _SCALE_DTYPE).tobytes()
+        )
+        parts.append(
+            np.ascontiguousarray(h.v_scales, _SCALE_DTYPE).tobytes()
+        )
+    return b"".join(parts)
 
 
 def deserialize(blob: bytes) -> KVHandoff:
@@ -161,6 +254,7 @@ def deserialize(blob: bytes) -> KVHandoff:
             f"unsupported handoff version {header.get('version')!r}"
         )
     dtype = _resolve_dtype(header["dtype"])
+    quantized = _check_quant_block(header, "handoff")
     shape = (
         header["num_layers"],
         header["n_pages"],
@@ -169,8 +263,10 @@ def deserialize(blob: bytes) -> KVHandoff:
         header["head_dim"],
     )
     count = int(np.prod(shape))
+    scale_count = int(np.prod(shape[:-1])) if quantized else 0
     body = blob[8 + hdr_len :]
     expected = 2 * count * dtype.itemsize
+    expected += 2 * scale_count * _SCALE_DTYPE.itemsize
     if len(body) != expected:
         raise HandoffError(
             f"handoff body is {len(body)} bytes, expected {expected}"
@@ -178,9 +274,20 @@ def deserialize(blob: bytes) -> KVHandoff:
     k = np.frombuffer(body[: count * dtype.itemsize], dtype=dtype).reshape(
         shape
     )
-    v = np.frombuffer(body[count * dtype.itemsize :], dtype=dtype).reshape(
-        shape
-    )
+    v = np.frombuffer(
+        body[count * dtype.itemsize : 2 * count * dtype.itemsize],
+        dtype=dtype,
+    ).reshape(shape)
+    k_scales = v_scales = None
+    if quantized:
+        off = 2 * count * dtype.itemsize
+        sz = scale_count * _SCALE_DTYPE.itemsize
+        k_scales = np.frombuffer(
+            body[off : off + sz], dtype=_SCALE_DTYPE
+        ).reshape(shape[:-1])
+        v_scales = np.frombuffer(
+            body[off + sz :], dtype=_SCALE_DTYPE
+        ).reshape(shape[:-1])
     plen = int(header["plen"])
     if not 0 < plen <= header["n_pages"] * header["page_size"]:
         raise HandoffError(f"plen {plen} outside shipped pages")
@@ -204,6 +311,8 @@ def deserialize(blob: bytes) -> KVHandoff:
         client=str(header.get("client", "")),
         priority=str(header.get("priority", "")),
         model=str(header.get("model", "")),
+        k_scales=k_scales,
+        v_scales=v_scales,
     )
 
 
@@ -224,13 +333,23 @@ class KVPageExport:
     k_pages: np.ndarray  # [NL, n_pages, page, KVH, D]
     v_pages: np.ndarray
     model: str = ""
+    # Int8 pools only: [NL, n_pages, page, KVH] f32 scales.
+    k_scales: np.ndarray | None = None
+    v_scales: np.ndarray | None = None
 
     @property
     def n_pages(self) -> int:
         return int(self.k_pages.shape[1])
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scales is not None
+
     def nbytes(self) -> int:
-        return int(self.k_pages.nbytes + self.v_pages.nbytes)
+        n = int(self.k_pages.nbytes + self.v_pages.nbytes)
+        if self.quantized:
+            n += int(self.k_scales.nbytes + self.v_scales.nbytes)
+        return n
 
 
 def serialize_pages(e: KVPageExport) -> bytes:
@@ -243,6 +362,12 @@ def serialize_pages(e: KVPageExport) -> bytes:
         raise HandoffError(
             f"{len(e.prefix_hashes)} hashes for {n_pages} pages"
         )
+    quant = _quant_header(e.dtype, e.k_scales, e.v_scales)
+    if quant is not None and e.k_scales.shape != (nl, n_pages, page, kvh):
+        raise HandoffError(
+            f"scale shape {e.k_scales.shape} does not match pages "
+            f"{(nl, n_pages, page, kvh)}"
+        )
     header = {
         "version": VERSION,
         "dtype": e.dtype,
@@ -254,13 +379,23 @@ def serialize_pages(e: KVPageExport) -> bytes:
         "prefix_hashes": list(e.prefix_hashes),
         "model": e.model,
     }
+    if quant is not None:
+        header["kv_quant"] = quant
     hdr = json.dumps(header).encode()
     k = np.ascontiguousarray(e.k_pages)
     v = np.ascontiguousarray(e.v_pages)
-    return b"".join(
-        [PAGES_MAGIC, struct.pack("<I", len(hdr)), hdr, k.tobytes(),
-         v.tobytes()]
-    )
+    parts = [
+        PAGES_MAGIC, struct.pack("<I", len(hdr)), hdr, k.tobytes(),
+        v.tobytes(),
+    ]
+    if quant is not None:
+        parts.append(
+            np.ascontiguousarray(e.k_scales, _SCALE_DTYPE).tobytes()
+        )
+        parts.append(
+            np.ascontiguousarray(e.v_scales, _SCALE_DTYPE).tobytes()
+        )
+    return b"".join(parts)
 
 
 def deserialize_pages(blob: bytes) -> KVPageExport:
@@ -286,8 +421,10 @@ def deserialize_pages(blob: bytes) -> KVPageExport:
         header["head_dim"],
     )
     count = int(np.prod(shape))
+    quantized = _check_quant_block(header, "page-export")
+    scale_count = int(np.prod(shape[:-1])) if quantized else 0
     body = blob[8 + hdr_len :]
-    expected = 2 * count * dtype.itemsize
+    expected = 2 * count * dtype.itemsize + 2 * scale_count * 4
     if len(body) != expected:
         raise HandoffError(
             f"page-export body is {len(body)} bytes, expected {expected}"
@@ -295,9 +432,19 @@ def deserialize_pages(blob: bytes) -> KVPageExport:
     k = np.frombuffer(body[: count * dtype.itemsize], dtype=dtype).reshape(
         shape
     )
-    v = np.frombuffer(body[count * dtype.itemsize :], dtype=dtype).reshape(
-        shape
-    )
+    v = np.frombuffer(
+        body[count * dtype.itemsize : 2 * count * dtype.itemsize],
+        dtype=dtype,
+    ).reshape(shape)
+    k_scales = v_scales = None
+    if quantized:
+        off = 2 * count * dtype.itemsize
+        k_scales = np.frombuffer(
+            body[off : off + scale_count * 4], dtype=_SCALE_DTYPE
+        ).reshape(shape[:-1])
+        v_scales = np.frombuffer(
+            body[off + scale_count * 4 :], dtype=_SCALE_DTYPE
+        ).reshape(shape[:-1])
     hashes = tuple(header.get("prefix_hashes") or ())
     if len(hashes) != header["n_pages"]:
         raise HandoffError(
@@ -310,4 +457,6 @@ def deserialize_pages(blob: bytes) -> KVPageExport:
         k_pages=k,
         v_pages=v,
         model=str(header.get("model", "")),
+        k_scales=k_scales,
+        v_scales=v_scales,
     )

@@ -124,6 +124,16 @@ class EngineConfig:
     # docs/benchmarks/prefix-aware-load-balancing.md).
     prefix_cache: bool = False
     cache_dtype: Any = jnp.bfloat16
+    # KV-cache quantization (paged mode): "" / "bfloat16" stores pages in
+    # cache_dtype; "int8" stores pages as int8 with per-token-per-head f32
+    # scales riding alongside ({"q8", "scale"} pool leaves — see
+    # ops/kv_quant.py), roughly doubling slot capacity at equal HBM
+    # (2D/(D+4), 1.94x at D=128) and halving every KV byte shipped by
+    # disagg handoff, peer prefix fetch and objstore spill. Quantized
+    # pools always use the reference attention path (the Pallas decode
+    # kernels are bf16-only) and do not compose with speculation, the
+    # fused decode kernel, or pipeline parallelism yet.
+    kv_dtype: str = ""
     # Decode steps fused into one device call (lax.scan). Amortizes host
     # dispatch — critical when the chip sits behind an RPC tunnel. Tokens a
     # request emits past its stop point within a chunk are discarded
@@ -338,6 +348,30 @@ class Engine:
 
         self.decode_kernel = resolve_decode_kernel(cfg.decode_kernel)
 
+        # KV quantization: validated here, materialized in the paged
+        # branch below ({"q8", "scale"} pool leaves; ops/kv_quant.py).
+        from kubeai_tpu.ops.kv_quant import resolve_kv_dtype
+
+        self.kv_dtype = resolve_kv_dtype(cfg.kv_dtype)
+        self._kv_quant = self.kv_dtype == "int8"
+        if self._kv_quant:
+            if self.cache_mode != "paged":
+                raise ValueError(
+                    "kv_dtype='int8' requires cache_mode='paged' (pages "
+                    "are the quantization unit)"
+                )
+            if cfg.speculate > 0 or draft is not None:
+                raise ValueError(
+                    "kv_dtype='int8' does not compose with speculative "
+                    "decoding yet (the verify kernels read bf16 pools)"
+                )
+            if self.decode_kernel == "fused":
+                raise ValueError(
+                    "kv_dtype='int8' does not compose with "
+                    "decode_kernel='fused' (the fused kernel reads a "
+                    "stacked bf16 pool); use per_layer"
+                )
+
         # Pipeline parallelism: stage-local layers + KV over the pp mesh
         # axis (GPipe microbatched decode; see models/llama.py
         # decode_step_paged_pp). Composes with dp AND tp — the pp
@@ -348,6 +382,12 @@ class Engine:
         self._pp = self.mesh.shape.get("pp", 1)
         self._pp_microbatches = 0
         if self._pp > 1:
+            if self._kv_quant:
+                raise ValueError(
+                    "kv_dtype='int8' does not compose with pipeline "
+                    "parallelism yet (the pp shard_map moves raw bf16 "
+                    "pools)"
+                )
             if getattr(self.family, "decode_step_paged_pp", None) is None:
                 raise ValueError(
                     f"family {self.family.name} does not support pipeline "
@@ -435,6 +475,19 @@ class Engine:
                 (psh.LAYERS, None, None, psh.KV_HEADS, None),
                 cache_rules,
             )
+            if self._kv_quant:
+                # Dict pool leaves: int8 pages shard like bf16 pages; the
+                # [NL, pages, page, KVH] scale leaf drops the head_dim
+                # axis. device_put and jit out_shardings both take the
+                # pytree form.
+                pool_sharding = {
+                    "q8": pool_sharding,
+                    "scale": psh.named_sharding(
+                        self.mesh,
+                        (psh.LAYERS, None, None, psh.KV_HEADS),
+                        cache_rules,
+                    ),
+                }
             if n_pages - 1 < max_pages:
                 raise ValueError(
                     f"num_pages={n_pages} cannot hold one max_seq_len "
@@ -449,7 +502,7 @@ class Engine:
                 cfg.max_seq_len,
                 model_cfg.num_kv_heads,
                 model_cfg.head_size,
-                dtype=cfg.cache_dtype,
+                dtype="int8" if self._kv_quant else cfg.cache_dtype,
             )
             self.cache.k_pages = jax.device_put(self.cache.k_pages, pool_sharding)
             self.cache.v_pages = jax.device_put(self.cache.v_pages, pool_sharding)
@@ -984,23 +1037,15 @@ class Engine:
             sequence_page_coords as _seq_coords,
         )
 
-        def _import_handoff(ks, vs, ints, floats, bt_row, kp, vp, bt, state):
-            """Admit a prefilled KV handoff into a slot WITHOUT any
-            prefill compute: scatter the (max_seq_len-padded) imported
-            sequence through the freshly allocated block-table row and
-            set the slot's decode state so the next decode step resumes
-            exactly where the exporting engine's sampler left off.
-            `ints` packs [length, slot, seed, top_k, adapter,
-            first_token]; `floats` packs [temp, top_p]. Positions >=
-            length scatter into the reserved scratch page 0."""
+        def _slot_resume_state(state, ints, floats):
+            """Shared handoff-import state update. `ints` packs [length,
+            slot, seed, top_k, adapter, first_token]; `floats` packs
+            [temp, top_p]."""
             length, slot = ints[0], ints[1]
             seed = ints[2].astype(jnp.uint32)
             topk, adapter, first = ints[3], ints[4], ints[5]
             temp, topp = floats[0], floats[1]
-            page_ids, offsets = _seq_coords(bt_row, length, max_len, page)
-            kp, vp = _scatter_seq(kp, vp, ks, vs, page_ids, offsets)
-            bt = bt.at[slot].set(bt_row)
-            state = dict(
+            return dict(
                 tokens=state["tokens"].at[slot].set(first),
                 positions=state["positions"].at[slot].set(length),
                 seeds=state["seeds"].at[slot].set(seed),
@@ -1009,15 +1054,59 @@ class Engine:
                 topp=state["topp"].at[slot].set(topp),
                 lora_idx=state["lora_idx"].at[slot].set(adapter),
             )
-            return kp, vp, bt, state
 
-        self._import_handoff_jit = jax.jit(
-            _import_handoff,
-            donate_argnums=(5, 6),
-            out_shardings=(
-                pool_sharding, pool_sharding, self._bt_sharding, None,
-            ),
-        )
+        if not self._kv_quant:
+
+            def _import_handoff(
+                ks, vs, ints, floats, bt_row, kp, vp, bt, state
+            ):
+                """Admit a prefilled KV handoff into a slot WITHOUT any
+                prefill compute: scatter the (max_seq_len-padded) imported
+                sequence through the freshly allocated block-table row and
+                set the slot's decode state so the next decode step resumes
+                exactly where the exporting engine's sampler left off.
+                Positions >= length scatter into the reserved scratch
+                page 0."""
+                length = ints[0]
+                page_ids, offsets = _seq_coords(bt_row, length, max_len, page)
+                kp, vp = _scatter_seq(kp, vp, ks, vs, page_ids, offsets)
+                bt = bt.at[ints[1]].set(bt_row)
+                return kp, vp, bt, _slot_resume_state(state, ints, floats)
+
+            self._import_handoff_jit = jax.jit(
+                _import_handoff,
+                donate_argnums=(5, 6),
+                out_shardings=(
+                    pool_sharding, pool_sharding, self._bt_sharding, None,
+                ),
+            )
+        else:
+            from kubeai_tpu.ops.paged_attention import (
+                scatter_sequence_prequantized as _scatter_preq,
+            )
+
+            def _import_handoff_q(
+                k8, ksc, v8, vsc, ints, floats, bt_row, kp, vp, bt, state
+            ):
+                """Quantized handoff import: the wire shipped int8 values
+                + scales, and they scatter VERBATIM — re-quantizing a
+                dequantized copy would round twice and break the
+                byte-identity guarantee the disagg tests assert."""
+                length = ints[0]
+                page_ids, offsets = _seq_coords(bt_row, length, max_len, page)
+                kp, vp = _scatter_preq(
+                    kp, vp, k8, ksc, v8, vsc, page_ids, offsets
+                )
+                bt = bt.at[ints[1]].set(bt_row)
+                return kp, vp, bt, _slot_resume_state(state, ints, floats)
+
+            self._import_handoff_jit = jax.jit(
+                _import_handoff_q,
+                donate_argnums=(7, 8),
+                out_shardings=(
+                    pool_sharding, pool_sharding, self._bt_sharding, None,
+                ),
+            )
 
         if self._spec:
             gamma = self._spec
@@ -1304,10 +1393,28 @@ class Engine:
                     Static shapes: the whole row gathers every call;
                     junk past the cached length is masked by the chunk
                     graph's causal frontier and overwritten by the
-                    suffix compute."""
+                    suffix compute. Quantized pools dequantize into the
+                    (bf16) staging buffers — the resident pages stay
+                    byte-identical; only the staged working copy is
+                    floating point."""
+                    from kubeai_tpu.ops.kv_quant import (
+                        dequantize_kv,
+                        is_quantized_kv,
+                    )
+
                     row = jnp.maximum(bt_row, 0)
-                    gk = kp[:, row]  # [NL, MP, page, KVH, D]
-                    gv = vp[:, row]
+                    if is_quantized_kv(kp):
+                        gk = dequantize_kv(
+                            kp["q8"][:, row], kp["scale"][:, row],
+                            self.cfg.cache_dtype,
+                        )
+                        gv = dequantize_kv(
+                            vp["q8"][:, row], vp["scale"][:, row],
+                            self.cfg.cache_dtype,
+                        )
+                    else:
+                        gk = kp[:, row]  # [NL, MP, page, KVH, D]
+                        gv = vp[:, row]
                     nl, mp, pg, kvh, d = gk.shape
                     ks = gk.reshape(nl, mp * pg, kvh, d)[:, :S]
                     vs = gv.reshape(nl, mp * pg, kvh, d)[:, :S]
@@ -2153,6 +2260,68 @@ class Engine:
 
     # ---- disaggregated serving: KV handoff export / import ------------------
 
+    def _kv_dtype_name(self) -> str:
+        """Wire-format dtype name for KV exports ("int8" for quantized
+        pools — the handoff/page-export headers carry it and importers
+        refuse on mismatch rather than cast)."""
+        return "int8" if self._kv_quant else np.dtype(self.cfg.cache_dtype).name
+
+    def _gather_pages_host(self, pool, idx):
+        """Gather pages[:, idx] to host. Returns (values, scales|None):
+        quantized pools gather both leaves so exports ship the exact
+        resident bytes (never a dequantized copy)."""
+        from kubeai_tpu.ops.kv_quant import is_quantized_kv
+
+        if is_quantized_kv(pool):
+            return (
+                np.asarray(jax.device_get(pool["q8"][:, idx])),
+                np.asarray(jax.device_get(pool["scale"][:, idx])),
+            )
+        return np.asarray(jax.device_get(pool[:, idx])), None
+
+    def _page_wire_nbytes(self) -> int:
+        """Payload bytes of ONE page's K+V on the wire (scales included
+        when quantized) — the unit every kv_share byte counter uses."""
+        mcfg = self.model_cfg
+        ps, kvh, d = self.cfg.page_size, mcfg.num_kv_heads, mcfg.head_size
+        if self._kv_quant:
+            return 2 * mcfg.num_layers * ps * kvh * (d + 4)
+        return (
+            2 * mcfg.num_layers * ps * kvh * d
+            * np.dtype(self.cfg.cache_dtype).itemsize
+        )
+
+    def kv_cache_info(self) -> dict:
+        """KV-cache capacity facts for /v1/state and the metrics plane:
+        dtype, resident pool bytes, and the capacity factor vs a bf16
+        pool at equal HBM (2D/(D+4) under int8 — what lets the
+        autoscaler's KV-utilization signal and the capacity planner's
+        right-sizing see the REAL slot capacity of a quantized replica)."""
+        from kubeai_tpu.ops.kv_quant import kv_capacity_factor
+
+        factor = (
+            kv_capacity_factor(self.model_cfg.head_size)
+            if self._kv_quant else 1.0
+        )
+        info = {
+            "dtype": self._kv_dtype_name(),
+            "quantized": self._kv_quant,
+            "capacity_factor": factor,
+            "slot_capacity": int(self.cfg.num_slots),
+        }
+        if self.cache_mode == "paged":
+            info["num_pages"] = int(self._n_pages)
+            info["page_size"] = int(self.cfg.page_size)
+            info["token_capacity"] = int(
+                (self._n_pages - 1) * self.cfg.page_size
+            )
+            info["pool_bytes"] = int(self.cache.nbytes())
+        else:
+            info["pool_bytes"] = int(
+                self.cache.k.nbytes + self.cache.v.nbytes
+            )
+        return info
+
     def export_handoff(
         self,
         prompt_tokens: list[int],
@@ -2239,11 +2408,11 @@ class Engine:
                 # packed-page blob is position-major by construction.
                 _kv_t0 = time.perf_counter()
                 idx = jnp.asarray(pages, jnp.int32)
-                k_host = np.asarray(
-                    jax.device_get(self.cache.k_pages[:, idx])
+                k_host, k_scales = self._gather_pages_host(
+                    self.cache.k_pages, idx
                 )
-                v_host = np.asarray(
-                    jax.device_get(self.cache.v_pages[:, idx])
+                v_host, v_scales = self._gather_pages_host(
+                    self.cache.v_pages, idx
                 )
                 self.profiler.observe(
                     "kv_transfer", time.perf_counter() - _kv_t0
@@ -2268,9 +2437,11 @@ class Engine:
                 first_token=tok,
                 first_finish=first_finish,
                 page_size=self.cfg.page_size,
-                dtype=np.dtype(self.cfg.cache_dtype).name,
+                dtype=self._kv_dtype_name(),
                 k_pages=k_host,
                 v_pages=v_host,
+                k_scales=k_scales,
+                v_scales=v_scales,
                 seed=seed,
                 temperature=params.temperature,
                 top_k=params.top_k,
@@ -2321,6 +2492,18 @@ class Engine:
         if plen >= self.cfg.max_seq_len:
             raise HandoffError(
                 f"handoff length {plen} >= max_seq_len {self.cfg.max_seq_len}"
+            )
+        expect = self._kv_dtype_name()
+        if handoff.dtype != expect or (
+            self._kv_quant and not handoff.quantized
+        ):
+            # Refuse, never cast: an astype here would silently alter KV
+            # values while the stream still claims token-identity with
+            # the exporting engine.
+            raise HandoffError(
+                f"handoff KV dtype {handoff.dtype!r} != local pool dtype "
+                f"{expect!r}; casting would break token-identity "
+                "(re-export from a matching-dtype prefill pool)"
             )
         params = SamplingParams(
             temperature=handoff.temperature,
@@ -2397,9 +2580,8 @@ class Engine:
             self._set_bt_row(slot, pages)
             # Re-page into THIS pool's layout: flatten to token order,
             # zero-pad to max_seq_len (the scatter's static shape) and
-            # push through the import graph. Values are copied bit-exact;
-            # a dtype mismatch casts (and is caught by tests that assert
-            # token identity across matching-dtype pools).
+            # push through the import graph. Values are copied bit-exact
+            # (a dtype mismatch was refused above, never cast).
             _kv_t0 = time.perf_counter()
             k_seq, v_seq = handoff.contiguous_kv()
             pad = np.zeros(
@@ -2408,6 +2590,14 @@ class Engine:
             k_pad, v_pad = pad.copy(), pad
             k_pad[:, :plen] = k_seq
             v_pad[:, :plen] = v_seq
+            if self._kv_quant:
+                ks_seq, vs_seq = handoff.contiguous_scales()
+                spad = np.zeros(
+                    (nl, self.cfg.max_seq_len, kvh), np.float32
+                )
+                ks_pad, vs_pad = spad.copy(), spad
+                ks_pad[:, :plen] = ks_seq
+                vs_pad[:, :plen] = vs_seq
             ints = jnp.asarray(
                 [
                     plen,
@@ -2422,22 +2612,42 @@ class Engine:
             floats = jnp.asarray(
                 [params.temperature, params.top_p], jnp.float32
             )
-            (
-                self.cache.k_pages,
-                self.cache.v_pages,
-                self.cache.block_tables,
-                self._state,
-            ) = self._import_handoff_jit(
-                jnp.asarray(k_pad, self.cfg.cache_dtype),
-                jnp.asarray(v_pad, self.cfg.cache_dtype),
-                ints,
-                floats,
-                jnp.asarray(self._bt_host[slot]),
-                self.cache.k_pages,
-                self.cache.v_pages,
-                self.cache.block_tables,
-                self._state,
-            )
+            if self._kv_quant:
+                (
+                    self.cache.k_pages,
+                    self.cache.v_pages,
+                    self.cache.block_tables,
+                    self._state,
+                ) = self._import_handoff_jit(
+                    jnp.asarray(k_pad, jnp.int8),
+                    jnp.asarray(ks_pad, jnp.float32),
+                    jnp.asarray(v_pad, jnp.int8),
+                    jnp.asarray(vs_pad, jnp.float32),
+                    ints,
+                    floats,
+                    jnp.asarray(self._bt_host[slot]),
+                    self.cache.k_pages,
+                    self.cache.v_pages,
+                    self.cache.block_tables,
+                    self._state,
+                )
+            else:
+                (
+                    self.cache.k_pages,
+                    self.cache.v_pages,
+                    self.cache.block_tables,
+                    self._state,
+                ) = self._import_handoff_jit(
+                    jnp.asarray(k_pad, self.cfg.cache_dtype),
+                    jnp.asarray(v_pad, self.cfg.cache_dtype),
+                    ints,
+                    floats,
+                    jnp.asarray(self._bt_host[slot]),
+                    self.cache.k_pages,
+                    self.cache.v_pages,
+                    self.cache.block_tables,
+                    self._state,
+                )
             self.profiler.observe(
                 "kv_transfer", time.perf_counter() - _kv_t0
             )
@@ -2508,34 +2718,44 @@ class Engine:
             return None
         mcfg = self.model_cfg
         ps = self.cfg.page_size
-        dtype = np.dtype(self.cfg.cache_dtype)
-        page_nbytes = (
-            2 * mcfg.num_layers * ps * mcfg.num_kv_heads * mcfg.head_size
-            * dtype.itemsize
-        )
+        page_nbytes = self._page_wire_nbytes()
         with self._lock:
             pages = self._alloc.lookup(hashes)
             if max_bytes > 0:
                 pages = pages[: max_bytes // page_nbytes]
             n = len(pages)
+            k_scales = v_scales = None
             if n:
                 idx = jnp.asarray(pages, jnp.int32)
-                k_host = np.asarray(jax.device_get(self.cache.k_pages[:, idx]))
-                v_host = np.asarray(jax.device_get(self.cache.v_pages[:, idx]))
+                k_host, k_scales = self._gather_pages_host(
+                    self.cache.k_pages, idx
+                )
+                v_host, v_scales = self._gather_pages_host(
+                    self.cache.v_pages, idx
+                )
             else:
                 shape = (
                     mcfg.num_layers, 0, ps, mcfg.num_kv_heads, mcfg.head_size,
                 )
-                k_host = np.zeros(shape, dtype)
-                v_host = np.zeros(shape, dtype)
+                if self._kv_quant:
+                    k_host = np.zeros(shape, np.int8)
+                    v_host = np.zeros(shape, np.int8)
+                    k_scales = np.zeros(shape[:-1], np.float32)
+                    v_scales = np.zeros(shape[:-1], np.float32)
+                else:
+                    dtype = np.dtype(self.cfg.cache_dtype)
+                    k_host = np.zeros(shape, dtype)
+                    v_host = np.zeros(shape, dtype)
             self.kv_share_stats["exported_pages"] += n
             self.kv_share_stats["exported_bytes"] += n * page_nbytes
         return KVPageExport(
             prefix_hashes=tuple(hashes_hex[:n]),
             page_size=ps,
-            dtype=dtype.name,
+            dtype=self._kv_dtype_name(),
             k_pages=k_host,
             v_pages=v_host,
+            k_scales=k_scales,
+            v_scales=v_scales,
         )
 
     def import_prefix_pages(self, export, source: str = "peer") -> int:
@@ -2569,10 +2789,12 @@ class Engine:
                 f"page size {page} != local {self.cfg.page_size} (chain "
                 "hashes are page-size-dependent; no re-paging is possible)"
             )
-        if export.dtype != np.dtype(self.cfg.cache_dtype).name:
+        if export.dtype != self._kv_dtype_name() or (
+            self._kv_quant and not export.quantized
+        ):
             raise HandoffError(
                 f"KV dtype {export.dtype} != local cache dtype "
-                f"{np.dtype(self.cfg.cache_dtype).name}; casting would "
+                f"{self._kv_dtype_name()}; casting would "
                 "break token-identity"
             )
         try:
@@ -2586,24 +2808,60 @@ class Engine:
             write = [(i, p) for i, p in enumerate(seeded) if p is not None]
             if write:
                 idx = jnp.asarray([p for _, p in write], jnp.int32)
-                src = np.ascontiguousarray(
-                    export.k_pages[:, [i for i, _ in write]]
-                )
-                self.cache.k_pages = self.cache.k_pages.at[:, idx].set(
-                    jnp.asarray(src, self.cfg.cache_dtype)
-                )
-                src = np.ascontiguousarray(
-                    export.v_pages[:, [i for i, _ in write]]
-                )
-                self.cache.v_pages = self.cache.v_pages.at[:, idx].set(
-                    jnp.asarray(src, self.cfg.cache_dtype)
-                )
+                cols = [i for i, _ in write]
+                if self._kv_quant:
+                    # Verbatim int8 + scale writes — the chain hash
+                    # vouches for these exact quantized bytes.
+                    self.cache.k_pages = {
+                        "q8": self.cache.k_pages["q8"].at[:, idx].set(
+                            jnp.asarray(
+                                np.ascontiguousarray(
+                                    export.k_pages[:, cols]
+                                ),
+                                jnp.int8,
+                            )
+                        ),
+                        "scale": self.cache.k_pages["scale"].at[:, idx].set(
+                            jnp.asarray(
+                                np.ascontiguousarray(
+                                    export.k_scales[:, cols]
+                                ),
+                                jnp.float32,
+                            )
+                        ),
+                    }
+                    self.cache.v_pages = {
+                        "q8": self.cache.v_pages["q8"].at[:, idx].set(
+                            jnp.asarray(
+                                np.ascontiguousarray(
+                                    export.v_pages[:, cols]
+                                ),
+                                jnp.int8,
+                            )
+                        ),
+                        "scale": self.cache.v_pages["scale"].at[:, idx].set(
+                            jnp.asarray(
+                                np.ascontiguousarray(
+                                    export.v_scales[:, cols]
+                                ),
+                                jnp.float32,
+                            )
+                        ),
+                    }
+                else:
+                    src = np.ascontiguousarray(export.k_pages[:, cols])
+                    self.cache.k_pages = self.cache.k_pages.at[:, idx].set(
+                        jnp.asarray(src, self.cfg.cache_dtype)
+                    )
+                    src = np.ascontiguousarray(export.v_pages[:, cols])
+                    self.cache.v_pages = self.cache.v_pages.at[:, idx].set(
+                        jnp.asarray(src, self.cfg.cache_dtype)
+                    )
             key = "imported_pages" if source == "peer" else "filled_pages"
             self.kv_share_stats[key] += len(write)
             if source == "peer":
                 self.kv_share_stats["imported_bytes"] += (
-                    len(write) * 2 * nl * page * kvh * d
-                    * np.dtype(self.cfg.cache_dtype).itemsize
+                    len(write) * self._page_wire_nbytes()
                 )
             return len(write)
 
@@ -2618,15 +2876,17 @@ class Engine:
 
         def _spill(page: int, h: bytes) -> None:
             idx = jnp.asarray([page], jnp.int32)
-            k = np.asarray(jax.device_get(self.cache.k_pages[:, idx]))
-            v = np.asarray(jax.device_get(self.cache.v_pages[:, idx]))
+            k, k_scales = self._gather_pages_host(self.cache.k_pages, idx)
+            v, v_scales = self._gather_pages_host(self.cache.v_pages, idx)
             blob = serialize_pages(
                 KVPageExport(
                     prefix_hashes=(h.hex(),),
                     page_size=self.cfg.page_size,
-                    dtype=np.dtype(self.cfg.cache_dtype).name,
+                    dtype=self._kv_dtype_name(),
                     k_pages=k,
                     v_pages=v,
+                    k_scales=k_scales,
+                    v_scales=v_scales,
                 )
             )
             store.put(h.hex(), blob)
@@ -2882,8 +3142,12 @@ class Engine:
         """Emit each slot's accepted+corrected tokens (1..γ+1 per step).
         A stop mid-window discards the remainder, like chunk surplus."""
         _sync_t0 = time.perf_counter()
-        choices = np.asarray(jax.device_get(choices))  # [B, γ+1]
-        n_emit = np.asarray(jax.device_get(n_emit))  # [B]
+        # ONE fused transfer for both outputs: two sequential device_get
+        # calls would pay the host round trip twice per verify step and
+        # charge host_sync for both (a profiler test pins this to one).
+        choices, n_emit = jax.device_get((choices, n_emit))
+        choices = np.asarray(choices)  # [B, γ+1]
+        n_emit = np.asarray(n_emit)  # [B]
         self._note_phase("host_sync", time.perf_counter() - _sync_t0)
         _sample_t0 = time.perf_counter()
         emitted: list[StepEvent] = []

@@ -38,21 +38,44 @@ class OutOfPages(RuntimeError):
 
 @dataclasses.dataclass
 class PagedKVCache:
-    k_pages: jax.Array  # [NL, n_pages, page, KVH, D]
-    v_pages: jax.Array
+    # A pool is either a plain [NL, n_pages, page, KVH, D] array or an
+    # int8-quantized {"q8": int8 pages, "scale": f32 [NL, n_pages, page,
+    # KVH]} dict (ops/kv_quant.py) — the same leaf-dispatch idiom the
+    # weight quantizer uses, so jit plumbing and layer scans carry both
+    # unchanged.
+    k_pages: jax.Array | dict  # [NL, n_pages, page, KVH, D]
+    v_pages: jax.Array | dict
     block_tables: jax.Array  # [slots, max_pages] int32, -1 = unallocated
 
     @property
+    def quantized(self) -> bool:
+        from kubeai_tpu.ops.kv_quant import is_quantized_kv
+
+        return is_quantized_kv(self.k_pages)
+
+    @property
+    def pages_shape(self) -> tuple:
+        from kubeai_tpu.ops.kv_quant import kv_pages_shape
+
+        return kv_pages_shape(self.k_pages)
+
+    @property
     def page_size(self) -> int:
-        return self.k_pages.shape[2]
+        return self.pages_shape[2]
 
     @property
     def num_pages(self) -> int:
-        return self.k_pages.shape[1]
+        return self.pages_shape[1]
 
     @property
     def max_pages_per_slot(self) -> int:
         return self.block_tables.shape[1]
+
+    def nbytes(self) -> int:
+        """Resident pool bytes (pages + scales when quantized)."""
+        from kubeai_tpu.ops.kv_quant import kv_pool_nbytes
+
+        return kv_pool_nbytes(self.k_pages) + kv_pool_nbytes(self.v_pages)
 
     @staticmethod
     def create(
@@ -65,11 +88,19 @@ class PagedKVCache:
         head_dim: int,
         dtype=jnp.bfloat16,
     ) -> "PagedKVCache":
+        from kubeai_tpu.ops.kv_quant import make_quantized_pool
+
         max_pages = -(-max_seq_len // page_size)
         shape = (num_layers, num_pages, page_size, kv_heads, head_dim)
+        if dtype in (jnp.int8, "int8"):
+            k_pages = make_quantized_pool(shape)
+            v_pages = make_quantized_pool(shape)
+        else:
+            k_pages = jnp.zeros(shape, dtype)
+            v_pages = jnp.zeros(shape, dtype)
         return PagedKVCache(
-            k_pages=jnp.zeros(shape, dtype),
-            v_pages=jnp.zeros(shape, dtype),
+            k_pages=k_pages,
+            v_pages=v_pages,
             block_tables=jnp.full((num_slots, max_pages), -1, jnp.int32),
         )
 
@@ -313,9 +344,19 @@ def gather_slot_kv(cache: PagedKVCache) -> tuple[jax.Array, jax.Array]:
     This is the functional reference; the paged-attention kernel reads
     pages in place and never materializes this view.
     """
+    from kubeai_tpu.ops.kv_quant import dequantize_kv
+
     bt = jnp.maximum(cache.block_tables, 0)  # -1 -> reserved scratch page 0
-    k = cache.k_pages[:, bt]  # [NL, slots, max_pages, page, KVH, D]
-    v = cache.v_pages[:, bt]
+    if cache.quantized:
+        k = dequantize_kv(
+            cache.k_pages["q8"][:, bt], cache.k_pages["scale"][:, bt]
+        )
+        v = dequantize_kv(
+            cache.v_pages["q8"][:, bt], cache.v_pages["scale"][:, bt]
+        )
+    else:
+        k = cache.k_pages[:, bt]  # [NL, slots, max_pages, page, KVH, D]
+        v = cache.v_pages[:, bt]
     nl, slots, mp, page, kvh, d = k.shape
     return (
         k.reshape(nl, slots, mp * page, kvh, d),
@@ -330,6 +371,8 @@ def scatter_token(
     positions: jax.Array,  # [slots] absolute position of the token
 ) -> PagedKVCache:
     """Write one token per slot through the block tables (decode step)."""
+    from kubeai_tpu.ops.kv_quant import quantize_kv
+
     page = cache.page_size
     slot_idx = jnp.arange(cache.block_tables.shape[0])
     page_ids = cache.block_tables[slot_idx, positions // page]  # [slots]
@@ -337,6 +380,18 @@ def scatter_token(
     # because the allocator never hands page 0 to a live sequence.
     page_ids = jnp.maximum(page_ids, 0)
     offsets = positions % page
+    if cache.quantized:
+        k8, ks = quantize_kv(k_new)
+        v8, vs = quantize_kv(v_new)
+        k_pages = {
+            "q8": cache.k_pages["q8"].at[:, page_ids, offsets].set(k8),
+            "scale": cache.k_pages["scale"].at[:, page_ids, offsets].set(ks),
+        }
+        v_pages = {
+            "q8": cache.v_pages["q8"].at[:, page_ids, offsets].set(v8),
+            "scale": cache.v_pages["scale"].at[:, page_ids, offsets].set(vs),
+        }
+        return PagedKVCache(k_pages, v_pages, cache.block_tables)
     k_pages = cache.k_pages.at[:, page_ids, offsets].set(
         k_new.astype(cache.k_pages.dtype)
     )
@@ -354,6 +409,8 @@ def insert_sequence(
     length: int,
 ) -> PagedKVCache:
     """Write a prefilled sequence through slot's block table (admission)."""
+    from kubeai_tpu.ops.kv_quant import quantize_kv
+
     page = cache.page_size
     bt = cache.block_tables
     k_pages, v_pages = cache.k_pages, cache.v_pages
@@ -363,10 +420,20 @@ def insert_sequence(
         pid = jnp.maximum(pid, 0)
         start = p * page
         count = min(page, length - start)
-        k_pages = k_pages.at[:, pid, :count].set(
-            k_seq[:, start : start + count].astype(k_pages.dtype)
-        )
-        v_pages = v_pages.at[:, pid, :count].set(
-            v_seq[:, start : start + count].astype(v_pages.dtype)
-        )
+        ks = k_seq[:, start : start + count]
+        vs = v_seq[:, start : start + count]
+        if cache.quantized:
+            k8, ksc = quantize_kv(ks)
+            v8, vsc = quantize_kv(vs)
+            k_pages = {
+                "q8": k_pages["q8"].at[:, pid, :count].set(k8),
+                "scale": k_pages["scale"].at[:, pid, :count].set(ksc),
+            }
+            v_pages = {
+                "q8": v_pages["q8"].at[:, pid, :count].set(v8),
+                "scale": v_pages["scale"].at[:, pid, :count].set(vsc),
+            }
+        else:
+            k_pages = k_pages.at[:, pid, :count].set(ks.astype(k_pages.dtype))
+            v_pages = v_pages.at[:, pid, :count].set(vs.astype(v_pages.dtype))
     return PagedKVCache(k_pages, v_pages, bt)

@@ -244,6 +244,27 @@ class EngineMetrics:
             "pool, or token positions / slot capacity).",
             self.registry,
         )
+        self.kv_cache_bytes = Gauge(
+            "kubeai_engine_kv_cache_bytes",
+            "Resident bytes of the KV-cache pool (pages + quantization "
+            "scales) — int8 pools report roughly half a bf16 pool of "
+            "equal token capacity.",
+            self.registry,
+        )
+        self.kv_quant_enabled = Gauge(
+            "kubeai_engine_kv_quant_enabled",
+            "1 when the paged KV cache stores int8 quantized pages "
+            "(kv_dtype=int8), else 0.",
+            self.registry,
+        )
+        self.kv_quant_capacity_factor = Gauge(
+            "kubeai_engine_kv_quant_capacity_factor",
+            "Slot-capacity multiplier of the configured KV dtype vs bf16 "
+            "at equal HBM budget (2D/(D+4) under int8, 1.0 under bf16) — "
+            "what the autoscaler and capacity planner scale the replica's "
+            "effective KV capacity by.",
+            self.registry,
+        )
         self.tokens_per_step = Gauge(
             "kubeai_engine_tokens_per_step",
             "Tokens emitted by the last engine step (all requests).",
@@ -406,6 +427,15 @@ class EngineMetrics:
         slots = getattr(getattr(inner, "cfg", None), "num_slots", None)
         if slots is not None:
             self.slot_capacity.set(slots)
+        kv_info = snap.get("kv_cache") or {}
+        if kv_info:
+            self.kv_cache_bytes.set(kv_info.get("pool_bytes", 0))
+            self.kv_quant_enabled.set(
+                1.0 if kv_info.get("quantized") else 0.0
+            )
+            self.kv_quant_capacity_factor.set(
+                kv_info.get("capacity_factor", 1.0)
+            )
         drain = getattr(inner, "drain_timing", None)
         if drain is not None:
             for kind, seconds in drain():
@@ -445,10 +475,15 @@ def engine_state_snapshot(engine) -> dict:
     inner = getattr(engine, "inner", engine)  # LockstepEngine proxies
     kvu = getattr(inner, "kv_utilization", None)
     sched = getattr(inner, "scheduler", None)
+    kv_info = getattr(inner, "kv_cache_info", None)
     return {
         "slots_active": engine.num_active,
         "requests_pending": engine.num_pending,
         "kv_utilization": kvu() if kvu is not None else 0.0,
+        # KV dtype / capacity block: quantized replicas advertise their
+        # capacity factor here so the autoscaler and capacity planner
+        # size against REAL capacity, not the bf16 assumption.
+        "kv_cache": kv_info() if kv_info is not None else {},
         "last_step": dict(getattr(inner, "last_step_stats", {}) or {}),
         "spec_stats": dict(getattr(inner, "spec_stats", {}) or {}),
         "prefix_stats": dict(getattr(inner, "prefix_stats", {}) or {}),
@@ -2269,6 +2304,13 @@ def main(argv=None) -> int:
     ap.add_argument("--decode-chunk", type=int, default=8)
     ap.add_argument("--quantization", default="", choices=["", "int8"])
     ap.add_argument(
+        "--kv-dtype", default="", choices=["", "bfloat16", "int8"],
+        help="paged KV-cache storage dtype; int8 stores quantized pages "
+        "with per-token-per-head scales (~2x slot capacity at equal "
+        "HBM, half the KV bytes on every handoff/fetch/spill) "
+        "(CRD kvCache.dtype)",
+    )
+    ap.add_argument(
         "--pipeline", action="store_true",
         help="overlap decode chunks with host processing (direct PJRT targets)",
     )
@@ -2500,6 +2542,7 @@ def main(argv=None) -> int:
             decode_chunk=args.decode_chunk,
             pipeline=args.pipeline,
             quantization=args.quantization,
+            kv_dtype=args.kv_dtype,
             speculate=args.speculate,
             spec_adaptive=args.spec_adaptive == "on",
             prefill_chunk=args.prefill_chunk,

@@ -519,6 +519,7 @@ def decode_step_paged(
 
     Both layouts share _decode_layer_qkv/_decode_layer_finish, so the
     projection/LoRA/MLP math cannot drift between them."""
+    from kubeai_tpu.ops.kv_quant import is_quantized_kv, kv_pages_shape
     from kubeai_tpu.ops.paged_attention import (
         batched_scatter_sequence,
         paged_decode_attention_fused,
@@ -527,6 +528,11 @@ def decode_step_paged(
     )
 
     attn_kernel = resolve_decode_kernel(attn_kernel)
+    if is_quantized_kv(k_pages) and attn_kernel != "per_layer":
+        raise ValueError(
+            "quantized KV pools require attn_kernel='per_layer' (the "
+            "fused kernel reads a raw bf16 pool)"
+        )
     inv_freq = jnp.asarray(
         rope_frequencies(
             cfg.head_size, cfg.rope_theta, cfg.rope_scaling,
@@ -534,7 +540,7 @@ def decode_step_paged(
         )
     )
     msc = rope_attention_scaling(cfg.rope_scaling)
-    page_size = k_pages.shape[2]
+    page_size = kv_pages_shape(k_pages)[2]
     x = params["embed"][tokens]  # [B, E]
     page_ids, offsets = token_page_coords(block_tables, positions, page_size)
     pos1 = positions[:, None]

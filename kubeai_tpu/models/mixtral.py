@@ -255,10 +255,17 @@ def decode_step_paged(params, cfg, tokens, positions, k_pages, v_pages,
         token_page_coords,
     )
 
+    from kubeai_tpu.ops.kv_quant import is_quantized_kv, kv_pages_shape
+
     attn_kernel = resolve_decode_kernel(attn_kernel)
+    if is_quantized_kv(k_pages) and attn_kernel != "per_layer":
+        raise ValueError(
+            "quantized KV pools require attn_kernel='per_layer' (the "
+            "fused kernel reads a raw bf16 pool)"
+        )
     B = tokens.shape[0]
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_size
-    page_size = k_pages.shape[2]
+    page_size = kv_pages_shape(k_pages)[2]
     inv_freq = jnp.asarray(rope_frequencies(D, cfg.rope_theta))
     x = params["embed"][tokens]
     pos1 = positions[:, None]

@@ -113,6 +113,10 @@ def kubeai_tpu_pod(
             args += ["--max-transfer-mb", str(kvs.max_transfer_mb)]
         if kvs.spill_url:
             args += ["--kv-spill-url", kvs.spill_url]
+    # KV-cache storage dtype (CRD kvCache: block): int8 halves resident
+    # KV bytes (~2x slot capacity at equal HBM) and every KV transfer.
+    if model.spec.kv_cache.enabled():
+        args += ["--kv-dtype", model.spec.kv_cache.dtype]
     # Adapters are NOT baked into the spec: they hot-swap through the
     # /v1/load_lora_adapter admin API (see operator/adapters.py), so adapter
     # changes never trigger a pod rollout.

@@ -20,6 +20,13 @@ actually occupies. Two implementations with one contract:
 Sliding-window (Gemma-2) and logit softcap are supported in both paths:
 window masks keys at positions < length - window.
 
+Int8 KV (ops/kv_quant.py): a pool passed as a {"q8", "scale"} dict is
+a quantized pool. The reference path gathers pages AND scales through
+the block tables and dequantizes in f32 before attention; the write
+helpers quantize each new token's rows on append. The Pallas kernels
+are bf16-only, so quantized pools always dispatch to the reference path
+(int8 KV buys capacity, not kernel speed — see kv_quant module docs).
+
 The reference operator has no attention code — it runs vLLM images whose
 PagedAttention this replaces TPU-natively (reference:
 internal/modelcontroller/engine_vllm.go:12-167 renders the Pod; kernels
@@ -121,19 +128,30 @@ def ref_paged_decode_attention(
     #   local/global layers with one compiled graph
 ) -> jnp.ndarray:
     """Gather pages into a virtual contiguous view, then masked attention.
-    Semantics oracle for the kernel; CPU/test fallback path."""
+    Semantics oracle for the kernel; CPU/test fallback path. Accepts
+    quantized {"q8", "scale"} pools — pages and scales gather through
+    the same block tables and dequantize in f32."""
+    from kubeai_tpu.ops.kv_quant import is_quantized_kv
+
     b, h, d = q.shape
-    kvh = k_pages.shape[2]
     bt = jnp.maximum(block_tables, 0)  # -1 -> scratch page 0 (masked below)
-    k = k_pages[bt]  # [B, MP, page, KVH, D]
-    v = v_pages[bt]
+    if is_quantized_kv(k_pages):
+        kvh = k_pages["q8"].shape[2]
+        k = k_pages["q8"][bt].astype(jnp.float32)  # [B, MP, page, KVH, D]
+        v = v_pages["q8"][bt].astype(jnp.float32)
+        k = k * k_pages["scale"][bt].astype(jnp.float32)[..., None]
+        v = v * v_pages["scale"][bt].astype(jnp.float32)[..., None]
+    else:
+        kvh = k_pages.shape[2]
+        k = k_pages[bt].astype(jnp.float32)
+        v = v_pages[bt].astype(jnp.float32)
     mp, page = k.shape[1], k.shape[2]
     k = k.reshape(b, mp * page, kvh, d)
     v = v.reshape(b, mp * page, kvh, d)
     scale = scale if scale is not None else d ** -0.5
     qg = (q * scale).reshape(b, kvh, h // kvh, d)
     logits = jnp.einsum(
-        "bkgd,blkd->bkgl", qg.astype(jnp.float32), k.astype(jnp.float32)
+        "bkgd,blkd->bkgl", qg.astype(jnp.float32), k
     )
     if logit_softcap is not None:
         logits = jnp.tanh(logits / logit_softcap) * logit_softcap
@@ -146,7 +164,7 @@ def ref_paged_decode_attention(
         )
     logits = jnp.where(mask[:, None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bkgl,blkd->bkgd", probs, v.astype(jnp.float32))
+    out = jnp.einsum("bkgl,blkd->bkgd", probs, v)
     return out.reshape(b, h, d).astype(q.dtype)
 
 
@@ -320,10 +338,19 @@ def paged_decode_attention(
     use_pallas: bool | None = None,  # None = auto (TPU backend only)
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Paged decode attention with automatic kernel/reference dispatch."""
+    """Paged decode attention with automatic kernel/reference dispatch.
+    Quantized {"q8", "scale"} pools always take the reference path (the
+    Pallas kernel is bf16-only)."""
+    from kubeai_tpu.ops.kv_quant import is_quantized_kv
+
     b, h, d = q.shape
-    kvh = k_pages.shape[2]
     scale = scale if scale is not None else d ** -0.5
+    if is_quantized_kv(k_pages):
+        return ref_paged_decode_attention(
+            q, k_pages, v_pages, block_tables, lengths,
+            scale=scale, logit_softcap=logit_softcap, window=window,
+        )
+    kvh = k_pages.shape[2]
     if use_pallas is None:
         use_pallas = (
             _HAS_PLTPU
@@ -961,7 +988,24 @@ def scatter_decode_token(
     page_ids: jnp.ndarray,  # [B]
     offsets: jnp.ndarray,  # [B]
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Write one token per slot through the block tables (decode step)."""
+    """Write one token per slot through the block tables (decode step).
+    Quantized pools quantize-on-append: each new row gets its own scale,
+    so resident tokens are never re-scaled (pages stay immutable)."""
+    from kubeai_tpu.ops.kv_quant import is_quantized_kv, quantize_kv
+
+    if is_quantized_kv(k_pages):
+        k8, ks = quantize_kv(k_new)
+        v8, vs = quantize_kv(v_new)
+        return (
+            {
+                "q8": k_pages["q8"].at[page_ids, offsets].set(k8),
+                "scale": k_pages["scale"].at[page_ids, offsets].set(ks),
+            },
+            {
+                "q8": v_pages["q8"].at[page_ids, offsets].set(v8),
+                "scale": v_pages["scale"].at[page_ids, offsets].set(vs),
+            },
+        )
     k_pages = k_pages.at[page_ids, offsets].set(k_new.astype(k_pages.dtype))
     v_pages = v_pages.at[page_ids, offsets].set(v_new.astype(v_pages.dtype))
     return k_pages, v_pages
@@ -991,7 +1035,23 @@ def batched_scatter_sequence(
     offsets: jnp.ndarray,  # [A, S]
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Write A prefilled sequences through their block tables in one
-    static-shape scatter (batched admission)."""
+    static-shape scatter (batched admission). Quantized pools quantize
+    each token row on the way in (prefill output is bf16)."""
+    from kubeai_tpu.ops.kv_quant import is_quantized_kv, quantize_kv
+
+    if is_quantized_kv(k_pages):
+        k8, ks = quantize_kv(k_seq)
+        v8, vs = quantize_kv(v_seq)
+        return (
+            {
+                "q8": k_pages["q8"].at[:, page_ids, offsets].set(k8),
+                "scale": k_pages["scale"].at[:, page_ids, offsets].set(ks),
+            },
+            {
+                "q8": v_pages["q8"].at[:, page_ids, offsets].set(v8),
+                "scale": v_pages["scale"].at[:, page_ids, offsets].set(vs),
+            },
+        )
     k_pages = k_pages.at[:, page_ids, offsets].set(
         k_seq.astype(k_pages.dtype)
     )
@@ -1026,4 +1086,29 @@ def scatter_sequence(
     return batched_scatter_sequence(
         k_pages, v_pages, k_seq[:, None], v_seq[:, None],
         page_ids[None], offsets[None],
+    )
+
+
+def scatter_sequence_prequantized(
+    k_pages: dict,  # quantized pools {"q8", "scale"}
+    v_pages: dict,
+    k8_seq: jnp.ndarray,  # [NL, S, KVH, D] int8 — wire bytes, verbatim
+    ks_seq: jnp.ndarray,  # [NL, S, KVH] f32 scales
+    v8_seq: jnp.ndarray,
+    vs_seq: jnp.ndarray,
+    page_ids: jnp.ndarray,  # [S]
+    offsets: jnp.ndarray,  # [S]
+) -> tuple[dict, dict]:
+    """Scatter ALREADY-QUANTIZED rows (a KV handoff import): the int8
+    values and their scales pass through untouched — re-quantizing would
+    break the byte-identity a quantized handoff round-trip guarantees."""
+    return (
+        {
+            "q8": k_pages["q8"].at[:, page_ids, offsets].set(k8_seq),
+            "scale": k_pages["scale"].at[:, page_ids, offsets].set(ks_seq),
+        },
+        {
+            "q8": v_pages["q8"].at[:, page_ids, offsets].set(v8_seq),
+            "scale": v_pages["scale"].at[:, page_ids, offsets].set(vs_seq),
+        },
     )

@@ -47,7 +47,10 @@ def registered_metric_names() -> set[str]:
     """Every kubeai_* metric the codebase can register: the two live
     instrument bundles (instantiated, so computed names are real) plus a
     static scan for instruments declared outside any bundle (e.g. the
-    whisper transcription server's per-instance counters)."""
+    whisper transcription server's per-instance counters). benchmarks/
+    is scanned too: the sims expose real-named gauges/histograms (e.g.
+    kv_quant_sim's capacity and step-phase series), and those names must
+    stay catalogued like any other exposition surface."""
     sys.path.insert(0, REPO_ROOT)
     from kubeai_tpu.engine.server import EngineMetrics
     from kubeai_tpu.metrics.registry import Metrics
@@ -56,13 +59,13 @@ def registered_metric_names() -> set[str]:
     for reg in (Metrics().registry, EngineMetrics().registry):
         for m in reg.metrics:
             names.add(m.name)
-    pkg = os.path.join(REPO_ROOT, "kubeai_tpu")
-    for root, _dirs, files in os.walk(pkg):
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            with open(os.path.join(root, fname)) as f:
-                names.update(_DECL_RE.findall(f.read()))
+    for pkg in ("kubeai_tpu", "benchmarks"):
+        for root, _dirs, files in os.walk(os.path.join(REPO_ROOT, pkg)):
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                with open(os.path.join(root, fname)) as f:
+                    names.update(_DECL_RE.findall(f.read()))
     return names
 
 

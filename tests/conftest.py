@@ -91,6 +91,13 @@ def pytest_configure(config):
         "spill/fill, token-identity, fake-clock fleet sim (runs in the "
         "fast tier; select with -m kvshare)",
     )
+    config.addinivalue_line(
+        "markers",
+        "kvquant: quantized (int8) paged-KV cache suite — quantize-on-"
+        "append/dequantize-on-read, greedy token identity vs bf16, "
+        "wire byte-identity and dtype-mismatch refusal, capacity/bytes "
+        "sim (runs in the fast tier; select with -m kvquant)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,86 @@
+"""Tier-1 gate on the deterministic int8-KV capacity/bytes sim: the
+quantized tier's capacity claim (>= 1.9x tokens and slots at equal HBM,
+at the D=128 geometry the feature targets), its wire claim (strictly
+fewer bytes than bf16 in every transfer category, byte-identical
+round-trips), its decode-phase non-regression, and the planner
+consequence (the int8 replica fits a chip budget the bf16 replica's
+KV-utilization signal overflows) hold on every run — and the sim itself
+is deterministic."""
+
+import pytest
+
+from benchmarks.kv_quant_sim import (
+    CAPACITY_FACTOR,
+    HEAD_DIM,
+    check_invariants,
+    run_sim,
+)
+
+pytestmark = pytest.mark.kvquant
+
+
+@pytest.fixture(scope="module")
+def summary():
+    return run_sim()
+
+
+def test_all_invariants_hold(summary):
+    assert check_invariants(summary) == []
+
+
+def test_capacity_factor_matches_real_helper():
+    # The sim stays JAX-free, so its 2D/(D+4) constant is pinned here to
+    # the op-layer helper the engine actually reports from.
+    from kubeai_tpu.ops.kv_quant import kv_capacity_factor
+
+    assert CAPACITY_FACTOR == pytest.approx(kv_capacity_factor(HEAD_DIM))
+    assert CAPACITY_FACTOR > 1.9
+
+
+def test_capacity_doubles_at_equal_hbm(summary):
+    bf = summary["capacity"]["bfloat16"]
+    q8 = summary["capacity"]["int8"]
+    assert q8["token_capacity"] >= 1.9 * bf["token_capacity"]
+    assert q8["slot_capacity"] >= 1.9 * bf["slot_capacity"]
+    # Equal budget on both arms — the ratio is capacity, not spend.
+    budget = summary["geometry"]["hbm_kv_budget_bytes"]
+    assert bf["pool_bytes"] <= budget and q8["pool_bytes"] <= budget
+
+
+def test_int8_ships_strictly_fewer_wire_bytes(summary):
+    bf = summary["wire"]["bfloat16"]
+    q8 = summary["wire"]["int8"]
+    assert bf["events"] == q8["events"]  # identical trace
+    for kind in ("handoff", "fetch", "spill"):
+        assert q8["events"][kind] > 0  # contrast: category exercised
+        assert q8["bytes"][kind] < bf["bytes"][kind], kind
+    assert q8["roundtrip_byte_identical"]
+    assert bf["roundtrip_byte_identical"]
+
+
+def test_no_decode_phase_regression(summary):
+    bf = summary["decode_phases"]["bfloat16"]
+    q8 = summary["decode_phases"]["int8"]
+    assert bf["steps"] == q8["steps"] > 0
+    assert q8["decode_phase_total_s"] <= bf["decode_phase_total_s"]
+
+
+def test_planner_fits_int8_where_bf16_did_not(summary):
+    bf = summary["planner"]["bfloat16"]
+    q8 = summary["planner"]["int8"]
+    # Same chip budget, same resident load: bf16's KV-utilization signal
+    # demands a replica the budget cannot host; int8's halved signal fits.
+    assert bf["chip_budget"] == q8["chip_budget"]
+    assert bf["throttled_replicas"] > 0
+    assert q8["throttled_replicas"] == 0
+    assert q8["allocated_roles"] == q8["target_roles"]
+    # The decision record carries the doubled capacity the engine
+    # advertised, not a guess.
+    assert q8["slot_capacity"] >= 1.9 * bf["slot_capacity"]
+    assert q8["decision_record"]["kv_utilization"] < (
+        0.55 * bf["decision_record"]["kv_utilization"]
+    )
+
+
+def test_sim_is_deterministic(summary):
+    assert run_sim() == summary
