@@ -185,12 +185,16 @@ def _parse_args(argv=None):
         "paging wins)",
     )
     ap.add_argument(
-        "--measure", default="decode", choices=["decode", "prefill"],
+        "--measure", default="decode",
+        choices=["decode", "prefill", "coldstart"],
         help="what to measure: 'decode' = steady-state decode tok/s (the "
         "headline); 'prefill' = admission throughput in prompt tok/s over "
         "shared-prefix traffic — pair with/without --prefix-cache for the "
         "on-chip APC A/B (requests share a prompt-len-sized system "
-        "prefix with small unique tails)",
+        "prefix with small unique tails); 'coldstart' = boot-to-first-"
+        "tokens with snapshot restore vs full load (two boots against a "
+        "file:// snapshot store; reports the restore speedup and checks "
+        "greedy token identity between the two engines)",
     )
     ap.add_argument(
         "--prefix-cache", action="store_true",
@@ -296,6 +300,9 @@ def _child_main(args) -> None:
     else:
         cfg = llama_1b_cfg()
         model_name = "llama-1b-class"
+
+    if args.measure == "coldstart":
+        return _measure_coldstart(args, cfg, model_name, backend_note)
 
     prefill_chunk = args.prefill_chunk
     if prefill_chunk <= 0 and (
@@ -476,6 +483,72 @@ def _measure_prefill(args, eng, cfg, model_name, backend_note) -> None:
         done_tokens += wave * (args.prompt_len + tail)
         emit(done_tokens, time.perf_counter() - t0, partial=True)
     emit(done_tokens, time.perf_counter() - t0, partial=False)
+
+
+def _measure_coldstart(args, cfg, model_name, backend_note) -> None:
+    """Boot-to-first-tokens, twice against one file:// snapshot store:
+    boot A full-loads (param init stands in for HF conversion on this
+    zero-egress image), warms up, and publishes its snapshot; boot B
+    restores from it. Reports the restore speedup and checks greedy
+    token identity between the two engines — a fast boot that decodes
+    different tokens is a bug, not a win."""
+    import shutil
+    import tempfile
+
+    from kubeai_tpu.engine import Engine, EngineConfig
+    from kubeai_tpu.engine.coldstart import ColdStartManager
+    from kubeai_tpu.engine.sampling import SamplingParams
+    from kubeai_tpu.models import llama
+    from kubeai_tpu.parallel.mesh import single_device_mesh
+
+    root = tempfile.mkdtemp(prefix="bench-coldstart-")
+    snap_url = "file://" + os.path.join(root, "snaps")
+    ecfg = EngineConfig(
+        num_slots=args.slots,
+        max_seq_len=args.max_seq_len,
+        cache_mode=args.cache_mode,
+        decode_chunk=max(1, args.decode_chunk),
+    )
+    mesh = single_device_mesh()
+    prompt = list(range(1, 1 + min(16, args.prompt_len)))
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+
+    def boot(label: str):
+        t0 = time.perf_counter()
+        mgr = ColdStartManager(
+            snap_url, model_name, ecfg, mesh,
+            work_dir=os.path.join(root, label),
+        )
+        params = mgr.acquire_params(lambda: llama.init_params(cfg))
+        eng = Engine("llama", cfg, params, cfg=ecfg)
+        toks = eng.generate([prompt], sp)[0]
+        mgr.maybe_publish(params)
+        mgr.tracker.finish()
+        return mgr, toks, time.perf_counter() - t0
+
+    try:
+        _m1, toks_full, t_full = boot("full")
+        m2, toks_restore, t_restore = boot("restore")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    identical = toks_full == toks_restore
+    speedup = t_full / t_restore if t_restore > 0 else 0.0
+    ok = m2.tracker.restored and identical
+    print(json.dumps({
+        "metric": f"{model_name} engine cold start, snapshot restore vs "
+        f"full load, bs={args.slots}"
+        + (" (smoke)" if args.smoke else "") + backend_note,
+        # A restore that didn't happen, or decoded different tokens, is
+        # a failed measurement — not a speedup.
+        "value": round(speedup, 2) if ok else 0,
+        "unit": "x faster boot",
+        "vs_baseline": 0,
+        "full_load_s": round(t_full, 3),
+        "restore_s": round(t_restore, 3),
+        "restored": bool(m2.tracker.restored),
+        "tokens_identical": identical,
+    }), flush=True)
 
 
 def _result_line(args, eng, model_name, backend_note, toks_per_s, baseline):
@@ -725,7 +798,20 @@ def main() -> None:
     cpu_wd = min(args.watchdog_seconds, _cpu_reserve_s()) \
         if args.watchdog_seconds > 0 else _cpu_reserve_s()
 
-    if on_tpu:
+    if on_tpu and args.measure == "coldstart":
+        # No decode-kernel ladder for a boot measurement: run the
+        # requested config under the watchdog, fall back to CPU smoke
+        # scale like everything else.
+        result = _run_measurement(argv, args.watchdog_seconds)
+        if result is None:
+            result = _run_measurement(
+                _cpu_fallback_argv(
+                    argv, ", smoke-scale CPU FALLBACK (TPU measurement "
+                    "failed)",
+                ),
+                cpu_wd,
+            )
+    elif on_tpu:
         result = _tpu_ladder(argv, args)
         if result is None:
             # Ladder produced nothing (hangs, crashes, or a mid-way relay

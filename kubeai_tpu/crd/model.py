@@ -338,6 +338,51 @@ class KVSharing:
             )
 
 
+SNAPSHOT_URL_SCHEMES = ("gs", "s3", "oss", "file")
+
+
+@dataclasses.dataclass
+class ColdStart:
+    """Serverless-grade cold start via engine snapshots (in-tree engine
+    only; no reference analog). When enabled, a replica that boots the
+    slow path (HF conversion + XLA compile) publishes its post-warmup
+    state — orbax params + compilation-cache artifacts — under
+    `snapshotURL`, keyed by a fingerprint of (model, engine config,
+    mesh shape, snapshot version); later replicas restore from the
+    snapshot instead, skipping conversion and most compilation. The
+    operator tightens the startup-probe budget accordingly, and the
+    capacity planner may prewarm replicas ahead of forecast demand
+    (docs/concepts/cold-start.md)."""
+
+    enabled: bool = False
+    # Object-store URL the snapshot tree lives under (gs://, s3://,
+    # oss://, or file:// for a shared filesystem mount).
+    snapshot_url: str = ""
+    # Whether a full-load boot publishes its snapshot for later
+    # replicas (false = restore-only consumers).
+    publish: bool = True
+    # Whether the capacity planner may order predictive prewarm
+    # replicas for this model.
+    prewarm: bool = True
+
+    def validate(self) -> None:
+        if not self.enabled:
+            return
+        if not self.snapshot_url:
+            raise ValidationError(
+                "coldStart.snapshotURL required when coldStart.enabled"
+            )
+        scheme = (
+            self.snapshot_url.split("://", 1)[0]
+            if "://" in self.snapshot_url else ""
+        )
+        if scheme not in SNAPSHOT_URL_SCHEMES:
+            raise ValidationError(
+                "coldStart.snapshotURL scheme must be one of "
+                f"{list(SNAPSHOT_URL_SCHEMES)}, got {self.snapshot_url!r}"
+            )
+
+
 KV_CACHE_DTYPES = ("bfloat16", "int8")
 
 
@@ -405,6 +450,8 @@ class ModelSpec:
     kv_sharing: KVSharing = dataclasses.field(default_factory=KVSharing)
     # Paged KV-cache storage dtype (in-tree engine only).
     kv_cache: KVCacheSpec = dataclasses.field(default_factory=KVCacheSpec)
+    # Engine snapshot/restore cold-start path (in-tree engine only).
+    cold_start: ColdStart = dataclasses.field(default_factory=ColdStart)
     # Graceful-drain budget: seconds an engine waits for in-flight
     # generations after SIGTERM / POST /v1/drain before terminating the
     # remainder. 0 = the system config `resilience.drainTimeout`
@@ -499,6 +546,11 @@ class ModelSpec:
         if self.kv_cache.enabled() and self.engine != ENGINE_KUBEAI_TPU:
             raise ValidationError(
                 "spec.kvCache requires the KubeAITPU engine"
+            )
+        self.cold_start.validate()
+        if self.cold_start.enabled and self.engine != ENGINE_KUBEAI_TPU:
+            raise ValidationError(
+                "spec.coldStart requires the KubeAITPU engine"
             )
         if self.kv_cache.dtype == "int8" and self.speculative_tokens:
             raise ValidationError(
@@ -667,6 +719,7 @@ class Model:
         dis = spec.get("disaggregation", {}) or {}
         kvs = spec.get("kvSharing", {}) or {}
         kvc = spec.get("kvCache", {}) or {}
+        cold = spec.get("coldStart", {}) or {}
 
         def _role_scaling(key: str) -> RoleScaling:
             r = dis.get(key) or {}
@@ -779,6 +832,12 @@ class Model:
                 ),
                 kv_cache=KVCacheSpec(
                     dtype=kvc.get("dtype", "") or "",
+                ),
+                cold_start=ColdStart(
+                    enabled=bool(cold.get("enabled", False)),
+                    snapshot_url=cold.get("snapshotURL", ""),
+                    publish=bool(cold.get("publish", True)),
+                    prewarm=bool(cold.get("prewarm", True)),
                 ),
             ),
             status=ModelStatus(
@@ -916,4 +975,12 @@ def _spec_to_dict(s: ModelSpec) -> dict:
         }
     if s.kv_cache.enabled():
         d["kvCache"] = {"dtype": s.kv_cache.dtype}
+    if s.cold_start.enabled:
+        cold = s.cold_start
+        d["coldStart"] = {
+            "enabled": True,
+            "snapshotURL": cold.snapshot_url,
+            **({} if cold.publish else {"publish": False}),
+            **({} if cold.prewarm else {"prewarm": False}),
+        }
     return d

@@ -45,6 +45,7 @@ from kubeai_tpu.metrics.registry import (
     Counter,
     Gauge,
     Histogram,
+    ObjstoreRetries,
     Registry,
     TracingDroppedSpans,
 )
@@ -352,6 +353,52 @@ class EngineMetrics:
             "Hung-device-step detections by the engine watchdog.",
             self.registry,
         )
+        # -- cold start: snapshot restore-first boot (engine/coldstart) -----
+        self.coldstart_phase = Gauge(
+            "kubeai_coldstart_phase_seconds",
+            "Wall time of each boot phase (label `phase`: fetch/restore "
+            "on the snapshot path, load on the full HF-conversion path, "
+            "compile/warmup on both) — the per-phase answer to 'why was "
+            "this replica slow to Ready'.",
+            self.registry,
+        )
+        self.coldstart_total = Gauge(
+            "kubeai_coldstart_total_seconds",
+            "End-to-end boot wall time (model resolve through warm-up) — "
+            "the measured cold-start cost the capacity planner prices "
+            "into prewarm and preemption choices.",
+            self.registry,
+        )
+        self.coldstart_restored = Gauge(
+            "kubeai_coldstart_restored",
+            "1 when this boot restored the engine snapshot (params + "
+            "compilation cache), 0 on the full load path.",
+            self.registry,
+        )
+        self.coldstart_events = Counter(
+            "kubeai_coldstart_snapshot_events_total",
+            "Snapshot lifecycle events at boot (label `event`: restored, "
+            "published, absent, mismatch, error). `mismatch` means the "
+            "stored fingerprint disagreed and the boot fell back to full "
+            "load — a stale layout is never served.",
+            self.registry,
+        )
+        self.objstore_retries = ObjstoreRetries(
+            "kubeai_objstore_retries_total",
+            "Object-store requests retried after a transient failure "
+            "(5xx/429, connection reset, short read) across every "
+            "client in the process.",
+            self.registry,
+        )
+
+    def record_coldstart(self, cold_start: dict) -> None:
+        """Fold a ColdStartTracker snapshot into the boot metrics."""
+        for phase, secs in (cold_start.get("phases") or {}).items():
+            self.coldstart_phase.set(secs, phase=phase)
+        self.coldstart_total.set(float(cold_start.get("total_s", 0.0)))
+        self.coldstart_restored.set(1 if cold_start.get("restored") else 0)
+        for ev in cold_start.get("events") or ():
+            self.coldstart_events.inc(event=ev)
 
     def observe_timing(self, kind: str, seconds: float) -> None:
         h = self._timing_hist.get(kind)
@@ -516,11 +563,19 @@ class EngineServer:
         kv_sharing: bool = False,
         kv_fetch_timeout: float = 5.0,
         kv_spill_store=None,
+        cold_start: dict | None = None,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.served_model_name = served_model_name
         self.metrics = EngineMetrics()
+        # Boot cold-start record (ColdStartTracker.snapshot()): surfaced
+        # on /v1/state so the fleet aggregator carries each replica's
+        # measured cold-start cost to the planner, and folded into the
+        # kubeai_coldstart_* metrics.
+        self.cold_start = dict(cold_start or {})
+        if self.cold_start:
+            self.metrics.record_coldstart(self.cold_start)
         # Disaggregated serving role: "prefill" turns every generate into
         # prefill→handoff (pushed to the decode address the router names);
         # "decode"/"unified" accept handoffs on /v1/kv/import and admit
@@ -667,6 +722,11 @@ class EngineServer:
                             # here (not per step) — it walks the whole
                             # registered-page table.
                             "kv_holdings": outer.kv_holdings(),
+                            # Boot cold-start record: restored-or-not,
+                            # per-phase timings, snapshot fingerprint.
+                            # The aggregator copies this to the planner
+                            # as the model's measured cold-start cost.
+                            "cold_start": outer.cold_start,
                             **engine_state_snapshot(outer.engine),
                         },
                     )
@@ -2421,6 +2481,24 @@ def main(argv=None) -> int:
         "re-filled from; empty = in-memory spill "
         "(CRD kvSharing.spillURL)",
     )
+    ap.add_argument(
+        "--snapshot-url", default="",
+        help="object-store URL for engine boot snapshots (post-conversion "
+        "param tree + XLA compilation cache, keyed by model/config/mesh "
+        "fingerprint): boot restores from it when a matching snapshot "
+        "exists and writes one back on the first full-load boot; empty "
+        "disables (CRD coldStart.snapshotURL)",
+    )
+    ap.add_argument(
+        "--snapshot-dir", default="",
+        help="local staging dir for snapshot fetch/publish and the "
+        "persistent compilation cache (default: a fresh temp dir)",
+    )
+    ap.add_argument(
+        "--snapshot-no-publish", action="store_true",
+        help="restore-only consumer: never write a snapshot back after "
+        "a full-load boot (CRD coldStart.publish=false)",
+    )
     args = ap.parse_args(argv)
     if args.kv_sharing:
         args.prefix_cache = True
@@ -2482,9 +2560,48 @@ def main(argv=None) -> int:
             tserver.stop()
         return 0
 
+    from kubeai_tpu.engine.coldstart import ColdStartManager
     from kubeai_tpu.engine.weights import load_params as _load_params
 
-    params = _load_params(family.name, model_dir, model_cfg)
+    # The mesh comes first now: its shape is part of the snapshot
+    # fingerprint (a tree sharded for a different slice must miss).
+    mesh = (
+        mesh_from_topology(args.tpu_topology)
+        if args.tpu_topology
+        else single_device_mesh()
+    )
+
+    engine_cfg = EngineConfig(
+        num_slots=args.num_slots,
+        max_seq_len=args.max_seq_len,
+        # LoRA is lockstep on multihost: host 0 broadcasts adapter
+        # weights to every process (engine/multihost.py).
+        max_adapters=args.max_adapters,
+        decode_chunk=args.decode_chunk,
+        pipeline=args.pipeline,
+        quantization=args.quantization,
+        kv_dtype=args.kv_dtype,
+        speculate=args.speculate,
+        spec_adaptive=args.spec_adaptive == "on",
+        prefill_chunk=args.prefill_chunk,
+        prefix_cache=args.prefix_cache,
+    )
+
+    # Restore-first boot: a complete snapshot under this (model, config,
+    # mesh) fingerprint skips HF conversion — and its bundled compilation
+    # cache makes the first jit a cache read. Absence/mismatch falls back
+    # to the full load path unchanged.
+    coldstart = ColdStartManager(
+        args.snapshot_url,
+        args.served_model_name,
+        engine_cfg,
+        mesh,
+        work_dir=args.snapshot_dir or None,
+        publish=not args.snapshot_no_publish,
+    )
+    params = coldstart.acquire_params(
+        lambda: _load_params(family.name, model_dir, model_cfg)
+    )
 
     draft = None
     if args.draft_url:
@@ -2502,11 +2619,6 @@ def main(argv=None) -> int:
         draft = (draft_cfg, _load_params(family.name, draft_dir, draft_cfg))
         log.info("loaded draft model (%s) from %s", draft_arch, draft_dir)
 
-    mesh = (
-        mesh_from_topology(args.tpu_topology)
-        if args.tpu_topology
-        else single_device_mesh()
-    )
     from kubeai_tpu.objstore import KVSpillStore
     from kubeai_tpu.scheduling import RequestScheduler, SchedulingPolicy
 
@@ -2533,21 +2645,7 @@ def main(argv=None) -> int:
         model_cfg,
         params,
         mesh=mesh,
-        cfg=EngineConfig(
-            num_slots=args.num_slots,
-            max_seq_len=args.max_seq_len,
-            # LoRA is lockstep on multihost: host 0 broadcasts adapter
-            # weights to every process (engine/multihost.py).
-            max_adapters=args.max_adapters,
-            decode_chunk=args.decode_chunk,
-            pipeline=args.pipeline,
-            quantization=args.quantization,
-            kv_dtype=args.kv_dtype,
-            speculate=args.speculate,
-            spec_adaptive=args.spec_adaptive == "on",
-            prefill_chunk=args.prefill_chunk,
-            prefix_cache=args.prefix_cache,
-        ),
+        cfg=engine_cfg,
         eos_token_ids=tuple(getattr(tokenizer, "eos_token_ids", ())),
         draft=draft,
         scheduler=scheduler,
@@ -2578,8 +2676,26 @@ def main(argv=None) -> int:
     # doesn't eat compile time (the reference warms Ollama the same way —
     # reference: engine_ollama.go:173-213 probe warm-up). In multihost
     # mode this is the first lockstep broadcast: workers join here.
-    engine.generate([[1, 2, 3]], SamplingParams(temperature=0.0, max_tokens=2))
-    log.info("warm-up complete")
+    # Phase-split for the cold-start record: the first generate carries
+    # the jit (or the persistent-cache read on the restore path), the
+    # second measures the warmed steady state.
+    with coldstart.tracker.phase("compile"):
+        engine.generate(
+            [[1, 2, 3]], SamplingParams(temperature=0.0, max_tokens=2)
+        )
+    with coldstart.tracker.phase("warmup"):
+        engine.generate(
+            [[1, 2, 3]], SamplingParams(temperature=0.0, max_tokens=2)
+        )
+    # Write-back on first boot: publish AFTER warm-up so the snapshot
+    # ships a compilation cache that already holds the serving graphs.
+    coldstart.maybe_publish(params)
+    coldstart.tracker.finish()
+    log.info(
+        "warm-up complete (cold start %.2fs, %s)",
+        coldstart.tracker.total_s,
+        "restored" if coldstart.tracker.restored else "full load",
+    )
 
     def _watchdog_exit():
         # The watchdog already flipped /health; exiting nonzero hands the
@@ -2610,6 +2726,7 @@ def main(argv=None) -> int:
         kv_spill_store=(
             KVSpillStore(args.kv_spill_url) if args.kv_sharing else None
         ),
+        cold_start=coldstart.tracker.snapshot(),
     )
     tracing.configure(service_name=f"kubeai-tpu-engine.{args.served_model_name}")
     server.start()

@@ -22,12 +22,21 @@ Plus the consumer that makes the aggregated state actionable:
     class preemption, slice right-sizing, and joint prefill/decode
     damping; `kubeai_planner_*` gauges, `GET /v1/fleet/plan`, and an
     override channel into the autoscaler.
+  - `DemandForecaster` — least-squares demand trend + spot-preemption
+    early warning over the snapshot ring (docs/concepts/cold-start.md):
+    feeds the planner's predictive prewarm pass and prices measured
+    cold-start cost into its preemption choices; `kubeai_prewarm_*`
+    gauges.
 """
 
 from kubeai_tpu.fleet.aggregator import (
     FleetStateAggregator,
     endpoint_signals,
     hist_quantiles,
+)
+from kubeai_tpu.fleet.forecaster import (
+    DemandForecaster,
+    Forecast,
 )
 from kubeai_tpu.fleet.planner import (
     CapacityPlanner,
@@ -45,6 +54,8 @@ from kubeai_tpu.fleet.profiler import PHASES, StepProfiler, phase_totals
 __all__ = [
     "ANONYMOUS_TENANT",
     "CapacityPlanner",
+    "DemandForecaster",
+    "Forecast",
     "FleetStateAggregator",
     "PHASES",
     "SCHEDULING_CLASSES",

@@ -356,7 +356,7 @@ class FleetStateAggregator:
                     state = cached.get("state") or {}
                     for k in (
                         "healthy", "draining", "pending_handoffs",
-                        "kv_sharing", "kv_holdings",
+                        "kv_sharing", "kv_holdings", "cold_start",
                     ):
                         if k in state:
                             entry[k] = state[k]
@@ -487,6 +487,7 @@ class FleetStateAggregator:
                 {
                     "total": 0, "ready": 0, "disrupted": 0,
                     "chips": 0, "by_role": {}, "by_shape": {},
+                    "by_disruption": {},
                 },
             )
             entry["total"] += 1
@@ -495,8 +496,14 @@ class FleetStateAggregator:
             entry["by_shape"][shape] = entry["by_shape"].get(shape, 0) + 1
             if k8sutils.pod_is_ready(pod):
                 entry["ready"] += 1
-            if k8sutils.pod_disruption_reason(pod) is not None:
+            disruption = k8sutils.pod_disruption_reason(pod)
+            if disruption is not None:
                 entry["disrupted"] += 1
+                # Per-reason counts: the demand forecaster reads the
+                # SpotPreemption bucket as an early warm trigger.
+                entry["by_disruption"][disruption] = (
+                    entry["by_disruption"].get(disruption, 0) + 1
+                )
             by_shape[shape] = by_shape.get(shape, 0) + chips
             pods_by_shape[shape] = pods_by_shape.get(shape, 0) + 1
             total_chips += chips

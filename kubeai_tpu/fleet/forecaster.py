@@ -1,0 +1,210 @@
+"""Demand forecaster: the predictive half of serverless-grade cold start.
+
+The aggregator's snapshot ring (`history()`) finally gets its promised
+consumer: per model, the forecaster fits a least-squares trend over the
+recent demand trajectory (scheduler queue depth + in-flight requests on
+fresh endpoints) and projects it to a configurable horizon. Two signals
+order a prewarm:
+
+  * **trend** — the projected demand at the horizon exceeds current
+    demand by the growth threshold: a spike is building, and a replica
+    ordered NOW (restore-path boot) is Ready before it lands.
+  * **spot** — the pod inventory's `by_disruption` bucket for
+    SpotPreemption is rising: capacity is about to vanish and its
+    replacement should be warming before the autoscaler notices the
+    gap (the PR 5 classification, used as an early-warning trigger).
+
+The forecaster also carries each model's MEASURED cold-start cost,
+read from the replicas' `/v1/state` cold_start blocks — the capacity
+planner prices this into preemption choices (preempting a model whose
+replicas restore in seconds beats preempting one that recompiles for
+minutes) and into how early a prewarm must be ordered.
+
+Pure function of the snapshot ring: no clocks, no sockets — the
+fake-clock cold-start sim drives it deterministically in tier-1.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from kubeai_tpu.operator import k8sutils
+
+logger = logging.getLogger(__name__)
+
+# Trigger vocabulary (metric label values; stable strings).
+TRIGGER_TREND = "trend"
+TRIGGER_SPOT = "spot"
+
+# A model with no cold_start telemetry yet is assumed expensive: full
+# HF conversion + XLA compile. Keeps preemption pricing conservative
+# until a replica reports its measured boot.
+DEFAULT_COLDSTART_S = 300.0
+
+
+@dataclass
+class Forecast:
+    """One model's demand outlook at the forecast horizon."""
+
+    model: str
+    current: float = 0.0       # latest demand sample (queued + in flight)
+    predicted: float = 0.0     # projected demand at t+horizon
+    slope: float = 0.0         # demand units per second (fit)
+    samples: int = 0           # ring samples behind the fit
+    warm_trigger: bool = False
+    trigger: str = ""          # "", "trend", or "spot"
+    spot_disruptions: int = 0  # SpotPreemption pods in the latest snapshot
+    coldstart_cost_s: float = DEFAULT_COLDSTART_S
+    restore_available: bool = False  # any replica booted from a snapshot
+    reasons: list = field(default_factory=list)
+
+    def payload(self) -> dict:
+        return {
+            "model": self.model,
+            "current": round(self.current, 3),
+            "predicted": round(self.predicted, 3),
+            "slope_per_s": round(self.slope, 6),
+            "samples": self.samples,
+            "warm_trigger": self.warm_trigger,
+            "trigger": self.trigger,
+            "spot_disruptions": self.spot_disruptions,
+            "coldstart_cost_s": round(self.coldstart_cost_s, 3),
+            "restore_available": self.restore_available,
+            "reasons": list(self.reasons),
+        }
+
+
+class DemandForecaster:
+    """See module docstring. `fleet` is a FleetStateAggregator (only
+    `history()` is used, so anything with a compatible snapshot ring —
+    the sim's fake aggregator included — plugs in)."""
+
+    def __init__(
+        self,
+        fleet,
+        *,
+        horizon_s: float = 120.0,
+        window: int = 12,
+        min_samples: int = 3,
+        growth_threshold: float = 1.5,
+        min_demand: float = 1.0,
+    ):
+        self.fleet = fleet
+        self.horizon_s = horizon_s
+        self.window = window
+        self.min_samples = min_samples
+        self.growth_threshold = growth_threshold
+        # Demand floor for the relative-growth test: a trajectory from
+        # 0.01 to 0.04 triples but is noise, not a spike.
+        self.min_demand = min_demand
+
+    # -- snapshot readers ------------------------------------------------------
+
+    @staticmethod
+    def demand_of(entry: dict) -> float:
+        """One snapshot entry's demand: queued + in flight on fresh
+        endpoints (stale endpoints' numbers are fiction)."""
+        depth = float(((entry.get("queue") or {}).get("depth")) or 0.0)
+        active = sum(
+            float(e.get("active_requests") or 0.0)
+            for e in (entry.get("endpoints") or {}).values()
+            if not e.get("stale")
+        )
+        return depth + active
+
+    @staticmethod
+    def _spot_disruptions(entry: dict) -> int:
+        by = ((entry.get("pods") or {}).get("by_disruption")) or {}
+        return int(by.get(k8sutils.REASON_SPOT_PREEMPTION, 0))
+
+    @staticmethod
+    def coldstart_of(entry: dict) -> tuple[float, bool]:
+        """(measured cold-start cost, restore_available) from the
+        replicas' cold_start blocks: the worst fresh replica's boot
+        total prices the preemption (re-adding capacity costs at least
+        that), restore_available when any replica restored a snapshot."""
+        costs: list[float] = []
+        restored = False
+        for e in (entry.get("endpoints") or {}).values():
+            if e.get("stale"):
+                continue
+            cs = e.get("cold_start") or {}
+            total = float(cs.get("total_s") or 0.0)
+            if total > 0:
+                costs.append(total)
+            restored = restored or bool(cs.get("restored"))
+        return (max(costs) if costs else DEFAULT_COLDSTART_S), restored
+
+    # -- forecasting -----------------------------------------------------------
+
+    def forecast(self, model: str) -> Forecast:
+        """Fit the model's demand trajectory over the ring and project
+        it `horizon_s` ahead. Degrades gracefully: too few samples →
+        no trend trigger (the spot trigger still fires)."""
+        snaps = self.fleet.history(self.window)
+        series: list[tuple[float, float]] = []
+        spot_series: list[int] = []
+        latest_entry: dict | None = None
+        for snap in snaps:
+            entry = (snap.get("models") or {}).get(model)
+            if entry is None:
+                continue
+            series.append((float(snap["ts"]), self.demand_of(entry)))
+            spot_series.append(self._spot_disruptions(entry))
+            latest_entry = entry
+        fc = Forecast(model=model, samples=len(series))
+        if latest_entry is None:
+            return fc
+        fc.current = series[-1][1]
+        fc.spot_disruptions = spot_series[-1]
+        fc.coldstart_cost_s, fc.restore_available = self.coldstart_of(
+            latest_entry
+        )
+        if len(series) >= self.min_samples:
+            fc.slope = _slope(series)
+            fc.predicted = max(0.0, fc.current + fc.slope * self.horizon_s)
+        else:
+            fc.predicted = fc.current
+        # Spot early warning outranks the trend fit: capacity is
+        # ALREADY being reclaimed, replacement warming can't wait for
+        # a regression to notice.
+        if fc.spot_disruptions > min(spot_series):
+            fc.warm_trigger = True
+            fc.trigger = TRIGGER_SPOT
+            fc.reasons.append(
+                f"spot preemptions rising ({min(spot_series)} -> "
+                f"{fc.spot_disruptions})"
+            )
+        elif (
+            fc.slope > 0
+            and fc.predicted
+            >= self.growth_threshold * max(fc.current, self.min_demand)
+        ):
+            fc.warm_trigger = True
+            fc.trigger = TRIGGER_TREND
+            fc.reasons.append(
+                f"demand projected {fc.current:.1f} -> {fc.predicted:.1f} "
+                f"in {self.horizon_s:.0f}s"
+            )
+        return fc
+
+    def forecast_all(self) -> dict[str, Forecast]:
+        snaps = self.fleet.history(self.window)
+        models: set[str] = set()
+        for snap in snaps:
+            models.update((snap.get("models") or {}).keys())
+        return {m: self.forecast(m) for m in sorted(models)}
+
+
+def _slope(series: list[tuple[float, float]]) -> float:
+    """Least-squares slope of (ts, demand) samples; 0 when degenerate
+    (all samples at one timestamp)."""
+    n = len(series)
+    mean_t = sum(t for t, _ in series) / n
+    mean_d = sum(d for _, d in series) / n
+    var_t = sum((t - mean_t) ** 2 for t, _ in series)
+    if var_t <= 0:
+        return 0.0
+    cov = sum((t - mean_t) * (d - mean_d) for t, d in series)
+    return cov / var_t

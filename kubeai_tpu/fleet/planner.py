@@ -43,12 +43,25 @@ A cluster whose store carries no Node objects (or whose nodes expose no
 `google.com/tpu` capacity) has an UNKNOWN budget: the planner then plans
 unconstrained — allocations equal desires, nothing is preempted — which
 is exactly the pre-planner behavior.
+
+When a `DemandForecaster` (kubeai_tpu/fleet/forecaster) is wired in, the
+planner additionally runs a PREWARM pass after demand is satisfied: a
+model whose forecast fires a warm trigger (rising demand trend, or spot
+preemptions eating its capacity) is granted extra replicas from the
+REMAINING free chips — gated per model by `governor.allow_prewarm` and
+clamped by `maxReplicas` — so snapshot-warm pods are Ready before the
+spike lands instead of cold-booting into it. The forecaster's measured
+cold-start cost is also priced into arbitration: within a class, demand
+chips flow to expensive-to-boot models first, so when preemption must
+happen it lands on the models whose replicas restore from a snapshot in
+seconds rather than the ones that recompile for minutes.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import math
 import threading
 import time
 
@@ -146,6 +159,7 @@ class CapacityPlanner:
         budget_override: dict | None = None,
         clock=time.time,
         governor=None,
+        forecaster=None,
     ):
         self.fleet = fleet
         self.model_client = model_client
@@ -172,6 +186,9 @@ class CapacityPlanner:
         from kubeai_tpu.operator import governor as governor_mod
 
         self.governor = governor or governor_mod.PERMISSIVE
+        # DemandForecaster (fleet/forecaster): enables the prewarm pass
+        # and cold-start-priced arbitration. None → both are no-ops.
+        self.forecaster = forecaster
         self.avg_lookup = None
         self._clock = clock
         self._lock = threading.Lock()
@@ -259,6 +276,9 @@ class CapacityPlanner:
             "desired": desired,
             "target": target,
             "floor": floor,
+            "target_requests": model.spec.target_requests,
+            "max_replicas": model.spec.max_replicas,
+            "prewarm_allowed": model.spec.cold_start.prewarm,
             "slo_pressure": bool(
                 threshold > 0 and queue["oldest_wait_s"] >= threshold
             ),
@@ -406,6 +426,96 @@ class CapacityPlanner:
                     e["shapes"][shape] = e["shapes"].get(shape, 0) + 1
                     progressed = True
 
+    # -- predictive prewarm / cold-start pricing -------------------------------
+
+    def _attach_forecasts(self, planned: list[dict]) -> dict:
+        """Forecast every planned model once per tick and stamp the
+        measured cold-start cost onto its entry (the arbitration price).
+        No forecaster → every model prices at the conservative default
+        and nothing triggers."""
+        from kubeai_tpu.fleet import forecaster as forecaster_mod
+
+        forecasts: dict[str, object] = {}
+        for e in planned:
+            fc = None
+            if self.forecaster is not None:
+                try:
+                    fc = self.forecaster.forecast(e["model"])
+                except Exception as err:  # noqa: BLE001 — advisory path
+                    logger.warning(
+                        "demand forecast for %s failed: %s",
+                        e["model"], err,
+                    )
+            forecasts[e["model"]] = fc
+            e["coldstart_cost_s"] = (
+                fc.coldstart_cost_s if fc is not None
+                else forecaster_mod.DEFAULT_COLDSTART_S
+            )
+            e["prewarm"] = 0
+            e["prewarm_trigger"] = ""
+        return forecasts
+
+    @staticmethod
+    def _priced(entries: list[dict]) -> list[dict]:
+        """Demand-fill order within a class: expensive-to-boot models
+        take chips first, so when the class's budget runs out the
+        shortfall (throttle, then preemption) lands on the models whose
+        replicas restore from a snapshot in seconds — re-adding THEIR
+        capacity later is cheap."""
+        return sorted(
+            entries,
+            key=lambda e: (-e["coldstart_cost_s"], e["model"]),
+        )
+
+    def _prewarm_pass(
+        self, planned: list[dict], forecasts: dict,
+        pools: list[_ShapePool], budget_known: bool,
+    ) -> None:
+        """Grant warm replicas ahead of forecast demand from whatever
+        chips the demand fill left free. Unified models only (a disagg
+        pair's role balance is the demand pass's job); each grant is
+        clamped by `maxReplicas` and gated per model by the actuation
+        governor — a prewarm creates pods and obeys the same fencing
+        and coverage gates as any other scale actuation."""
+        from kubeai_tpu.fleet import forecaster as forecaster_mod
+
+        for e in planned:
+            fc = forecasts.get(e["model"])
+            if fc is None or not fc.warm_trigger or e["kind"] != "unified":
+                continue
+            if not e.get("prewarm_allowed", True):
+                continue  # CRD coldStart.prewarm=false opts the model out
+            if fc.trigger == forecaster_mod.TRIGGER_SPOT:
+                # Capacity is being reclaimed: warm one replacement per
+                # disrupted pod before the autoscaler notices the gap.
+                need = max(1, fc.spot_disruptions)
+            else:
+                per = max(1.0, float(e.get("target_requests") or 1))
+                need = max(
+                    1, math.ceil((fc.predicted - fc.current) / per)
+                )
+            if e.get("max_replicas") is not None:
+                need = min(need, e["max_replicas"] - e["alloc"])
+            if need <= 0:
+                continue
+            if not self.governor.allow_prewarm(e["model"]):
+                continue  # the governor counted and logged the denial
+            granted = 0
+            for _ in range(need):
+                if budget_known:
+                    shape = self._place(pools, e["chips_per_replica"])
+                    if shape is None:
+                        break
+                    e["shapes"][shape] = e["shapes"].get(shape, 0) + 1
+                e["alloc"] += 1
+                granted += 1
+            if granted:
+                e["prewarm"] = granted
+                e["prewarm_trigger"] = fc.trigger
+                self.metrics.prewarm_orders.inc(
+                    granted, model=e["model"], trigger=fc.trigger
+                )
+
     def plan_from_snapshot(self, snap: dict) -> dict:
         now = self._clock()
         models = self.model_client.list_all_models()
@@ -460,6 +570,7 @@ class CapacityPlanner:
             entries.append(d)
 
         planned = [e for e in entries if e["kind"] != "fixed"]
+        forecasts = self._attach_forecasts(planned)
         if budget_known:
             # Floors are CRD guarantees — honored across ALL classes
             # first (in priority order), then demand water-fills per
@@ -471,7 +582,10 @@ class CapacityPlanner:
                 )
             for cls in SCHEDULING_CLASSES:
                 self._grant_rounds(
-                    [e for e in planned if e["class"] == cls], pools,
+                    self._priced(
+                        [e for e in planned if e["class"] == cls]
+                    ),
+                    pools,
                     to_floor=False,
                 )
         else:
@@ -482,6 +596,7 @@ class CapacityPlanner:
                     e["alloc_roles"] = dict(e["target_roles"])
                 else:
                     e["alloc"] = e["target"]
+        self._prewarm_pass(planned, forecasts, pools, budget_known)
 
         records: dict[str, dict] = {}
         chips_allocated = 0
@@ -552,6 +667,15 @@ class CapacityPlanner:
                     preempted_replicas=preempted,
                     chips_allocated=chips,
                 )
+            if e["kind"] != "fixed":
+                base.update(
+                    coldstart_cost_s=round(e["coldstart_cost_s"], 3),
+                    prewarm_replicas=e.get("prewarm", 0),
+                    prewarm_trigger=e.get("prewarm_trigger", ""),
+                )
+                fc = forecasts.get(e["model"])
+                if fc is not None:
+                    base["forecast"] = fc.payload()
             chips_allocated += chips
             if base.get("preempted_replicas"):
                 preemptions.append(
@@ -722,6 +846,20 @@ class CapacityPlanner:
                     m.planner_preemptions.inc(
                         rec["preempted_replicas"], model=name
                     )
+                set_(
+                    m.prewarm_replicas,
+                    rec.get("prewarm_replicas", 0), model=name,
+                )
+                set_(
+                    m.prewarm_coldstart_cost,
+                    rec.get("coldstart_cost_s", 0.0), model=name,
+                )
+                fc = rec.get("forecast")
+                if fc is not None:
+                    set_(
+                        m.prewarm_forecast_demand,
+                        fc["predicted"], model=name,
+                    )
         for shape, chips in plan["allocated_chips"]["by_shape"].items():
             set_(m.planner_chips_allocated, chips, shape=shape)
         for shape, chips in plan["free_chips"]["by_shape"].items():
@@ -770,6 +908,10 @@ class CapacityPlanner:
             "replicas": rec["allocated_replicas"],
             "class": rec["class"],
             "plan_ts": plan["ts"],
+            # Prewarm grants are already folded into the replica count —
+            # the autoscaler actuates them through the governed pod path
+            # like any other scale-up; this field is visibility only.
+            "prewarm_replicas": rec.get("prewarm_replicas", 0),
         }
 
     def plan_payload(self) -> dict:

@@ -25,6 +25,10 @@ from kubeai_tpu import objstore
 logger = logging.getLogger("kubeai-tpu-loader")
 
 
+class UnsupportedSchemeError(objstore.ObjStoreError):
+    """Source/destination URL scheme the loader cannot speak."""
+
+
 def _download_hf(repo_ref: str, dest: str) -> None:
     repo = repo_ref.split("?")[0]
     from huggingface_hub import snapshot_download
@@ -45,14 +49,14 @@ def download(src: str, dest_dir: str) -> None:
     elif os.path.isdir(src):  # local-to-local (tests, pvc copies)
         shutil.copytree(src, dest_dir, dirs_exist_ok=True)
     else:
-        raise SystemExit(f"Unsupported source url: {src}")
+        raise UnsupportedSchemeError(f"Unsupported source url: {src}")
 
 
 def upload(src_dir: str, dest: str) -> None:
     if dest.split("://")[0] in ("s3", "gs", "oss"):
         objstore.upload_dir(src_dir, dest)
     else:
-        raise SystemExit(f"Unsupported destination url: {dest}")
+        raise UnsupportedSchemeError(f"Unsupported destination url: {dest}")
 
 
 def main(argv=None) -> int:
@@ -64,12 +68,16 @@ def main(argv=None) -> int:
     p.add_argument("dst")
     args = ap.parse_args(argv)
 
-    if "://" in args.dst:
-        with tempfile.TemporaryDirectory() as tmp:
-            download(args.src, tmp)
-            upload(tmp, args.dst)
-    else:
-        download(args.src, args.dst)
+    try:
+        if "://" in args.dst:
+            with tempfile.TemporaryDirectory() as tmp:
+                download(args.src, tmp)
+                upload(tmp, args.dst)
+        else:
+            download(args.src, args.dst)
+    except UnsupportedSchemeError as e:
+        logger.error("%s", e)
+        return 1
     logger.info("load complete: %s -> %s", args.src, args.dst)
     return 0
 

@@ -102,6 +102,21 @@ class TracingDroppedSpans(Counter):
         return super().collect()
 
 
+class ObjstoreRetries(Counter):
+    """Live view of the object-store layer's transient-failure retry
+    count (5xx/429, connection resets, short reads). Synced from
+    `objstore.RETRIES` at collect time — same pattern as
+    TracingDroppedSpans, so the operator bundle and the engine bundle
+    both expose the process's one true count."""
+
+    def collect(self) -> list[str]:
+        from kubeai_tpu import objstore
+
+        with self._lock:
+            self._values[self._key({})] = float(objstore.RETRIES["total"])
+        return super().collect()
+
+
 class Gauge(_Metric):
     TYPE = "gauge"
 
@@ -650,6 +665,48 @@ class Metrics:
             "Unix timestamp of the latest capacity plan (plan age = "
             "now - this; the autoscaler ignores plans past the "
             "staleness bound).",
+            self.registry,
+        )
+        # -- predictive prewarm (kubeai_tpu/fleet/forecaster) ----------------
+        self.prewarm_forecast_demand = Gauge(
+            "kubeai_prewarm_forecast_demand",
+            "Forecast demand (requests in flight + queued) per model at "
+            "the forecast horizon, from the demand forecaster's fit over "
+            "the snapshot ring.",
+            self.registry,
+        )
+        self.prewarm_replicas = Gauge(
+            "kubeai_prewarm_replicas",
+            "Extra replicas the latest plan prewarms per model ahead of "
+            "forecast demand (granted from spare chips, actuated through "
+            "the governor like any scale-up).",
+            self.registry,
+        )
+        self.prewarm_orders = Counter(
+            "kubeai_prewarm_orders_total",
+            "Prewarm replica grants ordered by the planner per model and "
+            "trigger (trend = rising request-rate fit, spot = "
+            "spot-preemption early warning).",
+            self.registry,
+        )
+        self.prewarm_denied = Counter(
+            "kubeai_prewarm_denied_total",
+            "Prewarm grants the actuation governor refused per model "
+            "(fencing or telemetry-coverage gate).",
+            self.registry,
+        )
+        self.prewarm_coldstart_cost = Gauge(
+            "kubeai_prewarm_coldstart_cost_seconds",
+            "Measured cold-start cost per model (replica-reported boot "
+            "total; restore-path replicas report the cheap figure) — "
+            "what the planner prices into preemption choices.",
+            self.registry,
+        )
+        self.objstore_retries = ObjstoreRetries(
+            "kubeai_objstore_retries_total",
+            "Object-store requests retried after a transient failure "
+            "(5xx/429, connection reset, short read) across every "
+            "client in the process.",
             self.registry,
         )
         # -- per-tenant usage metering (kubeai_tpu/fleet/metering) ----------

@@ -15,9 +15,18 @@ environment installs nothing, so the stores are spoken natively:
   oss://bucket/prefix  — Alibaba OSS through its S3-compatible surface:
       the S3 client with OSS_ENDPOINT (+ OSS_ACCESS_KEY_ID/SECRET).
 
+  file://dir/prefix    — a plain directory behind the same client
+      interface (PVC-mounted snapshot volumes, tests, bench runs with
+      no bucket in reach).
+
 Streaming discipline: downloads go object→file in fixed-size chunks
 (never whole-object in memory), one object at a time — the weight
-loader's shard-at-a-time path builds on this.
+loader's shard-at-a-time path builds on this. Every wire operation
+retries transient failures (5xx/429, connection resets, short reads)
+with capped exponential backoff + jitter; an interrupted download
+RESUMES from the bytes already on disk via a Range request instead of
+restarting. Retries are counted in `RETRIES` and exported as
+`kubeai_objstore_retries_total`.
 """
 
 from __future__ import annotations
@@ -29,6 +38,8 @@ import http.client
 import json
 import logging
 import os
+import random
+import time
 import urllib.parse
 import xml.etree.ElementTree as ET
 
@@ -39,6 +50,144 @@ CHUNK = 1 << 20  # 1 MiB copy chunks
 
 class ObjStoreError(RuntimeError):
     pass
+
+
+class TransientStoreError(ObjStoreError):
+    """A store response worth retrying (5xx, 429): the bytes may well
+    arrive on the next attempt. Non-transient 4xx stay plain
+    `ObjStoreError` and fail immediately."""
+
+
+class SnapshotMismatch(ObjStoreError):
+    """A snapshot manifest whose fingerprint does not match the booting
+    engine's — serving from it could silently run a stale layout, so
+    callers MUST fall back to the full load path."""
+
+
+# -- transient-failure retry discipline ---------------------------------------
+#
+# One flaky read used to fail the whole operation (a multi-GB weight
+# download restarted from byte 0 on a connection reset). Every request
+# now runs under `with_retries`: capped exponential backoff with full
+# jitter, counted in RETRIES (scraped into kubeai_objstore_retries_total
+# at collect time by the instrument bundles).
+
+RETRIES = {"total": 0.0}  # read by metrics.registry.ObjstoreRetries
+
+RETRY_ATTEMPTS = int(os.environ.get("KUBEAI_OBJSTORE_RETRIES", "4"))
+RETRY_BASE_S = 0.2
+RETRY_CAP_S = 8.0
+
+# Module-level so tests (and latency-sensitive embedders) can replace
+# the sleeper without threading a parameter through every client call.
+RETRY_SLEEP = time.sleep
+
+
+def _is_transient(exc: BaseException) -> bool:
+    """Worth retrying: our own transient marker, connection-layer
+    failures (reset/aborted/refused mid-pool, broken pipe), timeouts,
+    and short reads (`IncompleteRead`, `RemoteDisconnected`)."""
+    return isinstance(
+        exc,
+        (
+            TransientStoreError,
+            ConnectionError,
+            TimeoutError,
+            http.client.IncompleteRead,
+            http.client.BadStatusLine,
+        ),
+    )
+
+
+def with_retries(desc: str, fn, *, attempts: int | None = None,
+                 sleep=None, rng=None):
+    """Run `fn()` retrying transient failures up to `attempts` extra
+    times with capped exponential backoff + full jitter. `fn` must be
+    safe to re-run whole (each client attempt opens a fresh
+    connection); download resume is handled inside `get_to_file`, not
+    here."""
+    attempts = RETRY_ATTEMPTS if attempts is None else attempts
+    sleep = sleep if sleep is not None else RETRY_SLEEP
+    rng = rng if rng is not None else random.random
+    for i in range(attempts + 1):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — filtered below
+            if not _is_transient(e) or i >= attempts:
+                raise
+            RETRIES["total"] += 1
+            delay = min(RETRY_CAP_S, RETRY_BASE_S * (2 ** i)) * (
+                0.5 + rng()
+            )
+            logger.warning(
+                "objstore %s: %s — retry %d/%d in %.2fs",
+                desc, e, i + 1, attempts, delay,
+            )
+            sleep(delay)
+
+
+def _status_error(op: str, status: int, detail: str = "") -> ObjStoreError:
+    msg = f"{op}: {status}" + (f" {detail}" if detail else "")
+    if status >= 500 or status == 429:
+        return TransientStoreError(msg)
+    return ObjStoreError(msg)
+
+
+class _RangeIgnored(TransientStoreError):
+    """The server answered a nonzero Range request with 200-whole-object.
+    Appending that stream would duplicate the resumed prefix, so the
+    download restarts from byte 0 instead."""
+
+
+def _read_exact(resp, n: int, desc: str) -> bytes:
+    """Read exactly n bytes from a response; a cleanly-closed short
+    stream raises IncompleteRead so the retry layer re-requests."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = resp.read(min(CHUNK, n - len(buf)))
+        if not chunk:
+            raise http.client.IncompleteRead(bytes(buf), n - len(buf))
+        buf += chunk
+    return bytes(buf)
+
+
+def _ranged_get_to_file(open_stream, desc: str, dest_path: str) -> None:
+    """Streaming download with mid-stream resume: on a transient failure
+    the next attempt re-requests `bytes=<bytes_on_disk>-` and APPENDS,
+    instead of redownloading the whole object. A fresh call always
+    truncates dest, so stale partials from a previous process never
+    leak into the result. `open_stream(start)` must return a
+    (response, connection) pair positioned at byte `start`."""
+    os.makedirs(os.path.dirname(dest_path) or ".", exist_ok=True)
+    state = {"offset": 0}
+
+    def attempt():
+        try:
+            resp, conn = open_stream(state["offset"])
+        except _RangeIgnored:
+            state["offset"] = 0
+            resp, conn = open_stream(0)
+        try:
+            # http.client's read(amt) returns b"" on a premature close
+            # instead of raising, so a mid-stream cut would otherwise
+            # pass for end-of-object and leave a silently truncated
+            # file. Hold it to the advertised Content-Length ourselves.
+            expected = resp.length
+            received = 0
+            with open(dest_path, "wb" if state["offset"] == 0 else "ab") as f:
+                while True:
+                    chunk = resp.read(CHUNK)
+                    if not chunk:
+                        break
+                    f.write(chunk)
+                    received += len(chunk)
+                    state["offset"] += len(chunk)
+            if expected is not None and received < expected:
+                raise http.client.IncompleteRead(b"", expected - received)
+        finally:
+            conn.close()
+
+    with_retries(f"get {desc}", attempt)
 
 
 def parse_url(url: str) -> tuple[str, str, str]:
@@ -59,6 +208,8 @@ def client_for(url: str):
             access_key=os.environ.get("OSS_ACCESS_KEY_ID"),
             secret_key=os.environ.get("OSS_ACCESS_KEY_SECRET"),
         )
+    if scheme == "file":
+        return LocalDirClient()
     raise ObjStoreError(f"unsupported object-store scheme {scheme!r}")
 
 
@@ -153,23 +304,30 @@ class GCSClient:
             q = {"prefix": prefix, "maxResults": "1000"}
             if page:
                 q["pageToken"] = page
-            conn = self._conn()
-            try:
-                conn.request(
-                    "GET",
-                    f"/storage/v1/b/{urllib.parse.quote(bucket, safe='')}/o?"
-                    + urllib.parse.urlencode(q),
-                    headers=self._auth(),
-                )
-                resp = conn.getresponse()
-                body = resp.read()
-                if resp.status >= 400:
-                    raise ObjStoreError(
-                        f"gcs list {bucket}/{prefix}: {resp.status} {body[:200]!r}"
+
+            def attempt():
+                conn = self._conn()
+                try:
+                    conn.request(
+                        "GET",
+                        f"/storage/v1/b/{urllib.parse.quote(bucket, safe='')}/o?"
+                        + urllib.parse.urlencode(q),
+                        headers=self._auth(),
                     )
-            finally:
-                conn.close()
-            out = json.loads(body)
+                    resp = conn.getresponse()
+                    body = resp.read()
+                    if resp.status >= 400:
+                        raise _status_error(
+                            f"gcs list {bucket}/{prefix}",
+                            resp.status, repr(body[:200]),
+                        )
+                    return body
+                finally:
+                    conn.close()
+
+            out = json.loads(
+                with_retries(f"list gs://{bucket}/{prefix}", attempt)
+            )
             items += [
                 {"name": o["name"], "size": int(o.get("size", 0))}
                 for o in out.get("items", [])
@@ -178,55 +336,94 @@ class GCSClient:
             if not page:
                 return items
 
+    def _object_path(self, bucket: str, name: str) -> str:
+        return (
+            f"/download/storage/v1/b/{urllib.parse.quote(bucket, safe='')}"
+            f"/o/{urllib.parse.quote(name, safe='')}?alt=media"
+        )
+
     def get_to_file(self, bucket: str, name: str, dest_path: str) -> None:
+        _ranged_get_to_file(
+            lambda start: self._open_stream(bucket, name, start),
+            f"gs://{bucket}/{name}", dest_path,
+        )
+
+    def get_range(self, bucket: str, name: str, start: int, end: int) -> bytes:
+        """Inclusive byte range [start, end] of one object."""
+        def attempt():
+            resp, conn = self._open_stream(bucket, name, start, end)
+            try:
+                return _read_exact(
+                    resp, end - start + 1, f"gs://{bucket}/{name}"
+                )
+            finally:
+                conn.close()
+
+        return with_retries(
+            f"get gs://{bucket}/{name}[{start}-{end}]", attempt
+        )
+
+    def _open_stream(
+        self, bucket: str, name: str, start: int = 0, end: int | None = None
+    ):
+        """(response, connection) streaming the object from `start`
+        (to `end` inclusive when given). Returns a NON-206 response for
+        start=0; a server that ignores a nonzero Range raises so the
+        caller restarts from scratch instead of appending a duplicate
+        prefix."""
+        headers = self._auth()
+        if start > 0 or end is not None:
+            headers = dict(headers)
+            headers["Range"] = (
+                f"bytes={start}-" if end is None else f"bytes={start}-{end}"
+            )
         conn = self._conn()
         try:
-            conn.request(
-                "GET",
-                f"/download/storage/v1/b/{urllib.parse.quote(bucket, safe='')}"
-                f"/o/{urllib.parse.quote(name, safe='')}?alt=media",
-                headers=self._auth(),
-            )
+            conn.request("GET", self._object_path(bucket, name), headers=headers)
             resp = conn.getresponse()
-            if resp.status >= 400:
-                raise ObjStoreError(
-                    f"gcs get {bucket}/{name}: {resp.status}"
-                )
-            os.makedirs(os.path.dirname(dest_path) or ".", exist_ok=True)
-            with open(dest_path, "wb") as f:
-                while True:
-                    chunk = resp.read(CHUNK)
-                    if not chunk:
-                        break
-                    f.write(chunk)
-        finally:
+        except BaseException:
             conn.close()
+            raise
+        if resp.status >= 400:
+            conn.close()
+            raise _status_error(f"gcs get {bucket}/{name}", resp.status)
+        if (start > 0 or end is not None) and resp.status != 206:
+            conn.close()
+            raise _RangeIgnored(
+                f"gcs get {bucket}/{name}: server ignored Range "
+                f"(status {resp.status})"
+            )
+        return resp, conn
 
     def put_from_file(self, bucket: str, name: str, src_path: str) -> None:
         size = os.path.getsize(src_path)
-        conn = self._conn()
-        try:
-            with open(src_path, "rb") as f:
-                headers = {
-                    "Content-Length": str(size),
-                    "Content-Type": "application/octet-stream",
-                }
-                headers.update(self._auth())
-                conn.request(
-                    "POST",
-                    f"/upload/storage/v1/b/{urllib.parse.quote(bucket, safe='')}"
-                    f"/o?uploadType=media&name={urllib.parse.quote(name, safe='')}",
-                    body=f,
-                    headers=headers,
-                )
-                resp = conn.getresponse()
-                resp.read()
-                if resp.status >= 400:
-                    raise ObjStoreError(
-                        f"gcs put {bucket}/{name}: {resp.status}"
+
+        def attempt():
+            conn = self._conn()
+            try:
+                with open(src_path, "rb") as f:
+                    headers = {
+                        "Content-Length": str(size),
+                        "Content-Type": "application/octet-stream",
+                    }
+                    headers.update(self._auth())
+                    conn.request(
+                        "POST",
+                        f"/upload/storage/v1/b/{urllib.parse.quote(bucket, safe='')}"
+                        f"/o?uploadType=media&name={urllib.parse.quote(name, safe='')}",
+                        body=f,
+                        headers=headers,
                     )
-        finally:
-            conn.close()
+                    resp = conn.getresponse()
+                    resp.read()
+                    if resp.status >= 400:
+                        raise _status_error(
+                            f"gcs put {bucket}/{name}", resp.status
+                        )
+            finally:
+                conn.close()
+
+        with_retries(f"put gs://{bucket}/{name}", attempt)
 
 
 def sigv4_sign(
@@ -336,18 +533,24 @@ class S3Client:
                 sorted(q.items()), quote_via=urllib.parse.quote
             )
             path = f"/{bucket}"
-            conn = self._conn()
-            try:
-                headers = self._sign("GET", path, query, self.EMPTY_SHA)
-                conn.request("GET", f"{path}?{query}", headers=headers)
-                resp = conn.getresponse()
-                body = resp.read()
-                if resp.status >= 400:
-                    raise ObjStoreError(
-                        f"s3 list {bucket}/{prefix}: {resp.status} {body[:200]!r}"
-                    )
-            finally:
-                conn.close()
+
+            def attempt():
+                conn = self._conn()
+                try:
+                    headers = self._sign("GET", path, query, self.EMPTY_SHA)
+                    conn.request("GET", f"{path}?{query}", headers=headers)
+                    resp = conn.getresponse()
+                    body = resp.read()
+                    if resp.status >= 400:
+                        raise _status_error(
+                            f"s3 list {bucket}/{prefix}",
+                            resp.status, repr(body[:200]),
+                        )
+                    return body
+                finally:
+                    conn.close()
+
+            body = with_retries(f"list s3://{bucket}/{prefix}", attempt)
             ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
             root = ET.fromstring(body)
             # Tolerate namespaced and namespace-less XML (fakes).
@@ -370,44 +573,147 @@ class S3Client:
                 return items
 
     def get_to_file(self, bucket: str, name: str, dest_path: str) -> None:
+        _ranged_get_to_file(
+            lambda start: self._open_stream(bucket, name, start),
+            f"s3://{bucket}/{name}", dest_path,
+        )
+
+    def get_range(self, bucket: str, name: str, start: int, end: int) -> bytes:
+        """Inclusive byte range [start, end] of one object."""
+        def attempt():
+            resp, conn = self._open_stream(bucket, name, start, end)
+            try:
+                return _read_exact(
+                    resp, end - start + 1, f"s3://{bucket}/{name}"
+                )
+            finally:
+                conn.close()
+
+        return with_retries(
+            f"get s3://{bucket}/{name}[{start}-{end}]", attempt
+        )
+
+    def _open_stream(
+        self, bucket: str, name: str, start: int = 0, end: int | None = None
+    ):
+        """(response, connection) streaming the object from `start` (to
+        `end` inclusive when given). Range is an unsigned header — SigV4
+        only commits to (host, x-amz-date, x-amz-content-sha256) here."""
         path = f"/{bucket}/{urllib.parse.quote(name)}"
+        headers = dict(self._sign("GET", path, "", self.EMPTY_SHA))
+        if start > 0 or end is not None:
+            headers["Range"] = (
+                f"bytes={start}-" if end is None else f"bytes={start}-{end}"
+            )
         conn = self._conn()
         try:
-            headers = self._sign("GET", path, "", self.EMPTY_SHA)
             conn.request("GET", path, headers=headers)
             resp = conn.getresponse()
-            if resp.status >= 400:
-                raise ObjStoreError(f"s3 get {bucket}/{name}: {resp.status}")
-            os.makedirs(os.path.dirname(dest_path) or ".", exist_ok=True)
-            with open(dest_path, "wb") as f:
-                while True:
-                    chunk = resp.read(CHUNK)
-                    if not chunk:
-                        break
-                    f.write(chunk)
-        finally:
+        except BaseException:
             conn.close()
+            raise
+        if resp.status >= 400:
+            conn.close()
+            raise _status_error(f"s3 get {bucket}/{name}", resp.status)
+        if (start > 0 or end is not None) and resp.status != 206:
+            conn.close()
+            raise _RangeIgnored(
+                f"s3 get {bucket}/{name}: server ignored Range "
+                f"(status {resp.status})"
+            )
+        return resp, conn
 
     def put_from_file(self, bucket: str, name: str, src_path: str) -> None:
         path = f"/{bucket}/{urllib.parse.quote(name)}"
+
         # Sign with UNSIGNED-PAYLOAD so the file streams without a
         # whole-file hash pass into memory.
-        conn = self._conn()
-        try:
-            with open(src_path, "rb") as f:
-                headers = {
-                    "Content-Length": str(os.path.getsize(src_path)),
-                }
-                headers.update(self._sign("PUT", path, "", "UNSIGNED-PAYLOAD"))
-                conn.request("PUT", path, body=f, headers=headers)
-                resp = conn.getresponse()
-                resp.read()
-                if resp.status >= 400:
-                    raise ObjStoreError(
-                        f"s3 put {bucket}/{name}: {resp.status}"
+        def attempt():
+            conn = self._conn()
+            try:
+                with open(src_path, "rb") as f:
+                    headers = {
+                        "Content-Length": str(os.path.getsize(src_path)),
+                    }
+                    headers.update(
+                        self._sign("PUT", path, "", "UNSIGNED-PAYLOAD")
                     )
-        finally:
-            conn.close()
+                    conn.request("PUT", path, body=f, headers=headers)
+                    resp = conn.getresponse()
+                    resp.read()
+                    if resp.status >= 400:
+                        raise _status_error(
+                            f"s3 put {bucket}/{name}", resp.status
+                        )
+            finally:
+                conn.close()
+
+        with_retries(f"put s3://{bucket}/{name}", attempt)
+
+
+class LocalDirClient:
+    """A plain directory behind the object-store client interface
+    (file:// URLs): PVC-mounted snapshot volumes, tests, and bench runs
+    with no bucket in reach. `parse_url("file:///var/snap")` yields
+    bucket "" and prefix "var/snap", so names resolve from `root`
+    (the filesystem root by default)."""
+
+    def __init__(self, root: str = "/"):
+        self.root = root
+
+    def _path(self, bucket: str, name: str) -> str:
+        parts = [p for p in (bucket, name) if p]
+        return os.path.join(self.root, *parts) if parts else self.root
+
+    def list(self, bucket: str, prefix: str) -> list[dict]:
+        """String-prefix semantics like the real stores: a prefix naming
+        a directory lists its whole tree; one naming a file lists it."""
+        base = self._path(bucket, prefix)
+        items = []
+        if os.path.isfile(base):
+            items.append({"name": prefix, "size": os.path.getsize(base)})
+        if os.path.isdir(base):
+            for root, _dirs, files in os.walk(base):
+                for fname in files:
+                    full = os.path.join(root, fname)
+                    rel = os.path.relpath(full, base)
+                    name = f"{prefix.rstrip('/')}/{rel}" if prefix else rel
+                    items.append(
+                        {"name": name, "size": os.path.getsize(full)}
+                    )
+        return sorted(items, key=lambda o: o["name"])
+
+    def get_to_file(self, bucket: str, name: str, dest_path: str) -> None:
+        src = self._path(bucket, name)
+        if not os.path.isfile(src):
+            raise ObjStoreError(f"file get {src}: not found")
+        os.makedirs(os.path.dirname(dest_path) or ".", exist_ok=True)
+        with open(src, "rb") as s, open(dest_path, "wb") as d:
+            while True:
+                chunk = s.read(CHUNK)
+                if not chunk:
+                    break
+                d.write(chunk)
+
+    def get_range(self, bucket: str, name: str, start: int, end: int) -> bytes:
+        src = self._path(bucket, name)
+        if not os.path.isfile(src):
+            raise ObjStoreError(f"file get {src}: not found")
+        with open(src, "rb") as f:
+            f.seek(start)
+            return f.read(end - start + 1)
+
+    def put_from_file(self, bucket: str, name: str, src_path: str) -> None:
+        dest = self._path(bucket, name)
+        os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+        tmp = dest + ".inflight"
+        with open(src_path, "rb") as s, open(tmp, "wb") as d:
+            while True:
+                chunk = s.read(CHUNK)
+                if not chunk:
+                    break
+                d.write(chunk)
+        os.replace(tmp, dest)  # objects appear atomically, like a store
 
 
 def download_prefix(url: str, dest_dir: str, client=None) -> list[str]:
@@ -451,6 +757,196 @@ def upload_dir(src_dir: str, url: str, client=None) -> list[str]:
             client.put_from_file(bucket, key, full)
             uploaded.append(key)
     return uploaded
+
+
+def fetch_object_parallel(
+    client,
+    bucket: str,
+    name: str,
+    size: int,
+    dest_path: str,
+    *,
+    part_bytes: int = 8 << 20,
+    max_workers: int = 8,
+) -> None:
+    """Chunk-parallel ranged download of ONE object: the dest file is
+    preallocated, then worker threads each GET an independent byte range
+    (individually retried; fresh connection per request) and pwrite it
+    into place. Small objects and clients without `get_range` fall back
+    to the sequential streaming path."""
+    if size <= part_bytes or not hasattr(client, "get_range"):
+        client.get_to_file(bucket, name, dest_path)
+        return
+    os.makedirs(os.path.dirname(dest_path) or ".", exist_ok=True)
+    with open(dest_path, "wb") as f:
+        f.truncate(size)
+    ranges = [
+        (s, min(s + part_bytes, size) - 1) for s in range(0, size, part_bytes)
+    ]
+    import concurrent.futures
+
+    fd = os.open(dest_path, os.O_WRONLY)
+    try:
+        def fetch(rng):
+            start, end = rng
+            os.pwrite(fd, client.get_range(bucket, name, start, end), start)
+
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_workers
+        ) as ex:
+            # list() re-raises the first worker failure
+            list(ex.map(fetch, ranges))
+    finally:
+        os.close(fd)
+
+
+SNAPSHOT_VERSION = 1
+SNAPSHOT_MANIFEST = "MANIFEST.json"
+
+
+class SnapshotStore:
+    """Engine boot snapshots: the post-conversion param tree (orbax
+    checkpoint layout) plus the JAX persistent compilation cache, so a
+    replica's birth costs a streamed restore instead of HF-weight
+    conversion + XLA recompilation.
+
+    Layout under `<url>/<model>/<fingerprint>/`:
+
+      params/...      orbax checkpoint tree (one object per array file)
+      xla_cache/...   JAX compilation-cache entries (may be empty on
+                      platforms without persistent-cache support)
+      MANIFEST.json   uploaded LAST — its presence marks the snapshot
+                      complete. A crashed publisher leaves no manifest,
+                      so a partial tree is never restored; the next full
+                      boot simply overwrites it.
+
+    The fingerprint folds in everything that changes the on-device
+    layout or the compiled program (model id, engine config, mesh
+    shape, snapshot schema version). `fetch` re-validates the manifest
+    against the expected fingerprint and raises `SnapshotMismatch` on
+    drift: a stale layout must NEVER be served — callers fall back to
+    the full-load path and republish, self-healing the key."""
+
+    def __init__(self, url: str, client=None):
+        self.url = url.rstrip("/")
+        self.client = client or client_for(self.url)
+        _scheme, self.bucket, self.base_prefix = parse_url(self.url)
+
+    @staticmethod
+    def fingerprint(
+        model: str,
+        engine_config: dict,
+        mesh_shape,
+        version: int = SNAPSHOT_VERSION,
+    ) -> str:
+        blob = json.dumps(
+            {
+                "model": model,
+                "engine_config": engine_config,
+                "mesh_shape": list(mesh_shape),
+                "snapshot_version": version,
+            },
+            sort_keys=True,
+            default=str,
+        )
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    def _prefix(self, model: str, fingerprint: str) -> str:
+        parts = [self.base_prefix, model.replace("/", "--"), fingerprint]
+        return "/".join(p for p in parts if p)
+
+    def manifest(self, model: str, fingerprint: str) -> dict | None:
+        """The manifest iff a COMPLETE snapshot exists at this key.
+        Store trouble (including exhausted retries) reads as absent:
+        boot falls back to the full-load path rather than crash-looping
+        on an unreachable bucket."""
+        import tempfile
+
+        key = f"{self._prefix(model, fingerprint)}/{SNAPSHOT_MANIFEST}"
+        tmp = tempfile.mktemp()
+        try:
+            self.client.get_to_file(self.bucket, key, tmp)
+            with open(tmp) as f:
+                return json.load(f)
+        except (ObjStoreError, json.JSONDecodeError):
+            return None
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def fetch(
+        self,
+        model: str,
+        fingerprint: str,
+        dest_dir: str,
+        *,
+        max_workers: int = 8,
+    ) -> dict | None:
+        """Download the snapshot tree into dest_dir (params/ +
+        xla_cache/), chunk-parallel per object. Returns the manifest,
+        None when absent, or raises `SnapshotMismatch` when the manifest
+        disagrees with the expected fingerprint."""
+        man = self.manifest(model, fingerprint)
+        if man is None:
+            return None
+        if man.get("fingerprint") != fingerprint:
+            raise SnapshotMismatch(
+                f"snapshot at {self._prefix(model, fingerprint)} carries "
+                f"fingerprint {man.get('fingerprint')!r}, expected "
+                f"{fingerprint!r} — falling back to full load"
+            )
+        prefix = self._prefix(model, fingerprint)
+        for obj in self.client.list(self.bucket, prefix + "/"):
+            rel = obj["name"][len(prefix):].lstrip("/")
+            if not rel or rel == SNAPSHOT_MANIFEST:
+                continue
+            fetch_object_parallel(
+                self.client,
+                self.bucket,
+                obj["name"],
+                obj["size"],
+                os.path.join(dest_dir, rel),
+                max_workers=max_workers,
+            )
+        return man
+
+    def publish(
+        self, model: str, fingerprint: str, src_dir: str, *, meta: dict | None = None
+    ) -> dict:
+        """Upload a snapshot directory; MANIFEST.json goes LAST so a
+        half-uploaded tree is never mistaken for a complete snapshot.
+        Republishing over an existing key overwrites it (self-heal)."""
+        import tempfile
+
+        prefix = self._prefix(model, fingerprint)
+        uploaded = []
+        for root, _dirs, files in os.walk(src_dir):
+            for fname in sorted(files):
+                if fname == SNAPSHOT_MANIFEST:
+                    continue
+                full = os.path.join(root, fname)
+                rel = os.path.relpath(full, src_dir)
+                self.client.put_from_file(self.bucket, f"{prefix}/{rel}", full)
+                uploaded.append(rel)
+        man = {
+            "fingerprint": fingerprint,
+            "model": model,
+            "snapshot_version": SNAPSHOT_VERSION,
+            "objects": sorted(uploaded),
+            **(meta or {}),
+        }
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".json", delete=False
+        ) as f:
+            json.dump(man, f)
+            tmp = f.name
+        try:
+            self.client.put_from_file(
+                self.bucket, f"{prefix}/{SNAPSHOT_MANIFEST}", tmp
+            )
+        finally:
+            os.unlink(tmp)
+        return man
 
 
 class KVSpillStore:

@@ -117,6 +117,14 @@ def kubeai_tpu_pod(
     # KV bytes (~2x slot capacity at equal HBM) and every KV transfer.
     if model.spec.kv_cache.enabled():
         args += ["--kv-dtype", model.spec.kv_cache.dtype]
+    # Engine snapshot/restore (CRD coldStart: block): boot restores the
+    # post-conversion param tree + compilation cache from the snapshot
+    # store instead of re-running HF conversion and XLA compilation.
+    cold = model.spec.cold_start
+    if cold.enabled:
+        args += ["--snapshot-url", cold.snapshot_url]
+        if not cold.publish:
+            args += ["--snapshot-no-publish"]
     # Adapters are NOT baked into the spec: they hot-swap through the
     # /v1/load_lora_adapter admin API (see operator/adapters.py), so adapter
     # changes never trigger a pod rollout.
@@ -136,10 +144,15 @@ def kubeai_tpu_pod(
         "volumeMounts": mounts,
         # Sharded weight streaming into slice HBM can take a long time on
         # first boot (no cache); same 3h ceiling the reference grants vLLM.
+        # Snapshot-restore boots skip conversion and most compilation, so
+        # the budget tightens to 30min: a replica stuck that long is
+        # broken and should be restarted, not waited on for 3h. (The
+        # first full-load boot of a model still fits — publish happens
+        # after Ready, and the fallback path only re-runs conversion.)
         "startupProbe": {
             "httpGet": {"path": "/health", "port": PORT},
             "periodSeconds": 10,
-            "failureThreshold": 1080,
+            "failureThreshold": 180 if cold.enabled else 1080,
         },
         "readinessProbe": {
             "httpGet": {"path": "/health", "port": PORT},

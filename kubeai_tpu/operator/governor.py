@@ -60,6 +60,7 @@ ACTION_MODEL_TEARDOWN = "model_teardown"
 ACTION_SCALE_DOWN = "scale_down"
 ACTION_SCALE_TO_ZERO = "scale_to_zero"
 ACTION_PREEMPT_MARK = "preempt_mark"
+ACTION_PREWARM = "prewarm"
 
 # Denial-reason vocabulary.
 DENY_LEASE = "lease-invalid"
@@ -300,6 +301,28 @@ class ActuationGovernor:
             self._deny(ACTION_PREEMPT_MARK, model, DENY_COVERAGE)
             return False
         self._allow(ACTION_PREEMPT_MARK, model)
+        return True
+
+    def allow_prewarm(self, model: str) -> bool:
+        """Whether the capacity planner may order predictive prewarm
+        replicas for this model right now. Prewarm only ADDS capacity,
+        so budgets don't apply — but the order is still fenced (a
+        non-leader's plan must not create pods) and refused while fleet
+        telemetry is stale: a blind forecaster extrapolating from a dead
+        snapshot ring must not spend chips. Denials land in
+        kubeai_prewarm_denied_total."""
+        if not self.fence_valid():
+            self.metrics.leader_fenced_writes.inc()
+            self.metrics.prewarm_denied.inc(model=model)
+            self._deny(ACTION_PREWARM, model, DENY_LEASE)
+            return False
+        if self.armed:
+            _cov, fresh = self._coverage(model)
+            if not fresh:
+                self.metrics.prewarm_denied.inc(model=model)
+                self._deny(ACTION_PREWARM, model, DENY_STALE)
+                return False
+        self._allow(ACTION_PREWARM, model)
         return True
 
     # -- last-known-good persistence / restart rehydration ---------------------

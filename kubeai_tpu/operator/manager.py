@@ -125,6 +125,7 @@ class Manager:
         # /v1/usage from them.
         from kubeai_tpu.fleet import (
             CapacityPlanner,
+            DemandForecaster,
             FleetStateAggregator,
             UsageMeter,
         )
@@ -177,6 +178,11 @@ class Manager:
                 ),
                 preemption_enabled=self.cfg.capacity_planning.preemption,
                 governor=self.governor,
+                # Predictive prewarm + cold-start-priced preemption:
+                # the forecaster reads the aggregator's snapshot ring,
+                # the planner orders warm replicas ahead of forecast
+                # spikes (docs/concepts/cold-start.md).
+                forecaster=DemandForecaster(self.fleet),
             )
             # Plan desires smooth over the SAME moving average the
             # direct scaling path uses — abundant chips must mean the
@@ -274,6 +280,8 @@ class Manager:
         )
 
         self._self_pod_name = f"kubeai-{self.identity}"
+        # ungoverned: the operator's own bookkeeping self-pod, not
+        # serving capacity (scripts/check_actuation_paths.py)
         self.store.create(
             {
                 "apiVersion": "v1",

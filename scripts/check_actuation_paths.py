@@ -9,7 +9,15 @@ self-harm hole PR 8 closed. This gate scans kubeai_tpu/ for:
 
   - Pod deletions: `.delete("Pod"` / `.delete_all_of("Pod"` (literal
     kind, possibly across a line break);
-  - replica-spec writes: `spec["replicas"] = ...`.
+  - replica-spec writes: `spec["replicas"] = ...`;
+  - Pod creations: `.create(pod)` / `.create({..."kind": "Pod"...})` —
+    creation is fenced (`governor.create_pod`), and predictive prewarm
+    makes it an automated path, not just reconcile;
+  - prewarm grants: a `["prewarm"] = ...` allocation write anywhere but
+    the capacity planner, and — checked structurally — the planner's own
+    grant site must sit in a function that consults
+    `governor.allow_prewarm`, so the prewarm gate can't be silently
+    dropped while the metric-shaped plumbing stays green.
 
 A hit is a violation unless it is
 
@@ -27,6 +35,7 @@ wires it in so a new unguarded actuation path fails CI.
 
 from __future__ import annotations
 
+import ast
 import os
 import re
 import sys
@@ -44,9 +53,18 @@ _PATTERNS = (
     re.compile(r"\.delete\(\s*[\"']Pod[\"']", re.S),
     re.compile(r"\.delete_all_of\(\s*[\"']Pod[\"']", re.S),
     re.compile(r"spec\[[\"']replicas[\"']\]\s*=", re.S),
+    re.compile(r"\.create\(\s*pod\b", re.S),
+    re.compile(r"\.create\(\s*\{[^{}]*?[\"']kind[\"']\s*:\s*[\"']Pod[\"']", re.S),
 )
 
 _PRAGMA = re.compile(r"#\s*(un)?governed\b")
+
+# Prewarm grants are pod creations by another name: the planner writes
+# `e["prewarm"] = granted` and the controller materializes the extra
+# replicas. Only the planner may write one, and only behind the gate.
+_PREWARM_WRITE = re.compile(r"\[\s*[\"']prewarm[\"']\s*\]\s*=")
+_PREWARM_HOME = os.path.join("fleet", "planner.py")
+_PREWARM_GATE = "allow_prewarm"
 
 
 def _exempt_file(rel: str) -> bool:
@@ -60,6 +78,57 @@ def _has_pragma(lines: list[str], lineno: int) -> bool:
         if _PRAGMA.search(lines[i]):
             return True
     return False
+
+
+def _prewarm_violations(rel: str, text: str, lines: list[str]) -> list[str]:
+    """Prewarm-grant writes outside the planner are violations; inside
+    the planner each write must live in a function that consults the
+    governor's `allow_prewarm` gate."""
+    hits = []
+    for m in _PREWARM_WRITE.finditer(text):
+        n = text.count("\n", 0, m.start()) + 1
+        # `["prewarm"] = 0` is the plan-record zero-reset, not a grant.
+        if re.search(r"\]\s*=\s*0\s*(#.*)?$", text.splitlines()[n - 1]):
+            continue
+        hits.append(n)
+    if not hits:
+        return []
+    if not rel.endswith(_PREWARM_HOME):
+        return [
+            f"{rel}:{n}: prewarm grant written outside the capacity "
+            f"planner `{lines[n - 1].strip()[:80]}` — prewarm orders "
+            "belong to CapacityPlanner._prewarm_pass, behind "
+            "governor.allow_prewarm"
+            for n in hits
+            if not _has_pragma(lines, n)
+        ]
+    violations = []
+    funcs = [
+        node
+        for node in ast.walk(ast.parse(text))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for n in hits:
+        owners = [
+            f for f in funcs if f.lineno <= n <= (f.end_lineno or f.lineno)
+        ]
+        if not owners:
+            violations.append(
+                f"{rel}:{n}: prewarm grant written at module level — "
+                "move it behind governor.allow_prewarm"
+            )
+            continue
+        body = "\n".join(
+            lines[min(f.lineno for f in owners) - 1:
+                  max(f.end_lineno or f.lineno for f in owners)]
+        )
+        if _PREWARM_GATE not in body:
+            violations.append(
+                f"{rel}:{n}: prewarm grant in a function that never "
+                f"consults governor.{_PREWARM_GATE} — the prewarm gate "
+                "has been dropped"
+            )
+    return violations
 
 
 def check(pkg: str = PKG) -> list[str]:
@@ -91,6 +160,7 @@ def check(pkg: str = PKG) -> list[str]:
                         "ActuationGovernor (operator/governor.py) or "
                         "annotate `# governed:`/`# ungoverned: <reason>`"
                     )
+            violations.extend(_prewarm_violations(rel, text, lines))
     return sorted(set(violations))
 
 

@@ -98,6 +98,14 @@ def pytest_configure(config):
         "wire byte-identity and dtype-mismatch refusal, capacity/bytes "
         "sim (runs in the fast tier; select with -m kvquant)",
     )
+    config.addinivalue_line(
+        "markers",
+        "coldstart: serverless-grade cold-start suite — snapshot "
+        "publish/restore round-trips, restore-vs-full-load token "
+        "identity, objstore retry/resume, demand forecaster, planner "
+        "prewarm, fake-clock cold-start sim (runs in the fast tier; "
+        "select with -m coldstart)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -255,7 +255,7 @@ def test_unknown_budget_plans_unconstrained():
     assert rec["preempted_replicas"] == 0
     assert p.allocation_for("m") == {
         "replicas": rec["allocated_replicas"], "class": "batch",
-        "plan_ts": plan["ts"],
+        "plan_ts": plan["ts"], "prewarm_replicas": 0,
     }
 
 

@@ -1,0 +1,53 @@
+"""Tier-1 gate on the deterministic cold-start sim: the restore-vs-
+full-load speedup claim (>= 5x in the phase model), the prewarm claim
+(forecast-ordered replica Ready before the spike, zero realtime
+queue-pressure breaches vs a baseline underwater from the spike on),
+the safety claims (a fingerprint-mismatched snapshot never serves; a
+fenced or telemetry-stale governor zeroes every prewarm grant), and the
+arbitration claim (preemption lands on the cheap-restore model) hold on
+every run — and the sim itself is deterministic."""
+
+import pytest
+
+from benchmarks.coldstart_sim import (
+    ALL_CHECKS,
+    BOOT_FULL_S,
+    BOOT_RESTORE_S,
+    run_sim,
+)
+
+pytestmark = pytest.mark.coldstart
+
+
+@pytest.fixture(scope="module")
+def result():
+    return run_sim()
+
+
+@pytest.mark.parametrize("check", ALL_CHECKS, ids=lambda c: c.__name__)
+def test_invariant(result, check):
+    check(result)
+
+
+def test_phase_model_matches_measured_totals(result):
+    # The worlds' boot latencies are the tracker-measured totals, not
+    # independent constants — retuning the phase model retunes both.
+    assert result["boot"]["full_s"] == BOOT_FULL_S
+    assert result["boot"]["restore_s"] == BOOT_RESTORE_S
+    assert BOOT_FULL_S >= 5.0 * BOOT_RESTORE_S
+
+
+def test_sim_is_deterministic(result):
+    again = run_sim()
+    assert again["warm"]["breach_ticks"] == result["warm"]["breach_ticks"]
+    assert again["cold"]["breach_ticks"] == result["cold"]["breach_ticks"]
+    assert again["warm"]["first_prewarm"] == result["warm"]["first_prewarm"]
+    assert again["warm"]["trajectory"] == result["warm"]["trajectory"]
+
+
+def test_warm_world_restore_cost_feeds_the_plan(result):
+    # The planner's published cold-start price is the replicas' measured
+    # restore boot, not the conservative default.
+    rec = result["warm"]["last_record"]
+    assert rec["coldstart_cost_s"] == BOOT_RESTORE_S
+    assert rec["forecast"]["restore_available"] is True

@@ -718,6 +718,38 @@ def test_actuation_path_gate_catches_new_unguarded_site(tmp_path):
     assert "rogue.py" in violations[0]
 
 
+def test_actuation_path_gate_catches_prewarm_paths(tmp_path):
+    """The prewarm extension: rogue pod creations, prewarm grants
+    outside the planner, and a planner grant site that lost its
+    governor.allow_prewarm consultation all fail the gate; a zero-reset
+    and a gated grant pass."""
+    pkg = tmp_path / "kubeai_tpu"
+    (pkg / "fleet").mkdir(parents=True)
+    (pkg / "rogue_create.py").write_text(
+        "def f(store, pod):\n    store.create(pod)\n"
+    )
+    (pkg / "rogue_grant.py").write_text(
+        'def f(e):\n    e["prewarm"] = 3\n'
+    )
+    (pkg / "fleet" / "planner.py").write_text(
+        "class P:\n"
+        "    def reset(self, e):\n"
+        '        e["prewarm"] = 0\n'  # zero-reset: not a grant
+        "    def gated(self, e):\n"
+        "        if self.governor.allow_prewarm(e['model']):\n"
+        '            e["prewarm"] = 2\n'
+        "    def dropped_gate(self, e):\n"
+        '        e["prewarm"] = 5\n'
+    )
+    violations = _load_gate().check(pkg=str(pkg))
+    assert len(violations) == 3
+    assert any("rogue_create.py" in v for v in violations)
+    assert any("rogue_grant.py" in v for v in violations)
+    assert any(
+        "planner.py" in v and "allow_prewarm" in v for v in violations
+    )
+
+
 # ---- chaos-sim invariants (the PR's acceptance criteria) ---------------------
 
 

@@ -176,3 +176,40 @@ def test_kubeai_tpu_renderer_scheduling_flags(cfg):
     assert "--default-priority" not in plain
     assert "--queue-shares" not in plain
     assert "--max-deadline-ms" not in plain
+
+
+@pytest.mark.coldstart
+def test_kubeai_tpu_renderer_coldstart_flags_and_probe(cfg):
+    from kubeai_tpu.crd.model import ColdStart
+
+    m = mk(
+        "KubeAITPU", "hf://org/model",
+        cold_start=ColdStart(enabled=True, snapshot_url="gs://snaps/ai"),
+    )
+    c = container(render(cfg, m))
+    args = c["args"]
+    assert args[args.index("--snapshot-url") + 1] == "gs://snaps/ai"
+    assert "--snapshot-no-publish" not in args
+    # A snapshot-restoring boot skips conversion and most compilation:
+    # the startup budget tightens from 3h to 30min.
+    sp = c["startupProbe"]
+    assert sp["periodSeconds"] * sp["failureThreshold"] <= 30 * 60
+
+    # publish=false renders the restore-only flag.
+    m2 = mk(
+        "KubeAITPU", "hf://org/model",
+        cold_start=ColdStart(
+            enabled=True, snapshot_url="gs://snaps/ai", publish=False,
+        ),
+    )
+    assert "--snapshot-no-publish" in container(render(cfg, m2))["args"]
+
+
+@pytest.mark.coldstart
+def test_kubeai_tpu_renderer_no_coldstart_keeps_slow_budget(cfg):
+    c = container(render(cfg, mk("KubeAITPU", "hf://org/model")))
+    assert "--snapshot-url" not in c["args"]
+    assert "--snapshot-no-publish" not in c["args"]
+    # Without snapshots the generous full-load budget stays.
+    sp = c["startupProbe"]
+    assert sp["periodSeconds"] * sp["failureThreshold"] >= 3 * 3600

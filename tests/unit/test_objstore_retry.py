@@ -1,0 +1,286 @@
+"""Object-store transient-failure discipline against a scripted flaky
+transport: 5xx retried with capped exponential backoff + full jitter,
+connection resets and mid-stream short reads retried with Range-resume,
+and every retry counted into kubeai_objstore_retries_total."""
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubeai_tpu import loader
+from kubeai_tpu import objstore
+from kubeai_tpu.metrics.registry import Metrics
+
+pytestmark = pytest.mark.coldstart
+
+
+class FlakyGCS:
+    """GCS download/list subset with scripted faults: `fail_next` 503
+    responses, `reset_next` connections dropped before any response,
+    `truncate_next` bytes of a response body sent before the socket
+    closes (Content-Length still claims the full object). Every
+    download GET is recorded in `gets` as (name, Range-or-None);
+    nonzero Range values additionally land in `ranges`."""
+
+    def __init__(self):
+        self.objects: dict[tuple[str, str], bytes] = {}
+        self.fail_next = 0
+        self.reset_next = 0
+        self.truncate_next: int | None = None
+        self.ranges: list[str] = []
+        self.gets: list[tuple[str, str | None]] = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, status, body=b"", ctype="application/json"):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                parts = parsed.path.split("/")
+                if parsed.path.startswith("/storage/v1/b/"):
+                    bucket = parts[4]
+                    prefix = urllib.parse.parse_qs(parsed.query).get(
+                        "prefix", [""]
+                    )[0]
+                    items = [
+                        {"name": n, "size": len(d)}
+                        for (b, n), d in sorted(outer.objects.items())
+                        if b == bucket and n.startswith(prefix)
+                    ]
+                    return self._send(
+                        200, json.dumps({"items": items}).encode()
+                    )
+                if not parsed.path.startswith("/download/storage/v1/b/"):
+                    return self._send(404, b"{}")
+                outer.gets.append(
+                    (
+                        urllib.parse.unquote(parts[7]),
+                        self.headers.get("Range"),
+                    )
+                )
+                if outer.reset_next > 0:
+                    outer.reset_next -= 1
+                    self.connection.close()
+                    return
+                if outer.fail_next > 0:
+                    outer.fail_next -= 1
+                    return self._send(503, b"backend unavailable")
+                bucket = parts[5]
+                name = urllib.parse.unquote(parts[7])
+                data = outer.objects.get((bucket, name))
+                if data is None:
+                    return self._send(404, b"{}")
+                status = 200
+                rng = self.headers.get("Range")
+                if rng:
+                    outer.ranges.append(rng)
+                    start = int(rng.split("=")[1].split("-")[0])
+                    data = data[start:]
+                    status = 206
+                if outer.truncate_next is not None:
+                    k, outer.truncate_next = outer.truncate_next, None
+                    self.send_response(status)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data[:k])
+                    self.wfile.flush()
+                    self.connection.close()
+                    return
+                return self._send(status, data, "application/octet-stream")
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    @property
+    def endpoint(self):
+        return f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture
+def flaky(monkeypatch):
+    fake = FlakyGCS()
+    monkeypatch.setenv("STORAGE_EMULATOR_HOST", fake.endpoint)
+    monkeypatch.setattr(objstore, "RETRY_SLEEP", lambda s: None)
+    yield fake
+    fake.close()
+
+
+# ---- with_retries unit surface -----------------------------------------------
+
+
+def test_backoff_doubles_then_caps():
+    delays = []
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] <= 8:
+            raise objstore.TransientStoreError("503")
+        return "ok"
+
+    # rng pinned to 0.5 makes the full-jitter factor exactly 1.0, so
+    # the raw schedule shows: base * 2^i, capped at RETRY_CAP_S.
+    assert objstore.with_retries(
+        "t", fn, attempts=8, sleep=delays.append, rng=lambda: 0.5
+    ) == "ok"
+    assert delays == [0.2, 0.4, 0.8, 1.6, 3.2, 6.4, 8.0, 8.0]
+
+
+def test_backoff_full_jitter_bounds():
+    for rng_val, factor in ((0.0, 0.5), (1.0, 1.5)):
+        delays = []
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ConnectionResetError("reset")
+            return 1
+
+        objstore.with_retries(
+            "t", fn, attempts=2, sleep=delays.append,
+            rng=lambda v=rng_val: v,
+        )
+        assert delays == [pytest.approx(0.2 * factor)]
+
+
+def test_non_transient_raises_immediately():
+    delays = []
+    before = objstore.RETRIES["total"]
+    with pytest.raises(ValueError):
+        objstore.with_retries(
+            "t", lambda: (_ for _ in ()).throw(ValueError("bad")),
+            attempts=5, sleep=delays.append,
+        )
+    assert delays == []
+    assert objstore.RETRIES["total"] == before
+
+
+def test_exhausted_attempts_raise_last_error():
+    delays = []
+    with pytest.raises(objstore.TransientStoreError):
+        objstore.with_retries(
+            "t", lambda: (_ for _ in ()).throw(
+                objstore.TransientStoreError("always")
+            ),
+            attempts=3, sleep=delays.append, rng=lambda: 0.5,
+        )
+    assert len(delays) == 3
+
+
+def test_retry_count_flows_to_metric():
+    before = objstore.RETRIES["total"]
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise TimeoutError("slow")
+        return 1
+
+    objstore.with_retries("t", fn, attempts=4, sleep=lambda s: None)
+    assert objstore.RETRIES["total"] == before + 2
+    m = Metrics()
+    lines = m.objstore_retries.collect()
+    assert m.objstore_retries.get() == before + 2
+    assert any(
+        line.startswith("kubeai_objstore_retries_total") for line in lines
+    )
+
+
+# ---- flaky transport ---------------------------------------------------------
+
+
+def test_get_to_file_survives_5xx(flaky, tmp_path):
+    flaky.objects[("bkt", "w.bin")] = b"x" * 1024
+    flaky.fail_next = 2
+    before = objstore.RETRIES["total"]
+    dest = str(tmp_path / "w.bin")
+    objstore.GCSClient().get_to_file("bkt", "w.bin", dest)
+    assert open(dest, "rb").read() == b"x" * 1024
+    assert objstore.RETRIES["total"] == before + 2
+
+
+def test_get_to_file_survives_connection_reset(flaky, tmp_path):
+    flaky.objects[("bkt", "w.bin")] = b"y" * 2048
+    flaky.reset_next = 1
+    dest = str(tmp_path / "w.bin")
+    objstore.GCSClient().get_to_file("bkt", "w.bin", dest)
+    assert open(dest, "rb").read() == b"y" * 2048
+
+
+def test_midstream_cut_resumes_with_range(flaky, tmp_path):
+    """A short read after the first full chunk must NOT restart from
+    byte 0: the retry re-requests `bytes=<on-disk>-` and appends."""
+    data = bytes(range(256)) * ((objstore.CHUNK + 4096) // 256)
+    flaky.objects[("bkt", "big.bin")] = data
+    flaky.truncate_next = objstore.CHUNK  # one full chunk, then cut
+    dest = str(tmp_path / "big.bin")
+    objstore.GCSClient().get_to_file("bkt", "big.bin", dest)
+    assert open(dest, "rb").read() == data
+    assert f"bytes={objstore.CHUNK}-" in flaky.ranges
+
+
+def test_exhausted_5xx_surfaces_transient_error(flaky, tmp_path, monkeypatch):
+    monkeypatch.setattr(objstore, "RETRY_ATTEMPTS", 1)
+    flaky.objects[("bkt", "w.bin")] = b"z"
+    flaky.fail_next = 5
+    with pytest.raises(objstore.TransientStoreError):
+        objstore.GCSClient().get_to_file(
+            "bkt", "w.bin", str(tmp_path / "w.bin")
+        )
+
+
+# ---- loader edge cases -------------------------------------------------------
+
+
+def test_loader_overwrites_stale_partial_on_disk(flaky, tmp_path):
+    """A partial file left behind by a crashed previous process must not
+    leak into the result: a fresh download truncates before writing."""
+    flaky.objects[("models", "m/w.bin")] = b"fresh-bytes" * 64
+    dest = tmp_path / "out"
+    dest.mkdir()
+    (dest / "w.bin").write_bytes(b"STALE-GARBAGE" * 999)
+    loader.download("gs://models/m", str(dest))
+    assert (dest / "w.bin").read_bytes() == b"fresh-bytes" * 64
+
+
+def test_loader_download_resumes_instead_of_restarting(flaky, tmp_path):
+    data = bytes(range(256)) * ((objstore.CHUNK + 8192) // 256)
+    flaky.objects[("models", "m/big.bin")] = data
+    flaky.truncate_next = objstore.CHUNK
+    dest = tmp_path / "out"
+    loader.download("gs://models/m", str(dest))
+    assert (dest / "big.bin").read_bytes() == data
+    # Exactly one from-scratch GET; the second request resumed from the
+    # bytes already on disk rather than redownloading the prefix.
+    assert flaky.gets == [
+        ("m/big.bin", None),
+        ("m/big.bin", f"bytes={objstore.CHUNK}-"),
+    ]
+
+
+def test_loader_bad_scheme_is_typed_error(tmp_path):
+    with pytest.raises(loader.UnsupportedSchemeError):
+        loader.download("ftp://host/thing", str(tmp_path))
+    with pytest.raises(loader.UnsupportedSchemeError):
+        loader.upload(str(tmp_path), "ftp://host/thing")
+    # The typed error is a store error, so cache-Job callers that trap
+    # ObjStoreError keep working; the CLI maps it to a nonzero exit.
+    assert issubclass(loader.UnsupportedSchemeError, objstore.ObjStoreError)
+    assert loader.main(["load", "ftp://host/thing", str(tmp_path)]) == 1
