@@ -186,7 +186,7 @@ def _parse_args(argv=None):
     )
     ap.add_argument(
         "--measure", default="decode",
-        choices=["decode", "prefill", "coldstart"],
+        choices=["decode", "prefill", "coldstart", "step-overlap"],
         help="what to measure: 'decode' = steady-state decode tok/s (the "
         "headline); 'prefill' = admission throughput in prompt tok/s over "
         "shared-prefix traffic — pair with/without --prefix-cache for the "
@@ -194,7 +194,10 @@ def _parse_args(argv=None):
         "prefix with small unique tails); 'coldstart' = boot-to-first-"
         "tokens with snapshot restore vs full load (two boots against a "
         "file:// snapshot store; reports the restore speedup and checks "
-        "greedy token identity between the two engines)",
+        "greedy token identity between the two engines); 'step-overlap' = "
+        "the same steady-state decode A/B'd with --step-overlap off vs on "
+        "(reports the speedup, both arms' tok/s and per-phase step "
+        "breakdown, and checks greedy token identity)",
     )
     ap.add_argument(
         "--prefix-cache", action="store_true",
@@ -303,6 +306,8 @@ def _child_main(args) -> None:
 
     if args.measure == "coldstart":
         return _measure_coldstart(args, cfg, model_name, backend_note)
+    if args.measure == "step-overlap":
+        return _measure_step_overlap(args, cfg, model_name, backend_note)
 
     prefill_chunk = args.prefill_chunk
     if prefill_chunk <= 0 and (
@@ -547,6 +552,109 @@ def _measure_coldstart(args, cfg, model_name, backend_note) -> None:
         "full_load_s": round(t_full, 3),
         "restore_s": round(t_restore, 3),
         "restored": bool(m2.tracker.restored),
+        "tokens_identical": identical,
+    }), flush=True)
+
+
+def _measure_step_overlap(args, cfg, model_name, backend_note) -> None:
+    """A/B the SAME steady-state decode with the overlapped dispatch/reap
+    pipeline off vs on, against identical seeded traffic. Reports the
+    speedup plus both arms' per-phase step breakdown — under overlap the
+    win shows up as overlap_idle (the block_until_ready wait) shrinking
+    while schedule/sample/readback hide behind device compute. Greedy
+    token identity is checked first: a faster pipeline that decodes
+    different tokens is a bug, not a win."""
+    import numpy as np
+
+    from kubeai_tpu.engine import Engine, EngineConfig
+    from kubeai_tpu.engine.sampling import SamplingParams
+    from kubeai_tpu.fleet.profiler import phase_totals
+    from kubeai_tpu.models import llama
+
+    params = llama.init_params(cfg)
+
+    def build(overlap: str) -> Engine:
+        return Engine(
+            "llama", cfg, params,
+            cfg=EngineConfig(
+                num_slots=args.slots,
+                max_seq_len=args.max_seq_len,
+                cache_mode=args.cache_mode,
+                decode_kernel=args.decode_kernel,
+                quantization=args.quantization,
+                kv_dtype=args.kv_dtype,
+                decode_chunk=max(1, args.decode_chunk),
+                prefill_chunk=max(0, args.prefill_chunk),
+                page_size=args.page_size,
+                step_overlap=overlap,
+            ),
+        )
+
+    engines = {"sync": build("off"), "overlap": build("on")}
+
+    # Identity smoke — doubles as the prefill/decode warm-up compile for
+    # both arms, so the timed windows below measure steady state only.
+    ident_prompts = [list(range(1, 1 + min(16, args.prompt_len))), [7, 8, 9]]
+    sp_ident = SamplingParams(temperature=0.0, max_tokens=16)
+    streams = [e.generate(ident_prompts, sp_ident) for e in engines.values()]
+    identical = streams[0] == streams[1]
+
+    gen_budget = args.max_seq_len - args.prompt_len
+    sp = SamplingParams(temperature=0.0, max_tokens=gen_budget)
+    arms: dict[str, dict] = {}
+    for name, eng in engines.items():
+        rng = np.random.default_rng(0)  # identical traffic per arm
+        for _ in range(args.slots):
+            if args.uniform_prompts:
+                plen = args.prompt_len
+            else:
+                lo = min(max(4, args.prompt_len // 4), args.prompt_len)
+                plen = int(rng.integers(lo, args.prompt_len + 1))
+            eng.add_request(
+                rng.integers(0, cfg.vocab_size, plen).tolist(), sp
+            )
+        eng.step()
+        while eng.num_pending and eng.has_work():
+            eng.step()
+        eng.step()
+        mark = len(eng.profiler.recent())
+        t0 = time.perf_counter()
+        tokens = steps = 0
+        dt = 0.0
+        full_batch = eng.num_active
+        steady = None
+        while eng.has_work():
+            tokens += len(eng.step())
+            steps += 1
+            dt = time.perf_counter() - t0
+            if eng.num_active < full_batch:
+                if steady is not None:
+                    tokens, dt = steady
+                break
+            steady = (tokens, dt)
+            if steps >= args.decode_steps:
+                break
+        phases = phase_totals(eng.profiler.recent()[mark:])
+        arms[name] = {
+            "toks_per_s": round(tokens / dt, 2) if dt > 0 else 0.0,
+            "phases_s": {k: round(v, 4) for k, v in sorted(phases.items())},
+        }
+
+    sync_tps = arms["sync"]["toks_per_s"]
+    over_tps = arms["overlap"]["toks_per_s"]
+    speedup = over_tps / sync_tps if sync_tps > 0 else 0.0
+    print(json.dumps({
+        "metric": f"{model_name} overlapped step pipeline vs sync decode, "
+        f"bs={args.slots}, {args.cache_mode} kv cache, "
+        f"chunk={max(1, args.decode_chunk)}"
+        + (" (smoke)" if args.smoke else "") + backend_note,
+        # An overlap arm that decoded different tokens is a failed
+        # measurement — not a speedup.
+        "value": round(speedup, 3) if identical else 0,
+        "unit": "x decode speedup",
+        "vs_baseline": 0,
+        "sync": arms["sync"],
+        "overlap": arms["overlap"],
         "tokens_identical": identical,
     }), flush=True)
 
@@ -798,10 +906,10 @@ def main() -> None:
     cpu_wd = min(args.watchdog_seconds, _cpu_reserve_s()) \
         if args.watchdog_seconds > 0 else _cpu_reserve_s()
 
-    if on_tpu and args.measure == "coldstart":
-        # No decode-kernel ladder for a boot measurement: run the
-        # requested config under the watchdog, fall back to CPU smoke
-        # scale like everything else.
+    if on_tpu and args.measure in ("coldstart", "step-overlap"):
+        # No decode-kernel ladder for a boot measurement or a self-
+        # contained A/B: run the requested config under the watchdog,
+        # fall back to CPU smoke scale like everything else.
         result = _run_measurement(argv, args.watchdog_seconds)
         if result is None:
             result = _run_measurement(

@@ -274,7 +274,8 @@ def run_decode_phases(dtype: str) -> dict:
         phases = {
             "schedule": 0.0002,
             "decode": decode_s,
-            "host_sync": 0.0004,
+            "overlap_idle": 0.0001,
+            "readback": 0.0003,
             "sample": 0.0003,
         }
         prof.observe_step(

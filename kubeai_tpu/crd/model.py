@@ -408,6 +408,34 @@ class KVCacheSpec:
             )
 
 
+STEP_OVERLAP_MODES = ("auto", "on", "off")
+
+
+@dataclasses.dataclass
+class EngineStep:
+    """Engine step-loop tuning (in-tree engine only). `overlap` drives
+    the overlapped step pipeline (engine flag --step-overlap): dispatch
+    decode chunk N+1 before reaping chunk N so readback, admission,
+    detokenize and SSE fan-out hide behind device compute —
+    token-identical to the synchronous loop. "auto" (the engine default)
+    overlaps wherever the topology allows and degrades to synchronous
+    for lockstep multihost and pipeline parallelism; "on" requires it
+    (the engine refuses with a typed error where unsupported); "off"
+    forces the synchronous loop."""
+
+    overlap: str = ""  # "" = engine default (auto)
+
+    def enabled(self) -> bool:
+        return bool(self.overlap)
+
+    def validate(self) -> None:
+        if self.overlap and self.overlap not in STEP_OVERLAP_MODES:
+            raise ValidationError(
+                "engineStep.overlap must be one of "
+                f"{list(STEP_OVERLAP_MODES)}"
+            )
+
+
 @dataclasses.dataclass
 class ModelSpec:
     """(reference: api/k8s/v1/model_types.go:36-144)"""
@@ -452,6 +480,8 @@ class ModelSpec:
     kv_cache: KVCacheSpec = dataclasses.field(default_factory=KVCacheSpec)
     # Engine snapshot/restore cold-start path (in-tree engine only).
     cold_start: ColdStart = dataclasses.field(default_factory=ColdStart)
+    # Engine step-loop tuning (overlapped step pipeline; in-tree only).
+    engine_step: EngineStep = dataclasses.field(default_factory=EngineStep)
     # Graceful-drain budget: seconds an engine waits for in-flight
     # generations after SIGTERM / POST /v1/drain before terminating the
     # remainder. 0 = the system config `resilience.drainTimeout`
@@ -551,6 +581,11 @@ class ModelSpec:
         if self.cold_start.enabled and self.engine != ENGINE_KUBEAI_TPU:
             raise ValidationError(
                 "spec.coldStart requires the KubeAITPU engine"
+            )
+        self.engine_step.validate()
+        if self.engine_step.enabled() and self.engine != ENGINE_KUBEAI_TPU:
+            raise ValidationError(
+                "spec.engineStep requires the KubeAITPU engine"
             )
         if self.kv_cache.dtype == "int8" and self.speculative_tokens:
             raise ValidationError(
@@ -720,6 +755,7 @@ class Model:
         kvs = spec.get("kvSharing", {}) or {}
         kvc = spec.get("kvCache", {}) or {}
         cold = spec.get("coldStart", {}) or {}
+        estep = spec.get("engineStep", {}) or {}
 
         def _role_scaling(key: str) -> RoleScaling:
             r = dis.get(key) or {}
@@ -838,6 +874,9 @@ class Model:
                     snapshot_url=cold.get("snapshotURL", ""),
                     publish=bool(cold.get("publish", True)),
                     prewarm=bool(cold.get("prewarm", True)),
+                ),
+                engine_step=EngineStep(
+                    overlap=estep.get("overlap", "") or "",
                 ),
             ),
             status=ModelStatus(
@@ -975,6 +1014,8 @@ def _spec_to_dict(s: ModelSpec) -> dict:
         }
     if s.kv_cache.enabled():
         d["kvCache"] = {"dtype": s.kv_cache.dtype}
+    if s.engine_step.enabled():
+        d["engineStep"] = {"overlap": s.engine_step.overlap}
     if s.cold_start.enabled:
         cold = s.cold_start
         d["coldStart"] = {

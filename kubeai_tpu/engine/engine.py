@@ -160,6 +160,19 @@ class EngineConfig:
     # with a second donated-buffer program in flight behind a pending
     # fetch; direct PJRT targets can enable it safely.
     pipeline: bool = False
+    # Overlapped step pipeline: "auto" (default — overlap ON wherever the
+    # topology allows it), "on" (require overlap; typed
+    # StepOverlapUnsupported where it can't run), "off" (synchronous
+    # loop). When on, step() dispatches decode chunk N+1 BEFORE reaping
+    # chunk N's tokens, so readback, scheduler admission, detokenize and
+    # SSE fan-out for chunk N run concurrently with chunk N+1's device
+    # compute. Conservative barriers (pending admissions, cancel/release,
+    # drain, handoff export/import, prefix-page export/import, and any
+    # speculation window) force a reap before state mutates, so greedy
+    # AND seeded streams are token-identical to the synchronous loop.
+    # Auto-off for pipeline parallelism (pp > 1) and lockstep multihost.
+    # Subsumes the legacy `pipeline` bool (pipeline=True == "on").
+    step_overlap: str = "auto"
     # Pipeline parallelism (mesh pp axis > 1): decode microbatch count for
     # the GPipe schedule. 0 = the pp stage count (steady-state utilization
     # M/(M+P-1); raise toward num_slots for higher utilization at smaller
@@ -184,6 +197,14 @@ class EngineConfig:
             return self.num_pages
         per_slot = -(-self.max_seq_len // self.page_size)
         return 1 + self.num_slots * per_slot  # +1: reserved scratch page 0
+
+
+class StepOverlapUnsupported(ValueError):
+    """step_overlap='on' requested in a topology that cannot overlap
+    (pipeline parallelism, lockstep multihost): a second in-flight
+    program would race the GPipe stage handoffs / desynchronize the
+    per-step cross-host broadcast. 'auto' degrades to the synchronous
+    loop instead of raising."""
 
 
 class StepEvent(NamedTuple):
@@ -291,8 +312,11 @@ class Engine:
         self._active: dict[int, _Request] = {}  # slot -> request
         self._requests: dict[int, _Request] = {}
         self._free_slots = list(range(cfg.num_slots))
-        # In-flight decode chunk (pipelined stepping): (token futures,
-        # snapshot of the slot->request map the chunk was dispatched with).
+        # In-flight decode chunk (overlapped stepping): (token futures,
+        # snapshot of the slot->request map the chunk was dispatched
+        # with, chunk length in model steps, monotonic dispatch time).
+        # The dispatch timestamp feeds the server watchdog: a dispatched
+        # chunk counts as progress until its own reap deadline ages out.
         self._inflight: tuple | None = None
         # Base entropy for unseeded requests (per-request seed = base ^ rid).
         self._seed_base = int.from_bytes(np.random.bytes(4), "little")
@@ -412,6 +436,41 @@ class Engine:
                     f"pp_microbatches={m}"
                 )
             self._pp_microbatches = m
+
+        # Overlapped stepping: resolve the tri-state knob against the
+        # topology. pp > 1 already fills the device with microbatch ticks
+        # inside ONE call and a second in-flight donated-buffer program
+        # would race the stage handoffs, so explicit "on" is a typed
+        # refusal and "auto" stays synchronous. (Lockstep multihost is
+        # enforced one level up — LockstepEngine / server main — because
+        # the engine cannot see its wrapper.)
+        overlap = cfg.step_overlap
+        if isinstance(overlap, bool):
+            overlap = "on" if overlap else "off"
+        overlap = (overlap or "auto").strip().lower()
+        if overlap not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unknown step_overlap {cfg.step_overlap!r} "
+                "(expected 'auto' | 'on' | 'off')"
+            )
+        if overlap == "auto" and cfg.pipeline:
+            overlap = "on"  # legacy knob: pipeline=True meant depth-1 overlap
+        if self._pp > 1:
+            if overlap == "on":
+                raise StepOverlapUnsupported(
+                    "step_overlap='on' does not compose with pipeline "
+                    "parallelism (pp>1): the GPipe decode schedule already "
+                    "keeps the device busy with microbatch ticks and a "
+                    "second in-flight program would race the stage "
+                    "handoffs; use step_overlap='auto' or 'off'"
+                )
+            overlap = "off"
+        # Resolved: the step loop overlaps unless something said no.
+        self._overlap = overlap != "off"
+        # Events reaped OUTSIDE step() (barrier reaps in cancel/drain/
+        # handoff/prefix paths): queued here, prepended to the next
+        # step()'s return so no token is ever dropped.
+        self._pending_events: list[StepEvent] = []
 
         # Quantize (optional), then shard params onto the mesh.
         specs = self.family.param_specs(model_cfg)
@@ -1541,6 +1600,9 @@ class Engine:
         """Stop admitting new requests; queued + active work continues
         until finished (or the server's drain budget terminates it)."""
         with self._lock:
+            # Overlap barrier: drain decisions (who is still running,
+            # what to terminate) must see fully-reaped state.
+            self._barrier_locked()
             self._draining = True
 
     @property
@@ -2151,21 +2213,29 @@ class Engine:
             req.finish_reason = "length"
         return req.done
 
-    def _ensure_decode_pages(self) -> None:
-        """Grow every active slot's pages to cover the next decode chunk.
-        Pool exhaustion preempts the YOUNGEST other request (recompute on
-        re-admission). Init guarantees the pool holds one full sequence,
-        so the loop always terminates with the oldest request served."""
-        from kubeai_tpu.engine.paged_cache import OutOfPages
-
-        # Lookahead: how far positions can advance in one device call.
-        # Adaptive speculation may run EITHER mode this step, so cover both.
+    def _decode_lookahead(self) -> int:
+        """How far positions can advance in one device call. Adaptive
+        speculation may run EITHER mode a given step, so cover both."""
         if self._spec:
             chunk = self._spec + 1
             if self.cfg.spec_adaptive:
                 chunk = max(chunk, max(1, self.cfg.decode_chunk))
-        else:
-            chunk = max(1, self.cfg.decode_chunk)
+            return chunk
+        return max(1, self.cfg.decode_chunk)
+
+    def _ensure_decode_pages(self, inflight_lag: int = 0) -> None:
+        """Grow every active slot's pages to cover the next decode chunk.
+        Pool exhaustion preempts the YOUNGEST other request (recompute on
+        re-admission). Init guarantees the pool holds one full sequence,
+        so the loop always terminates with the oldest request served.
+
+        `inflight_lag`: model steps of a dispatched-but-unreaped chunk.
+        Host positions LAG the device by that many tokens while a chunk
+        is in flight, so coverage extends past the lag or the overlapped
+        dispatch would decode into unallocated rows of the block table."""
+        from kubeai_tpu.engine.paged_cache import OutOfPages
+
+        chunk = self._decode_lookahead() + max(0, int(inflight_lag))
         for slot, req in sorted(
             self._active.items(), key=lambda kv: kv[1].rid
         ):
@@ -2252,6 +2322,10 @@ class Engine:
             req = self._requests.get(rid)
             if req is None:
                 return False
+            # Overlap barrier: freeing the slot/pages under an unreaped
+            # chunk would let admission reuse them before the reap; reap
+            # first so the release mutates fully-settled state.
+            self._barrier_locked()
             self._sched.remove(req)
             req.done = True
             req.finish_reason = "cancelled"
@@ -2367,6 +2441,9 @@ class Engine:
                 f"prompt length {plen} >= max_seq_len {self.cfg.max_seq_len}"
             )
         with self._lock:
+            # Overlap barrier: this borrows a slot + pages synchronously;
+            # an unreaped chunk's stop-driven frees must land first.
+            self._barrier_locked()
             if self._draining:
                 raise EngineDraining("engine is draining")
             if not self._free_slots:
@@ -2514,6 +2591,10 @@ class Engine:
             stop=tuple(handoff.stop),
         )
         with self._lock:
+            # Overlap barrier: handoff import admits a slot OUTSIDE
+            # _admit_pending (bypassing step()'s admission barrier), so
+            # reap here before the slot/page grant.
+            self._barrier_locked()
             if self._draining:
                 raise EngineDraining("engine is draining")
             adapter_idx = 0
@@ -2720,6 +2801,9 @@ class Engine:
         ps = self.cfg.page_size
         page_nbytes = self._page_wire_nbytes()
         with self._lock:
+            # Overlap barrier: the exported bytes must be a settled
+            # snapshot — an in-flight chunk is still WRITING pages.
+            self._barrier_locked()
             pages = self._alloc.lookup(hashes)
             if max_bytes > 0:
                 pages = pages[: max_bytes // page_nbytes]
@@ -2802,6 +2886,9 @@ class Engine:
         except ValueError as e:
             raise HandoffError(f"bad chain hash: {e}") from e
         with self._lock:
+            # Overlap barrier: seeding idle-pool pages races an unreaped
+            # chunk's frees/allocations — reap before touching the pool.
+            self._barrier_locked()
             seeded = self._alloc.seed_unowned(hashes)
             if seeded is None:
                 return 0
@@ -2942,39 +3029,82 @@ class Engine:
         """Admit pending prefills, then run one fused decode chunk
         (cfg.decode_chunk model steps in a single device call).
 
-        With cfg.pipeline, the chunk dispatched this call is fetched on the
-        NEXT call: the device computes chunk N+1 while the host fetches and
-        processes chunk N's tokens.
+        With step_overlap resolved on, the chunk dispatched this call is
+        reaped on the NEXT call: the device computes chunk N+1 while the
+        host reads back and processes chunk N's tokens (readback,
+        admission, detokenize, SSE fan-out all hide behind device
+        compute). Conservative barriers reap first wherever overlap
+        could change tokens — see _reap_inflight_locked.
 
         Returns a list of StepEvents in emission order.
         """
         with self._lock:
             # Per-phase timeline for this step (fleet/profiler.py):
             # prefill = admission pass, schedule = host bookkeeping
-            # before the decode dispatch, decode = jit DISPATCH (async;
-            # the device wait lands in host_sync at device_get inside
+            # before the decode dispatch, dispatch = block-table upload,
+            # decode = jit DISPATCH (async; the device wait lands in
+            # overlap_idle and the transfer in readback inside
             # _process_chunk), sample = host token emission.
             phases: dict[str, float] = {}
             self._phase_scratch = phases
+            emitted: list[StepEvent] = []
+            if self._pending_events:
+                # Tokens reaped by an out-of-step barrier (cancel, drain,
+                # handoff, prefix fetch) — deliver before this step's.
+                emitted.extend(self._pending_events)
+                self._pending_events.clear()
+            # ADMISSION BARRIER: a pending prompt's slot/page grant must
+            # observe the in-flight chunk's stop-driven slot frees (and a
+            # preempted request's re-prefill must see its full out_tokens),
+            # so reap before admitting. Also reap before any speculation
+            # window: prompt-lookup proposals read out_tokens.
+            if self._inflight is not None and (len(self._sched) or self._spec):
+                emitted.extend(self._reap_inflight_locked())
             _admit_t0 = time.perf_counter()
-            emitted = self._admit_pending()
-            phases["prefill"] = time.perf_counter() - _admit_t0
+            emitted.extend(self._admit_pending())
+            phases["prefill"] = (
+                phases.get("prefill", 0.0)
+                + (time.perf_counter() - _admit_t0)
+            )
             prev = self._inflight
             self._inflight = None
             current = None
             decode_mode = None
             t0 = time.perf_counter()
             _dec_t0 = t0
+            if self._active and prev is not None:
+                # SEQ-CAP BARRIER: dispatching chunk N+1 before reaping N
+                # advances device positions by up to len(N) + chunk. If
+                # any slot could cross max_seq_len in that window its
+                # decode would write past its block-table row, so reap
+                # first — the dispatch below then overshoots by at most
+                # one chunk, exactly the envelope the synchronous loop
+                # already tolerates (surplus tokens are discarded).
+                horizon = prev[2] + self._decode_lookahead() + 1
+                if any(
+                    req.position + horizon >= self.cfg.max_seq_len
+                    for req in self._active.values()
+                ):
+                    emitted.extend(self._process_chunk(prev))
+                    prev = None
             if self._active:
                 if self.cache_mode == "paged":
-                    self._ensure_decode_pages()
+                    self._ensure_decode_pages(
+                        inflight_lag=prev[2] if prev is not None else 0
+                    )
                     if self._bt_dirty:
+                        _disp_t0 = time.perf_counter()
                         self.cache.block_tables = jax.device_put(
                             jnp.asarray(self._bt_host), self._bt_sharding
                         )
                         self._bt_dirty = False
+                        self._note_phase(
+                            "dispatch", time.perf_counter() - _disp_t0
+                        )
                     _dec_t0 = time.perf_counter()
-                    phases["schedule"] = _dec_t0 - t0
+                    phases["schedule"] = (
+                        _dec_t0 - t0 - phases.get("dispatch", 0.0)
+                    )
                     if self._spec and self._spec_pick():
                         decode_mode = "spec"
                         if self._draft:
@@ -3049,10 +3179,20 @@ class Engine:
                     + (time.perf_counter() - _dec_t0)
                 )
                 self._steps += 1
-                current = (toks_seq, list(self._active.items()))
-                if self.cfg.pipeline:
-                    # Fetch current NEXT call: device computes through the
-                    # host's fetch+process of prev.
+                is_spec = isinstance(toks_seq, tuple)
+                chunk_len = 0 if is_spec else int(toks_seq.shape[0])
+                current = (
+                    toks_seq,
+                    list(self._active.items()),
+                    chunk_len,
+                    time.monotonic(),
+                )
+                if self._overlap and not is_spec and not self._spec:
+                    # Reap current NEXT call: the device computes through
+                    # the host's readback+process of prev. Speculation
+                    # windows never overlap — proposals read out_tokens,
+                    # and the adaptive arm needs the measured wall time of
+                    # every chunk call.
                     self._inflight = current
                     current = None
             if prev is not None:
@@ -3075,17 +3215,33 @@ class Engine:
             self._sched.observe_service(finished, step_s)
             # Per-decode-step snapshot for the serve loop's gauges. Plain
             # attribute write (already under the engine lock): the metrics
-            # registry is never touched from this hot path.
-            self.last_step_stats = {
-                "batch_size": len(self._active),
-                "waiting": len(self._sched),
-                "tokens": len(emitted),
-                "duration_s": step_s,
-            }
+            # registry is never touched from this hot path. The overlap
+            # tail — reaping a chunk whose every row finished last step,
+            # emitting nothing, with no work left — must not clobber the
+            # final real step's numbers with zeros.
+            if (
+                emitted
+                or self._active
+                or len(self._sched)
+                or current is not None
+                or self._inflight is not None
+            ):
+                self.last_step_stats = {
+                    "batch_size": len(self._active),
+                    "waiting": len(self._sched),
+                    "tokens": len(emitted),
+                    "duration_s": step_s,
+                }
             self._phase_scratch = None
             # Record only steps that DID something — an idle poll's
-            # all-zero timeline would just dilute the ring.
-            if emitted or current is not None or prev is not None:
+            # all-zero timeline would just dilute the ring. A dispatch-
+            # only step (overlap holding its first chunk) counts.
+            if (
+                emitted
+                or current is not None
+                or prev is not None
+                or self._inflight is not None
+            ):
                 self.profiler.observe_step(
                     phases,
                     tokens=len(emitted),
@@ -3094,13 +3250,63 @@ class Engine:
                 )
             return emitted
 
+    def _reap_inflight_locked(self) -> list[StepEvent]:
+        """Reap the dispatched-but-unreaped chunk NOW (caller holds the
+        engine lock). The conservative barrier behind every mutation that
+        must observe the chunk's tokens or slot frees: pending
+        admissions, cancel, drain, handoff export/import, prefix-page
+        export/import, speculation windows. Returns the chunk's events."""
+        inflight = self._inflight
+        if inflight is None:
+            return []
+        self._inflight = None
+        return self._process_chunk(inflight)
+
+    def _barrier_locked(self) -> None:
+        """Barrier for callers OUTSIDE step() (cancel/drain/handoff/
+        prefix paths, under the engine lock): reap the in-flight chunk
+        and queue its events for the next step() so no token is lost."""
+        evs = self._reap_inflight_locked()
+        if evs:
+            self._pending_events.extend(evs)
+
+    def inflight_info(self) -> dict | None:
+        """Snapshot of the dispatched-but-unreaped chunk for the server
+        watchdog: {"dispatched_at": monotonic seconds} or None. Lock-free
+        read of an atomically swapped tuple — safe from the watchdog
+        thread."""
+        inflight = self._inflight
+        if inflight is None or len(inflight) < 4:
+            return None
+        return {"dispatched_at": inflight[3]}
+
     def _process_chunk(self, inflight: tuple) -> list[StepEvent]:
-        toks_seq, chunk_slots = inflight
+        toks_seq, chunk_slots = inflight[0], inflight[1]
         if isinstance(toks_seq, tuple) and toks_seq[0] == "spec":
             return self._process_spec(toks_seq[1], toks_seq[2], chunk_slots)
+        cols = [slot for slot, req in chunk_slots if not req.done]
+        if not cols:
+            return []  # every rider cancelled since dispatch — no transfer
+        col_of = None
+        if len(cols) < int(toks_seq.shape[1]):
+            # Slice to the ACTIVE rows on-device before the host
+            # transfer: the decode chunk is a padded [chunk, B] buffer
+            # and fetching dead columns ships chunk*(B-A) junk tokens
+            # per step. The gather is a dependent device op, so timing
+            # block_until_ready on its output still measures the chunk's
+            # compute wait.
+            toks_seq = jnp.take(
+                toks_seq, jnp.asarray(cols, jnp.int32), axis=1
+            )
+            col_of = {slot: i for i, slot in enumerate(cols)}
+        _wait_t0 = time.perf_counter()
+        toks_seq = jax.block_until_ready(toks_seq)
+        # Device compute the host could NOT hide: ~the whole device step
+        # in the synchronous loop, →0 under perfect overlap.
+        self._note_phase("overlap_idle", time.perf_counter() - _wait_t0)
         _sync_t0 = time.perf_counter()
-        toks_seq = np.asarray(jax.device_get(toks_seq))  # [chunk, B]
-        self._note_phase("host_sync", time.perf_counter() - _sync_t0)
+        toks_seq = np.asarray(jax.device_get(toks_seq))  # [chunk, A]
+        self._note_phase("readback", time.perf_counter() - _sync_t0)
         _sample_t0 = time.perf_counter()
         emitted: list[StepEvent] = []
         for k in range(toks_seq.shape[0]):
@@ -3111,7 +3317,9 @@ class Engine:
             for slot, req in chunk_slots:
                 if req.done:
                     continue  # surplus chunk tokens discarded
-                tok = int(toks_seq[k, slot])
+                tok = int(
+                    toks_seq[k, slot if col_of is None else col_of[slot]]
+                )
                 if req.t_prev_token:
                     self._timing.append(
                         ("itl", max(0.0, now - req.t_prev_token))
@@ -3144,11 +3352,11 @@ class Engine:
         _sync_t0 = time.perf_counter()
         # ONE fused transfer for both outputs: two sequential device_get
         # calls would pay the host round trip twice per verify step and
-        # charge host_sync for both (a profiler test pins this to one).
+        # charge readback for both (a profiler test pins this to one).
         choices, n_emit = jax.device_get((choices, n_emit))
         choices = np.asarray(choices)  # [B, γ+1]
         n_emit = np.asarray(n_emit)  # [B]
-        self._note_phase("host_sync", time.perf_counter() - _sync_t0)
+        self._note_phase("readback", time.perf_counter() - _sync_t0)
         _sample_t0 = time.perf_counter()
         emitted: list[StepEvent] = []
         now = _now()  # one verify forward produced the whole window

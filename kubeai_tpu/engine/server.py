@@ -281,9 +281,12 @@ class EngineMetrics:
         self.step_phase = Histogram(
             "kubeai_engine_step_phase_seconds",
             "Wall time per engine-step phase (label `phase`: schedule / "
-            "prefill / decode / sample / host_sync / kv_transfer) — "
-            "the per-phase answer to 'why is ITL high'. decode is the "
-            "async jit DISPATCH; the device wait surfaces as host_sync.",
+            "prefill / decode / dispatch / overlap_idle / readback / "
+            "sample / kv_transfer) — the per-phase answer to 'why is ITL "
+            "high'. decode is the async jit DISPATCH; the device wait "
+            "surfaces as overlap_idle at reap (shrinking toward zero "
+            "under the overlapped step pipeline) and the token transfer "
+            "as readback.",
             self.registry,
             buckets=ITL_BUCKETS_S,
         )
@@ -913,7 +916,24 @@ class EngineServer:
                 # Work just (re)appeared: stall time counts from here,
                 # not from a _last_progress stamped before an idle gap.
                 busy_since = now
-            stalled_for = now - max(self._last_progress, busy_since)
+            anchor = max(self._last_progress, busy_since)
+            # Overlapped stepping: a dispatched-but-unreaped chunk IS
+            # progress — the device is computing and the host will reap
+            # on the next step — but only within its own reap deadline
+            # (the same watchdog budget). An in-flight chunk older than
+            # that means the reap itself is wedged (hung dispatch, dead
+            # tunnel) and must still trip the restart.
+            info_fn = getattr(self.engine, "inflight_info", None)
+            if info_fn is not None:
+                try:
+                    info = info_fn()
+                except Exception:
+                    info = None
+                if info:
+                    dispatched_at = float(info.get("dispatched_at", 0.0))
+                    if now - dispatched_at <= self.watchdog_timeout:
+                        anchor = max(anchor, dispatched_at)
+            stalled_for = now - anchor
             if stalled_for <= self.watchdog_timeout:
                 continue
             self._wedged = True
@@ -2372,7 +2392,17 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--pipeline", action="store_true",
-        help="overlap decode chunks with host processing (direct PJRT targets)",
+        help="legacy alias for --step-overlap on (overlap decode chunks "
+        "with host processing; direct PJRT targets)",
+    )
+    ap.add_argument(
+        "--step-overlap", choices=["auto", "on", "off"], default="auto",
+        help="overlapped step pipeline: dispatch decode chunk N+1 before "
+        "reaping chunk N so readback/admission/detokenize/SSE hide "
+        "behind device compute (token-identical to the synchronous "
+        "loop). auto = on wherever the topology allows (off for "
+        "lockstep multihost and pipeline parallelism); on = require it "
+        "(typed error where unsupported) (CRD engineStep.overlap)",
     )
     ap.add_argument(
         "--speculate", type=int, default=0,
@@ -2504,6 +2534,23 @@ def main(argv=None) -> int:
         args.prefix_cache = True
     if args.prefix_cache and args.prefill_chunk <= 0:
         args.prefill_chunk = max(32, min(512, args.max_seq_len // 4))
+    if args.num_processes > 1:
+        # Lockstep multihost: every host must replay the SAME op/step
+        # sequence; an overlapped reap would reorder host 0's broadcast
+        # schedule relative to the workers'. Refuse an explicit "on"
+        # (typed — the operator asked for something this topology cannot
+        # do), auto-off otherwise — BEFORE EngineConfig is built, so the
+        # worker hosts' engines resolve identically to host 0's.
+        from kubeai_tpu.engine.engine import StepOverlapUnsupported
+
+        if args.step_overlap == "on" or args.pipeline:
+            raise StepOverlapUnsupported(
+                "--step-overlap on does not compose with lockstep "
+                "multihost (--num-processes > 1): the overlapped reap "
+                "would desynchronize the per-step cross-host broadcast; "
+                "use --step-overlap auto or off"
+            )
+        args.step_overlap = "off"
 
     logging.basicConfig(level=logging.INFO)
     log = logging.getLogger("kubeai-tpu-engine")
@@ -2579,6 +2626,7 @@ def main(argv=None) -> int:
         max_adapters=args.max_adapters,
         decode_chunk=args.decode_chunk,
         pipeline=args.pipeline,
+        step_overlap=args.step_overlap,
         quantization=args.quantization,
         kv_dtype=args.kv_dtype,
         speculate=args.speculate,

@@ -6,15 +6,26 @@ answer to "why is ITL high" required guesswork. The profiler breaks each
 step into phases:
 
   schedule   — host-side bookkeeping before the decode dispatch (page
-               allocation, block-table upload, speculation arm pick)
+               allocation, speculation arm pick)
   prefill    — the admission pass (scheduler pops + prefill compute)
   decode     — the decode/speculation jit DISPATCH (async under JAX; the
-               device wait surfaces in host_sync)
-  host_sync  — jax.device_get of the decode chunk (device wall time the
-               host actually waits for)
+               device wait surfaces in overlap_idle at reap time)
+  dispatch   — host→device input staging for the chunk (the block-table
+               upload before the decode jit)
+  overlap_idle — time the host spends blocked on device compute at reap
+               (`block_until_ready`). In the synchronous loop this is
+               ~the whole device step; under the overlapped step
+               pipeline it shrinks toward zero — the overlap win,
+               made visible per step.
+  readback   — jax.device_get of the (ready) decode chunk: the actual
+               device→host token transfer.
   sample     — host-side token emission (stop checks, slot release)
   kv_transfer — paged-KV handoff export/import (disaggregated serving;
                recorded outside the step timeline)
+
+(`host_sync` — the old single bucket covering device wait + transfer —
+split into dispatch/readback/overlap_idle when the overlapped step
+pipeline landed.)
 
 The engine records plain floats under its own lock — it never touches a
 metrics registry from the hot path (same discipline as `Engine._timing`).
@@ -32,7 +43,8 @@ from collections import deque
 
 # Canonical phase vocabulary (metric label values; docs list them).
 PHASES = (
-    "schedule", "prefill", "decode", "sample", "host_sync", "kv_transfer",
+    "schedule", "prefill", "decode", "dispatch", "overlap_idle",
+    "readback", "sample", "kv_transfer",
 )
 
 

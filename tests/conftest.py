@@ -106,6 +106,15 @@ def pytest_configure(config):
         "prewarm, fake-clock cold-start sim (runs in the fast tier; "
         "select with -m coldstart)",
     )
+    config.addinivalue_line(
+        "markers",
+        "stepperf: overlapped step pipeline suite — fake-device-clock "
+        "overlap sim (>=1.3x decode throughput when host time >=30% of "
+        "the step, zero token divergence), token-identity matrix "
+        "(overlap on/off x greedy/seeded x cache modes), barrier "
+        "coverage, watchdog/overlap interaction, topology refusals "
+        "(runs in the fast tier; select with -m stepperf)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

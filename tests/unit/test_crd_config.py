@@ -221,3 +221,35 @@ def test_queue_pressure_config_parses_and_validates():
     sys_obj.model_autoscaling.queue_pressure_max_wait_seconds = -1
     with pytest.raises(ConfigError):
         sys_obj.default_and_validate()
+
+
+@pytest.mark.stepperf
+def test_engine_step_block_valid_and_roundtrip():
+    from kubeai_tpu.crd.model import EngineStep
+
+    for mode in ("auto", "on", "off"):
+        m = valid_model(engine_step=EngineStep(overlap=mode))
+        m.validate()
+        d = m.to_dict()
+        assert d["spec"]["engineStep"] == {"overlap": mode}
+        back = Model.from_dict(d)
+        assert back.spec.engine_step == m.spec.engine_step
+    # Default (unset) engineStep is omitted from the manifest.
+    assert "engineStep" not in valid_model().to_dict()["spec"]
+    assert Model.from_dict(
+        valid_model().to_dict()
+    ).spec.engine_step.enabled() is False
+
+
+@pytest.mark.stepperf
+def test_engine_step_block_invalid():
+    from kubeai_tpu.crd.model import EngineStep
+
+    with pytest.raises(ValidationError, match="engineStep.overlap"):
+        valid_model(engine_step=EngineStep(overlap="sometimes")).validate()
+    # engineStep is an in-tree engine feature (like speculation).
+    with pytest.raises(ValidationError, match="KubeAITPU"):
+        valid_model(
+            engine_step=EngineStep(overlap="on"), engine="VLLM",
+            resource_profile="",
+        ).validate()

@@ -213,3 +213,17 @@ def test_kubeai_tpu_renderer_no_coldstart_keeps_slow_budget(cfg):
     # Without snapshots the generous full-load budget stays.
     sp = c["startupProbe"]
     assert sp["periodSeconds"] * sp["failureThreshold"] >= 3 * 3600
+
+
+@pytest.mark.stepperf
+def test_kubeai_tpu_renderer_step_overlap_flag(cfg):
+    from kubeai_tpu.crd.model import EngineStep
+
+    for mode in ("on", "off", "auto"):
+        m = mk("KubeAITPU", "hf://org/model",
+               engine_step=EngineStep(overlap=mode))
+        args = container(render(cfg, m))["args"]
+        assert args[args.index("--step-overlap") + 1] == mode
+    # No engineStep block -> no flag (the engine default, auto, applies).
+    plain = container(render(cfg, mk("KubeAITPU", "hf://org/model")))["args"]
+    assert "--step-overlap" not in plain

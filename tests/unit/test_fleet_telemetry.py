@@ -465,9 +465,11 @@ def test_profile_endpoint_reports_real_multistep_phases(
     assert any(
         s["phases_s"].get("prefill", 0) > 0 for s in steps
     ), "no step recorded prefill time"
-    # host_sync (the device_get wait) must appear — that's where device
-    # time surfaces on the host timeline.
-    assert any("host_sync" in s["phases_s"] for s in steps)
+    # overlap_idle (the device wait) and readback (the device_get
+    # transfer) must appear — that's where device time surfaces on the
+    # host timeline now that the old host_sync bucket is split.
+    assert any("overlap_idle" in s["phases_s"] for s in steps)
+    assert any("readback" in s["phases_s"] for s in steps)
     assert prof["phase_totals_s"].get("decode", 0) > 0
     assert prof["jax_trace_dir"] is None
     # Per-phase histograms on /metrics with observations.

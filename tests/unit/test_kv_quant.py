@@ -463,8 +463,8 @@ def test_page_export_dtype_mismatch_refused(qfleet):
 
 def test_spec_verify_fuses_host_transfer(tiny, monkeypatch):
     """_process_spec must fetch choices AND n_emit in ONE device_get (two
-    sequential transfers would double per-verify-step host_sync), and
-    charge host_sync exactly once per invocation through the profiler."""
+    sequential transfers would double per-verify-step readback), and
+    charge readback exactly once per invocation through the profiler."""
     cfg, params = tiny
     eng = Engine(
         "llama", cfg, params,
@@ -493,7 +493,7 @@ def test_spec_verify_fuses_host_transfer(tiny, monkeypatch):
             calls["depth"] -= 1
 
     def counting_note(self, phase, seconds):
-        if calls["depth"] and phase == "host_sync":
+        if calls["depth"] and phase == "readback":
             calls["syncs"] += 1
         return orig_note(self, phase, seconds)
 
@@ -512,7 +512,7 @@ def test_spec_verify_fuses_host_transfer(tiny, monkeypatch):
     assert calls["syncs"] == calls["invocations"]  # charged exactly once
     # The phase reached the profiler's step records.
     specced = [
-        r for r in eng.profiler.recent() if "host_sync" in r["phases_s"]
+        r for r in eng.profiler.recent() if "readback" in r["phases_s"]
     ]
     assert specced
 
