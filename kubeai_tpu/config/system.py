@@ -137,6 +137,48 @@ class GovernorConfig:
 
 
 @dataclasses.dataclass
+class TenancyConfig:
+    """Front-door tenant admission (kubeai_tpu/fleet/tenancy; no
+    reference analog — the reference admits everything and lets engines
+    drown). System-wide defaults for per-tenant token-bucket rate
+    limits, rolling-window token-budget quotas, and the global overload
+    door; per-model CRD `tenancy:` blocks override the per-tenant
+    limits. Door state only — none of this renders into engine flags or
+    pod specs. Disabled by default: the governor is then never
+    constructed and the serving path is identical to a build without
+    it."""
+
+    enabled: bool = False
+    # Per-tenant token buckets, keyed tenant×model. 0 = unlimited.
+    requests_per_second: float = 0.0
+    request_burst: float = 0.0     # 0 -> max(rate, 1)
+    tokens_per_second: float = 0.0
+    token_burst: float = 0.0       # 0 -> max(rate, 1)
+    # Rolling-window token budget fed by the UsageMeter ledger.
+    # 0 for either disables the quota check.
+    window_seconds: float = 0.0
+    window_token_budget: int = 0
+    # Global overload door: fleet-wide queue depth (aggregator
+    # snapshot, direct-scrape fallback) at which the door starts
+    # shedding batch-class work; standard sheds at
+    # overload_standard_factor x high water; realtime never door-sheds.
+    # 0 disables overload shedding. Low water (hysteresis release)
+    # defaults to 0.8 x high water when unset.
+    overload_high_water: float = 0.0
+    overload_low_water: float = 0.0
+    overload_standard_factor: float = 2.0
+    # Retry-After clamp band for door refusals.
+    min_retry_after_seconds: float = 0.25
+    max_retry_after_seconds: float = 300.0
+    # Metric-cardinality cap: distinct tenant label values on
+    # kubeai_tenant_* / kubeai_door_* series (overflow -> 'other').
+    max_tenant_series: int = 512
+    # Tenants idle this long have their door state and metric series
+    # expired (label-churn pass).
+    tenant_idle_seconds: float = 600.0
+
+
+@dataclasses.dataclass
 class ModelRollouts:
     """Surge pods during rollout (reference: internal/config/system.go:114-117)."""
 
@@ -277,6 +319,9 @@ class System:
     governor: GovernorConfig = dataclasses.field(
         default_factory=GovernorConfig
     )
+    tenancy: TenancyConfig = dataclasses.field(
+        default_factory=TenancyConfig
+    )
     model_rollouts: ModelRollouts = dataclasses.field(
         default_factory=ModelRollouts
     )
@@ -320,6 +365,43 @@ class System:
             raise ConfigError(
                 "governor.minTelemetryCoverage must be in [0, 1]"
             )
+        t = self.tenancy
+        for field, value in (
+            ("requestsPerSecond", t.requests_per_second),
+            ("requestBurst", t.request_burst),
+            ("tokensPerSecond", t.tokens_per_second),
+            ("tokenBurst", t.token_burst),
+            ("window", t.window_seconds),
+            ("windowTokenBudget", t.window_token_budget),
+            ("overloadHighWater", t.overload_high_water),
+            ("overloadLowWater", t.overload_low_water),
+        ):
+            if value < 0:
+                raise ConfigError(f"tenancy.{field} must be >= 0")
+        if t.window_token_budget > 0 and t.window_seconds <= 0:
+            raise ConfigError(
+                "tenancy.windowTokenBudget needs tenancy.window > 0"
+            )
+        if (
+            t.overload_low_water > 0
+            and t.overload_high_water > 0
+            and t.overload_low_water > t.overload_high_water
+        ):
+            raise ConfigError(
+                "tenancy.overloadLowWater must be <= overloadHighWater"
+            )
+        if t.overload_standard_factor < 1.0:
+            raise ConfigError("tenancy.overloadStandardFactor must be >= 1")
+        if t.min_retry_after_seconds <= 0:
+            raise ConfigError("tenancy.minRetryAfter must be > 0")
+        if t.max_retry_after_seconds < t.min_retry_after_seconds:
+            raise ConfigError(
+                "tenancy.maxRetryAfter must be >= minRetryAfter"
+            )
+        if t.max_tenant_series < 1:
+            raise ConfigError("tenancy.maxTenantSeries must be >= 1")
+        if t.tenant_idle_seconds <= 0:
+            raise ConfigError("tenancy.tenantIdle must be > 0")
         if self.model_rollouts.surge < 0:
             raise ConfigError("modelRollouts.surge must be >= 0")
         r = self.resilience
@@ -653,6 +735,26 @@ def system_from_dict(data: dict) -> System:
             min_telemetry_coverage=float(
                 g.get("minTelemetryCoverage", 0.0)
             ),
+        )
+    if "tenancy" in data:
+        t = data["tenancy"]
+        sys_obj.tenancy = TenancyConfig(
+            enabled=bool(t.get("enabled", False)),
+            requests_per_second=float(t.get("requestsPerSecond", 0.0)),
+            request_burst=float(t.get("requestBurst", 0.0)),
+            tokens_per_second=float(t.get("tokensPerSecond", 0.0)),
+            token_burst=float(t.get("tokenBurst", 0.0)),
+            window_seconds=_seconds(t.get("window", 0)),
+            window_token_budget=int(t.get("windowTokenBudget", 0)),
+            overload_high_water=float(t.get("overloadHighWater", 0.0)),
+            overload_low_water=float(t.get("overloadLowWater", 0.0)),
+            overload_standard_factor=float(
+                t.get("overloadStandardFactor", 2.0)
+            ),
+            min_retry_after_seconds=_seconds(t.get("minRetryAfter", 0.25)),
+            max_retry_after_seconds=_seconds(t.get("maxRetryAfter", 300)),
+            max_tenant_series=int(t.get("maxTenantSeries", 512)),
+            tenant_idle_seconds=_seconds(t.get("tenantIdle", 600)),
         )
     if "modelRollouts" in data:
         sys_obj.model_rollouts = ModelRollouts(

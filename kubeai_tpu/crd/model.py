@@ -215,6 +215,50 @@ class Scheduling:
 
 
 @dataclasses.dataclass
+class Tenancy:
+    """Per-model overrides for the front door's tenant admission layer
+    (kubeai_tpu/fleet/tenancy; system `tenancy:` config holds the
+    defaults). DOOR state: enforced before any work is queued, rendered
+    into no engine flag or pod spec, and valid for every engine — the
+    door fronts them all. A field set to 0 inherits the system default;
+    `exempt: true` opts the model out of door admission entirely."""
+
+    requests_per_second: float = 0.0
+    request_burst: float = 0.0
+    tokens_per_second: float = 0.0
+    token_burst: float = 0.0
+    window_seconds: float = 0.0
+    window_token_budget: int = 0
+    exempt: bool = False
+
+    def enabled(self) -> bool:
+        return bool(
+            self.requests_per_second or self.request_burst
+            or self.tokens_per_second or self.token_burst
+            or self.window_seconds or self.window_token_budget
+            or self.exempt
+        )
+
+    def validate(self) -> None:
+        for field, value in (
+            ("requestsPerSecond", self.requests_per_second),
+            ("requestBurst", self.request_burst),
+            ("tokensPerSecond", self.tokens_per_second),
+            ("tokenBurst", self.token_burst),
+            ("windowSeconds", self.window_seconds),
+            ("windowTokenBudget", self.window_token_budget),
+        ):
+            try:
+                ok = float(value) >= 0
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValidationError(
+                    f"tenancy.{field} must be a number >= 0"
+                )
+
+
+@dataclasses.dataclass
 class RoleScaling:
     """Replica bounds for one disaggregated role's pod group. The
     autoscaler writes the applied count into a Model annotation
@@ -470,6 +514,8 @@ class ModelSpec:
     draft_url: str = ""
     # SLO-aware queue discipline (in-tree engine only).
     scheduling: Scheduling = dataclasses.field(default_factory=Scheduling)
+    # Front-door tenant admission overrides (door state, every engine).
+    tenancy: Tenancy = dataclasses.field(default_factory=Tenancy)
     # Disaggregated prefill/decode serving (in-tree engine only).
     disaggregation: Disaggregation = dataclasses.field(
         default_factory=Disaggregation
@@ -562,6 +608,9 @@ class ModelSpec:
             raise ValidationError(
                 "spec.scheduling requires the KubeAITPU engine"
             )
+        # Deliberately no engine gate: tenancy is door state, enforced
+        # before any engine sees the request.
+        self.tenancy.validate()
         self.disaggregation.validate()
         if self.disaggregation.enabled and self.engine != ENGINE_KUBEAI_TPU:
             raise ValidationError(
@@ -756,6 +805,7 @@ class Model:
         kvc = spec.get("kvCache", {}) or {}
         cold = spec.get("coldStart", {}) or {}
         estep = spec.get("engineStep", {}) or {}
+        ten = spec.get("tenancy", {}) or {}
 
         def _role_scaling(key: str) -> RoleScaling:
             r = dis.get(key) or {}
@@ -837,6 +887,21 @@ class Model:
                         (spec.get("scheduling") or {}).get("maxDeadlineMs", 0)
                         or 0
                     ),
+                ),
+                tenancy=Tenancy(
+                    requests_per_second=float(
+                        ten.get("requestsPerSecond", 0) or 0
+                    ),
+                    request_burst=float(ten.get("requestBurst", 0) or 0),
+                    tokens_per_second=float(
+                        ten.get("tokensPerSecond", 0) or 0
+                    ),
+                    token_burst=float(ten.get("tokenBurst", 0) or 0),
+                    window_seconds=float(ten.get("windowSeconds", 0) or 0),
+                    window_token_budget=int(
+                        ten.get("windowTokenBudget", 0) or 0
+                    ),
+                    exempt=bool(ten.get("exempt", False)),
                 ),
                 disaggregation=Disaggregation(
                     enabled=bool(dis.get("enabled", False)),
@@ -967,6 +1032,23 @@ def _spec_to_dict(s: ModelSpec) -> dict:
         if s.scheduling.max_deadline_ms:
             sched["maxDeadlineMs"] = s.scheduling.max_deadline_ms
         d["scheduling"] = sched
+    if s.tenancy.enabled():
+        ten: dict[str, Any] = {}
+        if s.tenancy.requests_per_second:
+            ten["requestsPerSecond"] = s.tenancy.requests_per_second
+        if s.tenancy.request_burst:
+            ten["requestBurst"] = s.tenancy.request_burst
+        if s.tenancy.tokens_per_second:
+            ten["tokensPerSecond"] = s.tenancy.tokens_per_second
+        if s.tenancy.token_burst:
+            ten["tokenBurst"] = s.tenancy.token_burst
+        if s.tenancy.window_seconds:
+            ten["windowSeconds"] = s.tenancy.window_seconds
+        if s.tenancy.window_token_budget:
+            ten["windowTokenBudget"] = s.tenancy.window_token_budget
+        if s.tenancy.exempt:
+            ten["exempt"] = True
+        d["tenancy"] = ten
     if s.disaggregation.enabled:
         dis = s.disaggregation
 

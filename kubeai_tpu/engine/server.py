@@ -53,6 +53,7 @@ from kubeai_tpu.scheduling import (
     DeadlineInfeasible,
     PRIORITY_CLASSES,
 )
+from kubeai_tpu.utils import retryafter
 
 logger = logging.getLogger(__name__)
 
@@ -1045,12 +1046,13 @@ class EngineServer:
 
     def _drain_refusal(self, http):
         """503 for work arriving during drain: computed Retry-After (the
-        remaining drain budget — by then kubelet has restarted us or the
-        LB moved on) and Connection: close so the client's keep-alive
-        doesn't pin a dying server."""
-        remaining = max(
-            1.0,
+        remaining drain budget, jittered through the shared helper — by
+        then kubelet has restarted us or the LB moved on) and
+        Connection: close so the client's keep-alive doesn't pin a
+        dying server."""
+        remaining = retryafter.jittered(
             self._drain_started + self.drain_timeout - time.monotonic(),
+            min_s=1.0,
         )
         http.close_connection = True
         return http._json(
@@ -1060,7 +1062,7 @@ class EngineServer:
                 "draining": True,
             },
             headers={
-                "Retry-After": f"{remaining:.0f}",
+                "Retry-After": retryafter.format_header(remaining),
                 "Connection": "close",
             },
         )
@@ -1935,12 +1937,14 @@ class EngineServer:
 
     def _shed_response(self, http, message: str, retry_after: float | None = None):
         """429 with a COMPUTED Retry-After (queue depth ÷ drain rate, from
-        the scheduler — never a constant) and per-class queue depths in
-        the body, so clients and the LB can make informed retry
-        decisions."""
+        the scheduler — never a constant; jittered ONCE through the
+        shared helper so header and body carry the same value) and
+        per-class queue depths in the body, so clients and the LB can
+        make informed retry decisions."""
         sched = self._scheduler()
         if retry_after is None:
             retry_after = sched.retry_after() if sched is not None else 1.0
+        retry_after = retryafter.jittered(retry_after)
         depths = sched.class_depths() if sched is not None else {}
         return http._json(
             429,
@@ -1951,7 +1955,7 @@ class EngineServer:
                     "retry_after_s": round(retry_after, 3),
                 },
             },
-            headers={"Retry-After": f"{retry_after:.3f}"},
+            headers={"Retry-After": retryafter.format_header(retry_after)},
         )
 
     def _collect(self, rid, sub, sp, on_delta=None, deadline=None,
@@ -2074,11 +2078,18 @@ class EngineServer:
             # stalled OR merely backlogged; either way this replica can't
             # serve it now. 503 (not 500) so the proxy retries a
             # different replica (nothing is on the wire yet in unary).
+            # Retry-After from scheduler state (shared helper), not a
+            # constant: a backlogged replica's hint should reflect its
+            # queue.
+            sched = self._scheduler()
+            ra = retryafter.jittered(
+                sched.retry_after() if sched is not None else 1.0
+            )
             return http._json(
                 503,
                 {"error": {"message": "engine produced no tokens within "
                            f"{self.request_timeout}s"}},
-                headers={"Retry-After": "1"},
+                headers={"Retry-After": retryafter.format_header(ra)},
             )
         usage = {
             "prompt_tokens": n_prompt,

@@ -13,6 +13,11 @@ Three pillars (see docs/concepts/observability.md — Fleet telemetry):
     (`kubeai_tenant_*` counters, `GET /v1/usage`).
   - `StepProfiler` — per-phase Engine.step timeline
     (`kubeai_engine_step_phase_seconds`, `POST /v1/profile`).
+  - `TenantGovernor` — the front door's admission layer
+    (docs/concepts/tenancy.md): per-tenant token buckets, rolling
+    token-budget quotas fed by the `UsageMeter` ledger, and
+    lowest-class-first overload shedding driven by the aggregator's
+    queue pressure; `kubeai_door_*` metrics.
 
 Plus the consumer that makes the aggregated state actionable:
 
@@ -50,6 +55,7 @@ from kubeai_tpu.fleet.metering import (
     tenant_of,
 )
 from kubeai_tpu.fleet.profiler import PHASES, StepProfiler, phase_totals
+from kubeai_tpu.fleet.tenancy import Refusal, TenantGovernor
 
 __all__ = [
     "ANONYMOUS_TENANT",
@@ -58,8 +64,10 @@ __all__ = [
     "Forecast",
     "FleetStateAggregator",
     "PHASES",
+    "Refusal",
     "SCHEDULING_CLASSES",
     "StepProfiler",
+    "TenantGovernor",
     "UsageMeter",
     "endpoint_signals",
     "hist_quantiles",

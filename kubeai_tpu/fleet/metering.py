@@ -1,17 +1,21 @@
 """Per-tenant usage metering: the enforcement-ready ledger behind
-future quotas (ROADMAP item 5).
+the door's quotas (kubeai_tpu/fleet/tenancy).
 
 Every request through the front door or messenger is attributed to a
-tenant — the `X-Client-Id` header (the same WFQ fairness key the
-scheduler uses), or a stable digest of the API-key principal when only
-an Authorization header is present, or `anonymous`. A `UsageMeter`
-accumulates prompt/completion tokens, request counts, stream-seconds,
-and shed/429 counts per tenant×model, mirrored to `kubeai_tenant_*`
-counters and summarized by `GET /v1/usage`.
+tenant — a stable digest of the API-key principal when an Authorization
+header is present (the authenticated identity always wins), else the
+`X-Client-Id` header (the same WFQ fairness key the scheduler uses),
+else `anonymous`. A `UsageMeter` accumulates prompt/completion tokens,
+request counts, stream-seconds, and shed/429 counts per tenant×model,
+mirrored to `kubeai_tenant_*` counters and summarized by `GET /v1/usage`.
 
 The ledger keeps EXACT integer token counts (the counters are floats by
 exposition necessity); billing-grade accounting must not depend on float
-accumulation staying integral.
+accumulation staying integral. The metric MIRROR, by contrast, bounds
+its cardinality: at most `max_tenant_series` distinct tenant label
+values ever appear on `kubeai_tenant_*` series — overflow tenants
+aggregate into the `other` label, and `prune_tenant_series` removes
+churned tenants' series (the ledger itself is never pruned).
 """
 
 from __future__ import annotations
@@ -22,22 +26,25 @@ import threading
 from kubeai_tpu.metrics.registry import DEFAULT_METRICS, Metrics
 
 ANONYMOUS_TENANT = "anonymous"
+OVERFLOW_TENANT_LABEL = "other"
 
 
 def tenant_of(headers: dict) -> str:
     """Resolve the tenant identity from request headers (lowercase keys,
-    as the front door normalizes them): explicit `X-Client-Id` wins, an
-    API-key principal (`Authorization: Bearer ...`) becomes a stable
-    `key-<digest>` pseudonym (the raw key must never become a metric
-    label), else `anonymous`."""
-    cid = (headers.get("x-client-id") or "").strip()
-    if cid:
-        return cid
+    as the front door normalizes them). Trust ordering matters: the
+    API-key principal (`Authorization: Bearer ...`, as a stable
+    `key-<digest>` pseudonym — the raw key must never become a metric
+    label) wins over the client-supplied `X-Client-Id`, otherwise a
+    spoofed header could bill/attribute one tenant's traffic to another.
+    `X-Client-Id` only identifies otherwise-anonymous callers."""
     auth = (headers.get("authorization") or "").strip()
     if auth.lower().startswith("bearer "):
         key = auth[7:].strip()
         if key:
             return "key-" + hashlib.sha256(key.encode()).hexdigest()[:12]
+    cid = (headers.get("x-client-id") or "").strip()
+    if cid:
+        return cid
     return ANONYMOUS_TENANT
 
 
@@ -56,10 +63,29 @@ class UsageMeter:
     mirror. One instance per operator replica (shared by the front door
     and every messenger stream)."""
 
-    def __init__(self, metrics: Metrics = DEFAULT_METRICS):
+    def __init__(self, metrics: Metrics = DEFAULT_METRICS,
+                 max_tenant_series: int = 512):
         self.metrics = metrics
+        self.max_tenant_series = int(max_tenant_series)
         self._lock = threading.Lock()
         self._ledger: dict[tuple[str, str], dict] = {}
+        # tenant -> metric label (own name, or "other" past the cap),
+        # and label -> model labels emitted, so churned tenants' series
+        # can be removed without touching the exact ledger.
+        self._labels: dict[str, str] = {}
+        self._series: dict[str, set[str]] = {}
+
+    def _label_for(self, tenant: str) -> str:
+        label = self._labels.get(tenant)
+        if label is None:
+            label = (
+                tenant
+                if self.max_tenant_series <= 0
+                or len(self._labels) < self.max_tenant_series
+                else OVERFLOW_TENANT_LABEL
+            )
+            self._labels[tenant] = label
+        return label
 
     def record(
         self,
@@ -82,8 +108,10 @@ class UsageMeter:
             entry["stream_seconds"] += float(stream_seconds)
             if shed:
                 entry["shed"] += 1
+            label = self._label_for(tenant)
+            self._series.setdefault(label, set()).add(model)
         m = self.metrics
-        labels = {"tenant": tenant, "model": model}
+        labels = {"tenant": label, "model": model}
         if requests:
             m.tenant_requests.inc(requests, **labels)
         if prompt_tokens:
@@ -124,6 +152,46 @@ class UsageMeter:
             stream_seconds=stream_seconds,
             shed=status == 429,
         )
+
+    def tenant_model_tokens(self, tenant: str, model: str) -> int:
+        """Exact cumulative prompt+completion tokens for one
+        tenant×model pair — the quota feed for the door's rolling
+        windows (window usage = this value now minus its value at the
+        window start)."""
+        tenant = tenant or ANONYMOUS_TENANT
+        model = model or "unknown"
+        with self._lock:
+            entry = self._ledger.get((tenant, model))
+            if entry is None:
+                return 0
+            return entry["prompt_tokens"] + entry["completion_tokens"]
+
+    def prune_tenant_series(self, keep) -> int:
+        """Label-churn pass: remove `kubeai_tenant_*` series for tenants
+        not in `keep` (the door's still-active set). The exact ledger is
+        deliberately untouched — billing history survives churn; only
+        the exposition-side label space is bounded. Returns the number
+        of tenant labels removed."""
+        keep = set(keep)
+        m = self.metrics
+        removed = 0
+        with self._lock:
+            gone = [
+                t for t in self._labels
+                if t not in keep and self._labels[t] != OVERFLOW_TENANT_LABEL
+            ]
+            for tenant in gone:
+                label = self._labels.pop(tenant)
+                removed += 1
+                for model in self._series.pop(label, ()):
+                    labels = {"tenant": label, "model": model}
+                    for metric in (
+                        m.tenant_requests, m.tenant_prompt_tokens,
+                        m.tenant_completion_tokens, m.tenant_stream_seconds,
+                        m.tenant_shed,
+                    ):
+                        metric.remove(**labels)
+        return removed
 
     def summary(self, tenant: str | None = None) -> dict:
         """The `/v1/usage` payload: per-tenant per-model entries plus

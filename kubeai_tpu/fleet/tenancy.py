@@ -1,0 +1,585 @@
+"""Tenant-aware overload protection: the front door's admission layer.
+
+One abusive tenant must not be able to queue unbounded work into every
+engine and move every other tenant's p99. ``TenantGovernor`` is
+enforced in the front door (routing/openai_server.py) and the pub/sub
+messenger (routing/messenger.py) BEFORE any work is queued anywhere —
+before model scale-up, before the load-balancer wait, before a byte
+reaches an engine. Three independent checks, cheapest first:
+
+  1. **Per-tenant token buckets** — requests/s and estimated-tokens/s
+     with configurable burst, keyed (tenant, model). The token estimate
+     is body bytes / 4 plus the request's ``max_tokens``: cheap, done
+     before any queueing, and good enough for flow control (exact
+     accounting stays with the UsageMeter ledger).
+  2. **Rolling-window token-budget quotas** — fed by the existing
+     ``UsageMeter`` ledger's exact integers: usage inside the window is
+     the ledger's cumulative count minus its value at the window start.
+     A tenant over budget is refused until the window resets.
+  3. **Global overload mode** — when fleet-wide queue pressure (summed
+     from the FleetStateAggregator snapshot, with a direct collect()
+     sweep as the stale fallback) crosses the configured high-water
+     mark, the door sheds lowest-scheduling-class-first: ``batch`` at
+     the high-water mark, ``standard`` at ``overload_standard_factor``
+     times it, and ``realtime`` NEVER (realtime degrades last; the
+     engine scheduler's own admission control remains its backstop).
+     A low-water mark provides hysteresis.
+
+Every refusal carries a COMPUTED, jittered ``Retry-After``
+(kubeai_tpu/utils/retryafter): time-to-bucket-refill for rate limits,
+time-to-window-reset for quotas, the fleet's oldest queued wait for
+overload sheds — never a magic constant.
+
+Config: system ``tenancy:`` defaults (config/system.py TenancyConfig)
+plus a per-model CRD ``tenancy:`` block (crd/model.py Tenancy) that
+overrides the per-tenant limits. This is DOOR state — it renders into
+no engine flag or pod spec. Disabled (the default) means the governor
+is never constructed and the serving path is byte-identical to a
+build without it.
+
+Metric cardinality is bounded: at most ``max_tenant_series`` distinct
+tenant label values appear on ``kubeai_door_*`` series (overflow
+tenants aggregate into ``other``), and churned tenants' series are
+removed by the idle-cleanup pass, the same label-churn discipline the
+fleet aggregator applies to endpoint gauges.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+
+from kubeai_tpu.fleet.metering import ANONYMOUS_TENANT, tenant_of
+from kubeai_tpu.metrics.registry import DEFAULT_METRICS, Metrics
+from kubeai_tpu.utils import retryafter
+
+# Scheduling classes, highest precedence first (duplicated from
+# kubeai_tpu/scheduling/scheduler.py PRIORITY_CLASSES so the door stays
+# import-light — the engine package pulls in jax).
+PRIORITY_CLASSES = ("realtime", "standard", "batch")
+CLASS_RANK = {c: i for i, c in enumerate(PRIORITY_CLASSES)}
+
+OVERFLOW_TENANT_LABEL = "other"
+
+REASON_RATE = "rate"
+REASON_TOKENS = "tokens"
+REASON_QUOTA = "quota"
+REASON_OVERLOAD = "overload"
+
+
+@dataclasses.dataclass(frozen=True)
+class DoorPolicy:
+    """The resolved per-model admission policy: system ``tenancy:``
+    defaults with the model's CRD ``tenancy:`` overrides applied.
+    0 = unlimited for every rate/budget field."""
+
+    requests_per_second: float = 0.0
+    request_burst: float = 0.0
+    tokens_per_second: float = 0.0
+    token_burst: float = 0.0
+    window_seconds: float = 0.0
+    window_token_budget: int = 0
+    exempt: bool = False
+
+
+@dataclasses.dataclass
+class Refusal:
+    """One admission refusal: everything the HTTP/messenger layer needs
+    to answer 429 honestly."""
+
+    tenant: str
+    model: str
+    reason: str          # rate | tokens | quota | overload
+    message: str
+    retry_after_s: float  # computed + jittered, never a constant
+    status: int = 429
+
+
+class _TokenBucket:
+    """Classic token bucket on an injected clock. ``take`` either
+    consumes and admits, or refuses with the computed time until enough
+    tokens will have refilled."""
+
+    __slots__ = ("rate", "burst", "tokens", "updated")
+
+    def __init__(self, rate: float, burst: float, now: float):
+        self.rate = float(rate)
+        self.burst = max(float(burst), 1.0)
+        self.tokens = self.burst
+        self.updated = now
+
+    def take(self, n: float, now: float) -> tuple[bool, float]:
+        if now > self.updated:
+            self.tokens = min(
+                self.burst, self.tokens + (now - self.updated) * self.rate
+            )
+        self.updated = max(self.updated, now)
+        if self.tokens >= n:
+            self.tokens -= n
+            return True, 0.0
+        deficit = n - self.tokens
+        if self.rate <= 0.0:
+            return False, float("inf")
+        return False, deficit / self.rate
+
+
+def estimate_tokens(body: bytes, parsed: dict | None = None) -> int:
+    """Pre-queue token estimate for the tokens/s bucket: prompt bytes at
+    ~4 bytes/token plus the requested completion budget. Deliberately
+    crude — it runs before any tokenizer and only drives flow control;
+    billing uses the UsageMeter's exact post-hoc counts."""
+    est = max(1, len(body) // 4)
+    if isinstance(parsed, dict):
+        for key in ("max_tokens", "max_completion_tokens"):
+            v = parsed.get(key)
+            if isinstance(v, int) and not isinstance(v, bool) and v > 0:
+                est += v
+                break
+    return est
+
+
+class TenantGovernor:
+    """Front-door admission governor. Thread-safe; shared by the HTTP
+    front door and every messenger stream. Clock-injected so the abuse
+    sim (benchmarks/tenant_isolation_sim.py) drives it deterministically.
+    """
+
+    def __init__(
+        self,
+        cfg,                      # config.system.TenancyConfig
+        usage=None,               # fleet.metering.UsageMeter (quota feed)
+        fleet=None,               # fleet.aggregator.FleetStateAggregator
+        model_client=None,        # routing.modelclient.ModelClient
+        metrics: Metrics = DEFAULT_METRICS,
+        clock=time.monotonic,
+        pressure_fn=None,         # test seam: () -> {"depth", "oldest_wait_s"}
+        pressure_ttl_s: float = 1.0,
+    ):
+        self.cfg = cfg
+        self.usage = usage
+        self.fleet = fleet
+        self.model_client = model_client
+        self.metrics = metrics
+        self._clock = clock
+        self._pressure_fn = pressure_fn
+        self._pressure_ttl = pressure_ttl_s
+        self._lock = threading.Lock()
+        # (tenant, model) -> {"req": bucket|None, "tok": bucket|None,
+        #                     "seen": ts}
+        self._buckets: dict[tuple[str, str], dict] = {}
+        # (tenant, model) -> (window_start_ts, ledger_tokens_at_start)
+        self._windows: dict[tuple[str, str], tuple[float, int]] = {}
+        # Overload latch + cached fleet pressure.
+        self._overload = False
+        self._pressure = {"depth": 0.0, "oldest_wait_s": 0.0,
+                          "source": "none"}
+        self._pressure_at = float("-inf")
+        # Bounded metric cardinality: tenant -> label (own name or
+        # "other"), plus the (model, reason) series each label has
+        # emitted so churn cleanup can remove them.
+        self._labels: dict[str, str] = {}
+        self._door_series: dict[str, set[tuple[str, str]]] = {}
+        self._last_seen: dict[str, float] = {}
+        self._last_cleanup = clock()
+        # Exact refusal tallies for /v1/usage (ints, not float counters).
+        self._tally = {REASON_RATE: 0, REASON_TOKENS: 0,
+                       REASON_QUOTA: 0, REASON_OVERLOAD: 0}
+        self._admitted = 0
+
+    # -- public admission entry points ---------------------------------------
+
+    def active(self) -> bool:
+        return bool(self.cfg and getattr(self.cfg, "enabled", False))
+
+    def admit_http(self, headers: dict, body: bytes) -> Refusal | None:
+        """The HTTP front door's check: resolve tenant from headers
+        (API-key digest wins over X-Client-Id — fleet.metering.tenant_of)
+        and model/priority/token-estimate from the request body. Runs
+        BEFORE proxy.handle, i.e. before any queueing anywhere."""
+        if not self.active():
+            return None
+        tenant = tenant_of(headers)
+        parsed = self._parse_body(body)
+        model_name = ""
+        if isinstance(parsed, dict):
+            model_name = str(parsed.get("model") or "")
+        priority = (headers.get("x-priority") or "").strip()
+        return self.admit(
+            tenant, model_name, priority=priority,
+            est_tokens=estimate_tokens(body, parsed),
+        )
+
+    def admit_message(self, metadata: dict, model, body: bytes) -> Refusal | None:
+        """The messenger's check: same policy, tenant from
+        ``metadata.client_id`` (the pub/sub path's only identity)."""
+        if not self.active():
+            return None
+        tenant = str(metadata.get("client_id") or "").strip() or ANONYMOUS_TENANT
+        priority = str(metadata.get("priority") or "").strip()
+        return self.admit(
+            tenant, model.name, priority=priority,
+            est_tokens=estimate_tokens(body, self._parse_body(body)),
+            model=model,
+        )
+
+    def admit(
+        self,
+        tenant: str,
+        model_name: str,
+        *,
+        priority: str = "",
+        est_tokens: int = 1,
+        model=None,
+    ) -> Refusal | None:
+        """Admit or refuse one request. Returns None (admitted) or a
+        Refusal carrying the computed, jittered Retry-After."""
+        if not self.active():
+            return None
+        tenant = tenant or ANONYMOUS_TENANT
+        now = self._clock()
+        if model is None:
+            model = self._lookup_model(model_name)
+        policy = self.resolve_policy(model)
+        cls = self._request_class(priority, model)
+        refusal = None
+        if not policy.exempt:
+            refusal = (
+                self._check_buckets(tenant, model_name, policy, est_tokens, now)
+                or self._check_quota(tenant, model_name, policy, now)
+                or self._check_overload(tenant, model_name, cls, now)
+            )
+        with self._lock:
+            self._last_seen[tenant] = now
+            if refusal is None:
+                self._admitted += 1
+            else:
+                self._tally[refusal.reason] += 1
+        if refusal is None:
+            self.metrics.door_admitted.inc(model=model_name or "unknown")
+        else:
+            label = self._tenant_label(tenant)
+            mlabel = model_name or "unknown"
+            self.metrics.door_rejections.inc(
+                tenant=label, model=mlabel, reason=refusal.reason
+            )
+            with self._lock:
+                self._door_series.setdefault(label, set()).add(
+                    (mlabel, refusal.reason)
+                )
+            self.metrics.door_retry_after.observe(refusal.retry_after_s)
+        self._maybe_cleanup(now)
+        return refusal
+
+    # -- the three checks ----------------------------------------------------
+
+    def _check_buckets(self, tenant, model_name, policy, est_tokens, now):
+        key = (tenant, model_name)
+        with self._lock:
+            entry = self._buckets.get(key)
+            if entry is None:
+                entry = {
+                    "req": self._make_bucket(
+                        policy.requests_per_second, policy.request_burst, now
+                    ),
+                    "tok": self._make_bucket(
+                        policy.tokens_per_second, policy.token_burst, now
+                    ),
+                    "seen": now,
+                }
+                self._buckets[key] = entry
+            entry["seen"] = now
+            if entry["req"] is not None:
+                ok, wait = entry["req"].take(1.0, now)
+                if not ok:
+                    return self._refuse(
+                        tenant, model_name, REASON_RATE,
+                        f"tenant {tenant!r} exceeds its request rate "
+                        "limit", wait,
+                    )
+            if entry["tok"] is not None and est_tokens > 0:
+                ok, wait = entry["tok"].take(float(est_tokens), now)
+                if not ok:
+                    return self._refuse(
+                        tenant, model_name, REASON_TOKENS,
+                        f"tenant {tenant!r} exceeds its token throughput "
+                        "limit", wait,
+                    )
+        return None
+
+    def _check_quota(self, tenant, model_name, policy, now):
+        if (
+            policy.window_seconds <= 0.0
+            or policy.window_token_budget <= 0
+            or self.usage is None
+        ):
+            return None
+        ledger = self.usage.tenant_model_tokens(tenant, model_name)
+        key = (tenant, model_name)
+        with self._lock:
+            start = self._windows.get(key)
+            if start is None or now - start[0] >= policy.window_seconds:
+                start = (now, ledger)
+                self._windows[key] = start
+            used = ledger - start[1]
+            if used < policy.window_token_budget:
+                return None
+            reset_in = start[0] + policy.window_seconds - now
+        return self._refuse(
+            tenant, model_name, REASON_QUOTA,
+            f"tenant {tenant!r} is over its {policy.window_token_budget}"
+            f"-token budget for the current window", reset_in,
+        )
+
+    def _check_overload(self, tenant, model_name, cls, now):
+        high = float(getattr(self.cfg, "overload_high_water", 0.0) or 0.0)
+        if high <= 0.0:
+            return None
+        pressure = self.fleet_pressure(now)
+        depth = pressure["depth"]
+        low = float(getattr(self.cfg, "overload_low_water", 0.0) or 0.0)
+        if low <= 0.0:
+            low = 0.8 * high
+        if self._overload:
+            if depth <= low:
+                self._overload = False
+        elif depth >= high:
+            self._overload = True
+        shed = set()
+        if self._overload:
+            shed.add("batch")
+            factor = float(
+                getattr(self.cfg, "overload_standard_factor", 2.0) or 2.0
+            )
+            if depth >= factor * high:
+                shed.add("standard")
+        # realtime is NEVER door-shed: it degrades last, bounded only by
+        # the engine scheduler's own admission control.
+        self.metrics.door_overload.set(1.0 if self._overload else 0.0)
+        self.metrics.door_queue_pressure.set(depth)
+        for c in PRIORITY_CLASSES:
+            self.metrics.door_shedding.set(
+                1.0 if c in shed else 0.0, priority=c
+            )
+        if cls not in shed:
+            return None
+        # Retry hint: the fleet's oldest queued wait is the measured
+        # drain horizon — clients should come back roughly when the
+        # current backlog has moved.
+        return self._refuse(
+            tenant, model_name, REASON_OVERLOAD,
+            f"fleet overloaded (queue pressure {depth:.0f} >= "
+            f"{high:.0f}); shedding {cls!r}-class work",
+            max(pressure["oldest_wait_s"], 1.0),
+        )
+
+    # -- fleet pressure (aggregator snapshot, direct sweep fallback) ---------
+
+    def fleet_pressure(self, now: float | None = None) -> dict:
+        """Fleet-wide queue pressure, cached for ``pressure_ttl_s``.
+        Sums every model's queue depth from the aggregator's fresh
+        snapshot; when the snapshot is stale (or absent) falls back to a
+        direct collect() sweep — the same freshness discipline the
+        autoscaler applies."""
+        now = self._clock() if now is None else now
+        if now - self._pressure_at < self._pressure_ttl:
+            return self._pressure
+        depth, oldest, source = 0.0, 0.0, "none"
+        if self._pressure_fn is not None:
+            try:
+                p = self._pressure_fn() or {}
+                depth = float(p.get("depth", 0.0))
+                oldest = float(p.get("oldest_wait_s", 0.0))
+                source = "injected"
+            except Exception:
+                source = "error"
+        elif self.fleet is not None:
+            snap = self.fleet.snapshot()
+            fresh = False
+            if snap is not None:
+                for name in list(snap.get("models") or {}):
+                    q = self.fleet.queue_pressure(name)
+                    if q is None:
+                        continue
+                    fresh = True
+                    depth += float(q["depth"])
+                    oldest = max(oldest, float(q["oldest_wait_s"]))
+            if fresh:
+                source = "aggregator"
+            else:
+                # Stale/absent snapshot: direct sweep, never silently 0.
+                try:
+                    snap = self.fleet.collect()
+                    for entry in (snap.get("models") or {}).values():
+                        q = entry.get("queue") or {}
+                        depth += float(q.get("depth", 0.0))
+                        oldest = max(
+                            oldest, float(q.get("oldest_wait_s", 0.0))
+                        )
+                    source = "direct"
+                except Exception:
+                    source = "error"
+        self._pressure = {
+            "depth": depth, "oldest_wait_s": oldest, "source": source,
+        }
+        self._pressure_at = now
+        return self._pressure
+
+    # -- policy resolution ---------------------------------------------------
+
+    def resolve_policy(self, model=None) -> DoorPolicy:
+        """System ``tenancy:`` defaults with the model CRD block's
+        overrides applied (a CRD field set > 0 wins; ``exempt`` opts the
+        model out of door admission entirely)."""
+        c = self.cfg
+        fields = {
+            "requests_per_second": float(c.requests_per_second),
+            "request_burst": float(c.request_burst),
+            "tokens_per_second": float(c.tokens_per_second),
+            "token_burst": float(c.token_burst),
+            "window_seconds": float(c.window_seconds),
+            "window_token_budget": int(c.window_token_budget),
+            "exempt": False,
+        }
+        t = getattr(getattr(model, "spec", None), "tenancy", None)
+        if t is not None and t.enabled():
+            for name in (
+                "requests_per_second", "request_burst",
+                "tokens_per_second", "token_burst", "window_seconds",
+                "window_token_budget",
+            ):
+                v = getattr(t, name)
+                if v:
+                    fields[name] = type(fields[name])(v)
+            fields["exempt"] = bool(t.exempt)
+        return DoorPolicy(**fields)
+
+    def _lookup_model(self, model_name: str):
+        if not self.model_client or not model_name:
+            return None
+        from kubeai_tpu.routing.apiutils import split_model_adapter
+
+        base, adapter = split_model_adapter(model_name)
+        for candidate in (model_name, base):
+            try:
+                return self.model_client.lookup_model(candidate, "", None)
+            except Exception:
+                continue
+        return None
+
+    def _request_class(self, priority: str, model) -> str:
+        if priority in PRIORITY_CLASSES:
+            return priority
+        default = getattr(
+            getattr(getattr(model, "spec", None), "scheduling", None),
+            "default_priority", "",
+        )
+        return default if default in PRIORITY_CLASSES else "standard"
+
+    # -- internals -----------------------------------------------------------
+
+    def _parse_body(self, body: bytes):
+        try:
+            return json.loads(body) if body else None
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return None  # the proxy/engine will answer 400 on its own
+
+    def _make_bucket(self, rate: float, burst: float, now: float):
+        if rate <= 0.0:
+            return None
+        return _TokenBucket(rate, burst if burst > 0.0 else max(rate, 1.0), now)
+
+    def _refuse(self, tenant, model_name, reason, message, wait_s) -> Refusal:
+        return Refusal(
+            tenant=tenant,
+            model=model_name,
+            reason=reason,
+            message=message,
+            retry_after_s=retryafter.jittered(
+                wait_s,
+                min_s=float(self.cfg.min_retry_after_seconds),
+                max_s=float(self.cfg.max_retry_after_seconds),
+            ),
+        )
+
+    def _tenant_label(self, tenant: str) -> str:
+        cap = int(getattr(self.cfg, "max_tenant_series", 0) or 0)
+        with self._lock:
+            label = self._labels.get(tenant)
+            if label is None:
+                label = (
+                    tenant if cap <= 0 or len(self._labels) < cap
+                    else OVERFLOW_TENANT_LABEL
+                )
+                self._labels[tenant] = label
+            return label
+
+    def _maybe_cleanup(self, now: float) -> None:
+        idle = float(getattr(self.cfg, "tenant_idle_seconds", 0.0) or 0.0)
+        if idle <= 0.0 or now - self._last_cleanup < idle / 2.0:
+            return
+        self.cleanup(now=now)
+
+    def cleanup(self, now: float | None = None) -> int:
+        """Churn pass: drop buckets/windows/labels (and their
+        ``kubeai_door_*`` series) for tenants idle past
+        ``tenant_idle_seconds``, and prune their ``kubeai_tenant_*``
+        series from the UsageMeter's mirror (the exact ledger is never
+        touched). Returns the number of tenants expired."""
+        now = self._clock() if now is None else now
+        idle = float(getattr(self.cfg, "tenant_idle_seconds", 0.0) or 0.0)
+        self._last_cleanup = now
+        if idle <= 0.0:
+            return 0
+        with self._lock:
+            gone = {
+                t for t, seen in self._last_seen.items()
+                if now - seen > idle
+            }
+            keep = set(self._last_seen) - gone
+            for t in gone:
+                self._last_seen.pop(t, None)
+                label = self._labels.pop(t, None)
+                if label and label != OVERFLOW_TENANT_LABEL and (
+                    label not in self._labels.values()
+                ):
+                    for mlabel, reason in self._door_series.pop(label, ()):
+                        self.metrics.door_rejections.remove(
+                            tenant=label, model=mlabel, reason=reason
+                        )
+            for key in [k for k in self._buckets if k[0] in gone]:
+                del self._buckets[key]
+            for key in [k for k in self._windows if k[0] in gone]:
+                del self._windows[key]
+        if gone and self.usage is not None:
+            self.usage.prune_tenant_series(keep)
+        self.metrics.door_tenants_tracked.set(float(len(keep)))
+        return len(gone)
+
+    # -- surfaces ------------------------------------------------------------
+
+    def state_payload(self) -> dict:
+        """The ``GET /v1/usage`` tenancy block: door state an operator
+        can read at a glance."""
+        with self._lock:
+            tracked = len(self._last_seen)
+            tally = dict(self._tally)
+            admitted = self._admitted
+        self.metrics.door_tenants_tracked.set(float(tracked))
+        pressure = dict(self._pressure)
+        return {
+            "enabled": self.active(),
+            "overload": self._overload,
+            "queue_pressure": pressure,
+            "tenants_tracked": tracked,
+            "admitted": admitted,
+            "rejections": tally,
+            "limits": {
+                "requestsPerSecond": self.cfg.requests_per_second,
+                "tokensPerSecond": self.cfg.tokens_per_second,
+                "window": self.cfg.window_seconds,
+                "windowTokenBudget": self.cfg.window_token_budget,
+                "overloadHighWater": self.cfg.overload_high_water,
+            },
+        }

@@ -737,6 +737,53 @@ class Metrics:
             "model.",
             self.registry,
         )
+        # -- front-door tenant admission (kubeai_tpu/fleet/tenancy) ---------
+        self.door_admitted = Counter(
+            "kubeai_door_admitted_total",
+            "Requests the tenant admission layer admitted per model "
+            "(the front door's pre-queue gate).",
+            self.registry,
+        )
+        self.door_rejections = Counter(
+            "kubeai_door_rejections_total",
+            "Requests refused at the door per tenant (label capped; "
+            "overflow aggregates into 'other'), model, and reason "
+            "(rate | tokens | quota | overload).",
+            self.registry,
+        )
+        self.door_retry_after = Histogram(
+            "kubeai_door_retry_after_seconds",
+            "Computed Retry-After values handed out with door 429s "
+            "(post-jitter).",
+            self.registry,
+            buckets=(0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+                     300.0),
+        )
+        self.door_overload = Gauge(
+            "kubeai_door_overload",
+            "1 while the door's global overload latch is engaged (fleet "
+            "queue pressure crossed the high-water mark; clears at the "
+            "low-water mark).",
+            self.registry,
+        )
+        self.door_queue_pressure = Gauge(
+            "kubeai_door_queue_pressure",
+            "Fleet-wide queue depth the door last observed (aggregator "
+            "snapshot, or a direct scrape when the snapshot is stale).",
+            self.registry,
+        )
+        self.door_shedding = Gauge(
+            "kubeai_door_shedding",
+            "1 while the door is shedding the given scheduling class "
+            "(priority label; batch sheds first, realtime never).",
+            self.registry,
+        )
+        self.door_tenants_tracked = Gauge(
+            "kubeai_door_tenants_tracked",
+            "Tenants with live admission state at the door (buckets and "
+            "quota windows; idle tenants expire).",
+            self.registry,
+        )
         # -- tracing export health ------------------------------------------
         self.tracing_dropped_spans = TracingDroppedSpans(
             "kubeai_tracing_dropped_spans_total",

@@ -127,10 +127,14 @@ class Manager:
             CapacityPlanner,
             DemandForecaster,
             FleetStateAggregator,
+            TenantGovernor,
             UsageMeter,
         )
 
-        self.usage = UsageMeter(metrics=self.metrics)
+        self.usage = UsageMeter(
+            metrics=self.metrics,
+            max_tenant_series=self.cfg.tenancy.max_tenant_series,
+        )
         self.fleet = FleetStateAggregator(
             lb=self.lb,
             model_client=self.model_client,
@@ -189,6 +193,18 @@ class Manager:
             # plan is a no-op, not a subtly different controller.
             self.planner.avg_lookup = self.autoscaler.current_average
             self.autoscaler.planner = self.planner
+        # Front-door tenant admission (kubeai_tpu/fleet/tenancy): only
+        # constructed when tenancy is enabled — disabled (the default)
+        # leaves the serving path identical to a build without it.
+        self.tenancy = None
+        if self.cfg.tenancy.enabled:
+            self.tenancy = TenantGovernor(
+                cfg=self.cfg.tenancy,
+                usage=self.usage,
+                fleet=self.fleet,
+                model_client=self.model_client,
+                metrics=self.metrics,
+            )
         self.api_server = OpenAIServer(
             self.proxy,
             self.model_client,
@@ -198,6 +214,7 @@ class Manager:
             fleet=self.fleet,
             usage=self.usage,
             planner=self.planner,
+            governor=self.tenancy,
         )
         self.messengers: list[Messenger] = []
         # One broker per stream, chosen by URL scheme (gcppubsub://,
@@ -233,6 +250,7 @@ class Manager:
                     error_max_backoff=self.cfg.messaging.error_max_backoff_seconds,
                     metrics=self.metrics,
                     usage=self.usage,
+                    governor=self.tenancy,
                 )
             )
         self.broker = default_broker

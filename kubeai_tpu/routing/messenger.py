@@ -134,6 +134,7 @@ class Messenger:
         http_send=None,  # injectable for tests
         metrics: Metrics = DEFAULT_METRICS,
         usage=None,
+        governor=None,
     ):
         self.metrics = metrics
         # Per-tenant usage metering (kubeai_tpu/fleet/metering): async
@@ -141,6 +142,9 @@ class Messenger:
         # metadata.client_id, so a batch pipeline's tokens land in the
         # same ledger interactive traffic does.
         self.usage = usage
+        # Tenant admission (kubeai_tpu/fleet/tenancy): same door policy
+        # as the HTTP path, applied before the scale/dispatch work.
+        self.governor = governor
         self.broker = broker
         self.request_subscription = request_subscription
         self.response_topic = response_topic
@@ -264,6 +268,36 @@ class Messenger:
             return self._reply_error(
                 msg, metadata, 404, f"model not found: {e}"
             )
+
+        # Tenant admission before any work is queued: no scale-up, no
+        # load-balancer wait, no dispatch for a refused message. The
+        # shed response (429 + retry_after_s hint) publishes before ack,
+        # like every reply; a deliberate refusal is not a handler error,
+        # so it never feeds the consecutive-error throttle.
+        if self.governor is not None:
+            refusal = self.governor.admit_message(metadata, model, body)
+            if refusal is not None:
+                if self.usage is not None:
+                    self.usage.record_response(
+                        refusal.tenant, model.name, refusal.status
+                    )
+                ok = self._respond(
+                    metadata,
+                    refusal.status,
+                    {
+                        "error": {
+                            "message": refusal.message,
+                            "type": "rate_limit_exceeded",
+                            "code": refusal.reason,
+                        },
+                        "retry_after_s": round(refusal.retry_after_s, 3),
+                    },
+                )
+                if ok:
+                    msg.ack()
+                else:
+                    msg.nack()
+                return False
 
         self.metrics.inference_requests_active.inc(model=model.name)
         self.metrics.inference_requests_total.inc(model=model.name)

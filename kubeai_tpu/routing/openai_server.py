@@ -102,6 +102,7 @@ class OpenAIServer:
         fleet=None,
         usage=None,
         planner=None,
+        governor=None,
     ):
         self.proxy = proxy
         self.model_client = model_client
@@ -109,10 +110,12 @@ class OpenAIServer:
         # Fleet telemetry plane (kubeai_tpu/fleet): the aggregator backs
         # /v1/fleet/*, the usage meter attributes every request to a
         # tenant and backs /v1/usage, the capacity planner backs
-        # /v1/fleet/plan. All optional (embedded tests).
+        # /v1/fleet/plan, the tenant governor refuses over-limit work
+        # before it queues. All optional (embedded tests).
         self.fleet = fleet
         self.usage = usage
         self.planner = planner
+        self.governor = governor
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -191,9 +194,10 @@ class OpenAIServer:
 
                     qs = parse_qs(urlsplit(self.path).query)
                     tenant = (qs.get("tenant") or [None])[0]
-                    return self._respond_json(
-                        200, outer.usage.summary(tenant)
-                    )
+                    payload = outer.usage.summary(tenant)
+                    if outer.governor is not None:
+                        payload["tenancy"] = outer.governor.state_payload()
+                    return self._respond_json(200, payload)
                 self._respond_json(404, {"error": {"message": "not found"}})
 
             def _handle_models(self):
@@ -258,6 +262,17 @@ class OpenAIServer:
             def _do_proxied_post(self, normalized, headers, span, request_id, t0):
                 length = int(self.headers.get("Content-Length", "0") or "0")
                 body = self.rfile.read(length) if length else b""
+                # Tenant admission (kubeai_tpu/fleet/tenancy) runs before
+                # proxy.handle — i.e. before scale-from-zero, the load
+                # balancer wait, or any engine queue sees the request. A
+                # refusal answers 429 here for unary AND stream requests
+                # alike (the stream never starts).
+                if outer.governor is not None:
+                    refusal = outer.governor.admit_http(headers, body)
+                    if refusal is not None:
+                        return self._refuse(
+                            refusal, normalized, span, request_id, t0
+                        )
                 result = outer.proxy.handle(
                     # strip the /openai prefix when forwarding to engines
                     normalized[len("/openai"):],
@@ -382,6 +397,48 @@ class OpenAIServer:
                                 f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n"
                             )
                     self.wfile.write(b"0\r\n\r\n")
+
+            def _refuse(self, refusal, normalized, span, request_id, t0):
+                from kubeai_tpu.utils import retryafter
+
+                payload = {
+                    "error": {
+                        "message": refusal.message,
+                        "type": "rate_limit_exceeded",
+                        "code": refusal.reason,
+                    },
+                    "retry_after_s": round(refusal.retry_after_s, 3),
+                }
+                body = json.dumps(payload).encode()
+                # Exactly one shed lands in the ledger per refusal — the
+                # normal _meter path never runs for a refused request.
+                # Record BEFORE writing: once the body is on the wire the
+                # client may act on it, and the ledger must already agree.
+                if outer.usage is not None:
+                    outer.usage.record_response(
+                        refusal.tenant, refusal.model or "unknown",
+                        refusal.status,
+                    )
+                self.send_response(refusal.status)
+                self.send_header("X-Request-Id", request_id)
+                self.send_header(
+                    "Retry-After",
+                    retryafter.format_header(refusal.retry_after_s),
+                )
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                duration = time.monotonic() - t0
+                span.set_attribute("http.status_code", refusal.status)
+                span.set_attribute("door.refusal", refusal.reason)
+                span.set_attribute("http.duration_s", duration)
+                access_log.info(
+                    "route=%s request_id=%s model=%s status=%d "
+                    "duration_ms=%.1f shed=%s",
+                    normalized, request_id, refusal.model or "unknown",
+                    refusal.status, duration * 1e3, refusal.reason,
+                )
 
         self.httpd = DeepBacklogHTTPServer((host, port), Handler)
         self._thread: threading.Thread | None = None

@@ -66,6 +66,7 @@ from kubeai_tpu.routing.modelclient import (
     ModelNotFound,
 )
 from kubeai_tpu.routing.prefixchain import ChainComputer
+from kubeai_tpu.utils import retryafter
 
 logger = logging.getLogger(__name__)
 
@@ -544,11 +545,9 @@ class ModelProxy:
                 # values (RFC 7231 allows HTTP-dates) are ignored rather
                 # than parsed: an immediate re-pick beats a crash.
                 if retry_after and resp.status in (429, 503):
-                    try:
-                        base = min(float(retry_after), 2.0)
-                    except ValueError:
-                        pass
-                    else:
+                    parsed_ra = retryafter.parse_header(retry_after)
+                    if parsed_ra is not None:
+                        base = min(parsed_ra, 2.0)
                         # Cumulative backoff may not eat the deadline:
                         # cap the sleep at the remaining budget.
                         if remaining is not None:

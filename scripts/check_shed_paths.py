@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Shed-path gate: every 429-returning path must carry a computed
+Retry-After.
+
+A 429 without a Retry-After tells well-behaved clients nothing and
+tells retry loops "immediately" — the door's whole isolation story
+(kubeai_tpu/fleet/tenancy) rests on refusals carrying an honest,
+computed hint. This gate scans kubeai_tpu/ for 429-emitting call sites:
+
+  - engine JSON responses: `http._json(429, ...)` / `_json(429, ...)`;
+  - front-door responses: `_respond_json(429, ...)`,
+    `send_response(429)`, and refusal status constants;
+  - messenger publishes: `_respond(metadata, 429, ...)`.
+
+Each hit must mention `Retry-After` / `retry_after` within the next
+dozen lines (the same statement, in practice), or carry a reviewed
+pragma on the same or one of the two preceding lines:
+`# shed-reviewed: <reason>`.
+
+Run directly (exit 1 on violations) or import `check()` — a tier-1
+test wires it in so a new hint-less shed path fails CI.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO_ROOT, "kubeai_tpu")
+
+_PATTERNS = (
+    re.compile(r"\b_json\(\s*429\b", re.S),
+    re.compile(r"\b_respond_json\(\s*429\b", re.S),
+    re.compile(r"\b_respond\(\s*[\w.]+\s*,\s*429\b", re.S),
+    re.compile(r"\bsend_response\(\s*429\b", re.S),
+)
+
+_HINT = re.compile(r"Retry-After|retry_after", re.I)
+_PRAGMA = re.compile(r"#\s*shed-reviewed\b")
+
+# How far below the 429 the hint may sit: one JSON-body statement in
+# this codebase spans at most about a dozen lines.
+_HINT_WINDOW = 12
+
+
+def _has_pragma(lines: list[str], lineno: int) -> bool:
+    """Pragma on the matched line or either of the two lines above it
+    (multi-line call sites put the comment above the statement)."""
+    for i in range(max(0, lineno - 3), lineno):
+        if _PRAGMA.search(lines[i]):
+            return True
+    return False
+
+
+def _has_hint(lines: list[str], lineno: int) -> bool:
+    window = lines[lineno - 1:lineno - 1 + _HINT_WINDOW]
+    return any(_HINT.search(line) for line in window)
+
+
+def check(pkg: str = PKG) -> list[str]:
+    """Returns human-readable violations (empty = every 429 path sets a
+    Retry-After hint or is explicitly reviewed)."""
+    violations: list[str] = []
+    for root, _dirs, files in os.walk(pkg):
+        if "__pycache__" in root:
+            continue
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, REPO_ROOT)
+            with open(path) as f:
+                text = f.read()
+            lines = text.splitlines()
+            for pat in _PATTERNS:
+                for m in pat.finditer(text):
+                    lineno = text.count("\n", 0, m.start()) + 1
+                    if _has_pragma(lines, lineno):
+                        continue
+                    if _has_hint(lines, lineno):
+                        continue
+                    snippet = lines[lineno - 1].strip()[:80]
+                    violations.append(
+                        f"{rel}:{lineno}: 429 without a Retry-After "
+                        f"hint `{snippet}` — compute one via "
+                        "kubeai_tpu/utils/retryafter or annotate "
+                        "`# shed-reviewed: <reason>`"
+                    )
+    return sorted(set(violations))
+
+
+def main() -> int:
+    violations = check()
+    if violations:
+        print("hint-less shed paths detected:")
+        for v in violations:
+            print(f"  - {v}")
+        return 1
+    print("every 429 path carries a computed Retry-After")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,6 +108,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "tenancy: front-door tenant admission suite — token-bucket "
+        "rate limits, rolling token-budget quotas, class-aware overload "
+        "shedding, computed Retry-After, attribution trust ordering, "
+        "metric-cardinality caps, fake-clock abuse-isolation sim (runs "
+        "in the fast tier; select with -m tenancy)",
+    )
+    config.addinivalue_line(
+        "markers",
         "stepperf: overlapped step pipeline suite — fake-device-clock "
         "overlap sim (>=1.3x decode throughput when host time >=30% of "
         "the step, zero token divergence), token-identity matrix "

@@ -69,10 +69,12 @@ def test_tenant_of_resolution():
     assert "sk-secret-123" not in t1
     assert tenant_of({}) == "anonymous"
     assert tenant_of({"authorization": "Basic abc"}) == "anonymous"
-    # Explicit client id wins over the auth principal.
+    # The verified auth principal WINS over the client-supplied header:
+    # X-Client-Id is a free-text spoofable claim, and tenant identity
+    # now gates admission (kubeai_tpu/fleet/tenancy), not just billing.
     assert tenant_of(
         {"x-client-id": "acme", "authorization": "Bearer k"}
-    ) == "acme"
+    ) == tenant_of({"authorization": "Bearer k"})
 
 
 def test_usage_meter_ledger_and_counters():

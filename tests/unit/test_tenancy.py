@@ -1,0 +1,744 @@
+"""Tenant-aware overload protection: front-door token-bucket rate
+limits, rolling token-budget quotas, class-aware overload shedding,
+computed Retry-After on every refusal, attribution trust ordering,
+metric-cardinality caps — unit + real-HTTP + messenger acceptance, plus
+the deterministic abuse-isolation sim's invariants
+(benchmarks/tenant_isolation_sim.py)."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from testutil import http_get, http_post
+
+from kubeai_tpu.config.system import ConfigError, TenancyConfig, system_from_dict
+from kubeai_tpu.crd.model import (
+    LoadBalancing,
+    Model,
+    ModelSpec,
+    Tenancy,
+    ValidationError,
+)
+from kubeai_tpu.fleet import Refusal, TenantGovernor, UsageMeter
+from kubeai_tpu.fleet.metering import tenant_of
+from kubeai_tpu.fleet.tenancy import estimate_tokens
+from kubeai_tpu.metrics.registry import Metrics, parse_prometheus_text
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.loadbalancer import LoadBalancer
+from kubeai_tpu.routing.messenger import MemBroker, Message, Messenger
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.routing.openai_server import OpenAIServer
+from kubeai_tpu.routing.proxy import ModelProxy
+from kubeai_tpu.testing.faults import FakeClock
+from kubeai_tpu.utils import retryafter
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+pytestmark = pytest.mark.tenancy
+
+
+@pytest.fixture
+def pinned_jitter(monkeypatch):
+    """jittered(x) == clamp(x): every Retry-After hint deterministic."""
+    monkeypatch.setattr(retryafter, "_jitter", lambda: 1.0)
+
+
+def _cfg(**overrides) -> TenancyConfig:
+    base = dict(enabled=True)
+    base.update(overrides)
+    return TenancyConfig(**base)
+
+
+# ---- abuse-isolation sim invariants (benchmarks/tenant_isolation_sim.py) -----
+
+
+def test_abuse_isolation_sim_invariants():
+    """Tier-1 contract: the abuser's excess is refused at the door with
+    honest Retry-After hints, compliant tenants' p99 stays within
+    epsilon of the no-abuser baseline, realtime sheds last, and the
+    disabled door is a byte-identical no-op."""
+    from benchmarks.tenant_isolation_sim import ALL_CHECKS, run_sim
+
+    result = run_sim()
+    for check in ALL_CHECKS:
+        check(result)
+
+
+# ---- retryafter: one helper for every shed path ------------------------------
+
+
+def test_clamp_floors_garbage_to_min():
+    # Non-finite values are broken estimates, not "very long waits":
+    # inf floors to "retry soon" like NaN does, it never becomes the
+    # 300s ceiling a real hour-long window reset would cap at.
+    for garbage in (0, -5, -0.001, float("nan"), float("inf"),
+                    float("-inf"), None, "not-a-number", [1]):
+        assert retryafter.clamp(garbage) == retryafter.MIN_RETRY_AFTER_S
+    # Huge FINITE waits cap at the ceiling, not the floor.
+    assert retryafter.clamp(10**9) == retryafter.MAX_RETRY_AFTER_S
+    assert retryafter.clamp(2.5) == 2.5
+    assert retryafter.clamp(2.5, min_s=5.0) == 5.0
+    assert retryafter.clamp(50.0, max_s=10.0) == 10.0
+
+
+def test_jittered_stays_in_band(monkeypatch):
+    monkeypatch.setattr(retryafter, "_jitter", lambda: 1.0)
+    assert retryafter.jittered(2.0) == 2.0
+    monkeypatch.setattr(retryafter, "_jitter", lambda: 0.0)
+    # Half the base, but never below the floor the clamp enforced.
+    assert retryafter.jittered(2.0) == 1.0
+    assert retryafter.jittered(0.3) == retryafter.MIN_RETRY_AFTER_S
+    monkeypatch.setattr(retryafter, "_jitter", lambda: 1.0)
+    assert retryafter.jittered(10**9) == retryafter.MAX_RETRY_AFTER_S
+
+
+def test_header_round_trip_and_rejects():
+    assert retryafter.parse_header(retryafter.format_header(2.5)) == 2.5
+    assert retryafter.parse_header("0") == 0.0
+    assert retryafter.parse_header(" 1.25 ") == 1.25
+    # RFC 7231 HTTP-dates, negatives, and garbage all fall back to the
+    # caller's own backoff (None) rather than a sleep until 2015.
+    for bad in (None, "", "soon", "-3", "nan", "inf",
+                "Wed, 21 Oct 2015 07:28:00 GMT"):
+        assert retryafter.parse_header(bad) is None
+    assert retryafter.format_header("garbage") == retryafter.format_header(
+        retryafter.MIN_RETRY_AFTER_S
+    )
+    assert retryafter.format_header(-1) == retryafter.format_header(
+        retryafter.MIN_RETRY_AFTER_S
+    )
+
+
+# ---- attribution trust ordering ----------------------------------------------
+
+
+def test_auth_digest_beats_spoofed_client_id():
+    """X-Client-Id is free text; the API key is verified. When both are
+    present the digest wins — a flooder cannot bill (or rate-limit)
+    its traffic to a victim tenant by spoofing the header."""
+    digest = tenant_of({"authorization": "Bearer sk-flooder"})
+    assert digest.startswith("key-") and "sk-flooder" not in digest
+    spoofed = tenant_of({
+        "authorization": "Bearer sk-flooder",
+        "x-client-id": "victim-tenant",
+    })
+    assert spoofed == digest
+    # Without credentials the self-declared id still attributes usage.
+    assert tenant_of({"x-client-id": "victim-tenant"}) == "victim-tenant"
+
+
+# ---- governor unit behavior ---------------------------------------------------
+
+
+def test_bucket_refusal_hint_is_exact_refill_time(pinned_jitter):
+    clock = FakeClock(100.0)
+    gov = TenantGovernor(
+        _cfg(requests_per_second=1.0, request_burst=2.0),
+        metrics=Metrics(), clock=clock,
+    )
+    assert gov.admit("t1", "m1") is None
+    assert gov.admit("t1", "m1") is None
+    ref = gov.admit("t1", "m1")
+    assert isinstance(ref, Refusal) and ref.reason == "rate"
+    # Empty bucket at rate 1/s: exactly 1s to the next token.
+    assert ref.retry_after_s == pytest.approx(1.0)
+    # Coming back 1ms early is refused; at the hint, admitted.
+    clock.advance(0.999)
+    assert gov.admit("t1", "m1") is not None
+    clock.advance(0.001)
+    assert gov.admit("t1", "m1") is None
+
+
+def test_token_bucket_and_estimate(pinned_jitter):
+    clock = FakeClock(0.0)
+    gov = TenantGovernor(
+        _cfg(tokens_per_second=100.0, token_burst=200.0),
+        metrics=Metrics(), clock=clock,
+    )
+    body = json.dumps({"model": "m1", "prompt": "x" * 400,
+                       "max_tokens": 64}).encode()
+    est = estimate_tokens(body, json.loads(body))
+    assert est == len(body) // 4 + 64
+    assert gov.admit("t1", "m1", est_tokens=est) is None
+    ref = gov.admit("t1", "m1", est_tokens=est)
+    assert ref is not None and ref.reason == "tokens"
+    # Deficit / rate: the hint is the measured refill time.
+    deficit = est - (200.0 - est)
+    assert ref.retry_after_s == pytest.approx(deficit / 100.0)
+
+
+def test_quota_window_refusal_and_reset(pinned_jitter):
+    clock = FakeClock(1000.0)
+    usage = UsageMeter(metrics=Metrics())
+    gov = TenantGovernor(
+        _cfg(window_seconds=60.0, window_token_budget=500),
+        usage=usage, metrics=Metrics(), clock=clock,
+    )
+    assert gov.admit("t1", "m1") is None  # opens the window
+    usage.record("t1", "m1", prompt_tokens=400, completion_tokens=200)
+    clock.advance(10.0)
+    ref = gov.admit("t1", "m1")
+    assert ref is not None and ref.reason == "quota"
+    # Time-to-window-reset, not a constant: 60 - 10 elapsed.
+    assert ref.retry_after_s == pytest.approx(50.0)
+    clock.advance(50.0)  # window resets; ledger snapshot re-anchors
+    assert gov.admit("t1", "m1") is None
+
+
+def test_overload_sheds_lowest_class_first_with_hysteresis(pinned_jitter):
+    clock = FakeClock(0.0)
+    pressure = {"depth": 0.0, "oldest_wait_s": 12.0}
+    gov = TenantGovernor(
+        _cfg(overload_high_water=100.0),
+        metrics=Metrics(), clock=clock,
+        pressure_fn=lambda: pressure, pressure_ttl_s=0.0,
+    )
+
+    def verdicts():
+        out = {}
+        for cls in ("realtime", "standard", "batch"):
+            out[cls] = gov.admit("t", "m", priority=cls) is not None
+        return out
+
+    assert verdicts() == {"realtime": False, "standard": False,
+                          "batch": False}
+    pressure["depth"] = 100.0  # at high water: batch sheds
+    assert verdicts() == {"realtime": False, "standard": False,
+                          "batch": True}
+    pressure["depth"] = 199.0  # below factor*high: standard still in
+    assert verdicts()["standard"] is False
+    pressure["depth"] = 200.0  # standard sheds; realtime NEVER
+    assert verdicts() == {"realtime": False, "standard": True,
+                          "batch": True}
+    ref = gov.admit("t", "m", priority="batch")
+    assert ref.reason == "overload"
+    # The hint is the fleet's measured oldest queued wait.
+    assert ref.retry_after_s == pytest.approx(12.0)
+    pressure["depth"] = 90.0  # above low water (80): latch holds
+    assert verdicts()["batch"] is True
+    pressure["depth"] = 79.0  # below low water: released
+    assert verdicts() == {"realtime": False, "standard": False,
+                          "batch": False}
+
+
+def test_crd_override_and_exempt(pinned_jitter):
+    clock = FakeClock(0.0)
+    gov = TenantGovernor(
+        _cfg(requests_per_second=1.0, request_burst=1.0),
+        metrics=Metrics(), clock=clock,
+    )
+    m = Model(name="vip", spec=ModelSpec(
+        url="hf://org/x", engine="KubeAITPU",
+        tenancy=Tenancy(requests_per_second=100.0, request_burst=100.0),
+    ))
+    pol = gov.resolve_policy(m)
+    assert pol.requests_per_second == 100.0 and pol.request_burst == 100.0
+    for _ in range(50):
+        assert gov.admit("t1", "vip", model=m) is None
+    # exempt opts the model out of the door entirely.
+    ex = Model(name="internal", spec=ModelSpec(
+        url="hf://org/x", engine="KubeAITPU",
+        tenancy=Tenancy(exempt=True),
+    ))
+    assert gov.resolve_policy(ex).exempt is True
+    for _ in range(50):
+        assert gov.admit("t1", "internal", model=ex) is None
+
+
+def test_governor_label_cap_and_churn_cleanup(pinned_jitter):
+    clock = FakeClock(0.0)
+    metrics = Metrics()
+    usage = UsageMeter(metrics=metrics, max_tenant_series=2)
+    gov = TenantGovernor(
+        _cfg(requests_per_second=1.0, request_burst=1.0,
+             max_tenant_series=2, tenant_idle_seconds=30.0),
+        usage=usage, metrics=metrics, clock=clock,
+    )
+    for t in ("t1", "t2", "t3"):
+        assert gov.admit(t, "m1") is None
+        ref = gov.admit(t, "m1")
+        assert ref is not None
+        usage.record(t, "m1", prompt_tokens=5)
+    parsed = parse_prometheus_text(metrics.registry.expose())
+    rejection_tenants = {
+        dict(labels)["tenant"]
+        for (name, labels) in parsed
+        if name == "kubeai_door_rejections_total"
+    }
+    # Third tenant overflows the cap into the aggregate label on BOTH
+    # the door and usage-mirror series; the ledger keeps exact names.
+    assert rejection_tenants == {"t1", "t2", "other"}
+    usage_tenants = {
+        dict(labels)["tenant"]
+        for (name, labels) in parsed
+        if name == "kubeai_tenant_prompt_tokens_total"
+    }
+    assert usage_tenants == {"t1", "t2", "other"}
+    assert set(usage.summary()["tenants"]) == {"t1", "t2", "t3"}
+
+    # Churn: idle tenants' series vanish; the billing ledger survives.
+    clock.advance(20.0)
+    assert gov.admit("t2", "m1") is None  # t2 stays warm at t=20
+    clock.advance(20.0)  # t=40: t1/t3 idle 40s > 30s, t2 only 20s
+    expired = gov.cleanup()
+    assert expired == 2
+    parsed = parse_prometheus_text(metrics.registry.expose())
+    remaining = {
+        dict(labels).get("tenant")
+        for (name, labels) in parsed
+        if name == "kubeai_door_rejections_total" and labels
+    }
+    assert "t1" not in remaining
+    assert set(usage.summary()["tenants"]) == {"t1", "t2", "t3"}
+
+
+def test_usage_meter_churn_returns_to_baseline():
+    metrics = Metrics()
+    baseline = len(parse_prometheus_text(metrics.registry.expose()))
+    meter = UsageMeter(metrics=metrics)
+    for i in range(20):
+        meter.record(f"churn-{i}", "m1", prompt_tokens=1)
+    grown = len(parse_prometheus_text(metrics.registry.expose()))
+    assert grown > baseline
+    removed = meter.prune_tenant_series(keep=set())
+    assert removed == 20
+    assert len(parse_prometheus_text(metrics.registry.expose())) == baseline
+    # The exact ledger is deliberately untouched by exposition pruning.
+    assert len(meter.summary()["tenants"]) == 20
+
+
+# ---- real-HTTP acceptance -----------------------------------------------------
+
+
+def _http_world(tenancy_cfg):
+    """store + LB + governed OpenAI server with one fake-backed model."""
+    store = KubeStore()
+    lb = LoadBalancer(store, default_timeout=5)
+    mc = ModelClient(store)
+    metrics = Metrics()
+    usage = UsageMeter(metrics=metrics)
+    governor = None
+    if tenancy_cfg is not None:
+        governor = TenantGovernor(
+            tenancy_cfg, usage=usage, model_client=mc, metrics=metrics,
+        )
+    server = OpenAIServer(
+        ModelProxy(lb, mc), mc, metrics=metrics, usage=usage,
+        governor=governor,
+    )
+    server.start()
+    from testutil import FakeEngine
+
+    m = Model(name="m1", spec=ModelSpec(
+        url="hf://org/x", engine="KubeAITPU",
+        features=["TextGeneration"], autoscaling_disabled=True,
+        replicas=1, load_balancing=LoadBalancing(),
+    ))
+    store.create(m.to_dict())
+    eng = FakeEngine()
+    store.create({
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": "model-m1-0", "namespace": "default",
+            "labels": {"model": "m1"},
+            "annotations": {
+                "model-pod-ip": "127.0.0.1",
+                "model-pod-port": str(eng.port),
+            },
+        },
+        "status": {
+            "conditions": [{"type": "Ready", "status": "True"}],
+            "podIP": "127.0.0.1",
+        },
+    })
+    lb.sync_model("m1")
+    return {
+        "server": server, "lb": lb, "engine": eng, "usage": usage,
+        "metrics": metrics, "governor": governor,
+    }
+
+
+@pytest.fixture
+def governed_world(pinned_jitter):
+    world = _http_world(_cfg(requests_per_second=1.0, request_burst=1.0))
+    yield world
+    world["server"].stop()
+    world["lb"].stop()
+    world["engine"].stop()
+
+
+@pytest.fixture
+def open_world():
+    world = _http_world(None)
+    yield world
+    world["server"].stop()
+    world["lb"].stop()
+    world["engine"].stop()
+
+
+def _chat_body(stream=False):
+    body = {"model": "m1", "messages": [{"role": "user", "content": "hi"}]}
+    if stream:
+        body["stream"] = True
+    return body
+
+
+def test_http_429_semantics_unary(governed_world):
+    server = governed_world["server"]
+    headers = {"X-Client-Id": "acme"}
+    status, _ = http_post(server.address, "/openai/v1/chat/completions",
+                          _chat_body(), timeout=10, headers=headers)
+    assert status == 200
+    status, data = http_post(server.address, "/openai/v1/chat/completions",
+                             _chat_body(), timeout=10, headers=headers)
+    assert status == 429
+    payload = json.loads(data)
+    assert payload["error"]["type"] == "rate_limit_exceeded"
+    assert payload["error"]["code"] == "rate"
+    # Time-to-bucket-refill (1/s rate) minus however long the first
+    # exchange took — computed, never a constant.
+    assert 0.5 < payload["retry_after_s"] <= 1.0
+    # Exactly ONE shed lands in the ledger per refusal — the refused
+    # request never reaches the normal metering path.
+    acme = governed_world["usage"].summary()["tenants"]["acme"]["models"]["m1"]
+    assert acme["shed"] == 1
+    # And the refused request never reached any engine.
+    assert len(governed_world["engine"].requests) == 1
+
+
+def test_http_429_sets_retry_after_header(governed_world):
+    """Raw-socket check: the 429 carries Retry-After ~= the body hint
+    plus a request id (http_post's helper hides headers)."""
+    import http.client
+
+    server = governed_world["server"]
+    host, port = server.address.split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=10)
+    try:
+        for _ in range(2):
+            conn.request(
+                "POST", "/openai/v1/chat/completions",
+                body=json.dumps(_chat_body()),
+                headers={"Content-Type": "application/json",
+                         "X-Client-Id": "acme"},
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+        assert resp.status == 429
+        header = retryafter.parse_header(resp.getheader("Retry-After"))
+        assert header is not None
+        assert header == pytest.approx(
+            json.loads(data)["retry_after_s"], abs=0.05
+        )
+        assert resp.getheader("X-Request-Id")
+        assert "json" in (resp.getheader("Content-Type") or "")
+    finally:
+        conn.close()
+
+
+def test_http_429_semantics_stream_start(governed_world):
+    """A refused stream:true request gets the same JSON refusal before
+    any SSE bytes — the door runs before the proxy picks an endpoint."""
+    server = governed_world["server"]
+    headers = {"X-Client-Id": "streamer"}
+    status, _ = http_post(server.address, "/openai/v1/chat/completions",
+                          _chat_body(stream=True), timeout=10,
+                          headers=headers)
+    assert status == 200
+    status, data = http_post(server.address, "/openai/v1/chat/completions",
+                             _chat_body(stream=True), timeout=10,
+                             headers=headers)
+    assert status == 429
+    payload = json.loads(data)  # JSON error body, not an SSE frame
+    assert payload["error"]["code"] == "rate"
+    assert payload["retry_after_s"] > 0
+    got = governed_world["usage"].summary()
+    assert got["tenants"]["streamer"]["models"]["m1"]["shed"] == 1
+
+
+def test_http_spoofed_client_id_cannot_starve_victim(governed_world):
+    """The flooder's API key exhausts the FLOODER's bucket even when it
+    spoofs the victim's X-Client-Id; the victim's own budget is intact."""
+    server = governed_world["server"]
+    spoof = {"Authorization": "Bearer sk-flooder",
+             "X-Client-Id": "victim"}
+    status, _ = http_post(server.address, "/openai/v1/chat/completions",
+                          _chat_body(), timeout=10, headers=spoof)
+    assert status == 200
+    status, _ = http_post(server.address, "/openai/v1/chat/completions",
+                          _chat_body(), timeout=10, headers=spoof)
+    assert status == 429
+    # The shed is attributed to the key digest, never the spoofed name.
+    tenants = governed_world["usage"].summary()["tenants"]
+    digest = tenant_of({"authorization": "Bearer sk-flooder"})
+    assert tenants[digest]["models"]["m1"]["shed"] == 1
+    assert "victim" not in tenants
+    # The real victim still has a full bucket.
+    status, _ = http_post(server.address, "/openai/v1/chat/completions",
+                          _chat_body(), timeout=10,
+                          headers={"X-Client-Id": "victim"})
+    assert status == 200
+
+
+def test_usage_endpoint_surfaces_tenancy_state(governed_world):
+    server = governed_world["server"]
+    headers = {"X-Client-Id": "acme"}
+    for _ in range(2):
+        http_post(server.address, "/openai/v1/chat/completions",
+                  _chat_body(), timeout=10, headers=headers)
+    status, data = http_get(server.address, "/v1/usage", timeout=10)
+    assert status == 200
+    tenancy = json.loads(data)["tenancy"]
+    assert tenancy["enabled"] is True
+    assert tenancy["admitted"] == 1
+    assert tenancy["rejections"]["rate"] == 1
+    assert tenancy["limits"]["requestsPerSecond"] == 1.0
+
+
+def test_disabled_door_serves_everything(open_world):
+    """No governor (the default): a burst sails through, no door metric
+    gets a labeled series — today's behavior, byte-identical."""
+    server = open_world["server"]
+    for _ in range(5):
+        status, _ = http_post(
+            server.address, "/openai/v1/chat/completions", _chat_body(),
+            timeout=10, headers={"X-Client-Id": "acme"},
+        )
+        assert status == 200
+    status, data = http_get(server.address, "/v1/usage", timeout=10)
+    assert status == 200 and "tenancy" not in json.loads(data)
+    for (name, labels) in parse_prometheus_text(
+        open_world["metrics"].registry.expose()
+    ):
+        if name.startswith("kubeai_door_"):
+            assert labels == (), f"door series {name}{labels} emitted"
+
+
+# ---- messenger (pub/sub) acceptance -------------------------------------------
+
+
+def _messenger_world(pinned=True):
+    store = KubeStore()
+    mc = ModelClient(store)
+    lb = LoadBalancer(store)
+    metrics = Metrics()
+    usage = UsageMeter(metrics=metrics)
+    governor = TenantGovernor(
+        _cfg(requests_per_second=1.0, request_burst=1.0),
+        usage=usage, model_client=mc, metrics=metrics,
+    )
+    sent = []
+
+    def fake_send(addr, path, body):
+        sent.append((addr, path, json.loads(body)))
+        return 200, json.dumps({"ok": True}).encode()
+
+    store.create(Model(name="m1", spec=ModelSpec(
+        url="hf://org/x", engine="KubeAITPU",
+        min_replicas=0, max_replicas=2, replicas=1,
+    )).to_dict())
+    store.create({
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": "model-m1-0", "namespace": "default",
+            "labels": {"model": "m1"},
+            "annotations": {"model-pod-ip": "127.0.0.1",
+                            "model-pod-port": "9000"},
+        },
+        "status": {
+            "conditions": [{"type": "Ready", "status": "True"}],
+            "podIP": "127.0.0.1",
+        },
+    })
+    lb.sync_model("m1")
+    broker = MemBroker()
+    messenger = Messenger(
+        broker, "requests", "responses", lb, mc, http_send=fake_send,
+        metrics=metrics, usage=usage, governor=governor,
+    )
+    return {
+        "broker": broker, "messenger": messenger, "usage": usage,
+        "sent": sent, "lb": lb,
+    }
+
+
+def _envelope(client_id="acme"):
+    return Message(json.dumps({
+        "metadata": {"client_id": client_id},
+        "path": "/v1/completions",
+        "body": {"model": "m1", "prompt": "hi"},
+    }).encode())
+
+
+def test_messenger_door_publishes_shed_with_hint(pinned_jitter):
+    world = _messenger_world()
+    msgr, broker = world["messenger"], world["broker"]
+    try:
+        msg1 = _envelope()
+        assert msgr.handle_request(msg1) is False  # served, no throttle
+        assert len(world["sent"]) == 1 and msg1.acked
+
+        msg2 = _envelope()
+        counts_toward_throttle = msgr.handle_request(msg2)
+        # A deliberate refusal never feeds the error throttle: a flood
+        # of over-limit traffic must not slow compliant consumers.
+        assert counts_toward_throttle is False
+        assert len(world["sent"]) == 1  # no dispatch for refused work
+        assert msg2.acked is True  # published-then-acked, no redelivery
+        reply = broker.receive("responses", timeout=1)
+        assert reply is not None  # admitted response
+        shed = broker.receive("responses", timeout=1)
+        assert shed is not None
+        payload = json.loads(shed.body)
+        assert payload["metadata"]["client_id"] == "acme"
+        assert payload["status_code"] == 429
+        assert payload["body"]["error"]["code"] == "rate"
+        assert 0.5 < payload["body"]["retry_after_s"] <= 1.0
+        # Exactly one shed attributed in the ledger.
+        acme = world["usage"].summary()["tenants"]["acme"]["models"]["m1"]
+        assert acme["shed"] == 1
+    finally:
+        world["lb"].stop()
+
+
+def test_messenger_anonymous_when_client_id_missing(pinned_jitter):
+    world = _messenger_world()
+    msgr = world["messenger"]
+    try:
+        msgr.handle_request(_envelope(client_id=""))
+        msgr.handle_request(_envelope(client_id=""))
+        tenants = world["usage"].summary()["tenants"]
+        assert "anonymous" in tenants
+        assert tenants["anonymous"]["models"]["m1"]["shed"] == 1
+    finally:
+        world["lb"].stop()
+
+
+# ---- config + CRD plumbing ----------------------------------------------------
+
+
+def test_system_tenancy_round_trip():
+    sys_obj = system_from_dict({
+        "secretNames": {"huggingface": "hf"},
+        "modelServers": {},
+        "resourceProfiles": {},
+        "tenancy": {
+            "enabled": True,
+            "requestsPerSecond": 5,
+            "requestBurst": 10,
+            "tokensPerSecond": 1000,
+            "window": "1m",
+            "windowTokenBudget": 50000,
+            "overloadHighWater": 200,
+            "minRetryAfter": 0.5,
+            "maxRetryAfter": "2m",
+            "maxTenantSeries": 64,
+            "tenantIdle": "10m",
+        },
+    })
+    t = sys_obj.tenancy
+    assert t.enabled is True
+    assert t.requests_per_second == 5.0 and t.request_burst == 10.0
+    assert t.window_seconds == 60.0 and t.window_token_budget == 50000
+    assert t.max_retry_after_seconds == 120.0
+    assert t.tenant_idle_seconds == 600.0
+    sys_obj.default_and_validate()  # valid config passes
+
+
+@pytest.mark.parametrize("patch,msg", [
+    ({"requestsPerSecond": -1}, "must be >= 0"),
+    ({"windowTokenBudget": 100}, "needs tenancy.window"),
+    ({"overloadHighWater": 100, "overloadLowWater": 150},
+     "overloadLowWater"),
+    ({"overloadStandardFactor": 0.5}, "overloadStandardFactor"),
+    ({"minRetryAfter": 0}, "minRetryAfter"),
+    ({"minRetryAfter": 10, "maxRetryAfter": 1}, "maxRetryAfter"),
+    ({"maxTenantSeries": 0}, "maxTenantSeries"),
+    ({"tenantIdle": 0}, "tenantIdle"),
+])
+def test_system_tenancy_validation_rejects(patch, msg):
+    sys_obj = system_from_dict({
+        "secretNames": {"huggingface": "hf"},
+        "modelServers": {},
+        "resourceProfiles": {},
+        "tenancy": dict({"enabled": True}, **patch),
+    })
+    with pytest.raises(ConfigError, match=msg):
+        sys_obj.default_and_validate()
+
+
+def test_crd_tenancy_round_trip_and_validation():
+    m = Model(name="m1", spec=ModelSpec(
+        url="hf://org/x", engine="KubeAITPU",
+        tenancy=Tenancy(requests_per_second=2.0, window_seconds=60.0,
+                        window_token_budget=1000),
+    ))
+    m.validate()
+    d = m.to_dict()
+    block = d["spec"]["tenancy"]
+    assert block == {"requestsPerSecond": 2.0, "windowSeconds": 60.0,
+                     "windowTokenBudget": 1000}
+    back = Model.from_dict(d)
+    assert back.spec.tenancy == m.spec.tenancy
+    # An unset block emits nothing (door state, no engine rendering).
+    bare = Model(name="m2", spec=ModelSpec(url="hf://org/x",
+                                           engine="KubeAITPU"))
+    assert "tenancy" not in bare.to_dict()["spec"]
+    # Exempt survives the round trip.
+    ex = Model(name="m3", spec=ModelSpec(
+        url="hf://org/x", engine="KubeAITPU", tenancy=Tenancy(exempt=True),
+    ))
+    assert ex.to_dict()["spec"]["tenancy"] == {"exempt": True}
+    assert Model.from_dict(ex.to_dict()).spec.tenancy.exempt is True
+    # Negative and non-numeric fields are rejected at validate().
+    bad = Model(name="m4", spec=ModelSpec(
+        url="hf://org/x", engine="KubeAITPU",
+        tenancy=Tenancy(requests_per_second=-1.0),
+    ))
+    with pytest.raises(ValidationError, match="requestsPerSecond"):
+        bad.validate()
+
+
+# ---- static gate: every 429 path carries a computed Retry-After ---------------
+
+
+def _load_shed_gate():
+    path = os.path.join(REPO_ROOT, "scripts", "check_shed_paths.py")
+    spec = importlib.util.spec_from_file_location("check_shed_paths", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_shed_path_gate_is_clean():
+    assert _load_shed_gate().check() == []
+
+
+def test_shed_path_gate_catches_hintless_429(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "def f(http):\n"
+        "    http._json(429, {'error': 'slow down'})\n"
+    )
+    (pkg / "ok.py").write_text(
+        "def f(http, ra):\n"
+        "    http._json(429, {'retry_after_s': ra},\n"
+        "               headers={'Retry-After': str(ra)})\n"
+    )
+    (pkg / "reviewed.py").write_text(
+        "def f(http):\n"
+        "    # shed-reviewed: reply transport has no headers\n"
+        "    http._json(429, {'error': 'slow down'})\n"
+    )
+    violations = _load_shed_gate().check(str(pkg))
+    assert len(violations) == 1 and "bad.py" in violations[0]
