@@ -40,7 +40,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from kubeai_tpu.config import System
 from kubeai_tpu.config.system import GovernorConfig
 from kubeai_tpu.crd import metadata as md
-from kubeai_tpu.crd.model import Model, ModelSpec
 from kubeai_tpu.metrics import Metrics
 from kubeai_tpu.autoscaler.leader import LeaderElection
 from kubeai_tpu.operator.controller import ModelReconciler
@@ -51,6 +50,7 @@ from kubeai_tpu.operator.k8s.rest import RestKubeClient
 from kubeai_tpu.operator.k8s.store import KubeStore
 from kubeai_tpu.routing.modelclient import ModelClient
 from kubeai_tpu.testing.faults import ApiFault, ApiFaultPlan, FakeClock
+from kubeai_tpu.testing.simkit import mark_all_ready, mk_model, pod_names
 
 
 class StubFleet:
@@ -68,39 +68,16 @@ class StubFleet:
 def _mk_model(
     store, name: str = "sim", replicas: int = 2, min_replicas: int = 0
 ) -> None:
-    m = Model(
-        name=name,
-        spec=ModelSpec(
-            url="hf://org/model",
-            engine="KubeAITPU",
-            features=["TextGeneration"],
-            resource_profile="google-tpu-v5e-1x1:1",
-            autoscaling_disabled=False,
-            min_replicas=min_replicas,
-            replicas=replicas,
-            scale_down_delay_seconds=0,
-        ),
+    mk_model(
+        store, name=name, replicas=replicas,
+        autoscaling_disabled=False, min_replicas=min_replicas,
+        scale_down_delay_seconds=0,
     )
-    m.validate()
-    store.create(m.to_dict())
 
 
-def _mark_all_ready(store, model: str = "sim") -> None:
-    for pod in store.list("Pod", "default", {md.POD_MODEL_LABEL: model}):
-        fresh = store.get("Pod", "default", pod["metadata"]["name"])
-        fresh.setdefault("status", {})["conditions"] = [
-            {"type": "Ready", "status": "True"},
-            {"type": "PodScheduled", "status": "True"},
-        ]
-        fresh["status"]["phase"] = "Running"
-        store.update(fresh)
-
-
-def _pod_names(store, model: str = "sim") -> set[str]:
-    return {
-        p["metadata"]["name"]
-        for p in store.list("Pod", "default", {md.POD_MODEL_LABEL: model})
-    }
+# Ready flips and pod-name sets come from the shared sim scaffolding.
+_mark_all_ready = mark_all_ready
+_pod_names = pod_names
 
 
 # ---- phase 1: dual-operator split-brain --------------------------------------

@@ -1,0 +1,1315 @@
+"""Game-day simulation — every chaos axis at once, one fake clock.
+
+The prior sims each break ONE thing: resilience_sim breaks endpoints,
+control_plane_chaos_sim breaks the API server, tenant_isolation_sim
+floods a tenant, capacity_planner_sim squeezes the chip budget. This
+harness composes all of them against the REAL components — reconciler +
+actuation governor, autoscaler + capacity planner + fleet aggregator,
+load balancer + circuit breakers, the tenant door, and a simulated
+engine data plane — driven by one declarative, seeded
+`GameDayTrace` (kubeai_tpu/testing/chaos.py) whose events can land on
+the SAME tick:
+
+    kill/spot-preempt a pod, wedge an engine's step loop, partition or
+    storm the API server, flood a tenant, flip the spot chip budget,
+    stale-out telemetry, drop a proxy->engine link.
+
+Invariants split into two kinds:
+
+  CONTINUOUS (checked every tick)
+    * zero client-visible stream errors — every interrupted stream
+      resumes within the proxy's resume budget;
+    * budgeted pod deletions per sliding window stay within the
+      governor's model AND cluster disruption budgets (measured from
+      metric scrapes, not from the governor's own bookkeeping);
+    * realtime traffic is NEVER door-shed, no matter the overload;
+    * the capacity plan never allocates more chips than the inventory
+      (per shape too);
+    * the billing ledger exactly matches delivered work — no
+      double-billing across stream resumes;
+    * resumed streams deliver every token exactly once.
+
+  TERMINAL (checked once, after the last chaos event)
+    * the fleet converges back to a healthy steady state (ready ==
+      spec, queues drained, door closed) within CONVERGE_BOUND_S.
+
+Every run writes a JSONL `GameDayLog`; a failing run replays
+byte-identically from its dump:
+
+    python benchmarks/gameday_sim.py --trace failing --dump /tmp/g.jsonl
+    python -m benchmarks.gameday_sim --replay /tmp/g.jsonl
+
+Run directly for a human-readable report:
+
+    python benchmarks/gameday_sim.py [--ticks N] [--seed N]
+        [--trace fast|extended|failing]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from collections import deque
+
+from kubeai_tpu.autoscaler import Autoscaler
+from kubeai_tpu.autoscaler.autoscaler import (
+    scrape_queue_pressure,
+    scrape_role_signals,
+)
+from kubeai_tpu.config import System
+from kubeai_tpu.config.system import GovernorConfig, TenancyConfig
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.fleet import CapacityPlanner, FleetStateAggregator
+from kubeai_tpu.fleet.metering import UsageMeter
+from kubeai_tpu.fleet.tenancy import TenantGovernor
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.operator.controller import ModelReconciler
+from kubeai_tpu.operator.governor import ActuationGovernor
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.loadbalancer import (
+    Group,
+    LoadBalancer,
+    LoadBalancerTimeout,
+    NoHealthyEndpoints,
+)
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.testing.chaos import (
+    CONTINUOUS,
+    EV_API_PARTITION,
+    EV_API_STORM,
+    EV_CHIP_FLIP,
+    EV_KILL_POD,
+    EV_LINK_DROP,
+    EV_SPOT_PREEMPT,
+    EV_TELEMETRY_STALE,
+    EV_TENANT_FLOOD,
+    EV_WEDGE_ENGINE,
+    TERMINAL,
+    ApiServerError,
+    ApiServerUnreachable,
+    ChaosKubeStore,
+    GameDayEvent,
+    GameDayLog,
+    GameDayTrace,
+    Invariant,
+    InvariantChecker,
+)
+from kubeai_tpu.testing.clock import FakeClock
+from kubeai_tpu.testing.faults import ApiFault, ApiFaultPlan, Fault, FaultPlan
+from kubeai_tpu.testing.simkit import (
+    break_pod,
+    mk_model,
+    percentile,
+    scrape_diff,
+    seeded_rng,
+)
+
+ACCEL = "tpu-v5-lite-podslice"
+
+TICK_S = 1.0
+WARMUP_TICKS = 8           # steady state before the trace's t=0
+BOOT_TICKS = 2             # created pod -> Ready
+SLOTS = 4                  # concurrent streams per endpoint
+TOKENS_PER_TICK = 10
+STREAM_TOKENS = 20
+PROMPT_TOKENS = 16
+MAX_ATTEMPTS = 3           # proxy retry budget per dispatch
+MAX_STREAM_RESUMES = 3     # mid-stream continuation budget per stream
+WEDGE_TICKS = 4            # wedged engine -> watchdog kill
+CONVERGE_BOUND_S = 40.0
+
+MODELS = ("rt", "std", "batch")
+MODEL_CLASS = {"rt": "realtime", "std": "standard", "batch": "batch"}
+
+GOVERNOR_WINDOW_S = 20.0
+MODEL_DISRUPTION_BUDGET = 2
+CLUSTER_DISRUPTION_BUDGET = 3
+
+DELETE_SERIES = "kubeai_governor_actions_total"
+
+
+class Stream:
+    """One admitted client request: queue wait, token delivery, and the
+    resume discipline across endpoint deaths."""
+
+    __slots__ = ("tenant", "model", "cls", "t_arrive", "t_first",
+                 "delivered", "need", "addr", "done", "failed", "resumes",
+                 "billed")
+
+    def __init__(self, tenant: str, model: str, cls: str, t_arrive: float,
+                 need: int = STREAM_TOKENS):
+        self.tenant = tenant
+        self.model = model
+        self.cls = cls
+        self.t_arrive = t_arrive
+        self.t_first: float | None = None
+        self.delivered = 0
+        self.need = need
+        self.addr: str | None = None
+        self.done = None
+        self.failed: set[str] = set()
+        self.resumes = 0
+        self.billed = 0  # completion tokens actually billed (ledger cross-check)
+
+
+def _node(name: str, chips: int = 1, spot: bool = False) -> dict:
+    labels = {
+        "cloud.google.com/gke-tpu-accelerator": ACCEL,
+        "cloud.google.com/gke-tpu-topology": "1x1",
+    }
+    if spot:
+        labels["cloud.google.com/gke-spot"] = "true"
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": labels},
+        "status": {"allocatable": {"google.com/tpu": str(chips)}},
+    }
+
+
+class GameDayWorld:
+    """The composed fleet: real control plane over a chaos-wrapped
+    store, real routing, real tenant door, simulated engines."""
+
+    def __init__(self, trace: GameDayTrace, ticks: int, seed: int = 0,
+                 stream_tokens: int = STREAM_TOKENS):
+        self.trace = trace
+        self.ticks = int(ticks)
+        self.seed = int(seed)
+        self.stream_tokens = int(stream_tokens)
+        self.rng = seeded_rng(seed)
+        self.clock = FakeClock(1000.0)
+        self.wall = FakeClock(1_000_000.0)
+        self.tick_no = 0
+        self.t0 = self.clock() + WARMUP_TICKS * TICK_S  # trace's t=0
+
+        # Retry-After jitter is the one rng the door reaches for outside
+        # our seam; pin it so a replayed run is byte-identical.
+        from kubeai_tpu.utils import retryafter
+        retryafter._jitter = lambda: 1.0
+
+        # -- stores: raw for the data/telemetry plane, chaos-wrapped for
+        # the control plane (the wrapper IS the API server's front door).
+        # Deterministic generateName suffixes: a zero-padded counter, so
+        # pod names sort in creation order and a replay in any process
+        # (any PYTHONHASHSEED, no uuid entropy) picks identical victims.
+        self._name_counter = itertools.count()
+        self.raw_store = KubeStore(
+            namegen=lambda: f"{next(self._name_counter):06d}"
+        )
+        self.api_plan = ApiFaultPlan()
+        self.api = ChaosKubeStore(self.raw_store, self.api_plan)
+        self.metrics = Metrics()
+
+        cfg = System()
+        cfg.fixed_self_metric_addrs = ["self:1"]
+        cfg.model_autoscaling.interval_seconds = 10.0
+        cfg.model_autoscaling.time_window_seconds = 10.0
+        cfg.default_and_validate()
+        self.cfg = cfg
+
+        # -- inventory: on-demand + spot single-chip v5e nodes.
+        self.spot_nodes: list[str] = []
+        for i in range(10):
+            self.raw_store.create(_node(f"node-od-{i}"))
+        for i in range(4):
+            name = f"node-spot-{i}"
+            self.spot_nodes.append(name)
+            self.raw_store.create(_node(name, spot=True))
+
+        # -- models: one per scheduling class, autoscaler-owned.
+        from kubeai_tpu.crd.model import Scheduling
+        common = dict(
+            target_requests=4, scale_down_delay_seconds=0,
+        )
+        mk_model(self.raw_store, "rt", replicas=3, min_replicas=2,
+                 max_replicas=4,
+                 scheduling=Scheduling(default_priority="realtime"),
+                 **common)
+        mk_model(self.raw_store, "std", replicas=2, min_replicas=1,
+                 max_replicas=4,
+                 scheduling=Scheduling(default_priority="standard"),
+                 **common)
+        mk_model(self.raw_store, "batch", replicas=2, min_replicas=1,
+                 max_replicas=3,
+                 scheduling=Scheduling(default_priority="batch"),
+                 **common)
+
+        # -- routing: groups pre-seeded on the fake clock so breaker
+        # open/half-open timing is simulated time, not wall time.
+        self.lb = LoadBalancer(self.raw_store, metrics=self.metrics)
+        for name in MODELS:
+            self.lb._groups[name] = Group(
+                metrics=self.metrics, model=name, clock=self.clock
+            )
+
+        self.mc_raw = ModelClient(self.raw_store)
+        self.aggregator = FleetStateAggregator(
+            lb=self.lb, model_client=self.mc_raw, store=self.raw_store,
+            metrics=self.metrics, interval_s=1.0, staleness_s=2.5,
+            fetch_metrics=self.fetch_metrics, fetch_state=self.fetch_state,
+            clock=self.clock,
+        )
+
+        # -- control plane, all of it behind the chaos store.
+        class AlwaysLeader:
+            is_leader = True
+
+        gcfg = GovernorConfig(
+            window_seconds=GOVERNOR_WINDOW_S,
+            model_disruption_budget=MODEL_DISRUPTION_BUDGET,
+            cluster_disruption_budget=CLUSTER_DISRUPTION_BUDGET,
+            min_telemetry_coverage=0.9,
+        )
+        self.governor = ActuationGovernor(
+            cfg=gcfg, fleet=self.aggregator, store=self.api,
+            metrics=self.metrics, clock=self.clock,
+        )
+        self.gcfg = gcfg
+        self.mc = ModelClient(self.api)
+        self.mc.governor = self.governor
+        self.reconciler = ModelReconciler(
+            self.api, cfg, metrics=self.metrics, clock=self.clock,
+            wall=self.wall, governor=self.governor,
+        )
+        self.scaler = Autoscaler(
+            self.api, cfg, self.mc, self.lb, AlwaysLeader(),
+            metrics=self.metrics,
+        )
+        self.scaler.active_scraper = lambda addrs: self.active_totals()
+        self.scaler.queue_scraper = lambda addrs: scrape_queue_pressure(
+            addrs, fetch=self.fetch_metrics
+        )
+        self.scaler.role_scraper = lambda addrs: scrape_role_signals(
+            addrs, fetch=self.fetch_metrics
+        )
+        self.scaler.fleet = self.aggregator
+        self.planner = CapacityPlanner(
+            fleet=self.aggregator, model_client=self.mc, store=self.api,
+            cfg=cfg, metrics=self.metrics, interval_s=1.0, staleness_s=2.5,
+            clock=self.clock,
+        )
+        self.planner.avg_lookup = self.scaler.current_average
+        self.scaler.planner = self.planner
+
+        # -- tenant door + billing.
+        self.usage = UsageMeter(metrics=self.metrics)
+        self.door = TenantGovernor(
+            TenancyConfig(
+                enabled=True,
+                requests_per_second=2.0,
+                request_burst=4.0,
+                overload_high_water=10.0,
+                overload_low_water=5.0,
+                tenant_idle_seconds=1e9,
+            ),
+            usage=self.usage, metrics=self.metrics, clock=self.clock,
+            pressure_fn=self.queue_pressure, pressure_ttl_s=0.0,
+        )
+
+        # -- data plane state.
+        self.queues: dict[str, deque] = {m: deque() for m in MODELS}
+        self.active: list[Stream] = []
+        self.completed: list[Stream] = []
+        self.errored: list[Stream] = []
+        self.client_errors = 0
+        self.addr_model: dict[str, str] = {}
+        self.dead: set[str] = set()
+        self.wedged: dict[str, int] = {}     # addr -> watchdog-fires tick
+        self.first_seen: dict[str, int] = {}
+        self.ip_counter = 1
+        self.arrival_counter = {m: 0 for m in MODELS}
+
+        # -- chaos state.
+        self.link_plan = FaultPlan(seed=seed)
+        self.active_links: list[dict] = []   # {"addr","fault","until"}
+        self.floods: list[dict] = []         # {"tenant","model","rps","until"}
+        self.partition_until = float("-inf")
+        self.stale_until = float("-inf")
+        self.spot_removed: list[dict] = []   # removed Node objects (restorable)
+
+        # -- measurement.
+        self.log = GameDayLog(
+            trace, ticks,
+            extra={"seed": seed, "stream_tokens": self.stream_tokens},
+        )
+        self.checker = InvariantChecker(INVARIANTS, log=self.log)
+        self.metric_history: deque = deque()  # (t, exposition_text)
+        self.refusals: list[tuple] = []       # (tenant, model, cls, reason)
+        self.wait_samples: dict = {}          # (tenant, model) -> [wait_s]
+        self.plans: list[dict] = []
+        self.last_plan: dict | None = None
+        self.control_plane_errors = 0
+        self.kinds_timeline: list[list[str]] = []
+        self.last_unconverged_tick: int | None = None
+        self.converged_final = False
+
+    # ---- time ----------------------------------------------------------
+
+    def rel_now(self) -> float:
+        """Trace-relative time: 0.0 at the first post-warmup tick."""
+        return self.clock() - self.t0
+
+    # ---- scripted transport (engine telemetry) -------------------------
+
+    def fetch_metrics(self, addr: str, timeout: float = 5.0) -> str:
+        model = self.addr_model.get(addr)
+        if model is None or addr in self.dead:
+            raise ConnectionError(f"injected: {addr} unreachable")
+        q = self.queues[model]
+        ready = max(1, len(self._ready_addrs(model)))
+        depth = len(q) / ready
+        oldest = (self.clock() - q[0].t_arrive) if q else 0.0
+        active = sum(1 for s in self.active if s.addr == addr)
+        return "\n".join([
+            'kubeai_engine_queue_depth{class="standard"} ' + f"{depth}",
+            f"kubeai_engine_queue_oldest_wait_seconds {oldest}",
+            "kubeai_engine_kv_cache_utilization 0.0",
+            f"kubeai_engine_slots_active {float(active)}",
+            f"kubeai_engine_slot_capacity {float(SLOTS)}",
+            "kubeai_engine_ttft_seconds_sum 0.0",
+            "kubeai_engine_ttft_seconds_count 0.0",
+            f"kubeai_engine_active_requests {float(active)}",
+        ]) + "\n"
+
+    def fetch_state(self, addr: str, timeout: float = 5.0) -> dict:
+        model = self.addr_model.get(addr)
+        if model is None or addr in self.dead:
+            raise ConnectionError(f"injected: {addr} unreachable")
+        return {"model": model, "healthy": True}
+
+    def active_totals(self) -> dict[str, float]:
+        totals = {m: float(len(self.queues[m])) for m in MODELS}
+        for s in self.active:
+            totals[s.model] += 1.0
+        return totals
+
+    def queue_pressure(self) -> dict:
+        depth = sum(len(q) for q in self.queues.values())
+        oldest = 0.0
+        now = self.clock()
+        for q in self.queues.values():
+            if q:
+                oldest = max(oldest, now - q[0].t_arrive)
+        return {"depth": float(depth), "oldest_wait_s": oldest}
+
+    # ---- pod/addr bookkeeping ------------------------------------------
+
+    def _pods(self, model: str) -> list[dict]:
+        return sorted(
+            self.raw_store.list("Pod", "default", {md.POD_MODEL_LABEL: model}),
+            key=lambda p: p["metadata"]["name"],
+        )
+
+    def _addr_of(self, pod: dict) -> str | None:
+        ip = pod.get("status", {}).get("podIP")
+        return f"{ip}:8000" if ip else None
+
+    def _is_ready(self, pod: dict) -> bool:
+        st = pod.get("status", {})
+        if st.get("phase") == "Failed":
+            return False
+        return any(
+            c.get("type") == "Ready" and c.get("status") == "True"
+            for c in st.get("conditions", [])
+        )
+
+    def _ready_addrs(self, model: str) -> list[str]:
+        out = []
+        for pod in self._pods(model):
+            addr = self._addr_of(pod)
+            if addr and self._is_ready(pod) and addr not in self.dead:
+                out.append(addr)
+        return out
+
+    def _kubelet(self) -> None:
+        """Boot rendered pods: assign a podIP and flip Ready after
+        BOOT_TICKS. Broken pods stay broken — repair is the
+        reconciler's job."""
+        for model in MODELS:
+            for pod in self._pods(model):
+                st = pod.get("status", {})
+                if st.get("podIP"):
+                    continue
+                if st.get("reason") == "Preempted" or st.get(
+                    "containerStatuses"
+                ):
+                    continue
+                name = pod["metadata"]["name"]
+                born = self.first_seen.setdefault(name, self.tick_no)
+                if self.tick_no - born < BOOT_TICKS:
+                    continue
+                fresh = self.raw_store.get("Pod", "default", name)
+                ip = f"10.77.0.{self.ip_counter}"
+                self.ip_counter += 1
+                status = fresh.setdefault("status", {})
+                status["podIP"] = ip
+                status["phase"] = "Running"
+                status["conditions"] = [
+                    {"type": "Ready", "status": "True"},
+                    {"type": "PodScheduled", "status": "True"},
+                ]
+                self.raw_store.update(fresh)
+                self.addr_model[f"{ip}:8000"] = model
+
+    # ---- chaos event application ---------------------------------------
+
+    def apply_event(self, ev: GameDayEvent) -> None:
+        p = ev.params
+        if ev.kind in (EV_KILL_POD, EV_SPOT_PREEMPT):
+            mode = p.get("mode", "preempt")
+            for _ in range(int(p.get("count", 1))):
+                self._kill_one(ev.target, mode, p.get("victim", ""))
+        elif ev.kind == EV_WEDGE_ENGINE:
+            addr = None
+            if p.get("victim") == "most_resumed":
+                # Chase one stream across its resumes: freeze whichever
+                # bound stream has died the most (first pick: the one
+                # with the most work left, so it can't just finish).
+                bound = sorted(
+                    (s for s in self.active if s.model == ev.target
+                     and s.addr is not None),
+                    key=lambda s: (-s.resumes, s.delivered - s.need,
+                                   s.t_arrive, s.tenant),
+                )
+                if bound:
+                    addr = bound[0].addr
+            if addr is None:
+                addrs = self._ready_addrs(ev.target)
+                if addrs:
+                    addr = addrs[int(p.get("index", 0)) % len(addrs)]
+            if addr is not None:
+                self.wedged[addr] = self.tick_no + WEDGE_TICKS
+        elif ev.kind == EV_API_PARTITION:
+            self.api.partitioned = True
+            self.partition_until = self.rel_now() + float(
+                p.get("duration_s", 5.0)
+            )
+        elif ev.kind == EV_API_STORM:
+            key = (p.get("method", "GET"), p.get("plural", "pods"), False)
+            cur = self.api_plan.counts[key]
+            self.api_plan.faults.append(ApiFault(
+                method=key[0], plural=key[1], watch=False, kind="http",
+                status=int(p.get("status", 500)),
+                start=cur + 1, end=cur + int(p.get("count", 3)),
+            ))
+        elif ev.kind == EV_TENANT_FLOOD:
+            self.floods.append({
+                "tenant": ev.target or "flooder",
+                "model": p.get("model", "std"),
+                "rps": int(p.get("rps", 20)),
+                "until": self.rel_now() + float(p.get("duration_s", 10.0)),
+            })
+        elif ev.kind == EV_CHIP_FLIP:
+            delta = int(p.get("delta", 0))
+            if delta < 0:
+                for _ in range(-delta):
+                    if not self.spot_nodes:
+                        break
+                    name = self.spot_nodes.pop()
+                    node = self.raw_store.get("Node", "default", name)
+                    self.raw_store.delete("Node", "default", name)
+                    self.spot_removed.append(node)
+            else:
+                for _ in range(delta):
+                    if not self.spot_removed:
+                        break
+                    node = self.spot_removed.pop()
+                    node["metadata"].pop("resourceVersion", None)
+                    node["metadata"].pop("uid", None)
+                    self.raw_store.create(node)
+                    self.spot_nodes.append(node["metadata"]["name"])
+        elif ev.kind == EV_TELEMETRY_STALE:
+            self.stale_until = self.rel_now() + float(
+                p.get("duration_s", 5.0)
+            )
+        elif ev.kind == EV_LINK_DROP:
+            if p.get("mode") == "sever":
+                # Instant mid-stream link cut: the pod stays healthy,
+                # the stream(s) over the link die and must resume.
+                if p.get("victim") == "most_resumed":
+                    # Surgical: cut ONE stream's connection — the one
+                    # that has died the most (first pick: the one with
+                    # the most work left, so it can't just finish).
+                    bound = sorted(
+                        (s for s in self.active if s.model == ev.target
+                         and s.addr is not None),
+                        key=lambda s: (-s.resumes, s.delivered - s.need,
+                                       s.t_arrive, s.tenant),
+                    )
+                    if bound:
+                        self._sever_one(bound[0])
+                    return
+                addrs = self._ready_addrs(ev.target)
+                if addrs:
+                    self._sever_streams(
+                        addrs[int(p.get("index", 0)) % len(addrs)]
+                    )
+                return
+            addrs = self._ready_addrs(ev.target)
+            if addrs:
+                addr = addrs[int(p.get("index", 0)) % len(addrs)]
+                cur = self.link_plan.counts[addr]
+                fault = Fault(addr, "connect_error", start=cur + 1, end=None)
+                self.link_plan.faults.append(fault)
+                self.active_links.append({
+                    "addr": addr, "fault": fault,
+                    "until": self.rel_now() + float(p.get("duration_s", 3.0)),
+                })
+
+    def _kill_one(self, model: str, mode: str, victim: str) -> None:
+        pods = [p for p in self._pods(model) if self._is_ready(p)]
+        if not pods:
+            return
+        pod = pods[0]
+        if victim == "oldest_stream":
+            bound = sorted(
+                (s for s in self.active if s.model == model
+                 and s.addr is not None),
+                key=lambda s: (s.t_arrive, s.tenant),
+            )
+            if bound:
+                target = bound[0].addr
+                for p in pods:
+                    if self._addr_of(p) == target:
+                        pod = p
+                        break
+        break_pod(self.raw_store, pod, mode)
+        addr = self._addr_of(pod)
+        if addr:
+            self._addr_died(addr)
+
+    def _addr_died(self, addr: str) -> None:
+        """An endpoint is gone mid-flight: feed the breaker, resume or
+        fail each bound stream per the proxy's continuation discipline."""
+        self.dead.add(addr)
+        self.wedged.pop(addr, None)
+        self._sever_streams(addr)
+
+    def _sever_streams(self, addr: str) -> None:
+        """Cut every stream bound over `addr` (endpoint death or a
+        mid-stream link cut — the pod itself may be fine)."""
+        for s in [s for s in self.active if s.addr == addr]:
+            self._sever_one(s)
+
+    def _sever_one(self, s: Stream) -> None:
+        """One stream's connection dies mid-flight: feed the breaker,
+        then resume from the delivered position — or surface the error
+        once the continuation budget is spent."""
+        self.active.remove(s)
+        if s.done is not None:
+            s.done(outcome="midstream", error="stream connection died")
+        s.failed.add(s.addr)
+        s.addr = None
+        s.done = None
+        s.resumes += 1
+        if s.resumes > MAX_STREAM_RESUMES:
+            self.client_errors += 1
+            self.errored.append(s)
+        else:
+            self.queues[s.model].appendleft(s)
+
+    def _expire_timed_chaos(self) -> None:
+        rel = self.rel_now()
+        if self.api.partitioned and rel >= self.partition_until:
+            self.api.partitioned = False
+        self.floods = [f for f in self.floods if rel < f["until"]]
+        still = []
+        for link in self.active_links:
+            if rel >= link["until"]:
+                # Seal the fault at the current attempt count: the link
+                # is back, later attempts must pass.
+                link["fault"].end = self.link_plan.counts[link["addr"]]
+            else:
+                still.append(link)
+        self.active_links = still
+        for addr, fires_at in list(self.wedged.items()):
+            if self.tick_no >= fires_at:
+                # Watchdog: a wedged engine is killed and replaced.
+                for pod in self._pods(self.addr_model.get(addr, "")):
+                    if self._addr_of(pod) == addr:
+                        break_pod(self.raw_store, pod, "crashloop")
+                        break
+                self._addr_died(addr)
+
+    # ---- data plane ----------------------------------------------------
+
+    def _arrivals(self) -> None:
+        now = self.clock()
+        plan = [("user-rt", "rt", 2), ("user-std", "std", 1)]
+        if self.tick_no % 2 == 0:
+            plan.append(("user-batch", "batch", 1))
+        rel = self.rel_now()
+        for f in self.floods:
+            if rel < f["until"]:
+                plan.append((f["tenant"], f["model"], f["rps"]))
+        for tenant, model, count in plan:
+            cls = MODEL_CLASS[model]
+            for _ in range(count):
+                self.arrival_counter[model] += 1
+                refusal = self.door.admit(
+                    tenant, model, priority=cls,
+                    est_tokens=PROMPT_TOKENS + self.stream_tokens,
+                )
+                if refusal is not None:
+                    self.refusals.append(
+                        (tenant, model, cls, refusal.reason)
+                    )
+                    continue
+                self.queues[model].append(
+                    Stream(tenant, model, cls, now,
+                           need=self.stream_tokens)
+                )
+
+    def _dispatch(self) -> None:
+        for model in MODELS:
+            group = self.lb.group(model)
+            q = self.queues[model]
+            guard = len(q)
+            while q and guard > 0:
+                guard -= 1
+                s = q[0]
+                bound = False
+                slot_full: set[str] = set()
+                for _ in range(MAX_ATTEMPTS):
+                    try:
+                        addr, done = group.get_best_addr(
+                            "LeastLoad", "", "", timeout=0.02,
+                            exclude=s.failed | slot_full,
+                        )
+                    except (NoHealthyEndpoints, LoadBalancerTimeout):
+                        break
+                    if addr in self.dead:
+                        done(outcome="connect_error",
+                             error="endpoint dead")
+                        s.failed.add(addr)
+                        continue
+                    if sum(
+                        1 for a in self.active if a.addr == addr
+                    ) >= SLOTS:
+                        # Engine at slot capacity isn't a fault — skip
+                        # it for this pick, stop once every endpoint is
+                        # full.
+                        done()
+                        if addr in slot_full:
+                            break
+                        slot_full.add(addr)
+                        continue
+                    if self.active_links and any(
+                        link["addr"] == addr for link in self.active_links
+                    ):
+                        fault = self.link_plan.on_attempt(addr)
+                        if fault is not None:
+                            done(outcome="connect_error",
+                                 error="link dropped")
+                            s.failed.add(addr)
+                            continue
+                    s.addr = addr
+                    s.done = done
+                    bound = True
+                    break
+                if not bound:
+                    # Nothing reachable for this stream right now: it
+                    # stays queued; retries start fresh next tick (the
+                    # exclude set only spans one dispatch cycle, like
+                    # the proxy's).
+                    s.failed.clear()
+                    break
+                q.popleft()
+                self.active.append(s)
+
+    def _serve(self) -> None:
+        now = self.clock()
+        finished = []
+        for s in self.active:
+            if s.addr in self.wedged:
+                continue  # wedged engine: no tokens this tick
+            if s.t_first is None:
+                s.t_first = now
+                self.wait_samples.setdefault(
+                    (s.tenant, s.model), []
+                ).append(now - s.t_arrive)
+            s.delivered += TOKENS_PER_TICK
+            if s.delivered >= s.need:
+                finished.append(s)
+        for s in finished:
+            self.active.remove(s)
+            s.done(outcome="success")
+            s.done = None
+            s.addr = None
+            s.billed = s.need
+            self.usage.record(
+                s.tenant, s.model,
+                prompt_tokens=PROMPT_TOKENS, completion_tokens=s.need,
+                stream_seconds=now - s.t_arrive,
+            )
+            self.completed.append(s)
+
+    # ---- control plane -------------------------------------------------
+
+    def _control_plane(self) -> None:
+        rel = self.rel_now()
+        if rel >= self.stale_until:
+            try:
+                self.aggregator.collect()
+            except Exception:
+                self.control_plane_errors += 1
+        for step in (self.scaler.tick, self._planner_tick):
+            try:
+                step()
+            except (ApiServerUnreachable, ApiServerError):
+                self.control_plane_errors += 1
+        for model in MODELS:
+            try:
+                self.reconciler.reconcile("default", model)
+            except (ApiServerUnreachable, ApiServerError):
+                self.control_plane_errors += 1
+
+    def _planner_tick(self) -> None:
+        plan = self.planner.tick()
+        if plan is not None:
+            self.last_plan = plan
+            self.plans.append(plan)
+
+    # ---- convergence + observability -----------------------------------
+
+    def active_chaos_kinds(self) -> list[str]:
+        kinds = set()
+        rel = self.rel_now()
+        if self.api.partitioned:
+            kinds.add("api_partition")
+        if self.floods:
+            kinds.add("tenant_flood")
+        if self.active_links:
+            kinds.add("link_drop")
+        if self.wedged:
+            kinds.add("wedge")
+        if rel < self.stale_until:
+            kinds.add("telemetry_stale")
+        if self.spot_removed:
+            kinds.add("chip_flip")
+        for model in MODELS:
+            spec = self.raw_store.get("Model", "default", model)["spec"]
+            if len(self._ready_addrs(model)) < int(
+                spec.get("replicas") or 0
+            ):
+                kinds.add("dead_pod")
+                break
+        return sorted(kinds)
+
+    def is_converged(self) -> bool:
+        if self.wedged or self.dead & set(
+            a for m in MODELS for a in self._ready_addrs(m)
+        ):
+            return False
+        now = self.clock()
+        for model in MODELS:
+            spec = self.raw_store.get("Model", "default", model)["spec"]
+            want = int(spec.get("replicas") or 0)
+            if len(self._ready_addrs(model)) != want:
+                return False
+            q = self.queues[model]
+            if q and now - q[0].t_arrive > 3 * TICK_S:
+                return False
+        return not self.door._overload
+
+    # ---- the tick ------------------------------------------------------
+
+    def tick(self) -> None:
+        self.tick_no += 1
+        self.clock.advance(TICK_S)
+        self.wall.advance(TICK_S)
+        rel = self.rel_now()
+
+        for ev in self.trace.due(rel):
+            self.apply_event(ev)
+            self.log.event(self.tick_no, ev)
+        self._expire_timed_chaos()
+        self._kubelet()
+        self.lb.sync_all()
+        self._arrivals()
+        self._dispatch()
+        self._serve()
+        self._control_plane()
+
+        self.metric_history.append(
+            (self.clock(), self.metrics.registry.expose())
+        )
+        while (
+            len(self.metric_history) > 2
+            and self.metric_history[1][0]
+            <= self.clock() - self.gcfg.window_seconds
+        ):
+            self.metric_history.popleft()
+
+        kinds = self.active_chaos_kinds()
+        self.kinds_timeline.append(kinds)
+        self.log.obs(
+            self.tick_no,
+            t=round(rel, 3),
+            chaos=kinds,
+            queues={m: len(self.queues[m]) for m in MODELS},
+            ready={m: len(self._ready_addrs(m)) for m in MODELS},
+            active=len(self.active),
+            errors=self.client_errors,
+        )
+        self.checker.check_continuous(self, self.tick_no, rel)
+        if rel > self.trace.last_event_t and not self.is_converged():
+            self.last_unconverged_tick = self.tick_no
+
+    def run(self) -> dict:
+        for _ in range(WARMUP_TICKS + self.ticks):
+            self.tick()
+        self.converged_final = self.is_converged()
+        self.checker.check_terminal(self, self.tick_no, self.rel_now())
+        return self.result()
+
+    def result(self) -> dict:
+        max_kinds = max((len(k) for k in self.kinds_timeline), default=0)
+        at_max = next(
+            (k for k in self.kinds_timeline if len(k) == max_kinds), []
+        )
+        fv = self.checker.first_violation
+        return {
+            "seed": self.seed,
+            "ticks": self.ticks,
+            "trace_events": len(self.trace.events),
+            "last_event_t": self.trace.last_event_t,
+            "client_errors": self.client_errors,
+            "completed": len(self.completed),
+            "arrivals": dict(self.arrival_counter),
+            "refusals": list(self.refusals),
+            "violations": [
+                {"tick": v.tick, "t": v.t, "invariant": v.invariant,
+                 "detail": v.detail}
+                for v in self.checker.violations
+            ],
+            "first_violation": None if fv is None else {
+                "tick": fv.tick, "t": fv.t, "invariant": fv.invariant,
+                "detail": fv.detail,
+            },
+            "max_concurrent_kinds": max_kinds,
+            "concurrent_kinds_at_max": at_max,
+            "kinds_timeline": self.kinds_timeline,
+            "converged_final": self.converged_final,
+            "last_unconverged_tick": self.last_unconverged_tick,
+            "converge_bound_s": CONVERGE_BOUND_S,
+            "control_plane_errors": self.control_plane_errors,
+            "plans_seen": len(self.plans),
+            "usage_totals": self.usage.totals(),
+            "wait_samples": {
+                f"{t}/{m}": v for (t, m), v in self.wait_samples.items()
+            },
+            "log": self.log,
+        }
+
+
+# ---- invariants --------------------------------------------------------------
+
+
+def _inv_zero_stream_errors(world) -> str | None:
+    if world.client_errors:
+        return (
+            f"{world.client_errors} stream(s) exhausted the "
+            f"{MAX_STREAM_RESUMES}-resume budget and surfaced to clients"
+        )
+    return None
+
+
+def _inv_disruption_budget(world) -> str | None:
+    """Budgeted deletions per sliding window, measured from SCRAPES —
+    the governor is audited from the outside, not trusted."""
+    hist = world.metric_history
+    if len(hist) < 2:
+        return None
+    now = world.clock()
+    base = None
+    for t, text in hist:
+        if t > now - world.gcfg.window_seconds:
+            base = text
+            break
+    if base is None:
+        return None
+    per_model: dict[str, float] = {}
+    for (name, labels), delta in scrape_diff(base, hist[-1][1]).items():
+        if name != DELETE_SERIES:
+            continue
+        lab = dict(labels)
+        if lab.get("action") != "delete":
+            continue
+        per_model[lab.get("model", "?")] = (
+            per_model.get(lab.get("model", "?"), 0.0) + delta
+        )
+    for model, n in per_model.items():
+        if n > MODEL_DISRUPTION_BUDGET + 1e-9:
+            return (
+                f"model {model}: {n:.0f} budgeted deletions in one "
+                f"{world.gcfg.window_seconds:.0f}s window "
+                f"(budget {MODEL_DISRUPTION_BUDGET})"
+            )
+    total = sum(per_model.values())
+    if total > CLUSTER_DISRUPTION_BUDGET + 1e-9:
+        return (
+            f"cluster: {total:.0f} budgeted deletions in one window "
+            f"(budget {CLUSTER_DISRUPTION_BUDGET})"
+        )
+    return None
+
+
+def _inv_realtime_never_shed(world) -> str | None:
+    for tenant, model, cls, reason in world.refusals:
+        if cls == "realtime" and reason == "overload":
+            return (
+                f"realtime request ({tenant}/{model}) door-shed under "
+                "overload — realtime must never be shed"
+            )
+    return None
+
+
+def _inv_chip_budget(world) -> str | None:
+    plan = world.last_plan
+    if plan is None:
+        return None
+    if plan["allocated_chips"]["total"] > plan["budget"]["total"]:
+        return (
+            f"plan allocates {plan['allocated_chips']['total']} chips "
+            f"with only {plan['budget']['total']} in inventory"
+        )
+    for shape, used in plan["allocated_chips"]["by_shape"].items():
+        if used > plan["budget"]["by_shape"].get(shape, 0):
+            return f"shape {shape} over-allocated: {used}"
+    return None
+
+
+def _inv_billing_exact(world) -> str | None:
+    totals = world.usage.totals()
+    want_completion = sum(s.billed for s in world.completed)
+    want_prompt = PROMPT_TOKENS * len(world.completed)
+    got_completion = int(totals.get("completion_tokens", 0))
+    got_prompt = int(totals.get("prompt_tokens", 0))
+    if (got_completion, got_prompt) != (want_completion, want_prompt):
+        return (
+            f"ledger says {got_prompt}+{got_completion} tokens, "
+            f"delivered work is {want_prompt}+{want_completion} — "
+            "billing drifted across resumes"
+        )
+    if int(totals.get("requests", 0)) != len(world.completed):
+        return (
+            f"ledger counts {totals.get('requests')} requests, "
+            f"{len(world.completed)} streams completed"
+        )
+    return None
+
+
+def _inv_token_continuity(world) -> str | None:
+    for s in world.completed:
+        if s.delivered != s.need:
+            return (
+                f"stream for {s.tenant}/{s.model} delivered "
+                f"{s.delivered}/{s.need} tokens after {s.resumes} "
+                "resume(s) — gap or duplication"
+            )
+    return None
+
+
+def _inv_convergence(world) -> str | None:
+    if not world.converged_final:
+        return (
+            "fleet did not return to steady state by the end of the run "
+            f"(queues={ {m: len(world.queues[m]) for m in MODELS} }, "
+            f"wedged={sorted(world.wedged)}, "
+            f"overload={world.door._overload})"
+        )
+    last = world.last_unconverged_tick
+    if last is not None:
+        settle = (last + 1 - WARMUP_TICKS) * TICK_S - world.trace.last_event_t
+        if settle > CONVERGE_BOUND_S:
+            return (
+                f"converged {settle:.0f}s after the last chaos event "
+                f"(bound {CONVERGE_BOUND_S:.0f}s)"
+            )
+    return None
+
+
+INVARIANTS = (
+    Invariant("zero_stream_errors", _inv_zero_stream_errors, CONTINUOUS,
+              "no client ever sees a broken stream"),
+    Invariant("disruption_budget", _inv_disruption_budget, CONTINUOUS,
+              "budgeted deletions per window within model+cluster budgets"),
+    Invariant("realtime_never_shed", _inv_realtime_never_shed, CONTINUOUS,
+              "the door never sheds realtime traffic"),
+    Invariant("chip_budget", _inv_chip_budget, CONTINUOUS,
+              "the plan never allocates more chips than the inventory"),
+    Invariant("billing_exact", _inv_billing_exact, CONTINUOUS,
+              "the usage ledger equals delivered work exactly"),
+    Invariant("token_continuity", _inv_token_continuity, CONTINUOUS,
+              "resumed streams deliver every token exactly once"),
+    Invariant("convergence", _inv_convergence, TERMINAL,
+              "healthy steady state within CONVERGE_BOUND_S of last chaos"),
+)
+
+
+# ---- traces ------------------------------------------------------------------
+
+
+def fast_trace(seed: int = 0) -> GameDayTrace:
+    """The tier-1 game day: all four headline chaos kinds overlap around
+    t=12-13 (flood + partition + spot flip + dead pod), with wedge,
+    storm, staleness and a link drop layered on."""
+    return GameDayTrace([
+        GameDayEvent(5.0, EV_TENANT_FLOOD, "flooder",
+                     {"model": "std", "rps": 30, "duration_s": 20.0}),
+        GameDayEvent(8.0, EV_CHIP_FLIP, "",
+                     {"delta": -4, "duration_s": 18.0}),
+        GameDayEvent(8.0, EV_SPOT_PREEMPT, "batch", {"count": 1}),
+        GameDayEvent(10.0, EV_API_PARTITION, "", {"duration_s": 8.0}),
+        GameDayEvent(12.0, EV_KILL_POD, "rt",
+                     {"count": 1, "mode": "preempt"}),
+        GameDayEvent(14.0, EV_WEDGE_ENGINE, "std", {}),
+        GameDayEvent(16.0, EV_TELEMETRY_STALE, "", {"duration_s": 6.0}),
+        GameDayEvent(18.0, EV_LINK_DROP, "rt",
+                     {"index": 0, "duration_s": 5.0}),
+        GameDayEvent(20.0, EV_API_STORM, "",
+                     {"method": "GET", "plural": "pods", "status": 500,
+                      "count": 3}),
+        GameDayEvent(26.0, EV_CHIP_FLIP, "", {"delta": 4}),
+    ], seed=seed)
+
+
+def extended_trace(seed: int = 0) -> GameDayTrace:
+    """Two full chaos rounds back to back — the slow-tier soak."""
+    base = fast_trace(seed).events
+    second = [
+        GameDayEvent(ev.t + 45.0, ev.kind, ev.target, dict(ev.params))
+        for ev in base
+    ]
+    return GameDayTrace(list(base) + second, seed=seed)
+
+
+def failing_trace(seed: int = 0) -> GameDayTrace:
+    """A trace engineered to violate zero_stream_errors: every tick,
+    the link under the MOST-RESUMED bound stream is severed (the pod
+    stays healthy, so there's always somewhere to resume to — and the
+    cut chases the stream wherever it lands). Run with
+    stream_tokens=FAILING_STREAM_TOKENS so delivery can't outrun the
+    cuts: the victim burns all MAX_STREAM_RESUMES continuations.
+    Exists to prove the dump->replay loop lands on the same first
+    violation."""
+    events = list(fast_trace(seed).events)
+    for i in range(6):
+        events.append(GameDayEvent(
+            30.0 + i, EV_LINK_DROP, "rt",
+            {"mode": "sever", "victim": "most_resumed"},
+        ))
+    return GameDayTrace(events, seed=seed)
+
+
+TRACES = {
+    "fast": fast_trace,
+    "extended": extended_trace,
+    "failing": failing_trace,
+}
+
+DEFAULT_TICKS = {"fast": 70, "extended": 140, "failing": 70}
+
+
+FAILING_STREAM_TOKENS = 50  # long enough that per-tick kills outpace delivery
+
+
+def run_gameday(trace: GameDayTrace, ticks: int, seed: int = 0,
+                stream_tokens: int = STREAM_TOKENS) -> dict:
+    return GameDayWorld(
+        trace, ticks, seed=seed, stream_tokens=stream_tokens
+    ).run()
+
+
+def run_sim(ticks: int = DEFAULT_TICKS["fast"], seed: int = 0) -> dict:
+    """The tier-1 entry point: the full game day, the same day minus
+    the flood (tenant-isolation baseline), and the engineered failure
+    (replay fodder)."""
+    gameday = run_gameday(fast_trace(seed), ticks, seed)
+    baseline = run_gameday(
+        fast_trace(seed).without(EV_TENANT_FLOOD), ticks, seed
+    )
+    failing = run_gameday(
+        failing_trace(seed), ticks, seed,
+        stream_tokens=FAILING_STREAM_TOKENS,
+    )
+    return {
+        "ticks": ticks,
+        "seed": seed,
+        "gameday": gameday,
+        "baseline": baseline,
+        "failing": failing,
+    }
+
+
+# ---- result-level checks (imported by tests/unit/test_gameday.py) -----------
+
+
+def check_chaos_concurrency(result: dict) -> None:
+    """The headline composition really happened: flood + partition +
+    chip flip + dead pod active on one tick."""
+    g = result["gameday"]
+    need = {"tenant_flood", "api_partition", "chip_flip", "dead_pod"}
+    assert any(
+        need <= set(kinds) for kinds in g["kinds_timeline"]
+    ), f"never saw {need} concurrently; max was {g['concurrent_kinds_at_max']}"
+
+
+def check_no_violations(result: dict) -> None:
+    """The full game day holds every invariant, continuous AND
+    terminal."""
+    g = result["gameday"]
+    assert g["violations"] == [], g["violations"]
+    assert g["client_errors"] == 0
+    assert g["converged_final"], "fleet did not converge"
+
+
+def check_progress_under_chaos(result: dict) -> None:
+    """Chaos must not deadlock the data plane: most admitted work
+    completes, and every class completes some."""
+    g = result["gameday"]
+    assert g["completed"] > 0
+    done_models = {s for k in g["wait_samples"] for s in [k.split("/")[1]]}
+    assert done_models == set(MODELS), (
+        f"classes that completed work: {sorted(done_models)}"
+    )
+
+
+def check_tenant_isolation(result: dict) -> None:
+    """The flooding tenant cannot move a compliant tenant's p99 TTFT
+    wait: full game day vs the identical day without the flood."""
+    g, b = result["gameday"], result["baseline"]
+    for key in ("user-rt/rt",):
+        flooded = percentile(g["wait_samples"].get(key, []), 0.99)
+        calm = percentile(b["wait_samples"].get(key, []), 0.99)
+        assert flooded <= calm + 1.0 * TICK_S, (
+            f"{key}: p99 wait {flooded:.2f}s with flood vs {calm:.2f}s "
+            "without — isolation broken"
+        )
+    key = "user-std/std"
+    flooded = percentile(g["wait_samples"].get(key, []), 0.99)
+    calm = percentile(b["wait_samples"].get(key, []), 0.99)
+    assert flooded <= calm + 4.0 * TICK_S, (
+        f"{key}: p99 wait {flooded:.2f}s with flood vs {calm:.2f}s "
+        "without — isolation broken"
+    )
+
+
+def check_flood_was_real(result: dict) -> None:
+    """The abusive tenant was actually refused at the door (rate), and
+    compliant realtime was never refused at all."""
+    g = result["gameday"]
+    flood_refusals = [r for r in g["refusals"] if r[0] == "flooder"]
+    assert len(flood_refusals) > 100, len(flood_refusals)
+    rt_refusals = [r for r in g["refusals"] if r[0] == "user-rt"]
+    assert rt_refusals == [], rt_refusals
+
+
+def check_failing_trace_fails(result: dict) -> None:
+    """The engineered trace produces a deterministic first violation of
+    zero_stream_errors."""
+    f = result["failing"]
+    assert f["first_violation"] is not None
+    assert f["first_violation"]["invariant"] == "zero_stream_errors"
+
+
+ALL_CHECKS = (
+    check_chaos_concurrency,
+    check_no_violations,
+    check_progress_under_chaos,
+    check_tenant_isolation,
+    check_flood_was_real,
+    check_failing_trace_fails,
+)
+
+
+# ---- replay ------------------------------------------------------------------
+
+
+def replay(path: str) -> tuple[dict, dict]:
+    """Re-run a dumped game day byte-identically: rebuild the trace from
+    the dump's header and drive a fresh world with the same seed and
+    tick count. Returns (header, fresh result)."""
+    header, _records = GameDayLog.load(path)
+    trace = GameDayTrace(
+        [GameDayEvent.from_dict(d) for d in header["events"]],
+        seed=int(header["seed"]),
+    )
+    result = run_gameday(
+        trace, int(header["ticks"]), seed=int(header["seed"]),
+        stream_tokens=int(header.get("stream_tokens", STREAM_TOKENS)),
+    )
+    return header, result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", choices=sorted(TRACES), default="fast")
+    ap.add_argument("--ticks", type=int, default=0,
+                    help="simulated ticks after warmup (default: per trace)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--dump", help="write the JSONL event log here")
+    ap.add_argument("--replay", metavar="DUMP",
+                    help="re-run a dumped game day and compare")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.replay:
+        with open(args.replay) as fh:
+            original = [line.rstrip("\n") for line in fh if line.strip()]
+        header, result = replay(args.replay)
+        fresh = result["log"].lines
+        identical = fresh == original
+        fv = result["first_violation"]
+        print(f"replayed {args.replay}: {len(original)} log lines")
+        print(f"byte-identical: {identical}")
+        print(f"first violation: {fv}")
+        return 0 if identical else 1
+
+    trace = TRACES[args.trace](args.seed)
+    ticks = args.ticks or DEFAULT_TICKS[args.trace]
+    stream_tokens = (
+        FAILING_STREAM_TOKENS if args.trace == "failing" else STREAM_TOKENS
+    )
+    result = run_gameday(
+        trace, ticks, seed=args.seed, stream_tokens=stream_tokens
+    )
+    if args.dump:
+        result["log"].dump(args.dump)
+        print(f"log -> {args.dump}")
+
+    if args.json:
+        slim = {k: v for k, v in result.items()
+                if k not in ("log", "kinds_timeline", "wait_samples")}
+        print(json.dumps(slim, indent=2, default=str))
+        return 0
+
+    print(f"game day [{args.trace}]: seed={args.seed} ticks={ticks} "
+          f"events={result['trace_events']}")
+    print(f"  completed={result['completed']} "
+          f"client_errors={result['client_errors']} "
+          f"refusals={len(result['refusals'])}")
+    print(f"  max concurrent chaos kinds: {result['max_concurrent_kinds']} "
+          f"{result['concurrent_kinds_at_max']}")
+    print(f"  control-plane errors absorbed: "
+          f"{result['control_plane_errors']}")
+    print(f"  converged: {result['converged_final']}")
+    if result["violations"]:
+        print(f"  VIOLATIONS ({len(result['violations'])}):")
+        for v in result["violations"][:10]:
+            print(f"    tick {v['tick']} [{v['invariant']}] {v['detail']}")
+    else:
+        print("  all invariants held")
+    return 0 if not result["violations"] or args.trace == "failing" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

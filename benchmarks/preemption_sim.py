@@ -34,7 +34,6 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from kubeai_tpu.config import System
-from kubeai_tpu.crd.model import Model, ModelSpec
 from kubeai_tpu.metrics import Metrics
 from kubeai_tpu.operator.controller import ModelReconciler
 from kubeai_tpu.operator.k8s.store import KubeStore
@@ -45,6 +44,7 @@ from kubeai_tpu.routing.loadbalancer import (
     NoHealthyEndpoints,
 )
 from kubeai_tpu.testing.faults import FakeClock
+from kubeai_tpu.testing.simkit import break_pod, mark_ready, mk_model
 
 MAX_STREAM_RESUMES = 3  # mirrors proxy.MAX_STREAM_RESUMES
 
@@ -146,54 +146,8 @@ def run_stream_phase(
 # ---- phase 2: self-healing operator repair -----------------------------------
 
 
-def _mk_model(store: KubeStore, replicas: int) -> None:
-    m = Model(
-        name="sim",
-        spec=ModelSpec(
-            url="hf://org/model",
-            engine="KubeAITPU",
-            features=["TextGeneration"],
-            resource_profile="google-tpu-v5e-1x1:1",
-            autoscaling_disabled=True,
-            replicas=replicas,
-        ),
-    )
-    m.validate()
-    store.create(m.to_dict())
-
-
-def _mark_ready(store: KubeStore, pod: dict, wall: FakeClock) -> None:
-    fresh = store.get(
-        "Pod", pod["metadata"]["namespace"], pod["metadata"]["name"]
-    )
-    fresh.setdefault("status", {})["conditions"] = [
-        {"type": "Ready", "status": "True"},
-        {"type": "PodScheduled", "status": "True"},
-    ]
-    fresh["status"]["phase"] = "Running"
-    store.update(fresh)
-
-
-def _break_pod(store: KubeStore, pod: dict, mode: str) -> None:
-    fresh = store.get(
-        "Pod", pod["metadata"]["namespace"], pod["metadata"]["name"]
-    )
-    status = fresh.setdefault("status", {})
-    if mode == "preempt":
-        status["phase"] = "Failed"
-        status["reason"] = "Preempted"
-        status["conditions"] = [{"type": "Ready", "status": "False"}]
-    else:  # crashloop
-        status["phase"] = "Running"
-        status["conditions"] = [{"type": "Ready", "status": "False"}]
-        status["containerStatuses"] = [
-            {
-                "name": "server",
-                "restartCount": 7,
-                "state": {"waiting": {"reason": "CrashLoopBackOff"}},
-            }
-        ]
-    store.update(fresh)
+# Model factory and pod breakage live in kubeai_tpu.testing.simkit now,
+# shared with every other sim and the game-day harness.
 
 
 def run_repair_phase(
@@ -213,10 +167,10 @@ def run_repair_phase(
     rec = ModelReconciler(
         store, cfg, metrics=metrics, clock=clock, wall=wall
     )
-    _mk_model(store, replicas)
+    mk_model(store, replicas=replicas, autoscaling_disabled=True)
     rec.reconcile("default", "sim")
     for pod in store.list("Pod", "default", {"model": "sim"}):
-        _mark_ready(store, pod, wall)
+        mark_ready(store, pod)
     rec.reconcile("default", "sim")
 
     bound_s = cfg.resilience.repair_backoff_max_seconds + step_s
@@ -226,7 +180,7 @@ def run_repair_phase(
         pods = store.list("Pod", "default", {"model": "sim"})
         victim = pods[rnd % len(pods)]
         victim_name = victim["metadata"]["name"]
-        _break_pod(store, victim, "preempt" if rnd % 2 == 0 else "crashloop")
+        break_pod(store, victim, "preempt" if rnd % 2 == 0 else "crashloop")
         t0 = clock()
         # The watch would requeue on the pod MODIFIED event; the sim
         # drives reconcile directly, advancing the clocks until the
@@ -251,7 +205,7 @@ def run_repair_phase(
         repair_delays.append(clock() - t0)
         # Fresh replacements come up Ready before the next round.
         for pod in store.list("Pod", "default", {"model": "sim"}):
-            _mark_ready(store, pod, wall)
+            mark_ready(store, pod)
         rec.reconcile("default", "sim")
         clock.advance(step_s)
         wall.advance(step_s)

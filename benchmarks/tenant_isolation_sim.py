@@ -41,6 +41,7 @@ from kubeai_tpu.fleet.metering import UsageMeter
 from kubeai_tpu.fleet.tenancy import TenantGovernor
 from kubeai_tpu.metrics import Metrics
 from kubeai_tpu.testing.faults import FakeClock
+from kubeai_tpu.testing.simkit import percentile
 from kubeai_tpu.utils import retryafter
 
 MODEL = "m0"
@@ -69,12 +70,9 @@ def _pin_jitter():
     retryafter._jitter = lambda: 1.0
 
 
-def _percentile(values: list[float], q: float) -> float:
-    if not values:
-        return 0.0
-    ordered = sorted(values)
-    idx = min(len(ordered) - 1, int(q * len(ordered)))
-    return ordered[idx]
+# Nearest-rank percentile comes from the shared sim scaffolding — same
+# definition, so the asserted thresholds carry over unchanged.
+_percentile = percentile
 
 
 def _run_trace(enabled: bool, abuse: bool, governor_present: bool = True):

@@ -181,6 +181,15 @@ class ActuationGovernor:
             )
         return None
 
+    def _refund_budget(self, model: str) -> None:
+        """Give back the most recent budget unit taken for `model` —
+        the delete it paid for never reached the API server."""
+        with self._lock:
+            for i in range(len(self._window) - 1, -1, -1):
+                if self._window[i][1] == model:
+                    del self._window[i]
+                    break
+
     def _deny(self, action: str, model: str, reason: str) -> None:
         self.metrics.governor_denied.inc(
             action=action, model=model, reason=reason
@@ -227,6 +236,14 @@ class ActuationGovernor:
             store.delete("Pod", namespace, name)
         except NotFound:
             pass
+        except Exception:
+            # The delete never happened (API partition, 5xx storm past
+            # the client's retries): refund the budget unit, or a storm
+            # of failed writes would drain the disruption window with
+            # ZERO actual disruptions and stall post-chaos convergence.
+            if self.enabled and budgeted:
+                self._refund_budget(model)
+            raise
         self._allow(action, model)
         return True
 

@@ -51,13 +51,17 @@ def match_labels(obj: dict, selector: dict[str, str] | None) -> bool:
 class KubeStore:
     """Thread-safe in-memory object store keyed by (kind, namespace, name)."""
 
-    def __init__(self):
+    def __init__(self, namegen: Callable[[], str] | None = None):
         self._lock = threading.RLock()
         self._objects: dict[tuple, dict] = {}
         self._rv = 0
         self._watchers: list[tuple[tuple[str, ...] | None, queue.Queue]] = []
         # admission validators: kind -> callable(new_obj, old_obj|None)
         self._validators: dict[str, Callable[[dict, dict | None], None]] = {}
+        # generateName suffix source. The default mirrors the real API
+        # server (random); deterministic sims inject a counter so pod
+        # names — and everything that sorts by them — replay identically.
+        self._namegen = namegen or (lambda: uuid.uuid4().hex[:6])
 
     # -- admission -----------------------------------------------------------
 
@@ -95,7 +99,7 @@ class KubeStore:
             name = m.get("name")
             if not name:
                 if m.get("generateName"):
-                    name = m["generateName"] + uuid.uuid4().hex[:6]
+                    name = m["generateName"] + self._namegen()
                     m["name"] = name
                 else:
                     raise Invalid("metadata.name required")

@@ -1,5 +1,20 @@
 """Deterministic test doubles for the resilience suite."""
 
+from kubeai_tpu.testing.chaos import (
+    CONTINUOUS,
+    EVENT_KINDS,
+    TERMINAL,
+    ApiServerError,
+    ApiServerUnreachable,
+    ChaosKubeStore,
+    GameDayEvent,
+    GameDayLog,
+    GameDayTrace,
+    Invariant,
+    InvariantChecker,
+    Violation,
+)
+from kubeai_tpu.testing.clock import FakeClock
 from kubeai_tpu.testing.faults import (
     API_FAULT_DROP,
     API_FAULT_HTTP,
@@ -11,8 +26,17 @@ from kubeai_tpu.testing.faults import (
     FAULT_TIMEOUT,
     ApiFault,
     ApiFaultPlan,
-    FakeClock,
     Fault,
     FaultPlan,
     faulty_send,
+)
+from kubeai_tpu.testing.simkit import (
+    break_pod,
+    mark_all_ready,
+    mark_ready,
+    mk_model,
+    percentile,
+    pod_names,
+    scrape_diff,
+    seeded_rng,
 )

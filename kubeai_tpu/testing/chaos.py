@@ -1,0 +1,404 @@
+"""The unified chaos plane: one declarative, seeded schedule of typed
+chaos events driving REAL components under one shared `FakeClock`.
+
+PRs 1-14 each proved one subsystem under its own private fault plan;
+this module is the composition layer the game-day harness
+(`benchmarks/gameday_sim.py`) is built on:
+
+  * `GameDayTrace` — a single time-ordered schedule of typed events
+    (kill/spot-preempt a pod, wedge an engine step, partition or storm
+    the API server, flood a tenant, flip the chip budget, stale-out
+    telemetry, drop a proxy->engine link). Same-tick ordering is
+    deterministic: events are stably sorted by (time, insertion order),
+    so two events at the same instant always apply in the order the
+    trace author wrote them — the same first-listed-wins discipline as
+    `FaultPlan`/`ApiFaultPlan`.
+  * `GameDayLog` — a JSONL event/observation/violation log with a
+    header carrying (seed, ticks, trace), so any failing run replays
+    byte-identically from its dump: the trace IS the input, the log IS
+    the evidence.
+  * `Invariant`/`InvariantChecker` — CONTINUOUS invariants are checked
+    every tick (zero client-visible stream errors, budgets respected,
+    realtime never door-shed, allocated <= inventory, billing exact);
+    TERMINAL invariants are checked once chaos has ended (convergence
+    to a healthy steady state within a bound). The checker records the
+    FIRST violation with its tick so a dump pinpoints the instant the
+    world went wrong.
+  * `ChaosKubeStore` — the API-server chaos seam: wraps a `KubeStore`
+    and consults an `ApiFaultPlan` per operation (plus a hard
+    `partitioned` switch), raising `ApiServerUnreachable` /
+    `ApiServerError` exactly where a real client would see its retries
+    exhaust. The operator stack is pointed at the wrapper; the sim's
+    own "kubelet"/infrastructure hands stay on the raw store — a
+    partition severs the control plane, not physics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from kubeai_tpu.testing.faults import (
+    API_FAULT_DROP,
+    API_FAULT_HTTP,
+    ApiFaultPlan,
+)
+
+# ---- event vocabulary --------------------------------------------------------
+
+EV_KILL_POD = "kill_pod"            # params: model, count, mode
+EV_SPOT_PREEMPT = "spot_preempt"    # params: model, count
+EV_WEDGE_ENGINE = "wedge_engine"    # params: model
+EV_API_PARTITION = "api_partition"  # params: duration_s
+EV_API_STORM = "api_storm"          # params: method, plural, status, count
+EV_TENANT_FLOOD = "tenant_flood"    # params: tenant, model, rps, duration_s
+EV_CHIP_FLIP = "chip_flip"          # params: delta (spot nodes +/-)
+EV_TELEMETRY_STALE = "telemetry_stale"  # params: duration_s
+EV_LINK_DROP = "link_drop"          # params: model, index, duration_s
+
+EVENT_KINDS = (
+    EV_KILL_POD,
+    EV_SPOT_PREEMPT,
+    EV_WEDGE_ENGINE,
+    EV_API_PARTITION,
+    EV_API_STORM,
+    EV_TENANT_FLOOD,
+    EV_CHIP_FLIP,
+    EV_TELEMETRY_STALE,
+    EV_LINK_DROP,
+)
+
+
+@dataclasses.dataclass
+class GameDayEvent:
+    """One scheduled chaos event. `seq` is the insertion index the
+    trace assigns — the documented same-tick tie-break."""
+
+    t: float
+    kind: str
+    target: str = ""
+    params: dict = dataclasses.field(default_factory=dict)
+    seq: int = -1
+
+    def __post_init__(self):
+        if self.kind not in EVENT_KINDS:
+            raise ValueError(f"unknown game-day event kind {self.kind!r}")
+
+    def to_dict(self) -> dict:
+        return {
+            "t": self.t, "kind": self.kind, "target": self.target,
+            "params": self.params, "seq": self.seq,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "GameDayEvent":
+        return cls(
+            t=float(d["t"]), kind=str(d["kind"]),
+            target=str(d.get("target", "")),
+            params=dict(d.get("params") or {}),
+            seq=int(d.get("seq", -1)),
+        )
+
+
+class GameDayTrace:
+    """A seeded, time-ordered schedule of `GameDayEvent`s.
+
+    Determinism contract: events are stably sorted by (t, seq) where
+    seq is insertion order, so same-tick events apply in the order the
+    author listed them; the only randomness available to a consumer is
+    `self.seed` (the consumer seeds its own RNG from it). `due(now)`
+    is a cursor — each event is delivered exactly once, in order."""
+
+    def __init__(self, events, seed: int = 0):
+        self.seed = int(seed)
+        self.events: list[GameDayEvent] = []
+        for i, ev in enumerate(events):
+            if ev.seq < 0:
+                ev = dataclasses.replace(ev, seq=i)
+            self.events.append(ev)
+        self.events.sort(key=lambda e: (e.t, e.seq))
+        self._cursor = 0
+
+    def reset(self) -> None:
+        self._cursor = 0
+
+    def due(self, now: float) -> list[GameDayEvent]:
+        """Pop every not-yet-delivered event with t <= now, in order."""
+        out = []
+        while (
+            self._cursor < len(self.events)
+            and self.events[self._cursor].t <= now
+        ):
+            out.append(self.events[self._cursor])
+            self._cursor += 1
+        return out
+
+    @property
+    def last_event_t(self) -> float:
+        """When scheduled chaos ends (instantaneous event times plus
+        their durations) — the terminal-invariant clock starts here."""
+        t = 0.0
+        for ev in self.events:
+            t = max(t, ev.t + float(ev.params.get("duration_s", 0.0)))
+        return t
+
+    def without(self, *kinds: str) -> "GameDayTrace":
+        """A copy of this trace with the given event kinds removed —
+        the baseline-comparison seam (e.g. the same chaos minus the
+        tenant flood, to measure what the flood alone moved)."""
+        return GameDayTrace(
+            [
+                dataclasses.replace(ev)
+                for ev in self.events
+                if ev.kind not in kinds
+            ],
+            seed=self.seed,
+        )
+
+    def to_jsonl(self) -> list[str]:
+        return [
+            json.dumps(ev.to_dict(), sort_keys=True) for ev in self.events
+        ]
+
+    @classmethod
+    def from_jsonl(cls, lines, seed: int = 0) -> "GameDayTrace":
+        events = [
+            GameDayEvent.from_dict(json.loads(line))
+            for line in lines
+            if line.strip()
+        ]
+        return cls(events, seed=seed)
+
+
+# ---- JSONL run log -----------------------------------------------------------
+
+
+class GameDayLog:
+    """Append-only JSONL run log. Line 1 is the header (seed, ticks,
+    the full trace); every subsequent line is a typed record
+    (`event` | `obs` | `violation`). Records are serialized with sorted
+    keys so two runs of the same (trace, seed) produce byte-identical
+    logs — the replay contract."""
+
+    def __init__(self, trace: GameDayTrace, ticks: int, extra: dict | None = None):
+        self.header = {
+            "kind": "gameday",
+            "seed": trace.seed,
+            "ticks": int(ticks),
+            "events": [ev.to_dict() for ev in trace.events],
+        }
+        if extra:
+            self.header.update(extra)
+        self.lines: list[str] = [json.dumps(self.header, sort_keys=True)]
+
+    def record(self, record_kind: str, tick: int, **payload) -> None:
+        entry = {"record": record_kind, "tick": int(tick)}
+        entry.update(payload)
+        self.lines.append(json.dumps(entry, sort_keys=True))
+
+    def event(self, tick: int, ev: GameDayEvent) -> None:
+        self.record("event", tick, **ev.to_dict())
+
+    def obs(self, tick: int, **payload) -> None:
+        self.record("obs", tick, **payload)
+
+    def violation(self, tick: int, invariant: str, detail: str) -> None:
+        self.record("violation", tick, invariant=invariant, detail=detail)
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write("\n".join(self.lines) + "\n")
+
+    @staticmethod
+    def load(path: str) -> tuple[dict, list[dict]]:
+        """(header, records) from a dumped log."""
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        if not lines:
+            raise ValueError(f"{path}: empty game-day dump")
+        header = json.loads(lines[0])
+        if header.get("kind") != "gameday":
+            raise ValueError(f"{path}: not a game-day dump")
+        return header, [json.loads(ln) for ln in lines[1:]]
+
+
+# ---- invariant framework -----------------------------------------------------
+
+CONTINUOUS = "continuous"
+TERMINAL = "terminal"
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    tick: int
+    t: float
+    invariant: str
+    detail: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Invariant:
+    """One named check. `check(world) -> None | str` returns a human
+    detail string on violation. CONTINUOUS invariants run every tick;
+    TERMINAL ones run once chaos has ended (convergence-style)."""
+
+    name: str
+    check: object  # callable(world) -> str | None
+    kind: str = CONTINUOUS
+    doc: str = ""
+
+    def __post_init__(self):
+        if self.kind not in (CONTINUOUS, TERMINAL):
+            raise ValueError(f"unknown invariant kind {self.kind!r}")
+
+
+class InvariantChecker:
+    """Runs the invariant set against the world, recording every
+    violation (and logging it) — `first_violation` is the debugging
+    anchor a dumped trace replays to."""
+
+    def __init__(self, invariants, log: GameDayLog | None = None):
+        self.invariants = list(invariants)
+        self.log = log
+        self.violations: list[Violation] = []
+
+    @property
+    def first_violation(self) -> Violation | None:
+        return self.violations[0] if self.violations else None
+
+    def _run(self, kinds, world, tick: int, t: float) -> None:
+        for inv in self.invariants:
+            if inv.kind not in kinds:
+                continue
+            try:
+                detail = inv.check(world)
+            except Exception as exc:  # a crashing check IS a violation
+                detail = f"invariant check raised: {exc!r}"
+            if detail:
+                self.violations.append(
+                    Violation(tick=tick, t=t, invariant=inv.name,
+                              detail=str(detail))
+                )
+                if self.log is not None:
+                    self.log.violation(tick, inv.name, str(detail))
+
+    def check_continuous(self, world, tick: int, t: float) -> None:
+        self._run((CONTINUOUS,), world, tick, t)
+
+    def check_terminal(self, world, tick: int, t: float) -> None:
+        self._run((TERMINAL,), world, tick, t)
+
+
+# ---- API-server chaos seam ---------------------------------------------------
+
+
+class ApiServerUnreachable(ConnectionError):
+    """The wrapped store's answer to a partition / dropped connection:
+    what a real kube client surfaces once its retries exhaust."""
+
+
+class ApiServerError(RuntimeError):
+    """An injected non-conflict HTTP error the client could not retry
+    through (5xx storm outlasting the retry budget)."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(f"injected API server {status}: {message}")
+        self.status = status
+
+
+_KIND_PLURALS = {
+    "Pod": "pods",
+    "Model": "models",
+    "Node": "nodes",
+    "Lease": "leases",
+    "ConfigMap": "configmaps",
+}
+
+
+def _plural(kind: str) -> str:
+    return _KIND_PLURALS.get(kind, kind.lower() + "s")
+
+
+class ChaosKubeStore:
+    """`KubeStore` front gated by an `ApiFaultPlan` + a partition switch.
+
+    Every verb consults `plan.on_request(METHOD, plural)` first (one
+    consult per operation — the positional schedule maps 1:1 onto
+    operations); `partitioned=True` fails everything unconditionally.
+    HTTP faults map onto the store's own exception vocabulary where one
+    exists (404 -> NotFound, 409 -> Conflict) so callers exercise their
+    real handling; other statuses raise `ApiServerError`. `stall`
+    faults pass through — fake-clock sims have no wall to stall
+    against, and the decision still lands in `plan.log`.
+
+    Watches and validators pass through un-gated: the LB watch queue is
+    process-local plumbing, not an API-server round trip, and the sim
+    partitions the CONTROL plane, not the process."""
+
+    def __init__(self, inner, plan: ApiFaultPlan | None = None):
+        self.inner = inner
+        self.plan = plan if plan is not None else ApiFaultPlan()
+        self.partitioned = False
+
+    def _gate(self, method: str, kind: str, watch: bool = False) -> None:
+        if self.partitioned:
+            raise ApiServerUnreachable(
+                f"injected partition: {method} {_plural(kind)} unreachable"
+            )
+        f = self.plan.on_request(method, _plural(kind), watch)
+        if f is None:
+            return
+        if f.kind == API_FAULT_DROP:
+            raise ApiServerUnreachable(
+                f"injected drop: {method} {_plural(kind)}"
+            )
+        if f.kind == API_FAULT_HTTP:
+            if f.status == 404:
+                from kubeai_tpu.operator.k8s.store import NotFound
+
+                raise NotFound(f"injected 404: {f.message}")
+            if f.status == 409:
+                from kubeai_tpu.operator.k8s.store import Conflict
+
+                raise Conflict(f"injected 409: {f.message}")
+            raise ApiServerError(f.status, f.message)
+        # API_FAULT_STALL: logged by the plan, no wall clock to stall.
+
+    # -- gated verbs (the kube API surface the operator stack uses) ----------
+
+    def create(self, obj: dict) -> dict:
+        self._gate("POST", obj.get("kind", ""))
+        return self.inner.create(obj)
+
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        self._gate("GET", kind)
+        return self.inner.get(kind, namespace, name)
+
+    def try_get(self, kind: str, namespace: str, name: str) -> dict | None:
+        self._gate("GET", kind)
+        return self.inner.try_get(kind, namespace, name)
+
+    def list(self, kind: str, *args, **kwargs) -> list:
+        self._gate("GET", kind)
+        return self.inner.list(kind, *args, **kwargs)
+
+    def update(self, obj: dict) -> dict:
+        self._gate("PUT", obj.get("kind", ""))
+        return self.inner.update(obj)
+
+    def patch_merge(self, kind: str, *args, **kwargs) -> dict:
+        self._gate("PATCH", kind)
+        return self.inner.patch_merge(kind, *args, **kwargs)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._gate("DELETE", kind)
+        return self.inner.delete(kind, namespace, name)
+
+    def delete_all_of(self, kind: str, *args, **kwargs):
+        self._gate("DELETE", kind)
+        return self.inner.delete_all_of(kind, *args, **kwargs)
+
+    # -- pass-throughs --------------------------------------------------------
+
+    def __getattr__(self, name: str):
+        return getattr(self.inner, name)

@@ -1,0 +1,34 @@
+"""The shared fake clock every deterministic sim runs on.
+
+Hoisted from `kubeai_tpu/testing/faults.py` (where it is still
+re-exported for back-compat): one injectable monotonic clock shared by
+breakers, backoffs, leases, budget windows, and the game-day harness, so
+a whole fleet of real components experiences the same instant.
+"""
+
+from __future__ import annotations
+
+
+class FakeClock:
+    """Injectable monotonic clock for breaker/backoff determinism.
+
+    Monotonicity is enforced: `advance` refuses a negative delta instead
+    of silently rewinding time — a sim that rewound its clock would
+    corrupt every sliding window (disruption budgets, breaker windows,
+    lease deadlines) built on the assumption that time only moves
+    forward, and the corruption would surface ticks later as an
+    unrelated-looking invariant violation.
+    """
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        if dt < 0:
+            raise ValueError(
+                f"FakeClock.advance({dt!r}): a fake clock never rewinds"
+            )
+        self.t += dt

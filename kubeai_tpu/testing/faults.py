@@ -25,6 +25,10 @@ import json
 import time
 from collections import defaultdict
 
+# FakeClock lives in kubeai_tpu/testing/clock.py now; re-exported here
+# because every sim historically imported it from this module.
+from kubeai_tpu.testing.clock import FakeClock  # noqa: F401
+
 FAULT_CONNECT_ERROR = "connect_error"
 FAULT_TIMEOUT = "timeout"
 FAULT_HTTP = "http"
@@ -38,19 +42,6 @@ FAULT_KINDS = (
     FAULT_DIE_MID_STREAM,
     FAULT_STALL,
 )
-
-
-class FakeClock:
-    """Injectable monotonic clock for breaker/backoff determinism."""
-
-    def __init__(self, t: float = 0.0):
-        self.t = float(t)
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance(self, dt: float) -> None:
-        self.t += dt
 
 
 @dataclasses.dataclass
@@ -103,7 +94,13 @@ class FaultPlan:
 
     def on_attempt(self, endpoint: str) -> Fault | None:
         """Advance the endpoint's attempt counter and return the fault
-        (first match wins) this attempt should suffer, if any."""
+        this attempt should suffer, if any.
+
+        Tie-break when several faults match the same attempt: FIRST
+        MATCH IN LIST ORDER WINS — `self.faults` order is the priority
+        order, and it is stable across runs. Same-tick determinism in
+        every sim rests on this: two faults scheduled for the same
+        attempt always resolve to the one listed first."""
         self.counts[endpoint] += 1
         n = self.counts[endpoint]
         for f in self.faults:
@@ -188,6 +185,12 @@ class ApiFaultPlan:
     def on_request(
         self, method: str, plural: str, watch: bool = False
     ) -> ApiFault | None:
+        """Advance the (method, plural, watch) request counter and
+        return the fault this request should suffer, if any.
+
+        Tie-break mirrors `FaultPlan.on_attempt`: when several faults
+        match the same request, the FIRST MATCH IN LIST ORDER wins —
+        deterministic same-tick ordering for free."""
         key = (method, plural, bool(watch))
         self.counts[key] += 1
         n = self.counts[key]

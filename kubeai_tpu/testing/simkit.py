@@ -1,0 +1,141 @@
+"""Shared scaffolding for the deterministic `benchmarks/*_sim.py` fleet.
+
+Every sim used to re-implement the same five helpers (a percentile, a
+seeded RNG, a `Model` factory, pod Ready/broken status flips, a metric
+scrape diff). They live here now so a new sim — and the game-day
+harness that composes several sims' worth of chaos — builds on one
+audited version of each.
+
+Nothing here touches real time, sockets, or jax: these are pure
+store/str manipulations safe to import from tier-1.
+"""
+
+from __future__ import annotations
+
+import random
+
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import Model, ModelSpec
+from kubeai_tpu.metrics.registry import parse_prometheus_text
+
+__all__ = [
+    "break_pod",
+    "mark_all_ready",
+    "mark_ready",
+    "mk_model",
+    "percentile",
+    "pod_names",
+    "scrape_diff",
+    "seeded_rng",
+]
+
+
+def seeded_rng(seed: int = 0) -> random.Random:
+    """The one RNG seam sims draw from: all randomness flows from the
+    seed, so a failing run is reproducible from its (seed, trace)."""
+    return random.Random(seed)
+
+
+def percentile(values: list[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 1]) of an unsorted sample;
+    0.0 for an empty one. Matches the tenant-isolation sim's original
+    definition so its asserted thresholds carry over unchanged."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(q * len(ordered)))
+    return ordered[idx]
+
+
+def scrape_diff(before: str, after: str) -> dict:
+    """Per-series numeric delta between two Prometheus expositions:
+    {(metric_name, ((label, value), ...)): after - before}, keeping only
+    series that moved. Series absent from `before` count from 0.0, so a
+    counter's first increment shows up as its value."""
+    b = parse_prometheus_text(before)
+    a = parse_prometheus_text(after)
+    out: dict = {}
+    for key, av in a.items():
+        delta = av - b.get(key, 0.0)
+        if delta != 0.0:
+            out[key] = delta
+    for key, bv in b.items():
+        if key not in a and bv != 0.0:
+            out[key] = -bv
+    return out
+
+
+# ---- k8s-store scaffolding ---------------------------------------------------
+
+
+def mk_model(store, name: str = "sim", replicas: int = 2, **spec_overrides):
+    """Create a validated minimal `Model` in the store. The base spec is
+    the one every sim used; keyword overrides (min_replicas,
+    autoscaling_disabled, scale_down_delay_seconds, ...) layer on top so
+    each sim keeps its exact original spec."""
+    spec = dict(
+        url="hf://org/model",
+        engine="KubeAITPU",
+        features=["TextGeneration"],
+        resource_profile="google-tpu-v5e-1x1:1",
+        replicas=replicas,
+    )
+    spec.update(spec_overrides)
+    m = Model(name=name, spec=ModelSpec(**spec))
+    m.validate()
+    store.create(m.to_dict())
+    return m
+
+
+def mark_ready(store, pod: dict) -> None:
+    """Flip one pod to Running/Ready (the sim's kubelet)."""
+    fresh = store.get(
+        "Pod", pod["metadata"].get("namespace", "default"),
+        pod["metadata"]["name"],
+    )
+    fresh.setdefault("status", {})["conditions"] = [
+        {"type": "Ready", "status": "True"},
+        {"type": "PodScheduled", "status": "True"},
+    ]
+    fresh["status"]["phase"] = "Running"
+    store.update(fresh)
+
+
+def mark_all_ready(store, model: str = "sim", namespace: str = "default") -> None:
+    for pod in store.list("Pod", namespace, {md.POD_MODEL_LABEL: model}):
+        mark_ready(store, pod)
+
+
+def break_pod(store, pod: dict, mode: str) -> None:
+    """Break one pod the way the classifier expects to see it:
+    `preempt` -> Failed/Preempted (spot reclaim), `crashloop` ->
+    Running + CrashLoopBackOff container state."""
+    fresh = store.get(
+        "Pod", pod["metadata"].get("namespace", "default"),
+        pod["metadata"]["name"],
+    )
+    status = fresh.setdefault("status", {})
+    if mode == "preempt":
+        status["phase"] = "Failed"
+        status["reason"] = "Preempted"
+        status["conditions"] = [{"type": "Ready", "status": "False"}]
+    elif mode == "crashloop":
+        status["phase"] = "Running"
+        status["conditions"] = [{"type": "Ready", "status": "False"}]
+        status["containerStatuses"] = [
+            {
+                "name": "server",
+                "restartCount": 7,
+                "state": {"waiting": {"reason": "CrashLoopBackOff"}},
+            }
+        ]
+    else:
+        raise ValueError(f"unknown break mode {mode!r}")
+    store.update(fresh)
+
+
+def pod_names(store, model: str = "sim", namespace: str = "default") -> set[str]:
+    return {
+        p["metadata"]["name"]
+        for p in store.list("Pod", namespace, {md.POD_MODEL_LABEL: model})
+    }

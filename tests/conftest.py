@@ -123,6 +123,14 @@ def pytest_configure(config):
         "coverage, watchdog/overlap interaction, topology refusals "
         "(runs in the fast tier; select with -m stepperf)",
     )
+    config.addinivalue_line(
+        "markers",
+        "gameday: cross-subsystem game-day suite — seeded chaos traces "
+        "driving the real reconciler/governor/planner/LB/tenant door "
+        "under one fake clock, continuous+terminal invariants, "
+        "deterministic dump/replay (runs in the fast tier; select with "
+        "-m gameday)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,383 @@
+"""Game-day harness: the composed cross-subsystem chaos trace (kills +
+API partition + tenant flood + spot chip flip SIMULTANEOUSLY) against
+the real reconciler/governor/planner/LB/tenant door under one FakeClock,
+the continuous+terminal invariant set, the deterministic dump->replay
+loop, the same-tick ordering contracts, and the governor budget-refund
+regression — all tier-1."""
+
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+sys.path.insert(0, REPO_ROOT)
+
+from benchmarks.gameday_sim import (
+    ALL_CHECKS,
+    DEFAULT_TICKS,
+    FAILING_STREAM_TOKENS,
+    check_chaos_concurrency,
+    check_failing_trace_fails,
+    check_flood_was_real,
+    check_no_violations,
+    check_progress_under_chaos,
+    check_tenant_isolation,
+    extended_trace,
+    failing_trace,
+    fast_trace,
+    replay,
+    run_gameday,
+    run_sim,
+)
+from kubeai_tpu.config.system import GovernorConfig
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.operator.governor import ActuationGovernor
+from kubeai_tpu.testing import (
+    ApiFault,
+    ApiFaultPlan,
+    ChaosKubeStore,
+    FakeClock,
+    Fault,
+    FaultPlan,
+    GameDayEvent,
+    GameDayLog,
+    GameDayTrace,
+    Invariant,
+    InvariantChecker,
+)
+from kubeai_tpu.testing.simkit import percentile, scrape_diff
+
+pytestmark = pytest.mark.gameday
+
+
+# ---- the composed game day (one run, many assertions) ------------------------
+
+
+@pytest.fixture(scope="module")
+def sim():
+    return run_sim()
+
+
+def test_chaos_kinds_concurrent(sim):
+    check_chaos_concurrency(sim)
+
+
+def test_all_invariants_hold(sim):
+    check_no_violations(sim)
+
+
+def test_progress_under_chaos(sim):
+    check_progress_under_chaos(sim)
+
+
+def test_tenant_isolation_under_composed_chaos(sim):
+    check_tenant_isolation(sim)
+
+
+def test_flood_was_real(sim):
+    check_flood_was_real(sim)
+
+
+def test_failing_trace_fails_deterministically(sim):
+    check_failing_trace_fails(sim)
+
+
+def test_all_checks_is_complete(sim):
+    # Belt and braces: every exported check runs against the one sim.
+    for check in ALL_CHECKS:
+        check(sim)
+
+
+def test_control_plane_errors_were_absorbed(sim):
+    """The partition + 5xx storm really hit the operator stack — and
+    none of it surfaced as a client error or violation."""
+    g = sim["gameday"]
+    assert g["control_plane_errors"] > 0
+    assert g["client_errors"] == 0
+
+
+# ---- dump -> replay ----------------------------------------------------------
+
+
+def test_replay_reproduces_first_violation(sim, tmp_path):
+    """The replay contract end to end: dump the engineered failure, feed
+    the dump back through `replay`, land on a byte-identical log and the
+    SAME first violation."""
+    failing = sim["failing"]
+    path = tmp_path / "gameday_fail.jsonl"
+    failing["log"].dump(str(path))
+
+    header, fresh = replay(str(path))
+    assert header["stream_tokens"] == FAILING_STREAM_TOKENS
+    assert fresh["log"].lines == failing["log"].lines
+    assert fresh["first_violation"] == failing["first_violation"]
+    assert fresh["first_violation"]["invariant"] == "zero_stream_errors"
+
+
+def test_log_round_trip(sim, tmp_path):
+    """GameDayLog.load returns the header + typed records that dump
+    wrote, and the header rebuilds the exact trace."""
+    g = sim["gameday"]
+    path = tmp_path / "gameday.jsonl"
+    g["log"].dump(str(path))
+    header, records = GameDayLog.load(str(path))
+    assert header["kind"] == "gameday"
+    assert header["ticks"] == sim["ticks"]
+    rebuilt = GameDayTrace(
+        [GameDayEvent.from_dict(d) for d in header["events"]],
+        seed=int(header["seed"]),
+    )
+    assert rebuilt.to_jsonl() == fast_trace(sim["seed"]).to_jsonl()
+    kinds = {r["record"] for r in records}
+    assert {"event", "obs"} <= kinds
+
+
+def test_load_rejects_non_gameday_file(tmp_path):
+    path = tmp_path / "not_a_dump.jsonl"
+    path.write_text('{"kind": "something_else"}\n')
+    with pytest.raises(ValueError):
+        GameDayLog.load(str(path))
+
+
+# ---- trace determinism -------------------------------------------------------
+
+
+def test_trace_same_tick_ordering_is_insertion_order():
+    """Two events at the same instant apply in the order the author
+    listed them (stable (t, seq) sort), and `due` is a deliver-once
+    cursor."""
+    a = GameDayEvent(5.0, "kill_pod", "rt")
+    b = GameDayEvent(5.0, "api_partition", "", {"duration_s": 3.0})
+    c = GameDayEvent(2.0, "tenant_flood", "flooder", {"duration_s": 1.0})
+    trace = GameDayTrace([a, b, c])
+    assert [ev.kind for ev in trace.events] == [
+        "tenant_flood", "kill_pod", "api_partition",
+    ]
+    assert [ev.kind for ev in trace.due(2.0)] == ["tenant_flood"]
+    assert [ev.kind for ev in trace.due(5.0)] == [
+        "kill_pod", "api_partition",
+    ]
+    assert trace.due(100.0) == []
+
+
+def test_trace_jsonl_round_trip():
+    trace = fast_trace(7)
+    again = GameDayTrace.from_jsonl(trace.to_jsonl(), seed=trace.seed)
+    assert again.to_jsonl() == trace.to_jsonl()
+    assert again.seed == 7
+
+
+def test_trace_without_strips_kind_keeps_order():
+    trace = fast_trace(0)
+    calm = trace.without("tenant_flood")
+    assert all(ev.kind != "tenant_flood" for ev in calm.events)
+    kept = [ev.kind for ev in trace.events if ev.kind != "tenant_flood"]
+    assert [ev.kind for ev in calm.events] == kept
+
+
+def test_trace_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        GameDayEvent(1.0, "meteor_strike")
+
+
+def test_last_event_t_includes_durations():
+    trace = GameDayTrace([
+        GameDayEvent(10.0, "kill_pod", "rt"),
+        GameDayEvent(5.0, "api_partition", "", {"duration_s": 30.0}),
+    ])
+    assert trace.last_event_t == 35.0
+
+
+# ---- fault-plan same-tick ordering -------------------------------------------
+
+
+def test_faultplan_first_match_wins():
+    """Two faults matching the same attempt resolve to the one listed
+    first — the documented same-tick tie-break."""
+    plan = FaultPlan([
+        Fault("e:1", "timeout", start=1, end=None),
+        Fault("e:1", "connect_error", start=1, end=None),
+    ])
+    f = plan.on_attempt("e:1")
+    assert f is not None and f.kind == "timeout"
+    # Reversed listing, fresh counters: the other one wins.
+    plan2 = FaultPlan([
+        Fault("e:1", "connect_error", start=1, end=None),
+        Fault("e:1", "timeout", start=1, end=None),
+    ])
+    f2 = plan2.on_attempt("e:1")
+    assert f2 is not None and f2.kind == "connect_error"
+
+
+def test_api_faultplan_first_match_wins():
+    plan = ApiFaultPlan([
+        ApiFault(method="GET", plural="pods", kind="http", status=500),
+        ApiFault(method="GET", plural="pods", kind="drop"),
+    ])
+    f = plan.on_request("GET", "pods")
+    assert f is not None and f.kind == "http" and f.status == 500
+    plan2 = ApiFaultPlan([
+        ApiFault(method="GET", plural="pods", kind="drop"),
+        ApiFault(method="GET", plural="pods", kind="http", status=500),
+    ])
+    f2 = plan2.on_request("GET", "pods")
+    assert f2 is not None and f2.kind == "drop"
+
+
+def test_fake_clock_rejects_negative_advance():
+    clock = FakeClock(100.0)
+    with pytest.raises(ValueError):
+        clock.advance(-0.5)
+    assert clock() == 100.0  # the failed advance moved nothing
+
+
+# ---- invariant framework -----------------------------------------------------
+
+
+def test_invariant_checker_records_first_violation():
+    inv_ok = Invariant("always_ok", lambda w: None)
+    inv_bad = Invariant("always_bad", lambda w: "broken")
+    inv_crash = Invariant("crashes", lambda w: 1 / 0)
+    checker = InvariantChecker([inv_ok, inv_bad, inv_crash])
+    checker.check_continuous(object(), tick=3, t=1.5)
+    assert checker.first_violation.invariant == "always_bad"
+    assert checker.first_violation.tick == 3
+    names = [v.invariant for v in checker.violations]
+    assert names == ["always_bad", "crashes"]  # a crashing check IS one
+
+
+def test_terminal_invariants_only_run_at_the_end():
+    hits = []
+    inv = Invariant(
+        "term", lambda w: hits.append(1), kind="terminal",
+    )
+    checker = InvariantChecker([inv])
+    checker.check_continuous(object(), tick=0, t=0.0)
+    assert hits == []
+    checker.check_terminal(object(), tick=9, t=9.0)
+    assert hits == [1]
+
+
+# ---- governor budget refund (regression) -------------------------------------
+
+
+class _ExplodingStore:
+    """`delete` fails the way an exhausted kube client surfaces an API
+    partition; everything else is unused."""
+
+    def delete(self, kind, namespace, name):
+        raise ConnectionError("injected partition: DELETE pods")
+
+
+class _OkStore:
+    def delete(self, kind, namespace, name):
+        return None
+
+
+def _governor(clock):
+    cfg = GovernorConfig(
+        enabled=True, window_seconds=60.0,
+        model_disruption_budget=2, cluster_disruption_budget=3,
+    )
+    return ActuationGovernor(cfg, metrics=Metrics(), clock=clock)
+
+
+def test_failed_delete_refunds_disruption_budget():
+    """Regression: a delete that never reached the API server must not
+    consume a budget unit — otherwise an API partition or 5xx storm
+    drains the disruption window with ZERO actual disruptions and
+    stalls post-chaos convergence."""
+    clock = FakeClock(100.0)
+    gov = _governor(clock)
+    before = gov.budget_remaining("m")
+    for _ in range(5):  # well past both budgets if the refund leaked
+        with pytest.raises(ConnectionError):
+            gov.delete_pod(
+                _ExplodingStore(), "default", "pod-x", model="m",
+            )
+    assert gov.budget_remaining("m") == before
+
+
+def test_successful_delete_still_consumes_budget():
+    clock = FakeClock(100.0)
+    gov = _governor(clock)
+    model_rem, cluster_rem = gov.budget_remaining("m")
+    assert gov.delete_pod(_OkStore(), "default", "pod-x", model="m")
+    assert gov.budget_remaining("m") == (model_rem - 1, cluster_rem - 1)
+
+
+def test_refund_is_per_model():
+    """The refund takes back the unit the FAILED delete paid for — a
+    different model's successful disruption stays spent."""
+    clock = FakeClock(100.0)
+    gov = _governor(clock)
+    assert gov.delete_pod(_OkStore(), "default", "pod-a", model="a")
+    with pytest.raises(ConnectionError):
+        gov.delete_pod(_ExplodingStore(), "default", "pod-b", model="b")
+    a_rem, cluster_rem = gov.budget_remaining("a")
+    assert a_rem == gov.cfg.model_disruption_budget - 1
+    assert cluster_rem == gov.cfg.cluster_disruption_budget - 1
+    assert gov.budget_remaining("b")[0] == gov.cfg.model_disruption_budget
+
+
+# ---- chaos store -------------------------------------------------------------
+
+
+def test_chaos_store_partition_switch():
+    from kubeai_tpu.operator.k8s.store import KubeStore
+    from kubeai_tpu.testing import ApiServerUnreachable
+
+    store = ChaosKubeStore(KubeStore())
+    store.create({"kind": "ConfigMap", "metadata": {"name": "cm"}})
+    store.partitioned = True
+    with pytest.raises(ApiServerUnreachable):
+        store.get("ConfigMap", "default", "cm")
+    store.partitioned = False
+    assert store.get("ConfigMap", "default", "cm")["metadata"]["name"] == "cm"
+
+
+# ---- shared sim scaffolding --------------------------------------------------
+
+
+def test_percentile_nearest_rank():
+    assert percentile([], 0.99) == 0.0
+    assert percentile([3.0, 1.0, 2.0], 0.0) == 1.0
+    assert percentile([3.0, 1.0, 2.0], 0.99) == 3.0
+    assert percentile([1.0, 2.0, 3.0, 4.0], 0.5) == 3.0
+
+
+def test_scrape_diff_deltas():
+    before = (
+        'a_total{x="1"} 2\n'
+        'b_total 5\n'
+        'gone_total 1\n'
+    )
+    after = (
+        'a_total{x="1"} 7\n'
+        'b_total 5\n'
+        'new_total 3\n'
+    )
+    diff = scrape_diff(before, after)
+    moved = {name: delta for (name, _labels), delta in diff.items()}
+    assert moved["a_total"] == 5.0
+    assert moved["new_total"] == 3.0
+    assert moved["gone_total"] == -1.0
+    assert "b_total" not in moved
+
+
+# ---- the long game day (slow tier) -------------------------------------------
+
+
+@pytest.mark.slow
+def test_extended_trace_holds_invariants():
+    """The same composition plus a second, time-shifted wave — twice the
+    ticks, same zero-violation bar."""
+    result = run_gameday(
+        extended_trace(0), DEFAULT_TICKS["extended"], seed=0,
+    )
+    assert result["violations"] == []
+    assert result["client_errors"] == 0
+    assert result["converged_final"]
