@@ -1265,6 +1265,13 @@ def main(argv=None) -> int:
     if args.replay:
         with open(args.replay) as fh:
             original = [line.rstrip("\n") for line in fh if line.strip()]
+        # Flight-recorder incident bundles share the GameDayLog format
+        # but replay through the sim named in their header, not the
+        # game-day trace machinery.
+        if json.loads(original[0]).get("bundle") == "incident":
+            from benchmarks import slo_incident_sim
+
+            return slo_incident_sim.replay_main(args.replay)
         header, result = replay(args.replay)
         fresh = result["log"].lines
         identical = fresh == original

@@ -1,0 +1,511 @@
+"""Deterministic SLO-incident simulation — no JAX, no sockets.
+
+A three-replica model serves healthy traffic on a fake clock, then a
+TTFT latency regression sets in while an abusive tenant hammers the
+front door and a connect-failure storm trips every circuit breaker.
+All of it flows through REAL components: scripted endpoint expositions
+feed the real `FleetStateAggregator`, refusals come from the real
+`TenantGovernor`, breaker transitions from the real LoadBalancer
+`Group`, and the real `SLOEvaluator` judges every tick — wired to a
+real `FlightRecorder` whose fast-burn page dumps the incident bundle.
+
+Invariants (asserted in tier-1 by tests/unit/test_slo.py):
+
+  * the TTFT fast-burn alert fires, and fires WITHIN the fast-burn
+    window of the regression's onset — the multi-window rule pages
+    fast, not after the slow window catches up;
+  * the page dumps an incident bundle whose rings hold the door sheds,
+    the breaker transitions, the all-circuits-open event, and the SLO
+    transition that triggered it, plus metric deltas and trace-id
+    exemplars;
+  * the door flood produces a shed-rate SLOW burn only (a shed
+    fraction can never reach the 14.4x fast threshold at a 10% shed
+    objective — the objective algebra caps it at 10x);
+  * replay is byte-identical: `replay(bundle)` re-runs the sim from
+    the bundle's own header (sim/seed/ticks) and the fresh bundle
+    matches the dumped one byte-for-byte, same first SLO violation —
+    which is what `python -m benchmarks.gameday_sim --replay <bundle>`
+    dispatches to when the header says `bundle: incident`.
+
+Run directly for a human-readable report:
+
+    python benchmarks/slo_incident_sim.py [--dump /tmp/incident.jsonl]
+    python benchmarks/slo_incident_sim.py --replay /tmp/incident.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeai_tpu.config import System
+from kubeai_tpu.fleet import FleetStateAggregator, SLOEvaluator, TenantGovernor
+from kubeai_tpu.fleet.slo import STATE_FAST_BURN, STATE_SLOW_BURN
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.metrics import flightrecorder
+from kubeai_tpu.metrics.flightrecorder import FlightRecorder
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.health import OUTCOME_CONNECT_ERROR
+from kubeai_tpu.routing.loadbalancer import LoadBalancer, NoHealthyEndpoints
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.testing.clock import FakeClock
+from kubeai_tpu.testing.simkit import mk_model, seeded_rng
+from kubeai_tpu.utils import retryafter
+
+SIM_NAME = "slo_incident"
+MODEL = "m0"
+REPLICAS = 3
+TICK_S = 10.0
+TICKS = 40
+OBS_PER_TICK = 10          # TTFT observations per endpoint per tick
+HEALTHY_TTFT = 0.2         # healthy observations land in the 0.25 bucket
+REGRESSED_TTFT = 0.8       # regressed observations land in the 1.0 bucket
+REGRESS_TICK = 15          # latency regression onset (0-based tick)
+STORM_TICK = 18            # breaker storm: every circuit trips open
+FLOOD_RPS_TICK = 6         # abusive tenant's requests per tick
+USER_RPS_TICK = 1          # compliant tenant's requests per tick
+
+
+def _slo_config() -> System:
+    """Sim-scale SLO + tenancy config: same rule shapes as production
+    defaults, windows shrunk so the whole incident fits in 40 ticks."""
+    cfg = System()
+    cfg.default_and_validate()
+    cfg.slo.enabled = True
+    cfg.slo.ttft_p95_seconds = 0.5
+    cfg.slo.max_shed_rate = 0.10
+    cfg.slo.budget_window_seconds = 1200.0
+    cfg.slo.fast_burn_threshold = 14.4
+    cfg.slo.fast_burn_window_seconds = 120.0
+    cfg.slo.fast_burn_short_window_seconds = 30.0
+    cfg.slo.slow_burn_threshold = 3.0
+    cfg.slo.slow_burn_window_seconds = 600.0
+    cfg.slo.min_incident_interval_seconds = 3600.0
+    cfg.tenancy.enabled = True
+    cfg.tenancy.requests_per_second = 0.2   # 2 tokens per 10s tick
+    cfg.tenancy.request_burst = 2.0
+    return cfg
+
+
+class Endpoint:
+    """One scripted serving endpoint: cumulative TTFT histogram rendered
+    as real Prometheus exposition text, the way the aggregator scrapes
+    it in production."""
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.good = 0    # observations <= 0.25s
+        self.bad = 0     # observations in (0.5, 1.0]
+
+    def advance(self, regressed: bool) -> None:
+        if regressed:
+            self.bad += OBS_PER_TICK
+        else:
+            self.good += OBS_PER_TICK
+
+    def exposition(self) -> str:
+        total = self.good + self.bad
+        ttft_sum = self.good * HEALTHY_TTFT + self.bad * REGRESSED_TTFT
+        return "\n".join([
+            "# TYPE kubeai_engine_ttft_seconds histogram",
+            f'kubeai_engine_ttft_seconds_bucket{{le="0.25"}} {self.good}',
+            f'kubeai_engine_ttft_seconds_bucket{{le="0.5"}} {self.good}',
+            f'kubeai_engine_ttft_seconds_bucket{{le="1"}} {total}',
+            f'kubeai_engine_ttft_seconds_bucket{{le="+Inf"}} {total}',
+            f"kubeai_engine_ttft_seconds_count {total}",
+            f"kubeai_engine_ttft_seconds_sum {ttft_sum}",
+            "kubeai_engine_queue_depth 2.0",
+            "kubeai_engine_slots_active 4.0",
+            "kubeai_engine_slot_capacity 32.0",
+            "kubeai_engine_active_requests 4.0",
+        ]) + "\n"
+
+    def state(self) -> dict:
+        return {"model": MODEL, "healthy": True, "draining": False,
+                "role": "unified"}
+
+
+def _pod(idx: int, addr: str) -> dict:
+    ip, _, port = addr.partition(":")
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"model-{MODEL}-{idx}",
+            "namespace": "default",
+            "labels": {"model": MODEL},
+            "annotations": {"model-pod-ip": ip, "model-pod-port": port},
+        },
+        "spec": {
+            "containers": [{
+                "name": "server",
+                "resources": {
+                    "requests": {"google.com/tpu": "4"},
+                    "limits": {"google.com/tpu": "4"},
+                },
+            }],
+        },
+        "status": {
+            "conditions": [{"type": "Ready", "status": "True"}],
+            "podIP": ip,
+        },
+    }
+
+
+def run_sim(seed: int = 0, ticks: int = TICKS) -> dict:
+    """Run the full incident; returns measured facts for the tier-1
+    invariant checks, including every bundle the recorder dumped."""
+    rng = seeded_rng(seed)
+    saved_jitter = retryafter._jitter
+    retryafter._jitter = rng.random  # deterministic Retry-After hints
+    try:
+        return _run(seed, ticks)
+    finally:
+        retryafter._jitter = saved_jitter
+
+
+def _run(seed: int, ticks: int) -> dict:
+    clock = FakeClock(1000.0)
+    cfg = _slo_config()
+    store = KubeStore()
+    metrics = Metrics()
+    mc = ModelClient(store)
+    lb = LoadBalancer(store, metrics=metrics)
+
+    mk_model(store, name=MODEL, replicas=REPLICAS, max_replicas=REPLICAS)
+    endpoints: dict[str, Endpoint] = {}
+    for j in range(REPLICAS):
+        addr = f"10.0.0.{j}:8000"
+        endpoints[addr] = Endpoint(addr)
+        store.create(_pod(j, addr))
+    lb.sync_all()
+
+    def fetch_metrics(addr: str, timeout: float) -> str:
+        return endpoints[addr].exposition()
+
+    def fetch_state(addr: str, timeout: float) -> dict:
+        return endpoints[addr].state()
+
+    aggregator = FleetStateAggregator(
+        lb=lb,
+        model_client=mc,
+        store=store,
+        namespace="default",
+        metrics=metrics,
+        interval_s=TICK_S,
+        staleness_s=3 * TICK_S,
+        fetch_metrics=fetch_metrics,
+        fetch_state=fetch_state,
+        clock=clock,
+    )
+
+    tick_box = {"tick": 0}
+    recorder = FlightRecorder(
+        clock=clock,
+        tick_fn=lambda: tick_box["tick"],
+        min_trigger_interval_s=cfg.slo.min_incident_interval_seconds,
+    )
+    recorder.replay_context = {
+        "sim": SIM_NAME, "seed": seed, "ticks": ticks,
+    }
+    lb.set_recorder(recorder)
+
+    door = TenantGovernor(
+        cfg.tenancy, fleet=aggregator, model_client=mc,
+        metrics=metrics, clock=clock,
+    )
+    door.recorder = recorder
+
+    evaluator = SLOEvaluator(
+        cfg=cfg.slo,
+        aggregator=aggregator,
+        model_client=mc,
+        metrics=metrics,
+        recorder=recorder,
+        interval_s=TICK_S,
+        clock=clock,
+    )
+
+    group = lb.group(MODEL)
+    timeline: list[dict] = []
+    first_violation: dict | None = None
+    storm_raised = False
+
+    for tick in range(ticks):
+        tick_box["tick"] = tick
+        clock.advance(TICK_S)
+        regressed = tick >= REGRESS_TICK
+        for ep in endpoints.values():
+            ep.advance(regressed)
+        aggregator.collect()
+
+        # Front-door traffic: one compliant tenant, one flooder. The
+        # flooder's bucket refills 2 requests per tick, so 4 of its 6
+        # are refused (REASON_RATE -> door_shed flight events).
+        ttft = REGRESSED_TTFT if regressed else HEALTHY_TTFT
+        for i in range(FLOOD_RPS_TICK):
+            if door.admit("flooder", MODEL, est_tokens=16) is None:
+                metrics.request_ttft.observe(
+                    ttft, exemplar=f"req-t{tick}-flood{i}", model=MODEL
+                )
+        for i in range(USER_RPS_TICK):
+            if door.admit("user", MODEL, est_tokens=16) is None:
+                metrics.request_ttft.observe(
+                    ttft, exemplar=f"req-t{tick}-user{i}", model=MODEL
+                )
+
+        # Breaker storm: three consecutive connect failures per replica
+        # trip every circuit; the next pick finds no healthy endpoint
+        # and fires the all-circuits-open trigger.
+        if tick == STORM_TICK:
+            for addr in sorted(endpoints):
+                for _ in range(3):
+                    group.report_outcome(
+                        addr, OUTCOME_CONNECT_ERROR, "connection refused"
+                    )
+            try:
+                group.get_best_addr("", "", "", timeout=0.01)
+            except NoHealthyEndpoints:
+                storm_raised = True
+
+        results = evaluator.tick()
+        objectives = (
+            results["models"].get(MODEL, {}).get("objectives", {})
+        )
+        row = {"tick": tick, "t": clock()}
+        for kind, rec in objectives.items():
+            row[kind] = {
+                "state": rec["state"],
+                "burn": rec["burn"],
+                "budget": rec["budget"],
+            }
+            if first_violation is None and rec["state"] != "ok":
+                first_violation = {
+                    "tick": tick,
+                    "t": clock(),
+                    "model": MODEL,
+                    "objective": kind,
+                    "state": rec["state"],
+                }
+        timeline.append(row)
+
+    return {
+        "seed": seed,
+        "ticks": ticks,
+        "timeline": timeline,
+        "first_violation": first_violation,
+        "incidents": list(recorder.incidents),
+        "storm_raised": storm_raised,
+        "regress_t": 1000.0 + (REGRESS_TICK + 1) * TICK_S,
+        "fast_window_s": cfg.slo.fast_burn_window_seconds,
+        "evaluator": evaluator,
+        "recorder": recorder,
+        "metrics": metrics,
+    }
+
+
+def _fast_burn_ticks(result: dict) -> list[dict]:
+    return [
+        row for row in result["timeline"]
+        if row.get("ttft_p95", {}).get("state") == "fast"
+    ]
+
+
+def _bundle(result: dict, reason: str) -> dict | None:
+    for inc in result["incidents"]:
+        if inc["reason"] == reason:
+            return inc
+    return None
+
+
+# ---- invariant checks (imported by tests/unit/test_slo.py) -------------------
+
+
+def check_fast_burn_within_window(result: dict) -> None:
+    """The TTFT regression pages, and pages within the fast-burn window
+    of its onset."""
+    fast = _fast_burn_ticks(result)
+    assert fast, "TTFT fast-burn alert never fired"
+    onset_to_page = fast[0]["t"] - result["regress_t"]
+    assert onset_to_page <= result["fast_window_s"], (
+        f"fast burn took {onset_to_page}s > "
+        f"{result['fast_window_s']}s window"
+    )
+    fv = result["first_violation"]
+    assert fv is not None and fv["model"] == MODEL
+
+
+def check_incident_bundle(result: dict) -> None:
+    """The page dumped a bundle carrying the whole story: door sheds,
+    breaker trips, the all-circuits-open event, the SLO transition,
+    metric deltas, and trace-id exemplars."""
+    inc = _bundle(result, flightrecorder.TRIGGER_FAST_BURN)
+    assert inc is not None, "fast-burn page dumped no incident bundle"
+    lines = inc["lines"]
+    header = json.loads(lines[0])
+    assert header["bundle"] == "incident"
+    assert header["sim"] == SIM_NAME
+    assert header["seed"] == result["seed"]
+    assert header["ticks"] == result["ticks"]
+    records = [json.loads(ln) for ln in lines[1:]]
+    kinds = {r["kind"] for r in records if r["record"] == "flight"}
+    for want in (
+        flightrecorder.DOOR_SHED,
+        flightrecorder.BREAKER,
+        flightrecorder.LB_NO_ENDPOINTS,
+        flightrecorder.SLO_ALERT,
+    ):
+        assert want in kinds, f"bundle missing {want} flight events"
+    assert any(r["record"] == "metric_delta" for r in records), (
+        "bundle carries no metric deltas"
+    )
+    assert any(r["record"] == "exemplar" for r in records), (
+        "bundle carries no trace-id exemplars"
+    )
+    # Every line is canonical sorted-key JSON (the byte-identity basis).
+    for ln in lines:
+        assert json.dumps(json.loads(ln), sort_keys=True) == ln
+
+
+def check_storm_recorded(result: dict) -> None:
+    """The breaker storm really happened and was bundled on its own
+    trigger too: one closed->open transition per replica, then the
+    all-circuits-open page."""
+    assert result["storm_raised"], "storm never hit NoHealthyEndpoints"
+    inc = _bundle(result, flightrecorder.TRIGGER_ALL_CIRCUITS_OPEN)
+    assert inc is not None, "all-circuits-open dumped no bundle"
+    trips = [
+        e for e in result["recorder"].events("lb")
+        if e["kind"] == flightrecorder.BREAKER
+        and e["detail"]["to_state"] == "open"
+    ]
+    assert len(trips) == REPLICAS, trips
+
+
+def check_shed_slow_burn_only(result: dict) -> None:
+    """The flood warns (slow burn) but can never page: a shed fraction
+    is bounded by 1.0, so burn tops out at 1/0.10 = 10 < 14.4."""
+    states = {
+        row.get("shed_rate", {}).get("state")
+        for row in result["timeline"]
+    }
+    assert "slow" in states, f"flood never reached slow burn: {states}"
+    assert "fast" not in states, "shed objective must not fast-burn"
+
+
+def check_exact_ledger(result: dict) -> None:
+    """The budget ledger is exact arithmetic: for the final TTFT tick,
+    remaining == allowed*total - bad as integers-and-fractions, and the
+    exact string round-trips through Fraction."""
+    from fractions import Fraction
+
+    last = result["timeline"][-1]["ttft_p95"]["budget"]
+    allowed = Fraction(last["allowed"])
+    budget = allowed * last["total"]
+    assert Fraction(last["budget"]) == budget
+    assert Fraction(last["remaining"]) == budget - last["bad"]
+    if budget > 0:
+        assert Fraction(last["remaining_frac_exact"]) == (
+            (budget - last["bad"]) / budget
+        )
+    assert last["exhausted"] == (budget - last["bad"] < 0)
+
+
+ALL_CHECKS = (
+    check_fast_burn_within_window,
+    check_incident_bundle,
+    check_storm_recorded,
+    check_shed_slow_burn_only,
+    check_exact_ledger,
+)
+
+
+# ---- replay ------------------------------------------------------------------
+
+
+def replay(path: str) -> tuple[dict, dict]:
+    """Re-run the incident byte-identically: read the bundle's header,
+    re-drive the sim with the header's own (seed, ticks), and compare
+    the fresh bundle for the same trigger line-for-line. Returns
+    (header, comparison dict)."""
+    with open(path) as fh:
+        original = [ln.rstrip("\n") for ln in fh if ln.strip()]
+    header = json.loads(original[0])
+    if header.get("bundle") != "incident":
+        raise ValueError(f"{path}: not an incident bundle")
+    if header.get("sim") != SIM_NAME:
+        raise ValueError(
+            f"{path}: bundle was recorded by sim {header.get('sim')!r}, "
+            f"not {SIM_NAME!r}"
+        )
+    result = run_sim(
+        seed=int(header.get("seed", 0)),
+        ticks=int(header.get("ticks", TICKS)),
+    )
+    inc = _bundle(result, header["reason"])
+    fresh = inc["lines"] if inc else []
+    return header, {
+        "lines": fresh,
+        "identical": fresh == original,
+        "first_violation": result["first_violation"],
+    }
+
+
+def replay_main(path: str) -> int:
+    """CLI replay entry (also dispatched to by
+    `python -m benchmarks.gameday_sim --replay <incident bundle>`)."""
+    header, cmp = replay(path)
+    print(f"replayed incident bundle {path}: "
+          f"{len(cmp['lines'])} bundle lines")
+    print(f"trigger: {header['reason']} ({header.get('detail', '')})")
+    print(f"byte-identical: {cmp['identical']}")
+    print(f"first SLO violation: {cmp['first_violation']}")
+    return 0 if cmp["identical"] else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ticks", type=int, default=TICKS)
+    ap.add_argument("--dump", help="write the fast-burn incident bundle here")
+    ap.add_argument("--replay", metavar="BUNDLE",
+                    help="re-run a dumped incident bundle and compare")
+    args = ap.parse_args(argv)
+
+    if args.replay:
+        return replay_main(args.replay)
+
+    result = run_sim(seed=args.seed, ticks=args.ticks)
+    for chk in ALL_CHECKS:
+        chk(result)
+        print(f"PASS {chk.__name__}")
+    fast = _fast_burn_ticks(result)
+    print(json.dumps(
+        {
+            "first_violation": result["first_violation"],
+            "fast_burn_tick": fast[0]["tick"] if fast else None,
+            "onset_to_page_s": (
+                fast[0]["t"] - result["regress_t"] if fast else None
+            ),
+            "incidents": [
+                {"reason": i["reason"], "t": i["t"], "lines": len(i["lines"])}
+                for i in result["incidents"]
+            ],
+            "ticks": result["ticks"],
+        },
+        indent=2, sort_keys=True,
+    ))
+    if args.dump:
+        inc = _bundle(result, flightrecorder.TRIGGER_FAST_BURN)
+        with open(args.dump, "w") as fh:
+            fh.write("\n".join(inc["lines"]) + "\n")
+        print(f"bundle -> {args.dump}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

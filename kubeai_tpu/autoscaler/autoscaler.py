@@ -326,6 +326,12 @@ class Autoscaler:
         # model's own desire before ModelClient.scale/scale_role; a
         # stale or missing plan falls back to direct per-model scaling.
         self.planner = None
+        # SLO evaluator (kubeai_tpu/fleet/slo): when wired, a model whose
+        # objectives are fast-burning gets one replica of headroom beyond
+        # its signal-derived desire — a latency regression burns budget
+        # before queues back up, so waiting for queue pressure means
+        # paying a cold start AFTER the page instead of before it.
+        self.slo = None
         self.interval = cfg.model_autoscaling.interval_seconds
         self.window_count = cfg.model_autoscaling.average_window_count
         self._averages: dict[str, SimpleMovingAverage] = {}
@@ -416,6 +422,10 @@ class Autoscaler:
                     avg, queue, model.spec.target_requests,
                     self.cfg.model_autoscaling.queue_pressure_max_wait_seconds,
                 )
+                burn = self._slo_pressure(model.name)
+                slo_fast = bool(burn and burn["level"] >= 2)
+                if slo_fast and desired > 0:
+                    desired += 1
                 # Cluster capacity plan override: a fresh plan's
                 # bin-packed allocation wins over this model's solo
                 # desire (the planner already saw the desire's inputs
@@ -446,6 +456,8 @@ class Autoscaler:
                     "queue_per_class": dict(queue["per_class"]),
                     "telemetry_source": queue_src,
                     "scaling_source": scaling_source,
+                    "slo_pressure": slo_fast,
+                    "slo_burn": (burn or {}).get("state", ""),
                 }
                 if scaling_source == "planner":
                     record["planner_replicas"] = target
@@ -561,6 +573,13 @@ class Autoscaler:
         desired_dec, slot_occ, util = desired_decode_replicas(
             dec, len(dec_addrs), dis
         )
+        # TTFT lives in prefill: a fast-burning objective buys prefill
+        # headroom (decode scales on occupancy, which the burn already
+        # reflects if decode is the bottleneck).
+        burn = self._slo_pressure(model.name)
+        slo_fast = bool(burn and burn["level"] >= 2)
+        if slo_fast:
+            desired_pre += 1
         # Capacity plan override: the planner damps the prefill/decode
         # pair JOINTLY (both roles shrink toward their desired ratio
         # under chip pressure) — per-role direct scaling is the stale-
@@ -609,6 +628,8 @@ class Autoscaler:
                 md.ROLE_DECODE: dec_src,
             },
             "scaling_source": scaling_source,
+            "slo_pressure": slo_fast,
+            "slo_burn": (burn or {}).get("state", ""),
             "roles": {
                 md.ROLE_PREFILL: {
                     "endpoints": len(pre_addrs),
@@ -632,6 +653,16 @@ class Autoscaler:
         if self.cfg.fixed_self_metric_addrs:
             return list(self.cfg.fixed_self_metric_addrs)
         return self.lb.get_self_ips()
+
+    def _slo_pressure(self, model: str) -> dict | None:
+        """The SLO evaluator's pressure read, or None when no evaluator
+        is wired / the model was not judged this tick."""
+        if self.slo is None:
+            return None
+        try:
+            return self.slo.pressure(model)
+        except Exception:  # noqa: BLE001 — advisory signal only
+            return None
 
     def _avg_for(self, model: str) -> SimpleMovingAverage:
         if model not in self._averages:

@@ -179,6 +179,41 @@ class TenancyConfig:
 
 
 @dataclasses.dataclass
+class SLOConfig:
+    """SLO plane (kubeai_tpu/fleet/slo; no reference analog — the
+    reference emits metrics and lets the operator's humans judge them).
+    System-wide default objectives per scheduling class ride here;
+    per-model CRD `slo:` blocks override the targets. The evaluator
+    judges every objective each tick from fleet-aggregator snapshots
+    with multi-window multi-burn-rate logic (Google SRE workbook shape):
+    fast burn pages when BOTH the short and long fast windows burn above
+    `fastBurnThreshold`; slow burn warns on the slow window alone. A
+    page fires the flight recorder's incident bundling. Disabled by
+    default: the evaluator is never constructed and nothing changes."""
+
+    enabled: bool = False
+    # Evaluation cadence. 0 = follow modelAutoscaling.interval.
+    interval_seconds: float = 0.0
+    # Default objective targets (0 disables that objective).
+    ttft_p95_seconds: float = 0.0   # 95% of requests see TTFT <= this
+    itl_p99_seconds: float = 0.0    # 99% of tokens see ITL <= this
+    availability: float = 0.0       # e.g. 0.999 request success target
+    max_shed_rate: float = 0.0      # max fraction door-shed, e.g. 0.05
+    # Error-budget ledger horizon (rolling).
+    budget_window_seconds: float = 3600.0
+    # Burn-rate alert rules.
+    fast_burn_threshold: float = 14.4
+    fast_burn_window_seconds: float = 300.0
+    fast_burn_short_window_seconds: float = 60.0
+    slow_burn_threshold: float = 3.0
+    slow_burn_window_seconds: float = 1800.0
+    # Incident bundles land here ("" = retained in memory only).
+    incident_dir: str = ""
+    # Per-trigger debounce between bundles.
+    min_incident_interval_seconds: float = 300.0
+
+
+@dataclasses.dataclass
 class ModelRollouts:
     """Surge pods during rollout (reference: internal/config/system.go:114-117)."""
 
@@ -322,6 +357,7 @@ class System:
     tenancy: TenancyConfig = dataclasses.field(
         default_factory=TenancyConfig
     )
+    slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
     model_rollouts: ModelRollouts = dataclasses.field(
         default_factory=ModelRollouts
     )
@@ -402,6 +438,37 @@ class System:
             raise ConfigError("tenancy.maxTenantSeries must be >= 1")
         if t.tenant_idle_seconds <= 0:
             raise ConfigError("tenancy.tenantIdle must be > 0")
+        s = self.slo
+        if s.interval_seconds < 0:
+            raise ConfigError("slo.interval must be >= 0")
+        if s.ttft_p95_seconds < 0:
+            raise ConfigError("slo.ttftP95 must be >= 0")
+        if s.itl_p99_seconds < 0:
+            raise ConfigError("slo.itlP99 must be >= 0")
+        if not 0.0 <= s.availability < 1.0:
+            raise ConfigError("slo.availability must be in [0, 1)")
+        if not 0.0 <= s.max_shed_rate < 1.0:
+            raise ConfigError("slo.maxShedRate must be in [0, 1)")
+        if s.budget_window_seconds <= 0:
+            raise ConfigError("slo.budgetWindow must be > 0")
+        if s.fast_burn_threshold <= 0 or s.slow_burn_threshold <= 0:
+            raise ConfigError("slo burn thresholds must be > 0")
+        if s.fast_burn_short_window_seconds <= 0:
+            raise ConfigError("slo.fastBurnShortWindow must be > 0")
+        if s.fast_burn_window_seconds < s.fast_burn_short_window_seconds:
+            raise ConfigError(
+                "slo.fastBurnWindow must be >= fastBurnShortWindow"
+            )
+        if s.slow_burn_window_seconds < s.fast_burn_window_seconds:
+            raise ConfigError(
+                "slo.slowBurnWindow must be >= fastBurnWindow"
+            )
+        if s.budget_window_seconds < s.slow_burn_window_seconds:
+            raise ConfigError(
+                "slo.budgetWindow must be >= slowBurnWindow"
+            )
+        if s.min_incident_interval_seconds < 0:
+            raise ConfigError("slo.minIncidentInterval must be >= 0")
         if self.model_rollouts.surge < 0:
             raise ConfigError("modelRollouts.surge must be >= 0")
         r = self.resilience
@@ -755,6 +822,28 @@ def system_from_dict(data: dict) -> System:
             max_retry_after_seconds=_seconds(t.get("maxRetryAfter", 300)),
             max_tenant_series=int(t.get("maxTenantSeries", 512)),
             tenant_idle_seconds=_seconds(t.get("tenantIdle", 600)),
+        )
+    if "slo" in data:
+        s = data["slo"]
+        sys_obj.slo = SLOConfig(
+            enabled=bool(s.get("enabled", False)),
+            interval_seconds=_seconds(s.get("interval", 0)),
+            ttft_p95_seconds=_seconds(s.get("ttftP95", 0)),
+            itl_p99_seconds=_seconds(s.get("itlP99", 0)),
+            availability=float(s.get("availability", 0.0)),
+            max_shed_rate=float(s.get("maxShedRate", 0.0)),
+            budget_window_seconds=_seconds(s.get("budgetWindow", 3600)),
+            fast_burn_threshold=float(s.get("fastBurnThreshold", 14.4)),
+            fast_burn_window_seconds=_seconds(s.get("fastBurnWindow", 300)),
+            fast_burn_short_window_seconds=_seconds(
+                s.get("fastBurnShortWindow", 60)
+            ),
+            slow_burn_threshold=float(s.get("slowBurnThreshold", 3.0)),
+            slow_burn_window_seconds=_seconds(s.get("slowBurnWindow", 1800)),
+            incident_dir=str(s.get("incidentDir", "")),
+            min_incident_interval_seconds=_seconds(
+                s.get("minIncidentInterval", 300)
+            ),
         )
     if "modelRollouts" in data:
         sys_obj.model_rollouts = ModelRollouts(

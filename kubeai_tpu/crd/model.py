@@ -259,6 +259,50 @@ class Tenancy:
 
 
 @dataclasses.dataclass
+class Slo:
+    """Per-model service-level objectives for the SLO plane
+    (kubeai_tpu/fleet/slo; system `slo:` config holds the defaults and
+    the burn-rate windows). Pure observability/control-bias state: the
+    evaluator judges these each tick from fleet snapshots, and a breach
+    biases scaling — no engine flag or pod spec renders from this
+    block. A field set to 0 inherits the system default; a model whose
+    resolved targets are all 0 has no objectives and is never judged."""
+
+    ttft_p95_seconds: float = 0.0   # 95% of requests see TTFT <= this
+    itl_p99_seconds: float = 0.0    # 99% of tokens see ITL <= this
+    availability: float = 0.0       # request success target, e.g. 0.999
+    max_shed_rate: float = 0.0      # max fraction door-shed, e.g. 0.05
+
+    def enabled(self) -> bool:
+        return bool(
+            self.ttft_p95_seconds or self.itl_p99_seconds
+            or self.availability or self.max_shed_rate
+        )
+
+    def validate(self) -> None:
+        for field, value in (
+            ("ttftP95Seconds", self.ttft_p95_seconds),
+            ("itlP99Seconds", self.itl_p99_seconds),
+        ):
+            try:
+                ok = float(value) >= 0
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValidationError(f"slo.{field} must be a number >= 0")
+        for field, value in (
+            ("availability", self.availability),
+            ("maxShedRate", self.max_shed_rate),
+        ):
+            try:
+                ok = 0.0 <= float(value) < 1.0
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValidationError(f"slo.{field} must be in [0, 1)")
+
+
+@dataclasses.dataclass
 class RoleScaling:
     """Replica bounds for one disaggregated role's pod group. The
     autoscaler writes the applied count into a Model annotation
@@ -516,6 +560,8 @@ class ModelSpec:
     scheduling: Scheduling = dataclasses.field(default_factory=Scheduling)
     # Front-door tenant admission overrides (door state, every engine).
     tenancy: Tenancy = dataclasses.field(default_factory=Tenancy)
+    # Per-model SLO targets (observability/control-bias, every engine).
+    slo: Slo = dataclasses.field(default_factory=Slo)
     # Disaggregated prefill/decode serving (in-tree engine only).
     disaggregation: Disaggregation = dataclasses.field(
         default_factory=Disaggregation
@@ -611,6 +657,9 @@ class ModelSpec:
         # Deliberately no engine gate: tenancy is door state, enforced
         # before any engine sees the request.
         self.tenancy.validate()
+        # Same: SLO targets are judged from the fleet plane — no engine
+        # needs to know them.
+        self.slo.validate()
         self.disaggregation.validate()
         if self.disaggregation.enabled and self.engine != ENGINE_KUBEAI_TPU:
             raise ValidationError(
@@ -806,6 +855,7 @@ class Model:
         cold = spec.get("coldStart", {}) or {}
         estep = spec.get("engineStep", {}) or {}
         ten = spec.get("tenancy", {}) or {}
+        slo = spec.get("slo", {}) or {}
 
         def _role_scaling(key: str) -> RoleScaling:
             r = dis.get(key) or {}
@@ -902,6 +952,14 @@ class Model:
                         ten.get("windowTokenBudget", 0) or 0
                     ),
                     exempt=bool(ten.get("exempt", False)),
+                ),
+                slo=Slo(
+                    ttft_p95_seconds=float(
+                        slo.get("ttftP95Seconds", 0) or 0
+                    ),
+                    itl_p99_seconds=float(slo.get("itlP99Seconds", 0) or 0),
+                    availability=float(slo.get("availability", 0) or 0),
+                    max_shed_rate=float(slo.get("maxShedRate", 0) or 0),
                 ),
                 disaggregation=Disaggregation(
                     enabled=bool(dis.get("enabled", False)),
@@ -1049,6 +1107,17 @@ def _spec_to_dict(s: ModelSpec) -> dict:
         if s.tenancy.exempt:
             ten["exempt"] = True
         d["tenancy"] = ten
+    if s.slo.enabled():
+        slo: dict[str, Any] = {}
+        if s.slo.ttft_p95_seconds:
+            slo["ttftP95Seconds"] = s.slo.ttft_p95_seconds
+        if s.slo.itl_p99_seconds:
+            slo["itlP99Seconds"] = s.slo.itl_p99_seconds
+        if s.slo.availability:
+            slo["availability"] = s.slo.availability
+        if s.slo.max_shed_rate:
+            slo["maxShedRate"] = s.slo.max_shed_rate
+        d["slo"] = slo
     if s.disaggregation.enabled:
         dis = s.disaggregation
 

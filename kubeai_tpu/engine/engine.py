@@ -1625,11 +1625,14 @@ class Engine:
         """The request scheduler (queue-pressure snapshots, retry hints)."""
         return self._sched
 
-    def drain_timing(self) -> list[tuple[str, float]]:
-        """Pop the accumulated latency observations: (kind, seconds) with
-        kind ∈ {queue_wait, prefill, ttft, itl, e2e}. The serve loop (and
-        the /metrics scrape) observes these into the server's histograms;
-        draining transfers ownership so each record lands exactly once."""
+    def drain_timing(self) -> list[tuple]:
+        """Pop the accumulated latency observations: (kind, seconds) or
+        (kind, seconds, exemplar_tag) with kind ∈ {queue_wait, prefill,
+        ttft, itl, e2e} — ttft/itl carry a "rid-<n>" tag so the server's
+        histograms keep a last-request exemplar per bucket. The serve
+        loop (and the /metrics scrape) observes these into the server's
+        histograms; draining transfers ownership so each record lands
+        exactly once."""
         with self._lock:
             out, self._timing = self._timing, []
         return out
@@ -2124,7 +2127,9 @@ class Engine:
             ("queue_wait", max(0.0, req.t_admit_start - req.t_enqueue))
         )
         self._timing.append(("prefill", max(0.0, now - req.t_admit_start)))
-        self._timing.append(("ttft", max(0.0, now - req.t_enqueue)))
+        self._timing.append(
+            ("ttft", max(0.0, now - req.t_enqueue), f"rid-{req.rid}")
+        )
         req.t_prev_token = now
         req.out_tokens.append(tok)
         req.position = plen
@@ -2286,6 +2291,14 @@ class Engine:
         self._bt_host[slot] = -1
         self._bt_dirty = True
         self._sched.requeue_front(victim)
+        # Optional observer (the server's flight recorder): set as a
+        # plain attribute so engine stand-ins need no constructor change.
+        cb = getattr(self, "on_preempt", None)
+        if cb is not None:
+            try:
+                cb(victim.rid, victim.client)
+            except Exception:
+                pass
 
     def _release(self, req: _Request) -> None:
         # Completed requests (not cancellations — a disconnect says
@@ -2480,7 +2493,9 @@ class Engine:
                         )[0]
                     )
                 self._timing.append(("prefill", max(0.0, _now() - t0)))
-                self._timing.append(("ttft", max(0.0, _now() - t0)))
+                self._timing.append(
+                    ("ttft", max(0.0, _now() - t0), f"rid-{rid}")
+                )
                 # Gather the sequence's pages to host IN TABLE ORDER: the
                 # packed-page blob is position-major by construction.
                 _kv_t0 = time.perf_counter()
@@ -3322,7 +3337,8 @@ class Engine:
                 )
                 if req.t_prev_token:
                     self._timing.append(
-                        ("itl", max(0.0, now - req.t_prev_token))
+                        ("itl", max(0.0, now - req.t_prev_token),
+                         f"rid-{req.rid}")
                     )
                 req.t_prev_token = now
                 req.out_tokens.append(tok)
@@ -3370,7 +3386,8 @@ class Engine:
                 tok = int(choices[slot, j])
                 if req.t_prev_token:
                     self._timing.append(
-                        ("itl", max(0.0, now - req.t_prev_token))
+                        ("itl", max(0.0, now - req.t_prev_token),
+                         f"rid-{req.rid}")
                     )
                 req.t_prev_token = now
                 req.out_tokens.append(tok)

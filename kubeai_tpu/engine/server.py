@@ -39,7 +39,7 @@ from kubeai_tpu.engine.engine import (
     StepEvent,
 )
 from kubeai_tpu.engine.sampling import SamplingParams
-from kubeai_tpu.metrics import tracing
+from kubeai_tpu.metrics import flightrecorder, tracing
 from kubeai_tpu.engine.tokenizer import Tokenizer, load_tokenizer
 from kubeai_tpu.metrics.registry import (
     Counter,
@@ -404,10 +404,12 @@ class EngineMetrics:
         for ev in cold_start.get("events") or ():
             self.coldstart_events.inc(event=ev)
 
-    def observe_timing(self, kind: str, seconds: float) -> None:
+    def observe_timing(
+        self, kind: str, seconds: float, exemplar: str | None = None
+    ) -> None:
         h = self._timing_hist.get(kind)
         if h is not None:
-            h.observe(seconds)
+            h.observe(seconds, exemplar=exemplar)
 
     def sync_engine(self, engine) -> None:
         """Snapshot engine serving state (the engine owns these counters;
@@ -489,8 +491,11 @@ class EngineMetrics:
             )
         drain = getattr(inner, "drain_timing", None)
         if drain is not None:
-            for kind, seconds in drain():
-                self.observe_timing(kind, seconds)
+            for rec in drain():
+                self.observe_timing(
+                    rec[0], rec[1],
+                    exemplar=rec[2] if len(rec) > 2 else None,
+                )
         prof = getattr(inner, "profiler", None)
         if prof is not None:
             for phase, seconds in prof.drain():
@@ -573,6 +578,12 @@ class EngineServer:
         self.tokenizer = tokenizer
         self.served_model_name = served_model_name
         self.metrics = EngineMetrics()
+        # Always-on flight recorder: scheduler admissions/sheds,
+        # preemptions, watchdog/step anomalies land in bounded rings
+        # surfaced on /v1/state (the fleet plane bundles its own rings;
+        # the engine's travel with its state snapshot).
+        self.recorder = flightrecorder.FlightRecorder(ring_size=128)
+        engine.on_preempt = self._note_preempt
         # Boot cold-start record (ColdStartTracker.snapshot()): surfaced
         # on /v1/state so the fleet aggregator carries each replica's
         # measured cold-start cost to the planner, and folded into the
@@ -731,6 +742,20 @@ class EngineServer:
                             # The aggregator copies this to the planner
                             # as the model's measured cold-start cost.
                             "cold_start": outer.cold_start,
+                            # Last-request-per-bucket exemplars: the
+                            # "rid-<n>" tags that let an operator jump
+                            # from a latency bucket to the request that
+                            # last landed in it.
+                            "exemplars": {
+                                "ttft": outer.metrics.ttft.exemplars(),
+                                "itl": outer.metrics.itl.exemplars(),
+                            },
+                            # Flight-recorder rings: the engine's
+                            # discrete decisions (admits, sheds,
+                            # preemptions, watchdog) in decision order.
+                            "flight_recorder": (
+                                outer.recorder.state_payload()
+                            ),
                             **engine_state_snapshot(outer.engine),
                         },
                     )
@@ -873,6 +898,10 @@ class EngineServer:
                 # routing here) — failure detection parity with the
                 # reference's probe design (engine_vllm.go liveness).
                 logger.exception("serving loop crashed")
+                self.recorder.record(
+                    flightrecorder.STEP_ANOMALY, "engine",
+                    target=self.served_model_name, reason="loop_crash",
+                )
                 self._loop_dead = True
                 return
 
@@ -884,6 +913,13 @@ class EngineServer:
             not self._loop_dead
             and not self._wedged
             and not self._stop.is_set()
+        )
+
+    def _note_preempt(self, rid: int, client: str) -> None:
+        self.recorder.record(
+            flightrecorder.SCHED_PREEMPT, "engine_sched",
+            target=self.served_model_name, trace_id=f"rid-{rid}",
+            client=client or "",
         )
 
     # -- step watchdog ----------------------------------------------------------
@@ -940,6 +976,20 @@ class EngineServer:
             self._wedged = True
             self.metrics.watchdog_wedged.set(1)
             self.metrics.watchdog_stalls.inc()
+            self.recorder.record(
+                flightrecorder.WATCHDOG, "engine",
+                target=self.served_model_name,
+                stalled_for_s=round(stalled_for, 3),
+                active=self.engine.num_active,
+                pending=self.engine.num_pending,
+            )
+            self.recorder.trigger(
+                flightrecorder.TRIGGER_WATCHDOG,
+                detail=(
+                    f"no step progress for {stalled_for:.1f}s with "
+                    f"work active"
+                ),
+            )
             logger.error(
                 "watchdog: no engine step progress for %.1fs with work "
                 "active (%d active, %d pending) — flipping /health and "
@@ -1333,6 +1383,11 @@ class EngineServer:
                 self.engine.cancel(rid_i)
                 with self._sub_lock:
                     self._subscribers.pop(rid_i, None)
+            self.recorder.record(
+                flightrecorder.SCHED_SHED, "engine_sched",
+                target=self.served_model_name, priority=priority,
+                deadline_ms=deadline_ms, reason=str(e),
+            )
             return self._shed_response(
                 http, str(e), retry_after=e.retry_after
             )
@@ -1363,6 +1418,11 @@ class EngineServer:
         self.metrics.requests_total.inc(model=display)
         self.metrics.active_requests.inc()
         self.metrics.prompt_tokens.inc(len(prompt_ids) * n)
+        self.recorder.record(
+            flightrecorder.SCHED_ADMIT, "engine_sched", target=display,
+            trace_id=f"rid-{reqs[0][0]}" if reqs else "",
+            priority=priority, choices=n,
+        )
         self._work.set()
         t0 = time.monotonic()
         span = getattr(http, "current_span", None)

@@ -32,11 +32,18 @@ Plus the consumer that makes the aggregated state actionable:
     feeds the planner's predictive prewarm pass and prices measured
     cold-start cost into its preemption choices; `kubeai_prewarm_*`
     gauges.
+  - `SLOEvaluator` — the judge over all of it (docs/concepts/slo.md):
+    declarative per-model objectives (TTFT p95, ITL p99, availability,
+    shed rate) evaluated each tick from the aggregator's snapshots with
+    multi-window multi-burn-rate alerting and an exact error-budget
+    ledger; `kubeai_slo_*` metrics, `GET /v1/slo`, and burn-rate
+    pressure fed into the autoscaler and planner.
 """
 
 from kubeai_tpu.fleet.aggregator import (
     FleetStateAggregator,
     endpoint_signals,
+    hist_detail,
     hist_quantiles,
 )
 from kubeai_tpu.fleet.forecaster import (
@@ -55,6 +62,7 @@ from kubeai_tpu.fleet.metering import (
     tenant_of,
 )
 from kubeai_tpu.fleet.profiler import PHASES, StepProfiler, phase_totals
+from kubeai_tpu.fleet.slo import OBJECTIVE_KINDS, SLOEvaluator
 from kubeai_tpu.fleet.tenancy import Refusal, TenantGovernor
 
 __all__ = [
@@ -63,13 +71,16 @@ __all__ = [
     "DemandForecaster",
     "Forecast",
     "FleetStateAggregator",
+    "OBJECTIVE_KINDS",
     "PHASES",
     "Refusal",
     "SCHEDULING_CLASSES",
+    "SLOEvaluator",
     "StepProfiler",
     "TenantGovernor",
     "UsageMeter",
     "endpoint_signals",
+    "hist_detail",
     "hist_quantiles",
     "model_chips_per_replica",
     "model_scheduling_class",

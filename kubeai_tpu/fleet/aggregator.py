@@ -32,7 +32,6 @@ from __future__ import annotations
 
 import json
 import logging
-import math
 import threading
 import time
 import urllib.request
@@ -52,7 +51,10 @@ from kubeai_tpu.crd import metadata as md
 from kubeai_tpu.metrics.registry import (
     DEFAULT_METRICS,
     Metrics,
+    _fmt_le as _registry_fmt_le,
+    hist_buckets,
     parse_prometheus_text,
+    quantiles_from_buckets,
 )
 from kubeai_tpu.operator import k8sutils
 
@@ -81,45 +83,29 @@ def hist_quantiles(
     parsed: dict, name: str, qs: tuple[float, ...] = (0.5, 0.95, 0.99)
 ) -> dict:
     """Approximate quantiles from one endpoint's cumulative histogram
-    buckets (each quantile reports its bucket's upper bound — the
-    standard Prometheus-side estimate). Returns {} when the histogram
-    has no observations."""
-    buckets: list[tuple[float, float]] = []
-    total = 0.0
-    total_sum = 0.0
-    for (metric, labels), value in parsed.items():
-        if metric == f"{name}_bucket":
-            le = dict(labels).get("le", "")
-            try:
-                bound = float(le)
-            except ValueError:
-                continue
-            buckets.append((bound, value))
-        elif metric == f"{name}_count":
-            total = value
-        elif metric == f"{name}_sum":
-            total_sum = value
+    buckets. The math lives in the shared estimator
+    (`kubeai_tpu.metrics.registry.quantiles_from_buckets`) so the SLO
+    evaluator's burn-rate reads and these per-endpoint views can never
+    disagree about the same scrape. Returns {} when the histogram has no
+    observations."""
+    buckets, total, total_sum = hist_buckets(parsed, name)
+    return quantiles_from_buckets(buckets, total, total_sum, qs)
+
+
+def hist_detail(parsed: dict, name: str) -> dict:
+    """JSON-safe raw histogram state for one scraped histogram: the
+    cumulative buckets keyed by their canonical `le` STRING (a float
+    +Inf would serialize as non-standard JSON `Infinity`), plus count
+    and sum. This is what snapshots carry so the SLO evaluator can
+    window observations across ticks; {} when never observed."""
+    buckets, total, total_sum = hist_buckets(parsed, name)
     if total <= 0 or not buckets:
         return {}
-    buckets.sort(key=lambda b: b[0])
-    out = {
+    return {
+        "buckets": [[_registry_fmt_le(b), c] for b, c in buckets],
         "count": total,
-        "mean_s": round(total_sum / total, 9),
+        "sum": total_sum,
     }
-    for q in qs:
-        target = q * total
-        est = buckets[-1][0]
-        for bound, cum in buckets:
-            if cum >= target:
-                est = bound
-                break
-        if math.isinf(est):
-            # The quantile lands past the largest finite bucket; report
-            # that bound rather than a meaningless +Inf.
-            finite = [b for b, _ in buckets if not math.isinf(b)]
-            est = finite[-1] if finite else float("inf")
-        out[f"p{int(q * 100)}_s"] = est
-    return out
 
 
 def endpoint_signals(parsed: dict) -> dict:
@@ -158,6 +144,11 @@ def endpoint_signals(parsed: dict) -> dict:
         "active_requests": active,
         "ttft": hist_quantiles(parsed, TTFT_HIST),
         "itl": hist_quantiles(parsed, ITL_HIST),
+        # Raw cumulative bucket state rides along so the SLO evaluator
+        # can difference consecutive snapshots into per-window counts —
+        # quantile summaries alone cannot be windowed.
+        "ttft_hist": hist_detail(parsed, TTFT_HIST),
+        "itl_hist": hist_detail(parsed, ITL_HIST),
     }
 
 
@@ -534,6 +525,16 @@ class FleetStateAggregator:
                 len(entry["stale_endpoints"]), model=name,
             )
             set_(m.fleet_queue_depth, entry["queue"]["depth"], model=name)
+            for addr, ep in entry["endpoints"].items():
+                # Staleness visible per endpoint, not just as a count —
+                # a flapping endpoint shows up as a sawtooth here while
+                # kubeai_fleet_stale_endpoints only blinks. Never-scraped
+                # endpoints export nothing: absence is not zero age.
+                if ep.get("age_s") is not None:
+                    set_(
+                        m.fleet_endpoint_staleness,
+                        ep["age_s"], model=name, endpoint=addr,
+                    )
             for role, sig in entry["roles"].items():
                 set_(
                     m.fleet_kv_utilization,

@@ -72,6 +72,7 @@ from kubeai_tpu.autoscaler.autoscaler import (
     desired_unified_replicas,
 )
 from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.metrics import flightrecorder
 from kubeai_tpu.metrics.registry import DEFAULT_METRICS, Metrics
 from kubeai_tpu.operator import k8sutils
 from kubeai_tpu.operator.k8s.store import Conflict, NotFound
@@ -190,6 +191,13 @@ class CapacityPlanner:
         # and cold-start-priced arbitration. None → both are no-ops.
         self.forecaster = forecaster
         self.avg_lookup = None
+        # SLO evaluator (fleet/slo) + flight recorder, wired by the
+        # manager: a fast-burning objective asserts slo_pressure even
+        # when the queue looks calm (latency regressions burn budget
+        # without backlog), and preemption marks land in the flight
+        # ring so incident bundles show capacity decisions.
+        self.slo = None
+        self.recorder = None
         self._clock = clock
         self._lock = threading.Lock()
         self._plan: dict | None = None
@@ -251,6 +259,16 @@ class CapacityPlanner:
             return self.cfg.model_autoscaling.queue_pressure_max_wait_seconds
         return 3.0
 
+    def _slo_burn(self, model_name: str) -> dict | None:
+        """The SLO evaluator's pressure read for this model, or None
+        when no evaluator is wired / the model was not judged."""
+        if self.slo is None:
+            return None
+        try:
+            return self.slo.pressure(model_name)
+        except Exception:  # noqa: BLE001 — advisory signal only
+            return None
+
     def _unified_desire(self, model, entry: dict) -> dict:
         avg = self.avg_lookup(model.name) if self.avg_lookup else None
         if avg is None:
@@ -263,6 +281,7 @@ class CapacityPlanner:
             "depth": 0.0, "oldest_wait_s": 0.0, "per_class": {},
         }
         threshold = self._threshold()
+        burn = self._slo_burn(model.name)
         desired = desired_unified_replicas(
             avg, queue, model.spec.target_requests, threshold
         )
@@ -280,8 +299,10 @@ class CapacityPlanner:
             "max_replicas": model.spec.max_replicas,
             "prewarm_allowed": model.spec.cold_start.prewarm,
             "slo_pressure": bool(
-                threshold > 0 and queue["oldest_wait_s"] >= threshold
+                (threshold > 0 and queue["oldest_wait_s"] >= threshold)
+                or (burn is not None and burn["level"] >= 2)
             ),
+            "slo_burn": (burn or {}).get("state", ""),
             "queue_depth": queue["depth"],
             "queue_oldest_wait_s": queue["oldest_wait_s"],
         }
@@ -293,6 +314,7 @@ class CapacityPlanner:
         pre_sig = roles.get(md.ROLE_PREFILL) or aggregate_role_signals({})
         dec_sig = roles.get(md.ROLE_DECODE) or aggregate_role_signals({})
         threshold = self._threshold()
+        burn = self._slo_burn(model.name)
         desired_pre = desired_prefill_replicas(
             pre_sig, replicas.get(md.ROLE_PREFILL, 0), dis, threshold
         )
@@ -325,7 +347,9 @@ class CapacityPlanner:
                     and pre_sig["ttft_mean_s"]
                     > dis.prefill_target_ttft_seconds
                 )
+                or (burn is not None and burn["level"] >= 2)
             ),
+            "slo_burn": (burn or {}).get("state", ""),
             "kv_utilization": util,
             "slot_occupancy": slot_occ,
         }
@@ -638,6 +662,7 @@ class CapacityPlanner:
                 base.update(
                     signal=e["signal"],
                     slo_pressure=e["slo_pressure"],
+                    slo_burn=e.get("slo_burn", ""),
                     desired_roles=dict(e["desired_roles"]),
                     target_roles=dict(e["target_roles"]),
                     allocated_roles=dict(e["alloc_roles"]),
@@ -657,6 +682,7 @@ class CapacityPlanner:
                 base.update(
                     signal=e["signal"],
                     slo_pressure=e["slo_pressure"],
+                    slo_burn=e.get("slo_burn", ""),
                     queue_depth=e["queue_depth"],
                     queue_oldest_wait_s=e["queue_oldest_wait_s"],
                     desired_replicas=e["desired"],
@@ -766,6 +792,12 @@ class CapacityPlanner:
                     pod["metadata"].setdefault("annotations", {})[
                         md.PLANNER_PREEMPT_ANNOTATION
                     ] = md.PREEMPT_REASON_CAPACITY
+                    if self.recorder is not None:
+                        self.recorder.record(
+                            flightrecorder.PLANNER_PREEMPT, "planner",
+                            target=name, pod=pod_name,
+                            cls=rec.get("class", ""),
+                        )
                 else:
                     pod["metadata"]["annotations"].pop(
                         md.PLANNER_PREEMPT_ANNOTATION, None

@@ -1,0 +1,619 @@
+"""SLO plane: declarative objectives judged each tick from fleet state.
+
+The stack already emits rich raw telemetry (request lifecycle
+histograms, fleet aggregator snapshots, door/governor/planner decision
+records), but nothing JUDGES it — whether the fleet is meeting its
+latency and availability objectives was a Grafana-and-human problem.
+This module makes SLO attainment a first-class control signal:
+
+  * **Objectives** are declared per model (CRD `slo:` block, system
+    `slo:` config defaults): TTFT p95, ITL p99, availability, and
+    door shed-rate. Every objective reduces to one discipline — a
+    (total, bad) event count per evaluation tick — so one burn-rate
+    engine and one error-budget ledger serve all four kinds.
+
+  * **Evaluation** runs each tick from `FleetStateAggregator`
+    snapshots (latency bucket deltas, with per-endpoint monotone
+    accumulation so an engine restart's counter reset never counts
+    history twice) and the front-door instrument bundle (availability
+    and shed counters). Ticks whose telemetry coverage is below the
+    governor's `minTelemetryCoverage` are REFUSED and counted — a
+    blind judge recuses itself rather than guessing.
+
+  * **Multi-window multi-burn-rate alerting** (the SRE-workbook
+    shape): fast burn pages when both the short and long fast windows
+    burn above `fastBurnThreshold`; slow burn warns on the slow
+    window alone. The error-budget ledger is EXACT — integer event
+    counts and `fractions.Fraction` arithmetic, so "budget remaining"
+    in a decision record is a statement, not a float estimate.
+
+  * **Outputs**: `kubeai_slo_*` gauges/counters, one JSON decision
+    record per (model, objective) per tick on `kubeai.slo.alerts`,
+    `GET /v1/slo`, a `pressure(model)` read the autoscaler and
+    planner surface in their own decision records (`slo_pressure`),
+    and — on a fast-burn page — the flight recorder's incident
+    bundling, so the breach ships with its own evidence.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from collections import deque
+from fractions import Fraction
+
+from kubeai_tpu.fleet.planner import model_scheduling_class
+from kubeai_tpu.metrics import flightrecorder
+from kubeai_tpu.metrics.registry import (
+    DEFAULT_METRICS,
+    Metrics,
+    count_over_threshold,
+    quantiles_from_buckets,
+)
+
+logger = logging.getLogger(__name__)
+
+# One structured JSON record per (tick, model, objective): the SLO
+# plane's decision trail, same contract as kubeai.autoscaler.decisions.
+alert_log = logging.getLogger("kubeai.slo.alerts")
+
+OBJ_TTFT_P95 = "ttft_p95"
+OBJ_ITL_P99 = "itl_p99"
+OBJ_AVAILABILITY = "availability"
+OBJ_SHED_RATE = "shed_rate"
+
+OBJECTIVE_KINDS = (OBJ_TTFT_P95, OBJ_ITL_P99, OBJ_AVAILABILITY, OBJ_SHED_RATE)
+
+# Alert states (the kubeai_slo_alert_state gauge values).
+STATE_OK = 0
+STATE_SLOW_BURN = 1
+STATE_FAST_BURN = 2
+STATE_NAMES = {STATE_OK: "ok", STATE_SLOW_BURN: "slow", STATE_FAST_BURN: "fast"}
+
+# Consecutive below-coverage refusals before the flight recorder's
+# coverage-collapse trigger fires (one flap must not dump a bundle).
+COVERAGE_COLLAPSE_TICKS = 3
+
+
+class Objective:
+    """One resolved objective: a latency threshold or a rate bound,
+    reduced to an allowed-bad-fraction. `allowed` is an exact Fraction
+    (1/20 for p95, 1/100 for p99, 1 - target for availability, the
+    configured rate for shed)."""
+
+    def __init__(self, kind: str, allowed: Fraction, threshold: float = 0.0,
+                 target: float = 0.0):
+        if kind not in OBJECTIVE_KINDS:
+            raise ValueError(f"unknown objective kind {kind!r}")
+        self.kind = kind
+        self.allowed = allowed
+        self.threshold = threshold  # seconds (latency kinds only)
+        self.target = target        # the declared target, for records
+
+    def describe(self) -> dict:
+        d = {"kind": self.kind, "allowed": str(self.allowed)}
+        if self.threshold:
+            d["threshold_s"] = self.threshold
+        if self.target:
+            d["target"] = self.target
+        return d
+
+
+def resolve_objectives(model, cfg) -> list[Objective]:
+    """The model's effective objectives: CRD `slo:` fields override the
+    system `slo:` defaults field-by-field; a resolved 0 disables that
+    objective. `Fraction(str(x))` keeps user-written decimals exact
+    (0.999 stays 999/1000, not a binary-float neighborhood)."""
+    spec = model.spec.slo
+    out: list[Objective] = []
+    ttft = spec.ttft_p95_seconds or cfg.ttft_p95_seconds
+    if ttft > 0:
+        out.append(Objective(OBJ_TTFT_P95, Fraction(5, 100), threshold=ttft))
+    itl = spec.itl_p99_seconds or cfg.itl_p99_seconds
+    if itl > 0:
+        out.append(Objective(OBJ_ITL_P99, Fraction(1, 100), threshold=itl))
+    avail = spec.availability or cfg.availability
+    if avail > 0:
+        out.append(Objective(
+            OBJ_AVAILABILITY, Fraction(1) - Fraction(str(avail)),
+            target=avail,
+        ))
+    shed = spec.max_shed_rate or cfg.max_shed_rate
+    if shed > 0:
+        out.append(Objective(
+            OBJ_SHED_RATE, Fraction(str(shed)), target=shed,
+        ))
+    return out
+
+
+class _HistAccumulator:
+    """Monotone per-(model, histogram) bucket totals accumulated from
+    per-endpoint cumulative scrapes. Engine restarts reset an
+    endpoint's counters to zero; differencing raw sums across a restart
+    would count all surviving history as fresh observations (or go
+    negative). Per-endpoint deltas clamped at >= 0 — with a full
+    restart detected as ANY bucket shrinking, in which case the
+    endpoint's current totals count as the delta — keep the model-level
+    series monotone and honest."""
+
+    def __init__(self):
+        # (model, hist) -> {"buckets": {le: float}, "count": float}
+        self.totals: dict[tuple, dict] = {}
+        # (model, hist, endpoint) -> last seen {"buckets", "count"}
+        self._last: dict[tuple, dict] = {}
+
+    def absorb(self, model: str, hist: str, endpoint: str,
+               detail: dict) -> None:
+        if not detail:
+            return
+        cur = {
+            "buckets": {le: float(c) for le, c in detail.get("buckets", [])},
+            "count": float(detail.get("count", 0.0)),
+        }
+        key = (model, hist, endpoint)
+        prev = self._last.get(key)
+        if prev is None or self._reset(prev, cur):
+            delta = cur
+        else:
+            delta = {
+                "buckets": {
+                    le: max(0.0, c - prev["buckets"].get(le, 0.0))
+                    for le, c in cur["buckets"].items()
+                },
+                "count": max(0.0, cur["count"] - prev["count"]),
+            }
+        self._last[key] = cur
+        tot = self.totals.setdefault(
+            (model, hist), {"buckets": {}, "count": 0.0}
+        )
+        for le, c in delta["buckets"].items():
+            tot["buckets"][le] = tot["buckets"].get(le, 0.0) + c
+        tot["count"] += delta["count"]
+
+    @staticmethod
+    def _reset(prev: dict, cur: dict) -> bool:
+        if cur["count"] < prev["count"]:
+            return True
+        return any(
+            cur["buckets"].get(le, 0.0) < c
+            for le, c in prev["buckets"].items()
+        )
+
+    def forget_endpoint(self, model: str, endpoint: str) -> None:
+        for hist in ("ttft", "itl"):
+            self._last.pop((model, hist, endpoint), None)
+
+    def model_total(self, model: str, hist: str) -> tuple[list, float]:
+        """(sorted cumulative [(bound, cum)], total) of everything
+        absorbed for the model so far."""
+        tot = self.totals.get((model, hist))
+        if not tot:
+            return [], 0.0
+        buckets = sorted(
+            (float(le), c) for le, c in tot["buckets"].items()
+        )
+        return buckets, tot["count"]
+
+
+class SLOEvaluator:
+    """Judges every model's objectives each tick; owns the burn-rate
+    state machine, the exact budget ledger, and the alert trail.
+
+    `clock` is injectable (FakeClock in sims and tests); the evaluator
+    never reads the wall directly. `min_telemetry_coverage` is the
+    governor's threshold — the SLO plane refuses to judge what the
+    governor would refuse to act on."""
+
+    def __init__(
+        self,
+        cfg,
+        aggregator,
+        model_client,
+        metrics: Metrics = DEFAULT_METRICS,
+        recorder: flightrecorder.FlightRecorder | None = None,
+        min_telemetry_coverage: float = 0.0,
+        interval_s: float = 10.0,
+        clock=time.time,
+    ):
+        self.cfg = cfg
+        self.aggregator = aggregator
+        self.model_client = model_client
+        self.metrics = metrics
+        self.recorder = recorder
+        self.min_telemetry_coverage = float(min_telemetry_coverage)
+        self.interval_s = (
+            cfg.interval_seconds if cfg.interval_seconds > 0 else interval_s
+        )
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._accum = _HistAccumulator()
+        # (model, objective) -> deque[(ts, total_cum:int, bad_cum:int)]
+        # cumulative from evaluator start; the implicit epoch sample is
+        # (start, 0, 0), so a window with no baseline uses zeros.
+        self._samples: dict[tuple, deque] = {}
+        self._alert_state: dict[tuple, int] = {}
+        self._coverage_refusals: dict[str, int] = {}
+        # Counter baselines (the bundle counters predate the evaluator).
+        self._counter_base: dict[tuple, float] = {}
+        self._last_eval: dict = {}
+        self._prev_series: dict[str, tuple] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — next tick retries
+                logger.warning("slo evaluation failed: %s", e)
+
+    # -- SLI extraction ------------------------------------------------------
+
+    def _counter_sum(self, counter, model: str, bad_only=None) -> float:
+        total = 0.0
+        for labels, value in counter.samples():
+            if labels.get("model") != model:
+                continue
+            if bad_only is not None and not bad_only(labels):
+                continue
+            total += value
+        return total
+
+    def _rebased(self, key: tuple, value: float) -> float:
+        """Counter value relative to the evaluator's first sight of it."""
+        base = self._counter_base.setdefault(key, value)
+        return max(0.0, value - base)
+
+    def _sli_totals(self, model: str, obj: Objective,
+                    entry: dict) -> tuple[int, int]:
+        """Cumulative (total, bad) for one objective since evaluator
+        start — integer event counts, the ledger's raw material."""
+        m = self.metrics
+        if obj.kind in (OBJ_TTFT_P95, OBJ_ITL_P99):
+            hist = "ttft" if obj.kind == OBJ_TTFT_P95 else "itl"
+            buckets, total = self._accum.model_total(model, hist)
+            bad = count_over_threshold(buckets, total, obj.threshold)
+            return int(round(total)), int(round(bad))
+        if obj.kind == OBJ_AVAILABILITY:
+            total = self._rebased(
+                (model, "requests"),
+                self._counter_sum(m.inference_requests_total, model),
+            )
+            bad = self._rebased(
+                (model, "failures"),
+                self._counter_sum(m.proxy_stream_resume_failures, model)
+                + self._counter_sum(m.proxy_deadline_exhausted, model),
+            )
+            return int(round(total)), int(round(min(bad, total)))
+        # OBJ_SHED_RATE: everything that knocked on the door vs refusals.
+        admitted = self._rebased(
+            (model, "admitted"),
+            self._counter_sum(m.door_admitted, model),
+        )
+        shed = self._rebased(
+            (model, "shed"),
+            self._counter_sum(m.door_rejections, model),
+        )
+        return int(round(admitted + shed)), int(round(shed))
+
+    def _absorb_snapshot(self, snap: dict) -> None:
+        """Fold every fresh endpoint's latency buckets into the monotone
+        per-model accumulators."""
+        for model, entry in snap.get("models", {}).items():
+            for addr, ep in entry.get("endpoints", {}).items():
+                if ep.get("stale"):
+                    continue
+                self._accum.absorb(model, "ttft", addr, ep.get("ttft_hist"))
+                self._accum.absorb(model, "itl", addr, ep.get("itl_hist"))
+
+    # -- burn-rate windows ---------------------------------------------------
+
+    def _window_counts(self, ring, now: float,
+                       window_s: float) -> tuple[int, int]:
+        """(total, bad) events inside the window ending now. Baseline =
+        the newest sample at or before the window start (zeros when the
+        evaluator is younger than the window — the window is then
+        effectively 'since start', the standard cold-start behavior)."""
+        if not ring:
+            return 0, 0
+        cur_ts, cur_total, cur_bad = ring[-1]
+        base_total = base_bad = 0
+        start = now - window_s
+        for ts, total, bad in ring:
+            if ts <= start:
+                base_total, base_bad = total, bad
+            else:
+                break
+        return cur_total - base_total, cur_bad - base_bad
+
+    def _burn(self, ring, now: float, window_s: float,
+              allowed: Fraction) -> float:
+        total, bad = self._window_counts(ring, now, window_s)
+        if total <= 0 or allowed <= 0:
+            return 0.0
+        return float(Fraction(bad, total) / allowed)
+
+    def _ledger(self, ring, now: float, allowed: Fraction) -> dict:
+        """The exact error-budget ledger over the budget window:
+        integer counts in, Fractions out. `remaining` and
+        `remaining_frac` are exact strings alongside the float gauges —
+        the decision record states arithmetic, not an estimate."""
+        total, bad = self._window_counts(
+            ring, now, self.cfg.budget_window_seconds
+        )
+        if total <= 0:
+            return {
+                "window_s": self.cfg.budget_window_seconds,
+                "total": 0, "bad": 0, "allowed": str(allowed),
+                "budget": "0", "remaining": "0", "remaining_frac": 1.0,
+                "remaining_frac_exact": "1", "exhausted": False,
+            }
+        budget = allowed * total
+        remaining = budget - bad
+        remaining_frac = (
+            remaining / budget if budget > 0 else Fraction(0)
+        )
+        return {
+            "window_s": self.cfg.budget_window_seconds,
+            "total": total,
+            "bad": bad,
+            "allowed": str(allowed),
+            "budget": str(budget),
+            "remaining": str(remaining),
+            "remaining_frac": float(remaining_frac),
+            "remaining_frac_exact": str(remaining_frac),
+            "exhausted": remaining < 0,
+        }
+
+    # -- one tick ------------------------------------------------------------
+
+    def tick(self) -> dict:
+        now = self._clock()
+        cfg = self.cfg
+        snap = self.aggregator.snapshot()
+        results: dict = {"ts": now, "models": {}, "skipped": {}}
+        models = self.model_client.list_all_models()
+        snap_fresh = (
+            snap is not None
+            and now - snap["ts"] <= self.aggregator.staleness_s
+        )
+        if snap_fresh:
+            self._absorb_snapshot(snap)
+        for model in models:
+            objectives = resolve_objectives(model, cfg)
+            if not objectives:
+                continue
+            name = model.name
+            coverage, fresh = self.aggregator.model_coverage(name)
+            if not fresh or not snap_fresh:
+                self.metrics.slo_skipped_ticks.inc(model=name, reason="stale")
+                results["skipped"][name] = "stale"
+                continue
+            if (
+                self.min_telemetry_coverage > 0
+                and coverage is not None
+                and coverage < self.min_telemetry_coverage
+            ):
+                self.metrics.slo_skipped_ticks.inc(
+                    model=name, reason="coverage"
+                )
+                results["skipped"][name] = "coverage"
+                n = self._coverage_refusals.get(name, 0) + 1
+                self._coverage_refusals[name] = n
+                if n == COVERAGE_COLLAPSE_TICKS and self.recorder:
+                    self.recorder.trigger(
+                        flightrecorder.TRIGGER_COVERAGE_COLLAPSE,
+                        detail=(
+                            f"model {name} telemetry coverage "
+                            f"{coverage:.2f} < "
+                            f"{self.min_telemetry_coverage:.2f} for "
+                            f"{n} ticks"
+                        ),
+                    )
+                continue
+            self._coverage_refusals[name] = 0
+            entry = snap["models"].get(name, {})
+            results["models"][name] = self._judge_model(
+                model, name, objectives, entry, now
+            )
+        self.metrics.slo_evaluations.inc()
+        with self._lock:
+            self._last_eval = results
+        self._publish_gauges(results)
+        if self.recorder is not None:
+            self.recorder.capture_metrics(self.metrics.registry)
+            for name in results["models"]:
+                ex = self.metrics.request_ttft.exemplars(model=name)
+                if ex:
+                    self.recorder.note_exemplars(f"door_ttft/{name}", ex)
+        return results
+
+    def _judge_model(self, model, name: str, objectives, entry: dict,
+                     now: float) -> dict:
+        cfg = self.cfg
+        cls = model_scheduling_class(model)
+        out = {"class": cls, "objectives": {}}
+        for obj in objectives:
+            key = (name, obj.kind)
+            ring = self._samples.setdefault(key, deque())
+            total, bad = self._sli_totals(name, obj, entry)
+            ring.append((now, total, bad))
+            # Prune: keep one baseline sample older than the budget
+            # window so _window_counts always finds its anchor.
+            horizon = now - cfg.budget_window_seconds
+            while len(ring) > 2 and ring[1][0] <= horizon:
+                ring.popleft()
+            burn_short = self._burn(
+                ring, now, cfg.fast_burn_short_window_seconds, obj.allowed
+            )
+            burn_fast = self._burn(
+                ring, now, cfg.fast_burn_window_seconds, obj.allowed
+            )
+            burn_slow = self._burn(
+                ring, now, cfg.slow_burn_window_seconds, obj.allowed
+            )
+            if (
+                burn_short >= cfg.fast_burn_threshold
+                and burn_fast >= cfg.fast_burn_threshold
+            ):
+                state = STATE_FAST_BURN
+            elif burn_slow >= cfg.slow_burn_threshold:
+                state = STATE_SLOW_BURN
+            else:
+                state = STATE_OK
+            prev_state = self._alert_state.get(key, STATE_OK)
+            self._alert_state[key] = state
+            ledger = self._ledger(ring, now, obj.allowed)
+            record = {
+                "ts": now,
+                "model": name,
+                "class": cls,
+                "objective": obj.kind,
+                **obj.describe(),
+                "total": total,
+                "bad": bad,
+                "burn": {
+                    "short": round(burn_short, 6),
+                    "fast": round(burn_fast, 6),
+                    "slow": round(burn_slow, 6),
+                },
+                "thresholds": {
+                    "fast": cfg.fast_burn_threshold,
+                    "slow": cfg.slow_burn_threshold,
+                },
+                "budget": ledger,
+                "state": STATE_NAMES[state],
+                "prev_state": STATE_NAMES[prev_state],
+            }
+            alert_log.info(json.dumps(record, sort_keys=True))
+            out["objectives"][obj.kind] = record
+            if state != prev_state:
+                self._on_transition(name, obj, prev_state, state, record)
+        return out
+
+    def _on_transition(self, name: str, obj: Objective, prev: int,
+                       state: int, record: dict) -> None:
+        if state == STATE_FAST_BURN:
+            self.metrics.slo_alerts.inc(
+                model=name, objective=obj.kind, severity="fast"
+            )
+        elif state == STATE_SLOW_BURN and prev < STATE_SLOW_BURN:
+            self.metrics.slo_alerts.inc(
+                model=name, objective=obj.kind, severity="slow"
+            )
+        if self.recorder is None:
+            return
+        self.recorder.record(
+            flightrecorder.SLO_ALERT, "slo", target=name,
+            objective=obj.kind,
+            state=STATE_NAMES[state], prev_state=STATE_NAMES[prev],
+            burn=record["burn"],
+        )
+        if state == STATE_FAST_BURN:
+            # The page IS the incident: dump the bundle while the rings
+            # still hold the decisions that led here.
+            self.recorder.trigger(
+                flightrecorder.TRIGGER_FAST_BURN,
+                detail=(
+                    f"{name}/{obj.kind} fast burn "
+                    f"(short={record['burn']['short']}, "
+                    f"fast={record['burn']['fast']})"
+                ),
+            )
+
+    # -- gauges (with label-churn hygiene) ----------------------------------
+
+    def _publish_gauges(self, results: dict) -> None:
+        m = self.metrics
+        new_series: dict[str, tuple] = {}
+
+        def set_(gauge, value, **labels):
+            gauge.set(value, **labels)
+            new_series.setdefault(gauge.name, (gauge, set()))[1].add(
+                tuple(sorted(labels.items()))
+            )
+
+        for name, entry in results["models"].items():
+            for kind, rec in entry["objectives"].items():
+                for window, value in rec["burn"].items():
+                    set_(
+                        m.slo_burn_rate, value,
+                        model=name, objective=kind, window=window,
+                    )
+                set_(
+                    m.slo_error_budget_remaining,
+                    rec["budget"]["remaining_frac"],
+                    model=name, objective=kind,
+                )
+                state_value = {
+                    v: k for k, v in STATE_NAMES.items()
+                }[rec["state"]]
+                set_(
+                    m.slo_alert_state, state_value,
+                    model=name, objective=kind,
+                )
+                key = (name, kind)
+                prev_counts = getattr(self, "_prev_counts", {})
+                p_total, p_bad = prev_counts.get(key, (0, 0))
+                if rec["total"] >= p_total:
+                    m.slo_events.inc(
+                        rec["total"] - p_total, model=name, objective=kind
+                    )
+                if rec["bad"] >= p_bad:
+                    m.slo_bad_events.inc(
+                        rec["bad"] - p_bad, model=name, objective=kind
+                    )
+                prev_counts[key] = (rec["total"], rec["bad"])
+                self._prev_counts = prev_counts
+        for gname, (gauge, keys) in self._prev_series.items():
+            current = new_series.get(gname, (gauge, set()))[1]
+            for k in keys - current:
+                gauge.remove(**dict(k))
+        self._prev_series = new_series
+
+    # -- consumer API --------------------------------------------------------
+
+    def pressure(self, model: str) -> dict | None:
+        """The control loops' read: the model's worst alert state and
+        which objective drove it, or None when the model was not judged
+        (no objectives, skipped tick, or no tick yet)."""
+        with self._lock:
+            entry = (self._last_eval.get("models") or {}).get(model)
+        if entry is None:
+            return None
+        worst = STATE_OK
+        driver = None
+        for kind, rec in entry["objectives"].items():
+            value = {v: k for k, v in STATE_NAMES.items()}[rec["state"]]
+            if value > worst:
+                worst, driver = value, kind
+        return {
+            "state": STATE_NAMES[worst],
+            "level": worst,
+            "objective": driver,
+        }
+
+    def state_payload(self) -> dict:
+        """`GET /v1/slo`: the latest evaluation plus the flight
+        recorder's incident index."""
+        with self._lock:
+            last = dict(self._last_eval)
+        payload = {"object": "slo.state", "interval_s": self.interval_s}
+        payload.update(last)
+        if self.recorder is not None:
+            payload["flight_recorder"] = self.recorder.state_payload()
+        return payload

@@ -52,6 +52,7 @@ import threading
 import time
 
 from kubeai_tpu.fleet.metering import ANONYMOUS_TENANT, tenant_of
+from kubeai_tpu.metrics import flightrecorder
 from kubeai_tpu.metrics.registry import DEFAULT_METRICS, Metrics
 from kubeai_tpu.utils import retryafter
 
@@ -165,6 +166,11 @@ class TenantGovernor:
         self._clock = clock
         self._pressure_fn = pressure_fn
         self._pressure_ttl = pressure_ttl_s
+        # Flight recorder (metrics.flightrecorder.FlightRecorder), wired
+        # by the manager when the SLO plane is on: every refusal lands
+        # in the door ring so an incident bundle shows WHO was turned
+        # away in the minutes before a page, not just how many.
+        self.recorder = None
         self._lock = threading.Lock()
         # (tenant, model) -> {"req": bucket|None, "tok": bucket|None,
         #                     "seen": ts}
@@ -269,6 +275,17 @@ class TenantGovernor:
                     (mlabel, refusal.reason)
                 )
             self.metrics.door_retry_after.observe(refusal.retry_after_s)
+            if self.recorder is not None:
+                kind = (
+                    flightrecorder.DOOR_QUOTA
+                    if refusal.reason == REASON_QUOTA
+                    else flightrecorder.DOOR_SHED
+                )
+                self.recorder.record(
+                    kind, "door", target=mlabel, tenant=label,
+                    reason=refusal.reason, cls=cls,
+                    retry_after_s=round(refusal.retry_after_s, 3),
+                )
         self._maybe_cleanup(now)
         return refusal
 

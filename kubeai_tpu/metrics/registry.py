@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import math
 import re
 import threading
 from collections import defaultdict
@@ -53,6 +54,18 @@ class _Metric:
     def get(self, **labels) -> float:
         with self._lock:
             return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def samples(self) -> list[tuple[dict, float]]:
+        """Every (labels, value) series of this instrument, sorted by
+        label key — the read path for consumers that aggregate across
+        label sets (the SLO evaluator sums rejections over tenants and
+        reasons). Histograms don't populate scalar values; use their
+        get()/sum_for() instead."""
+        with self._lock:
+            return [
+                (dict(self._label_keys[k]), v)
+                for k, v in sorted(self._values.items())
+            ]
 
     def remove(self, **labels) -> None:
         """Drop one label-set's series (endpoint churn would otherwise
@@ -142,8 +155,15 @@ class Histogram(_Metric):
         self._bucket_counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = defaultdict(float)
         self._counts: dict[tuple, int] = defaultdict(int)
+        # Last exemplar (trace/request id) per bucket per label set —
+        # index len(buckets) is the +Inf overflow bucket. Deliberately
+        # NOT emitted in the 0.0.4 text exposition (parsers here and in
+        # the fleet would choke on OpenMetrics `# {...}` suffixes);
+        # consumers read them via exemplars() / the admin state payloads.
+        self._exemplars: dict[tuple, dict[int, str]] = {}
 
-    def observe(self, value: float, **labels) -> None:
+    def observe(self, value: float, exemplar: str | None = None,
+                **labels) -> None:
         with self._lock:
             k = self._key(labels)
             if k not in self._bucket_counts:
@@ -152,12 +172,30 @@ class Histogram(_Metric):
             # that fits increments; collect() produces the cumulative
             # `le` series. Incrementing every bucket >= value here would
             # double-cumulate at collect time.
+            idx = len(self.buckets)
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     self._bucket_counts[k][i] += 1
+                    idx = i
                     break
             self._sums[k] += value
             self._counts[k] += 1
+            if exemplar:
+                self._exemplars.setdefault(k, {})[idx] = str(exemplar)
+
+    def exemplars(self, **labels) -> dict[str, str]:
+        """Last exemplar per bucket for the label set, keyed by the
+        bucket's canonical `le` string (`+Inf` for the overflow bucket)."""
+        with self._lock:
+            per_idx = self._exemplars.get(tuple(sorted(labels.items())), {})
+            out: dict[str, str] = {}
+            for idx, ex in sorted(per_idx.items()):
+                bound = (
+                    "+Inf" if idx >= len(self.buckets)
+                    else _fmt_le(self.buckets[idx])
+                )
+                out[bound] = ex
+            return out
 
     def get(self, **labels) -> float:
         """Observation COUNT for the label set (the scalar `_Metric.get`
@@ -176,6 +214,7 @@ class Histogram(_Metric):
             self._bucket_counts.pop(k, None)
             self._sums.pop(k, None)
             self._counts.pop(k, None)
+            self._exemplars.pop(k, None)
 
     def sum_for(self, **labels) -> float:
         """Sum of observed values for the label set."""
@@ -260,6 +299,90 @@ def lint_registry(registry: Registry) -> list[str]:
             if not m.name.endswith("_total"):
                 errors.append(f"{m.name}: counter must end in _total")
     return errors
+
+
+# -- shared bucket-quantile estimator ---------------------------------------
+# One estimator for every consumer of cumulative histogram buckets: the
+# fleet aggregator's per-endpoint TTFT/ITL quantiles and the SLO
+# evaluator's burn-rate math both read scraped `le` series, and they must
+# agree on what "p95" means or an SLO breach and the signal that scaled
+# for it would disagree about the same data.
+
+
+def hist_buckets(
+    parsed: dict, name: str
+) -> tuple[list[tuple[float, float]], float, float]:
+    """Extract one histogram's cumulative buckets from a parsed scrape:
+    (sorted [(upper_bound, cumulative_count)], total_count, total_sum).
+    Labels beyond `le` are ignored (one endpoint exposes one series per
+    histogram); unparseable `le` values are skipped."""
+    buckets: list[tuple[float, float]] = []
+    total = 0.0
+    total_sum = 0.0
+    for (metric, labels), value in parsed.items():
+        if metric == f"{name}_bucket":
+            le = dict(labels).get("le", "")
+            try:
+                bound = float(le)
+            except ValueError:
+                continue
+            buckets.append((bound, value))
+        elif metric == f"{name}_count":
+            total = value
+        elif metric == f"{name}_sum":
+            total_sum = value
+    buckets.sort(key=lambda b: b[0])
+    return buckets, total, total_sum
+
+
+def quantiles_from_buckets(
+    buckets: list[tuple[float, float]],
+    total: float,
+    total_sum: float,
+    qs: tuple[float, ...] = (0.5, 0.95, 0.99),
+) -> dict:
+    """Approximate quantiles from cumulative histogram buckets (each
+    quantile reports its bucket's upper bound — the standard
+    Prometheus-side estimate). `buckets` must be sorted ascending by
+    bound. Returns {} when the histogram has no observations or no
+    buckets; a quantile landing in the +Inf bucket reports the largest
+    finite bound (a meaningless +Inf estimate helps nobody), or +Inf
+    when the histogram is a single +Inf bucket."""
+    if total <= 0 or not buckets:
+        return {}
+    out = {
+        "count": total,
+        "mean_s": round(total_sum / total, 9),
+    }
+    for q in qs:
+        target = q * total
+        est = buckets[-1][0]
+        for bound, cum in buckets:
+            if cum >= target:
+                est = bound
+                break
+        if math.isinf(est):
+            finite = [b for b, _ in buckets if not math.isinf(b)]
+            est = finite[-1] if finite else float("inf")
+        out[f"p{int(q * 100)}_s"] = est
+    return out
+
+
+def count_over_threshold(
+    buckets: list[tuple[float, float]], total: float, threshold: float
+) -> float:
+    """Observations strictly above `threshold`, from cumulative buckets.
+    Conservative toward the service: observations in the bucket that
+    CONTAINS the threshold count as good (they may be below it), so the
+    bound used is the smallest bucket bound >= threshold. A threshold
+    past every finite bound yields 0 — the buckets cannot distinguish
+    violations up there, and guessing badness would page on rounding."""
+    if total <= 0 or not buckets:
+        return 0.0
+    for bound, cum in buckets:
+        if bound >= threshold:
+            return max(0.0, total - cum)
+    return 0.0
 
 
 # Request-latency buckets: sub-ms (cache hits, tiny models) through the
@@ -604,6 +727,65 @@ class Metrics:
             "kubeai_fleet_snapshot_timestamp_seconds",
             "Unix timestamp of the latest fleet snapshot (scrape-side "
             "age = now - this).",
+            self.registry,
+        )
+        self.fleet_endpoint_staleness = Gauge(
+            "kubeai_fleet_endpoint_staleness_seconds",
+            "Age of each endpoint's last successful telemetry scrape at "
+            "the last sweep, per model and endpoint (never-scraped "
+            "endpoints export no series — absence is not zero age).",
+            self.registry,
+        )
+        # -- SLO plane (kubeai_tpu/fleet/slo) --------------------------------
+        self.slo_evaluations = Counter(
+            "kubeai_slo_evaluations_total",
+            "Completed SLO evaluation ticks (a fresh fleet snapshot was "
+            "judged against every configured objective).",
+            self.registry,
+        )
+        self.slo_skipped_ticks = Counter(
+            "kubeai_slo_skipped_ticks_total",
+            "SLO evaluation ticks refused per model and reason "
+            "(coverage = telemetry coverage below the governor's "
+            "minTelemetryCoverage, stale = no fresh fleet snapshot) — a "
+            "refused tick judges nothing rather than judging blind.",
+            self.registry,
+        )
+        self.slo_burn_rate = Gauge(
+            "kubeai_slo_burn_rate",
+            "Error-budget burn rate per model, objective, and window "
+            "(1.0 = burning exactly the budget the objective allows).",
+            self.registry,
+        )
+        self.slo_error_budget_remaining = Gauge(
+            "kubeai_slo_error_budget_remaining",
+            "Fraction of the rolling error budget still unspent per "
+            "model and objective (exact ledger arithmetic; negative = "
+            "budget exhausted).",
+            self.registry,
+        )
+        self.slo_alert_state = Gauge(
+            "kubeai_slo_alert_state",
+            "Burn-rate alert state per model and objective: 0 ok, "
+            "1 slow burn (warn), 2 fast burn (page).",
+            self.registry,
+        )
+        self.slo_alerts = Counter(
+            "kubeai_slo_alerts_total",
+            "Burn-rate alert transitions fired per model, objective, and "
+            "severity (slow|fast) — increments on entry, not per tick.",
+            self.registry,
+        )
+        self.slo_events = Counter(
+            "kubeai_slo_events_total",
+            "SLI events judged per model and objective (the ledger's "
+            "denominator).",
+            self.registry,
+        )
+        self.slo_bad_events = Counter(
+            "kubeai_slo_bad_events_total",
+            "SLI events that violated the objective per model and "
+            "objective (the ledger's numerator).",
             self.registry,
         )
         # -- cluster capacity planner (kubeai_tpu/fleet/planner) ------------

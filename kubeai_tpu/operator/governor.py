@@ -47,6 +47,7 @@ import time
 from collections import deque
 
 from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.metrics import flightrecorder
 from kubeai_tpu.metrics.registry import DEFAULT_METRICS, Metrics
 from kubeai_tpu.operator.k8s.store import NotFound
 
@@ -100,6 +101,9 @@ class ActuationGovernor:
         self.namespace = namespace
         self.metrics = metrics
         self._clock = clock
+        # Flight recorder (wired by the manager): every denial is a
+        # discrete decision worth replaying in an incident bundle.
+        self.recorder = None
         self._lock = threading.Lock()
         # Sliding window of budgeted disruptions: (clock time, model).
         self._window: deque[tuple[float, str]] = deque()
@@ -194,6 +198,11 @@ class ActuationGovernor:
         self.metrics.governor_denied.inc(
             action=action, model=model, reason=reason
         )
+        if self.recorder is not None:
+            self.recorder.record(
+                flightrecorder.GOVERNOR_DENY, "governor", target=model,
+                action=action, reason=reason,
+            )
         logger.warning(
             "governor denied %s for model %s: %s", action, model, reason
         )

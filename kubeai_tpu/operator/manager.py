@@ -205,6 +205,44 @@ class Manager:
                 model_client=self.model_client,
                 metrics=self.metrics,
             )
+        # SLO plane (kubeai_tpu/fleet/slo) + always-on flight recorder
+        # (kubeai_tpu/metrics/flightrecorder): only constructed when
+        # `slo.enabled` — disabled leaves every subsystem's `recorder`
+        # attribute None and the hot paths untouched.
+        self.slo = None
+        self.recorder = None
+        if self.cfg.slo.enabled:
+            from kubeai_tpu.fleet.slo import SLOEvaluator
+            from kubeai_tpu.metrics.flightrecorder import FlightRecorder
+
+            self.recorder = FlightRecorder(
+                sink_dir=self.cfg.slo.incident_dir or None,
+                min_trigger_interval_s=(
+                    self.cfg.slo.min_incident_interval_seconds
+                ),
+            )
+            self.slo = SLOEvaluator(
+                cfg=self.cfg.slo,
+                aggregator=self.fleet,
+                model_client=self.model_client,
+                metrics=self.metrics,
+                recorder=self.recorder,
+                min_telemetry_coverage=(
+                    self.cfg.governor.min_telemetry_coverage
+                    if self.cfg.governor.enabled else 0.0
+                ),
+                interval_s=self.cfg.model_autoscaling.interval_seconds,
+            )
+            # Burn-rate state biases both control loops; decision rings
+            # land in every subsystem that makes discrete refusals.
+            self.autoscaler.slo = self.slo
+            self.governor.recorder = self.recorder
+            self.lb.set_recorder(self.recorder)
+            if self.planner is not None:
+                self.planner.slo = self.slo
+                self.planner.recorder = self.recorder
+            if self.tenancy is not None:
+                self.tenancy.recorder = self.recorder
         self.api_server = OpenAIServer(
             self.proxy,
             self.model_client,
@@ -216,6 +254,7 @@ class Manager:
             planner=self.planner,
             governor=self.tenancy,
         )
+        self.api_server.slo = self.slo
         self.messengers: list[Messenger] = []
         # One broker per stream, chosen by URL scheme (gcppubsub://,
         # nats://, plain names = in-memory) — the reference registers the
@@ -277,6 +316,10 @@ class Manager:
         self.fleet.start()
         if self.planner is not None:
             self.planner.start()
+        if self.slo is not None:
+            # After the aggregator (it judges from snapshots), before
+            # the autoscaler (whose first tick may read its pressure).
+            self.slo.start()
         self.autoscaler.start()
         self.api_server.start()
         for m in self.messengers:
@@ -337,6 +380,8 @@ class Manager:
                 pass
         self.api_server.stop()
         self.autoscaler.stop()
+        if self.slo is not None:
+            self.slo.stop()
         if self.planner is not None:
             self.planner.stop()
         self.fleet.stop()

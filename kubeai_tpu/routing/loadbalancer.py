@@ -36,6 +36,7 @@ from kubeai_tpu.crd.model import (
 from kubeai_tpu.operator import k8sutils
 from kubeai_tpu.operator.k8s.store import KubeStore
 from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
+from kubeai_tpu.metrics import flightrecorder
 from kubeai_tpu.routing.chwbl import make_ring
 from kubeai_tpu.routing.health import (
     STATE_CLOSED,
@@ -138,6 +139,11 @@ class Group:
         # flight: their done() callbacks must keep draining the group
         # totals, and the snapshot must show them until they empty.
         self._retired: dict[int, _Endpoint] = {}
+        # Flight recorder + last state it saw per endpoint, so only
+        # genuine breaker TRANSITIONS land in the ring (the sync runs
+        # on every done(), transitions are rare).
+        self.recorder = None
+        self._breaker_states: dict[str, str] = {}
 
     def set_breaker_policy(self, policy: BreakerPolicy) -> None:
         with self._cond:
@@ -292,6 +298,19 @@ class Group:
                     if not avail:
                         # Fail fast: blocking would just burn the whole
                         # scale-from-zero budget against dead replicas.
+                        if self.recorder is not None:
+                            self.recorder.record(
+                                flightrecorder.LB_NO_ENDPOINTS, "lb",
+                                target=self.model,
+                                endpoints=len(eps),
+                            )
+                            self.recorder.trigger(
+                                flightrecorder.TRIGGER_ALL_CIRCUITS_OPEN,
+                                detail=(
+                                    f"model {self.model}: all "
+                                    f"{len(eps)} circuits open"
+                                ),
+                            )
                         raise NoHealthyEndpoints(
                             self.model,
                             {
@@ -375,6 +394,16 @@ class Group:
             _STATE_VALUE[ep.health.state],
             model=self.model, endpoint=ep.address,
         )
+        if self.recorder is not None:
+            prev = self._breaker_states.get(ep.address, STATE_CLOSED)
+            if ep.health.state != prev:
+                self.recorder.record(
+                    flightrecorder.BREAKER, "lb", target=ep.address,
+                    model=self.model, from_state=prev,
+                    to_state=ep.health.state,
+                    last_error=ep.health.last_error,
+                )
+        self._breaker_states[ep.address] = ep.health.state
         ejections = self.metrics.lb_circuit_ejections
         recorded = ejections.get(model=self.model, endpoint=ep.address)
         if ep.health.ejections > recorded:
@@ -395,6 +424,7 @@ class Group:
         self.metrics.lb_circuit_ejections.remove(
             model=self.model, endpoint=addr
         )
+        self._breaker_states.pop(addr, None)
 
     def snapshot(self) -> dict:
         """Breaker + in-flight state for the LB state snapshot."""
@@ -493,6 +523,7 @@ class LoadBalancer:
         self.default_timeout = default_timeout
         self.metrics = metrics
         self.default_breaker = default_breaker or BreakerPolicy()
+        self.recorder = None
         self._lock = threading.Lock()
         self._groups: dict[str, Group] = {}
         self._self_ips: list[str] = []
@@ -501,6 +532,14 @@ class LoadBalancer:
         self._events = store.watch(("Pod",))
 
     # -- lifecycle ------------------------------------------------------------
+
+    def set_recorder(self, recorder) -> None:
+        """Wire the flight recorder into every group, existing and
+        future (the manager constructs the recorder after the LB)."""
+        with self._lock:
+            self.recorder = recorder
+            for group in self._groups.values():
+                group.recorder = recorder
 
     def start(self) -> None:
         self.sync_all()
@@ -606,11 +645,13 @@ class LoadBalancer:
     def group(self, model: str) -> Group:
         with self._lock:
             if model not in self._groups:
-                self._groups[model] = Group(
+                group = Group(
                     metrics=self.metrics,
                     model=model,
                     breaker=self.default_breaker,
                 )
+                group.recorder = self.recorder
+                self._groups[model] = group
             return self._groups[model]
 
     def set_breaker_policy(self, model: str, policy: BreakerPolicy) -> None:

@@ -116,6 +116,10 @@ class OpenAIServer:
         self.usage = usage
         self.planner = planner
         self.governor = governor
+        # SLO evaluator (kubeai_tpu/fleet/slo): backs GET /v1/slo with
+        # the latest per-objective burn/budget verdicts and the flight
+        # recorder's incident index. Wired by the manager when enabled.
+        self.slo = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -182,6 +186,16 @@ class OpenAIServer:
                         )
                     return self._respond_json(
                         200, outer.planner.plan_payload()
+                    )
+                if path in ("/v1/slo", "/openai/v1/slo"):
+                    if outer.slo is None:
+                        return self._respond_json(
+                            404,
+                            {"error": {"message":
+                                       "slo plane not configured"}},
+                        )
+                    return self._respond_json(
+                        200, outer.slo.state_payload()
                     )
                 if path in ("/v1/usage", "/openai/v1/usage"):
                     if outer.usage is None:
@@ -340,7 +354,7 @@ class OpenAIServer:
                     duration = time.monotonic() - t0
                     span.set_attribute("http.duration_s", duration)
                     outer.metrics.request_duration.observe(
-                        duration, model=model
+                        duration, model=model, exemplar=request_id
                     )
                     _meter(duration)
                     access_log.info(
@@ -360,7 +374,8 @@ class OpenAIServer:
                                 ttft = time.monotonic() - t0
                                 span.set_attribute("http.ttft_s", ttft)
                                 outer.metrics.request_ttft.observe(
-                                    ttft, model=model
+                                    ttft, model=model,
+                                    exemplar=request_id,
                                 )
                             if sse_acc is not None:
                                 sse_acc.feed(chunk)

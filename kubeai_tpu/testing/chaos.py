@@ -68,6 +68,56 @@ EVENT_KINDS = (
     EV_LINK_DROP,
 )
 
+# ---- shared incident/flight schema -------------------------------------------
+# The flight recorder (kubeai_tpu/metrics/flightrecorder.py) embeds
+# bounded decision-event rings in the live subsystems and dumps them as
+# GameDayLog-format JSONL incident bundles. This block is the ONE schema
+# both sides speak: the recorder may only emit record kinds and flight
+# event kinds declared here, so `gameday_sim --replay` never meets a
+# record it silently drops. scripts/check_incident_schema.py gates the
+# subset relation in tier-1.
+
+# Every `record` field value a GameDayLog-format JSONL line may carry.
+LOG_RECORD_KINDS = (
+    "event",        # a chaos-trace event applied (game-day runs)
+    "obs",          # a per-tick observation
+    "violation",    # an invariant / SLO violation
+    "flight",       # a flight-recorder decision event
+    "span",         # a recent span snapshotted into an incident bundle
+    "metric_delta", # a metric series' movement across the capture window
+    "exemplar",     # last trace-id exemplars of a latency histogram
+)
+
+# The decision-event vocabulary the replay side understands. The flight
+# recorder's own accepted kinds must stay a subset of this tuple.
+FLIGHT_DOOR_SHED = "door_shed"              # door refusal (rate/overload)
+FLIGHT_DOOR_QUOTA = "door_quota"            # door refusal (token quota)
+FLIGHT_BREAKER = "breaker_transition"       # circuit state change
+FLIGHT_LB_NO_ENDPOINTS = "lb_no_healthy_endpoints"
+FLIGHT_GOVERNOR_DENY = "governor_denial"    # actuation refused
+FLIGHT_SCHED_ADMIT = "scheduler_admit"      # engine queue admission
+FLIGHT_SCHED_SHED = "scheduler_shed"        # deadline-infeasible refusal
+FLIGHT_SCHED_PREEMPT = "scheduler_preempt"  # running request preempted
+FLIGHT_PLANNER_PREEMPT = "planner_preempt_mark"
+FLIGHT_WATCHDOG = "engine_watchdog"         # wedged-step detection
+FLIGHT_STEP_ANOMALY = "engine_step_anomaly"
+FLIGHT_SLO_ALERT = "slo_alert"              # burn-rate state transition
+
+FLIGHT_EVENT_KINDS = (
+    FLIGHT_DOOR_SHED,
+    FLIGHT_DOOR_QUOTA,
+    FLIGHT_BREAKER,
+    FLIGHT_LB_NO_ENDPOINTS,
+    FLIGHT_GOVERNOR_DENY,
+    FLIGHT_SCHED_ADMIT,
+    FLIGHT_SCHED_SHED,
+    FLIGHT_SCHED_PREEMPT,
+    FLIGHT_PLANNER_PREEMPT,
+    FLIGHT_WATCHDOG,
+    FLIGHT_STEP_ANOMALY,
+    FLIGHT_SLO_ALERT,
+)
+
 
 @dataclasses.dataclass
 class GameDayEvent:

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Incident-bundle schema drift check.
+
+The flight recorder (`kubeai_tpu/metrics/flightrecorder.py`) declares
+the decision-event kinds and record kinds its incident bundles emit.
+The game-day replay side (`kubeai_tpu/testing/chaos.py`) declares the
+vocabulary it understands (`FLIGHT_EVENT_KINDS`, `LOG_RECORD_KINDS`).
+The two lists are deliberately DUPLICATED, not imported from one
+another — so this check is a real drift gate, not a tautology:
+
+  - every event kind the recorder can emit must be replayable
+    (`flightrecorder.EVENT_KINDS ⊆ chaos.FLIGHT_EVENT_KINDS`);
+  - every record kind a bundle line can carry must be loadable
+    (`flightrecorder.RECORD_KINDS ⊆ chaos.LOG_RECORD_KINDS`);
+  - a replay-side kind with no producer is flagged too (dead schema
+    rots the replay machinery the same way stale docs rot a catalogue).
+
+Adding a new decision event means touching BOTH files — this gate turns
+forgetting the replay side into a tier-1 failure instead of a silently
+dropped record during the next incident.
+
+Run directly (exit 1 on drift) or import `check()` — a tier-1 test
+wires it in.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def check() -> list[str]:
+    """Returns human-readable schema violations (empty = recorder and
+    replay vocabularies agree)."""
+    sys.path.insert(0, REPO_ROOT)
+    from kubeai_tpu.metrics import flightrecorder
+    from kubeai_tpu.testing import chaos
+
+    errors: list[str] = []
+    for kind in flightrecorder.EVENT_KINDS:
+        if kind not in chaos.FLIGHT_EVENT_KINDS:
+            errors.append(
+                f"event kind {kind!r}: emitted by the flight recorder "
+                "but absent from chaos.FLIGHT_EVENT_KINDS — the replay "
+                "side would drop it"
+            )
+    for kind in flightrecorder.RECORD_KINDS:
+        if kind not in chaos.LOG_RECORD_KINDS:
+            errors.append(
+                f"record kind {kind!r}: bundles emit it but it is absent "
+                "from chaos.LOG_RECORD_KINDS — the replay side would "
+                "reject the bundle line"
+            )
+    for kind in chaos.FLIGHT_EVENT_KINDS:
+        if kind not in flightrecorder.EVENT_KINDS:
+            errors.append(
+                f"event kind {kind!r}: chaos.FLIGHT_EVENT_KINDS declares "
+                "it but no flight-recorder producer exists — dead schema"
+            )
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    if errors:
+        print("incident-bundle schema drift detected:")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    sys.path.insert(0, REPO_ROOT)
+    from kubeai_tpu.metrics import flightrecorder
+
+    print(
+        f"incident schema in sync ({len(flightrecorder.EVENT_KINDS)} "
+        f"event kinds, {len(flightrecorder.RECORD_KINDS)} record kinds)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

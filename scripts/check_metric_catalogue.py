@@ -2,9 +2,9 @@
 """Metric-catalogue drift check.
 
 Collects every `kubeai_*` metric name registered by the codebase's
-instrument bundles (the operator `Metrics` bundle and the engine's
-`EngineMetrics`) and diffs them against the catalogue in
-docs/concepts/observability.md:
+instrument bundles (the operator `Metrics` bundle, the engine's
+`EngineMetrics`, and the flight recorder's `FlightRecorderMetrics`) and
+diffs them against the catalogue in docs/concepts/observability.md:
 
   - a REGISTERED metric missing from the doc fails (the catalogue rots
     the moment an instrument lands undocumented);
@@ -44,7 +44,7 @@ _DECL_RE = re.compile(
 
 
 def registered_metric_names() -> set[str]:
-    """Every kubeai_* metric the codebase can register: the two live
+    """Every kubeai_* metric the codebase can register: the live
     instrument bundles (instantiated, so computed names are real) plus a
     static scan for instruments declared outside any bundle (e.g. the
     whisper transcription server's per-instance counters). benchmarks/
@@ -53,10 +53,15 @@ def registered_metric_names() -> set[str]:
     stay catalogued like any other exposition surface."""
     sys.path.insert(0, REPO_ROOT)
     from kubeai_tpu.engine.server import EngineMetrics
+    from kubeai_tpu.metrics.flightrecorder import FlightRecorderMetrics
     from kubeai_tpu.metrics.registry import Metrics
 
     names: set[str] = set()
-    for reg in (Metrics().registry, EngineMetrics().registry):
+    for reg in (
+        Metrics().registry,
+        EngineMetrics().registry,
+        FlightRecorderMetrics().registry,
+    ):
         for m in reg.metrics:
             names.add(m.name)
     for pkg in ("kubeai_tpu", "benchmarks"):

@@ -175,8 +175,11 @@ def test_itl_records_match_fake_clock_ticks(monkeypatch):
     while eng.has_work():
         events.extend(eng.step())
     timing: dict[str, list[float]] = {}
-    for kind, v in eng.drain_timing():
-        timing.setdefault(kind, []).append(v)
+    exemplars: dict[str, list[str]] = {}
+    for rec in eng.drain_timing():
+        timing.setdefault(rec[0], []).append(rec[1])
+        if len(rec) > 2:
+            exemplars.setdefault(rec[0], []).append(rec[2])
     assert len(timing["queue_wait"]) == 1
     assert len(timing["prefill"]) == 1
     assert len(timing["ttft"]) == 1
@@ -193,5 +196,9 @@ def test_itl_records_match_fake_clock_ticks(monkeypatch):
         for v in vals:
             assert v >= 0, (kind, v)
     assert timing["e2e"][0] > timing["ttft"][0]
+    # ttft/itl records carry the request's exemplar tag so the server's
+    # histograms can map a bucket back to a request.
+    assert exemplars["ttft"] == [f"rid-{rid}"]
+    assert all(tag == f"rid-{rid}" for tag in exemplars["itl"])
     # A second drain is empty — records land exactly once.
     assert eng.drain_timing() == []

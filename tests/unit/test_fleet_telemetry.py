@@ -599,3 +599,131 @@ def test_manager_wires_fleet_plane():
     assert mgr.fleet.usage is mgr.usage
     for messenger in mgr.messengers:
         assert messenger.usage is mgr.usage
+
+
+def test_endpoint_staleness_gauge_tracks_each_endpoint():
+    """kubeai_fleet_endpoint_staleness_seconds is PER ENDPOINT: the
+    dead replica's age climbs tick over tick (a flapping endpoint would
+    sawtooth) while live replicas stay at zero age, and never-scraped
+    endpoints export no series at all (absence is not zero age)."""
+    from benchmarks.fleet_telemetry_sim import (
+        DEAD_ADDR,
+        FleetWorld,
+        STALE_ADDR,
+        STALE_AFTER_TICK,
+    )
+
+    world = FleetWorld()
+    aggregator = FleetStateAggregator(
+        lb=world.lb,
+        model_client=world.mc,
+        store=world.store,
+        namespace="default",
+        metrics=world.metrics,
+        interval_s=1.0,
+        staleness_s=2.5,
+        fetch_metrics=world.fetch_metrics,
+        fetch_state=world.fetch_state,
+        clock=world.clock,
+    )
+    gauge = world.metrics.fleet_endpoint_staleness
+    for _ in range(STALE_AFTER_TICK + 3):
+        world.advance()
+        aggregator.collect()
+    # The endpoint that died mid-run: its last-success age grows with
+    # the fake clock while its healthy peer stays fresh.
+    stale_age = gauge.get(model="m1", endpoint=STALE_ADDR)
+    assert stale_age >= 3.0, stale_age
+    assert gauge.get(model="m1", endpoint="10.0.1.1:8000") == 0.0
+    # The never-answered endpoint exports NO series.
+    assert all(
+        labels.get("endpoint") != DEAD_ADDR
+        for labels, _ in gauge.samples()
+    )
+    # One more tick: the sawtooth's rising edge.
+    world.advance()
+    aggregator.collect()
+    assert gauge.get(model="m1", endpoint=STALE_ADDR) > stale_age
+
+
+# ---- SLO plane over HTTP + engine exemplars ----------------------------------
+
+
+def test_slo_endpoint_404_then_serves_state():
+    """GET /v1/slo mirrors the other fleet surfaces: 404 with a clear
+    message until the manager wires an evaluator, then the evaluator's
+    state_payload verbatim (including the flight-recorder index)."""
+    from kubeai_tpu.config import System
+    from kubeai_tpu.fleet.slo import SLOEvaluator
+    from kubeai_tpu.metrics.flightrecorder import FlightRecorder
+    from kubeai_tpu.testing.clock import FakeClock
+
+    store = KubeStore()
+    lb = LoadBalancer(store, default_timeout=1)
+    mc = ModelClient(store)
+    metrics = Metrics()
+    server = OpenAIServer(ModelProxy(lb, mc, metrics=metrics), mc,
+                          metrics=metrics)
+    server.start()
+    try:
+        status, body = http_get(f"127.0.0.1:{server.port}", "/v1/slo")
+        assert status == 404
+        assert b"slo plane not configured" in body
+
+        from benchmarks.fleet_telemetry_sim import FleetWorld
+
+        world = FleetWorld()
+        clock = world.clock
+        aggregator = FleetStateAggregator(
+            lb=world.lb, model_client=world.mc, store=world.store,
+            metrics=world.metrics, interval_s=1.0, staleness_s=5.0,
+            fetch_metrics=world.fetch_metrics,
+            fetch_state=world.fetch_state, clock=clock,
+        )
+        recorder = FlightRecorder(clock=clock)
+        evaluator = SLOEvaluator(
+            System().slo, aggregator, world.mc, metrics=world.metrics,
+            recorder=recorder, interval_s=1.0, clock=clock,
+        )
+        world.advance()
+        aggregator.collect()
+        evaluator.tick()
+        server.slo = evaluator
+        status, body = http_get(f"127.0.0.1:{server.port}", "/v1/slo")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["object"] == "slo.state"
+        assert "flight_recorder" in payload
+        # The prefixed alias the gateway exposes too.
+        assert http_get(
+            f"127.0.0.1:{server.port}", "/openai/v1/slo"
+        )[0] == 200
+    finally:
+        server.stop()
+        lb.stop()
+
+
+def test_engine_exemplars_ride_state_not_exposition(tiny_engine_server):
+    """Trace-id exemplars recorded against the engine's TTFT/ITL
+    histograms surface under /v1/state's "exemplars" key (where the
+    aggregator and incident bundles read them) but never leak into the
+    /metrics exposition text."""
+    addr = f"127.0.0.1:{tiny_engine_server.port}"
+    tiny_engine_server.metrics.observe_timing(
+        "ttft", 0.12, exemplar="rid-exemplar-ttft"
+    )
+    tiny_engine_server.metrics.observe_timing(
+        "itl", 0.03, exemplar="rid-exemplar-itl"
+    )
+    status, body = http_get(addr, "/v1/state")
+    assert status == 200
+    state = json.loads(body)
+    ex = state["exemplars"]
+    assert "rid-exemplar-ttft" in ex["ttft"].values()
+    assert "rid-exemplar-itl" in ex["itl"].values()
+    # Flight-recorder summary rides along for the same operators.
+    assert "flight_recorder" in state
+    # Exposition stays plain Prometheus text: no trace ids.
+    status, body = http_get(addr, "/metrics")
+    assert status == 200
+    assert b"rid-exemplar" not in body

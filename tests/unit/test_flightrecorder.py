@@ -1,0 +1,194 @@
+"""Flight recorder: bounded ring semantics (eviction + drop counters),
+per-trigger debounce, atomic sorted-key incident bundles with metric
+deltas and exemplars, the nondeterministic-series filter, and the
+bundle's byte-determinism under a fake clock — all tier-1."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+sys.path.insert(0, REPO_ROOT)
+
+from kubeai_tpu.metrics import flightrecorder
+from kubeai_tpu.metrics.flightrecorder import FlightRecorder
+from kubeai_tpu.metrics.registry import Counter, Gauge, Registry
+from kubeai_tpu.testing.clock import FakeClock
+
+
+def _recorder(**kw):
+    clock = FakeClock(100.0)
+    kw.setdefault("clock", clock)
+    return FlightRecorder(**kw), clock
+
+
+class TestRings:
+    def test_events_merge_in_global_decision_order(self):
+        rec, clock = _recorder()
+        rec.record(flightrecorder.DOOR_SHED, "door", target="m")
+        clock.advance(1.0)
+        rec.record(flightrecorder.BREAKER, "lb", target="ep")
+        rec.record(flightrecorder.SLO_ALERT, "slo", target="m")
+        kinds = [e["kind"] for e in rec.events()]
+        assert kinds == [
+            flightrecorder.DOOR_SHED,
+            flightrecorder.BREAKER,
+            flightrecorder.SLO_ALERT,
+        ]
+        # Same-instant ordering is the monotonic seq, not dict luck.
+        seqs = [e["seq"] for e in rec.events()]
+        assert seqs == sorted(seqs)
+
+    def test_ring_bounds_evict_oldest_and_count_drops(self):
+        rec, _ = _recorder(ring_size=4)
+        for i in range(10):
+            rec.record(flightrecorder.DOOR_SHED, "door", target=f"t{i}")
+        events = rec.events("door")
+        assert len(events) == 4
+        assert [e["target"] for e in events] == ["t6", "t7", "t8", "t9"]
+        assert rec.metrics.events.get(ring="door") == 10.0
+        assert rec.metrics.dropped.get(ring="door") == 6.0
+
+    def test_unknown_kind_is_rejected(self):
+        rec, _ = _recorder()
+        with pytest.raises(ValueError):
+            rec.record("made_up_kind", "door")
+
+    def test_rings_are_per_subsystem(self):
+        rec, _ = _recorder(ring_size=2)
+        for _ in range(5):
+            rec.record(flightrecorder.DOOR_SHED, "door")
+            rec.record(flightrecorder.BREAKER, "lb")
+        assert len(rec.events("door")) == 2
+        assert len(rec.events("lb")) == 2
+
+
+class TestTriggers:
+    def test_debounce_suppresses_and_counts(self):
+        rec, clock = _recorder(min_trigger_interval_s=300.0)
+        assert rec.trigger("fast_burn_page") is None  # no sink_dir
+        assert len(rec.incidents) == 1
+        clock.advance(10.0)
+        rec.trigger("fast_burn_page")
+        assert len(rec.incidents) == 1, "second fire inside debounce"
+        assert rec.metrics.suppressed.get(trigger="fast_burn_page") == 1.0
+        # A DIFFERENT reason is not debounced by the first.
+        rec.trigger("watchdog_wedge")
+        assert len(rec.incidents) == 2
+        # Past the interval, the same reason fires again.
+        clock.advance(300.0)
+        rec.trigger("fast_burn_page")
+        assert len(rec.incidents) == 3
+        assert rec.metrics.incidents.get(trigger="fast_burn_page") == 2.0
+
+    def test_sink_dir_writes_bundle_file(self, tmp_path):
+        rec, _ = _recorder(sink_dir=str(tmp_path))
+        rec.record(flightrecorder.WATCHDOG, "engine", target="step")
+        path = rec.trigger("watchdog_wedge", detail="stalled 30s")
+        assert path and os.path.exists(path)
+        lines = open(path).read().splitlines()
+        header = json.loads(lines[0])
+        assert header["bundle"] == "incident"
+        assert header["reason"] == "watchdog_wedge"
+        assert rec.incidents[0]["path"] == path
+
+
+class TestBundles:
+    def test_bundle_lines_are_sorted_key_json(self):
+        rec, clock = _recorder()
+        rec.record(flightrecorder.DOOR_SHED, "door", target="m",
+                   trace_id="rid-1", tenant="acme")
+        rec.note_span({"name": "engine.step", "dur_s": 0.5})
+        rec.note_exemplars("door_ttft/m", {"0.5": "req-1"})
+        reg = Registry()
+        c = Counter("kubeai_x_total", "x", reg)
+        c.inc(5, model="m")
+        rec.capture_metrics(reg)
+        clock.advance(1.0)
+        c.inc(2, model="m")
+        rec.capture_metrics(reg)
+        lines = rec.bundle_lines("fast_burn_page", detail="d")
+        for ln in lines:
+            assert json.dumps(json.loads(ln), sort_keys=True) == ln
+        records = [json.loads(ln) for ln in lines[1:]]
+        by_kind = {}
+        for r in records:
+            by_kind.setdefault(r["record"], []).append(r)
+        assert set(by_kind) == {"flight", "span", "metric_delta",
+                                "exemplar"}
+        delta = by_kind["metric_delta"][0]
+        assert delta["series"] == "kubeai_x_total{model=m}"
+        assert delta["delta"] == 2.0
+        assert by_kind["exemplar"][0]["exemplars"] == {"0.5": "req-1"}
+
+    def test_every_record_kind_is_declared(self):
+        """Whatever bundle_lines can emit must be in RECORD_KINDS (the
+        schema gate's premise)."""
+        rec, clock = _recorder()
+        rec.record(flightrecorder.SLO_ALERT, "slo")
+        rec.note_span({"name": "s"})
+        rec.note_exemplars("src", {"+Inf": "t"})
+        reg = Registry()
+        g = Gauge("kubeai_y", "y", reg)
+        g.set(1.0)
+        rec.capture_metrics(reg)
+        clock.advance(1.0)
+        g.set(2.0)
+        rec.capture_metrics(reg)
+        for ln in rec.bundle_lines("watchdog_wedge")[1:]:
+            assert json.loads(ln)["record"] in flightrecorder.RECORD_KINDS
+
+    def test_nondeterministic_series_filtered_from_deltas(self):
+        rec, clock = _recorder()
+        reg = Registry()
+        wall = Gauge("kubeai_fleet_collection_duration_seconds", "w", reg)
+        ok = Gauge("kubeai_fleet_models", "ok", reg)
+        wall.set(0.1)
+        ok.set(1.0)
+        rec.capture_metrics(reg)
+        clock.advance(1.0)
+        wall.set(0.7)   # moves run-to-run in real life
+        ok.set(3.0)
+        rec.capture_metrics(reg)
+        series = [
+            json.loads(ln)["series"]
+            for ln in rec.bundle_lines("fast_burn_page")[1:]
+            if json.loads(ln)["record"] == "metric_delta"
+        ]
+        assert "kubeai_fleet_models" in series
+        assert not any("collection_duration" in s for s in series)
+
+    def test_replay_context_stamps_the_header(self):
+        rec, _ = _recorder()
+        rec.replay_context = {"sim": "slo_incident", "seed": 7,
+                              "ticks": 40}
+        header = json.loads(rec.bundle_lines("fast_burn_page")[0])
+        assert header["sim"] == "slo_incident"
+        assert header["seed"] == 7 and header["ticks"] == 40
+
+    def test_bundle_is_deterministic_under_fake_clock(self):
+        def build():
+            rec, clock = _recorder()
+            rec.replay_context = {"sim": "s", "seed": 1, "ticks": 2}
+            rec.record(flightrecorder.BREAKER, "lb", target="ep",
+                       from_state="closed", to_state="open")
+            clock.advance(2.0)
+            rec.record(flightrecorder.SLO_ALERT, "slo", target="m")
+            return rec.bundle_lines("fast_burn_page", detail="x")
+
+        assert build() == build()
+
+    def test_state_payload_summarizes_without_lines(self):
+        rec, _ = _recorder()
+        rec.record(flightrecorder.DOOR_SHED, "door")
+        rec.note_exemplars("door_ttft/m", {"1": "req-9"})
+        rec.trigger("fast_burn_page", detail="d")
+        payload = rec.state_payload()
+        assert payload["rings"] == {"door": 1}
+        assert payload["exemplars"] == {"door_ttft/m": {"1": "req-9"}}
+        assert payload["incidents"][0]["reason"] == "fast_burn_page"
+        assert "lines" not in payload["incidents"][0]

@@ -1,0 +1,57 @@
+"""The flight recorder's event/record vocabulary cannot drift from the
+game-day replay schema: every kind the recorder emits must be one the
+replay side understands, and vice versa. Tier-1 wiring for
+scripts/check_incident_schema.py."""
+
+import importlib.util
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+
+def _load_checker():
+    path = os.path.join(REPO_ROOT, "scripts", "check_incident_schema.py")
+    spec = importlib.util.spec_from_file_location(
+        "check_incident_schema", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_incident_schema_in_sync():
+    checker = _load_checker()
+    errors = checker.check()
+    assert errors == [], "incident schema drift:\n" + "\n".join(errors)
+
+
+def test_checker_detects_drift_both_ways(monkeypatch):
+    """The gate itself must catch both rot directions: a recorder kind
+    the replay side doesn't know, and a replay kind with no producer."""
+    from kubeai_tpu.metrics import flightrecorder
+    from kubeai_tpu.testing import chaos
+
+    checker = _load_checker()
+    monkeypatch.setattr(
+        flightrecorder, "EVENT_KINDS",
+        flightrecorder.EVENT_KINDS + ("brand_new_kind",),
+    )
+    errors = "\n".join(checker.check())
+    assert "brand_new_kind" in errors
+    monkeypatch.setattr(
+        flightrecorder, "EVENT_KINDS", flightrecorder.EVENT_KINDS[:-2]
+    )
+    errors = "\n".join(checker.check())
+    assert "no flight-recorder producer" in errors
+    # Record-kind drift too.
+    monkeypatch.setattr(
+        flightrecorder, "EVENT_KINDS", chaos.FLIGHT_EVENT_KINDS
+    )
+    monkeypatch.setattr(
+        flightrecorder, "RECORD_KINDS",
+        flightrecorder.RECORD_KINDS + ("hologram",),
+    )
+    errors = "\n".join(checker.check())
+    assert "hologram" in errors

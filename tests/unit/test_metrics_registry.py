@@ -353,3 +353,99 @@ def test_pod_replacement_churn_leaves_no_stale_lb_series():
         store.delete("Pod", "default", pod["metadata"]["name"])
         lb.sync_model("m1")
     assert _series_count(metrics.registry) == baseline
+
+
+# ---- shared bucket-quantile estimator (SLO plane + aggregator) ----------------
+
+
+def test_quantile_estimator_empty_buckets_returns_empty():
+    from kubeai_tpu.metrics.registry import quantiles_from_buckets
+
+    assert quantiles_from_buckets([], 0.0, 0.0) == {}
+    # Buckets present but zero observations: still no estimate.
+    assert quantiles_from_buckets([(0.5, 0.0), (float("inf"), 0.0)],
+                                  0.0, 0.0) == {}
+
+
+def test_quantile_estimator_single_inf_bucket():
+    """A histogram that is one +Inf bucket carries no finite bound to
+    report — the estimator says +Inf rather than inventing a number."""
+    from kubeai_tpu.metrics.registry import quantiles_from_buckets
+
+    out = quantiles_from_buckets([(float("inf"), 10.0)], 10.0, 25.0)
+    assert out["count"] == 10.0
+    assert out["mean_s"] == 2.5
+    assert out["p95_s"] == float("inf")
+
+
+def test_quantile_estimator_reports_containing_bucket_bound():
+    from kubeai_tpu.metrics.registry import quantiles_from_buckets
+
+    buckets = [(0.1, 50.0), (0.5, 90.0), (1.0, 100.0),
+               (float("inf"), 100.0)]
+    out = quantiles_from_buckets(buckets, 100.0, 30.0)
+    assert out["p50_s"] == 0.1
+    assert out["p95_s"] == 1.0
+    # A quantile landing in +Inf reports the largest FINITE bound.
+    buckets = [(0.1, 100.0), (float("inf"), 101.0)]
+    assert quantiles_from_buckets(buckets, 101.0, 11.0)["p99_s"] == 0.1
+
+
+def test_count_over_threshold_edge_cases():
+    from kubeai_tpu.metrics.registry import count_over_threshold
+
+    # Zero observations / no buckets: nothing can be over.
+    assert count_over_threshold([], 0.0, 0.5) == 0.0
+    assert count_over_threshold([(0.5, 0.0)], 0.0, 0.5) == 0.0
+    buckets = [(0.25, 60.0), (0.5, 80.0), (1.0, 95.0),
+               (float("inf"), 100.0)]
+    # Threshold on a bound: observations in that bucket count as good.
+    assert count_over_threshold(buckets, 100.0, 0.5) == 20.0
+    # Threshold between bounds resolves to the NEXT bound (conservative
+    # toward the service: in-bucket observations may be below it).
+    assert count_over_threshold(buckets, 100.0, 0.3) == 20.0
+    # Threshold past every finite bound: the buckets cannot see up
+    # there, so badness is 0, not a guess.
+    assert count_over_threshold(buckets, 100.0, 5.0) == 0.0
+
+
+def test_estimator_is_shared_by_aggregator_and_slo_paths():
+    """One estimator, two consumers: the aggregator's per-endpoint
+    quantile view and the SLO evaluator's burn-rate read must flow
+    through the same functions so they can never disagree about the
+    same scrape."""
+    from kubeai_tpu.fleet import aggregator as agg_mod
+    from kubeai_tpu.fleet import slo as slo_mod
+    from kubeai_tpu.metrics import registry as reg_mod
+
+    assert agg_mod.quantiles_from_buckets is reg_mod.quantiles_from_buckets
+    assert slo_mod.quantiles_from_buckets is reg_mod.quantiles_from_buckets
+    assert slo_mod.count_over_threshold is reg_mod.count_over_threshold
+
+
+# ---- trace-id exemplars -------------------------------------------------------
+
+
+def test_histogram_exemplars_keep_last_trace_per_bucket():
+    reg = Registry()
+    h = Histogram("kubeai_ex_seconds", "h", reg, buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar="req-a", model="m")
+    h.observe(0.07, exemplar="req-b", model="m")   # same bucket: wins
+    h.observe(0.5, exemplar="req-c", model="m")
+    h.observe(30.0, exemplar="req-inf", model="m")  # overflow bucket
+    assert h.exemplars(model="m") == {
+        "0.1": "req-b", "1": "req-c", "+Inf": "req-inf",
+    }
+    # Exemplars are per label set; an unobserved set has none.
+    assert h.exemplars(model="other") == {}
+
+
+def test_histogram_exemplar_is_optional_and_unexposed():
+    """Exemplars never leak into the exposition text (the scrape
+    transport stays plain Prometheus); omitting one records nothing."""
+    reg = Registry()
+    h = Histogram("kubeai_ex2_seconds", "h", reg, buckets=(1.0,))
+    h.observe(0.5)
+    h.observe(0.6, exemplar="req-z")
+    assert "req-z" not in reg.expose()
+    assert h.exemplars() == {"1": "req-z"}

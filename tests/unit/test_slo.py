@@ -1,0 +1,492 @@
+"""SLO plane: multi-window multi-burn-rate alerting on a fake clock
+(slow burn, fast burn, recovery, budget exhaustion), exact error-budget
+ledger arithmetic, coverage refusal + the flapping-endpoint regression,
+the monotone histogram accumulator across engine restarts, objective
+resolution (CRD override vs system default), and the full deterministic
+incident loop: benchmarks/slo_incident_sim drives a latency regression
+plus breaker storm through the real door/LB/aggregator/evaluator, the
+fast-burn page dumps a bundle, and `gameday_sim --replay` reproduces it
+byte-identically — all tier-1."""
+
+import json
+import os
+import sys
+from fractions import Fraction
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+sys.path.insert(0, REPO_ROOT)
+
+from benchmarks import gameday_sim, slo_incident_sim
+from kubeai_tpu.config.system import SLOConfig
+from kubeai_tpu.crd.model import Model, ModelSpec, Slo
+from kubeai_tpu.fleet.slo import (
+    COVERAGE_COLLAPSE_TICKS,
+    OBJ_AVAILABILITY,
+    OBJ_ITL_P99,
+    OBJ_SHED_RATE,
+    OBJ_TTFT_P95,
+    SLOEvaluator,
+    _HistAccumulator,
+    resolve_objectives,
+)
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.metrics import flightrecorder
+from kubeai_tpu.metrics.flightrecorder import FlightRecorder
+from kubeai_tpu.testing.clock import FakeClock
+
+TICK_S = 10.0
+
+
+def _cfg(**over) -> SLOConfig:
+    base = dict(
+        enabled=True,
+        ttft_p95_seconds=0.5,
+        budget_window_seconds=1200.0,
+        fast_burn_threshold=14.4,
+        fast_burn_window_seconds=120.0,
+        fast_burn_short_window_seconds=30.0,
+        slow_burn_threshold=3.0,
+        slow_burn_window_seconds=600.0,
+    )
+    base.update(over)
+    return SLOConfig(**base)
+
+
+def _model(name="m", **slo_fields) -> Model:
+    return Model(
+        name=name,
+        spec=ModelSpec(
+            url="hf://org/x", engine="KubeAITPU",
+            features=["TextGeneration"], slo=Slo(**slo_fields),
+        ),
+    )
+
+
+class FakeModelClient:
+    def __init__(self, *models):
+        self.models = list(models)
+
+    def list_all_models(self, selectors=None):
+        return self.models
+
+
+class FakeAggregator:
+    """Synthetic snapshot source: the test scripts per-endpoint
+    cumulative TTFT bucket state tick by tick."""
+
+    def __init__(self, clock, staleness_s=3 * TICK_S):
+        self.clock = clock
+        self.staleness_s = staleness_s
+        self.coverage = {}          # model -> (coverage, fresh)
+        self.endpoints = {}         # addr -> {"good": n, "bad": n}
+        self.snapshot_ts = None     # None -> stamped fresh each call
+        self.model = "m"
+
+    def observe(self, addr, good=0, bad=0):
+        ep = self.endpoints.setdefault(addr, {"good": 0, "bad": 0})
+        ep["good"] += good
+        ep["bad"] += bad
+
+    def reset_endpoint(self, addr, good=0, bad=0):
+        """Engine restart: cumulative counters start over."""
+        self.endpoints[addr] = {"good": good, "bad": bad}
+
+    def _hist(self, ep):
+        total = ep["good"] + ep["bad"]
+        if total == 0:
+            return {}
+        return {
+            "buckets": [
+                ["0.25", float(ep["good"])],
+                ["0.5", float(ep["good"])],
+                ["1", float(total)],
+                ["+Inf", float(total)],
+            ],
+            "count": float(total),
+            "sum": 0.2 * ep["good"] + 0.8 * ep["bad"],
+        }
+
+    def snapshot(self):
+        ts = (
+            self.snapshot_ts if self.snapshot_ts is not None
+            else self.clock()
+        )
+        return {
+            "ts": ts,
+            "models": {
+                self.model: {
+                    "endpoints": {
+                        addr: {
+                            "stale": False,
+                            "ttft_hist": self._hist(ep),
+                            "itl_hist": {},
+                        }
+                        for addr, ep in self.endpoints.items()
+                    },
+                },
+            },
+        }
+
+    def model_coverage(self, model):
+        return self.coverage.get(model, (1.0, True))
+
+
+def _evaluator(cfg=None, recorder=None, min_coverage=0.0):
+    clock = FakeClock(1000.0)
+    agg = FakeAggregator(clock)
+    metrics = Metrics()
+    ev = SLOEvaluator(
+        cfg=cfg or _cfg(),
+        aggregator=agg,
+        model_client=FakeModelClient(_model()),
+        metrics=metrics,
+        recorder=recorder,
+        min_telemetry_coverage=min_coverage,
+        interval_s=TICK_S,
+        clock=clock,
+    )
+    return ev, agg, clock, metrics
+
+
+def _tick(ev, agg, clock, good=0, bad=0, addr="ep1"):
+    clock.advance(TICK_S)
+    if good or bad:
+        agg.observe(addr, good=good, bad=bad)
+    return ev.tick()
+
+
+def _ttft(results):
+    return results["models"]["m"]["objectives"][OBJ_TTFT_P95]
+
+
+# ---- objective resolution ----------------------------------------------------
+
+
+class TestObjectiveResolution:
+    def test_system_defaults_apply(self):
+        cfg = _cfg(itl_p99_seconds=0.05, availability=0.999,
+                   max_shed_rate=0.05)
+        objs = {o.kind: o for o in resolve_objectives(_model(), cfg)}
+        assert set(objs) == {OBJ_TTFT_P95, OBJ_ITL_P99,
+                             OBJ_AVAILABILITY, OBJ_SHED_RATE}
+        assert objs[OBJ_TTFT_P95].allowed == Fraction(5, 100)
+        assert objs[OBJ_TTFT_P95].threshold == 0.5
+        assert objs[OBJ_ITL_P99].allowed == Fraction(1, 100)
+        # Fraction(str(...)) keeps the decimal exact: 1 - 0.999 is
+        # EXACTLY 1/1000, not a binary-float neighborhood.
+        assert objs[OBJ_AVAILABILITY].allowed == Fraction(1, 1000)
+        assert objs[OBJ_SHED_RATE].allowed == Fraction(1, 20)
+
+    def test_crd_overrides_field_by_field(self):
+        cfg = _cfg(ttft_p95_seconds=0.5, itl_p99_seconds=0.05)
+        model = _model(ttft_p95_seconds=1.5)
+        objs = {o.kind: o for o in resolve_objectives(model, cfg)}
+        assert objs[OBJ_TTFT_P95].threshold == 1.5      # CRD wins
+        assert objs[OBJ_ITL_P99].threshold == 0.05      # default rides
+
+    def test_all_zero_resolves_to_no_objectives(self):
+        cfg = _cfg(ttft_p95_seconds=0.0)
+        assert resolve_objectives(_model(), cfg) == []
+
+
+# ---- burn-rate windows on a fake clock ---------------------------------------
+
+
+class TestBurnWindows:
+    def test_steady_slow_burn_warns_without_paging(self):
+        """30% bad at a 5% objective burns at exactly 6x everywhere:
+        above the 3x slow threshold, below the 14.4x fast one."""
+        ev, agg, clock, metrics = _evaluator()
+        for _ in range(10):
+            results = _tick(ev, agg, clock, good=70, bad=30)
+        rec = _ttft(results)
+        assert rec["burn"] == {"short": 6.0, "fast": 6.0, "slow": 6.0}
+        assert rec["state"] == "slow"
+        assert metrics.slo_alerts.get(
+            model="m", objective=OBJ_TTFT_P95, severity="slow"
+        ) == 1.0
+        assert metrics.slo_alerts.get(
+            model="m", objective=OBJ_TTFT_P95, severity="fast"
+        ) == 0.0
+
+    def test_fast_burn_requires_both_windows(self):
+        """After a long healthy history, an all-bad regression trips the
+        short window first; the page waits for the 120s fast window to
+        agree — both-windows is the multi-window rule's whole point."""
+        ev, agg, clock, metrics = _evaluator()
+        for _ in range(15):
+            _tick(ev, agg, clock, good=30)
+        states = []
+        for i in range(12):
+            results = _tick(ev, agg, clock, bad=30)
+            rec = _ttft(results)
+            states.append(rec["state"])
+            if rec["state"] == "fast":
+                break
+        # Short window (3 ticks) saturates at burn 20 by tick 3, but
+        # the fast window (12 ticks) needs >= 0.72 bad fraction: 9 bad
+        # ticks. Page on the 9th bad tick, not the 3rd.
+        assert states[-1] == "fast"
+        assert len(states) == 9, states
+        assert "fast" not in states[:-1]
+        assert metrics.slo_alerts.get(
+            model="m", objective=OBJ_TTFT_P95, severity="fast"
+        ) == 1.0
+
+    def test_recovery_returns_to_ok(self):
+        ev, agg, clock, metrics = _evaluator()
+        for _ in range(15):
+            _tick(ev, agg, clock, bad=30)
+        assert _ttft(_tick(ev, agg, clock, bad=30))["state"] == "fast"
+        # Healthy traffic pushes the bad fraction in every window back
+        # under threshold; the state machine walks fast -> slow -> ok.
+        seen = []
+        for _ in range(70):
+            results = _tick(ev, agg, clock, good=30)
+            seen.append(_ttft(results)["state"])
+        assert seen[-1] == "ok"
+        assert "slow" in seen, "recovery must pass through slow burn"
+        # Gauge mirrors the final state.
+        assert metrics.slo_alert_state.get(
+            model="m", objective=OBJ_TTFT_P95
+        ) == 0.0
+
+    def test_cold_start_window_is_since_start(self):
+        """Younger than the window, the window is 'since start': one
+        all-bad tick at birth burns every window at 20x and pages —
+        cold start must not blind the fast rule."""
+        ev, agg, clock, _ = _evaluator()
+        results = _tick(ev, agg, clock, bad=30)
+        rec = _ttft(results)
+        assert rec["burn"] == {"short": 20.0, "fast": 20.0, "slow": 20.0}
+        assert rec["state"] == "fast"
+
+
+# ---- exact error-budget ledger -----------------------------------------------
+
+
+class TestLedger:
+    def test_ledger_is_exact_fraction_arithmetic(self):
+        ev, agg, clock, _ = _evaluator()
+        for _ in range(4):
+            results = _tick(ev, agg, clock, good=24, bad=1)
+        budget = _ttft(results)["budget"]
+        # 100 events, 4 bad, allowed 1/20: budget 5, remaining 1.
+        assert budget["total"] == 100 and budget["bad"] == 4
+        assert budget["allowed"] == "1/20"
+        assert budget["budget"] == "5"
+        assert budget["remaining"] == "1"
+        assert budget["remaining_frac_exact"] == "1/5"
+        assert budget["remaining_frac"] == 0.2
+        assert budget["exhausted"] is False
+
+    def test_budget_exhaustion_is_a_statement_not_an_estimate(self):
+        ev, agg, clock, metrics = _evaluator()
+        for _ in range(2):
+            results = _tick(ev, agg, clock, good=25, bad=25)
+        budget = _ttft(results)["budget"]
+        # 100 events, 50 bad, budget 5: remaining -45, exactly -9x over.
+        assert budget["remaining"] == "-45"
+        assert budget["remaining_frac_exact"] == "-9"
+        assert budget["exhausted"] is True
+        assert Fraction(budget["remaining"]) == (
+            Fraction(budget["allowed"]) * budget["total"] - budget["bad"]
+        )
+        assert metrics.slo_error_budget_remaining.get(
+            model="m", objective=OBJ_TTFT_P95
+        ) == -9.0
+
+    def test_empty_ledger_reports_full_budget(self):
+        ev, agg, clock, _ = _evaluator()
+        results = _tick(ev, agg, clock)  # no observations at all
+        budget = _ttft(results)["budget"]
+        assert budget["total"] == 0
+        assert budget["remaining_frac"] == 1.0
+        assert budget["exhausted"] is False
+
+    def test_event_counters_track_ring_deltas(self):
+        ev, agg, clock, metrics = _evaluator()
+        for _ in range(3):
+            _tick(ev, agg, clock, good=9, bad=1)
+        assert metrics.slo_events.get(
+            model="m", objective=OBJ_TTFT_P95
+        ) == 30.0
+        assert metrics.slo_bad_events.get(
+            model="m", objective=OBJ_TTFT_P95
+        ) == 3.0
+
+
+# ---- coverage refusal + flapping endpoints -----------------------------------
+
+
+class TestCoverage:
+    def test_stale_snapshot_refused_and_counted(self):
+        ev, agg, clock, metrics = _evaluator()
+        agg.snapshot_ts = clock() - 10 * TICK_S  # ancient snapshot
+        clock.advance(TICK_S)
+        results = ev.tick()
+        assert results["models"] == {}
+        assert results["skipped"] == {"m": "stale"}
+        assert metrics.slo_skipped_ticks.get(model="m", reason="stale") == 1.0
+
+    def test_low_coverage_refused_then_collapse_trigger(self):
+        """A blind judge recuses itself: below-coverage ticks are
+        refused and counted, and the flight recorder's coverage-collapse
+        trigger fires exactly once after the third consecutive refusal
+        — not on a single flap."""
+        recorder = FlightRecorder(clock=lambda: 0.0)
+        ev, agg, clock, metrics = _evaluator(
+            recorder=recorder, min_coverage=0.5
+        )
+        agg.coverage["m"] = (0.25, True)
+        for i in range(COVERAGE_COLLAPSE_TICKS + 2):
+            results = _tick(ev, agg, clock, good=10)
+            assert results["skipped"] == {"m": "coverage"}
+        assert metrics.slo_skipped_ticks.get(
+            model="m", reason="coverage"
+        ) == float(COVERAGE_COLLAPSE_TICKS + 2)
+        collapses = [
+            i for i in recorder.incidents
+            if i["reason"] == flightrecorder.TRIGGER_COVERAGE_COLLAPSE
+        ]
+        assert len(collapses) == 1
+
+    def test_flapping_endpoint_resets_refusal_streak(self):
+        """The flapping-endpoint regression: coverage dipping for one
+        tick, recovering, then dipping again must never reach the
+        collapse trigger — the streak resets on every healthy tick."""
+        recorder = FlightRecorder(clock=lambda: 0.0)
+        ev, agg, clock, _ = _evaluator(recorder=recorder, min_coverage=0.5)
+        for _ in range(4):
+            agg.coverage["m"] = (0.25, True)   # endpoint flaps out
+            _tick(ev, agg, clock, good=10)
+            agg.coverage["m"] = (1.0, True)    # and back in
+            _tick(ev, agg, clock, good=10)
+        assert recorder.incidents == []
+
+    def test_judged_tick_resumes_after_coverage_recovers(self):
+        ev, agg, clock, _ = _evaluator(min_coverage=0.5)
+        agg.coverage["m"] = (0.25, True)
+        _tick(ev, agg, clock, good=10)
+        agg.coverage["m"] = (1.0, True)
+        results = _tick(ev, agg, clock, good=10)
+        assert "m" in results["models"]
+
+
+# ---- monotone accumulation across restarts -----------------------------------
+
+
+class TestHistAccumulator:
+    def test_restart_never_counts_history_twice_or_negative(self):
+        """An engine restart resets its cumulative histogram; naive
+        differencing would go negative (or re-count survivors). The
+        accumulator detects the shrink and treats current totals as the
+        delta, keeping the model series monotone."""
+        ev, agg, clock, _ = _evaluator()
+        _tick(ev, agg, clock, good=50, bad=10)
+        before = _ttft(_tick(ev, agg, clock, good=0))
+        assert (before["total"], before["bad"]) == (60, 10)
+        # Restart: counters start over smaller, with fresh observations.
+        agg.reset_endpoint("ep1", good=5, bad=2)
+        after = _ttft(_tick(ev, agg, clock))
+        assert (after["total"], after["bad"]) == (67, 12)
+
+    def test_absorb_skips_stale_endpoints(self):
+        acc = _HistAccumulator()
+        acc.absorb("m", "ttft", "ep1", {})  # empty detail: no-op
+        assert acc.model_total("m", "ttft") == ([], 0.0)
+
+    def test_forget_endpoint_keeps_model_totals(self):
+        acc = _HistAccumulator()
+        detail = {"buckets": [["0.5", 4.0], ["+Inf", 5.0]],
+                  "count": 5.0, "sum": 1.0}
+        acc.absorb("m", "ttft", "ep1", detail)
+        acc.forget_endpoint("m", "ep1")
+        buckets, total = acc.model_total("m", "ttft")
+        assert total == 5.0  # history survives the endpoint's departure
+        # Re-absorbing the same cumulative state after forget counts it
+        # again as fresh — which is why forget is only for removals.
+        acc.absorb("m", "ttft", "ep1", detail)
+        assert acc.model_total("m", "ttft")[1] == 10.0
+
+
+# ---- pressure + state payload ------------------------------------------------
+
+
+class TestConsumerAPI:
+    def test_pressure_reports_worst_objective(self):
+        ev, agg, clock, _ = _evaluator(
+            cfg=_cfg(max_shed_rate=0.10)
+        )
+        for _ in range(3):
+            _tick(ev, agg, clock, bad=30)
+        p = ev.pressure("m")
+        assert p == {"state": "fast", "level": 2,
+                     "objective": OBJ_TTFT_P95}
+        assert ev.pressure("no-such-model") is None
+
+    def test_state_payload_carries_recorder_index(self):
+        recorder = FlightRecorder(clock=lambda: 0.0)
+        ev, agg, clock, _ = _evaluator(recorder=recorder)
+        _tick(ev, agg, clock, good=10)
+        payload = ev.state_payload()
+        assert payload["object"] == "slo.state"
+        assert "m" in payload["models"]
+        assert "flight_recorder" in payload
+
+    def test_decision_records_are_json_on_the_alert_logger(self, caplog):
+        import logging
+
+        ev, agg, clock, _ = _evaluator()
+        with caplog.at_level(logging.INFO, logger="kubeai.slo.alerts"):
+            _tick(ev, agg, clock, good=10)
+        records = [json.loads(r.message) for r in caplog.records
+                   if r.name == "kubeai.slo.alerts"]
+        assert len(records) == 1
+        rec = records[0]
+        assert rec["model"] == "m" and rec["objective"] == OBJ_TTFT_P95
+        assert rec["state"] == "ok" and "budget" in rec
+
+
+# ---- the deterministic incident loop (acceptance) ----------------------------
+
+
+@pytest.fixture(scope="module")
+def incident_result():
+    return slo_incident_sim.run_sim()
+
+
+@pytest.mark.parametrize(
+    "chk", slo_incident_sim.ALL_CHECKS, ids=lambda c: c.__name__
+)
+def test_incident_sim_invariant(incident_result, chk):
+    chk(incident_result)
+
+
+def test_incident_replay_is_byte_identical(incident_result, tmp_path):
+    """The dumped fast-burn bundle replays byte-identically through the
+    game-day CLI: same sim, same seed, same first SLO violation."""
+    inc = slo_incident_sim._bundle(
+        incident_result, flightrecorder.TRIGGER_FAST_BURN
+    )
+    path = tmp_path / "incident.jsonl"
+    path.write_text("\n".join(inc["lines"]) + "\n")
+    header, cmp = slo_incident_sim.replay(str(path))
+    assert cmp["identical"], "replayed bundle diverged byte-wise"
+    assert header["sim"] == slo_incident_sim.SIM_NAME
+    # The replayed run reproduces the SAME first violation.
+    fv = incident_result["first_violation"]
+    assert cmp["first_violation"] == fv
+    # And the gameday CLI dispatches incident bundles here.
+    assert gameday_sim.main(["--replay", str(path)]) == 0
+
+
+def test_incident_replay_rejects_foreign_bundles(tmp_path):
+    path = tmp_path / "not-an-incident.jsonl"
+    path.write_text(json.dumps({"kind": "gameday", "seed": 0}) + "\n")
+    with pytest.raises(ValueError):
+        slo_incident_sim.replay(str(path))
