@@ -72,6 +72,7 @@ from kubeai_tpu.metrics import Metrics
 from kubeai_tpu.operator.controller import ModelReconciler
 from kubeai_tpu.operator.governor import ActuationGovernor
 from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.operator import slicegroup
 from kubeai_tpu.routing.loadbalancer import (
     Group,
     LoadBalancer,
@@ -84,6 +85,7 @@ from kubeai_tpu.testing.chaos import (
     EV_API_PARTITION,
     EV_API_STORM,
     EV_CHIP_FLIP,
+    EV_KILL_GROUP_HOST,
     EV_KILL_POD,
     EV_LINK_DROP,
     EV_SPOT_PREEMPT,
@@ -466,6 +468,11 @@ class GameDayWorld:
             mode = p.get("mode", "preempt")
             for _ in range(int(p.get("count", 1))):
                 self._kill_one(ev.target, mode, p.get("victim", ""))
+        elif ev.kind == EV_KILL_GROUP_HOST:
+            self._kill_group_host(
+                ev.target, int(p.get("group", 0)), int(p.get("host", 0)),
+                p.get("mode", "preempt"),
+            )
         elif ev.kind == EV_WEDGE_ENGINE:
             addr = None
             if p.get("victim") == "most_resumed":
@@ -584,6 +591,20 @@ class GameDayWorld:
         addr = self._addr_of(pod)
         if addr:
             self._addr_died(addr)
+
+    def _kill_group_host(self, model: str, group: int, host: int,
+                         mode: str) -> None:
+        """Break ONE member pod of a multi-host slice group. The whole
+        group must stop being routable — that is the invariant the
+        slice-group plane owes the fleet."""
+        for pod in self._pods(model):
+            if (slicegroup.group_index(pod) == group
+                    and slicegroup.host_index(pod) == host):
+                break_pod(self.raw_store, pod, mode)
+                addr = self._addr_of(pod)
+                if addr:
+                    self._addr_died(addr)
+                return
 
     def _addr_died(self, addr: str) -> None:
         """An endpoint is gone mid-flight: feed the breaker, resume or
@@ -1018,6 +1039,34 @@ def _inv_token_continuity(world) -> str | None:
     return None
 
 
+def _inv_group_dead_member_not_routable(world) -> str | None:
+    """A slice group with ANY broken member must not be routable: its
+    coordinator address may never appear among the LB endpoints.
+    Vacuous when the fleet has no group-labelled pods."""
+    for model in MODELS:
+        by_group: dict[int, list[dict]] = {}
+        for pod in world._pods(model):
+            g = slicegroup.group_index(pod)
+            if g is not None:
+                by_group.setdefault(g, []).append(pod)
+        if not by_group:
+            continue
+        routable = set(world.lb.group(model).addresses())
+        for g, members in sorted(by_group.items()):
+            if slicegroup.expected_size(members) <= 1:
+                continue
+            if not any(slicegroup.member_broken(p) for p in members):
+                continue
+            coord = slicegroup.coordinator_pod(members)
+            addr = world._addr_of(coord) if coord else None
+            if addr and addr in routable:
+                return (
+                    f"group {model}/g{g} has a broken member but its "
+                    f"coordinator {addr} is still routable"
+                )
+    return None
+
+
 def _inv_convergence(world) -> str | None:
     if not world.converged_final:
         return (
@@ -1050,6 +1099,9 @@ INVARIANTS = (
               "the usage ledger equals delivered work exactly"),
     Invariant("token_continuity", _inv_token_continuity, CONTINUOUS,
               "resumed streams deliver every token exactly once"),
+    Invariant("group_dead_member_not_routable",
+              _inv_group_dead_member_not_routable, CONTINUOUS,
+              "a slice group with a dead member is never routable"),
     Invariant("convergence", _inv_convergence, TERMINAL,
               "healthy steady state within CONVERGE_BOUND_S of last chaos"),
 )

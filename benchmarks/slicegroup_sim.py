@@ -1,0 +1,525 @@
+#!/usr/bin/env python
+"""Slice-group serving-plane simulation: multi-host replicas as atomic
+units, proven against the REAL control plane under a fake clock.
+
+The world runs the production `ModelReconciler`, `ActuationGovernor`,
+`CapacityPlanner`, `FleetStateAggregator`, and `LoadBalancer` over a
+deterministic in-memory `KubeStore`. One multi-host model
+(`google-tpu-v5e-4x4:8` — two 8-chip host pods per replica) serves on
+an inventory of whole 4x4 slices while a chaos trace kills individual
+group member hosts (`kill_group_host` events). A simplified kubelet
+boots rendered pods; everything above the pod is the real code.
+
+Invariants (the PR's acceptance criteria):
+
+  * `no_partial_group_routable` — the LB never routes to a group that
+    is incomplete or has a broken member: every routable address
+    belongs to a fully-Ready group's coordinator (host 0).
+  * `aggregator_groups_truthful` — the fleet snapshot never reports
+    more Ready groups than the store actually holds; a partial or
+    broken group is never Ready.
+  * `planner_whole_groups` — the capacity plan never allocates more
+    chips than the slice inventory, per shape, and the multi-host
+    model's allocation is always a whole number of groups.
+  * `atomic_repair` (terminal) — every killed host produced EXACTLY one
+    whole-group repair (one `kubeai_slicegroup_repairs_total`
+    increment, `num_hosts` pod replacements), each within the repair
+    backoff bound.
+  * `convergence` (terminal) — the model ends the run with all its
+    groups fully Ready and routable.
+
+Run: python benchmarks/slicegroup_sim.py [--ticks N] [--dump PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeai_tpu.config import System
+from kubeai_tpu.config.system import GovernorConfig
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.fleet import CapacityPlanner, FleetStateAggregator
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.operator import slicegroup
+from kubeai_tpu.operator.controller import ModelReconciler
+from kubeai_tpu.operator.governor import ActuationGovernor
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.loadbalancer import Group, LoadBalancer
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.testing.chaos import (
+    CONTINUOUS,
+    EV_KILL_GROUP_HOST,
+    TERMINAL,
+    ChaosKubeStore,
+    GameDayEvent,
+    GameDayLog,
+    GameDayTrace,
+    Invariant,
+    InvariantChecker,
+)
+from kubeai_tpu.testing.clock import FakeClock
+from kubeai_tpu.testing.faults import ApiFaultPlan
+from kubeai_tpu.testing.simkit import break_pod, mk_model, scrape_diff
+
+ACCEL = "tpu-v5-lite-podslice"
+TOPOLOGY = "4x4"
+PROFILE = "google-tpu-v5e-4x4:8"   # 8 chips PER HOST, 2 hosts per replica
+MODEL = "big"
+NUM_HOSTS = 2
+CHIPS_PER_HOST = 8
+GROUP_CHIPS = NUM_HOSTS * CHIPS_PER_HOST
+REPLICAS = 2
+SLICES = 3                         # whole 4x4 slices in the inventory
+
+TICK_S = 1.0
+WARMUP_TICKS = 8                   # steady state before the trace's t=0
+BOOT_TICKS = 2                     # created pod -> Ready
+REPAIR_BOUND_TICKS = 4             # kill -> whole-group repair bound
+
+REPAIRS_SERIES = "kubeai_slicegroup_repairs_total"
+REPLACE_SERIES = "kubeai_controller_pod_replacements_total"
+
+
+def _node(name: str) -> dict:
+    """One 8-chip host VM of a 4x4 slice: the topology label prices the
+    slice (16 chips), allocatable prices the VM."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {
+            "name": name,
+            "labels": {
+                "cloud.google.com/gke-tpu-accelerator": ACCEL,
+                "cloud.google.com/gke-tpu-topology": TOPOLOGY,
+            },
+        },
+        "status": {"allocatable": {"google.com/tpu": str(CHIPS_PER_HOST)}},
+    }
+
+
+class SliceGroupWorld:
+    """Real control plane + simulated kubelet around one multi-host
+    model. The kubelet is deliberately dumb: assign an IP, flip Ready
+    after BOOT_TICKS, never touch a broken pod — repair is the
+    reconciler's job and the whole point of the run."""
+
+    def __init__(self, trace: GameDayTrace, ticks: int):
+        self.trace = trace
+        self.ticks = int(ticks)
+        self.clock = FakeClock(1000.0)
+        self.wall = FakeClock(1_000_000.0)
+        self.tick_no = 0
+        self.t0 = self.clock() + WARMUP_TICKS * TICK_S
+
+        self._name_counter = itertools.count()
+        self.raw_store = KubeStore(
+            namegen=lambda: f"{next(self._name_counter):06d}"
+        )
+        self.api = ChaosKubeStore(self.raw_store, ApiFaultPlan())
+        self.metrics = Metrics()
+
+        cfg = System()
+        cfg.fixed_self_metric_addrs = ["self:1"]
+        cfg.default_and_validate()
+        self.cfg = cfg
+
+        for s in range(SLICES):
+            for h in range(NUM_HOSTS):
+                self.raw_store.create(_node(f"node-s{s}-h{h}"))
+
+        mk_model(
+            self.raw_store, MODEL, replicas=REPLICAS,
+            resource_profile=PROFILE, autoscaling_disabled=True,
+        )
+
+        self.lb = LoadBalancer(self.raw_store, metrics=self.metrics)
+        self.lb._groups[MODEL] = Group(
+            metrics=self.metrics, model=MODEL, clock=self.clock
+        )
+
+        self.mc_raw = ModelClient(self.raw_store)
+        self.aggregator = FleetStateAggregator(
+            lb=self.lb, model_client=self.mc_raw, store=self.raw_store,
+            metrics=self.metrics, interval_s=1.0, staleness_s=2.5,
+            fetch_metrics=self.fetch_metrics, fetch_state=self.fetch_state,
+            clock=self.clock,
+        )
+
+        gcfg = GovernorConfig(
+            window_seconds=20.0,
+            model_disruption_budget=2,
+            cluster_disruption_budget=3,
+            min_telemetry_coverage=0.9,
+        )
+        self.governor = ActuationGovernor(
+            cfg=gcfg, fleet=self.aggregator, store=self.api,
+            metrics=self.metrics, clock=self.clock,
+        )
+        self.mc = ModelClient(self.api)
+        self.mc.governor = self.governor
+        self.reconciler = ModelReconciler(
+            self.api, cfg, metrics=self.metrics, clock=self.clock,
+            wall=self.wall, governor=self.governor,
+        )
+        self.planner = CapacityPlanner(
+            fleet=self.aggregator, model_client=self.mc, store=self.api,
+            cfg=cfg, metrics=self.metrics, interval_s=1.0, staleness_s=2.5,
+            clock=self.clock,
+        )
+
+        self.addr_model: dict[str, str] = {}
+        self.dead: set[str] = set()
+        self.first_seen: dict[str, int] = {}
+        self.ip_counter = 1
+        self.last_plan: dict | None = None
+        self.kill_ticks: list[int] = []
+        self.repair_ticks: list[int] = []
+        self.control_plane_errors = 0
+        self._metrics_base: str | None = None
+
+        self.log = GameDayLog(trace, ticks)
+        self.checker = InvariantChecker(INVARIANTS, log=self.log)
+
+    # ---- time / telemetry ----------------------------------------------
+
+    def rel_now(self) -> float:
+        return self.clock() - self.t0
+
+    def fetch_metrics(self, addr: str, timeout: float = 5.0) -> str:
+        if self.addr_model.get(addr) is None or addr in self.dead:
+            raise ConnectionError(f"injected: {addr} unreachable")
+        return "\n".join([
+            'kubeai_engine_queue_depth{class="standard"} 0.0',
+            "kubeai_engine_queue_oldest_wait_seconds 0.0",
+            "kubeai_engine_kv_cache_utilization 0.0",
+            "kubeai_engine_slots_active 0.0",
+            "kubeai_engine_slot_capacity 4.0",
+            "kubeai_engine_active_requests 0.0",
+        ]) + "\n"
+
+    def fetch_state(self, addr: str, timeout: float = 5.0) -> dict:
+        model = self.addr_model.get(addr)
+        if model is None or addr in self.dead:
+            raise ConnectionError(f"injected: {addr} unreachable")
+        return {"model": model, "healthy": True}
+
+    # ---- pod bookkeeping -----------------------------------------------
+
+    def pods(self) -> list[dict]:
+        return sorted(
+            self.raw_store.list("Pod", "default", {md.POD_MODEL_LABEL: MODEL}),
+            key=lambda p: p["metadata"]["name"],
+        )
+
+    def groups(self) -> dict[int, list[dict]]:
+        return slicegroup.group_pods(self.pods())
+
+    def addr_of(self, pod: dict) -> str | None:
+        ip = pod.get("status", {}).get("podIP")
+        return f"{ip}:8000" if ip else None
+
+    def ready_group_addrs(self) -> set[str]:
+        """Coordinator addresses of groups that are fully Ready."""
+        out: set[str] = set()
+        for members in self.groups().values():
+            if not slicegroup.group_ready(members, NUM_HOSTS):
+                continue
+            coord = slicegroup.coordinator_pod(members)
+            addr = self.addr_of(coord) if coord else None
+            if addr:
+                out.add(addr)
+        return out
+
+    def counter_total(self, series: str) -> float:
+        """Sum of a counter across labels since the post-warmup baseline,
+        measured from the exposition text — the control plane is audited
+        from the outside."""
+        if self._metrics_base is None:
+            return 0.0
+        return sum(
+            delta
+            for (name, _labels), delta in scrape_diff(
+                self._metrics_base, self.metrics.registry.expose()
+            ).items()
+            if name == series
+        )
+
+    # ---- chaos ----------------------------------------------------------
+
+    def apply_event(self, ev: GameDayEvent) -> None:
+        p = ev.params
+        if ev.kind != EV_KILL_GROUP_HOST:
+            raise ValueError(f"slicegroup sim only speaks {EV_KILL_GROUP_HOST!r}")
+        group, host = int(p.get("group", 0)), int(p.get("host", 0))
+        for pod in self.pods():
+            if (slicegroup.group_index(pod) == group
+                    and slicegroup.host_index(pod) == host):
+                break_pod(self.raw_store, pod, p.get("mode", "preempt"))
+                addr = self.addr_of(pod)
+                if addr:
+                    self.dead.add(addr)
+                self.kill_ticks.append(self.tick_no)
+                return
+
+    # ---- kubelet ---------------------------------------------------------
+
+    def _kubelet(self) -> None:
+        for pod in self.pods():
+            st = pod.get("status", {})
+            if st.get("podIP"):
+                continue
+            if st.get("reason") == "Preempted" or st.get("containerStatuses"):
+                continue
+            uid = pod["metadata"].get("uid") or pod["metadata"]["name"]
+            born = self.first_seen.setdefault(uid, self.tick_no)
+            if self.tick_no - born < BOOT_TICKS:
+                continue
+            ip = f"10.88.0.{self.ip_counter}"
+            self.ip_counter += 1
+            fresh = self.raw_store.get("Pod", "default",
+                                       pod["metadata"]["name"])
+            fresh.setdefault("status", {})["podIP"] = ip
+            fresh["status"]["phase"] = "Running"
+            fresh["status"]["conditions"] = [
+                {"type": "Ready", "status": "True"},
+                {"type": "PodScheduled", "status": "True"},
+            ]
+            self.raw_store.update(fresh)
+            self.addr_model[f"{ip}:8000"] = MODEL
+
+    # ---- the tick --------------------------------------------------------
+
+    def tick(self) -> None:
+        self.tick_no += 1
+        self.clock.advance(TICK_S)
+        self.wall.advance(TICK_S)
+        rel = self.rel_now()
+
+        prev_repairs = self.counter_total(REPAIRS_SERIES)
+        for ev in self.trace.due(rel):
+            self.apply_event(ev)
+            self.log.event(self.tick_no, ev)
+        self._kubelet()
+        self.lb.sync_all()
+        try:
+            self.aggregator.collect()
+        except Exception:
+            self.control_plane_errors += 1
+        plan = self.planner.tick(force=True)
+        if plan is not None:
+            self.last_plan = plan
+        try:
+            self.reconciler.reconcile("default", MODEL)
+        except Exception:
+            self.control_plane_errors += 1
+        # A repair deleted the group's pods AFTER this tick's LB sync;
+        # re-sync so the routing view the invariants audit reflects the
+        # store the reconciler just wrote.
+        self.lb.sync_all()
+
+        repairs = self.counter_total(REPAIRS_SERIES)
+        if repairs > prev_repairs:
+            self.repair_ticks.extend(
+                [self.tick_no] * int(round(repairs - prev_repairs))
+            )
+
+        groups = self.groups()
+        self.log.obs(
+            self.tick_no,
+            t=round(rel, 3),
+            groups_ready=sum(
+                1 for m in groups.values()
+                if slicegroup.group_ready(m, NUM_HOSTS)
+            ),
+            groups_total=len(groups),
+            routable=len(self.lb.group(MODEL).addresses()),
+            repairs=repairs,
+        )
+        self.checker.check_continuous(self, self.tick_no, rel)
+
+    def run(self) -> dict:
+        for _ in range(WARMUP_TICKS):
+            self.tick()
+        # Baseline AFTER warmup: steady-state creation is not repair.
+        self._metrics_base = self.metrics.registry.expose()
+        for _ in range(self.ticks):
+            self.tick()
+        self.checker.check_terminal(self, self.tick_no, self.rel_now())
+        fv = self.checker.first_violation
+        groups = self.groups()
+        return {
+            "ticks": self.ticks,
+            "trace_events": len(self.trace.events),
+            "kills": len(self.kill_ticks),
+            "repairs": len(self.repair_ticks),
+            "groups_ready": sum(
+                1 for m in groups.values()
+                if slicegroup.group_ready(m, NUM_HOSTS)
+            ),
+            "routable": sorted(self.lb.group(MODEL).addresses()),
+            "pod_replacements": self.counter_total(REPLACE_SERIES),
+            "control_plane_errors": self.control_plane_errors,
+            "violations": [
+                {"tick": v.tick, "t": v.t, "invariant": v.invariant,
+                 "detail": v.detail}
+                for v in self.checker.violations
+            ],
+            "first_violation": None if fv is None else {
+                "tick": fv.tick, "t": fv.t, "invariant": fv.invariant,
+                "detail": fv.detail,
+            },
+            "log": self.log,
+        }
+
+
+# ---- invariants --------------------------------------------------------------
+
+
+def _inv_no_partial_group_routable(world) -> str | None:
+    routable = set(world.lb.group(MODEL).addresses())
+    extra = routable - world.ready_group_addrs()
+    if extra:
+        return (
+            f"routable address(es) {sorted(extra)} do not belong to a "
+            "fully-Ready slice group's coordinator"
+        )
+    return None
+
+
+def _inv_aggregator_groups_truthful(world) -> str | None:
+    snap = world.aggregator.snapshot()
+    if not snap:
+        return None
+    entry = (snap.get("models") or {}).get(MODEL) or {}
+    groups = (entry.get("pods") or {}).get("groups")
+    if not groups:
+        return None
+    actual = sum(
+        1 for m in world.groups().values()
+        if slicegroup.group_ready(m, NUM_HOSTS)
+    )
+    if groups["ready"] > actual:
+        return (
+            f"snapshot reports {groups['ready']} Ready groups, the store "
+            f"holds {actual} — a partial/broken group was counted Ready"
+        )
+    return None
+
+
+def _inv_planner_whole_groups(world) -> str | None:
+    plan = world.last_plan
+    if plan is None:
+        return None
+    if plan["allocated_chips"]["total"] > plan["budget"]["total"]:
+        return (
+            f"plan allocates {plan['allocated_chips']['total']} chips "
+            f"with only {plan['budget']['total']} in inventory"
+        )
+    for shape, used in plan["allocated_chips"]["by_shape"].items():
+        if used > plan["budget"]["by_shape"].get(shape, 0):
+            return f"shape {shape} over-allocated: {used}"
+    rec = plan["models"].get(MODEL)
+    if rec and rec["chips_allocated"] % GROUP_CHIPS:
+        return (
+            f"model {MODEL} allocated {rec['chips_allocated']} chips — "
+            f"not a whole number of {GROUP_CHIPS}-chip groups"
+        )
+    return None
+
+
+def _inv_atomic_repair(world) -> str | None:
+    kills, repairs = world.kill_ticks, world.repair_ticks
+    if len(repairs) != len(kills):
+        return (
+            f"{len(kills)} host kill(s) produced {len(repairs)} "
+            "whole-group repair(s) — want exactly one each"
+        )
+    for kill_t, repair_t in zip(kills, repairs):
+        if repair_t - kill_t > REPAIR_BOUND_TICKS:
+            return (
+                f"repair lagged the kill by {repair_t - kill_t} ticks "
+                f"(bound {REPAIR_BOUND_TICKS})"
+            )
+    replaced = world.counter_total(REPLACE_SERIES)
+    if replaced != len(kills) * NUM_HOSTS:
+        return (
+            f"{replaced:.0f} pod replacements for {len(kills)} group "
+            f"repair(s) — want {NUM_HOSTS} per group, whole groups only"
+        )
+    return None
+
+
+def _inv_convergence(world) -> str | None:
+    groups = world.groups()
+    ready = sum(
+        1 for m in groups.values()
+        if slicegroup.group_ready(m, NUM_HOSTS)
+    )
+    if ready != REPLICAS:
+        return f"{ready}/{REPLICAS} groups Ready at end of run"
+    routable = world.lb.group(MODEL).addresses()
+    if len(routable) != REPLICAS:
+        return (
+            f"{len(routable)} routable endpoint(s) for {REPLICAS} "
+            "Ready groups"
+        )
+    return None
+
+
+INVARIANTS = (
+    Invariant("no_partial_group_routable", _inv_no_partial_group_routable,
+              CONTINUOUS,
+              "every routable address is a fully-Ready group's host 0"),
+    Invariant("aggregator_groups_truthful", _inv_aggregator_groups_truthful,
+              CONTINUOUS,
+              "the fleet snapshot never counts a partial group Ready"),
+    Invariant("planner_whole_groups", _inv_planner_whole_groups,
+              CONTINUOUS,
+              "plans fit the slice inventory in whole groups"),
+    Invariant("atomic_repair", _inv_atomic_repair, TERMINAL,
+              "one kill -> one whole-group repair, within backoff bounds"),
+    Invariant("convergence", _inv_convergence, TERMINAL,
+              "all groups Ready and routable at end of run"),
+)
+
+
+# ---- traces ------------------------------------------------------------------
+
+
+def default_trace() -> GameDayTrace:
+    """Two member-host kills, staggered: a preempted worker (host 1 of
+    group 0), then a crash-looping coordinator (host 0 of group 1) —
+    both must yield one atomic whole-group repair."""
+    return GameDayTrace([
+        GameDayEvent(3.0, EV_KILL_GROUP_HOST, MODEL,
+                     {"group": 0, "host": 1, "mode": "preempt"}),
+        GameDayEvent(10.0, EV_KILL_GROUP_HOST, MODEL,
+                     {"group": 1, "host": 0, "mode": "crashloop"}),
+    ])
+
+
+def run(trace: GameDayTrace | None = None, ticks: int = 22) -> dict:
+    return SliceGroupWorld(trace or default_trace(), ticks).run()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ticks", type=int, default=22)
+    ap.add_argument("--dump", default="")
+    args = ap.parse_args()
+    result = run(ticks=args.ticks)
+    log = result.pop("log")
+    if args.dump:
+        log.dump(args.dump)
+    print(json.dumps(result, indent=2, sort_keys=True))
+    return 1 if result["violations"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,11 @@ MODEL_POD_PORT_ANNOTATION = "model-pod-port"
 MODEL_POD_SERVING_ANNOTATION = "model-pod-serving"
 POD_GROUP_LABEL = "model-group-index"
 POD_HOST_LABEL = "model-host-index"
+# Expected member count of the pod's slice group, stamped on every
+# member so consumers that see only pods (LB sync, fleet aggregation)
+# can tell a complete group from a partial one without re-resolving the
+# model's profile.
+POD_GROUP_SIZE_LABEL = "model-group-size"
 
 # Disaggregated serving (kubeai_tpu/disagg): a replica's serving role.
 # Unified replicas carry no role label; prefill/decode pod groups are

@@ -524,6 +524,51 @@ class EngineStep:
             )
 
 
+# Logical mesh axes a sharding block may size (SpecLayout vocabulary:
+# data-parallel replicas, FSDP weight shards, tensor-parallel shards).
+MESH_AXES = ("data", "fsdp", "tp")
+
+_TOPOLOGY_RE = re.compile(r"^\d+x\d+(x\d+)?$")
+
+
+@dataclasses.dataclass
+class Sharding:
+    """Multi-host slice-group serving (in-tree engine only). Declares
+    that one replica is a *process group* of `hosts` pods spanning one
+    ICI-connected TPU slice of the given `topology` (e.g. "4x4"), with
+    the model partitioned over the logical `mesh` axes (data/fsdp/tp).
+    The operator then plans, repairs, routes, and bin-packs the group
+    as one atomic unit — never a partial group. hosts=0 / topology=""
+    inherit the resource profile's values; an explicit value here wins
+    over the profile."""
+
+    hosts: int = 0  # host pods per replica; 0 = profile default
+    topology: str = ""  # ICI slice topology, e.g. "4x4" / "4x4x4"
+    mesh: dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def enabled(self) -> bool:
+        return bool(self.hosts or self.topology or self.mesh)
+
+    def validate(self) -> None:
+        if self.hosts < 0:
+            raise ValidationError("sharding.hosts must be >= 0")
+        if self.topology and not _TOPOLOGY_RE.match(self.topology):
+            raise ValidationError(
+                'sharding.topology must look like "4x4" or "4x4x4", '
+                f"got {self.topology!r}"
+            )
+        for axis, size in self.mesh.items():
+            if axis not in MESH_AXES:
+                raise ValidationError(
+                    f"sharding.mesh axis must be one of {list(MESH_AXES)}, "
+                    f"got {axis!r}"
+                )
+            if not isinstance(size, int) or size < 1:
+                raise ValidationError(
+                    f"sharding.mesh[{axis!r}] must be an integer >= 1"
+                )
+
+
 @dataclasses.dataclass
 class ModelSpec:
     """(reference: api/k8s/v1/model_types.go:36-144)"""
@@ -574,6 +619,8 @@ class ModelSpec:
     cold_start: ColdStart = dataclasses.field(default_factory=ColdStart)
     # Engine step-loop tuning (overlapped step pipeline; in-tree only).
     engine_step: EngineStep = dataclasses.field(default_factory=EngineStep)
+    # Multi-host slice-group serving (in-tree engine only).
+    sharding: Sharding = dataclasses.field(default_factory=Sharding)
     # Graceful-drain budget: seconds an engine waits for in-flight
     # generations after SIGTERM / POST /v1/drain before terminating the
     # remainder. 0 = the system config `resilience.drainTimeout`
@@ -684,6 +731,11 @@ class ModelSpec:
         if self.engine_step.enabled() and self.engine != ENGINE_KUBEAI_TPU:
             raise ValidationError(
                 "spec.engineStep requires the KubeAITPU engine"
+            )
+        self.sharding.validate()
+        if self.sharding.enabled() and self.engine != ENGINE_KUBEAI_TPU:
+            raise ValidationError(
+                "spec.sharding requires the KubeAITPU engine"
             )
         if self.kv_cache.dtype == "int8" and self.speculative_tokens:
             raise ValidationError(
@@ -854,6 +906,7 @@ class Model:
         kvc = spec.get("kvCache", {}) or {}
         cold = spec.get("coldStart", {}) or {}
         estep = spec.get("engineStep", {}) or {}
+        shd = spec.get("sharding", {}) or {}
         ten = spec.get("tenancy", {}) or {}
         slo = spec.get("slo", {}) or {}
 
@@ -1000,6 +1053,14 @@ class Model:
                 ),
                 engine_step=EngineStep(
                     overlap=estep.get("overlap", "") or "",
+                ),
+                sharding=Sharding(
+                    hosts=int(shd.get("hosts", 0) or 0),
+                    topology=shd.get("topology", "") or "",
+                    mesh={
+                        k: int(v)
+                        for k, v in (shd.get("mesh") or {}).items()
+                    },
                 ),
             ),
             status=ModelStatus(
@@ -1167,6 +1228,15 @@ def _spec_to_dict(s: ModelSpec) -> dict:
         d["kvCache"] = {"dtype": s.kv_cache.dtype}
     if s.engine_step.enabled():
         d["engineStep"] = {"overlap": s.engine_step.overlap}
+    if s.sharding.enabled():
+        shd: dict[str, Any] = {}
+        if s.sharding.hosts:
+            shd["hosts"] = s.sharding.hosts
+        if s.sharding.topology:
+            shd["topology"] = s.sharding.topology
+        if s.sharding.mesh:
+            shd["mesh"] = dict(s.sharding.mesh)
+        d["sharding"] = shd
     if s.cold_start.enabled:
         cold = s.cold_start
         d["coldStart"] = {

@@ -56,7 +56,7 @@ from kubeai_tpu.metrics.registry import (
     parse_prometheus_text,
     quantiles_from_buckets,
 )
-from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator import k8sutils, slicegroup
 
 logger = logging.getLogger(__name__)
 
@@ -431,15 +431,23 @@ class FleetStateAggregator:
             if chips <= 0:
                 continue
             shape = k8sutils.node_slice_shape(node)
+            slice_chips = k8sutils.node_slice_chip_count(node)
             entry = shapes.setdefault(
-                shape, {"chips": 0, "nodes": 0, "slice_chips": chips}
+                shape, {"chips": 0, "nodes": 0, "slice_chips": slice_chips}
             )
+            # Each node contributes ITS OWN allocatable chips to the
+            # shape's budget — a multi-host slice's member nodes
+            # together make up the slice, so summing whole-slice chips
+            # per node would count the slice once per member.
             entry["chips"] += chips
             entry["nodes"] += 1
-            # One Node = one schedulable slice of this shape; a replica
-            # cannot span slices, so the per-slice chip count bounds the
-            # largest replica this shape can host.
-            entry["slice_chips"] = max(entry["slice_chips"], chips)
+            # A replica cannot span slices, so the chips of one WHOLE
+            # ICI slice (the topology product — not one member VM's
+            # allocatable) bound the largest replica this shape hosts:
+            # on a 4x4x4 slice of 4-chip VMs that is 64, and taking the
+            # per-node max instead would tell the planner a multi-host
+            # group can never place.
+            entry["slice_chips"] = max(entry["slice_chips"], slice_chips)
             total += chips
         return {
             "total": total,
@@ -463,10 +471,14 @@ class FleetStateAggregator:
                     "slice_chips": {},
                 },
             }
+        group_members: dict[tuple[str, int], list[dict]] = {}
         for pod in self.store.list("Pod", self.namespace):
             model = k8sutils.get_label(pod, md.POD_MODEL_LABEL)
             if not model:
                 continue
+            g = slicegroup.group_index(pod)
+            if g is not None:
+                group_members.setdefault((model, g), []).append(pod)
             role = (
                 k8sutils.get_label(pod, md.POD_ROLE_LABEL)
                 or md.ROLE_UNIFIED
@@ -498,6 +510,24 @@ class FleetStateAggregator:
             by_shape[shape] = by_shape.get(shape, 0) + chips
             pods_by_shape[shape] = pods_by_shape.get(shape, 0) + 1
             total_chips += chips
+        # Join member pods into per-group health: a replica of a
+        # multi-host model is a GROUP, and only complete all-ready
+        # groups count as serving capacity. Models without group labels
+        # carry no "groups" key — their entries are unchanged.
+        for (model, g), members in sorted(group_members.items()):
+            entry = per_model[model]
+            groups = entry.setdefault(
+                "groups",
+                {"total": 0, "ready": 0, "partial": 0, "broken": 0},
+            )
+            groups["total"] += 1
+            expected = slicegroup.expected_size(members)
+            if slicegroup.group_ready(members, expected):
+                groups["ready"] += 1
+            elif not slicegroup.group_complete(members, expected):
+                groups["partial"] += 1
+            else:
+                groups["broken"] += 1
         return per_model, {
             "total": total_chips,
             "by_shape": by_shape,
@@ -540,6 +570,13 @@ class FleetStateAggregator:
                     m.fleet_kv_utilization,
                     sig["kv_utilization"], model=name, role=role,
                 )
+            groups = (entry.get("pods") or {}).get("groups")
+            if groups:
+                for state in ("ready", "partial", "broken"):
+                    set_(
+                        m.slicegroup_groups,
+                        groups[state], model=name, state=state,
+                    )
         for shape, chips in snap["chips"]["by_shape"].items():
             set_(m.fleet_chips, chips, shape=shape)
         m.fleet_snapshot_ts.set(snap["ts"])

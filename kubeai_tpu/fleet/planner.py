@@ -95,17 +95,36 @@ def model_scheduling_class(model) -> str:
     return cls if cls in SCHEDULING_CLASSES else "standard"
 
 
+def model_num_hosts(model, cfg) -> int:
+    """Host pods per replica: spec.sharding.hosts when set, else the
+    resource profile's numHosts, else 1. A multi-host replica is an
+    atomic N-pod group — the planner sizes and places it whole."""
+    sharding = getattr(model.spec, "sharding", None)
+    if sharding is not None and sharding.hosts:
+        return max(1, sharding.hosts)
+    if cfg is not None and model.spec.resource_profile:
+        name, _, _count = model.spec.resource_profile.partition(":")
+        prof = (cfg.resource_profiles or {}).get(name)
+        if prof is not None:
+            return max(1, getattr(prof, "num_hosts", 1) or 1)
+    return 1
+
+
 def model_chips_per_replica(model, cfg, pods_entry: dict | None) -> int:
     """Chips one replica of this model occupies: observed from its live
     pods' `google.com/tpu` requests when any exist, else derived from
     its resource profile (`name:count` multiplies the profile's chip
     request), else 1 — a model the planner cannot size still costs
-    SOMETHING, or an unsizable model would bin-pack for free."""
+    SOMETHING, or an unsizable model would bin-pack for free. For a
+    multi-host model one replica is `hosts` pods, so both paths scale
+    by the group size: a 2-host x8-chip replica is 16 chips, placed
+    atomically in one slice."""
     pods_entry = pods_entry or {}
     total = pods_entry.get("total") or 0
     chips = pods_entry.get("chips") or 0
+    hosts = model_num_hosts(model, cfg)
     if total > 0 and chips > 0:
-        return max(1, round(chips / total))
+        return max(1, round(chips / total)) * hosts
     if cfg is not None and model.spec.resource_profile:
         name, _, count_s = model.spec.resource_profile.partition(":")
         prof = (cfg.resource_profiles or {}).get(name)
@@ -119,7 +138,7 @@ def model_chips_per_replica(model, cfg, pods_entry: dict | None) -> int:
             ).get(k8sutils.TPU_RESOURCE)
             per = k8sutils.parse_chip_quantity(v, where=f"profile {name}")
             if per > 0:
-                return per * count
+                return per * count * hosts
     return 1
 
 
@@ -554,11 +573,16 @@ class CapacityPlanner:
             cpr = model_chips_per_replica(model, self.cfg, pods_entry)
             cls = model_scheduling_class(model)
             replicas = entry.get("replicas") or {}
+            # Replica counts, not pod counts: a multi-host model's pod
+            # inventory is hosts× its replica count.
+            hosts = model_num_hosts(model, self.cfg)
+            pod_total = pods_entry.get("total") or 0
+            current_pods = pod_total // hosts if hosts > 1 else pod_total
             if model.spec.autoscaling_disabled:
                 # Not under plan control, but its chips are spoken for:
                 # reserve them off the top so arbitration sees the true
                 # remaining budget.
-                current = pods_entry.get("total") or (
+                current = current_pods or (
                     model.spec.replicas or 0
                 )
                 e = {
@@ -583,7 +607,7 @@ class CapacityPlanner:
                 d["alloc_roles"] = {role: 0 for role in md.DISAGG_ROLES}
             else:
                 d = self._unified_desire(model, entry)
-                d["current"] = pods_entry.get("total") or sum(
+                d["current"] = current_pods or sum(
                     replicas.values()
                 ) or (model.spec.replicas or 0)
                 d["alloc"] = 0

@@ -534,6 +534,28 @@ class Metrics:
             "pass, per model and classification reason.",
             self.registry,
         )
+        # -- slice groups (multi-host replicas, operator/slicegroup) --------
+        self.slicegroup_groups = Gauge(
+            "kubeai_slicegroup_groups",
+            "Slice groups per model and state (ready|partial|broken) at "
+            "the fleet aggregator's last collection — a partial or "
+            "broken group is never serving capacity.",
+            self.registry,
+        )
+        self.slicegroup_repairs = Counter(
+            "kubeai_slicegroup_repairs_total",
+            "Whole-group atomic repairs issued by the group-health "
+            "pass, per model and the first broken member's "
+            "classification reason.",
+            self.registry,
+        )
+        self.slicegroup_ejections = Counter(
+            "kubeai_slicegroup_ejections_total",
+            "Slice groups ejected from load-balancer rotation because a "
+            "member pod was not ready, disrupted, or terminating while "
+            "the coordinator still looked routable, per model.",
+            self.registry,
+        )
         # -- actuation safety governor (operator/governor) -----------------
         self.governor_actions = Counter(
             "kubeai_governor_actions_total",

@@ -32,6 +32,7 @@ from kubeai_tpu.operator import cache as cache_mod
 from kubeai_tpu.operator import files as files_mod
 from kubeai_tpu.operator import governor as governor_mod
 from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator import slicegroup
 from kubeai_tpu.operator.governor import NotLeader
 from kubeai_tpu.operator.engine_client import EngineClient
 from kubeai_tpu.operator.engines import render_pod, resolve_model_config
@@ -157,8 +158,13 @@ class ModelReconciler:
         )
         # Self-healing pass: classify preempted / crash-looping /
         # stuck-Pending pods, delete-and-replace them (per-model backoff),
-        # and surface the result through status.conditions.
-        pods, degraded, repaired = self._pod_health_pass(model, pods)
+        # and surface the result through status.conditions. Multi-host
+        # models repair in GROUP units: one broken member poisons its
+        # whole slice group.
+        if mcfg.num_hosts > 1:
+            pods, degraded, repaired = self._group_health_pass(model, pods)
+        else:
+            pods, degraded, repaired = self._pod_health_pass(model, pods)
         n_all, ready = self._replica_counts(pods, mcfg)
         self._patch_status(
             model,
@@ -276,6 +282,95 @@ class ModelReconciler:
         self._repair_state[key] = (count + 1, now)
         self._persist_repair_state(model, count + 1)
         return healthy, degraded, True
+
+    def _group_health_pass(
+        self, model: Model, pods: list[dict]
+    ) -> tuple[list[dict], list[tuple[str, str]], bool]:
+        """Whole-group self-healing for multi-host replicas. One broken
+        member poisons its entire slice group — lockstep multihost
+        cannot survive a single host restarting with a fresh address —
+        so repair tears down EVERY member of an afflicted group through
+        the governor's atomic group-delete (one fenced action, never
+        budget-limited: the group is already broken) and lets the group
+        plan recreate the full group. The per-model exponential repair
+        backoff is shared with the single-host pass.
+
+        Returns (surviving pods, [(pod name, reason)...], repaired?)."""
+        r = self.cfg.resilience
+        key = (model.namespace, model.name)
+        now = self._clock()
+        groups = slicegroup.group_pods(pods)
+        singles = slicegroup.ungrouped_pods(pods)
+        broken_by_group: dict[int, list[tuple[str, str]]] = {}
+        for g, members in groups.items():
+            for p in members:
+                reason = k8sutils.classify_pod_failure(
+                    p,
+                    now=self._wall(),
+                    pending_deadline_s=r.pod_pending_deadline_seconds,
+                    restart_threshold=r.pod_restart_threshold,
+                )
+                if reason is not None:
+                    broken_by_group.setdefault(g, []).append(
+                        (p["metadata"]["name"], reason)
+                    )
+        if not broken_by_group:
+            st = self._repair_state.get(key)
+            if st and now - st[1] > r.repair_backoff_max_seconds:
+                self._repair_state.pop(key, None)
+                self._persist_repair_state(model, None)
+            return pods, [], False
+        degraded = [
+            nr for _, pairs in sorted(broken_by_group.items()) for nr in pairs
+        ]
+        count, last = (
+            self._repair_state.get(key)
+            or self._rehydrate_repair_state(model)
+        )
+        backoff = min(
+            r.repair_backoff_max_seconds,
+            r.repair_backoff_base_seconds * (2.0 ** min(count, 10)),
+        )
+        if count and now - last < backoff:
+            self._repair_state[key] = (count, last)
+            return pods, degraded, False
+        repaired_groups: set[int] = set()
+        for g, name_reasons in sorted(broken_by_group.items()):
+            members = groups[g]
+            names = [p["metadata"]["name"] for p in members]
+            first_name, first_reason = name_reasons[0]
+            self.governor.delete_group(
+                self.store, model.namespace, names,
+                model=model.name, reason=first_reason, budgeted=False,
+            )
+            self.metrics.slicegroup_repairs.inc(
+                model=model.name, reason=first_reason
+            )
+            # EVERY member is replaced, not just the broken ones: a
+            # healthy host torn down in the cascade is charged to the
+            # group's triggering reason.
+            broken_reasons = dict(name_reasons)
+            for name in names:
+                self.metrics.controller_pod_replacements.inc(
+                    model=model.name,
+                    reason=broken_reasons.get(name, first_reason),
+                )
+            logger.warning(
+                "group-health: replacing slice group g%d (%d hosts) of "
+                "model %s — member %s %s (repair streak %d)",
+                g, len(members), model.name, first_name, first_reason,
+                count + 1,
+            )
+            repaired_groups.add(g)
+        self._repair_state[key] = (count + 1, now)
+        self._persist_repair_state(model, count + 1)
+        surviving = singles + [
+            p
+            for g, members in sorted(groups.items())
+            if g not in repaired_groups
+            for p in members
+        ]
+        return surviving, degraded, True
 
     def _rehydrate_repair_state(self, model: Model) -> tuple[int, float]:
         """A restarted operator must not forget an in-flight repair

@@ -108,11 +108,24 @@ def resolve_model_config(model: Model, cfg: System) -> ModelConfig:
         image_name = profile.image_name or "default"
         image = images.get(image_name) or images["default"]
 
+    # spec.sharding overrides the profile's group shape: an explicit
+    # hosts-per-replica wins over profile.numHosts, and an explicit ICI
+    # topology wins over the profile's topology node selector.
+    node_selector = dict(profile.node_selector)
+    num_hosts = profile.num_hosts
+    if model.spec.sharding.enabled():
+        from kubeai_tpu.config.system import TPU_TOPOLOGY_SELECTOR
+
+        if model.spec.sharding.hosts:
+            num_hosts = model.spec.sharding.hosts
+        if model.spec.sharding.topology:
+            node_selector[TPU_TOPOLOGY_SELECTOR] = model.spec.sharding.topology
+
     return ModelConfig(
         image=image,
         requests=requests,
         limits=limits,
-        node_selector=dict(profile.node_selector),
+        node_selector=node_selector,
         affinity=profile.affinity,
         tolerations=list(profile.tolerations),
         scheduler_name=profile.scheduler_name,
@@ -120,7 +133,7 @@ def resolve_model_config(model: Model, cfg: System) -> ModelConfig:
         profile_name=profile_name,
         profile_count=count,
         source=parse_model_source(model.spec.url),
-        num_hosts=profile.num_hosts,
+        num_hosts=num_hosts,
     )
 
 

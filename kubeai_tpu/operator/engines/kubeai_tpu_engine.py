@@ -288,9 +288,22 @@ def kubeai_tpu_host_pods(
                 ),
             },
         ]
+        if model.spec.sharding.mesh:
+            # Logical mesh axis sizes (data/fsdp/tp) for the engine's
+            # SpecLayout; rendered in a stable axis order so the pod
+            # hash doesn't churn on dict ordering.
+            c["env"].append({
+                "name": "TPU_MESH",
+                "value": ",".join(
+                    f"{axis}={model.spec.sharding.mesh[axis]}"
+                    for axis in ("data", "fsdp", "tp")
+                    if axis in model.spec.sharding.mesh
+                ),
+            })
         labels = pod["metadata"]["labels"]
         labels[md.POD_GROUP_LABEL] = str(group)
         labels[md.POD_HOST_LABEL] = str(h)
+        labels[md.POD_GROUP_SIZE_LABEL] = str(mcfg.num_hosts)
         if h > 0:
             # Workers join the mesh but never serve HTTP: the LB must not
             # route to them.

@@ -55,6 +55,7 @@ logger = logging.getLogger(__name__)
 
 # Action vocabulary (metric label values; stable strings).
 ACTION_DELETE = "delete"
+ACTION_GROUP_DELETE = "group_delete"
 ACTION_CREATE = "create"
 ACTION_REPAIR = "repair"
 ACTION_MODEL_TEARDOWN = "model_teardown"
@@ -253,6 +254,60 @@ class ActuationGovernor:
             if self.enabled and budgeted:
                 self._refund_budget(model)
             raise
+        self._allow(action, model)
+        return True
+
+    def delete_group(
+        self,
+        store,
+        namespace: str,
+        names: list[str],
+        *,
+        model: str = "",
+        reason: str = "",
+        budgeted: bool = True,
+    ) -> bool:
+        """Fence-checked, budget-limited deletion of ONE slice group's
+        member pods, atomically from the budget's point of view: the
+        whole group consumes a single disruption-budget unit — an
+        N-host replica going away is one replica's worth of disruption,
+        not N pods' worth. This is the ONLY sanctioned path for
+        deleting group-member pods (`scripts/check_actuation_paths.py`
+        gates callers); per-pod deletes of members would tear a group
+        down one host at a time and burn N budget units doing it.
+
+        `budgeted=False` marks whole-group repair of an already-broken
+        group. Returns True when the members were deleted (missing ones
+        count as already gone), False when the governor refused the
+        whole group — members are never partially refused."""
+        self.check_fence()
+        action = ACTION_GROUP_DELETE if budgeted else ACTION_REPAIR
+        if self.enabled and budgeted:
+            if self.armed:
+                _cov, fresh = self._coverage(model)
+                if not fresh:
+                    self.metrics.governor_static_holds.inc(model=model)
+                    self._deny(action, model, DENY_STALE)
+                    return False
+            denied = self._consume_budget(model)
+            if denied is not None:
+                self._deny(action, model, denied)
+                return False
+        deleted_any = False
+        for name in names:
+            try:
+                store.delete("Pod", namespace, name)
+            except NotFound:
+                continue
+            except Exception:
+                # Refund only while the group is still intact: once one
+                # member is gone the group IS disrupted — the unit was
+                # genuinely spent, and the pod plan finishes the
+                # teardown on a later pass.
+                if self.enabled and budgeted and not deleted_any:
+                    self._refund_budget(model)
+                raise
+            deleted_any = True
         self._allow(action, model)
         return True
 

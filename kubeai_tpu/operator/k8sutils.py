@@ -5,6 +5,7 @@ from __future__ import annotations
 import hashlib
 import json
 import logging
+import re
 
 logger = logging.getLogger(__name__)
 
@@ -251,6 +252,43 @@ def _slice_shape(selectors: dict, chips: int) -> str:
     if chips:
         return f"tpu-{chips}"
     return "cpu"
+
+
+_TOPOLOGY_RE = re.compile(r"^\d+x\d+(?:x\d+)?$")
+
+
+def topology_chip_count(topo: str) -> int | None:
+    """Total chips in an ICI topology string: "4x4" -> 16,
+    "4x4x4" -> 64. On a multi-host slice this is the chips of the WHOLE
+    slice, not of one member VM — chips-per-node times hosts. Returns
+    None (with a warning) on malformed shapes so callers fall back to
+    per-node counting instead of inventing a number."""
+    if not topo or not isinstance(topo, str):
+        return None
+    if not _TOPOLOGY_RE.match(topo):
+        logger.warning("malformed TPU topology %r: ignoring", topo)
+        return None
+    n = 1
+    for dim in topo.split("x"):
+        n *= int(dim)
+    if n < 1:
+        logger.warning("degenerate TPU topology %r: ignoring", topo)
+        return None
+    return n
+
+
+def node_slice_chip_count(node: dict) -> int:
+    """Chips of the whole ICI slice this Node belongs to: the topology
+    product when the node carries a parseable GKE topology label, else
+    the node's own allocatable chips. On a 4x4x4 slice served by
+    sixteen 4-chip VMs this is 64, not 4 — the difference between
+    pricing a slice and pricing one member VM."""
+    labels = (node.get("metadata") or {}).get("labels") or {}
+    per_slice = topology_chip_count(labels.get(TPU_TOPOLOGY_LABEL, ""))
+    own = node_chip_capacity(node)
+    if per_slice is not None and per_slice >= own:
+        return per_slice
+    return own
 
 
 def pod_slice_shape(pod: dict) -> str:

@@ -82,6 +82,7 @@ class Manager:
             self.cfg,
             engine_client=self.engine_client,
             pod_exec=self.pod_exec,
+            metrics=self.metrics,
         )
         self.controller_loop = ControllerLoop(self.reconciler)
         self.leader = LeaderElection(

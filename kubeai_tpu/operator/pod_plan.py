@@ -19,7 +19,7 @@ import dataclasses
 
 from kubeai_tpu.crd import metadata as md
 from kubeai_tpu.crd.model import Model
-from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator import k8sutils, slicegroup
 from kubeai_tpu.operator.k8s.store import KubeStore, NotFound, Conflict
 
 
@@ -30,6 +30,16 @@ class PodPlan:
     to_delete: list[dict]
     to_remain: list[dict]
     details: list[str]
+    # Multi-host: the member pods of each slice group being deleted,
+    # one inner list per group, ordered broken-groups-first. Members
+    # also appear flattened in `to_delete` (so inspection and counting
+    # stay uniform); `execute()` routes each inner list through the
+    # governor's atomic group-delete — one disruption-budget unit per
+    # group — and skips those members in the per-pod loop. Empty for
+    # single-host plans, keeping them identical to the pre-group world.
+    to_delete_groups: list[list[dict]] = dataclasses.field(
+        default_factory=list
+    )
 
     def contains_actions(self) -> bool:
         return bool(self.to_create or self.to_delete)
@@ -46,8 +56,33 @@ class PodPlan:
         gov.check_fence()
         changed = False
         model_name = self.model.name
-        # Delete before create (reference: pod_plan.go:179).
+        # Delete before create (reference: pod_plan.go:179). Slice
+        # groups go first, atomically: the whole group is one replica,
+        # so it pays ONE budget unit — and only when every member was
+        # healthy (a group with any broken member is already disrupted;
+        # replacing it is repair).
+        grouped: set[str] = set()
+        for members in self.to_delete_groups:
+            if not members:
+                continue
+            budgeted = all(
+                k8sutils.pod_is_ready(p)
+                and k8sutils.pod_disruption_reason(p) is None
+                for p in members
+            )
+            names = [p["metadata"]["name"] for p in members]
+            grouped.update(names)
+            if gov.delete_group(
+                store,
+                members[0]["metadata"]["namespace"],
+                names,
+                model=model_name,
+                budgeted=budgeted,
+            ):
+                changed = True
         for pod in self.to_delete:
+            if pod["metadata"]["name"] in grouped:
+                continue
             # Deleting a pod that is already broken (not ready, or
             # disrupted) is repair; only healthy serving capacity
             # consumes disruption budget.
@@ -253,10 +288,29 @@ def calculate_group_pod_plan(
     remain = [
         p for n, p in existing.items() if n not in deleted and n in desired
     ]
+    # Deletions execute in GROUP units: join the flat delete list back
+    # into member lists per group index (stale teardown and surplus
+    # scale-down alike), broken groups first — they serve nothing and
+    # cost no budget — then youngest (highest index) first, matching
+    # the single-host youngest-first scale-down bias. Pods without
+    # group labels (shouldn't happen under this planner, but a manual
+    # pod could drift in) stay individual deletions.
+    delete_groups = slicegroup.group_pods(to_delete)
+
+    def _group_order(item: tuple[int, list[dict]]):
+        g, members = item
+        broken = any(slicegroup.member_broken(p) for p in members)
+        return (not broken, -g)
+
+    to_delete_groups = [
+        members for _, members in sorted(delete_groups.items(),
+                                         key=_group_order)
+    ]
     return PodPlan(
         model=model,
         to_create=to_create,
         to_delete=to_delete,
         to_remain=remain,
         details=details,
+        to_delete_groups=to_delete_groups,
     )

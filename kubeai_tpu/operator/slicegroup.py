@@ -1,0 +1,153 @@
+"""Slice groups: multi-host replicas as first-class atomic units.
+
+One multi-host replica is a *process group* of N host pods spanning one
+ICI-connected TPU slice (the unit of scale on TPU pods — MLPerf-0.6 on
+TPU-v3 Pods; Limits of Concurrency on Google TPUs). The renderer stamps
+every member with the group index (`POD_GROUP_LABEL`) and host index
+(`POD_HOST_LABEL`); this module is the ONE place that joins those labels
+back into group objects, so the reconciler, load balancer, fleet
+aggregator, and capacity planner all agree on what a group is and when
+it is healthy.
+
+The atomicity contract every consumer enforces through these helpers:
+
+- a group is Ready only when ALL members are Ready — no partial group
+  is ever surfaced as serving capacity;
+- one broken member marks the WHOLE group broken — repair replaces the
+  group, never one host (lockstep multihost cannot survive a member
+  restart with fresh addresses);
+- deletions of group members route through the governor's group-delete
+  helper and consume ONE disruption-budget unit per group, not one per
+  pod (`scripts/check_actuation_paths.py` gates this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.operator import k8sutils
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class GroupKey:
+    """Identity of one slice group: (model, group index). Hashable and
+    ordered so groups sort deterministically in plans and snapshots."""
+
+    model: str
+    group: int
+
+    def __str__(self) -> str:
+        return f"{self.model}/g{self.group}"
+
+
+def group_index(pod: dict) -> int | None:
+    """The pod's group index, or None for single-host (ungrouped) pods.
+    A malformed label counts as ungrouped rather than raising — one bad
+    pod must not take down a reconcile pass."""
+    raw = k8sutils.get_label(pod, md.POD_GROUP_LABEL)
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return None
+
+
+def host_index(pod: dict) -> int | None:
+    """The pod's host index within its group, or None when unlabeled."""
+    raw = k8sutils.get_label(pod, md.POD_HOST_LABEL)
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return None
+
+
+def group_size(pod: dict) -> int | None:
+    """Expected member count of the pod's group (the renderer stamps
+    `POD_GROUP_SIZE_LABEL` on every member), or None when unlabeled —
+    older pods rendered before the label existed fall back to counting
+    present members."""
+    raw = k8sutils.get_label(pod, md.POD_GROUP_SIZE_LABEL)
+    if raw is None:
+        return None
+    try:
+        n = int(raw)
+    except (TypeError, ValueError):
+        return None
+    return n if n >= 1 else None
+
+
+def expected_size(members: list[dict], default: int = 0) -> int:
+    """Best-known expected size of a group from its members' size
+    labels (max wins — a rollout changing the size renders fresh
+    labels), else `default`, else the member count itself."""
+    sizes = [s for s in (group_size(p) for p in members) if s is not None]
+    if sizes:
+        return max(sizes)
+    return default or len(members)
+
+
+def group_pods(pods: list[dict]) -> dict[int, list[dict]]:
+    """Join member pods into groups by group index, members sorted by
+    host index (host 0 — the coordinator — first). Ungrouped pods are
+    excluded; use `ungrouped_pods` for those."""
+    groups: dict[int, list[dict]] = {}
+    for pod in pods:
+        g = group_index(pod)
+        if g is None:
+            continue
+        groups.setdefault(g, []).append(pod)
+    for members in groups.values():
+        members.sort(key=lambda p: (host_index(p) or 0,
+                                    (p.get("metadata") or {}).get("name", "")))
+    return groups
+
+
+def ungrouped_pods(pods: list[dict]) -> list[dict]:
+    """Pods with no group label — the single-host world."""
+    return [p for p in pods if group_index(p) is None]
+
+
+def coordinator_pod(members: list[dict]) -> dict | None:
+    """Host 0 of a group — the lockstep coordinator and the ONE
+    endpoint the load balancer routes to."""
+    for pod in members:
+        if host_index(pod) == 0:
+            return pod
+    return None
+
+
+def group_complete(members: list[dict], num_hosts: int) -> bool:
+    """All N hosts exist (regardless of readiness)."""
+    return len(members) >= num_hosts
+
+
+def group_ready(members: list[dict], num_hosts: int) -> bool:
+    """The group is serving capacity: complete AND every member Ready
+    AND no member disrupted or terminating. Anything less is not a
+    smaller group — it is no group."""
+    if not group_complete(members, num_hosts):
+        return False
+    return not any(member_broken(p) for p in members)
+
+
+def member_broken(pod: dict) -> bool:
+    """One member in a state that poisons the whole group: not Ready,
+    disrupted (preempted/evicted), or already terminating."""
+    return (
+        not k8sutils.pod_is_ready(pod)
+        or k8sutils.pod_disruption_reason(pod) is not None
+        or k8sutils.pod_is_terminating(pod)
+    )
+
+
+def group_broken(members: list[dict], num_hosts: int) -> bool:
+    """True when the group needs whole-group repair: a member is
+    missing, or any present member is broken. (A brand-new group that
+    is merely still booting is NOT broken — callers that repair should
+    classify members with `classify_pod_failure` first; this predicate
+    answers routability, not repair.)"""
+    return not group_ready(members, num_hosts)

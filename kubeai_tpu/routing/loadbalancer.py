@@ -33,7 +33,7 @@ from kubeai_tpu.crd import metadata as md
 from kubeai_tpu.crd.model import (
     LB_STRATEGY_PREFIX_HASH,
 )
-from kubeai_tpu.operator import k8sutils
+from kubeai_tpu.operator import k8sutils, slicegroup
 from kubeai_tpu.operator.k8s.store import KubeStore
 from kubeai_tpu.metrics import DEFAULT_METRICS, Metrics
 from kubeai_tpu.metrics import flightrecorder
@@ -601,11 +601,33 @@ class LoadBalancer:
             self._self_ips = addrs
 
     def sync_model(self, model: str, namespace: str = "default") -> None:
+        pods = self.store.list("Pod", namespace, {md.POD_MODEL_LABEL: model})
+        # A slice group is ONE endpoint, keyed to host 0 — and it is
+        # ejected WHOLE when any member is missing, not ready, disrupted,
+        # or terminating. A lockstep group short one host serves nothing,
+        # even while its coordinator still reports Ready; routing to it
+        # would hang requests until the group repair lands.
+        blocked_groups: set[int] = set()
+        for g, members in slicegroup.group_pods(pods).items():
+            if not slicegroup.group_ready(
+                members, slicegroup.expected_size(members)
+            ):
+                blocked_groups.add(g)
         observed: dict[str, set[str]] = {}
         roles: dict[str, str] = {}
-        for pod in self.store.list(
-            "Pod", namespace, {md.POD_MODEL_LABEL: model}
-        ):
+        for pod in pods:
+            g = slicegroup.group_index(pod)
+            if g is not None and g in blocked_groups:
+                if (
+                    slicegroup.host_index(pod) == 0
+                    and k8sutils.pod_is_ready(pod)
+                    and k8sutils.pod_disruption_reason(pod) is None
+                ):
+                    # The coordinator alone would have passed the
+                    # per-pod filters below: this is a true whole-group
+                    # ejection, not a dead endpoint.
+                    self.metrics.slicegroup_ejections.inc(model=model)
+                continue
             if not k8sutils.pod_is_ready(pod):
                 continue
             # Preempted / evicted pods are ejected the moment the watch

@@ -55,6 +55,7 @@ EV_TENANT_FLOOD = "tenant_flood"    # params: tenant, model, rps, duration_s
 EV_CHIP_FLIP = "chip_flip"          # params: delta (spot nodes +/-)
 EV_TELEMETRY_STALE = "telemetry_stale"  # params: duration_s
 EV_LINK_DROP = "link_drop"          # params: model, index, duration_s
+EV_KILL_GROUP_HOST = "kill_group_host"  # params: model, group, host, mode
 
 EVENT_KINDS = (
     EV_KILL_POD,
@@ -66,6 +67,7 @@ EVENT_KINDS = (
     EV_CHIP_FLIP,
     EV_TELEMETRY_STALE,
     EV_LINK_DROP,
+    EV_KILL_GROUP_HOST,
 )
 
 # ---- shared incident/flight schema -------------------------------------------

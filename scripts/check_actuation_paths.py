@@ -17,7 +17,13 @@ self-harm hole PR 8 closed. This gate scans kubeai_tpu/ for:
     the capacity planner, and — checked structurally — the planner's own
     grant site must sit in a function that consults
     `governor.allow_prewarm`, so the prewarm gate can't be silently
-    dropped while the metric-shaped plumbing stays green.
+    dropped while the metric-shaped plumbing stays green;
+  - member-wise slice-group deletions: a `.delete_pod(` call nested in
+    a loop over group members consumes one budget unit PER MEMBER and
+    can leave a partial multi-host group behind. Whole groups are
+    deleted through `ActuationGovernor.delete_group` (one budget unit,
+    all members, atomic refund semantics), so any `.delete_pod(` whose
+    enclosing `for` iterates something group-shaped is a violation.
 
 A hit is a violation unless it is
 
@@ -131,6 +137,49 @@ def _prewarm_violations(rel: str, text: str, lines: list[str]) -> list[str]:
     return violations
 
 
+# Loops whose iterable mentions group membership: `plan.to_delete_groups`,
+# `slicegroup.group_pods(...)`, `members_by_group[g]`, ...
+_GROUP_ITER = re.compile(r"group", re.I)
+
+
+def _group_delete_violations(rel: str, text: str, lines: list[str]) -> list[str]:
+    """A `.delete_pod(` call lexically inside a `for` loop that iterates
+    group members is a member-wise group deletion — it miscounts the
+    disruption budget (N units instead of 1) and a mid-loop failure
+    strands a partial group. Route it through delete_group."""
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return []
+    out: list[str] = []
+
+    def visit(node: ast.AST, group_loops: int) -> None:
+        for child in ast.iter_child_nodes(node):
+            depth = group_loops
+            if isinstance(child, (ast.For, ast.AsyncFor)):
+                seg = ast.get_source_segment(text, child.iter) or ""
+                if _GROUP_ITER.search(seg):
+                    depth += 1
+            if (
+                isinstance(child, ast.Call)
+                and isinstance(child.func, ast.Attribute)
+                and child.func.attr == "delete_pod"
+                and depth
+                and not _has_pragma(lines, child.lineno)
+            ):
+                out.append(
+                    f"{rel}:{child.lineno}: member-wise slice-group "
+                    f"deletion `{lines[child.lineno - 1].strip()[:80]}` "
+                    "— delete whole groups through "
+                    "ActuationGovernor.delete_group (one budget unit, "
+                    "all members) or annotate `# ungoverned: <reason>`"
+                )
+            visit(child, depth)
+
+    visit(tree, 0)
+    return out
+
+
 def check(pkg: str = PKG) -> list[str]:
     """Returns human-readable violations (empty = every destructive
     call site is governed or explicitly reviewed)."""
@@ -161,6 +210,7 @@ def check(pkg: str = PKG) -> list[str]:
                         "annotate `# governed:`/`# ungoverned: <reason>`"
                     )
             violations.extend(_prewarm_violations(rel, text, lines))
+            violations.extend(_group_delete_violations(rel, text, lines))
     return sorted(set(violations))
 
 

@@ -750,6 +750,37 @@ def test_actuation_path_gate_catches_prewarm_paths(tmp_path):
     )
 
 
+def test_actuation_path_gate_catches_memberwise_group_delete(tmp_path):
+    """The slice-group extension: a `.delete_pod(` inside a loop over
+    group members fails the gate (budget miscount + partial-group
+    risk); the same shape behind a reviewed pragma, a delete_group
+    call, or a non-group loop all pass."""
+    pkg = tmp_path / "kubeai_tpu"
+    pkg.mkdir()
+    (pkg / "rogue_group.py").write_text(
+        "def f(gov, store, plan):\n"
+        "    for members in plan.to_delete_groups:\n"
+        "        for pod in members:\n"
+        "            gov.delete_pod(store, 'ns', pod)\n"
+    )
+    (pkg / "fine_group.py").write_text(
+        "def whole(gov, store, plan):\n"
+        "    for members in plan.to_delete_groups:\n"
+        "        gov.delete_group(store, 'ns', members)\n"
+        "def singles(gov, store, plan):\n"
+        "    for pod in plan.to_delete:\n"
+        "        gov.delete_pod(store, 'ns', pod)\n"
+        "def reviewed(gov, store, groups):\n"
+        "    for pod in groups[0]:\n"
+        "        # ungoverned: reviewed test site\n"
+        "        gov.delete_pod(store, 'ns', pod)\n"
+    )
+    violations = _load_gate().check(pkg=str(pkg))
+    assert len(violations) == 1
+    assert "rogue_group.py" in violations[0]
+    assert "delete_group" in violations[0]
+
+
 # ---- chaos-sim invariants (the PR's acceptance criteria) ---------------------
 
 

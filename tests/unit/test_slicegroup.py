@@ -1,0 +1,688 @@
+"""Slice-group serving plane: multi-host replicas as first-class atomic
+units. Covers the membership model (operator/slicegroup), the `sharding:`
+CRD block, renderer labels, the governor's atomic group delete, group
+pod-plan semantics (incl. the single-host no-change pin), LB whole-group
+ejection, fleet-snapshot group joins, slice-aware chip budgeting, the
+`kill_group_host` chaos kind, and the deterministic slice-group sim whose
+invariants are this PR's acceptance criteria."""
+
+import copy
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+sys.path.insert(0, REPO_ROOT)
+
+from kubeai_tpu.config import System
+from kubeai_tpu.config.system import GovernorConfig
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import (
+    Model,
+    ModelSpec,
+    Sharding,
+    ValidationError,
+)
+from kubeai_tpu.fleet.aggregator import FleetStateAggregator
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.operator import k8sutils, slicegroup
+from kubeai_tpu.operator.engines import resolve_model_config
+from kubeai_tpu.operator.engines.kubeai_tpu_engine import (
+    kubeai_tpu_host_pods,
+)
+from kubeai_tpu.operator.governor import ActuationGovernor
+from kubeai_tpu.operator.k8s.store import KubeStore, NotFound
+from kubeai_tpu.operator.pod_plan import (
+    PodPlan,
+    calculate_group_pod_plan,
+    calculate_pod_plan,
+)
+from kubeai_tpu.routing.loadbalancer import LoadBalancer
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.testing.chaos import (
+    EVENT_KINDS,
+    EV_KILL_GROUP_HOST,
+    GameDayEvent,
+    GameDayLog,
+    GameDayTrace,
+)
+from kubeai_tpu.testing.faults import FakeClock
+
+
+def _member(name, model="big", group=0, host=0, size=2, ready=True,
+            ip=None, phase="Running", reason=None, serving=None):
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "labels": {
+                md.POD_MODEL_LABEL: model,
+                md.POD_GROUP_LABEL: str(group),
+                md.POD_HOST_LABEL: str(host),
+                md.POD_GROUP_SIZE_LABEL: str(size),
+            },
+            "annotations": {},
+        },
+        "spec": {},
+        "status": {
+            "phase": phase,
+            "conditions": [
+                {"type": "Ready", "status": "True" if ready else "False"},
+                {"type": "PodScheduled", "status": "True"},
+            ],
+        },
+    }
+    if ip:
+        pod["status"]["podIP"] = ip
+    if reason:
+        pod["status"]["reason"] = reason
+    if serving is not None:
+        pod["metadata"]["annotations"][md.MODEL_POD_SERVING_ANNOTATION] = (
+            serving
+        )
+    return pod
+
+
+# ---- membership model (operator/slicegroup) ---------------------------------
+
+
+def test_group_membership_and_readiness():
+    a = _member("g0-h0", group=0, host=0)
+    b = _member("g0-h1", group=0, host=1)
+    c = _member("g1-h0", group=1, host=0, ready=False)
+    plain = {"metadata": {"name": "solo", "labels": {}}}
+    grouped = slicegroup.group_pods([a, b, c, plain])
+    assert sorted(grouped) == [0, 1]
+    assert [p["metadata"]["name"] for p in grouped[0]] == ["g0-h0", "g0-h1"]
+    assert slicegroup.ungrouped_pods([a, plain]) == [plain]
+    assert slicegroup.coordinator_pod(grouped[0]) is a
+    assert slicegroup.expected_size(grouped[0]) == 2
+    assert slicegroup.group_ready(grouped[0], 2)
+    assert not slicegroup.group_ready([a], 2)  # partial: member missing
+    assert slicegroup.group_broken(grouped[1], 2)  # member not ready
+    assert slicegroup.member_broken(c)
+    assert not slicegroup.member_broken(a)
+    # Disrupted-but-Ready member still poisons the group.
+    d = _member("g2-h1", group=2, host=1, phase="Failed", reason="Preempted")
+    assert slicegroup.member_broken(d)
+    assert str(slicegroup.GroupKey("big", 3)) == "big/g3"
+
+
+def test_group_labels_tolerate_malformed_values():
+    bad = {"metadata": {"name": "x", "labels": {
+        md.POD_GROUP_LABEL: "not-a-number",
+        md.POD_HOST_LABEL: "",
+        md.POD_GROUP_SIZE_LABEL: "0",
+    }}}
+    assert slicegroup.group_index(bad) is None
+    assert slicegroup.host_index(bad) is None
+    assert slicegroup.group_size(bad) is None
+    assert slicegroup.group_pods([bad]) == {}
+    # expected_size falls back: label max > default > member count.
+    assert slicegroup.expected_size([bad], default=3) == 3
+    assert slicegroup.expected_size([bad]) == 1
+
+
+# ---- sharding: CRD block -----------------------------------------------------
+
+
+def _sharded_model(**sharding_kw):
+    return Model(
+        name="big",
+        spec=ModelSpec(
+            url="hf://org/llama-70b",
+            engine="KubeAITPU",
+            resource_profile="google-tpu-v5e-4x4:8",
+            replicas=1,
+            sharding=Sharding(**sharding_kw),
+        ),
+    )
+
+
+def test_sharding_validate_and_round_trip():
+    m = _sharded_model(hosts=2, topology="4x4",
+                       mesh={"data": 1, "fsdp": 4, "tp": 4})
+    m.validate()
+    d = m.to_dict()
+    assert d["spec"]["sharding"] == {
+        "hosts": 2, "topology": "4x4", "mesh": {"data": 1, "fsdp": 4, "tp": 4},
+    }
+    back = Model.from_dict(d)
+    assert back.spec.sharding == m.spec.sharding
+    # Disabled block serializes to nothing and round-trips to nothing.
+    plain = _sharded_model()
+    plain.validate()
+    assert "sharding" not in plain.to_dict()["spec"]
+    assert not Model.from_dict(plain.to_dict()).spec.sharding.enabled()
+
+
+@pytest.mark.parametrize("kw", [
+    dict(hosts=-1),
+    dict(topology="4x"),
+    dict(topology="4x4x4x4"),
+    dict(topology="axb"),
+    dict(mesh={"pipeline": 2}),
+    dict(mesh={"tp": 0}),
+    dict(mesh={"tp": "four"}),
+])
+def test_sharding_rejects_malformed(kw):
+    with pytest.raises(ValidationError):
+        _sharded_model(**kw).validate()
+
+
+def test_sharding_requires_kubeai_tpu_engine():
+    m = _sharded_model(hosts=2)
+    m.spec.engine = "VLLM"
+    m.spec.features = ["TextGeneration"]
+    with pytest.raises(ValidationError, match="sharding"):
+        m.validate()
+
+
+def test_sharding_overrides_profile_and_exports_mesh():
+    cfg = System().default_and_validate()
+    m = _sharded_model(hosts=4, mesh={"tp": 8, "data": 2})
+    mcfg = resolve_model_config(m, cfg)
+    assert mcfg.num_hosts == 4  # sharding.hosts beats the profile's 2
+    pods = kubeai_tpu_host_pods(m, cfg, mcfg, group=0)
+    assert len(pods) == 4
+    for h, pod in enumerate(pods):
+        labels = pod["metadata"]["labels"]
+        assert labels[md.POD_GROUP_SIZE_LABEL] == "4"
+        assert labels[md.POD_GROUP_LABEL] == "0"
+        assert labels[md.POD_HOST_LABEL] == str(h)
+        env = {
+            e["name"]: e.get("value")
+            for e in pod["spec"]["containers"][0]["env"]
+        }
+        # Stable axis order regardless of dict insertion order.
+        assert env["TPU_MESH"] == "data=2,tp=8"
+
+
+def test_unsharded_render_has_no_mesh_env():
+    cfg = System().default_and_validate()
+    m = _sharded_model()
+    mcfg = resolve_model_config(m, cfg)
+    for pod in kubeai_tpu_host_pods(m, cfg, mcfg, group=0):
+        names = [e["name"] for e in pod["spec"]["containers"][0]["env"]]
+        assert "TPU_MESH" not in names
+        assert pod["metadata"]["labels"][md.POD_GROUP_SIZE_LABEL] == "2"
+
+
+# ---- k8sutils: slice-shape parsing hardening --------------------------------
+
+
+def test_topology_chip_count():
+    assert k8sutils.topology_chip_count("4x4") == 16
+    assert k8sutils.topology_chip_count("4x4x4") == 64
+    assert k8sutils.topology_chip_count("2x4") == 8
+    for bad in ("", "4x", "x4", "4x4x4x4", "axb", "4*4", None, 16):
+        assert k8sutils.topology_chip_count(bad) is None
+    assert k8sutils.topology_chip_count("0x4") is None  # degenerate
+
+
+def _tpu_node(name, chips, topo):
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {
+            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+            "cloud.google.com/gke-tpu-topology": topo,
+        }},
+        "status": {"allocatable": {"google.com/tpu": str(chips)}},
+    }
+
+
+def test_node_slice_chip_count():
+    # Multi-host slice: 4-chip member VM of a 4x4x4 slice prices at 64.
+    assert k8sutils.node_slice_chip_count(_tpu_node("n", 4, "4x4x4")) == 64
+    # Single-host slice: topology product equals the VM.
+    assert k8sutils.node_slice_chip_count(_tpu_node("n", 16, "4x4")) == 16
+    # Malformed topology falls back to the node's own allocatable.
+    assert k8sutils.node_slice_chip_count(_tpu_node("n", 8, "garbage")) == 8
+    # A topology SMALLER than the node's allocatable is nonsense — trust
+    # the node, not the label.
+    assert k8sutils.node_slice_chip_count(_tpu_node("n", 8, "2x2")) == 8
+
+
+def test_node_budget_does_not_double_count_slices():
+    """Sixteen 4-chip member VMs of one 4x4x4 slice: budget 64 chips
+    (per-node allocatable summed), slice_chips 64 (whole-slice bound) —
+    NOT 16 x 64 = 1024."""
+    store = KubeStore()
+    for i in range(16):
+        store.create(_tpu_node(f"n{i}", 4, "4x4x4"))
+    agg = FleetStateAggregator(
+        lb=LoadBalancer(store), model_client=ModelClient(store),
+        store=store, metrics=Metrics(), interval_s=1.0, staleness_s=2.5,
+        fetch_metrics=lambda a, timeout=5.0: "",
+        fetch_state=lambda a, timeout=5.0: {},
+        clock=FakeClock(0.0),
+    )
+    budget = agg.collect()["chips"]["budget"]
+    shape = "tpu-v5-lite-podslice/4x4x4"
+    assert budget["by_shape"][shape] == 64
+    assert budget["slice_chips"][shape] == 64
+    assert budget["total"] == 64
+
+
+# ---- governor: atomic group delete ------------------------------------------
+
+
+def _gov(store, *, model_budget=2, cluster_budget=10, clock=None):
+    return ActuationGovernor(
+        cfg=GovernorConfig(
+            window_seconds=60.0,
+            model_disruption_budget=model_budget,
+            cluster_disruption_budget=cluster_budget,
+        ),
+        store=store, metrics=Metrics(), clock=clock or FakeClock(0.0),
+    )
+
+
+def _create_group(store, group, model="big", size=2):
+    names = []
+    for h in range(size):
+        name = f"model-{model}-g{group}-h{h}"
+        store.create(_member(name, model=model, group=group, host=h,
+                             size=size))
+        names.append(name)
+    return names
+
+
+def test_delete_group_consumes_one_budget_unit():
+    store = KubeStore()
+    gov = _gov(store, model_budget=1)
+    g0 = _create_group(store, 0)
+    g1 = _create_group(store, 1)
+    assert gov.delete_group(store, "default", g0, model="big")
+    for n in g0:
+        assert store.try_get("Pod", "default", n) is None
+    # One unit spent for TWO pods; the second group exhausts the budget.
+    assert not gov.delete_group(store, "default", g1, model="big")
+    for n in g1:
+        assert store.try_get("Pod", "default", n) is not None
+    assert gov.metrics.governor_actions.get(
+        action="group_delete", model="big"
+    ) == 1
+    assert gov.metrics.governor_denied.get(
+        action="group_delete", model="big", reason="model-budget-exhausted"
+    ) == 1
+
+
+def test_delete_group_repair_bypasses_budget():
+    store = KubeStore()
+    gov = _gov(store, model_budget=0)
+    g0 = _create_group(store, 0)
+    assert gov.delete_group(store, "default", g0, model="big",
+                            budgeted=False)
+    assert gov.metrics.governor_actions.get(
+        action="repair", model="big"
+    ) == 1
+
+
+def test_delete_group_tolerates_missing_members():
+    store = KubeStore()
+    gov = _gov(store)
+    g0 = _create_group(store, 0)
+    store.delete("Pod", "default", g0[1])  # ungoverned: test arranges a half-gone group
+    assert gov.delete_group(store, "default", g0, model="big")
+    assert store.try_get("Pod", "default", g0[0]) is None
+
+
+class _FlakyStore:
+    """Delegates to a KubeStore but fails deletes of chosen pods."""
+
+    def __init__(self, inner, fail_names):
+        self._inner = inner
+        self.fail_names = set(fail_names)
+
+    def delete(self, kind, namespace, name):
+        if kind == "Pod" and name in self.fail_names:
+            raise RuntimeError(f"injected: cannot delete {name}")
+        return self._inner.delete(kind, namespace, name)
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+def test_delete_group_refunds_only_while_group_intact():
+    store = KubeStore()
+    clock = FakeClock(0.0)
+    gov = _gov(store, model_budget=1, clock=clock)
+    g0 = _create_group(store, 0)
+    # First member delete fails: the group is still whole, the budget
+    # unit comes back, and a later group delete can still proceed.
+    flaky = _FlakyStore(store, [g0[0]])
+    with pytest.raises(RuntimeError):
+        gov.delete_group(flaky, "default", g0, model="big")
+    assert store.try_get("Pod", "default", g0[0]) is not None
+    assert gov.delete_group(store, "default", g0, model="big")
+
+    # SECOND member delete fails: one member is already gone, the group
+    # IS disrupted — the unit stays spent. Roll the budget window first
+    # so the successful delete above doesn't mask the refund question.
+    clock.advance(61.0)
+    g1 = _create_group(store, 1)
+    flaky = _FlakyStore(store, [g1[1]])
+    with pytest.raises(RuntimeError):
+        gov.delete_group(flaky, "default", g1, model="big")
+    assert store.try_get("Pod", "default", g1[0]) is None
+    g2 = _create_group(store, 2)
+    assert not gov.delete_group(store, "default", g2, model="big")
+
+
+# ---- pod plan: group semantics ----------------------------------------------
+
+
+def _mh_model(replicas=1):
+    return Model(
+        name="big",
+        spec=ModelSpec(
+            url="hf://org/llama-70b",
+            engine="KubeAITPU",
+            resource_profile="google-tpu-v5e-4x4:8",
+            replicas=replicas,
+            min_replicas=0,
+            max_replicas=4,
+        ),
+    )
+
+
+def _render(model, cfg, mcfg):
+    def render_group(g):
+        return kubeai_tpu_host_pods(model, cfg, mcfg, g)
+
+    return render_group
+
+
+def test_group_plan_rollout_deletes_whole_groups():
+    cfg = System().default_and_validate()
+    model = _mh_model(replicas=2)
+    mcfg = resolve_model_config(model, cfg)
+    existing = [
+        copy.deepcopy(p)
+        for p in calculate_group_pod_plan(
+            [], model, _render(model, cfg, mcfg), 2
+        ).to_create
+    ]
+    # Spec change -> new pod hash -> every group stale, deleted in
+    # GROUP units: to_delete_groups joins the flat list per group.
+    model.spec.args = ["--new-flag"]
+    mcfg2 = resolve_model_config(model, cfg)
+    plan = calculate_group_pod_plan(
+        existing, model, _render(model, cfg, mcfg2), 2
+    )
+    assert len(plan.to_delete) == 4
+    assert [len(g) for g in plan.to_delete_groups] == [2, 2]
+    flat = [p["metadata"]["name"]
+            for members in plan.to_delete_groups for p in members]
+    assert sorted(flat) == sorted(p["metadata"]["name"]
+                                  for p in plan.to_delete)
+    # Healthy rollout order: youngest group (highest index) first.
+    assert slicegroup.group_index(plan.to_delete_groups[0][0]) == 1
+
+
+def test_group_plan_deletion_order_broken_groups_first():
+    cfg = System().default_and_validate()
+    model = _mh_model(replicas=2)
+    mcfg = resolve_model_config(model, cfg)
+    existing = [
+        copy.deepcopy(p)
+        for p in calculate_group_pod_plan(
+            [], model, _render(model, cfg, mcfg), 2
+        ).to_create
+    ]
+    for p in existing:
+        p.setdefault("status", {})["conditions"] = [
+            {"type": "Ready", "status": "True"},
+        ]
+    # Break a member of group 0, then scale to zero: group 0 (broken)
+    # must be ordered before group 1 (healthy) despite the youngest-
+    # first bias.
+    existing[1]["status"] = {"phase": "Failed", "reason": "Preempted"}
+    model.spec.replicas = 0
+    plan = calculate_group_pod_plan(
+        existing, model, _render(model, cfg, mcfg), 2
+    )
+    assert [slicegroup.group_index(g[0]) for g in plan.to_delete_groups] \
+        == [0, 1]
+
+
+class _RecordingGovernor:
+    """Permissive governor double that records the call sequence."""
+
+    def __init__(self):
+        self.calls = []
+
+    def check_fence(self):
+        pass
+
+    def delete_pod(self, store, namespace, name, *, model="", reason="",
+                   budgeted=True):
+        self.calls.append(("delete_pod", name, budgeted))
+        store.delete("Pod", namespace, name)  # governed: test double is the governor seam
+        return True
+
+    def delete_group(self, store, namespace, names, *, model="", reason="",
+                     budgeted=True):
+        self.calls.append(("delete_group", tuple(names), budgeted))
+        for name in names:
+            try:
+                store.delete("Pod", namespace, name)  # governed: test double is the governor seam
+            except NotFound:
+                pass
+        return True
+
+    def create_pod(self, store, pod, *, model=""):
+        self.calls.append(("create_pod",))
+        return store.create(pod)
+
+
+def test_execute_routes_groups_through_group_delete():
+    store = KubeStore()
+    names = _create_group(store, 0)
+    members = [store.get("Pod", "default", n) for n in names]
+    solo = _member("solo", group=5, host=0, size=1)
+    del solo["metadata"]["labels"][md.POD_GROUP_LABEL]
+    store.create(solo)
+    plan = PodPlan(
+        model=_mh_model(), to_create=[], to_delete=members + [solo],
+        to_remain=[], details=[], to_delete_groups=[members],
+    )
+    gov = _RecordingGovernor()
+    assert plan.execute(store, {"metadata": {"name": "big",
+                                             "namespace": "default"}},
+                        governor=gov)
+    # Whole group in ONE call, members skipped in the per-pod loop, the
+    # ungrouped pod deleted individually.
+    assert gov.calls == [
+        ("delete_group", tuple(names), True),
+        ("delete_pod", "solo", True),
+    ]
+
+
+def test_single_host_plan_byte_identical_pin():
+    """The slice-group machinery is invisible for num_hosts == 1: the
+    single-host planner emits no group deletions, and `execute` issues
+    exactly the per-pod governor sequence it always has — same calls,
+    same order."""
+    model = Model(
+        name="m",
+        spec=ModelSpec(
+            url="hf://org/model",
+            engine="KubeAITPU",
+            features=["TextGeneration"],
+            resource_profile="google-tpu-v5e-1x1:1",
+            replicas=1,
+        ),
+    )
+    desired = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "x", "namespace": "default",
+                     "labels": {md.POD_MODEL_LABEL: "m"}},
+        "spec": {"containers": [{"name": "server"}]},
+    }
+    pods = []
+    for i in range(3):
+        p = copy.deepcopy(desired)
+        p["metadata"]["name"] = f"p{i}"
+        p["metadata"]["creationTimestamp"] = i
+        p["status"] = {"phase": "Running", "conditions": [
+            {"type": "Ready", "status": "True"},
+            {"type": "PodScheduled", "status": "True"},
+        ]}
+        pods.append(p)
+    plan = calculate_pod_plan(copy.deepcopy(pods), model,
+                              copy.deepcopy(desired), surge=1)
+    assert plan.to_delete_groups == []
+    # Youngest-first, one per pass — the pre-slice-group scale-down pin.
+    assert json.dumps(
+        [p["metadata"]["name"] for p in plan.to_delete], sort_keys=True
+    ) == json.dumps(["p2"], sort_keys=True)
+    store = KubeStore()
+    for p in pods:
+        store.create(copy.deepcopy(p))
+    gov = _RecordingGovernor()
+    plan.execute(store, {"metadata": {"name": "m",
+                                      "namespace": "default"}},
+                 governor=gov)
+    # The pre-group-plane call sequence, exactly: flat per-pod deletes
+    # in plan order, no group calls.
+    assert gov.calls == [("delete_pod", "p2", True)]
+
+
+# ---- load balancer: whole-group ejection ------------------------------------
+
+
+def test_lb_ejects_whole_group_on_member_disruption():
+    store = KubeStore()
+    metrics = Metrics()
+    lb = LoadBalancer(store, metrics=metrics)
+    # Group 0 healthy; group 1's worker is preempted while its
+    # coordinator still looks perfectly Ready.
+    store.create(_member("g0-h0", group=0, host=0, ip="10.0.0.1"))
+    store.create(_member("g0-h1", group=0, host=1, ip="10.0.0.2",
+                         serving="false"))
+    store.create(_member("g1-h0", group=1, host=0, ip="10.0.0.3"))
+    store.create(_member("g1-h1", group=1, host=1, ip="10.0.0.4",
+                         serving="false", ready=False, phase="Failed",
+                         reason="Preempted"))
+    lb.sync_model("big")
+    assert lb.group("big").addresses() == ["10.0.0.1:8000"]
+    assert metrics.slicegroup_ejections.get(model="big") == 1
+    # A partial group (member missing entirely) is not routable either.
+    store.delete("Pod", "default", "g0-h1")  # ungoverned: test arranges a partial group
+    lb.sync_model("big")
+    assert lb.group("big").addresses() == []
+
+
+# ---- fleet snapshot: per-group join -----------------------------------------
+
+
+def test_aggregator_joins_members_into_group_states():
+    store = KubeStore()
+    m = Model(
+        name="big",
+        spec=ModelSpec(
+            url="hf://org/llama-70b",
+            engine="KubeAITPU",
+            resource_profile="google-tpu-v5e-4x4:8",
+            replicas=3,
+        ),
+    )
+    m.validate()
+    store.create(m.to_dict())
+    # g0 fully ready; g1 partial (one member); g2 complete but broken.
+    store.create(_member("g0-h0", group=0, host=0))
+    store.create(_member("g0-h1", group=0, host=1))
+    store.create(_member("g1-h0", group=1, host=0))
+    store.create(_member("g2-h0", group=2, host=0))
+    store.create(_member("g2-h1", group=2, host=1, ready=False))
+    agg = FleetStateAggregator(
+        lb=LoadBalancer(store), model_client=ModelClient(store),
+        store=store, metrics=Metrics(), interval_s=1.0, staleness_s=2.5,
+        fetch_metrics=lambda a, timeout=5.0: "",
+        fetch_state=lambda a, timeout=5.0: {},
+        clock=FakeClock(0.0),
+    )
+    snap = agg.collect()
+    groups = snap["models"]["big"]["pods"]["groups"]
+    assert groups == {"total": 3, "ready": 1, "partial": 1, "broken": 1}
+    assert agg.metrics.slicegroup_groups.get(model="big", state="ready") == 1
+    assert agg.metrics.slicegroup_groups.get(model="big", state="partial") == 1
+    assert agg.metrics.slicegroup_groups.get(model="big", state="broken") == 1
+
+
+# ---- chaos plane: kill_group_host -------------------------------------------
+
+
+def test_kill_group_host_is_a_first_class_event_kind():
+    assert EV_KILL_GROUP_HOST == "kill_group_host"
+    assert EV_KILL_GROUP_HOST in EVENT_KINDS
+
+
+def test_kill_group_host_trace_round_trip(tmp_path):
+    trace = GameDayTrace([
+        GameDayEvent(3.0, EV_KILL_GROUP_HOST, "big",
+                     {"group": 0, "host": 1, "mode": "preempt"}),
+        GameDayEvent(3.0, EV_KILL_GROUP_HOST, "big",
+                     {"group": 1, "host": 0, "mode": "crashloop"}),
+    ])
+    # Deliver-once ordering: same-tick events arrive in authored order,
+    # exactly once, and never again.
+    due = trace.due(3.0)
+    assert [(e.kind, e.params["group"]) for e in due] == [
+        (EV_KILL_GROUP_HOST, 0), (EV_KILL_GROUP_HOST, 1),
+    ]
+    assert trace.due(3.0) == []
+    assert trace.due(100.0) == []
+    # JSONL round trip preserves the new kind and its params.
+    log = GameDayLog(trace, ticks=5)
+    path = str(tmp_path / "trace.jsonl")
+    log.dump(path)
+    header, _records = GameDayLog.load(path)
+    assert [e["kind"] for e in header["events"]] == [EV_KILL_GROUP_HOST] * 2
+    assert header["events"][0]["params"] == {
+        "group": 0, "host": 1, "mode": "preempt",
+    }
+
+
+# ---- the deterministic slice-group sim (acceptance criteria) ----------------
+
+
+def _load_sim():
+    path = os.path.join(REPO_ROOT, "benchmarks", "slicegroup_sim.py")
+    spec = importlib.util.spec_from_file_location("slicegroup_sim", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_slicegroup_sim_invariants():
+    """Tier-1 contract: the real reconciler/governor/planner/LB over a
+    fake clock hold (a) no partial group ever Ready or routable, (b) a
+    killed member host yields exactly ONE atomic whole-group repair
+    within the backoff bound, (c) the plan never exceeds the slice
+    inventory and only allocates whole groups, (d) the fleet converges
+    back to every group Ready and routable."""
+    sim = _load_sim()
+    result = sim.run()
+    assert result["violations"] == [], result["first_violation"]
+    assert result["kills"] == 2
+    assert result["repairs"] == 2
+    assert result["pod_replacements"] == 2 * sim.NUM_HOSTS
+    assert result["groups_ready"] == sim.REPLICAS
+    assert len(result["routable"]) == sim.REPLICAS
+    assert result["control_plane_errors"] == 0
+    # Replayability: the JSONL log round-trips with the chaos events.
+    header = json.loads(result["log"].lines[0])
+    assert [e["kind"] for e in header["events"]] == [
+        EV_KILL_GROUP_HOST, EV_KILL_GROUP_HOST,
+    ]
