@@ -67,7 +67,7 @@ from kubeai_tpu.config.system import GovernorConfig, TenancyConfig
 from kubeai_tpu.crd import metadata as md
 from kubeai_tpu.fleet import CapacityPlanner, FleetStateAggregator
 from kubeai_tpu.fleet.metering import UsageMeter
-from kubeai_tpu.fleet.tenancy import TenantGovernor
+from kubeai_tpu.fleet.tenancy import TenantGovernor, build_door
 from kubeai_tpu.metrics import Metrics
 from kubeai_tpu.operator.controller import ModelReconciler
 from kubeai_tpu.operator.governor import ActuationGovernor
@@ -85,6 +85,8 @@ from kubeai_tpu.testing.chaos import (
     EV_API_PARTITION,
     EV_API_STORM,
     EV_CHIP_FLIP,
+    EV_DOOR_CRASH,
+    EV_DOOR_PARTITION,
     EV_KILL_GROUP_HOST,
     EV_KILL_POD,
     EV_LINK_DROP,
@@ -125,6 +127,7 @@ MAX_ATTEMPTS = 3           # proxy retry budget per dispatch
 MAX_STREAM_RESUMES = 3     # mid-stream continuation budget per stream
 WEDGE_TICKS = 4            # wedged engine -> watchdog kill
 CONVERGE_BOUND_S = 40.0
+DOOR_SHARDS = 3            # in-process door shards behind one gossip plane
 
 MODELS = ("rt", "std", "batch")
 MODEL_CLASS = {"rt": "realtime", "std": "standard", "batch": "batch"}
@@ -300,19 +303,30 @@ class GameDayWorld:
         self.planner.avg_lookup = self.scaler.current_average
         self.scaler.planner = self.planner
 
-        # -- tenant door + billing.
+        # -- tenant door + billing. The door is SHARDED: three
+        # in-process governors sharing one gossiped CRDT state plane,
+        # so the game day exercises partition-tolerant admission (one
+        # shared UsageMeter keeps billing_exact a single ledger).
+        # Rate 3.0 with compliant tenants at <=2 req/s: a partitioned
+        # door charges a conservative split, so a tenant at exactly
+        # 100% of its limit is at the margin by construction — the
+        # no-compliant-refusals guarantee needs utilization headroom.
         self.usage = UsageMeter(metrics=self.metrics)
-        self.door = TenantGovernor(
-            TenancyConfig(
-                enabled=True,
-                requests_per_second=2.0,
-                request_burst=4.0,
-                overload_high_water=10.0,
-                overload_low_water=5.0,
-                tenant_idle_seconds=1e9,
-            ),
-            usage=self.usage, metrics=self.metrics, clock=self.clock,
-            pressure_fn=self.queue_pressure, pressure_ttl_s=0.0,
+        self.door_cfg = TenancyConfig(
+            enabled=True,
+            requests_per_second=3.0,
+            request_burst=4.0,
+            overload_high_water=10.0,
+            overload_low_water=5.0,
+            tenant_idle_seconds=1e9,
+            door_shards=DOOR_SHARDS,
+            gossip_interval_seconds=1.0,
+            gossip_stale_seconds=3.0,
+        )
+        self.door = build_door(
+            self.door_cfg, usage=self.usage, metrics=self.metrics,
+            clock=self.clock, pressure_fn=self.queue_pressure,
+            pressure_ttl_s=0.0, seed=seed,
         )
 
         # -- data plane state.
@@ -333,6 +347,10 @@ class GameDayWorld:
         self.active_links: list[dict] = []   # {"addr","fault","until"}
         self.floods: list[dict] = []         # {"tenant","model","rps","until"}
         self.partition_until = float("-inf")
+        self.door_partition_until = float("-inf")
+        self.door_crashes = 0                # crashed-and-rebuilt shards
+        self.flood_t0: dict[str, float] = {}      # tenant -> first flood t
+        self.flood_admitted: dict[str, int] = {}  # tenant -> admissions
         self.stale_until = float("-inf")
         self.spot_removed: list[dict] = []   # removed Node objects (restorable)
 
@@ -507,12 +525,36 @@ class GameDayWorld:
                 start=cur + 1, end=cur + int(p.get("count", 3)),
             ))
         elif ev.kind == EV_TENANT_FLOOD:
+            tenant = ev.target or "flooder"
             self.floods.append({
-                "tenant": ev.target or "flooder",
+                "tenant": tenant,
                 "model": p.get("model", "std"),
                 "rps": int(p.get("rps", 20)),
                 "until": self.rel_now() + float(p.get("duration_s", 10.0)),
             })
+            self.flood_t0.setdefault(tenant, self.rel_now())
+        elif ev.kind == EV_DOOR_PARTITION:
+            ss = getattr(self.door, "shard_set", None)
+            if ss is not None:
+                names = ss.names()
+                half = max(1, len(names) // 2)
+                ss.partition([names[:half], names[half:]])
+                self.door_partition_until = self.rel_now() + float(
+                    p.get("duration_s", 5.0)
+                )
+        elif ev.kind == EV_DOOR_CRASH:
+            ss = getattr(self.door, "shard_set", None)
+            if ss is not None:
+                idx = int(p.get("shard", 0)) % len(ss.names())
+                name = ss.names()[idx]
+                ss.crash(name)
+                self.door.replace_shard(idx, TenantGovernor(
+                    cfg=self.door_cfg, usage=self.usage,
+                    metrics=self.metrics, clock=self.clock,
+                    pressure_fn=self.queue_pressure, pressure_ttl_s=0.0,
+                    gossip=ss.node(name),
+                ))
+                self.door_crashes += 1
         elif ev.kind == EV_CHIP_FLIP:
             delta = int(p.get("delta", 0))
             if delta < 0:
@@ -640,6 +682,10 @@ class GameDayWorld:
         rel = self.rel_now()
         if self.api.partitioned and rel >= self.partition_until:
             self.api.partitioned = False
+        ss = getattr(self.door, "shard_set", None)
+        if (ss is not None and ss.partitioned()
+                and rel >= self.door_partition_until):
+            ss.heal()
         self.floods = [f for f in self.floods if rel < f["until"]]
         still = []
         for link in self.active_links:
@@ -683,6 +729,10 @@ class GameDayWorld:
                         (tenant, model, cls, refusal.reason)
                     )
                     continue
+                if tenant in self.flood_t0:
+                    self.flood_admitted[tenant] = (
+                        self.flood_admitted.get(tenant, 0) + 1
+                    )
                 self.queues[model].append(
                     Stream(tenant, model, cls, now,
                            need=self.stream_tokens)
@@ -813,6 +863,9 @@ class GameDayWorld:
             kinds.add("wedge")
         if rel < self.stale_until:
             kinds.add("telemetry_stale")
+        ss = getattr(self.door, "shard_set", None)
+        if ss is not None and ss.partitioned():
+            kinds.add("door_partition")
         if self.spot_removed:
             kinds.add("chip_flip")
         for model in MODELS:
@@ -838,7 +891,7 @@ class GameDayWorld:
             q = self.queues[model]
             if q and now - q[0].t_arrive > 3 * TICK_S:
                 return False
-        return not self.door._overload
+        return not self.door.overload
 
     # ---- the tick ------------------------------------------------------
 
@@ -924,6 +977,9 @@ class GameDayWorld:
             "control_plane_errors": self.control_plane_errors,
             "plans_seen": len(self.plans),
             "usage_totals": self.usage.totals(),
+            "flood_admitted": dict(self.flood_admitted),
+            "door_shards": DOOR_SHARDS,
+            "door_crashes": self.door_crashes,
             "wait_samples": {
                 f"{t}/{m}": v for (t, m), v in self.wait_samples.items()
             },
@@ -1028,6 +1084,45 @@ def _inv_billing_exact(world) -> str | None:
     return None
 
 
+def door_budget_epsilon(world) -> float:
+    """Admission slack the sharded door is ALLOWED over the single
+    global budget: un-gossiped burst on N-1 peers, one gossip interval
+    of rate on every shard, the degraded window's conservative-split
+    residue on N-1 peers, and a fresh burst per crashed-and-rebuilt
+    shard (the rebuilt bucket starts full)."""
+    cfg = world.door_cfg
+    n = DOOR_SHARDS
+    return (
+        (n - 1) * cfg.request_burst
+        + n * cfg.requests_per_second * cfg.gossip_interval_seconds
+        + (n - 1) * cfg.requests_per_second * cfg.gossip_stale_seconds
+        + world.door_crashes * cfg.request_burst
+        + 2.0
+    )
+
+
+def _inv_door_budget(world) -> str | None:
+    """The flooder is held to ONE global token budget no matter how
+    the door shards are split: cumulative admissions for any flooding
+    tenant never exceed burst + rate*elapsed + epsilon — continuously,
+    including mid-partition and mid-crash."""
+    rel = world.rel_now()
+    eps = door_budget_epsilon(world)
+    cfg = world.door_cfg
+    for tenant, t0 in world.flood_t0.items():
+        elapsed = max(0.0, rel - t0)
+        budget = cfg.request_burst + cfg.requests_per_second * elapsed
+        got = world.flood_admitted.get(tenant, 0)
+        if got > budget + eps:
+            return (
+                f"flood tenant {tenant}: {got} admissions in "
+                f"{elapsed:.0f}s — global budget {budget:.0f} "
+                f"(+{eps:.0f} epsilon) breached across "
+                f"{DOOR_SHARDS} door shards"
+            )
+    return None
+
+
 def _inv_token_continuity(world) -> str | None:
     for s in world.completed:
         if s.delivered != s.need:
@@ -1073,7 +1168,7 @@ def _inv_convergence(world) -> str | None:
             "fleet did not return to steady state by the end of the run "
             f"(queues={ {m: len(world.queues[m]) for m in MODELS} }, "
             f"wedged={sorted(world.wedged)}, "
-            f"overload={world.door._overload})"
+            f"overload={world.door.overload})"
         )
     last = world.last_unconverged_tick
     if last is not None:
@@ -1099,6 +1194,9 @@ INVARIANTS = (
               "the usage ledger equals delivered work exactly"),
     Invariant("token_continuity", _inv_token_continuity, CONTINUOUS,
               "resumed streams deliver every token exactly once"),
+    Invariant("door_budget", _inv_door_budget, CONTINUOUS,
+              "flooder admissions across all door shards within one "
+              "global budget + epsilon"),
     Invariant("group_dead_member_not_routable",
               _inv_group_dead_member_not_routable, CONTINUOUS,
               "a slice group with a dead member is never routable"),
@@ -1117,6 +1215,8 @@ def fast_trace(seed: int = 0) -> GameDayTrace:
     return GameDayTrace([
         GameDayEvent(5.0, EV_TENANT_FLOOD, "flooder",
                      {"model": "std", "rps": 30, "duration_s": 20.0}),
+        GameDayEvent(7.0, EV_DOOR_PARTITION, "",
+                     {"duration_s": 10.0}),
         GameDayEvent(8.0, EV_CHIP_FLIP, "",
                      {"delta": -4, "duration_s": 18.0}),
         GameDayEvent(8.0, EV_SPOT_PREEMPT, "batch", {"count": 1}),
@@ -1130,6 +1230,7 @@ def fast_trace(seed: int = 0) -> GameDayTrace:
         GameDayEvent(20.0, EV_API_STORM, "",
                      {"method": "GET", "plural": "pods", "status": 500,
                       "count": 3}),
+        GameDayEvent(22.0, EV_DOOR_CRASH, "", {"shard": 1}),
         GameDayEvent(26.0, EV_CHIP_FLIP, "", {"delta": 4}),
     ], seed=seed)
 
@@ -1265,6 +1366,24 @@ def check_flood_was_real(result: dict) -> None:
     assert rt_refusals == [], rt_refusals
 
 
+def check_door_chaos_was_real(result: dict) -> None:
+    """The door shards really were split mid-flood (door_partition in
+    the chaos timeline), a shard really crashed and was rebuilt, and
+    the flooder still only ever got ONE global budget."""
+    g = result["gameday"]
+    assert any(
+        "door_partition" in kinds for kinds in g["kinds_timeline"]
+    ), "door_partition never active"
+    assert any(
+        {"door_partition", "tenant_flood"} <= set(kinds)
+        for kinds in g["kinds_timeline"]
+    ), "flood and door partition never overlapped"
+    assert g["door_crashes"] == 1, g["door_crashes"]
+    assert g["flood_admitted"].get("flooder", 0) > 0, (
+        "flooder was never admitted at all — budget check is vacuous"
+    )
+
+
 def check_failing_trace_fails(result: dict) -> None:
     """The engineered trace produces a deterministic first violation of
     zero_stream_errors."""
@@ -1279,6 +1398,7 @@ ALL_CHECKS = (
     check_progress_under_chaos,
     check_tenant_isolation,
     check_flood_was_real,
+    check_door_chaos_was_real,
     check_failing_trace_fails,
 )
 

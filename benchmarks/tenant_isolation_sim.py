@@ -24,13 +24,32 @@ Invariants (asserted in tier-1 by tests/unit/test_tenancy.py):
     no `kubeai_door_*` series appear, and the measured waits are
     byte-identical to a world with no governor at all.
 
-Run directly for a human-readable report:
+Sharded-door invariants (same tier-1 wiring), driving `build_door`
+with three governors behind one gossiped CRDT state plane:
+
+  * the flooder is held to ONE global budget within a declared epsilon
+    no matter how its traffic is split across shards (round-robin,
+    all-on-one, alternating), through a gossip partition, and through
+    a shard crash;
+  * compliant p99 wait/TTFT through the sharded door stays within the
+    isolation epsilon of the single-door run, with zero compliant
+    refusals;
+  * partition-then-heal CONVERGES: after quiescing, every shard's
+    CRDT state digest is byte-identical;
+  * a crashed shard is rebuilt empty and reconstructs its own
+    consumption components from peer replicas;
+  * single-shard mode (`doorShards: 1`) is sample-for-sample identical
+    to the classic TenantGovernor run.
+
+Run directly for a human-readable report (``--users 1000000`` for the
+million-user trace, ``--shards N`` to vary the shard count):
 
     python benchmarks/tenant_isolation_sim.py
 """
 
 from __future__ import annotations
 
+import argparse
 import os
 import sys
 
@@ -38,8 +57,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from kubeai_tpu.config.system import TenancyConfig
 from kubeai_tpu.fleet.metering import UsageMeter
-from kubeai_tpu.fleet.tenancy import TenantGovernor
+from kubeai_tpu.fleet.tenancy import TenantGovernor, build_door
 from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.routing.gossip import NS_REQ
 from kubeai_tpu.testing.faults import FakeClock
 from kubeai_tpu.testing.simkit import percentile
 from kubeai_tpu.utils import retryafter
@@ -216,7 +236,174 @@ def _run_overload():
     return timeline
 
 
-def run_sim() -> dict:
+# -- the sharded door --------------------------------------------------------
+
+DOOR_SHARDS = 3
+GOSSIP_INTERVAL_S = 0.5
+GOSSIP_STALE_S = 2.0
+PARTITION_T = (30.0, 60.0)   # trace-relative gossip-split window
+CRASH_T = 50.0               # trace-relative shard-crash instant
+CRASH_IDX = 1                # which shard dies
+
+
+def _sharded_policy(shards: int = DOOR_SHARDS) -> TenancyConfig:
+    cfg = _policy()
+    cfg.door_shards = shards
+    cfg.gossip_interval_seconds = GOSSIP_INTERVAL_S
+    cfg.gossip_stale_seconds = GOSSIP_STALE_S
+    return cfg
+
+
+def sharded_budget_epsilon(shards: int, crashes: int = 0) -> float:
+    """Transient admission slack a sharded door is ALLOWED over the
+    single global budget: un-gossiped burst on N-1 peers, one gossip
+    interval of rate on every shard, the stale-detection window on N-1
+    peers, the banked conservative reserve (at most one burst per
+    shard), and a fresh full bucket per crashed-and-rebuilt shard."""
+    cfg = _sharded_policy(shards)
+    return (
+        (shards - 1) * cfg.request_burst
+        + shards * cfg.requests_per_second * cfg.gossip_interval_seconds
+        + (shards - 1) * cfg.requests_per_second * cfg.gossip_stale_seconds
+        + shards * cfg.request_burst
+        + crashes * cfg.request_burst
+        + 2.0
+    )
+
+
+def _run_sharded_trace(
+    shards: int = DOOR_SHARDS,
+    flood_split: str = "rr",
+    partition: bool = False,
+    crash: bool = False,
+    users: int = N_TENANTS,
+) -> dict:
+    """The abuse trace through ``build_door``: compliant tenants always
+    round-robin across shards; the flooder's split is the scenario knob
+    (``rr`` everywhere, ``one`` hammers shard 0, ``alt`` alternates two
+    shards). Optional mid-trace gossip partition (healed at
+    PARTITION_T[1]) and shard crash+rebuild. After the trace the plane
+    is quiesced and every shard's CRDT digest is byte-compared."""
+    clock = FakeClock(1000.0)
+    metrics = Metrics()
+    door = build_door(
+        _sharded_policy(shards), metrics=metrics, clock=clock, seed=7
+    )
+    shard_set = getattr(door, "shard_set", None)
+
+    service_time = (
+        SERVICE_TIME_S if users <= N_TENANTS else RUN_S / (1.5 * users)
+    )
+    arrivals: list[tuple[float, str]] = [
+        (i * (RUN_S / users), f"tenant-{i}") for i in range(users)
+    ]
+    n_flood = int(RUN_S / ABUSER_INTERVAL_S)
+    arrivals += [(j * ABUSER_INTERVAL_S, ABUSER) for j in range(n_flood)]
+    arrivals.sort()
+
+    t0 = clock()
+    last_finish = t0
+    waits: dict[str, list[float]] = {"compliant": [], "abuser": []}
+    ttfts: dict[str, list[float]] = {"compliant": [], "abuser": []}
+    door_tally = {"admitted": 0, "refused": 0, "abuser_refused": 0,
+                  "abuser_admitted": 0, "compliant_refused": 0}
+    rr = 0
+    flood_i = 0
+    did_partition = did_heal = did_crash = False
+    pre_crash_component = 0.0
+    crashed_name = ""
+    for offset, tenant in arrivals:
+        now = t0 + offset
+        clock.advance(now - clock())
+        if shard_set is not None:
+            if partition and not did_partition and offset >= PARTITION_T[0]:
+                names = list(shard_set.names())
+                shard_set.partition([names[:1], names[1:]])
+                did_partition = True
+            if did_partition and not did_heal and offset >= PARTITION_T[1]:
+                shard_set.heal()
+                did_heal = True
+            if crash and not did_crash and offset >= CRASH_T:
+                crashed_name = shard_set.names()[CRASH_IDX]
+                node = shard_set.node(crashed_name)
+                entry = node.state.get(NS_REQ, f"{ABUSER}|{MODEL}")
+                pre_crash_component = (
+                    entry.of(crashed_name) if entry is not None else 0.0
+                )
+                shard_set.crash(crashed_name)
+                door.replace_shard(CRASH_IDX, TenantGovernor(
+                    _sharded_policy(shards), metrics=metrics, clock=clock,
+                    gossip=shard_set.node(crashed_name),
+                ))
+                did_crash = True
+            shard_set.maybe_step(now)
+            if tenant == ABUSER and flood_split == "one":
+                idx = 0
+            elif tenant == ABUSER and flood_split == "alt":
+                idx = flood_i % min(2, shards)
+                flood_i += 1
+            else:
+                idx = rr % shards
+                rr += 1
+            gov = door.shards[idx]
+        else:
+            gov = door
+        refusal = gov.admit(tenant, MODEL)
+        if refusal is not None:
+            door_tally["refused"] += 1
+            if tenant == ABUSER:
+                door_tally["abuser_refused"] += 1
+            else:
+                door_tally["compliant_refused"] += 1
+            continue
+        door_tally["admitted"] += 1
+        if tenant == ABUSER:
+            door_tally["abuser_admitted"] += 1
+        start = max(now, last_finish)
+        last_finish = start + service_time
+        pop = "abuser" if tenant == ABUSER else "compliant"
+        waits[pop].append(start - now)
+        ttfts[pop].append(last_finish - now)
+
+    # Quiesce: no more admissions, just anti-entropy rounds until every
+    # shard's state digest agrees (byte-compared), bounded.
+    converged = True
+    digests: dict[str, str] = {}
+    post_crash_component = 0.0
+    if shard_set is not None:
+        if did_partition and not did_heal:
+            shard_set.heal()
+        for _ in range(20 * shards):
+            clock.advance(GOSSIP_INTERVAL_S)
+            shard_set.step(clock())
+            if shard_set.converged():
+                break
+        converged = shard_set.converged()
+        digests = shard_set.digests()
+        if crashed_name:
+            entry = shard_set.node(crashed_name).state.get(
+                NS_REQ, f"{ABUSER}|{MODEL}"
+            )
+            post_crash_component = (
+                entry.of(crashed_name) if entry is not None else 0.0
+            )
+    return {
+        "shards": shards,
+        "users": users,
+        "waits": waits,
+        "ttfts": ttfts,
+        "door": door_tally,
+        "n_flood": n_flood,
+        "converged": converged,
+        "digests": digests,
+        "pre_crash_component": pre_crash_component,
+        "post_crash_component": post_crash_component,
+        "p99_wait_compliant": _percentile(waits["compliant"], 0.99),
+        "p99_ttft_compliant": _percentile(ttfts["compliant"], 0.99),
+    }
+
+
+def run_sim(users: int = N_TENANTS, shards: int = DOOR_SHARDS) -> dict:
     _pin_jitter()
     return {
         "baseline": _run_trace(enabled=True, abuse=False),
@@ -227,6 +414,20 @@ def run_sim() -> dict:
         ),
         "hints": _run_hint_honesty(),
         "overload": _run_overload(),
+        "sharded_rr": _run_sharded_trace(shards=shards, users=users),
+        "sharded_one": _run_sharded_trace(
+            shards=shards, flood_split="one", users=users
+        ),
+        "sharded_alt": _run_sharded_trace(
+            shards=shards, flood_split="alt", users=users
+        ),
+        "sharded_partition": _run_sharded_trace(
+            shards=shards, partition=True, users=users
+        ),
+        "sharded_crash": _run_sharded_trace(
+            shards=shards, crash=True, users=users
+        ),
+        "sharded_single": _run_sharded_trace(shards=1, users=users),
     }
 
 
@@ -320,16 +521,110 @@ def check_disabled_is_noop(result: dict) -> None:
             raise AssertionError(f"disabled door emitted: {line}")
 
 
+_SHARDED_SCENARIOS = (
+    ("sharded_rr", 0),
+    ("sharded_one", 0),
+    ("sharded_alt", 0),
+    ("sharded_partition", 0),
+    ("sharded_crash", 1),
+)
+
+
+def check_sharded_global_budget(result: dict) -> None:
+    """The flooder gets ONE global budget within epsilon no matter how
+    its traffic is split across shards — including through a gossip
+    partition and a shard crash — and the flood is still mostly
+    refused (enforcement is real, not vacuous)."""
+    allowance = 4.0 + 2.0 * RUN_S
+    for name, crashes in _SHARDED_SCENARIOS:
+        run = result[name]
+        eps = sharded_budget_epsilon(run["shards"], crashes)
+        got = run["door"]["abuser_admitted"]
+        assert got <= allowance + eps, (
+            f"{name}: flooder admitted {got} > global budget "
+            f"{allowance:.0f} + epsilon {eps:.0f}"
+        )
+        assert run["door"]["abuser_refused"] >= run["n_flood"] - allowance - eps, (
+            name, run["door"],
+        )
+
+
+def check_sharded_compliant_p99(result: dict) -> None:
+    """Sharding the door must not move compliant latency: p99 wait and
+    TTFT through 3 shards stay within the isolation epsilon of the
+    single-door run, and no compliant request is ever refused."""
+    single = result["sharded_single"]
+    for name, _ in _SHARDED_SCENARIOS:
+        run = result[name]
+        assert run["door"]["compliant_refused"] == 0, (name, run["door"])
+    multi = result["sharded_rr"]
+    assert (
+        multi["p99_wait_compliant"]
+        <= single["p99_wait_compliant"] + EPSILON_S
+    ), (multi["p99_wait_compliant"], single["p99_wait_compliant"])
+    assert (
+        multi["p99_ttft_compliant"]
+        <= single["p99_ttft_compliant"] + EPSILON_S
+    ), (multi["p99_ttft_compliant"], single["p99_ttft_compliant"])
+
+
+def check_sharded_partition_heals(result: dict) -> None:
+    """Partition-then-heal converges: after quiescing, every shard's
+    CRDT state digest is byte-identical — in every scenario."""
+    for name, _ in _SHARDED_SCENARIOS:
+        run = result[name]
+        assert run["converged"], f"{name}: gossip plane never converged"
+        assert len(set(run["digests"].values())) == 1, (
+            f"{name}: shard digests diverge: {run['digests']}"
+        )
+
+
+def check_sharded_crash_reconstructed(result: dict) -> None:
+    """A crashed shard rebuilt empty reconstructs its own consumption
+    component from peer replicas: the flooder's pre-crash counter
+    reappears on the fresh node (minus at most one gossip interval of
+    un-replicated tail)."""
+    run = result["sharded_crash"]
+    assert run["pre_crash_component"] > 0.0, run
+    assert run["post_crash_component"] >= run["pre_crash_component"] - 3.0, (
+        run["pre_crash_component"], run["post_crash_component"],
+    )
+
+
+def check_sharded_single_is_classic(result: dict) -> None:
+    """doorShards: 1 IS the classic TenantGovernor — sample for sample:
+    identical waits, TTFTs, and door tallies to the pre-sharding run."""
+    s = result["sharded_single"]
+    c = result["abuse_guarded"]
+    assert s["waits"] == c["waits"]
+    assert s["ttfts"] == c["ttfts"]
+    assert s["door"]["admitted"] == c["door"]["admitted"]
+    assert s["door"]["refused"] == c["door"]["refused"]
+    assert s["door"]["abuser_refused"] == c["door"]["abuser_refused"]
+
+
 ALL_CHECKS = (
     check_abuser_rejected_with_correct_retry_after,
     check_compliant_isolation,
     check_realtime_sheds_last,
     check_disabled_is_noop,
+    check_sharded_global_budget,
+    check_sharded_compliant_p99,
+    check_sharded_partition_heals,
+    check_sharded_crash_reconstructed,
+    check_sharded_single_is_classic,
 )
 
 
-def main() -> int:
-    result = run_sim()
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--users", type=int, default=N_TENANTS,
+                    help="compliant tenants in the sharded runs "
+                         "(1000000 for the million-user trace)")
+    ap.add_argument("--shards", type=int, default=DOOR_SHARDS,
+                    help="door shards behind the gossip plane (>= 2)")
+    args = ap.parse_args(argv)
+    result = run_sim(users=args.users, shards=args.shards)
     base = result["baseline"]
     guarded = result["abuse_guarded"]
     open_ = result["abuse_open"]
@@ -342,6 +637,16 @@ def main() -> int:
           f"(abuser refused {guarded['door']['abuser_refused']})")
     print(f"abuse, no door p99 wait={open_['p99_wait_compliant']*1e3:8.2f} ms "
           f" (the world the door prevents)")
+    allowance = 4.0 + 2.0 * RUN_S
+    print(f"sharded door: {args.shards} shards, {args.users} users, "
+          f"global budget {allowance:.0f}")
+    for name, crashes in _SHARDED_SCENARIOS:
+        run = result[name]
+        print(f"  {name:20s} flooder admitted "
+              f"{run['door']['abuser_admitted']:4d} "
+              f"(eps {sharded_budget_epsilon(run['shards'], crashes):.0f})  "
+              f"p99 wait={run['p99_wait_compliant']*1e3:8.2f} ms  "
+              f"converged={run['converged']}")
     for chk in ALL_CHECKS:
         chk(result)
         print(f"PASS {chk.__name__}")

@@ -176,6 +176,18 @@ class TenancyConfig:
     # Tenants idle this long have their door state and metric series
     # expired (label-churn pass).
     tenant_idle_seconds: float = 600.0
+    # Horizontal door sharding: number of in-process door shards behind
+    # the round-robin shard picker. 1 = the classic single door
+    # (byte-identical arithmetic). >1 wires the gossiped CRDT state
+    # plane (routing/gossip) so N shards enforce ONE global budget.
+    door_shards: int = 1
+    # Anti-entropy cadence: seconds between gossip rounds (driven
+    # lazily from the admission path on the injected clock).
+    gossip_interval_seconds: float = 1.0
+    # A peer unheard-from for this long counts as partitioned; the
+    # shard degrades to local-view enforcement with a conservative
+    # budget split until the peer is heard again.
+    gossip_stale_seconds: float = 5.0
 
 
 @dataclasses.dataclass
@@ -438,6 +450,14 @@ class System:
             raise ConfigError("tenancy.maxTenantSeries must be >= 1")
         if t.tenant_idle_seconds <= 0:
             raise ConfigError("tenancy.tenantIdle must be > 0")
+        if t.door_shards < 1:
+            raise ConfigError("tenancy.doorShards must be >= 1")
+        if t.gossip_interval_seconds <= 0:
+            raise ConfigError("tenancy.gossipInterval must be > 0")
+        if t.gossip_stale_seconds < t.gossip_interval_seconds:
+            raise ConfigError(
+                "tenancy.gossipStaleAfter must be >= gossipInterval"
+            )
         s = self.slo
         if s.interval_seconds < 0:
             raise ConfigError("slo.interval must be >= 0")
@@ -822,6 +842,9 @@ def system_from_dict(data: dict) -> System:
             max_retry_after_seconds=_seconds(t.get("maxRetryAfter", 300)),
             max_tenant_series=int(t.get("maxTenantSeries", 512)),
             tenant_idle_seconds=_seconds(t.get("tenantIdle", 600)),
+            door_shards=int(t.get("doorShards", 1)),
+            gossip_interval_seconds=_seconds(t.get("gossipInterval", 1)),
+            gossip_stale_seconds=_seconds(t.get("gossipStaleAfter", 5)),
         )
     if "slo" in data:
         s = data["slo"]

@@ -63,7 +63,12 @@ from kubeai_tpu.fleet.metering import (
 )
 from kubeai_tpu.fleet.profiler import PHASES, StepProfiler, phase_totals
 from kubeai_tpu.fleet.slo import OBJECTIVE_KINDS, SLOEvaluator
-from kubeai_tpu.fleet.tenancy import Refusal, TenantGovernor
+from kubeai_tpu.fleet.tenancy import (
+    Refusal,
+    ShardedDoor,
+    TenantGovernor,
+    build_door,
+)
 
 __all__ = [
     "ANONYMOUS_TENANT",
@@ -76,9 +81,11 @@ __all__ = [
     "Refusal",
     "SCHEDULING_CLASSES",
     "SLOEvaluator",
+    "ShardedDoor",
     "StepProfiler",
     "TenantGovernor",
     "UsageMeter",
+    "build_door",
     "endpoint_signals",
     "hist_detail",
     "hist_quantiles",

@@ -67,13 +67,18 @@ class UsageMeter:
                  max_tenant_series: int = 512):
         self.metrics = metrics
         self.max_tenant_series = int(max_tenant_series)
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # local-state: process-local mutex, not replicated data
         self._ledger: dict[tuple[str, str], dict] = {}
+        # Peer door-shard ledgers learned via the gossip state plane:
+        # shard -> (tenant, model) -> counts. Cumulative snapshots
+        # merged with per-field max, so re-delivered gossip deltas (any
+        # suffix, any order) never double-bill — totals are exact.
+        self._remote: dict[str, dict[tuple[str, str], dict]] = {}
         # tenant -> metric label (own name, or "other" past the cap),
         # and label -> model labels emitted, so churned tenants' series
         # can be removed without touching the exact ledger.
-        self._labels: dict[str, str] = {}
-        self._series: dict[str, set[str]] = {}
+        self._labels: dict[str, str] = {}  # local-state: exposition label map, not billing state
+        self._series: dict[str, set[str]] = {}  # local-state: exposition series map, not billing state
 
     def _label_for(self, tenant: str) -> str:
         label = self._labels.get(tenant)
@@ -153,18 +158,69 @@ class UsageMeter:
             shed=status == 429,
         )
 
+    # -- gossip merge (sharded front door) -------------------------------
+
+    def shard_snapshot(self) -> dict[str, float]:
+        """This shard's cumulative ledger flattened to
+        `tenant|model|field` keys — the G-Counter component this door
+        publishes into the gossip state plane. Cumulative (not deltas),
+        so publication is idempotent by construction."""
+        out: dict[str, float] = {}
+        with self._lock:
+            for (tenant, model), entry in self._ledger.items():
+                for fld, value in entry.items():
+                    if value:
+                        out[f"{tenant}|{model}|{fld}"] = value
+        return out
+
+    def merge_shard_snapshot(self, shard: str,
+                             snapshot: dict[str, float]) -> None:
+        """Merge a peer door-shard's cumulative ledger snapshot.
+        Per-field max keeps every component monotone, so replaying any
+        gossip delta suffix — stale, reordered, or duplicated — leaves
+        the exact-integer totals unchanged."""
+        parsed: dict[tuple[str, str], dict] = {}
+        for key, value in snapshot.items():
+            tenant, model, fld = key.split("|", 2)
+            if fld not in _zero():
+                continue
+            entry = parsed.setdefault((tenant, model), {})
+            entry[fld] = (
+                float(value) if fld == "stream_seconds" else int(value)
+            )
+        with self._lock:
+            held = self._remote.setdefault(shard, {})
+            for tm, fields in parsed.items():
+                entry = held.setdefault(tm, _zero())
+                for fld, value in fields.items():
+                    if value > entry[fld]:
+                        entry[fld] = value
+
+    def absorb_gossip(self, node) -> None:
+        """Pull every peer shard's ledger components out of a
+        DoorGossipNode and merge them (idempotent)."""
+        for shard, snapshot in node.ledger_components().items():
+            self.merge_shard_snapshot(shard, snapshot)
+
     def tenant_model_tokens(self, tenant: str, model: str) -> int:
         """Exact cumulative prompt+completion tokens for one
-        tenant×model pair — the quota feed for the door's rolling
-        windows (window usage = this value now minus its value at the
-        window start)."""
+        tenant×model pair, across every door shard — the quota feed for
+        the door's rolling windows (window usage = this value now minus
+        its value at the window start)."""
         tenant = tenant or ANONYMOUS_TENANT
         model = model or "unknown"
         with self._lock:
+            total = 0
             entry = self._ledger.get((tenant, model))
-            if entry is None:
-                return 0
-            return entry["prompt_tokens"] + entry["completion_tokens"]
+            if entry is not None:
+                total += entry["prompt_tokens"] + entry["completion_tokens"]
+            for held in self._remote.values():
+                remote = held.get((tenant, model))
+                if remote is not None:
+                    total += (
+                        remote["prompt_tokens"] + remote["completion_tokens"]
+                    )
+            return total
 
     def prune_tenant_series(self, keep) -> int:
         """Label-churn pass: remove `kubeai_tenant_*` series for tenants
@@ -195,10 +251,22 @@ class UsageMeter:
 
     def summary(self, tenant: str | None = None) -> dict:
         """The `/v1/usage` payload: per-tenant per-model entries plus
-        per-tenant and global totals. `tenant` filters to one tenant."""
+        per-tenant and global totals, spanning this shard's ledger and
+        every peer shard learned via gossip. `tenant` filters to one
+        tenant."""
         with self._lock:
+            merged: dict[tuple[str, str], dict] = {}
+            for (t, m), e in self._ledger.items():
+                entry = merged.setdefault((t, m), _zero())
+                for k in e:
+                    entry[k] += e[k]
+            for held in self._remote.values():
+                for (t, m), e in held.items():
+                    entry = merged.setdefault((t, m), _zero())
+                    for k in e:
+                        entry[k] += e[k]
             items = [
-                (t, m, dict(e)) for (t, m), e in self._ledger.items()
+                (t, m, e) for (t, m), e in merged.items()
                 if tenant is None or t == tenant
             ]
         tenants: dict[str, dict] = {}

@@ -103,19 +103,22 @@ class _TokenBucket:
     consumes and admits, or refuses with the computed time until enough
     tokens will have refilled."""
 
-    __slots__ = ("rate", "burst", "tokens", "updated")
+    __slots__ = ("rate", "burst", "tokens", "updated", "clipped")
 
     def __init__(self, rate: float, burst: float, now: float):
         self.rate = float(rate)
         self.burst = max(float(burst), 1.0)
         self.tokens = self.burst
         self.updated = now
+        self.clipped = 0.0
 
     def take(self, n: float, now: float) -> tuple[bool, float]:
         if now > self.updated:
-            self.tokens = min(
-                self.burst, self.tokens + (now - self.updated) * self.rate
-            )
+            raw = self.tokens + (now - self.updated) * self.rate
+            if raw > self.burst:
+                self.clipped += raw - self.burst
+                raw = self.burst
+            self.tokens = raw
         self.updated = max(self.updated, now)
         if self.tokens >= n:
             self.tokens -= n
@@ -124,6 +127,34 @@ class _TokenBucket:
         if self.rate <= 0.0:
             return False, float("inf")
         return False, deficit / self.rate
+
+    def drain(self, n: float, now: float) -> None:
+        """Remove ``n`` tokens without the admit gate — folds in
+        consumption observed from peer door shards via gossip. May push
+        the balance negative (debt): every shard's bucket is drained by
+        every shard's admissions, which is exactly what makes N doors
+        enforce ONE global budget instead of N.
+
+        The refill and the fold are applied ATOMICALLY — subtract
+        before clipping at burst. Folds lag real consumption by the
+        gossip interval; clipping the refill first would discard
+        tokens the already-pending fold still claims, ratcheting
+        every shard's balance toward zero even when the tenant runs
+        exactly at its global rate."""
+        raw = self.tokens + max(0.0, now - self.updated) * self.rate - n
+        if raw > self.burst:
+            self.clipped += raw - self.burst
+            raw = self.burst
+        self.tokens = raw
+        self.updated = max(self.updated, now)
+
+    def pop_clipped(self) -> float:
+        """Return and reset refill lost to the burst cap since the
+        last call. Only the gossip fold path reads this — a single
+        door discards clip exactly as the classic bucket does."""
+        c = self.clipped
+        self.clipped = 0.0
+        return c
 
 
 def estimate_tokens(body: bytes, parsed: dict | None = None) -> int:
@@ -157,6 +188,7 @@ class TenantGovernor:
         clock=time.monotonic,
         pressure_fn=None,         # test seam: () -> {"depth", "oldest_wait_s"}
         pressure_ttl_s: float = 1.0,
+        gossip=None,              # routing.gossip.DoorGossipNode (sharded door)
     ):
         self.cfg = cfg
         self.usage = usage
@@ -166,33 +198,42 @@ class TenantGovernor:
         self._clock = clock
         self._pressure_fn = pressure_fn
         self._pressure_ttl = pressure_ttl_s
+        # The gossiped CRDT state plane handle when this governor is one
+        # of N door shards: bucket consumption folds through it, the
+        # overload latch lives in its LWW register, and quota reads span
+        # peer-shard ledgers. None -> classic single-door arithmetic,
+        # byte-identical to the pre-sharding build.
+        self.gossip = gossip
         # Flight recorder (metrics.flightrecorder.FlightRecorder), wired
         # by the manager when the SLO plane is on: every refusal lands
         # in the door ring so an incident bundle shows WHO was turned
         # away in the minutes before a page, not just how many.
-        self.recorder = None
-        self._lock = threading.Lock()
+        self.recorder = None  # local-state: wiring seam set by the manager, not request state
+        self._lock = threading.Lock()  # local-state: process-local mutex, not replicated data
         # (tenant, model) -> {"req": bucket|None, "tok": bucket|None,
-        #                     "seen": ts}
+        #  "seen": ts, "req_rem"/"tok_rem": peer consumption already
+        #  folded into the bucket}. CRDT-backed: consumption is gossiped
+        #  as per-shard G-Counters and folded via _TokenBucket.drain.
         self._buckets: dict[tuple[str, str], dict] = {}
-        # (tenant, model) -> (window_start_ts, ledger_tokens_at_start)
-        self._windows: dict[tuple[str, str], tuple[float, int]] = {}
-        # Overload latch + cached fleet pressure.
+        # (tenant, model) -> (window_start_ts, ledger_tokens_at_start).
+        self._windows: dict[tuple[str, str], tuple[float, int]] = {}  # local-state: window anchors over the CRDT-merged ledger; the cumulative reads they anchor are global
+        # Overload latch (mirrors the gossiped LWW register when
+        # sharded) + cached fleet pressure.
         self._overload = False
         self._pressure = {"depth": 0.0, "oldest_wait_s": 0.0,
-                          "source": "none"}
-        self._pressure_at = float("-inf")
+                          "source": "none"}  # local-state: TTL cache of this shard's fleet-pressure view
+        self._pressure_at = float("-inf")  # local-state: cache timestamp for _pressure
         # Bounded metric cardinality: tenant -> label (own name or
         # "other"), plus the (model, reason) series each label has
         # emitted so churn cleanup can remove them.
-        self._labels: dict[str, str] = {}
-        self._door_series: dict[str, set[tuple[str, str]]] = {}
-        self._last_seen: dict[str, float] = {}
+        self._labels: dict[str, str] = {}  # local-state: exposition label map, not admission state
+        self._door_series: dict[str, set[tuple[str, str]]] = {}  # local-state: exposition series map, not admission state
+        self._last_seen: dict[str, float] = {}  # local-state: per-shard idle tracking; churn is per-process by design
         self._last_cleanup = clock()
         # Exact refusal tallies for /v1/usage (ints, not float counters).
         self._tally = {REASON_RATE: 0, REASON_TOKENS: 0,
-                       REASON_QUOTA: 0, REASON_OVERLOAD: 0}
-        self._admitted = 0
+                       REASON_QUOTA: 0, REASON_OVERLOAD: 0}  # local-state: per-shard tallies; ShardedDoor.state_payload sums shards
+        self._admitted = 0  # local-state: per-shard tally; ShardedDoor.state_payload sums shards
 
     # -- public admission entry points ---------------------------------------
 
@@ -293,6 +334,7 @@ class TenantGovernor:
 
     def _check_buckets(self, tenant, model_name, policy, est_tokens, now):
         key = (tenant, model_name)
+        g = self.gossip
         with self._lock:
             entry = self._buckets.get(key)
             if entry is None:
@@ -305,10 +347,55 @@ class TenantGovernor:
                     ),
                     "seen": now,
                 }
+                if g is not None:
+                    # The bucket starts full; peer consumption from
+                    # before it existed was already charged against the
+                    # peers' own buckets, so the fold baseline is "what
+                    # the global counters say right now".
+                    entry["req_rem"] = g.remote_consumed(
+                        "req", tenant, model_name
+                    )
+                    entry["tok_rem"] = g.remote_consumed(
+                        "tok", tenant, model_name
+                    )
+                    # Degraded-mode overcharge insurance: the extra
+                    # (split-1) charged per admission while partitioned
+                    # pre-pays for remote consumption we cannot see yet.
+                    # When the fold eventually arrives it is paid from
+                    # this pool first, so heal does not double-bill.
+                    entry["req_over"] = 0.0
+                    entry["tok_over"] = 0.0
                 self._buckets[key] = entry
             entry["seen"] = now
+            # Partition degradation: fully connected -> split == 1.0 and
+            # this is byte-identical single-door arithmetic; with stale
+            # peers each admission is charged a conservative multiple so
+            # any split of N shards still admits at most ONE budget.
+            split = g.split(now) if g is not None else 1.0
             if entry["req"] is not None:
-                ok, wait = entry["req"].take(1.0, now)
+                if g is not None:
+                    # Refill lost to the burst cap is the conservative
+                    # reserve this shard withheld for consumption it
+                    # could not see; bank it (up to one burst) so the
+                    # matching folds don't bill the tenant twice.
+                    c = entry["req"].pop_clipped()
+                    if c > 0.0 and entry["req_over"] < policy.request_burst:
+                        entry["req_over"] = min(
+                            policy.request_burst, entry["req_over"] + c
+                        )
+                    rem = g.remote_consumed("req", tenant, model_name)
+                    delta = rem - entry["req_rem"]
+                    if delta > 0.0:
+                        use = min(entry["req_over"], delta)
+                        entry["req_over"] -= use
+                        if delta > use:
+                            entry["req"].drain(delta - use, now)
+                        entry["req_rem"] = rem
+                ok, wait = entry["req"].take(1.0 * split, now)
+                if ok and g is not None:
+                    g.consume("req", tenant, model_name, 1.0)
+                    if split > 1.0:
+                        entry["req_over"] += split - 1.0
                 if not ok:
                     return self._refuse(
                         tenant, model_name, REASON_RATE,
@@ -316,7 +403,25 @@ class TenantGovernor:
                         "limit", wait,
                     )
             if entry["tok"] is not None and est_tokens > 0:
-                ok, wait = entry["tok"].take(float(est_tokens), now)
+                if g is not None:
+                    c = entry["tok"].pop_clipped()
+                    if c > 0.0 and entry["tok_over"] < policy.token_burst:
+                        entry["tok_over"] = min(
+                            policy.token_burst, entry["tok_over"] + c
+                        )
+                    rem = g.remote_consumed("tok", tenant, model_name)
+                    delta = rem - entry["tok_rem"]
+                    if delta > 0.0:
+                        use = min(entry["tok_over"], delta)
+                        entry["tok_over"] -= use
+                        if delta > use:
+                            entry["tok"].drain(delta - use, now)
+                        entry["tok_rem"] = rem
+                ok, wait = entry["tok"].take(float(est_tokens) * split, now)
+                if ok and g is not None:
+                    g.consume("tok", tenant, model_name, float(est_tokens))
+                    if split > 1.0:
+                        entry["tok_over"] += float(est_tokens) * (split - 1.0)
                 if not ok:
                     return self._refuse(
                         tenant, model_name, REASON_TOKENS,
@@ -358,11 +463,22 @@ class TenantGovernor:
         low = float(getattr(self.cfg, "overload_low_water", 0.0) or 0.0)
         if low <= 0.0:
             low = 0.8 * high
+        g = self.gossip
+        if g is not None:
+            # Sharded door: the latch lives in the gossiped LWW
+            # register. Adopt the merged view, then apply this shard's
+            # pressure reading as a read-modify-write — any shard may
+            # flip it either way, and HLC ordering settles races.
+            self._overload = g.overload(default=self._overload)
         if self._overload:
             if depth <= low:
                 self._overload = False
+                if g is not None:
+                    g.set_overload(False)
         elif depth >= high:
             self._overload = True
+            if g is not None:
+                g.set_overload(True)
         shed = set()
         if self._overload:
             shed.add("batch")
@@ -600,3 +716,182 @@ class TenantGovernor:
                 "overloadHighWater": self.cfg.overload_high_water,
             },
         }
+
+
+class ShardedDoor:
+    """N in-process door shards behind a deterministic round-robin
+    shard picker, sharing one gossiped CRDT state plane
+    (routing/gossip.DoorShardSet).
+
+    Same surface as a single TenantGovernor (``admit`` /
+    ``admit_http`` / ``admit_message`` / ``active`` /
+    ``state_payload`` / ``cleanup`` / ``recorder``), so the HTTP front
+    door and the messenger take either without caring. The round-robin
+    picker models an external L4 balancer spraying requests across N
+    door replicas — the adversarial case for budget enforcement, since
+    an abuser's traffic splits evenly across every shard's local view.
+
+    Anti-entropy is driven lazily from the admission path (no
+    background thread): each admission runs a gossip round when the
+    configured interval has elapsed on the injected clock, which keeps
+    FakeClock sims bit-deterministic.
+    """
+
+    def __init__(self, shards, shard_set, usage=None):
+        if not shards:
+            raise ValueError("ShardedDoor needs at least one shard")
+        self.shards = list(shards)
+        self.shard_set = shard_set
+        self.usage = usage
+        self._rr = 0
+        self._recorder = None
+
+    # -- TenantGovernor surface ------------------------------------------
+
+    def active(self) -> bool:
+        return any(s.active() for s in self.shards)
+
+    def admit_http(self, headers: dict, body: bytes) -> Refusal | None:
+        self._tick()
+        return self._pick().admit_http(headers, body)
+
+    def admit_message(self, metadata, model, body) -> Refusal | None:
+        self._tick()
+        return self._pick().admit_message(metadata, model, body)
+
+    def admit(self, tenant, model_name, *, priority="", est_tokens=1,
+              model=None) -> Refusal | None:
+        self._tick()
+        return self._pick().admit(
+            tenant, model_name, priority=priority,
+            est_tokens=est_tokens, model=model,
+        )
+
+    @property
+    def recorder(self):
+        return self._recorder
+
+    @recorder.setter
+    def recorder(self, rec) -> None:
+        self._recorder = rec
+        for s in self.shards:
+            s.recorder = rec
+
+    @property
+    def overload(self) -> bool:
+        """The fleet-wide overload latch: any shard's view (converged
+        via the gossiped LWW register)."""
+        return any(s._overload for s in self.shards)
+
+    @property
+    def cfg(self):
+        return self.shards[0].cfg
+
+    def fleet_pressure(self, now: float | None = None) -> dict:
+        return self.shards[0].fleet_pressure(now)
+
+    def cleanup(self, now: float | None = None) -> int:
+        return sum(s.cleanup(now=now) for s in self.shards)
+
+    def state_payload(self) -> dict:
+        """Aggregate door state across shards: exact tallies summed,
+        plus per-shard gossip health."""
+        payload = self.shards[0].state_payload()
+        for s in self.shards[1:]:
+            p = s.state_payload()
+            payload["admitted"] += p["admitted"]
+            payload["tenants_tracked"] += p["tenants_tracked"]
+            for reason, n in p["rejections"].items():
+                payload["rejections"][reason] += n
+        payload["overload"] = self.overload
+        now = float(self.shard_set.clock())
+        payload["shards"] = {
+            name: {
+                "degraded": node.degraded(now),
+                "stale_peers": list(node.stale_peers(now)),
+                "state_entries": len(node.state),
+            }
+            for name, node in sorted(self.shard_set.nodes.items())
+        }
+        return payload
+
+    # -- shard plumbing ---------------------------------------------------
+
+    def _pick(self) -> TenantGovernor:
+        i = self._rr % len(self.shards)
+        self._rr += 1
+        return self.shards[i]
+
+    def _tick(self) -> None:
+        now = float(self.shard_set.clock())
+        if self.shard_set.maybe_step(now):
+            self._after_round()
+
+    def step_gossip(self, now: float | None = None) -> None:
+        """Explicit anti-entropy round (sims and tests)."""
+        self.shard_set.step(now)
+        self._after_round()
+
+    def _after_round(self) -> None:
+        # Per-shard UsageMeters (cross-process deployments and the
+        # sharded sims) absorb peer ledgers after every round; with one
+        # shared in-process meter usage_source is unwired and this is a
+        # no-op.
+        for s in self.shards:
+            if (
+                s.usage is not None
+                and s.gossip is not None
+                and s.gossip.usage_source is not None
+            ):
+                s.usage.absorb_gossip(s.gossip)
+
+    def replace_shard(self, index: int, governor: TenantGovernor) -> None:
+        """Swap in a restarted shard (door_crash chaos): the fresh
+        governor starts with empty local state and reconstructs the
+        replicated portion from its peers via anti-entropy."""
+        self.shards[index] = governor
+        governor.recorder = self._recorder
+
+
+def build_door(
+    cfg,
+    *,
+    usage=None,
+    fleet=None,
+    model_client=None,
+    metrics: Metrics = DEFAULT_METRICS,
+    clock=time.monotonic,
+    pressure_fn=None,
+    pressure_ttl_s: float = 1.0,
+    seed: int = 0,
+):
+    """Build the front door from TenancyConfig: a single TenantGovernor
+    when ``door_shards <= 1`` (byte-identical to the pre-sharding
+    build), else N governors sharing a gossiped state plane behind a
+    ShardedDoor."""
+    n = int(getattr(cfg, "door_shards", 1) or 1)
+
+    def _governor(gossip=None):
+        return TenantGovernor(
+            cfg=cfg, usage=usage, fleet=fleet, model_client=model_client,
+            metrics=metrics, clock=clock, pressure_fn=pressure_fn,
+            pressure_ttl_s=pressure_ttl_s, gossip=gossip,
+        )
+
+    if n <= 1:
+        return _governor()
+    from kubeai_tpu.routing.gossip import DoorShardSet
+
+    names = [f"door-{i}" for i in range(n)]
+    shard_set = DoorShardSet(
+        names, clock, seed=seed,
+        interval_s=float(
+            getattr(cfg, "gossip_interval_seconds", 1.0) or 1.0
+        ),
+        stale_after_s=float(
+            getattr(cfg, "gossip_stale_seconds", 5.0) or 5.0
+        ),
+        metrics=metrics,
+    )
+    shards = [_governor(gossip=shard_set.node(name)) for name in names]
+    return ShardedDoor(shards, shard_set, usage=usage)

@@ -988,6 +988,58 @@ class Metrics:
             "quota windows; idle tenants expire).",
             self.registry,
         )
+        # -- door-shard gossip state plane (kubeai_tpu/routing/gossip) ------
+        self.gossip_rounds = Counter(
+            "kubeai_gossip_rounds_total",
+            "Anti-entropy rounds run by the door shard set (each round "
+            "push-pulls every shard with one rotated peer).",
+            self.registry,
+        )
+        self.gossip_syncs = Counter(
+            "kubeai_gossip_syncs_total",
+            "Per-shard pairwise sync attempts by result: ok (state "
+            "exchanged), skip (digests already equal), unreachable "
+            "(link severed by a partition).",
+            self.registry,
+        )
+        self.gossip_entries_sent = Counter(
+            "kubeai_gossip_entries_sent_total",
+            "CRDT entries shipped between door shards (delta-state "
+            "sync; full state only after crash/heal/churn).",
+            self.registry,
+        )
+        self.gossip_merges = Counter(
+            "kubeai_gossip_merges_total",
+            "CRDT entries that actually changed when merged (idempotent "
+            "re-deliveries do not count).",
+            self.registry,
+        )
+        self.gossip_state_entries = Gauge(
+            "kubeai_gossip_state_entries",
+            "CRDT entries held in each door shard's replicated state "
+            "(shard label).",
+            self.registry,
+        )
+        self.gossip_peer_staleness = Gauge(
+            "kubeai_gossip_peer_staleness_seconds",
+            "Seconds since each door shard last exchanged state with "
+            "each peer (shard, peer labels); the partition detector's "
+            "input.",
+            self.registry,
+        )
+        self.gossip_degraded = Gauge(
+            "kubeai_gossip_degraded",
+            "1 while the door shard is partitioned from at least one "
+            "peer and enforcing the conservative local budget split.",
+            self.registry,
+        )
+        self.gossip_breaker_adoptions = Counter(
+            "kubeai_gossip_breaker_adoptions_total",
+            "Breaker opens adopted from peer door shards via gossip "
+            "per model — failures this shard never had to pay for "
+            "itself.",
+            self.registry,
+        )
         # -- tracing export health ------------------------------------------
         self.tracing_dropped_spans = TracingDroppedSpans(
             "kubeai_tracing_dropped_spans_total",

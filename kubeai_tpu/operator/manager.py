@@ -128,8 +128,8 @@ class Manager:
             CapacityPlanner,
             DemandForecaster,
             FleetStateAggregator,
-            TenantGovernor,
             UsageMeter,
+            build_door,
         )
 
         self.usage = UsageMeter(
@@ -197,15 +197,22 @@ class Manager:
         # Front-door tenant admission (kubeai_tpu/fleet/tenancy): only
         # constructed when tenancy is enabled — disabled (the default)
         # leaves the serving path identical to a build without it.
+        # `doorShards > 1` builds N in-process door shards sharing a
+        # gossiped CRDT state plane behind a round-robin shard picker
+        # (fleet/tenancy.ShardedDoor); the routing tier then reads
+        # breaker verdicts and prefix holdings from the same plane.
         self.tenancy = None
         if self.cfg.tenancy.enabled:
-            self.tenancy = TenantGovernor(
-                cfg=self.cfg.tenancy,
+            self.tenancy = build_door(
+                self.cfg.tenancy,
                 usage=self.usage,
                 fleet=self.fleet,
                 model_client=self.model_client,
                 metrics=self.metrics,
             )
+            shard_set = getattr(self.tenancy, "shard_set", None)
+            if shard_set is not None:
+                self.lb.set_gossip(shard_set.node(shard_set.names()[0]))
         # SLO plane (kubeai_tpu/fleet/slo) + always-on flight recorder
         # (kubeai_tpu/metrics/flightrecorder): only constructed when
         # `slo.enabled` — disabled leaves every subsystem's `recorder`

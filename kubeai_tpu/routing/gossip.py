@@ -1,0 +1,900 @@
+"""Gossiped CRDT state plane for the sharded front door.
+
+The front door (TenantGovernor + breakers + prefix-holdings routing)
+used to be ONE process; running N of them naively means N x every
+token bucket and N independent breaker views.  This module gives N
+door shards a decentralized, partition-tolerant shared brain built
+from state-based CRDTs:
+
+* ``GCounter`` / ``PNCounter`` — per-(tenant, model) token-bucket
+  consumption and UsageMeter ledger totals.  Components are keyed by
+  shard name; merge is an element-wise max, so re-delivered deltas are
+  idempotent and any merge order converges to the same bytes.
+* ``LWWRegister`` / ``LWWMap`` — breaker states, the global overload
+  latch, and the per-model KV-holdings map.  Timestamps are hybrid
+  logical clocks (``HLC``), never wall clock, so ordering is total and
+  deterministic under clock skew between shards.
+* ``FWWRegister`` — first-writer-wins claims for half-open breaker
+  probe election (exactly one shard probes per half-open window).
+* ``DoorShardSet`` — membership plus the anti-entropy loop: push-pull
+  digest exchange, delta-state sync with per-peer dirty tracking,
+  per-peer staleness, and a partition seam for chaos drills.  When a
+  shard cannot hear its peers it degrades to local-view enforcement
+  with a conservative budget split (see ``DoorGossipNode.split``).
+
+Determinism contract: everything in this file is driven by an injected
+clock (FakeClock in tests/sims) and deterministic peer rotation — no
+wall clock, no unseeded randomness.  Serialization is sorted-key JSON
+so converged state is byte-comparable across shards.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+
+logger = logging.getLogger(__name__)
+
+# Registry consumed by scripts/check_shared_state.py: mutable
+# cross-request state fields on the door/breaker classes that are
+# backed by this state plane.  Every other mutable field on these
+# classes must carry a reviewed `# local-state:` pragma.  The gate
+# checks both directions (unregistered field -> violation; registered
+# field that no longer exists -> violation).
+CRDT_BACKED_FIELDS: dict[str, tuple[str, ...]] = {
+    # fleet/tenancy.py — bucket consumption is gossiped as G-Counters,
+    # the overload latch as an LWW register.
+    "TenantGovernor": ("_buckets", "_overload"),
+    # routing/health.py — open/half-open transitions are published as
+    # LWW entries and adopted by peer shards.
+    "EndpointHealth": ("state", "_opened_at"),
+    # routing/loadbalancer.py — per-endpoint prefix-chain holdings are
+    # read from the gossiped LWW map when a provider is wired.
+    "Group": ("_kv_holdings", "_kv_holdings_ts"),
+    # fleet/metering.py — the billing ledger merges peer-shard
+    # cumulative snapshots (G-Counter semantics per component).
+    "UsageMeter": ("_ledger", "_remote"),
+}
+
+
+def _canon(obj) -> str:
+    """Canonical JSON used for digests and byte-compare convergence."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+# ---------------------------------------------------------------------------
+# Hybrid logical clock
+
+
+class HLC:
+    """Hybrid logical clock: stamps are ``(physical, logical, node)``.
+
+    ``physical`` comes from the injected clock (FakeClock in tests) —
+    never ``time.time()`` directly — and ``logical`` breaks ties so
+    stamps issued by one node are strictly increasing even when the
+    clock does not advance.  Tuple comparison gives a deterministic
+    total order under arbitrary clock skew between shards.
+    """
+
+    __slots__ = ("node", "_clock", "physical", "logical")
+
+    def __init__(self, node: str, clock) -> None:
+        self.node = node
+        self._clock = clock
+        self.physical = 0.0
+        self.logical = 0
+
+    def tick(self) -> tuple[float, int, str]:
+        """Stamp a local event."""
+        now = float(self._clock())
+        if now > self.physical:
+            self.physical, self.logical = now, 0
+        else:
+            self.logical += 1
+        return (self.physical, self.logical, self.node)
+
+    def observe(self, stamp) -> None:
+        """Fold a remote stamp so future local stamps sort after it."""
+        rp, rl = float(stamp[0]), int(stamp[1])
+        now = float(self._clock())
+        top = max(self.physical, rp, now)
+        if top == self.physical and top == rp:
+            self.logical = max(self.logical, rl) + 1
+        elif top == self.physical:
+            self.logical += 1
+        elif top == rp:
+            self.logical = rl + 1
+        else:
+            self.logical = 0
+        self.physical = top
+
+
+# ---------------------------------------------------------------------------
+# CRDT primitives
+
+
+class GCounter:
+    """Grow-only counter: one monotone component per shard.
+
+    ``merge`` is element-wise max — commutative, associative,
+    idempotent — so counting is exact under re-delivery and arbitrary
+    merge order.  Components may be ``set`` to a cumulative value
+    (ledger snapshots) or ``add``-ed (bucket consumption); both keep
+    the per-component monotonicity the merge relies on.
+    """
+
+    __slots__ = ("counts",)
+
+    def __init__(self, counts: dict[str, float] | None = None) -> None:
+        self.counts: dict[str, float] = dict(counts or {})
+
+    def add(self, node: str, n: float) -> None:
+        if n < 0:
+            raise ValueError("GCounter.add requires n >= 0")
+        if n == 0 and node not in self.counts:
+            # Never materialize a zero component: merge only copies
+            # strictly-greater values, so an explicit 0.0 would live on
+            # one replica but never transfer — semantically equal
+            # states with different bytes, a permanent digest mismatch.
+            return
+        self.counts[node] = self.counts.get(node, 0.0) + n
+
+    def set_component(self, node: str, value: float) -> None:
+        cur = self.counts.get(node, 0.0)
+        if value < cur:
+            raise ValueError(
+                f"GCounter component for {node} would regress "
+                f"({value} < {cur})"
+            )
+        self.counts[node] = value
+
+    def value(self) -> float:
+        return sum(self.counts.values())
+
+    def of(self, node: str) -> float:
+        return self.counts.get(node, 0.0)
+
+    def except_of(self, node: str) -> float:
+        return sum(v for k, v in self.counts.items() if k != node)
+
+    def merge(self, other: "GCounter") -> bool:
+        changed = False
+        for node, v in other.counts.items():
+            if v > self.counts.get(node, 0.0):
+                self.counts[node] = v
+                changed = True
+        return changed
+
+    def to_wire(self) -> dict:
+        # Zero components are dropped from the canonical form (they
+        # contribute nothing and cannot transfer through merge), so
+        # byte-compared digests agree across replicas.
+        return {
+            "t": "g",
+            "c": {k: v for k, v in sorted(self.counts.items()) if v != 0.0},
+        }
+
+    @classmethod
+    def from_wire(cls, wire: dict) -> "GCounter":
+        return cls({str(k): float(v) for k, v in wire["c"].items()})
+
+
+class PNCounter:
+    """Positive-negative counter: a G-Counter pair (adds, removes)."""
+
+    __slots__ = ("pos", "neg")
+
+    def __init__(self, pos: GCounter | None = None,
+                 neg: GCounter | None = None) -> None:
+        self.pos = pos or GCounter()
+        self.neg = neg or GCounter()
+
+    def add(self, node: str, n: float) -> None:
+        if n >= 0:
+            self.pos.add(node, n)
+        else:
+            self.neg.add(node, -n)
+
+    def value(self) -> float:
+        return self.pos.value() - self.neg.value()
+
+    def merge(self, other: "PNCounter") -> bool:
+        a = self.pos.merge(other.pos)
+        b = self.neg.merge(other.neg)
+        return a or b
+
+    def to_wire(self) -> dict:
+        return {"t": "pn", "p": self.pos.to_wire(), "n": self.neg.to_wire()}
+
+    @classmethod
+    def from_wire(cls, wire: dict) -> "PNCounter":
+        return cls(GCounter.from_wire(wire["p"]), GCounter.from_wire(wire["n"]))
+
+
+class LWWRegister:
+    """Last-writer-wins register ordered by HLC stamp.
+
+    The stamp includes the writing node, so ties are impossible:
+    ``(physical, logical, node)`` is a strict total order and any two
+    merge orders agree on the winner.
+    """
+
+    __slots__ = ("value", "stamp")
+
+    _ZERO = (-1.0, 0, "")
+
+    def __init__(self, value=None, stamp=None) -> None:
+        self.value = value
+        self.stamp = tuple(stamp) if stamp else self._ZERO
+
+    def set(self, value, stamp) -> None:
+        stamp = tuple(stamp)
+        if stamp > self.stamp:
+            self.value, self.stamp = value, stamp
+
+    def merge(self, other: "LWWRegister") -> bool:
+        if other.stamp > self.stamp:
+            self.value, self.stamp = other.value, other.stamp
+            return True
+        return False
+
+    def to_wire(self) -> dict:
+        return {"t": "lww", "v": self.value, "s": list(self.stamp)}
+
+    @classmethod
+    def from_wire(cls, wire: dict) -> "LWWRegister":
+        reg = cls()
+        reg.value = wire["v"]
+        s = wire["s"]
+        reg.stamp = (float(s[0]), int(s[1]), str(s[2]))
+        return reg
+
+
+class FWWRegister:
+    """First-writer-wins register: the EARLIEST stamp wins.
+
+    Merge keeps the minimum stamp — still commutative, associative and
+    idempotent — which is what probe election needs: the first shard to
+    claim a half-open window owns it, and later claims lose
+    deterministically on every shard.
+    """
+
+    __slots__ = ("value", "stamp")
+
+    _INF = (float("inf"), 0, "￿")
+
+    def __init__(self, value=None, stamp=None) -> None:
+        self.value = value
+        self.stamp = tuple(stamp) if stamp else self._INF
+
+    def set(self, value, stamp) -> None:
+        stamp = tuple(stamp)
+        if stamp < self.stamp:
+            self.value, self.stamp = value, stamp
+
+    def merge(self, other: "FWWRegister") -> bool:
+        if other.stamp < self.stamp:
+            self.value, self.stamp = other.value, other.stamp
+            return True
+        return False
+
+    def to_wire(self) -> dict:
+        if self.stamp == self._INF:
+            return {"t": "fww", "v": self.value, "s": None}
+        return {"t": "fww", "v": self.value, "s": list(self.stamp)}
+
+    @classmethod
+    def from_wire(cls, wire: dict) -> "FWWRegister":
+        reg = cls()
+        reg.value = wire["v"]
+        s = wire["s"]
+        if s is not None:
+            reg.stamp = (float(s[0]), int(s[1]), str(s[2]))
+        return reg
+
+
+_WIRE_TYPES = {
+    "g": GCounter,
+    "pn": PNCounter,
+    "lww": LWWRegister,
+    "fww": FWWRegister,
+}
+
+
+def entry_from_wire(wire: dict):
+    return _WIRE_TYPES[wire["t"]].from_wire(wire)
+
+
+# ---------------------------------------------------------------------------
+# Replicated door state
+
+# Entry-key namespaces.  Keys are flat strings "<ns>!<parts...>" so the
+# whole state serializes as one sorted map.
+NS_REQ = "req"        # request-bucket consumption, key tenant|model
+NS_TOK = "tok"        # token-bucket consumption, key tenant|model
+NS_LEDGER = "led"     # usage ledger, key tenant|model|field
+NS_BREAKER = "brk"    # breaker LWW, key model|addr
+NS_OVERLOAD = "ovl"   # overload LWW, key "global"
+NS_HOLDINGS = "kvh"   # holdings LWW, key model|addr
+NS_PROBE = "prb"      # probe-claim FWW, key model|addr|window
+
+_SEP = "!"
+_CTOR = {
+    NS_REQ: GCounter,
+    NS_TOK: GCounter,
+    NS_LEDGER: GCounter,
+    NS_BREAKER: LWWRegister,
+    NS_OVERLOAD: LWWRegister,
+    NS_HOLDINGS: LWWRegister,
+    NS_PROBE: FWWRegister,
+}
+
+
+class DoorShardState:
+    """The full replicated state of one door shard: a flat map of
+    namespaced keys to CRDT entries.  State-based: merging a peer's
+    entries (full state or any delta suffix, in any order, any number
+    of times) converges to the same bytes."""
+
+    __slots__ = ("entries", "_entry_hashes", "_acc", "_pending", "_digest")
+
+    def __init__(self) -> None:
+        self.entries: dict[str, object] = {}
+        # Incremental digest: per-entry 128-bit hashes XOR-combined
+        # into `_acc`.  XOR is order-independent, so two replicas with
+        # the same entry set produce the same digest no matter what
+        # order the entries arrived in — and updating it costs O(keys
+        # touched), not O(total entries), which is what keeps gossip
+        # rounds affordable at million-tenant state sizes.
+        self._entry_hashes: dict[str, int] = {}
+        self._acc = 0
+        # Keys whose hash is stale; None = rebuild everything.
+        self._pending: set[str] | None = None
+        self._digest: str | None = None
+
+    def bump(self, full_key: str | None = None) -> None:
+        """Invalidate the digest for one key — callers that hand out
+        entries for in-place mutation (DoorGossipNode._touch) must call
+        this.  ``None`` invalidates the whole state (rare, slow)."""
+        self._digest = None
+        if full_key is None or self._pending is None:
+            self._pending = None
+        else:
+            self._pending.add(full_key)
+
+    def get(self, ns: str, key: str, create: bool = False):
+        full = f"{ns}{_SEP}{key}"
+        entry = self.entries.get(full)
+        if entry is None and create:
+            entry = _CTOR[ns]()
+            self.entries[full] = entry
+        return entry
+
+    def in_namespace(self, ns: str):
+        prefix = f"{ns}{_SEP}"
+        for full, entry in self.entries.items():
+            if full.startswith(prefix):
+                yield full[len(prefix):], entry
+
+    def merge_entry(self, full_key: str, wire: dict) -> bool:
+        incoming = entry_from_wire(wire)
+        mine = self.entries.get(full_key)
+        if mine is None:
+            ns = full_key.split(_SEP, 1)[0]
+            expect = _CTOR.get(ns)
+            if expect is not None and not isinstance(incoming, expect):
+                raise ValueError(
+                    f"wire type mismatch for {full_key!r}: {wire['t']}"
+                )
+            self.entries[full_key] = incoming
+            self.bump(full_key)
+            return True
+        changed = mine.merge(incoming)
+        if changed:
+            self.bump(full_key)
+        return changed
+
+    def merge(self, other: "DoorShardState") -> bool:
+        changed = False
+        for full, entry in other.entries.items():
+            if self.merge_entry(full, entry.to_wire()):
+                changed = True
+        return changed
+
+    def to_wire(self) -> dict[str, dict]:
+        return {k: self.entries[k].to_wire() for k in sorted(self.entries)}
+
+    def delta_wire(self, keys) -> dict[str, dict]:
+        return {
+            k: self.entries[k].to_wire()
+            for k in sorted(keys)
+            if k in self.entries
+        }
+
+    @staticmethod
+    def _entry_hash(full_key: str, entry) -> int:
+        h = hashlib.sha256(
+            f"{full_key}={_canon(entry.to_wire())}".encode()
+        ).digest()
+        return int.from_bytes(h[:16], "big")
+
+    def digest(self) -> str:
+        if self._digest is not None:
+            return self._digest
+        if self._pending is None:
+            self._entry_hashes = {
+                k: self._entry_hash(k, e) for k, e in self.entries.items()
+            }
+            acc = 0
+            for h in self._entry_hashes.values():
+                acc ^= h
+            self._acc = acc
+        else:
+            for k in self._pending:
+                entry = self.entries.get(k)
+                if entry is None:
+                    continue
+                old = self._entry_hashes.get(k, 0)
+                new = self._entry_hash(k, entry)
+                self._acc ^= old ^ new
+                self._entry_hashes[k] = new
+        self._pending = set()
+        # Entry count disambiguates the empty-XOR case and paired
+        # duplicates; replicas with identical entry sets always agree.
+        self._digest = f"{len(self.entries)}:{self._acc:032x}"
+        return self._digest
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+
+# ---------------------------------------------------------------------------
+# Per-shard node handle
+
+
+class DoorGossipNode:
+    """One door shard's handle onto the replicated state.
+
+    The TenantGovernor, breaker plumbing, and prefix router talk to
+    this object; the DoorShardSet moves state between nodes.  All
+    mutation goes through the CRDT entries so anti-entropy stays
+    idempotent.
+    """
+
+    def __init__(self, name: str, clock, *, stale_after_s: float = 5.0):
+        self.name = name
+        self.clock = clock
+        self.hlc = HLC(name, clock)
+        self.state = DoorShardState()
+        # Bumped on every local touch and every absorbed change —
+        # readers (Group's holdings view) key caches off it.
+        self.version = 0
+        self.stale_after_s = float(stale_after_s)
+        # Peer name -> last time we successfully exchanged state with
+        # it (direct sync only; transitivity is handled by rotation).
+        self.last_heard: dict[str, float] = {}
+        self.peers: tuple[str, ...] = ()
+        # Keys touched locally since the last successful sync with each
+        # peer (delta-state sync).  None sentinel = send full state.
+        self._dirty: dict[str, set | None] = {}
+        # Callable returning the local UsageMeter's cumulative ledger
+        # snapshot {(tenant|model|field): int}; folded into NS_LEDGER
+        # before each outbound sync.
+        self.usage_source = None
+
+    # -- membership -----------------------------------------------------
+
+    def set_peers(self, peers) -> None:
+        self.peers = tuple(sorted(p for p in peers if p != self.name))
+        for p in self.peers:
+            self.last_heard.setdefault(p, float(self.clock()))
+            self._dirty.setdefault(p, None)
+
+    def mark_dirty(self, full_key: str) -> None:
+        for peer, keys in self._dirty.items():
+            if keys is not None:
+                keys.add(full_key)
+
+    def _touch(self, ns: str, key: str, create: bool = True):
+        entry = self.state.get(ns, key, create=create)
+        if entry is not None:
+            full = f"{ns}{_SEP}{key}"
+            self.mark_dirty(full)
+            self.version += 1
+            self.state.bump(full)
+        return entry
+
+    # -- partition awareness --------------------------------------------
+
+    def stale_peers(self, now: float) -> tuple[str, ...]:
+        return tuple(
+            p for p in self.peers
+            if now - self.last_heard.get(p, 0.0) > self.stale_after_s
+        )
+
+    def degraded(self, now: float) -> bool:
+        return bool(self.stale_peers(now))
+
+    def split(self, now: float) -> float:
+        """Conservative budget split while degraded.
+
+        With F of N-1 peers stale, this shard can only vouch for the
+        shards it still hears; it charges each admission
+        ``N / reachable`` tokens, i.e. enforces a ``1/reachable`` slice
+        of a budget conservatively scaled as if every unreachable shard
+        were spending its own full slice.  Any partition of N shards
+        therefore admits at most the one global budget (plus the
+        staleness-detection lag, which the sims fold into epsilon).
+        Fully connected -> split == 1.0 -> byte-identical single-door
+        arithmetic.
+        """
+        total = len(self.peers) + 1
+        reachable = total - len(self.stale_peers(now))
+        return total / max(1, reachable)
+
+    # -- token-bucket consumption ---------------------------------------
+
+    def consume(self, ns: str, tenant: str, model: str, n: float) -> None:
+        self._touch(ns, f"{tenant}|{model}").add(self.name, n)
+
+    def remote_consumed(self, ns: str, tenant: str, model: str) -> float:
+        entry = self.state.get(ns, f"{tenant}|{model}")
+        return entry.except_of(self.name) if entry is not None else 0.0
+
+    # -- usage ledger ----------------------------------------------------
+
+    def publish_usage(self, snapshot: dict[str, float]) -> None:
+        """Fold the local meter's cumulative ledger into the state.
+        Components are set (not added) so re-publication is idempotent."""
+        for key, value in snapshot.items():
+            entry = self.state.get(NS_LEDGER, key, create=True)
+            if value > entry.of(self.name):
+                entry.set_component(self.name, value)
+                full = f"{NS_LEDGER}{_SEP}{key}"
+                self.mark_dirty(full)
+                self.version += 1
+                self.state.bump(full)
+
+    def remote_ledger(self) -> dict[str, float]:
+        """Peer-shard ledger totals keyed tenant|model|field."""
+        out: dict[str, float] = {}
+        for key, entry in self.state.in_namespace(NS_LEDGER):
+            v = entry.except_of(self.name)
+            if v:
+                out[key] = v
+        return out
+
+    def ledger_components(self) -> dict[str, dict[str, float]]:
+        """Per-peer cumulative ledger snapshots learned via gossip,
+        keyed shard -> {tenant|model|field: value}; own component
+        excluded.  Feed for ``UsageMeter.merge_shard_snapshot``."""
+        out: dict[str, dict[str, float]] = {}
+        for key, entry in self.state.in_namespace(NS_LEDGER):
+            for node, v in entry.counts.items():
+                if node != self.name and v:
+                    out.setdefault(node, {})[key] = v
+        return out
+
+    def remote_ledger_tokens(self, tenant: str, model: str) -> int:
+        total = 0.0
+        for fld in ("prompt_tokens", "completion_tokens"):
+            entry = self.state.get(NS_LEDGER, f"{tenant}|{model}|{fld}")
+            if entry is not None:
+                total += entry.except_of(self.name)
+        return int(total)
+
+    # -- overload latch --------------------------------------------------
+
+    def set_overload(self, value: bool) -> None:
+        self._touch(NS_OVERLOAD, "global").set(bool(value), self.hlc.tick())
+
+    def overload(self, default: bool = False) -> bool:
+        entry = self.state.get(NS_OVERLOAD, "global")
+        if entry is None or entry.value is None:
+            return default
+        return bool(entry.value)
+
+    # -- breaker propagation ---------------------------------------------
+
+    def publish_breaker(self, model: str, addr: str, state: str,
+                        opened_at: float, error: str = "") -> None:
+        key = f"{model}|{addr}"
+        stamp = self.hlc.tick()
+        self._touch(NS_BREAKER, key).set(
+            {"state": state, "opened_at": float(opened_at),
+             "error": error, "by": self.name},
+            stamp,
+        )
+        if state == "open":
+            # The tripping shard claims the upcoming half-open window
+            # eagerly; adopters see the claim and stand down, so
+            # exactly one probe lands per window fleet-wide.
+            self.claim_probe(model, addr, opened_at, stamp=stamp)
+
+    def breaker_view(self, model: str) -> dict[str, dict]:
+        out = {}
+        prefix = f"{model}|"
+        for key, entry in self.state.in_namespace(NS_BREAKER):
+            if key.startswith(prefix) and entry.value is not None:
+                out[key[len(prefix):]] = dict(entry.value, stamp=entry.stamp)
+        return out
+
+    # -- probe election --------------------------------------------------
+
+    @staticmethod
+    def _probe_window(opened_at: float) -> str:
+        return f"{float(opened_at):.6f}"
+
+    def claim_probe(self, model: str, addr: str, opened_at: float,
+                    *, stamp=None) -> bool:
+        """Claim the half-open probe window keyed by the open stamp.
+        Returns True when this shard holds the claim (first writer)."""
+        key = f"{model}|{addr}|{self._probe_window(opened_at)}"
+        entry = self._touch(NS_PROBE, key)
+        entry.set(self.name, stamp or self.hlc.tick())
+        return entry.value == self.name
+
+    def probe_winner(self, model: str, addr: str,
+                     opened_at: float) -> str | None:
+        key = f"{model}|{addr}|{self._probe_window(opened_at)}"
+        entry = self.state.get(NS_PROBE, key)
+        return entry.value if entry is not None else None
+
+    def may_probe(self, model: str, addr: str, opened_at: float) -> bool:
+        """Probe-election gate for Group.get_best_addr.  A shard may
+        probe when it holds the window claim, or when nobody has
+        claimed it yet (it claims on the way in)."""
+        winner = self.probe_winner(model, addr, opened_at)
+        if winner is None:
+            return self.claim_probe(model, addr, opened_at)
+        return winner == self.name
+
+    # -- prefix holdings -------------------------------------------------
+
+    def publish_holdings(self, model: str, addr: str,
+                         chains, ts: float) -> None:
+        self._touch(NS_HOLDINGS, f"{model}|{addr}").set(
+            {"chains": sorted(chains), "ts": float(ts)}, self.hlc.tick()
+        )
+
+    def holdings(self, model: str) -> tuple[dict[str, frozenset], float | None]:
+        """Merged per-endpoint chain holdings for one model, plus the
+        newest publication timestamp (None when cold)."""
+        out: dict[str, frozenset] = {}
+        newest: float | None = None
+        prefix = f"{model}|"
+        for key, entry in self.state.in_namespace(NS_HOLDINGS):
+            if not key.startswith(prefix) or entry.value is None:
+                continue
+            out[key[len(prefix):]] = frozenset(entry.value["chains"])
+            ts = float(entry.value["ts"])
+            if newest is None or ts > newest:
+                newest = ts
+        return out, newest
+
+    # -- sync plumbing ---------------------------------------------------
+
+    def flush_usage(self) -> None:
+        """Fold the local meter's ledger into the state if a source is
+        wired. Must run BEFORE digest comparison: fresh local usage on
+        top of otherwise-identical gossip state would otherwise hit the
+        equal-digest skip and never enter the plane."""
+        if self.usage_source is not None:
+            self.publish_usage(self.usage_source())
+
+    def outbound(self, peer: str) -> dict[str, dict]:
+        """Wire delta for a peer: only keys dirtied since the last
+        successful sync, or the full state when history is unknown
+        (fresh peer, post-crash, post-partition churn)."""
+        self.flush_usage()
+        dirty = self._dirty.get(peer)
+        if dirty is None:
+            return self.state.to_wire()
+        return self.state.delta_wire(dirty)
+
+    def absorb(self, wire: dict[str, dict], now: float,
+               from_peer: str | None = None) -> int:
+        """Merge a peer's wire delta; returns entries changed."""
+        changed = 0
+        for full_key, entry_wire in sorted(wire.items()):
+            if self.state.merge_entry(full_key, entry_wire):
+                changed += 1
+                self.version += 1
+                # Adopted entries must keep flowing to *other* peers.
+                for peer, keys in self._dirty.items():
+                    if peer != from_peer and keys is not None:
+                        keys.add(full_key)
+            t = entry_wire.get("s")
+            if entry_wire.get("t") in ("lww", "fww") and t:
+                self.hlc.observe((float(t[0]), int(t[1]), str(t[2])))
+        if from_peer is not None:
+            self.last_heard[from_peer] = now
+            self._dirty[from_peer] = set()
+        return changed
+
+    def forget_peer_history(self, peer: str) -> None:
+        self._dirty[peer] = None
+
+
+class DoorShardSet:
+    """Membership and anti-entropy for N in-process door shards.
+
+    One gossip round (``step``): every node, in sorted name order,
+    push-pulls with one peer chosen by deterministic rotation
+    (node i's r-th round partner cycles through the other N-1 nodes).
+    Digests are exchanged first; equal digests skip the transfer.  A
+    ``partition`` seam severs links between groups for chaos drills;
+    ``heal`` restores them, and full-state resync on the first
+    post-heal round guarantees convergence within a bounded number of
+    rounds (<= N-1 with rotation).  Deterministic: the only inputs are
+    the injected clock and the seed.
+    """
+
+    def __init__(self, names, clock, *, seed: int = 0,
+                 interval_s: float = 1.0, stale_after_s: float = 5.0,
+                 metrics=None):
+        names = sorted(names)
+        if len(set(names)) != len(names):
+            raise ValueError("duplicate shard names")
+        self.clock = clock
+        self.seed = int(seed)
+        self.interval_s = float(interval_s)
+        self.stale_after_s = float(stale_after_s)
+        self.metrics = metrics
+        self.nodes: dict[str, DoorGossipNode] = {
+            n: DoorGossipNode(n, clock, stale_after_s=stale_after_s)
+            for n in names
+        }
+        for node in self.nodes.values():
+            node.set_peers(names)
+        self._round = 0
+        self._last_round_t: float | None = None
+        # Severed links: frozenset({a, b}) pairs that cannot sync.
+        self._cut: set[frozenset] = set()
+
+    # -- membership / chaos seams ---------------------------------------
+
+    def node(self, name: str) -> DoorGossipNode:
+        return self.nodes[name]
+
+    def names(self) -> tuple[str, ...]:
+        return tuple(sorted(self.nodes))
+
+    def partition(self, groups) -> None:
+        """Sever every link that crosses group boundaries."""
+        lookup = {}
+        for gi, group in enumerate(groups):
+            for name in group:
+                lookup[name] = gi
+        cut = set()
+        names = self.names()
+        for i, a in enumerate(names):
+            for b in names[i + 1:]:
+                if lookup.get(a, -1) != lookup.get(b, -2):
+                    cut.add(frozenset((a, b)))
+        self._cut = cut
+
+    def heal(self) -> None:
+        """Restore all links; force full-state resync so convergence
+        after a partition is bounded by the rotation period."""
+        for pair in self._cut:
+            a, b = sorted(pair)
+            if a in self.nodes:
+                self.nodes[a].forget_peer_history(b)
+            if b in self.nodes:
+                self.nodes[b].forget_peer_history(a)
+        self._cut = set()
+
+    def partitioned(self) -> bool:
+        return bool(self._cut)
+
+    def crash(self, name: str) -> DoorGossipNode:
+        """Replace a shard's node with an empty-state one (process
+        restart).  Its pre-crash counter components live on in peer
+        replicas and flow back on the next full-state syncs — the CRDT
+        reconstruction path the game day asserts."""
+        if name not in self.nodes:
+            raise KeyError(name)
+        fresh = DoorGossipNode(
+            name, self.clock, stale_after_s=self.stale_after_s
+        )
+        self.nodes[name] = fresh
+        fresh.set_peers(self.names())
+        for other in self.nodes.values():
+            if other is not fresh:
+                other.forget_peer_history(name)
+        return fresh
+
+    def link_up(self, a: str, b: str) -> bool:
+        return frozenset((a, b)) not in self._cut
+
+    # -- anti-entropy ----------------------------------------------------
+
+    def _partner(self, idx: int, rnd: int, n: int) -> int:
+        # Deterministic rotation seeded by construction seed: node i's
+        # partner cycles through the other n-1 nodes across rounds.
+        return (idx + 1 + (rnd + self.seed) % (n - 1)) % n
+
+    def step(self, now: float | None = None) -> int:
+        """Run one gossip round; returns total entries merged."""
+        now = float(self.clock()) if now is None else float(now)
+        names = self.names()
+        n = len(names)
+        merged = 0
+        if n >= 2:
+            for i, name in enumerate(names):
+                peer = names[self._partner(i, self._round, n)]
+                merged += self._sync_pair(name, peer, now)
+        self._round += 1
+        self._last_round_t = now
+        m = self.metrics
+        if m is not None:
+            m.gossip_rounds.inc()
+            for name in names:
+                node = self.nodes[name]
+                m.gossip_state_entries.set(
+                    float(len(node.state)), shard=name
+                )
+                m.gossip_degraded.set(
+                    1.0 if node.degraded(now) else 0.0, shard=name
+                )
+                for peer in node.peers:
+                    m.gossip_peer_staleness.set(
+                        max(0.0, now - node.last_heard.get(peer, 0.0)),
+                        shard=name, peer=peer,
+                    )
+        return merged
+
+    def _sync_pair(self, a_name: str, b_name: str, now: float) -> int:
+        m = self.metrics
+        if not self.link_up(a_name, b_name):
+            if m is not None:
+                m.gossip_syncs.inc(
+                    shard=a_name, result="unreachable"
+                )
+            return 0
+        a, b = self.nodes[a_name], self.nodes[b_name]
+        a.flush_usage()
+        b.flush_usage()
+        # Push-pull digest exchange: equal digests -> nothing to ship.
+        if a.state.digest() == b.state.digest():
+            a.last_heard[b_name] = now
+            b.last_heard[a_name] = now
+            a._dirty[b_name] = set()
+            b._dirty[a_name] = set()
+            if m is not None:
+                m.gossip_syncs.inc(shard=a_name, result="skip")
+            return 0
+        out_a = a.outbound(b_name)
+        out_b = b.outbound(a_name)
+        changed = b.absorb(out_a, now, from_peer=a_name)
+        changed += a.absorb(out_b, now, from_peer=b_name)
+        if m is not None:
+            m.gossip_syncs.inc(shard=a_name, result="ok")
+            m.gossip_entries_sent.inc(len(out_a) + len(out_b))
+            if changed:
+                m.gossip_merges.inc(changed)
+        return changed
+
+    def maybe_step(self, now: float) -> bool:
+        """Lazy driver: run a round when the interval has elapsed.
+        Admissions call this, so no background thread is needed and
+        FakeClock sims stay deterministic."""
+        if (self._last_round_t is not None
+                and now - self._last_round_t < self.interval_s):
+            return False
+        self.step(now)
+        return True
+
+    def run_rounds(self, k: int, now: float | None = None) -> None:
+        for _ in range(k):
+            self.step(now)
+
+    # -- convergence -----------------------------------------------------
+
+    def digests(self) -> dict[str, str]:
+        return {n: self.nodes[n].state.digest() for n in self.names()}
+
+    def converged(self) -> bool:
+        return len(set(self.digests().values())) <= 1

@@ -104,12 +104,15 @@ class EndpointHealth:
     ):
         self.policy = policy or BreakerPolicy()
         self.clock = clock
+        # state/_opened_at are CRDT-backed when the door is sharded:
+        # open/close transitions publish into the gossiped LWW breaker
+        # map and peers adopt them (adopt_open / remote_close below).
         self.state = STATE_CLOSED
-        self._window: deque[bool] = deque(maxlen=self.policy.window)
-        self._consecutive = 0
+        self._window: deque[bool] = deque(maxlen=self.policy.window)  # local-state: this shard's own attempt outcomes; peers see only the verdict
+        self._consecutive = 0  # local-state: derived from the local outcome window
         self._opened_at = 0.0
-        self.ejections = 0
-        self.last_error = ""
+        self.ejections = 0  # local-state: per-shard observability tally
+        self.last_error = ""  # local-state: per-shard observability detail
 
     def set_policy(self, policy: BreakerPolicy) -> None:
         if policy == self.policy:
@@ -182,6 +185,37 @@ class EndpointHealth:
         self._consecutive = 0
         self._window.clear()
         self.last_error = ""
+
+    # -- gossip adoption (sharded front door) ----------------------------
+
+    @property
+    def opened_at(self) -> float:
+        """The open stamp — keys the half-open probe-election window in
+        the gossiped state plane."""
+        return self._opened_at
+
+    def adopt_open(self, opened_at: float, error: str = "") -> bool:
+        """Adopt a peer door shard's open verdict: stop sending before
+        this shard pays the failure tax itself. The peer's opened_at is
+        kept so every shard's backoff (and therefore the probe-election
+        window key) lines up. Not counted as a local ejection — this
+        shard observed no failure. Returns True when state changed."""
+        if self.state == STATE_OPEN and self._opened_at >= opened_at:
+            return False
+        self.state = STATE_OPEN
+        self._opened_at = float(opened_at)
+        self._consecutive = 0
+        if error:
+            self.last_error = error
+        return True
+
+    def remote_close(self) -> bool:
+        """Adopt a peer shard's close verdict (its probe succeeded).
+        Returns True when state changed."""
+        if self.state == STATE_CLOSED:
+            return False
+        self._reset()
+        return True
 
     def snapshot(self) -> dict:
         return {

@@ -116,34 +116,47 @@ class Group:
         breaker: BreakerPolicy | None = None,
         clock=time.monotonic,
     ):
-        self._cond = threading.Condition()
-        self._endpoints: dict[str, _Endpoint] = {}
+        self._cond = threading.Condition()  # local-state: process-local lock, not replicated data
+        self._endpoints: dict[str, _Endpoint] = {}  # local-state: rebuilt from the shared KubeStore watch; membership is store-derived
         self._chwbl = make_ring(
             load_factor=load_factor, replication=replication, metrics=metrics
         )
         self.load_factor = load_factor
-        self.total_in_flight = 0
+        self.total_in_flight = 0  # local-state: this shard's own in-flight accounting
         self.model = model
         self.metrics = metrics
         self.breaker_policy = breaker or BreakerPolicy()
         self._clock = clock
         # Cluster KV-sharing: advertised prefix holdings per endpoint
-        # (addr -> set of held chain hashes, hex), pushed by the fleet
-        # aggregator after each collect. Advisory and freshness-gated —
-        # past the TTL the longest-held-prefix pick disables itself and
-        # routing degrades byte-identically to classic CHWBL.
+        # (addr -> set of held chain hashes, hex). CRDT-backed when the
+        # door is sharded: reads come from the gossiped LWW holdings
+        # map (zero aggregator round-trips on the hot path); without
+        # gossip the fleet aggregator pushes this map after each
+        # collect. Advisory and freshness-gated either way — past the
+        # TTL the longest-held-prefix pick disables itself and routing
+        # degrades byte-identically to classic CHWBL.
         self._kv_holdings: dict[str, frozenset[str]] = {}
         self._kv_holdings_ts: float | None = None
-        self.kv_holdings_ttl_s = 15.0
+        self.kv_holdings_ttl_s = 15.0  # local-state: freshness policy constant, not shared state
+        # The door shard's gossip node (routing/gossip.DoorGossipNode)
+        # when sharded: holdings reads, breaker publication/adoption,
+        # and half-open probe election flow through it. None -> classic
+        # single-door behavior, byte-identical.
+        self.gossip = None  # local-state: wiring seam set by the manager/sims, not request state
+        self._gossip_holdings_cache = None  # local-state: per-version cache of the gossiped holdings view
+        # LWW stamps already applied from the gossiped breaker map, so
+        # remote sync is idempotent per publication.
+        self._breaker_stamps: dict[str, tuple] = {}  # local-state: applied-stamp cursor over the CRDT breaker map
+        self._adopting = False  # local-state: reentrancy guard while applying remote breaker verdicts
         # Endpoints removed by reconcile while requests were still in
         # flight: their done() callbacks must keep draining the group
         # totals, and the snapshot must show them until they empty.
-        self._retired: dict[int, _Endpoint] = {}
+        self._retired: dict[int, _Endpoint] = {}  # local-state: in-flight accounting for reconciled-away endpoints
         # Flight recorder + last state it saw per endpoint, so only
         # genuine breaker TRANSITIONS land in the ring (the sync runs
         # on every done(), transitions are rare).
-        self.recorder = None
-        self._breaker_states: dict[str, str] = {}
+        self.recorder = None  # local-state: wiring seam set by the manager, not request state
+        self._breaker_states: dict[str, str] = {}  # local-state: last-seen states for transition detection
 
     def set_breaker_policy(self, policy: BreakerPolicy) -> None:
         with self._cond:
@@ -202,18 +215,42 @@ class Group:
 
     def set_kv_holdings(self, holdings: dict[str, Iterable[str]]) -> None:
         """Replace the advertised prefix-holdings map (fleet-aggregator
-        push after each collect; stale endpoints simply don't appear)."""
+        push after each collect; stale endpoints simply don't appear).
+        When this door is sharded, the map is additionally published
+        into the gossiped state plane so every peer shard routes from
+        the same view without its own aggregator sweep."""
         with self._cond:
             self._kv_holdings = {
                 a: frozenset(h) for a, h in holdings.items() if h
             }
             self._kv_holdings_ts = self._clock()
+            if self.gossip is not None:
+                ts = self._kv_holdings_ts
+                for addr, held in self._kv_holdings.items():
+                    self.gossip.publish_holdings(
+                        self.model, addr, held, ts
+                    )
+
+    def _holdings_view(self) -> tuple[dict[str, frozenset], float | None]:
+        """The (holdings, newest-ts) pair the prefix pick routes from:
+        the gossiped LWW map when sharded (cached per state version —
+        the hot path never rebuilds it unless gossip moved), else the
+        aggregator-pushed local map."""
+        g = self.gossip
+        if g is None:
+            return self._kv_holdings, self._kv_holdings_ts
+        cache = self._gossip_holdings_cache
+        if cache is not None and cache[0] == g.version:
+            return cache[1], cache[2]
+        held, ts = g.holdings(self.model)
+        self._gossip_holdings_cache = (g.version, held, ts)
+        return held, ts
 
     def _holdings_fresh(self) -> bool:
+        _, ts = self._holdings_view()
         return (
-            self._kv_holdings_ts is not None
-            and self._clock() - self._kv_holdings_ts
-            <= self.kv_holdings_ttl_s
+            ts is not None
+            and self._clock() - ts <= self.kv_holdings_ttl_s
         )
 
     def _chain_depth(self, chain: list[str], held: frozenset[str]) -> int:
@@ -237,14 +274,15 @@ class Group:
         with self._cond:
             if not self._holdings_fresh():
                 return None, 0
+            held_map, _ = self._holdings_view()
             best, best_depth = None, 0
-            for addr in sorted(self._kv_holdings):
+            for addr in sorted(held_map):
                 if addr in excluded:
                     continue
                 ep = self._endpoints.get(addr)
                 if ep is None or ep.health.state != STATE_CLOSED:
                     continue
-                depth = self._chain_depth(chain, self._kv_holdings[addr])
+                depth = self._chain_depth(chain, held_map[addr])
                 if depth > best_depth:
                     best, best_depth = addr, depth
             return best, best_depth
@@ -293,7 +331,9 @@ class Group:
                 eps = self._candidates(adapter, role)
                 if eps:
                     avail = [
-                        e for e in eps if e.health.available(e.in_flight)
+                        e for e in eps
+                        if e.health.available(e.in_flight)
+                        and self._may_probe(e)
                     ]
                     if not avail:
                         # Fail fast: blocking would just burn the whole
@@ -389,11 +429,93 @@ class Group:
                 self._sync_breaker_metrics(ep)
                 self._cond.notify_all()
 
+    def _may_probe(self, e: _Endpoint) -> bool:
+        """Half-open probe election across door shards: a non-closed
+        endpoint is only routable (i.e. probe-able) when this shard
+        holds the gossip claim for the half-open window keyed by the
+        open stamp. Unclaimed windows are claimed on the way in, so a
+        solo shard (or a gossip-less build) behaves exactly as before."""
+        if self.gossip is None or e.health.state == STATE_CLOSED:
+            return True
+        return self.gossip.may_probe(
+            self.model, e.address, e.health.opened_at
+        )
+
+    def sync_remote_breakers(self) -> int:
+        """Apply peer door shards' breaker verdicts from the gossiped
+        LWW map: adopt opens (stop sending before this shard pays the
+        failure tax itself) and adopt closes stamped at-or-after our
+        open (the elected prober's probe succeeded). Idempotent per
+        publication — applied stamps are remembered. Returns the number
+        of local state changes."""
+        g = self.gossip
+        if g is None:
+            return 0
+        changed = 0
+        with self._cond:
+            self._adopting = True
+            try:
+                for addr, entry in sorted(
+                    g.breaker_view(self.model).items()
+                ):
+                    ep = self._endpoints.get(addr)
+                    if ep is None:
+                        continue
+                    stamp = entry.get("stamp")
+                    if self._breaker_stamps.get(addr) == stamp:
+                        continue
+                    self._breaker_stamps[addr] = stamp
+                    if entry.get("by") == g.name:
+                        continue  # our own publication, round-tripped
+                    state = entry.get("state")
+                    opened_at = float(entry.get("opened_at", 0.0))
+                    if state == "open" and ep.health.state == STATE_CLOSED:
+                        if ep.health.adopt_open(
+                            opened_at, error=entry.get("error", "")
+                        ):
+                            changed += 1
+                            self.metrics.gossip_breaker_adoptions.inc(
+                                model=self.model
+                            )
+                            self._sync_breaker_metrics(ep)
+                    elif (
+                        state == "closed"
+                        and ep.health.state != STATE_CLOSED
+                        and opened_at >= ep.health.opened_at
+                    ):
+                        if ep.health.remote_close():
+                            changed += 1
+                            self._sync_breaker_metrics(ep)
+            finally:
+                self._adopting = False
+            if changed:
+                self._cond.notify_all()
+        return changed
+
     def _sync_breaker_metrics(self, ep: _Endpoint) -> None:
         self.metrics.lb_circuit_state.set(
             _STATE_VALUE[ep.health.state],
             model=self.model, endpoint=ep.address,
         )
+        prev_state = self._breaker_states.get(ep.address, STATE_CLOSED)
+        if (
+            self.gossip is not None
+            and not self._adopting
+            and ep.health.state != prev_state
+        ):
+            # Publish genuine local transitions into the state plane.
+            # HALF_OPEN is deliberately not published: peers keep the
+            # endpoint open while the elected prober works, and learn
+            # the VERDICT (closed, or a re-open with a fresh stamp).
+            if ep.health.state == STATE_OPEN:
+                self.gossip.publish_breaker(
+                    self.model, ep.address, "open",
+                    ep.health.opened_at, ep.health.last_error,
+                )
+            elif ep.health.state == STATE_CLOSED:
+                self.gossip.publish_breaker(
+                    self.model, ep.address, "closed", ep.health.opened_at
+                )
         if self.recorder is not None:
             prev = self._breaker_states.get(ep.address, STATE_CLOSED)
             if ep.health.state != prev:
@@ -425,6 +547,7 @@ class Group:
             model=self.model, endpoint=addr
         )
         self._breaker_states.pop(addr, None)
+        self._breaker_stamps.pop(addr, None)
 
     def snapshot(self) -> dict:
         """Breaker + in-flight state for the LB state snapshot."""
@@ -490,6 +613,7 @@ class Group:
         holds a single page — the caller falls back to classic CHWBL."""
         if not self._holdings_fresh():
             return None
+        held_map, _ = self._holdings_view()
         loads = {a: e.in_flight for a, e in self._endpoints.items()}
         total = sum(loads.values())
         n = max(len(loads), 1)
@@ -497,7 +621,7 @@ class Group:
 
         best, best_depth = None, 0
         for addr in sorted(allowed):
-            held = self._kv_holdings.get(addr)
+            held = held_map.get(addr)
             if not held:
                 continue
             if total and loads.get(addr, 0) > threshold:
@@ -524,6 +648,7 @@ class LoadBalancer:
         self.metrics = metrics
         self.default_breaker = default_breaker or BreakerPolicy()
         self.recorder = None
+        self.gossip = None
         self._lock = threading.Lock()
         self._groups: dict[str, Group] = {}
         self._self_ips: list[str] = []
@@ -540,6 +665,24 @@ class LoadBalancer:
             self.recorder = recorder
             for group in self._groups.values():
                 group.recorder = recorder
+
+    def set_gossip(self, node) -> None:
+        """Wire this door shard's gossip node
+        (routing/gossip.DoorGossipNode) into every group, existing and
+        future: breaker verdicts publish/adopt through it, half-open
+        probes are elected through it, and prefix-holdings reads come
+        from the gossiped map."""
+        with self._lock:
+            self.gossip = node
+            for group in self._groups.values():
+                group.gossip = node
+
+    def sync_remote_breakers(self) -> int:
+        """Apply peer shards' gossiped breaker verdicts to every group
+        (called after anti-entropy rounds). Returns state changes."""
+        with self._lock:
+            groups = list(self._groups.values())
+        return sum(g.sync_remote_breakers() for g in groups)
 
     def start(self) -> None:
         self.sync_all()
@@ -673,6 +816,7 @@ class LoadBalancer:
                     breaker=self.default_breaker,
                 )
                 group.recorder = self.recorder
+                group.gossip = self.gossip
                 self._groups[model] = group
             return self._groups[model]
 

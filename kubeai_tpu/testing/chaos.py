@@ -56,6 +56,8 @@ EV_CHIP_FLIP = "chip_flip"          # params: delta (spot nodes +/-)
 EV_TELEMETRY_STALE = "telemetry_stale"  # params: duration_s
 EV_LINK_DROP = "link_drop"          # params: model, index, duration_s
 EV_KILL_GROUP_HOST = "kill_group_host"  # params: model, group, host, mode
+EV_DOOR_PARTITION = "door_partition"  # params: duration_s (splits the door shard set into two halves)
+EV_DOOR_CRASH = "door_crash"        # params: shard (index; state reconstructed from peers)
 
 EVENT_KINDS = (
     EV_KILL_POD,
@@ -68,6 +70,8 @@ EVENT_KINDS = (
     EV_TELEMETRY_STALE,
     EV_LINK_DROP,
     EV_KILL_GROUP_HOST,
+    EV_DOOR_PARTITION,
+    EV_DOOR_CRASH,
 )
 
 # ---- shared incident/flight schema -------------------------------------------

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Shared-state drift check for the sharded front door.
+
+Once the door runs as N gossiped shards, any mutable cross-request
+field on a door-path class is a split-brain bug waiting to happen:
+state written on shard A is invisible on shard B unless it rides the
+CRDT plane. This gate makes that property reviewable instead of
+tribal. For every class on the door path (the tenancy governor, the
+endpoint breaker, the balancer group, the usage meter), each
+`self.X = ...` assignment in `__init__` must be one of:
+
+  - **CRDT-backed** — listed in `kubeai_tpu.routing.gossip.
+    CRDT_BACKED_FIELDS`, meaning its mutations flow through the
+    gossiped state plane (G-Counter folds, LWW adoption, ledger
+    merge);
+  - **reviewed local state** — carrying a `# local-state: <why>`
+    pragma on the assignment, documenting why per-shard divergence is
+    correct (locks, caches, exposition maps, wiring seams);
+  - **construction wiring** — initialized from a constructor
+    parameter (config, injected collaborators, clocks), which is
+    fixed at build time rather than mutated across requests.
+
+Drift fails in both directions:
+
+  - a NEW unclassified field fails (someone added shard-divergent
+    state without routing it through gossip or reviewing it);
+  - a REGISTRY entry whose field no longer exists fails (the
+    CRDT-backed list rots);
+  - a field claimed as CRDT-backed that also carries a local-state
+    pragma fails (the two claims contradict each other).
+
+Run directly (exit 1 on drift) or import `check()` — a tier-1 test
+wires it in so the door path can't silently grow shared mutable state.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PRAGMA = "# local-state:"
+
+# Door-path classes whose instances serve every admitted request.
+# class name -> repo-relative module path.
+DOOR_CLASSES: dict[str, str] = {
+    "TenantGovernor": "kubeai_tpu/fleet/tenancy.py",
+    "EndpointHealth": "kubeai_tpu/routing/health.py",
+    "Group": "kubeai_tpu/routing/loadbalancer.py",
+    "UsageMeter": "kubeai_tpu/fleet/metering.py",
+}
+
+
+def _crdt_backed_fields() -> dict[str, tuple[str, ...]]:
+    sys.path.insert(0, REPO_ROOT)
+    from kubeai_tpu.routing.gossip import CRDT_BACKED_FIELDS
+
+    return CRDT_BACKED_FIELDS
+
+
+def _rhs_uses_param(stmt, params: set[str]) -> bool:
+    value = stmt.value
+    if value is None:  # bare annotation, no assignment
+        return False
+    return any(
+        isinstance(n, ast.Name) and n.id in params
+        for n in ast.walk(value)
+    )
+
+
+def scan_class(source: str, class_name: str):
+    """Field records for `class_name.__init__` in `source`:
+    (field, lineno, has_pragma, param_backed). Raises ValueError if the
+    class or its __init__ is missing (the gate must notice removals,
+    not skip them)."""
+    lines = source.splitlines()
+    tree = ast.parse(source)
+    init = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            for item in node.body:
+                if (
+                    isinstance(item, ast.FunctionDef)
+                    and item.name == "__init__"
+                ):
+                    init = item
+    if init is None:
+        raise ValueError(f"class {class_name} with __init__ not found")
+    params = {a.arg for a in init.args.args + init.args.kwonlyargs} - {
+        "self"
+    }
+    records = []
+    for stmt in ast.walk(init):
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, ast.AnnAssign):
+            targets = [stmt.target]
+        else:
+            continue
+        for tgt in targets:
+            if not (
+                isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"
+            ):
+                continue
+            end = stmt.end_lineno or stmt.lineno
+            has_pragma = any(
+                PRAGMA in lines[i - 1]
+                for i in range(stmt.lineno, end + 1)
+            )
+            records.append(
+                (
+                    tgt.attr,
+                    stmt.lineno,
+                    has_pragma,
+                    _rhs_uses_param(stmt, params),
+                )
+            )
+    return records
+
+
+def check(
+    door_classes: dict[str, str] | None = None,
+    registry: dict[str, tuple[str, ...]] | None = None,
+    sources: dict[str, str] | None = None,
+) -> list[str]:
+    """Returns human-readable drift violations (empty = every door-path
+    field is classified). `sources` maps class name -> source text for
+    tests; unlisted classes are read from disk."""
+    door_classes = DOOR_CLASSES if door_classes is None else door_classes
+    registry = _crdt_backed_fields() if registry is None else registry
+    errors: list[str] = []
+    for cls, rel_path in sorted(door_classes.items()):
+        if sources is not None and cls in sources:
+            source = sources[cls]
+        else:
+            with open(os.path.join(REPO_ROOT, rel_path)) as f:
+                source = f.read()
+        try:
+            records = scan_class(source, cls)
+        except (ValueError, SyntaxError) as exc:
+            errors.append(f"{rel_path}: {exc}")
+            continue
+        backed = set(registry.get(cls, ()))
+        seen: set[str] = set()
+        for field, lineno, has_pragma, param_backed in records:
+            seen.add(field)
+            if field in backed:
+                if has_pragma:
+                    errors.append(
+                        f"{rel_path}:{lineno}: {cls}.{field} is listed "
+                        "in CRDT_BACKED_FIELDS but carries a "
+                        "local-state pragma — the claims contradict"
+                    )
+                continue
+            if has_pragma or param_backed:
+                continue
+            errors.append(
+                f"{rel_path}:{lineno}: {cls}.{field} is mutable "
+                "cross-request state on the door path: route it "
+                "through the gossip plane (add it to "
+                "CRDT_BACKED_FIELDS) or review it with a "
+                f"`{PRAGMA} <why>` pragma"
+            )
+        for field in sorted(backed - seen):
+            errors.append(
+                f"{rel_path}: CRDT_BACKED_FIELDS claims {cls}.{field} "
+                "but __init__ no longer assigns it — the registry rots"
+            )
+    for cls in sorted(set(registry) - set(door_classes)):
+        errors.append(
+            f"CRDT_BACKED_FIELDS lists unknown class {cls}: add it to "
+            "DOOR_CLASSES in scripts/check_shared_state.py or drop it"
+        )
+    return errors
+
+
+def main() -> int:
+    errors = check()
+    if errors:
+        print("door-path shared-state drift detected:")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    n = sum(len(scan_class(open(os.path.join(REPO_ROOT, p)).read(), c))
+            for c, p in DOOR_CLASSES.items())
+    print(f"door-path shared state classified ({n} fields checked)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

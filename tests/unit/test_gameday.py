@@ -20,6 +20,7 @@ from benchmarks.gameday_sim import (
     DEFAULT_TICKS,
     FAILING_STREAM_TOKENS,
     check_chaos_concurrency,
+    check_door_chaos_was_real,
     check_failing_trace_fails,
     check_flood_was_real,
     check_no_violations,
@@ -83,6 +84,13 @@ def test_flood_was_real(sim):
 
 def test_failing_trace_fails_deterministically(sim):
     check_failing_trace_fails(sim)
+
+
+def test_door_chaos_was_real(sim):
+    """The gossip plane was split mid-flood, a door shard crashed and
+    was rebuilt from peers, and the flooder never exceeded one global
+    budget + epsilon (the door_budget continuous invariant)."""
+    check_door_chaos_was_real(sim)
 
 
 def test_all_checks_is_complete(sim):

@@ -1,0 +1,580 @@
+"""Gossiped CRDT state plane: algebra laws, HLC ordering, anti-entropy
+convergence, probe election, and ledger-merge idempotency.
+
+The sharded front door's correctness rests on a handful of algebraic
+facts — merge is commutative, associative, idempotent; HLC stamps
+totally order writes under skew; anti-entropy converges after any
+partition/heal/crash sequence; exactly one shard probes a half-open
+breaker. These tests pin each fact with seeded randomized inputs and
+byte-level comparison (to_wire / digest), so a refactor that keeps the
+API but breaks the algebra fails loudly.
+"""
+
+import itertools
+import json
+import random
+
+import pytest
+
+from kubeai_tpu.fleet.metering import UsageMeter
+from kubeai_tpu.routing.gossip import (
+    HLC,
+    NS_BREAKER,
+    NS_REQ,
+    NS_TOK,
+    DoorGossipNode,
+    DoorShardSet,
+    DoorShardState,
+    FWWRegister,
+    GCounter,
+    LWWRegister,
+    PNCounter,
+    entry_from_wire,
+)
+from kubeai_tpu.routing.health import (
+    OUTCOME_5XX,
+    OUTCOME_SUCCESS,
+    STATE_CLOSED,
+    STATE_OPEN,
+    BreakerPolicy,
+    EndpointHealth,
+)
+
+
+class ManualClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def _wire(x) -> str:
+    return json.dumps(x.to_wire(), sort_keys=True, separators=(",", ":"))
+
+
+# ---------------------------------------------------------------------------
+# CRDT algebra: seeded randomized merge laws, byte-compared
+
+
+_NODES = ("door-0", "door-1", "door-2", "door-3")
+
+
+def _random_gcounter(rng):
+    c = GCounter()
+    for _ in range(rng.randrange(1, 8)):
+        c.add(rng.choice(_NODES), rng.randrange(0, 50))
+    return c
+
+
+def _random_pncounter(rng):
+    c = PNCounter()
+    for _ in range(rng.randrange(1, 8)):
+        c.add(rng.choice(_NODES), rng.randrange(-30, 30))
+    return c
+
+
+def _random_stamp(rng):
+    return (rng.randrange(0, 5) * 1.0, rng.randrange(0, 3), rng.choice(_NODES))
+
+
+def _random_lww(rng):
+    # The value is a pure function of the stamp: production stamps are
+    # unique per write (HLC + node in the stamp), so two replicas can
+    # only share a stamp when they observed the SAME write.
+    r = LWWRegister()
+    for _ in range(rng.randrange(1, 5)):
+        stamp = _random_stamp(rng)
+        r.set(f"v@{stamp}", stamp)
+    return r
+
+
+def _random_fww(rng):
+    r = FWWRegister()
+    for _ in range(rng.randrange(1, 5)):
+        stamp = _random_stamp(rng)
+        r.set(stamp[2], stamp)  # the claiming node rides its own stamp
+    return r
+
+
+_FACTORIES = {
+    "g": _random_gcounter,
+    "pn": _random_pncounter,
+    "lww": _random_lww,
+    "fww": _random_fww,
+}
+
+
+def _copy(entry):
+    return entry_from_wire(entry.to_wire())
+
+
+@pytest.mark.parametrize("kind", sorted(_FACTORIES))
+@pytest.mark.parametrize("seed", range(10))
+def test_merge_laws_byte_identical(kind, seed):
+    """Commutativity, associativity, idempotence — every merge order of
+    three random replicas produces the same bytes, and re-merging is a
+    no-op (state-based CRDT laws the anti-entropy loop relies on)."""
+    kind_seed = {"g": 1, "pn": 2, "lww": 3, "fww": 4}[kind]
+    rng = random.Random(kind_seed * 1000 + seed)
+    make = _FACTORIES[kind]
+    replicas = [make(rng) for _ in range(3)]
+
+    results = []
+    for order in itertools.permutations(range(3)):
+        acc = _copy(replicas[order[0]])
+        acc.merge(_copy(replicas[order[1]]))
+        acc.merge(_copy(replicas[order[2]]))
+        results.append(_wire(acc))
+    assert len(set(results)) == 1, f"merge order changed bytes: {results}"
+
+    # Associativity: (a ∪ b) ∪ c == a ∪ (b ∪ c).
+    left = _copy(replicas[0])
+    left.merge(_copy(replicas[1]))
+    left.merge(_copy(replicas[2]))
+    bc = _copy(replicas[1])
+    bc.merge(_copy(replicas[2]))
+    right = _copy(replicas[0])
+    right.merge(bc)
+    assert _wire(left) == _wire(right)
+
+    # Idempotence: folding the merged result (or any input) back in
+    # changes nothing — re-delivered gossip deltas are harmless.
+    again = _copy(left)
+    assert not again.merge(_copy(left))
+    for r in replicas:
+        again.merge(_copy(r))
+    assert _wire(again) == _wire(left)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_shard_state_merge_order_and_replay(seed):
+    """Whole-state law: merging three divergent DoorShardStates in any
+    order — and replaying any delta suffix any number of times —
+    converges to one digest."""
+    rng = random.Random(7000 + seed)
+
+    def random_state():
+        s = DoorShardState()
+        for _ in range(rng.randrange(3, 10)):
+            kind = rng.choice(("g", "pn", "lww", "fww"))
+            # pn/fww use unregistered namespaces: the registered ones
+            # (_CTOR) type-check the wire, and NS_TOK is a G-Counter.
+            ns = {"g": NS_REQ, "pn": "xpn",
+                  "lww": NS_BREAKER, "fww": "xfw"}[kind]
+            key = f"t{rng.randrange(3)}|m{rng.randrange(2)}"
+            s.merge_entry(f"{ns}!{key}-{kind}",
+                          _FACTORIES[kind](rng).to_wire())
+        return s
+
+    def clone(state):
+        c = DoorShardState()
+        for k, w in state.to_wire().items():
+            c.merge_entry(k, w)
+        return c
+
+    states = [random_state() for _ in range(3)]
+    digests = []
+    for order in itertools.permutations(range(3)):
+        acc = clone(states[order[0]])
+        acc.merge(states[order[1]])
+        acc.merge(states[order[2]])
+        digests.append(acc.digest())
+    assert len(set(digests)) == 1
+
+    # Delta-suffix replay: re-deliver random subsets of the merged
+    # wire, in random order, repeatedly — digest never moves.
+    acc = clone(states[0])
+    acc.merge(states[1])
+    acc.merge(states[2])
+    final = acc.digest()
+    wire = acc.to_wire()
+    keys = sorted(wire)
+    for _ in range(10):
+        subset = rng.sample(keys, rng.randrange(1, len(keys) + 1))
+        rng.shuffle(subset)
+        for k in subset:
+            acc.merge_entry(k, wire[k])
+        assert acc.digest() == final
+
+
+def test_gcounter_component_monotone():
+    c = GCounter()
+    c.add("a", 3.0)
+    c.set_component("a", 10.0)
+    with pytest.raises(ValueError):
+        c.set_component("a", 5.0)
+    with pytest.raises(ValueError):
+        c.add("a", -1.0)
+    assert c.value() == 10.0
+    assert c.of("a") == 10.0 and c.except_of("a") == 0.0
+
+
+def test_lww_total_order_has_no_ties():
+    """Same (physical, logical) from two nodes: the node name breaks
+    the tie identically on every replica."""
+    a, b = LWWRegister(), LWWRegister()
+    a.set("from-x", (5.0, 0, "x"))
+    a.set("from-y", (5.0, 0, "y"))
+    b.set("from-y", (5.0, 0, "y"))
+    b.set("from-x", (5.0, 0, "x"))
+    assert a.value == b.value == "from-y"
+    assert a.stamp == b.stamp
+
+
+def test_fww_first_claim_wins_everywhere():
+    a, b = FWWRegister(), FWWRegister()
+    a.set("late", (6.0, 0, "z"))
+    a.set("early", (5.0, 0, "a"))
+    b.set("early", (5.0, 0, "a"))
+    b.set("late", (6.0, 0, "z"))
+    assert a.value == b.value == "early"
+
+
+# ---------------------------------------------------------------------------
+# HLC: monotone under skew
+
+
+def test_hlc_monotone_under_backwards_clock():
+    clock = ManualClock(100.0)
+    hlc = HLC("door-0", clock)
+    stamps = [hlc.tick()]
+    for dt in (5.0, -50.0, 0.0, -1.0, 2.0, -100.0):
+        clock.advance(dt)
+        stamps.append(hlc.tick())
+    assert stamps == sorted(stamps)
+    assert len(set(stamps)) == len(stamps), "stamps must be unique"
+
+
+def test_hlc_observe_orders_after_remote():
+    """After folding a remote stamp from a fast clock, local stamps
+    sort after it even though the local clock lags far behind."""
+    clock = ManualClock(10.0)
+    hlc = HLC("door-0", clock)
+    remote = (500.0, 7, "door-1")
+    hlc.observe(remote)
+    assert hlc.tick() > remote
+    # And observing an OLD stamp must not regress the local clock.
+    newest = hlc.tick()
+    hlc.observe((1.0, 0, "door-2"))
+    assert hlc.tick() > newest
+
+
+# ---------------------------------------------------------------------------
+# Anti-entropy: partition / heal / crash convergence
+
+
+def _shard_set(n=3, clock=None, **kw):
+    clock = clock or ManualClock()
+    names = [f"door-{i}" for i in range(n)]
+    return DoorShardSet(names, clock, **kw), clock
+
+
+def test_partition_heal_converges_byte_identically():
+    ss, clock = _shard_set(4)
+    names = ss.names()
+    # Divergent writes on both sides of a 2|2 split.
+    ss.partition([names[:2], names[2:]])
+    for i, name in enumerate(names):
+        node = ss.node(name)
+        node.consume(NS_REQ, "tenant-a", "m", 1.0 + i)
+        node.set_overload(i % 2 == 0)
+        node.publish_breaker("m", f"10.0.0.{i}:8000", "open",
+                             float(clock()), "boom")
+    for _ in range(4):
+        clock.advance(1.0)
+        ss.step()
+    # Sides converge internally but not across the cut.
+    assert not ss.converged()
+    ss.heal()
+    for _ in range(len(names)):
+        clock.advance(1.0)
+        ss.step()
+    assert ss.converged()
+    assert len(set(ss.digests().values())) == 1
+    # Every shard agrees on the merged counter value, byte for byte.
+    wires = {
+        name: _wire(ss.node(name).state.get(NS_REQ, "tenant-a|m"))
+        for name in names
+    }
+    assert len(set(wires.values())) == 1
+    total = ss.node(names[0]).state.get(NS_REQ, "tenant-a|m").value()
+    assert total == sum(1.0 + i for i in range(len(names)))
+
+
+def test_crashed_shard_reconstructed_from_peers():
+    ss, clock = _shard_set(3)
+    victim = "door-1"
+    ss.node(victim).consume(NS_REQ, "t", "m", 9.0)
+    for _ in range(3):
+        clock.advance(1.0)
+        ss.step()
+    assert ss.converged()
+    pre = ss.node(victim).state.get(NS_REQ, "t|m").of(victim)
+    assert pre == 9.0
+
+    fresh = ss.crash(victim)
+    assert len(fresh.state) == 0
+    for _ in range(3):
+        clock.advance(1.0)
+        ss.step()
+    assert ss.converged()
+    # The victim's own component came back from peer replicas.
+    assert ss.node(victim).state.get(NS_REQ, "t|m").of(victim) == 9.0
+
+
+def test_degraded_split_is_conservative():
+    ss, clock = _shard_set(3, stale_after_s=2.0)
+    for _ in range(3):
+        clock.advance(0.5)
+        ss.step()
+    node = ss.node("door-0")
+    now = clock()
+    assert not node.degraded(now)
+    assert node.split(now) == 1.0
+    # Isolate door-0: both peers go stale -> it enforces 1/3 of the
+    # budget at 3x the charge (N / reachable = 3 / 1).
+    ss.partition([["door-0"], ["door-1", "door-2"]])
+    clock.advance(5.0)
+    ss.step()
+    now = clock()
+    assert node.degraded(now)
+    assert node.split(now) == 3.0
+    # The majority side only lost one peer: 3 / 2.
+    assert ss.node("door-1").split(now) == 1.5
+    ss.heal()
+    for _ in range(3):
+        clock.advance(1.0)
+        ss.step()
+    assert ss.node("door-0").split(clock()) == 1.0
+
+
+def test_single_shard_set_is_trivially_converged():
+    ss, clock = _shard_set(1)
+    ss.node("door-0").consume(NS_REQ, "t", "m", 5.0)
+    clock.advance(1.0)
+    assert ss.step() == 0
+    assert ss.converged()
+
+
+# ---------------------------------------------------------------------------
+# Probe election: exactly one probe per half-open window, fleet-wide
+
+
+def _trip(health, n=3):
+    for _ in range(n):
+        health.record(OUTCOME_5XX, "boom")
+
+
+def test_exactly_one_probe_per_half_open_window():
+    """Fleet of 3 door shards, one endpoint trips on shard 0: after
+    gossip, every shard agrees shard 0 owns the half-open window — so
+    exactly one probe lands fleet-wide per window."""
+    ss, clock = _shard_set(3)
+    policy = BreakerPolicy(consecutive_failures=3, open_seconds=5.0)
+    healths = {
+        n: EndpointHealth(policy=policy, clock=clock) for n in ss.names()
+    }
+    addr, model = "10.0.0.1:8000", "m"
+
+    tripper = "door-0"
+    _trip(healths[tripper])
+    assert healths[tripper].state == STATE_OPEN
+    opened = healths[tripper].opened_at
+    ss.node(tripper).publish_breaker(
+        model, addr, "open", opened, "boom"
+    )
+    for _ in range(3):
+        clock.advance(1.0)
+        ss.step()
+    # Peers adopt the open verdict with the SAME stamp, so the probe
+    # window key lines up on every shard.
+    for name in ss.names():
+        if name == tripper:
+            continue
+        entry = ss.node(name).breaker_view(model)[addr]
+        assert entry["state"] == "open"
+        assert healths[name].adopt_open(entry["opened_at"], entry["error"])
+        assert healths[name].opened_at == opened
+
+    clock.advance(policy.open_seconds + 0.1)
+    claims = [
+        name for name in ss.names()
+        if healths[name].available(in_flight=0)
+        and ss.node(name).may_probe(model, addr, healths[name].opened_at)
+    ]
+    assert claims == [tripper], (
+        f"probe election leaked: {claims} may all probe"
+    )
+
+    # The probe succeeds: the prober closes and publishes; peers adopt.
+    healths[tripper].on_pick()
+    healths[tripper].record(OUTCOME_SUCCESS)
+    assert healths[tripper].state == STATE_CLOSED
+    ss.node(tripper).publish_breaker(model, addr, "closed", opened)
+    for _ in range(3):
+        clock.advance(1.0)
+        ss.step()
+    for name in ss.names():
+        if name == tripper:
+            continue
+        entry = ss.node(name).breaker_view(model)[addr]
+        assert entry["state"] == "closed"
+        assert healths[name].remote_close()
+        assert healths[name].state == STATE_CLOSED
+
+
+def test_unclaimed_window_race_converges_to_one_winner():
+    """No eager claim (e.g. the tripper crashed before gossiping): each
+    shard claims on the way in. Locally several may think they won, but
+    the FWW merge picks ONE deterministic winner everywhere, and only
+    that shard may probe afterwards."""
+    ss, clock = _shard_set(3)
+    model, addr, opened = "m", "10.0.0.2:8000", 42.0
+    # Race: every shard claims before any gossip flows.
+    local_wins = [
+        n for n in ss.names()
+        if ss.node(n).claim_probe(model, addr, opened)
+    ]
+    assert len(local_wins) >= 1  # optimistic local claims
+    for _ in range(3):
+        clock.advance(1.0)
+        ss.step()
+    assert ss.converged()
+    winners = {
+        n: ss.node(n).probe_winner(model, addr, opened)
+        for n in ss.names()
+    }
+    assert len(set(winners.values())) == 1, winners
+    winner = next(iter(winners.values()))
+    may = [n for n in ss.names()
+           if ss.node(n).may_probe(model, addr, opened)]
+    assert may == [winner]
+
+
+def test_new_window_gets_fresh_election():
+    """A re-open (fresh opened_at) keys a NEW window: the old claim
+    does not carry over."""
+    ss, clock = _shard_set(2)
+    model, addr = "m", "10.0.0.3:8000"
+    assert ss.node("door-0").claim_probe(model, addr, 10.0)
+    for _ in range(2):
+        clock.advance(1.0)
+        ss.step()
+    assert ss.node("door-1").probe_winner(model, addr, 10.0) == "door-0"
+    # Window keyed by a later open stamp: door-1 can win this one.
+    assert ss.node("door-1").claim_probe(model, addr, 20.0)
+    for _ in range(2):
+        clock.advance(1.0)
+        ss.step()
+    assert ss.node("door-0").probe_winner(model, addr, 20.0) == "door-1"
+    assert ss.node("door-0").probe_winner(model, addr, 10.0) == "door-0"
+
+
+# ---------------------------------------------------------------------------
+# UsageMeter: gossip merge idempotency (billing_exact under sharding)
+
+
+def _meter_with_usage():
+    m = UsageMeter()
+    m.record("acme", "llama", prompt_tokens=100, completion_tokens=50)
+    m.record("acme", "llama", prompt_tokens=10, completion_tokens=5)
+    m.record("globex", "phi", prompt_tokens=7, completion_tokens=3,
+             stream_seconds=1.25)
+    return m
+
+
+def test_ledger_delta_suffix_replay_leaves_totals_unchanged():
+    """/v1/usage is exact under gossip re-delivery: merging any delta
+    suffix of a peer's cumulative snapshot — stale, duplicated,
+    reordered — never changes the summed totals (byte-compared)."""
+    a = _meter_with_usage()
+    b = UsageMeter()
+    b.record("initech", "llama", prompt_tokens=20, completion_tokens=9)
+
+    snap = a.shard_snapshot()
+    b.merge_shard_snapshot("door-0", snap)
+    baseline = json.dumps(b.summary(), sort_keys=True)
+    totals = b.totals()
+    assert totals["requests"] == 4
+    assert totals["prompt_tokens"] == 100 + 10 + 7 + 20
+
+    rng = random.Random(11)
+    keys = sorted(snap)
+    for _ in range(8):
+        subset = rng.sample(keys, rng.randrange(1, len(keys) + 1))
+        rng.shuffle(subset)
+        b.merge_shard_snapshot("door-0", {k: snap[k] for k in subset})
+        assert json.dumps(b.summary(), sort_keys=True) == baseline
+    # A STALE full snapshot (earlier cumulative values) is a no-op too.
+    stale = {k: v * 0.5 if isinstance(v, float) else v // 2
+             for k, v in snap.items()}
+    b.merge_shard_snapshot("door-0", stale)
+    assert json.dumps(b.summary(), sort_keys=True) == baseline
+
+
+def test_ledger_merge_through_gossip_node_round_trip():
+    """End-to-end: meter A publishes through its gossip node, the state
+    plane syncs, meter B absorbs — B's totals include A's usage
+    exactly, and repeating the whole cycle is idempotent."""
+    ss, clock = _shard_set(2)
+    a_meter = _meter_with_usage()
+    b_meter = UsageMeter()
+    ss.node("door-0").usage_source = a_meter.shard_snapshot
+    ss.node("door-1").usage_source = b_meter.shard_snapshot
+    for _ in range(3):
+        clock.advance(1.0)
+        ss.step()
+    b_meter.absorb_gossip(ss.node("door-1"))
+    assert b_meter.tenant_model_tokens("acme", "llama") == 165
+    before = json.dumps(b_meter.summary(), sort_keys=True)
+    for _ in range(2):
+        clock.advance(1.0)
+        ss.step()
+        b_meter.absorb_gossip(ss.node("door-1"))
+    assert json.dumps(b_meter.summary(), sort_keys=True) == before
+
+
+# ---------------------------------------------------------------------------
+# Prefix holdings via gossip
+
+
+def test_holdings_publish_merge_and_newest_ts():
+    ss, clock = _shard_set(2)
+    a, b = ss.node("door-0"), ss.node("door-1")
+    a.publish_holdings("m", "10.0.0.1:8000", ["c1", "c2"], ts=100.0)
+    clock.advance(1.0)
+    b.publish_holdings("m", "10.0.0.2:8000", ["c3"], ts=101.0)
+    for _ in range(2):
+        clock.advance(1.0)
+        ss.step()
+    for node in (a, b):
+        held, newest = node.holdings("m")
+        assert held == {
+            "10.0.0.1:8000": frozenset({"c1", "c2"}),
+            "10.0.0.2:8000": frozenset({"c3"}),
+        }
+        assert newest == 101.0
+    # Cold model: no entries -> (empty, None), the signal Group uses to
+    # fall back to classic CHWBL byte-identically.
+    held, newest = a.holdings("other-model")
+    assert held == {} and newest is None
+
+
+def test_version_bumps_on_local_touch_and_absorb():
+    """Group's holdings cache keys off node.version — it must move on
+    both local touches and absorbed remote changes."""
+    ss, clock = _shard_set(2)
+    a, b = ss.node("door-0"), ss.node("door-1")
+    v0 = b.version
+    a.publish_holdings("m", "addr", ["c1"], ts=1.0)
+    clock.advance(1.0)
+    ss.step()
+    assert b.version > v0
+    v1 = b.version
+    b.consume(NS_REQ, "t", "m", 1.0)
+    assert b.version > v1

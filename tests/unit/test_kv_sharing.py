@@ -146,6 +146,77 @@ def test_stale_holdings_degrade_to_classic_chwbl_byte_identically():
     assert m_with.lb_prefix_route_misses.get(model="m") == 8
 
 
+def test_cold_gossip_holdings_degrade_to_classic_chwbl_byte_identically():
+    """Sharded-door regression pin: a Group reading holdings from a COLD
+    gossip plane (nothing published yet) and one past the freshness TTL
+    must both route exactly like a classic gossip-less CHWBL group —
+    same ring, same pick sequence, byte for byte."""
+    from kubeai_tpu.routing.gossip import DoorShardSet
+
+    now = [1000.0]
+    clock = lambda: now[0]
+    eps = {"a:1": set(), "b:1": set(), "c:1": set()}
+    chain = _chain(4)
+
+    ss = DoorShardSet(["door-0", "door-1"], clock)
+    g_cold = Group(model="m", metrics=Metrics(), clock=clock)
+    g_cold.gossip = ss.node("door-0")
+    g_cold.reconcile_endpoints(dict(eps))
+
+    g_stale = Group(model="m", metrics=Metrics(), clock=clock)
+    g_stale.gossip = ss.node("door-1")
+    g_stale.reconcile_endpoints(dict(eps))
+    g_stale.set_kv_holdings({"a:1": chain})  # publishes into gossip
+
+    g_ref = Group(model="m", metrics=Metrics(), clock=clock)
+    g_ref.reconcile_endpoints(dict(eps))
+
+    now[0] += g_stale.kv_holdings_ttl_s + 1.0  # published map goes stale
+
+    picks = {"cold": [], "stale": [], "ref": []}
+    for i in range(8):
+        prefix = f"tenant-{i % 3}"
+        for name, g in (("cold", g_cold), ("stale", g_stale),
+                        ("ref", g_ref)):
+            kw = {"chain": chain} if name != "ref" else {}
+            a, _ = g.get_best_addr("PrefixHash", "", prefix, timeout=1, **kw)
+            picks[name].append(a)
+    assert picks["cold"] == picks["ref"]
+    assert picks["stale"] == picks["ref"]
+
+
+def test_gossiped_holdings_route_without_aggregator():
+    """One shard's aggregator push is enough: a peer shard that never
+    saw set_kv_holdings routes by the gossiped map (zero aggregator
+    round-trips on its hot path)."""
+    from kubeai_tpu.routing.gossip import DoorShardSet
+
+    now = [1000.0]
+    clock = lambda: now[0]
+    chain = _chain(4)
+
+    ss = DoorShardSet(["door-0", "door-1"], clock)
+    g_pub = Group(model="m", metrics=Metrics(), clock=clock)
+    g_pub.gossip = ss.node("door-0")
+    g_pub.reconcile_endpoints({"a:1": set(), "b:1": set()})
+    g_pub.set_kv_holdings({"b:1": chain})
+
+    metrics = Metrics()
+    g_peer = Group(model="m", metrics=metrics, clock=clock)
+    g_peer.gossip = ss.node("door-1")
+    g_peer.reconcile_endpoints({"a:1": set(), "b:1": set()})
+
+    for _ in range(2):
+        now[0] += 1.0
+        ss.step()
+    addr, done = g_peer.get_best_addr(
+        "LeastLoad", "", "", timeout=1, chain=chain
+    )
+    assert addr == "b:1"
+    done()
+    assert metrics.lb_prefix_route_hits.get(model="m") == 1
+
+
 def test_kv_holder_never_suggests_open_circuit_peer():
     from kubeai_tpu.routing.health import BreakerPolicy
 

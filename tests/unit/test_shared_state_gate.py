@@ -1,0 +1,119 @@
+"""The door path cannot silently grow shared mutable state: every
+`self.X = ...` in the door-path classes' __init__ must be CRDT-backed
+(listed in gossip.CRDT_BACKED_FIELDS), reviewed (`# local-state:`
+pragma), or constructor wiring. Tier-1 wiring for
+scripts/check_shared_state."""
+
+import importlib.util
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+
+
+def _load_checker():
+    path = os.path.join(REPO_ROOT, "scripts", "check_shared_state.py")
+    spec = importlib.util.spec_from_file_location(
+        "check_shared_state", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_door_path_fields_all_classified():
+    checker = _load_checker()
+    errors = checker.check()
+    assert errors == [], "shared-state drift:\n" + "\n".join(errors)
+
+
+def test_registry_covers_real_classes():
+    """CRDT_BACKED_FIELDS and DOOR_CLASSES must name the same classes —
+    a registry entry for a class the gate never scans is dead weight."""
+    checker = _load_checker()
+    from kubeai_tpu.routing.gossip import CRDT_BACKED_FIELDS
+
+    assert set(CRDT_BACKED_FIELDS) == set(checker.DOOR_CLASSES)
+
+
+_DOCTORED = '''
+class TenantGovernor:
+    def __init__(self, cfg, clock=None):
+        self.cfg = cfg
+        self._clock = clock
+        self._buckets = {}
+        self._overload = False
+        self._rogue_cache = {}
+'''
+
+_PRAGMA_REMOVED = '''
+class TenantGovernor:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self._buckets = {}
+        self._overload = False
+        self._tally = {}
+'''
+
+_FIELD_GONE = '''
+class TenantGovernor:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self._buckets = {}
+'''
+
+_CONTRADICTION = '''
+class TenantGovernor:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self._buckets = {}  # local-state: but also claimed CRDT-backed
+        self._overload = False
+'''
+
+
+def _check_doctored(checker, source):
+    return checker.check(
+        door_classes={"TenantGovernor": "kubeai_tpu/fleet/tenancy.py"},
+        registry={"TenantGovernor": ("_buckets", "_overload")},
+        sources={"TenantGovernor": source},
+    )
+
+
+def test_gate_detects_drift_both_ways():
+    """The gate itself must catch every rot direction: an unclassified
+    new field, a pragma removal, a stale registry entry, and a
+    field claimed both CRDT-backed and local."""
+    checker = _load_checker()
+
+    errors = "\n".join(_check_doctored(checker, _DOCTORED))
+    assert "_rogue_cache" in errors
+    assert "_buckets" not in errors  # registered fields stay clean
+
+    errors = "\n".join(_check_doctored(checker, _PRAGMA_REMOVED))
+    assert "_tally" in errors
+
+    errors = "\n".join(_check_doctored(checker, _FIELD_GONE))
+    assert "_overload" in errors and "registry rots" in errors
+
+    errors = "\n".join(_check_doctored(checker, _CONTRADICTION))
+    assert "_buckets" in errors and "contradict" in errors
+
+
+def test_gate_detects_missing_class():
+    checker = _load_checker()
+    errors = "\n".join(
+        checker.check(
+            door_classes={
+                "TenantGovernor": "kubeai_tpu/fleet/tenancy.py"
+            },
+            registry={
+                "TenantGovernor": ("_buckets", "_overload"),
+                "GhostClass": ("_x",),
+            },
+            sources={"TenantGovernor": _DOCTORED.replace(
+                "self._rogue_cache = {}", ""
+            )},
+        )
+    )
+    assert "GhostClass" in errors

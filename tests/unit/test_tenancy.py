@@ -63,12 +63,35 @@ def test_abuse_isolation_sim_invariants():
     """Tier-1 contract: the abuser's excess is refused at the door with
     honest Retry-After hints, compliant tenants' p99 stays within
     epsilon of the no-abuser baseline, realtime sheds last, and the
-    disabled door is a byte-identical no-op."""
+    disabled door is a byte-identical no-op. Sharded-door contract:
+    the flooder is held to ONE global budget within epsilon under any
+    split (round-robin / all-on-one / alternating / partition /
+    crash), compliant p99 is unmoved vs single-door, partition-then-
+    heal converges to byte-identical CRDT digests, a crashed shard is
+    reconstructed from peers, and doorShards:1 is sample-for-sample
+    the classic governor."""
     from benchmarks.tenant_isolation_sim import ALL_CHECKS, run_sim
 
     result = run_sim()
     for check in ALL_CHECKS:
         check(result)
+
+
+@pytest.mark.slow
+def test_million_user_sharded_door():
+    """The gossip plane holds at scale: one MILLION compliant tenants
+    plus the flooder through 3 door shards — one global budget, zero
+    compliant refusals, byte-identical convergence."""
+    from benchmarks import tenant_isolation_sim as tis
+
+    tis._pin_jitter()
+    run = tis._run_sharded_trace(users=1_000_000)
+    allowance = 4.0 + 2.0 * tis.RUN_S
+    eps = tis.sharded_budget_epsilon(run["shards"])
+    assert run["door"]["abuser_admitted"] <= allowance + eps
+    assert run["door"]["compliant_refused"] == 0
+    assert run["converged"]
+    assert len(set(run["digests"].values())) == 1
 
 
 # ---- retryafter: one helper for every shed path ------------------------------
