@@ -1,0 +1,1179 @@
+"""Federation sim — two fake clusters, one fake clock, the real stack.
+
+Two clusters ("west" hosts giant+hot, "east" hosts hot+m-east) each run
+the REAL components — `FleetStateAggregator`, `CapacityPlanner`,
+`ActuationGovernor`, a gossiped `TenantGovernor` door — and the
+federation plane on top: `FederationAggregator` joining the peer's
+snapshot (staleness flagged, never merged), `FederationRouter`
+spilling admitted requests to the peer door on local chip exhaustion
+(cost-ranked: queue wait vs RTT + MEASURED boot cost), and
+`FederationPlanner` failing whole models over through the governor
+when a cluster partitions. Cross-cluster links are closures over the
+peer's in-process objects; cutting them IS the partition.
+
+Invariants:
+
+  CONTINUOUS (checked every tick)
+    * spillover fires ONLY on exhaustion (`throttled_replicas > 0`)
+      and only when the peer is genuinely cheaper — and the 240 s-boot
+      "giant" model never spills to a cluster that would cold-boot it;
+    * the flooding tenant's admissions ACROSS BOTH cluster doors stay
+      within ONE token-bucket budget (+ the gossip epsilon) — quota
+      cannot be laundered by hopping clusters;
+    * compliant tenants are never refused at either door;
+    * each cluster's billing ledger exactly equals its delivered work,
+      spilled requests billed where they were served;
+    * a partitioned peer is FLAGGED stale, never merged: its last-good
+      snapshot stays visible, its models never leak into the local
+      snapshot;
+    * a spilled request is never re-spilled (no ping-pong);
+    * the partitioned cluster itself never actuates a takeover.
+
+  TERMINAL (checked once, after the last event)
+    * the partitioned cluster's models fail over within the bounded
+      window (staleness + failover window + slack), only models the
+      survivor also deploys, and fail BACK within the slack of heal;
+    * the cross-cluster KV fill script hit exactly its expected
+      fill/refusal/recompute counts (dtype mismatch refuses, a
+      truncated blob refuses — never casts);
+    * every queue drains (spillover helped, not hurt).
+
+Every run writes a JSONL `GameDayLog`; dump -> replay is
+byte-identical:
+
+    python benchmarks/federation_sim.py --dump /tmp/f.jsonl
+    python -m benchmarks.federation_sim --replay /tmp/f.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from collections import deque
+
+import numpy as np
+
+from kubeai_tpu.config import System
+from kubeai_tpu.config.system import (
+    GovernorConfig,
+    PeerClusterConfig,
+    TenancyConfig,
+)
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.disagg.handoff import KVPageExport, serialize_pages
+from kubeai_tpu.federation import (
+    FederationAggregator,
+    FederationKVFiller,
+    FederationPlanner,
+    FederationRouter,
+)
+from kubeai_tpu.federation.router import SERVED_BY_HEADER
+from kubeai_tpu.fleet import CapacityPlanner, FleetStateAggregator
+from kubeai_tpu.fleet.metering import UsageMeter
+from kubeai_tpu.fleet.tenancy import TenantGovernor
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.objstore import KVSpillStore
+from kubeai_tpu.operator.governor import ActuationGovernor
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.gossip import DoorShardSet
+from kubeai_tpu.routing.loadbalancer import Group, LoadBalancer
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.routing.proxy import ProxyResult
+from kubeai_tpu.testing.chaos import (
+    CONTINUOUS,
+    EV_CLUSTER_HEAL,
+    EV_CLUSTER_PARTITION,
+    EV_TENANT_FLOOD,
+    TERMINAL,
+    ChaosKubeStore,
+    GameDayEvent,
+    GameDayLog,
+    GameDayTrace,
+    Invariant,
+    InvariantChecker,
+)
+from kubeai_tpu.testing.clock import FakeClock
+from kubeai_tpu.testing.faults import ApiFaultPlan
+from kubeai_tpu.testing.simkit import mk_model
+
+TICK_S = 1.0
+WARMUP_TICKS = 6
+DEFAULT_TICKS = 48
+
+PROMPT_TOKENS = 16
+COMPLETION_TOKENS = 8
+
+# Federation timing: a peer is flagged stale STALENESS_S after its last
+# successful fetch; a flagged peer is failed over FAILOVER_WINDOW_S
+# after the flag; the sim allows FAILOVER_SLACK_S of tick quantization
+# on top of both.
+STALENESS_S = 3.0
+FAILOVER_WINDOW_S = 5.0
+FAILOVER_SLACK_S = 4.0
+RTT_S = 0.05
+QUEUE_WAIT_PER_REQ_S = 0.5
+
+# Measured boot costs the planner surfaces in its plan records
+# (coldstart_cost_s) — the router prices spillover with these. "giant"
+# is the 70B-class model whose four-minute boot must price it OUT of
+# spilling to a cluster that would have to cold-boot it.
+BOOT_COSTS = {"hot": 6.0, "giant": 240.0, "m-east": 6.0}
+SERVE_RATE = {"hot": 3, "giant": 1, "m-east": 3}
+
+CLUSTER_MODELS = {"west": ("giant", "hot"), "east": ("hot", "m-east")}
+CLUSTERS = ("east", "west")  # deterministic iteration order everywhere
+
+# Two chips per cluster: two single-chip models fit exactly, so ANY
+# queue-driven extra desire is throttled demand (chip exhaustion).
+BUDGET_OVERRIDE = {"tpu-v5-lite-podslice/1x1": {"chips": 2, "slice_chips": 1}}
+
+# One federation-wide tenant budget, enforced by the gossiped door.
+DOOR_RATE = 3.0
+DOOR_BURST = 4.0
+GOSSIP_INTERVAL_S = 1.0
+GOSSIP_STALE_S = 3.0
+
+
+def door_budget_epsilon() -> float:
+    """Worst-case over-admission of the 2-door gossip plane (same
+    bound the game-day sim derives): peers' unseen bursts + in-flight
+    gossip intervals + the staleness window, plus tick slack."""
+    n = len(CLUSTERS)
+    return (
+        (n - 1) * DOOR_BURST
+        + n * DOOR_RATE * GOSSIP_INTERVAL_S
+        + (n - 1) * DOOR_RATE * GOSSIP_STALE_S
+        + 2.0
+    )
+
+
+class _Forecast:
+    """The forecast surface the planner prices with."""
+
+    def __init__(self, coldstart_cost_s: float):
+        self.coldstart_cost_s = coldstart_cost_s
+        self.warm_trigger = False  # no prewarm in this sim
+        self.trigger = ""
+        self.spot_disruptions = 0
+
+    def payload(self) -> dict:
+        return {
+            "current": 0.0,
+            "predicted": 0.0,
+            "coldstart_cost_s": self.coldstart_cost_s,
+        }
+
+
+class BootCostBook:
+    """Stands in for the demand forecaster: per-model MEASURED boot
+    costs (the planner would learn these from observed boots)."""
+
+    def forecast(self, model: str):
+        cost = BOOT_COSTS.get(model)
+        return _Forecast(cost) if cost is not None else None
+
+
+class _Req:
+    __slots__ = ("tenant", "model", "t_arrive")
+
+    def __init__(self, tenant: str, model: str, t_arrive: float):
+        self.tenant = tenant
+        self.model = model
+        self.t_arrive = t_arrive
+
+
+class SimCluster:
+    """One cluster's full stack: store, models, telemetry, planner,
+    governor, door shard, and the federation trio."""
+
+    def __init__(self, name: str, peer_name: str, world: "FederationWorld"):
+        self.name = name
+        self.peer_name = peer_name
+        self.world = world
+        clock = world.clock
+
+        cfg = System()
+        cfg.cluster.name = name
+        cfg.cluster.peers = [
+            PeerClusterConfig(
+                name=peer_name,
+                door_url=f"http://door.{peer_name}.example:8000",
+                spill_url="",  # the sim injects in-memory spill stores
+                rtt_seconds=RTT_S,
+            )
+        ]
+        cfg.federation.enabled = True
+        cfg.federation.interval_seconds = 1.0
+        cfg.federation.staleness_seconds = STALENESS_S
+        cfg.federation.failover_window_seconds = FAILOVER_WINDOW_S
+        cfg.federation.queue_wait_per_request_seconds = QUEUE_WAIT_PER_REQ_S
+        cfg.default_and_validate()
+        self.cfg = cfg
+
+        self._name_counter = itertools.count()
+        self.raw = KubeStore(
+            namegen=lambda: f"{next(self._name_counter):06d}"
+        )
+        self.api = ChaosKubeStore(self.raw, ApiFaultPlan())
+        self.metrics = Metrics()
+
+        # -- models + one hand-made Ready pod per model (the data plane
+        # is static here: federation is a control/routing-plane sim).
+        self.queues: dict[str, deque] = {}
+        self.addr_model: dict[str, str] = {}
+        subnet = 10 + sorted(CLUSTERS).index(name)
+        for i, model in enumerate(CLUSTER_MODELS[name]):
+            mk_model(self.raw, model, replicas=1, min_replicas=1,
+                     max_replicas=4, target_requests=1,
+                     scale_down_delay_seconds=0)
+            ip = f"10.{subnet}.0.{i + 1}"
+            self.raw.create({
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": f"{model}-0",
+                    "namespace": "default",
+                    "labels": {md.POD_MODEL_LABEL: model},
+                },
+                "status": {
+                    "phase": "Running",
+                    "podIP": ip,
+                    "conditions": [{"type": "Ready", "status": "True"}],
+                },
+            })
+            self.addr_model[f"{ip}:8000"] = model
+            self.queues[model] = deque()
+
+        self.lb = LoadBalancer(self.raw, metrics=self.metrics)
+        for model in CLUSTER_MODELS[name]:
+            self.lb._groups[model] = Group(
+                metrics=self.metrics, model=model, clock=clock
+            )
+
+        self.aggregator = FleetStateAggregator(
+            lb=self.lb, model_client=ModelClient(self.raw), store=self.raw,
+            metrics=self.metrics, interval_s=1.0, staleness_s=2.5,
+            fetch_metrics=self._fetch_metrics, fetch_state=self._fetch_state,
+            clock=clock, cluster=name,
+        )
+
+        gcfg = GovernorConfig(
+            window_seconds=20.0,
+            model_disruption_budget=2,
+            cluster_disruption_budget=3,
+            min_telemetry_coverage=0.9,
+        )
+        self.governor = ActuationGovernor(
+            cfg=gcfg, fleet=self.aggregator, store=self.api,
+            metrics=self.metrics, clock=clock,
+        )
+        self.planner = CapacityPlanner(
+            fleet=self.aggregator, model_client=ModelClient(self.api),
+            store=None, cfg=cfg, metrics=self.metrics, interval_s=1.0,
+            staleness_s=2.5, clock=clock, governor=self.governor,
+            forecaster=BootCostBook(), budget_override=BUDGET_OVERRIDE,
+        )
+        self.planner.avg_lookup = (
+            lambda m: float(len(self.queues[m])) if m in self.queues else 0.0
+        )
+
+        # -- tenant door: one shard of the FEDERATION-wide gossip plane.
+        self.usage = UsageMeter(metrics=self.metrics)
+        self.door = TenantGovernor(
+            cfg=TenancyConfig(
+                enabled=True,
+                requests_per_second=DOOR_RATE,
+                request_burst=DOOR_BURST,
+                overload_high_water=5e7,
+                overload_low_water=1e7,
+                tenant_idle_seconds=1e9,
+                gossip_interval_seconds=GOSSIP_INTERVAL_S,
+                gossip_stale_seconds=GOSSIP_STALE_S,
+            ),
+            usage=self.usage, metrics=self.metrics, clock=clock,
+            pressure_fn=self._pressure, pressure_ttl_s=0.0,
+            gossip=world.ss.node(name),
+        )
+
+        # -- the federation trio.
+        self.federation = FederationAggregator(
+            cfg, self.aggregator, metrics=self.metrics, clock=clock,
+            fetch_snapshot=world.mk_fetch_snapshot(name, peer_name),
+        )
+        self.router = FederationRouter(
+            cfg, planner=self.planner, federation=self.federation,
+            metrics=self.metrics, clock=clock,
+            dispatch=world.mk_dispatch(name),
+        )
+        self.fed_planner = FederationPlanner(
+            cfg, federation=self.federation, store=self.api,
+            governor=self.governor, metrics=self.metrics, clock=clock,
+        )
+
+        # -- bookkeeping.
+        self.served: dict[str, int] = {m: 0 for m in CLUSTER_MODELS[name]}
+        self.spills: list[dict] = []      # origin-side spill records
+        self.refusals: list[tuple] = []   # (tick, tenant, model, reason)
+        self.denied = 0                   # governor-denied failovers
+        self.control_errors = 0
+
+    # -- injected engine telemetry ---------------------------------------
+
+    def _fetch_metrics(self, addr: str, timeout: float = 5.0) -> str:
+        model = self.addr_model.get(addr)
+        if model is None:
+            raise ConnectionError(f"injected: {addr} unreachable")
+        q = self.queues[model]
+        depth = float(len(q))
+        oldest = (self.world.clock() - q[0].t_arrive) if q else 0.0
+        return "\n".join([
+            'kubeai_engine_queue_depth{class="standard"} ' + f"{depth}",
+            f"kubeai_engine_queue_oldest_wait_seconds {oldest}",
+            "kubeai_engine_kv_cache_utilization 0.0",
+            f"kubeai_engine_slots_active {depth}",
+            "kubeai_engine_slot_capacity 4.0",
+            "kubeai_engine_ttft_seconds_sum 0.0",
+            "kubeai_engine_ttft_seconds_count 0.0",
+            f"kubeai_engine_active_requests {depth}",
+        ]) + "\n"
+
+    def _fetch_state(self, addr: str, timeout: float = 5.0) -> dict:
+        model = self.addr_model.get(addr)
+        if model is None:
+            raise ConnectionError(f"injected: {addr} unreachable")
+        return {"model": model, "healthy": True}
+
+    def _pressure(self) -> dict:
+        depth = sum(len(q) for q in self.queues.values())
+        oldest = 0.0
+        now = self.world.clock()
+        for q in self.queues.values():
+            if q:
+                oldest = max(oldest, now - q[0].t_arrive)
+        return {"depth": float(depth), "oldest_wait_s": oldest}
+
+
+class FederationWorld:
+    """Two `SimCluster`s on one `FakeClock`, one chaos trace, one
+    federation-wide door gossip plane."""
+
+    def __init__(self, trace: GameDayTrace, ticks: int, seed: int = 0):
+        self.trace = trace
+        self.ticks = int(ticks)
+        self.seed = int(seed)
+        self.clock = FakeClock(1000.0)
+        self.tick_no = 0
+        self.t0 = self.clock() + WARMUP_TICKS * TICK_S
+
+        from kubeai_tpu.utils import retryafter
+        retryafter._jitter = lambda: 1.0  # byte-identical replays
+
+        # The door shard set spans CLUSTERS, not in-process shards: each
+        # cluster's door is one shard of a federation-wide gossip plane,
+        # which is exactly what makes the tenant budget global.
+        self.ss = DoorShardSet(
+            CLUSTERS, self.clock, seed=seed,
+            interval_s=GOSSIP_INTERVAL_S, stale_after_s=GOSSIP_STALE_S,
+        )
+
+        self.clusters = {
+            "west": SimCluster("west", "east", self),
+            "east": SimCluster("east", "west", self),
+        }
+
+        # -- chaos state.
+        self.partitioned_cluster: str | None = None
+        self.partition_until = float("inf")
+        self.partition_t: float | None = None
+        self.heal_t: float | None = None
+        self.floods: list[dict] = []
+        self.flood_t0: dict[str, float] = {}
+        self.flood_admitted: dict[str, int] = {}
+
+        # -- observation state.
+        self.ping_pongs = 0
+        self.giant_priced_out = 0
+        self.failover_seen_t: float | None = None
+        self.failback_seen_t: float | None = None
+        self.failed_over_peak: dict[str, str] = {}
+        self.east_seen_once = False
+        self.kv_done = False
+        self.kv_counts: dict | None = None
+
+        self.log = GameDayLog(
+            trace, ticks, extra={"seed": seed, "sim": "federation"},
+        )
+        self.checker = InvariantChecker(INVARIANTS, log=self.log)
+        self.converged_final = False
+
+    def rel_now(self) -> float:
+        return self.clock() - self.t0
+
+    def comms_cut(self, a: str, b: str) -> bool:
+        return self.partitioned_cluster in (a, b)
+
+    # -- cross-cluster links (closures over the peer's objects) ----------
+
+    def mk_fetch_snapshot(self, src: str, dst: str):
+        def fetch(peer):
+            if self.comms_cut(src, dst):
+                raise ConnectionError(
+                    f"cluster partition: {src} cannot reach {dst}"
+                )
+            agg = self.clusters[dst].aggregator
+            snap = agg.snapshot()
+            return snap if snap is not None else agg.collect()
+        return fetch
+
+    def mk_dispatch(self, src: str):
+        """Spill transport: admit at the peer's door (tenancy headers
+        intact — the gossiped budget stays global), then enqueue on the
+        peer's data plane. A refusal there fails the dispatch, which
+        the router degrades to serving locally."""
+        def dispatch(peer, path, body, headers):
+            dst = peer.name
+            if self.comms_cut(src, dst):
+                raise ConnectionError(
+                    f"cluster partition: {src} cannot reach {dst}"
+                )
+            c = self.clusters[dst]
+            model = FederationRouter.model_of(body)
+            # Anti-ping-pong audit: the peer router must decline to
+            # re-spill a request already stamped as spilled.
+            if c.router.maybe_spill(model, path, body, list(headers)) is not None:
+                self.ping_pongs += 1
+            hdrs = {str(k).lower(): v for k, v in headers}
+            tenant = hdrs.get("x-kubeai-tenant", "")
+            refusal = c.door.admit(
+                tenant, model, priority="standard",
+                est_tokens=PROMPT_TOKENS + COMPLETION_TOKENS,
+            )
+            if refusal is not None:
+                raise RuntimeError(
+                    f"peer door refused spill: {refusal.reason}"
+                )
+            c.queues[model].append(_Req(tenant, model, self.clock()))
+            return ProxyResult(
+                200, [("content-type", "application/json")], iter(())
+            )
+        return dispatch
+
+    # -- chaos -----------------------------------------------------------
+
+    def apply_event(self, ev: GameDayEvent, rel: float) -> None:
+        p = ev.params
+        if ev.kind == EV_TENANT_FLOOD:
+            tenant = ev.target or "flooder"
+            self.floods.append({
+                "tenant": tenant,
+                "cluster": p.get("cluster", "west"),
+                "model": p.get("model", "hot"),
+                "rps": int(p.get("rps", 10)),
+                "until": rel + float(p.get("duration_s", 10.0)),
+            })
+            self.flood_t0.setdefault(tenant, rel)
+        elif ev.kind == EV_CLUSTER_PARTITION:
+            name = ev.target or "east"
+            self.partitioned_cluster = name
+            self.partition_until = rel + float(p.get("duration_s", 1e9))
+            if self.partition_t is None:
+                self.partition_t = rel
+            self.clusters[name].api.partitioned = True
+            self.ss.partition([[n] for n in self.ss.names()])
+        elif ev.kind == EV_CLUSTER_HEAL:
+            if self.partitioned_cluster == (ev.target or
+                                            self.partitioned_cluster):
+                self._heal(rel)
+
+    def _heal(self, rel: float) -> None:
+        if self.partitioned_cluster is None:
+            return
+        self.clusters[self.partitioned_cluster].api.partitioned = False
+        self.ss.heal()
+        self.partitioned_cluster = None
+        self.partition_until = float("inf")
+        if self.heal_t is None:
+            self.heal_t = rel
+
+    # -- per-tick phases -------------------------------------------------
+
+    def control(self) -> None:
+        """Each cluster's control plane: telemetry sweep, capacity
+        plan, federation join, failover pass. A partitioned cluster's
+        planner errors are absorbed — that IS the promoted
+        api_partition scenario."""
+        for name in CLUSTERS:
+            c = self.clusters[name]
+            c.lb.sync_all()
+            try:
+                c.aggregator.collect()
+            except Exception:  # noqa: BLE001 — chaos-injected
+                c.control_errors += 1
+            try:
+                c.planner.tick(force=True)
+            except Exception:  # noqa: BLE001 — chaos-injected
+                c.control_errors += 1
+            c.federation.join()
+            actions = c.fed_planner.tick()
+            c.denied += len(actions["denied"])
+        self.ss.step(self.clock())
+
+    def arrivals(self, rel: float) -> None:
+        now = self.clock()
+        offered: list[tuple[str, str, str, int]] = [
+            ("west", "user-west", "hot", 1),
+            ("east", "user-east", "hot", 1),
+        ]
+        if self.tick_no % 2 == 0:
+            offered.append(("east", "user-m", "m-east", 1))
+        self.floods = [f for f in self.floods if rel < f["until"]]
+        for f in self.floods:
+            offered.append((f["cluster"], f["tenant"], f["model"], f["rps"]))
+        for cname, tenant, model, n in offered:
+            c = self.clusters[cname]
+            for _ in range(n):
+                refusal = c.door.admit(
+                    tenant, model, priority="standard",
+                    est_tokens=PROMPT_TOKENS + COMPLETION_TOKENS,
+                )
+                if refusal is not None:
+                    c.refusals.append(
+                        (self.tick_no, tenant, model, refusal.reason)
+                    )
+                    continue
+                if tenant in self.flood_t0:
+                    self.flood_admitted[tenant] = (
+                        self.flood_admitted.get(tenant, 0) + 1
+                    )
+                self._route(c, tenant, model, now)
+
+    def _route(self, c: SimCluster, tenant: str, model: str,
+               now: float) -> None:
+        plan = c.planner.current_plan() or {}
+        rec = (plan.get("models") or {}).get(model) or {}
+        body = json.dumps({"model": model}).encode()
+        result = c.router.maybe_spill(
+            model, "/v1/chat/completions", body,
+            [("x-kubeai-tenant", tenant)],
+        )
+        if result is not None:
+            ranked = c.router.rank(model, rec)
+            c.spills.append({
+                "tick": self.tick_no,
+                "tenant": tenant,
+                "model": model,
+                "to": dict(result.headers).get(SERVED_BY_HEADER, ""),
+                "throttled": int(rec.get("throttled_replicas") or 0),
+                "local_cost": FederationRouter.local_cost(
+                    rec, QUEUE_WAIT_PER_REQ_S
+                ),
+                "remote_cost": ranked[0][0] if ranked else None,
+            })
+            return
+        c.queues[model].append(_Req(tenant, model, now))
+
+    def serve(self) -> None:
+        for name in CLUSTERS:
+            c = self.clusters[name]
+            for model in CLUSTER_MODELS[name]:
+                q = c.queues[model]
+                for _ in range(min(len(q), SERVE_RATE[model])):
+                    req = q.popleft()
+                    c.usage.record(
+                        req.tenant, model,
+                        prompt_tokens=PROMPT_TOKENS,
+                        completion_tokens=COMPLETION_TOKENS,
+                        requests=1,
+                    )
+                    c.served[model] += 1
+
+    def _kv_script(self) -> None:
+        """The cross-cluster KVP1 fill drill, run once: a good fill
+        from the peer's spill store, a dtype-mismatch refusal, and a
+        truncated (mid-transfer death) refusal — both degrade to a
+        counted recompute (miss), never a cast."""
+        store = KVSpillStore("")  # east's in-memory spill leg
+        shape = (2, 1, 4, 2, 4)  # [NL, n_pages, page, KVH, D]
+        k = np.arange(int(np.prod(shape)), dtype=np.float32).reshape(shape)
+        h_good = "ab" * 16
+        h_trunc = "cd" * 16
+        blob = serialize_pages(KVPageExport(
+            prefix_hashes=(h_good,), page_size=4, dtype="float32",
+            k_pages=k, v_pages=k + 0.5, model="hot",
+        ))
+        store.put(h_good, blob)
+        store.put(h_trunc, blob[: len(blob) // 2])
+        west = self.clusters["west"]
+        filler = FederationKVFiller(
+            west.cfg, metrics=west.metrics, stores={"east": store},
+        )
+        got = filler.fill(h_good, expect_dtype="float32")
+        ok = (
+            got is not None
+            and got.dtype == "float32"
+            and got.prefix_hashes == (h_good,)
+            and np.array_equal(got.k_pages, k)
+        )
+        refused_dtype = filler.fill(h_good, expect_dtype="int8") is None
+        refused_trunc = filler.fill(h_trunc, expect_dtype="float32") is None
+        self.kv_counts = {
+            "fills": filler.fills,
+            "refusals": filler.refusals,
+            "misses": filler.misses,
+            "verified": bool(ok),
+            "refused_dtype": refused_dtype,
+            "refused_trunc": refused_trunc,
+        }
+
+    def observe(self, rel: float) -> None:
+        west = self.clusters["west"]
+        # The durable record of the takeover: the annotation on the
+        # survivor's local Model (read via the RAW store — observation
+        # must not depend on the chaos wrapper).
+        ann = None
+        try:
+            m = west.raw.get("Model", "default", "hot")
+            ann = ((m.get("metadata") or {}).get("annotations") or {}).get(
+                md.FEDERATION_FAILOVER_ANNOTATION
+            )
+        except Exception:  # noqa: BLE001
+            ann = None
+        if ann:
+            if self.failover_seen_t is None:
+                self.failover_seen_t = rel
+        elif (
+            self.failover_seen_t is not None
+            and self.heal_t is not None
+            and self.failback_seen_t is None
+        ):
+            self.failback_seen_t = rel
+        for model, src in west.fed_planner.failed_over.items():
+            self.failed_over_peak[model] = src
+        if "m-east" in west.federation.peer_models("east"):
+            self.east_seen_once = True
+        # "giant" priced out: exhausted AND a fresh peer exists, but
+        # its boot cost keeps the peer from being cheaper.
+        plan = west.planner.current_plan() or {}
+        rec = (plan.get("models") or {}).get("giant")
+        if rec and int(rec.get("throttled_replicas") or 0) > 0:
+            ranked = west.router.rank("giant", rec)
+            if ranked:
+                local = FederationRouter.local_cost(
+                    rec, QUEUE_WAIT_PER_REQ_S
+                )
+                if local > RTT_S and ranked[0][0] >= local:
+                    self.giant_priced_out += 1
+
+    # -- the loop --------------------------------------------------------
+
+    def tick(self) -> None:
+        self.tick_no += 1
+        self.clock.advance(TICK_S)
+        rel = self.rel_now()
+        for ev in self.trace.due(rel):
+            self.apply_event(ev, rel)
+            self.log.event(self.tick_no, ev)
+        if self.partitioned_cluster is not None and rel >= self.partition_until:
+            self._heal(rel)
+        self.control()
+        self.arrivals(rel)
+        self.serve()
+        if not self.kv_done and rel >= 4.0:
+            self._kv_script()
+            self.kv_done = True
+        self.observe(rel)
+        self.log.obs(
+            self.tick_no,
+            t=round(rel, 3),
+            queues={n: {m: len(q) for m, q in sorted(
+                self.clusters[n].queues.items())} for n in CLUSTERS},
+            served={n: dict(sorted(self.clusters[n].served.items()))
+                    for n in CLUSTERS},
+            spills={n: len(self.clusters[n].spills) for n in CLUSTERS},
+            refusals={n: len(self.clusters[n].refusals) for n in CLUSTERS},
+            stale={n: self.clusters[n].federation.cluster_stale(
+                self.clusters[n].peer_name) for n in CLUSTERS},
+            failed_over={n: dict(sorted(
+                self.clusters[n].fed_planner.failed_over.items()))
+                for n in CLUSTERS},
+            flood_admitted=dict(sorted(self.flood_admitted.items())),
+            partitioned=self.partitioned_cluster or "",
+        )
+        self.checker.check_continuous(self, self.tick_no, rel)
+
+    def run(self) -> dict:
+        for _ in range(WARMUP_TICKS + self.ticks):
+            self.tick()
+        self.converged_final = (
+            self.partitioned_cluster is None
+            and all(
+                not q
+                for c in self.clusters.values()
+                for q in c.queues.values()
+            )
+        )
+        self.checker.check_terminal(self, self.tick_no, self.rel_now())
+        return self.result()
+
+    def result(self) -> dict:
+        first = self.checker.first_violation
+        return {
+            "ticks": self.ticks,
+            "seed": self.seed,
+            "trace_events": len(self.trace.events),
+            "violations": [
+                {"tick": v.tick, "t": v.t, "invariant": v.invariant,
+                 "detail": v.detail}
+                for v in self.checker.violations
+            ],
+            "first_violation": (
+                None if first is None else
+                {"tick": first.tick, "invariant": first.invariant,
+                 "detail": first.detail}
+            ),
+            "spills": {n: list(self.clusters[n].spills) for n in CLUSTERS},
+            "spill_total": sum(
+                len(self.clusters[n].spills) for n in CLUSTERS
+            ),
+            "refusal_total": sum(
+                len(self.clusters[n].refusals) for n in CLUSTERS
+            ),
+            "served": {n: dict(self.clusters[n].served) for n in CLUSTERS},
+            "billing": {
+                n: self.clusters[n].usage.totals() for n in CLUSTERS
+            },
+            "flood_admitted": dict(self.flood_admitted),
+            "giant_priced_out": self.giant_priced_out,
+            "ping_pongs": self.ping_pongs,
+            "denied": {n: self.clusters[n].denied for n in CLUSTERS},
+            "control_errors": {
+                n: self.clusters[n].control_errors for n in CLUSTERS
+            },
+            "failover": {
+                "partition_t": self.partition_t,
+                "heal_t": self.heal_t,
+                "failover_seen_t": self.failover_seen_t,
+                "failback_seen_t": self.failback_seen_t,
+                "peak": dict(self.failed_over_peak),
+            },
+            "kv": self.kv_counts,
+            "converged_final": self.converged_final,
+            "log": self.log,
+        }
+
+
+# ---- invariants --------------------------------------------------------------
+
+
+def _inv_spill_exhaustion_cost(world) -> str | None:
+    """Every spill happened under exhaustion, with the peer strictly
+    cheaper — and the 240 s-boot model never spills at all."""
+    for name in CLUSTERS:
+        for s in world.clusters[name].spills:
+            if s["model"] == "giant":
+                return (
+                    f"{name} spilled 'giant' (boot cost "
+                    f"{BOOT_COSTS['giant']}s) at tick {s['tick']} — "
+                    "boot-cost pricing failed"
+                )
+            if s["throttled"] <= 0:
+                return (
+                    f"{name} spilled {s['model']} at tick {s['tick']} "
+                    "without chip exhaustion (throttled_replicas=0)"
+                )
+            if s["remote_cost"] is None or s["remote_cost"] >= s["local_cost"]:
+                return (
+                    f"{name} spilled {s['model']} at tick {s['tick']} "
+                    f"with remote {s['remote_cost']} >= local "
+                    f"{s['local_cost']} — not cost-ranked"
+                )
+    return None
+
+
+def _inv_federation_budget(world) -> str | None:
+    """A flooding tenant's admissions ACROSS BOTH cluster doors stay
+    within one token-bucket budget plus the gossip epsilon."""
+    rel = world.rel_now()
+    eps = door_budget_epsilon()
+    for tenant, t0 in world.flood_t0.items():
+        elapsed = max(0.0, rel - t0)
+        bound = DOOR_BURST + DOOR_RATE * elapsed + eps
+        got = world.flood_admitted.get(tenant, 0)
+        if got > bound:
+            return (
+                f"{tenant}: {got} admissions across both doors > "
+                f"global budget {bound:.1f} ({elapsed:.0f}s elapsed, "
+                f"eps {eps:.1f}) — the federation budget leaked"
+            )
+    return None
+
+
+def _inv_compliant_never_refused(world) -> str | None:
+    for name in CLUSTERS:
+        for tick, tenant, model, reason in world.clusters[name].refusals:
+            if not tenant.startswith("user-"):
+                continue
+            return (
+                f"compliant tenant {tenant} refused at {name} door "
+                f"(tick {tick}, model {model}, reason {reason})"
+            )
+    return None
+
+
+def _inv_billing_exact(world) -> str | None:
+    """Each cluster's ledger equals its delivered work exactly —
+    spilled requests are billed once, where they were served."""
+    for name in CLUSTERS:
+        c = world.clusters[name]
+        served = sum(c.served.values())
+        t = c.usage.totals()
+        want = {
+            "requests": served,
+            "prompt_tokens": served * PROMPT_TOKENS,
+            "completion_tokens": served * COMPLETION_TOKENS,
+        }
+        for k, v in want.items():
+            if int(t.get(k, 0)) != v:
+                return (
+                    f"{name}: ledger {k}={t.get(k)} != delivered {v} "
+                    f"(served={served})"
+                )
+    return None
+
+
+def _inv_staleness_flagged_not_merged(world) -> str | None:
+    """The peer's models never merge into the local snapshot; a
+    partitioned peer is flagged stale while its last-good snapshot
+    stays visible (what failover plans from)."""
+    west = world.clusters["west"]
+    snap = west.federation.snapshot()
+    if snap is None:
+        return None
+    local = (snap["clusters"]["west"].get("snapshot") or {})
+    if "m-east" in (local.get("models") or {}):
+        return "east's m-east leaked into west's LOCAL snapshot (merged)"
+    if world.east_seen_once and "m-east" not in west.federation.peer_models(
+        "east"
+    ):
+        return "east's last-good snapshot lost m-east (flagging dropped it)"
+    if world.partitioned_cluster == "east" and world.partition_t is not None:
+        active = world.rel_now() - world.partition_t
+        east_entry = snap["clusters"].get("east") or {}
+        if active > STALENESS_S + 1.5 * TICK_S and not east_entry.get("stale"):
+            return (
+                f"east partitioned {active:.0f}s but not flagged stale "
+                f"(staleness bound {STALENESS_S}s)"
+            )
+    return None
+
+
+def _inv_no_ping_pong(world) -> str | None:
+    if world.ping_pongs:
+        return (
+            f"{world.ping_pongs} spilled request(s) were re-spilled by "
+            "the peer router — the one-hop stamp failed"
+        )
+    return None
+
+
+def _inv_partitioned_never_actuates(world) -> str | None:
+    """The cluster that lost its API server must not take over anyone's
+    models: it cannot even see its own store."""
+    east = world.clusters["east"]
+    if east.fed_planner.failed_over:
+        return (
+            f"partitioned east actuated takeovers: "
+            f"{dict(east.fed_planner.failed_over)}"
+        )
+    return None
+
+
+def _inv_failover_bounded(world) -> str | None:
+    if world.partition_t is None:
+        return "trace never partitioned a cluster"
+    if world.failover_seen_t is None:
+        return "east partitioned but west never failed its models over"
+    bound = STALENESS_S + FAILOVER_WINDOW_S + FAILOVER_SLACK_S
+    took = world.failover_seen_t - world.partition_t
+    if took > bound:
+        return f"failover took {took:.0f}s > bound {bound:.0f}s"
+    if world.failed_over_peak != {"hot": "east"}:
+        return (
+            f"expected exactly hot<-east failed over; got "
+            f"{dict(world.failed_over_peak)} (m-east is not deployed on "
+            "west and must never be taken over)"
+        )
+    return None
+
+
+def _inv_failback_on_heal(world) -> str | None:
+    if world.heal_t is None:
+        return "trace never healed the partition"
+    if world.failback_seen_t is None:
+        return "east healed but the takeover was never reversed"
+    took = world.failback_seen_t - world.heal_t
+    if took > FAILOVER_SLACK_S:
+        return f"failback took {took:.0f}s > slack {FAILOVER_SLACK_S:.0f}s"
+    if world.clusters["west"].fed_planner.failed_over:
+        return (
+            f"failed_over not empty after heal: "
+            f"{dict(world.clusters['west'].fed_planner.failed_over)}"
+        )
+    return None
+
+
+def _inv_kv_fill_discipline(world) -> str | None:
+    kc = world.kv_counts
+    if kc is None:
+        return "the KV fill script never ran"
+    want = {"fills": 1, "refusals": 2, "misses": 2}
+    got = {k: kc[k] for k in want}
+    if got != want:
+        return f"KV fill counts {got} != expected {want}"
+    if not (kc["verified"] and kc["refused_dtype"] and kc["refused_trunc"]):
+        return f"KV fill outcomes wrong: {kc}"
+    return None
+
+
+def _inv_queues_drained(world) -> str | None:
+    if not world.converged_final:
+        leftover = {
+            n: {m: len(q) for m, q in world.clusters[n].queues.items() if q}
+            for n in CLUSTERS
+        }
+        return (
+            f"queues not drained / partition not healed by end: "
+            f"{leftover}, partitioned={world.partitioned_cluster}"
+        )
+    return None
+
+
+INVARIANTS = (
+    Invariant("spill_exhaustion_cost", _inv_spill_exhaustion_cost,
+              CONTINUOUS,
+              "spillover only on exhaustion, only when the peer is "
+              "cheaper; boot cost prices 'giant' out"),
+    Invariant("federation_budget", _inv_federation_budget, CONTINUOUS,
+              "one tenant budget across both cluster doors"),
+    Invariant("compliant_never_refused", _inv_compliant_never_refused,
+              CONTINUOUS, "compliant tenants never refused"),
+    Invariant("billing_exact", _inv_billing_exact, CONTINUOUS,
+              "each cluster's ledger equals its delivered work"),
+    Invariant("staleness_flagged_not_merged",
+              _inv_staleness_flagged_not_merged, CONTINUOUS,
+              "a stale peer is flagged, never merged"),
+    Invariant("no_ping_pong", _inv_no_ping_pong, CONTINUOUS,
+              "a spilled request is never re-spilled"),
+    Invariant("partitioned_never_actuates",
+              _inv_partitioned_never_actuates, CONTINUOUS,
+              "the partitioned cluster never takes over models"),
+    Invariant("failover_bounded", _inv_failover_bounded, TERMINAL,
+              "partitioned models fail over within the bounded window"),
+    Invariant("failback_on_heal", _inv_failback_on_heal, TERMINAL,
+              "the takeover reverses when the peer heals"),
+    Invariant("kv_fill_discipline", _inv_kv_fill_discipline, TERMINAL,
+              "cross-cluster KV fills verify; mismatches refuse"),
+    Invariant("queues_drained", _inv_queues_drained, TERMINAL,
+              "both clusters drain by the end of the run"),
+)
+
+
+# ---- the trace ---------------------------------------------------------------
+
+
+def federation_trace(seed: int = 0) -> GameDayTrace:
+    """Flood both doors into exhaustion (spillover + global budget),
+    flood the giant model (boot-cost pricing), partition east mid-run
+    (failover), flood again DURING the partition (split-door budget),
+    then heal (failback)."""
+    return GameDayTrace([
+        GameDayEvent(2.0, EV_TENANT_FLOOD, "flooder",
+                     {"cluster": "west", "model": "hot", "rps": 20,
+                      "duration_s": 14.0}),
+        GameDayEvent(2.0, EV_TENANT_FLOOD, "flooder",
+                     {"cluster": "east", "model": "hot", "rps": 20,
+                      "duration_s": 14.0}),
+        GameDayEvent(3.0, EV_TENANT_FLOOD, "flood-giant",
+                     {"cluster": "west", "model": "giant", "rps": 10,
+                      "duration_s": 6.0}),
+        GameDayEvent(20.0, EV_CLUSTER_PARTITION, "east",
+                     {"duration_s": 30.0}),
+        GameDayEvent(24.0, EV_TENANT_FLOOD, "flooder",
+                     {"cluster": "west", "model": "hot", "rps": 10,
+                      "duration_s": 6.0}),
+        GameDayEvent(24.0, EV_TENANT_FLOOD, "flooder",
+                     {"cluster": "east", "model": "hot", "rps": 10,
+                      "duration_s": 6.0}),
+        GameDayEvent(34.0, EV_CLUSTER_HEAL, "east", {}),
+    ], seed=seed)
+
+
+def run_federation(trace: GameDayTrace, ticks: int, seed: int = 0) -> dict:
+    return FederationWorld(trace, ticks, seed=seed).run()
+
+
+def run_sim(ticks: int = DEFAULT_TICKS, seed: int = 0) -> dict:
+    """Tier-1 entry point: the full federation day plus the same day
+    without the floods (spillover must be exhaustion-only: a calm
+    federation never spills)."""
+    federation = run_federation(federation_trace(seed), ticks, seed)
+    baseline = run_federation(
+        federation_trace(seed).without(EV_TENANT_FLOOD), ticks, seed
+    )
+    return {
+        "ticks": ticks,
+        "seed": seed,
+        "federation": federation,
+        "baseline": baseline,
+    }
+
+
+# ---- result-level checks (imported by tests/unit/test_federation.py) ---------
+
+
+def check_no_violations(result: dict) -> None:
+    """Both runs hold every invariant, continuous AND terminal."""
+    for key in ("federation", "baseline"):
+        assert result[key]["violations"] == [], (
+            key, result[key]["violations"],
+        )
+        assert result[key]["converged_final"], f"{key} did not converge"
+
+
+def check_spillover_real(result: dict) -> None:
+    """Spillover actually fired under the flood — hot spilled from
+    west to east — and NEVER without the flood (exhaustion-only), and
+    the giant model was priced out by its measured boot cost."""
+    fed, base = result["federation"], result["baseline"]
+    west_hot = [
+        s for s in fed["spills"]["west"]
+        if s["model"] == "hot" and s["to"] == "east"
+    ]
+    assert west_hot, "west never spilled hot to east under the flood"
+    assert base["spill_total"] == 0, (
+        f"baseline (no flood) spilled {base['spill_total']} times — "
+        "spillover is not exhaustion-gated"
+    )
+    assert fed["giant_priced_out"] > 0, (
+        "giant was never exhausted-but-priced-out — the boot-cost "
+        "pricing path was not exercised"
+    )
+    giant_spills = [
+        s for n in CLUSTERS for s in fed["spills"][n]
+        if s["model"] == "giant"
+    ]
+    assert giant_spills == [], giant_spills
+
+
+def check_failover_cycle(result: dict) -> None:
+    """Partition -> bounded failover of exactly the co-deployed model
+    -> failback on heal, in BOTH runs (failover is flood-independent)."""
+    for key in ("federation", "baseline"):
+        fo = result[key]["failover"]
+        assert fo["failover_seen_t"] is not None, (key, fo)
+        assert fo["failback_seen_t"] is not None, (key, fo)
+        assert fo["peak"] == {"hot": "east"}, (key, fo)
+
+
+def check_flood_budget_nonvacuous(result: dict) -> None:
+    """The budget invariant had teeth: the flooder was admitted some
+    (the bound is not vacuously satisfied at 0) AND refused a lot."""
+    fed = result["federation"]
+    assert fed["flood_admitted"].get("flooder", 0) > 0
+    assert fed["refusal_total"] > 100, fed["refusal_total"]
+
+
+def check_kv_counts(result: dict) -> None:
+    kc = result["federation"]["kv"]
+    assert kc is not None
+    assert (kc["fills"], kc["refusals"], kc["misses"]) == (1, 2, 2), kc
+
+
+ALL_CHECKS = (
+    check_no_violations,
+    check_spillover_real,
+    check_failover_cycle,
+    check_flood_budget_nonvacuous,
+    check_kv_counts,
+)
+
+
+# ---- replay ------------------------------------------------------------------
+
+
+def replay(path: str) -> tuple[dict, dict]:
+    """Re-run a dumped federation day byte-identically from its own
+    header (trace + seed + ticks)."""
+    header, _records = GameDayLog.load(path)
+    trace = GameDayTrace(
+        [GameDayEvent.from_dict(d) for d in header["events"]],
+        seed=int(header["seed"]),
+    )
+    result = run_federation(
+        trace, int(header["ticks"]), seed=int(header["seed"])
+    )
+    return header, result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ticks", type=int, default=DEFAULT_TICKS)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--dump", help="write the JSONL event log here")
+    ap.add_argument("--replay", metavar="DUMP",
+                    help="re-run a dumped federation day and compare")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.replay:
+        with open(args.replay) as fh:
+            original = [ln.rstrip("\n") for ln in fh if ln.strip()]
+        header, result = replay(args.replay)
+        fresh = result["log"].lines
+        identical = fresh == original
+        print(f"replayed {args.replay}: {len(original)} log lines")
+        print(f"byte-identical: {identical}")
+        print(f"first violation: {result['first_violation']}")
+        return 0 if identical else 1
+
+    result = run_federation(
+        federation_trace(args.seed), args.ticks, seed=args.seed
+    )
+    if args.dump:
+        result["log"].dump(args.dump)
+        print(f"log -> {args.dump}")
+
+    if args.json:
+        slim = {k: v for k, v in result.items() if k not in ("log", "spills")}
+        print(json.dumps(slim, indent=2, default=str))
+        return 0
+
+    print(f"federation day: seed={args.seed} ticks={args.ticks} "
+          f"events={result['trace_events']}")
+    print(f"  spills={result['spill_total']} "
+          f"refusals={result['refusal_total']} "
+          f"flood_admitted={result['flood_admitted']}")
+    print(f"  giant priced out on {result['giant_priced_out']} ticks; "
+          f"ping_pongs={result['ping_pongs']}")
+    print(f"  failover: {result['failover']}")
+    print(f"  kv: {result['kv']}")
+    print(f"  served: {result['served']}")
+    print(f"  control errors absorbed: {result['control_errors']}")
+    print(f"  converged: {result['converged_final']}")
+    if result["violations"]:
+        print(f"  VIOLATIONS ({len(result['violations'])}):")
+        for v in result["violations"][:10]:
+            print(f"    tick {v['tick']} [{v['invariant']}] {v['detail']}")
+    else:
+        print("  all invariants held")
+    return 0 if not result["violations"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,6 +85,8 @@ from kubeai_tpu.testing.chaos import (
     EV_API_PARTITION,
     EV_API_STORM,
     EV_CHIP_FLIP,
+    EV_CLUSTER_HEAL,
+    EV_CLUSTER_PARTITION,
     EV_DOOR_CRASH,
     EV_DOOR_PARTITION,
     EV_KILL_GROUP_HOST,
@@ -516,6 +518,26 @@ class GameDayWorld:
             self.partition_until = self.rel_now() + float(
                 p.get("duration_s", 5.0)
             )
+        elif ev.kind == EV_CLUSTER_PARTITION:
+            # Cluster-level promotion of api_partition: in this
+            # single-cluster world, losing the WHOLE cluster's control
+            # plane is an API partition plus a split door gossip plane
+            # (the data plane keeps serving — exactly the failure the
+            # federation planner fails over on, seen from inside).
+            until = self.rel_now() + float(p.get("duration_s", 5.0))
+            self.api.partitioned = True
+            self.partition_until = until
+            ss = getattr(self.door, "shard_set", None)
+            if ss is not None:
+                ss.partition([[n] for n in ss.names()])
+                self.door_partition_until = until
+        elif ev.kind == EV_CLUSTER_HEAL:
+            self.api.partitioned = False
+            self.partition_until = float("inf")
+            ss = getattr(self.door, "shard_set", None)
+            if ss is not None:
+                ss.heal()
+                self.door_partition_until = float("inf")
         elif ev.kind == EV_API_STORM:
             key = (p.get("method", "GET"), p.get("plural", "pods"), False)
             cur = self.api_plan.counts[key]
@@ -1236,13 +1258,21 @@ def fast_trace(seed: int = 0) -> GameDayTrace:
 
 
 def extended_trace(seed: int = 0) -> GameDayTrace:
-    """Two full chaos rounds back to back — the slow-tier soak."""
+    """Two full chaos rounds back to back, capped by a cluster-level
+    partition wave (api_partition promoted to the whole cluster: API
+    dark AND the door gossip plane split at once) — the slow-tier
+    soak."""
     base = fast_trace(seed).events
     second = [
         GameDayEvent(ev.t + 45.0, ev.kind, ev.target, dict(ev.params))
         for ev in base
     ]
-    return GameDayTrace(list(base) + second, seed=seed)
+    wave = [
+        GameDayEvent(95.0, EV_CLUSTER_PARTITION, "",
+                     {"duration_s": 30.0}),
+        GameDayEvent(101.0, EV_CLUSTER_HEAL, "", {}),
+    ]
+    return GameDayTrace(list(base) + second + wave, seed=seed)
 
 
 def failing_trace(seed: int = 0) -> GameDayTrace:

@@ -19,11 +19,21 @@ import dataclasses
 import json
 import math
 import os
+import re
 from typing import Any
 
 
 class ConfigError(ValueError):
     pass
+
+
+# RFC 1123 DNS label: what a cluster (or peer) name must be so it can
+# ride in metric labels, snapshot keys, and k8s object names unchanged.
+_DNS_LABEL = re.compile(r"^[a-z0-9]([a-z0-9-]*[a-z0-9])?$")
+
+
+def is_dns_label(name: str) -> bool:
+    return bool(name) and len(name) <= 63 and bool(_DNS_LABEL.match(name))
 
 
 # GKE TPU node labels (reference: charts/kubeai/values-gke.yaml:18-41).
@@ -188,6 +198,70 @@ class TenancyConfig:
     # shard degrades to local-view enforcement with a conservative
     # budget split until the peer is heard again.
     gossip_stale_seconds: float = 5.0
+
+
+@dataclasses.dataclass
+class PeerClusterConfig:
+    """One peer cluster this cluster may spill to / fail over toward.
+    `door_url` is the peer's front-door base URL (its OpenAIServer);
+    `spill_url` optionally names the peer's KV spill store so prefix
+    pages can be filled cross-cluster instead of recomputed;
+    `rtt_seconds` is the operator-measured network round trip used by
+    the federation router's cost ranking."""
+
+    name: str = ""
+    door_url: str = ""
+    spill_url: str = ""
+    rtt_seconds: float = 0.05
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    """This cluster's identity in a federation (kubeai_tpu/federation;
+    no reference analog — the reference is single-cluster). The name is
+    stamped on every fleet snapshot so a federation join can tell whose
+    telemetry it is looking at; peers list the clusters requests may
+    spill to. Defaults to a standalone cluster named "local" with no
+    peers — byte-identical behavior to a build without this block."""
+
+    name: str = "local"
+    region: str = ""
+    peers: list[PeerClusterConfig] = dataclasses.field(
+        default_factory=list
+    )
+
+    def peer(self, name: str) -> PeerClusterConfig | None:
+        for p in self.peers:
+            if p.name == name:
+                return p
+        return None
+
+
+@dataclasses.dataclass
+class FederationConfig:
+    """Federation plane (kubeai_tpu/federation). When enabled, the
+    manager wires a FederationAggregator (joined multi-cluster
+    snapshots), a FederationRouter in the front door (cost-ranked
+    spillover to peer doors on local chip exhaustion), and a
+    FederationPlanner pass (whole-model failover when a peer cluster
+    partitions, every actuation governor-gated). Disabled by default:
+    nothing is constructed and the serving path is identical to a
+    single-cluster build."""
+
+    enabled: bool = False
+    # Join cadence. 0 = follow modelAutoscaling.interval.
+    interval_seconds: float = 0.0
+    # A peer snapshot older than this is flagged stale and excluded
+    # from routing/failover decisions. 0 = 3 x interval.
+    staleness_seconds: float = 0.0
+    # A peer must be unreachable/stale this long before the federation
+    # planner fails its models over (bounded-window failover, and the
+    # heal path reverses it once the peer reports fresh again).
+    failover_window_seconds: float = 30.0
+    # Cost model: estimated local wait = queue oldest wait + depth x
+    # this per-request service estimate; remote cost = peer RTT
+    # (+ measured model boot cost when the peer would cold-start it).
+    queue_wait_per_request_seconds: float = 0.1
 
 
 @dataclasses.dataclass
@@ -370,6 +444,12 @@ class System:
         default_factory=TenancyConfig
     )
     slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
+    cluster: ClusterConfig = dataclasses.field(
+        default_factory=ClusterConfig
+    )
+    federation: FederationConfig = dataclasses.field(
+        default_factory=FederationConfig
+    )
     model_rollouts: ModelRollouts = dataclasses.field(
         default_factory=ModelRollouts
     )
@@ -489,6 +569,48 @@ class System:
             )
         if s.min_incident_interval_seconds < 0:
             raise ConfigError("slo.minIncidentInterval must be >= 0")
+        c = self.cluster
+        if not is_dns_label(c.name):
+            raise ConfigError(
+                "cluster.name must be a DNS label (lowercase "
+                "alphanumerics and '-', <= 63 chars)"
+            )
+        if len(c.region) > 63:
+            raise ConfigError("cluster.region must be <= 63 chars")
+        seen_peers: set[str] = set()
+        for p in c.peers:
+            if not is_dns_label(p.name):
+                raise ConfigError(
+                    f"cluster.peers[].name {p.name!r} must be a DNS label"
+                )
+            if p.name == c.name:
+                raise ConfigError(
+                    f"cluster.peers[].name {p.name!r} shadows cluster.name"
+                )
+            if p.name in seen_peers:
+                raise ConfigError(
+                    f"cluster.peers[].name {p.name!r} is duplicated"
+                )
+            seen_peers.add(p.name)
+            if not p.door_url:
+                raise ConfigError(
+                    f"cluster.peers[{p.name}].doorUrl is required"
+                )
+            if p.rtt_seconds < 0:
+                raise ConfigError(
+                    f"cluster.peers[{p.name}].rtt must be >= 0"
+                )
+        f = self.federation
+        if f.interval_seconds < 0:
+            raise ConfigError("federation.interval must be >= 0")
+        if f.staleness_seconds < 0:
+            raise ConfigError("federation.stalenessAfter must be >= 0")
+        if f.failover_window_seconds <= 0:
+            raise ConfigError("federation.failoverWindow must be > 0")
+        if f.queue_wait_per_request_seconds < 0:
+            raise ConfigError(
+                "federation.queueWaitPerRequest must be >= 0"
+            )
         if self.model_rollouts.surge < 0:
             raise ConfigError("modelRollouts.surge must be >= 0")
         r = self.resilience
@@ -866,6 +988,32 @@ def system_from_dict(data: dict) -> System:
             incident_dir=str(s.get("incidentDir", "")),
             min_incident_interval_seconds=_seconds(
                 s.get("minIncidentInterval", 300)
+            ),
+        )
+    if "cluster" in data:
+        c = data["cluster"]
+        sys_obj.cluster = ClusterConfig(
+            name=str(c.get("name", "local")),
+            region=str(c.get("region", "")),
+            peers=[
+                PeerClusterConfig(
+                    name=str(p.get("name", "")),
+                    door_url=str(p.get("doorUrl", "")),
+                    spill_url=str(p.get("spillUrl", "")),
+                    rtt_seconds=_seconds(p.get("rtt", 0.05)),
+                )
+                for p in (c.get("peers") or [])
+            ],
+        )
+    if "federation" in data:
+        f = data["federation"]
+        sys_obj.federation = FederationConfig(
+            enabled=bool(f.get("enabled", False)),
+            interval_seconds=_seconds(f.get("interval", 0)),
+            staleness_seconds=_seconds(f.get("stalenessAfter", 0)),
+            failover_window_seconds=_seconds(f.get("failoverWindow", 30)),
+            queue_wait_per_request_seconds=_seconds(
+                f.get("queueWaitPerRequest", 0.1)
             ),
         )
     if "modelRollouts" in data:

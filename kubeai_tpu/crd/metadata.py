@@ -62,6 +62,13 @@ PREEMPT_REASON_CAPACITY = "CapacityPreemption"
 # first tick. Value: JSON {"replicas": n} or {"roles": {role: n}}.
 LAST_KNOWN_GOOD_ANNOTATION = "kubeai.org/last-known-good-replicas"
 
+# Federation planner (kubeai_tpu/federation/planner): stamped on a Model
+# when a peer cluster partitions and this cluster takes over serving it.
+# Value: the failed peer's cluster name, so heal-time failback can clear
+# exactly the takeovers it owns. Every write is gated by
+# ActuationGovernor.allow_federation_failover.
+FEDERATION_FAILOVER_ANNOTATION = "kubeai.org/federation-failover-from"
+
 # Self-healing repair-backoff state (kubeai_tpu/operator/controller):
 # JSON {"count": n, "last": wall_ts} persisted on the Model so an
 # operator restart mid-backoff cannot issue duplicate repairs.

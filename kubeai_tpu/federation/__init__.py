@@ -1,0 +1,23 @@
+"""Multi-cluster federation plane.
+
+One cluster is one failure domain. The federation plane joins
+per-cluster fleet snapshots into a single view (staleness flagged per
+cluster, never silently merged), spills requests to a peer cluster's
+front door when the local planner reports chip exhaustion (cost-ranked
+by measured boot cost vs queue wait), fails whole models over when a
+peer cluster partitions (every actuation routed through the
+ActuationGovernor), and fills evicted KV prefixes from a peer
+cluster's spill store with the quant-header refusal protocol intact.
+"""
+
+from kubeai_tpu.federation.aggregator import FederationAggregator
+from kubeai_tpu.federation.kv import FederationKVFiller
+from kubeai_tpu.federation.planner import FederationPlanner
+from kubeai_tpu.federation.router import FederationRouter
+
+__all__ = [
+    "FederationAggregator",
+    "FederationKVFiller",
+    "FederationPlanner",
+    "FederationRouter",
+]

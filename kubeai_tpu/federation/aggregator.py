@@ -1,0 +1,189 @@
+"""Join per-cluster fleet snapshots into one federation snapshot.
+
+Each cluster already runs a `FleetStateAggregator` whose snapshot is
+stamped with the cluster's validated identity (`cluster:` config
+block). The `FederationAggregator` polls every peer's front door for
+that snapshot and joins them into a federation view keyed by cluster
+name. The cardinal rule: a stale or unreachable peer is FLAGGED, never
+merged — its last-good snapshot stays visible (the failover planner
+needs to know what the lost cluster was serving) but every consumer
+sees `stale: true` and the age, so nobody mistakes a partitioned
+cluster's past for the present.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+logger = logging.getLogger(__name__)
+
+# Bound on the blocking peer fetch; peers are remote clusters, so this
+# is generous relative to the intra-cluster scrape timeout.
+PEER_FETCH_TIMEOUT_S = 5.0
+
+
+def _http_fetch_snapshot(peer, timeout: float = PEER_FETCH_TIMEOUT_S) -> dict:
+    """Default peer fetch: GET the peer door's fleet-state endpoint."""
+    url = peer.door_url.rstrip("/") + "/v1/fleet/state"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:  # noqa: S310
+        return json.loads(resp.read().decode("utf-8"))
+
+
+class FederationAggregator:
+    """The federation state plane: local snapshot + flagged peer views.
+
+    `fetch_snapshot` is injectable (tests and the federation sim hand
+    in a closure over the peer cluster's in-process aggregator); the
+    default speaks HTTP to the peer door. All clock reads go through
+    the injected clock so the sim can drive staleness deterministically.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        local,
+        *,
+        metrics,
+        clock=time.monotonic,
+        fetch_snapshot=None,
+    ):
+        self.cfg = cfg
+        self.cluster = cfg.cluster.name
+        self.peers = tuple(cfg.cluster.peers)
+        self.local = local
+        self.metrics = metrics
+        self._clock = clock
+        self.fetch_snapshot = fetch_snapshot or _http_fetch_snapshot
+        fed = cfg.federation
+        self.staleness_s = (
+            fed.staleness_seconds
+            or (3 * fed.interval_seconds)
+            or 15.0
+        )
+        self._lock = threading.Lock()
+        # peer name -> {"snapshot": last-good dict|None, "fetched_at":
+        # local-clock ts|None, "stale_since": ts|None, "error": str}
+        self._peer_state: dict[str, dict] = {
+            p.name: {
+                "snapshot": None,
+                "fetched_at": None,
+                "stale_since": None,
+                "error": "",
+            }
+            for p in self.peers
+        }
+        self._snapshot: dict | None = None
+
+    # -- collection ------------------------------------------------------
+
+    def join(self) -> dict:
+        """One federation sweep: refresh every peer view, join with the
+        local snapshot, publish. Peer staleness is judged on the LOCAL
+        clock (time since a successful fetch), never on the peer's own
+        timestamps — a partitioned peer's clock is exactly what we
+        cannot trust."""
+        now = self._clock()
+        clusters: dict[str, dict] = {}
+        local_snap = self.local.snapshot()
+        if local_snap is None:
+            local_snap = self.local.collect()
+        clusters[self.cluster] = {
+            "snapshot": local_snap,
+            "stale": False,
+            "age_s": round(max(0.0, now - local_snap["ts"]), 3),
+            "error": "",
+            "local": True,
+        }
+        for peer in self.peers:
+            st = self._peer_state[peer.name]
+            try:
+                snap = self.fetch_snapshot(peer)
+                if not isinstance(snap, dict):
+                    raise TypeError(
+                        f"peer snapshot is {type(snap).__name__}, not dict"
+                    )
+                st["snapshot"] = snap
+                st["fetched_at"] = now
+                st["error"] = ""
+            except Exception as e:  # noqa: BLE001 — peer loss is routine
+                st["error"] = str(e) or type(e).__name__
+                logger.debug(
+                    "federation fetch from %s failed: %s", peer.name, e
+                )
+            stale = self._is_stale(st, now)
+            if stale:
+                if st["stale_since"] is None:
+                    st["stale_since"] = now
+            else:
+                st["stale_since"] = None
+            age = (
+                round(max(0.0, now - st["fetched_at"]), 3)
+                if st["fetched_at"] is not None
+                else None
+            )
+            clusters[peer.name] = {
+                "snapshot": st["snapshot"],
+                "stale": stale,
+                "age_s": age,
+                "error": st["error"],
+                "local": False,
+            }
+            self.metrics.federation_cluster_stale.set(
+                1.0 if stale else 0.0, cluster=peer.name
+            )
+        snapshot = {"ts": now, "cluster": self.cluster, "clusters": clusters}
+        with self._lock:
+            self._snapshot = snapshot
+        self.metrics.federation_joins.inc()
+        self.metrics.federation_snapshot_ts.set(now)
+        return snapshot
+
+    def _is_stale(self, st: dict, now: float) -> bool:
+        if st["fetched_at"] is None:
+            return True
+        return now - st["fetched_at"] > self.staleness_s
+
+    # -- reads -----------------------------------------------------------
+
+    def snapshot(self) -> dict | None:
+        with self._lock:
+            return self._snapshot
+
+    def cluster_stale(self, name: str) -> bool:
+        """Is the named peer's view currently flagged stale? Unknown
+        clusters are stale by definition (no view at all)."""
+        st = self._peer_state.get(name)
+        if st is None:
+            return True
+        return self._is_stale(st, self._clock())
+
+    def stale_since(self, name: str) -> float | None:
+        """Local-clock instant the named peer's view went stale (the
+        failover planner's window input), or None while fresh."""
+        st = self._peer_state.get(name)
+        if st is None:
+            return None
+        return st["stale_since"]
+
+    def peer_models(self, name: str) -> dict:
+        """The named peer's last-good model map — the failover
+        planner's read of what a lost cluster was serving. Empty when
+        no snapshot was ever fetched."""
+        st = self._peer_state.get(name)
+        if st is None or st["snapshot"] is None:
+            return {}
+        return st["snapshot"].get("models") or {}
+
+    def state_payload(self) -> dict:
+        """`GET /v1/federation/state`: the latest federation snapshot,
+        joined anew when none exists."""
+        snap = self.snapshot()
+        if snap is None:
+            snap = self.join()
+        payload = {"object": "federation.state"}
+        payload.update(snap)
+        return payload

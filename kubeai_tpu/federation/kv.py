@@ -1,0 +1,83 @@
+"""Cross-cluster KV prefix fills through peer spill stores.
+
+`KVSpillStore` already gives each cluster an objstore leg for evicted
+hot-prefix pages. Federation reuses that medium across clusters: when
+a local fill misses, peers' spill URLs are tried in config order. A
+filled blob is still a KVP1 `KVPageExport`, so the full handoff
+protocol applies unchanged — in particular the quant-header refusal:
+a dtype or kv_quant-scheme mismatch between clusters (one runs int8
+KV, the other bf16) REFUSES the fill and recomputes; it never casts.
+Every failure mode — miss, refusal, mid-transfer death — degrades to a
+counted local recompute; cross-cluster fill is an optimization, never
+a correctness dependency.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kubeai_tpu.disagg import handoff
+from kubeai_tpu.objstore import KVSpillStore
+
+logger = logging.getLogger(__name__)
+
+
+class FederationKVFiller:
+    """Fill evicted prefixes from peer clusters' spill stores."""
+
+    def __init__(self, cfg, *, metrics, stores=None):
+        self.metrics = metrics
+        self.fills = 0
+        self.refusals = 0
+        self.misses = 0
+        if stores is not None:
+            # Injected peer-name -> KVSpillStore map (tests, sim).
+            self.stores = dict(stores)
+            return
+        self.stores = {
+            p.name: KVSpillStore(p.spill_url)
+            for p in cfg.cluster.peers
+            if p.spill_url
+        }
+
+    def fill(self, hash_hex: str, expect_dtype: str | None = None):
+        """Try each peer's spill store for the page run keyed by
+        `hash_hex`. Returns a verified `KVPageExport` or None (miss —
+        the caller recomputes; its recompute counter is the ledger).
+
+        A malformed or quant-incompatible blob from one peer does not
+        stop the sweep: another peer may hold a compatible copy."""
+        for cluster, store in self.stores.items():
+            try:
+                blob = store.get(hash_hex)
+            except Exception as e:  # noqa: BLE001 — peer loss is a miss
+                logger.debug(
+                    "federation KV fetch from %s failed: %s", cluster, e
+                )
+                continue
+            if blob is None:
+                continue
+            try:
+                export = handoff.deserialize_pages(blob)
+            except handoff.HandoffError as e:
+                # Truncated (mid-transfer death) or quant-header
+                # mismatch: refuse, never cast or guess.
+                self.refusals += 1
+                self.metrics.federation_kv_refusals.inc(cluster=cluster)
+                logger.warning(
+                    "federation KV fill from %s refused: %s", cluster, e
+                )
+                continue
+            if expect_dtype and export.dtype != expect_dtype:
+                self.refusals += 1
+                self.metrics.federation_kv_refusals.inc(cluster=cluster)
+                logger.warning(
+                    "federation KV fill from %s refused: dtype %s, "
+                    "expected %s", cluster, export.dtype, expect_dtype,
+                )
+                continue
+            self.fills += 1
+            self.metrics.federation_kv_fills.inc(cluster=cluster)
+            return export
+        self.misses += 1
+        return None

@@ -1,0 +1,190 @@
+"""Whole-model failover when a peer cluster partitions.
+
+The intra-cluster chaos plane already drills `api_partition` (one
+cluster's API server going dark). The federation planner promotes that
+to the cluster level: when a peer's federation view has been flagged
+stale for a full `failover window` — one blip never moves a model —
+every model the lost cluster was serving (live replicas in its
+last-good snapshot) is failed over to this cluster by stamping
+`FEDERATION_FAILOVER_ANNOTATION` on the local Model — the durable
+record of the takeover that downstream capacity consumers can honor
+as extra demand. When the peer heals, the takeover is reversed.
+
+Every actuation — failover AND failback — routes through
+`ActuationGovernor.allow_federation_failover`: a fenced leader or
+blind telemetry cannot move models between clusters, and the static
+gate (scripts/check_actuation_paths.py) pins the annotation write to
+this module, inside a gate-consulting function, so no future caller
+can bypass the governor.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from kubeai_tpu.crd import metadata as md
+
+logger = logging.getLogger(__name__)
+
+
+class FederationPlanner:
+    """Bounded-window cluster failover, governor-gated end to end."""
+
+    def __init__(
+        self,
+        cfg,
+        *,
+        federation,
+        store,
+        governor,
+        metrics,
+        clock=time.monotonic,
+        namespace: str = "default",
+    ):
+        self.cfg = cfg
+        self.peers = tuple(cfg.cluster.peers)
+        self.federation = federation
+        self.store = store
+        self.governor = governor
+        self.metrics = metrics
+        self._clock = clock
+        self.namespace = namespace
+        self.window_s = cfg.federation.failover_window_seconds
+        # model -> source cluster name we took it over from. Only
+        # takeovers this planner owns are ever failed back.
+        self.failed_over: dict[str, str] = {}
+
+    def tick(self, now: float | None = None) -> dict:
+        """One pass: fail over models of peers stale past the window,
+        fail back models of peers that healed. Returns a summary for
+        the sim's invariant checks."""
+        now = self._clock() if now is None else now
+        actions = {"failed_over": [], "failed_back": [], "denied": []}
+        for peer in self.peers:
+            since = self.federation.stale_since(peer.name)
+            if since is not None and now - since >= self.window_s:
+                self._fail_over_peer(peer, actions)
+            elif since is None and not self.federation.cluster_stale(
+                peer.name
+            ):
+                self._fail_back_peer(peer, actions)
+        return actions
+
+    # -- failover --------------------------------------------------------
+
+    def _fail_over_peer(self, peer, actions: dict) -> None:
+        for model, entry in sorted(
+            self.federation.peer_models(peer.name).items()
+        ):
+            if self.failed_over.get(model):
+                continue
+            live = sum((entry.get("replicas") or {}).values())
+            if live <= 0:
+                continue  # the peer wasn't serving it; nothing to save
+            if not self._local_model_exists(model):
+                continue  # can't serve what this cluster never deployed
+            verdict = self._actuate_failover(model, peer.name)
+            if verdict == "denied":
+                actions["denied"].append(model)
+                continue
+            if verdict != "ok":
+                continue  # write failed; retried next tick
+            self.failed_over[model] = peer.name
+            self.metrics.federation_failovers.inc(
+                model=model, cluster=peer.name
+            )
+            actions["failed_over"].append(model)
+            logger.warning(
+                "federation failover: %s taken over from partitioned "
+                "cluster %s", model, peer.name,
+            )
+
+    def _fail_back_peer(self, peer, actions: dict) -> None:
+        for model, src in sorted(self.failed_over.items()):
+            if src != peer.name:
+                continue
+            verdict = self._actuate_failback(model)
+            if verdict == "denied":
+                actions["denied"].append(model)
+                continue
+            if verdict != "ok":
+                continue  # write failed; retried next tick
+            del self.failed_over[model]
+            self.metrics.federation_failbacks.inc(
+                model=model, cluster=peer.name
+            )
+            actions["failed_back"].append(model)
+            logger.info(
+                "federation failback: %s returned to healed cluster %s",
+                model, peer.name,
+            )
+
+    # -- actuation (the ONLY writers of the failover annotation) ---------
+
+    def _local_model_exists(self, model: str) -> bool:
+        try:
+            self.store.get("Model", self.namespace, model)
+            return True
+        except Exception:  # noqa: BLE001 — absent or unreachable: skip
+            return False
+
+    def _actuate_failover(self, model: str, source: str) -> str:
+        """Gate, then stamp the takeover on the local Model. Returns
+        "ok" | "denied" | "error". The static gate requires the write
+        and the governor consult to share this function."""
+        if not self.governor.allow_federation_failover(model):
+            self.metrics.federation_failover_denied.inc(model=model)
+            return "denied"
+        try:
+            self.store.patch_merge(
+                "Model",
+                self.namespace,
+                model,
+                {
+                    "metadata": {
+                        "annotations": {
+                            md.FEDERATION_FAILOVER_ANNOTATION: source
+                        }
+                    }
+                },
+            )
+            return "ok"
+        except Exception as e:  # noqa: BLE001 — retried next tick
+            logger.warning(
+                "federation failover write for %s failed: %s", model, e
+            )
+            return "error"
+
+    def _actuate_failback(self, model: str) -> str:
+        """Gate, then clear the takeover (merge-patch None deletes the
+        key). Returns "ok" | "denied" | "error"."""
+        if not self.governor.allow_federation_failover(model):
+            self.metrics.federation_failover_denied.inc(model=model)
+            return "denied"
+        try:
+            self.store.patch_merge(
+                "Model",
+                self.namespace,
+                model,
+                {
+                    "metadata": {
+                        "annotations": {
+                            md.FEDERATION_FAILOVER_ANNOTATION: None
+                        }
+                    }
+                },
+            )
+            return "ok"
+        except Exception as e:  # noqa: BLE001 — retried next tick
+            logger.warning(
+                "federation failback write for %s failed: %s", model, e
+            )
+            return "error"
+
+    def state_payload(self) -> dict:
+        return {
+            "object": "federation.failovers",
+            "window_s": self.window_s,
+            "failed_over": dict(sorted(self.failed_over.items())),
+        }

@@ -1,0 +1,179 @@
+"""Cost-ranked request spillover across cluster front doors.
+
+The front door consults the `FederationRouter` AFTER local admission
+(tenancy verdicts are rendered where the request arrived — the gossiped
+budget is global, so a tenant cannot launder quota by hopping doors)
+and BEFORE the local proxy. Spillover fires only when the local
+capacity planner reports chip exhaustion for the model
+(`throttled_replicas > 0`: demand the local budget cannot seat), and
+only when a peer is genuinely cheaper:
+
+    local cost   = oldest queue wait + depth x per-request wait
+    remote cost  = peer RTT (+ the model's MEASURED boot cost from the
+                   plan record when the peer has no live replica)
+
+The boot cost is the `coldstart_cost_s` the planner already prices
+demand with — observed boots, not config guesses — so a 70B model with
+a four-minute cold start never spills to a cluster that would have to
+boot it for one request. Tenancy headers are forwarded intact.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.request
+
+from kubeai_tpu.routing.proxy import ProxyResult
+
+logger = logging.getLogger(__name__)
+
+DISPATCH_TIMEOUT_S = 30.0
+# Stamped on spilled responses so callers can see which cluster served.
+SERVED_BY_HEADER = "x-kubeai-served-by-cluster"
+# Stamped on the spilled request so the peer door never re-spills it
+# (a two-cluster mutual-exhaustion loop would otherwise ping-pong).
+SPILLED_HEADER = "x-kubeai-federation-spilled"
+
+
+def _http_dispatch(peer, path: str, body: bytes, headers) -> ProxyResult:
+    """Default dispatch: POST the request to the peer cluster's door.
+
+    The peer door runs the full stack — tenancy, breakers, prefix
+    routing — so the spilled request is an ordinary request there."""
+    url = peer.door_url.rstrip("/") + path
+    req = urllib.request.Request(url, data=body, method="POST")
+    for k, v in headers:
+        req.add_header(k, v)
+    resp = urllib.request.urlopen(req, timeout=DISPATCH_TIMEOUT_S)  # noqa: S310
+    out_headers = [(k.lower(), v) for k, v in resp.getheaders()]
+
+    def chunks(r=resp):
+        try:
+            while True:
+                chunk = r.read(65536)
+                if not chunk:
+                    return
+                yield chunk
+        finally:
+            r.close()
+
+    return ProxyResult(resp.status, out_headers, chunks())
+
+
+class FederationRouter:
+    """Exhaustion-gated, cost-ranked spillover to peer cluster doors."""
+
+    def __init__(
+        self,
+        cfg,
+        *,
+        planner,
+        federation,
+        metrics,
+        clock=time.monotonic,
+        dispatch=None,
+    ):
+        self.cfg = cfg
+        self.peers = tuple(cfg.cluster.peers)
+        self.planner = planner
+        self.federation = federation
+        self.metrics = metrics
+        self._clock = clock
+        self.dispatch = dispatch or _http_dispatch
+        self.queue_wait_per_request_s = (
+            cfg.federation.queue_wait_per_request_seconds
+        )
+
+    # -- cost model ------------------------------------------------------
+
+    @staticmethod
+    def local_cost(record: dict, per_request_s: float) -> float:
+        """Expected wait behind the local queue for this model."""
+        return (
+            float(record.get("queue_oldest_wait_s") or 0.0)
+            + float(record.get("queue_depth") or 0) * per_request_s
+        )
+
+    @staticmethod
+    def remote_cost(peer, record: dict, peer_entry: dict | None) -> float:
+        """RTT to the peer door, plus the model's measured boot cost
+        when the peer holds no live replica of it (the request would
+        wait out a cold start there)."""
+        cost = float(peer.rtt_seconds)
+        live = 0
+        if peer_entry:
+            live = sum((peer_entry.get("replicas") or {}).values())
+        if live <= 0:
+            cost += float(record.get("coldstart_cost_s") or 0.0)
+        return cost
+
+    def rank(self, model: str, record: dict) -> list[tuple[float, object]]:
+        """Fresh peers ranked by remote cost (ties broken by name so
+        the ranking is deterministic under equal RTTs)."""
+        ranked = []
+        for peer in self.peers:
+            if self.federation.cluster_stale(peer.name):
+                continue  # a flagged cluster is not a spill target
+            entry = self.federation.peer_models(peer.name).get(model)
+            ranked.append(
+                (self.remote_cost(peer, record, entry), peer.name, peer)
+            )
+        ranked.sort(key=lambda t: (t[0], t[1]))
+        return [(cost, peer) for cost, _name, peer in ranked]
+
+    # -- the spill decision ---------------------------------------------
+
+    def maybe_spill(self, model, path, body, headers):
+        """Return a peer door's ProxyResult when spilling wins, else
+        None (serve locally). Every failure path degrades to None — the
+        local queue is always a valid answer."""
+        if not model or not self.peers:
+            return None
+        hdr_map = {str(k).lower(): v for k, v in (headers or [])}
+        if hdr_map.get(SPILLED_HEADER):
+            return None  # one hop only — never re-spill a spilled request
+        plan = self.planner.current_plan() if self.planner else None
+        if plan is None:
+            return None
+        record = (plan.get("models") or {}).get(model)
+        if record is None:
+            return None
+        if int(record.get("throttled_replicas") or 0) <= 0:
+            return None  # local capacity can seat the demand: stay home
+        local = self.local_cost(record, self.queue_wait_per_request_s)
+        ranked = self.rank(model, record)
+        if not ranked:
+            return None
+        best_cost, peer = ranked[0]
+        if best_cost >= local:
+            return None  # waiting here is cheaper than going there
+        fwd = list(headers or [])
+        fwd.append((SPILLED_HEADER, self.federation.cluster))
+        try:
+            result = self.dispatch(peer, path, body, fwd)
+        except Exception as e:  # noqa: BLE001 — peer loss degrades to local
+            self.metrics.federation_spill_errors.inc(cluster=peer.name)
+            logger.warning(
+                "spillover of %s to %s failed (%s); serving locally",
+                model, peer.name, e,
+            )
+            return None
+        if result is None:
+            return None
+        result.headers = list(result.headers) + [
+            (SERVED_BY_HEADER, peer.name)
+        ]
+        self.metrics.federation_spillovers.inc(
+            model=model, cluster=peer.name
+        )
+        return result
+
+    @staticmethod
+    def model_of(body: bytes) -> str:
+        """Best-effort model extraction from an OpenAI-shaped body."""
+        try:
+            return str(json.loads(body or b"{}").get("model") or "")
+        except (ValueError, TypeError):
+            return ""

@@ -176,8 +176,14 @@ class FleetStateAggregator:
         fetch_metrics=None,
         fetch_state=None,
         clock=time.time,
+        cluster: str = "local",
     ):
         self.lb = lb
+        # Which cluster's telemetry this is: stamped on every snapshot
+        # so a federation join can flag (never merge) a peer's staleness
+        # per cluster. "local" is the standalone default — consumers
+        # that predate federation never see a different value.
+        self.cluster = cluster
         self.model_client = model_client
         self.store = store
         self.namespace = namespace
@@ -391,6 +397,7 @@ class FleetStateAggregator:
 
         snapshot = {
             "ts": now,
+            "cluster": self.cluster,
             "models": snap_models,
             "chips": chips,
             "endpoints_total": endpoints_total,

@@ -619,6 +619,16 @@ class CapacityPlanner:
 
         planned = [e for e in entries if e["kind"] != "fixed"]
         forecasts = self._attach_forecasts(planned)
+        # The demand-fill pricing, made observable: each model's position
+        # in its class's `_priced` order rides on the plan record (0 =
+        # granted first = most expensive to boot). The federation router
+        # reads the same records to rank remote-cold-start costs, so the
+        # ordering must be inspectable at /v1/fleet/plan, not implicit.
+        for cls in SCHEDULING_CLASSES:
+            for rank, e in enumerate(
+                self._priced([e for e in planned if e["class"] == cls])
+            ):
+                e["priced_rank"] = rank
         if budget_known:
             # Floors are CRD guarantees — honored across ALL classes
             # first (in priority order), then demand water-fills per
@@ -720,6 +730,7 @@ class CapacityPlanner:
             if e["kind"] != "fixed":
                 base.update(
                     coldstart_cost_s=round(e["coldstart_cost_s"], 3),
+                    priced_rank=e["priced_rank"],
                     prewarm_replicas=e.get("prewarm", 0),
                     prewarm_trigger=e.get("prewarm_trigger", ""),
                 )

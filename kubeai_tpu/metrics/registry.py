@@ -1040,6 +1040,69 @@ class Metrics:
             "itself.",
             self.registry,
         )
+        # -- federation plane (kubeai_tpu/federation) ------------------------
+        self.federation_joins = Counter(
+            "kubeai_federation_joins_total",
+            "Federation join sweeps: per-cluster fleet snapshots merged "
+            "into one federation snapshot (staleness flagged per "
+            "cluster, never silently merged).",
+            self.registry,
+        )
+        self.federation_snapshot_ts = Gauge(
+            "kubeai_federation_snapshot_timestamp_seconds",
+            "Unix timestamp of the latest federation snapshot.",
+            self.registry,
+        )
+        self.federation_cluster_stale = Gauge(
+            "kubeai_federation_cluster_stale",
+            "1 while the named peer cluster's snapshot is stale or "
+            "unreachable (cluster label) — the failover window's input.",
+            self.registry,
+        )
+        self.federation_spillovers = Counter(
+            "kubeai_federation_spillovers_total",
+            "Requests the federation router spilled to a peer cluster's "
+            "door per model and cluster (fires only on local chip "
+            "exhaustion, cost-ranked, tenancy headers forwarded intact).",
+            self.registry,
+        )
+        self.federation_spill_errors = Counter(
+            "kubeai_federation_spill_errors_total",
+            "Spillover dispatches that failed at the peer door per "
+            "cluster (the request then falls back to the local queue).",
+            self.registry,
+        )
+        self.federation_failovers = Counter(
+            "kubeai_federation_failovers_total",
+            "Whole-model failovers the federation planner actuated per "
+            "model and (partitioned source) cluster, governor-gated.",
+            self.registry,
+        )
+        self.federation_failbacks = Counter(
+            "kubeai_federation_failbacks_total",
+            "Failovers reversed after the partitioned cluster healed, "
+            "per model and cluster.",
+            self.registry,
+        )
+        self.federation_failover_denied = Counter(
+            "kubeai_federation_failover_denied_total",
+            "Federation failovers the actuation governor refused per "
+            "model (fencing or telemetry-coverage gate).",
+            self.registry,
+        )
+        self.federation_kv_fills = Counter(
+            "kubeai_federation_kv_fills_total",
+            "KVP1 prefix fills served from a peer cluster's spill store "
+            "per cluster (pages adopted instead of recomputed).",
+            self.registry,
+        )
+        self.federation_kv_refusals = Counter(
+            "kubeai_federation_kv_refusals_total",
+            "Cross-cluster KVP1 fills refused by the quant-header "
+            "protocol per cluster (dtype/scheme mismatch — refused, "
+            "never cast; the request recomputes locally).",
+            self.registry,
+        )
         # -- tracing export health ------------------------------------------
         self.tracing_dropped_spans = TracingDroppedSpans(
             "kubeai_tracing_dropped_spans_total",

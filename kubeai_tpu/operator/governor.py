@@ -63,6 +63,7 @@ ACTION_SCALE_DOWN = "scale_down"
 ACTION_SCALE_TO_ZERO = "scale_to_zero"
 ACTION_PREEMPT_MARK = "preempt_mark"
 ACTION_PREWARM = "prewarm"
+ACTION_FEDERATION_FAILOVER = "federation_failover"
 
 # Denial-reason vocabulary.
 DENY_LEASE = "lease-invalid"
@@ -404,6 +405,26 @@ class ActuationGovernor:
                 self._deny(ACTION_PREWARM, model, DENY_STALE)
                 return False
         self._allow(ACTION_PREWARM, model)
+        return True
+
+    def allow_federation_failover(self, model: str) -> bool:
+        """Whether the federation planner may fail this model over to
+        (or back from) another cluster right now. A failover rewrites
+        where a whole model serves, so it is fenced (a non-leader must
+        not rehome models) and refused while LOCAL fleet telemetry is
+        stale: a cluster that cannot see its own fleet must not judge a
+        peer's partition. Budgets don't apply — failover adds capacity
+        elsewhere rather than destroying it here."""
+        if not self.fence_valid():
+            self.metrics.leader_fenced_writes.inc()
+            self._deny(ACTION_FEDERATION_FAILOVER, model, DENY_LEASE)
+            return False
+        if self.armed:
+            _cov, fresh = self._coverage(model)
+            if not fresh:
+                self._deny(ACTION_FEDERATION_FAILOVER, model, DENY_STALE)
+                return False
+        self._allow(ACTION_FEDERATION_FAILOVER, model)
         return True
 
     # -- last-known-good persistence / restart rehydration ---------------------

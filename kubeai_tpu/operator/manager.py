@@ -144,6 +144,10 @@ class Manager:
             metrics=self.metrics,
             usage=self.usage,
             interval_s=self.cfg.model_autoscaling.interval_seconds / 2.0,
+            # Validated cluster identity (config `cluster:` block):
+            # every snapshot is stamped so federation peers can join
+            # views without guessing who they came from.
+            cluster=self.cfg.cluster.name,
         )
         self.autoscaler.fleet = self.fleet
         # Actuation safety governor (kubeai_tpu/operator/governor):
@@ -251,6 +255,40 @@ class Manager:
                 self.planner.recorder = self.recorder
             if self.tenancy is not None:
                 self.tenancy.recorder = self.recorder
+        # Federation plane (kubeai_tpu/federation): only constructed
+        # when `federation.enabled` — single-cluster builds keep the
+        # serving path identical. The aggregator joins peer fleet
+        # snapshots (staleness flagged per cluster), the router spills
+        # chip-exhausted models' requests to the cheapest fresh peer
+        # door, the planner fails whole models over when a peer stays
+        # partitioned past the window (governor-gated actuation).
+        self.federation = None
+        self.federation_router = None
+        self.federation_planner = None
+        if self.cfg.federation.enabled:
+            from kubeai_tpu.federation import (
+                FederationAggregator,
+                FederationPlanner,
+                FederationRouter,
+            )
+
+            self.federation = FederationAggregator(
+                self.cfg, self.fleet, metrics=self.metrics,
+            )
+            self.federation_router = FederationRouter(
+                self.cfg,
+                planner=self.planner,
+                federation=self.federation,
+                metrics=self.metrics,
+            )
+            self.federation_planner = FederationPlanner(
+                self.cfg,
+                federation=self.federation,
+                store=self.store,
+                governor=self.governor,
+                metrics=self.metrics,
+                namespace=self.namespace,
+            )
         self.api_server = OpenAIServer(
             self.proxy,
             self.model_client,
@@ -263,6 +301,9 @@ class Manager:
             governor=self.tenancy,
         )
         self.api_server.slo = self.slo
+        self.api_server.federation = self.federation
+        self.api_server.federation_router = self.federation_router
+        self.api_server.federation_planner = self.federation_planner
         self.messengers: list[Messenger] = []
         # One broker per stream, chosen by URL scheme (gcppubsub://,
         # nats://, plain names = in-memory) — the reference registers the

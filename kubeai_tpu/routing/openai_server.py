@@ -120,6 +120,15 @@ class OpenAIServer:
         # the latest per-objective burn/budget verdicts and the flight
         # recorder's incident index. Wired by the manager when enabled.
         self.slo = None
+        # Federation plane (kubeai_tpu/federation): the aggregator backs
+        # GET /v1/federation/state, the router spills a chip-exhausted
+        # model's requests to a peer cluster's door (cost-ranked, after
+        # local admission so the gossiped budget stays global), the
+        # planner reports failover state. Wired by the manager when
+        # federation is enabled.
+        self.federation = None
+        self.federation_router = None
+        self.federation_planner = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -197,6 +206,20 @@ class OpenAIServer:
                     return self._respond_json(
                         200, outer.slo.state_payload()
                     )
+                if path in ("/v1/federation/state",
+                            "/openai/v1/federation/state"):
+                    if outer.federation is None:
+                        return self._respond_json(
+                            404,
+                            {"error": {"message":
+                                       "federation not configured"}},
+                        )
+                    payload = outer.federation.state_payload()
+                    if outer.federation_planner is not None:
+                        payload["failovers"] = (
+                            outer.federation_planner.state_payload()
+                        )
+                    return self._respond_json(200, payload)
                 if path in ("/v1/usage", "/openai/v1/usage"):
                     if outer.usage is None:
                         return self._respond_json(
@@ -287,12 +310,30 @@ class OpenAIServer:
                         return self._refuse(
                             refusal, normalized, span, request_id, t0
                         )
-                result = outer.proxy.handle(
-                    # strip the /openai prefix when forwarding to engines
-                    normalized[len("/openai"):],
-                    body,
-                    headers,
-                )
+                # Federation spillover sits between local admission and
+                # the local proxy: the tenancy verdict is rendered here
+                # (the gossiped budget is global, so spilling cannot
+                # launder quota) but a chip-exhausted model's request
+                # may be served by a cheaper peer cluster's door.
+                result = None
+                if outer.federation_router is not None:
+                    from kubeai_tpu.federation.router import (
+                        FederationRouter,
+                    )
+
+                    result = outer.federation_router.maybe_spill(
+                        FederationRouter.model_of(body),
+                        normalized[len("/openai"):],
+                        body,
+                        list(headers.items()),
+                    )
+                if result is None:
+                    result = outer.proxy.handle(
+                        # strip the /openai prefix when forwarding
+                        normalized[len("/openai"):],
+                        body,
+                        headers,
+                    )
                 span.set_attribute("http.status_code", result.status)
                 # End the span when the BODY finishes, not when headers
                 # arrive: for SSE the generation streams long after

@@ -58,6 +58,11 @@ EV_LINK_DROP = "link_drop"          # params: model, index, duration_s
 EV_KILL_GROUP_HOST = "kill_group_host"  # params: model, group, host, mode
 EV_DOOR_PARTITION = "door_partition"  # params: duration_s (splits the door shard set into two halves)
 EV_DOOR_CRASH = "door_crash"        # params: shard (index; state reconstructed from peers)
+# Cluster-level partition: api_partition promoted one level — target
+# names the cluster whose entire control plane AND door go dark; the
+# federation planner fails its models over within the bounded window.
+EV_CLUSTER_PARTITION = "cluster_partition"  # params: duration_s; target: cluster
+EV_CLUSTER_HEAL = "cluster_heal"    # target: cluster (explicit heal; else duration_s)
 
 EVENT_KINDS = (
     EV_KILL_POD,
@@ -72,6 +77,8 @@ EVENT_KINDS = (
     EV_KILL_GROUP_HOST,
     EV_DOOR_PARTITION,
     EV_DOOR_CRASH,
+    EV_CLUSTER_PARTITION,
+    EV_CLUSTER_HEAL,
 )
 
 # ---- shared incident/flight schema -------------------------------------------

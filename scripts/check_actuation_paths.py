@@ -18,6 +18,11 @@ self-harm hole PR 8 closed. This gate scans kubeai_tpu/ for:
     grant site must sit in a function that consults
     `governor.allow_prewarm`, so the prewarm gate can't be silently
     dropped while the metric-shaped plumbing stays green;
+  - cross-cluster failover writes: stamping `FEDERATION_FAILOVER_
+    ANNOTATION` moves a whole model between clusters, so (checked
+    structurally, like prewarm) only the federation planner may write
+    it and its write sites must consult
+    `governor.allow_federation_failover`;
   - member-wise slice-group deletions: a `.delete_pod(` call nested in
     a loop over group members consumes one budget unit PER MEMBER and
     can leave a partial multi-host group behind. Whole groups are
@@ -137,6 +142,67 @@ def _prewarm_violations(rel: str, text: str, lines: list[str]) -> list[str]:
     return violations
 
 
+# Cross-cluster failover is an actuation by another name: stamping
+# FEDERATION_FAILOVER_ANNOTATION moves a whole model between clusters.
+# Only the federation planner may write it (as a patch key — reads
+# carry no colon), and only in a function that consults the governor's
+# `allow_federation_failover` gate.
+_FEDOVER_WRITE = re.compile(r"FEDERATION_FAILOVER_ANNOTATION\s*:")
+_FEDOVER_HOME = os.path.join("federation", "planner.py")
+_FEDOVER_GATE = "allow_federation_failover"
+
+
+def _fedover_violations(rel: str, text: str, lines: list[str]) -> list[str]:
+    """Federation-failover annotation writes outside the federation
+    planner are violations; inside it each write must live in a
+    function that consults the governor's `allow_federation_failover`
+    gate."""
+    hits = [
+        text.count("\n", 0, m.start()) + 1
+        for m in _FEDOVER_WRITE.finditer(text)
+    ]
+    if not hits:
+        return []
+    if rel.endswith(os.path.join("crd", "metadata.py")):
+        return []  # the constant's own definition site
+    if not rel.endswith(_FEDOVER_HOME):
+        return [
+            f"{rel}:{n}: federation failover written outside the "
+            f"federation planner `{lines[n - 1].strip()[:80]}` — "
+            "cross-cluster failover belongs to FederationPlanner, "
+            "behind governor.allow_federation_failover"
+            for n in hits
+            if not _has_pragma(lines, n)
+        ]
+    violations = []
+    funcs = [
+        node
+        for node in ast.walk(ast.parse(text))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for n in hits:
+        owners = [
+            f for f in funcs if f.lineno <= n <= (f.end_lineno or f.lineno)
+        ]
+        if not owners:
+            violations.append(
+                f"{rel}:{n}: federation failover written at module "
+                "level — move it behind governor.allow_federation_failover"
+            )
+            continue
+        body = "\n".join(
+            lines[min(f.lineno for f in owners) - 1:
+                  max(f.end_lineno or f.lineno for f in owners)]
+        )
+        if _FEDOVER_GATE not in body:
+            violations.append(
+                f"{rel}:{n}: federation failover in a function that "
+                f"never consults governor.{_FEDOVER_GATE} — the "
+                "failover gate has been dropped"
+            )
+    return violations
+
+
 # Loops whose iterable mentions group membership: `plan.to_delete_groups`,
 # `slicegroup.group_pods(...)`, `members_by_group[g]`, ...
 _GROUP_ITER = re.compile(r"group", re.I)
@@ -210,6 +276,7 @@ def check(pkg: str = PKG) -> list[str]:
                         "annotate `# governed:`/`# ungoverned: <reason>`"
                     )
             violations.extend(_prewarm_violations(rel, text, lines))
+            violations.extend(_fedover_violations(rel, text, lines))
             violations.extend(_group_delete_violations(rel, text, lines))
     return sorted(set(violations))
 

@@ -131,6 +131,14 @@ def pytest_configure(config):
         "deterministic dump/replay (runs in the fast tier; select with "
         "-m gameday)",
     )
+    config.addinivalue_line(
+        "markers",
+        "federation: multi-cluster federation plane suite — cluster "
+        "identity config, snapshot joins with flagged staleness, "
+        "cost-ranked spillover, governor-gated cluster failover, "
+        "cross-cluster KV fills, two-cluster fake-clock sim (runs in "
+        "the fast tier; select with -m federation)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,963 @@
+"""Federation plane: cluster identity config, snapshot joins with
+flagged (never merged) staleness, cost-ranked spillover, governor-gated
+whole-model failover, cross-cluster KV fills, the static failover gate,
+and the two-fake-cluster sim with its tier-1-asserted invariants."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from testutil import http_get
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+sys.path.insert(0, REPO_ROOT)
+
+from benchmarks.federation_sim import (
+    ALL_CHECKS,
+    check_failover_cycle,
+    check_flood_budget_nonvacuous,
+    check_kv_counts,
+    check_no_violations,
+    check_spillover_real,
+    federation_trace,
+    replay,
+    run_sim,
+)
+from kubeai_tpu.config import System
+from kubeai_tpu.config.system import (
+    ClusterConfig,
+    ConfigError,
+    FederationConfig,
+    PeerClusterConfig,
+    load_config_file,
+)
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.disagg.handoff import KVPageExport, serialize_pages
+from kubeai_tpu.federation import (
+    FederationAggregator,
+    FederationKVFiller,
+    FederationPlanner,
+    FederationRouter,
+)
+from kubeai_tpu.federation.router import SERVED_BY_HEADER, SPILLED_HEADER
+from kubeai_tpu.fleet import CapacityPlanner, FleetStateAggregator
+from kubeai_tpu.metrics import Metrics
+from kubeai_tpu.objstore import KVSpillStore
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.routing.loadbalancer import LoadBalancer
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.routing.openai_server import OpenAIServer
+from kubeai_tpu.routing.proxy import ModelProxy, ProxyResult
+from kubeai_tpu.testing import GameDayEvent, GameDayTrace
+from kubeai_tpu.testing.chaos import (
+    EV_CLUSTER_HEAL,
+    EV_CLUSTER_PARTITION,
+    EV_TENANT_FLOOD,
+)
+from kubeai_tpu.testing.clock import FakeClock
+from kubeai_tpu.testing.simkit import mk_model
+
+pytestmark = pytest.mark.federation
+
+
+# ---- the two-cluster sim (the PR's acceptance criteria) ----------------------
+
+
+@pytest.fixture(scope="module")
+def sim():
+    return run_sim()
+
+
+def test_sim_all_invariants_hold(sim):
+    check_no_violations(sim)
+
+
+def test_sim_spillover_exhaustion_gated_and_cost_ranked(sim):
+    check_spillover_real(sim)
+
+
+def test_sim_failover_cycle_bounded(sim):
+    check_failover_cycle(sim)
+
+
+def test_sim_federation_budget_had_teeth(sim):
+    check_flood_budget_nonvacuous(sim)
+
+
+def test_sim_kv_fill_discipline(sim):
+    check_kv_counts(sim)
+
+
+def test_sim_all_checks_is_complete(sim):
+    for check in ALL_CHECKS:
+        check(sim)
+
+
+def test_sim_partition_errors_absorbed_on_the_lost_side_only(sim):
+    """East's control plane (behind the chaos store) erred exactly
+    while partitioned; west's never did; none of it actuated from the
+    east side."""
+    fed = sim["federation"]
+    assert fed["control_errors"]["east"] > 0
+    assert fed["control_errors"]["west"] == 0
+    assert fed["ping_pongs"] == 0
+
+
+def test_sim_replay_is_byte_identical(sim, tmp_path):
+    """Dump -> replay lands on a byte-identical log: the whole
+    two-cluster day (door gossip, spill ranking, failover timing) is a
+    pure function of (trace, seed, ticks)."""
+    fed = sim["federation"]
+    path = tmp_path / "federation.jsonl"
+    fed["log"].dump(str(path))
+    header, fresh = replay(str(path))
+    assert fresh["log"].lines == fed["log"].lines
+    assert fresh["first_violation"] == fed["first_violation"] is None
+
+
+# ---- satellite 1: validated cluster identity config --------------------------
+
+
+def test_cluster_config_defaults_standalone_local():
+    """Backward compat: a config with no cluster/federation block is a
+    standalone cluster named "local" with federation off."""
+    cfg = System().default_and_validate()
+    assert cfg.cluster.name == "local"
+    assert cfg.cluster.peers == []
+    assert cfg.federation.enabled is False
+
+
+def test_cluster_config_file_round_trip(tmp_path):
+    path = tmp_path / "system.json"
+    path.write_text(json.dumps({
+        "cluster": {
+            "name": "us-west4-a",
+            "region": "us-west4",
+            "peers": [
+                {"name": "us-east5-b",
+                 "doorUrl": "http://door.east.example:8000",
+                 "spillUrl": "gs://east-kv-spill",
+                 "rtt": "80ms"},
+            ],
+        },
+        "federation": {
+            "enabled": True,
+            "interval": "2s",
+            "stalenessAfter": "10s",
+            "failoverWindow": "45s",
+            "queueWaitPerRequest": "250ms",
+        },
+    }))
+    cfg = load_config_file(str(path))
+    assert cfg.cluster.name == "us-west4-a"
+    assert cfg.cluster.region == "us-west4"
+    [peer] = cfg.cluster.peers
+    assert peer.name == "us-east5-b"
+    assert peer.door_url == "http://door.east.example:8000"
+    assert peer.spill_url == "gs://east-kv-spill"
+    assert peer.rtt_seconds == pytest.approx(0.08)
+    f = cfg.federation
+    assert f.enabled is True
+    assert f.interval_seconds == 2.0
+    assert f.staleness_seconds == 10.0
+    assert f.failover_window_seconds == 45.0
+    assert f.queue_wait_per_request_seconds == pytest.approx(0.25)
+
+
+@pytest.mark.parametrize("mutate, message", [
+    (lambda c: setattr(c.cluster, "name", "Not_A_Label"), "DNS label"),
+    (lambda c: c.cluster.peers.append(
+        PeerClusterConfig(name="UPPER", door_url="http://x")), "DNS label"),
+    (lambda c: c.cluster.peers.append(
+        PeerClusterConfig(name="local", door_url="http://x")), "shadows"),
+    (lambda c: c.cluster.peers.extend([
+        PeerClusterConfig(name="east", door_url="http://a"),
+        PeerClusterConfig(name="east", door_url="http://b"),
+    ]), "duplicated"),
+    (lambda c: c.cluster.peers.append(
+        PeerClusterConfig(name="east")), "doorUrl is required"),
+    (lambda c: c.cluster.peers.append(
+        PeerClusterConfig(name="east", door_url="http://x",
+                          rtt_seconds=-1.0)), "rtt"),
+    (lambda c: setattr(c.federation, "failover_window_seconds", 0.0),
+     "failoverWindow"),
+    (lambda c: setattr(c.federation, "interval_seconds", -1.0),
+     "interval"),
+])
+def test_cluster_config_validation_refuses(mutate, message):
+    cfg = System()
+    mutate(cfg)
+    with pytest.raises(ConfigError, match=message):
+        cfg.default_and_validate()
+
+
+def _ready_pod(model: str, ip: str) -> dict:
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": f"{model}-0", "namespace": "default",
+                     "labels": {md.POD_MODEL_LABEL: model}},
+        "status": {"phase": "Running", "podIP": ip,
+                   "conditions": [{"type": "Ready", "status": "True"}]},
+    }
+
+
+def _mk_aggregator(store, clock, **kw):
+    return FleetStateAggregator(
+        lb=LoadBalancer(store), model_client=ModelClient(store),
+        store=store, metrics=Metrics(), interval_s=1.0, staleness_s=5.0,
+        fetch_metrics=lambda addr, timeout=5.0: "",
+        fetch_state=lambda addr, timeout=5.0: {"healthy": True},
+        clock=clock, **kw,
+    )
+
+
+def test_fleet_snapshot_stamps_cluster_identity():
+    """Every fleet snapshot carries the cluster identity it was
+    collected in; unstamped aggregators default to "local" (backward
+    compat: single-cluster consumers never see a missing key)."""
+    store = KubeStore()
+    clock = FakeClock(50.0)
+    snap = _mk_aggregator(store, clock, cluster="us-west4-a").collect()
+    assert snap["cluster"] == "us-west4-a"
+    snap_default = _mk_aggregator(store, clock).collect()
+    assert snap_default["cluster"] == "local"
+
+
+# ---- satellite 2: the planner's _priced boot-cost pricing is observable ------
+
+
+class _StubFleet:
+    def __init__(self, snap):
+        self.snap = snap
+
+    def snapshot(self):
+        return self.snap
+
+
+class _CostBook:
+    def __init__(self, costs):
+        self.costs = costs
+
+    def forecast(self, model):
+        cost = self.costs.get(model)
+        if cost is None:
+            return None
+
+        class _F:
+            coldstart_cost_s = cost
+            warm_trigger = False
+            trigger = ""
+            spot_disruptions = 0
+
+            @staticmethod
+            def payload():
+                return {"current": 0.0, "predicted": 0.0,
+                        "coldstart_cost_s": cost}
+        return _F()
+
+
+def _plan_with_costs():
+    store = KubeStore()
+    for name in ("cheap", "pricey"):
+        mk_model(store, name, replicas=1)
+    models = {
+        name: {
+            "pods": {"total": 1, "chips": 1},
+            "replicas": {"unified": 1},
+            "endpoints": {},
+            "queue": {"depth": 0, "oldest_wait_s": 0, "per_class": {}},
+        }
+        for name in ("cheap", "pricey")
+    }
+    snap = {
+        "ts": 1000.0, "models": models,
+        "chips": {"total": 2, "by_shape": {}, "pods_by_shape": {},
+                  "budget": {"total": 2, "by_shape": {}, "nodes_by_shape": {},
+                             "slice_chips": {}}},
+    }
+    planner = CapacityPlanner(
+        fleet=_StubFleet(snap), model_client=ModelClient(store),
+        store=store, metrics=Metrics(), interval_s=1.0, staleness_s=3.0,
+        clock=lambda: 1000.0,
+        forecaster=_CostBook({"cheap": 4.0, "pricey": 300.0}),
+    )
+    plan = planner.tick(force=True)
+    assert plan is not None
+    return planner, plan
+
+
+def test_plan_records_pin_priced_rank():
+    """Regression pin: each plan record carries `priced_rank` — the
+    model's position in its class's `_priced` demand-fill order (0 =
+    most expensive to boot = granted chips first). The federation
+    router prices spillover off these records, so the ordering must
+    stay observable."""
+    _planner, plan = _plan_with_costs()
+    recs = plan["models"]
+    assert recs["pricey"]["priced_rank"] == 0
+    assert recs["cheap"]["priced_rank"] == 1
+    assert recs["pricey"]["coldstart_cost_s"] == 300.0
+    assert recs["cheap"]["coldstart_cost_s"] == 4.0
+
+
+def test_plan_endpoint_surfaces_priced_rank():
+    """`GET /v1/fleet/plan` exposes the same field end to end."""
+    planner, _plan = _plan_with_costs()
+    store = KubeStore()
+    mc = ModelClient(store)
+    metrics = Metrics()
+    server = OpenAIServer(
+        ModelProxy(LoadBalancer(store), mc, metrics=metrics), mc,
+        metrics=metrics, planner=planner,
+    )
+    server.start()
+    try:
+        status, body = http_get(
+            f"127.0.0.1:{server.port}", "/v1/fleet/plan", timeout=30
+        )
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["models"]["pricey"]["priced_rank"] == 0
+        assert payload["models"]["cheap"]["priced_rank"] == 1
+    finally:
+        server.stop()
+
+
+# ---- satellite 3: cluster-level chaos event kinds ----------------------------
+
+
+def test_cluster_event_kinds_validate():
+    GameDayEvent(1.0, EV_CLUSTER_PARTITION, "east", {"duration_s": 30.0})
+    GameDayEvent(2.0, EV_CLUSTER_HEAL, "east")
+    with pytest.raises(ValueError):
+        GameDayEvent(1.0, "cluster_meteor")
+
+
+def test_cluster_events_same_tick_order_and_deliver_once():
+    """Same-instant cluster events apply in authoring order (stable
+    (t, seq) sort) and `due` never re-delivers them."""
+    a = GameDayEvent(5.0, EV_CLUSTER_PARTITION, "east",
+                     {"duration_s": 10.0})
+    b = GameDayEvent(5.0, EV_TENANT_FLOOD, "flooder", {"duration_s": 1.0})
+    c = GameDayEvent(9.0, EV_CLUSTER_HEAL, "east")
+    trace = GameDayTrace([c, a, b])
+    assert [ev.kind for ev in trace.due(5.0)] == [
+        EV_CLUSTER_PARTITION, EV_TENANT_FLOOD,
+    ]
+    assert trace.due(5.0) == []
+    assert [ev.kind for ev in trace.due(9.0)] == [EV_CLUSTER_HEAL]
+    assert trace.due(100.0) == []
+
+
+def test_cluster_events_jsonl_round_trip():
+    trace = federation_trace(3)
+    again = GameDayTrace.from_jsonl(trace.to_jsonl(), seed=trace.seed)
+    assert again.to_jsonl() == trace.to_jsonl()
+    kinds = {ev.kind for ev in again.events}
+    assert {EV_CLUSTER_PARTITION, EV_CLUSTER_HEAL} <= kinds
+
+
+def test_cluster_partition_duration_extends_last_event_t():
+    trace = GameDayTrace([
+        GameDayEvent(10.0, EV_CLUSTER_PARTITION, "east",
+                     {"duration_s": 30.0}),
+    ])
+    assert trace.last_event_t == 40.0
+
+
+def test_gameday_extended_trace_carries_cluster_wave():
+    """The slow-tier game-day soak now ends in a cluster-level
+    partition wave (API dark + door gossip split at once)."""
+    from benchmarks.gameday_sim import extended_trace
+
+    kinds = [ev.kind for ev in extended_trace(0).events]
+    assert EV_CLUSTER_PARTITION in kinds
+    assert EV_CLUSTER_HEAL in kinds
+    assert kinds.index(EV_CLUSTER_PARTITION) < kinds.index(EV_CLUSTER_HEAL)
+
+
+# ---- satellite 4: cross-cluster KVP1 fills -----------------------------------
+
+
+def _page_export(h: str, dtype="float32") -> KVPageExport:
+    shape = (2, 1, 4, 2, 4)
+    k = np.arange(int(np.prod(shape)), dtype=np.float32).reshape(shape)
+    if dtype == "int8":
+        k8 = k.astype(np.int8)
+        scales = np.ones((2, 1, 4, 2), dtype=np.float32)
+        return KVPageExport(
+            prefix_hashes=(h,), page_size=4, dtype="int8",
+            k_pages=k8, v_pages=k8, model="m",
+            k_scales=scales, v_scales=scales,
+        )
+    return KVPageExport(
+        prefix_hashes=(h,), page_size=4, dtype="float32",
+        k_pages=k, v_pages=k + 0.5, model="m",
+    )
+
+
+def _fed_cfg(spill_url="mem://east") -> System:
+    cfg = System()
+    cfg.cluster.name = "west"
+    cfg.cluster.peers = [PeerClusterConfig(
+        name="east", door_url="http://door.east:8000",
+        spill_url=spill_url, rtt_seconds=0.05,
+    )]
+    cfg.federation.enabled = True
+    return cfg.default_and_validate()
+
+
+def test_kv_fill_from_peer_spill_store():
+    """A KVP1 page run published to a peer cluster's spill store fills
+    locally byte-exact (pages, hashes, dtype all survive the hop)."""
+    h = "ab" * 16
+    store = KVSpillStore("")
+    export = _page_export(h)
+    store.put(h, serialize_pages(export))
+    filler = FederationKVFiller(
+        _fed_cfg(), metrics=Metrics(), stores={"east": store},
+    )
+    got = filler.fill(h, expect_dtype="float32")
+    assert got is not None
+    assert got.prefix_hashes == (h,)
+    assert got.dtype == "float32"
+    assert np.array_equal(got.k_pages, export.k_pages)
+    assert np.array_equal(got.v_pages, export.v_pages)
+    assert (filler.fills, filler.refusals, filler.misses) == (1, 0, 0)
+
+
+def test_kv_fill_dtype_mismatch_refuses_never_casts():
+    """A quantized (int8) page run never silently casts into a float32
+    consumer and vice versa: the fill refuses and counts a recompute."""
+    h = "cd" * 16
+    store = KVSpillStore("")
+    store.put(h, serialize_pages(_page_export(h, dtype="int8")))
+    filler = FederationKVFiller(
+        _fed_cfg(), metrics=Metrics(), stores={"east": store},
+    )
+    assert filler.fill(h, expect_dtype="float32") is None
+    assert (filler.fills, filler.refusals, filler.misses) == (0, 1, 1)
+    # The blob itself is untouched int8 — nothing was coerced.
+    assert filler.fill(h, expect_dtype="int8") is not None
+
+
+def test_kv_fill_truncated_blob_degrades_to_counted_recompute():
+    """Mid-transfer peer death = a truncated blob: the fill refuses
+    (header promises more bytes than arrived) and the caller recomputes;
+    nothing crashes, everything is counted."""
+    h = "ef" * 16
+    store = KVSpillStore("")
+    blob = serialize_pages(_page_export(h))
+    store.put(h, blob[: len(blob) // 2])
+    filler = FederationKVFiller(
+        _fed_cfg(), metrics=Metrics(), stores={"east": store},
+    )
+    assert filler.fill(h, expect_dtype="float32") is None
+    assert (filler.fills, filler.refusals, filler.misses) == (0, 1, 1)
+
+
+def test_kv_fill_unreachable_store_is_a_miss():
+    class _DeadStore:
+        def get(self, h):
+            raise ConnectionError("injected: peer objstore unreachable")
+
+    filler = FederationKVFiller(
+        _fed_cfg(), metrics=Metrics(), stores={"east": _DeadStore()},
+    )
+    assert filler.fill("ab" * 16, expect_dtype="float32") is None
+    assert (filler.fills, filler.refusals, filler.misses) == (0, 0, 1)
+
+
+# ---- the federation aggregator: flagged, never merged ------------------------
+
+
+def _two_cluster_fixture(clock):
+    """A west aggregator whose peer fetch reads an east fleet
+    aggregator in-process; returns (fed, cut) where flipping cut[0]
+    severs the link."""
+    west_cfg = _fed_cfg()
+    east_store = KubeStore()
+    mk_model(east_store, "m-east", replicas=1)
+    east_store.create(_ready_pod("m-east", "10.1.0.1"))
+    east = _mk_aggregator(east_store, clock, cluster="east")
+    cut = [False]
+
+    def fetch(peer):
+        if cut[0]:
+            raise ConnectionError("cluster partition")
+        return east.collect()
+
+    west_local = _mk_aggregator(KubeStore(), clock, cluster="west")
+    fed = FederationAggregator(
+        west_cfg, west_local, metrics=Metrics(), clock=clock,
+        fetch_snapshot=fetch,
+    )
+    return fed, cut
+
+
+def test_join_flags_staleness_never_merges():
+    """The cardinal rule end to end: fresh join shows east's models
+    under east's key only; a severed link past the staleness bound
+    flips the flag while the last-good snapshot stays visible."""
+    clock = FakeClock(100.0)
+    fed, cut = _two_cluster_fixture(clock)
+    snap = fed.join()
+    assert snap["cluster"] == "west"
+    east_entry = snap["clusters"]["east"]
+    assert east_entry["stale"] is False
+    assert "m-east" in east_entry["snapshot"]["models"]
+    assert "m-east" not in (
+        snap["clusters"]["west"]["snapshot"]["models"]
+    )
+    assert fed.stale_since("east") is None
+
+    cut[0] = True
+    clock.advance(fed.staleness_s + 1.0)
+    snap2 = fed.join()
+    east2 = snap2["clusters"]["east"]
+    assert east2["stale"] is True
+    assert east2["error"]
+    # Flagged, NOT dropped: the failover planner still reads what the
+    # lost cluster was serving.
+    assert "m-east" in (east2["snapshot"] or {}).get("models", {})
+    assert "m-east" in fed.peer_models("east")
+    assert fed.stale_since("east") is not None
+    assert fed.cluster_stale("east") is True
+
+    cut[0] = False
+    snap3 = fed.join()
+    assert snap3["clusters"]["east"]["stale"] is False
+    assert fed.stale_since("east") is None
+
+
+def test_unknown_cluster_is_stale_by_definition():
+    clock = FakeClock(100.0)
+    fed, _cut = _two_cluster_fixture(clock)
+    assert fed.cluster_stale("nowhere") is True
+    assert fed.peer_models("nowhere") == {}
+
+
+def test_state_payload_joins_when_empty():
+    clock = FakeClock(100.0)
+    fed, _cut = _two_cluster_fixture(clock)
+    payload = fed.state_payload()
+    assert payload["object"] == "federation.state"
+    assert set(payload["clusters"]) == {"west", "east"}
+
+
+# ---- the federation router: exhaustion-gated, cost-ranked --------------------
+
+
+class _StubPlanner:
+    def __init__(self, record):
+        self.record = record
+
+    def current_plan(self):
+        if self.record is None:
+            return None
+        return {"models": {"m": self.record}}
+
+
+class _StubFederation:
+    def __init__(self, stale=False, peer_replicas=1, cluster="west"):
+        self.stale = stale
+        self.peer_replicas = peer_replicas
+        self.cluster = cluster
+
+    def cluster_stale(self, name):
+        return self.stale
+
+    def peer_models(self, name):
+        return {"m": {"replicas": {"unified": self.peer_replicas}}}
+
+
+def _router(record, *, stale=False, peer_replicas=1, dispatch=None,
+            metrics=None):
+    cfg = _fed_cfg()
+    calls = []
+
+    def default_dispatch(peer, path, body, headers):
+        calls.append((peer.name, path, list(headers)))
+        return ProxyResult(200, [("content-type", "application/json")],
+                           iter(()))
+
+    r = FederationRouter(
+        cfg, planner=_StubPlanner(record),
+        federation=_StubFederation(stale=stale,
+                                   peer_replicas=peer_replicas),
+        metrics=metrics or Metrics(), clock=lambda: 0.0,
+        dispatch=dispatch or default_dispatch,
+    )
+    return r, calls
+
+
+_EXHAUSTED = {
+    "throttled_replicas": 1, "queue_depth": 10,
+    "queue_oldest_wait_s": 2.0, "coldstart_cost_s": 6.0,
+}
+
+
+def test_spill_requires_exhaustion():
+    r, calls = _router({**_EXHAUSTED, "throttled_replicas": 0})
+    assert r.maybe_spill("m", "/p", b'{"model":"m"}', []) is None
+    assert calls == []
+
+
+def test_spill_requires_peer_cheaper():
+    """Deep local queue spills; an idle local queue stays home even
+    when throttled (RTT isn't worth it)."""
+    r, calls = _router(_EXHAUSTED)
+    out = r.maybe_spill("m", "/p", b'{"model":"m"}', [("x-kubeai-tenant", "t")])
+    assert out is not None
+    assert ("x-kubeai-served-by-cluster", "east") in [
+        (k, v) for k, v in out.headers
+    ]
+    assert len(calls) == 1
+    # Tenancy headers forwarded intact + the one-hop stamp added.
+    sent = calls[0][2]
+    assert ("x-kubeai-tenant", "t") in sent
+    assert any(k == SPILLED_HEADER for k, _v in sent)
+
+    idle = {**_EXHAUSTED, "queue_depth": 0, "queue_oldest_wait_s": 0.0}
+    r2, calls2 = _router(idle)
+    assert r2.maybe_spill("m", "/p", b'{"model":"m"}', []) is None
+    assert calls2 == []
+
+
+def test_boot_cost_prices_out_cold_peers():
+    """A peer with no live replica pays the model's MEASURED boot cost
+    in the ranking: a 240 s model never spills to a cold cluster, a
+    6 s model still does."""
+    giant = {**_EXHAUSTED, "coldstart_cost_s": 240.0}
+    r, calls = _router(giant, peer_replicas=0)
+    assert r.maybe_spill("m", "/p", b'{"model":"m"}', []) is None
+    assert calls == []
+    [(cost, _peer)] = r.rank("m", giant)
+    assert cost == pytest.approx(240.05)
+
+    small = {**_EXHAUSTED, "coldstart_cost_s": 1.0}
+    r2, calls2 = _router(small, peer_replicas=0)
+    assert r2.maybe_spill("m", "/p", b'{"model":"m"}', []) is not None
+    assert len(calls2) == 1
+
+
+def test_stale_peer_is_not_a_spill_target():
+    r, calls = _router(_EXHAUSTED, stale=True)
+    assert r.maybe_spill("m", "/p", b'{"model":"m"}', []) is None
+    assert calls == []
+    assert r.rank("m", _EXHAUSTED) == []
+
+
+def test_spilled_request_is_never_respilled():
+    r, calls = _router(_EXHAUSTED)
+    out = r.maybe_spill(
+        "m", "/p", b'{"model":"m"}', [(SPILLED_HEADER, "east")]
+    )
+    assert out is None
+    assert calls == []
+
+
+def test_dispatch_failure_degrades_to_local():
+    def boom(peer, path, body, headers):
+        raise ConnectionError("injected: peer door unreachable")
+
+    metrics = Metrics()
+    r, _calls = _router(_EXHAUSTED, dispatch=boom, metrics=metrics)
+    assert r.maybe_spill("m", "/p", b'{"model":"m"}', []) is None
+    assert metrics.federation_spill_errors.get(cluster="east") == 1.0
+
+
+def test_no_plan_or_unknown_model_stays_home():
+    r, calls = _router(None)
+    assert r.maybe_spill("m", "/p", b'{"model":"m"}', []) is None
+    r2, _ = _router(_EXHAUSTED)
+    assert r2.maybe_spill("other", "/p", b'{"model":"other"}', []) is None
+    assert calls == []
+
+
+def test_model_of_extraction():
+    assert FederationRouter.model_of(b'{"model": "m"}') == "m"
+    assert FederationRouter.model_of(b"not json") == ""
+    assert FederationRouter.model_of(b"") == ""
+
+
+# ---- the federation planner: governor-gated failover -------------------------
+
+
+class _StubFedState:
+    """Minimal federation surface for the planner: one peer whose
+    staleness the test scripts directly."""
+
+    def __init__(self, models):
+        self.models = models
+        self._stale_since = None
+        self._stale = False
+
+    def set_stale(self, since):
+        self._stale_since = since
+        self._stale = since is not None
+
+    def stale_since(self, name):
+        return self._stale_since
+
+    def cluster_stale(self, name):
+        return self._stale
+
+    def peer_models(self, name):
+        return self.models
+
+
+class _AllowAll:
+    def allow_federation_failover(self, model):
+        return True
+
+
+class _DenyAll:
+    def allow_federation_failover(self, model):
+        return False
+
+
+def _fed_planner(store, fedstate, governor, clock):
+    return FederationPlanner(
+        _fed_cfg(), federation=fedstate, store=store, governor=governor,
+        metrics=Metrics(), clock=clock,
+    )
+
+
+def _west_store_with(name="hot"):
+    store = KubeStore()
+    mk_model(store, name, replicas=1)
+    return store
+
+
+def test_failover_waits_out_the_window_then_stamps():
+    """One staleness blip never moves a model; a full window does —
+    and the annotation names the source cluster (the durable record a
+    capacity consumer honors as extra demand)."""
+    clock = FakeClock(100.0)
+    store = _west_store_with("hot")
+    fed = _StubFedState({
+        "hot": {"replicas": {"unified": 2}},
+        "m-east": {"replicas": {"unified": 1}},  # not deployed locally
+        "idle": {"replicas": {}},                # peer wasn't serving it
+    })
+    p = _fed_planner(store, fed, _AllowAll(), clock)
+
+    fed.set_stale(clock())
+    assert p.tick() == {"failed_over": [], "failed_back": [], "denied": []}
+    clock.advance(p.window_s + 0.1)
+    actions = p.tick()
+    assert actions["failed_over"] == ["hot"]
+    assert p.failed_over == {"hot": "east"}
+    ann = store.get("Model", "default", "hot")["metadata"]["annotations"]
+    assert ann[md.FEDERATION_FAILOVER_ANNOTATION] == "east"
+    # Idempotent: the next tick does not re-stamp.
+    assert p.tick()["failed_over"] == []
+
+
+def test_failback_on_heal_clears_the_annotation():
+    clock = FakeClock(100.0)
+    store = _west_store_with("hot")
+    fed = _StubFedState({"hot": {"replicas": {"unified": 2}}})
+    p = _fed_planner(store, fed, _AllowAll(), clock)
+    fed.set_stale(clock())
+    clock.advance(p.window_s + 0.1)
+    p.tick()
+    assert p.failed_over == {"hot": "east"}
+
+    fed.set_stale(None)
+    actions = p.tick()
+    assert actions["failed_back"] == ["hot"]
+    assert p.failed_over == {}
+    ann = (store.get("Model", "default", "hot")["metadata"]
+           .get("annotations") or {})
+    assert md.FEDERATION_FAILOVER_ANNOTATION not in ann
+
+
+def test_denied_failover_writes_nothing():
+    """The governor's verdict is binding: a denial leaves the store
+    untouched and counts the denial."""
+    clock = FakeClock(100.0)
+    store = _west_store_with("hot")
+    fed = _StubFedState({"hot": {"replicas": {"unified": 2}}})
+    p = _fed_planner(store, fed, _DenyAll(), clock)
+    fed.set_stale(clock())
+    clock.advance(p.window_s + 0.1)
+    actions = p.tick()
+    assert actions["denied"] == ["hot"]
+    assert p.failed_over == {}
+    ann = (store.get("Model", "default", "hot")["metadata"]
+           .get("annotations") or {})
+    assert md.FEDERATION_FAILOVER_ANNOTATION not in ann
+    assert p.metrics.federation_failover_denied.get(model="hot") == 1.0
+
+
+def test_failover_skips_models_this_cluster_never_deployed():
+    clock = FakeClock(100.0)
+    store = _west_store_with("hot")  # no "m-east" here
+    fed = _StubFedState({"m-east": {"replicas": {"unified": 1}}})
+    p = _fed_planner(store, fed, _AllowAll(), clock)
+    fed.set_stale(clock())
+    clock.advance(p.window_s + 0.1)
+    assert p.tick()["failed_over"] == []
+    assert p.failed_over == {}
+
+
+def test_partitioned_local_store_cannot_actuate():
+    """The promoted api_partition seen from the LOST side: with its own
+    store unreachable the planner cannot even verify local deployment,
+    so it skips — a partitioned cluster never takes over anyone."""
+    class _DeadStore:
+        def get(self, *a):
+            raise ConnectionError("injected: api server unreachable")
+
+        def patch_merge(self, *a, **k):
+            raise AssertionError("must never be reached")
+
+    clock = FakeClock(100.0)
+    fed = _StubFedState({"hot": {"replicas": {"unified": 2}}})
+    p = _fed_planner(_DeadStore(), fed, _AllowAll(), clock)
+    fed.set_stale(clock())
+    clock.advance(p.window_s + 0.1)
+    assert p.tick()["failed_over"] == []
+    assert p.failed_over == {}
+
+
+# ---- the federation state endpoint -------------------------------------------
+
+
+def test_federation_state_endpoint_real_http():
+    """GET /v1/federation/state serves the joined snapshot plus the
+    failover ledger; 404 with a clear error when federation is off."""
+    clock = FakeClock(100.0)
+    fed, _cut = _two_cluster_fixture(clock)
+    store = KubeStore()
+    mc = ModelClient(store)
+    metrics = Metrics()
+    server = OpenAIServer(
+        ModelProxy(LoadBalancer(store), mc, metrics=metrics), mc,
+        metrics=metrics,
+    )
+    server.federation = fed
+    server.federation_planner = FederationPlanner(
+        _fed_cfg(), federation=fed, store=store, governor=_AllowAll(),
+        metrics=metrics, clock=clock,
+    )
+    server.start()
+    try:
+        status, body = http_get(
+            f"127.0.0.1:{server.port}", "/v1/federation/state", timeout=30
+        )
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["object"] == "federation.state"
+        assert set(payload["clusters"]) == {"west", "east"}
+        assert payload["failovers"]["object"] == "federation.failovers"
+        assert payload["failovers"]["failed_over"] == {}
+    finally:
+        server.stop()
+
+    bare = OpenAIServer(
+        ModelProxy(LoadBalancer(store), mc, metrics=metrics), mc,
+        metrics=metrics,
+    )
+    bare.start()
+    try:
+        status, body = http_get(
+            f"127.0.0.1:{bare.port}", "/v1/federation/state", timeout=30
+        )
+        assert status == 404
+        assert b"federation not configured" in body
+    finally:
+        bare.stop()
+
+
+# ---- satellite 6: the static failover gate, both directions ------------------
+
+
+def _load_gate():
+    path = os.path.join(REPO_ROOT, "scripts", "check_actuation_paths.py")
+    spec = importlib.util.spec_from_file_location(
+        "check_actuation_paths", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_gate_is_clean_on_the_real_tree():
+    assert _load_gate().check() == []
+
+
+def test_gate_catches_failover_write_outside_the_planner(tmp_path):
+    """Drift direction 1: a new call site stamping the failover
+    annotation anywhere but the federation planner fails the gate; a
+    reviewed pragma passes."""
+    pkg = tmp_path / "kubeai_tpu"
+    pkg.mkdir()
+    (pkg / "rogue_failover.py").write_text(
+        "from kubeai_tpu.crd import metadata as md\n"
+        "def f(store):\n"
+        "    store.patch_merge('Model', 'ns', 'm', {'metadata': {\n"
+        "        'annotations': {md.FEDERATION_FAILOVER_ANNOTATION: 'x'}\n"
+        "    }})\n"
+    )
+    (pkg / "reviewed.py").write_text(
+        "from kubeai_tpu.crd import metadata as md\n"
+        "def f(store):\n"
+        "    # ungoverned: reviewed test site\n"
+        "    store.patch_merge('Model', 'ns', 'm', {'metadata': {\n"
+        "        'annotations': {md.FEDERATION_FAILOVER_ANNOTATION: 'x'}\n"
+        "    }})\n"
+    )
+    violations = _load_gate().check(pkg=str(pkg))
+    assert len(violations) == 1
+    assert "rogue_failover.py" in violations[0]
+    assert "allow_federation_failover" in violations[0]
+
+
+def test_gate_catches_dropped_governor_consult(tmp_path):
+    """Drift direction 2: the planner's own write site losing its
+    `allow_federation_failover` consultation fails the gate; the gated
+    shape passes."""
+    pkg = tmp_path / "kubeai_tpu"
+    (pkg / "federation").mkdir(parents=True)
+    (pkg / "federation" / "planner.py").write_text(
+        "from kubeai_tpu.crd import metadata as md\n"
+        "class P:\n"
+        "    def gated(self, store, model):\n"
+        "        if self.governor.allow_federation_failover(model):\n"
+        "            store.patch_merge('Model', 'ns', model, {\n"
+        "                'metadata': {'annotations': {\n"
+        "                    md.FEDERATION_FAILOVER_ANNOTATION: 'src'\n"
+        "                }}})\n"
+        "    def dropped(self, store, model):\n"
+        "        store.patch_merge('Model', 'ns', model, {\n"
+        "            'metadata': {'annotations': {\n"
+        "                md.FEDERATION_FAILOVER_ANNOTATION: 'src'\n"
+        "            }}})\n"
+    )
+    violations = _load_gate().check(pkg=str(pkg))
+    assert len(violations) == 1
+    assert "planner.py" in violations[0]
+    assert "allow_federation_failover" in violations[0]
+
+
+def test_gate_reads_of_the_annotation_do_not_trip(tmp_path):
+    """Reading the annotation (no colon — not a patch key) is not an
+    actuation, so observers outside the planner stay clean."""
+    pkg = tmp_path / "kubeai_tpu"
+    pkg.mkdir()
+    (pkg / "reader.py").write_text(
+        "from kubeai_tpu.crd import metadata as md\n"
+        "def f(model):\n"
+        "    anns = model['metadata'].get('annotations') or {}\n"
+        "    return anns.get(md.FEDERATION_FAILOVER_ANNOTATION)\n"
+    )
+    assert _load_gate().check(pkg=str(pkg)) == []
