@@ -73,6 +73,7 @@ from kubeai_tpu.operator.controller import ModelReconciler
 from kubeai_tpu.operator.governor import ActuationGovernor
 from kubeai_tpu.operator.k8s.store import KubeStore
 from kubeai_tpu.operator import slicegroup
+from kubeai_tpu.operator.rollout import RolloutController
 from kubeai_tpu.routing.loadbalancer import (
     Group,
     LoadBalancer,
@@ -84,6 +85,7 @@ from kubeai_tpu.testing.chaos import (
     CONTINUOUS,
     EV_API_PARTITION,
     EV_API_STORM,
+    EV_BAD_ROLLOUT,
     EV_CHIP_FLIP,
     EV_CLUSTER_HEAL,
     EV_CLUSTER_PARTITION,
@@ -285,6 +287,15 @@ class GameDayWorld:
             self.api, cfg, metrics=self.metrics, clock=self.clock,
             wall=self.wall, governor=self.governor,
         )
+        # Progressive-delivery plane: paces the pod plan for models
+        # carrying a rollout: block (the bad_rollout chaos event opts a
+        # model in mid-run) and rolls a judged-bad hash back.
+        self.rollout = RolloutController(
+            store=self.api, lb=self.lb, fleet=self.aggregator,
+            governor=self.governor, metrics=self.metrics,
+            clock=self.clock,
+        )
+        self.reconciler.rollout = self.rollout
         self.scaler = Autoscaler(
             self.api, cfg, self.mc, self.lb, AlwaysLeader(),
             metrics=self.metrics,
@@ -355,6 +366,10 @@ class GameDayWorld:
         self.flood_admitted: dict[str, int] = {}  # tenant -> admissions
         self.stale_until = float("-inf")
         self.spot_removed: list[dict] = []   # removed Node objects (restorable)
+        # model -> {"mode", "good": {pre-event pod hashes}} for the
+        # bad_rollout event: new-hash pods of a wedged revision never
+        # boot, so the rollout judge must condemn them.
+        self.bad_rollout: dict[str, dict] = {}
 
         # -- measurement.
         self.log = GameDayLog(
@@ -463,6 +478,13 @@ class GameDayWorld:
                     "containerStatuses"
                 ):
                     continue
+                br = self.bad_rollout.get(model)
+                if br and br["mode"] == "wedged":
+                    h = pod["metadata"].get("labels", {}).get(
+                        md.POD_HASH_LABEL
+                    )
+                    if h and h not in br["good"]:
+                        continue  # the bad revision never comes up
                 name = pod["metadata"]["name"]
                 born = self.first_seen.setdefault(name, self.tick_no)
                 if self.tick_no - born < BOOT_TICKS:
@@ -596,6 +618,8 @@ class GameDayWorld:
                     node["metadata"].pop("uid", None)
                     self.raw_store.create(node)
                     self.spot_nodes.append(node["metadata"]["name"])
+        elif ev.kind == EV_BAD_ROLLOUT:
+            self._ship_bad_rollout(ev.target or "rt", p)
         elif ev.kind == EV_TELEMETRY_STALE:
             self.stale_until = self.rel_now() + float(
                 p.get("duration_s", 5.0)
@@ -633,6 +657,32 @@ class GameDayWorld:
                     "addr": addr, "fault": fault,
                     "until": self.rel_now() + float(p.get("duration_s", 3.0)),
                 })
+
+    def _ship_bad_rollout(self, model: str, p: dict) -> None:
+        """An operator ships a bad spec revision: opt the model into a
+        canary rollout and stamp a spec marker that changes the rendered
+        pod hash. Mode "wedged" (default) keeps every new-hash pod from
+        ever booting, so the judge's crashloop verdict must pin the old
+        hash back — with zero client-visible impact meanwhile."""
+        self.bad_rollout[model] = {
+            "mode": p.get("mode", "wedged"),
+            "good": {
+                pod["metadata"].get("labels", {}).get(md.POD_HASH_LABEL)
+                for pod in self._pods(model)
+            },
+        }
+        obj = self.raw_store.get("Model", "default", model)
+        spec = obj["spec"]
+        spec["rollout"] = {
+            "strategy": "canary",
+            "canaryPercent": float(p.get("canary_percent", 40.0)),
+            "stepSeconds": float(p.get("step_seconds", 4.0)),
+            "judge": {"windowSeconds": float(p.get("window_s", 3.0))},
+        }
+        env = dict(spec.get("env") or {})
+        env["BAD_ROLLOUT_REV"] = str(p.get("revision", 1))
+        spec["env"] = env
+        self.raw_store.update(obj)
 
     def _kill_one(self, model: str, mode: str, victim: str) -> None:
         pods = [p for p in self._pods(model) if self._is_ready(p)]
@@ -853,7 +903,8 @@ class GameDayWorld:
                 self.aggregator.collect()
             except Exception:
                 self.control_plane_errors += 1
-        for step in (self.scaler.tick, self._planner_tick):
+        for step in (self.scaler.tick, self._planner_tick,
+                     self.rollout.tick):
             try:
                 step()
             except (ApiServerUnreachable, ApiServerError):
@@ -890,6 +941,14 @@ class GameDayWorld:
             kinds.add("door_partition")
         if self.spot_removed:
             kinds.add("chip_flip")
+        for model, br in self.bad_rollout.items():
+            if any(
+                pod["metadata"].get("labels", {}).get(md.POD_HASH_LABEL)
+                not in br["good"]
+                for pod in self._pods(model)
+            ):
+                kinds.add("bad_rollout")
+                break
         for model in MODELS:
             spec = self.raw_store.get("Model", "default", model)["spec"]
             if len(self._ready_addrs(model)) < int(
@@ -1260,8 +1319,9 @@ def fast_trace(seed: int = 0) -> GameDayTrace:
 def extended_trace(seed: int = 0) -> GameDayTrace:
     """Two full chaos rounds back to back, capped by a cluster-level
     partition wave (api_partition promoted to the whole cluster: API
-    dark AND the door gossip plane split at once) — the slow-tier
-    soak."""
+    dark AND the door gossip plane split at once) and a bad-rollout
+    wave (a wedged spec revision ships through the progressive-delivery
+    plane and must be rolled back) — the slow-tier soak."""
     base = fast_trace(seed).events
     second = [
         GameDayEvent(ev.t + 45.0, ev.kind, ev.target, dict(ev.params))
@@ -1271,6 +1331,7 @@ def extended_trace(seed: int = 0) -> GameDayTrace:
         GameDayEvent(95.0, EV_CLUSTER_PARTITION, "",
                      {"duration_s": 30.0}),
         GameDayEvent(101.0, EV_CLUSTER_HEAL, "", {}),
+        GameDayEvent(106.0, EV_BAD_ROLLOUT, "rt", {"mode": "wedged"}),
     ]
     return GameDayTrace(list(base) + second + wave, seed=seed)
 
@@ -1470,7 +1531,12 @@ def main(argv=None) -> int:
         # Flight-recorder incident bundles share the GameDayLog format
         # but replay through the sim named in their header, not the
         # game-day trace machinery.
-        if json.loads(original[0]).get("bundle") == "incident":
+        header = json.loads(original[0])
+        if header.get("bundle") == "incident":
+            if header.get("sim") == "rollout_sim":
+                from benchmarks import rollout_sim
+
+                return rollout_sim.replay_main(args.replay)
             from benchmarks import slo_incident_sim
 
             return slo_incident_sim.replay_main(args.replay)

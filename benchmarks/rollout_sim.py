@@ -1,0 +1,961 @@
+#!/usr/bin/env python
+"""Deterministic progressive-rollout simulation — no JAX, no sockets.
+
+A model opted into `rollout: {strategy: canary}` takes a spec edit on a
+fake clock, and the REAL control plane carries it end to end: the
+`ModelReconciler` renders the new pod hash, `RolloutController` paces
+`calculate_pod_plan(max_new=...)` through canary -> ramp -> complete,
+the `LoadBalancer` enforces the canary's traffic share at routing time,
+scripted per-endpoint TTFT expositions feed the real
+`FleetStateAggregator` (whose per-version split is the judge's
+evidence), every step asks the real `ActuationGovernor`, and a judged
+failure pins the last-good hash back via `kubeai.org/rollout-pinned-hash`
+while the real `FlightRecorder` dumps a replayable `rollout_rollback`
+incident bundle.
+
+Four scenarios, each a one-event `bad_rollout` chaos trace:
+
+  clean     — the new revision is healthy: the rollout completes, every
+              replica ends on the new hash, zero rollbacks.
+  latency   — the new revision's TTFT is regressed: the comparative
+              judge condemns it, and the rollback lands before the bad
+              version ever serves more than its canary traffic share.
+  crashloop — the new revision never becomes Ready: the judge's
+              crashloop verdict rolls back a version that never served
+              a single request.
+  group     — a multi-host model (slice groups) rolls ONE group per
+              stepSeconds, each group recreated atomically.
+
+Invariants (asserted in tier-1 by tests/unit/test_rollout_sim.py):
+
+  * zero client-visible stream errors in every scenario — old-hash
+    capacity keeps serving throughout;
+  * the bad version's measured traffic share never exceeds
+    canaryPercent + epsilon (and a crash-looping canary serves NOTHING);
+  * auto-rollback lands within judge.windowSeconds + stepSeconds +
+    slack of the bad revision shipping;
+  * the clean rollout reaches 100% new-hash and forgets itself;
+  * worlds the rollout plane must NOT touch (single replica, or no
+    `rollout:` block) produce byte-identical pod plans with and without
+    the controller wired — the classic surge path is regression-pinned;
+  * dump -> replay is byte-identical, for both the run log and the
+    `rollout_rollback` incident bundle (which is what
+    `python -m benchmarks.gameday_sim --replay <bundle>` dispatches to
+    when the bundle header names this sim).
+
+Run directly for a human-readable report:
+
+    python benchmarks/rollout_sim.py [--scenario all|clean|latency|...]
+    python benchmarks/rollout_sim.py --scenario latency --dump-bundle /tmp/rb.jsonl
+    python -m benchmarks.gameday_sim --replay /tmp/rb.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeai_tpu.config import System
+from kubeai_tpu.config.system import GovernorConfig
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import Rollout, RolloutJudge
+from kubeai_tpu.fleet import FleetStateAggregator
+from kubeai_tpu.metrics import Metrics, flightrecorder
+from kubeai_tpu.metrics.flightrecorder import FlightRecorder
+from kubeai_tpu.operator import slicegroup
+from kubeai_tpu.operator.controller import ModelReconciler
+from kubeai_tpu.operator.governor import ActuationGovernor
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.operator.rollout import RolloutController
+from kubeai_tpu.routing.health import OUTCOME_SUCCESS
+from kubeai_tpu.routing.loadbalancer import (
+    Group,
+    LoadBalancer,
+    LoadBalancerTimeout,
+    NoHealthyEndpoints,
+)
+from kubeai_tpu.routing.modelclient import ModelClient
+from kubeai_tpu.testing.chaos import (
+    CONTINUOUS,
+    EV_BAD_ROLLOUT,
+    TERMINAL,
+    GameDayEvent,
+    GameDayLog,
+    GameDayTrace,
+    Invariant,
+    InvariantChecker,
+)
+from kubeai_tpu.testing.clock import FakeClock
+from kubeai_tpu.testing.simkit import mk_model
+
+SIM_NAME = "rollout_sim"
+MODEL = "m0"
+REPLICAS = 4
+CANARY_PERCENT = 25.0          # -> a one-replica canary step
+STEP_SECONDS = 6.0
+JUDGE_WINDOW_S = 4.0
+TTFT_RATIO = 1.5
+
+TICK_S = 1.0
+WARMUP_TICKS = 8               # steady state before the trace's t=0
+BOOT_TICKS = 2                 # created pod -> Ready
+MUTATE_T = 2.0                 # when the bad revision ships (rel time)
+REQS_PER_TICK = 20             # synthetic client picks through the LB
+OBS_PER_TICK = 6               # TTFT observations per endpoint per tick
+HEALTHY_TTFT = 0.2             # lands in the 0.25 bucket (p95 0.25s)
+REGRESSED_TTFT = 0.8           # lands in the 1.0 bucket (p95 1.0s)
+
+SHARE_EPS = 0.05               # integer-rounding slack on the share cap
+# Mutation -> rollback deadline: one judge window after the canary
+# step, plus the step dwell, plus boot/scrape/tick latency slack.
+ROLLBACK_SLACK_S = 8.0
+ROLLBACK_BOUND_S = JUDGE_WINDOW_S + STEP_SECONDS + ROLLBACK_SLACK_S
+
+# Multi-host (slice group) scenario: two 2-host groups on 4x4 slices.
+ACCEL = "tpu-v5-lite-podslice"
+TOPOLOGY = "4x4"
+GROUP_PROFILE = "google-tpu-v5e-4x4:8"
+NUM_HOSTS = 2
+CHIPS_PER_HOST = 8
+GROUP_REPLICAS = 2
+SLICES = 3
+
+SCENARIOS = ("clean", "latency", "crashloop", "group")
+DEFAULT_TICKS = {"clean": 45, "latency": 30, "crashloop": 30, "group": 30}
+
+
+def scenario_trace(scenario: str, seed: int = 0) -> GameDayTrace:
+    """One bad_rollout event: a spec revision ships at MUTATE_T. The
+    mode rides the event so a dumped log replays the same failure."""
+    return GameDayTrace([
+        GameDayEvent(MUTATE_T, EV_BAD_ROLLOUT, MODEL,
+                     {"mode": scenario}),
+    ], seed=seed)
+
+
+def _rollout_spec() -> Rollout:
+    return Rollout(
+        strategy="canary",
+        canary_percent=CANARY_PERCENT,
+        step_seconds=STEP_SECONDS,
+        judge=RolloutJudge(
+            window_seconds=JUDGE_WINDOW_S,
+            ttft_p95_ratio=TTFT_RATIO,
+            max_breaker_trips=0,
+        ),
+    )
+
+
+def _node(name: str) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {
+            "name": name,
+            "labels": {
+                "cloud.google.com/gke-tpu-accelerator": ACCEL,
+                "cloud.google.com/gke-tpu-topology": TOPOLOGY,
+            },
+        },
+        "status": {"allocatable": {"google.com/tpu": str(CHIPS_PER_HOST)}},
+    }
+
+
+def _pod_hash_of(pod: dict) -> str:
+    return pod["metadata"].get("labels", {}).get(md.POD_HASH_LABEL) or ""
+
+
+class RolloutWorld:
+    """Real control plane + scripted engines around one rolling model.
+    The kubelet is deliberately dumb: assign an IP, flip Ready after
+    BOOT_TICKS — and in the crashloop scenario, never boot a new-hash
+    pod at all."""
+
+    def __init__(self, scenario: str, ticks: int, seed: int = 0):
+        if scenario not in SCENARIOS:
+            raise ValueError(f"unknown scenario {scenario!r}")
+        self.scenario = scenario
+        self.multi = scenario == "group"
+        self.replicas = GROUP_REPLICAS if self.multi else REPLICAS
+        # pods per replica differs: a slice-group replica is NUM_HOSTS pods
+        self.expected_pods = self.replicas * (NUM_HOSTS if self.multi else 1)
+        self.ticks = int(ticks)
+        self.seed = int(seed)
+        self.trace = scenario_trace(scenario, seed)
+        self.clock = FakeClock(1000.0)
+        self.wall = FakeClock(1_000_000.0)
+        self.tick_no = 0
+        self.t0 = self.clock() + WARMUP_TICKS * TICK_S
+
+        self._name_counter = itertools.count()
+        self.store = KubeStore(
+            namegen=lambda: f"{next(self._name_counter):06d}"
+        )
+        self.metrics = Metrics()
+
+        cfg = System()
+        cfg.fixed_self_metric_addrs = ["self:1"]
+        cfg.default_and_validate()
+        self.cfg = cfg
+
+        if self.multi:
+            for s in range(SLICES):
+                for h in range(NUM_HOSTS):
+                    self.store.create(_node(f"node-s{s}-h{h}"))
+            mk_model(
+                self.store, MODEL, replicas=self.replicas,
+                resource_profile=GROUP_PROFILE,
+                autoscaling_disabled=True, rollout=_rollout_spec(),
+            )
+        else:
+            mk_model(
+                self.store, MODEL, replicas=self.replicas,
+                autoscaling_disabled=True, rollout=_rollout_spec(),
+            )
+
+        self.lb = LoadBalancer(self.store, metrics=self.metrics)
+        self.lb._groups[MODEL] = Group(
+            metrics=self.metrics, model=MODEL, clock=self.clock
+        )
+
+        self.mc = ModelClient(self.store)
+        self.aggregator = FleetStateAggregator(
+            lb=self.lb, model_client=self.mc, store=self.store,
+            metrics=self.metrics, interval_s=1.0, staleness_s=2.5,
+            fetch_metrics=self.fetch_metrics, fetch_state=self.fetch_state,
+            clock=self.clock,
+        )
+
+        gcfg = GovernorConfig(
+            window_seconds=10.0,
+            model_disruption_budget=6,
+            cluster_disruption_budget=12,
+            min_telemetry_coverage=0.9,
+        )
+        self.governor = ActuationGovernor(
+            cfg=gcfg, fleet=self.aggregator, store=self.store,
+            metrics=self.metrics, clock=self.clock,
+        )
+
+        self.recorder = FlightRecorder(
+            clock=self.clock,
+            tick_fn=lambda: self.tick_no,
+            min_trigger_interval_s=300.0,
+        )
+        self.recorder.replay_context = {
+            "sim": SIM_NAME, "seed": self.seed, "ticks": self.ticks,
+            "scenario": scenario,
+        }
+        self.lb.set_recorder(self.recorder)
+
+        self.reconciler = ModelReconciler(
+            self.store, cfg, metrics=self.metrics, clock=self.clock,
+            wall=self.wall, governor=self.governor,
+        )
+        self.rollout = RolloutController(
+            store=self.store, lb=self.lb, fleet=self.aggregator,
+            governor=self.governor, recorder=self.recorder,
+            metrics=self.metrics, clock=self.clock,
+        )
+        self.reconciler.rollout = self.rollout
+
+        # -- scripted data plane.
+        self.addr_model: dict[str, str] = {}
+        self.addr_hash: dict[str, str] = {}
+        self.obs: dict[str, dict] = {}       # addr -> {"good","bad"}
+        self.first_seen: dict[str, int] = {}
+        self.ip_counter = 1
+
+        # -- measured facts.
+        self.mode: str | None = None         # set by the trace event
+        self.good_hashes: set[str] = set()
+        self.mutate_rel: float | None = None
+        self.rollback_rel: float | None = None
+        self.total_picks = 0
+        self.bad_picks = 0
+        self.client_errors = 0
+
+        self.log = GameDayLog(
+            self.trace, ticks,
+            extra={"sim": SIM_NAME, "scenario": scenario, "seed": self.seed},
+        )
+        self.checker = InvariantChecker(
+            invariants_for(scenario), log=self.log
+        )
+
+    # ---- time / telemetry ----------------------------------------------
+
+    def rel_now(self) -> float:
+        return self.clock() - self.t0
+
+    def _regressed(self, addr: str) -> bool:
+        return (
+            self.mode == "latency"
+            and self.addr_hash.get(addr, "") not in self.good_hashes
+        )
+
+    def fetch_metrics(self, addr: str, timeout: float = 5.0) -> str:
+        rec = self.obs.get(addr)
+        if rec is None:
+            raise ConnectionError(f"injected: {addr} unreachable")
+        good, bad = rec["good"], rec["bad"]
+        total = good + bad
+        ttft_sum = good * HEALTHY_TTFT + bad * REGRESSED_TTFT
+        return "\n".join([
+            "# TYPE kubeai_engine_ttft_seconds histogram",
+            f'kubeai_engine_ttft_seconds_bucket{{le="0.25"}} {good}',
+            f'kubeai_engine_ttft_seconds_bucket{{le="0.5"}} {good}',
+            f'kubeai_engine_ttft_seconds_bucket{{le="1"}} {total}',
+            f'kubeai_engine_ttft_seconds_bucket{{le="+Inf"}} {total}',
+            f"kubeai_engine_ttft_seconds_count {total}",
+            f"kubeai_engine_ttft_seconds_sum {ttft_sum}",
+            "kubeai_engine_queue_depth 0.0",
+            "kubeai_engine_queue_oldest_wait_seconds 0.0",
+            "kubeai_engine_kv_cache_utilization 0.0",
+            "kubeai_engine_slots_active 0.0",
+            "kubeai_engine_slot_capacity 4.0",
+            "kubeai_engine_active_requests 0.0",
+        ]) + "\n"
+
+    def fetch_state(self, addr: str, timeout: float = 5.0) -> dict:
+        if addr not in self.obs:
+            raise ConnectionError(f"injected: {addr} unreachable")
+        return {"model": MODEL, "healthy": True}
+
+    # ---- pod bookkeeping ------------------------------------------------
+
+    def pods(self) -> list[dict]:
+        return sorted(
+            self.store.list("Pod", "default", {md.POD_MODEL_LABEL: MODEL}),
+            key=lambda p: p["metadata"]["name"],
+        )
+
+    def _is_ready(self, pod: dict) -> bool:
+        st = pod.get("status", {})
+        if st.get("phase") == "Failed":
+            return False
+        return any(
+            c.get("type") == "Ready" and c.get("status") == "True"
+            for c in st.get("conditions", [])
+        )
+
+    def pod_split(self) -> dict:
+        """Counts the invariants and the log read every tick."""
+        out = {"old": 0, "new": 0, "old_ready": 0, "new_ready": 0}
+        for pod in self.pods():
+            h = _pod_hash_of(pod)
+            side = (
+                "old" if not self.good_hashes or h in self.good_hashes
+                else "new"
+            )
+            out[side] += 1
+            if self._is_ready(pod):
+                out[side + "_ready"] += 1
+        return out
+
+    def groups_not_ready(self) -> int:
+        groups = slicegroup.group_pods(self.pods())
+        return sum(
+            1 for members in groups.values()
+            if not slicegroup.group_ready(members, NUM_HOSTS)
+        )
+
+    # ---- the bad revision ----------------------------------------------
+
+    def apply_event(self, ev: GameDayEvent) -> None:
+        if ev.kind != EV_BAD_ROLLOUT:
+            raise ValueError(f"rollout sim only speaks {EV_BAD_ROLLOUT!r}")
+        self.mode = ev.params.get("mode", "latency")
+        self.good_hashes = {_pod_hash_of(p) for p in self.pods()}
+        self.mutate_rel = self.rel_now()
+        obj = self.store.get("Model", "default", MODEL)
+        env = dict(obj["spec"].get("env") or {})
+        env["ROLLOUT_REV"] = "2"
+        obj["spec"]["env"] = env
+        self.store.update(obj)
+
+    # ---- kubelet ---------------------------------------------------------
+
+    def _kubelet(self) -> None:
+        for pod in self.pods():
+            st = pod.get("status", {})
+            if st.get("podIP"):
+                continue
+            if st.get("reason") == "Preempted" or st.get("containerStatuses"):
+                continue
+            if (
+                self.mode == "crashloop"
+                and _pod_hash_of(pod) not in self.good_hashes
+            ):
+                continue  # the bad revision never comes up
+            uid = pod["metadata"].get("uid") or pod["metadata"]["name"]
+            born = self.first_seen.setdefault(uid, self.tick_no)
+            if self.tick_no - born < BOOT_TICKS:
+                continue
+            ip = f"10.88.0.{self.ip_counter}"
+            self.ip_counter += 1
+            fresh = self.store.get("Pod", "default",
+                                   pod["metadata"]["name"])
+            fresh.setdefault("status", {})["podIP"] = ip
+            fresh["status"]["phase"] = "Running"
+            fresh["status"]["conditions"] = [
+                {"type": "Ready", "status": "True"},
+                {"type": "PodScheduled", "status": "True"},
+            ]
+            self.store.update(fresh)
+            addr = f"{ip}:8000"
+            self.addr_model[addr] = MODEL
+            self.addr_hash[addr] = _pod_hash_of(pod)
+            self.obs[addr] = {"good": 0, "bad": 0}
+
+    def _advance_observations(self) -> None:
+        """Every Ready endpoint observes OBS_PER_TICK requests' TTFT —
+        regressed on new-hash endpoints in the latency scenario."""
+        for pod in self.pods():
+            ip = pod.get("status", {}).get("podIP")
+            if not ip or not self._is_ready(pod):
+                continue
+            addr = f"{ip}:8000"
+            rec = self.obs.get(addr)
+            if rec is None:
+                continue
+            if self._regressed(addr):
+                rec["bad"] += OBS_PER_TICK
+            else:
+                rec["good"] += OBS_PER_TICK
+
+    # ---- client traffic --------------------------------------------------
+
+    def _traffic(self) -> None:
+        """REQS_PER_TICK synthetic picks through the real LB — this is
+        where the canary share cap is MEASURED, from the outside."""
+        group = self.lb.group(MODEL)
+        dones = []
+        for _ in range(REQS_PER_TICK):
+            try:
+                addr, done = group.get_best_addr("", "", "", timeout=0.01)
+            except (NoHealthyEndpoints, LoadBalancerTimeout):
+                self.client_errors += 1
+                continue
+            dones.append(done)
+            if self.mutate_rel is not None:
+                self.total_picks += 1
+                if self.addr_hash.get(addr, "") not in self.good_hashes:
+                    self.bad_picks += 1
+        # Requests stay in flight for the rest of the tick so the
+        # least-load pick actually spreads — otherwise every endpoint
+        # sits at zero and the canary would never be measured.
+        for done in dones:
+            done(OUTCOME_SUCCESS)
+
+    def bad_share(self) -> float:
+        if not self.total_picks:
+            return 0.0
+        return self.bad_picks / self.total_picks
+
+    # ---- the tick --------------------------------------------------------
+
+    def tick(self) -> None:
+        self.tick_no += 1
+        self.clock.advance(TICK_S)
+        self.wall.advance(TICK_S)
+        rel = self.rel_now()
+
+        for ev in self.trace.due(rel):
+            self.apply_event(ev)
+            self.log.event(self.tick_no, ev)
+        self._kubelet()
+        self.lb.sync_all()
+        self._advance_observations()
+        self.aggregator.collect()
+        self.rollout.tick()
+        self.reconciler.reconcile("default", MODEL)
+        # The plan may have replaced pods after this tick's sync; the
+        # routing view the traffic and invariants see must reflect it.
+        self.lb.sync_all()
+        if rel >= 0:
+            self._traffic()
+
+        if self.rollback_rel is None and any(
+            inc["reason"] == flightrecorder.TRIGGER_ROLLBACK
+            for inc in self.recorder.incidents
+        ):
+            self.rollback_rel = rel
+
+        split = self.pod_split()
+        self.log.obs(
+            self.tick_no,
+            t=round(rel, 3),
+            pods=split,
+            bad_share=round(self.bad_share(), 4),
+            picks=self.total_picks,
+            errors=self.client_errors,
+            rollbacks=len([
+                i for i in self.recorder.incidents
+                if i["reason"] == flightrecorder.TRIGGER_ROLLBACK
+            ]),
+        )
+        self.checker.check_continuous(self, self.tick_no, rel)
+
+    def run(self) -> dict:
+        for _ in range(WARMUP_TICKS + self.ticks):
+            self.tick()
+        self.checker.check_terminal(self, self.tick_no, self.rel_now())
+        fv = self.checker.first_violation
+        rollback_decisions = [
+            e for e in self.recorder.events("rollout")
+            if e["detail"].get("decision") == "rollback"
+        ] if self.recorder.events("rollout") else []
+        return {
+            "sim": SIM_NAME,
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "ticks": self.ticks,
+            "client_errors": self.client_errors,
+            "bad_share": round(self.bad_share(), 4),
+            "total_picks": self.total_picks,
+            "bad_picks": self.bad_picks,
+            "mutate_rel": self.mutate_rel,
+            "rollback_rel": self.rollback_rel,
+            "rollback": (
+                {
+                    "verdict": rollback_decisions[0]["detail"].get("verdict"),
+                    "pinned": rollback_decisions[0]["detail"].get("pinned"),
+                    "condemned": rollback_decisions[0]["detail"].get(
+                        "condemned"
+                    ),
+                }
+                if rollback_decisions else None
+            ),
+            "pods": self.pod_split(),
+            "violations": [
+                {"tick": v.tick, "t": v.t, "invariant": v.invariant,
+                 "detail": v.detail}
+                for v in self.checker.violations
+            ],
+            "first_violation": None if fv is None else {
+                "tick": fv.tick, "t": fv.t, "invariant": fv.invariant,
+                "detail": fv.detail,
+            },
+            "incidents": list(self.recorder.incidents),
+            "log": self.log,
+            "world": self,
+        }
+
+
+# ---- invariants --------------------------------------------------------------
+
+
+def _inv_zero_stream_errors(world) -> str | None:
+    if world.client_errors:
+        return f"{world.client_errors} client pick(s) found no endpoint"
+    return None
+
+
+def _inv_share_bounded(world) -> str | None:
+    """The bad version never exceeds its canary traffic share — and a
+    crash-looping canary never serves at all."""
+    if world.scenario == "crashloop":
+        if world.bad_picks:
+            return (
+                f"{world.bad_picks} request(s) routed to a version that "
+                "never became Ready"
+            )
+        return None
+    cap = CANARY_PERCENT / 100.0 + SHARE_EPS
+    if world.total_picks >= REQS_PER_TICK and world.bad_share() > cap:
+        return (
+            f"bad-version traffic share {world.bad_share():.3f} exceeds "
+            f"canary cap {cap:.3f}"
+        )
+    return None
+
+
+def _inv_single_group_in_flight(world) -> str | None:
+    """Slice groups roll one at a time: at most one group may be
+    partial/not-Ready at any tick (post-warmup)."""
+    if world.rel_now() < 0:
+        return None
+    broken = world.groups_not_ready()
+    if broken > 1:
+        return f"{broken} slice groups simultaneously not Ready"
+    return None
+
+
+def _inv_rolled_back(world) -> str | None:
+    """Terminal for the failing scenarios: the rollback landed in time
+    and the fleet converged back onto the last-good hash."""
+    if world.rollback_rel is None:
+        return "the bad revision was never rolled back"
+    lag = world.rollback_rel - world.mutate_rel
+    if lag > ROLLBACK_BOUND_S:
+        return (
+            f"rollback took {lag:.1f}s > bound {ROLLBACK_BOUND_S:.1f}s "
+            "after the bad revision shipped"
+        )
+    split = world.pod_split()
+    if split["new"]:
+        return f"{split['new']} condemned-hash pod(s) still present"
+    if split["old_ready"] != world.expected_pods:
+        return (
+            f"{split['old_ready']}/{world.expected_pods} last-good pods "
+            "Ready at end of run"
+        )
+    obj = world.store.get("Model", "default", MODEL)
+    pin = (obj["metadata"].get("annotations") or {}).get(
+        md.ROLLOUT_PINNED_HASH_ANNOTATION
+    )
+    if not pin:
+        return "rollback left no pinned-hash annotation on the Model"
+    return None
+
+
+def _inv_completed(world) -> str | None:
+    """Terminal for the healthy scenarios: the rollout finished — every
+    replica on the new hash, no rollback, no lingering state."""
+    if world.rollback_rel is not None:
+        return "a healthy revision was rolled back"
+    split = world.pod_split()
+    if split["old"]:
+        return f"{split['old']} old-hash pod(s) still present"
+    if split["new_ready"] != world.expected_pods:
+        return (
+            f"{split['new_ready']}/{world.expected_pods} new-hash pods "
+            "Ready at end of run"
+        )
+    state = world.rollout.state_payload()
+    if state["rollouts"] or state["condemned"]:
+        return f"rollout state not forgotten: {state}"
+    return None
+
+
+def _inv_groups_paced(world) -> str | None:
+    """Terminal for the group scenario: one group_roll per group, each
+    at least stepSeconds apart."""
+    rolls = [
+        e for e in world.recorder.events("rollout")
+        if e["detail"].get("decision") == "group_roll"
+    ]
+    if len(rolls) != GROUP_REPLICAS:
+        return (
+            f"{len(rolls)} group roll(s) for {GROUP_REPLICAS} stale "
+            "groups — want exactly one each"
+        )
+    times = [e["t"] for e in rolls]
+    for a, b in zip(times, times[1:]):
+        if b - a < STEP_SECONDS - 1e-6:
+            return (
+                f"group rolls {b - a:.1f}s apart — pacing floor is "
+                f"{STEP_SECONDS:g}s"
+            )
+    return None
+
+
+def invariants_for(scenario: str) -> tuple:
+    invs = [
+        Invariant("zero_stream_errors", _inv_zero_stream_errors, CONTINUOUS,
+                  "clients never see an error while a rollout is judged"),
+    ]
+    if scenario in ("latency", "crashloop"):
+        invs.append(Invariant(
+            "canary_share_bounded", _inv_share_bounded, CONTINUOUS,
+            "the bad version never exceeds its canary traffic share"))
+        invs.append(Invariant(
+            "rolled_back_in_time", _inv_rolled_back, TERMINAL,
+            "auto-rollback lands within window + step + slack"))
+    if scenario in ("clean", "group"):
+        invs.append(Invariant(
+            "rollout_completes", _inv_completed, TERMINAL,
+            "a healthy revision reaches 100% new-hash"))
+    if scenario == "group":
+        invs.append(Invariant(
+            "single_group_in_flight", _inv_single_group_in_flight,
+            CONTINUOUS, "slice groups roll one at a time"))
+        invs.append(Invariant(
+            "groups_paced", _inv_groups_paced, TERMINAL,
+            "one atomic roll per group, stepSeconds apart"))
+    return tuple(invs)
+
+
+# ---- entry points ------------------------------------------------------------
+
+
+def run_sim(scenario: str, seed: int = 0, ticks: int | None = None) -> dict:
+    return RolloutWorld(
+        scenario, ticks if ticks is not None else DEFAULT_TICKS[scenario],
+        seed=seed,
+    ).run()
+
+
+def run_all(seed: int = 0) -> dict:
+    return {s: run_sim(s, seed=seed) for s in SCENARIOS}
+
+
+# ---- result-level checks (imported by tests/unit/test_rollout_sim.py) --------
+
+
+def check_no_violations(results: dict) -> None:
+    for scenario, result in results.items():
+        assert not result["violations"], (
+            scenario, result["first_violation"]
+        )
+
+
+def check_clean_completes(results: dict) -> None:
+    r = results["clean"]
+    assert r["rollback_rel"] is None
+    assert r["pods"]["old"] == 0 and r["pods"]["new_ready"] == REPLICAS
+    # The ramp really was progressive: the canary share was enforced
+    # sub-100% for a while (picks landed while the cap was partial).
+    assert 0 < r["bad_picks"] < r["total_picks"]
+
+
+def check_latency_rolls_back(results: dict) -> None:
+    r = results["latency"]
+    assert r["rollback"] is not None
+    assert r["rollback"]["verdict"] == "ttft_regression"
+    assert r["rollback_rel"] - r["mutate_rel"] <= ROLLBACK_BOUND_S
+    assert r["bad_share"] <= CANARY_PERCENT / 100.0 + SHARE_EPS
+    assert r["client_errors"] == 0
+
+
+def check_crashloop_rolls_back(results: dict) -> None:
+    r = results["crashloop"]
+    assert r["rollback"] is not None
+    assert r["rollback"]["verdict"] == "crashloop"
+    assert r["bad_picks"] == 0, "a never-Ready version served traffic"
+    assert r["client_errors"] == 0
+
+
+def check_group_rolls_atomically(results: dict) -> None:
+    r = results["group"]
+    assert r["pods"]["old"] == 0
+    assert r["pods"]["new_ready"] == GROUP_REPLICAS * NUM_HOSTS
+
+
+def check_rollback_bundle(results: dict) -> None:
+    """The latency rollback dumped a replayable incident bundle naming
+    this sim, carrying the rollout decisions and the canonical-JSON
+    byte-identity basis."""
+    r = results["latency"]
+    bundles = [
+        i for i in r["incidents"]
+        if i["reason"] == flightrecorder.TRIGGER_ROLLBACK
+    ]
+    assert bundles, "rollback fired no rollout_rollback trigger"
+    lines = bundles[0]["lines"]
+    header = json.loads(lines[0])
+    assert header["bundle"] == "incident"
+    assert header["sim"] == SIM_NAME
+    assert header["scenario"] == "latency"
+    assert header["seed"] == r["seed"]
+    assert header["ticks"] == r["ticks"]
+    records = [json.loads(ln) for ln in lines[1:]]
+    kinds = {rec["kind"] for rec in records if rec["record"] == "flight"}
+    assert flightrecorder.ROLLOUT_DECISION in kinds
+    for ln in lines:
+        assert json.dumps(json.loads(ln), sort_keys=True) == ln
+
+
+ALL_CHECKS = (
+    check_no_violations,
+    check_clean_completes,
+    check_latency_rolls_back,
+    check_crashloop_rolls_back,
+    check_group_rolls_atomically,
+    check_rollback_bundle,
+)
+
+
+# ---- the classic-plan regression pin ----------------------------------------
+
+
+def _drive_classic(replicas: int, with_rollout_block: bool,
+                   wire_controller: bool) -> list[str]:
+    """Reconcile a world through a spec change and return a canonical
+    dump of every pod decision the plan made, tick by tick."""
+    counter = itertools.count()
+    store = KubeStore(namegen=lambda: f"{next(counter):06d}")
+    clock = FakeClock(1000.0)
+    wall = FakeClock(1_000_000.0)
+    metrics = Metrics()
+    cfg = System()
+    cfg.fixed_self_metric_addrs = ["self:1"]
+    cfg.default_and_validate()
+    kwargs = {"rollout": _rollout_spec()} if with_rollout_block else {}
+    mk_model(store, MODEL, replicas=replicas, autoscaling_disabled=True,
+             **kwargs)
+    reconciler = ModelReconciler(
+        store, cfg, metrics=metrics, clock=clock, wall=wall,
+    )
+    if wire_controller:
+        reconciler.rollout = RolloutController(
+            store=store, metrics=metrics, clock=clock,
+        )
+    timeline: list[str] = []
+
+    def snap() -> None:
+        timeline.append(json.dumps(
+            sorted(
+                (p["metadata"]["name"], _pod_hash_of(p),
+                 bool(p.get("status", {}).get("conditions")))
+                for p in store.list(
+                    "Pod", "default", {md.POD_MODEL_LABEL: MODEL}
+                )
+            ),
+            sort_keys=True,
+        ))
+
+    def mark_all_ready() -> None:
+        for pod in store.list("Pod", "default", {md.POD_MODEL_LABEL: MODEL}):
+            fresh = store.get("Pod", "default", pod["metadata"]["name"])
+            fresh.setdefault("status", {})["phase"] = "Running"
+            fresh["status"]["conditions"] = [
+                {"type": "Ready", "status": "True"},
+            ]
+            store.update(fresh)
+
+    for step in range(8):
+        clock.advance(1.0)
+        wall.advance(1.0)
+        if step == 3:
+            obj = store.get("Model", "default", MODEL)
+            obj["spec"]["env"] = {"ROLLOUT_REV": "2"}
+            store.update(obj)
+        reconciler.reconcile("default", MODEL)
+        mark_all_ready()
+        snap()
+    return timeline
+
+
+def check_classic_plan_unchanged() -> None:
+    """Worlds the rollout plane must not touch plan byte-identically
+    with and without the controller wired: a single-replica model even
+    WITH a rollout block, and a multi-replica model without one."""
+    for replicas, with_block in ((1, True), (3, False)):
+        bare = _drive_classic(replicas, with_block, wire_controller=False)
+        wired = _drive_classic(replicas, with_block, wire_controller=True)
+        assert bare == wired, (
+            f"replicas={replicas} rollout_block={with_block}: the wired "
+            "controller changed the classic surge plan"
+        )
+
+
+# ---- replay ------------------------------------------------------------------
+
+
+def replay(path: str) -> tuple[dict, dict]:
+    """Re-run a dump byte-identically from its own header. Handles both
+    artifact kinds this sim produces: a full run log (GameDayLog) and a
+    `rollout_rollback` flight-recorder incident bundle."""
+    with open(path) as fh:
+        original = [ln.rstrip("\n") for ln in fh if ln.strip()]
+    header = json.loads(original[0])
+    if header.get("sim") != SIM_NAME:
+        raise ValueError(
+            f"{path}: dump was recorded by sim {header.get('sim')!r}, "
+            f"not {SIM_NAME!r}"
+        )
+    scenario = header.get("scenario", "latency")
+    result = run_sim(
+        scenario,
+        seed=int(header.get("seed", 0)),
+        ticks=int(header.get("ticks", DEFAULT_TICKS[scenario])),
+    )
+    if header.get("bundle") == "incident":
+        fresh = next(
+            (i["lines"] for i in result["incidents"]
+             if i["reason"] == header["reason"]),
+            [],
+        )
+    else:
+        fresh = result["log"].lines
+    return header, {
+        "lines": fresh,
+        "identical": fresh == original,
+        "first_violation": result["first_violation"],
+        "rollback": result["rollback"],
+    }
+
+
+def replay_main(path: str) -> int:
+    """CLI replay entry (also dispatched to by
+    `python -m benchmarks.gameday_sim --replay <bundle>` when the
+    bundle header names this sim)."""
+    header, cmp = replay(path)
+    what = "incident bundle" if header.get("bundle") == "incident" else "log"
+    print(f"replayed rollout {what} {path}: {len(cmp['lines'])} lines "
+          f"(scenario {header.get('scenario')})")
+    print(f"byte-identical: {cmp['identical']}")
+    print(f"rollback: {cmp['rollback']}")
+    print(f"first violation: {cmp['first_violation']}")
+    return 0 if cmp["identical"] else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", choices=("all",) + SCENARIOS,
+                    default="all")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ticks", type=int, default=0,
+                    help="simulated ticks after warmup (default: per scenario)")
+    ap.add_argument("--dump", help="write the run's JSONL log here")
+    ap.add_argument("--dump-bundle",
+                    help="write the rollout_rollback incident bundle here")
+    ap.add_argument("--replay", metavar="DUMP",
+                    help="re-run a dumped log or incident bundle and compare")
+    args = ap.parse_args(argv)
+
+    if args.replay:
+        return replay_main(args.replay)
+
+    if args.scenario == "all":
+        results = run_all(seed=args.seed)
+        check_classic_plan_unchanged()
+        print("PASS check_classic_plan_unchanged")
+        for chk in ALL_CHECKS:
+            chk(results)
+            print(f"PASS {chk.__name__}")
+        summary = {
+            s: {
+                "rollback": r["rollback"],
+                "bad_share": r["bad_share"],
+                "client_errors": r["client_errors"],
+                "pods": r["pods"],
+                "violations": len(r["violations"]),
+            }
+            for s, r in results.items()
+        }
+        print(json.dumps(summary, indent=2, sort_keys=True))
+        return 0
+
+    result = run_sim(args.scenario, seed=args.seed,
+                     ticks=args.ticks or None)
+    if args.dump:
+        result["log"].dump(args.dump)
+        print(f"log -> {args.dump}")
+    if args.dump_bundle:
+        bundle = next(
+            (i for i in result["incidents"]
+             if i["reason"] == flightrecorder.TRIGGER_ROLLBACK),
+            None,
+        )
+        if bundle is None:
+            print("no rollout_rollback bundle was dumped this run")
+            return 1
+        with open(args.dump_bundle, "w") as fh:
+            fh.write("\n".join(bundle["lines"]) + "\n")
+        print(f"bundle -> {args.dump_bundle}")
+    slim = {k: v for k, v in result.items()
+            if k not in ("log", "incidents", "world")}
+    print(json.dumps(slim, indent=2, sort_keys=True, default=str))
+    return 1 if result["violations"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

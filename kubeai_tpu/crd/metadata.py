@@ -69,6 +69,14 @@ LAST_KNOWN_GOOD_ANNOTATION = "kubeai.org/last-known-good-replicas"
 # ActuationGovernor.allow_federation_failover.
 FEDERATION_FAILOVER_ANNOTATION = "kubeai.org/federation-failover-from"
 
+# Progressive rollouts (kubeai_tpu/operator/rollout): stamped on a Model
+# when the rollout judge condemns the in-flight spec hash — the pod plan
+# treats the pinned (last-good) hash as desired and tears the condemned
+# hash down. Value: the pod-hash to keep serving. Every write is gated by
+# ActuationGovernor.allow_rollback and pinned to operator/rollout.py
+# (scripts/check_actuation_paths.py enforces both).
+ROLLOUT_PINNED_HASH_ANNOTATION = "kubeai.org/rollout-pinned-hash"
+
 # Self-healing repair-backoff state (kubeai_tpu/operator/controller):
 # JSON {"count": n, "last": wall_ts} persisted on the Model so an
 # operator restart mid-backoff cannot issue duplicate repairs.

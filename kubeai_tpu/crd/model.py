@@ -302,6 +302,85 @@ class Slo:
                 raise ValidationError(f"slo.{field} must be in [0, 1)")
 
 
+ROLLOUT_STRATEGY_CANARY = "canary"
+ROLLOUT_STRATEGIES = ("", ROLLOUT_STRATEGY_CANARY)
+
+
+@dataclasses.dataclass
+class RolloutJudge:
+    """Comparative-judgment thresholds for a progressive rollout: the
+    new hash is condemned when it looks WORSE than the old one by these
+    margins, from the fleet plane's per-version aggregates. A field set
+    to 0 inherits the rollout controller's default."""
+
+    window_seconds: float = 0.0     # observation window per judgment
+    ttft_p95_ratio: float = 0.0     # max new/old TTFT p95 ratio, e.g. 1.5
+    max_breaker_trips: int = 0      # open circuits tolerated on the new hash
+
+    def validate(self) -> None:
+        for field, value in (
+            ("windowSeconds", self.window_seconds),
+            ("ttftP95Ratio", self.ttft_p95_ratio),
+            ("maxBreakerTrips", self.max_breaker_trips),
+        ):
+            try:
+                ok = float(value) >= 0
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValidationError(
+                    f"rollout.judge.{field} must be a number >= 0"
+                )
+
+
+@dataclasses.dataclass
+class Rollout:
+    """Progressive-delivery policy for spec-hash changes
+    (kubeai_tpu/operator/rollout). Operator-plane state: nothing here
+    renders into an engine flag or pod spec — the rollout controller
+    paces the pod plan through canary → ramp → complete, the LB
+    enforces the canary traffic share at routing time, and the SLO
+    machinery judges new vs old comparatively. No `rollout:` block (or
+    strategy "") keeps the classic surge rollout byte-identical."""
+
+    strategy: str = ""              # "" = classic surge; "canary"
+    canary_percent: float = 10.0    # traffic+replica share of the canary step
+    step_seconds: float = 60.0      # dwell per governed step
+    max_unavailable: int = 0        # extra replicas replaceable per step
+    auto_rollback: bool = True      # pin the old hash on a failed judgment
+    judge: RolloutJudge = dataclasses.field(default_factory=RolloutJudge)
+
+    def enabled(self) -> bool:
+        return self.strategy == ROLLOUT_STRATEGY_CANARY
+
+    def validate(self) -> None:
+        if self.strategy not in ROLLOUT_STRATEGIES:
+            raise ValidationError(
+                f"rollout.strategy must be one of {ROLLOUT_STRATEGIES}"
+            )
+        try:
+            pct_ok = 0.0 < float(self.canary_percent) <= 100.0
+        except (TypeError, ValueError):
+            pct_ok = False
+        if self.enabled() and not pct_ok:
+            raise ValidationError(
+                "rollout.canaryPercent must be in (0, 100]"
+            )
+        for field, value in (
+            ("stepSeconds", self.step_seconds),
+            ("maxUnavailable", self.max_unavailable),
+        ):
+            try:
+                ok = float(value) >= 0
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ValidationError(
+                    f"rollout.{field} must be a number >= 0"
+                )
+        self.judge.validate()
+
+
 @dataclasses.dataclass
 class RoleScaling:
     """Replica bounds for one disaggregated role's pod group. The
@@ -607,6 +686,8 @@ class ModelSpec:
     tenancy: Tenancy = dataclasses.field(default_factory=Tenancy)
     # Per-model SLO targets (observability/control-bias, every engine).
     slo: Slo = dataclasses.field(default_factory=Slo)
+    # Progressive-delivery policy (operator plane, every engine).
+    rollout: Rollout = dataclasses.field(default_factory=Rollout)
     # Disaggregated prefill/decode serving (in-tree engine only).
     disaggregation: Disaggregation = dataclasses.field(
         default_factory=Disaggregation
@@ -707,6 +788,9 @@ class ModelSpec:
         # Same: SLO targets are judged from the fleet plane — no engine
         # needs to know them.
         self.slo.validate()
+        # Same: rollout pacing is operator-plane state; no engine flag
+        # or pod spec renders from it.
+        self.rollout.validate()
         self.disaggregation.validate()
         if self.disaggregation.enabled and self.engine != ENGINE_KUBEAI_TPU:
             raise ValidationError(
@@ -909,6 +993,8 @@ class Model:
         shd = spec.get("sharding", {}) or {}
         ten = spec.get("tenancy", {}) or {}
         slo = spec.get("slo", {}) or {}
+        ro = spec.get("rollout", {}) or {}
+        roj = ro.get("judge", {}) or {}
 
         def _role_scaling(key: str) -> RoleScaling:
             r = dis.get(key) or {}
@@ -1013,6 +1099,26 @@ class Model:
                     itl_p99_seconds=float(slo.get("itlP99Seconds", 0) or 0),
                     availability=float(slo.get("availability", 0) or 0),
                     max_shed_rate=float(slo.get("maxShedRate", 0) or 0),
+                ),
+                rollout=Rollout(
+                    strategy=ro.get("strategy", "") or "",
+                    canary_percent=float(
+                        ro.get("canaryPercent", 10.0) or 10.0
+                    ),
+                    step_seconds=float(ro.get("stepSeconds", 60.0) or 60.0),
+                    max_unavailable=int(ro.get("maxUnavailable", 0) or 0),
+                    auto_rollback=bool(ro.get("autoRollback", True)),
+                    judge=RolloutJudge(
+                        window_seconds=float(
+                            roj.get("windowSeconds", 0) or 0
+                        ),
+                        ttft_p95_ratio=float(
+                            roj.get("ttftP95Ratio", 0) or 0
+                        ),
+                        max_breaker_trips=int(
+                            roj.get("maxBreakerTrips", 0) or 0
+                        ),
+                    ),
                 ),
                 disaggregation=Disaggregation(
                     enabled=bool(dis.get("enabled", False)),
@@ -1179,6 +1285,27 @@ def _spec_to_dict(s: ModelSpec) -> dict:
         if s.slo.max_shed_rate:
             slo["maxShedRate"] = s.slo.max_shed_rate
         d["slo"] = slo
+    if s.rollout.enabled():
+        ro = s.rollout
+        rod: dict[str, Any] = {
+            "strategy": ro.strategy,
+            "canaryPercent": ro.canary_percent,
+            "stepSeconds": ro.step_seconds,
+        }
+        if ro.max_unavailable:
+            rod["maxUnavailable"] = ro.max_unavailable
+        if not ro.auto_rollback:
+            rod["autoRollback"] = False
+        jd: dict[str, Any] = {}
+        if ro.judge.window_seconds:
+            jd["windowSeconds"] = ro.judge.window_seconds
+        if ro.judge.ttft_p95_ratio:
+            jd["ttftP95Ratio"] = ro.judge.ttft_p95_ratio
+        if ro.judge.max_breaker_trips:
+            jd["maxBreakerTrips"] = ro.judge.max_breaker_trips
+        if jd:
+            rod["judge"] = jd
+        d["rollout"] = rod
     if s.disaggregation.enabled:
         dis = s.disaggregation
 

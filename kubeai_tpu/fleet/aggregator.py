@@ -152,6 +152,42 @@ def endpoint_signals(parsed: dict) -> dict:
     }
 
 
+def merge_hist_details(details: list[dict]) -> dict:
+    """Sum per-endpoint cumulative histogram details (`hist_detail`
+    shape) into one: bucket counts add by `le`, as do count and sum.
+    {} when nothing was observed. This is how per-version aggregates
+    are built — the rollout judge compares versions, not endpoints."""
+    by_le: dict[str, float] = {}
+    total = 0.0
+    total_sum = 0.0
+    for d in details:
+        if not d:
+            continue
+        for le, c in d.get("buckets", []):
+            by_le[le] = by_le.get(le, 0.0) + c
+        total += d.get("count", 0.0)
+        total_sum += d.get("sum", 0.0)
+    if total <= 0 or not by_le:
+        return {}
+    buckets = sorted(by_le.items(), key=lambda kv: float(kv[0]))
+    return {
+        "buckets": [[le, c] for le, c in buckets],
+        "count": total,
+        "sum": total_sum,
+    }
+
+
+def hist_detail_quantiles(detail: dict, qs=(0.5, 0.95, 0.99)) -> dict:
+    """Quantile summary of a (possibly merged) `hist_detail` dict via
+    the shared estimator; {} when empty."""
+    if not detail:
+        return {}
+    return quantiles_from_buckets(
+        [(float(le), c) for le, c in detail["buckets"]],
+        detail["count"], detail["sum"], qs,
+    )
+
+
 class FleetStateAggregator:
     """Background fleet-state collector + snapshot ring.
 
@@ -343,10 +379,15 @@ class FleetStateAggregator:
                 )
                 entry = {
                     "role": role,
+                    # Serving version (pod-hash label of the backing
+                    # pod), stamped by the LB sync — observable with or
+                    # without a rollout in flight.
+                    "version": lb_view.get("version") or "",
                     "stale": bool(stale),
                     "age_s": None if age is None else round(age, 3),
                     "error": cached.get("error"),
                     "in_flight": lb_view.get("in_flight", 0),
+                    "breaker": lb_view.get("state") or "",
                 }
                 if cached["parsed"] is not None:
                     entry.update(endpoint_signals(cached["parsed"]))
@@ -383,8 +424,38 @@ class FleetStateAggregator:
                     e.get("kv_sharing") for e in ep_entries.values()
                 ):
                     push(model.name, holdings)
+            # Per-version rows: the fleet split on the pod-hash label.
+            # The rollout judge reads these comparatively (new hash vs
+            # old); `/v1/fleet/state` shows them unconditionally.
+            version_rows: dict[str, dict] = {}
+            for addr, e in ep_entries.items():
+                row = version_rows.setdefault(
+                    e.get("version") or "",
+                    {
+                        "endpoints": 0, "fresh": 0, "in_flight": 0,
+                        "breakers_open": 0, "_ttft": [], "_itl": [],
+                    },
+                )
+                row["endpoints"] += 1
+                row["in_flight"] += e.get("in_flight", 0)
+                if not e["stale"]:
+                    row["fresh"] += 1
+                    row["_ttft"].append(e.get("ttft_hist") or {})
+                    row["_itl"].append(e.get("itl_hist") or {})
+                if e.get("breaker") and e["breaker"] != "closed":
+                    row["breakers_open"] += 1
+            versions_out: dict[str, dict] = {}
+            for v, row in sorted(version_rows.items()):
+                ttft_hist = merge_hist_details(row.pop("_ttft"))
+                itl_hist = merge_hist_details(row.pop("_itl"))
+                row["ttft_hist"] = ttft_hist
+                row["itl_hist"] = itl_hist
+                row["ttft"] = hist_detail_quantiles(ttft_hist)
+                row["itl"] = hist_detail_quantiles(itl_hist)
+                versions_out[v] = row
             snap_models[model.name] = {
                 "endpoints": ep_entries,
+                "versions": versions_out,
                 "replicas": replicas,
                 "queue": aggregate_queue_pressure(fresh_parsed),
                 "roles": {
@@ -615,6 +686,13 @@ class FleetStateAggregator:
         if self._clock() - snap["ts"] > self.staleness_s:
             return None
         return snap["models"].get(model)
+
+    def model_entry(self, model: str) -> dict | None:
+        """The model's row in the latest FRESH snapshot (None when the
+        snapshot is stale or the model unknown) — the rollout judge's
+        evidence source: `entry["versions"]` splits the fleet on the
+        pod-hash label."""
+        return self._fresh_model(model)
 
     def model_coverage(self, model: str) -> tuple[float | None, bool]:
         """The actuation governor's telemetry-coverage read:

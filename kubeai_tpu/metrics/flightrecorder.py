@@ -62,6 +62,7 @@ PLANNER_PREEMPT = "planner_preempt_mark"
 WATCHDOG = "engine_watchdog"
 STEP_ANOMALY = "engine_step_anomaly"
 SLO_ALERT = "slo_alert"
+ROLLOUT_DECISION = "rollout_decision"
 
 EVENT_KINDS = (
     DOOR_SHED,
@@ -76,6 +77,7 @@ EVENT_KINDS = (
     WATCHDOG,
     STEP_ANOMALY,
     SLO_ALERT,
+    ROLLOUT_DECISION,
 )
 
 # Record kinds incident bundles emit. MUST stay a subset of
@@ -88,6 +90,7 @@ TRIGGER_FAST_BURN = "fast_burn_page"
 TRIGGER_WATCHDOG = "watchdog_wedge"
 TRIGGER_ALL_CIRCUITS_OPEN = "all_circuits_open"
 TRIGGER_COVERAGE_COLLAPSE = "coverage_collapse"
+TRIGGER_ROLLBACK = "rollout_rollback"
 
 # Metric series derived from the host wall clock even under a FakeClock
 # (they time real work with time.monotonic). Excluded from bundle

@@ -1103,6 +1103,45 @@ class Metrics:
             "never cast; the request recomputes locally).",
             self.registry,
         )
+        # -- progressive rollouts (kubeai_tpu/operator/rollout) --------------
+        self.rollout_phase = Gauge(
+            "kubeai_rollout_phase",
+            "Rollout phase per model: 0 idle, 1 canary, 2 ramp, "
+            "3 rolling back (pin written, condemned hash draining).",
+            self.registry,
+        )
+        self.rollout_canary_share = Gauge(
+            "kubeai_rollout_canary_share",
+            "Traffic share the load balancer currently allows the "
+            "new-hash endpoints of an in-flight rollout per model "
+            "(0..1; absent outside a rollout).",
+            self.registry,
+        )
+        self.rollout_steps = Counter(
+            "kubeai_rollout_steps_total",
+            "Rollout steps taken per model and step kind (start / "
+            "widen / promote), each one governor-budgeted.",
+            self.registry,
+        )
+        self.rollout_verdicts = Counter(
+            "kubeai_rollout_verdicts_total",
+            "Comparative judge verdicts per model and verdict (pass, or "
+            "the failing signal: ttft_regression / breaker_trips / "
+            "crashloop) — one per judged tick of an in-flight rollout.",
+            self.registry,
+        )
+        self.rollout_rollbacks = Counter(
+            "kubeai_rollout_rollbacks_total",
+            "Automatic rollbacks per model and reason: the judge "
+            "condemned the new hash and pinned the last-good one.",
+            self.registry,
+        )
+        self.rollout_denied = Counter(
+            "kubeai_rollout_denied_total",
+            "Rollout steps or rollbacks the actuation governor refused "
+            "per model and action (fencing, budget, or coverage gate).",
+            self.registry,
+        )
         # -- tracing export health ------------------------------------------
         self.tracing_dropped_spans = TracingDroppedSpans(
             "kubeai_tracing_dropped_spans_total",

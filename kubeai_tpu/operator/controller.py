@@ -71,6 +71,7 @@ class ModelReconciler:
         clock=time.monotonic,
         wall=time.time,
         governor: governor_mod.ActuationGovernor | None = None,
+        rollout=None,
     ):
         self.store = store
         self.cfg = cfg
@@ -81,6 +82,11 @@ class ModelReconciler:
         # the governor (fencing + disruption budgets); the permissive
         # default keeps directly-constructed reconcilers ungoverned.
         self.governor = governor or governor_mod.PERMISSIVE
+        # Progressive-rollout controller (operator/rollout.RolloutController,
+        # wired by the manager): supplies the canary pod cap for models
+        # with a `rollout:` block. None leaves every plan identical to
+        # the classic surge rollout.
+        self.rollout = rollout
         # Two clocks, both injectable: `clock` (monotonic) spaces repair
         # backoff; `wall` compares against pod creationTimestamps (the
         # store stamps wall time) for the stuck-Pending deadline.
@@ -187,10 +193,17 @@ class ModelReconciler:
                     self.cfg.model_server_pods.json_patches, desired_pod
                 )
             plan = calculate_pod_plan(
-                pods, model, desired_pod, self.cfg.model_rollouts.surge
+                pods, model, desired_pod, self.cfg.model_rollouts.surge,
+                **self._rollout_kwargs(model, desired_pod, pods),
             )
         if plan.contains_actions():
             plan.execute(self.store, model_obj, governor=self.governor)
+            if plan.churned_not_ready:
+                # The plan delete-and-replaced not-ready out-of-date
+                # pods: extend the model's repair-backoff streak so a
+                # rollout whose pods never go Ready retries on the same
+                # exponential cadence as any other repair loop.
+                self._note_plan_churn(model)
             pods = self.store.list(
                 "Pod", model.namespace, {md.POD_MODEL_LABEL: model.name}
             )
@@ -408,6 +421,63 @@ class ModelReconciler:
         except (NotFound, Conflict):
             pass
 
+    # -- progressive-rollout seams ---------------------------------------------
+
+    def _rollout_kwargs(
+        self, model: Model, desired_pod: dict, pods: list[dict]
+    ) -> dict:
+        """Keyword seams for `calculate_pod_plan`. The pinned hash comes
+        straight off the Model annotation — a rollback written by a
+        previous leader keeps steering the plan even when no rollout
+        controller is wired here — the canary cap comes from the rollout
+        controller, and churn pacing rides the model's repair-backoff
+        streak either way."""
+        kw: dict = {}
+        pinned = model.annotations.get(md.ROLLOUT_PINNED_HASH_ANNOTATION)
+        if pinned:
+            kw["pinned_hash"] = pinned
+        if self.rollout is not None:
+            # Always consulted — pod_cap doubles as the controller's
+            # hash-drift sensor — but it returns None (no cap) while a
+            # pin is steering the plan or no rollout is in flight.
+            cap = self.rollout.pod_cap(model, desired_pod, pods)
+            if cap is not None:
+                kw["max_new"] = cap
+        budget = self._churn_pacing(model)
+        if budget is not None:
+            kw["recreate_budget"] = budget
+        return kw
+
+    def _churn_pacing(self, model: Model) -> int | None:
+        """`recreate_budget` for the pod plan: 0 while the model's
+        repair-backoff window is open (not-ready out-of-date pods wait
+        out the same backoff the health pass honors), None otherwise
+        (the plan's own max(1, surge) per-pass default)."""
+        st = self._repair_state.get((model.namespace, model.name))
+        if not st:
+            return None
+        count, last = st
+        r = self.cfg.resilience
+        backoff = min(
+            r.repair_backoff_max_seconds,
+            r.repair_backoff_base_seconds * (2.0 ** min(count, 10)),
+        )
+        if count and self._clock() - last < backoff:
+            return 0
+        return None
+
+    def _note_plan_churn(self, model: Model) -> None:
+        """Count a plan pass that churned not-ready out-of-date pods as
+        one repair round: shares the exponential backoff streak with the
+        pod-health pass."""
+        key = (model.namespace, model.name)
+        count, _last = (
+            self._repair_state.get(key)
+            or self._rehydrate_repair_state(model)
+        )
+        self._repair_state[key] = (count + 1, self._clock())
+        self._persist_repair_state(model, count + 1)
+
     def _conditions(
         self,
         model: Model,
@@ -511,7 +581,19 @@ class ModelReconciler:
                 rendered.append(pod)
             return rendered
 
-        return calculate_group_pod_plan(pods, model, render_group, mcfg.num_hosts)
+        cap = None
+        if self.rollout is not None:
+            # Canary pacing in GROUP units: at most `cap` groups that
+            # are stale only by hash drift roll per step; broken groups
+            # always repair atomically regardless.
+            cap = self.rollout.group_cap(model)
+        plan = calculate_group_pod_plan(
+            pods, model, render_group, mcfg.num_hosts,
+            max_hash_recreates=cap,
+        )
+        if self.rollout is not None and plan.rolled_stale_groups:
+            self.rollout.note_group_step(model, plan.rolled_stale_groups)
+        return plan
 
     def _plan_disagg(self, model, mcfg, pods):
         """Disaggregated prefill/decode: render one desired pod PER ROLE
@@ -539,6 +621,7 @@ class ModelReconciler:
         to_create: list[dict] = []
         to_delete: list[dict] = list(strays)
         to_remain: list[dict] = []
+        churned = 0
         details = [
             f"deleting roleless pod {p['metadata']['name']}" for p in strays
         ]
@@ -553,13 +636,18 @@ class ModelReconciler:
             # the model with the ROLE's replica count in that seat.
             role_model = _copy.deepcopy(model)
             role_model.spec.replicas = disagg_role_replicas(model, role)
+            # Disaggregated roles don't canary (each role renders its
+            # own hash, so there is no single version to judge), but
+            # churn pacing still applies.
             plan = calculate_pod_plan(
                 by_role.get(role, []), role_model, desired_pod,
                 self.cfg.model_rollouts.surge,
+                recreate_budget=self._churn_pacing(model),
             )
             to_create += plan.to_create
             to_delete += plan.to_delete
             to_remain += plan.to_remain
+            churned += plan.churned_not_ready
             details += [f"{role}: {d}" for d in plan.details]
         return PodPlan(
             model=model,
@@ -567,6 +655,7 @@ class ModelReconciler:
             to_delete=to_delete,
             to_remain=to_remain,
             details=details,
+            churned_not_ready=churned,
         )
 
     def _apply_self_labels(self, model_obj: dict) -> bool:
@@ -691,6 +780,12 @@ class ControllerLoop:
                 self._enqueue_obj(obj)
         except Exception:
             logger.warning("leader resync failed", exc_info=True)
+
+    def enqueue(self, namespace: str, name: str) -> None:
+        """Ask for a reconcile of one Model outside the watch stream —
+        the rollout controller calls this after advancing a step (the
+        raised canary cap would otherwise wait for the next event)."""
+        self._queue.put((namespace, name))
 
     def _enqueue_obj(self, obj: dict) -> None:
         kind = obj.get("kind")

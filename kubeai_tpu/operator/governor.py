@@ -64,6 +64,8 @@ ACTION_SCALE_TO_ZERO = "scale_to_zero"
 ACTION_PREEMPT_MARK = "preempt_mark"
 ACTION_PREWARM = "prewarm"
 ACTION_FEDERATION_FAILOVER = "federation_failover"
+ACTION_ROLLOUT_STEP = "rollout_step"
+ACTION_ROLLBACK = "rollout_rollback"
 
 # Denial-reason vocabulary.
 DENY_LEASE = "lease-invalid"
@@ -425,6 +427,58 @@ class ActuationGovernor:
                 self._deny(ACTION_FEDERATION_FAILOVER, model, DENY_STALE)
                 return False
         self._allow(ACTION_FEDERATION_FAILOVER, model)
+        return True
+
+    def allow_rollout_step(self, model: str) -> bool:
+        """Whether the rollout controller may advance a rollout one step
+        (canary admission, ramp widening, promotion) right now. A step
+        deliberately replaces healthy serving capacity, so it is
+        BUDGETED like any other disruption — one unit per step — on top
+        of being fenced and refused while fleet telemetry is stale or
+        below coverage: a judge that cannot see both versions must not
+        promote either."""
+        if not self.fence_valid():
+            self.metrics.leader_fenced_writes.inc()
+            self._deny(ACTION_ROLLOUT_STEP, model, DENY_LEASE)
+            return False
+        if self.armed:
+            cov, fresh = self._coverage(model)
+            if not fresh:
+                self._deny(ACTION_ROLLOUT_STEP, model, DENY_STALE)
+                return False
+            if cov is not None and cov < self.cfg.min_telemetry_coverage:
+                self._deny(ACTION_ROLLOUT_STEP, model, DENY_COVERAGE)
+                return False
+        if self.enabled:
+            denied = self._consume_budget(model)
+            if denied is not None:
+                self._deny(ACTION_ROLLOUT_STEP, model, denied)
+                return False
+        self._allow(ACTION_ROLLOUT_STEP, model)
+        return True
+
+    def allow_rollback(self, model: str) -> bool:
+        """Whether the rollout controller may pin the last-good hash and
+        tear the condemned version down right now. Rolling back REPAIRS
+        a fleet the judge already found burning budget, so disruption
+        budgets don't apply (a budget-starved rollback would leave the
+        bad version serving) — but the pin write is still fenced (a
+        non-leader must not rewrite rollout state) and refused while
+        telemetry is stale or below coverage: condemning a version takes
+        evidence, and a blind judge has none."""
+        if not self.fence_valid():
+            self.metrics.leader_fenced_writes.inc()
+            self._deny(ACTION_ROLLBACK, model, DENY_LEASE)
+            return False
+        if self.armed:
+            cov, fresh = self._coverage(model)
+            if not fresh:
+                self._deny(ACTION_ROLLBACK, model, DENY_STALE)
+                return False
+            if cov is not None and cov < self.cfg.min_telemetry_coverage:
+                self._deny(ACTION_ROLLBACK, model, DENY_COVERAGE)
+                return False
+        self._allow(ACTION_ROLLBACK, model)
         return True
 
     # -- last-known-good persistence / restart rehydration ---------------------

@@ -167,6 +167,25 @@ class Manager:
         )
         self.reconciler.governor = self.governor
         self.model_client.governor = self.governor
+        # Progressive-delivery controller (kubeai_tpu/operator/rollout):
+        # models with a `rollout:` block get judged canary→ramp spec
+        # changes with automatic rollback; everyone else keeps the
+        # classic surge plan untouched. Reads the aggregator's
+        # per-version split, weights the LB's canary share, and feeds
+        # the reconciler its pod caps.
+        from kubeai_tpu.operator.rollout import RolloutController
+
+        self.rollout = RolloutController(
+            store=self.store,
+            lb=self.lb,
+            fleet=self.fleet,
+            governor=self.governor,
+            namespace=self.namespace,
+            metrics=self.metrics,
+            interval_s=self.cfg.model_autoscaling.interval_seconds / 2.0,
+            enqueue=self.controller_loop.enqueue,
+        )
+        self.reconciler.rollout = self.rollout
         # Cluster-wide capacity planner (kubeai_tpu/fleet/planner):
         # bin-packs every model's desire onto the chip budget each tick;
         # the autoscaler applies its allocations (stale plan → direct
@@ -249,6 +268,7 @@ class Manager:
             # land in every subsystem that makes discrete refusals.
             self.autoscaler.slo = self.slo
             self.governor.recorder = self.recorder
+            self.rollout.recorder = self.recorder
             self.lb.set_recorder(self.recorder)
             if self.planner is not None:
                 self.planner.slo = self.slo
@@ -369,6 +389,9 @@ class Manager:
             # After the aggregator (it judges from snapshots), before
             # the autoscaler (whose first tick may read its pressure).
             self.slo.start()
+        # After the aggregator too: the rollout judge reads the same
+        # snapshots (per-version split).
+        self.rollout.start()
         self.autoscaler.start()
         self.api_server.start()
         for m in self.messengers:
@@ -429,6 +452,7 @@ class Manager:
                 pass
         self.api_server.stop()
         self.autoscaler.stop()
+        self.rollout.stop()
         if self.slo is not None:
             self.slo.stop()
         if self.planner is not None:

@@ -82,7 +82,8 @@ SELF_METRICS_ADDR_ANNOTATION = "kubeai.org/metrics-addr"
 
 
 class _Endpoint:
-    __slots__ = ("address", "adapters", "in_flight", "health", "role")
+    __slots__ = ("address", "adapters", "in_flight", "health", "role",
+                 "version")
 
     def __init__(
         self,
@@ -91,6 +92,7 @@ class _Endpoint:
         policy: BreakerPolicy | None = None,
         clock=time.monotonic,
         role: str = md.ROLE_UNIFIED,
+        version: str = "",
     ):
         self.address = address
         self.adapters = adapters
@@ -100,6 +102,10 @@ class _Endpoint:
         # "prefill" / "decode", or "unified" (no label). Role-filtered
         # picks drive the proxy's two-hop prefill→decode flow.
         self.role = role
+        # Pod-hash of the backing pod's rendered spec — the serving
+        # VERSION. Always stamped (rollout controller or not) so version
+        # split is observable, and canary weighting keys on it.
+        self.version = version
 
 
 class Group:
@@ -157,6 +163,16 @@ class Group:
         # on every done(), transitions are rare).
         self.recorder = None  # local-state: wiring seam set by the manager, not request state
         self._breaker_states: dict[str, str] = {}  # local-state: last-seen states for transition detection
+        # Progressive rollouts: while a canary version is declared, its
+        # endpoints receive at most `share` of routed requests — replica
+        # count alone under-enforces the cap when the canary is idle and
+        # least-load would pile onto it. Rolling counters reset whenever
+        # the declaration changes; share 0.0 (rollback) stops routing to
+        # the condemned version instantly, ahead of pod teardown.
+        self._canary_version: str | None = None  # local-state: declared by the rollout controller
+        self._canary_share = 0.0  # local-state: canary traffic ceiling in [0,1]
+        self._canary_routed = 0  # local-state: requests routed to the canary version since declaration
+        self._canary_total = 0  # local-state: all requests routed since declaration
 
     def set_breaker_policy(self, policy: BreakerPolicy) -> None:
         with self._cond:
@@ -170,24 +186,28 @@ class Group:
         self,
         observed: dict[str, set[str]],
         roles: dict[str, str] | None = None,
+        versions: dict[str, str] | None = None,
     ) -> None:
         """observed: address -> adapter names; roles: address -> serving
-        role (absent/"" = unified). Broadcasts on ANY change: additions
-        wake the scale-from-zero hold (reference: group.go:108-137),
-        removals and role flips wake waiters whose candidate/exclude
+        role (absent/"" = unified); versions: address -> pod-hash of the
+        backing pod. Broadcasts on ANY change: additions wake the
+        scale-from-zero hold (reference: group.go:108-137), removals and
+        role/version flips wake waiters whose candidate/exclude
         predicate just changed so they re-evaluate instead of sleeping on
         a stale view."""
         roles = roles or {}
+        versions = versions or {}
         with self._cond:
             changed = False
             for addr, adapters in observed.items():
                 role = roles.get(addr) or md.ROLE_UNIFIED
+                version = versions.get(addr) or ""
                 ep = self._endpoints.get(addr)
                 if ep is None:
                     self._endpoints[addr] = _Endpoint(
                         addr, set(adapters),
                         policy=self.breaker_policy, clock=self._clock,
-                        role=role,
+                        role=role, version=version,
                     )
                     self._chwbl.add(addr)
                     changed = True
@@ -195,6 +215,9 @@ class Group:
                     ep.adapters = set(adapters)
                     if ep.role != role:
                         ep.role = role
+                        changed = True
+                    if ep.version != version:
+                        ep.version = version
                         changed = True
             for addr in list(self._endpoints):
                 if addr not in observed:
@@ -287,6 +310,39 @@ class Group:
                     best, best_depth = addr, depth
             return best, best_depth
 
+    def set_canary(self, version: str | None, share: float = 0.0) -> None:
+        """Declare (or clear, with None) the canary version and its
+        traffic ceiling. Idempotent when unchanged so the rollout
+        controller can call it every tick; a change resets the rolling
+        counters — the share is enforced over the NEW declaration's
+        traffic, not history."""
+        with self._cond:
+            version = version or None
+            share = max(0.0, min(1.0, share))
+            if version == self._canary_version and share == self._canary_share:
+                return
+            self._canary_version = version
+            self._canary_share = share
+            self._canary_routed = 0
+            self._canary_total = 0
+            self._cond.notify_all()
+
+    def _canary_filter(self, avail: list[_Endpoint]) -> list[_Endpoint]:
+        """Drop canary-version endpoints from the pick when routing one
+        more request to them would push their traffic share past the
+        ceiling. When ONLY canary endpoints are available the cap yields
+        — serving beats starving (the zero-share rollback case never
+        hits this: the old version's pods are kept by the pin)."""
+        v = self._canary_version
+        if v is None:
+            return avail
+        stable = [e for e in avail if e.version != v]
+        if not stable:
+            return avail
+        if self._canary_routed + 1 > self._canary_share * (self._canary_total + 1):
+            return stable
+        return avail
+
     def addresses(self, role: str = "") -> list[str]:
         with self._cond:
             if not role:
@@ -359,6 +415,7 @@ class Group:
                                 if e.health.state != STATE_CLOSED
                             },
                         )
+                    avail = self._canary_filter(avail)
                     picks = [
                         e for e in avail if e.address not in excluded
                     ] or avail
@@ -376,6 +433,10 @@ class Group:
                     self._sync_breaker_metrics(ep)
                     ep.in_flight += 1
                     self.total_in_flight += 1
+                    if self._canary_version is not None:
+                        self._canary_total += 1
+                        if ep.version == self._canary_version:
+                            self._canary_routed += 1
                     break
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -552,13 +613,14 @@ class Group:
     def snapshot(self) -> dict:
         """Breaker + in-flight state for the LB state snapshot."""
         with self._cond:
-            return {
+            snap = {
                 "total_in_flight": self.total_in_flight,
                 "endpoints": {
                     ep.address: {
                         "in_flight": ep.in_flight,
                         "adapters": sorted(ep.adapters),
                         "role": ep.role,
+                        "version": ep.version,
                         **ep.health.snapshot(),
                     }
                     for ep in self._endpoints.values()
@@ -567,6 +629,14 @@ class Group:
                     ep.in_flight for ep in self._retired.values()
                 ),
             }
+            if self._canary_version is not None:
+                snap["canary"] = {
+                    "version": self._canary_version,
+                    "share": self._canary_share,
+                    "routed": self._canary_routed,
+                    "total": self._canary_total,
+                }
+            return snap
 
     def _candidates(self, adapter: str, role: str = "") -> list[_Endpoint]:
         eps = list(self._endpoints.values())
@@ -758,6 +828,7 @@ class LoadBalancer:
                 blocked_groups.add(g)
         observed: dict[str, set[str]] = {}
         roles: dict[str, str] = {}
+        versions: dict[str, str] = {}
         for pod in pods:
             g = slicegroup.group_index(pod)
             if g is not None and g in blocked_groups:
@@ -805,7 +876,12 @@ class LoadBalancer:
             role = k8sutils.get_label(pod, md.POD_ROLE_LABEL)
             if role:
                 roles[addr] = role
-        self.group(model).reconcile_endpoints(observed, roles=roles)
+            version = k8sutils.get_label(pod, md.POD_HASH_LABEL)
+            if version:
+                versions[addr] = version
+        self.group(model).reconcile_endpoints(
+            observed, roles=roles, versions=versions
+        )
 
     def group(self, model: str) -> Group:
         with self._lock:

@@ -63,6 +63,12 @@ EV_DOOR_CRASH = "door_crash"        # params: shard (index; state reconstructed 
 # federation planner fails its models over within the bounded window.
 EV_CLUSTER_PARTITION = "cluster_partition"  # params: duration_s; target: cluster
 EV_CLUSTER_HEAL = "cluster_heal"    # target: cluster (explicit heal; else duration_s)
+# A bad deploy: mutate the target model's spec so its pod-hash drifts,
+# with every new-hash pod born broken — `mode` picks how ("wedged": the
+# pod never goes Ready; "latency": it serves with TTFT inflated by
+# `ttft_factor`). The rollout judge must condemn the hash and pin the
+# old one before the canary burns budget the stable version doesn't.
+EV_BAD_ROLLOUT = "bad_rollout"      # params: mode (wedged|latency), ttft_factor; target: model
 
 EVENT_KINDS = (
     EV_KILL_POD,
@@ -79,6 +85,7 @@ EVENT_KINDS = (
     EV_DOOR_CRASH,
     EV_CLUSTER_PARTITION,
     EV_CLUSTER_HEAL,
+    EV_BAD_ROLLOUT,
 )
 
 # ---- shared incident/flight schema -------------------------------------------
@@ -115,6 +122,7 @@ FLIGHT_PLANNER_PREEMPT = "planner_preempt_mark"
 FLIGHT_WATCHDOG = "engine_watchdog"         # wedged-step detection
 FLIGHT_STEP_ANOMALY = "engine_step_anomaly"
 FLIGHT_SLO_ALERT = "slo_alert"              # burn-rate state transition
+FLIGHT_ROLLOUT_DECISION = "rollout_decision"  # promotion / rollback verdict
 
 FLIGHT_EVENT_KINDS = (
     FLIGHT_DOOR_SHED,
@@ -129,6 +137,7 @@ FLIGHT_EVENT_KINDS = (
     FLIGHT_WATCHDOG,
     FLIGHT_STEP_ANOMALY,
     FLIGHT_SLO_ALERT,
+    FLIGHT_ROLLOUT_DECISION,
 )
 
 

@@ -23,6 +23,11 @@ self-harm hole PR 8 closed. This gate scans kubeai_tpu/ for:
     structurally, like prewarm) only the federation planner may write
     it and its write sites must consult
     `governor.allow_federation_failover`;
+  - rollback pins: stamping `ROLLOUT_PINNED_HASH_ANNOTATION` condemns
+    an in-flight rollout's version and makes the pod plan tear it down,
+    so (checked structurally, like prewarm) only the rollout controller
+    may write it and its write sites must consult
+    `governor.allow_rollback`;
   - member-wise slice-group deletions: a `.delete_pod(` call nested in
     a loop over group members consumes one budget unit PER MEMBER and
     can leave a partial multi-host group behind. Whole groups are
@@ -203,6 +208,66 @@ def _fedover_violations(rel: str, text: str, lines: list[str]) -> list[str]:
     return violations
 
 
+# A rollback pin is an actuation by another name: stamping
+# ROLLOUT_PINNED_HASH_ANNOTATION condemns the rendered spec and makes
+# the pod plan tear the new version down. Only the rollout controller
+# may write it (as a patch key — reads carry no colon), and only in a
+# function that consults the governor's `allow_rollback` gate.
+_ROLLPIN_WRITE = re.compile(r"ROLLOUT_PINNED_HASH_ANNOTATION\s*:")
+_ROLLPIN_HOME = os.path.join("operator", "rollout.py")
+_ROLLPIN_GATE = "allow_rollback"
+
+
+def _rollpin_violations(rel: str, text: str, lines: list[str]) -> list[str]:
+    """Rollout-pin annotation writes outside the rollout controller are
+    violations; inside it each write must live in a function that
+    consults the governor's `allow_rollback` gate."""
+    hits = [
+        text.count("\n", 0, m.start()) + 1
+        for m in _ROLLPIN_WRITE.finditer(text)
+    ]
+    if not hits:
+        return []
+    if rel.endswith(os.path.join("crd", "metadata.py")):
+        return []  # the constant's own definition site
+    if not rel.endswith(_ROLLPIN_HOME):
+        return [
+            f"{rel}:{n}: rollout pin written outside the rollout "
+            f"controller `{lines[n - 1].strip()[:80]}` — condemning a "
+            "version belongs to RolloutController, behind "
+            "governor.allow_rollback"
+            for n in hits
+            if not _has_pragma(lines, n)
+        ]
+    violations = []
+    funcs = [
+        node
+        for node in ast.walk(ast.parse(text))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for n in hits:
+        owners = [
+            f for f in funcs if f.lineno <= n <= (f.end_lineno or f.lineno)
+        ]
+        if not owners:
+            violations.append(
+                f"{rel}:{n}: rollout pin written at module level — "
+                "move it behind governor.allow_rollback"
+            )
+            continue
+        body = "\n".join(
+            lines[min(f.lineno for f in owners) - 1:
+                  max(f.end_lineno or f.lineno for f in owners)]
+        )
+        if _ROLLPIN_GATE not in body:
+            violations.append(
+                f"{rel}:{n}: rollout pin in a function that never "
+                f"consults governor.{_ROLLPIN_GATE} — the rollback "
+                "gate has been dropped"
+            )
+    return violations
+
+
 # Loops whose iterable mentions group membership: `plan.to_delete_groups`,
 # `slicegroup.group_pods(...)`, `members_by_group[g]`, ...
 _GROUP_ITER = re.compile(r"group", re.I)
@@ -277,6 +342,7 @@ def check(pkg: str = PKG) -> list[str]:
                     )
             violations.extend(_prewarm_violations(rel, text, lines))
             violations.extend(_fedover_violations(rel, text, lines))
+            violations.extend(_rollpin_violations(rel, text, lines))
             violations.extend(_group_delete_violations(rel, text, lines))
     return sorted(set(violations))
 

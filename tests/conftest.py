@@ -139,6 +139,15 @@ def pytest_configure(config):
         "cross-cluster KV fills, two-cluster fake-clock sim (runs in "
         "the fast tier; select with -m federation)",
     )
+    config.addinivalue_line(
+        "markers",
+        "rollout: progressive-delivery suite — SLO-gated canary "
+        "rollouts with comparative judging and automatic rollback: "
+        "CRD round-trip, governor step/rollback gates, LB canary "
+        "share, phase-aware pod plans, controller verdicts, and the "
+        "four-scenario fake-clock rollout sim with byte-identical "
+        "dump/replay (runs in the fast tier; select with -m rollout)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -235,3 +235,216 @@ def test_json_patch_applies_to_rendered_pod():
     assert patched["spec"]["priorityClassName"] == "high"
     assert patched["spec"]["containers"][0]["image"] == "img:v2"
     assert pod["spec"]["containers"][0]["image"] == "img:v1"  # original untouched
+
+
+# ---- progressive-rollout seams (kubeai_tpu/operator/rollout) -----------------
+
+from kubeai_tpu.operator.pod_plan import calculate_group_pod_plan
+
+
+def test_canary_cap_mints_exactly_the_step():
+    """max_new=1 over 4 ready old pods: one canary pod created, nothing
+    deleted — the old fleet keeps serving while the canary boots."""
+    pods = [mk_pod(f"old{i}", "oldhash", ready=True) for i in range(4)]
+    plan = calculate_pod_plan(pods, mk_model(4), desired_pod(), surge=1,
+                              max_new=1)
+    assert len(plan.to_create) == 1
+    assert not plan.to_delete
+
+
+def test_canary_surge_holds_while_minted_pod_boots():
+    """Regression pin for the canary-oscillation bug: once the step's
+    pod exists but is NOT Ready, allowed_new is 0 — the surge allowance
+    must persist or the plan deletes the very pod the step minted
+    (not-ready sorts first in deletion order) and loops forever."""
+    h = current_hash()
+    pods = [mk_pod(f"old{i}", "oldhash", ready=True) for i in range(4)]
+    pods.append(mk_pod("canary", h, ready=False))
+    plan = calculate_pod_plan(pods, mk_model(4), desired_pod(), surge=1,
+                              max_new=1)
+    assert not plan.contains_actions()  # a strict no-op while it boots
+
+
+def test_canary_surge_clamped_to_cap():
+    """surge > 1 cannot mint more new-hash pods than the step admits."""
+    pods = [mk_pod(f"old{i}", "oldhash", ready=True) for i in range(4)]
+    plan = calculate_pod_plan(pods, mk_model(4), desired_pod(), surge=3,
+                              max_new=1)
+    assert len(plan.to_create) == 1
+    assert not plan.to_delete
+
+
+def test_raised_cap_mints_then_retires_an_old_pod():
+    """Cap raised to 2 with the canary Ready: this pass surge-creates
+    the second new pod (delete waits, classic semantics); once it is
+    Ready too, the next pass retires exactly one old-hash pod."""
+    h = current_hash()
+    pods = [mk_pod(f"old{i}", "oldhash", ready=True) for i in range(3)]
+    pods.append(mk_pod("canary", h, ready=True))
+    plan = calculate_pod_plan(pods, mk_model(4), desired_pod(), surge=1,
+                              max_new=2)
+    assert len(plan.to_create) == 1
+    assert not plan.to_delete
+    pods.append(mk_pod("canary2", h, ready=True))
+    plan2 = calculate_pod_plan(pods, mk_model(4), desired_pod(), surge=1,
+                               max_new=2)
+    assert not plan2.to_create  # cap reached: no replacement minting
+    assert len(plan2.to_delete) == 1
+    assert plan2.to_delete[0]["metadata"]["name"].startswith("old")
+
+
+def test_pinned_hash_steers_plan_back_to_survivor():
+    """Rollback: the judge pinned the old hash. The survivor's template
+    becomes the desired pod, and rendered-hash (condemned) pods are the
+    out-of-date ones torn down."""
+    survivor = mk_pod("good", "pin00001", ready=True)
+    survivor["spec"] = {"containers": [{"name": "server", "image": "img:v0"}]}
+    pods = [
+        survivor,
+        mk_pod("good2", "pin00001", ready=True),
+        mk_pod("good3", "pin00001", ready=True),
+        mk_pod("bad", current_hash(), ready=False),
+    ]
+    plan = calculate_pod_plan(pods, mk_model(3), desired_pod(), surge=1,
+                              pinned_hash="pin00001")
+    deleted = {p["metadata"]["name"] for p in plan.to_delete}
+    assert "bad" in deleted
+    for pod in plan.to_create:
+        assert pod["metadata"]["labels"][md.POD_HASH_LABEL] == "pin00001"
+        assert pod["spec"]["containers"][0]["image"] == "img:v0"
+
+
+def test_pinned_hash_without_survivor_is_inert():
+    """The pin only steers while a pod of that version still exists;
+    with none left the rendered spec is all there is to serve with."""
+    pods = [mk_pod(f"old{i}", "oldhash", ready=True) for i in range(2)]
+    pinned = calculate_pod_plan(pods, mk_model(2), desired_pod(), surge=1,
+                                pinned_hash="gone0000")
+    classic = calculate_pod_plan(pods, mk_model(2), desired_pod(), surge=1)
+    assert [p["metadata"].get("generateName") for p in pinned.to_create] == [
+        p["metadata"].get("generateName") for p in classic.to_create
+    ]
+
+
+def test_recreate_budget_bounds_not_ready_churn():
+    """Satellite: a rollout whose new pods never go Ready must not
+    churn the whole out-of-date set every pass."""
+    pods = [mk_pod(f"old{i}", "oldhash", ready=False) for i in range(5)]
+    plan = calculate_pod_plan(pods, mk_model(5), desired_pod(), surge=1,
+                              recreate_budget=1)
+    assert plan.churned_not_ready == 1
+    assert len(plan.to_delete) == 1
+    # Default budget is max(1, surge) — not the whole set.
+    plan2 = calculate_pod_plan(pods, mk_model(5), desired_pod(), surge=2)
+    assert plan2.churned_not_ready == 2
+
+
+# ---- group plan: paced slice-group rollouts ----------------------------------
+
+
+def _group_pod(g, h, hash_, ready=True, image="img:v1"):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": f"model-m-g{g}-h{h}",
+            "namespace": "default",
+            "labels": {
+                md.POD_HASH_LABEL: hash_,
+                md.POD_MODEL_LABEL: "m",
+                md.POD_GROUP_LABEL: str(g),
+                md.POD_HOST_LABEL: str(h),
+            },
+        },
+        "spec": {"containers": [{"name": "server", "image": image}]},
+        "status": {"conditions": [
+            {"type": "Ready", "status": "True" if ready else "False"},
+        ]},
+    }
+
+
+def _render_group(g, num_hosts=2, image="img:v2"):
+    out = []
+    for h in range(num_hosts):
+        pod = _group_pod(g, h, hash_="", image=image)
+        del pod["metadata"]["labels"][md.POD_HASH_LABEL]
+        del pod["status"]
+        out.append(pod)
+    return out
+
+
+def _group_world(num_groups=3, num_hosts=2, stale=(), missing=()):
+    """Existing pods: `stale` groups carry an old hash, `missing`
+    groups lack host 1, the rest match the rendered hash."""
+    fresh = k8sutils.pod_hash(_render_group(0, num_hosts)[0]["spec"])
+    pods = []
+    for g in range(num_groups):
+        hash_ = "stalehash" if g in stale else fresh
+        for h in range(num_hosts):
+            if g in missing and h == num_hosts - 1:
+                continue
+            pods.append(_group_pod(g, h, hash_))
+    return pods
+
+
+def test_group_plan_classic_rolls_every_stale_group():
+    pods = _group_world(num_groups=3, stale={0, 2})
+    plan = calculate_group_pod_plan(
+        pods, mk_model(3), lambda g: _render_group(g), 2,
+    )
+    deleted = {p["metadata"]["name"] for p in plan.to_delete}
+    assert deleted == {"model-m-g0-h0", "model-m-g0-h1",
+                       "model-m-g2-h0", "model-m-g2-h1"}
+    assert plan.rolled_stale_groups == ["0", "2"]
+
+
+def test_group_canary_rolls_one_group_lowest_index_first():
+    pods = _group_world(num_groups=3, stale={0, 2})
+    plan = calculate_group_pod_plan(
+        pods, mk_model(3), lambda g: _render_group(g), 2,
+        max_hash_recreates=1,
+    )
+    deleted = {p["metadata"]["name"] for p in plan.to_delete}
+    assert deleted == {"model-m-g0-h0", "model-m-g0-h1"}  # group 0 only
+    assert plan.rolled_stale_groups == ["0"]
+    assert not plan.to_create  # delete-before-create: recreate next pass
+
+
+def test_group_canary_cap_zero_holds_everything():
+    pods = _group_world(num_groups=3, stale={0, 2})
+    plan = calculate_group_pod_plan(
+        pods, mk_model(3), lambda g: _render_group(g), 2,
+        max_hash_recreates=0,
+    )
+    assert not plan.contains_actions()
+    assert plan.rolled_stale_groups == []
+
+
+def test_group_broken_groups_exempt_from_the_cap():
+    """A group with a missing member is broken, not a canary: it is
+    repaired even when the hash-drift cap is exhausted elsewhere."""
+    pods = _group_world(num_groups=3, stale={0}, missing={2})
+    plan = calculate_group_pod_plan(
+        pods, mk_model(3), lambda g: _render_group(g), 2,
+        max_hash_recreates=0,
+    )
+    deleted = {p["metadata"]["name"] for p in plan.to_delete}
+    assert deleted == {"model-m-g2-h0"}  # broken group torn down whole
+    assert plan.rolled_stale_groups == []  # the hash canary stayed held
+
+
+def test_group_cap_none_is_byte_identical_to_classic():
+    pods = _group_world(num_groups=3, stale={1, 2})
+    classic = calculate_group_pod_plan(
+        pods, mk_model(3), lambda g: _render_group(g), 2,
+    )
+    explicit = calculate_group_pod_plan(
+        pods, mk_model(3), lambda g: _render_group(g), 2,
+        max_hash_recreates=None,
+    )
+    key = lambda plan: (
+        sorted(p["metadata"]["name"] for p in plan.to_delete),
+        sorted(p["metadata"]["name"] for p in plan.to_create),
+        plan.rolled_stale_groups,
+    )
+    assert key(classic) == key(explicit)

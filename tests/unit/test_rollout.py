@@ -1,0 +1,798 @@
+"""Progressive-delivery plane: SLO-gated canary rollouts with automatic
+rollback. Covers the `rollout:` CRD block, the governor's budgeted
+`allow_rollout_step` / repair-exempt `allow_rollback` gates, the LB's
+routing-time canary share cap, the per-version fleet split the judge
+reads, the RolloutController's detect → step → judge → rollback flows
+(pin hygiene, condemned-hash memory, restart rehydration), slice-group
+pacing, the `bad_rollout` chaos kind, and the static actuation-path gate
+for the pin annotation (both drift directions)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+sys.path.insert(0, REPO_ROOT)
+
+from kubeai_tpu.config.system import GovernorConfig
+from kubeai_tpu.crd import metadata as md
+from kubeai_tpu.crd.model import (
+    Model,
+    ModelSpec,
+    Rollout,
+    RolloutJudge,
+    ValidationError,
+)
+from kubeai_tpu.fleet.aggregator import (
+    hist_detail_quantiles,
+    merge_hist_details,
+)
+from kubeai_tpu.metrics import Metrics, flightrecorder
+from kubeai_tpu.metrics.flightrecorder import FlightRecorder
+from kubeai_tpu.operator.governor import ActuationGovernor
+from kubeai_tpu.operator.k8s.store import KubeStore
+from kubeai_tpu.operator.k8sutils import pod_hash
+from kubeai_tpu.operator.rollout import (
+    PHASE_CANARY,
+    PHASE_RAMP,
+    RolloutController,
+    VERDICT_BREAKERS,
+    VERDICT_CRASHLOOP,
+    VERDICT_PASS,
+    VERDICT_TTFT,
+    _delta_hist,
+)
+from kubeai_tpu.routing.loadbalancer import Group
+from kubeai_tpu.testing.chaos import (
+    EVENT_KINDS,
+    EV_BAD_ROLLOUT,
+    EV_KILL_POD,
+    GameDayEvent,
+    GameDayTrace,
+)
+from kubeai_tpu.testing.faults import FakeClock
+
+pytestmark = pytest.mark.rollout
+
+
+# ---- fixtures / helpers ------------------------------------------------------
+
+
+def mk_rollout(**kwargs) -> Rollout:
+    base = dict(
+        strategy="canary",
+        canary_percent=25.0,
+        step_seconds=10.0,
+        judge=RolloutJudge(window_seconds=5.0, ttft_p95_ratio=1.5),
+    )
+    base.update(kwargs)
+    return Rollout(**base)
+
+
+def mk_model(replicas=4, rollout=None, name="m") -> Model:
+    return Model(
+        name=name,
+        spec=ModelSpec(
+            url="hf://org/m", engine="KubeAITPU", replicas=replicas,
+            autoscaling_disabled=True,
+            rollout=rollout if rollout is not None else mk_rollout(),
+        ),
+    )
+
+
+def desired_pod(image="img:v2") -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "x", "namespace": "default", "labels": {}},
+        "spec": {"containers": [{"name": "server", "image": image}]},
+    }
+
+
+def mk_pod(name, hash_, ready=True, model="m") -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "labels": {md.POD_HASH_LABEL: hash_, md.POD_MODEL_LABEL: model},
+        },
+        "spec": {},
+        "status": {"conditions": [
+            {"type": "Ready", "status": "True" if ready else "False"},
+        ]},
+    }
+
+
+def _hist(count, le, each_s=None):
+    """A cumulative hist_detail with `count` observations in bucket `le`."""
+    if count <= 0:
+        return {}
+    each = float(le) * 0.8 if each_s is None else each_s
+    return {
+        "buckets": [[le, float(count)], ["+Inf", float(count)]],
+        "count": float(count),
+        "sum": each * count,
+    }
+
+
+def _version_row(endpoints=1, hist=None, breakers=0):
+    return {
+        "endpoints": endpoints,
+        "breakers_open": breakers,
+        "ttft_hist": hist or {},
+    }
+
+
+class StubFleet:
+    """Settable model_entry + always-good coverage."""
+
+    def __init__(self, entry=None):
+        self.entry = entry
+
+    def model_entry(self, model):
+        return self.entry
+
+    def model_coverage(self, model):
+        return (1.0, True)
+
+
+class StubLeader:
+    def __init__(self, valid=True):
+        self.valid = valid
+
+    def fence_valid(self):
+        return self.valid
+
+
+class World:
+    """Store + controller + stubs around one rollout-enabled model."""
+
+    def __init__(self, replicas=4, rollout=None, governor=None,
+                 recorder=None):
+        self.clock = FakeClock(100.0)
+        self.store = KubeStore()
+        self.fleet = StubFleet()
+        self.metrics = Metrics()
+        self.model = mk_model(replicas=replicas, rollout=rollout)
+        self.store.create(self.model.to_dict())
+        self.ctl = RolloutController(
+            store=self.store, fleet=self.fleet, governor=governor,
+            recorder=recorder, metrics=self.metrics, clock=self.clock,
+        )
+        self.desired = desired_pod()
+        self.new_hash = pod_hash(self.desired["spec"])
+        self.old_hash = "aaaa1111"
+        self.pods = [
+            mk_pod(f"p{i}", self.old_hash)
+            for i in range(replicas)
+        ]
+
+    def fresh_model(self) -> Model:
+        return Model.from_dict(self.store.get("Model", "default", "m"))
+
+    def cap(self):
+        return self.ctl.pod_cap(self.fresh_model(), self.desired, self.pods)
+
+    def healthy_versions(self):
+        self.fleet.entry = {"versions": {
+            self.new_hash: _version_row(hist=_hist(20, "0.25")),
+            self.old_hash: _version_row(endpoints=3,
+                                        hist=_hist(200, "0.25")),
+        }}
+
+
+# ---- CRD ---------------------------------------------------------------------
+
+
+def test_rollout_block_disabled_by_default():
+    m = mk_model(rollout=Rollout())
+    assert not m.spec.rollout.enabled()
+    assert "rollout" not in m.to_dict()["spec"]
+
+
+def test_rollout_round_trips_camel_case():
+    m = mk_model(rollout=mk_rollout(max_unavailable=1, auto_rollback=False))
+    d = m.to_dict()
+    ro = d["spec"]["rollout"]
+    assert ro["strategy"] == "canary"
+    assert ro["canaryPercent"] == 25.0
+    assert ro["stepSeconds"] == 10.0
+    assert ro["maxUnavailable"] == 1
+    assert ro["autoRollback"] is False
+    assert ro["judge"] == {"windowSeconds": 5.0, "ttftP95Ratio": 1.5}
+    again = Model.from_dict(d)
+    assert again.spec.rollout == m.spec.rollout
+
+
+def test_rollout_validation_rejects_bad_fields():
+    with pytest.raises(ValidationError):
+        mk_model(rollout=Rollout(strategy="bluegreen")).validate()
+    with pytest.raises(ValidationError):
+        mk_model(rollout=mk_rollout(canary_percent=0.0)).validate()
+    with pytest.raises(ValidationError):
+        mk_model(rollout=mk_rollout(canary_percent=101.0)).validate()
+    with pytest.raises(ValidationError):
+        mk_model(
+            rollout=mk_rollout(judge=RolloutJudge(window_seconds=-1.0))
+        ).validate()
+    mk_model(rollout=mk_rollout()).validate()  # the good shape passes
+
+
+# ---- governor gates ----------------------------------------------------------
+
+
+def _gov(budget=2, leader=None, fleet=None, clock=None):
+    return ActuationGovernor(
+        cfg=GovernorConfig(
+            window_seconds=60.0,
+            model_disruption_budget=budget,
+            cluster_disruption_budget=10,
+            min_telemetry_coverage=0.9,
+        ),
+        fleet=fleet if fleet is not None else StubFleet(),
+        leader=leader,
+        store=KubeStore(),
+        metrics=Metrics(),
+        clock=clock or FakeClock(0.0),
+    )
+
+
+def test_rollout_step_consumes_disruption_budget():
+    gov = _gov(budget=2)
+    assert gov.allow_rollout_step("m")
+    assert gov.allow_rollout_step("m")
+    assert not gov.allow_rollout_step("m")  # budget exhausted
+
+
+def test_rollback_is_exempt_from_budget():
+    gov = _gov(budget=0)
+    assert not gov.allow_rollout_step("m")
+    assert gov.allow_rollback("m")  # repair: budgets never starve it
+
+
+def test_rollback_still_fenced():
+    gov = _gov(budget=0, leader=StubLeader(valid=False))
+    assert not gov.allow_rollback("m")
+    assert not gov.allow_rollout_step("m")
+
+
+def test_rollback_requires_telemetry_evidence():
+    class BlindFleet:
+        def model_coverage(self, model):
+            return (0.2, True)
+
+    class StaleFleet:
+        def model_coverage(self, model):
+            return (1.0, False)
+
+    assert not _gov(fleet=BlindFleet()).allow_rollback("m")
+    assert not _gov(fleet=StaleFleet()).allow_rollback("m")
+    assert not _gov(fleet=BlindFleet()).allow_rollout_step("m")
+
+
+# ---- LB canary share ---------------------------------------------------------
+
+
+def _canary_group():
+    g = Group(clock=FakeClock(0.0).__call__)
+    g.reconcile_endpoints(
+        {"old1:1": set(), "old2:1": set(), "old3:1": set(), "new1:1": set()},
+        versions={"old1:1": "old", "old2:1": "old", "old3:1": "old",
+                  "new1:1": "new"},
+    )
+    return g
+
+
+def _drain(picks):
+    for done in picks:
+        done()
+
+
+def test_canary_share_capped_at_routing_time():
+    g = _canary_group()
+    g.set_canary("new", 0.25)
+    canary = 0
+    dones = []
+    for _ in range(40):
+        addr, done = g.get_best_addr("LeastLoad", "", "", timeout=1)
+        dones.append(done)
+        if addr == "new1:1":
+            canary += 1
+        if len(dones) == 4:  # release in waves so load spreads
+            _drain(dones)
+            dones = []
+    _drain(dones)
+    assert canary > 0  # the canary does serve...
+    assert canary <= 40 * 0.25 + 1  # ...but never past its share
+
+
+def test_canary_zero_share_is_never_picked():
+    g = _canary_group()
+    g.set_canary("new", 0.0)
+    for _ in range(20):
+        addr, done = g.get_best_addr("LeastLoad", "", "", timeout=1)
+        done()
+        assert addr != "new1:1"
+
+
+def test_canary_cap_yields_when_only_canary_remains():
+    g = Group(clock=FakeClock(0.0).__call__)
+    g.reconcile_endpoints({"new1:1": set()}, versions={"new1:1": "new"})
+    g.set_canary("new", 0.25)
+    addr, done = g.get_best_addr("LeastLoad", "", "", timeout=1)
+    done()
+    assert addr == "new1:1"  # serving beats starving
+
+
+def test_canary_counters_reset_on_redeclare():
+    g = _canary_group()
+    g.set_canary("new", 0.25)
+    for _ in range(8):
+        _, done = g.get_best_addr("LeastLoad", "", "", timeout=1)
+        done()
+    snap1 = g.snapshot()["canary"]
+    assert snap1["total"] == 8
+    g.set_canary("new", 0.25)  # unchanged: idempotent, counters keep
+    assert g.snapshot()["canary"]["total"] == 8
+    g.set_canary("new", 0.5)  # share change: counters reset
+    snap2 = g.snapshot()["canary"]
+    assert (snap2["share"], snap2["total"], snap2["routed"]) == (0.5, 0, 0)
+    g.set_canary(None)
+    assert "canary" not in g.snapshot()
+
+
+def test_endpoint_version_in_snapshot():
+    g = _canary_group()
+    snap = g.snapshot()
+    assert snap["endpoints"]["new1:1"]["version"] == "new"
+    assert snap["endpoints"]["old1:1"]["version"] == "old"
+
+
+# ---- histogram plumbing the judge rides --------------------------------------
+
+
+def test_delta_hist_windows_cumulative_counters():
+    base = _hist(10, "0.25")
+    cur = merge_hist_details([_hist(10, "0.25"), _hist(30, "1")])
+    delta = _delta_hist(cur, base)
+    assert delta["count"] == 30.0
+    q = hist_detail_quantiles(delta)
+    assert q["count"] == 30.0
+    assert q["p95_s"] == pytest.approx(1.0)
+
+
+def test_delta_hist_clamps_counter_resets():
+    base = _hist(50, "0.25")
+    cur = _hist(10, "0.25")  # endpoint replaced: counters restarted
+    delta = _delta_hist(cur, base)
+    assert delta == {} or delta.get("count", 0.0) == 0.0
+
+
+def test_delta_hist_no_baseline_is_lifetime():
+    cur = _hist(12, "0.5")
+    assert _delta_hist(cur, {}) == cur
+    assert _delta_hist({}, cur) == {}
+
+
+# ---- controller: detect -> step -> judge -> rollback -------------------------
+
+
+def test_pod_cap_none_without_rollout_block():
+    w = World(rollout=Rollout())
+    assert w.cap() is None
+
+
+def test_pod_cap_none_for_single_replica():
+    w = World(replicas=1)
+    assert w.cap() is None
+
+
+def test_pod_cap_none_at_steady_state():
+    w = World()
+    w.pods = [mk_pod(f"p{i}", w.new_hash) for i in range(4)]
+    assert w.cap() is None
+
+
+def test_detection_holds_cap_until_first_governed_step():
+    w = World()
+    assert w.cap() == 0  # detected, nothing admitted yet
+    st = w.ctl.state_payload()["rollouts"]["default/m"]
+    assert st["phase"] == PHASE_CANARY
+    assert st["max_new"] == 0
+    w.ctl.tick()  # first step: admit the canary
+    assert w.cap() == 1  # ceil(25% of 4)
+    st = w.ctl.state_payload()["rollouts"]["default/m"]
+    assert (st["max_new"], st["steps"], st["share"]) == (1, 1, 0.25)
+
+
+def test_ramp_widens_only_after_step_seconds_and_pass():
+    w = World()
+    w.cap()
+    w.ctl.tick()  # admit (t=100)
+    w.healthy_versions()
+    w.clock.advance(6.0)  # window (5s) elapsed, step_seconds (10s) not
+    verdicts = w.ctl.tick()
+    assert verdicts == {"m": VERDICT_PASS}
+    assert w.cap() == 1  # judged good but still dwelling
+    w.clock.advance(4.0)  # step_seconds reached
+    w.ctl.tick()
+    assert w.cap() == 2
+    st = w.ctl.state_payload()["rollouts"]["default/m"]
+    assert st["phase"] == PHASE_RAMP
+
+
+def test_judge_abstains_while_window_fills():
+    w = World()
+    w.cap()
+    w.ctl.tick()
+    w.healthy_versions()
+    w.clock.advance(2.0)  # inside the 5s window
+    assert w.ctl.tick() == {}  # no verdict at all
+
+
+def test_judge_crashloop_rolls_back():
+    w = World()
+    w.cap()
+    w.ctl.tick()
+    # Old version serving, new version has no endpoint at all.
+    w.fleet.entry = {"versions": {
+        w.old_hash: _version_row(endpoints=3, hist=_hist(100, "0.25")),
+    }}
+    w.clock.advance(6.0)
+    verdicts = w.ctl.tick()
+    assert verdicts == {"m": VERDICT_CRASHLOOP}
+    anns = w.store.get("Model", "default", "m")["metadata"]["annotations"]
+    assert anns[md.ROLLOUT_PINNED_HASH_ANNOTATION] == w.old_hash
+    assert w.ctl.state_payload()["condemned"] == {"default/m": w.new_hash}
+
+
+def test_judge_ttft_regression_rolls_back():
+    w = World()
+    w.cap()
+    w.ctl.tick()
+    w.fleet.entry = {"versions": {
+        w.new_hash: _version_row(hist=_hist(20, "1")),     # p95 1.0s
+        w.old_hash: _version_row(endpoints=3,
+                                 hist=_hist(200, "0.25")),  # p95 0.25s
+    }}
+    w.clock.advance(6.0)
+    assert w.ctl.tick() == {"m": VERDICT_TTFT}
+    anns = w.store.get("Model", "default", "m")["metadata"]["annotations"]
+    assert anns[md.ROLLOUT_PINNED_HASH_ANNOTATION] == w.old_hash
+
+
+def test_judge_breaker_trips_roll_back():
+    w = World()
+    w.cap()
+    w.ctl.tick()
+    w.fleet.entry = {"versions": {
+        w.new_hash: _version_row(hist=_hist(20, "0.25"), breakers=1),
+        w.old_hash: _version_row(endpoints=3, hist=_hist(200, "0.25")),
+    }}
+    w.clock.advance(6.0)
+    assert w.ctl.tick() == {"m": VERDICT_BREAKERS}
+
+
+def test_judge_abstains_below_min_samples():
+    w = World()
+    w.cap()
+    w.ctl.tick()
+    w.fleet.entry = {"versions": {
+        w.new_hash: _version_row(hist=_hist(3, "1")),  # 3 obs condemn nobody
+        w.old_hash: _version_row(endpoints=3, hist=_hist(200, "0.25")),
+    }}
+    w.clock.advance(6.0)
+    assert w.ctl.tick() == {"m": VERDICT_PASS}
+
+
+def test_auto_rollback_false_freezes_instead():
+    rec = FlightRecorder(clock=FakeClock(0.0))
+    w = World(rollout=mk_rollout(auto_rollback=False), recorder=rec)
+    w.cap()
+    w.ctl.tick()
+    w.fleet.entry = {"versions": {
+        w.old_hash: _version_row(endpoints=3, hist=_hist(100, "0.25")),
+    }}
+    w.clock.advance(6.0)
+    w.ctl.tick()
+    anns = (w.store.get("Model", "default", "m")["metadata"]
+            .get("annotations") or {})
+    assert md.ROLLOUT_PINNED_HASH_ANNOTATION not in anns  # no pin
+    decisions = [e["detail"]["decision"] for e in rec.events("rollout")]
+    assert "frozen" in decisions and "rollback" not in decisions
+    assert "default/m" in w.ctl.state_payload()["rollouts"]  # cap held
+
+
+def test_rollback_fires_replayable_trigger():
+    rec = FlightRecorder(clock=FakeClock(0.0))
+    w = World(recorder=rec)
+    w.cap()
+    w.ctl.tick()
+    w.fleet.entry = {"versions": {
+        w.old_hash: _version_row(endpoints=3, hist=_hist(100, "0.25")),
+    }}
+    w.clock.advance(6.0)
+    w.ctl.tick()
+    assert [i["reason"] for i in rec.incidents] == [
+        flightrecorder.TRIGGER_ROLLBACK
+    ]
+
+
+def test_condemned_hash_cannot_restart_its_own_rollout():
+    w = World()
+    w.cap()
+    w.ctl.tick()
+    w.fleet.entry = {"versions": {
+        w.old_hash: _version_row(endpoints=3, hist=_hist(100, "0.25")),
+    }}
+    w.clock.advance(6.0)
+    w.ctl.tick()  # rollback: pin written, hash condemned
+    # While the pin steers, the classic plan takes over (cap None).
+    assert w.cap() is None
+    # Even if the pin write were lost, the condemned memory holds the
+    # cap at zero for the exact hash the judge killed.
+    w.store.patch_merge("Model", "default", "m", {"metadata": {
+        "annotations": {md.ROLLOUT_PINNED_HASH_ANNOTATION: None},
+    }})
+    assert w.cap() == 0
+
+
+def test_third_hash_supersedes_condemned():
+    w = World()
+    w.cap()
+    w.ctl.tick()
+    w.fleet.entry = {"versions": {
+        w.old_hash: _version_row(endpoints=3, hist=_hist(100, "0.25")),
+    }}
+    w.clock.advance(6.0)
+    w.ctl.tick()  # condemned
+    w.desired = desired_pod(image="img:v3-fixed")  # operator ships a fix
+    assert w.cap() is None  # stale pin still steers this pass...
+    w.ctl.tick()  # ...until pin hygiene sees the fix supersede it
+    anns = (w.store.get("Model", "default", "m")["metadata"]
+            .get("annotations") or {})
+    assert not anns.get(md.ROLLOUT_PINNED_HASH_ANNOTATION)
+    assert w.cap() == 0  # a fresh rollout of the fix, from detection
+    assert w.ctl.state_payload()["condemned"] == {}
+
+
+def test_pin_hygiene_clears_redundant_pin():
+    w = World()
+    # Operator reverted the spec to exactly the pinned version.
+    w.store.patch_merge("Model", "default", "m", {"metadata": {
+        "annotations": {md.ROLLOUT_PINNED_HASH_ANNOTATION: w.new_hash},
+    }})
+    w.cap()  # reconciler seam reports the rendered hash == pin
+    w.ctl.tick()
+    anns = (w.store.get("Model", "default", "m")["metadata"]
+            .get("annotations") or {})
+    assert not anns.get(md.ROLLOUT_PINNED_HASH_ANNOTATION)
+
+
+def test_restart_rehydrates_condemned_from_pin():
+    w = World()
+    w.store.patch_merge("Model", "default", "m", {"metadata": {
+        "annotations": {md.ROLLOUT_PINNED_HASH_ANNOTATION: w.old_hash},
+    }})
+    # A brand-new controller (operator restart) sees pin != rendered
+    # hash and recovers the condemned set from that alone.
+    assert w.cap() is None
+    assert w.ctl.state_payload()["condemned"] == {"default/m": w.new_hash}
+
+
+def test_spec_change_mid_rollout_restarts_against_new_hash():
+    w = World()
+    w.cap()
+    w.ctl.tick()
+    assert w.cap() == 1
+    w.desired = desired_pod(image="img:v3")  # spec moved again
+    assert w.cap() == 0  # restarted: back to detection hold
+    st = w.ctl.state_payload()["rollouts"]["default/m"]
+    assert st["new_hash"] == pod_hash(w.desired["spec"])
+
+
+def test_rollout_completes_when_old_hash_drains():
+    w = World()
+    w.cap()
+    w.ctl.tick()
+    w.pods = [mk_pod(f"n{i}", w.new_hash) for i in range(4)]
+    assert w.cap() is None  # complete
+    assert w.ctl.state_payload()["rollouts"] == {}
+
+
+def test_governor_denial_holds_the_cap():
+    gov = _gov(budget=0)
+    w = World(governor=gov)
+    assert w.cap() == 0
+    w.ctl.tick()  # step denied: budget 0
+    assert w.cap() == 0
+    assert w.ctl.state_payload()["rollouts"]["default/m"]["steps"] == 0
+
+
+def test_group_pacing_one_roll_per_step_seconds():
+    w = World()
+    m = w.fresh_model()
+    assert w.ctl.group_cap(m) == 1
+    w.ctl.note_group_step(m, ["0"])
+    assert w.ctl.group_cap(m) == 0  # dwell
+    w.clock.advance(11.0)
+    assert w.ctl.group_cap(m) == 1
+
+
+def test_group_cap_none_without_rollout_block():
+    w = World(rollout=Rollout())
+    assert w.ctl.group_cap(w.fresh_model()) is None
+
+
+# ---- the bad_rollout chaos kind ----------------------------------------------
+
+
+def test_bad_rollout_is_a_trace_kind():
+    assert EV_BAD_ROLLOUT == "bad_rollout"
+    assert EV_BAD_ROLLOUT in EVENT_KINDS
+
+
+def test_bad_rollout_trace_round_trip_and_deliver_once():
+    trace = GameDayTrace([
+        GameDayEvent(2.0, EV_BAD_ROLLOUT, "rt", {"mode": "wedged"}),
+        GameDayEvent(2.0, EV_KILL_POD, "rt", {}),
+    ], seed=7)
+    again = GameDayTrace.from_jsonl(trace.to_jsonl(), seed=trace.seed)
+    assert again.to_jsonl() == trace.to_jsonl()
+    # Same-tick ordering is insertion order, and due() delivers once.
+    kinds = [ev.kind for ev in again.due(2.0)]
+    assert kinds == [EV_BAD_ROLLOUT, EV_KILL_POD]
+    assert again.due(2.0) == []
+
+
+# ---- satellite: the static pin-write gate, both directions -------------------
+
+
+def _load_gate():
+    path = os.path.join(REPO_ROOT, "scripts", "check_actuation_paths.py")
+    spec = importlib.util.spec_from_file_location(
+        "check_actuation_paths", path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_gate_is_clean_on_the_real_tree():
+    assert _load_gate().check() == []
+
+
+def test_gate_catches_pin_write_outside_the_controller(tmp_path):
+    """Drift direction 1: stamping the pin annotation anywhere but the
+    rollout controller fails the gate; a reviewed pragma passes."""
+    pkg = tmp_path / "kubeai_tpu"
+    pkg.mkdir()
+    (pkg / "rogue_pin.py").write_text(
+        "from kubeai_tpu.crd import metadata as md\n"
+        "def f(store):\n"
+        "    store.patch_merge('Model', 'ns', 'm', {'metadata': {\n"
+        "        'annotations': {md.ROLLOUT_PINNED_HASH_ANNOTATION: 'x'}\n"
+        "    }})\n"
+    )
+    (pkg / "reviewed.py").write_text(
+        "from kubeai_tpu.crd import metadata as md\n"
+        "def f(store):\n"
+        "    # ungoverned: reviewed test site\n"
+        "    store.patch_merge('Model', 'ns', 'm', {'metadata': {\n"
+        "        'annotations': {md.ROLLOUT_PINNED_HASH_ANNOTATION: 'x'}\n"
+        "    }})\n"
+    )
+    violations = _load_gate().check(pkg=str(pkg))
+    assert len(violations) == 1
+    assert "rogue_pin.py" in violations[0]
+    assert "allow_rollback" in violations[0]
+
+
+def test_gate_catches_dropped_rollback_gate(tmp_path):
+    """Drift direction 2: the controller's own write site losing its
+    `allow_rollback` consultation fails the gate; the gated shape
+    passes."""
+    pkg = tmp_path / "kubeai_tpu"
+    (pkg / "operator").mkdir(parents=True)
+    (pkg / "operator" / "rollout.py").write_text(
+        "from kubeai_tpu.crd import metadata as md\n"
+        "class C:\n"
+        "    def gated(self, store, model):\n"
+        "        if self.governor.allow_rollback(model):\n"
+        "            store.patch_merge('Model', 'ns', model, {\n"
+        "                'metadata': {'annotations': {\n"
+        "                    md.ROLLOUT_PINNED_HASH_ANNOTATION: 'h'\n"
+        "                }}})\n"
+        "    def dropped(self, store, model):\n"
+        "        store.patch_merge('Model', 'ns', model, {\n"
+        "            'metadata': {'annotations': {\n"
+        "                md.ROLLOUT_PINNED_HASH_ANNOTATION: 'h'\n"
+        "            }}})\n"
+    )
+    violations = _load_gate().check(pkg=str(pkg))
+    assert len(violations) == 1
+    assert "rollout.py" in violations[0]
+    assert "allow_rollback" in violations[0]
+
+
+def test_gate_reads_of_the_pin_do_not_trip(tmp_path):
+    pkg = tmp_path / "kubeai_tpu"
+    pkg.mkdir()
+    (pkg / "reader.py").write_text(
+        "from kubeai_tpu.crd import metadata as md\n"
+        "def f(model):\n"
+        "    anns = model['metadata'].get('annotations') or {}\n"
+        "    return anns.get(md.ROLLOUT_PINNED_HASH_ANNOTATION)\n"
+    )
+    assert _load_gate().check(pkg=str(pkg)) == []
+
+
+# ---- per-version fleet split (the judge's evidence source) -------------------
+
+
+def _exposition(good, bad):
+    total = good + bad
+    return "\n".join([
+        "# TYPE kubeai_engine_ttft_seconds histogram",
+        f'kubeai_engine_ttft_seconds_bucket{{le="0.25"}} {good}',
+        f'kubeai_engine_ttft_seconds_bucket{{le="1"}} {total}',
+        f'kubeai_engine_ttft_seconds_bucket{{le="+Inf"}} {total}',
+        f"kubeai_engine_ttft_seconds_count {total}",
+        f"kubeai_engine_ttft_seconds_sum {good * 0.2 + bad * 0.8}",
+        "kubeai_engine_queue_depth 0.0",
+        "kubeai_engine_active_requests 0.0",
+    ]) + "\n"
+
+
+def test_fleet_state_splits_per_version():
+    """Per-version rows ride `/v1/fleet/state` from the pod-hash label
+    alone — observable even with the rollout controller disabled."""
+    from benchmarks.fleet_telemetry_sim import _pod
+    from kubeai_tpu.fleet import FleetStateAggregator
+    from kubeai_tpu.routing.loadbalancer import LoadBalancer
+    from kubeai_tpu.routing.modelclient import ModelClient
+
+    clock = FakeClock(50.0)
+    store = KubeStore()
+    store.create(mk_model().to_dict())
+    expositions = {}
+    for idx, (hash_, good, bad) in enumerate(
+        [("oldhash", 40, 0), ("oldhash", 40, 0), ("newhash", 0, 20)]
+    ):
+        addr = f"10.0.0.{idx}:8000"
+        pod = _pod("m", idx, addr)
+        pod["metadata"]["labels"][md.POD_HASH_LABEL] = hash_
+        store.create(pod)
+        expositions[addr] = _exposition(good, bad)
+
+    lb = LoadBalancer(store, metrics=Metrics())
+    try:
+        lb.sync_all()
+        agg = FleetStateAggregator(
+            lb=lb, model_client=ModelClient(store), store=store,
+            metrics=Metrics(), interval_s=1.0, staleness_s=10.0,
+            fetch_metrics=lambda addr, timeout=5.0: expositions[addr],
+            fetch_state=lambda addr, timeout=5.0: {"model": "m",
+                                                   "healthy": True},
+            clock=clock,
+        )
+        agg.collect()
+        entry = agg.model_entry("m")
+        versions = entry["versions"]
+        assert set(versions) == {"oldhash", "newhash"}
+        old, new = versions["oldhash"], versions["newhash"]
+        assert (old["endpoints"], new["endpoints"]) == (2, 1)
+        assert old["ttft"]["count"] == 80.0
+        assert new["ttft"]["count"] == 20.0
+        assert new["ttft"]["p95_s"] > old["ttft"]["p95_s"]
+        # The flat per-endpoint records carry the version too.
+        for ep in entry["endpoints"].values():
+            assert ep["version"] in ("oldhash", "newhash")
+    finally:
+        lb.stop()

@@ -1,0 +1,149 @@
+"""Tier-1 assertion of the rollout game-day: the four-scenario seeded
+sim (clean ramp, latency regression, crashloop, slice-group roll)
+drives the real RolloutController / governor / LB / aggregator under
+one fake clock, and every invariant + module check from
+benchmarks/rollout_sim.py must hold here. Also pins the classic-plan
+byte-identity contract (no `rollout:` block => unchanged pod plans) and
+the dump -> replay byte-identity for both run logs and incident
+bundles."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+sys.path.insert(0, REPO_ROOT)
+
+from benchmarks.rollout_sim import (  # noqa: E402
+    ALL_CHECKS,
+    CANARY_PERCENT,
+    GROUP_REPLICAS,
+    NUM_HOSTS,
+    REPLICAS,
+    ROLLBACK_BOUND_S,
+    SCENARIOS,
+    SHARE_EPS,
+    check_classic_plan_unchanged,
+    check_clean_completes,
+    check_crashloop_rolls_back,
+    check_group_rolls_atomically,
+    check_latency_rolls_back,
+    check_no_violations,
+    check_rollback_bundle,
+    replay,
+    run_sim,
+    run_all,
+)
+
+pytestmark = pytest.mark.rollout
+
+
+@pytest.fixture(scope="module")
+def results():
+    return run_all(seed=0)
+
+
+def test_no_invariant_violations(results):
+    check_no_violations(results)
+
+
+def test_clean_rollout_completes_progressively(results):
+    check_clean_completes(results)
+
+
+def test_latency_regression_rolls_back(results):
+    check_latency_rolls_back(results)
+
+
+def test_latency_blast_radius_stayed_canary_sized(results):
+    r = results["latency"]
+    assert r["bad_share"] <= CANARY_PERCENT / 100.0 + SHARE_EPS
+    assert r["rollback_rel"] - r["mutate_rel"] <= ROLLBACK_BOUND_S
+
+
+def test_crashloop_rolls_back_without_serving(results):
+    check_crashloop_rolls_back(results)
+
+
+def test_group_rollout_is_atomic_and_paced(results):
+    check_group_rolls_atomically(results)
+    r = results["group"]
+    assert r["pods"]["new_ready"] == GROUP_REPLICAS * NUM_HOSTS
+
+
+def test_rollback_bundle_is_replayable(results):
+    check_rollback_bundle(results)
+
+
+def test_zero_client_errors_everywhere(results):
+    assert {s: results[s]["client_errors"] for s in SCENARIOS} == {
+        s: 0 for s in SCENARIOS
+    }
+
+
+def test_all_checks_is_complete(results):
+    """Every module-level check is wired into ALL_CHECKS (a check added
+    to the sim but not the tuple would silently never gate)."""
+    assert set(ALL_CHECKS) == {
+        check_no_violations, check_clean_completes,
+        check_latency_rolls_back, check_crashloop_rolls_back,
+        check_group_rolls_atomically, check_rollback_bundle,
+    }
+    for check in ALL_CHECKS:
+        check(results)
+
+
+# ---- determinism: dump -> replay ---------------------------------------------
+
+
+def test_run_log_replays_byte_identically(results, tmp_path):
+    path = tmp_path / "clean.jsonl"
+    results["clean"]["log"].dump(str(path))
+    header, cmp = replay(str(path))
+    assert header["scenario"] == "clean"
+    assert cmp["identical"], "replay diverged from the recorded log"
+
+
+def test_incident_bundle_replays_byte_identically(results, tmp_path):
+    r = results["latency"]
+    bundle = r["incidents"][0]
+    path = tmp_path / "rollback_bundle.jsonl"
+    path.write_text("".join(ln + "\n" for ln in bundle["lines"]))
+    header, cmp = replay(str(path))
+    assert header["bundle"] == "incident"
+    assert cmp["identical"], "bundle replay diverged"
+    assert cmp["rollback"]["verdict"] == "ttft_regression"
+
+
+def test_replay_rejects_foreign_dump(tmp_path):
+    path = tmp_path / "foreign.jsonl"
+    path.write_text(json.dumps({"sim": "other_sim"}) + "\n")
+    with pytest.raises(ValueError, match="other_sim"):
+        replay(str(path))
+
+
+def test_same_seed_is_deterministic(results):
+    again = run_sim("latency", seed=0)
+    assert again["log"].lines == results["latency"]["log"].lines
+
+
+# ---- the classic-plan regression pin -----------------------------------------
+
+
+def test_classic_plan_byte_identical_without_rollout_block():
+    """Models without a `rollout:` block get byte-identical pod plans
+    whether or not the controller is wired in — and single-replica
+    models bypass canarying entirely even with the block."""
+    check_classic_plan_unchanged()
+
+
+def test_clean_completion_left_no_state(results):
+    r = results["clean"]
+    payload = r["world"].rollout.state_payload()
+    assert payload["rollouts"] == {}
+    assert payload["condemned"] == {}
+    assert r["pods"]["old"] == 0 and r["pods"]["new_ready"] == REPLICAS
